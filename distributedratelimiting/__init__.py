@@ -1,0 +1,4 @@
+"""distributedratelimiting — TPU-native distributed rate limiting.
+
+The public package lives in :mod:`distributedratelimiting.redis_tpu`.
+"""

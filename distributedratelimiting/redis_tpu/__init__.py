@@ -1,0 +1,26 @@
+"""TPU-native distributed rate limiting.
+
+A brand-new framework with the capabilities of
+``ReubenBond/DistributedRateLimiting.Redis`` (see ``SURVEY.md`` at the repo
+root), re-designed TPU-first:
+
+- Per-key token-bucket state lives as structure-of-arrays in device HBM,
+  sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
+- The reference's Lua-in-Redis "kernels" (atomic refill-and-decrement,
+  decaying global counter) become jitted XLA / Pallas batch kernels; one
+  kernel launch amortizes what the reference paid one network round-trip for.
+- The store — not the client — remains the time authority: every kernel
+  launch receives a single host-injected monotonic ``now`` operand, giving
+  all keys in a batch one consistent clock (the property Redis ``TIME``
+  provided in the reference).
+- The two-level approximate algorithm (local scores + decaying global
+  counter + membership-free instance estimation) is preserved, with the
+  global tier realized as ``lax.psum`` over the mesh.
+
+Public API parallels .NET's ``System.Threading.RateLimiting`` contract that
+the reference implements (``RateLimiter``, ``RateLimitLease``,
+``PartitionedRateLimiter``), translated to idiomatic async Python.
+"""
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Device-side operations: pure bucket math (L0) and jitted batch kernels (L1).
+
+This package is the TPU equivalent of the reference's "store execution layer"
+— the Lua scripts embedded in
+``TokenBucket/RedisTokenBucketRateLimiter.cs:176-239`` and
+``ApproximateTokenBucket/RedisApproximateTokenBucketRateLimiter.cs:216-271``.
+Where Redis ran one Lua program atomically per key per call, we run one
+jitted/Pallas kernel over a whole micro-batch of keys per launch.
+"""

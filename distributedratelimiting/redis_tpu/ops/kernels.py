@@ -1,0 +1,345 @@
+"""L1 — jitted batch kernels over the keyed state table.
+
+Each function here is the moral equivalent of one prepared Lua script in the
+reference (``LuaScript.Prepare`` at ``RedisTokenBucketRateLimiter.cs:45``):
+traced and compiled once, then invoked per micro-batch. Differences, by
+design (TPU-first, SURVEY.md §7):
+
+- One launch serves a whole batch of keys (the reference paid one network
+  RTT per key per acquire, ``RedisTokenBucketRateLimiter.cs:63``).
+- Bucket parameters (capacity, fill rate) are *operands*, not constants
+  baked into compiled text, so one compilation serves every limiter config.
+- State buffers are donated: steady-state operation re-uses the same HBM
+  allocation, no copies of the (potentially multi-GB) table per launch.
+- Atomicity (invariant 3) holds at batch granularity: XLA executes the
+  whole gather → decide → scatter program as one serialized step over the
+  state arrays, exactly as Redis serialized Lua scripts. Duplicate keys
+  within one batch are serialized conservatively via
+  :func:`~.bucket_math.duplicate_prefix` (never over-admit; the host
+  batcher coalesces duplicates so the conservative path is rare).
+
+State layout is structure-of-arrays in HBM — ``tokens: f32[N]``,
+``last_ts: i32[N]``, ``exists: bool[N]`` — 9 bytes/key, so 10M keys ≈ 90 MB,
+comfortably resident on one chip and shardable along N over a mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+__all__ = [
+    "BucketState",
+    "CounterState",
+    "WindowState",
+    "init_bucket_state",
+    "init_counter_state",
+    "init_window_state",
+    "acquire_batch",
+    "sync_batch",
+    "window_acquire_batch",
+    "sweep_expired",
+    "sweep_counters",
+    "sweep_windows",
+    "rebase_bucket_epoch",
+    "rebase_counter_epoch",
+    "peek_batch",
+]
+
+
+class BucketState(NamedTuple):
+    """SoA token-bucket table ≙ the Redis hash ``{v, t}`` per key
+    (``RedisTokenBucketRateLimiter.cs:210-230``), plus an occupancy mask
+    standing in for Redis key existence."""
+
+    tokens: jax.Array   # f32[N]
+    last_ts: jax.Array  # i32[N]
+    exists: jax.Array   # bool[N]
+
+
+class CounterState(NamedTuple):
+    """SoA decaying-counter table ≙ the Redis hash ``{v, p, t}``
+    (``RedisApproximateTokenBucketRateLimiter.cs:265-268``)."""
+
+    value: jax.Array    # f32[N] decaying throttle score
+    period: jax.Array   # f32[N] EWMA of inter-sync interval (ticks)
+    last_ts: jax.Array  # i32[N]
+    exists: jax.Array   # bool[N]
+
+
+class WindowState(NamedTuple):
+    """SoA two-bucket sliding-window table (BASELINE config 4)."""
+
+    prev_count: jax.Array  # f32[N]
+    curr_count: jax.Array  # f32[N]
+    window_idx: jax.Array  # i32[N]
+    exists: jax.Array      # bool[N]
+
+
+def init_bucket_state(n: int) -> BucketState:
+    return BucketState(
+        tokens=jnp.zeros((n,), jnp.float32),
+        last_ts=jnp.zeros((n,), jnp.int32),
+        exists=jnp.zeros((n,), bool),
+    )
+
+
+def init_counter_state(n: int) -> CounterState:
+    return CounterState(
+        value=jnp.zeros((n,), jnp.float32),
+        period=jnp.zeros((n,), jnp.float32),
+        last_ts=jnp.zeros((n,), jnp.int32),
+        exists=jnp.zeros((n,), bool),
+    )
+
+
+def init_window_state(n: int) -> WindowState:
+    return WindowState(
+        prev_count=jnp.zeros((n,), jnp.float32),
+        curr_count=jnp.zeros((n,), jnp.float32),
+        window_idx=jnp.zeros((n,), jnp.int32),
+        exists=jnp.zeros((n,), bool),
+    )
+
+
+def _valid_slots(slots, valid, size):
+    """A row is live only if marked valid AND its slot is in range — an
+    out-of-range slot with ``valid=True`` (e.g. a stale directory entry) must
+    become a denied padding row, not a phantom grant against row 0/N-1."""
+    return valid & (slots >= 0) & (slots < size)
+
+
+def _gather_slots(slots, valid):
+    """Clamp invalid/padding rows to slot 0 for the gather; their results are
+    masked out and their scatters dropped."""
+    return jnp.where(valid, slots, 0)
+
+
+def _scatter_slots(slots, valid, size):
+    """Padding rows map past the end of the table ⇒ dropped by
+    ``mode='drop'`` scatters. (Negative indices would *wrap*, not drop.)"""
+    return jnp.where(valid, slots, size)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
+                  fill_rate_per_tick, *, handle_duplicates: bool = True):
+    """Atomic batched refill-and-decrement — the exact-bucket Lua kernel
+    (``RedisTokenBucketRateLimiter.cs:176-239``) over a micro-batch.
+
+    Args:
+      state: donated ``BucketState`` (buffers re-used in place).
+      slots: i32[B] table indices (-1 or any out-of-range ⇒ padding row).
+      counts: i32[B] requested permits (>= 0; 0 behaves as a probe).
+      valid: bool[B] real-request mask.
+      now: i32 scalar batch timestamp (host is time authority, invariant 1).
+      capacity, fill_rate_per_tick: f32 scalars (operands, not constants).
+      handle_duplicates: statically enables the O(B²) same-slot
+        serialization. The host batcher coalesces duplicates, so the fast
+        variant (False) is used whenever a flush is duplicate-free.
+
+    Returns:
+      ``(new_state, granted bool[B], remaining f32[B])`` where ``remaining``
+      is each request's post-decision view of its bucket (conservative under
+      in-batch duplication) — the analogue of the script's ``new_v`` reply
+      (``:238``).
+    """
+    valid = _valid_slots(slots, valid, state.tokens.shape[0])
+    gs = _gather_slots(slots, valid)
+    t_old = state.tokens[gs]
+    ts_old = state.last_ts[gs]
+    ex_old = state.exists[gs]
+
+    counts_f = jnp.asarray(counts, jnp.float32)
+    refilled = bm.refill_or_init(t_old, ts_old, ex_old, now, capacity,
+                                 fill_rate_per_tick)
+
+    if handle_duplicates:
+        prefix = bm.duplicate_prefix(slots, counts, valid)
+    else:
+        prefix = jnp.zeros_like(counts_f)
+
+    granted = valid & (refilled >= prefix + counts_f)
+    consumed = jnp.where(granted, counts_f, 0.0)
+    remaining = jnp.where(valid, jnp.maximum(refilled - prefix - consumed, 0.0), 0.0)
+
+    ss = _scatter_slots(slots, valid, state.tokens.shape[0])
+    # Duplicates all write the identical refilled value (same now, same old
+    # state), then consumption accumulates via scatter-add.
+    new_tokens = state.tokens.at[ss].set(refilled, mode="drop")
+    new_tokens = new_tokens.at[ss].add(-consumed, mode="drop")
+    new_last_ts = state.last_ts.at[ss].set(
+        jnp.asarray(now, jnp.int32), mode="drop"
+    )
+    new_exists = state.exists.at[ss].set(True, mode="drop")
+
+    return BucketState(new_tokens, new_last_ts, new_exists), granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0)
+def sync_batch(state: CounterState, slots, local_counts, valid, now,
+               decay_rate_per_tick):
+    """Batched decaying-counter sync — the approximate-bucket Lua kernel
+    (``RedisApproximateTokenBucketRateLimiter.cs:216-271``) over a batch of
+    global counters.
+
+    One row per counter per flush (the host aggregates each limiter's local
+    score before syncing, so duplicate slots do not occur in practice; if
+    they do, decayed-value writes coincide and count adds accumulate, which
+    over-counts only the EWMA, never the score).
+
+    Returns ``(new_state, global_scores f32[B], period_ewmas f32[B])`` — the
+    script's ``{new_v, new_p}`` reply (``:270``).
+    """
+    valid = _valid_slots(slots, valid, state.value.shape[0])
+    gs = _gather_slots(slots, valid)
+    v_old = state.value[gs]
+    p_old = state.period[gs]
+    ts_old = state.last_ts[gs]
+    ex_old = state.exists[gs]
+
+    counts_f = jnp.asarray(local_counts, jnp.float32)
+    decayed, new_period = bm.decay_core(
+        v_old, p_old, ts_old, ex_old, now, decay_rate_per_tick
+    )
+    new_value = decayed + counts_f
+
+    ss = _scatter_slots(slots, valid, state.value.shape[0])
+    value_arr = state.value.at[ss].set(decayed, mode="drop")
+    value_arr = value_arr.at[ss].add(counts_f * valid, mode="drop")
+    period_arr = state.period.at[ss].set(new_period, mode="drop")
+    ts_arr = state.last_ts.at[ss].set(jnp.asarray(now, jnp.int32), mode="drop")
+    ex_arr = state.exists.at[ss].set(True, mode="drop")
+
+    return CounterState(value_arr, period_arr, ts_arr, ex_arr), new_value, new_period
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def window_acquire_batch(state: WindowState, slots, counts, valid, now, limit,
+                         window_ticks, *, handle_duplicates: bool = True):
+    """Batched sliding-window acquire (BASELINE config 4).
+
+    Same contract as :func:`acquire_batch`; grant iff the interpolated
+    trailing-window estimate plus this request stays within ``limit``.
+    """
+    valid = _valid_slots(slots, valid, state.prev_count.shape[0])
+    gs = _gather_slots(slots, valid)
+    prev_old = state.prev_count[gs]
+    curr_old = state.curr_count[gs]
+    idx_old = state.window_idx[gs]
+    ex_old = state.exists[gs]
+
+    counts_f = jnp.asarray(counts, jnp.float32)
+    prev_new, curr_new, idx_new = bm.sliding_window_advance(
+        prev_old, curr_old, idx_old, ex_old, now, window_ticks
+    )
+    est = bm.sliding_window_estimate(prev_new, curr_new, idx_new, now, window_ticks)
+
+    if handle_duplicates:
+        prefix = bm.duplicate_prefix(slots, counts, valid)
+    else:
+        prefix = jnp.zeros_like(counts_f)
+
+    granted = valid & (est + prefix + counts_f <= jnp.asarray(limit, jnp.float32))
+    consumed = jnp.where(granted, counts_f, 0.0)
+    remaining = jnp.where(
+        valid,
+        jnp.maximum(jnp.asarray(limit, jnp.float32) - est - prefix - consumed, 0.0),
+        0.0,
+    )
+
+    ss = _scatter_slots(slots, valid, state.prev_count.shape[0])
+    prev_arr = state.prev_count.at[ss].set(prev_new, mode="drop")
+    curr_arr = state.curr_count.at[ss].set(curr_new, mode="drop")
+    curr_arr = curr_arr.at[ss].add(consumed, mode="drop")
+    idx_arr = state.window_idx.at[ss].set(idx_new, mode="drop")
+    ex_arr = state.exists.at[ss].set(True, mode="drop")
+
+    return WindowState(prev_arr, curr_arr, idx_arr, ex_arr), granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0)
+def sweep_expired(state: BucketState, now, capacity, fill_rate_per_tick):
+    """TTL eviction pass — invariant 5 (state self-expiry, bounded memory).
+
+    A slot whose bucket has been idle past its time-to-full-refill TTL
+    (clamped ``[1s, 1yr]``, ``RedisTokenBucketRateLimiter.cs:234-235``) is
+    indistinguishable from init-on-miss, so `exists` is simply cleared. One
+    vectorized pass over the whole table; the host runs it on a slow cadence
+    (it also bounds int32 tick staleness far below wraparound).
+
+    Returns ``(new_state, freed bool[N])`` — `freed` lets the host directory
+    reclaim slot ids.
+    """
+    ttl = bm.time_to_full_ttl(state.tokens, capacity, fill_rate_per_tick)
+    expired = state.exists & (bm.elapsed_ticks(now, state.last_ts) >= ttl)
+    new_exists = state.exists & ~expired
+    return BucketState(state.tokens, state.last_ts, new_exists), expired
+
+
+@jax.jit
+def peek_batch(state: BucketState, slots, valid, now, capacity,
+               fill_rate_per_tick):
+    """Read-only availability estimate (``GetAvailablePermits`` support,
+    invariant 7) — refill math applied without writing state back."""
+    valid = _valid_slots(slots, valid, state.tokens.shape[0])
+    gs = _gather_slots(slots, valid)
+    refilled = bm.refill_or_init(
+        state.tokens[gs], state.last_ts[gs], state.exists[gs], now, capacity,
+        fill_rate_per_tick,
+    )
+    return jnp.where(valid, jnp.floor(refilled), 0.0)
+
+
+@partial(jax.jit, donate_argnums=0)
+def sweep_counters(state: CounterState, now):
+    """TTL eviction for the decaying-counter table: fixed 86400 s TTL, the
+    reference's ``EXPIRE`` on the global counter hash
+    (``RedisApproximateTokenBucketRateLimiter.cs:268``)."""
+    expired = state.exists & (
+        bm.elapsed_ticks(now, state.last_ts) >= bm.GLOBAL_COUNTER_TTL_TICKS
+    )
+    return CounterState(
+        state.value, state.period, state.last_ts, state.exists & ~expired
+    ), expired
+
+
+@partial(jax.jit, donate_argnums=0)
+def sweep_windows(state: WindowState, now, window_ticks):
+    """TTL eviction for the sliding-window table: a slot idle for two full
+    windows carries no information (both counters would roll to zero)."""
+    idx_now = jnp.asarray(now, jnp.int32) // jnp.asarray(window_ticks, jnp.int32)
+    expired = state.exists & (idx_now - state.window_idx >= 2)
+    return WindowState(
+        state.prev_count, state.curr_count, state.window_idx,
+        state.exists & ~expired,
+    ), expired
+
+
+@partial(jax.jit, donate_argnums=0)
+def rebase_bucket_epoch(state: BucketState, offset_ticks):
+    """Shift every timestamp back by ``offset_ticks`` — the host calls this
+    (and rebases its clock epoch identically) before int32 tick time can
+    overflow (~24 days of uptime at 1024 ticks/s). Elapsed values are
+    invariant under the joint shift."""
+    new_ts = jnp.where(
+        state.exists,
+        jnp.maximum(state.last_ts - jnp.asarray(offset_ticks, jnp.int32), 0),
+        state.last_ts,
+    )
+    return BucketState(state.tokens, new_ts, state.exists)
+
+
+@partial(jax.jit, donate_argnums=0)
+def rebase_counter_epoch(state: CounterState, offset_ticks):
+    new_ts = jnp.where(
+        state.exists,
+        jnp.maximum(state.last_ts - jnp.asarray(offset_ticks, jnp.int32), 0),
+        state.last_ts,
+    )
+    return CounterState(state.value, state.period, new_ts, state.exists)

@@ -1,0 +1,7 @@
+"""Host runtime: clocks, the device bucket store, micro-batching, queueing.
+
+This is the layer the reference outsourced to Redis + StackExchange.Redis
+(connection manager, §2 #6 of SURVEY.md) plus the client-side queueing
+machinery (§2 #5). Here the "store" is device HBM fronted by an asyncio
+micro-batcher, and the "connection" is a kernel launch.
+"""

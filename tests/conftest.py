@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (SURVEY.md §4 implication (d) — this replaces the
+reference's Orleans-localhost multi-silo trick, ``TestApp/Program.cs:37-104``).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

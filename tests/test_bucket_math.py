@@ -1,0 +1,234 @@
+"""L0 unit/property tests for the pure bucket math.
+
+The kernel logic is deterministic given injected time (SURVEY.md §4
+implication (a)); each semantic invariant from SURVEY.md §2 gets a direct
+test here.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+TPS = bm.TICKS_PER_SECOND
+
+
+def f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def i32(x):
+    return jnp.asarray(x, jnp.int32)
+
+
+class TestElapsed:
+    def test_forward(self):
+        assert bm.elapsed_ticks(i32(100), i32(40)) == 60
+
+    def test_clock_regression_clamped_to_zero(self):
+        # Invariant 1: failover to a store whose clock is behind must not
+        # mint/destroy tokens (RedisTokenBucketRateLimiter.cs:218).
+        assert bm.elapsed_ticks(i32(40), i32(100)) == 0
+
+
+class TestRefill:
+    def test_lazy_refill_linear(self):
+        # 2 tokens/s, 3 s elapsed, from 1 token → 7
+        out = bm.refill(f32(1.0), i32(0), i32(3 * TPS), 100.0, 2.0 / TPS)
+        assert np.isclose(float(out), 7.0)
+
+    def test_refill_clamped_to_capacity(self):
+        # Invariant 2: forward jump grants at most one full bucket (:221).
+        out = bm.refill(f32(1.0), i32(0), i32(10**9), 100.0, 2.0 / TPS)
+        assert float(out) == 100.0
+
+    def test_refill_never_negative_elapsed(self):
+        out = bm.refill(f32(5.0), i32(1000), i32(500), 100.0, 2.0 / TPS)
+        assert float(out) == 5.0
+
+
+class TestRefillAndDecrement:
+    def test_all_or_nothing(self):
+        # Invariant 4: request of N succeeds iff refilled >= N (:224-227).
+        tokens, ts, granted = bm.refill_and_decrement(
+            f32([5.0, 5.0]), i32([0, 0]), jnp.array([True, True]),
+            i32(0), i32([5, 6]), 10.0, 1.0 / TPS,
+        )
+        assert list(np.asarray(granted)) == [True, False]
+        assert np.allclose(np.asarray(tokens), [0.0, 5.0])
+
+    def test_init_on_miss_full_bucket(self):
+        # A missing key starts FULL (:210-215) — wiped store self-heals.
+        tokens, ts, granted = bm.refill_and_decrement(
+            f32([123.0]), i32([999]), jnp.array([False]),
+            i32(5), i32([4]), 10.0, 1.0 / TPS,
+        )
+        assert bool(granted[0])
+        assert float(tokens[0]) == 6.0
+        assert int(ts[0]) == 5
+
+    def test_zero_count_probe_consumes_nothing(self):
+        tokens, _, granted = bm.refill_and_decrement(
+            f32([3.0]), i32([0]), jnp.array([True]),
+            i32(0), i32([0]), 10.0, 1.0 / TPS,
+        )
+        assert bool(granted[0])
+        assert float(tokens[0]) == 3.0
+
+    def test_conservation_property(self, rng):
+        # Over a random op sequence on one key: balance always in
+        # [0, capacity]; grants exactly account for decrements.
+        cap, rate = 50.0, 8.0 / TPS
+        tokens, ts, exists = f32(0.0), i32(0), jnp.array(True)
+        now = 0
+        for _ in range(200):
+            now += int(rng.integers(0, 2 * TPS))
+            count = int(rng.integers(0, 12))
+            prev = float(bm.refill(tokens, ts, i32(now), cap, rate))
+            tokens, ts, granted = bm.refill_and_decrement(
+                tokens, ts, exists, i32(now), i32(count), cap, rate
+            )
+            t = float(tokens)
+            assert 0.0 <= t <= cap
+            if bool(granted):
+                assert np.isclose(t, prev - count, atol=1e-3)
+            else:
+                assert np.isclose(t, prev, atol=1e-3)
+                assert prev < count
+
+
+class TestTtl:
+    def test_time_to_full(self):
+        # 100-cap bucket at 40 tokens, 2 tokens/s → 30 s to full.
+        ttl = bm.time_to_full_ttl(f32(40.0), 100.0, 2.0 / TPS)
+        assert int(ttl) == 30 * TPS
+
+    def test_clamped_to_min_1s(self):
+        ttl = bm.time_to_full_ttl(f32(100.0), 100.0, 2.0 / TPS)
+        assert int(ttl) == bm.MIN_TTL_TICKS
+
+    def test_clamped_to_max(self):
+        ttl = bm.time_to_full_ttl(f32(0.0), 1e9, 1e-12)
+        assert int(ttl) <= min(bm.MAX_TTL_TICKS, 2**31 - 1)
+
+
+class TestDecayAndAdd:
+    def test_decay_formula(self):
+        # new_v = max(0, v - delta*decay) + count  (:258)
+        v, p, ts = bm.decay_and_add(
+            f32(10.0), f32(float(TPS)), i32(0), jnp.array(True),
+            i32(2 * TPS), f32(3.0), 2.0 / TPS,
+        )
+        assert np.isclose(float(v), 10.0 - 4.0 + 3.0)
+
+    def test_decay_floor_zero(self):
+        v, _, _ = bm.decay_and_add(
+            f32(1.0), f32(0.0), i32(0), jnp.array(True),
+            i32(100 * TPS), f32(5.0), 2.0 / TPS,
+        )
+        assert float(v) == 5.0
+
+    def test_ewma(self):
+        # new_p = 0.8*p + 0.2*delta  (:260-262)
+        _, p, _ = bm.decay_and_add(
+            f32(0.0), f32(1000.0), i32(0), jnp.array(True),
+            i32(500), f32(0.0), 1.0 / TPS,
+        )
+        assert np.isclose(float(p), 0.8 * 1000.0 + 0.2 * 500.0)
+
+    def test_init_on_miss(self):
+        v, p, ts = bm.decay_and_add(
+            f32(99.0), f32(99.0), i32(7), jnp.array(False),
+            i32(1000), f32(4.0), 1.0 / TPS,
+        )
+        assert float(v) == 4.0
+        assert float(p) == 1000.0  # stale ts masked on miss; seed = elapsed-from-epoch
+        assert int(ts) == 1000
+
+
+class TestInstanceEstimate:
+    def test_k_clients(self):
+        # k clients syncing every period → observed interval ≈ period/k.
+        period = 1 * TPS
+        for k in (1, 2, 5, 20):
+            est = bm.instance_count_estimate(period, f32(period / k))
+            assert int(est) == k
+
+    def test_floor_one(self):
+        est = bm.instance_count_estimate(TPS, f32(100 * TPS))
+        assert int(est) == 1
+
+
+class TestAvailableTokens:
+    def test_fair_share_formula(self):
+        # ceil((limit - global)/instances) - local  (:37)
+        avail = bm.available_tokens(100.0, f32(40.0), 4, f32(5.0))
+        assert float(avail) == 10.0  # ceil(60/4)=15, minus 5
+
+    def test_floor_zero(self):
+        avail = bm.available_tokens(100.0, f32(100.0), 1, f32(50.0))
+        assert float(avail) == 0.0
+
+
+class TestRetryAfter:
+    def test_corrected_dimension(self):
+        # deficit / rate, NOT deficit * rate (reference defect, SURVEY §2).
+        # 10-token deficit at 2 tokens/s → 5 s.
+        t = bm.retry_after_ticks(f32(10.0), 2.0 / TPS)
+        assert int(t) == 5 * TPS
+
+
+class TestSlidingWindow:
+    W = 10 * TPS
+
+    def test_advance_same_window(self):
+        p, c, i = bm.sliding_window_advance(
+            f32(3.0), f32(4.0), i32(5), jnp.array(True), i32(5 * self.W + 1), self.W
+        )
+        assert (float(p), float(c), int(i)) == (3.0, 4.0, 5)
+
+    def test_advance_one_window_rolls(self):
+        p, c, i = bm.sliding_window_advance(
+            f32(3.0), f32(4.0), i32(5), jnp.array(True), i32(6 * self.W), self.W
+        )
+        assert (float(p), float(c), int(i)) == (4.0, 0.0, 6)
+
+    def test_advance_two_windows_zeros(self):
+        p, c, i = bm.sliding_window_advance(
+            f32(3.0), f32(4.0), i32(5), jnp.array(True), i32(8 * self.W), self.W
+        )
+        assert (float(p), float(c), int(i)) == (0.0, 0.0, 8)
+
+    def test_estimate_interpolation(self):
+        # Halfway through current window: est = curr + 0.5*prev.
+        est = bm.sliding_window_estimate(
+            f32(10.0), f32(4.0), i32(6), i32(6 * self.W + self.W // 2), self.W
+        )
+        assert np.isclose(float(est), 4.0 + 5.0)
+
+    def test_acquire_grant_and_deny(self):
+        p, c, i, g = bm.sliding_window_acquire(
+            f32(0.0), f32(8.0), i32(0), jnp.array(True),
+            i32(1), i32(2), 10.0, self.W,
+        )
+        assert bool(g) and float(c) == 10.0
+        p, c, i, g = bm.sliding_window_acquire(
+            p, c, i, jnp.array(True), i32(2), i32(1), 10.0, self.W
+        )
+        assert not bool(g) and float(c) == 10.0
+
+
+class TestDuplicatePrefix:
+    def test_prefix_counts_earlier_same_slot(self):
+        slots = jnp.array([3, 7, 3, 3, 7])
+        counts = jnp.array([2, 5, 1, 4, 1])
+        valid = jnp.array([True] * 5)
+        pref = np.asarray(bm.duplicate_prefix(slots, counts, valid))
+        assert list(pref) == [0.0, 0.0, 2.0, 3.0, 5.0]
+
+    def test_invalid_rows_excluded(self):
+        slots = jnp.array([3, 3, 3])
+        counts = jnp.array([2, 5, 1])
+        valid = jnp.array([True, False, True])
+        pref = np.asarray(bm.duplicate_prefix(slots, counts, valid))
+        assert list(pref) == [0.0, 2.0, 2.0]

@@ -1,0 +1,286 @@
+"""L1 kernel tests: the jitted batch kernels vs a serial Python simulator.
+
+The simulator replays the reference's Lua semantics one request at a time
+(``RedisTokenBucketRateLimiter.cs:176-239``); the batch kernel must agree on
+every grant/state when batches are duplicate-free, and must never over-admit
+when they are not (conservative in-batch serialization).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+from distributedratelimiting.redis_tpu.ops import kernels as K
+
+TPS = bm.TICKS_PER_SECOND
+
+
+class SerialBucketSim:
+    """Pure-Python serial replay of the exact-bucket Lua kernel."""
+
+    def __init__(self, n, capacity, fill_rate_per_tick):
+        self.tokens = np.zeros(n)
+        self.last_ts = np.zeros(n, np.int64)
+        self.exists = np.zeros(n, bool)
+        self.cap = capacity
+        self.rate = fill_rate_per_tick
+
+    def acquire(self, slot, count, now):
+        if not self.exists[slot]:
+            refilled = self.cap
+        else:
+            delta = max(0, now - self.last_ts[slot])
+            refilled = min(self.cap, self.tokens[slot] + delta * self.rate)
+        granted = refilled >= count
+        self.tokens[slot] = refilled - (count if granted else 0)
+        self.last_ts[slot] = now
+        self.exists[slot] = True
+        return granted
+
+
+def run_batch(state, slots, counts, now, cap, rate, handle_duplicates=True):
+    b = len(slots)
+    return K.acquire_batch(
+        state,
+        jnp.asarray(slots, jnp.int32),
+        jnp.asarray(counts, jnp.int32),
+        jnp.ones((b,), bool),
+        jnp.asarray(now, jnp.int32),
+        jnp.float32(cap),
+        jnp.float32(rate),
+        handle_duplicates=handle_duplicates,
+    )
+
+
+class TestAcquireBatch:
+    def test_matches_serial_sim_unique_slots(self, rng):
+        n, cap, rate = 64, 20.0, 4.0 / TPS
+        state = K.init_bucket_state(n)
+        sim = SerialBucketSim(n, cap, rate)
+        now = 0
+        for _ in range(30):
+            now += int(rng.integers(0, TPS))
+            batch = rng.choice(n, size=16, replace=False)
+            counts = rng.integers(0, 8, size=16)
+            state, granted, remaining = run_batch(state, batch, counts, now, cap, rate)
+            granted = np.asarray(granted)
+            for s, c, g in zip(batch, counts, granted):
+                assert sim.acquire(s, c, now) == g, (s, c, now)
+            np.testing.assert_allclose(
+                np.asarray(state.tokens)[batch], sim.tokens[batch], atol=1e-2
+            )
+
+    def test_duplicates_never_over_admit(self, rng):
+        # Many requests to few slots in one batch: total granted per slot
+        # must fit within that slot's refilled balance (invariant 3 at batch
+        # granularity), regardless of grant pattern.
+        n, cap, rate = 8, 10.0, 0.0
+        for trial in range(10):
+            state = K.init_bucket_state(n)
+            slots = rng.integers(0, n, size=64)
+            counts = rng.integers(1, 6, size=64)
+            state, granted, _ = run_batch(state, slots, counts, 1, cap, rate)
+            granted = np.asarray(granted)
+            for s in range(n):
+                m = slots == s
+                assert counts[m][granted[m]].sum() <= cap
+
+    def test_duplicates_serialize_in_batch_order(self):
+        # capacity 10, zero rate: requests [6, 6, 3] to one slot →
+        # serial order grants 6, denies 6, conservative prefix denies 3 too
+        # (prefix counts the denied 6) — allowed to under-admit, never over.
+        state = K.init_bucket_state(4)
+        state, granted, _ = run_batch(state, [2, 2, 2], [6, 6, 3], 1, 10.0, 0.0)
+        g = list(np.asarray(granted))
+        assert g[0] is np.True_
+        assert g[1] is np.False_
+        assert float(state.tokens[2]) == 4.0
+
+    def test_padding_rows_untouched(self):
+        state = K.init_bucket_state(4)
+        b = 4
+        state, granted, remaining = K.acquire_batch(
+            state,
+            jnp.asarray([1, -1, 2, -1], jnp.int32),
+            jnp.asarray([3, 5, 2, 7], jnp.int32),
+            jnp.asarray([True, False, True, False]),
+            jnp.int32(10),
+            jnp.float32(10.0),
+            jnp.float32(0.0),
+        )
+        assert list(np.asarray(granted)) == [True, False, True, False]
+        assert not bool(state.exists[0]) and not bool(state.exists[3])
+        assert bool(state.exists[1]) and bool(state.exists[2])
+
+    def test_fast_path_no_duplicates_flag(self, rng):
+        n, cap, rate = 32, 15.0, 2.0 / TPS
+        s1 = K.init_bucket_state(n)
+        s2 = K.init_bucket_state(n)
+        slots = rng.choice(n, size=8, replace=False)
+        counts = rng.integers(0, 6, size=8)
+        s1, g1, r1 = run_batch(s1, slots, counts, 100, cap, rate, True)
+        s2, g2, r2 = run_batch(s2, slots, counts, 100, cap, rate, False)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        np.testing.assert_allclose(np.asarray(s1.tokens), np.asarray(s2.tokens))
+
+
+class TestSyncBatch:
+    def test_decay_add_and_ewma(self):
+        state = K.init_counter_state(4)
+        decay = 2.0 / TPS
+        # First sync at t=TPS: init-on-miss, v = count, p = now (epoch delta).
+        state, v, p = K.sync_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([6.0], jnp.float32),
+            jnp.asarray([True]), jnp.int32(TPS), jnp.float32(decay),
+        )
+        assert float(v[0]) == 6.0
+        assert float(p[0]) == TPS
+        # Second sync 2 s later: v = max(0, 6 - 4) + 5 = 7.
+        state, v, p = K.sync_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([5.0], jnp.float32),
+            jnp.asarray([True]), jnp.int32(3 * TPS), jnp.float32(decay),
+        )
+        assert np.isclose(float(v[0]), 7.0)
+        assert np.isclose(float(p[0]), 0.8 * TPS + 0.2 * 2 * TPS)
+        assert float(state.value[1]) == float(v[0])
+
+    def test_independent_counters(self):
+        state = K.init_counter_state(8)
+        state, v, _ = K.sync_batch(
+            state, jnp.asarray([0, 5], jnp.int32),
+            jnp.asarray([3.0, 9.0], jnp.float32),
+            jnp.asarray([True, True]), jnp.int32(10), jnp.float32(0.0),
+        )
+        assert list(np.asarray(v)) == [3.0, 9.0]
+        assert float(state.value[5]) == 9.0
+
+
+class TestWindowAcquireBatch:
+    W = 10 * TPS
+
+    def test_grant_then_deny_at_limit(self):
+        state = K.init_window_state(4)
+        state, g, r = K.window_acquire_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([8], jnp.int32),
+            jnp.asarray([True]), jnp.int32(1), jnp.float32(10.0),
+            jnp.int32(self.W),
+        )
+        assert bool(g[0])
+        state, g, r = K.window_acquire_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([5], jnp.int32),
+            jnp.asarray([True]), jnp.int32(2), jnp.float32(10.0),
+            jnp.int32(self.W),
+        )
+        assert not bool(g[0])
+
+    def test_window_rolloff_readmits(self):
+        state = K.init_window_state(4)
+        state, g, _ = K.window_acquire_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([10], jnp.int32),
+            jnp.asarray([True]), jnp.int32(1), jnp.float32(10.0),
+            jnp.int32(self.W),
+        )
+        assert bool(g[0])
+        # Two full windows later the old consumption is gone entirely.
+        state, g, _ = K.window_acquire_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([10], jnp.int32),
+            jnp.asarray([True]), jnp.int32(2 * self.W + 1), jnp.float32(10.0),
+            jnp.int32(self.W),
+        )
+        assert bool(g[0])
+
+
+class TestSweep:
+    def test_evicts_idle_full_buckets_only(self):
+        cap, rate = 10.0, 1.0 / TPS  # time-to-full from empty = 10 s
+        state = K.init_bucket_state(4)
+        # Slot 1 drained at t=0; slot 2 untouched (doesn't exist).
+        state, g, _ = run_batch(state, [1], [10], 0, cap, rate)
+        assert bool(g[0])
+        # 5 s later: not yet refillable to full → kept.
+        state, freed = K.sweep_expired(
+            state, jnp.int32(5 * TPS), jnp.float32(cap), jnp.float32(rate)
+        )
+        assert not bool(freed[1]) and bool(state.exists[1])
+        # 10 s later: bucket would be full → evicted.
+        state, freed = K.sweep_expired(
+            state, jnp.int32(10 * TPS), jnp.float32(cap), jnp.float32(rate)
+        )
+        assert bool(freed[1]) and not bool(state.exists[1])
+        assert not bool(freed[2])
+
+    def test_evicted_slot_reinitializes_full(self):
+        cap, rate = 10.0, 1.0 / TPS
+        state = K.init_bucket_state(4)
+        state, _, _ = run_batch(state, [1], [10], 0, cap, rate)
+        state, _ = K.sweep_expired(
+            state, jnp.int32(20 * TPS), jnp.float32(cap), jnp.float32(rate)
+        )
+        # Init-on-miss semantics: next touch sees a full bucket.
+        state, g, _ = run_batch(state, [1], [10], 20 * TPS + 1, cap, rate)
+        assert bool(g[0])
+
+
+class TestPeek:
+    def test_readonly_estimate(self):
+        cap, rate = 10.0, 2.0 / TPS
+        state = K.init_bucket_state(4)
+        state, _, _ = run_batch(state, [1], [8], 0, cap, rate)
+        est = K.peek_batch(
+            state, jnp.asarray([1, 2], jnp.int32), jnp.asarray([True, True]),
+            jnp.int32(2 * TPS), jnp.float32(cap), jnp.float32(rate),
+        )
+        assert float(est[0]) == 6.0   # 2 + 2*2
+        assert float(est[1]) == 10.0  # missing key reads full
+        # State unchanged by peek.
+        assert float(state.tokens[1]) == 2.0
+
+
+class TestSlotValidation:
+    def test_out_of_range_slot_is_denied_not_phantom_granted(self):
+        state = K.init_bucket_state(4)
+        state, granted, _ = K.acquire_batch(
+            state,
+            jnp.asarray([7, 1], jnp.int32),  # 7 out of range for N=4
+            jnp.asarray([1, 1], jnp.int32),
+            jnp.asarray([True, True]),
+            jnp.int32(0), jnp.float32(10.0), jnp.float32(0.0),
+        )
+        assert list(np.asarray(granted)) == [False, True]
+        assert not bool(state.exists[3])  # no wrap/clamp write
+
+
+class TestAuxSweepsAndRebase:
+    def test_counter_sweep_86400s_ttl(self):
+        state = K.init_counter_state(4)
+        state, _, _ = K.sync_batch(
+            state, jnp.asarray([2], jnp.int32), jnp.asarray([5.0], jnp.float32),
+            jnp.asarray([True]), jnp.int32(0), jnp.float32(0.0),
+        )
+        state, freed = K.sweep_counters(state, jnp.int32(bm.GLOBAL_COUNTER_TTL_TICKS))
+        assert bool(freed[2]) and not bool(state.exists[2])
+
+    def test_window_sweep_two_idle_windows(self):
+        W = 10 * TPS
+        state = K.init_window_state(4)
+        state, g, _ = K.window_acquire_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([1], jnp.int32),
+            jnp.asarray([True]), jnp.int32(1), jnp.float32(10.0), jnp.int32(W),
+        )
+        state, freed = K.sweep_windows(state, jnp.int32(W + 1), jnp.int32(W))
+        assert not bool(freed[1])
+        state, freed = K.sweep_windows(state, jnp.int32(2 * W + 1), jnp.int32(W))
+        assert bool(freed[1]) and not bool(state.exists[1])
+
+    def test_epoch_rebase_preserves_elapsed(self):
+        cap, rate = 10.0, 1.0 / TPS
+        state = K.init_bucket_state(4)
+        state, _, _ = run_batch(state, [1], [10], 5 * TPS, cap, rate)
+        # Rebase both the table and the caller's clock by 4 s.
+        state = K.rebase_bucket_epoch(state, jnp.int32(4 * TPS))
+        # 3 s of refill measured in the new epoch: now = (5-4)+3 = 4 s.
+        est = K.peek_batch(
+            state, jnp.asarray([1], jnp.int32), jnp.asarray([True]),
+            jnp.int32(4 * TPS), jnp.float32(cap), jnp.float32(rate),
+        )
+        assert float(est[0]) == 3.0
