@@ -24,3 +24,68 @@ the reference implements (``RateLimiter``, ``RateLimitLease``,
 
 
 __version__ = "0.1.0"
+
+from distributedratelimiting.redis_tpu.models.base import (
+    MetadataName,
+    RateLimitLease,
+    RateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import (
+    ApproximateTokenBucketOptions,
+    SlidingWindowOptions,
+    TokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.token_bucket import TokenBucketRateLimiter
+from distributedratelimiting.redis_tpu.models.approximate import (
+    ApproximateTokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.sliding_window import (
+    SlidingWindowRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.partitioned import PartitionedRateLimiter
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    BucketStore,
+    DeviceBucketStore,
+    InProcessBucketStore,
+    SyncResult,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import (
+    ManualClock,
+    MonotonicClock,
+    TICKS_PER_SECOND,
+)
+from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
+from distributedratelimiting.redis_tpu.utils.registry import (
+    ServiceRegistry,
+    add_tpu_approximate_token_bucket_rate_limiter,
+    add_tpu_sliding_window_rate_limiter,
+    add_tpu_token_bucket_rate_limiter,
+)
+
+__all__ = [
+    "MetadataName",
+    "RateLimitLease",
+    "RateLimiter",
+    "TokenBucketOptions",
+    "ApproximateTokenBucketOptions",
+    "SlidingWindowOptions",
+    "TokenBucketRateLimiter",
+    "ApproximateTokenBucketRateLimiter",
+    "SlidingWindowRateLimiter",
+    "PartitionedRateLimiter",
+    "AcquireResult",
+    "SyncResult",
+    "BucketStore",
+    "DeviceBucketStore",
+    "InProcessBucketStore",
+    "ManualClock",
+    "MonotonicClock",
+    "TICKS_PER_SECOND",
+    "QueueProcessingOrder",
+    "ServiceRegistry",
+    "add_tpu_token_bucket_rate_limiter",
+    "add_tpu_approximate_token_bucket_rate_limiter",
+    "add_tpu_sliding_window_rate_limiter",
+    "__version__",
+]

@@ -1,0 +1,8 @@
+"""Limiter model families: the public API surface.
+
+The ``models/`` package holds the client-side policy layer — the analogue of
+the reference's L2 limiter layer (SURVEY.md §1): exact and approximate token
+buckets, the sliding-window variant, and the partitioned (per-key) façade,
+all implementing a Python translation of the
+``System.Threading.RateLimiting.RateLimiter`` contract.
+"""

@@ -1,0 +1,135 @@
+"""The ``RateLimiter`` abstract contract and lease types.
+
+A Python translation of the abstract surface the reference implements from
+the ``System.Threading.RateLimiting`` package (SURVEY.md §2 invariant 7):
+
+=====================  ====================================
+.NET                   here
+=====================  ====================================
+``Acquire(int)``       ``acquire(permits)`` (sync)
+``WaitAsync(int, ct)`` ``await acquire_async(permits)``
+``GetAvailablePermits````available_permits()``
+``IdleDuration``       ``idle_duration`` (seconds or None)
+``Dispose/DisposeAsync````close()`` / ``await aclose()``
+``RateLimitLease``     :class:`RateLimitLease`
+``MetadataName``       :class:`MetadataName`
+=====================  ====================================
+
+Contract points preserved: zero-permit probe semantics, ``ValueError`` when
+``permits`` exceeds the configured maximum, disposal fails queued waiters,
+failed leases may carry ``retry_after`` metadata. Lease ``dispose`` does NOT
+return permits — token-bucket cost is consumed, not held (the reference's
+lease classes have no Dispose override; SURVEY.md §2 #9).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Iterable
+
+__all__ = ["MetadataName", "RateLimitLease", "RateLimiter"]
+
+
+class MetadataName:
+    """Well-known lease metadata keys (≙ ``MetadataName.RetryAfter``,
+    ``RedisApproximateTokenBucketRateLimiter.cs:575-585``)."""
+
+    RETRY_AFTER = "RETRY_AFTER"  # seconds (float)
+    REASON = "REASON"            # str
+
+
+class RateLimitLease:
+    """Result of an acquire. Shared metadata-free success/failure singletons
+    keep the hot path allocation-free, as in the reference
+    (``RedisTokenBucketRateLimiter.cs:9-10``)."""
+
+    __slots__ = ("_acquired", "_metadata")
+
+    def __init__(self, acquired: bool, metadata: dict[str, Any] | None = None):
+        self._acquired = acquired
+        self._metadata = metadata
+
+    @property
+    def is_acquired(self) -> bool:
+        return self._acquired
+
+    @property
+    def metadata_names(self) -> Iterable[str]:
+        return tuple(self._metadata) if self._metadata else ()
+
+    def try_get_metadata(self, name: str) -> tuple[bool, Any]:
+        if self._metadata and name in self._metadata:
+            return True, self._metadata[name]
+        return False, None
+
+    @property
+    def retry_after(self) -> float | None:
+        """Convenience accessor for ``MetadataName.RETRY_AFTER`` seconds."""
+        ok, val = self.try_get_metadata(MetadataName.RETRY_AFTER)
+        return val if ok else None
+
+    def dispose(self) -> None:
+        """No-op: token-bucket cost is consumed, never returned."""
+
+    def __enter__(self) -> "RateLimitLease":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.dispose()
+
+    def __bool__(self) -> bool:
+        return self._acquired
+
+    def __repr__(self) -> str:
+        return f"RateLimitLease(acquired={self._acquired})"
+
+
+#: Allocation-free shared leases for the metadata-free cases.
+SUCCESSFUL_LEASE = RateLimitLease(True)
+FAILED_LEASE = RateLimitLease(False)
+
+
+class RateLimiter(abc.ABC):
+    """Abstract rate limiter (≙ ``System.Threading.RateLimiting.RateLimiter``)."""
+
+    @abc.abstractmethod
+    def acquire(self, permits: int = 1) -> RateLimitLease:
+        """Synchronous attempt; never queues. Zero permits = probe."""
+
+    @abc.abstractmethod
+    async def acquire_async(self, permits: int = 1) -> RateLimitLease:
+        """Asynchronous acquire; may park on the waiter queue (if the
+        limiter has one). Cancellation of the awaiting task unwinds queue
+        accounting. Zero permits = probe."""
+
+    @abc.abstractmethod
+    def available_permits(self) -> int:
+        """Best-effort estimate (≙ ``GetAvailablePermits``; explicitly an
+        estimate in the reference, ``RedisTokenBucketRateLimiter.cs:48-51``)."""
+
+    @property
+    @abc.abstractmethod
+    def idle_duration(self) -> float | None:
+        """Seconds since the limiter last had consumption in flight, or
+        ``None`` if active (≙ ``IdleDuration``, ``…cs:33-34,503-506``)."""
+
+    @abc.abstractmethod
+    async def aclose(self) -> None:
+        """Dispose: stop background work, fail queued waiters."""
+
+    def close(self) -> None:
+        """Synchronous dispose for non-async contexts."""
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            asyncio.run(self.aclose())
+        else:
+            loop.create_task(self.aclose())
+
+    async def __aenter__(self) -> "RateLimiter":
+        return self
+
+    async def __aexit__(self, *exc: object) -> None:
+        await self.aclose()

@@ -1,0 +1,86 @@
+"""Options dataclasses — the configuration layer.
+
+Python translation of the reference's ``IOptions<TSelf>`` POCOs (SURVEY.md
+§2 #7, §5.6): frozen dataclasses with fail-fast validation and derived
+values computed once. Deliberate fixes over the reference:
+
+- ``replenishment_period_s`` must be **> 0** — the reference accepted
+  ``TimeSpan.Zero`` (``…Options.cs:59-62``), which made the fill rate
+  infinite and degenerated the sync timer (known defect, SURVEY.md §2).
+- Validation lives in ``__post_init__`` so an invalid options object cannot
+  exist, rather than being deferred to the limiter constructor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
+
+__all__ = [
+    "TokenBucketOptions",
+    "ApproximateTokenBucketOptions",
+    "SlidingWindowOptions",
+]
+
+
+@dataclass(frozen=True)
+class TokenBucketOptions:
+    """Exact token bucket (≙ ``RedisTokenBucketRateLimiterOptions``).
+
+    ``instance_name`` is the bucket key in the shared store
+    (``…Options.cs`` "InstanceName (the bucket key)") — limiter instances
+    on any number of hosts that share a store and an instance name share
+    one bucket.
+    """
+
+    token_limit: int = 100
+    tokens_per_period: int = 1
+    replenishment_period_s: float = 1.0
+    instance_name: str = "rate-limiter"
+
+    def __post_init__(self) -> None:
+        if self.token_limit <= 0:
+            raise ValueError("token_limit must be > 0")
+        if self.tokens_per_period <= 0:
+            raise ValueError("tokens_per_period must be > 0")
+        if self.replenishment_period_s <= 0:
+            raise ValueError(
+                "replenishment_period_s must be > 0 (a zero period would "
+                "make the fill rate infinite)"
+            )
+
+    @property
+    def fill_rate_per_second(self) -> float:
+        """Derived ``FillRatePerSecond`` (``…Options.cs:80-85``)."""
+        return self.tokens_per_period / self.replenishment_period_s
+
+
+@dataclass(frozen=True)
+class ApproximateTokenBucketOptions(TokenBucketOptions):
+    """Approximate two-level limiter options
+    (≙ ``RedisApproximateTokenBucketRateLimiterOptions`` — adds queueing,
+    ``…Options.cs:44-58``)."""
+
+    queue_limit: int = 0
+    queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+
+
+@dataclass(frozen=True)
+class SlidingWindowOptions:
+    """Sliding-window counter variant (BASELINE config 4)."""
+
+    permit_limit: int = 100
+    window_s: float = 1.0
+    instance_name: str = "rate-limiter"
+
+    def __post_init__(self) -> None:
+        if self.permit_limit <= 0:
+            raise ValueError("permit_limit must be > 0")
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")

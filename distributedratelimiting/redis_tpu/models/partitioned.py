@@ -1,0 +1,109 @@
+"""Partitioned (per-key) rate limiter — the batched keyed façade.
+
+The reference sketched this and never shipped it: the entire
+``PartitionedRedisTokenBucketRateLimiter`` is commented out
+(``TokenBucket/PartitionedRedisTokenBucketRateLimiter.cs:6-213``, dead
+component #13), its README naming request batching as the missing piece
+(``README.md:7``). This completes the intent the TPU-first way:
+
+- partition key = ``instance_name + separator + str(resource)`` — exactly
+  the reference's key-concatenation scheme (``:42``), one independent
+  bucket per partition (keys never interact; SURVEY.md §5.7);
+- every partition of one limiter shares a single homogeneous-config device
+  table, so concurrent ``acquire`` calls across *all* partitions coalesce
+  into one kernel launch — the batching the reference never built.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from distributedratelimiting.redis_tpu.models.base import (
+    FAILED_LEASE,
+    SUCCESSFUL_LEASE,
+    MetadataName,
+    RateLimitLease,
+)
+from distributedratelimiting.redis_tpu.models.options import TokenBucketOptions
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
+from distributedratelimiting.redis_tpu.utils.metrics import LimiterMetrics
+
+__all__ = ["PartitionedRateLimiter"]
+
+
+class PartitionedRateLimiter:
+    """≙ ``PartitionedRateLimiter<TResource>``: acquire against a resource,
+    each resource getting its own token bucket with shared options."""
+
+    def __init__(
+        self,
+        options: TokenBucketOptions,
+        store: BucketStore,
+        partition_key: Callable[[object], str] = str,
+    ) -> None:
+        self.options = options
+        self.store = store
+        self.partition_key = partition_key
+        self.metrics = LimiterMetrics()
+
+    def _key(self, resource: object) -> str:
+        # Key concatenation, one store bucket per partition (dead ref :42).
+        return f"{self.options.instance_name}:{self.partition_key(resource)}"
+
+    def _check_permits(self, permits: int) -> None:
+        if permits < 0:
+            raise ValueError("permits must be >= 0")
+        if permits > self.options.token_limit:
+            raise ValueError(
+                f"permits ({permits}) cannot exceed token_limit "
+                f"({self.options.token_limit})"
+            )
+
+    def _lease(self, granted: bool, remaining: float, permits: int,
+               latency_s: float) -> RateLimitLease:
+        self.metrics.record_decision(granted, latency_s)
+        if granted:
+            return SUCCESSFUL_LEASE
+        deficit = permits - remaining
+        return RateLimitLease(False, {
+            MetadataName.RETRY_AFTER: max(
+                0.0, deficit / self.options.fill_rate_per_second
+            ),
+        })
+
+    def acquire(self, resource: object, permits: int = 1) -> RateLimitLease:
+        self._check_permits(permits)
+        if permits == 0:
+            return SUCCESSFUL_LEASE
+        t0 = time.perf_counter()
+        res = self.store.acquire_blocking(
+            self._key(resource), permits, self.options.token_limit,
+            self.options.fill_rate_per_second,
+        )
+        return self._lease(res.granted, res.remaining, permits,
+                           time.perf_counter() - t0)
+
+    async def acquire_async(self, resource: object,
+                            permits: int = 1) -> RateLimitLease:
+        """Micro-batched: concurrent calls across partitions share one
+        kernel launch."""
+        self._check_permits(permits)
+        if permits == 0:
+            return SUCCESSFUL_LEASE
+        t0 = time.perf_counter()
+        res = await self.store.acquire(
+            self._key(resource), permits, self.options.token_limit,
+            self.options.fill_rate_per_second,
+        )
+        return self._lease(res.granted, res.remaining, permits,
+                           time.perf_counter() - t0)
+
+    def available_permits(self, resource: object) -> int:
+        return int(self.store.peek_blocking(
+            self._key(resource), self.options.token_limit,
+            self.options.fill_rate_per_second,
+        ))
+
+    async def aclose(self) -> None:
+        pass

@@ -1,0 +1,95 @@
+"""Sliding-window counter limiter (BASELINE config 4).
+
+No live counterpart exists in the reference (the variant appears only in
+the roadmap); semantics follow the standard two-counter interpolated
+sliding window, executed store-side with the same atomicity, time-authority
+and init-on-miss properties as the token-bucket kernels.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+from distributedratelimiting.redis_tpu.models.base import (
+    FAILED_LEASE,
+    SUCCESSFUL_LEASE,
+    MetadataName,
+    RateLimitLease,
+    RateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import SlidingWindowOptions
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
+from distributedratelimiting.redis_tpu.utils.metrics import LimiterMetrics
+
+__all__ = ["SlidingWindowRateLimiter"]
+
+
+class SlidingWindowRateLimiter(RateLimiter):
+    def __init__(self, options: SlidingWindowOptions, store: BucketStore) -> None:
+        self.options = options
+        self.store = store
+        self.metrics = LimiterMetrics()
+        self._estimated_remaining: float | None = None
+        self._idle_since: float | None = time.monotonic()
+
+    def _check_permits(self, permits: int) -> None:
+        if permits < 0:
+            raise ValueError("permits must be >= 0")
+        if permits > self.options.permit_limit:
+            raise ValueError(
+                f"permits ({permits}) cannot exceed permit_limit "
+                f"({self.options.permit_limit})"
+            )
+
+    def _lease(self, granted: bool, remaining: float, permits: int,
+               latency_s: float | None = None) -> RateLimitLease:
+        self._estimated_remaining = remaining
+        self.metrics.record_decision(granted, latency_s)
+        if granted:
+            if permits > 0:
+                self._idle_since = None
+            return SUCCESSFUL_LEASE
+        # A denied request can retry once enough of the window slides by;
+        # the worst case is one full window.
+        return RateLimitLease(False, {
+            MetadataName.RETRY_AFTER: self.options.window_s,
+        })
+
+    def acquire(self, permits: int = 1) -> RateLimitLease:
+        self._check_permits(permits)
+        if permits == 0:
+            return SUCCESSFUL_LEASE if self.available_permits() > 0 else FAILED_LEASE
+        t0 = time.perf_counter()
+        res = self.store.window_acquire_blocking(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )
+        return self._lease(res.granted, res.remaining, permits,
+                           time.perf_counter() - t0)
+
+    async def acquire_async(self, permits: int = 1) -> RateLimitLease:
+        self._check_permits(permits)
+        if permits == 0:
+            return SUCCESSFUL_LEASE if self.available_permits() > 0 else FAILED_LEASE
+        t0 = time.perf_counter()
+        res = await self.store.window_acquire(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )
+        return self._lease(res.granted, res.remaining, permits,
+                           time.perf_counter() - t0)
+
+    def available_permits(self) -> int:
+        if self._estimated_remaining is None:
+            return self.options.permit_limit
+        return int(math.floor(self._estimated_remaining))
+
+    @property
+    def idle_duration(self) -> float | None:
+        if self._idle_since is None:
+            return None
+        return time.monotonic() - self._idle_since
+
+    async def aclose(self) -> None:
+        pass

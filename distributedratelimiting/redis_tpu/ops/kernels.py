@@ -48,6 +48,7 @@ __all__ = [
     "sweep_windows",
     "rebase_bucket_epoch",
     "rebase_counter_epoch",
+    "rebase_window_epoch",
     "peek_batch",
 ]
 
@@ -343,3 +344,18 @@ def rebase_counter_epoch(state: CounterState, offset_ticks):
         state.last_ts,
     )
     return CounterState(state.value, state.period, new_ts, state.exists)
+
+
+@partial(jax.jit, donate_argnums=0)
+def rebase_window_epoch(state: WindowState, offset_windows):
+    """Epoch rebase for window tables: indices shift by whole windows
+    (``offset_windows = offset_ticks // window_ticks``, host-computed). The
+    sub-window phase remainder introduces at most one window of boundary
+    skew, once per rebase (~6 days) — without this the advance clamp would
+    pin old indices forever and freeze those keys."""
+    new_idx = jnp.where(
+        state.exists,
+        jnp.maximum(state.window_idx - jnp.asarray(offset_windows, jnp.int32), 0),
+        state.window_idx,
+    )
+    return WindowState(state.prev_count, state.curr_count, new_idx, state.exists)

@@ -1,0 +1,111 @@
+"""Asyncio micro-batcher: amortizes kernel-launch cost over concurrent calls.
+
+The reference pays one network round-trip per ``WaitAsync``
+(``RedisTokenBucketRateLimiter.cs:63``) and its README names request
+batching as the missing piece (``README.md:7``). Here batching is the core
+of the design (SURVEY.md §7 L2): concurrent ``acquire`` calls are collected
+into a flush — closed when it reaches ``max_batch`` or when the oldest
+entry has waited ``max_delay_s`` — and one kernel launch decides the whole
+batch. Device transfer/blocking happens on an executor thread so the event
+loop keeps accumulating the next flush while the previous one is in flight
+(double buffering); ``max_inflight`` bounds the pipeline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Generic, Sequence, TypeVar
+
+TReq = TypeVar("TReq")
+TRes = TypeVar("TRes")
+
+__all__ = ["MicroBatcher"]
+
+
+class MicroBatcher(Generic[TReq, TRes]):
+    def __init__(
+        self,
+        flush_fn: Callable[[Sequence[TReq]], Awaitable[Sequence[TRes]]],
+        *,
+        max_batch: int = 4096,
+        max_delay_s: float = 200e-6,
+        max_inflight: int = 2,
+    ) -> None:
+        if max_batch <= 0:
+            raise ValueError("max_batch must be positive")
+        self._flush_fn = flush_fn
+        self._max_batch = max_batch
+        self._max_delay_s = max_delay_s
+        self._pending: list[tuple[TReq, asyncio.Future]] = []
+        self._timer: asyncio.TimerHandle | None = None
+        self._inflight = asyncio.Semaphore(max_inflight)
+        self._tasks: set[asyncio.Task] = set()  # strong refs to in-flight flushes
+        self._closed = False
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    async def submit(self, request: TReq) -> TRes:
+        """Enqueue one request; resolves with its per-request result."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((request, fut))
+        if len(self._pending) >= self._max_batch:
+            self._start_flush(loop)
+        elif self._timer is None:
+            self._timer = loop.call_later(
+                self._max_delay_s, self._start_flush, loop
+            )
+        return await fut
+
+    def _start_flush(self, loop: asyncio.AbstractEventLoop) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._pending:
+            return
+        batch = self._pending[: self._max_batch]
+        del self._pending[: len(batch)]
+        task = loop.create_task(self._run_flush(batch))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        # Anything past max_batch re-arms the deadline.
+        if self._pending and self._timer is None:
+            self._timer = loop.call_later(
+                self._max_delay_s, self._start_flush, loop
+            )
+
+    async def _run_flush(self, batch: list[tuple[TReq, asyncio.Future]]) -> None:
+        async with self._inflight:
+            requests = [r for r, _ in batch]
+            try:
+                results = await self._flush_fn(requests)
+            except BaseException as exc:  # noqa: BLE001 — fan the failure out
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                return
+            for (_, fut), res in zip(batch, results):
+                if not fut.done():  # caller may have cancelled while queued
+                    fut.set_result(res)
+
+    async def flush_now(self) -> None:
+        """Force-flush pending requests and wait for every in-flight flush
+        to complete — a shutdown drain must not strand submitters on
+        futures whose flush task dies with the loop."""
+        loop = asyncio.get_running_loop()
+        while self._pending:
+            self._start_flush(loop)
+            await asyncio.sleep(0)
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+    async def aclose(self) -> None:
+        self._closed = True
+        await self.flush_now()
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None

@@ -29,6 +29,12 @@ class Clock:
     def now_seconds(self) -> float:
         return self.now_ticks() / TICKS_PER_SECOND
 
+    def rebase(self, offset_ticks: int) -> None:
+        """Shift the epoch forward so ``now_ticks`` shrinks by
+        ``offset_ticks`` — paired with the store's ``rebase_*_epoch``
+        kernels to keep int32 tick time far from overflow."""
+        raise NotImplementedError
+
 
 class MonotonicClock(Clock):
     """Monotonic wall-clock ticks since construction.
@@ -72,3 +78,6 @@ class ManualClock(Clock):
 
     def set_ticks(self, ticks: int) -> None:
         self._ticks = ticks
+
+    def rebase(self, offset_ticks: int) -> None:
+        self._ticks -= offset_ticks

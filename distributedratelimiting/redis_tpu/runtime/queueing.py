@@ -1,0 +1,150 @@
+"""Waiter-queue machinery for async acquires that can't be served instantly.
+
+Semantics cloned (behavior, not code) from the reference's queue logic —
+itself a faithful clone of .NET's in-memory ``TokenBucketRateLimiter``
+(SURVEY.md §2 #5, ``RedisApproximateTokenBucketRateLimiter.cs:139-183,
+462-501,515-557``):
+
+- ``queue_limit`` is counted in **cumulative permits**, not waiter count
+  (``:178``).
+- ``OLDEST_FIRST``: a newcomer that would overflow the queue is rejected
+  (``:159-163``). ``NEWEST_FIRST``: oldest entries are evicted (failed) to
+  make room for the newcomer (``:143-158``).
+- Waiters park on futures (≙ ``TaskCompletionSource``, ``:515-529``).
+- Cancellation unwinds the queue accounting (``CancelQueueState``,
+  ``:531-557``). The reference's drain loop *double-counts* consumption for
+  waiters found cancelled after speculative grant (``:486-492`` — known
+  defect, SURVEY.md §2); here cancelled waiters are detected before any
+  consumption is applied, so the accounting bug cannot occur by
+  construction (regression-tested).
+- Disposal fails all queued waiters; they never hang (``:291-298``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from typing import Callable, Iterable
+
+from distributedratelimiting.redis_tpu.utils.deque import Deque
+
+__all__ = ["QueueProcessingOrder", "Registration", "WaiterQueue"]
+
+
+class QueueProcessingOrder(enum.Enum):
+    """≙ ``System.Threading.RateLimiting.QueueProcessingOrder``."""
+
+    OLDEST_FIRST = "oldest_first"
+    NEWEST_FIRST = "newest_first"
+
+
+class Registration:
+    """One parked waiter (≙ ``RequestRegistration`` struct ``:515-529``)."""
+
+    __slots__ = ("count", "future")
+
+    def __init__(self, count: int, future: asyncio.Future) -> None:
+        self.count = count
+        self.future = future
+
+
+class WaiterQueue:
+    """Permit-counted waiter queue. Single-threaded (event loop) use."""
+
+    def __init__(self, queue_limit: int,
+                 order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
+                 ) -> None:
+        if queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+        self.queue_limit = queue_limit
+        self.order = order
+        self._deque: Deque[Registration] = Deque()
+        self._queue_count = 0  # cumulative permits queued
+
+    def __len__(self) -> int:
+        return len(self._deque)
+
+    @property
+    def queue_count(self) -> int:
+        return self._queue_count
+
+    def try_enqueue(self, count: int
+                    ) -> tuple[asyncio.Future | None, list[Registration]]:
+        """Park a waiter for ``count`` permits.
+
+        Returns ``(future, evicted)``. ``future is None`` ⇒ the request was
+        rejected (queue full under OLDEST_FIRST, or ``count`` alone exceeds
+        the whole queue_limit). ``evicted`` holds NEWEST_FIRST victims the
+        caller must complete with failed leases.
+        """
+        evicted: list[Registration] = []
+        if count > self.queue_limit:
+            return None, evicted
+        if self._queue_count + count > self.queue_limit:
+            if self.order is QueueProcessingOrder.OLDEST_FIRST:
+                return None, evicted  # reject the newcomer (:159-163)
+            # NEWEST_FIRST: evict oldest entries until the newcomer fits
+            # (:143-158).
+            while self._deque.count and self._queue_count + count > self.queue_limit:
+                victim = self._deque.dequeue_head()
+                self._queue_count -= victim.count
+                if not victim.future.done():
+                    evicted.append(victim)
+        loop = asyncio.get_running_loop()
+        reg = Registration(count, loop.create_future())
+        self._deque.enqueue_tail(reg)
+        self._queue_count += count
+        # Cancellation unwinds accounting immediately (corrected semantics:
+        # detect-before-consume, so no double count is possible).
+        reg.future.add_done_callback(
+            lambda fut, reg=reg: self._on_done(reg, fut)
+        )
+        return reg.future, evicted
+
+    def _on_done(self, reg: Registration, fut: asyncio.Future) -> None:
+        if fut.cancelled():
+            if self._deque.remove(reg):
+                self._queue_count -= reg.count
+
+    def drain(self, try_grant: Callable[[int], bool],
+              make_lease: Callable[[], object]) -> int:
+        """Release waiters while permits are available (the refresh drain
+        loop, ``:462-501``). ``try_grant(count)`` must atomically consume
+        ``count`` permits or decline; granted waiters get
+        ``make_lease()``.
+
+        Returns the number of waiters granted. Cancelled waiters are
+        discarded *before* any grant is attempted — the accounting defect
+        in the reference cannot arise.
+        """
+        granted = 0
+        while self._deque.count:
+            newest = self.order is QueueProcessingOrder.NEWEST_FIRST
+            reg = self._deque.peek_tail() if newest else self._deque.peek_head()
+            if reg.future.done():  # cancelled while parked
+                (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
+                self._queue_count -= reg.count
+                continue
+            if not try_grant(reg.count):
+                break
+            (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
+            self._queue_count -= reg.count
+            reg.future.set_result(make_lease())
+            granted += 1
+        return granted
+
+    def fail_all(self, make_lease: Callable[[], object]) -> int:
+        """Disposal path: every parked waiter completes with a failed lease
+        (``:291-298``), drained in queue-processing order."""
+        failed = 0
+        while self._deque.count:
+            newest = self.order is QueueProcessingOrder.NEWEST_FIRST
+            reg = (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
+            self._queue_count -= reg.count
+            if not reg.future.done():
+                reg.future.set_result(make_lease())
+                failed += 1
+        return failed
+
+    def __iter__(self) -> Iterable[Registration]:
+        return iter(self._deque)

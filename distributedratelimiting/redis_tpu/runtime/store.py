@@ -1,0 +1,784 @@
+"""The bucket store — where rate-limit state lives and decisions execute.
+
+``BucketStore`` is the framework's storage seam, playing the role the
+``IDatabase`` + ``ConnectionMultiplexerFactory`` pair played in the
+reference (``…Options.cs:75`` — the injection point SURVEY.md §4 calls out
+as "the seam designed for exactly this — preserve an equivalent seam"):
+
+- :class:`DeviceBucketStore` — the TPU store. Per-key bucket state lives in
+  HBM as SoA arrays; ``acquire`` calls are micro-batched into one kernel
+  launch (≙ one Lua ``EVALSHA``, but for thousands of keys at once); the
+  store's clock stamps every launch (store-as-time-authority, invariant 1).
+- :class:`InProcessBucketStore` — a pure-Python store with identical
+  semantics: the test fake (≙ a fake ``ConnectionMultiplexer``) and the
+  single-node CPU baseline for BASELINE config 1.
+
+Organization of the device store: one *table* per bucket configuration
+``(capacity, fill_rate)`` — matching the reference, where one limiter (or
+one partitioned limiter's whole key space) shares a single config
+(``RedisTokenBucketRateLimiterOptions``), so tables are homogeneous and the
+kernels take config as two scalar operands. Tables grow by doubling and
+reclaim slots with TTL sweeps (invariant 5). Decaying global counters (the
+approximate algorithm's shared tier) live in one store-wide table with a
+*per-row* decay-rate operand, since each approximate limiter may have its
+own rate.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import threading
+from typing import Iterable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+from distributedratelimiting.redis_tpu.ops import kernels as K
+from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
+
+__all__ = [
+    "AcquireResult",
+    "SyncResult",
+    "BucketStore",
+    "DeviceBucketStore",
+    "InProcessBucketStore",
+]
+
+# Host tick value at which the store rebases its epoch (≪ int32 max), and
+# how much history the new epoch keeps. Margin 2^29 (~6 days): timestamps
+# within the last ~6 days survive the shift exactly; older ones clamp to the
+# new epoch, which can only under-refill (safe) and only matters for buckets
+# whose time-to-full exceeds ~6 days.
+_REBASE_THRESHOLD_TICKS = 2**30
+_REBASE_MARGIN_TICKS = 2**29
+
+
+class AcquireResult(NamedTuple):
+    granted: bool
+    remaining: float  # post-decision token estimate (≙ Lua reply new_v)
+
+
+class SyncResult(NamedTuple):
+    global_score: float
+    period_ewma_ticks: float
+
+
+class _AcquireReq(NamedTuple):
+    key: str
+    count: int
+
+
+class BucketStore(abc.ABC):
+    """Abstract store: token buckets + decaying counters + sliding windows.
+
+    All rate arguments are per-second; conversion to per-tick happens at the
+    store boundary so callers never see ticks except in ``SyncResult``.
+    """
+
+    clock: Clock
+
+    @abc.abstractmethod
+    async def connect(self) -> None:
+        """Idempotent lazy init (≙ ``ConnectAsync``,
+        ``RedisTokenBucketRateLimiter.cs:111-151``)."""
+
+    # -- exact token bucket ------------------------------------------------
+    @abc.abstractmethod
+    async def acquire(self, key: str, count: int, capacity: float,
+                      fill_rate_per_sec: float) -> AcquireResult: ...
+
+    @abc.abstractmethod
+    def acquire_blocking(self, key: str, count: int, capacity: float,
+                         fill_rate_per_sec: float) -> AcquireResult:
+        """Synchronous single-request path (the reference's sync ``Acquire``
+        silently always failed — a surprise SURVEY.md §2 tells us not to
+        replicate; here it is a real, blocking decision)."""
+
+    @abc.abstractmethod
+    def peek_blocking(self, key: str, capacity: float,
+                      fill_rate_per_sec: float) -> float:
+        """Read-only availability estimate (``GetAvailablePermits``)."""
+
+    # -- decaying global counter (approximate algorithm's shared tier) -----
+    @abc.abstractmethod
+    async def sync_counter(self, key: str, local_count: float,
+                           decay_rate_per_sec: float) -> SyncResult: ...
+
+    @abc.abstractmethod
+    def sync_counter_blocking(self, key: str, local_count: float,
+                              decay_rate_per_sec: float) -> SyncResult: ...
+
+    # -- sliding window ----------------------------------------------------
+    @abc.abstractmethod
+    async def window_acquire(self, key: str, count: int, limit: float,
+                             window_sec: float) -> AcquireResult: ...
+
+    @abc.abstractmethod
+    def window_acquire_blocking(self, key: str, count: int, limit: float,
+                                window_sec: float) -> AcquireResult: ...
+
+    # -- lifecycle / ops ---------------------------------------------------
+    @abc.abstractmethod
+    async def aclose(self) -> None: ...
+
+    @abc.abstractmethod
+    def snapshot(self) -> dict:
+        """Host-side checkpoint of all live state (SURVEY.md §5.4: planned
+        restarts snapshot ``(keys, tokens, ts)``; crash recovery simply
+        accepts init-on-miss)."""
+
+    @abc.abstractmethod
+    def restore(self, snap: dict) -> None: ...
+
+
+def _rate_per_tick(rate_per_sec: float) -> float:
+    return rate_per_sec / bm.TICKS_PER_SECOND
+
+
+def _pad_size(n: int, floor: int = 64) -> int:
+    """Pad batches to a power of two ≥ ``floor`` so the jit cache stays
+    small (one compilation per size bucket, not per batch length)."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class _DeviceTable:
+    """One homogeneous-config bucket table: device arrays + host directory."""
+
+    def __init__(self, store: "DeviceBucketStore", capacity: float,
+                 fill_rate_per_sec: float, n_slots: int) -> None:
+        self.store = store
+        self.capacity = float(capacity)
+        self.fill_rate_per_sec = float(fill_rate_per_sec)
+        self.rate_per_tick = _rate_per_tick(fill_rate_per_sec)
+        self.state = K.init_bucket_state(n_slots)
+        self.n_slots = n_slots
+        self.directory: dict[str, int] = {}
+        self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
+            self._flush,
+            max_batch=store.max_batch,
+            max_delay_s=store.max_delay_s,
+        )
+
+    # -- slot management ---------------------------------------------------
+    def slot_for(self, key: str, pinned: set[int] | None = None) -> int:
+        slot = self.directory.get(key)
+        if slot is None:
+            slot = self._allocate(key, pinned)
+        return slot
+
+    def _allocate(self, key: str, pinned: set[int] | None = None) -> int:
+        if not self.free:
+            self._sweep(pinned)
+        if not self.free:
+            self._grow()
+        slot = self.free.pop()
+        self.directory[key] = slot
+        return slot
+
+    def _sweep(self, pinned: set[int] | None = None) -> None:
+        """Reclaim slots whose buckets have sat full-refilled past TTL
+        (invariant 5). One vectorized pass; freed ids return to the pool.
+
+        ``pinned`` slots (already resolved for the in-flight batch) are
+        exempt — a sweep triggered mid-batch must not free-and-reallocate a
+        slot an earlier request in the same batch is about to touch, which
+        would cross-contaminate two keys' buckets."""
+        now = self.store.clock.now_ticks()
+        self.state, freed = K.sweep_expired(
+            self.state, jnp.int32(now), jnp.float32(self.capacity),
+            jnp.float32(self.rate_per_tick),
+        )
+        freed_np = np.asarray(freed)
+        if freed_np.any():
+            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
+            if pinned:
+                dead -= pinned
+            for k in [k for k, s in self.directory.items() if s in dead]:
+                del self.directory[k]
+            self.free.extend(sorted(dead, reverse=True))
+            self.store.metrics.slots_evicted += len(dead)
+        self.store.metrics.sweeps += 1
+
+    def _grow(self) -> None:
+        """Double the table. Amortized; recompiles kernels for the new N."""
+        old_n = self.n_slots
+        new_n = old_n * 2
+        self.state = K.BucketState(
+            tokens=jnp.concatenate([self.state.tokens, jnp.zeros((old_n,), jnp.float32)]),
+            last_ts=jnp.concatenate([self.state.last_ts, jnp.zeros((old_n,), jnp.int32)]),
+            exists=jnp.concatenate([self.state.exists, jnp.zeros((old_n,), bool)]),
+        )
+        self.free.extend(range(new_n - 1, old_n - 1, -1))
+        self.n_slots = new_n
+
+    # -- decision paths ----------------------------------------------------
+    def _launch(self, reqs: Sequence[_AcquireReq]):
+        """Build padded arrays and dispatch one acquire kernel launch.
+
+        The whole read-modify-write of the donated ``self.state`` runs under
+        the store lock: the blocking path may be called from arbitrary
+        threads while the event loop flushes batches, and two concurrent
+        donating kernel calls on the same buffers would race (one side
+        would operate on a deleted/donated array)."""
+        with self.store._lock:
+            slots: list[int] = []
+            pinned: set[int] = set()
+            for r in reqs:
+                s = self.slot_for(r.key, pinned)
+                slots.append(s)
+                pinned.add(s)
+            b = _pad_size(len(reqs))
+            slots_np = np.full((b,), -1, np.int32)
+            counts_np = np.zeros((b,), np.int32)
+            valid_np = np.zeros((b,), bool)
+            slots_np[: len(reqs)] = slots
+            counts_np[: len(reqs)] = [r.count for r in reqs]
+            valid_np[: len(reqs)] = True
+            has_dups = len(set(slots)) != len(slots)
+            now = self.store.now_ticks_checked()
+            self.state, granted, remaining = K.acquire_batch(
+                self.state,
+                jnp.asarray(slots_np), jnp.asarray(counts_np), jnp.asarray(valid_np),
+                jnp.int32(now), jnp.float32(self.capacity),
+                jnp.float32(self.rate_per_tick),
+                handle_duplicates=has_dups,
+            )
+            self.store.metrics.record_launch(b, len(reqs))
+            return granted, remaining
+
+    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
+        granted, remaining = self._launch(reqs)
+        loop = asyncio.get_running_loop()
+        # Block for device results on an executor thread so the event loop
+        # keeps accumulating the next flush (double buffering).
+        g_np, r_np = await loop.run_in_executor(
+            None, lambda: (np.asarray(granted), np.asarray(remaining))
+        )
+        return [
+            AcquireResult(bool(g_np[i]), float(r_np[i])) for i in range(len(reqs))
+        ]
+
+    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
+        granted, remaining = self._launch([_AcquireReq(key, count)])
+        return AcquireResult(bool(np.asarray(granted)[0]),
+                             float(np.asarray(remaining)[0]))
+
+    def peek_blocking(self, key: str) -> float:
+        with self.store._lock:
+            slot = self.directory.get(key)
+            if slot is None:
+                return float(np.floor(self.capacity))
+            b = _pad_size(1)
+            slots_np = np.full((b,), -1, np.int32)
+            valid_np = np.zeros((b,), bool)
+            slots_np[0] = slot
+            valid_np[0] = True
+            est = K.peek_batch(
+                self.state, jnp.asarray(slots_np), jnp.asarray(valid_np),
+                jnp.int32(self.store.now_ticks_checked()),
+                jnp.float32(self.capacity), jnp.float32(self.rate_per_tick),
+            )
+        return float(np.asarray(est)[0])
+
+    def rebase(self, offset: int) -> None:
+        self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
+
+
+class _DeviceWindowTable:
+    """One homogeneous-config sliding-window table."""
+
+    def __init__(self, store: "DeviceBucketStore", limit: float,
+                 window_ticks: int, n_slots: int) -> None:
+        self.store = store
+        self.limit = float(limit)
+        self.window_ticks = int(window_ticks)
+        self.state = K.init_window_state(n_slots)
+        self.n_slots = n_slots
+        self.directory: dict[str, int] = {}
+        self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
+            self._flush,
+            max_batch=store.max_batch,
+            max_delay_s=store.max_delay_s,
+        )
+
+    def slot_for(self, key: str, pinned: set[int] | None = None) -> int:
+        slot = self.directory.get(key)
+        if slot is None:
+            if not self.free:
+                self._sweep(pinned)
+            if not self.free:
+                self._grow()
+            slot = self.free.pop()
+            self.directory[key] = slot
+        return slot
+
+    def _sweep(self, pinned: set[int] | None = None) -> None:
+        now = self.store.clock.now_ticks()
+        self.state, freed = K.sweep_windows(
+            self.state, jnp.int32(now), jnp.int32(self.window_ticks)
+        )
+        freed_np = np.asarray(freed)
+        if freed_np.any():
+            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
+            if pinned:
+                dead -= pinned
+            for k in [k for k, s in self.directory.items() if s in dead]:
+                del self.directory[k]
+            self.free.extend(sorted(dead, reverse=True))
+            self.store.metrics.slots_evicted += len(dead)
+        self.store.metrics.sweeps += 1
+
+    def rebase(self, offset_ticks: int) -> None:
+        self.state = K.rebase_window_epoch(
+            self.state, jnp.int32(offset_ticks // self.window_ticks)
+        )
+
+    def _grow(self) -> None:
+        old_n = self.n_slots
+        self.state = K.WindowState(
+            prev_count=jnp.concatenate([self.state.prev_count, jnp.zeros((old_n,), jnp.float32)]),
+            curr_count=jnp.concatenate([self.state.curr_count, jnp.zeros((old_n,), jnp.float32)]),
+            window_idx=jnp.concatenate([self.state.window_idx, jnp.zeros((old_n,), jnp.int32)]),
+            exists=jnp.concatenate([self.state.exists, jnp.zeros((old_n,), bool)]),
+        )
+        self.free.extend(range(old_n * 2 - 1, old_n - 1, -1))
+        self.n_slots = old_n * 2
+
+    def _launch(self, reqs: Sequence[_AcquireReq]):
+        with self.store._lock:  # same dispatch discipline as _DeviceTable
+            slots: list[int] = []
+            pinned: set[int] = set()
+            for r in reqs:
+                s = self.slot_for(r.key, pinned)
+                slots.append(s)
+                pinned.add(s)
+            b = _pad_size(len(reqs))
+            slots_np = np.full((b,), -1, np.int32)
+            counts_np = np.zeros((b,), np.int32)
+            valid_np = np.zeros((b,), bool)
+            slots_np[: len(reqs)] = slots
+            counts_np[: len(reqs)] = [r.count for r in reqs]
+            valid_np[: len(reqs)] = True
+            has_dups = len(set(slots)) != len(slots)
+            self.state, granted, remaining = K.window_acquire_batch(
+                self.state,
+                jnp.asarray(slots_np), jnp.asarray(counts_np), jnp.asarray(valid_np),
+                jnp.int32(self.store.now_ticks_checked()), jnp.float32(self.limit),
+                jnp.int32(self.window_ticks), handle_duplicates=has_dups,
+            )
+            self.store.metrics.record_launch(b, len(reqs))
+            return granted, remaining
+
+    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
+        granted, remaining = self._launch(reqs)
+        loop = asyncio.get_running_loop()
+        g_np, r_np = await loop.run_in_executor(
+            None, lambda: (np.asarray(granted), np.asarray(remaining))
+        )
+        return [
+            AcquireResult(bool(g_np[i]), float(r_np[i])) for i in range(len(reqs))
+        ]
+
+    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
+        granted, remaining = self._launch([_AcquireReq(key, count)])
+        return AcquireResult(bool(np.asarray(granted)[0]),
+                             float(np.asarray(remaining)[0]))
+
+
+class DeviceBucketStore(BucketStore):
+    """TPU-resident store: HBM tables + micro-batched kernel launches."""
+
+    def __init__(
+        self,
+        *,
+        n_slots: int = 2**17,
+        counter_slots: int = 2**14,
+        clock: Clock | None = None,
+        max_batch: int = 4096,
+        max_delay_s: float = 200e-6,
+    ) -> None:
+        self.clock = clock or MonotonicClock()
+        self.n_slots_default = n_slots
+        self.counter_slots = counter_slots
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.metrics = StoreMetrics()
+        self._tables: dict[tuple[float, float], _DeviceTable] = {}
+        self._wtables: dict[tuple[float, int], _DeviceWindowTable] = {}
+        self._counters = K.init_counter_state(counter_slots)
+        self._counter_dir: dict[str, int] = {}
+        self._counter_free = list(range(counter_slots - 1, -1, -1))
+        self._lock = threading.RLock()  # directory/slot allocation guard
+        self._connected = False
+        self._connect_gate = asyncio.Lock()
+
+    # -- connection lifecycle (lazy, idempotent) ---------------------------
+    async def connect(self) -> None:
+        if self._connected:
+            return
+        async with self._connect_gate:  # ≙ SemaphoreSlim(1,1) double-check
+            if self._connected:
+                return
+            # Touch the device so real connection errors surface here, not
+            # on the first hot-path acquire (mirrors lazy ConnectAsync).
+            jax.block_until_ready(jnp.zeros((8,)))
+            self._connected = True
+
+    def now_ticks_checked(self) -> int:
+        """Read the store clock; rebase every table's epoch before int32
+        tick time can overflow (~24 days of uptime)."""
+        now = self.clock.now_ticks()
+        if now >= _REBASE_THRESHOLD_TICKS:
+            with self._lock:
+                now = self.clock.now_ticks()
+                if now >= _REBASE_THRESHOLD_TICKS:
+                    offset = now - _REBASE_MARGIN_TICKS
+                    for t in self._tables.values():
+                        t.rebase(offset)
+                    for wt in self._wtables.values():
+                        wt.rebase(offset)
+                    self._counters = K.rebase_counter_epoch(
+                        self._counters, jnp.int32(offset)
+                    )
+                    self.clock.rebase(offset)  # type: ignore[attr-defined]
+                    now = self.clock.now_ticks()
+        return now
+
+    # -- table routing -----------------------------------------------------
+    def _table(self, capacity: float, fill_rate_per_sec: float) -> _DeviceTable:
+        key = (float(capacity), float(fill_rate_per_sec))
+        with self._lock:
+            table = self._tables.get(key)
+            if table is None:
+                table = _DeviceTable(self, capacity, fill_rate_per_sec,
+                                     self.n_slots_default)
+                self._tables[key] = table
+            return table
+
+    def _wtable(self, limit: float, window_sec: float) -> _DeviceWindowTable:
+        wt = int(window_sec * bm.TICKS_PER_SECOND)
+        key = (float(limit), wt)
+        with self._lock:
+            table = self._wtables.get(key)
+            if table is None:
+                table = _DeviceWindowTable(self, limit, wt, self.n_slots_default)
+                self._wtables[key] = table
+            return table
+
+    # -- exact bucket ------------------------------------------------------
+    async def acquire(self, key: str, count: int, capacity: float,
+                      fill_rate_per_sec: float) -> AcquireResult:
+        await self.connect()
+        table = self._table(capacity, fill_rate_per_sec)
+        return await table.batcher.submit(_AcquireReq(key, count))
+
+    def acquire_blocking(self, key: str, count: int, capacity: float,
+                         fill_rate_per_sec: float) -> AcquireResult:
+        return self._table(capacity, fill_rate_per_sec).acquire_blocking(key, count)
+
+    def peek_blocking(self, key: str, capacity: float,
+                      fill_rate_per_sec: float) -> float:
+        return self._table(capacity, fill_rate_per_sec).peek_blocking(key)
+
+    # -- decaying counter --------------------------------------------------
+    def _counter_slot(self, key: str) -> int:
+        with self._lock:
+            slot = self._counter_dir.get(key)
+            if slot is None:
+                if not self._counter_free:
+                    self._sweep_counters()
+                if not self._counter_free:
+                    self._grow_counters()
+                slot = self._counter_free.pop()
+                self._counter_dir[key] = slot
+            return slot
+
+    def _sweep_counters(self) -> None:
+        self._counters, freed = K.sweep_counters(
+            self._counters, jnp.int32(self.clock.now_ticks())
+        )
+        freed_np = np.asarray(freed)
+        if freed_np.any():
+            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
+            for k in [k for k, s in self._counter_dir.items() if s in dead]:
+                del self._counter_dir[k]
+            self._counter_free.extend(sorted(dead, reverse=True))
+            self.metrics.slots_evicted += len(dead)
+        self.metrics.sweeps += 1
+
+    def _grow_counters(self) -> None:
+        old_n = self._counters.value.shape[0]
+        self._counters = K.CounterState(
+            value=jnp.concatenate([self._counters.value, jnp.zeros((old_n,), jnp.float32)]),
+            period=jnp.concatenate([self._counters.period, jnp.zeros((old_n,), jnp.float32)]),
+            last_ts=jnp.concatenate([self._counters.last_ts, jnp.zeros((old_n,), jnp.int32)]),
+            exists=jnp.concatenate([self._counters.exists, jnp.zeros((old_n,), bool)]),
+        )
+        self._counter_free.extend(range(old_n * 2 - 1, old_n - 1, -1))
+
+    def _sync_dispatch(self, key: str, local_count: float,
+                       decay_rate_per_sec: float):
+        slot = self._counter_slot(key)
+        with self._lock:
+            b = _pad_size(1, floor=8)
+            slots_np = np.full((b,), -1, np.int32)
+            counts_np = np.zeros((b,), np.float32)
+            valid_np = np.zeros((b,), bool)
+            slots_np[0] = slot
+            counts_np[0] = local_count
+            valid_np[0] = True
+            self._counters, scores, periods = K.sync_batch(
+                self._counters, jnp.asarray(slots_np), jnp.asarray(counts_np),
+                jnp.asarray(valid_np), jnp.int32(self.now_ticks_checked()),
+                jnp.float32(_rate_per_tick(decay_rate_per_sec)),
+            )
+            return scores, periods
+
+    async def sync_counter(self, key: str, local_count: float,
+                           decay_rate_per_sec: float) -> SyncResult:
+        """One decaying-counter sync (≙ the approximate limiter's periodic
+        ``ScriptEvaluateAsync(_syncScript)``,
+        ``RedisApproximateTokenBucketRateLimiter.cs:439``)."""
+        await self.connect()
+        scores, periods = self._sync_dispatch(key, local_count,
+                                              decay_rate_per_sec)
+        loop = asyncio.get_running_loop()
+        s_np, p_np = await loop.run_in_executor(
+            None, lambda: (np.asarray(scores), np.asarray(periods))
+        )
+        return SyncResult(float(s_np[0]), float(p_np[0]))
+
+    def sync_counter_blocking(self, key: str, local_count: float,
+                              decay_rate_per_sec: float) -> SyncResult:
+        """Synchronous sync path for loop-less callers (the approximate
+        limiter's inline refresh when only the sync API is used)."""
+        scores, periods = self._sync_dispatch(key, local_count,
+                                              decay_rate_per_sec)
+        return SyncResult(float(np.asarray(scores)[0]),
+                          float(np.asarray(periods)[0]))
+
+    # -- sliding window ----------------------------------------------------
+    async def window_acquire(self, key: str, count: int, limit: float,
+                             window_sec: float) -> AcquireResult:
+        await self.connect()
+        table = self._wtable(limit, window_sec)
+        return await table.batcher.submit(_AcquireReq(key, count))
+
+    def window_acquire_blocking(self, key: str, count: int, limit: float,
+                                window_sec: float) -> AcquireResult:
+        return self._wtable(limit, window_sec).acquire_blocking(key, count)
+
+    # -- lifecycle / ops ---------------------------------------------------
+    async def aclose(self) -> None:
+        for t in self._tables.values():
+            await t.batcher.aclose()
+        for t in self._wtables.values():
+            await t.batcher.aclose()
+
+    def snapshot(self) -> dict:
+        """Pull all live state to host (planned-restart checkpoint).
+        ``now_ticks`` is captured so restore into a *different* process
+        (fresh clock epoch) can re-align every timestamp."""
+        with self._lock:
+            tables = {}
+            for (cap, rate), t in self._tables.items():
+                tables[(cap, rate)] = {
+                    "directory": dict(t.directory),
+                    "tokens": np.asarray(t.state.tokens),
+                    "last_ts": np.asarray(t.state.last_ts),
+                    "exists": np.asarray(t.state.exists),
+                }
+            wtables = {}
+            for (limit, wt), t in self._wtables.items():
+                wtables[(limit, wt)] = {
+                    "directory": dict(t.directory),
+                    "prev_count": np.asarray(t.state.prev_count),
+                    "curr_count": np.asarray(t.state.curr_count),
+                    "window_idx": np.asarray(t.state.window_idx),
+                    "exists": np.asarray(t.state.exists),
+                }
+            return {
+                "now_ticks": self.clock.now_ticks(),
+                "tables": tables,
+                "wtables": wtables,
+                "counter_dir": dict(self._counter_dir),
+                "counters": {
+                    "value": np.asarray(self._counters.value),
+                    "period": np.asarray(self._counters.period),
+                    "last_ts": np.asarray(self._counters.last_ts),
+                    "exists": np.asarray(self._counters.exists),
+                },
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Restore a checkpoint, re-aligning timestamps to THIS process's
+        clock epoch: elapsed-since-touch is preserved by shifting every
+        stored timestamp by ``now_here − now_at_snapshot`` (without this, a
+        restore into a fresh process would clamp all elapsed time to zero
+        and restored buckets would stop refilling)."""
+        with self._lock:
+            shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
+            for (cap, rate), data in snap["tables"].items():
+                table = self._table(cap, rate)
+                n = len(data["tokens"])
+                if n != table.n_slots:
+                    raise ValueError(
+                        f"snapshot table size {n} != store table size {table.n_slots}"
+                    )
+                last_ts = data["last_ts"].astype(np.int64) + shift
+                table.state = K.BucketState(
+                    tokens=jnp.asarray(data["tokens"]),
+                    last_ts=jnp.asarray(
+                        np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                    exists=jnp.asarray(data["exists"]),
+                )
+                table.directory = dict(data["directory"])
+                used = set(table.directory.values())
+                table.free = [s for s in range(table.n_slots - 1, -1, -1)
+                              if s not in used]
+            for (limit, wt), data in snap.get("wtables", {}).items():
+                table = self._wtable(limit, wt / bm.TICKS_PER_SECOND)
+                n = len(data["prev_count"])
+                if n != table.n_slots:
+                    raise ValueError(
+                        f"snapshot window table size {n} != {table.n_slots}")
+                idx = data["window_idx"].astype(np.int64) + shift // wt
+                table.state = K.WindowState(
+                    prev_count=jnp.asarray(data["prev_count"]),
+                    curr_count=jnp.asarray(data["curr_count"]),
+                    window_idx=jnp.asarray(
+                        np.clip(idx, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                    exists=jnp.asarray(data["exists"]),
+                )
+                table.directory = dict(data["directory"])
+                used = set(table.directory.values())
+                table.free = [s for s in range(table.n_slots - 1, -1, -1)
+                              if s not in used]
+            c = snap["counters"]
+            last_ts = c["last_ts"].astype(np.int64) + shift
+            self._counters = K.CounterState(
+                value=jnp.asarray(c["value"]),
+                period=jnp.asarray(c["period"]),
+                last_ts=jnp.asarray(
+                    np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                exists=jnp.asarray(c["exists"]),
+            )
+            self._counter_dir = dict(snap["counter_dir"])
+            used = set(self._counter_dir.values())
+            n = self._counters.value.shape[0]
+            self._counter_free = [s for s in range(n - 1, -1, -1) if s not in used]
+
+
+class InProcessBucketStore(BucketStore):
+    """Pure-Python store with identical semantics, executed serially per
+    request — the test fake and the Redis-class CPU baseline (one 'script'
+    per op, no batching)."""
+
+    def __init__(self, clock: Clock | None = None) -> None:
+        self.clock = clock or MonotonicClock()
+        self._buckets: dict[tuple, tuple[float, int]] = {}   # (tokens, ts)
+        self._counters: dict[str, tuple[float, float, int]] = {}  # (v, p, ts)
+        self._windows: dict[tuple, tuple[float, float, int]] = {}
+        self._connected = False
+
+    async def connect(self) -> None:
+        self._connected = True
+
+    def _acquire_core(self, key, count, capacity, rate_per_sec) -> AcquireResult:
+        now = self.clock.now_ticks()
+        rate = _rate_per_tick(rate_per_sec)
+        bkey = (key, float(capacity), float(rate_per_sec))
+        entry = self._buckets.get(bkey)
+        if entry is None:
+            refilled = float(capacity)
+        else:
+            tokens, ts = entry
+            refilled = min(float(capacity), tokens + max(0, now - ts) * rate)
+        granted = refilled >= count
+        self._buckets[bkey] = (refilled - (count if granted else 0), now)
+        return AcquireResult(granted, self._buckets[bkey][0])
+
+    async def acquire(self, key, count, capacity, fill_rate_per_sec):
+        await self.connect()
+        return self._acquire_core(key, count, capacity, fill_rate_per_sec)
+
+    def acquire_blocking(self, key, count, capacity, fill_rate_per_sec):
+        return self._acquire_core(key, count, capacity, fill_rate_per_sec)
+
+    def peek_blocking(self, key, capacity, fill_rate_per_sec):
+        now = self.clock.now_ticks()
+        bkey = (key, float(capacity), float(fill_rate_per_sec))
+        entry = self._buckets.get(bkey)
+        if entry is None:
+            return float(np.floor(capacity))
+        tokens, ts = entry
+        rate = _rate_per_tick(fill_rate_per_sec)
+        return float(np.floor(min(float(capacity), tokens + max(0, now - ts) * rate)))
+
+    async def sync_counter(self, key, local_count, decay_rate_per_sec):
+        return self.sync_counter_blocking(key, local_count, decay_rate_per_sec)
+
+    def sync_counter_blocking(self, key, local_count, decay_rate_per_sec):
+        now = self.clock.now_ticks()
+        rate = _rate_per_tick(decay_rate_per_sec)
+        entry = self._counters.get(key)
+        if entry is None:
+            v, p = float(local_count), float(now)
+        else:
+            v0, p0, ts = entry
+            delta = max(0, now - ts)
+            v = max(0.0, v0 - delta * rate) + local_count
+            p = (1 - bm.PERIOD_EWMA_ALPHA) * p0 + bm.PERIOD_EWMA_ALPHA * delta
+        self._counters[key] = (v, p, now)
+        return SyncResult(v, p)
+
+    async def window_acquire(self, key, count, limit, window_sec):
+        return self.window_acquire_blocking(key, count, limit, window_sec)
+
+    def window_acquire_blocking(self, key, count, limit, window_sec):
+        now = self.clock.now_ticks()
+        wt = int(window_sec * bm.TICKS_PER_SECOND)
+        wkey = (key, float(limit), wt)
+        entry = self._windows.get(wkey)
+        idx_now = now // wt
+        if entry is None:
+            prev = curr = 0.0
+        else:
+            prev, curr, idx = entry
+            steps = idx_now - idx
+            if steps == 1:
+                prev, curr = curr, 0.0
+            elif steps >= 2:
+                prev = curr = 0.0
+        frac = (now - idx_now * wt) / wt
+        est = curr + prev * (1.0 - frac)
+        granted = est + count <= limit
+        if granted:
+            curr += count
+        self._windows[wkey] = (prev, curr, idx_now)
+        return AcquireResult(granted, max(0.0, limit - est - (count if granted else 0)))
+
+    async def aclose(self) -> None:
+        pass
+
+    def snapshot(self) -> dict:
+        return {
+            "buckets": dict(self._buckets),
+            "counters": dict(self._counters),
+            "windows": dict(self._windows),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._buckets = dict(snap["buckets"])
+        self._counters = dict(snap["counters"])
+        self._windows = dict(snap["windows"])

@@ -1,0 +1,1 @@
+"""Shared utilities: deque, metrics, structured logging, service registry."""

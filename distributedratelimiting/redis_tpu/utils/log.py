@@ -1,0 +1,35 @@
+"""Structured log events.
+
+Mirror of the reference's source-generated ``LoggerMessage`` partials
+(``RedisApproximateTokenBucketRateLimiter.Log.cs:9-13``): two error events,
+same ids — 1 = could not connect/reach the store, 2 = error executing the
+store kernel. Called from the refresh path only, matching the reference's
+degraded-mode posture (log and keep serving; SURVEY.md invariant 9).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("distributedratelimiting.redis_tpu")
+
+EVENT_COULD_NOT_CONNECT = 1
+EVENT_ERROR_EVALUATING = 2
+
+
+def could_not_connect_to_store(exc: BaseException) -> None:
+    """Event id 1 — ``Log.CouldNotConnectToRedis``."""
+    logger.error(
+        "Could not connect to the backing store",
+        exc_info=exc,
+        extra={"event_id": EVENT_COULD_NOT_CONNECT},
+    )
+
+
+def error_evaluating_kernel(exc: BaseException) -> None:
+    """Event id 2 — ``Log.ErrorEvaluatingRedisScript``."""
+    logger.error(
+        "Error executing store kernel",
+        exc_info=exc,
+        extra={"event_id": EVENT_ERROR_EVALUATING},
+    )

@@ -1,0 +1,132 @@
+"""Metrics — decisions/sec, denial rate, batch occupancy, sync lag, latency.
+
+The reference's observability is skeletal (two error log events plus a
+``ToString()`` dump, SURVEY.md §5.5); real metrics are a gap the new
+framework fills since the north-star metric is decisions/sec + p99 latency.
+Counters are plain ints guarded by the GIL (single event loop); latency uses
+fixed log-spaced buckets so p50/p99 are O(1) to read and recording is
+allocation-free.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+class LatencyHistogram:
+    """Log-spaced buckets from 1µs to ~67s (factor √2, 52 buckets)."""
+
+    BASE = math.sqrt(2.0)
+    MIN_S = 1e-6
+    N_BUCKETS = 52
+
+    def __init__(self) -> None:
+        self.counts = [0] * self.N_BUCKETS
+        self.total = 0
+
+    def record(self, seconds: float) -> None:
+        if seconds <= self.MIN_S:
+            idx = 0
+        else:
+            idx = min(
+                self.N_BUCKETS - 1,
+                int(math.log(seconds / self.MIN_S, self.BASE)) + 1,
+            )
+        self.counts[idx] += 1
+        self.total += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket containing quantile ``q`` (0..1)."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.MIN_S * (self.BASE ** i)
+        return self.MIN_S * (self.BASE ** (self.N_BUCKETS - 1))
+
+    @property
+    def p50(self) -> float:
+        return self.quantile(0.50)
+
+    @property
+    def p99(self) -> float:
+        return self.quantile(0.99)
+
+
+@dataclass
+class LimiterMetrics:
+    """Per-limiter counters. ``snapshot()`` returns a plain dict for export."""
+
+    decisions: int = 0
+    grants: int = 0
+    denials: int = 0
+    queued: int = 0
+    evicted: int = 0
+    cancelled: int = 0
+    sync_failures: int = 0
+    syncs: int = 0
+    last_sync_lag_s: float = 0.0
+    acquire_latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+
+    def record_decision(self, granted: bool, latency_s: float | None = None) -> None:
+        self.decisions += 1
+        if granted:
+            self.grants += 1
+        else:
+            self.denials += 1
+        if latency_s is not None:
+            self.acquire_latency.record(latency_s)
+
+    @property
+    def denial_rate(self) -> float:
+        return self.denials / self.decisions if self.decisions else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "decisions": self.decisions,
+            "grants": self.grants,
+            "denials": self.denials,
+            "denial_rate": self.denial_rate,
+            "queued": self.queued,
+            "evicted": self.evicted,
+            "cancelled": self.cancelled,
+            "syncs": self.syncs,
+            "sync_failures": self.sync_failures,
+            "last_sync_lag_s": self.last_sync_lag_s,
+            "acquire_p50_s": self.acquire_latency.p50,
+            "acquire_p99_s": self.acquire_latency.p99,
+        }
+
+
+@dataclass
+class StoreMetrics:
+    """Per-store (device) counters: kernel launches and batch occupancy."""
+
+    launches: int = 0
+    rows_processed: int = 0
+    rows_valid: int = 0
+    sweeps: int = 0
+    slots_evicted: int = 0
+
+    def record_launch(self, batch_rows: int, valid_rows: int) -> None:
+        self.launches += 1
+        self.rows_processed += batch_rows
+        self.rows_valid += valid_rows
+
+    @property
+    def batch_occupancy(self) -> float:
+        return self.rows_valid / self.rows_processed if self.rows_processed else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "launches": self.launches,
+            "rows_processed": self.rows_processed,
+            "rows_valid": self.rows_valid,
+            "batch_occupancy": self.batch_occupancy,
+            "sweeps": self.sweeps,
+            "slots_evicted": self.slots_evicted,
+        }

@@ -1,0 +1,120 @@
+"""Service registry — the DI/composition layer.
+
+Python translation of ``ServiceCollectionExtensions``
+(``ServiceCollectionExtensions.cs:10-26``): each ``add_*`` helper registers
+an options-configured limiter as a lazily-constructed singleton under the
+``"rate_limiter"`` service type, exactly as the reference registers each
+concrete limiter under the ``RateLimiter`` service type
+(``:15,:24``) — except that here registering a second limiter under the
+same name raises instead of silently creating ambiguity (a known defect:
+both reference methods register the same service type, making resolution
+ambiguous when both are added; SURVEY.md §2 defects).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    ApproximateTokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import (
+    ApproximateTokenBucketOptions,
+    SlidingWindowOptions,
+    TokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.sliding_window import (
+    SlidingWindowRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.token_bucket import (
+    TokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
+
+__all__ = [
+    "ServiceRegistry",
+    "RATE_LIMITER",
+    "add_tpu_token_bucket_rate_limiter",
+    "add_tpu_approximate_token_bucket_rate_limiter",
+    "add_tpu_sliding_window_rate_limiter",
+]
+
+RATE_LIMITER = "rate_limiter"
+BUCKET_STORE = "bucket_store"
+
+
+class ServiceRegistry:
+    """Minimal singleton container: ``add_singleton(name, factory)`` +
+    ``resolve(name)`` with lazy construction (the reference's limiters are
+    likewise constructed on first resolve, SURVEY.md §3.4)."""
+
+    def __init__(self) -> None:
+        self._factories: dict[str, Callable[["ServiceRegistry"], Any]] = {}
+        self._instances: dict[str, Any] = {}
+
+    def add_singleton(self, name: str,
+                      factory: Callable[["ServiceRegistry"], Any]) -> None:
+        if name in self._factories:
+            raise ValueError(
+                f"service {name!r} is already registered — use a distinct "
+                "name per limiter (the reference allowed this collision and "
+                "made resolution ambiguous)"
+            )
+        self._factories[name] = factory
+
+    def resolve(self, name: str) -> Any:
+        if name not in self._instances:
+            if name not in self._factories:
+                raise KeyError(f"no service registered under {name!r}")
+            self._instances[name] = self._factories[name](self)
+        return self._instances[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._factories
+
+
+def _store_of(registry: ServiceRegistry, store: BucketStore | None) -> BucketStore:
+    return store if store is not None else registry.resolve(BUCKET_STORE)
+
+
+def add_tpu_token_bucket_rate_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], TokenBucketOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    """≙ ``AddRedisTokenBucketRateLimiter`` (``ServiceCollectionExtensions.cs:10-17``)."""
+    registry.add_singleton(
+        service_name,
+        lambda reg: TokenBucketRateLimiter(configure(), _store_of(reg, store)),
+    )
+
+
+def add_tpu_approximate_token_bucket_rate_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], ApproximateTokenBucketOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    """≙ ``AddRedisApproximateTokenBucketRateLimiter`` (``:19-26``)."""
+    registry.add_singleton(
+        service_name,
+        lambda reg: ApproximateTokenBucketRateLimiter(
+            configure(), _store_of(reg, store)
+        ),
+    )
+
+
+def add_tpu_sliding_window_rate_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], SlidingWindowOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    registry.add_singleton(
+        service_name,
+        lambda reg: SlidingWindowRateLimiter(configure(), _store_of(reg, store)),
+    )

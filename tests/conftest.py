@@ -12,6 +12,21 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# The environment's sitecustomize registers a remote TPU PJRT plugin
+# ("axon") at interpreter startup; when its relay is unreachable, *any*
+# backend init — even CPU-only — hangs indefinitely. Tests are CPU-only by
+# design, so deregister the plugin before the first array op and pin the
+# platform at the config level (env vars were already snapshotted).
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 import numpy as np
 import pytest
 

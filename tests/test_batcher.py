@@ -1,0 +1,117 @@
+"""Micro-batcher: coalescing, deadline flush, size flush, failure fan-out."""
+
+import asyncio
+
+from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_concurrent_submits_share_one_flush():
+    async def main():
+        batches = []
+
+        async def flush(reqs):
+            batches.append(list(reqs))
+            return [r * 10 for r in reqs]
+
+        b = MicroBatcher(flush, max_batch=64, max_delay_s=0.005)
+        results = await asyncio.gather(*(b.submit(i) for i in range(8)))
+        assert results == [i * 10 for i in range(8)]
+        assert len(batches) == 1 and len(batches[0]) == 8
+
+    run(main())
+
+
+def test_max_batch_triggers_immediate_flush():
+    async def main():
+        batches = []
+
+        async def flush(reqs):
+            batches.append(list(reqs))
+            return list(reqs)
+
+        b = MicroBatcher(flush, max_batch=4, max_delay_s=10.0)  # long deadline
+        await asyncio.gather(*(b.submit(i) for i in range(8)))
+        assert [len(x) for x in batches] == [4, 4]
+
+    run(main())
+
+
+def test_deadline_flush_fires_without_fill():
+    async def main():
+        async def flush(reqs):
+            return [True for _ in reqs]
+
+        b = MicroBatcher(flush, max_batch=1000, max_delay_s=0.002)
+        res = await asyncio.wait_for(b.submit("x"), timeout=1.0)
+        assert res is True
+
+    run(main())
+
+
+def test_flush_failure_fans_out_to_all_waiters():
+    async def main():
+        async def flush(reqs):
+            raise RuntimeError("device on fire")
+
+        b = MicroBatcher(flush, max_batch=64, max_delay_s=0.001)
+        results = await asyncio.gather(
+            *(b.submit(i) for i in range(3)), return_exceptions=True
+        )
+        assert all(isinstance(r, RuntimeError) for r in results)
+
+    run(main())
+
+
+def test_cancelled_submitter_does_not_break_batch():
+    async def main():
+        async def flush(reqs):
+            await asyncio.sleep(0.01)
+            return [r for r in reqs]
+
+        b = MicroBatcher(flush, max_batch=2, max_delay_s=0.001)
+        t1 = asyncio.ensure_future(b.submit(1))
+        t2 = asyncio.ensure_future(b.submit(2))
+        await asyncio.sleep(0)
+        t1.cancel()
+        res2 = await t2
+        assert res2 == 2
+
+    run(main())
+
+
+def test_closed_batcher_rejects():
+    async def main():
+        async def flush(reqs):
+            return list(reqs)
+
+        b = MicroBatcher(flush)
+        await b.aclose()
+        try:
+            await b.submit(1)
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError:
+            pass
+
+    run(main())
+
+
+def test_flush_now_waits_for_inflight_results():
+    """Regression: a shutdown drain must not strand submitters whose flush
+    task is still awaiting device results."""
+
+    async def main():
+        async def flush(reqs):
+            await asyncio.sleep(0.05)  # slow device fetch
+            return [r * 2 for r in reqs]
+
+        b = MicroBatcher(flush, max_batch=10, max_delay_s=0.001)
+        sub = asyncio.ensure_future(b.submit(21))
+        await asyncio.sleep(0.005)  # timer flush fired; task in flight
+        await b.aclose()
+        assert sub.done() and sub.result() == 42
+
+    run(main())

@@ -1,0 +1,88 @@
+"""Deque ring-buffer tests (mirrors reference ``Deque<T>`` behaviors)."""
+
+import pytest
+
+from distributedratelimiting.redis_tpu.utils.deque import Deque
+
+
+def test_fifo_head_tail():
+    d = Deque()
+    for i in range(10):
+        d.enqueue_tail(i)
+    assert len(d) == 10
+    assert d.peek_head() == 0
+    assert d.peek_tail() == 9
+    assert [d.dequeue_head() for _ in range(10)] == list(range(10))
+
+
+def test_dequeue_tail_lifo():
+    d = Deque()
+    for i in range(5):
+        d.enqueue_tail(i)
+    assert [d.dequeue_tail() for _ in range(5)] == [4, 3, 2, 1, 0]
+
+
+def test_enqueue_head():
+    d = Deque()
+    d.enqueue_tail(1)
+    d.enqueue_head(0)
+    assert list(d) == [0, 1]
+
+
+def test_grow_preserves_order_with_wrapped_head():
+    d = Deque(4)
+    for i in range(4):
+        d.enqueue_tail(i)
+    d.dequeue_head()
+    d.dequeue_head()
+    d.enqueue_tail(4)
+    d.enqueue_tail(5)  # wraps
+    d.enqueue_tail(6)  # forces grow with wrapped head
+    assert list(d) == [2, 3, 4, 5, 6]
+
+
+def test_min_grow_four():
+    d = Deque(0)
+    d.enqueue_tail(1)  # grow from 0 → 4
+    assert len(d) == 1
+
+
+def test_remove_middle_keeps_order():
+    d = Deque()
+    items = ["a", "b", "c", "d"]
+    for x in items:
+        d.enqueue_tail(x)
+    assert d.remove("b")
+    assert list(d) == ["a", "c", "d"]
+    assert not d.remove("zz")
+
+
+def test_empty_raises():
+    d = Deque()
+    with pytest.raises(IndexError):
+        d.dequeue_head()
+    with pytest.raises(IndexError):
+        d.peek_tail()
+
+
+def test_interleaved_random_ops_match_model(rng):
+    import collections
+
+    d = Deque()
+    model = collections.deque()
+    for _ in range(2000):
+        op = rng.integers(0, 4)
+        if op == 0:
+            v = int(rng.integers(0, 1000))
+            d.enqueue_tail(v)
+            model.append(v)
+        elif op == 1:
+            v = int(rng.integers(0, 1000))
+            d.enqueue_head(v)
+            model.appendleft(v)
+        elif op == 2 and model:
+            assert d.dequeue_head() == model.popleft()
+        elif op == 3 and model:
+            assert d.dequeue_tail() == model.pop()
+        assert len(d) == len(model)
+    assert list(d) == list(model)

@@ -1,0 +1,155 @@
+"""Waiter-queue semantics (SURVEY.md §2 #5, invariant 8) — including the
+regression test for the reference's cancelled-waiter double-count defect."""
+
+import asyncio
+
+from distributedratelimiting.redis_tpu.runtime.queueing import (
+    QueueProcessingOrder,
+    WaiterQueue,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+LEASE_OK = object()
+LEASE_FAIL = object()
+
+
+def test_queue_limit_counts_cumulative_permits():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        f1, _ = q.try_enqueue(6)
+        f2, _ = q.try_enqueue(4)
+        assert f1 is not None and f2 is not None
+        assert q.queue_count == 10
+        f3, _ = q.try_enqueue(1)  # would exceed 10 cumulative permits
+        assert f3 is None
+
+    run(main())
+
+
+def test_single_request_larger_than_queue_limit_rejected():
+    async def main():
+        q = WaiterQueue(5, QueueProcessingOrder.NEWEST_FIRST)
+        f, evicted = q.try_enqueue(6)
+        assert f is None and not evicted
+
+    run(main())
+
+
+def test_newest_first_evicts_oldest_to_make_room():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.NEWEST_FIRST)
+        f1, _ = q.try_enqueue(6)
+        f2, _ = q.try_enqueue(4)
+        f3, evicted = q.try_enqueue(8)  # evicts f1 then f2
+        assert f3 is not None
+        assert [r.future for r in evicted] == [f1, f2]
+        assert q.queue_count == 8
+
+    run(main())
+
+
+def test_oldest_first_drain_order():
+    async def main():
+        q = WaiterQueue(100, QueueProcessingOrder.OLDEST_FIRST)
+        futures = [q.try_enqueue(c)[0] for c in (5, 3, 2)]
+        available = [6]
+
+        def try_grant(c):
+            if available[0] >= c:
+                available[0] -= c
+                return True
+            return False
+
+        granted = q.drain(try_grant, lambda: LEASE_OK)
+        # Oldest (5) granted, next (3) doesn't fit the remaining 1 → stop.
+        assert granted == 1
+        assert futures[0].result() is LEASE_OK
+        assert not futures[1].done()
+        assert q.queue_count == 5
+
+    run(main())
+
+
+def test_newest_first_drain_order():
+    async def main():
+        q = WaiterQueue(100, QueueProcessingOrder.NEWEST_FIRST)
+        futures = [q.try_enqueue(c)[0] for c in (5, 3, 2)]
+        available = [5]
+
+        def try_grant(c):
+            if available[0] >= c:
+                available[0] -= c
+                return True
+            return False
+
+        granted = q.drain(try_grant, lambda: LEASE_OK)
+        # Newest (2) then (3) granted; oldest (5) doesn't fit remaining 0.
+        assert granted == 2
+        assert futures[2].result() is LEASE_OK
+        assert futures[1].result() is LEASE_OK
+        assert not futures[0].done()
+
+    run(main())
+
+
+def test_cancelled_waiter_unwinds_accounting_no_double_count():
+    """Regression for the reference defect at ``:486-492``: a waiter
+    cancelled while parked must neither hold queue room nor consume."""
+
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        f1, _ = q.try_enqueue(6)
+        f2, _ = q.try_enqueue(4)
+        f1.cancel()
+        await asyncio.sleep(0)  # let done-callback run
+        assert q.queue_count == 4  # f1's 6 permits released immediately
+        # Room freed by cancellation is usable again.
+        f3, _ = q.try_enqueue(6)
+        assert f3 is not None
+
+        consumed = []
+
+        def try_grant(c):
+            consumed.append(c)
+            return True
+
+        granted = q.drain(try_grant, lambda: LEASE_OK)
+        assert granted == 2
+        # The cancelled waiter's 6 permits were never consumed: only 4 + 6.
+        assert sorted(consumed) == [4, 6]
+        assert q.queue_count == 0
+
+    run(main())
+
+
+def test_cancelled_at_head_skipped_during_drain():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        f1, _ = q.try_enqueue(6)
+        f2, _ = q.try_enqueue(4)
+        # Cancel but don't yield: callback runs on cancel() synchronously in
+        # asyncio.Future — drain must still skip it safely either way.
+        f1.cancel()
+        granted = q.drain(lambda c: True, lambda: LEASE_OK)
+        assert granted == 1
+        assert f2.result() is LEASE_OK
+        assert q.queue_count == 0
+
+    run(main())
+
+
+def test_fail_all_completes_everyone():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        f1, _ = q.try_enqueue(6)
+        f2, _ = q.try_enqueue(4)
+        failed = q.fail_all(lambda: LEASE_FAIL)
+        assert failed == 2
+        assert f1.result() is LEASE_FAIL and f2.result() is LEASE_FAIL
+        assert q.queue_count == 0 and len(q) == 0
+
+    run(main())

@@ -1,0 +1,267 @@
+"""Store tests: device vs in-process semantic equivalence, slot lifecycle,
+snapshot/restore, counter sync."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.ops.bucket_math import TICKS_PER_SECOND
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def clock():
+    return ManualClock()
+
+
+def device_store(clock, **kw):
+    kw.setdefault("n_slots", 64)
+    kw.setdefault("counter_slots", 16)
+    kw.setdefault("max_delay_s", 0.001)
+    return DeviceBucketStore(clock=clock, **kw)
+
+
+class TestSemanticEquivalence:
+    def test_random_ops_agree(self, clock, rng):
+        """The TPU store and the serial in-process store must make identical
+        decisions on an identical op stream (deterministic manual clock)."""
+        dev = device_store(clock)
+        ref = InProcessBucketStore(clock=clock)
+        cap, rate = 20.0, 8.0
+
+        async def main():
+            for _ in range(120):
+                clock.advance_ticks(int(rng.integers(0, TICKS_PER_SECOND)))
+                key = f"k{rng.integers(0, 10)}"
+                count = int(rng.integers(0, 6))
+                got = dev.acquire_blocking(key, count, cap, rate)
+                want = ref.acquire_blocking(key, count, cap, rate)
+                assert got.granted == want.granted, (key, count)
+                assert abs(got.remaining - want.remaining) < 1e-2
+
+        run(main())
+
+    def test_batched_async_agrees_with_serial(self, clock, rng):
+        dev = device_store(clock)
+        ref = InProcessBucketStore(clock=clock)
+        cap, rate = 10.0, 2.0
+
+        async def main():
+            for round_ in range(10):
+                clock.advance_ticks(TICKS_PER_SECOND // 2)
+                keys = [f"k{i}" for i in range(8)]
+                counts = [int(rng.integers(1, 4)) for _ in keys]
+                got = await asyncio.gather(*(
+                    dev.acquire(k, c, cap, rate) for k, c in zip(keys, counts)
+                ))
+                want = [ref.acquire_blocking(k, c, cap, rate)
+                        for k, c in zip(keys, counts)]
+                for g, w in zip(got, want):
+                    assert g.granted == w.granted
+
+        run(main())
+
+
+class TestSlotLifecycle:
+    def test_grow_on_exhaustion(self, clock):
+        dev = device_store(clock, n_slots=4)
+
+        async def main():
+            # 10 distinct always-draining keys in a 4-slot table: the table
+            # must grow (sweep can't reclaim — all buckets stay non-full).
+            for i in range(10):
+                res = dev.acquire_blocking(f"k{i}", 5, 10.0, 1.0)
+                assert res.granted
+
+        run(main())
+        table = next(iter(dev._tables.values()))
+        assert table.n_slots >= 10
+        assert len(table.directory) == 10
+
+    def test_sweep_reclaims_idle_slots(self, clock):
+        dev = device_store(clock, n_slots=4)
+
+        async def main():
+            for i in range(4):
+                dev.acquire_blocking(f"k{i}", 1, 10.0, 10.0)
+            # After 2s the buckets are full again (rate 10/s, deficit 1) →
+            # sweep frees them instead of growing.
+            clock.advance_seconds(2.0)
+            dev.acquire_blocking("fresh", 1, 10.0, 10.0)
+
+        run(main())
+        table = next(iter(dev._tables.values()))
+        assert table.n_slots == 4  # no growth: sweep reclaimed
+        assert "fresh" in table.directory
+
+    def test_distinct_configs_get_distinct_tables(self, clock):
+        dev = device_store(clock)
+        dev.acquire_blocking("k", 1, 10.0, 1.0)
+        dev.acquire_blocking("k", 1, 20.0, 1.0)
+        assert len(dev._tables) == 2
+
+
+class TestCounterSync:
+    def test_sync_decay_and_instance_estimate(self, clock):
+        dev = device_store(clock)
+
+        async def main():
+            clock.advance_seconds(1.0)
+            res = await dev.sync_counter("bucket", 30.0, 10.0)
+            assert res.global_score == 30.0
+            clock.advance_seconds(2.0)
+            res = await dev.sync_counter("bucket", 5.0, 10.0)
+            # 30 decayed by 2s*10/s → 10, +5 = 15.
+            assert abs(res.global_score - 15.0) < 1e-3
+
+        run(main())
+
+    def test_matches_inprocess(self, clock, rng):
+        dev = device_store(clock)
+        ref = InProcessBucketStore(clock=clock)
+
+        async def main():
+            for _ in range(20):
+                clock.advance_ticks(int(rng.integers(1, 2 * TICKS_PER_SECOND)))
+                count = float(rng.integers(0, 20))
+                got = await dev.sync_counter("b", count, 5.0)
+                want = await ref.sync_counter("b", count, 5.0)
+                assert abs(got.global_score - want.global_score) < 1e-2
+                assert abs(got.period_ewma_ticks - want.period_ewma_ticks) < 1.0
+
+        run(main())
+
+
+class TestWindow:
+    def test_window_matches_inprocess(self, clock, rng):
+        dev = device_store(clock)
+        ref = InProcessBucketStore(clock=clock)
+
+        async def main():
+            for _ in range(60):
+                clock.advance_ticks(int(rng.integers(0, 3 * TICKS_PER_SECOND)))
+                key = f"k{rng.integers(0, 4)}"
+                count = int(rng.integers(1, 5))
+                got = dev.window_acquire_blocking(key, count, 10.0, 5.0)
+                want = ref.window_acquire_blocking(key, count, 10.0, 5.0)
+                assert got.granted == want.granted, (key, count)
+
+        run(main())
+
+
+class TestSnapshotRestore:
+    def test_roundtrip(self, clock):
+        dev = device_store(clock)
+
+        async def main():
+            dev.acquire_blocking("a", 3, 10.0, 1.0)
+            dev.acquire_blocking("b", 7, 10.0, 1.0)
+            await dev.sync_counter("g", 12.0, 1.0)
+            snap = dev.snapshot()
+
+            dev2 = device_store(clock)
+            dev2.restore(snap)
+            # Same immediate decision surface after restore.
+            r1 = dev.acquire_blocking("a", 7, 10.0, 1.0)
+            r2 = dev2.acquire_blocking("a", 7, 10.0, 1.0)
+            assert r1.granted == r2.granted == True  # noqa: E712  (7 left)
+            r1 = dev.acquire_blocking("b", 7, 10.0, 1.0)
+            r2 = dev2.acquire_blocking("b", 7, 10.0, 1.0)
+            assert r1.granted == r2.granted == False  # noqa: E712
+
+        run(main())
+
+
+class TestEpochRebase:
+    def test_rebase_preserves_decisions(self):
+        clock = ManualClock(start_ticks=2**30 - 10)
+        dev = device_store(clock)
+
+        async def main():
+            dev.acquire_blocking("k", 10, 10.0, 1.0)  # drain at t≈2^30
+            clock.advance_seconds(5.0)  # crosses the rebase threshold
+            # Rebase happens inside now_ticks_checked; elapsed must still be
+            # ~5s → 5 tokens refilled.
+            res = dev.acquire_blocking("k", 5, 10.0, 1.0)
+            assert res.granted
+            res = dev.acquire_blocking("k", 1, 10.0, 1.0)
+            assert not res.granted
+            assert clock.now_ticks() < 2**30  # clock was rebased
+
+        run(main())
+
+
+class TestRestoreAcrossProcesses:
+    def test_restore_realigns_clock_epoch(self):
+        """Regression: restoring into a fresh process (new clock epoch) must
+        preserve elapsed-since-touch, not clamp it to zero."""
+        old_clock = ManualClock(start_ticks=1000 * TICKS_PER_SECOND)  # old uptime
+        old = device_store(old_clock)
+
+        async def main():
+            old.acquire_blocking("k", 10, 10.0, 1.0)  # drain at old-t
+            snap = old.snapshot()
+
+            new_clock = ManualClock(start_ticks=0)  # fresh process
+            new = device_store(new_clock)
+            new.restore(snap)
+            new_clock.advance_seconds(5.0)
+            # 5s elapsed since the drain → 5 tokens, despite epoch change.
+            assert new.acquire_blocking("k", 5, 10.0, 1.0).granted
+            assert not new.acquire_blocking("k", 1, 10.0, 1.0).granted
+
+        run(main())
+
+    def test_restore_includes_window_tables(self):
+        clock = ManualClock()
+        dev = device_store(clock)
+
+        async def main():
+            dev.window_acquire_blocking("w", 8, 10.0, 5.0)
+            snap = dev.snapshot()
+            dev2 = device_store(ManualClock())
+            dev2.restore(snap)
+            # Restored window still remembers 8 of 10 consumed.
+            assert not dev2.window_acquire_blocking("w", 5, 10.0, 5.0).granted
+            assert dev2.window_acquire_blocking("w", 2, 10.0, 5.0).granted
+
+        run(main())
+
+
+class TestSweepPinning:
+    def test_midbatch_sweep_cannot_steal_batch_slot(self):
+        """Regression: a sweep triggered by slot exhaustion mid-batch must
+        not free a slot already resolved for an earlier request in the same
+        batch."""
+        clock = ManualClock()
+        dev = device_store(clock, n_slots=2)
+
+        async def main():
+            dev.acquire_blocking("a", 1, 10.0, 10.0)
+            dev.acquire_blocking("b", 1, 10.0, 10.0)
+            # Both buckets refill to full within 1s → sweepable.
+            clock.advance_seconds(5.0)
+            # Batch touches existing "a" AND new "c": allocating "c" sweeps;
+            # "a"'s pinned slot must survive in the directory.
+            res = await asyncio.gather(
+                dev.acquire(("a"), 10, 10.0, 10.0),
+                dev.acquire(("c"), 10, 10.0, 10.0),
+            )
+            assert all(r.granted for r in res)
+            table = next(iter(dev._tables.values()))
+            assert table.directory.get("a") is not None
+            assert table.directory["a"] != table.directory["c"]
+            # And "a" was actually drained — no cross-contamination (same
+            # tick, so no refill yet).
+            assert not dev.acquire_blocking("a", 1, 10.0, 10.0).granted
+
+        run(main())
