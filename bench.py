@@ -1,0 +1,168 @@
+"""Benchmark: permit decisions/sec/chip at 10M active keys.
+
+North star (BASELINE.json): >= 50M permit decisions/sec aggregate on a
+v5e-8 with p99 acquire < 2ms, i.e. >= 6.25M decisions/sec/chip.
+``vs_baseline`` is measured throughput / 6.25M (the per-chip north-star
+share — the reference itself publishes no numbers, BASELINE.md).
+
+Prints ONE JSON line. Extra keys carry secondary measurements (single-batch
+dispatch rate, end-to-end asyncio path, p99) without changing the schema.
+
+Method (headline): steady-state device throughput of the batched
+refill-and-decrement kernel over a 10M-slot HBM table — batches of 8K
+random keys, 16 batches pipelined per dispatch via lax.scan (each batch
+keeps its own ``now`` operand), donated state buffers, host->device
+transfer of fresh request arrays included in the timed loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+
+N_SLOTS = 10_000_000
+BATCH = 8192
+SCAN_K = 16
+ITERS = 30            # timed dispatches of SCAN_K batches each
+CAPACITY = 100.0
+RATE_PER_SEC = 50.0
+NORTH_STAR_PER_CHIP = 50e6 / 8
+
+
+def bench_kernel_throughput(jnp, K, clock):
+    """Headline: scanned multi-batch kernel path at 10M keys."""
+    import jax
+
+    rate_per_tick = jnp.float32(RATE_PER_SEC / 1024.0)
+    cap = jnp.float32(CAPACITY)
+    state = K.init_bucket_state(N_SLOTS)
+    rng = np.random.default_rng(0)
+
+    def stage():
+        slots = rng.integers(0, N_SLOTS, (SCAN_K, BATCH)).astype(np.int32)
+        counts = np.ones((SCAN_K, BATCH), np.int32)
+        valid = np.ones((SCAN_K, BATCH), bool)
+        return slots, counts, valid
+
+    staged = [stage() for _ in range(4)]
+
+    def dispatch(state, arrays):
+        slots, counts, valid = arrays
+        base = clock.now_ticks()
+        nows = np.arange(SCAN_K, dtype=np.int32) + base
+        return K.acquire_scan(
+            state, jnp.asarray(slots), jnp.asarray(counts),
+            jnp.asarray(valid), jnp.asarray(nows), cap, rate_per_tick,
+        )
+
+    # Warmup: compile + touch every page of the donated buffers.
+    state, granted, _ = dispatch(state, staged[0])
+    jax.block_until_ready(granted)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        state, granted, _ = dispatch(state, staged[i % len(staged)])
+    jax.block_until_ready(granted)
+    dt = time.perf_counter() - t0
+    decisions = ITERS * SCAN_K * BATCH
+    return decisions / dt, state
+
+
+def bench_single_batch(jnp, K, clock, state):
+    """Secondary: one-batch-per-dispatch rate (latency-oriented path)."""
+    import jax
+
+    rate_per_tick = jnp.float32(RATE_PER_SEC / 1024.0)
+    cap = jnp.float32(CAPACITY)
+    rng = np.random.default_rng(1)
+    slots = [jnp.asarray(rng.integers(0, N_SLOTS, BATCH), np.int32)
+             for _ in range(4)]
+    counts = jnp.ones((BATCH,), jnp.int32)
+    valid = jnp.ones((BATCH,), bool)
+
+    state, granted, _ = K.acquire_batch(
+        state, slots[0], counts, valid, jnp.int32(clock.now_ticks()),
+        cap, rate_per_tick, handle_duplicates=False)
+    jax.block_until_ready(granted)
+    iters = 100
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, granted, _ = K.acquire_batch(
+            state, slots[i % 4], counts, valid,
+            jnp.int32(clock.now_ticks()), cap, rate_per_tick,
+            handle_duplicates=False)
+    jax.block_until_ready(granted)
+    dt = time.perf_counter() - t0
+    return iters * BATCH / dt
+
+
+async def bench_e2e_async(store_mod, limiter_mod, options_mod):
+    """End-to-end asyncio path: micro-batched partitioned limiter; returns
+    (decisions/s, p99 seconds) at a modest concurrent load."""
+    store = store_mod.DeviceBucketStore(
+        n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6)
+    lim = limiter_mod.PartitionedRateLimiter(
+        options_mod.TokenBucketOptions(
+            token_limit=1000, tokens_per_period=1000,
+            instance_name="bench"), store)
+    # Warm the kernel path.
+    await lim.acquire_async("warm", 1)
+
+    lat: list[float] = []
+    concurrency = 512
+    total = concurrency * 8
+
+    async def one(i):
+        t0 = time.perf_counter()
+        await lim.acquire_async(f"user{i % 10000}", 1)
+        lat.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for start in range(0, total, concurrency):
+        await asyncio.gather(*(one(i) for i in range(start, start + concurrency)))
+    dt = time.perf_counter() - t0
+    await store.aclose()
+    lat.sort()
+    return len(lat) / dt, lat[int(len(lat) * 0.99)]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distributedratelimiting.redis_tpu.models import partitioned
+    from distributedratelimiting.redis_tpu.models import options as options_mod
+    from distributedratelimiting.redis_tpu.ops import kernels as K
+    from distributedratelimiting.redis_tpu.runtime import store as store_mod
+    from distributedratelimiting.redis_tpu.runtime.clock import MonotonicClock
+
+    platform = jax.devices()[0].platform
+    clock = MonotonicClock()
+
+    throughput, state = bench_kernel_throughput(jnp, K, clock)
+    single = bench_single_batch(jnp, K, clock, state)
+    e2e_rate, p99 = asyncio.run(
+        bench_e2e_async(store_mod, partitioned, options_mod))
+
+    print(json.dumps({
+        "metric": "permit_decisions_per_sec_per_chip",
+        "value": round(throughput),
+        "unit": "decisions/s",
+        "vs_baseline": round(throughput / NORTH_STAR_PER_CHIP, 3),
+        "platform": platform,
+        "n_keys": N_SLOTS,
+        "batch": BATCH,
+        "scan_depth": SCAN_K,
+        "single_batch_decisions_per_sec": round(single),
+        "e2e_async_decisions_per_sec": round(e2e_rate),
+        "e2e_p99_ms": round(p99 * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,9 @@ __all__ = [
     "init_bucket_state",
     "init_counter_state",
     "init_window_state",
+    "acquire_core",
     "acquire_batch",
+    "acquire_scan",
     "sync_batch",
     "window_acquire_batch",
     "sweep_expired",
@@ -127,29 +129,12 @@ def _scatter_slots(slots, valid, size):
     return jnp.where(valid, slots, size)
 
 
-@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
-def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
-                  fill_rate_per_tick, *, handle_duplicates: bool = True):
-    """Atomic batched refill-and-decrement — the exact-bucket Lua kernel
-    (``RedisTokenBucketRateLimiter.cs:176-239``) over a micro-batch.
-
-    Args:
-      state: donated ``BucketState`` (buffers re-used in place).
-      slots: i32[B] table indices (-1 or any out-of-range ⇒ padding row).
-      counts: i32[B] requested permits (>= 0; 0 behaves as a probe).
-      valid: bool[B] real-request mask.
-      now: i32 scalar batch timestamp (host is time authority, invariant 1).
-      capacity, fill_rate_per_tick: f32 scalars (operands, not constants).
-      handle_duplicates: statically enables the O(B²) same-slot
-        serialization. The host batcher coalesces duplicates, so the fast
-        variant (False) is used whenever a flush is duplicate-free.
-
-    Returns:
-      ``(new_state, granted bool[B], remaining f32[B])`` where ``remaining``
-      is each request's post-decision view of its bucket (conservative under
-      in-batch duplication) — the analogue of the script's ``new_v`` reply
-      (``:238``).
-    """
+def acquire_core(state: BucketState, slots, counts, valid, now, capacity,
+                 fill_rate_per_tick, *, handle_duplicates: bool = True):
+    """Traceable core of :func:`acquire_batch` — also the per-shard block
+    body under ``shard_map`` (where ``state`` is one shard's slice and
+    ``slots`` are shard-local ids). See :func:`acquire_batch` for the full
+    contract."""
     valid = _valid_slots(slots, valid, state.tokens.shape[0])
     gs = _gather_slots(slots, valid)
     t_old = state.tokens[gs]
@@ -180,6 +165,62 @@ def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
     new_exists = state.exists.at[ss].set(True, mode="drop")
 
     return BucketState(new_tokens, new_last_ts, new_exists), granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
+                  fill_rate_per_tick, *, handle_duplicates: bool = True):
+    """Atomic batched refill-and-decrement — the exact-bucket Lua kernel
+    (``RedisTokenBucketRateLimiter.cs:176-239``) over a micro-batch.
+
+    Args:
+      state: donated ``BucketState`` (buffers re-used in place).
+      slots: i32[B] table indices (-1 or any out-of-range ⇒ padding row).
+      counts: i32[B] requested permits (>= 0; 0 behaves as a probe).
+      valid: bool[B] real-request mask.
+      now: i32 scalar batch timestamp (host is time authority, invariant 1).
+      capacity, fill_rate_per_tick: f32 scalars (operands, not constants).
+      handle_duplicates: statically enables the O(B²) same-slot
+        serialization. The host batcher coalesces duplicates, so the fast
+        variant (False) is used whenever a flush is duplicate-free.
+
+    Returns:
+      ``(new_state, granted bool[B], remaining f32[B])`` where ``remaining``
+      is each request's post-decision view of its bucket (conservative under
+      in-batch duplication) — the analogue of the script's ``new_v`` reply
+      (``:238``).
+    """
+    return acquire_core(state, slots, counts, valid, now, capacity,
+                        fill_rate_per_tick, handle_duplicates=handle_duplicates)
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan(state: BucketState, slots_k, counts_k, valid_k, nows_k,
+                 capacity, fill_rate_per_tick, *,
+                 handle_duplicates: bool = False):
+    """Pipelined dispatch: K micro-batches decided in ONE kernel launch via
+    ``lax.scan`` — amortizes launch overhead when the host has several
+    flushes queued. Semantics are identical to K sequential
+    :func:`acquire_batch` calls: each scanned batch keeps its own ``now``
+    operand (``nows_k[k]``), preserving the one-timestamp-per-batch
+    time-authority property.
+
+    Shapes: ``slots_k/counts_k/valid_k: [K, B]``, ``nows_k: i32[K]``.
+    Returns ``(new_state, granted [K, B], remaining [K, B])``.
+    """
+
+    def body(st, xs):
+        slots, counts, valid, now = xs
+        st, granted, remaining = acquire_core(
+            st, slots, counts, valid, now, capacity, fill_rate_per_tick,
+            handle_duplicates=handle_duplicates,
+        )
+        return st, (granted, remaining)
+
+    state, (granted, remaining) = jax.lax.scan(
+        body, state, (slots_k, counts_k, valid_k, nows_k)
+    )
+    return state, granted, remaining
 
 
 @partial(jax.jit, donate_argnums=0)

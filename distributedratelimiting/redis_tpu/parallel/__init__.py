@@ -1,0 +1,10 @@
+"""Multi-chip scale-out: mesh helpers, key-sharded state, psum global tier.
+
+The reference's entire "distributed backend" is a client-server star over
+TCP — every client talks to one Redis, never to each other (SURVEY.md §5.8).
+On TPU the star inverts into a mesh: bucket state shards over devices along
+the key axis (keys never interact → zero cross-chip traffic on the hot
+path, §5.7), and the only collective is the two-level approximate
+algorithm's global tier — a ``lax.psum`` of per-chip consumed counts over
+ICI, replacing the per-period Redis round-trip.
+"""

@@ -1,0 +1,329 @@
+"""Key-sharded bucket state over a device mesh + the two-level psum step.
+
+This is the scale-out tier (SURVEY.md §5.7-5.8, §7 L4): the
+``(key → {tokens, last_ts})`` table becomes 1-D arrays sharded along the
+key axis of a ``Mesh``; key→shard routing is a stable hash on the host;
+per-key independence means the hot acquire path needs **zero cross-chip
+communication** — each shard decides its own keys' requests in its own
+HBM. The only collective is the approximate algorithm's global tier: one
+``lax.psum`` of per-chip consumed counts per sync (replacing the
+reference's per-period Redis round-trip,
+``RedisApproximateTokenBucketRateLimiter.cs:439``), so the ICI cost is one
+scalar all-reduce per period, not per request.
+
+``make_two_level_step`` builds the flagship fused step — sharded batched
+acquire + psum + decaying replicated global counter — which is also the
+framework's ``dryrun_multichip`` / bench entry (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import zlib
+from functools import partial
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+from distributedratelimiting.redis_tpu.ops import kernels as K
+from distributedratelimiting.redis_tpu.parallel.mesh import SHARD_AXIS
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    _pad_size,
+    _REBASE_MARGIN_TICKS,
+    _REBASE_THRESHOLD_TICKS,
+)
+from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
+
+__all__ = [
+    "GlobalCounter",
+    "make_sharded_acquire_step",
+    "make_two_level_step",
+    "ShardedDeviceStore",
+    "shard_of_key",
+]
+
+
+class GlobalCounter(NamedTuple):
+    """Replicated decaying global counter (one logical limiter's shared
+    tier): scalar ``{v, p, t}`` hash, same as the reference's global bucket
+    (``RedisApproximateTokenBucketRateLimiter.cs:265-268``)."""
+
+    value: jax.Array    # f32[] decaying throttle score
+    period: jax.Array   # f32[] EWMA of inter-sync interval (ticks)
+    last_ts: jax.Array  # i32[]
+    exists: jax.Array   # bool[]
+
+
+def init_global_counter() -> GlobalCounter:
+    return GlobalCounter(
+        value=jnp.float32(0), period=jnp.float32(0),
+        last_ts=jnp.int32(0), exists=jnp.asarray(False),
+    )
+
+
+def shard_of_key(key: str, n_shards: int) -> int:
+    """Stable key→shard routing (host side). crc32 so every client process
+    on every host routes identically — the distributed directory needs no
+    coordination."""
+    return zlib.crc32(key.encode()) % n_shards
+
+
+def make_sharded_acquire_step(mesh, *, handle_duplicates: bool = True):
+    """Jitted sharded acquire: state sharded along keys, batch laid out as
+    ``[n_shards, B_local]`` with shard-LOCAL slot ids. No collectives —
+    each shard serves its keys independently.
+    """
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    batch_spec = P(SHARD_AXIS, None)
+
+    def block(state, slots, counts, valid, now, capacity, rate):
+        # Block sees its own [per_shard] slice and [1, B] batch rows.
+        new_state, granted, remaining = K.acquire_core(
+            state, slots[0], counts[0], valid[0], now, capacity, rate,
+            handle_duplicates=handle_duplicates,
+        )
+        return new_state, granted[None], remaining[None]
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec, batch_spec, P(), P(), P()),
+        out_specs=(state_specs, batch_spec, batch_spec),
+    )
+    return jax.jit(mapped, donate_argnums=0)
+
+
+def make_two_level_step(mesh, *, handle_duplicates: bool = True):
+    """The flagship fused multi-chip step (BASELINE config 5):
+
+    1. sharded batched acquire over the key-sharded table (no comm);
+    2. per-chip consumed = Σ granted counts;
+    3. ``lax.psum`` over ICI → total consumed this step;
+    4. replicated global counter decays and absorbs the total
+       (``new_v = max(0, v − Δt·decay) + Σ``, the sync-script recurrence).
+
+    Returns ``(new_state, granted, remaining, new_global, global_score)``.
+    In production the global tier runs once per replenishment period; fusing
+    it here costs one scalar psum and gives the dry-run/bench a single step
+    exercising sharding + collective together.
+    """
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    gspecs = GlobalCounter(P(), P(), P(), P())
+    batch_spec = P(SHARD_AXIS, None)
+
+    def block(state, slots, counts, valid, now, capacity, rate,
+              gcounter, decay_rate):
+        new_state, granted, remaining = K.acquire_core(
+            state, slots[0], counts[0], valid[0], now, capacity, rate,
+            handle_duplicates=handle_duplicates,
+        )
+        consumed = jnp.sum(
+            jnp.asarray(counts[0], jnp.float32) * granted
+        )
+        total = jax.lax.psum(consumed, SHARD_AXIS)  # the only collective
+        decayed, new_period = bm.decay_core(
+            gcounter.value, gcounter.period, gcounter.last_ts,
+            gcounter.exists, now, decay_rate,
+        )
+        new_g = GlobalCounter(
+            value=decayed + total,
+            period=new_period,
+            last_ts=jnp.asarray(now, jnp.int32),
+            exists=jnp.asarray(True),
+        )
+        return new_state, granted[None], remaining[None], new_g
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
+                  P(), P(), P(), gspecs, P()),
+        out_specs=(state_specs, batch_spec, batch_spec, gspecs),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 7))
+
+
+class ShardedDeviceStore:
+    """Host runtime for one key-sharded, homogeneous-config bucket table.
+
+    Mirrors ``_DeviceTable``'s role in the single-chip store, scaled over a
+    mesh: host directory maps key → (shard, local slot); requests are
+    grouped by shard, padded to a common per-shard width, and decided in
+    one launch of the sharded step. The global tier (two-level) is fused
+    into the same launch.
+    """
+
+    def __init__(self, mesh, capacity: float, fill_rate_per_sec: float,
+                 *, per_shard_slots: int = 2**14,
+                 clock: Clock | None = None,
+                 handle_duplicates: bool = True) -> None:
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.per_shard = per_shard_slots
+        self.capacity = float(capacity)
+        self.fill_rate_per_sec = float(fill_rate_per_sec)
+        self.rate_per_tick = fill_rate_per_sec / bm.TICKS_PER_SECOND
+        self.clock = clock or MonotonicClock()
+        self.metrics = StoreMetrics()
+
+        n_total = self.n_shards * per_shard_slots
+        sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self.state = K.BucketState(
+            tokens=jax.device_put(jnp.zeros((n_total,), jnp.float32), sharding),
+            last_ts=jax.device_put(jnp.zeros((n_total,), jnp.int32), sharding),
+            exists=jax.device_put(jnp.zeros((n_total,), bool), sharding),
+        )
+        self.gcounter = jax.device_put(
+            init_global_counter(), NamedSharding(mesh, P())
+        )
+        self._step = make_two_level_step(mesh,
+                                         handle_duplicates=handle_duplicates)
+        self.directory: dict[str, tuple[int, int]] = {}
+        self.free: list[list[int]] = [
+            list(range(per_shard_slots - 1, -1, -1)) for _ in range(self.n_shards)
+        ]
+        import threading
+
+        self._lock = threading.RLock()
+
+    # -- slot routing ------------------------------------------------------
+    def _slot_for(self, key: str,
+                  new_allocs: list[str] | None = None,
+                  pinned: set[tuple[int, int]] | None = None) -> tuple[int, int]:
+        loc = self.directory.get(key)
+        if loc is None:
+            shard = shard_of_key(key, self.n_shards)
+            if not self.free[shard]:
+                # Try reclaiming expired slots before failing, as the
+                # single-chip allocator does (store.py _allocate).
+                self._sweep_locked(pinned)
+            if not self.free[shard]:
+                raise RuntimeError(
+                    f"shard {shard} is out of slots even after a sweep "
+                    f"(per_shard_slots={self.per_shard}); size the table for "
+                    "the live key population"
+                )
+            loc = (shard, self.free[shard].pop())
+            self.directory[key] = loc
+            if new_allocs is not None:
+                new_allocs.append(key)
+        return loc
+
+    def now_ticks_checked(self) -> int:
+        """Store clock read with the same int32-overflow protection as the
+        single-chip store: rebase every epoch-bearing array (sharded state,
+        replicated global counter) and the clock together before ~24 days
+        of tick time can overflow."""
+        now = self.clock.now_ticks()
+        if now >= _REBASE_THRESHOLD_TICKS:
+            with self._lock:
+                now = self.clock.now_ticks()
+                if now >= _REBASE_THRESHOLD_TICKS:
+                    offset = now - _REBASE_MARGIN_TICKS
+                    self.state = K.rebase_bucket_epoch(
+                        self.state, jnp.int32(offset))
+                    self.gcounter = GlobalCounter(
+                        value=self.gcounter.value,
+                        period=self.gcounter.period,
+                        last_ts=jnp.maximum(
+                            self.gcounter.last_ts - jnp.int32(offset), 0),
+                        exists=self.gcounter.exists,
+                    )
+                    self.clock.rebase(offset)
+                    now = self.clock.now_ticks()
+        return now
+
+    # -- decisions ---------------------------------------------------------
+    def acquire_batch_blocking(
+        self, requests: Sequence[tuple[str, int]],
+        decay_rate_per_sec: float | None = None,
+    ) -> list[AcquireResult]:
+        """Decide a batch of ``(key, count)`` requests in one fused launch.
+        Returns results in request order."""
+        decay = (decay_rate_per_sec if decay_rate_per_sec is not None
+                 else self.fill_rate_per_sec) / bm.TICKS_PER_SECOND
+        with self._lock:
+            return self._acquire_locked(requests, decay)
+
+    def _acquire_locked(self, requests, decay) -> list[AcquireResult]:
+        groups: list[list[int]] = [[] for _ in range(self.n_shards)]
+        locs: list[tuple[int, int]] = []
+        new_allocs: list[str] = []
+        pinned: set[tuple[int, int]] = set()
+        try:
+            for i, (key, _count) in enumerate(requests):
+                shard, local = self._slot_for(key, new_allocs, pinned)
+                locs.append((shard, local))
+                groups[shard].append(i)
+                pinned.add((shard, local))
+        except RuntimeError:
+            # Roll back this batch's fresh allocations: their device
+            # `exists` bits were never set, so the TTL sweep could never
+            # reclaim them — without rollback they would leak forever.
+            for key in new_allocs:
+                shard, local = self.directory.pop(key)
+                self.free[shard].append(local)
+            raise
+        b_local = _pad_size(max((len(g) for g in groups), default=1), floor=8)
+        slots_np = np.full((self.n_shards, b_local), -1, np.int32)
+        counts_np = np.zeros((self.n_shards, b_local), np.int32)
+        valid_np = np.zeros((self.n_shards, b_local), bool)
+        pos: list[tuple[int, int]] = [(-1, -1)] * len(requests)
+        for shard, idxs in enumerate(groups):
+            for j, i in enumerate(idxs):
+                slots_np[shard, j] = locs[i][1]
+                counts_np[shard, j] = requests[i][1]
+                valid_np[shard, j] = True
+                pos[i] = (shard, j)
+        now = self.now_ticks_checked()
+        self.state, granted, remaining, self.gcounter = self._step(
+            self.state,
+            jnp.asarray(slots_np), jnp.asarray(counts_np), jnp.asarray(valid_np),
+            jnp.int32(now), jnp.float32(self.capacity),
+            jnp.float32(self.rate_per_tick), self.gcounter, jnp.float32(decay),
+        )
+        g_np = np.asarray(granted)
+        r_np = np.asarray(remaining)
+        self.metrics.record_launch(self.n_shards * b_local, len(requests))
+        return [
+            AcquireResult(bool(g_np[s, j]), float(r_np[s, j])) for s, j in pos
+        ]
+
+    @property
+    def global_score(self) -> float:
+        return float(np.asarray(self.gcounter.value))
+
+    def sweep(self) -> int:
+        """TTL eviction across all shards (elementwise → partitioned by XLA
+        along the existing sharding, no resharding)."""
+        with self._lock:
+            return self._sweep_locked(None)
+
+    def _sweep_locked(self, pinned: set[tuple[int, int]] | None) -> int:
+        """``pinned`` (shard, local) pairs — slots already resolved for an
+        in-flight batch — are exempt from reclamation (same mid-batch
+        cross-contamination hazard as the single-chip store's sweep)."""
+        now = self.now_ticks_checked()
+        self.state, freed = K.sweep_expired(
+            self.state, jnp.int32(now), jnp.float32(self.capacity),
+            jnp.float32(self.rate_per_tick),
+        )
+        freed_np = np.asarray(freed)
+        n_freed = 0
+        if freed_np.any():
+            dead = set(np.nonzero(freed_np)[0].tolist())
+            if pinned:
+                dead -= {s * self.per_shard + l for s, l in pinned}
+            for k in [k for k, (s, l) in self.directory.items()
+                      if s * self.per_shard + l in dead]:
+                s, l = self.directory.pop(k)
+                self.free[s].append(l)
+                n_freed += 1
+        self.metrics.sweeps += 1
+        self.metrics.slots_evicted += n_freed
+        return n_freed
+

@@ -1,0 +1,122 @@
+"""Multi-chip tests on the virtual 8-device CPU mesh (SURVEY.md §4 (d)).
+
+Key-sharded acquire must agree with the serial in-process store; the
+two-level global tier must see the psum of all shards' consumption.
+"""
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.ops.bucket_math import TICKS_PER_SECOND
+from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
+from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+    ShardedDeviceStore,
+    shard_of_key,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+
+import jax
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return create_mesh(8)
+
+
+@pytest.fixture
+def clock():
+    return ManualClock()
+
+
+def test_mesh_has_8_devices(mesh):
+    assert mesh.devices.size == 8
+
+
+def test_routing_is_stable_and_spread(mesh):
+    shards = [shard_of_key(f"key-{i}", 8) for i in range(1000)]
+    assert shards == [shard_of_key(f"key-{i}", 8) for i in range(1000)]
+    counts = np.bincount(shards, minlength=8)
+    assert counts.min() > 50  # roughly uniform
+
+
+def test_sharded_agrees_with_serial(mesh, clock, rng):
+    sharded = ShardedDeviceStore(mesh, 20.0, 8.0, per_shard_slots=64,
+                                 clock=clock)
+    ref = InProcessBucketStore(clock=clock)
+    for _ in range(15):
+        clock.advance_ticks(int(rng.integers(0, TICKS_PER_SECOND)))
+        keys = [f"k{i}" for i in rng.choice(40, size=24, replace=False)]
+        counts = [int(c) for c in rng.integers(0, 6, size=24)]
+        got = sharded.acquire_batch_blocking(list(zip(keys, counts)))
+        want = [ref.acquire_blocking(k, c, 20.0, 8.0)
+                for k, c in zip(keys, counts)]
+        for g, w, k, c in zip(got, want, keys, counts):
+            assert g.granted == w.granted, (k, c)
+            assert abs(g.remaining - w.remaining) < 1e-2
+
+
+def test_global_tier_psums_all_shards(mesh, clock):
+    sharded = ShardedDeviceStore(mesh, 10.0, 0.0, per_shard_slots=16,
+                                 clock=clock)
+    # 32 distinct keys spread over all shards, each granted 2 permits.
+    reqs = [(f"k{i}", 2) for i in range(32)]
+    results = sharded.acquire_batch_blocking(reqs, decay_rate_per_sec=0.0)
+    assert all(r.granted for r in results)
+    # Global counter = psum of per-shard consumption = 64.
+    assert sharded.global_score == 64.0
+
+
+def test_global_tier_decays(mesh, clock):
+    sharded = ShardedDeviceStore(mesh, 10.0, 0.0, per_shard_slots=16,
+                                 clock=clock)
+    sharded.acquire_batch_blocking([("a", 4)], decay_rate_per_sec=2.0)
+    assert sharded.global_score == 4.0
+    clock.advance_seconds(1.0)
+    sharded.acquire_batch_blocking([("b", 0)], decay_rate_per_sec=2.0)
+    # 4 − 1s·2/s = 2, +0 consumed (b's probe grants nothing... probe counts 0)
+    assert abs(sharded.global_score - 2.0) < 1e-3
+
+
+def test_per_key_independence_across_shards(mesh, clock):
+    sharded = ShardedDeviceStore(mesh, 5.0, 0.0, per_shard_slots=16,
+                                 clock=clock)
+    reqs = [(f"k{i}", 5) for i in range(16)]
+    assert all(r.granted for r in sharded.acquire_batch_blocking(reqs))
+    # All drained; second round denied, regardless of shard.
+    assert not any(r.granted for r in sharded.acquire_batch_blocking(reqs))
+
+
+def test_sweep_reclaims_across_shards(mesh, clock):
+    sharded = ShardedDeviceStore(mesh, 10.0, 10.0, per_shard_slots=8,
+                                 clock=clock)
+    sharded.acquire_batch_blocking([(f"k{i}", 1) for i in range(20)])
+    assert len(sharded.directory) == 20
+    clock.advance_seconds(5.0)  # all buckets refill to full → expire
+    freed = sharded.sweep()
+    assert freed == 20
+    assert len(sharded.directory) == 0
+
+
+def test_duplicate_keys_in_one_batch_never_over_admit(mesh, clock):
+    sharded = ShardedDeviceStore(mesh, 5.0, 0.0, per_shard_slots=16,
+                                 clock=clock)
+    reqs = [("hot", 1)] * 12
+    results = sharded.acquire_batch_blocking(reqs)
+    assert sum(r.granted for r in results) == 5
+
+
+def test_failed_allocation_rolls_back_no_leak(mesh, clock):
+    """Regression: an exhaustion error mid-batch must roll back that
+    batch's fresh allocations (their exists bits were never set, so a sweep
+    could never reclaim them)."""
+    tiny = ShardedDeviceStore(mesh, 10.0, 5.0, per_shard_slots=2, clock=clock)
+    with pytest.raises(RuntimeError):
+        tiny.acquire_batch_blocking([(f"x{i}", 1) for i in range(64)])
+    # Nothing leaked: all slots are free again and the directory is empty.
+    assert len(tiny.directory) == 0
+    assert all(len(f) == 2 for f in tiny.free)
+    # The store remains fully usable.
+    res = tiny.acquire_batch_blocking([("y1", 1), ("y2", 1)])
+    assert all(r.granted for r in res)
