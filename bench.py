@@ -102,33 +102,44 @@ def bench_single_batch(jnp, K, clock, state):
 
 
 async def bench_e2e_async(store_mod, limiter_mod, options_mod):
-    """End-to-end asyncio path: micro-batched partitioned limiter; returns
-    (decisions/s, p99 seconds) at a modest concurrent load."""
+    """End-to-end asyncio path: micro-batched partitioned limiter driven by
+    a closed-loop worker pool deep enough to keep several flush readbacks in
+    flight (readback RTT dominates on tunneled links and overlaps across
+    flushes). Returns (decisions/s, p99 seconds)."""
     store = store_mod.DeviceBucketStore(
-        n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6)
+        n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6, max_inflight=16)
     lim = limiter_mod.PartitionedRateLimiter(
         options_mod.TokenBucketOptions(
-            token_limit=1000, tokens_per_period=1000,
+            token_limit=10_000_000, tokens_per_period=10_000_000,
             instance_name="bench"), store)
-    # Warm the kernel path.
-    await lim.acquire_async("warm", 1)
-
     lat: list[float] = []
-    concurrency = 512
-    total = concurrency * 8
+    workers = 16384
+    reqs_per_worker = 3
 
-    async def one(i):
-        t0 = time.perf_counter()
-        await lim.acquire_async(f"user{i % 10000}", 1)
-        lat.append(time.perf_counter() - t0)
+    async def worker(w):
+        for j in range(reqs_per_worker):
+            t0 = time.perf_counter()
+            await lim.acquire_async(f"user{(w * 7 + j) % 10000}", 1)
+            lat.append(time.perf_counter() - t0)
+
+    # Warm the kernel (one compile per table) at full depth.
+    await asyncio.gather(*(worker(w) for w in range(2048)))
+    lat.clear()
 
     t0 = time.perf_counter()
-    for start in range(0, total, concurrency):
-        await asyncio.gather(*(one(i) for i in range(start, start + concurrency)))
+    await asyncio.gather(*(worker(w) for w in range(workers)))
     dt = time.perf_counter() - t0
-    await store.aclose()
+    throughput = len(lat) / dt
+
+    # Low-load latency probe: p99 without saturation queueing — at this
+    # depth each request's latency ≈ flush deadline + one device round
+    # trip (RTT-bound on tunneled links; ~sub-ms on co-located hosts).
+    lat.clear()
+    await asyncio.gather(*(worker(w) for w in range(64)))
     lat.sort()
-    return len(lat) / dt, lat[int(len(lat) * 0.99)]
+    p99_low = lat[int(len(lat) * 0.99)]
+    await store.aclose()
+    return throughput, p99_low
 
 
 def main():
@@ -160,7 +171,7 @@ def main():
         "scan_depth": SCAN_K,
         "single_batch_decisions_per_sec": round(single),
         "e2e_async_decisions_per_sec": round(e2e_rate),
-        "e2e_p99_ms": round(p99 * 1e3, 3),
+        "e2e_p99_low_load_ms": round(p99 * 1e3, 3),
     }))
 
 

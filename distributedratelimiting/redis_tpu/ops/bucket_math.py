@@ -40,6 +40,7 @@ script text per limiter instance
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # One tick = 1/1024 s. Power of two → exact in float32, and a full int32 range
@@ -329,13 +330,31 @@ def duplicate_prefix(slots, counts, valid):
     micro-batcher additionally coalesces duplicates across flushes so this
     conservative path is rare (SURVEY.md §7 "Hard parts").
 
-    Implemented as a masked lower-triangular matmul so the O(B²) pairwise
-    comparison lands on the MXU: for B = 4096 this is one 4096×4096·f32
-    matvec, microseconds on TPU.
+    Implemented as a stable sort by slot + segmented exclusive prefix sum —
+    O(B log B) with O(B) memory traffic, cheap enough that the dup-safe
+    kernel variant is simply always used (no per-flush host dup detection,
+    no second compiled variant).
     """
     slots = jnp.asarray(slots)
-    b = slots.shape[0]
-    eq = (slots[:, None] == slots[None, :]).astype(jnp.float32)
-    lower = jnp.tri(b, k=-1, dtype=jnp.float32)  # strictly earlier requests
-    mask = eq * lower * jnp.asarray(valid, jnp.float32)[None, :]
-    return mask @ jnp.asarray(counts, jnp.float32)
+    counts_f = jnp.asarray(counts, jnp.float32) * jnp.asarray(valid, jnp.float32)
+    # Stable sort groups equal slots while preserving original request order
+    # within each group, so an in-segment exclusive prefix is exactly the
+    # "earlier same-slot demand" sum.
+    order = jnp.argsort(slots, stable=True)
+    c_sorted = counts_f[order]
+    s_sorted = slots[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s_sorted[1:] != s_sorted[:-1]]
+    )
+    # Segmented inclusive scan: sums reset at each segment boundary, so
+    # accumulation (and float32 rounding) stays per-key — a whole-batch
+    # cumsum would lose integer precision past 2^24 total demand and could
+    # over-admit duplicates.
+    def seg_combine(a, b):
+        a_flag, a_val = a
+        b_flag, b_val = b
+        return a_flag | b_flag, jnp.where(b_flag, b_val, a_val + b_val)
+
+    _, inc = jax.lax.associative_scan(seg_combine, (seg_start, c_sorted))
+    prefix_sorted = inc - c_sorted
+    return jnp.zeros_like(counts_f).at[order].set(prefix_sorted)

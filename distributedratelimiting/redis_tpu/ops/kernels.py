@@ -15,8 +15,8 @@ design (TPU-first, SURVEY.md §7):
   whole gather → decide → scatter program as one serialized step over the
   state arrays, exactly as Redis serialized Lua scripts. Duplicate keys
   within one batch are serialized conservatively via
-  :func:`~.bucket_math.duplicate_prefix` (never over-admit; the host
-  batcher coalesces duplicates so the conservative path is rare).
+  :func:`~.bucket_math.duplicate_prefix` (never over-admit) — a sort-based
+  O(B log B) pass cheap enough to run unconditionally.
 
 State layout is structure-of-arrays in HBM — ``tokens: f32[N]``,
 ``last_ts: i32[N]``, ``exists: bool[N]`` — 9 bytes/key, so 10M keys ≈ 90 MB,
@@ -42,9 +42,12 @@ __all__ = [
     "init_window_state",
     "acquire_core",
     "acquire_batch",
+    "acquire_batch_packed",
     "acquire_scan",
     "sync_batch",
+    "sync_batch_packed",
     "window_acquire_batch",
+    "window_acquire_batch_packed",
     "sweep_expired",
     "sweep_counters",
     "sweep_windows",
@@ -52,6 +55,7 @@ __all__ = [
     "rebase_counter_epoch",
     "rebase_window_epoch",
     "peek_batch",
+    "peek_batch_packed",
 ]
 
 
@@ -180,9 +184,9 @@ def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
       valid: bool[B] real-request mask.
       now: i32 scalar batch timestamp (host is time authority, invariant 1).
       capacity, fill_rate_per_tick: f32 scalars (operands, not constants).
-      handle_duplicates: statically enables the O(B²) same-slot
-        serialization. The host batcher coalesces duplicates, so the fast
-        variant (False) is used whenever a flush is duplicate-free.
+      handle_duplicates: statically enables the same-slot serialization
+        pass (sort-based, O(B log B)). On by default; False exists for
+        ablation and for callers that guarantee duplicate-free batches.
 
     Returns:
       ``(new_state, granted bool[B], remaining f32[B])`` where ``remaining``
@@ -194,10 +198,41 @@ def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
                         fill_rate_per_tick, handle_duplicates=handle_duplicates)
 
 
+def _unpack_requests(packed):
+    """Split the single packed i32[3, B] flush operand: row 0 = slots
+    (negative ⇒ padding), row 1 = counts, row 2 = broadcast batch timestamp.
+    One packed array = ONE host→device transfer per flush; per-transfer
+    latency on tunneled/remote TPU links is tens of ms, so operand count —
+    not operand bytes — is what the hot path must minimize."""
+    slots = packed[0]
+    counts = packed[1]
+    now = packed[2, 0]
+    valid = slots >= 0
+    return slots, counts, valid, now
+
+
+@partial(jax.jit, donate_argnums=0)
+def acquire_batch_packed(state: BucketState, packed, capacity,
+                         fill_rate_per_tick):
+    """:func:`acquire_batch` with single-transfer operands and a single
+    packed result: ``packed`` as in :func:`_unpack_requests`; ``capacity`` /
+    ``fill_rate_per_tick`` are device-resident per-table constants (no
+    per-flush scalar uploads). Returns ``(new_state, out f32[2, B])`` where
+    ``out[0] = granted`` (0/1) and ``out[1] = remaining`` — one device→host
+    transfer resolves the whole flush."""
+    slots, counts, valid, now = _unpack_requests(packed)
+    new_state, granted, remaining = acquire_core(
+        state, slots, counts, valid, now, capacity, fill_rate_per_tick,
+        handle_duplicates=True,
+    )
+    out = jnp.stack([granted.astype(jnp.float32), remaining])
+    return new_state, out
+
+
 @partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
 def acquire_scan(state: BucketState, slots_k, counts_k, valid_k, nows_k,
                  capacity, fill_rate_per_tick, *,
-                 handle_duplicates: bool = False):
+                 handle_duplicates: bool = True):
     """Pipelined dispatch: K micro-batches decided in ONE kernel launch via
     ``lax.scan`` — amortizes launch overhead when the host has several
     flushes queued. Semantics are identical to K sequential
@@ -238,6 +273,12 @@ def sync_batch(state: CounterState, slots, local_counts, valid, now,
     Returns ``(new_state, global_scores f32[B], period_ewmas f32[B])`` — the
     script's ``{new_v, new_p}`` reply (``:270``).
     """
+    return _sync_core(state, slots, local_counts, valid, now,
+                      decay_rate_per_tick)
+
+
+def _sync_core(state: CounterState, slots, local_counts, valid, now,
+               decay_rate_per_tick):
     valid = _valid_slots(slots, valid, state.value.shape[0])
     gs = _gather_slots(slots, valid)
     v_old = state.value[gs]
@@ -269,6 +310,13 @@ def window_acquire_batch(state: WindowState, slots, counts, valid, now, limit,
     Same contract as :func:`acquire_batch`; grant iff the interpolated
     trailing-window estimate plus this request stays within ``limit``.
     """
+    return _window_acquire_core(state, slots, counts, valid, now, limit,
+                                window_ticks,
+                                handle_duplicates=handle_duplicates)
+
+
+def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
+                         window_ticks, *, handle_duplicates: bool = True):
     valid = _valid_slots(slots, valid, state.prev_count.shape[0])
     gs = _gather_slots(slots, valid)
     prev_old = state.prev_count[gs]
@@ -306,6 +354,36 @@ def window_acquire_batch(state: WindowState, slots, counts, valid, now, limit,
 
 
 @partial(jax.jit, donate_argnums=0)
+def sync_batch_packed(state: CounterState, packed, decay_rate_per_tick):
+    """:func:`sync_batch` with single-transfer operands/results. Row 1 of
+    ``packed`` carries the float32 local counts bitcast to int32 (exact —
+    no quantization); the reply is ``f32[2, B]`` = (global scores, period
+    EWMAs), the Lua ``{new_v, new_p}`` pair in one readback."""
+    slots = packed[0]
+    local_counts = jax.lax.bitcast_convert_type(packed[1], jnp.float32)
+    now = packed[2, 0]
+    valid = slots >= 0
+    new_state, scores, periods = _sync_core(
+        state, slots, local_counts, valid, now, decay_rate_per_tick
+    )
+    return new_state, jnp.stack([scores, periods])
+
+
+@partial(jax.jit, donate_argnums=0)
+def window_acquire_batch_packed(state: WindowState, packed, limit,
+                                window_ticks):
+    """:func:`window_acquire_batch` with the single-transfer operand/result
+    convention of :func:`acquire_batch_packed`."""
+    slots, counts, valid, now = _unpack_requests(packed)
+    new_state, granted, remaining = _window_acquire_core(
+        state, slots, counts, valid, now, limit, window_ticks,
+        handle_duplicates=True,
+    )
+    out = jnp.stack([granted.astype(jnp.float32), remaining])
+    return new_state, out
+
+
+@partial(jax.jit, donate_argnums=0)
 def sweep_expired(state: BucketState, now, capacity, fill_rate_per_tick):
     """TTL eviction pass — invariant 5 (state self-expiry, bounded memory).
 
@@ -329,6 +407,21 @@ def peek_batch(state: BucketState, slots, valid, now, capacity,
                fill_rate_per_tick):
     """Read-only availability estimate (``GetAvailablePermits`` support,
     invariant 7) — refill math applied without writing state back."""
+    valid = _valid_slots(slots, valid, state.tokens.shape[0])
+    gs = _gather_slots(slots, valid)
+    refilled = bm.refill_or_init(
+        state.tokens[gs], state.last_ts[gs], state.exists[gs], now, capacity,
+        fill_rate_per_tick,
+    )
+    return jnp.where(valid, jnp.floor(refilled), 0.0)
+
+
+@jax.jit
+def peek_batch_packed(state: BucketState, packed, capacity,
+                      fill_rate_per_tick):
+    """:func:`peek_batch` with the packed operand convention (row 1 of
+    ``packed`` is ignored — peeks carry no counts)."""
+    slots, _, valid, now = _unpack_requests(packed)
     valid = _valid_slots(slots, valid, state.tokens.shape[0])
     gs = _gather_slots(slots, valid)
     refilled = bm.refill_or_init(

@@ -149,7 +149,42 @@ def _pad_size(n: int, floor: int = 64) -> int:
     return size
 
 
-class _DeviceTable:
+def _build_packed(reqs: Sequence[_AcquireReq], slots: Sequence[int], b: int,
+                  now: int) -> np.ndarray:
+    """ONE padded i32[3, b] operand per launch — row 0 slots (-1 = padding),
+    row 1 counts, row 2 the batch timestamp. Per-transfer latency dominates
+    on tunneled/remote device links, so the flush hot path ships exactly one
+    host→device array and reads back exactly one result array."""
+    packed = np.full((3, b), -1, np.int32)
+    packed[1] = 0
+    packed[0, : len(reqs)] = slots
+    packed[1, : len(reqs)] = [r.count for r in reqs]
+    packed[2] = now
+    return packed
+
+
+class _PackedLaunchMixin:
+    """Shared readback convention for tables whose ``_launch`` returns the
+    packed ``f32[2, B]`` result (row 0 grants, row 1 remaining)."""
+
+    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
+        out = self._launch(reqs)
+        loop = asyncio.get_running_loop()
+        # Block for device results on an executor thread so the event loop
+        # keeps accumulating the next flush; readbacks of distinct flushes
+        # overlap (see MicroBatcher). One packed array = one transfer.
+        out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+        return [
+            AcquireResult(bool(out_np[0, i] > 0.5), float(out_np[1, i]))
+            for i in range(len(reqs))
+        ]
+
+    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
+        out_np = np.asarray(self._launch([_AcquireReq(key, count)]))
+        return AcquireResult(bool(out_np[0, 0] > 0.5), float(out_np[1, 0]))
+
+
+class _DeviceTable(_PackedLaunchMixin):
     """One homogeneous-config bucket table: device arrays + host directory."""
 
     def __init__(self, store: "DeviceBucketStore", capacity: float,
@@ -162,10 +197,14 @@ class _DeviceTable:
         self.n_slots = n_slots
         self.directory: dict[str, int] = {}
         self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        # Device-resident config constants: uploaded once, never per flush.
+        self.cap_dev = jnp.float32(self.capacity)
+        self.rate_dev = jnp.float32(self.rate_per_tick)
         self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
             self._flush,
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
+            max_inflight=store.max_inflight,
         )
 
     # -- slot management ---------------------------------------------------
@@ -236,41 +275,18 @@ class _DeviceTable:
                 s = self.slot_for(r.key, pinned)
                 slots.append(s)
                 pinned.add(s)
-            b = _pad_size(len(reqs))
-            slots_np = np.full((b,), -1, np.int32)
-            counts_np = np.zeros((b,), np.int32)
-            valid_np = np.zeros((b,), bool)
-            slots_np[: len(reqs)] = slots
-            counts_np[: len(reqs)] = [r.count for r in reqs]
-            valid_np[: len(reqs)] = True
-            has_dups = len(set(slots)) != len(slots)
+            # Fixed pad width ⇒ exactly ONE compiled kernel per table (the
+            # extra rows are masked padding and cost ~nothing next to launch
+            # overhead; a varying pad width would recompile per size — ~1 s
+            # per size on TPU, fatal for serving-path p99).
+            b = self.store.max_batch
             now = self.store.now_ticks_checked()
-            self.state, granted, remaining = K.acquire_batch(
-                self.state,
-                jnp.asarray(slots_np), jnp.asarray(counts_np), jnp.asarray(valid_np),
-                jnp.int32(now), jnp.float32(self.capacity),
-                jnp.float32(self.rate_per_tick),
-                handle_duplicates=has_dups,
+            packed = _build_packed(reqs, slots, b, now)
+            self.state, out = K.acquire_batch_packed(
+                self.state, jnp.asarray(packed), self.cap_dev, self.rate_dev,
             )
             self.store.metrics.record_launch(b, len(reqs))
-            return granted, remaining
-
-    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
-        granted, remaining = self._launch(reqs)
-        loop = asyncio.get_running_loop()
-        # Block for device results on an executor thread so the event loop
-        # keeps accumulating the next flush (double buffering).
-        g_np, r_np = await loop.run_in_executor(
-            None, lambda: (np.asarray(granted), np.asarray(remaining))
-        )
-        return [
-            AcquireResult(bool(g_np[i]), float(r_np[i])) for i in range(len(reqs))
-        ]
-
-    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
-        granted, remaining = self._launch([_AcquireReq(key, count)])
-        return AcquireResult(bool(np.asarray(granted)[0]),
-                             float(np.asarray(remaining)[0]))
+            return out
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
@@ -278,14 +294,10 @@ class _DeviceTable:
             if slot is None:
                 return float(np.floor(self.capacity))
             b = _pad_size(1)
-            slots_np = np.full((b,), -1, np.int32)
-            valid_np = np.zeros((b,), bool)
-            slots_np[0] = slot
-            valid_np[0] = True
-            est = K.peek_batch(
-                self.state, jnp.asarray(slots_np), jnp.asarray(valid_np),
-                jnp.int32(self.store.now_ticks_checked()),
-                jnp.float32(self.capacity), jnp.float32(self.rate_per_tick),
+            packed = _build_packed([_AcquireReq(key, 0)], [slot], b,
+                                   self.store.now_ticks_checked())
+            est = K.peek_batch_packed(
+                self.state, jnp.asarray(packed), self.cap_dev, self.rate_dev,
             )
         return float(np.asarray(est)[0])
 
@@ -293,7 +305,7 @@ class _DeviceTable:
         self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
 
 
-class _DeviceWindowTable:
+class _DeviceWindowTable(_PackedLaunchMixin):
     """One homogeneous-config sliding-window table."""
 
     def __init__(self, store: "DeviceBucketStore", limit: float,
@@ -305,10 +317,13 @@ class _DeviceWindowTable:
         self.n_slots = n_slots
         self.directory: dict[str, int] = {}
         self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        self.limit_dev = jnp.float32(self.limit)
+        self.window_dev = jnp.int32(self.window_ticks)
         self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
             self._flush,
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
+            max_inflight=store.max_inflight,
         )
 
     def slot_for(self, key: str, pinned: set[int] | None = None) -> int:
@@ -362,37 +377,15 @@ class _DeviceWindowTable:
                 s = self.slot_for(r.key, pinned)
                 slots.append(s)
                 pinned.add(s)
-            b = _pad_size(len(reqs))
-            slots_np = np.full((b,), -1, np.int32)
-            counts_np = np.zeros((b,), np.int32)
-            valid_np = np.zeros((b,), bool)
-            slots_np[: len(reqs)] = slots
-            counts_np[: len(reqs)] = [r.count for r in reqs]
-            valid_np[: len(reqs)] = True
-            has_dups = len(set(slots)) != len(slots)
-            self.state, granted, remaining = K.window_acquire_batch(
-                self.state,
-                jnp.asarray(slots_np), jnp.asarray(counts_np), jnp.asarray(valid_np),
-                jnp.int32(self.store.now_ticks_checked()), jnp.float32(self.limit),
-                jnp.int32(self.window_ticks), handle_duplicates=has_dups,
+            b = self.store.max_batch  # fixed pad ⇒ one compiled kernel
+            packed = _build_packed(reqs, slots, b,
+                                   self.store.now_ticks_checked())
+            self.state, out = K.window_acquire_batch_packed(
+                self.state, jnp.asarray(packed), self.limit_dev,
+                self.window_dev,
             )
             self.store.metrics.record_launch(b, len(reqs))
-            return granted, remaining
-
-    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
-        granted, remaining = self._launch(reqs)
-        loop = asyncio.get_running_loop()
-        g_np, r_np = await loop.run_in_executor(
-            None, lambda: (np.asarray(granted), np.asarray(remaining))
-        )
-        return [
-            AcquireResult(bool(g_np[i]), float(r_np[i])) for i in range(len(reqs))
-        ]
-
-    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
-        granted, remaining = self._launch([_AcquireReq(key, count)])
-        return AcquireResult(bool(np.asarray(granted)[0]),
-                             float(np.asarray(remaining)[0]))
+            return out
 
 
 class DeviceBucketStore(BucketStore):
@@ -406,18 +399,21 @@ class DeviceBucketStore(BucketStore):
         clock: Clock | None = None,
         max_batch: int = 4096,
         max_delay_s: float = 200e-6,
+        max_inflight: int = 8,
     ) -> None:
         self.clock = clock or MonotonicClock()
         self.n_slots_default = n_slots
         self.counter_slots = counter_slots
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
+        self.max_inflight = max_inflight
         self.metrics = StoreMetrics()
         self._tables: dict[tuple[float, float], _DeviceTable] = {}
         self._wtables: dict[tuple[float, int], _DeviceWindowTable] = {}
         self._counters = K.init_counter_state(counter_slots)
         self._counter_dir: dict[str, int] = {}
         self._counter_free = list(range(counter_slots - 1, -1, -1))
+        self._decay_rate_dev: dict[float, jax.Array] = {}
         self._lock = threading.RLock()  # directory/slot allocation guard
         self._connected = False
         self._connect_gate = asyncio.Lock()
@@ -531,18 +527,20 @@ class DeviceBucketStore(BucketStore):
         slot = self._counter_slot(key)
         with self._lock:
             b = _pad_size(1, floor=8)
-            slots_np = np.full((b,), -1, np.int32)
-            counts_np = np.zeros((b,), np.float32)
-            valid_np = np.zeros((b,), bool)
-            slots_np[0] = slot
-            counts_np[0] = local_count
-            valid_np[0] = True
-            self._counters, scores, periods = K.sync_batch(
-                self._counters, jnp.asarray(slots_np), jnp.asarray(counts_np),
-                jnp.asarray(valid_np), jnp.int32(self.now_ticks_checked()),
-                jnp.float32(_rate_per_tick(decay_rate_per_sec)),
+            packed = np.full((3, b), -1, np.int32)
+            packed[1] = 0
+            packed[0, 0] = slot
+            # Float local counts travel bitcast in the int32 row (exact).
+            packed[1, 0] = np.float32(local_count).view(np.int32)
+            packed[2] = self.now_ticks_checked()
+            rate = self._decay_rate_dev.get(decay_rate_per_sec)
+            if rate is None:
+                rate = jnp.float32(_rate_per_tick(decay_rate_per_sec))
+                self._decay_rate_dev[decay_rate_per_sec] = rate
+            self._counters, out = K.sync_batch_packed(
+                self._counters, jnp.asarray(packed), rate,
             )
-            return scores, periods
+            return out
 
     async def sync_counter(self, key: str, local_count: float,
                            decay_rate_per_sec: float) -> SyncResult:
@@ -550,22 +548,18 @@ class DeviceBucketStore(BucketStore):
         ``ScriptEvaluateAsync(_syncScript)``,
         ``RedisApproximateTokenBucketRateLimiter.cs:439``)."""
         await self.connect()
-        scores, periods = self._sync_dispatch(key, local_count,
-                                              decay_rate_per_sec)
+        out = self._sync_dispatch(key, local_count, decay_rate_per_sec)
         loop = asyncio.get_running_loop()
-        s_np, p_np = await loop.run_in_executor(
-            None, lambda: (np.asarray(scores), np.asarray(periods))
-        )
-        return SyncResult(float(s_np[0]), float(p_np[0]))
+        out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+        return SyncResult(float(out_np[0, 0]), float(out_np[1, 0]))
 
     def sync_counter_blocking(self, key: str, local_count: float,
                               decay_rate_per_sec: float) -> SyncResult:
         """Synchronous sync path for loop-less callers (the approximate
         limiter's inline refresh when only the sync API is used)."""
-        scores, periods = self._sync_dispatch(key, local_count,
-                                              decay_rate_per_sec)
-        return SyncResult(float(np.asarray(scores)[0]),
-                          float(np.asarray(periods)[0]))
+        out_np = np.asarray(self._sync_dispatch(key, local_count,
+                                                decay_rate_per_sec))
+        return SyncResult(float(out_np[0, 0]), float(out_np[1, 0]))
 
     # -- sliding window ----------------------------------------------------
     async def window_acquire(self, key: str, count: int, limit: float,

@@ -232,3 +232,25 @@ class TestDuplicatePrefix:
         valid = jnp.array([True, False, True])
         pref = np.asarray(bm.duplicate_prefix(slots, counts, valid))
         assert list(pref) == [0.0, 2.0, 2.0]
+
+    def test_precision_at_large_batch_demand(self):
+        # Accumulation must stay per-key: with total batch demand far past
+        # 2^24 (float32 integer precision), a whole-batch running sum would
+        # corrupt same-slot prefixes and could over-admit duplicates.
+        rng = np.random.default_rng(7)
+        b = 4096
+        slots = rng.integers(0, b, b).astype(np.int32)
+        slots[100] = slots[50]  # guarantee at least one duplicate pair
+        counts = rng.integers(1, 20_000, b).astype(np.int32)  # total ~41M
+        valid = np.ones(b, bool)
+        pref = np.asarray(
+            bm.duplicate_prefix(jnp.asarray(slots), jnp.asarray(counts),
+                                jnp.asarray(valid))
+        )
+        # Exact per-request expectation in int64.
+        expected = np.zeros(b, np.int64)
+        seen: dict[int, int] = {}
+        for i in range(b):
+            expected[i] = seen.get(int(slots[i]), 0)
+            seen[int(slots[i])] = expected[i] + int(counts[i])
+        np.testing.assert_array_equal(pref.astype(np.int64), expected)
