@@ -134,11 +134,14 @@ def _scatter_slots(slots, valid, size):
 
 
 def acquire_core(state: BucketState, slots, counts, valid, now, capacity,
-                 fill_rate_per_tick, *, handle_duplicates: bool = True):
+                 fill_rate_per_tick, *, handle_duplicates: bool = True,
+                 prefix=None):
     """Traceable core of :func:`acquire_batch` — also the per-shard block
     body under ``shard_map`` (where ``state`` is one shard's slice and
     ``slots`` are shard-local ids). See :func:`acquire_batch` for the full
-    contract."""
+    contract. ``prefix`` (f32[B]) overrides the in-kernel same-slot demand
+    computation when the caller already knows it (the host batcher computes
+    it exactly during batch assembly)."""
     valid = _valid_slots(slots, valid, state.tokens.shape[0])
     gs = _gather_slots(slots, valid)
     t_old = state.tokens[gs]
@@ -149,10 +152,12 @@ def acquire_core(state: BucketState, slots, counts, valid, now, capacity,
     refilled = bm.refill_or_init(t_old, ts_old, ex_old, now, capacity,
                                  fill_rate_per_tick)
 
-    if handle_duplicates:
+    if prefix is None and handle_duplicates:
         prefix = bm.duplicate_prefix(slots, counts, valid)
-    else:
+    elif prefix is None:
         prefix = jnp.zeros_like(counts_f)
+    else:
+        prefix = jnp.asarray(prefix, jnp.float32)
 
     granted = valid & (refilled >= prefix + counts_f)
     consumed = jnp.where(granted, counts_f, 0.0)
@@ -199,16 +204,18 @@ def acquire_batch(state: BucketState, slots, counts, valid, now, capacity,
 
 
 def _unpack_requests(packed):
-    """Split the single packed i32[3, B] flush operand: row 0 = slots
-    (negative ⇒ padding), row 1 = counts, row 2 = broadcast batch timestamp.
-    One packed array = ONE host→device transfer per flush; per-transfer
-    latency on tunneled/remote TPU links is tens of ms, so operand count —
-    not operand bytes — is what the hot path must minimize."""
+    """Split the single packed i32[4, B] flush operand: row 0 = slots
+    (negative ⇒ padding), row 1 = counts, row 2 = broadcast batch timestamp,
+    row 3 = host-computed same-slot demand prefix. One packed array = ONE
+    host→device transfer per flush; per-transfer latency on tunneled/remote
+    TPU links is tens of ms, so operand count — not operand bytes — is what
+    the hot path must minimize."""
     slots = packed[0]
     counts = packed[1]
     now = packed[2, 0]
+    prefix = packed[3]
     valid = slots >= 0
-    return slots, counts, valid, now
+    return slots, counts, valid, now, prefix
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -220,10 +227,10 @@ def acquire_batch_packed(state: BucketState, packed, capacity,
     per-flush scalar uploads). Returns ``(new_state, out f32[2, B])`` where
     ``out[0] = granted`` (0/1) and ``out[1] = remaining`` — one device→host
     transfer resolves the whole flush."""
-    slots, counts, valid, now = _unpack_requests(packed)
+    slots, counts, valid, now, prefix = _unpack_requests(packed)
     new_state, granted, remaining = acquire_core(
         state, slots, counts, valid, now, capacity, fill_rate_per_tick,
-        handle_duplicates=True,
+        prefix=prefix,
     )
     out = jnp.stack([granted.astype(jnp.float32), remaining])
     return new_state, out
@@ -316,7 +323,8 @@ def window_acquire_batch(state: WindowState, slots, counts, valid, now, limit,
 
 
 def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
-                         window_ticks, *, handle_duplicates: bool = True):
+                         window_ticks, *, handle_duplicates: bool = True,
+                         prefix=None):
     valid = _valid_slots(slots, valid, state.prev_count.shape[0])
     gs = _gather_slots(slots, valid)
     prev_old = state.prev_count[gs]
@@ -330,10 +338,12 @@ def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
     )
     est = bm.sliding_window_estimate(prev_new, curr_new, idx_new, now, window_ticks)
 
-    if handle_duplicates:
+    if prefix is None and handle_duplicates:
         prefix = bm.duplicate_prefix(slots, counts, valid)
-    else:
+    elif prefix is None:
         prefix = jnp.zeros_like(counts_f)
+    else:
+        prefix = jnp.asarray(prefix, jnp.float32)
 
     granted = valid & (est + prefix + counts_f <= jnp.asarray(limit, jnp.float32))
     consumed = jnp.where(granted, counts_f, 0.0)
@@ -357,8 +367,8 @@ def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
 def sync_batch_packed(state: CounterState, packed, decay_rate_per_tick):
     """:func:`sync_batch` with single-transfer operands/results. Row 1 of
     ``packed`` carries the float32 local counts bitcast to int32 (exact —
-    no quantization); the reply is ``f32[2, B]`` = (global scores, period
-    EWMAs), the Lua ``{new_v, new_p}`` pair in one readback."""
+    no quantization); row 3 is unused; the reply is ``f32[2, B]`` = (global
+    scores, period EWMAs), the Lua ``{new_v, new_p}`` pair in one readback."""
     slots = packed[0]
     local_counts = jax.lax.bitcast_convert_type(packed[1], jnp.float32)
     now = packed[2, 0]
@@ -374,10 +384,10 @@ def window_acquire_batch_packed(state: WindowState, packed, limit,
                                 window_ticks):
     """:func:`window_acquire_batch` with the single-transfer operand/result
     convention of :func:`acquire_batch_packed`."""
-    slots, counts, valid, now = _unpack_requests(packed)
+    slots, counts, valid, now, prefix = _unpack_requests(packed)
     new_state, granted, remaining = _window_acquire_core(
         state, slots, counts, valid, now, limit, window_ticks,
-        handle_duplicates=True,
+        prefix=prefix,
     )
     out = jnp.stack([granted.astype(jnp.float32), remaining])
     return new_state, out
@@ -419,9 +429,9 @@ def peek_batch(state: BucketState, slots, valid, now, capacity,
 @jax.jit
 def peek_batch_packed(state: BucketState, packed, capacity,
                       fill_rate_per_tick):
-    """:func:`peek_batch` with the packed operand convention (row 1 of
-    ``packed`` is ignored — peeks carry no counts)."""
-    slots, _, valid, now = _unpack_requests(packed)
+    """:func:`peek_batch` with the packed operand convention (rows 1/3 of
+    ``packed`` are ignored — peeks carry no counts)."""
+    slots, _, valid, now, _ = _unpack_requests(packed)
     valid = _valid_slots(slots, valid, state.tokens.shape[0])
     gs = _gather_slots(slots, valid)
     refilled = bm.refill_or_init(
