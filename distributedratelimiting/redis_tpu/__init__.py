@@ -32,8 +32,12 @@ from distributedratelimiting.redis_tpu.models.base import (
 )
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
+    QueueingTokenBucketOptions,
     SlidingWindowOptions,
     TokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.queueing_token_bucket import (
+    QueueingTokenBucketRateLimiter,
 )
 from distributedratelimiting.redis_tpu.models.token_bucket import TokenBucketRateLimiter
 from distributedratelimiting.redis_tpu.models.approximate import (
@@ -59,6 +63,7 @@ from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOr
 from distributedratelimiting.redis_tpu.utils.registry import (
     ServiceRegistry,
     add_tpu_approximate_token_bucket_rate_limiter,
+    add_tpu_queueing_token_bucket_rate_limiter,
     add_tpu_sliding_window_rate_limiter,
     add_tpu_token_bucket_rate_limiter,
 )
@@ -69,9 +74,11 @@ __all__ = [
     "RateLimiter",
     "TokenBucketOptions",
     "ApproximateTokenBucketOptions",
+    "QueueingTokenBucketOptions",
     "SlidingWindowOptions",
     "TokenBucketRateLimiter",
     "ApproximateTokenBucketRateLimiter",
+    "QueueingTokenBucketRateLimiter",
     "SlidingWindowRateLimiter",
     "PartitionedRateLimiter",
     "AcquireResult",
@@ -86,6 +93,7 @@ __all__ = [
     "ServiceRegistry",
     "add_tpu_token_bucket_rate_limiter",
     "add_tpu_approximate_token_bucket_rate_limiter",
+    "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
     "__version__",
 ]

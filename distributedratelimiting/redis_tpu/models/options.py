@@ -20,6 +20,7 @@ from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOr
 __all__ = [
     "TokenBucketOptions",
     "ApproximateTokenBucketOptions",
+    "QueueingTokenBucketOptions",
     "SlidingWindowOptions",
 ]
 
@@ -61,6 +62,22 @@ class ApproximateTokenBucketOptions(TokenBucketOptions):
     """Approximate two-level limiter options
     (≙ ``RedisApproximateTokenBucketRateLimiterOptions`` — adds queueing,
     ``…Options.cs:44-58``)."""
+
+    queue_limit: int = 0
+    queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+
+
+@dataclass(frozen=True)
+class QueueingTokenBucketOptions(TokenBucketOptions):
+    """Queueing + exact hybrid options (≙ the orphaned
+    ``RedisQueueingTokenBucketRateLimiterOptions`` — its limiter is dead
+    code in the reference, ``TokenBucketWithQueue/…Options.cs``; here the
+    hybrid is live, see :class:`~.queueing_token_bucket.QueueingTokenBucketRateLimiter`)."""
 
     queue_limit: int = 0
     queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST

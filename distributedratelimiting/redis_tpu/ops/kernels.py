@@ -365,10 +365,12 @@ def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
 
 @partial(jax.jit, donate_argnums=0)
 def sync_batch_packed(state: CounterState, packed, decay_rate_per_tick):
-    """:func:`sync_batch` with single-transfer operands/results. Row 1 of
-    ``packed`` carries the float32 local counts bitcast to int32 (exact —
-    no quantization); row 3 is unused; the reply is ``f32[2, B]`` = (global
-    scores, period EWMAs), the Lua ``{new_v, new_p}`` pair in one readback."""
+    """:func:`sync_batch` with single-transfer operands/results. The
+    counter-sync operand is i32[3, B] (unlike the acquire kernels' i32[4, B]
+    — there is no duplicate-prefix row here): row 0 slots, row 1 the
+    float32 local counts bitcast to int32 (exact — no quantization), row 2
+    the timestamp. The reply is ``f32[2, B]`` = (global scores, period
+    EWMAs), the Lua ``{new_v, new_p}`` pair in one readback."""
     slots = packed[0]
     local_counts = jax.lax.bitcast_convert_type(packed[1], jnp.float32)
     now = packed[2, 0]

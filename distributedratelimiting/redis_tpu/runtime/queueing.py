@@ -133,6 +133,40 @@ class WaiterQueue:
             granted += 1
         return granted
 
+    async def drain_async(self, try_grant, make_lease: Callable[[], object]
+                          ) -> int:
+        """Async drain for limiters whose grants are store round-trips (the
+        queueing+exact hybrid, the intent of the reference's dead
+        ``TokenBucketWithQueue/RedisTokenBucketRateLimiter.cs``):
+        ``await try_grant(count)`` consumes from the shared store or
+        declines. Cancelled waiters are discarded before any store traffic.
+        A waiter cancelled in the narrow window between the store grant and
+        completion has its cost consumed (token-bucket cost is not
+        returnable); the next drain pass proceeds normally."""
+        granted = 0
+        while self._deque.count:
+            newest = self.order is QueueProcessingOrder.NEWEST_FIRST
+            reg = self._deque.peek_tail() if newest else self._deque.peek_head()
+            if reg.future.done():  # cancelled while parked
+                (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
+                self._queue_count -= reg.count
+                continue
+            if not await try_grant(reg.count):
+                break
+            # The registration may have been cancelled during the await.
+            # Either its done-callback already removed it (remove() returns
+            # False), or the cancellation is marked but the call_soon'd
+            # callback hasn't run yet (remove() returns True on a cancelled
+            # future) — settle only live waiters; a set_result on a
+            # cancelled future would raise InvalidStateError and abort the
+            # drain mid-queue.
+            if self._deque.remove(reg):
+                self._queue_count -= reg.count
+                if not reg.future.cancelled():
+                    reg.future.set_result(make_lease())
+                    granted += 1
+        return granted
+
     def fail_all(self, make_lease: Callable[[], object]) -> int:
         """Disposal path: every parked waiter completes with a failed lease
         (``:291-298``), drained in queue-processing order."""

@@ -20,8 +20,12 @@ from distributedratelimiting.redis_tpu.models.approximate import (
 )
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
+    QueueingTokenBucketOptions,
     SlidingWindowOptions,
     TokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.queueing_token_bucket import (
+    QueueingTokenBucketRateLimiter,
 )
 from distributedratelimiting.redis_tpu.models.sliding_window import (
     SlidingWindowRateLimiter,
@@ -36,6 +40,7 @@ __all__ = [
     "RATE_LIMITER",
     "add_tpu_token_bucket_rate_limiter",
     "add_tpu_approximate_token_bucket_rate_limiter",
+    "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
 ]
 
@@ -102,6 +107,23 @@ def add_tpu_approximate_token_bucket_rate_limiter(
     registry.add_singleton(
         service_name,
         lambda reg: ApproximateTokenBucketRateLimiter(
+            configure(), _store_of(reg, store)
+        ),
+    )
+
+
+def add_tpu_queueing_token_bucket_rate_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], QueueingTokenBucketOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    """Registers the finished queueing+exact hybrid (the reference's dead
+    component #14 had no DI method; its options class was orphaned)."""
+    registry.add_singleton(
+        service_name,
+        lambda reg: QueueingTokenBucketRateLimiter(
             configure(), _store_of(reg, store)
         ),
     )

@@ -1,0 +1,232 @@
+"""Queueing + exact hybrid limiter tests — the finished form of the
+reference's dead ``TokenBucketWithQueue`` component (SURVEY.md §2 #14).
+
+Every grant is an exact store round-trip; declined acquires park on the
+waiter queue and are drained by ``refresh()`` (stepped manually here — the
+ManualClock keeps the store's refill arithmetic deterministic)."""
+
+import asyncio
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.options import (
+    QueueingTokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.queueing_token_bucket import (
+    QueueingTokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def clock():
+    return ManualClock()
+
+
+@pytest.fixture
+def store(clock):
+    return InProcessBucketStore(clock=clock)
+
+
+def make(store, **kw):
+    defaults = dict(token_limit=5, tokens_per_period=5,
+                    replenishment_period_s=1.0, queue_limit=10,
+                    instance_name="q-bucket")
+    defaults.update(kw)
+    return QueueingTokenBucketRateLimiter(
+        QueueingTokenBucketOptions(**defaults), store)
+
+
+class TestExactGrants:
+    def test_sync_acquire_is_exact(self, store):
+        lim = make(store)
+        assert lim.acquire(5).is_acquired
+        assert not lim.acquire(1).is_acquired
+
+    def test_async_immediate_grant(self, store):
+        lim = make(store)
+
+        async def main():
+            assert (await lim.acquire_async(3)).is_acquired
+            assert lim.available_permits() == 2
+            await lim.aclose()
+
+        run(main())
+
+    def test_over_limit_raises(self, store):
+        lim = make(store)
+        with pytest.raises(ValueError):
+            lim.acquire(6)
+
+    def test_two_limiters_share_one_bucket(self, store):
+        # Exact semantics: same instance_name ⇒ same store bucket.
+        a, b = make(store), make(store)
+        assert a.acquire(5).is_acquired
+        assert not b.acquire(1).is_acquired
+
+
+class TestQueueing:
+    def test_declined_acquire_parks_then_drains(self, store, clock):
+        lim = make(store)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            waiter = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            assert not waiter.done()
+            clock.advance_seconds(1.0)  # store refills 5 tokens
+            await lim.refresh()
+            lease = await waiter
+            assert lease.is_acquired
+            await lim.aclose()
+
+        run(main())
+
+    def test_oldest_first_rejects_overflow(self, store):
+        lim = make(store, queue_limit=2)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w1 = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            # Queue holds 2 cumulative permits — newcomer of 1 overflows.
+            lease = await lim.acquire_async(1)
+            assert not lease.is_acquired
+            assert lease.retry_after is not None
+            w1.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await w1
+            await lim.aclose()
+
+        run(main())
+
+    def test_newest_first_evicts_oldest(self, store, clock):
+        lim = make(store, queue_limit=2,
+                   queue_processing_order=QueueProcessingOrder.NEWEST_FIRST)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w1 = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            w2 = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            # w1 was evicted with a failed lease to make room for w2.
+            assert (await w1).is_acquired is False
+            clock.advance_seconds(1.0)
+            await lim.refresh()
+            assert (await w2).is_acquired
+            await lim.aclose()
+
+        run(main())
+
+    def test_queue_respects_fifo_no_overtake(self, store, clock):
+        # While a waiter is parked under OLDEST_FIRST, a later async acquire
+        # must not jump the queue even if the store could serve it.
+        lim = make(store)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w_big = asyncio.ensure_future(lim.acquire_async(4))
+            await asyncio.sleep(0.01)
+            w_small = asyncio.ensure_future(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            clock.advance_seconds(1.0)  # 5 tokens available: serves both in order
+            await lim.refresh()
+            assert (await w_big).is_acquired
+            assert (await w_small).is_acquired
+            await lim.aclose()
+
+        run(main())
+
+    def test_cancellation_unwinds_accounting(self, store, clock):
+        lim = make(store, queue_limit=2)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w1 = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            w1.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await w1
+            # Queue space freed: another waiter fits and is served.
+            w2 = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            clock.advance_seconds(1.0)
+            await lim.refresh()
+            assert (await w2).is_acquired
+            # The cancelled waiter consumed nothing from the store.
+            assert lim.metrics.cancelled == 1
+            await lim.aclose()
+
+        run(main())
+
+    def test_dispose_fails_waiters(self, store):
+        lim = make(store)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            await lim.aclose()
+            assert (await w).is_acquired is False
+
+        run(main())
+
+
+class TestDegradedMode:
+    def test_store_failure_parks_instead_of_crashing(self, clock):
+        class FailingStore(InProcessBucketStore):
+            fail = True
+
+            async def acquire(self, *a, **kw):
+                if self.fail:
+                    raise ConnectionError("store down")
+                return await super().acquire(*a, **kw)
+
+        store = FailingStore(clock=clock)
+        lim = make(store)
+
+        async def main():
+            w = asyncio.ensure_future(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            assert not w.done()          # parked, not crashed
+            assert lim.metrics.sync_failures >= 1
+            store.fail = False           # store recovers
+            await lim.refresh()
+            assert (await w).is_acquired
+            await lim.aclose()
+
+        run(main())
+
+    def test_refresh_failure_keeps_waiters(self, clock):
+        class FlakyStore(InProcessBucketStore):
+            fail = False
+
+            async def acquire(self, *a, **kw):
+                if self.fail:
+                    raise ConnectionError("store down")
+                return await super().acquire(*a, **kw)
+
+        store = FlakyStore(clock=clock)
+        lim = make(store)
+
+        async def main():
+            assert (await lim.acquire_async(5)).is_acquired
+            w = asyncio.ensure_future(lim.acquire_async(2))
+            await asyncio.sleep(0.01)
+            store.fail = True
+            clock.advance_seconds(1.0)
+            await lim.refresh()          # drain fails, waiter survives
+            assert not w.done()
+            store.fail = False
+            await lim.refresh()
+            assert (await w).is_acquired
+            await lim.aclose()
+
+        run(main())
