@@ -60,6 +60,8 @@ from distributedratelimiting.redis_tpu.runtime.clock import (
     TICKS_PER_SECOND,
 )
 from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
 from distributedratelimiting.redis_tpu.utils.registry import (
     ServiceRegistry,
     add_tpu_approximate_token_bucket_rate_limiter,
@@ -84,8 +86,10 @@ __all__ = [
     "AcquireResult",
     "SyncResult",
     "BucketStore",
+    "BucketStoreServer",
     "DeviceBucketStore",
     "InProcessBucketStore",
+    "RemoteBucketStore",
     "ManualClock",
     "MonotonicClock",
     "TICKS_PER_SECOND",

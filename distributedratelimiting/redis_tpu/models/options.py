@@ -58,10 +58,13 @@ class TokenBucketOptions:
 
 
 @dataclass(frozen=True)
-class ApproximateTokenBucketOptions(TokenBucketOptions):
-    """Approximate two-level limiter options
-    (≙ ``RedisApproximateTokenBucketRateLimiterOptions`` — adds queueing,
-    ``…Options.cs:44-58``)."""
+class QueueingTokenBucketOptions(TokenBucketOptions):
+    """Queueing + exact hybrid options (≙ the orphaned
+    ``RedisQueueingTokenBucketRateLimiterOptions`` — its limiter is dead
+    code in the reference, ``TokenBucketWithQueue/…Options.cs``; here the
+    hybrid is live, see :class:`~.queueing_token_bucket.QueueingTokenBucketRateLimiter`).
+    Also the base for every queueing-capable options family, so queueing
+    validation lives in exactly one place."""
 
     queue_limit: int = 0
     queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
@@ -73,19 +76,11 @@ class ApproximateTokenBucketOptions(TokenBucketOptions):
 
 
 @dataclass(frozen=True)
-class QueueingTokenBucketOptions(TokenBucketOptions):
-    """Queueing + exact hybrid options (≙ the orphaned
-    ``RedisQueueingTokenBucketRateLimiterOptions`` — its limiter is dead
-    code in the reference, ``TokenBucketWithQueue/…Options.cs``; here the
-    hybrid is live, see :class:`~.queueing_token_bucket.QueueingTokenBucketRateLimiter`)."""
-
-    queue_limit: int = 0
-    queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
-
-    def __post_init__(self) -> None:
-        super().__post_init__()
-        if self.queue_limit < 0:
-            raise ValueError("queue_limit must be >= 0")
+class ApproximateTokenBucketOptions(QueueingTokenBucketOptions):
+    """Approximate two-level limiter options
+    (≙ ``RedisApproximateTokenBucketRateLimiterOptions`` — the same
+    queueing surface, ``…Options.cs:44-58``, inherited from
+    :class:`QueueingTokenBucketOptions`)."""
 
 
 @dataclass(frozen=True)

@@ -62,6 +62,7 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
         self._idle_since: float | None = time.monotonic()
         self._refresh_task: asyncio.Task | None = None
         self._refresh_running = False
+        self._store_reachable = False  # any store round-trip this round?
         self._disposed = False
 
     # -- helpers -----------------------------------------------------------
@@ -84,18 +85,15 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
             MetadataName.RETRY_AFTER: max(0.0, deficit / rate),
         })
 
-    def _record(self, granted: bool, remaining: float, permits: int) -> None:
-        self._estimated_remaining = remaining
-        self.metrics.record_decision(granted)
-        if granted and permits > 0:
-            self._idle_since = None
-
     async def _store_acquire(self, count: int) -> bool:
+        t0 = time.perf_counter()
         res = await self.store.acquire(
             self.options.instance_name, count, self.options.token_limit,
             self.options.fill_rate_per_second,
         )
+        self.metrics.acquire_latency.record(time.perf_counter() - t0)
         self._estimated_remaining = res.remaining
+        self._store_reachable = True
         return res.granted
 
     # -- contract ----------------------------------------------------------
@@ -108,11 +106,15 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
         if permits == 0:
             return (SUCCESSFUL_LEASE if self.available_permits() > 0
                     else self._failed_lease(0))
+        t0 = time.perf_counter()
         res = self.store.acquire_blocking(
             self.options.instance_name, permits, self.options.token_limit,
             self.options.fill_rate_per_second,
         )
-        self._record(res.granted, res.remaining, permits)
+        self._estimated_remaining = res.remaining
+        self.metrics.record_decision(res.granted, time.perf_counter() - t0)
+        if res.granted:
+            self._idle_since = None
         return SUCCESSFUL_LEASE if res.granted else self._failed_lease(permits)
 
     async def acquire_async(self, permits: int = 1) -> RateLimitLease:
@@ -138,7 +140,8 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
                 self.metrics.sync_failures += 1
                 granted = False
             if granted:
-                self._record(True, self._estimated_remaining or 0.0, permits)
+                self.metrics.record_decision(True)
+                self._idle_since = None
                 return SUCCESSFUL_LEASE
         future, evicted = self._queue.try_enqueue(permits)
         for victim in evicted:
@@ -181,11 +184,16 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
         self._refresh_running = True
         try:
             t0 = time.perf_counter()
+            self._store_reachable = False
             await self._queue.drain_async(
                 self._try_drain_grant, lambda: SUCCESSFUL_LEASE
             )
-            self.metrics.syncs += 1
-            self.metrics.last_sync_lag_s = time.perf_counter() - t0
+            # A "sync" is a round whose store traffic succeeded (matching
+            # the approximate limiter, which counts only successful syncs);
+            # failed rounds show up in sync_failures, empty rounds nowhere.
+            if self._store_reachable:
+                self.metrics.syncs += 1
+                self.metrics.last_sync_lag_s = time.perf_counter() - t0
         finally:
             self._refresh_running = False
 

@@ -140,9 +140,15 @@ class WaiterQueue:
         ``TokenBucketWithQueue/RedisTokenBucketRateLimiter.cs``):
         ``await try_grant(count)`` consumes from the shared store or
         declines. Cancelled waiters are discarded before any store traffic.
-        A waiter cancelled in the narrow window between the store grant and
-        completion has its cost consumed (token-bucket cost is not
-        returnable); the next drain pass proceeds normally."""
+
+        The waiter under grant is **dequeued before the await** (and
+        re-queued at the same end on decline), so nothing else — NEWEST_FIRST
+        eviction, cancellation callbacks, a concurrent ``fail_all`` — can
+        settle it while its store round-trip is in flight; ``_queue_count``
+        still includes it, so queue-limit accounting is unchanged. The one
+        unavoidable hazard: a waiter cancelled in the window between the
+        store grant and completion has its cost consumed (token-bucket cost
+        is not returnable); the drain proceeds normally."""
         granted = 0
         while self._deque.count:
             newest = self.order is QueueProcessingOrder.NEWEST_FIRST
@@ -151,20 +157,31 @@ class WaiterQueue:
                 (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
                 self._queue_count -= reg.count
                 continue
-            if not await try_grant(reg.count):
+            # Take ownership for the duration of the store round-trip.
+            (self._deque.dequeue_tail if newest else self._deque.dequeue_head)()
+            try:
+                ok = await try_grant(reg.count)
+            except BaseException:
+                # Drain task cancelled (disposal) or grant raised: hand the
+                # waiter back so dispose's fail_all can settle it — a
+                # checked-out registration must never be stranded unsettled.
+                (self._deque.enqueue_tail if newest
+                 else self._deque.enqueue_head)(reg)
+                raise
+            if reg.future.done():  # cancelled mid-flight (callback saw it
+                self._queue_count -= reg.count  # gone; unwind here instead)
+                if ok:
+                    continue  # grant consumed with no lease — documented loss
                 break
-            # The registration may have been cancelled during the await.
-            # Either its done-callback already removed it (remove() returns
-            # False), or the cancellation is marked but the call_soon'd
-            # callback hasn't run yet (remove() returns True on a cancelled
-            # future) — settle only live waiters; a set_result on a
-            # cancelled future would raise InvalidStateError and abort the
-            # drain mid-queue.
-            if self._deque.remove(reg):
+            if ok:
                 self._queue_count -= reg.count
-                if not reg.future.cancelled():
-                    reg.future.set_result(make_lease())
-                    granted += 1
+                reg.future.set_result(make_lease())
+                granted += 1
+            else:
+                # Put it back where it came from; it keeps its turn.
+                (self._deque.enqueue_tail if newest
+                 else self._deque.enqueue_head)(reg)
+                break
         return granted
 
     def fail_all(self, make_lease: Callable[[], object]) -> int:

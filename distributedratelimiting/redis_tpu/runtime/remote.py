@@ -1,0 +1,294 @@
+"""Remote store client — the framework's ``ConnectionMultiplexer``.
+
+:class:`RemoteBucketStore` lets limiter instances on any host share a
+:class:`~.server.BucketStoreServer` the way the reference's limiters share
+one Redis (SURVEY.md §2 #6, §5.8). Behaviors carried over:
+
+- **Config precedence** ``connection_factory > address > url`` — mirroring
+  the reference's ``ConnectionMultiplexerFactory > ConfigurationOptions >
+  Configuration``-string ladder (``RedisTokenBucketRateLimiter.cs:127-141``).
+  The factory seam is also the test fake's injection point (§4 implication
+  (b)).
+- **Lazy, double-checked connect**; a failed connect is logged (event id 1)
+  and retried on next use (``ConnectAsync`` ``:111-151``; invariant 9's
+  recovery posture).
+- **Multiplexed pipelining**: one TCP connection carries any number of
+  in-flight requests tagged with sequence ids; a background reader resolves
+  them in completion order — the StackExchange.Redis model.
+- **Time stays with the store.** The wire protocol carries no client
+  timestamps anywhere; all refill arithmetic runs against the server's
+  clock (invariant 1 — the property the reference gets from Lua ``TIME``).
+
+All socket I/O runs on a dedicated background event loop thread, so the
+same client instance serves both ``async`` callers (from any event loop)
+and blocking callers (from any thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Awaitable, Callable
+
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    BucketStore,
+    SyncResult,
+)
+from distributedratelimiting.redis_tpu.utils import log
+
+__all__ = ["RemoteBucketStore"]
+
+ConnectionFactory = Callable[
+    [], Awaitable[tuple[asyncio.StreamReader, asyncio.StreamWriter]]
+]
+
+
+class RemoteBucketStore(BucketStore):
+    """Client for a :class:`BucketStoreServer`.
+
+    Exactly one of ``connection_factory``, ``address``, or ``url`` must be
+    given (highest-precedence one wins if several are)::
+
+        store = RemoteBucketStore(address=("tpu-host", 6380))
+        store = RemoteBucketStore(url="tpu-host:6380")
+        store = RemoteBucketStore(connection_factory=my_open_fn)  # tests
+    """
+
+    def __init__(
+        self,
+        *,
+        connection_factory: ConnectionFactory | None = None,
+        address: tuple[str, int] | None = None,
+        url: str | None = None,
+        request_timeout_s: float = 30.0,
+        clock: Clock | None = None,
+    ) -> None:
+        if connection_factory is None and address is None and url is None:
+            # ≙ the reference's ctor validation "some Redis config present"
+            # (…RateLimiter.cs:49-67).
+            raise ValueError(
+                "one of connection_factory, address, or url is required"
+            )
+        self._factory = connection_factory
+        if address is None and url is not None:
+            host, _, port = url.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self._address = address
+        self._request_timeout_s = request_timeout_s
+        # The client clock exists only to satisfy the BucketStore interface
+        # (e.g. local diagnostics); the SERVER is the time authority.
+        self.clock = clock or MonotonicClock()
+
+        self._io_loop: asyncio.AbstractEventLoop | None = None
+        self._io_thread: threading.Thread | None = None
+        self._thread_gate = threading.Lock()
+
+        # Connection state — touched only from the I/O loop.
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._reader_task: asyncio.Task | None = None
+        self._connect_gate: asyncio.Lock | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._seq = 0
+        self._closed = False
+
+    # -- background I/O loop ------------------------------------------------
+    def _ensure_loop(self) -> asyncio.AbstractEventLoop:
+        with self._thread_gate:
+            if self._io_loop is None:
+                loop = asyncio.new_event_loop()
+                ready = threading.Event()
+
+                def run() -> None:
+                    asyncio.set_event_loop(loop)
+                    self._connect_gate = asyncio.Lock()
+                    ready.set()
+                    loop.run_forever()
+
+                t = threading.Thread(
+                    target=run, name="remote-bucket-store-io", daemon=True
+                )
+                t.start()
+                ready.wait()
+                self._io_loop = loop
+                self._io_thread = t
+            return self._io_loop
+
+    def _submit(self, coro) -> "asyncio.Future":
+        loop = self._ensure_loop()
+        return asyncio.run_coroutine_threadsafe(coro, loop)
+
+    async def _await_on_io(self, coro):
+        return await asyncio.wrap_future(self._submit(coro))
+
+    # -- connection lifecycle (on the I/O loop) -----------------------------
+    async def connect(self) -> None:
+        """Idempotent lazy connect; public so callers can front-load the
+        dial, but every request path calls it anyway (lazy as in the
+        reference)."""
+        await self._await_on_io(self._connect_io())
+
+    async def _connect_io(self) -> None:
+        if self._writer is not None:
+            return
+        assert self._connect_gate is not None
+        async with self._connect_gate:  # double-checked (≙ SemaphoreSlim(1,1))
+            if self._writer is not None or self._closed:
+                return
+            try:
+                if self._factory is not None:
+                    reader, writer = await self._factory()
+                else:
+                    assert self._address is not None
+                    reader, writer = await asyncio.open_connection(
+                        self._address[0], self._address[1]
+                    )
+            except Exception as exc:
+                log.could_not_connect_to_store(exc)
+                raise
+            self._reader, self._writer = reader, writer
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                body = await wire.read_frame(reader)
+                if body is None:
+                    break
+                seq, kind, vals = wire.decode_response(body)
+                fut = self._pending.pop(seq, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == wire.RESP_ERROR:
+                    fut.set_exception(wire.RemoteStoreError(vals[0]))
+                else:
+                    fut.set_result(vals)
+        except Exception as exc:
+            log.error_evaluating_kernel(exc)
+        finally:
+            self._drop_connection(ConnectionError("connection to store lost"))
+
+    def _drop_connection(self, exc: Exception) -> None:
+        """Fail all in-flight requests; the next use reconnects."""
+        if self._writer is not None:
+            self._writer.close()
+        self._reader = self._writer = None
+        self._reader_task = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    # -- request path (on the I/O loop) -------------------------------------
+    async def _request_io(self, op: int, key: str, count: int,
+                          a: float, b: float) -> tuple:
+        await self._connect_io()
+        assert self._writer is not None and self._io_loop is not None
+        self._seq = (self._seq + 1) & 0xFFFFFFFF
+        seq = self._seq
+        fut: asyncio.Future = self._io_loop.create_future()
+        self._pending[seq] = fut
+        try:
+            wire.write_frame(
+                self._writer, wire.encode_request(seq, op, key, count, a, b)
+            )
+            await self._writer.drain()
+        except Exception as exc:
+            self._pending.pop(seq, None)
+            self._drop_connection(
+                exc if isinstance(exc, ConnectionError)
+                else ConnectionError(str(exc))
+            )
+            raise
+        return await asyncio.wait_for(fut, self._request_timeout_s)
+
+    async def _request(self, op: int, key: str = "", count: int = 0,
+                       a: float = 0.0, b: float = 0.0) -> tuple:
+        return await self._await_on_io(self._request_io(op, key, count, a, b))
+
+    def _request_blocking(self, op: int, key: str = "", count: int = 0,
+                          a: float = 0.0, b: float = 0.0) -> tuple:
+        return self._submit(self._request_io(op, key, count, a, b)).result(
+            self._request_timeout_s + 1.0
+        )
+
+    # -- BucketStore API ----------------------------------------------------
+    async def acquire(self, key: str, count: int, capacity: float,
+                      fill_rate_per_sec: float) -> AcquireResult:
+        granted, remaining = await self._request(
+            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
+        return AcquireResult(granted, remaining)
+
+    def acquire_blocking(self, key: str, count: int, capacity: float,
+                         fill_rate_per_sec: float) -> AcquireResult:
+        granted, remaining = self._request_blocking(
+            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
+        return AcquireResult(granted, remaining)
+
+    def peek_blocking(self, key: str, capacity: float,
+                      fill_rate_per_sec: float) -> float:
+        (value,) = self._request_blocking(
+            wire.OP_PEEK, key, 0, capacity, fill_rate_per_sec)
+        return value
+
+    async def sync_counter(self, key: str, local_count: float,
+                           decay_rate_per_sec: float) -> SyncResult:
+        score, ewma = await self._request(
+            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec)
+        return SyncResult(score, ewma)
+
+    def sync_counter_blocking(self, key: str, local_count: float,
+                              decay_rate_per_sec: float) -> SyncResult:
+        score, ewma = self._request_blocking(
+            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec)
+        return SyncResult(score, ewma)
+
+    async def window_acquire(self, key: str, count: int, limit: float,
+                             window_sec: float) -> AcquireResult:
+        granted, remaining = await self._request(
+            wire.OP_WINDOW, key, count, limit, window_sec)
+        return AcquireResult(granted, remaining)
+
+    def window_acquire_blocking(self, key: str, count: int, limit: float,
+                                window_sec: float) -> AcquireResult:
+        granted, remaining = self._request_blocking(
+            wire.OP_WINDOW, key, count, limit, window_sec)
+        return AcquireResult(granted, remaining)
+
+    async def ping(self) -> None:
+        await self._request(wire.OP_PING)
+
+    # -- lifecycle ----------------------------------------------------------
+    async def aclose(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        loop = self._io_loop
+        if loop is None:
+            return
+
+        async def shutdown() -> None:
+            self._drop_connection(ConnectionError("store client closed"))
+
+        await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            shutdown(), loop))
+        loop.call_soon_threadsafe(loop.stop)
+        if self._io_thread is not None:
+            self._io_thread.join(timeout=5.0)
+        loop.close()
+        self._io_loop = None
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError(
+            "snapshot/restore runs on the server's store — durable state "
+            "lives with the store, clients are stateless (SURVEY.md §5.4)"
+        )
+
+    def restore(self, snap: dict) -> None:
+        raise NotImplementedError(
+            "snapshot/restore runs on the server's store — durable state "
+            "lives with the store, clients are stateless (SURVEY.md §5.4)"
+        )

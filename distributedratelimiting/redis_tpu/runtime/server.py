@@ -1,0 +1,197 @@
+"""The store server — the framework's "Redis process".
+
+The reference's topology is a pure client-server star: clients never talk
+to each other; all coordination is mediated by the shared store over TCP
+(SURVEY.md §5.8). :class:`BucketStoreServer` is that shared store for
+deployments whose clients are not co-located with the TPU host: it fronts
+any :class:`~.store.BucketStore` (typically :class:`~.store.DeviceBucketStore`)
+with an asyncio TCP listener speaking the :mod:`~.wire` protocol.
+
+The crucial inversion of the reference's economics: every concurrent
+request from *every* connection funnels into the store's micro-batcher, so
+N clients × M in-flight requests coalesce into single kernel launches —
+the server gets *more* efficient under load, where one Redis paid one Lua
+execution per request.
+
+Each request is served as its own task, so slow store operations from one
+connection never head-of-line-block another, and responses return in
+completion order (the seq id lets clients match them — same contract as a
+multiplexed Redis connection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
+from distributedratelimiting.redis_tpu.utils import log
+
+__all__ = ["BucketStoreServer"]
+
+
+class BucketStoreServer:
+    """Serve a :class:`BucketStore` over TCP.
+
+    Usage::
+
+        server = BucketStoreServer(DeviceBucketStore(), host="0.0.0.0", port=6380)
+        await server.start()
+        ...
+        await server.aclose()
+    """
+
+    def __init__(self, store: BucketStore, *, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.store = store
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.connections_served = 0
+        self.requests_served = 0
+
+    async def start(self) -> tuple[str, int]:
+        """Bind and listen; returns the bound ``(host, port)`` (port 0 in
+        the constructor picks a free one — the tests' and examples'
+        localhost-cluster trick, ≙ ``UseLocalhostClustering`` with per-
+        instance port offsets, ``TestApp/Program.cs:43-52``)."""
+        await self.store.connect()
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port
+        )
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        return self.host, self.port
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        self.connections_served += 1
+        write_lock = asyncio.Lock()
+        request_tasks: set[asyncio.Task] = set()
+        conn_task = asyncio.current_task()
+        if conn_task is not None:
+            self._conn_tasks.add(conn_task)
+            conn_task.add_done_callback(self._conn_tasks.discard)
+        try:
+            while True:
+                body = await wire.read_frame(reader)
+                if body is None:
+                    break
+                task = asyncio.ensure_future(
+                    self._serve_request(body, writer, write_lock)
+                )
+                request_tasks.add(task)
+                task.add_done_callback(request_tasks.discard)
+        except wire.RemoteStoreError as exc:
+            log.error_evaluating_kernel(exc)  # protocol-broken peer: drop
+        finally:
+            for t in request_tasks:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
+                             write_lock: asyncio.Lock) -> None:
+        seq = 0
+        try:
+            seq, op, key, count, a, b = wire.decode_request(body)
+            if op == wire.OP_ACQUIRE:
+                res = await self.store.acquire(key, count, a, b)
+                resp = wire.encode_response(
+                    seq, wire.RESP_DECISION, res.granted, res.remaining)
+            elif op == wire.OP_PEEK:
+                resp = wire.encode_response(
+                    seq, wire.RESP_VALUE, self.store.peek_blocking(key, a, b))
+            elif op == wire.OP_SYNC:
+                res = await self.store.sync_counter(key, a, b)
+                resp = wire.encode_response(
+                    seq, wire.RESP_PAIR, res.global_score, res.period_ewma_ticks)
+            elif op == wire.OP_WINDOW:
+                res = await self.store.window_acquire(key, count, a, b)
+                resp = wire.encode_response(
+                    seq, wire.RESP_DECISION, res.granted, res.remaining)
+            elif op == wire.OP_PING:
+                resp = wire.encode_response(seq, wire.RESP_EMPTY)
+            else:
+                resp = wire.encode_response(
+                    seq, wire.RESP_ERROR, f"unknown op {op}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # relay, never kill the connection
+            log.error_evaluating_kernel(exc)
+            resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
+        self.requests_served += 1
+        async with write_lock:  # frames must not interleave
+            try:
+                wire.write_frame(writer, resp)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass  # client went away; its futures die with the socket
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*list(self._conn_tasks),
+                                 return_exceptions=True)
+
+    async def __aenter__(self) -> "BucketStoreServer":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: object) -> None:
+        await self.aclose()
+
+
+def main(argv: list[str] | None = None) -> None:
+    """Run a store server from the console — the deployment unit that plays
+    the Redis process's role on the TPU host:
+
+        python -m distributedratelimiting.redis_tpu.runtime.server --port 6380
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU bucket-store server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6380)
+    parser.add_argument("--backend", choices=("device", "inprocess"),
+                        default="device",
+                        help="device = TPU-resident store; inprocess = "
+                        "pure-Python store (CPU baseline / tests)")
+    parser.add_argument("--slots", type=int, default=2**17)
+    args = parser.parse_args(argv)
+
+    async def serve() -> None:
+        if args.backend == "device":
+            from distributedratelimiting.redis_tpu.runtime.store import (
+                DeviceBucketStore,
+            )
+
+            store: BucketStore = DeviceBucketStore(n_slots=args.slots)
+        else:
+            from distributedratelimiting.redis_tpu.runtime.store import (
+                InProcessBucketStore,
+            )
+
+            store = InProcessBucketStore()
+        server = BucketStoreServer(store, host=args.host, port=args.port)
+        host, port = await server.start()
+        print(f"bucket-store server listening on {host}:{port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await server.aclose()
+
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

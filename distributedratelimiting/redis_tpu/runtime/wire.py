@@ -1,0 +1,172 @@
+"""Wire protocol for the remote store — the framework's RESP/EVALSHA analogue.
+
+The reference's entire comm stack is a multiplexed TCP connection carrying
+``EVALSHA`` invocations of prepared scripts (SURVEY.md §5.8,
+``StackExchange.Redis`` + ``ScriptEvaluateAsync``). Here the same star
+topology is served by a compact length-prefixed binary protocol: clients
+pipeline requests tagged with a sequence id over one connection; the server
+executes each against its local :class:`BucketStore` (typically the
+TPU-resident :class:`DeviceBucketStore`, whose micro-batcher coalesces
+concurrent requests from all connections into single kernel launches) and
+replies out of completion order.
+
+Frame layout (all integers little-endian):
+
+    [u32 length][u32 seq][u8 op][payload…]
+
+Request payloads:
+    ACQUIRE / WINDOW : [u16 klen][key utf-8][i32 count][f64 a][f64 b]
+                       (a, b) = (capacity, fill_rate) / (limit, window_s)
+    PEEK             : [u16 klen][key utf-8][f64 capacity][f64 fill_rate]
+    SYNC             : [u16 klen][key utf-8][f64 local_count][f64 decay_rate]
+    PING             : empty
+
+Response payloads:
+    OK_DECISION : [u8 granted][f64 remaining]
+    OK_VALUE    : [f64 value]
+    OK_PAIR     : [f64 a][f64 b]
+    OK_EMPTY    : empty
+    ERROR       : [u16 mlen][message utf-8]
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = [
+    "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
+    "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_ERROR",
+    "MAX_FRAME", "RemoteStoreError",
+    "encode_request", "decode_request", "encode_response", "decode_response",
+    "read_frame", "write_frame",
+]
+
+OP_ACQUIRE = 1
+OP_PEEK = 2
+OP_SYNC = 3
+OP_WINDOW = 4
+OP_PING = 5
+
+RESP_DECISION = 64
+RESP_VALUE = 65
+RESP_PAIR = 66
+RESP_EMPTY = 67
+RESP_ERROR = 127
+
+#: Upper bound on a frame body; a peer announcing more is protocol-broken
+#: (or hostile) and the connection is dropped rather than buffered.
+MAX_FRAME = 1 << 20
+
+_HDR = struct.Struct("<IIB")          # length covers [seq][op][payload]
+_DECISION = struct.Struct("<Bd")
+_VALUE = struct.Struct("<d")
+_PAIR = struct.Struct("<dd")
+_KEYED = struct.Struct("<H")
+_ACQ_TAIL = struct.Struct("<idd")
+_F64x2 = struct.Struct("<dd")
+
+
+class RemoteStoreError(RuntimeError):
+    """Server-side failure relayed to the client (≙ a Redis script error
+    surfaced through ``ScriptEvaluateAsync``)."""
+
+
+def _keyed(key: str, tail: bytes) -> bytes:
+    kb = key.encode("utf-8")
+    if len(kb) > 0xFFFF:
+        raise ValueError("key exceeds 65535 utf-8 bytes")
+    return _KEYED.pack(len(kb)) + kb + tail
+
+
+def _split_key(payload: bytes) -> tuple[str, bytes]:
+    (klen,) = _KEYED.unpack_from(payload, 0)
+    key = payload[2:2 + klen].decode("utf-8")
+    return key, payload[2 + klen:]
+
+
+def encode_request(seq: int, op: int, key: str = "", count: int = 0,
+                   a: float = 0.0, b: float = 0.0) -> bytes:
+    if op in (OP_ACQUIRE, OP_WINDOW):
+        payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
+    elif op in (OP_PEEK, OP_SYNC):
+        payload = _keyed(key, _F64x2.pack(a, b))
+    elif op == OP_PING:
+        payload = b""
+    else:
+        raise ValueError(f"unknown op {op}")
+    return _HDR.pack(5 + len(payload), seq, op) + payload
+
+
+def decode_request(seq_op_payload: bytes) -> tuple[int, int, str, int, float, float]:
+    """Returns ``(seq, op, key, count, a, b)``."""
+    seq, op = struct.unpack_from("<IB", seq_op_payload, 0)
+    body = seq_op_payload[5:]
+    if op in (OP_ACQUIRE, OP_WINDOW):
+        key, tail = _split_key(body)
+        count, a, b = _ACQ_TAIL.unpack(tail)
+        return seq, op, key, count, a, b
+    if op in (OP_PEEK, OP_SYNC):
+        key, tail = _split_key(body)
+        a, b = _F64x2.unpack(tail)
+        return seq, op, key, 0, a, b
+    if op == OP_PING:
+        return seq, op, "", 0, 0.0, 0.0
+    raise RemoteStoreError(f"unknown op {op}")
+
+
+def encode_response(seq: int, kind: int, *vals) -> bytes:
+    if kind == RESP_DECISION:
+        payload = _DECISION.pack(1 if vals[0] else 0, float(vals[1]))
+    elif kind == RESP_VALUE:
+        payload = _VALUE.pack(float(vals[0]))
+    elif kind == RESP_PAIR:
+        payload = _PAIR.pack(float(vals[0]), float(vals[1]))
+    elif kind == RESP_EMPTY:
+        payload = b""
+    elif kind == RESP_ERROR:
+        mb = str(vals[0]).encode("utf-8")[:0xFFFF]
+        payload = _KEYED.pack(len(mb)) + mb
+    else:
+        raise ValueError(f"unknown response kind {kind}")
+    return _HDR.pack(5 + len(payload), seq, kind) + payload
+
+
+def decode_response(seq_kind_payload: bytes) -> tuple[int, int, tuple]:
+    """Returns ``(seq, kind, values)``; raises nothing — errors travel as
+    ``(RESP_ERROR, (message,))`` so the client can fail just that future."""
+    seq, kind = struct.unpack_from("<IB", seq_kind_payload, 0)
+    body = seq_kind_payload[5:]
+    if kind == RESP_DECISION:
+        granted, remaining = _DECISION.unpack(body)
+        return seq, kind, (bool(granted), remaining)
+    if kind == RESP_VALUE:
+        return seq, kind, _VALUE.unpack(body)
+    if kind == RESP_PAIR:
+        return seq, kind, _PAIR.unpack(body)
+    if kind == RESP_EMPTY:
+        return seq, kind, ()
+    if kind == RESP_ERROR:
+        (mlen,) = _KEYED.unpack_from(body, 0)
+        return seq, kind, (body[2:2 + mlen].decode("utf-8"),)
+    raise RemoteStoreError(f"unknown response kind {kind}")
+
+
+async def read_frame(reader) -> bytes | None:
+    """Read one ``[seq][op][payload]`` body; ``None`` on clean EOF."""
+    import asyncio
+
+    try:
+        hdr = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = struct.unpack("<I", hdr)
+    if not 5 <= length <= MAX_FRAME:
+        raise RemoteStoreError(f"bad frame length {length}")
+    try:
+        return await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+
+
+def write_frame(writer, data: bytes) -> None:
+    writer.write(data)

@@ -153,3 +153,77 @@ def test_fail_all_completes_everyone():
         assert q.queue_count == 0 and len(q) == 0
 
     run(main())
+
+
+def test_drain_async_eviction_cannot_race_inflight_grant():
+    # Regression: a NEWEST_FIRST eviction arriving while the head waiter's
+    # store grant is in flight must neither fail that waiter nor leak the
+    # granted tokens — drain_async checks the waiter out of the deque for
+    # the duration of the round-trip.
+    async def main():
+        q = WaiterQueue(2, QueueProcessingOrder.NEWEST_FIRST)
+        w1, _ = q.try_enqueue(2)
+        gate = asyncio.Event()
+
+        grants = [True, False]  # only the in-flight round-trip succeeds
+
+        async def slow_grant(count):
+            await gate.wait()
+            return grants.pop(0)
+
+        drain = asyncio.ensure_future(q.drain_async(slow_grant, lambda: LEASE_OK))
+        await asyncio.sleep(0)  # drain checks w1 out, parks on the gate
+        # A newcomer that would previously have evicted w1:
+        w2, evicted = q.try_enqueue(2)
+        assert evicted == []          # w1 is checked out — untouchable
+        gate.set()
+        await drain
+        assert w1.result() is LEASE_OK  # the in-flight grant landed
+        assert not w2.done()
+        q.fail_all(lambda: LEASE_FAIL)
+
+    run(main())
+
+
+def test_drain_async_declined_waiter_keeps_turn():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        w1, _ = q.try_enqueue(5)
+        w2, _ = q.try_enqueue(1)
+        granted = await q.drain_async(lambda c: _ret(c <= 1), lambda: LEASE_OK)
+        # Head (5 permits) declined and re-queued at the head; w2 not
+        # overtaken past it.
+        assert granted == 0
+        assert not w1.done() and not w2.done()
+        assert q.queue_count == 6 and len(q) == 2
+        q.fail_all(lambda: LEASE_FAIL)
+
+    async def _ret(v):
+        return v
+
+    run(main())
+
+
+def test_drain_async_cancelled_drain_restores_waiter():
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        w1, _ = q.try_enqueue(3)
+        gate = asyncio.Event()
+
+        async def hanging_grant(count):
+            await gate.wait()
+            return True
+
+        drain = asyncio.ensure_future(q.drain_async(hanging_grant, lambda: LEASE_OK))
+        await asyncio.sleep(0)
+        drain.cancel()  # disposal path cancels the refresh task
+        try:
+            await drain
+        except asyncio.CancelledError:
+            pass
+        # The checked-out waiter was handed back; fail_all can settle it.
+        assert len(q) == 1
+        q.fail_all(lambda: LEASE_FAIL)
+        assert w1.result() is LEASE_FAIL
+
+    run(main())

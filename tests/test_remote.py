@@ -1,0 +1,260 @@
+"""Remote store tests: the client-server star topology over localhost TCP.
+
+This is the reference's deployment shape — N limiter instances sharing one
+store over the network (SURVEY.md §5.8) — and the test style its TestApp
+gestured at with Orleans localhost clustering (§4): multiple clients, one
+shared server, per-test free ports."""
+
+import asyncio
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    ApproximateTokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import (
+    ApproximateTokenBucketOptions,
+    TokenBucketOptions,
+)
+from distributedratelimiting.redis_tpu.models.token_bucket import (
+    TokenBucketRateLimiter,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestWireProtocol:
+    def test_request_roundtrip(self):
+        frame = wire.encode_request(7, wire.OP_ACQUIRE, "user:42", 3, 100.0, 5.0)
+        seq, op, key, count, a, b = wire.decode_request(frame[4:])
+        assert (seq, op, key, count, a, b) == (7, wire.OP_ACQUIRE, "user:42",
+                                               3, 100.0, 5.0)
+
+    def test_sync_request_roundtrip(self):
+        frame = wire.encode_request(9, wire.OP_SYNC, "bucket", 0, 12.5, 1.0)
+        seq, op, key, count, a, b = wire.decode_request(frame[4:])
+        assert (seq, op, key, a, b) == (9, wire.OP_SYNC, "bucket", 12.5, 1.0)
+
+    def test_response_roundtrips(self):
+        for kind, vals in [
+            (wire.RESP_DECISION, (True, 4.5)),
+            (wire.RESP_VALUE, (3.25,)),
+            (wire.RESP_PAIR, (1.5, 2.5)),
+            (wire.RESP_EMPTY, ()),
+            (wire.RESP_ERROR, ("boom",)),
+        ]:
+            seq, k, out = wire.decode_response(
+                wire.encode_response(11, kind, *vals)[4:])
+            assert (seq, k, out) == (11, kind, vals)
+
+    def test_unicode_key(self):
+        frame = wire.encode_request(1, wire.OP_PEEK, "ключ-🔑", 0, 1.0, 1.0)
+        _, _, key, _, _, _ = wire.decode_request(frame[4:])
+        assert key == "ключ-🔑"
+
+    def test_bad_frame_length_rejected(self):
+        async def main():
+            reader = asyncio.StreamReader()
+            reader.feed_data((wire.MAX_FRAME + 1).to_bytes(4, "little"))
+            with pytest.raises(wire.RemoteStoreError):
+                await wire.read_frame(reader)
+
+        run(main())
+
+
+class TestClientServer:
+    def test_acquire_over_tcp(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    # Fresh bucket grants up to capacity, then declines.
+                    results = [await store.acquire("k", 1, 5.0, 1.0)
+                               for _ in range(7)]
+                    assert [r.granted for r in results] == [True] * 5 + [False] * 2
+                    # Server-side refill (server clock is the authority).
+                    clock.advance_seconds(2.0)
+                    assert (await store.acquire("k", 2, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_blocking_paths_from_sync_context(self):
+        async def setup():
+            srv = BucketStoreServer(InProcessBucketStore())
+            await srv.start()
+            return srv
+
+        # Server must live on a real loop; run it on a background thread.
+        import threading
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        srv = asyncio.run_coroutine_threadsafe(setup(), loop).result(10)
+        store = RemoteBucketStore(url=f"{srv.host}:{srv.port}")
+        try:
+            res = store.acquire_blocking("k", 3, 10.0, 1.0)
+            assert res.granted and res.remaining == 7.0
+            assert store.peek_blocking("k", 10.0, 1.0) == 7.0
+            sync = store.sync_counter_blocking("g", 4.0, 1.0)
+            assert sync.global_score == 4.0
+            w = store.window_acquire_blocking("w", 1, 5.0, 1.0)
+            assert w.granted
+        finally:
+            run(store.aclose())
+            asyncio.run_coroutine_threadsafe(srv.aclose(), loop).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=5)
+
+    def test_pipelined_concurrent_requests(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    # 64 concurrent acquires multiplexed on one connection.
+                    results = await asyncio.gather(
+                        *(store.acquire(f"k{i % 8}", 1, 4.0, 1.0)
+                          for i in range(64)))
+                    granted = sum(r.granted for r in results)
+                    assert granted == 8 * 4  # 8 buckets × capacity 4
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_connection_factory_precedence(self):
+        # The factory seam (≙ ConnectionMultiplexerFactory) wins over a
+        # bogus address — proving precedence order.
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                async def factory():
+                    return await asyncio.open_connection(srv.host, srv.port)
+
+                store = RemoteBucketStore(
+                    connection_factory=factory,
+                    address=("256.0.0.1", 1),  # would fail if dialed
+                )
+                try:
+                    assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_requires_some_config(self):
+        with pytest.raises(ValueError):
+            RemoteBucketStore()
+
+    def test_connect_failure_logged_and_retried(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                attempts = 0
+
+                async def flaky_factory():
+                    nonlocal attempts
+                    attempts += 1
+                    if attempts == 1:
+                        raise ConnectionRefusedError("store down")
+                    return await asyncio.open_connection(srv.host, srv.port)
+
+                store = RemoteBucketStore(connection_factory=flaky_factory)
+                try:
+                    with pytest.raises(ConnectionRefusedError):
+                        await store.acquire("k", 1, 5.0, 1.0)
+                    # Next use retries the connect (lazy recovery,
+                    # invariant 9).
+                    assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                    assert attempts == 2
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_server_error_relayed_not_fatal(self):
+        class ExplodingStore(InProcessBucketStore):
+            async def acquire(self, key, *a, **kw):
+                if key == "bad":
+                    raise RuntimeError("kernel exploded")
+                return await super().acquire(key, *a, **kw)
+
+        async def main():
+            async with BucketStoreServer(ExplodingStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    with pytest.raises(wire.RemoteStoreError):
+                        await store.acquire("bad", 1, 5.0, 1.0)
+                    # Connection survives; next request works.
+                    assert (await store.acquire("good", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_snapshot_unsupported_remotely(self):
+        store = RemoteBucketStore(url="localhost:1")
+        with pytest.raises(NotImplementedError):
+            store.snapshot()
+
+
+class TestDistributedLimiters:
+    def test_exact_limiters_share_bucket_across_clients(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as srv:
+                a = RemoteBucketStore(address=(srv.host, srv.port))
+                b = RemoteBucketStore(address=(srv.host, srv.port))
+                lim_a = TokenBucketRateLimiter(
+                    TokenBucketOptions(token_limit=6, instance_name="shared"), a)
+                lim_b = TokenBucketRateLimiter(
+                    TokenBucketOptions(token_limit=6, instance_name="shared"), b)
+                try:
+                    ga = sum(l.is_acquired for l in await asyncio.gather(
+                        *(lim_a.acquire_async(1) for _ in range(6))))
+                    gb = sum(l.is_acquired for l in await asyncio.gather(
+                        *(lim_b.acquire_async(1) for _ in range(6))))
+                    assert ga + gb == 6  # one shared bucket, not two
+                finally:
+                    await a.aclose()
+                    await b.aclose()
+
+        run(main())
+
+    def test_approximate_convergence_across_clients(self):
+        # Two approximate limiters on separate TCP clients converge to the
+        # shared global counter: after syncs, each sees the other's load.
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as srv:
+                stores = [RemoteBucketStore(address=(srv.host, srv.port))
+                          for _ in range(2)]
+                lims = [ApproximateTokenBucketRateLimiter(
+                    ApproximateTokenBucketOptions(
+                        token_limit=100, tokens_per_period=10,
+                        instance_name="global"), s) for s in stores]
+                try:
+                    for lim in lims:
+                        for _ in range(30):
+                            lim._try_lease(1)  # consume locally
+                    for lim in lims:
+                        await lim.refresh()
+                    # Global counter saw 60 consumed permits.
+                    assert sum(l._global_score for l in lims) >= 60
+                    for lim in lims:
+                        assert lim.available_tokens < 100 - 30
+                finally:
+                    for lim in lims:
+                        await lim.aclose()
+                    for s in stores:
+                        await s.aclose()
+
+        run(main())
