@@ -1,0 +1,232 @@
+"""Key directories: the host-side ``(key string → device slot)`` map.
+
+In the reference, key routing is Redis's own keyspace — one hash per bucket
+key, resolved inside the store (SURVEY.md §2 #2, §5.7: "per-key
+partitioning = key concatenation, one Redis hash per partition"). Here the
+state lives in HBM slot arrays, so the routing map lives host-side in front
+of them, and its per-flush batch resolve is on the serving hot path. Two
+interchangeable implementations:
+
+- :class:`NativeKeyDirectory` — C++ open-addressing table
+  (``native/directory.cc``) via ctypes: one C call resolves a whole flush.
+- :class:`PyKeyDirectory` — dict + free-list, semantically identical; the
+  fallback when no compiler is available (``DRL_TPU_NO_NATIVE=1`` forces it).
+
+Shared semantics (both backends, property-tested against each other):
+slot ids pop in ascending order from a descending free-list; ``resolve``
+allocates on miss and returns ``-1`` once the free-list is dry (caller
+sweeps/grows and re-resolves); ``remove_slots`` evicts by slot id and
+recycles; ``add_slots`` extends capacity after a table grow.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from distributedratelimiting.redis_tpu.utils.native import load_directory_lib
+
+__all__ = ["KeyDirectory", "PyKeyDirectory", "NativeKeyDirectory",
+           "make_directory"]
+
+
+class KeyDirectory:
+    """Interface (duck-typed; both impls below)."""
+
+    def resolve_batch(self, keys: list[str]) -> np.ndarray:  # i32[n]
+        raise NotImplementedError
+
+    def lookup(self, key: str) -> int | None:
+        raise NotImplementedError
+
+    def remove_slots(self, dead: "np.ndarray | list[int]") -> int:
+        raise NotImplementedError
+
+    def add_slots(self, start: int, end: int) -> None:
+        raise NotImplementedError
+
+    @property
+    def free_count(self) -> int:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict[str, int]:
+        raise NotImplementedError
+
+    def load(self, mapping: dict[str, int], n_slots: int) -> None:
+        """Restore path: adopt ``mapping`` wholesale; free-list becomes all
+        slots in ``[0, n_slots)`` not present in the mapping, popping in
+        ascending order."""
+        raise NotImplementedError
+
+
+class PyKeyDirectory(KeyDirectory):
+    def __init__(self, n_slots: int) -> None:
+        self._map: dict[str, int] = {}
+        self._free: list[int] = list(range(n_slots - 1, -1, -1))
+
+    def resolve_batch(self, keys: list[str]) -> np.ndarray:
+        out = np.empty(len(keys), np.int32)
+        get = self._map.get
+        for i, k in enumerate(keys):
+            slot = get(k)
+            if slot is None:
+                if not self._free:
+                    out[i] = -1
+                    continue
+                slot = self._free.pop()
+                self._map[k] = slot
+            out[i] = slot
+        return out
+
+    def lookup(self, key: str) -> int | None:
+        return self._map.get(key)
+
+    def remove_slots(self, dead) -> int:
+        # Freed slots are pushed in input order (LIFO reuse) — the exact
+        # discipline of the native free-list, so the two backends assign
+        # identical slot ids for identical op streams.
+        rev = {s: k for k, s in self._map.items()}
+        removed = 0
+        for s in dead:
+            k = rev.pop(int(s), None)
+            if k is None:
+                continue
+            del self._map[k]
+            self._free.append(int(s))
+            removed += 1
+        return removed
+
+    def add_slots(self, start: int, end: int) -> None:
+        self._free.extend(range(end - 1, start - 1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def to_dict(self) -> dict[str, int]:
+        return dict(self._map)
+
+    def load(self, mapping: dict[str, int], n_slots: int) -> None:
+        self._map = dict(mapping)
+        used = set(self._map.values())
+        self._free = [s for s in range(n_slots - 1, -1, -1) if s not in used]
+
+
+class NativeKeyDirectory(KeyDirectory):
+    def __init__(self, n_slots: int, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        self._h = lib.dir_new(n_slots)
+        if not self._h:
+            raise MemoryError("dir_new failed")
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.dir_free(h)
+
+    def resolve_batch(self, keys: list[str]) -> np.ndarray:
+        out = np.empty(len(keys), np.int32)
+        out_ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if self._lib.has_pylist:
+            # Zero-copy: C reads each str's cached UTF-8 directly.
+            if not isinstance(keys, list):
+                keys = list(keys)
+            r = self._lib.dir_resolve_pylist(self._h, keys, out_ptr)
+            if r >= 0:
+                return out
+            # Non-str element: fall through to the encode path, which will
+            # raise the natural AttributeError/TypeError.
+        encoded = [k.encode("utf-8") for k in keys]
+        offsets = np.zeros(len(keys) + 1, np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        self._lib.dir_resolve_batch(
+            self._h, blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(keys), out_ptr,
+        )
+        return out
+
+    def lookup(self, key: str) -> int | None:
+        kb = key.encode("utf-8")
+        slot = self._lib.dir_lookup(self._h, kb, len(kb))
+        return None if slot < 0 else int(slot)
+
+    def remove_slots(self, dead) -> int:
+        arr = np.asarray(list(dead) if not isinstance(dead, np.ndarray) else dead,
+                         dtype=np.int32)
+        if arr.size == 0:
+            return 0
+        return int(self._lib.dir_remove_slots(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            arr.size))
+
+    def add_slots(self, start: int, end: int) -> None:
+        self._lib.dir_add_slots(self._h, start, end)
+
+    @property
+    def free_count(self) -> int:
+        return int(self._lib.dir_free_count(self._h))
+
+    @property
+    def arena_bytes(self) -> int:
+        """Live key bytes (diagnostics; compaction keeps the real arena
+        within 2× of this under churn)."""
+        return int(self._lib.dir_arena_bytes(self._h))
+
+    def __len__(self) -> int:
+        return int(self._lib.dir_size(self._h))
+
+    def to_dict(self) -> dict[str, int]:
+        n = len(self)
+        if n == 0:
+            return {}
+        nbytes = int(self._lib.dir_arena_bytes(self._h))
+        keys_buf = ctypes.create_string_buffer(max(nbytes, 1))
+        offsets = np.empty(n + 1, np.int64)
+        slots = np.empty(n, np.int32)
+        count = self._lib.dir_dump(
+            self._h, keys_buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        raw = keys_buf.raw
+        return {
+            raw[offsets[i]:offsets[i + 1]].decode("utf-8"): int(slots[i])
+            for i in range(count)
+        }
+
+    def load(self, mapping: dict[str, int], n_slots: int) -> None:
+        lib, h = self._lib, self._h
+        self._h = None
+        lib.dir_free(h)
+        self._h = lib.dir_new(n_slots)
+        for key, slot in mapping.items():
+            kb = key.encode("utf-8")
+            if lib.dir_insert(self._h, kb, len(kb), int(slot)) != 0:
+                raise ValueError(f"duplicate key in restore mapping: {key!r}")
+        used = set(mapping.values())
+        free = np.array([s for s in range(n_slots - 1, -1, -1)
+                         if s not in used], dtype=np.int32)
+        lib.dir_set_free(
+            self._h,
+            free.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            free.size)
+
+
+def make_directory(n_slots: int) -> KeyDirectory:
+    """Native if buildable, Python otherwise — transparently equivalent."""
+    lib = load_directory_lib()
+    if lib is not None:
+        try:
+            return NativeKeyDirectory(n_slots, lib)
+        except Exception:
+            pass
+    return PyKeyDirectory(n_slots)

@@ -60,6 +60,10 @@ class WaiterQueue:
         self.order = order
         self._deque: Deque[Registration] = Deque()
         self._queue_count = 0  # cumulative permits queued
+        # Set by fail_all: once the queue has been failed (disposal), a
+        # drain_async waiter returning from its in-flight round-trip must
+        # be settled with this factory, never re-parked.
+        self._fail_factory: Callable[[], object] | None = None
 
     def __len__(self) -> int:
         return len(self._deque)
@@ -165,13 +169,29 @@ class WaiterQueue:
                 # Drain task cancelled (disposal) or grant raised: hand the
                 # waiter back so dispose's fail_all can settle it — a
                 # checked-out registration must never be stranded unsettled.
-                (self._deque.enqueue_tail if newest
-                 else self._deque.enqueue_head)(reg)
+                # If fail_all already ran, settle directly instead.
+                if self._fail_factory is not None:
+                    self._queue_count -= reg.count
+                    if not reg.future.done():
+                        reg.future.set_result(self._fail_factory())
+                else:
+                    (self._deque.enqueue_tail if newest
+                     else self._deque.enqueue_head)(reg)
                 raise
             if reg.future.done():  # cancelled mid-flight (callback saw it
                 self._queue_count -= reg.count  # gone; unwind here instead)
                 if ok:
                     continue  # grant consumed with no lease — documented loss
+                break
+            if self._fail_factory is not None:
+                # fail_all ran while the round-trip was in flight; it
+                # couldn't see this checked-out waiter, so settle it here —
+                # re-parking would strand it in a disposed queue forever.
+                self._queue_count -= reg.count
+                reg.future.set_result(
+                    make_lease() if ok else self._fail_factory())
+                if ok:
+                    granted += 1
                 break
             if ok:
                 self._queue_count -= reg.count
@@ -186,7 +206,10 @@ class WaiterQueue:
 
     def fail_all(self, make_lease: Callable[[], object]) -> int:
         """Disposal path: every parked waiter completes with a failed lease
-        (``:291-298``), drained in queue-processing order."""
+        (``:291-298``), drained in queue-processing order. Also marks the
+        queue failed so a waiter checked out by an in-flight
+        :meth:`drain_async` settles on return instead of re-parking."""
+        self._fail_factory = make_lease
         failed = 0
         while self._deque.count:
             newest = self.order is QueueProcessingOrder.NEWEST_FIRST

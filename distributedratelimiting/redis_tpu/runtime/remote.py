@@ -98,6 +98,10 @@ class RemoteBucketStore(BucketStore):
     # -- background I/O loop ------------------------------------------------
     def _ensure_loop(self) -> asyncio.AbstractEventLoop:
         with self._thread_gate:
+            if self._closed:
+                # Post-close use must fail fast, not resurrect a loop
+                # thread that nothing would ever stop.
+                raise ConnectionError("store client is closed")
             if self._io_loop is None:
                 loop = asyncio.new_event_loop()
                 ready = threading.Event()
@@ -186,24 +190,31 @@ class RemoteBucketStore(BucketStore):
     async def _request_io(self, op: int, key: str, count: int,
                           a: float, b: float) -> tuple:
         await self._connect_io()
-        assert self._writer is not None and self._io_loop is not None
+        if self._writer is None or self._io_loop is None:
+            raise ConnectionError("store client is closed")
         self._seq = (self._seq + 1) & 0xFFFFFFFF
         seq = self._seq
         fut: asyncio.Future = self._io_loop.create_future()
         self._pending[seq] = fut
         try:
-            wire.write_frame(
-                self._writer, wire.encode_request(seq, op, key, count, a, b)
-            )
-            await self._writer.drain()
-        except Exception as exc:
+            try:
+                wire.write_frame(
+                    self._writer,
+                    wire.encode_request(seq, op, key, count, a, b),
+                )
+                await self._writer.drain()
+            except Exception as exc:
+                self._drop_connection(
+                    exc if isinstance(exc, ConnectionError)
+                    else ConnectionError(str(exc))
+                )
+                raise
+            return await asyncio.wait_for(fut, self._request_timeout_s)
+        finally:
+            # Timeout / cancellation must not leak the future: against a
+            # hung-but-connected server every timed-out request would
+            # otherwise grow _pending forever.
             self._pending.pop(seq, None)
-            self._drop_connection(
-                exc if isinstance(exc, ConnectionError)
-                else ConnectionError(str(exc))
-            )
-            raise
-        return await asyncio.wait_for(fut, self._request_timeout_s)
 
     async def _request(self, op: int, key: str = "", count: int = 0,
                        a: float = 0.0, b: float = 0.0) -> tuple:

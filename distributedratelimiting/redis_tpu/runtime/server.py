@@ -96,7 +96,11 @@ class BucketStoreServer:
 
     async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
                              write_lock: asyncio.Lock) -> None:
-        seq = 0
+        # The seq is always the first 4 bytes — recover it before decoding
+        # so even a malformed/unknown request gets a *routable* error reply
+        # (a reply with the wrong seq would strand the client's future for
+        # its whole timeout).
+        seq = int.from_bytes(body[:4], "little") if len(body) >= 4 else 0
         try:
             seq, op, key, count, a, b = wire.decode_request(body)
             if op == wire.OP_ACQUIRE:
@@ -104,8 +108,12 @@ class BucketStoreServer:
                 resp = wire.encode_response(
                     seq, wire.RESP_DECISION, res.granted, res.remaining)
             elif op == wire.OP_PEEK:
-                resp = wire.encode_response(
-                    seq, wire.RESP_VALUE, self.store.peek_blocking(key, a, b))
+                # peek_blocking can wait on the store lock / a device op —
+                # run it off-loop so one PEEK never stalls other
+                # connections' traffic.
+                value = await asyncio.to_thread(
+                    self.store.peek_blocking, key, a, b)
+                resp = wire.encode_response(seq, wire.RESP_VALUE, value)
             elif op == wire.OP_SYNC:
                 res = await self.store.sync_counter(key, a, b)
                 resp = wire.encode_response(
@@ -116,13 +124,13 @@ class BucketStoreServer:
                     seq, wire.RESP_DECISION, res.granted, res.remaining)
             elif op == wire.OP_PING:
                 resp = wire.encode_response(seq, wire.RESP_EMPTY)
-            else:
+            else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
         except asyncio.CancelledError:
             raise
-        except Exception as exc:  # relay, never kill the connection
-            log.error_evaluating_kernel(exc)
+        except Exception as exc:  # relay (with the recovered seq), never
+            log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
         self.requests_served += 1
         async with write_lock:  # frames must not interleave

@@ -40,6 +40,7 @@ from distributedratelimiting.redis_tpu.ops import kernels as K
 from distributedratelimiting.redis_tpu.utils import log
 from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.directory import make_directory
 from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
 
 __all__ = [
@@ -187,6 +188,22 @@ def _build_packed(reqs: Sequence[_AcquireReq], slots: Sequence[int], b: int,
     return packed
 
 
+def _resolve_with_reclaim(directory, keys: list[str], sweep, grow) -> np.ndarray:
+    """Batch key→slot resolution with the shared reclaim discipline: on
+    free-list exhaustion mid-batch, sweep expired slots (pinning the ones
+    already resolved for this batch), grow if still dry, re-resolve —
+    already-allocated keys are idempotent lookups, and each dry iteration
+    doubles capacity, so the loop terminates."""
+    slots = directory.resolve_batch(keys)
+    while (slots < 0).any():
+        pinned = {int(s) for s in slots[slots >= 0]}
+        sweep(pinned)
+        if directory.free_count == 0:
+            grow()
+        slots = directory.resolve_batch(keys)
+    return slots
+
+
 class _PackedLaunchMixin:
     """Shared readback convention for tables whose ``_launch`` returns the
     packed ``f32[2, B]`` result (row 0 grants, row 1 remaining)."""
@@ -219,8 +236,9 @@ class _DeviceTable(_PackedLaunchMixin):
         self.rate_per_tick = _rate_per_tick(fill_rate_per_sec)
         self.state = K.init_bucket_state(n_slots)
         self.n_slots = n_slots
-        self.directory: dict[str, int] = {}
-        self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        # Host key→slot routing: C++ batch-resolve when buildable, Python
+        # fallback otherwise (runtime/directory.py — identical semantics).
+        self.dir = make_directory(n_slots)
         # Device-resident config constants: uploaded once, never per flush.
         self.cap_dev = jnp.float32(self.capacity)
         self.rate_dev = jnp.float32(self.rate_per_tick)
@@ -232,20 +250,9 @@ class _DeviceTable(_PackedLaunchMixin):
         )
 
     # -- slot management ---------------------------------------------------
-    def slot_for(self, key: str, pinned: set[int] | None = None) -> int:
-        slot = self.directory.get(key)
-        if slot is None:
-            slot = self._allocate(key, pinned)
-        return slot
-
-    def _allocate(self, key: str, pinned: set[int] | None = None) -> int:
-        if not self.free:
-            self._sweep(pinned)
-        if not self.free:
-            self._grow()
-        slot = self.free.pop()
-        self.directory[key] = slot
-        return slot
+    def resolve_slots(self, keys: list[str]) -> np.ndarray:
+        """Batch key→slot resolution (the host hot path — one native call)."""
+        return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
         """Reclaim slots whose buckets have sat full-refilled past TTL
@@ -299,13 +306,11 @@ class _DeviceTable(_PackedLaunchMixin):
             )
             freed_np = np.asarray(freed)
         if freed_np.any():
-            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
+            dead = np.nonzero(freed_np)[0].astype(np.int32)
             if pinned:
-                dead -= pinned
-            for k in [k for k, s in self.directory.items() if s in dead]:
-                del self.directory[k]
-            self.free.extend(sorted(dead, reverse=True))
-            self.store.metrics.slots_evicted += len(dead)
+                dead = dead[~np.isin(dead, np.fromiter(pinned, np.int32,
+                                                       len(pinned)))]
+            self.store.metrics.slots_evicted += self.dir.remove_slots(dead)
         self.store.metrics.sweeps += 1
 
     def _grow(self) -> None:
@@ -317,7 +322,7 @@ class _DeviceTable(_PackedLaunchMixin):
             last_ts=jnp.concatenate([self.state.last_ts, jnp.zeros((old_n,), jnp.int32)]),
             exists=jnp.concatenate([self.state.exists, jnp.zeros((old_n,), bool)]),
         )
-        self.free.extend(range(new_n - 1, old_n - 1, -1))
+        self.dir.add_slots(old_n, new_n)
         self.n_slots = new_n
 
     # -- decision paths ----------------------------------------------------
@@ -330,12 +335,7 @@ class _DeviceTable(_PackedLaunchMixin):
         donating kernel calls on the same buffers would race (one side
         would operate on a deleted/donated array)."""
         with self.store._lock:
-            slots: list[int] = []
-            pinned: set[int] = set()
-            for r in reqs:
-                s = self.slot_for(r.key, pinned)
-                slots.append(s)
-                pinned.add(s)
+            slots = self.resolve_slots([r.key for r in reqs])
             # Fixed pad width ⇒ exactly ONE compiled kernel per table (the
             # extra rows are masked padding and cost ~nothing next to launch
             # overhead; a varying pad width would recompile per size — ~1 s
@@ -351,7 +351,7 @@ class _DeviceTable(_PackedLaunchMixin):
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
-            slot = self.directory.get(key)
+            slot = self.dir.lookup(key)
             if slot is None:
                 return float(np.floor(self.capacity))
             b = _pad_size(1)
@@ -376,8 +376,7 @@ class _DeviceWindowTable(_PackedLaunchMixin):
         self.window_ticks = int(window_ticks)
         self.state = K.init_window_state(n_slots)
         self.n_slots = n_slots
-        self.directory: dict[str, int] = {}
-        self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        self.dir = make_directory(n_slots)
         self.limit_dev = jnp.float32(self.limit)
         self.window_dev = jnp.int32(self.window_ticks)
         self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
@@ -387,16 +386,8 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             max_inflight=store.max_inflight,
         )
 
-    def slot_for(self, key: str, pinned: set[int] | None = None) -> int:
-        slot = self.directory.get(key)
-        if slot is None:
-            if not self.free:
-                self._sweep(pinned)
-            if not self.free:
-                self._grow()
-            slot = self.free.pop()
-            self.directory[key] = slot
-        return slot
+    def resolve_slots(self, keys: list[str]) -> np.ndarray:
+        return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
         now = self.store.clock.now_ticks()
@@ -405,13 +396,11 @@ class _DeviceWindowTable(_PackedLaunchMixin):
         )
         freed_np = np.asarray(freed)
         if freed_np.any():
-            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
+            dead = np.nonzero(freed_np)[0].astype(np.int32)
             if pinned:
-                dead -= pinned
-            for k in [k for k, s in self.directory.items() if s in dead]:
-                del self.directory[k]
-            self.free.extend(sorted(dead, reverse=True))
-            self.store.metrics.slots_evicted += len(dead)
+                dead = dead[~np.isin(dead, np.fromiter(pinned, np.int32,
+                                                       len(pinned)))]
+            self.store.metrics.slots_evicted += self.dir.remove_slots(dead)
         self.store.metrics.sweeps += 1
 
     def rebase(self, offset_ticks: int) -> None:
@@ -427,17 +416,12 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             window_idx=jnp.concatenate([self.state.window_idx, jnp.zeros((old_n,), jnp.int32)]),
             exists=jnp.concatenate([self.state.exists, jnp.zeros((old_n,), bool)]),
         )
-        self.free.extend(range(old_n * 2 - 1, old_n - 1, -1))
+        self.dir.add_slots(old_n, old_n * 2)
         self.n_slots = old_n * 2
 
     def _launch(self, reqs: Sequence[_AcquireReq]):
         with self.store._lock:  # same dispatch discipline as _DeviceTable
-            slots: list[int] = []
-            pinned: set[int] = set()
-            for r in reqs:
-                s = self.slot_for(r.key, pinned)
-                slots.append(s)
-                pinned.add(s)
+            slots = self.resolve_slots([r.key for r in reqs])
             b = self.store.max_batch  # fixed pad ⇒ one compiled kernel
             packed = _build_packed(reqs, slots, b,
                                    self.store.now_ticks_checked())
@@ -476,8 +460,7 @@ class DeviceBucketStore(BucketStore):
         self._tables: dict[tuple[float, float], _DeviceTable] = {}
         self._wtables: dict[tuple[float, int], _DeviceWindowTable] = {}
         self._counters = K.init_counter_state(counter_slots)
-        self._counter_dir: dict[str, int] = {}
-        self._counter_free = list(range(counter_slots - 1, -1, -1))
+        self._counter_dir = make_directory(counter_slots)
         self._decay_rate_dev: dict[float, jax.Array] = {}
         self._lock = threading.RLock()  # directory/slot allocation guard
         self._connected = False
@@ -554,15 +537,11 @@ class DeviceBucketStore(BucketStore):
     # -- decaying counter --------------------------------------------------
     def _counter_slot(self, key: str) -> int:
         with self._lock:
-            slot = self._counter_dir.get(key)
-            if slot is None:
-                if not self._counter_free:
-                    self._sweep_counters()
-                if not self._counter_free:
-                    self._grow_counters()
-                slot = self._counter_free.pop()
-                self._counter_dir[key] = slot
-            return slot
+            return int(_resolve_with_reclaim(
+                self._counter_dir, [key],
+                lambda pinned: self._sweep_counters(),
+                self._grow_counters,
+            )[0])
 
     def _sweep_counters(self) -> None:
         self._counters, freed = K.sweep_counters(
@@ -570,11 +549,8 @@ class DeviceBucketStore(BucketStore):
         )
         freed_np = np.asarray(freed)
         if freed_np.any():
-            dead = {s for s in np.nonzero(freed_np)[0].tolist()}
-            for k in [k for k, s in self._counter_dir.items() if s in dead]:
-                del self._counter_dir[k]
-            self._counter_free.extend(sorted(dead, reverse=True))
-            self.metrics.slots_evicted += len(dead)
+            dead = np.nonzero(freed_np)[0].astype(np.int32)
+            self.metrics.slots_evicted += self._counter_dir.remove_slots(dead)
         self.metrics.sweeps += 1
 
     def _grow_counters(self) -> None:
@@ -585,7 +561,7 @@ class DeviceBucketStore(BucketStore):
             last_ts=jnp.concatenate([self._counters.last_ts, jnp.zeros((old_n,), jnp.int32)]),
             exists=jnp.concatenate([self._counters.exists, jnp.zeros((old_n,), bool)]),
         )
-        self._counter_free.extend(range(old_n * 2 - 1, old_n - 1, -1))
+        self._counter_dir.add_slots(old_n, old_n * 2)
 
     def _sync_dispatch(self, key: str, local_count: float,
                        decay_rate_per_sec: float):
@@ -652,7 +628,7 @@ class DeviceBucketStore(BucketStore):
             tables = {}
             for (cap, rate), t in self._tables.items():
                 tables[(cap, rate)] = {
-                    "directory": dict(t.directory),
+                    "directory": t.dir.to_dict(),
                     "tokens": np.asarray(t.state.tokens),
                     "last_ts": np.asarray(t.state.last_ts),
                     "exists": np.asarray(t.state.exists),
@@ -660,7 +636,7 @@ class DeviceBucketStore(BucketStore):
             wtables = {}
             for (limit, wt), t in self._wtables.items():
                 wtables[(limit, wt)] = {
-                    "directory": dict(t.directory),
+                    "directory": t.dir.to_dict(),
                     "prev_count": np.asarray(t.state.prev_count),
                     "curr_count": np.asarray(t.state.curr_count),
                     "window_idx": np.asarray(t.state.window_idx),
@@ -670,7 +646,7 @@ class DeviceBucketStore(BucketStore):
                 "now_ticks": self.clock.now_ticks(),
                 "tables": tables,
                 "wtables": wtables,
-                "counter_dir": dict(self._counter_dir),
+                "counter_dir": self._counter_dir.to_dict(),
                 "counters": {
                     "value": np.asarray(self._counters.value),
                     "period": np.asarray(self._counters.period),
@@ -701,10 +677,7 @@ class DeviceBucketStore(BucketStore):
                         np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
                     exists=jnp.asarray(data["exists"]),
                 )
-                table.directory = dict(data["directory"])
-                used = set(table.directory.values())
-                table.free = [s for s in range(table.n_slots - 1, -1, -1)
-                              if s not in used]
+                table.dir.load(data["directory"], table.n_slots)
             for (limit, wt), data in snap.get("wtables", {}).items():
                 table = self._wtable(limit, wt / bm.TICKS_PER_SECOND)
                 n = len(data["prev_count"])
@@ -719,10 +692,7 @@ class DeviceBucketStore(BucketStore):
                         np.clip(idx, -(2**31) + 1, 2**31 - 1), jnp.int32),
                     exists=jnp.asarray(data["exists"]),
                 )
-                table.directory = dict(data["directory"])
-                used = set(table.directory.values())
-                table.free = [s for s in range(table.n_slots - 1, -1, -1)
-                              if s not in used]
+                table.dir.load(data["directory"], table.n_slots)
             c = snap["counters"]
             last_ts = c["last_ts"].astype(np.int64) + shift
             self._counters = K.CounterState(
@@ -732,10 +702,8 @@ class DeviceBucketStore(BucketStore):
                     np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
                 exists=jnp.asarray(c["exists"]),
             )
-            self._counter_dir = dict(snap["counter_dir"])
-            used = set(self._counter_dir.values())
-            n = self._counters.value.shape[0]
-            self._counter_free = [s for s in range(n - 1, -1, -1) if s not in used]
+            self._counter_dir.load(snap["counter_dir"],
+                                   self._counters.value.shape[0])
 
 
 class InProcessBucketStore(BucketStore):

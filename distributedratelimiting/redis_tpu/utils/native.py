@@ -1,0 +1,112 @@
+"""Loader for the native host-runtime library (``native/directory.cc``).
+
+The C++ directory is a performance component, not a correctness one: the
+store works identically on the pure-Python fallback (see
+:mod:`~.runtime.directory`). Build strategy: compile with ``g++`` into
+``native/build/`` on first import if the shared object is missing or older
+than its source; any failure (no compiler, read-only checkout, exotic
+platform) silently yields ``None`` and callers fall back. Set
+``DRL_TPU_NO_NATIVE=1`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+__all__ = ["load_directory_lib"]
+
+_REPO_NATIVE = pathlib.Path(__file__).resolve().parents[3] / "native"
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _build(src: pathlib.Path, out: pathlib.Path) -> bool:
+    """Prefer a build with the CPython API enabled (zero-copy list[str]
+    resolve); fall back to the plain C ABI if headers are unavailable."""
+    import sysconfig
+
+    out.parent.mkdir(parents=True, exist_ok=True)
+    base = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared"]
+    include = sysconfig.get_paths().get("include")
+    attempts = []
+    if include and (pathlib.Path(include) / "Python.h").exists():
+        attempts.append(base + ["-DDRL_WITH_PYTHON", f"-I{include}",
+                                str(src), "-o", str(out)])
+    attempts.append(base + [str(src), "-o", str(out)])
+    for cmd in attempts:
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if proc.returncode == 0 and out.exists():
+            return True
+    return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.dir_new.argtypes = [c.c_int64]
+    lib.dir_new.restype = c.c_void_p
+    lib.dir_free.argtypes = [c.c_void_p]
+    lib.dir_free.restype = None
+    lib.dir_size.argtypes = [c.c_void_p]
+    lib.dir_size.restype = c.c_int64
+    lib.dir_free_count.argtypes = [c.c_void_p]
+    lib.dir_free_count.restype = c.c_int64
+    lib.dir_resolve_batch.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.c_int64), c.c_int64,
+        c.POINTER(c.c_int32)]
+    lib.dir_resolve_batch.restype = c.c_int64
+    lib.dir_lookup.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.dir_lookup.restype = c.c_int32
+    lib.dir_remove_slots.argtypes = [c.c_void_p, c.POINTER(c.c_int32),
+                                     c.c_int64]
+    lib.dir_remove_slots.restype = c.c_int64
+    lib.dir_add_slots.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
+    lib.dir_add_slots.restype = None
+    lib.dir_insert.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.c_int32]
+    lib.dir_insert.restype = c.c_int32
+    lib.dir_set_free.argtypes = [c.c_void_p, c.POINTER(c.c_int32), c.c_int64]
+    lib.dir_set_free.restype = None
+    lib.dir_arena_bytes.argtypes = [c.c_void_p]
+    lib.dir_arena_bytes.restype = c.c_int64
+    lib.dir_dump.argtypes = [c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
+                             c.POINTER(c.c_int32)]
+    lib.dir_dump.restype = c.c_int64
+    try:
+        lib.dir_resolve_pylist.argtypes = [c.c_void_p, c.py_object,
+                                           c.POINTER(c.c_int32)]
+        lib.dir_resolve_pylist.restype = c.c_int64
+        lib.has_pylist = True
+    except AttributeError:  # built without Python.h
+        lib.has_pylist = False
+    return lib
+
+
+def load_directory_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the native directory; ``None`` on any
+    failure — callers must fall back to the Python implementation."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DRL_TPU_NO_NATIVE"):
+        return None
+    src = _REPO_NATIVE / "directory.cc"
+    out = _REPO_NATIVE / "build" / "_directory.so"
+    try:
+        if not src.exists():
+            return None
+        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+            if not _build(src, out):
+                return None
+        # PyDLL: calls hold the GIL, required for dir_resolve_pylist (which
+        # reads str objects); the remaining calls are short host ops already
+        # serialized under the store lock, so no parallelism is lost.
+        _LIB = _bind(ctypes.PyDLL(str(out)))
+    except Exception:
+        _LIB = None
+    return _LIB

@@ -1,0 +1,327 @@
+// Native key directory: the host-runtime hot path of the TPU bucket store.
+//
+// Role: the (key string -> device slot) map that the reference kept inside
+// Redis's own keyspace (one hash per bucket key) lives host-side here,
+// fronting the HBM slot arrays. Every micro-batch flush resolves up to
+// max_batch keys; this directory does that in one C call instead of a
+// Python dict loop — open addressing with linear probing, FNV-1a hashing,
+// an append-only key arena, an explicit free-list of device slots, and a
+// slot->bucket reverse index so TTL sweeps can evict by slot id.
+//
+// Plain C ABI (extern "C") consumed via ctypes; no Python.h dependency, so
+// it builds with a bare `g++ -O3 -shared -fPIC`.
+
+#ifdef DRL_WITH_PYTHON
+#include <Python.h>
+#endif
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+namespace {
+
+struct Bucket {
+  uint64_t hash;     // 0 = empty (hashes are forced nonzero)
+  uint64_t key_off;  // offset into arena
+  uint32_t key_len;
+  int32_t slot;
+};
+
+struct Directory {
+  std::vector<Bucket> table;     // power-of-two sized
+  std::vector<char> arena;       // concatenated key bytes
+  std::vector<int32_t> free_slots;   // LIFO free-list of device slots
+  std::vector<int32_t> slot_to_bucket;  // slot id -> table index (-1 = none)
+  uint64_t mask = 0;
+  int64_t size = 0;
+  uint64_t live_bytes = 0;  // arena bytes owned by live entries
+
+  explicit Directory(int64_t n_slots) {
+    uint64_t cap = 64;
+    while (cap < static_cast<uint64_t>(n_slots) * 2) cap <<= 1;
+    table.assign(cap, Bucket{0, 0, 0, -1});
+    mask = cap - 1;
+    arena.reserve(1 << 16);
+    free_slots.reserve(n_slots);
+    // Match the Python store's allocation order (descending pop -> slot 0
+    // first) so directory behavior is bit-identical across backends.
+    for (int64_t s = n_slots - 1; s >= 0; --s)
+      free_slots.push_back(static_cast<int32_t>(s));
+    slot_to_bucket.assign(n_slots, -1);
+  }
+};
+
+inline uint64_t fnv1a(const char* data, uint32_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= 1099511628211ULL;
+  }
+  return h | 1;  // nonzero: 0 marks an empty bucket
+}
+
+// Rebuild the arena with only live keys. The arena is append-only during
+// normal operation; without this, memory would grow with total distinct
+// keys ever seen rather than live keys under key churn (the designed
+// workload: TTL sweeps evict, new keys arrive).
+void compact_arena(Directory* d) {
+  std::vector<char> fresh;
+  fresh.reserve(d->live_bytes);
+  for (Bucket& b : d->table) {
+    if (b.hash == 0) continue;
+    uint64_t off = fresh.size();
+    fresh.insert(fresh.end(), d->arena.data() + b.key_off,
+                 d->arena.data() + b.key_off + b.key_len);
+    b.key_off = off;
+  }
+  d->arena = std::move(fresh);
+}
+
+void maybe_compact(Directory* d) {
+  if (d->arena.size() > (1 << 16) &&
+      d->arena.size() > d->live_bytes * 2)
+    compact_arena(d);
+}
+
+void rehash(Directory* d) {
+  std::vector<Bucket> old = std::move(d->table);
+  d->table.assign(old.size() * 2, Bucket{0, 0, 0, -1});
+  d->mask = d->table.size() - 1;
+  for (const Bucket& b : old) {
+    if (b.hash == 0) continue;
+    uint64_t i = b.hash & d->mask;
+    while (d->table[i].hash != 0) i = (i + 1) & d->mask;
+    d->table[i] = b;
+    d->slot_to_bucket[b.slot] = static_cast<int32_t>(i);
+  }
+}
+
+// Find the table index holding `key`, or the empty index where it belongs.
+inline uint64_t probe(const Directory* d, uint64_t h, const char* key,
+                      uint32_t len) {
+  uint64_t i = h & d->mask;
+  while (true) {
+    const Bucket& b = d->table[i];
+    if (b.hash == 0) return i;
+    if (b.hash == h && b.key_len == len &&
+        std::memcmp(d->arena.data() + b.key_off, key, len) == 0)
+      return i;
+    i = (i + 1) & d->mask;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dir_new(int64_t n_slots) { return new Directory(n_slots); }
+
+void dir_free(void* h) { delete static_cast<Directory*>(h); }
+
+int64_t dir_size(void* h) { return static_cast<Directory*>(h)->size; }
+
+int64_t dir_free_count(void* h) {
+  return static_cast<int64_t>(static_cast<Directory*>(h)->free_slots.size());
+}
+
+// Resolve a batch of keys to slots, allocating from the free-list on miss.
+// keys = concatenated UTF-8 bytes; offsets[i]..offsets[i+1] bounds key i
+// (offsets has n+1 entries). out_slots[i] receives the slot, or -1 if the
+// free-list ran dry at that point (caller sweeps/grows and re-resolves the
+// tail). Returns the number of unresolved (-1) entries.
+int64_t dir_resolve_batch(void* h, const char* keys, const int64_t* offsets,
+                          int64_t n, int32_t* out_slots) {
+  Directory* d = static_cast<Directory*>(h);
+  int64_t unresolved = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    const char* key = keys + offsets[k];
+    uint32_t len = static_cast<uint32_t>(offsets[k + 1] - offsets[k]);
+    uint64_t hash = fnv1a(key, len);
+    uint64_t i = probe(d, hash, key, len);
+    if (d->table[i].hash != 0) {
+      out_slots[k] = d->table[i].slot;
+      continue;
+    }
+    if (d->free_slots.empty()) {
+      out_slots[k] = -1;
+      ++unresolved;
+      continue;
+    }
+    int32_t slot = d->free_slots.back();
+    d->free_slots.pop_back();
+    uint64_t off = d->arena.size();
+    d->arena.insert(d->arena.end(), key, key + len);
+    d->table[i] = Bucket{hash, off, len, slot};
+    d->slot_to_bucket[slot] = static_cast<int32_t>(i);
+    out_slots[k] = slot;
+    d->live_bytes += len;
+    ++d->size;
+    if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) {
+      rehash(d);
+    }
+  }
+  return unresolved;
+}
+
+// Lookup without allocation; returns slot or -1.
+int32_t dir_lookup(void* h, const char* key, int64_t len) {
+  Directory* d = static_cast<Directory*>(h);
+  uint64_t hash = fnv1a(key, static_cast<uint32_t>(len));
+  uint64_t i = probe(d, hash, key, static_cast<uint32_t>(len));
+  return d->table[i].hash == 0 ? -1 : d->table[i].slot;
+}
+
+// Evict entries by device slot id (TTL sweep): for each dead slot, remove
+// its key (if mapped) and return the slot to the free-list. Tombstone-free
+// deletion via backward-shift, keeping probe chains intact. Returns the
+// number of entries actually removed.
+int64_t dir_remove_slots(void* h, const int32_t* dead, int64_t n_dead) {
+  Directory* d = static_cast<Directory*>(h);
+  int64_t removed = 0;
+  for (int64_t k = 0; k < n_dead; ++k) {
+    int32_t slot = dead[k];
+    if (slot < 0 ||
+        static_cast<size_t>(slot) >= d->slot_to_bucket.size())
+      continue;
+    int32_t ti = d->slot_to_bucket[slot];
+    if (ti < 0) continue;  // unmapped: skip — freeing it could double-free
+    // Backward-shift deletion starting at ti.
+    uint64_t i = static_cast<uint64_t>(ti);
+    d->live_bytes -= d->table[i].key_len;
+    d->slot_to_bucket[slot] = -1;
+    d->free_slots.push_back(slot);
+    --d->size;
+    ++removed;
+    uint64_t j = i;
+    while (true) {
+      j = (j + 1) & d->mask;
+      Bucket& bj = d->table[j];
+      if (bj.hash == 0) break;
+      uint64_t home = bj.hash & d->mask;
+      // Can bj move into the hole at i? Yes iff i is cyclically between
+      // home and j.
+      bool movable = (i <= j) ? (home <= i || home > j)
+                              : (home <= i && home > j);
+      if (movable) {
+        d->table[i] = bj;
+        d->slot_to_bucket[bj.slot] = static_cast<int32_t>(i);
+        i = j;
+      }
+    }
+    d->table[i] = Bucket{0, 0, 0, -1};
+  }
+  maybe_compact(d);
+  return removed;
+}
+
+// Extend slot capacity after a table grow: slots [start, end) join the
+// free-list in descending order (matching the Python store).
+void dir_add_slots(void* h, int32_t start, int32_t end) {
+  Directory* d = static_cast<Directory*>(h);
+  d->slot_to_bucket.resize(end, -1);
+  for (int32_t s = end - 1; s >= start; --s) d->free_slots.push_back(s);
+}
+
+// Restore support: bind `key` to a specific `slot` (checkpoint restore
+// rebuilds the directory from a saved mapping; the caller re-seeds the
+// free-list by NOT calling this for free slots — see dir_set_free below).
+// Returns 0 on success, -1 if the key already exists with another slot.
+int32_t dir_insert(void* h, const char* key, int64_t len, int32_t slot) {
+  Directory* d = static_cast<Directory*>(h);
+  uint64_t hash = fnv1a(key, static_cast<uint32_t>(len));
+  uint64_t i = probe(d, hash, key, static_cast<uint32_t>(len));
+  if (d->table[i].hash != 0) return d->table[i].slot == slot ? 0 : -1;
+  uint64_t off = d->arena.size();
+  d->arena.insert(d->arena.end(), key, key + len);
+  d->table[i] = Bucket{hash, off, static_cast<uint32_t>(len), slot};
+  if (static_cast<size_t>(slot) >= d->slot_to_bucket.size())
+    d->slot_to_bucket.resize(slot + 1, -1);
+  d->slot_to_bucket[slot] = static_cast<int32_t>(i);
+  d->live_bytes += static_cast<uint64_t>(len);
+  ++d->size;
+  if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) rehash(d);
+  return 0;
+}
+
+// Replace the free-list wholesale (restore path). Slots are pushed in the
+// given order; the LAST entry pops first.
+void dir_set_free(void* h, const int32_t* slots, int64_t n) {
+  Directory* d = static_cast<Directory*>(h);
+  d->free_slots.assign(slots, slots + n);
+}
+
+// Snapshot support: dump all (key, slot) pairs. Caller passes buffers
+// sized from dir_size()/dir_arena_size(); layout mirrors resolve input
+// (concatenated keys + n+1 offsets + slots). Returns the entry count.
+int64_t dir_arena_bytes(void* h) {
+  Directory* d = static_cast<Directory*>(h);
+  int64_t total = 0;
+  for (const Bucket& b : d->table)
+    if (b.hash != 0) total += b.key_len;
+  return total;
+}
+
+int64_t dir_dump(void* h, char* keys_out, int64_t* offsets_out,
+                 int32_t* slots_out) {
+  Directory* d = static_cast<Directory*>(h);
+  int64_t n = 0, off = 0;
+  for (const Bucket& b : d->table) {
+    if (b.hash == 0) continue;
+    std::memcpy(keys_out + off, d->arena.data() + b.key_off, b.key_len);
+    offsets_out[n] = off;
+    slots_out[n] = b.slot;
+    off += b.key_len;
+    ++n;
+  }
+  offsets_out[n] = off;
+  return n;
+}
+
+#ifdef DRL_WITH_PYTHON
+// Zero-copy batch resolve over a Python list[str]: reads each key's
+// cached UTF-8 via PyUnicode_AsUTF8AndSize — no encode, no concat, no
+// offset array. Must be called with the GIL held (load via ctypes.PyDLL).
+// Returns unresolved count, or -1 on a non-str element (with a Python
+// error set? no — ctypes PyDLL propagates it poorly; we just return -1
+// and let the caller fall back).
+int64_t dir_resolve_pylist(void* h, PyObject* keys, int32_t* out_slots) {
+  Directory* d = static_cast<Directory*>(h);
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  int64_t unresolved = 0;
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    PyObject* s = PyList_GET_ITEM(keys, k);
+    Py_ssize_t len;
+    const char* key = PyUnicode_AsUTF8AndSize(s, &len);
+    if (key == nullptr) {
+      PyErr_Clear();
+      return -1;
+    }
+    uint64_t hash = fnv1a(key, static_cast<uint32_t>(len));
+    uint64_t i = probe(d, hash, key, static_cast<uint32_t>(len));
+    if (d->table[i].hash != 0) {
+      out_slots[k] = d->table[i].slot;
+      continue;
+    }
+    if (d->free_slots.empty()) {
+      out_slots[k] = -1;
+      ++unresolved;
+      continue;
+    }
+    int32_t slot = d->free_slots.back();
+    d->free_slots.pop_back();
+    uint64_t off = d->arena.size();
+    d->arena.insert(d->arena.end(), key, key + len);
+    d->table[i] = Bucket{hash, off, static_cast<uint32_t>(len), slot};
+    d->slot_to_bucket[slot] = static_cast<int32_t>(i);
+    out_slots[k] = slot;
+    d->live_bytes += static_cast<uint64_t>(len);
+    ++d->size;
+    if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) rehash(d);
+  }
+  return unresolved;
+}
+#endif  // DRL_WITH_PYTHON
+
+}  // extern "C"

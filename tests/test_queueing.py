@@ -227,3 +227,50 @@ def test_drain_async_cancelled_drain_restores_waiter():
         assert w1.result() is LEASE_FAIL
 
     run(main())
+
+
+def test_drain_async_fail_all_during_inflight_grant_settles_waiter():
+    # Regression: dispose (fail_all) racing an in-flight store grant must
+    # settle the checked-out waiter on return — never re-park it in a
+    # disposed queue where it would hang forever.
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        w1, _ = q.try_enqueue(3)
+        gate = asyncio.Event()
+
+        async def slow_grant(count):
+            await gate.wait()
+            return False  # store declined
+
+        drain = asyncio.ensure_future(q.drain_async(slow_grant, lambda: LEASE_OK))
+        await asyncio.sleep(0)
+        q.fail_all(lambda: LEASE_FAIL)  # dispose while round-trip in flight
+        gate.set()
+        await drain
+        assert w1.result() is LEASE_FAIL
+        assert q.queue_count == 0
+
+    run(main())
+
+
+def test_drain_async_fail_all_during_inflight_grant_honors_grant():
+    # Same race, but the store GRANTED before disposal: the waiter gets the
+    # successful lease (tokens were consumed on its behalf).
+    async def main():
+        q = WaiterQueue(10, QueueProcessingOrder.OLDEST_FIRST)
+        w1, _ = q.try_enqueue(3)
+        gate = asyncio.Event()
+
+        async def slow_grant(count):
+            await gate.wait()
+            return True
+
+        drain = asyncio.ensure_future(q.drain_async(slow_grant, lambda: LEASE_OK))
+        await asyncio.sleep(0)
+        q.fail_all(lambda: LEASE_FAIL)
+        gate.set()
+        await drain
+        assert w1.result() is LEASE_OK
+        assert q.queue_count == 0
+
+    run(main())

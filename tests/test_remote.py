@@ -258,3 +258,19 @@ class TestDistributedLimiters:
                         await s.aclose()
 
         run(main())
+
+
+def test_post_close_use_fails_fast_without_thread_leak():
+    import threading
+
+    async def main():
+        async with BucketStoreServer(InProcessBucketStore()) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+            await store.aclose()
+            before = threading.active_count()
+            with pytest.raises(ConnectionError):
+                await store.acquire("k", 1, 5.0, 1.0)
+            assert threading.active_count() == before  # no resurrected loop
+
+    run(main())

@@ -85,7 +85,7 @@ class TestSlotLifecycle:
         run(main())
         table = next(iter(dev._tables.values()))
         assert table.n_slots >= 10
-        assert len(table.directory) == 10
+        assert len(table.dir) == 10
 
     def test_sweep_reclaims_idle_slots(self, clock):
         dev = device_store(clock, n_slots=4)
@@ -101,7 +101,7 @@ class TestSlotLifecycle:
         run(main())
         table = next(iter(dev._tables.values()))
         assert table.n_slots == 4  # no growth: sweep reclaimed
-        assert "fresh" in table.directory
+        assert table.dir.lookup("fresh") is not None
 
     def test_distinct_configs_get_distinct_tables(self, clock):
         dev = device_store(clock)
@@ -258,8 +258,8 @@ class TestSweepPinning:
             )
             assert all(r.granted for r in res)
             table = next(iter(dev._tables.values()))
-            assert table.directory.get("a") is not None
-            assert table.directory["a"] != table.directory["c"]
+            assert table.dir.lookup("a") is not None
+            assert table.dir.lookup("a") != table.dir.lookup("c")
             # And "a" was actually drained — no cross-contamination (same
             # tick, so no refill yet).
             assert not dev.acquire_blocking("a", 1, 10.0, 10.0).granted
