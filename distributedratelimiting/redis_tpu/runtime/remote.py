@@ -38,6 +38,7 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     SyncResult,
 )
 from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils.tracing import Profiler, ProfilingSession
 
 __all__ = ["RemoteBucketStore"]
 
@@ -65,6 +66,7 @@ class RemoteBucketStore(BucketStore):
         url: str | None = None,
         request_timeout_s: float = 30.0,
         clock: Clock | None = None,
+        profiling_session: Callable[[], ProfilingSession | None] | None = None,
     ) -> None:
         if connection_factory is None and address is None and url is None:
             # ≙ the reference's ctor validation "some Redis config present"
@@ -81,6 +83,10 @@ class RemoteBucketStore(BucketStore):
         # The client clock exists only to satisfy the BucketStore interface
         # (e.g. local diagnostics); the SERVER is the time authority.
         self.clock = clock or MonotonicClock()
+        # ≙ Func<ProfilingSession> on the connection (TryRegisterProfiler,
+        # RedisTokenBucketRateLimiter.cs:166-174): here each profiled
+        # command is one wire round-trip to the store server.
+        self.profiler = Profiler(profiling_session)
 
         self._io_loop: asyncio.AbstractEventLoop | None = None
         self._io_thread: threading.Thread | None = None
@@ -189,6 +195,14 @@ class RemoteBucketStore(BucketStore):
     # -- request path (on the I/O loop) -------------------------------------
     async def _request_io(self, op: int, key: str, count: int,
                           a: float, b: float) -> tuple:
+        # rows=1: one wire command = one request (the permit count is the
+        # command's argument, not its row count — keep units consistent
+        # with the device store's per-batch rows).
+        with self.profiler.span(wire.op_name(op), 1, annotate=False):
+            return await self._request_io_unprofiled(op, key, count, a, b)
+
+    async def _request_io_unprofiled(self, op: int, key: str, count: int,
+                                     a: float, b: float) -> tuple:
         await self._connect_io()
         if self._writer is None or self._io_loop is None:
             raise ConnectionError("store client is closed")

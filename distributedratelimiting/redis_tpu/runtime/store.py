@@ -29,7 +29,7 @@ from __future__ import annotations
 import abc
 import asyncio
 import threading
-from typing import Iterable, NamedTuple, Sequence
+from typing import Callable, Iterable, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,7 @@ from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
 from distributedratelimiting.redis_tpu.runtime.directory import make_directory
 from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
+from distributedratelimiting.redis_tpu.utils.tracing import Profiler, ProfilingSession
 
 __all__ = [
     "AcquireResult",
@@ -268,6 +269,10 @@ class _DeviceTable(_PackedLaunchMixin):
         exempt — a sweep triggered mid-batch must not free-and-reallocate a
         slot an earlier request in the same batch is about to touch, which
         would cross-contaminate two keys' buckets."""
+        with self.store.profiler.span("sweep", self.n_slots):
+            self._sweep_locked(pinned)
+
+    def _sweep_locked(self, pinned: set[int] | None = None) -> None:
         now = self.store.clock.now_ticks()
         freed_np = None
         if self.store.use_pallas_sweep:
@@ -334,7 +339,8 @@ class _DeviceTable(_PackedLaunchMixin):
         threads while the event loop flushes batches, and two concurrent
         donating kernel calls on the same buffers would race (one side
         would operate on a deleted/donated array)."""
-        with self.store._lock:
+        with self.store.profiler.span("acquire_batch", len(reqs)), \
+                self.store._lock:
             slots = self.resolve_slots([r.key for r in reqs])
             # Fixed pad width ⇒ exactly ONE compiled kernel per table (the
             # extra rows are masked padding and cost ~nothing next to launch
@@ -390,6 +396,10 @@ class _DeviceWindowTable(_PackedLaunchMixin):
         return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
+        with self.store.profiler.span("sweep_windows", self.n_slots):
+            self._sweep_locked(pinned)
+
+    def _sweep_locked(self, pinned: set[int] | None = None) -> None:
         now = self.store.clock.now_ticks()
         self.state, freed = K.sweep_windows(
             self.state, jnp.int32(now), jnp.int32(self.window_ticks)
@@ -420,7 +430,9 @@ class _DeviceWindowTable(_PackedLaunchMixin):
         self.n_slots = old_n * 2
 
     def _launch(self, reqs: Sequence[_AcquireReq]):
-        with self.store._lock:  # same dispatch discipline as _DeviceTable
+        # Same dispatch discipline as _DeviceTable.
+        with self.store.profiler.span("window_acquire_batch", len(reqs)), \
+                self.store._lock:
             slots = self.resolve_slots([r.key for r in reqs])
             b = self.store.max_batch  # fixed pad ⇒ one compiled kernel
             packed = _build_packed(reqs, slots, b,
@@ -446,8 +458,13 @@ class DeviceBucketStore(BucketStore):
         max_delay_s: float = 200e-6,
         max_inflight: int = 8,
         use_pallas_sweep: bool | None = None,
+        profiling_session: Callable[[], ProfilingSession | None] | None = None,
     ) -> None:
         self.clock = clock or MonotonicClock()
+        # ≙ Func<ProfilingSession> registered with the connection on connect
+        # (TryRegisterProfiler, RedisTokenBucketRateLimiter.cs:166-174);
+        # here the "commands" profiled are kernel dispatches.
+        self.profiler = Profiler(profiling_session)
         if use_pallas_sweep is None:
             use_pallas_sweep = jax.devices()[0].platform == "tpu"
         self.use_pallas_sweep = use_pallas_sweep
@@ -544,6 +561,11 @@ class DeviceBucketStore(BucketStore):
             )[0])
 
     def _sweep_counters(self) -> None:
+        with self.profiler.span("sweep_counters",
+                                self._counters.value.shape[0]):
+            self._sweep_counters_locked()
+
+    def _sweep_counters_locked(self) -> None:
         self._counters, freed = K.sweep_counters(
             self._counters, jnp.int32(self.clock.now_ticks())
         )
@@ -566,7 +588,7 @@ class DeviceBucketStore(BucketStore):
     def _sync_dispatch(self, key: str, local_count: float,
                        decay_rate_per_sec: float):
         slot = self._counter_slot(key)
-        with self._lock:
+        with self.profiler.span("sync_counter"), self._lock:
             b = _pad_size(1, floor=8)
             packed = np.full((3, b), -1, np.int32)
             packed[1] = 0

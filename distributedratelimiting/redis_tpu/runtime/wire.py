@@ -36,7 +36,7 @@ import struct
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_ERROR",
-    "MAX_FRAME", "RemoteStoreError",
+    "MAX_FRAME", "RemoteStoreError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
     "read_frame", "write_frame",
 ]
@@ -46,6 +46,20 @@ OP_PEEK = 2
 OP_SYNC = 3
 OP_WINDOW = 4
 OP_PING = 5
+
+_OP_NAMES = {
+    OP_ACQUIRE: "acquire",
+    OP_PEEK: "peek",
+    OP_SYNC: "sync_counter",
+    OP_WINDOW: "window_acquire",
+    OP_PING: "ping",
+}
+
+
+def op_name(op: int) -> str:
+    """Human-readable op name (used by the wire-level profiler)."""
+    return _OP_NAMES.get(op, f"op{op}")
+
 
 RESP_DECISION = 64
 RESP_VALUE = 65

@@ -1,0 +1,153 @@
+"""Tracing/profiling — the reference's ``ProfilingSession`` seam, TPU-style.
+
+The reference delegates tracing to StackExchange.Redis: each options class
+exposes ``Func<ProfilingSession>? ProfilingSession``
+(``TokenBucket/RedisTokenBucketRateLimiterOptions.cs:70``) and the limiter
+registers it on connect (``TryRegisterProfiler``,
+``TokenBucket/RedisTokenBucketRateLimiter.cs:166-174``), after which the
+client library captures per-command timings attributed to whichever session
+the factory returns at call time.
+
+Here the "commands" are kernel launches, so the equivalent is:
+
+- :class:`ProfilingSession` — collects :class:`ProfiledCommand` records
+  (command name, start, duration, batch rows), thread-safe because launches
+  may be dispatched from the event loop and from blocking callers at once.
+- :class:`Profiler` — holds the ``session_factory`` (≙ the
+  ``Func<ProfilingSession>``; invoked per command so callers can route
+  commands to per-request/ambient sessions exactly as the StackExchange
+  profiler does) and wraps every store dispatch in :meth:`Profiler.span`.
+  Each span also enters ``jax.profiler.TraceAnnotation``, so host-side
+  spans line up with device activity in Perfetto/XProf traces captured via
+  :func:`start_device_trace`.
+
+The default (no factory) path is allocation-free: ``span`` returns a shared
+no-op context manager, so serving-path cost is one ``if``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Iterator, NamedTuple
+
+__all__ = [
+    "ProfiledCommand",
+    "ProfilingSession",
+    "Profiler",
+    "start_device_trace",
+    "stop_device_trace",
+]
+
+
+class ProfiledCommand(NamedTuple):
+    """One store dispatch (≙ StackExchange.Redis's ``IProfiledCommand``)."""
+
+    command: str       # e.g. "acquire_batch", "sync_counter", "sweep"
+    start_s: float     # time.perf_counter() at dispatch
+    duration_s: float  # host wall time of the dispatch (enqueue, not device)
+    rows: int          # valid rows in the batch (1 for scalar commands)
+
+
+class ProfilingSession:
+    """Accumulates profiled commands. Thread-safe; drain with
+    :meth:`finish` (≙ ``ProfilingSession.FinishProfiling()``)."""
+
+    def __init__(self) -> None:
+        self._commands: list[ProfiledCommand] = []
+        self._lock = threading.Lock()
+
+    def record(self, cmd: ProfiledCommand) -> None:
+        with self._lock:
+            self._commands.append(cmd)
+
+    @property
+    def commands(self) -> list[ProfiledCommand]:
+        with self._lock:
+            return list(self._commands)
+
+    def finish(self) -> list[ProfiledCommand]:
+        """Return all captured commands and reset the session."""
+        with self._lock:
+            out = self._commands
+            self._commands = []
+            return out
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Profiler:
+    """Per-store profiler facade. ``session_factory`` may return ``None``
+    to skip recording a given command (the StackExchange contract)."""
+
+    __slots__ = ("session_factory",)
+
+    def __init__(
+        self,
+        session_factory: Callable[[], ProfilingSession | None] | None = None,
+    ) -> None:
+        self.session_factory = session_factory
+
+    @property
+    def enabled(self) -> bool:
+        return self.session_factory is not None
+
+    def span(self, command: str, rows: int = 1, *, annotate: bool = True):
+        """Context manager timing one dispatch. No-op (shared, allocation
+        free) unless a session factory is registered.
+
+        ``annotate=False`` skips the ``jax.profiler.TraceAnnotation``: trace
+        annotations must nest strictly per thread, so spans that wrap
+        ``await``s which interleave on one event loop (the remote client's
+        wire round-trips) record timings only."""
+        if self.session_factory is None:
+            return _NULL_SPAN
+        return self._timed_span(command, rows, annotate)
+
+    @contextmanager
+    def _timed_span(self, command: str, rows: int,
+                    annotate: bool) -> Iterator[None]:
+        session = self.session_factory() if self.session_factory else None
+        start = time.perf_counter()
+        if annotate:
+            import jax
+
+            annotation = jax.profiler.TraceAnnotation(f"drl/{command}")
+            annotation.__enter__()
+        try:
+            yield
+        finally:
+            if annotate:
+                annotation.__exit__(None, None, None)
+            if session is not None:
+                session.record(ProfiledCommand(
+                    command, start, time.perf_counter() - start, rows,
+                ))
+
+
+def start_device_trace(logdir: str) -> None:
+    """Begin a device trace (XProf/Perfetto) covering subsequent kernel
+    launches; host-side :meth:`Profiler.span` annotations appear inline.
+    The TPU analogue of attaching a wire-level profiler to the Redis
+    connection."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

@@ -1,0 +1,131 @@
+"""Tracing tests — the ProfilingSession seam (SURVEY.md §5.1).
+
+The reference registers a ``Func<ProfilingSession>`` with the Redis
+connection and gets per-command timings back; here the profiled commands
+are kernel dispatches (device store) and wire round-trips (remote store).
+"""
+
+import asyncio
+
+import pytest
+
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils.tracing import (
+    ProfiledCommand,
+    Profiler,
+    ProfilingSession,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestProfiler:
+    def test_disabled_profiler_is_allocation_free(self):
+        p = Profiler(None)
+        assert not p.enabled
+        # The no-op span is a shared singleton — same object every call.
+        assert p.span("a") is p.span("b")
+        with p.span("acquire_batch", 64):
+            pass  # must be a usable context manager
+
+    def test_session_records_command_name_duration_rows(self):
+        session = ProfilingSession()
+        p = Profiler(lambda: session)
+        with p.span("acquire_batch", 17):
+            pass
+        (cmd,) = session.commands
+        assert cmd.command == "acquire_batch"
+        assert cmd.rows == 17
+        assert cmd.duration_s >= 0.0
+
+    def test_factory_may_return_none_to_skip(self):
+        # The StackExchange contract: the factory decides per command
+        # whether (and to which session) the command is attributed.
+        calls = []
+        p = Profiler(lambda: calls.append(1) and None)
+        with p.span("sync_counter"):
+            pass
+        assert calls  # factory consulted, nothing recorded, no crash
+
+    def test_session_finish_drains(self):
+        session = ProfilingSession()
+        session.record(ProfiledCommand("x", 0.0, 1e-6, 1))
+        assert len(session.finish()) == 1
+        assert session.commands == []
+
+
+class TestDeviceStoreProfiling:
+    def test_dispatches_are_profiled(self):
+        session = ProfilingSession()
+        store = DeviceBucketStore(
+            n_slots=64, counter_slots=8, clock=ManualClock(),
+            max_batch=64, profiling_session=lambda: session,
+        )
+        store.acquire_blocking("k", 1, 10.0, 1.0)
+        store.sync_counter_blocking("c", 3.0, 1.0)
+        store.window_acquire_blocking("w", 1, 10.0, 1.0)
+        names = [c.command for c in session.commands]
+        assert "acquire_batch" in names
+        assert "sync_counter" in names
+        assert "window_acquire_batch" in names
+        acq = next(c for c in session.commands if c.command == "acquire_batch")
+        assert acq.rows == 1
+        assert all(c.duration_s > 0.0 for c in session.commands)
+
+    def test_async_batch_rows_attributed(self):
+        session = ProfilingSession()
+
+        async def main():
+            store = DeviceBucketStore(
+                n_slots=64, counter_slots=8, clock=ManualClock(),
+                max_batch=64, max_delay_s=5e-3,
+                profiling_session=lambda: session,
+            )
+            await asyncio.gather(*(
+                store.acquire(f"k{i}", 1, 10.0, 1.0) for i in range(8)
+            ))
+            await store.aclose()
+
+        run(main())
+        acq = [c for c in session.commands if c.command == "acquire_batch"]
+        assert sum(c.rows for c in acq) == 8
+
+    def test_unprofiled_store_by_default(self):
+        store = DeviceBucketStore(n_slots=64, counter_slots=8,
+                                  clock=ManualClock(), max_batch=64)
+        assert not store.profiler.enabled
+        store.acquire_blocking("k", 1, 10.0, 1.0)  # hot path unchanged
+
+
+class TestRemoteStoreProfiling:
+    def test_wire_roundtrips_are_profiled(self):
+        session = ProfilingSession()
+
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port),
+                    profiling_session=lambda: session,
+                )
+                try:
+                    await store.acquire("k", 1, 5.0, 1.0)
+                    await store.sync_counter("c", 2.0, 1.0)
+                    await store.ping()
+                finally:
+                    await store.aclose()
+
+        run(main())
+        names = [c.command for c in session.commands]
+        assert names.count("acquire") == 1
+        assert "sync_counter" in names
+        assert "ping" in names
+        # Wire round-trips have real (non-zero) durations.
+        assert all(c.duration_s > 0.0 for c in session.commands)
