@@ -1,0 +1,7 @@
+"""Reproducible benchmark suite — the five BASELINE.md configurations.
+
+The reference ships no benchmarks at all (SURVEY.md §6); this suite is the
+framework's proof surface. ``python -m benchmarks.suite`` runs every config
+and prints one JSON line per config; ``--smoke`` shrinks sizes so the same
+code paths run in seconds on the CPU test mesh (tests/test_benchmarks.py).
+"""

@@ -1,0 +1,366 @@
+"""The five BASELINE benchmark configurations (BASELINE.md "configs").
+
+1. ``single_bucket_cpu``      — TestApp-style single token bucket, pure-CPU
+                                store, one op per call (the Redis-class
+                                baseline the reference's exact limiter is
+                                architecturally bound to).
+2. ``partitioned_10k_uniform``— PartitionedRateLimiter over strings, 10K
+                                keys uniform, end-to-end asyncio micro-batch
+                                path against the device store.
+3. ``approximate_1m_zipf``    — 1M keys with Zipf(1.1) hot-key skew: the
+                                device scan kernel with in-batch duplicate
+                                serialization ON (hot keys collide inside
+                                every batch), plus the approximate
+                                limiter's local hot-path decision rate (its
+                                decisions never leave the host — that IS
+                                the algorithm, SURVEY.md invariant 6).
+4. ``sliding_window_10m_bursty`` — 10M-slot sliding-window table, bursty
+                                Poisson batch occupancy, scanned dispatch.
+5. ``two_level_mesh``         — key-sharded two-level step (acquire + psum
+                                global tier) over a mesh of ALL visible
+                                devices (8 virtual CPU devices in tests,
+                                real chips under TPU).
+
+Every config prints ONE JSON line:
+``{"config": ..., "metric": ..., "value": ..., "unit": ...}`` plus
+config-specific extras. Sizes shrink under ``--smoke`` so the full suite
+exercises identical code paths in seconds (tests/test_benchmarks.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def _zipf_slots(rng, n_slots: int, shape, a: float = 1.1):
+    """Zipf(a) ranks mapped onto the slot space: rank r → slot r-1, tail
+    clipped into the table. Hot slots repeat heavily inside each batch."""
+    z = rng.zipf(a, shape)
+    return ((z - 1) % n_slots).astype("int32")
+
+
+def bench_single_bucket_cpu(smoke: bool = False) -> dict:
+    """Config 1 — the reference's deployment class: one bucket, one store
+    op per acquire, no batching (TestApp/Program.cs:8-22 semantics)."""
+    from distributedratelimiting.redis_tpu.models.options import (
+        TokenBucketOptions,
+    )
+    from distributedratelimiting.redis_tpu.models.token_bucket import (
+        TokenBucketRateLimiter,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    n = 2_000 if smoke else 200_000
+    lim = TokenBucketRateLimiter(
+        TokenBucketOptions(token_limit=1 << 30, tokens_per_period=1 << 30,
+                           instance_name="cfg1"),
+        InProcessBucketStore(),
+    )
+    for _ in range(100):  # warm dict/code paths
+        lim.acquire(1)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        lim.acquire(1)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "single_bucket_cpu",
+        "metric": "decisions_per_sec",
+        "value": round(n / dt),
+        "unit": "decisions/s",
+        "store": "in-process (Redis-class, one op per call)",
+    }
+
+
+def bench_partitioned_10k_uniform(smoke: bool = False) -> dict:
+    """Config 2 — 10K keys uniform through the full asyncio micro-batched
+    serving path (closed-loop worker pool)."""
+    from distributedratelimiting.redis_tpu.models.options import (
+        TokenBucketOptions,
+    )
+    from distributedratelimiting.redis_tpu.models.partitioned import (
+        PartitionedRateLimiter,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    n_keys = 256 if smoke else 10_000
+    workers = 256 if smoke else 8192
+    reqs_per_worker = 2 if smoke else 4
+
+    async def main():
+        store = DeviceBucketStore(
+            n_slots=1 << (10 if smoke else 15), max_batch=4096,
+            max_delay_s=300e-6, max_inflight=16,
+        )
+        lim = PartitionedRateLimiter(
+            TokenBucketOptions(token_limit=1 << 30,
+                               tokens_per_period=1 << 30,
+                               instance_name="cfg2"),
+            store,
+        )
+        lat: list[float] = []
+
+        async def worker(w):
+            for j in range(reqs_per_worker):
+                t0 = time.perf_counter()
+                await lim.acquire_async(f"user{(w * 31 + j) % n_keys}", 1)
+                lat.append(time.perf_counter() - t0)
+
+        await asyncio.gather(*(worker(w) for w in range(min(workers, 512))))
+        lat.clear()
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(w) for w in range(workers)))
+        dt = time.perf_counter() - t0
+        throughput = len(lat) / dt
+        lat.sort()
+        p99 = lat[int(len(lat) * 0.99)]
+        await store.aclose()
+        return throughput, p99
+
+    throughput, p99 = asyncio.run(main())
+    return {
+        "config": "partitioned_10k_uniform",
+        "metric": "decisions_per_sec",
+        "value": round(throughput),
+        "unit": "decisions/s",
+        "n_keys": n_keys,
+        "p99_ms": round(p99 * 1e3, 3),
+    }
+
+
+def bench_approximate_1m_zipf(smoke: bool = False) -> dict:
+    """Config 3 — Zipf(1.1) hot-key skew at 1M keys. Two measurements:
+    the device scan kernel with duplicate serialization on (hot keys
+    collide inside every batch), and the approximate limiter's local
+    decision rate (its hot path never touches the store — invariant 6)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from distributedratelimiting.redis_tpu.models.approximate import (
+        ApproximateTokenBucketRateLimiter,
+    )
+    from distributedratelimiting.redis_tpu.models.options import (
+        ApproximateTokenBucketOptions,
+    )
+    from distributedratelimiting.redis_tpu.ops import kernels as K
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    n_slots = 1 << (12 if smoke else 20)
+    batch = 512 if smoke else 8192
+    scan_k = 4 if smoke else 16
+    iters = 2 if smoke else 30
+    rng = np.random.default_rng(3)
+
+    state = K.init_bucket_state(n_slots)
+    cap = jnp.float32(1e9)
+    rate = jnp.float32(1.0)
+
+    def stage(i):
+        slots = _zipf_slots(rng, n_slots, (scan_k, batch))
+        counts = np.ones((scan_k, batch), np.int32)
+        valid = np.ones((scan_k, batch), bool)
+        nows = np.arange(scan_k, dtype=np.int32) + 1 + i * scan_k
+        return (jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(valid),
+                jnp.asarray(nows))
+
+    staged = [stage(i) for i in range(4)]
+    state, granted, _ = K.acquire_scan(state, *staged[0], cap, rate,
+                                       handle_duplicates=True)
+    jax.block_until_ready(granted)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, granted, _ = K.acquire_scan(state, *staged[i % 4], cap, rate,
+                                           handle_duplicates=True)
+    jax.block_until_ready(granted)
+    device_rate = iters * scan_k * batch / (time.perf_counter() - t0)
+
+    # Local hot path: pure in-memory decisions (the approximate design's
+    # point — zero store round-trips between syncs).
+    lim = ApproximateTokenBucketRateLimiter(
+        ApproximateTokenBucketOptions(token_limit=1 << 30,
+                                      tokens_per_period=1 << 30,
+                                      instance_name="cfg3"),
+        InProcessBucketStore(),
+    )
+    n_local = 2_000 if smoke else 300_000
+    for _ in range(100):
+        lim.acquire(1)
+    t0 = time.perf_counter()
+    for _ in range(n_local):
+        lim.acquire(1)
+    local_rate = n_local / (time.perf_counter() - t0)
+
+    return {
+        "config": "approximate_1m_zipf",
+        "metric": "device_decisions_per_sec",
+        "value": round(device_rate),
+        "unit": "decisions/s",
+        "n_keys": n_slots,
+        "zipf_a": 1.1,
+        "duplicate_serialization": True,
+        "local_hot_path_decisions_per_sec": round(local_rate),
+    }
+
+
+def bench_sliding_window_10m_bursty(smoke: bool = False) -> dict:
+    """Config 4 — sliding-window counters at 10M keys under bursty Poisson
+    arrivals: per-scanned-batch occupancy ~ Poisson alternating between a
+    high and a low rate (bursts), invalid rows masked."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from distributedratelimiting.redis_tpu.ops import kernels as K
+
+    n_slots = 4096 if smoke else 10_000_000
+    batch = 512 if smoke else 8192
+    scan_k = 4 if smoke else 16
+    iters = 2 if smoke else 30
+    rng = np.random.default_rng(4)
+
+    state = K.init_window_state(n_slots)
+    limit = jnp.float32(100.0)
+    window = jnp.int32(1024)  # 1s of ticks
+
+    def stage(i):
+        slots = rng.integers(0, n_slots, (scan_k, batch)).astype(np.int32)
+        counts = np.ones((scan_k, batch), np.int32)
+        # Bursty: batch occupancy ~ Poisson(0.9·B) in bursts, Poisson(0.2·B)
+        # between bursts — the valid mask is how arrival gaps reach the
+        # fixed-shape kernel.
+        lam = batch * (0.9 if (i % 4) < 2 else 0.2)
+        occ = np.minimum(rng.poisson(lam, scan_k), batch)
+        valid = np.arange(batch)[None, :] < occ[:, None]
+        nows = np.arange(scan_k, dtype=np.int32) * 37 + 1 + i * scan_k * 37
+        return (jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(valid),
+                jnp.asarray(nows)), int(occ.sum())
+
+    staged = [stage(i) for i in range(4)]
+    (arrays, _) = staged[0]
+    state, granted, _ = K.window_acquire_scan(state, *arrays, limit, window,
+                                              handle_duplicates=False)
+    jax.block_until_ready(granted)
+    decided = 0
+    t0 = time.perf_counter()
+    for i in range(iters):
+        arrays, occ = staged[i % 4]
+        state, granted, _ = K.window_acquire_scan(
+            state, *arrays, limit, window, handle_duplicates=False)
+        decided += occ
+    jax.block_until_ready(granted)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "sliding_window_10m_bursty",
+        "metric": "decisions_per_sec",
+        "value": round(decided / dt),
+        "unit": "decisions/s",
+        "n_keys": n_slots,
+        "arrivals": "poisson bursts (0.9B/0.2B alternating)",
+    }
+
+
+def bench_two_level_mesh(smoke: bool = False) -> dict:
+    """Config 5 — the fused two-level step (sharded acquire + psum global
+    tier) over a mesh of every visible device."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedratelimiting.redis_tpu.ops import kernels as K
+    from distributedratelimiting.redis_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        create_mesh,
+    )
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        init_global_counter,
+        make_two_level_step,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh(n_dev)
+    per_shard = 1 << (10 if smoke else 20)  # ≈ 10M total keys at 8 chips full
+    b_local = 256 if smoke else 8192
+    iters = 4 if smoke else 50
+    rng = np.random.default_rng(5)
+
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    state = K.BucketState(
+        tokens=jax.device_put(jnp.zeros((n_dev * per_shard,), jnp.float32), sharding),
+        last_ts=jax.device_put(jnp.zeros((n_dev * per_shard,), jnp.int32), sharding),
+        exists=jax.device_put(jnp.zeros((n_dev * per_shard,), bool), sharding),
+    )
+    gcounter = jax.device_put(init_global_counter(), NamedSharding(mesh, P()))
+    step = make_two_level_step(mesh, handle_duplicates=False)
+
+    def stage():
+        slots = rng.integers(0, per_shard, (n_dev, b_local)).astype(np.int32)
+        counts = np.ones((n_dev, b_local), np.int32)
+        valid = np.ones((n_dev, b_local), bool)
+        return jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(valid)
+
+    staged = [stage() for _ in range(4)]
+    cap = jnp.float32(1e9)
+    rate = jnp.float32(1.0)
+    decay = jnp.float32(1.0)
+
+    state, granted, _, gcounter = step(
+        state, *staged[0], jnp.int32(1), cap, rate, gcounter, decay)
+    jax.block_until_ready(granted)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, granted, _, gcounter = step(
+            state, *staged[i % 4], jnp.int32(i + 2), cap, rate, gcounter,
+            decay)
+    jax.block_until_ready(granted)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "two_level_mesh",
+        "metric": "aggregate_decisions_per_sec",
+        "value": round(iters * n_dev * b_local / dt),
+        "unit": "decisions/s",
+        "n_devices": n_dev,
+        "n_keys": n_dev * per_shard,
+        "global_score_after": float(np.asarray(gcounter.value)),
+    }
+
+
+CONFIGS = {
+    "single_bucket_cpu": bench_single_bucket_cpu,
+    "partitioned_10k_uniform": bench_partitioned_10k_uniform,
+    "approximate_1m_zipf": bench_approximate_1m_zipf,
+    "sliding_window_10m_bursty": bench_sliding_window_10m_bursty,
+    "two_level_mesh": bench_two_level_mesh,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("configs", nargs="*",
+                        help=f"subset of configs to run (default: all); "
+                             f"choices: {', '.join(CONFIGS)}")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes — exercise code paths, not perf")
+    args = parser.parse_args(argv)
+    unknown = [c for c in args.configs if c not in CONFIGS]
+    if unknown:
+        parser.error(f"unknown config(s): {', '.join(unknown)}")
+    names = args.configs or list(CONFIGS)
+    for name in names:
+        result = CONFIGS[name](smoke=args.smoke)
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,7 @@ __all__ = [
     "sync_batch_packed",
     "window_acquire_batch",
     "window_acquire_batch_packed",
+    "window_acquire_scan",
     "sweep_expired",
     "sweep_counters",
     "sweep_windows",
@@ -255,6 +256,28 @@ def acquire_scan(state: BucketState, slots_k, counts_k, valid_k, nows_k,
         slots, counts, valid, now = xs
         st, granted, remaining = acquire_core(
             st, slots, counts, valid, now, capacity, fill_rate_per_tick,
+            handle_duplicates=handle_duplicates,
+        )
+        return st, (granted, remaining)
+
+    state, (granted, remaining) = jax.lax.scan(
+        body, state, (slots_k, counts_k, valid_k, nows_k)
+    )
+    return state, granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def window_acquire_scan(state: WindowState, slots_k, counts_k, valid_k,
+                        nows_k, limit, window_ticks, *,
+                        handle_duplicates: bool = True):
+    """Pipelined sliding-window dispatch: K micro-batches in ONE launch via
+    ``lax.scan`` — the window analogue of :func:`acquire_scan`, with the
+    same per-batch ``now`` time-authority property."""
+
+    def body(st, xs):
+        slots, counts, valid, now = xs
+        st, granted, remaining = _window_acquire_core(
+            st, slots, counts, valid, now, limit, window_ticks,
             handle_duplicates=handle_duplicates,
         )
         return st, (granted, remaining)

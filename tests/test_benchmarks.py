@@ -1,0 +1,35 @@
+"""Smoke-run every BASELINE benchmark config on the CPU test mesh.
+
+The suite is part of the product (the reference has no benchmarks at all,
+SURVEY.md §6) — these tests keep all five configs runnable so the real
+perf runs never discover bitrot."""
+
+import json
+
+import pytest
+
+from benchmarks import suite
+
+
+@pytest.mark.parametrize("name", list(suite.CONFIGS))
+def test_config_smoke(name):
+    result = suite.CONFIGS[name](smoke=True)
+    assert result["config"] == name
+    assert result["value"] > 0
+    assert result["unit"] == "decisions/s"
+    json.dumps(result)  # must be JSON-serializable
+
+
+def test_cli_runs_named_config(capsys):
+    assert suite.main(["single_bucket_cpu", "--smoke"]) == 0
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line)["config"] == "single_bucket_cpu"
+
+
+def test_two_level_global_tier_accumulates():
+    result = suite.CONFIGS["two_level_mesh"](smoke=True)
+    # Every request grants (huge capacity), so the psum-fed global counter
+    # must have absorbed consumption from all shards of the LAST step at
+    # minimum (earlier steps decay).
+    assert result["global_score_after"] > 0
+    assert result["n_devices"] >= 1
