@@ -10,9 +10,18 @@ dispatch rate, end-to-end asyncio path, p99) without changing the schema.
 
 Method (headline): steady-state device throughput of the batched
 refill-and-decrement kernel over a 10M-slot HBM table — batches of 8K
-random keys, 16 batches pipelined per dispatch via lax.scan (each batch
+random keys, SCAN_K batches pipelined per dispatch via lax.scan (each batch
 keeps its own ``now`` operand), donated state buffers, host->device
-transfer of fresh request arrays included in the timed loop.
+transfer of fresh request arrays included in the timed loop, in-batch
+duplicate serialization ON (exact invariant-3 semantics).
+
+The pipeline is transfer-bound, not compute-bound (the kernel runs at
+~3.3B decisions/s on resident operands; transfers overlap across queued
+dispatches, with a sharp sustained-rate cliff above ~1MB per dispatch),
+so the headline path uses the 3-bytes-per-decision operand layout
+(``acquire_scan_packed24``: 24-bit packed slot ids, unit permits). The
+5-bytes-per-decision mixed-count path (``acquire_scan_compact``) is
+reported as a secondary metric.
 """
 
 from __future__ import annotations
@@ -27,15 +36,18 @@ import numpy as np
 
 N_SLOTS = 10_000_000
 BATCH = 8192
-SCAN_K = 16
-ITERS = 30            # timed dispatches of SCAN_K batches each
+SCAN_K = 32           # 768KB/dispatch packed24 — under the ~1MB sustained
+                      # transfer cliff while amortizing dispatch overhead
+                      # (measured sweep in benchmarks/RESULTS.md)
+ITERS = 100           # timed dispatches of SCAN_K batches each
+COMPACT_SCAN_K = 20   # 5B/decision path's sweet spot under the same cliff
 CAPACITY = 100.0
 RATE_PER_SEC = 50.0
 NORTH_STAR_PER_CHIP = 50e6 / 8
 
 
 def bench_kernel_throughput(jnp, K, clock):
-    """Headline: scanned multi-batch kernel path at 10M keys."""
+    """Headline: 24-bit-packed scanned kernel path at 10M keys."""
     import jax
 
     rate_per_tick = jnp.float32(RATE_PER_SEC / 1024.0)
@@ -43,21 +55,17 @@ def bench_kernel_throughput(jnp, K, clock):
     state = K.init_bucket_state(N_SLOTS)
     rng = np.random.default_rng(0)
 
-    def stage():
-        slots = rng.integers(0, N_SLOTS, (SCAN_K, BATCH)).astype(np.int32)
-        counts = np.ones((SCAN_K, BATCH), np.int32)
-        valid = np.ones((SCAN_K, BATCH), bool)
-        return slots, counts, valid
+    staged = [
+        K.pack_slots24(rng.integers(0, N_SLOTS, (SCAN_K, BATCH)))
+        for _ in range(4)
+    ]
 
-    staged = [stage() for _ in range(4)]
-
-    def dispatch(state, arrays):
-        slots, counts, valid = arrays
+    def dispatch(state, packed):
         base = clock.now_ticks()
         nows = np.arange(SCAN_K, dtype=np.int32) + base
-        return K.acquire_scan(
-            state, jnp.asarray(slots), jnp.asarray(counts),
-            jnp.asarray(valid), jnp.asarray(nows), cap, rate_per_tick,
+        return K.acquire_scan_packed24(
+            state, jnp.asarray(packed), jnp.asarray(nows), cap,
+            rate_per_tick,
         )
 
     # Warmup: compile + touch every page of the donated buffers.
@@ -71,6 +79,39 @@ def bench_kernel_throughput(jnp, K, clock):
     dt = time.perf_counter() - t0
     decisions = ITERS * SCAN_K * BATCH
     return decisions / dt, state
+
+
+def bench_compact_throughput(jnp, K, clock, state):
+    """Secondary: mixed-count 5-bytes/decision path (i32 slot + u8 count)."""
+    import jax
+
+    rate_per_tick = jnp.float32(RATE_PER_SEC / 1024.0)
+    cap = jnp.float32(CAPACITY)
+    rng = np.random.default_rng(1)
+    sk = COMPACT_SCAN_K
+    staged = [
+        (rng.integers(0, N_SLOTS, (sk, BATCH)).astype(np.int32),
+         np.ones((sk, BATCH), np.uint8))
+        for _ in range(4)
+    ]
+
+    def dispatch(state, arrays):
+        slots, counts = arrays
+        nows = np.arange(sk, dtype=np.int32) + clock.now_ticks()
+        return K.acquire_scan_compact(
+            state, jnp.asarray(slots), jnp.asarray(counts),
+            jnp.asarray(nows), cap, rate_per_tick,
+        )
+
+    state, granted, _ = dispatch(state, staged[0])
+    jax.block_until_ready(granted)
+    iters = 60
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, granted, _ = dispatch(state, staged[i % 4])
+    jax.block_until_ready(granted)
+    dt = time.perf_counter() - t0
+    return iters * sk * BATCH / dt, state
 
 
 def bench_single_batch(jnp, K, clock, state):
@@ -156,6 +197,7 @@ def main():
     clock = MonotonicClock()
 
     throughput, state = bench_kernel_throughput(jnp, K, clock)
+    compact, state = bench_compact_throughput(jnp, K, clock, state)
     single = bench_single_batch(jnp, K, clock, state)
     e2e_rate, p99 = asyncio.run(
         bench_e2e_async(store_mod, partitioned, options_mod))
@@ -169,6 +211,7 @@ def main():
         "n_keys": N_SLOTS,
         "batch": BATCH,
         "scan_depth": SCAN_K,
+        "compact_path_decisions_per_sec": round(compact),
         "single_batch_decisions_per_sec": round(single),
         "e2e_async_decisions_per_sec": round(e2e_rate),
         "e2e_p99_low_load_ms": round(p99 * 1e3, 3),

@@ -97,7 +97,12 @@ async def _drive(limiter, seconds: float,
     tasks = [asyncio.create_task(worker()) for _ in range(5)]
     if print_dumps:
         tasks.append(asyncio.create_task(dumper()))
-    await asyncio.gather(*tasks, return_exceptions=True)
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    # A crashed worker must fail the harness loudly — swallowing it would
+    # let a convergence run "pass" having served zero traffic.
+    errors = [r for r in results if isinstance(r, BaseException)]
+    if errors:
+        raise errors[0]
     return granted, denied, granted_late
 
 
@@ -188,6 +193,11 @@ def cmd_convergence(args) -> int:
         server.terminate()
         server.wait(timeout=10)
 
+    if len(reports) != args.instances:
+        raise RuntimeError(
+            f"only {len(reports)}/{args.instances} workers reported — a "
+            "worker died before printing its summary"
+        )
     total_granted = sum(r["granted"] for r in reports)
     total_late = sum(r["granted_late"] for r in reports)
     # Steady-state admission bound, checked on the second half of the run

@@ -284,3 +284,105 @@ class TestAuxSweepsAndRebase:
             jnp.int32(4 * TPS), jnp.float32(cap), jnp.float32(rate),
         )
         assert float(est[0]) == 3.0
+
+
+class TestAcquireScanCompact:
+    def test_matches_sequential_batches(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(7)
+        n, b, k = 128, 32, 4
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[0, :3] = 5  # in-batch duplicates
+        counts = rng.integers(1, 4, (k, b)).astype(np.uint8)
+        nows = np.arange(1, k + 1, dtype=np.int32) * 10
+
+        s1 = K.init_bucket_state(n)
+        s1, granted, remaining = K.acquire_scan_compact(
+            s1, jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(nows),
+            jnp.float32(6.0), jnp.float32(0.5))
+
+        s2 = K.init_bucket_state(n)
+        for i in range(k):
+            s2, g2, r2 = K.acquire_batch(
+                s2, jnp.asarray(slots[i]), jnp.asarray(counts[i], jnp.int32),
+                jnp.ones((b,), bool), jnp.int32(nows[i]), jnp.float32(6.0),
+                jnp.float32(0.5))
+            np.testing.assert_array_equal(np.asarray(granted[i]),
+                                          np.asarray(g2))
+            np.testing.assert_allclose(np.asarray(remaining[i]),
+                                       np.asarray(r2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1.tokens),
+                                   np.asarray(s2.tokens), rtol=1e-6)
+
+    def test_negative_slot_is_padding(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        s = K.init_bucket_state(16)
+        slots = np.array([[0, -1, 3]], np.int32)
+        counts = np.ones((1, 3), np.uint8)
+        s, granted, _ = K.acquire_scan_compact(
+            s, jnp.asarray(slots), jnp.asarray(counts),
+            jnp.asarray([1], np.int32), jnp.float32(5.0), jnp.float32(0.1))
+        assert list(np.asarray(granted[0])) == [True, False, True]
+
+
+class TestAcquireScanPacked24:
+    def test_matches_sequential_unit_batches(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(11)
+        n, b, k = 200, 32, 3
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[1, :4] = 9  # duplicates within one batch
+        nows = np.arange(1, k + 1, dtype=np.int32) * 7
+
+        s1 = K.init_bucket_state(n)
+        s1, granted, remaining = K.acquire_scan_packed24(
+            s1, jnp.asarray(K.pack_slots24(slots)), jnp.asarray(nows),
+            jnp.float32(3.0), jnp.float32(0.25))
+
+        s2 = K.init_bucket_state(n)
+        for i in range(k):
+            s2, g2, r2 = K.acquire_batch(
+                s2, jnp.asarray(slots[i]), jnp.ones((b,), jnp.int32),
+                jnp.ones((b,), bool), jnp.int32(nows[i]), jnp.float32(3.0),
+                jnp.float32(0.25))
+            np.testing.assert_array_equal(np.asarray(granted[i]),
+                                          np.asarray(g2))
+            np.testing.assert_allclose(np.asarray(remaining[i]),
+                                       np.asarray(r2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1.tokens),
+                                   np.asarray(s2.tokens), rtol=1e-6)
+
+    def test_sentinel_rows_are_padding(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        slots = np.array([[2, K.SLOT24_PAD, 4]], np.int32)
+        s = K.init_bucket_state(8)
+        s, granted, _ = K.acquire_scan_packed24(
+            s, jnp.asarray(K.pack_slots24(slots)),
+            jnp.asarray([1], np.int32), jnp.float32(5.0), jnp.float32(0.1))
+        assert list(np.asarray(granted[0])) == [True, False, True]
+        # Padding touched nothing: only slots 2 and 4 exist.
+        assert list(np.nonzero(np.asarray(s.exists))[0]) == [2, 4]
+
+    def test_pack_roundtrip_at_boundaries(self):
+        import numpy as np
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        vals = np.array([0, 1, 255, 256, 65535, 65536, (1 << 24) - 2,
+                         K.SLOT24_PAD], np.int32)
+        packed = K.pack_slots24(vals)
+        restored = (packed[..., 0].astype(np.int32)
+                    | (packed[..., 1].astype(np.int32) << 8)
+                    | (packed[..., 2].astype(np.int32) << 16))
+        np.testing.assert_array_equal(restored, vals)
