@@ -166,21 +166,26 @@ def bench_approximate_1m_zipf(smoke: bool = False) -> dict:
     rate = jnp.float32(1.0)
 
     def stage(i):
+        # Host-side numpy staging: the timed loop pays the host→device
+        # transfer per dispatch, as production serving does (and as
+        # bench.py's headline measures).
         slots = _zipf_slots(rng, n_slots, (scan_k, batch))
-        counts = np.ones((scan_k, batch), np.int32)
-        valid = np.ones((scan_k, batch), bool)
+        counts = np.ones((scan_k, batch), np.uint8)
         nows = np.arange(scan_k, dtype=np.int32) + 1 + i * scan_k
-        return (jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(valid),
-                jnp.asarray(nows))
+        return slots, counts, nows
+
+    def dispatch(state, arrays):
+        slots, counts, nows = arrays
+        return K.acquire_scan_compact(
+            state, jnp.asarray(slots), jnp.asarray(counts),
+            jnp.asarray(nows), cap, rate, handle_duplicates=True)
 
     staged = [stage(i) for i in range(4)]
-    state, granted, _ = K.acquire_scan(state, *staged[0], cap, rate,
-                                       handle_duplicates=True)
+    state, granted, _ = dispatch(state, staged[0])
     jax.block_until_ready(granted)
     t0 = time.perf_counter()
     for i in range(iters):
-        state, granted, _ = K.acquire_scan(state, *staged[i % 4], cap, rate,
-                                           handle_duplicates=True)
+        state, granted, _ = dispatch(state, staged[i % 4])
     jax.block_until_ready(granted)
     device_rate = iters * scan_k * batch / (time.perf_counter() - t0)
 
@@ -234,28 +239,31 @@ def bench_sliding_window_10m_bursty(smoke: bool = False) -> dict:
 
     def stage(i):
         slots = rng.integers(0, n_slots, (scan_k, batch)).astype(np.int32)
-        counts = np.ones((scan_k, batch), np.int32)
+        counts = np.ones((scan_k, batch), np.uint8)
         # Bursty: batch occupancy ~ Poisson(0.9·B) in bursts, Poisson(0.2·B)
-        # between bursts — the valid mask is how arrival gaps reach the
-        # fixed-shape kernel.
+        # between bursts — arrival gaps become padding rows (slot = -1) in
+        # the fixed-shape compact layout.
         lam = batch * (0.9 if (i % 4) < 2 else 0.2)
         occ = np.minimum(rng.poisson(lam, scan_k), batch)
-        valid = np.arange(batch)[None, :] < occ[:, None]
+        slots[np.arange(batch)[None, :] >= occ[:, None]] = -1
         nows = np.arange(scan_k, dtype=np.int32) * 37 + 1 + i * scan_k * 37
-        return (jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(valid),
-                jnp.asarray(nows)), int(occ.sum())
+        return (slots, counts, nows), int(occ.sum())
+
+    def dispatch(state, arrays):
+        slots, counts, nows = arrays  # np staged; transfer paid in-loop
+        return K.window_acquire_scan_compact(
+            state, jnp.asarray(slots), jnp.asarray(counts),
+            jnp.asarray(nows), limit, window, handle_duplicates=False)
 
     staged = [stage(i) for i in range(4)]
     (arrays, _) = staged[0]
-    state, granted, _ = K.window_acquire_scan(state, *arrays, limit, window,
-                                              handle_duplicates=False)
+    state, granted, _ = dispatch(state, arrays)
     jax.block_until_ready(granted)
     decided = 0
     t0 = time.perf_counter()
     for i in range(iters):
         arrays, occ = staged[i % 4]
-        state, granted, _ = K.window_acquire_scan(
-            state, *arrays, limit, window, handle_duplicates=False)
+        state, granted, _ = dispatch(state, arrays)
         decided += occ
     jax.block_until_ready(granted)
     dt = time.perf_counter() - t0

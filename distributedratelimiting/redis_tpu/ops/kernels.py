@@ -53,6 +53,7 @@ __all__ = [
     "window_acquire_batch",
     "window_acquire_batch_packed",
     "window_acquire_scan",
+    "window_acquire_scan_compact",
     "sweep_expired",
     "sweep_counters",
     "sweep_windows",
@@ -392,6 +393,29 @@ def window_acquire_scan(state: WindowState, slots_k, counts_k, valid_k,
 
     state, (granted, remaining) = jax.lax.scan(
         body, state, (slots_k, counts_k, valid_k, nows_k)
+    )
+    return state, granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def window_acquire_scan_compact(state: WindowState, slots_k, counts_k,
+                                nows_k, limit, window_ticks, *,
+                                handle_duplicates: bool = True):
+    """Transfer-minimal scanned sliding-window dispatch — the window
+    analogue of :func:`acquire_scan_compact`: 5 bytes/decision (i32 slot +
+    u8 count), validity implied by slot sign, per-batch ``now`` operands.
+    Same transfer-cliff rationale (see benchmarks/RESULTS.md)."""
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, remaining = _window_acquire_core(
+            st, slots, counts.astype(jnp.int32), slots >= 0, now, limit,
+            window_ticks, handle_duplicates=handle_duplicates,
+        )
+        return st, (granted, remaining)
+
+    state, (granted, remaining) = jax.lax.scan(
+        body, state, (slots_k, counts_k, nows_k)
     )
     return state, granted, remaining
 

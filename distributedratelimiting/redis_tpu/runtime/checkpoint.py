@@ -1,0 +1,66 @@
+"""Planned-restart checkpoints on disk (SURVEY.md §5.4).
+
+The reference needs no checkpointing — all durable state lives in Redis
+and clients are stateless. Here the store's HBM arrays ARE the store, so
+planned restarts snapshot ``(keys, tokens, ts)`` to a file and restore
+re-aligns every timestamp to the new process's clock epoch
+(``BucketStore.snapshot``/``restore`` do the pulling and re-alignment;
+this module only adds the durable file form). Crash recovery deliberately
+stays init-on-miss — wiped state self-heals to "full bucket", exactly the
+reference's failover posture (``RedisTokenBucketRateLimiter.cs:210-215``).
+
+Format: one pickle (protocol 5 — numpy arrays serialize as raw buffers),
+written atomically via temp-file + rename so a crash mid-write leaves the
+previous checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+
+__all__ = ["save_snapshot", "load_snapshot"]
+
+_MAGIC = "drl-tpu-snapshot"
+_VERSION = 1
+
+
+def save_snapshot(store, path: str) -> None:
+    """Pull ``store``'s live state to host and write it to ``path``
+    atomically."""
+    payload = {
+        "magic": _MAGIC,
+        "version": _VERSION,
+        "snapshot": store.snapshot(),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".snapshot-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f, protocol=5)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_snapshot(store, path: str) -> None:
+    """Restore ``store`` from a checkpoint file written by
+    :func:`save_snapshot`. Timestamps re-align to this process's clock
+    epoch inside ``store.restore``. Only load files you wrote — the format
+    is pickle (trusted-operator checkpoint, not an interchange format)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("magic") != _MAGIC:
+        raise ValueError(f"{path} is not a rate-limiter snapshot")
+    if payload.get("version") != _VERSION:
+        raise ValueError(
+            f"snapshot version {payload.get('version')} != {_VERSION}"
+        )
+    store.restore(payload["snapshot"])

@@ -1,0 +1,94 @@
+"""Disk checkpoint tests (SURVEY.md §5.4 — planned-restart snapshots)."""
+
+import os
+
+import pytest
+
+from distributedratelimiting.redis_tpu.runtime.checkpoint import (
+    load_snapshot,
+    save_snapshot,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+
+
+def _store(clock):
+    return DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                             max_batch=64)
+
+
+def test_file_roundtrip_preserves_decisions(tmp_path):
+    clock = ManualClock()
+    dev = _store(clock)
+    dev.acquire_blocking("a", 3, 10.0, 1.0)
+    dev.acquire_blocking("b", 9, 10.0, 1.0)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(dev, path)
+
+    dev2 = _store(clock)
+    load_snapshot(dev2, path)
+    assert dev2.acquire_blocking("a", 7, 10.0, 1.0).granted
+    assert not dev2.acquire_blocking("b", 7, 10.0, 1.0).granted
+
+
+def test_restore_into_fresh_clock_epoch_keeps_refilling(tmp_path):
+    old_clock = ManualClock(start_ticks=500_000)
+    dev = _store(old_clock)
+    dev.acquire_blocking("k", 10, 10.0, 1.0)  # drain the bucket
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(dev, path)
+
+    # "New process": clock starts near zero.
+    new_clock = ManualClock(start_ticks=100)
+    dev2 = _store(new_clock)
+    load_snapshot(dev2, path)
+    assert not dev2.acquire_blocking("k", 5, 10.0, 1.0).granted
+    new_clock.advance_seconds(5.0)
+    assert dev2.acquire_blocking("k", 5, 10.0, 1.0).granted
+
+
+def test_atomic_write_leaves_previous_checkpoint_on_failure(tmp_path):
+    clock = ManualClock()
+    dev = _store(clock)
+    dev.acquire_blocking("a", 1, 10.0, 1.0)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(dev, path)
+    before = open(path, "rb").read()
+
+    class UnpicklableSnapshot:
+        # Failure must strike MID-WRITE (inside pickle.dump, after the
+        # temp file exists) to exercise the cleanup branch.
+        def snapshot(self):
+            return {"bad": lambda: None}
+
+    with pytest.raises(Exception):
+        save_snapshot(UnpicklableSnapshot(), path)
+    assert open(path, "rb").read() == before
+    # No temp litter left behind.
+    assert [p for p in os.listdir(tmp_path)
+            if p.startswith(".snapshot-")] == []
+
+
+def test_rejects_foreign_files(tmp_path):
+    path = str(tmp_path / "junk.bin")
+    import pickle
+
+    with open(path, "wb") as f:
+        pickle.dump({"magic": "other"}, f)
+    with pytest.raises(ValueError, match="not a rate-limiter snapshot"):
+        load_snapshot(InProcessBucketStore(), path)
+
+
+def test_works_for_inprocess_store(tmp_path):
+    clock = ManualClock()
+    s = InProcessBucketStore(clock=clock)
+    s.acquire_blocking("x", 4, 10.0, 1.0)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(s, path)
+    s2 = InProcessBucketStore(clock=clock)
+    load_snapshot(s2, path)
+    assert s2.acquire_blocking("x", 6, 10.0, 1.0).granted
+    assert not s2.acquire_blocking("x", 1, 10.0, 1.0).granted

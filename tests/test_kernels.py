@@ -386,3 +386,33 @@ class TestAcquireScanPacked24:
                     | (packed[..., 1].astype(np.int32) << 8)
                     | (packed[..., 2].astype(np.int32) << 16))
         np.testing.assert_array_equal(restored, vals)
+
+
+class TestWindowAcquireScanCompact:
+    def test_matches_sequential_window_batches(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(13)
+        n, b, k = 64, 16, 3
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[0, 5:] = -1  # bursty: padding tail rows
+        counts = rng.integers(1, 3, (k, b)).astype(np.uint8)
+        nows = np.array([10, 40, 90], np.int32)
+
+        s1 = K.init_window_state(n)
+        s1, granted, _ = K.window_acquire_scan_compact(
+            s1, jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(nows),
+            jnp.float32(4.0), jnp.int32(32))
+
+        s2 = K.init_window_state(n)
+        for i in range(k):
+            s2, g2, _ = K.window_acquire_batch(
+                s2, jnp.asarray(slots[i]), jnp.asarray(counts[i], jnp.int32),
+                jnp.asarray(slots[i] >= 0), jnp.int32(nows[i]),
+                jnp.float32(4.0), jnp.int32(32))
+            np.testing.assert_array_equal(np.asarray(granted[i]),
+                                          np.asarray(g2))
+        np.testing.assert_allclose(np.asarray(s1.curr_count),
+                                   np.asarray(s2.curr_count), rtol=1e-6)
