@@ -37,6 +37,7 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     _pad_size,
     _REBASE_MARGIN_TICKS,
     _REBASE_THRESHOLD_TICKS,
+    _shift_ts,
 )
 from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
 
@@ -44,6 +45,7 @@ __all__ = [
     "GlobalCounter",
     "make_sharded_acquire_step",
     "make_two_level_step",
+    "make_two_level_scan_step",
     "ShardedDeviceStore",
     "shard_of_key",
 ]
@@ -137,6 +139,62 @@ def make_two_level_step(mesh, *, handle_duplicates: bool = True):
             exists=jnp.asarray(True),
         )
         return new_state, granted[None], remaining[None], new_g
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
+                  P(), P(), P(), gspecs, P()),
+        out_specs=(state_specs, batch_spec, batch_spec, gspecs),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 7))
+
+
+def make_two_level_scan_step(mesh, *, handle_duplicates: bool = True):
+    """Scanned variant of :func:`make_two_level_step`: K micro-batches per
+    launch (``lax.scan`` inside each shard's block), one psum + global-
+    counter decay per scanned batch. Amortizes per-dispatch host overhead
+    the same way :func:`~.ops.kernels.acquire_scan_compact` does on one
+    chip — the sharded path is dispatch-bound at small per-step work, so
+    scanning multiplies multi-chip throughput without touching semantics
+    (each batch keeps its own ``now``; the global counter sees batches in
+    order).
+
+    Batch layout: ``slots_k/counts_k/valid_k: [n_shards, K, B_local]``
+    (sharded on axis 0), ``nows_k: i32[K]`` replicated. Returns
+    ``(new_state, granted [n_shards, K, B], remaining likewise,
+    new_gcounter, )``.
+    """
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    gspecs = GlobalCounter(P(), P(), P(), P())
+    batch_spec = P(SHARD_AXIS, None, None)
+
+    def block(state, slots, counts, valid, nows, capacity, rate,
+              gcounter, decay_rate):
+        def body(carry, xs):
+            st, g = carry
+            sl, ct, va, now = xs
+            st, granted, remaining = K.acquire_core(
+                st, sl, ct, va, now, capacity, rate,
+                handle_duplicates=handle_duplicates,
+            )
+            consumed = jnp.sum(jnp.asarray(ct, jnp.float32) * granted)
+            total = jax.lax.psum(consumed, SHARD_AXIS)
+            decayed, new_period = bm.decay_core(
+                g.value, g.period, g.last_ts, g.exists, now, decay_rate,
+            )
+            g = GlobalCounter(
+                value=decayed + total, period=new_period,
+                last_ts=jnp.asarray(now, jnp.int32),
+                exists=jnp.asarray(True),
+            )
+            return (st, g), (granted, remaining)
+
+        # Blocks see [1, K, B] slices; scan over K.
+        (state, gcounter), (granted, remaining) = jax.lax.scan(
+            body, (state, gcounter),
+            (slots[0], counts[0], valid[0], nows),
+        )
+        return state, granted[None], remaining[None], gcounter
 
     mapped = shard_map(
         block, mesh=mesh,
@@ -296,6 +354,71 @@ class ShardedDeviceStore:
     @property
     def global_score(self) -> float:
         return float(np.asarray(self.gcounter.value))
+
+    # -- checkpoint (SURVEY.md §5.4, parity with DeviceBucketStore) --------
+    def snapshot(self) -> dict:
+        """Pull the sharded state to host for a planned-restart checkpoint.
+        Restorable into a store with the same mesh size and per-shard
+        capacity; timestamps re-align via the captured ``now_ticks``."""
+        with self._lock:
+            return {
+                "now_ticks": self.clock.now_ticks(),
+                "n_shards": self.n_shards,
+                "per_shard": self.per_shard,
+                "capacity": self.capacity,
+                "fill_rate_per_sec": self.fill_rate_per_sec,
+                "directory": dict(self.directory),
+                "free": [list(f) for f in self.free],
+                "tokens": np.asarray(self.state.tokens),
+                "last_ts": np.asarray(self.state.last_ts),
+                "exists": np.asarray(self.state.exists),
+                "gcounter": {
+                    "value": np.asarray(self.gcounter.value),
+                    "period": np.asarray(self.gcounter.period),
+                    "last_ts": np.asarray(self.gcounter.last_ts),
+                    "exists": np.asarray(self.gcounter.exists),
+                },
+            }
+
+    def restore(self, snap: dict) -> None:
+        with self._lock:
+            if (snap["n_shards"] != self.n_shards
+                    or snap["per_shard"] != self.per_shard):
+                raise ValueError(
+                    f"snapshot geometry {snap['n_shards']}x{snap['per_shard']}"
+                    f" != store geometry {self.n_shards}x{self.per_shard}"
+                )
+            if (snap["capacity"] != self.capacity
+                    or snap["fill_rate_per_sec"] != self.fill_rate_per_sec):
+                # Token balances are only meaningful under the config they
+                # accrued under (the single-chip store gets this for free —
+                # its tables are keyed by (cap, rate)).
+                raise ValueError(
+                    f"snapshot config (cap={snap['capacity']}, "
+                    f"rate={snap['fill_rate_per_sec']}) != store config "
+                    f"(cap={self.capacity}, rate={self.fill_rate_per_sec})"
+                )
+            shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
+            sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+            self.state = K.BucketState(
+                tokens=jax.device_put(jnp.asarray(snap["tokens"]), sharding),
+                last_ts=jax.device_put(
+                    jnp.asarray(_shift_ts(snap["last_ts"], shift)), sharding),
+                exists=jax.device_put(jnp.asarray(snap["exists"]), sharding),
+            )
+            g = snap["gcounter"]
+            g_ts = int(_shift_ts(g["last_ts"], shift))
+            self.gcounter = jax.device_put(
+                GlobalCounter(
+                    value=jnp.asarray(g["value"], jnp.float32),
+                    period=jnp.asarray(g["period"], jnp.float32),
+                    last_ts=jnp.int32(g_ts),
+                    exists=jnp.asarray(bool(g["exists"])),
+                ),
+                NamedSharding(self.mesh, P()),
+            )
+            self.directory = dict(snap["directory"])
+            self.free = [list(f) for f in snap["free"]]
 
     def sweep(self) -> int:
         """TTL eviction across all shards (elementwise → partitioned by XLA

@@ -61,6 +61,14 @@ _REBASE_THRESHOLD_TICKS = 2**30
 _REBASE_MARGIN_TICKS = 2**29
 
 
+def _shift_ts(ts, shift: int):
+    """Re-align stored tick timestamps to a new clock epoch: widen to
+    int64, shift, and saturate back into int32 range (shared by every
+    snapshot-restore path — single-chip and sharded)."""
+    shifted = np.asarray(ts).astype(np.int64) + shift
+    return np.clip(shifted, -(2**31) + 1, 2**31 - 1).astype(np.int32)
+
+
 class AcquireResult(NamedTuple):
     granted: bool
     remaining: float  # post-decision token estimate (≙ Lua reply new_v)
@@ -692,11 +700,9 @@ class DeviceBucketStore(BucketStore):
                     raise ValueError(
                         f"snapshot table size {n} != store table size {table.n_slots}"
                     )
-                last_ts = data["last_ts"].astype(np.int64) + shift
                 table.state = K.BucketState(
                     tokens=jnp.asarray(data["tokens"]),
-                    last_ts=jnp.asarray(
-                        np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                    last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
                     exists=jnp.asarray(data["exists"]),
                 )
                 table.dir.load(data["directory"], table.n_slots)
@@ -706,22 +712,19 @@ class DeviceBucketStore(BucketStore):
                 if n != table.n_slots:
                     raise ValueError(
                         f"snapshot window table size {n} != {table.n_slots}")
-                idx = data["window_idx"].astype(np.int64) + shift // wt
                 table.state = K.WindowState(
                     prev_count=jnp.asarray(data["prev_count"]),
                     curr_count=jnp.asarray(data["curr_count"]),
                     window_idx=jnp.asarray(
-                        np.clip(idx, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                        _shift_ts(data["window_idx"], shift // wt)),
                     exists=jnp.asarray(data["exists"]),
                 )
                 table.dir.load(data["directory"], table.n_slots)
             c = snap["counters"]
-            last_ts = c["last_ts"].astype(np.int64) + shift
             self._counters = K.CounterState(
                 value=jnp.asarray(c["value"]),
                 period=jnp.asarray(c["period"]),
-                last_ts=jnp.asarray(
-                    np.clip(last_ts, -(2**31) + 1, 2**31 - 1), jnp.int32),
+                last_ts=jnp.asarray(_shift_ts(c["last_ts"], shift)),
                 exists=jnp.asarray(c["exists"]),
             )
             self._counter_dir.load(snap["counter_dir"],
