@@ -286,6 +286,20 @@ class RemoteBucketStore(BucketStore):
     async def ping(self) -> None:
         await self._request(wire.OP_PING)
 
+    async def save(self) -> None:
+        """Ask the server to checkpoint its store to its configured path
+        (≙ Redis ``BGSAVE``). Raises :class:`wire.RemoteStoreError` if the
+        server has no snapshot path."""
+        await self._request(wire.OP_SAVE)
+
+    async def stats(self) -> dict:
+        """Server + store metrics (requests served, kernel launches, batch
+        occupancy, sweeps …) as a dict."""
+        import json
+
+        (text,) = await self._request(wire.OP_STATS)
+        return json.loads(text)
+
     # -- lifecycle ----------------------------------------------------------
     async def aclose(self) -> None:
         if self._closed:

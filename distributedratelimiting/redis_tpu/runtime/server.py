@@ -42,12 +42,17 @@ class BucketStoreServer:
     """
 
     def __init__(self, store: BucketStore, *, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, snapshot_path: str | None = None) -> None:
         self.store = store
         self.host = host
         self.port = port
+        # Server-configured checkpoint destination for OP_SAVE (≙ Redis
+        # BGSAVE writing its configured dump file — clients never supply
+        # paths, so the wire cannot be used to write arbitrary files).
+        self.snapshot_path = snapshot_path
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
+        self._save_task: asyncio.Task | None = None
         self.connections_served = 0
         self.requests_served = 0
 
@@ -124,6 +129,29 @@ class BucketStoreServer:
                     seq, wire.RESP_DECISION, res.granted, res.remaining)
             elif op == wire.OP_PING:
                 resp = wire.encode_response(seq, wire.RESP_EMPTY)
+            elif op == wire.OP_SAVE:
+                if self.snapshot_path is None:
+                    resp = wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        "server has no --snapshot-path configured")
+                else:
+                    from distributedratelimiting.redis_tpu.runtime import (
+                        checkpoint,
+                    )
+
+                    # Coalesce concurrent SAVEs: requests arriving while a
+                    # save is in flight piggyback on it (BGSAVE semantics)
+                    # instead of queueing N redundant full-state pulls.
+                    if self._save_task is None or self._save_task.done():
+                        self._save_task = asyncio.ensure_future(
+                            asyncio.to_thread(
+                                checkpoint.save_snapshot, self.store,
+                                self.snapshot_path))
+                    await asyncio.shield(self._save_task)
+                    resp = wire.encode_response(seq, wire.RESP_EMPTY)
+            elif op == wire.OP_STATS:
+                resp = wire.encode_response(
+                    seq, wire.RESP_TEXT, self._stats_json())
             else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
@@ -139,6 +167,18 @@ class BucketStoreServer:
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError):
                 pass  # client went away; its futures die with the socket
+
+    def _stats_json(self) -> str:
+        import json
+
+        payload = {
+            "connections_served": self.connections_served,
+            "requests_served": self.requests_served,
+        }
+        metrics = getattr(self.store, "metrics", None)
+        if metrics is not None:
+            payload["store"] = metrics.snapshot()
+        return json.dumps(payload)
 
     async def aclose(self) -> None:
         if self._server is not None:
@@ -175,6 +215,13 @@ def main(argv: list[str] | None = None) -> None:
                         help="device = TPU-resident store; inprocess = "
                         "pure-Python store (CPU baseline / tests)")
     parser.add_argument("--slots", type=int, default=2**17)
+    parser.add_argument("--snapshot-path", default=None,
+                        help="checkpoint file for OP_SAVE (≙ Redis BGSAVE "
+                        "dump path); if it exists at startup, the store "
+                        "restores from it")
+    parser.add_argument("--sweep-period", type=float, default=0.0,
+                        help="active TTL-expiry period in seconds "
+                        "(0 = on-demand sweeps only; device backend only)")
     args = parser.parse_args(argv)
 
     async def serve() -> None:
@@ -190,13 +237,26 @@ def main(argv: list[str] | None = None) -> None:
             )
 
             store = InProcessBucketStore()
-        server = BucketStoreServer(store, host=args.host, port=args.port)
+        if args.snapshot_path:
+            import os
+
+            from distributedratelimiting.redis_tpu.runtime import checkpoint
+
+            if os.path.exists(args.snapshot_path):
+                checkpoint.load_snapshot(store, args.snapshot_path)
+                print(f"restored snapshot from {args.snapshot_path}",
+                      flush=True)
+        if args.sweep_period > 0 and hasattr(store, "start_sweeper"):
+            store.start_sweeper(args.sweep_period)
+        server = BucketStoreServer(store, host=args.host, port=args.port,
+                                   snapshot_path=args.snapshot_path)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         try:
             await asyncio.Event().wait()
         finally:
             await server.aclose()
+            await store.aclose()
 
     asyncio.run(serve())
 

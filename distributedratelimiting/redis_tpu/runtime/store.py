@@ -490,6 +490,7 @@ class DeviceBucketStore(BucketStore):
         self._lock = threading.RLock()  # directory/slot allocation guard
         self._connected = False
         self._connect_gate = asyncio.Lock()
+        self._sweeper_task: asyncio.Task | None = None
 
     # -- connection lifecycle (lazy, idempotent) ---------------------------
     async def connect(self) -> None:
@@ -643,8 +644,51 @@ class DeviceBucketStore(BucketStore):
                                 window_sec: float) -> AcquireResult:
         return self._wtable(limit, window_sec).acquire_blocking(key, count)
 
+    # -- TTL maintenance ---------------------------------------------------
+    def sweep_all(self) -> None:
+        """One TTL-eviction pass over every table (buckets, windows,
+        counters). On-demand sweeps already run on allocation pressure
+        (invariant 5); this is the *active* expiry pass — Redis's
+        background expiration cycle — so an idle store's memory shrinks
+        without waiting for the next allocation to force it."""
+        with self._lock:
+            for t in list(self._tables.values()):
+                t._sweep()
+            for wt in list(self._wtables.values()):
+                wt._sweep()
+            self._sweep_counters()
+
+    def start_sweeper(self, period_s: float = 30.0) -> None:
+        """Start the periodic active-expiry task on the running event loop
+        (idempotent). Stops automatically in :meth:`aclose`."""
+        if self._sweeper_task is not None and not self._sweeper_task.done():
+            return
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(period_s)
+                try:
+                    # Device passes block; keep the event loop responsive.
+                    await asyncio.to_thread(self.sweep_all)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    # A transient device error must not silently end active
+                    # expiry for the store's lifetime — log and retry next
+                    # period (degraded-mode posture, invariant 9).
+                    log.error_evaluating_kernel(exc)
+
+        self._sweeper_task = asyncio.get_running_loop().create_task(loop())
+
     # -- lifecycle / ops ---------------------------------------------------
     async def aclose(self) -> None:
+        if self._sweeper_task is not None:
+            self._sweeper_task.cancel()
+            try:
+                await self._sweeper_task
+            except (asyncio.CancelledError, Exception):
+                pass  # a failed sweeper must not abort batcher cleanup
+            self._sweeper_task = None
         for t in self._tables.values():
             await t.batcher.aclose()
         for t in self._wtables.values():
@@ -695,11 +739,12 @@ class DeviceBucketStore(BucketStore):
             shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
             for (cap, rate), data in snap["tables"].items():
                 table = self._table(cap, rate)
-                n = len(data["tokens"])
-                if n != table.n_slots:
-                    raise ValueError(
-                        f"snapshot table size {n} != store table size {table.n_slots}"
-                    )
+                # Adopt the snapshot's size: tables grow independently by
+                # doubling at runtime, so a post-growth checkpoint has no
+                # reason to match a fresh store's default size — a restore
+                # that raised here would crash-loop exactly the planned
+                # restart it exists for.
+                table.n_slots = len(data["tokens"])
                 table.state = K.BucketState(
                     tokens=jnp.asarray(data["tokens"]),
                     last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
@@ -708,10 +753,7 @@ class DeviceBucketStore(BucketStore):
                 table.dir.load(data["directory"], table.n_slots)
             for (limit, wt), data in snap.get("wtables", {}).items():
                 table = self._wtable(limit, wt / bm.TICKS_PER_SECOND)
-                n = len(data["prev_count"])
-                if n != table.n_slots:
-                    raise ValueError(
-                        f"snapshot window table size {n} != {table.n_slots}")
+                table.n_slots = len(data["prev_count"])
                 table.state = K.WindowState(
                     prev_count=jnp.asarray(data["prev_count"]),
                     curr_count=jnp.asarray(data["curr_count"]),
@@ -825,12 +867,28 @@ class InProcessBucketStore(BucketStore):
 
     def snapshot(self) -> dict:
         return {
+            "now_ticks": self.clock.now_ticks(),
             "buckets": dict(self._buckets),
             "counters": dict(self._counters),
             "windows": dict(self._windows),
         }
 
     def restore(self, snap: dict) -> None:
-        self._buckets = dict(snap["buckets"])
-        self._counters = dict(snap["counters"])
-        self._windows = dict(snap["windows"])
+        """Same clock-epoch re-alignment as the device store: stored
+        timestamps shift by ``now_here − now_at_snapshot`` so elapsed time
+        (refill/decay) survives a restore into a fresh process."""
+        # Snapshots from before the epoch field behave as same-process.
+        shift = (int(self.clock.now_ticks()) - int(snap["now_ticks"])
+                 if "now_ticks" in snap else 0)
+        self._buckets = {
+            k: (tokens, ts + shift)
+            for k, (tokens, ts) in snap["buckets"].items()
+        }
+        self._counters = {
+            k: (v, p, ts + shift)
+            for k, (v, p, ts) in snap["counters"].items()
+        }
+        self._windows = {
+            k: (prev, curr, idx + shift // k[2])
+            for k, (prev, curr, idx) in snap["windows"].items()
+        }

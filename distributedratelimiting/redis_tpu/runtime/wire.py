@@ -19,13 +19,15 @@ Request payloads:
                        (a, b) = (capacity, fill_rate) / (limit, window_s)
     PEEK             : [u16 klen][key utf-8][f64 capacity][f64 fill_rate]
     SYNC             : [u16 klen][key utf-8][f64 local_count][f64 decay_rate]
-    PING             : empty
+    PING / SAVE / STATS : empty (SAVE writes the server-configured
+                       checkpoint path — clients never supply paths)
 
 Response payloads:
     OK_DECISION : [u8 granted][f64 remaining]
     OK_VALUE    : [f64 value]
     OK_PAIR     : [f64 a][f64 b]
     OK_EMPTY    : empty
+    OK_TEXT     : [u16 mlen][text utf-8] (STATS reply: a JSON object)
     ERROR       : [u16 mlen][message utf-8]
 """
 
@@ -35,7 +37,9 @@ import struct
 
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
-    "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_ERROR",
+    "OP_SAVE", "OP_STATS",
+    "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
+    "RESP_ERROR",
     "MAX_FRAME", "RemoteStoreError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
     "read_frame", "write_frame",
@@ -46,6 +50,8 @@ OP_PEEK = 2
 OP_SYNC = 3
 OP_WINDOW = 4
 OP_PING = 5
+OP_SAVE = 6    # ≙ Redis BGSAVE: checkpoint the store server-side
+OP_STATS = 7   # server + store metrics as JSON text
 
 _OP_NAMES = {
     OP_ACQUIRE: "acquire",
@@ -53,6 +59,8 @@ _OP_NAMES = {
     OP_SYNC: "sync_counter",
     OP_WINDOW: "window_acquire",
     OP_PING: "ping",
+    OP_SAVE: "save",
+    OP_STATS: "stats",
 }
 
 
@@ -65,6 +73,7 @@ RESP_DECISION = 64
 RESP_VALUE = 65
 RESP_PAIR = 66
 RESP_EMPTY = 67
+RESP_TEXT = 68
 RESP_ERROR = 127
 
 #: Upper bound on a frame body; a peer announcing more is protocol-broken
@@ -104,7 +113,7 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
         payload = _keyed(key, _F64x2.pack(a, b))
-    elif op == OP_PING:
+    elif op in (OP_PING, OP_SAVE, OP_STATS):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
@@ -123,7 +132,7 @@ def decode_request(seq_op_payload: bytes) -> tuple[int, int, str, int, float, fl
         key, tail = _split_key(body)
         a, b = _F64x2.unpack(tail)
         return seq, op, key, 0, a, b
-    if op == OP_PING:
+    if op in (OP_PING, OP_SAVE, OP_STATS):
         return seq, op, "", 0, 0.0, 0.0
     raise RemoteStoreError(f"unknown op {op}")
 
@@ -137,7 +146,7 @@ def encode_response(seq: int, kind: int, *vals) -> bytes:
         payload = _PAIR.pack(float(vals[0]), float(vals[1]))
     elif kind == RESP_EMPTY:
         payload = b""
-    elif kind == RESP_ERROR:
+    elif kind in (RESP_ERROR, RESP_TEXT):
         mb = str(vals[0]).encode("utf-8")[:0xFFFF]
         payload = _KEYED.pack(len(mb)) + mb
     else:
@@ -159,7 +168,7 @@ def decode_response(seq_kind_payload: bytes) -> tuple[int, int, tuple]:
         return seq, kind, _PAIR.unpack(body)
     if kind == RESP_EMPTY:
         return seq, kind, ()
-    if kind == RESP_ERROR:
+    if kind in (RESP_ERROR, RESP_TEXT):
         (mlen,) = _KEYED.unpack_from(body, 0)
         return seq, kind, (body[2:2 + mlen].decode("utf-8"),)
     raise RemoteStoreError(f"unknown response kind {kind}")

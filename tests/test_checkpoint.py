@@ -92,3 +92,43 @@ def test_works_for_inprocess_store(tmp_path):
     load_snapshot(s2, path)
     assert s2.acquire_blocking("x", 6, 10.0, 1.0).granted
     assert not s2.acquire_blocking("x", 1, 10.0, 1.0).granted
+
+
+def test_restore_adopts_snapshot_table_size_after_growth(tmp_path):
+    """Regression: a checkpoint taken after a table doubled must restore
+    into a fresh (default-sized) store instead of crash-looping."""
+    clock = ManualClock()
+    dev = DeviceBucketStore(n_slots=4, counter_slots=8, clock=clock,
+                            max_batch=64)
+    # 4 slots, 6 distinct never-expiring keys -> forces at least one grow.
+    for i in range(6):
+        dev.acquire_blocking(f"k{i}", 1, 10.0, 1000.0)
+    table = dev._table(10.0, 1000.0)
+    assert table.n_slots > 4
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(dev, path)
+
+    dev2 = DeviceBucketStore(n_slots=4, counter_slots=8, clock=clock,
+                             max_batch=64)
+    load_snapshot(dev2, path)
+    t2 = dev2._table(10.0, 1000.0)
+    assert t2.n_slots == table.n_slots
+    # Restored keys still resolve to their buckets.
+    for i in range(6):
+        assert t2.dir.lookup(f"k{i}") is not None
+
+
+def test_inprocess_restore_realigns_clock_epoch():
+    """Regression: an in-process snapshot restored into a fresh process
+    (clock near zero) must keep refilling from elapsed time."""
+    old = ManualClock(start_ticks=5_000_000)
+    s = InProcessBucketStore(clock=old)
+    s.acquire_blocking("k", 10, 10.0, 1.0)  # drain
+    snap = s.snapshot()
+
+    fresh = ManualClock(start_ticks=10)
+    s2 = InProcessBucketStore(clock=fresh)
+    s2.restore(snap)
+    assert not s2.acquire_blocking("k", 5, 10.0, 1.0).granted
+    fresh.advance_seconds(5.0)
+    assert s2.acquire_blocking("k", 5, 10.0, 1.0).granted
