@@ -22,6 +22,7 @@ __all__ = [
     "ApproximateTokenBucketOptions",
     "QueueingTokenBucketOptions",
     "SlidingWindowOptions",
+    "ConcurrencyLimiterOptions",
 ]
 
 
@@ -81,6 +82,33 @@ class ApproximateTokenBucketOptions(QueueingTokenBucketOptions):
     (≙ ``RedisApproximateTokenBucketRateLimiterOptions`` — the same
     queueing surface, ``…Options.cs:44-58``, inherited from
     :class:`QueueingTokenBucketOptions`)."""
+
+
+@dataclass(frozen=True)
+class ConcurrencyLimiterOptions:
+    """Concurrency (held-permit) limiter options — the
+    ``System.Threading.RateLimiting.ConcurrencyLimiterOptions`` member the
+    reference never distributed; ``instance_name`` keys one shared
+    semaphore across every host sharing the store."""
+
+    permit_limit: int = 10
+    queue_limit: int = 0
+    queue_processing_order: QueueProcessingOrder = QueueProcessingOrder.OLDEST_FIRST
+    instance_name: str = "rate-limiter"
+    #: How often parked waiters re-probe the shared store. Local releases
+    #: drain immediately; the poll exists for permits freed by OTHER
+    #: instances sharing the semaphore (no cross-instance signal exists —
+    #: the same store-mediated-only coordination as the reference's star
+    #: topology, where staleness is likewise bounded by a period).
+    retry_period_s: float = 0.05
+
+    def __post_init__(self) -> None:
+        if self.permit_limit <= 0:
+            raise ValueError("permit_limit must be > 0")
+        if self.queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+        if self.retry_period_s <= 0:
+            raise ValueError("retry_period_s must be > 0")
 
 
 @dataclass(frozen=True)

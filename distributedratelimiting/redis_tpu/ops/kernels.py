@@ -50,6 +50,11 @@ __all__ = [
     "SLOT24_PAD",
     "sync_batch",
     "sync_batch_packed",
+    "SemaState",
+    "init_sema_state",
+    "sema_batch_packed",
+    "sweep_semas",
+    "rebase_sema_epoch",
     "window_acquire_batch",
     "window_acquire_batch_packed",
     "window_acquire_scan",
@@ -516,6 +521,112 @@ def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
     ex_arr = state.exists.at[ss].set(True, mode="drop")
 
     return WindowState(prev_arr, curr_arr, idx_arr, ex_arr), granted, remaining
+
+
+class SemaState(NamedTuple):
+    """SoA concurrency-semaphore table: ``active`` = permits currently
+    held per key. No reference analogue (the reference implements only
+    token buckets); this backs the ``ConcurrencyLimiter`` member of the
+    ``System.Threading.RateLimiting`` family, whose leases RETURN permits
+    on dispose."""
+
+    active: jax.Array   # i32[N] held permits
+    last_ts: jax.Array  # i32[N] last touch (for idle-slot sweeps)
+    exists: jax.Array   # bool[N]
+
+
+def init_sema_state(n: int) -> SemaState:
+    return SemaState(
+        active=jnp.zeros((n,), jnp.int32),
+        last_ts=jnp.zeros((n,), jnp.int32),
+        exists=jnp.zeros((n,), bool),
+    )
+
+
+@partial(jax.jit, donate_argnums=0)
+def sema_batch_packed(state: SemaState, packed):
+    """Atomic batched semaphore update. ``packed: i32[4, B]`` — row 0
+    slots (-1 padding), row 1 signed deltas (+n acquire / -n release),
+    row 2 per-row permit limits, row 3 the batch timestamp.
+
+    Acquire (+n) grants iff ``active + same-slot-earlier-demand + n <=
+    limit`` — all-or-nothing, duplicates serialized conservatively like
+    the token-bucket kernels (invariant 3 at batch granularity). Release
+    (-n) always applies, clamped at 0 (over-release is a caller bug the
+    store must survive, not amplify). Init-on-miss: a slot with
+    ``exists=False`` starts at 0 held.
+
+    Returns ``(new_state, out f32[2, B])``: row 0 ok (0/1 — releases are
+    always 1), row 1 post-op active count as seen by that row.
+    """
+    slots = packed[0]
+    deltas = packed[1]
+    limits = packed[2]
+    now = packed[3, 0]
+    valid = _valid_slots(slots, slots >= 0, state.active.shape[0])
+    gs = _gather_slots(slots, valid)
+    active_old = jnp.where(state.exists[gs], state.active[gs], 0)
+
+    # Serialize same-slot rows: earlier acquires reserve, earlier releases
+    # free. Net prefix = sum of earlier applied deltas, conservatively
+    # approximated by granting against (active + prefix of earlier GRANTS).
+    # Two-pass exact serialization would need a scan over the batch; the
+    # conservative form never over-admits: treat all earlier acquires in
+    # the batch as granted, ignore earlier releases for admission.
+    acq = jnp.maximum(deltas, 0)
+    prefix = bm.duplicate_prefix(slots, acq, valid)
+
+    is_release = deltas < 0
+    # f32 comparison (exact to 2^24 — far above any real permit limit)
+    # avoids int32 overflow when a batch's worth of acquires sums large.
+    fits = (active_old.astype(jnp.float32) + prefix.astype(jnp.float32)
+            + acq.astype(jnp.float32)) <= limits.astype(jnp.float32)
+    ok = valid & (is_release | fits)
+    applied = jnp.where(ok, deltas, 0)
+
+    ss = _scatter_slots(slots, valid, state.active.shape[0])
+    active_arr = state.active.at[ss].set(active_old, mode="drop")
+    active_arr = active_arr.at[ss].add(applied, mode="drop")
+    active_arr = jnp.maximum(active_arr, 0)
+    # delta == 0 is a read-only probe: it must not allocate the slot or
+    # refresh its TTL (a monitoring poll would otherwise keep dead slots
+    # alive past the sweep forever).
+    touch = _scatter_slots(slots, valid & (deltas != 0),
+                           state.active.shape[0])
+    ts_arr = state.last_ts.at[touch].set(jnp.asarray(now, jnp.int32),
+                                         mode="drop")
+    ex_arr = state.exists.at[touch].set(True, mode="drop")
+
+    after = active_arr[gs]
+    out = jnp.stack([
+        ok.astype(jnp.float32),
+        jnp.where(valid, after, 0).astype(jnp.float32),
+    ])
+    return SemaState(active_arr, ts_arr, ex_arr), out
+
+
+@partial(jax.jit, donate_argnums=0)
+def sweep_semas(state: SemaState, now):
+    """Reclaim idle semaphore slots: zero held permits AND untouched past
+    the global-counter TTL (86400 s). A slot with permits still held is
+    never swept — leaked permits are an operator problem (`active` reset
+    requires an explicit release), not something expiry may silently
+    forgive."""
+    expired = state.exists & (state.active <= 0) & (
+        bm.elapsed_ticks(now, state.last_ts) >= bm.GLOBAL_COUNTER_TTL_TICKS
+    )
+    return SemaState(
+        state.active, state.last_ts, state.exists & ~expired
+    ), expired
+
+
+@partial(jax.jit, donate_argnums=0)
+def rebase_sema_epoch(state: SemaState, offset_ticks):
+    return SemaState(
+        state.active,
+        jnp.maximum(state.last_ts - offset_ticks, 0),
+        state.exists,
+    )
 
 
 @partial(jax.jit, donate_argnums=0)

@@ -204,6 +204,36 @@ class WaiterQueue:
                 break
         return granted
 
+    def peek_next(self) -> Registration | None:
+        """Order-aware live head: discards cancelled entries (unwinding
+        their permit accounting) and returns the next waiter WITHOUT
+        removing it, or ``None``. For drains whose grant is an await and
+        whose cost is returnable (the concurrency limiter): the caller
+        acquires for the peeked waiter, then re-peeks to confirm it is
+        still next before popping — if not (cancelled mid-flight), the
+        caller returns the permits instead of stranding them."""
+        while self._deque.count:
+            newest = self.order is QueueProcessingOrder.NEWEST_FIRST
+            reg = self._deque.peek_tail() if newest else self._deque.peek_head()
+            if reg.future.done():
+                (self._deque.dequeue_tail if newest
+                 else self._deque.dequeue_head)()
+                self._queue_count -= reg.count
+                continue
+            return reg
+        return None
+
+    def pop_next(self) -> Registration | None:
+        """Remove and return the next live waiter (see :meth:`peek_next`),
+        unwinding its permit accounting."""
+        reg = self.peek_next()
+        if reg is not None:
+            newest = self.order is QueueProcessingOrder.NEWEST_FIRST
+            (self._deque.dequeue_tail if newest
+             else self._deque.dequeue_head)()
+            self._queue_count -= reg.count
+        return reg
+
     def fail_all(self, make_lease: Callable[[], object]) -> int:
         """Disposal path: every parked waiter completes with a failed lease
         (``:291-298``), drained in queue-processing order. Also marks the

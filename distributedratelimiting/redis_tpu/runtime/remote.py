@@ -271,6 +271,24 @@ class RemoteBucketStore(BucketStore):
             wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec)
         return SyncResult(score, ewma)
 
+    async def concurrency_acquire(self, key: str, count: int,
+                                  limit: int) -> AcquireResult:
+        granted, active = await self._request(
+            wire.OP_SEMA, key, count, float(limit), 0.0)
+        return AcquireResult(granted, active)
+
+    def concurrency_acquire_blocking(self, key: str, count: int,
+                                     limit: int) -> AcquireResult:
+        granted, active = self._request_blocking(
+            wire.OP_SEMA, key, count, float(limit), 0.0)
+        return AcquireResult(granted, active)
+
+    async def concurrency_release(self, key: str, count: int) -> None:
+        await self._request(wire.OP_SEMA, key, -count, 0.0, 0.0)
+
+    def concurrency_release_blocking(self, key: str, count: int) -> None:
+        self._request_blocking(wire.OP_SEMA, key, -count, 0.0, 0.0)
+
     async def window_acquire(self, key: str, count: int, limit: float,
                              window_sec: float) -> AcquireResult:
         granted, remaining = await self._request(

@@ -127,6 +127,17 @@ class BucketStoreServer:
                 res = await self.store.window_acquire(key, count, a, b)
                 resp = wire.encode_response(
                     seq, wire.RESP_DECISION, res.granted, res.remaining)
+            elif op == wire.OP_SEMA:
+                if count >= 0:
+                    res = await self.store.concurrency_acquire(
+                        key, count, int(a))
+                else:
+                    await self.store.concurrency_release(key, -count)
+                    res = None
+                resp = wire.encode_response(
+                    seq, wire.RESP_DECISION,
+                    True if res is None else res.granted,
+                    0.0 if res is None else res.remaining)
             elif op == wire.OP_PING:
                 resp = wire.encode_response(seq, wire.RESP_EMPTY)
             elif op == wire.OP_SAVE:

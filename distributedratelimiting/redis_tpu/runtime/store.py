@@ -133,6 +133,24 @@ class BucketStore(abc.ABC):
     def window_acquire_blocking(self, key: str, count: int, limit: float,
                                 window_sec: float) -> AcquireResult: ...
 
+    # -- concurrency semaphore (held permits, returned on lease dispose) ---
+    @abc.abstractmethod
+    async def concurrency_acquire(self, key: str, count: int,
+                                  limit: int) -> AcquireResult:
+        """Atomically add ``count`` held permits iff ``active + count <=
+        limit``. ``remaining`` in the result is the post-op active count."""
+
+    @abc.abstractmethod
+    def concurrency_acquire_blocking(self, key: str, count: int,
+                                     limit: int) -> AcquireResult: ...
+
+    @abc.abstractmethod
+    async def concurrency_release(self, key: str, count: int) -> None:
+        """Return ``count`` held permits (clamped at zero held)."""
+
+    @abc.abstractmethod
+    def concurrency_release_blocking(self, key: str, count: int) -> None: ...
+
     # -- lifecycle / ops ---------------------------------------------------
     @abc.abstractmethod
     async def aclose(self) -> None: ...
@@ -486,6 +504,8 @@ class DeviceBucketStore(BucketStore):
         self._wtables: dict[tuple[float, int], _DeviceWindowTable] = {}
         self._counters = K.init_counter_state(counter_slots)
         self._counter_dir = make_directory(counter_slots)
+        self._semas = K.init_sema_state(counter_slots)
+        self._sema_dir = make_directory(counter_slots)
         self._decay_rate_dev: dict[float, jax.Array] = {}
         self._lock = threading.RLock()  # directory/slot allocation guard
         self._connected = False
@@ -519,6 +539,9 @@ class DeviceBucketStore(BucketStore):
                         wt.rebase(offset)
                     self._counters = K.rebase_counter_epoch(
                         self._counters, jnp.int32(offset)
+                    )
+                    self._semas = K.rebase_sema_epoch(
+                        self._semas, jnp.int32(offset)
                     )
                     self.clock.rebase(offset)  # type: ignore[attr-defined]
                     now = self.clock.now_ticks()
@@ -633,6 +656,84 @@ class DeviceBucketStore(BucketStore):
                                                 decay_rate_per_sec))
         return SyncResult(float(out_np[0, 0]), float(out_np[1, 0]))
 
+    # -- concurrency semaphore ---------------------------------------------
+    def _sema_slot(self, key: str) -> int:
+        with self._lock:
+            return int(_resolve_with_reclaim(
+                self._sema_dir, [key],
+                lambda pinned: self._sweep_semas(),
+                self._grow_semas,
+            )[0])
+
+    def _sweep_semas(self) -> None:
+        with self.profiler.span("sweep_semas", self._semas.active.shape[0]):
+            self._semas, freed = K.sweep_semas(
+                self._semas, jnp.int32(self.clock.now_ticks())
+            )
+            freed_np = np.asarray(freed)
+            if freed_np.any():
+                dead = np.nonzero(freed_np)[0].astype(np.int32)
+                self.metrics.slots_evicted += self._sema_dir.remove_slots(dead)
+            self.metrics.sweeps += 1
+
+    def _grow_semas(self) -> None:
+        old_n = self._semas.active.shape[0]
+        self._semas = K.SemaState(
+            active=jnp.concatenate([self._semas.active, jnp.zeros((old_n,), jnp.int32)]),
+            last_ts=jnp.concatenate([self._semas.last_ts, jnp.zeros((old_n,), jnp.int32)]),
+            exists=jnp.concatenate([self._semas.exists, jnp.zeros((old_n,), bool)]),
+        )
+        self._sema_dir.add_slots(old_n, old_n * 2)
+
+    def _sema_dispatch(self, key: str, delta: int, limit: int):
+        if delta == 0:
+            # Read-only probe: must not allocate a directory slot either.
+            with self._lock:
+                slot = self._sema_dir.lookup(key)
+            if slot is None:
+                return None  # unknown key ⇒ zero held (probe trivially ok)
+        else:
+            slot = self._sema_slot(key)
+        with self.profiler.span("sema"), self._lock:
+            b = _pad_size(1, floor=8)
+            packed = np.full((4, b), -1, np.int32)
+            packed[1] = 0
+            packed[2] = 0
+            packed[0, 0] = slot
+            packed[1, 0] = delta
+            packed[2, 0] = limit
+            packed[3] = self.now_ticks_checked()
+            self._semas, out = K.sema_batch_packed(
+                self._semas, jnp.asarray(packed)
+            )
+            return out
+
+    async def concurrency_acquire(self, key: str, count: int,
+                                  limit: int) -> AcquireResult:
+        await self.connect()
+        out = self._sema_dispatch(key, count, limit)
+        if out is None:  # probe of an unknown key: zero permits held
+            return AcquireResult(True, 0.0)
+        loop = asyncio.get_running_loop()
+        out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+        return AcquireResult(bool(out_np[0, 0] > 0.5), float(out_np[1, 0]))
+
+    def concurrency_acquire_blocking(self, key: str, count: int,
+                                     limit: int) -> AcquireResult:
+        out = self._sema_dispatch(key, count, limit)
+        if out is None:
+            return AcquireResult(True, 0.0)
+        out_np = np.asarray(out)
+        return AcquireResult(bool(out_np[0, 0] > 0.5), float(out_np[1, 0]))
+
+    async def concurrency_release(self, key: str, count: int) -> None:
+        out = self._sema_dispatch(key, -count, 0)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, lambda: np.asarray(out))
+
+    def concurrency_release_blocking(self, key: str, count: int) -> None:
+        np.asarray(self._sema_dispatch(key, -count, 0))
+
     # -- sliding window ----------------------------------------------------
     async def window_acquire(self, key: str, count: int, limit: float,
                              window_sec: float) -> AcquireResult:
@@ -657,6 +758,7 @@ class DeviceBucketStore(BucketStore):
             for wt in list(self._wtables.values()):
                 wt._sweep()
             self._sweep_counters()
+            self._sweep_semas()
 
     def start_sweeper(self, period_s: float = 30.0) -> None:
         """Start the periodic active-expiry task on the running event loop
@@ -727,6 +829,12 @@ class DeviceBucketStore(BucketStore):
                     "last_ts": np.asarray(self._counters.last_ts),
                     "exists": np.asarray(self._counters.exists),
                 },
+                "sema_dir": self._sema_dir.to_dict(),
+                "semas": {
+                    "active": np.asarray(self._semas.active),
+                    "last_ts": np.asarray(self._semas.last_ts),
+                    "exists": np.asarray(self._semas.exists),
+                },
             }
 
     def restore(self, snap: dict) -> None:
@@ -771,6 +879,15 @@ class DeviceBucketStore(BucketStore):
             )
             self._counter_dir.load(snap["counter_dir"],
                                    self._counters.value.shape[0])
+            if "semas" in snap:  # absent in pre-semaphore checkpoints
+                s = snap["semas"]
+                self._semas = K.SemaState(
+                    active=jnp.asarray(s["active"]),
+                    last_ts=jnp.asarray(_shift_ts(s["last_ts"], shift)),
+                    exists=jnp.asarray(s["exists"]),
+                )
+                self._sema_dir.load(snap["sema_dir"],
+                                    self._semas.active.shape[0])
 
 
 class InProcessBucketStore(BucketStore):
@@ -783,6 +900,7 @@ class InProcessBucketStore(BucketStore):
         self._buckets: dict[tuple, tuple[float, int]] = {}   # (tokens, ts)
         self._counters: dict[str, tuple[float, float, int]] = {}  # (v, p, ts)
         self._windows: dict[tuple, tuple[float, float, int]] = {}
+        self._semas: dict[str, int] = {}                     # active permits
         self._connected = False
 
     async def connect(self) -> None:
@@ -836,6 +954,23 @@ class InProcessBucketStore(BucketStore):
         self._counters[key] = (v, p, now)
         return SyncResult(v, p)
 
+    async def concurrency_acquire(self, key, count, limit):
+        return self.concurrency_acquire_blocking(key, count, limit)
+
+    def concurrency_acquire_blocking(self, key, count, limit):
+        active = self._semas.get(key, 0)
+        if active + count <= limit:
+            if count > 0:  # count == 0 is a read-only probe
+                self._semas[key] = active + count
+            return AcquireResult(True, float(active + count))
+        return AcquireResult(False, float(active))
+
+    async def concurrency_release(self, key, count):
+        self.concurrency_release_blocking(key, count)
+
+    def concurrency_release_blocking(self, key, count):
+        self._semas[key] = max(0, self._semas.get(key, 0) - count)
+
     async def window_acquire(self, key, count, limit, window_sec):
         return self.window_acquire_blocking(key, count, limit, window_sec)
 
@@ -871,6 +1006,7 @@ class InProcessBucketStore(BucketStore):
             "buckets": dict(self._buckets),
             "counters": dict(self._counters),
             "windows": dict(self._windows),
+            "semas": dict(self._semas),
         }
 
     def restore(self, snap: dict) -> None:
@@ -892,3 +1028,4 @@ class InProcessBucketStore(BucketStore):
             k: (prev, curr, idx + shift // k[2])
             for k, (prev, curr, idx) in snap["windows"].items()
         }
+        self._semas = dict(snap.get("semas", {}))  # counts are epoch-free

@@ -18,8 +18,12 @@ from typing import Any, Callable
 from distributedratelimiting.redis_tpu.models.approximate import (
     ApproximateTokenBucketRateLimiter,
 )
+from distributedratelimiting.redis_tpu.models.concurrency import (
+    ConcurrencyLimiter,
+)
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
+    ConcurrencyLimiterOptions,
     QueueingTokenBucketOptions,
     SlidingWindowOptions,
     TokenBucketOptions,
@@ -42,6 +46,7 @@ __all__ = [
     "add_tpu_approximate_token_bucket_rate_limiter",
     "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
+    "add_tpu_concurrency_limiter",
 ]
 
 RATE_LIMITER = "rate_limiter"
@@ -126,6 +131,22 @@ def add_tpu_queueing_token_bucket_rate_limiter(
         lambda reg: QueueingTokenBucketRateLimiter(
             configure(), _store_of(reg, store)
         ),
+    )
+
+
+def add_tpu_concurrency_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], ConcurrencyLimiterOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    """Registers the distributed concurrency (held-permit) limiter — the
+    ``System.Threading.RateLimiting`` family member the reference never
+    distributed."""
+    registry.add_singleton(
+        service_name,
+        lambda reg: ConcurrencyLimiter(configure(), _store_of(reg, store)),
     )
 
 

@@ -1,0 +1,312 @@
+"""Concurrency (held-permit) limiter tests — permits return on dispose.
+
+Covers the semaphore kernel, all three stores, the limiter contract
+(queueing, cancellation-with-permit-return, dispose), and multi-instance
+sharing over the wire.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.models.concurrency import (
+    ConcurrencyLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import (
+    ConcurrencyLimiterOptions,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.queueing import (
+    QueueProcessingOrder,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def device_store():
+    return DeviceBucketStore(n_slots=64, counter_slots=8, clock=ManualClock(),
+                             max_batch=64)
+
+
+class TestSemaKernel:
+    def test_acquire_until_full_then_release(self):
+        import jax.numpy as jnp
+
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        state = K.init_sema_state(8)
+
+        def op(state, slot, delta, limit):
+            packed = np.full((4, 8), -1, np.int32)
+            packed[1] = 0
+            packed[2] = 0
+            packed[3] = 1
+            packed[0, 0] = slot
+            packed[1, 0] = delta
+            packed[2, 0] = limit
+            state, out = K.sema_batch_packed(state, jnp.asarray(packed))
+            o = np.asarray(out)
+            return state, bool(o[0, 0] > 0.5), float(o[1, 0])
+
+        state, ok, after = op(state, 3, 2, 3)
+        assert ok and after == 2
+        state, ok, after = op(state, 3, 2, 3)   # 2+2 > 3
+        assert not ok and after == 2
+        state, ok, after = op(state, 3, 1, 3)
+        assert ok and after == 3
+        state, ok, after = op(state, 3, -2, 0)  # release always applies
+        assert ok and after == 1
+        state, ok, after = op(state, 3, -9, 0)  # over-release clamps at 0
+        assert ok and after == 0
+
+    def test_batch_duplicates_never_over_admit(self):
+        import jax.numpy as jnp
+
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        state = K.init_sema_state(8)
+        packed = np.full((4, 8), -1, np.int32)
+        packed[1] = 0
+        packed[2] = 0
+        packed[3] = 1
+        # Five +1 acquires for the same slot, limit 3.
+        packed[0, :5] = 2
+        packed[1, :5] = 1
+        packed[2, :5] = 3
+        state, out = K.sema_batch_packed(state, jnp.asarray(packed))
+        o = np.asarray(out)
+        assert o[0, :5].sum() == 3
+        assert int(np.asarray(state.active)[2]) == 3
+
+
+@pytest.mark.parametrize("make_store", [InProcessBucketStore, device_store])
+class TestStoreSemantics:
+    def test_limit_enforced_and_released(self, make_store):
+        store = make_store()
+        assert store.concurrency_acquire_blocking("s", 2, 3).granted
+        assert not store.concurrency_acquire_blocking("s", 2, 3).granted
+        store.concurrency_release_blocking("s", 2)
+        assert store.concurrency_acquire_blocking("s", 3, 3).granted
+
+    def test_keys_are_independent(self, make_store):
+        store = make_store()
+        assert store.concurrency_acquire_blocking("a", 3, 3).granted
+        assert store.concurrency_acquire_blocking("b", 3, 3).granted
+
+
+class TestConcurrencyLimiter:
+    def test_lease_dispose_returns_permits(self):
+        lim = ConcurrencyLimiter(
+            ConcurrencyLimiterOptions(permit_limit=2, instance_name="c1"),
+            InProcessBucketStore())
+        l1 = lim.acquire(1)
+        l2 = lim.acquire(1)
+        assert l1.is_acquired and l2.is_acquired
+        assert not lim.acquire(1).is_acquired
+        l1.dispose()
+        assert lim.acquire(1).is_acquired
+        l1.dispose()  # double-dispose is a no-op, not an over-release
+        assert not lim.acquire(1).is_acquired
+
+    def test_context_manager_releases(self):
+        lim = ConcurrencyLimiter(
+            ConcurrencyLimiterOptions(permit_limit=1, instance_name="c2"),
+            InProcessBucketStore())
+        with lim.acquire(1) as lease:
+            assert lease.is_acquired
+            assert not lim.acquire(1).is_acquired
+        assert lim.acquire(1).is_acquired
+
+    def test_over_limit_raises_and_zero_probe(self):
+        lim = ConcurrencyLimiter(
+            ConcurrencyLimiterOptions(permit_limit=2, instance_name="c3"),
+            InProcessBucketStore())
+        with pytest.raises(ValueError):
+            lim.acquire(3)
+        assert lim.acquire(0).is_acquired          # permits available
+        hold = lim.acquire(2)
+        assert not lim.acquire(0).is_acquired      # none left
+        hold.dispose()
+
+    def test_async_waiters_drain_on_release(self):
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=4,
+                                          instance_name="c4"),
+                InProcessBucketStore())
+            first = await lim.acquire_async(1)
+            waiter = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            assert not waiter.done()
+            await first.release_async()
+            lease = await asyncio.wait_for(waiter, 2.0)
+            assert lease.is_acquired
+            await lease.release_async()
+            assert lim.available_permits() == 1
+            await lim.aclose()
+
+        run(main())
+
+    def test_cancelled_waiter_returns_queued_slot(self):
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=1,
+                                          instance_name="c5"),
+                InProcessBucketStore())
+            first = await lim.acquire_async(1)
+            waiter = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            waiter.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await waiter
+            # The cancelled waiter's queue slot is free again.
+            waiter2 = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            await first.release_async()
+            lease = await asyncio.wait_for(waiter2, 2.0)
+            assert lease.is_acquired
+            # Permits were never stranded on the cancelled waiter.
+            await lease.release_async()
+            assert lim.available_permits() == 1
+            await lim.aclose()
+
+        run(main())
+
+    def test_dispose_fails_queued_waiters(self):
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=3,
+                                          instance_name="c6"),
+                InProcessBucketStore())
+            first = await lim.acquire_async(1)
+            waiter = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            await lim.aclose()
+            lease = await asyncio.wait_for(waiter, 2.0)
+            assert not lease.is_acquired
+            del first
+
+        run(main())
+
+    def test_newest_first_evicts_oldest_waiter(self):
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(
+                    permit_limit=1, queue_limit=1,
+                    queue_processing_order=QueueProcessingOrder.NEWEST_FIRST,
+                    instance_name="c7"),
+                InProcessBucketStore())
+            first = await lim.acquire_async(1)
+            w1 = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            w2 = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            assert not (await asyncio.wait_for(w1, 2.0)).is_acquired
+            await first.release_async()
+            assert (await asyncio.wait_for(w2, 2.0)).is_acquired
+            await lim.aclose()
+
+        run(main())
+
+
+class TestDistributedConcurrency:
+    def test_two_instances_share_one_semaphore_over_tcp(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store_a = RemoteBucketStore(address=(srv.host, srv.port))
+                store_b = RemoteBucketStore(address=(srv.host, srv.port))
+                lim_a = ConcurrencyLimiter(
+                    ConcurrencyLimiterOptions(permit_limit=2,
+                                              instance_name="shared"),
+                    store_a)
+                lim_b = ConcurrencyLimiter(
+                    ConcurrencyLimiterOptions(permit_limit=2,
+                                              instance_name="shared"),
+                    store_b)
+                try:
+                    la = await lim_a.acquire_async(1)
+                    lb = await lim_b.acquire_async(1)
+                    assert la.is_acquired and lb.is_acquired
+                    # Global limit reached across both instances.
+                    assert not (await lim_a.acquire_async(1)).is_acquired
+                    await la.release_async()
+                    assert (await lim_b.acquire_async(1)).is_acquired
+                finally:
+                    await lim_a.aclose()
+                    await lim_b.aclose()
+                    await store_a.aclose()
+                    await store_b.aclose()
+
+        run(main())
+
+
+class TestCrossInstanceWakeup:
+    def test_waiter_wakes_on_other_instances_release(self):
+        """Regression: a waiter parked on instance B must wake when
+        instance A releases — there is no cross-instance signal, so B's
+        retry poll is the only wakeup path."""
+
+        async def main():
+            backing = InProcessBucketStore()
+            lim_a = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=2,
+                                          instance_name="x",
+                                          retry_period_s=0.02),
+                backing)
+            lim_b = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=2,
+                                          instance_name="x",
+                                          retry_period_s=0.02),
+                backing)
+            held = await lim_a.acquire_async(1)
+            waiter = asyncio.create_task(lim_b.acquire_async(1))
+            await asyncio.sleep(0.05)
+            assert not waiter.done()
+            await held.release_async()   # release on A — B must poll it up
+            lease = await asyncio.wait_for(waiter, 3.0)
+            assert lease.is_acquired
+            await lease.release_async()
+            await lim_a.aclose()
+            await lim_b.aclose()
+
+        run(main())
+
+
+class TestProbeIsReadOnly:
+    def test_probe_allocates_nothing_on_device_store(self):
+        store = device_store()
+        # Zero-delta probe of an unknown key: no directory slot, no device
+        # state — a monitoring poll must not create or TTL-refresh slots.
+        res = store.concurrency_acquire_blocking("never-used", 0, 5)
+        assert res.granted and res.remaining == 0.0
+        assert store._sema_dir.lookup("never-used") is None
+
+    def test_probe_does_not_refresh_ttl(self):
+        import numpy as np
+
+        clock = ManualClock()
+        store = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                                  max_batch=64)
+        store.concurrency_acquire_blocking("k", 1, 5)
+        store.concurrency_release_blocking("k", 1)
+        ts_after_release = int(np.asarray(store._semas.last_ts)[
+            store._sema_dir.lookup("k")])
+        clock.advance_seconds(100.0)
+        store.concurrency_acquire_blocking("k", 0, 5)  # probe
+        ts_after_probe = int(np.asarray(store._semas.last_ts)[
+            store._sema_dir.lookup("k")])
+        assert ts_after_probe == ts_after_release
+
+    def test_probe_does_not_create_inprocess_entry(self):
+        store = InProcessBucketStore()
+        store.concurrency_acquire_blocking("ghost", 0, 5)
+        assert "ghost" not in store._semas
