@@ -1,0 +1,42 @@
+"""Fixed-window counter limiter — the last `System.Threading.RateLimiting`
+family member (``FixedWindowRateLimiter``).
+
+No reference counterpart (the reference distributes only token buckets);
+semantics are the classic fixed window: consumption counts against the
+window containing ``now`` only, and the count resets at every window
+boundary (admitting the well-known 2× boundary burst the sliding variant
+exists to smooth). Everything else — contract, lease/metadata handling,
+device window table, atomicity, time authority, TTL sweeps — is the
+sliding limiter's; only the store call differs (the kernel skips the
+trailing-window interpolation), so this subclasses
+:class:`~.sliding_window.SlidingWindowRateLimiter` and overrides the two
+store-call hooks.
+"""
+
+from __future__ import annotations
+
+from distributedratelimiting.redis_tpu.models.options import FixedWindowOptions
+from distributedratelimiting.redis_tpu.models.sliding_window import (
+    SlidingWindowRateLimiter,
+)
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
+
+__all__ = ["FixedWindowRateLimiter"]
+
+
+class FixedWindowRateLimiter(SlidingWindowRateLimiter):
+    def __init__(self, options: FixedWindowOptions,
+                 store: BucketStore) -> None:
+        super().__init__(options, store)  # type: ignore[arg-type]
+
+    def _store_acquire_blocking(self, permits: int):
+        return self.store.fixed_window_acquire_blocking(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )
+
+    async def _store_acquire(self, permits: int):
+        return await self.store.fixed_window_acquire(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )

@@ -22,6 +22,7 @@ __all__ = [
     "ApproximateTokenBucketOptions",
     "QueueingTokenBucketOptions",
     "SlidingWindowOptions",
+    "FixedWindowOptions",
     "ConcurrencyLimiterOptions",
 ]
 
@@ -109,6 +110,22 @@ class ConcurrencyLimiterOptions:
             raise ValueError("queue_limit must be >= 0")
         if self.retry_period_s <= 0:
             raise ValueError("retry_period_s must be > 0")
+
+
+@dataclass(frozen=True)
+class FixedWindowOptions:
+    """Fixed-window counter limiter options (≙
+    ``FixedWindowRateLimiterOptions`` from the same family)."""
+
+    permit_limit: int = 100
+    window_s: float = 1.0
+    instance_name: str = "rate-limiter"
+
+    def __post_init__(self) -> None:
+        if self.permit_limit <= 0:
+            raise ValueError("permit_limit must be > 0")
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")
 
 
 @dataclass(frozen=True)

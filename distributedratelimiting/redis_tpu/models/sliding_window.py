@@ -56,15 +56,25 @@ class SlidingWindowRateLimiter(RateLimiter):
             MetadataName.RETRY_AFTER: self.options.window_s,
         })
 
+    # Store-call hooks — the fixed-window subclass overrides ONLY these.
+    def _store_acquire_blocking(self, permits: int):
+        return self.store.window_acquire_blocking(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )
+
+    async def _store_acquire(self, permits: int):
+        return await self.store.window_acquire(
+            self.options.instance_name, permits, self.options.permit_limit,
+            self.options.window_s,
+        )
+
     def acquire(self, permits: int = 1) -> RateLimitLease:
         self._check_permits(permits)
         if permits == 0:
             return SUCCESSFUL_LEASE if self.available_permits() > 0 else FAILED_LEASE
         t0 = time.perf_counter()
-        res = self.store.window_acquire_blocking(
-            self.options.instance_name, permits, self.options.permit_limit,
-            self.options.window_s,
-        )
+        res = self._store_acquire_blocking(permits)
         return self._lease(res.granted, res.remaining, permits,
                            time.perf_counter() - t0)
 
@@ -73,10 +83,7 @@ class SlidingWindowRateLimiter(RateLimiter):
         if permits == 0:
             return SUCCESSFUL_LEASE if self.available_permits() > 0 else FAILED_LEASE
         t0 = time.perf_counter()
-        res = await self.store.window_acquire(
-            self.options.instance_name, permits, self.options.permit_limit,
-            self.options.window_s,
-        )
+        res = await self._store_acquire(permits)
         return self._lease(res.granted, res.remaining, permits,
                            time.perf_counter() - t0)
 

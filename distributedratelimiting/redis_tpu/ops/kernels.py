@@ -469,22 +469,30 @@ def _sync_core(state: CounterState, slots, local_counts, valid, now,
     return CounterState(value_arr, period_arr, ts_arr, ex_arr), new_value, new_period
 
 
-@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+@partial(jax.jit, donate_argnums=0,
+         static_argnames=("handle_duplicates", "interpolate"))
 def window_acquire_batch(state: WindowState, slots, counts, valid, now, limit,
-                         window_ticks, *, handle_duplicates: bool = True):
+                         window_ticks, *, handle_duplicates: bool = True,
+                         interpolate: bool = True):
     """Batched sliding-window acquire (BASELINE config 4).
 
     Same contract as :func:`acquire_batch`; grant iff the interpolated
-    trailing-window estimate plus this request stays within ``limit``.
+    trailing-window estimate plus this request stays within ``limit``
+    (``interpolate=False`` = fixed-window: current-window count only).
     """
     return _window_acquire_core(state, slots, counts, valid, now, limit,
                                 window_ticks,
-                                handle_duplicates=handle_duplicates)
+                                handle_duplicates=handle_duplicates,
+                                interpolate=interpolate)
 
 
 def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
                          window_ticks, *, handle_duplicates: bool = True,
-                         prefix=None):
+                         prefix=None, interpolate: bool = True):
+    """``interpolate=True`` → sliding window (trailing-window estimate);
+    ``False`` → fixed window (current-window count only — the
+    ``FixedWindowRateLimiter`` family member's semantics). Same state,
+    advance, atomicity, and sweep machinery either way."""
     valid = _valid_slots(slots, valid, state.prev_count.shape[0])
     gs = _gather_slots(slots, valid)
     prev_old = state.prev_count[gs]
@@ -496,7 +504,11 @@ def _window_acquire_core(state: WindowState, slots, counts, valid, now, limit,
     prev_new, curr_new, idx_new = bm.sliding_window_advance(
         prev_old, curr_old, idx_old, ex_old, now, window_ticks
     )
-    est = bm.sliding_window_estimate(prev_new, curr_new, idx_new, now, window_ticks)
+    if interpolate:
+        est = bm.sliding_window_estimate(prev_new, curr_new, idx_new, now,
+                                         window_ticks)
+    else:
+        est = curr_new
 
     if prefix is None and handle_duplicates:
         prefix = bm.duplicate_prefix(slots, counts, valid)
@@ -647,15 +659,15 @@ def sync_batch_packed(state: CounterState, packed, decay_rate_per_tick):
     return new_state, jnp.stack([scores, periods])
 
 
-@partial(jax.jit, donate_argnums=0)
+@partial(jax.jit, donate_argnums=0, static_argnames=("interpolate",))
 def window_acquire_batch_packed(state: WindowState, packed, limit,
-                                window_ticks):
+                                window_ticks, *, interpolate: bool = True):
     """:func:`window_acquire_batch` with the single-transfer operand/result
     convention of :func:`acquire_batch_packed`."""
     slots, counts, valid, now, prefix = _unpack_requests(packed)
     new_state, granted, remaining = _window_acquire_core(
         state, slots, counts, valid, now, limit, window_ticks,
-        prefix=prefix,
+        prefix=prefix, interpolate=interpolate,
     )
     out = jnp.stack([granted.astype(jnp.float32), remaining])
     return new_state, out

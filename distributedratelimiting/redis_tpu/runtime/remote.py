@@ -301,6 +301,19 @@ class RemoteBucketStore(BucketStore):
             wire.OP_WINDOW, key, count, limit, window_sec)
         return AcquireResult(granted, remaining)
 
+    async def fixed_window_acquire(self, key: str, count: int, limit: float,
+                                   window_sec: float) -> AcquireResult:
+        granted, remaining = await self._request(
+            wire.OP_FWINDOW, key, count, limit, window_sec)
+        return AcquireResult(granted, remaining)
+
+    def fixed_window_acquire_blocking(self, key: str, count: int,
+                                      limit: float,
+                                      window_sec: float) -> AcquireResult:
+        granted, remaining = self._request_blocking(
+            wire.OP_FWINDOW, key, count, limit, window_sec)
+        return AcquireResult(granted, remaining)
+
     async def ping(self) -> None:
         await self._request(wire.OP_PING)
 

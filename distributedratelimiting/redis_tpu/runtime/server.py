@@ -127,6 +127,10 @@ class BucketStoreServer:
                 res = await self.store.window_acquire(key, count, a, b)
                 resp = wire.encode_response(
                     seq, wire.RESP_DECISION, res.granted, res.remaining)
+            elif op == wire.OP_FWINDOW:
+                res = await self.store.fixed_window_acquire(key, count, a, b)
+                resp = wire.encode_response(
+                    seq, wire.RESP_DECISION, res.granted, res.remaining)
             elif op == wire.OP_SEMA:
                 if count >= 0:
                     res = await self.store.concurrency_acquire(

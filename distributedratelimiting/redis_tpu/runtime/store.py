@@ -133,6 +133,16 @@ class BucketStore(abc.ABC):
     def window_acquire_blocking(self, key: str, count: int, limit: float,
                                 window_sec: float) -> AcquireResult: ...
 
+    # -- fixed window (current-window count only, no interpolation) --------
+    @abc.abstractmethod
+    async def fixed_window_acquire(self, key: str, count: int, limit: float,
+                                   window_sec: float) -> AcquireResult: ...
+
+    @abc.abstractmethod
+    def fixed_window_acquire_blocking(self, key: str, count: int,
+                                      limit: float,
+                                      window_sec: float) -> AcquireResult: ...
+
     # -- concurrency semaphore (held permits, returned on lease dispose) ---
     @abc.abstractmethod
     async def concurrency_acquire(self, key: str, count: int,
@@ -399,12 +409,16 @@ class _DeviceTable(_PackedLaunchMixin):
 
 
 class _DeviceWindowTable(_PackedLaunchMixin):
-    """One homogeneous-config sliding-window table."""
+    """One homogeneous-config window table (sliding by default;
+    ``fixed=True`` disables the trailing-window interpolation — the
+    fixed-window limiter's semantics — over the same state/sweeps)."""
 
     def __init__(self, store: "DeviceBucketStore", limit: float,
-                 window_ticks: int, n_slots: int) -> None:
+                 window_ticks: int, n_slots: int, *,
+                 fixed: bool = False) -> None:
         self.store = store
         self.limit = float(limit)
+        self.fixed = fixed
         self.window_ticks = int(window_ticks)
         self.state = K.init_window_state(n_slots)
         self.n_slots = n_slots
@@ -465,7 +479,7 @@ class _DeviceWindowTable(_PackedLaunchMixin):
                                    self.store.now_ticks_checked())
             self.state, out = K.window_acquire_batch_packed(
                 self.state, jnp.asarray(packed), self.limit_dev,
-                self.window_dev,
+                self.window_dev, interpolate=not self.fixed,
             )
             self.store.metrics.record_launch(b, len(reqs))
             return out
@@ -558,13 +572,15 @@ class DeviceBucketStore(BucketStore):
                 self._tables[key] = table
             return table
 
-    def _wtable(self, limit: float, window_sec: float) -> _DeviceWindowTable:
+    def _wtable(self, limit: float, window_sec: float,
+                fixed: bool = False) -> _DeviceWindowTable:
         wt = int(window_sec * bm.TICKS_PER_SECOND)
-        key = (float(limit), wt)
+        key = (float(limit), wt, fixed)
         with self._lock:
             table = self._wtables.get(key)
             if table is None:
-                table = _DeviceWindowTable(self, limit, wt, self.n_slots_default)
+                table = _DeviceWindowTable(self, limit, wt,
+                                           self.n_slots_default, fixed=fixed)
                 self._wtables[key] = table
             return table
 
@@ -745,6 +761,19 @@ class DeviceBucketStore(BucketStore):
                                 window_sec: float) -> AcquireResult:
         return self._wtable(limit, window_sec).acquire_blocking(key, count)
 
+    # -- fixed window ------------------------------------------------------
+    async def fixed_window_acquire(self, key: str, count: int, limit: float,
+                                   window_sec: float) -> AcquireResult:
+        await self.connect()
+        table = self._wtable(limit, window_sec, fixed=True)
+        return await table.batcher.submit(_AcquireReq(key, count))
+
+    def fixed_window_acquire_blocking(self, key: str, count: int,
+                                      limit: float,
+                                      window_sec: float) -> AcquireResult:
+        return self._wtable(limit, window_sec,
+                            fixed=True).acquire_blocking(key, count)
+
     # -- TTL maintenance ---------------------------------------------------
     def sweep_all(self) -> None:
         """One TTL-eviction pass over every table (buckets, windows,
@@ -810,8 +839,8 @@ class DeviceBucketStore(BucketStore):
                     "exists": np.asarray(t.state.exists),
                 }
             wtables = {}
-            for (limit, wt), t in self._wtables.items():
-                wtables[(limit, wt)] = {
+            for (limit, wt, fixed), t in self._wtables.items():
+                wtables[(limit, wt, fixed)] = {
                     "directory": t.dir.to_dict(),
                     "prev_count": np.asarray(t.state.prev_count),
                     "curr_count": np.asarray(t.state.curr_count),
@@ -859,8 +888,11 @@ class DeviceBucketStore(BucketStore):
                     exists=jnp.asarray(data["exists"]),
                 )
                 table.dir.load(data["directory"], table.n_slots)
-            for (limit, wt), data in snap.get("wtables", {}).items():
-                table = self._wtable(limit, wt / bm.TICKS_PER_SECOND)
+            for wkey, data in snap.get("wtables", {}).items():
+                # Pre-fixed-window snapshots carry 2-tuple keys (sliding).
+                limit, wt = wkey[0], wkey[1]
+                fixed = wkey[2] if len(wkey) > 2 else False
+                table = self._wtable(limit, wt / bm.TICKS_PER_SECOND, fixed)
                 table.n_slots = len(data["prev_count"])
                 table.state = K.WindowState(
                     prev_count=jnp.asarray(data["prev_count"]),
@@ -975,9 +1007,21 @@ class InProcessBucketStore(BucketStore):
         return self.window_acquire_blocking(key, count, limit, window_sec)
 
     def window_acquire_blocking(self, key, count, limit, window_sec):
+        return self._window_core(key, count, limit, window_sec,
+                                 interpolate=True)
+
+    async def fixed_window_acquire(self, key, count, limit, window_sec):
+        return self._window_core(key, count, limit, window_sec,
+                                 interpolate=False)
+
+    def fixed_window_acquire_blocking(self, key, count, limit, window_sec):
+        return self._window_core(key, count, limit, window_sec,
+                                 interpolate=False)
+
+    def _window_core(self, key, count, limit, window_sec, *, interpolate):
         now = self.clock.now_ticks()
         wt = int(window_sec * bm.TICKS_PER_SECOND)
-        wkey = (key, float(limit), wt)
+        wkey = (key, float(limit), wt, interpolate)
         entry = self._windows.get(wkey)
         idx_now = now // wt
         if entry is None:
@@ -989,8 +1033,11 @@ class InProcessBucketStore(BucketStore):
                 prev, curr = curr, 0.0
             elif steps >= 2:
                 prev = curr = 0.0
-        frac = (now - idx_now * wt) / wt
-        est = curr + prev * (1.0 - frac)
+        if interpolate:
+            frac = (now - idx_now * wt) / wt
+            est = curr + prev * (1.0 - frac)
+        else:
+            est = curr
         granted = est + count <= limit
         if granted:
             curr += count
@@ -1024,8 +1071,10 @@ class InProcessBucketStore(BucketStore):
             k: (v, p, ts + shift)
             for k, (v, p, ts) in snap["counters"].items()
         }
+        # Pre-fixed-window snapshots carry 3-tuple window keys (sliding);
+        # normalize to the 4-tuple (key, limit, wt, interpolate=True).
         self._windows = {
-            k: (prev, curr, idx + shift // k[2])
+            (k if len(k) == 4 else (*k, True)): (prev, curr, idx + shift // k[2])
             for k, (prev, curr, idx) in snap["windows"].items()
         }
         self._semas = dict(snap.get("semas", {}))  # counts are epoch-free

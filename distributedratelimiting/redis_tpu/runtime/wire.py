@@ -37,7 +37,7 @@ import struct
 
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
-    "OP_SAVE", "OP_STATS", "OP_SEMA",
+    "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_ERROR",
     "MAX_FRAME", "RemoteStoreError", "op_name",
@@ -53,6 +53,7 @@ OP_PING = 5
 OP_SAVE = 6    # ≙ Redis BGSAVE: checkpoint the store server-side
 OP_STATS = 7   # server + store metrics as JSON text
 OP_SEMA = 8    # concurrency semaphore: count = signed delta, a = limit
+OP_FWINDOW = 9  # fixed-window acquire: (a, b) = (limit, window_s)
 
 _OP_NAMES = {
     OP_ACQUIRE: "acquire",
@@ -63,6 +64,7 @@ _OP_NAMES = {
     OP_SAVE: "save",
     OP_STATS: "stats",
     OP_SEMA: "sema",
+    OP_FWINDOW: "fixed_window_acquire",
 }
 
 
@@ -111,7 +113,7 @@ def _split_key(payload: bytes) -> tuple[str, bytes]:
 
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
                    a: float = 0.0, b: float = 0.0) -> bytes:
-    if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA):
+    if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
         payload = _keyed(key, _F64x2.pack(a, b))
@@ -126,7 +128,7 @@ def decode_request(seq_op_payload: bytes) -> tuple[int, int, str, int, float, fl
     """Returns ``(seq, op, key, count, a, b)``."""
     seq, op = struct.unpack_from("<IB", seq_op_payload, 0)
     body = seq_op_payload[5:]
-    if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA):
+    if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         key, tail = _split_key(body)
         count, a, b = _ACQ_TAIL.unpack(tail)
         return seq, op, key, count, a, b

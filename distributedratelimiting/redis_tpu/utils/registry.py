@@ -21,9 +21,13 @@ from distributedratelimiting.redis_tpu.models.approximate import (
 from distributedratelimiting.redis_tpu.models.concurrency import (
     ConcurrencyLimiter,
 )
+from distributedratelimiting.redis_tpu.models.fixed_window import (
+    FixedWindowRateLimiter,
+)
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
     ConcurrencyLimiterOptions,
+    FixedWindowOptions,
     QueueingTokenBucketOptions,
     SlidingWindowOptions,
     TokenBucketOptions,
@@ -47,6 +51,7 @@ __all__ = [
     "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
     "add_tpu_concurrency_limiter",
+    "add_tpu_fixed_window_rate_limiter",
 ]
 
 RATE_LIMITER = "rate_limiter"
@@ -147,6 +152,19 @@ def add_tpu_concurrency_limiter(
     registry.add_singleton(
         service_name,
         lambda reg: ConcurrencyLimiter(configure(), _store_of(reg, store)),
+    )
+
+
+def add_tpu_fixed_window_rate_limiter(
+    registry: ServiceRegistry,
+    configure: Callable[[], FixedWindowOptions],
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    registry.add_singleton(
+        service_name,
+        lambda reg: FixedWindowRateLimiter(configure(), _store_of(reg, store)),
     )
 
 

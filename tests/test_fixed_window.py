@@ -1,0 +1,94 @@
+"""Fixed-window limiter tests — window count resets at every boundary."""
+
+import asyncio
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.fixed_window import (
+    FixedWindowRateLimiter,
+)
+from distributedratelimiting.redis_tpu.models.options import FixedWindowOptions
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def device_store(clock):
+    return DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                             max_batch=64)
+
+
+@pytest.mark.parametrize("make_store", [InProcessBucketStore, device_store])
+class TestFixedWindowStore:
+    def test_resets_at_boundary_not_gradually(self, make_store):
+        clock = ManualClock()
+        store = make_store(clock)
+        for _ in range(3):
+            assert store.fixed_window_acquire_blocking("k", 1, 3.0, 1.0).granted
+        assert not store.fixed_window_acquire_blocking("k", 1, 3.0, 1.0).granted
+        # Mid-window: still denied (fixed window does NOT slide open).
+        clock.advance_seconds(0.9)
+        assert not store.fixed_window_acquire_blocking("k", 1, 3.0, 1.0).granted
+        # Past the boundary: full limit again (the classic boundary reset).
+        clock.advance_seconds(0.2)
+        for _ in range(3):
+            assert store.fixed_window_acquire_blocking("k", 1, 3.0, 1.0).granted
+
+    def test_differs_from_sliding_at_boundary(self, make_store):
+        clock = ManualClock()
+        store = make_store(clock)
+        # Exhaust both variants in window 0...
+        for _ in range(3):
+            store.fixed_window_acquire_blocking("x", 1, 3.0, 1.0)
+            store.window_acquire_blocking("x", 1, 3.0, 1.0)
+        clock.advance_seconds(1.05)  # just past the boundary
+        # Fixed admits a full burst; sliding still counts the trailing
+        # window's consumption and denies.
+        assert store.fixed_window_acquire_blocking("x", 3, 3.0, 1.0).granted
+        assert not store.window_acquire_blocking("x", 3, 3.0, 1.0).granted
+
+
+class TestFixedWindowLimiter:
+    def test_contract_and_retry_after(self):
+        clock = ManualClock()
+        lim = FixedWindowRateLimiter(
+            FixedWindowOptions(permit_limit=2, window_s=1.0,
+                               instance_name="fw"),
+            InProcessBucketStore(clock=clock))
+        assert lim.acquire(2).is_acquired
+        denied = lim.acquire(1)
+        assert not denied.is_acquired
+        assert denied.retry_after == 1.0
+        with pytest.raises(ValueError):
+            lim.acquire(3)
+        clock.advance_seconds(1.1)
+        assert lim.acquire(2).is_acquired
+
+    def test_async_over_tcp(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(
+                    InProcessBucketStore(clock=clock)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                lim = FixedWindowRateLimiter(
+                    FixedWindowOptions(permit_limit=2, window_s=1.0,
+                                       instance_name="fw2"),
+                    store)
+                try:
+                    assert (await lim.acquire_async(2)).is_acquired
+                    assert not (await lim.acquire_async(1)).is_acquired
+                    clock.advance_seconds(1.1)  # server clock is authority
+                    assert (await lim.acquire_async(1)).is_acquired
+                finally:
+                    await lim.aclose()
+                    await store.aclose()
+
+        run(main())
