@@ -30,8 +30,17 @@ from distributedratelimiting.redis_tpu.models.base import (
     RateLimitLease,
     RateLimiter,
 )
+from distributedratelimiting.redis_tpu.models.concurrency import (
+    ConcurrencyLease,
+    ConcurrencyLimiter,
+)
+from distributedratelimiting.redis_tpu.models.fixed_window import (
+    FixedWindowRateLimiter,
+)
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
+    ConcurrencyLimiterOptions,
+    FixedWindowOptions,
     QueueingTokenBucketOptions,
     SlidingWindowOptions,
     TokenBucketOptions,
@@ -65,6 +74,8 @@ from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
 from distributedratelimiting.redis_tpu.utils.registry import (
     ServiceRegistry,
     add_tpu_approximate_token_bucket_rate_limiter,
+    add_tpu_concurrency_limiter,
+    add_tpu_fixed_window_rate_limiter,
     add_tpu_queueing_token_bucket_rate_limiter,
     add_tpu_sliding_window_rate_limiter,
     add_tpu_token_bucket_rate_limiter,
@@ -78,10 +89,15 @@ __all__ = [
     "ApproximateTokenBucketOptions",
     "QueueingTokenBucketOptions",
     "SlidingWindowOptions",
+    "FixedWindowOptions",
+    "ConcurrencyLimiterOptions",
     "TokenBucketRateLimiter",
     "ApproximateTokenBucketRateLimiter",
     "QueueingTokenBucketRateLimiter",
     "SlidingWindowRateLimiter",
+    "FixedWindowRateLimiter",
+    "ConcurrencyLimiter",
+    "ConcurrencyLease",
     "PartitionedRateLimiter",
     "AcquireResult",
     "SyncResult",
@@ -99,5 +115,7 @@ __all__ = [
     "add_tpu_approximate_token_bucket_rate_limiter",
     "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
+    "add_tpu_fixed_window_rate_limiter",
+    "add_tpu_concurrency_limiter",
     "__version__",
 ]

@@ -132,3 +132,47 @@ def test_inprocess_restore_realigns_clock_epoch():
     assert not s2.acquire_blocking("k", 5, 10.0, 1.0).granted
     fresh.advance_seconds(5.0)
     assert s2.acquire_blocking("k", 5, 10.0, 1.0).granted
+
+
+def test_pre_fixed_window_snapshot_keys_normalize_on_restore():
+    """Back-compat: snapshots written before the fixed-window feature carry
+    2-tuple device wtable keys / 3-tuple in-process window keys; restore
+    must map them onto the sliding (interpolate=True) tables."""
+    clock = ManualClock()
+
+    # Device store: simulate an old snapshot by rewriting the key tuples.
+    dev = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                            max_batch=64)
+    dev.window_acquire_blocking("w", 3, 5.0, 1.0)
+    snap = dev.snapshot()
+    snap["wtables"] = {k[:2]: v for k, v in snap["wtables"].items()}
+    dev2 = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                             max_batch=64)
+    dev2.restore(snap)
+    # 3 of 5 consumed in the current window survived the restore.
+    assert dev2.window_acquire_blocking("w", 2, 5.0, 1.0).granted
+    assert not dev2.window_acquire_blocking("w", 1, 5.0, 1.0).granted
+
+    # In-process store: same rewrite on the 4-tuple window keys.
+    s = InProcessBucketStore(clock=clock)
+    s.window_acquire_blocking("w", 3, 5.0, 1.0)
+    snap = s.snapshot()
+    snap["windows"] = {k[:3]: v for k, v in snap["windows"].items()}
+    s2 = InProcessBucketStore(clock=clock)
+    s2.restore(snap)
+    assert s2.window_acquire_blocking("w", 2, 5.0, 1.0).granted
+    assert not s2.window_acquire_blocking("w", 1, 5.0, 1.0).granted
+
+
+def test_fixed_window_table_checkpoint_roundtrip(tmp_path):
+    clock = ManualClock()
+    dev = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                            max_batch=64)
+    dev.fixed_window_acquire_blocking("f", 4, 5.0, 1.0)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(dev, path)
+    dev2 = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
+                             max_batch=64)
+    load_snapshot(dev2, path)
+    assert dev2.fixed_window_acquire_blocking("f", 1, 5.0, 1.0).granted
+    assert not dev2.fixed_window_acquire_blocking("f", 1, 5.0, 1.0).granted
