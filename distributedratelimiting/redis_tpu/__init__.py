@@ -68,6 +68,7 @@ from distributedratelimiting.redis_tpu.runtime.clock import (
     MonotonicClock,
     TICKS_PER_SECOND,
 )
+from distributedratelimiting.redis_tpu.parallel.mesh_store import MeshBucketStore
 from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
 from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
 from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
@@ -105,6 +106,7 @@ __all__ = [
     "BucketStoreServer",
     "DeviceBucketStore",
     "InProcessBucketStore",
+    "MeshBucketStore",
     "RemoteBucketStore",
     "ManualClock",
     "MonotonicClock",

@@ -1,0 +1,320 @@
+"""MeshBucketStore — the full store interface over a device mesh.
+
+This is the piece that joins the two deployment shapes (docs/DESIGN.md §6):
+:class:`~.server.BucketStoreServer` can front a whole TPU pod slice, so N
+remote client hosts (the reference's star topology) share bucket state
+sharded across every chip (the mesh-native scale-out). Request flow::
+
+    client hosts ──TCP──▶ server ──micro-batch──▶ two-level fused step
+                                                   (sharded acquire + psum)
+
+Routing of the abstract surface:
+
+- **Token buckets** — the scale-out path: one :class:`ShardedDeviceStore`
+  per ``(capacity, fill_rate)`` config (mirroring ``DeviceBucketStore``'s
+  one homogeneous table per config), each micro-batched so concurrent
+  acquires across all keys coalesce into single fused launches.
+- **Windows, decaying counters, semaphores** — delegated to an inner
+  single-device :class:`DeviceBucketStore`: these tables are small (one
+  row per *limiter*, not per key) and their traffic is per-period, not
+  per-request, so sharding them would buy nothing and cost a collective.
+
+Both layers share one clock: a single time authority for every table
+(invariant 1), one rebase path, one snapshot epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Sequence
+
+import jax
+
+from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
+from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+    ShardedDeviceStore,
+)
+from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    BucketStore,
+    DeviceBucketStore,
+    SyncResult,
+    _AcquireReq,
+    _REBASE_MARGIN_TICKS,
+    _REBASE_THRESHOLD_TICKS,
+    start_periodic_sweeper,
+)
+
+__all__ = ["MeshBucketStore"]
+
+#: Sub-stores never self-rebase (the mesh store coordinates): any value
+#: the int32 tick clock can never reach.
+_NEVER_REBASE = 1 << 62
+
+
+class _CombinedMetrics:
+    """Snapshot view merging the aux store's metrics with every sharded
+    bucket tier's (the OP_STATS surface for a mesh-backed server)."""
+
+    def __init__(self, store: "MeshBucketStore") -> None:
+        self._store = store
+
+    def snapshot(self) -> dict:
+        out = self._store._aux.metrics.snapshot()
+        with self._store._registry_lock:
+            shards = {
+                f"bucket[cap={cap},rate={rate}]": s.metrics.snapshot()
+                for (cap, rate), s in self._store._shards.items()
+            }
+        for sub in shards.values():
+            for k in ("launches", "rows_processed", "rows_valid",
+                      "sweeps", "slots_evicted"):
+                out[k] = out.get(k, 0) + sub[k]
+        out["batch_occupancy"] = (
+            out["rows_valid"] / out["rows_processed"]
+            if out.get("rows_processed") else 0.0
+        )
+        out["tiers"] = shards
+        return out
+
+
+class MeshBucketStore(BucketStore):
+    """``BucketStore`` whose token-bucket tier is key-sharded over a mesh."""
+
+    def __init__(
+        self,
+        mesh=None,
+        *,
+        per_shard_slots: int = 2**14,
+        clock: Clock | None = None,
+        max_batch: int = 4096,
+        max_delay_s: float = 200e-6,
+        max_inflight: int = 8,
+        aux_slots: int = 2**14,
+    ) -> None:
+        self.mesh = mesh if mesh is not None else create_mesh(
+            len(jax.devices()))
+        self.clock = clock or MonotonicClock()
+        self.per_shard_slots = per_shard_slots
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.max_inflight = max_inflight
+        # Small per-limiter tables (windows/counters/semas) live on one
+        # device; bucket tables are NOT created here (n_slots minimal).
+        # Sub-stores never self-rebase — see _maybe_rebase_all.
+        self._aux = DeviceBucketStore(
+            n_slots=64, counter_slots=aux_slots, clock=self.clock,
+            max_batch=max_batch, max_delay_s=max_delay_s,
+            max_inflight=max_inflight, rebase_threshold_ticks=_NEVER_REBASE,
+        )
+        self._shards: dict[tuple[float, float], ShardedDeviceStore] = {}
+        self._batchers: dict[tuple[float, float],
+                             MicroBatcher[_AcquireReq, AcquireResult]] = {}
+        self._registry_lock = threading.RLock()
+        self._connected = False
+        self._connect_gate = asyncio.Lock()
+        self._sweeper_task: asyncio.Task | None = None
+
+    @property
+    def metrics(self) -> _CombinedMetrics:
+        return _CombinedMetrics(self)
+
+    # -- coordinated epoch rebase ------------------------------------------
+    def _maybe_rebase_all(self) -> None:
+        """ONE rebase for every table sharing the clock. Sub-stores have
+        their own thresholds disabled; if any rebased independently, its
+        siblings' timestamps would strand in the old epoch and regression
+        clamps would freeze their refill for days.
+
+        Stop-the-world: ALL sub-store locks are held (fixed order: aux
+        first, then shards by config key) across the table shifts AND the
+        clock rebase, so no concurrent op can stamp a pre-rebase ``now``
+        into an already-shifted table. Deadlock-free: every other code
+        path takes at most ONE sub-store lock."""
+        if self.clock.now_ticks() < _REBASE_THRESHOLD_TICKS:
+            return
+        from contextlib import ExitStack
+
+        with self._registry_lock:
+            now = self.clock.now_ticks()
+            if now < _REBASE_THRESHOLD_TICKS:
+                return
+            offset = now - _REBASE_MARGIN_TICKS
+            with ExitStack() as stack:
+                stack.enter_context(self._aux._lock)
+                for key in sorted(self._shards):
+                    stack.enter_context(self._shards[key]._lock)
+                self._aux.force_rebase(offset)
+                for store in self._shards.values():
+                    store.force_rebase(offset)
+                self.clock.rebase(offset)  # type: ignore[attr-defined]
+
+    # -- lifecycle ---------------------------------------------------------
+    async def connect(self) -> None:
+        if self._connected:
+            return
+        async with self._connect_gate:
+            if self._connected:
+                return
+            await self._aux.connect()
+            self._connected = True
+
+    async def aclose(self) -> None:
+        if self._sweeper_task is not None:
+            self._sweeper_task.cancel()
+            try:
+                await self._sweeper_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._sweeper_task = None
+        with self._registry_lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            await b.aclose()
+        await self._aux.aclose()
+
+    # -- sharded token-bucket tier -----------------------------------------
+    def _sharded(self, capacity: float,
+                 fill_rate_per_sec: float) -> ShardedDeviceStore:
+        key = (float(capacity), float(fill_rate_per_sec))
+        with self._registry_lock:  # event loop + blocking threads race here
+            store = self._shards.get(key)
+            if store is None:
+                store = ShardedDeviceStore(
+                    self.mesh, capacity=capacity,
+                    fill_rate_per_sec=fill_rate_per_sec,
+                    per_shard_slots=self.per_shard_slots, clock=self.clock,
+                    rebase_threshold_ticks=_NEVER_REBASE,
+                )
+                self._shards[key] = store
+            return store
+
+    def _batcher(self, capacity: float, fill_rate_per_sec: float
+                 ) -> MicroBatcher[_AcquireReq, AcquireResult]:
+        key = (float(capacity), float(fill_rate_per_sec))
+        with self._registry_lock:
+            batcher = self._batchers.get(key)
+            if batcher is None:
+                sharded = self._sharded(capacity, fill_rate_per_sec)
+
+                async def flush(reqs: Sequence[_AcquireReq],
+                                _s=sharded) -> list[AcquireResult]:
+                    loop = asyncio.get_running_loop()
+                    # The fused launch + readback blocks; run it off-loop
+                    # so the loop keeps accumulating the next flush.
+                    return await loop.run_in_executor(
+                        None, _s.acquire_batch_blocking,
+                        [(r.key, r.count) for r in reqs],
+                    )
+
+                batcher = MicroBatcher(
+                    flush, max_batch=self.max_batch,
+                    max_delay_s=self.max_delay_s,
+                    max_inflight=self.max_inflight,
+                )
+                self._batchers[key] = batcher
+            return batcher
+
+    async def acquire(self, key: str, count: int, capacity: float,
+                      fill_rate_per_sec: float) -> AcquireResult:
+        await self.connect()
+        self._maybe_rebase_all()
+        return await self._batcher(capacity, fill_rate_per_sec).submit(
+            _AcquireReq(key, count))
+
+    def acquire_blocking(self, key: str, count: int, capacity: float,
+                         fill_rate_per_sec: float) -> AcquireResult:
+        self._maybe_rebase_all()
+        return self._sharded(capacity, fill_rate_per_sec
+                             ).acquire_batch_blocking([(key, count)])[0]
+
+    def peek_blocking(self, key: str, capacity: float,
+                      fill_rate_per_sec: float) -> float:
+        # Read-only: never allocates a slot or writes device state.
+        self._maybe_rebase_all()
+        return self._sharded(capacity, fill_rate_per_sec).peek_blocking(key)
+
+    # -- delegated small tables --------------------------------------------
+    # Every delegated path checks the coordinated rebase too: an aux-only
+    # workload (windows/counters/semaphores, no bucket acquires) must not
+    # run into int32 tick overflow just because the bucket tier is idle.
+    async def sync_counter(self, key, local_count, decay_rate_per_sec):
+        self._maybe_rebase_all()
+        return await self._aux.sync_counter(key, local_count,
+                                            decay_rate_per_sec)
+
+    def sync_counter_blocking(self, key, local_count, decay_rate_per_sec):
+        self._maybe_rebase_all()
+        return self._aux.sync_counter_blocking(key, local_count,
+                                               decay_rate_per_sec)
+
+    async def window_acquire(self, key, count, limit, window_sec):
+        self._maybe_rebase_all()
+        return await self._aux.window_acquire(key, count, limit, window_sec)
+
+    def window_acquire_blocking(self, key, count, limit, window_sec):
+        self._maybe_rebase_all()
+        return self._aux.window_acquire_blocking(key, count, limit,
+                                                 window_sec)
+
+    async def fixed_window_acquire(self, key, count, limit, window_sec):
+        self._maybe_rebase_all()
+        return await self._aux.fixed_window_acquire(key, count, limit,
+                                                    window_sec)
+
+    def fixed_window_acquire_blocking(self, key, count, limit, window_sec):
+        self._maybe_rebase_all()
+        return self._aux.fixed_window_acquire_blocking(key, count, limit,
+                                                       window_sec)
+
+    async def concurrency_acquire(self, key, count, limit):
+        self._maybe_rebase_all()
+        return await self._aux.concurrency_acquire(key, count, limit)
+
+    def concurrency_acquire_blocking(self, key, count, limit):
+        self._maybe_rebase_all()
+        return self._aux.concurrency_acquire_blocking(key, count, limit)
+
+    async def concurrency_release(self, key, count):
+        self._maybe_rebase_all()
+        await self._aux.concurrency_release(key, count)
+
+    def concurrency_release_blocking(self, key, count):
+        self._maybe_rebase_all()
+        self._aux.concurrency_release_blocking(key, count)
+
+    # -- TTL maintenance ---------------------------------------------------
+    def sweep_all(self) -> None:
+        """Active TTL expiry across every tier (≙ DeviceBucketStore.
+        sweep_all — the server's --sweep-period hooks this)."""
+        self._aux.sweep_all()
+        with self._registry_lock:
+            stores = list(self._shards.values())
+        for store in stores:
+            store.sweep()
+
+    def start_sweeper(self, period_s: float = 30.0) -> None:
+        if self._sweeper_task is not None and not self._sweeper_task.done():
+            return
+        self._sweeper_task = start_periodic_sweeper(self.sweep_all, period_s)
+
+    # -- checkpoint --------------------------------------------------------
+    def snapshot(self) -> dict:
+        # No mesh-level now_ticks: each sub-snapshot carries and re-aligns
+        # its own epoch (they all read the same shared clock).
+        with self._registry_lock:
+            return {
+                "aux": self._aux.snapshot(),
+                "shards": {
+                    key: store.snapshot()
+                    for key, store in self._shards.items()
+                },
+            }
+
+    def restore(self, snap: dict) -> None:
+        self._aux.restore(snap["aux"])
+        for (cap, rate), sub in snap["shards"].items():
+            self._sharded(cap, rate).restore(sub)

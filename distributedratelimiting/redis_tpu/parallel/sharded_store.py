@@ -69,6 +69,18 @@ def init_global_counter() -> GlobalCounter:
     )
 
 
+@jax.jit
+def _peek_gather(state: K.BucketState, idx):
+    """Read one slot's ``(tokens, last_ts, exists)`` as one f32[3] — a
+    single dispatch + readback regardless of the index's value. The i32
+    timestamp travels bitcast (exact); the host views it back."""
+    return jnp.stack([
+        state.tokens[idx],
+        jax.lax.bitcast_convert_type(state.last_ts[idx], jnp.float32),
+        state.exists[idx].astype(jnp.float32),
+    ])
+
+
 def shard_of_key(key: str, n_shards: int) -> int:
     """Stable key→shard routing (host side). crc32 so every client process
     on every host routes identically — the distributed directory needs no
@@ -218,7 +230,8 @@ class ShardedDeviceStore:
     def __init__(self, mesh, capacity: float, fill_rate_per_sec: float,
                  *, per_shard_slots: int = 2**14,
                  clock: Clock | None = None,
-                 handle_duplicates: bool = True) -> None:
+                 handle_duplicates: bool = True,
+                 rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS) -> None:
         self.mesh = mesh
         self.n_shards = mesh.devices.size
         self.per_shard = per_shard_slots
@@ -227,6 +240,8 @@ class ShardedDeviceStore:
         self.rate_per_tick = fill_rate_per_sec / bm.TICKS_PER_SECOND
         self.clock = clock or MonotonicClock()
         self.metrics = StoreMetrics()
+        # See DeviceBucketStore: a composing store coordinates rebases.
+        self._rebase_threshold = rebase_threshold_ticks
 
         n_total = self.n_shards * per_shard_slots
         sharding = NamedSharding(mesh, P(SHARD_AXIS))
@@ -277,23 +292,52 @@ class ShardedDeviceStore:
         replicated global counter) and the clock together before ~24 days
         of tick time can overflow."""
         now = self.clock.now_ticks()
-        if now >= _REBASE_THRESHOLD_TICKS:
+        if now >= self._rebase_threshold:
             with self._lock:
                 now = self.clock.now_ticks()
-                if now >= _REBASE_THRESHOLD_TICKS:
+                if now >= self._rebase_threshold:
                     offset = now - _REBASE_MARGIN_TICKS
-                    self.state = K.rebase_bucket_epoch(
-                        self.state, jnp.int32(offset))
-                    self.gcounter = GlobalCounter(
-                        value=self.gcounter.value,
-                        period=self.gcounter.period,
-                        last_ts=jnp.maximum(
-                            self.gcounter.last_ts - jnp.int32(offset), 0),
-                        exists=self.gcounter.exists,
-                    )
+                    self.force_rebase(offset)
                     self.clock.rebase(offset)
                     now = self.clock.now_ticks()
         return now
+
+    def force_rebase(self, offset: int) -> None:
+        """Shift table + global-counter timestamps without touching the
+        clock (the composing store's coordinated-rebase hook — see
+        ``DeviceBucketStore.force_rebase``)."""
+        with self._lock:
+            self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
+            self.gcounter = GlobalCounter(
+                value=self.gcounter.value,
+                period=self.gcounter.period,
+                last_ts=jnp.maximum(
+                    self.gcounter.last_ts - jnp.int32(offset), 0),
+                exists=self.gcounter.exists,
+            )
+
+    def peek_blocking(self, key: str) -> float:
+        """Read-only availability estimate: never allocates a slot or
+        writes device state (the ``GetAvailablePermits`` contract)."""
+        with self._lock:
+            loc = self.directory.get(key)
+            if loc is None:
+                return float(np.floor(self.capacity))
+            shard, local = loc
+            idx = shard * self.per_shard + local
+            now = self.now_ticks_checked()
+            # One jitted gather with the index as an OPERAND (a Python-int
+            # subscript would bake the index into the computation — one
+            # compile per distinct slot) and one packed readback.
+            out = np.asarray(_peek_gather(self.state, jnp.int32(idx)))
+        tokens = float(out[0])
+        ts = int(np.float32(out[1]).view(np.int32))
+        exists = bool(out[2])
+        if not exists:
+            return float(np.floor(self.capacity))
+        refilled = min(self.capacity,
+                       tokens + max(0, now - ts) * self.rate_per_tick)
+        return float(np.floor(refilled))
 
     # -- decisions ---------------------------------------------------------
     def acquire_batch_blocking(

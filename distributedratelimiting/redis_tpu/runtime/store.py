@@ -175,6 +175,27 @@ class BucketStore(abc.ABC):
     def restore(self, snap: dict) -> None: ...
 
 
+def start_periodic_sweeper(sweep_all: Callable[[], None],
+                           period_s: float) -> "asyncio.Task":
+    """Shared active-expiry loop (DeviceBucketStore + MeshBucketStore):
+    runs ``sweep_all`` off-loop every ``period_s``; a transient device
+    error must not silently end active expiry for the store's lifetime —
+    log and retry next period (degraded-mode posture, invariant 9)."""
+
+    async def loop() -> None:
+        while True:
+            await asyncio.sleep(period_s)
+            try:
+                # Device passes block; keep the event loop responsive.
+                await asyncio.to_thread(sweep_all)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                log.error_evaluating_kernel(exc)
+
+    return asyncio.get_running_loop().create_task(loop())
+
+
 def _rate_per_tick(rate_per_sec: float) -> float:
     return rate_per_sec / bm.TICKS_PER_SECOND
 
@@ -499,6 +520,7 @@ class DeviceBucketStore(BucketStore):
         max_inflight: int = 8,
         use_pallas_sweep: bool | None = None,
         profiling_session: Callable[[], ProfilingSession | None] | None = None,
+        rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS,
     ) -> None:
         self.clock = clock or MonotonicClock()
         # ≙ Func<ProfilingSession> registered with the connection on connect
@@ -522,6 +544,11 @@ class DeviceBucketStore(BucketStore):
         self._sema_dir = make_directory(counter_slots)
         self._decay_rate_dev: dict[float, jax.Array] = {}
         self._lock = threading.RLock()  # directory/slot allocation guard
+        # A composing store (MeshBucketStore) sets this effectively
+        # infinite and coordinates one rebase across every table sharing
+        # the clock — independent rebases would strand sibling stores'
+        # timestamps in the old epoch.
+        self._rebase_threshold = rebase_threshold_ticks
         self._connected = False
         self._connect_gate = asyncio.Lock()
         self._sweeper_task: asyncio.Task | None = None
@@ -542,24 +569,32 @@ class DeviceBucketStore(BucketStore):
         """Read the store clock; rebase every table's epoch before int32
         tick time can overflow (~24 days of uptime)."""
         now = self.clock.now_ticks()
-        if now >= _REBASE_THRESHOLD_TICKS:
+        if now >= self._rebase_threshold:
             with self._lock:
                 now = self.clock.now_ticks()
-                if now >= _REBASE_THRESHOLD_TICKS:
+                if now >= self._rebase_threshold:
                     offset = now - _REBASE_MARGIN_TICKS
-                    for t in self._tables.values():
-                        t.rebase(offset)
-                    for wt in self._wtables.values():
-                        wt.rebase(offset)
-                    self._counters = K.rebase_counter_epoch(
-                        self._counters, jnp.int32(offset)
-                    )
-                    self._semas = K.rebase_sema_epoch(
-                        self._semas, jnp.int32(offset)
-                    )
+                    self.force_rebase(offset)
                     self.clock.rebase(offset)  # type: ignore[attr-defined]
                     now = self.clock.now_ticks()
         return now
+
+    def force_rebase(self, offset: int) -> None:
+        """Shift every table's stored timestamps by ``-offset`` WITHOUT
+        touching the clock — the coordinated-rebase hook for composing
+        stores (the caller rebases the shared clock exactly once after
+        every participating store has shifted)."""
+        with self._lock:
+            for t in self._tables.values():
+                t.rebase(offset)
+            for wt in self._wtables.values():
+                wt.rebase(offset)
+            self._counters = K.rebase_counter_epoch(
+                self._counters, jnp.int32(offset)
+            )
+            self._semas = K.rebase_sema_epoch(
+                self._semas, jnp.int32(offset)
+            )
 
     # -- table routing -----------------------------------------------------
     def _table(self, capacity: float, fill_rate_per_sec: float) -> _DeviceTable:
@@ -794,22 +829,7 @@ class DeviceBucketStore(BucketStore):
         (idempotent). Stops automatically in :meth:`aclose`."""
         if self._sweeper_task is not None and not self._sweeper_task.done():
             return
-
-        async def loop() -> None:
-            while True:
-                await asyncio.sleep(period_s)
-                try:
-                    # Device passes block; keep the event loop responsive.
-                    await asyncio.to_thread(self.sweep_all)
-                except asyncio.CancelledError:
-                    raise
-                except Exception as exc:
-                    # A transient device error must not silently end active
-                    # expiry for the store's lifetime — log and retry next
-                    # period (degraded-mode posture, invariant 9).
-                    log.error_evaluating_kernel(exc)
-
-        self._sweeper_task = asyncio.get_running_loop().create_task(loop())
+        self._sweeper_task = start_periodic_sweeper(self.sweep_all, period_s)
 
     # -- lifecycle / ops ---------------------------------------------------
     async def aclose(self) -> None:
