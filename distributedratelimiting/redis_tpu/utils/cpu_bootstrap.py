@@ -1,0 +1,55 @@
+"""Force a CPU-only jax platform before first backend init.
+
+The environment's ``sitecustomize`` registers a remote TPU PJRT plugin
+("axon") at interpreter startup; when its relay is unreachable, *any*
+backend init — even CPU-only — hangs indefinitely, and because the env
+snapshot happens at import time, setting ``JAX_PLATFORMS`` later is not
+enough. The cure (used by both the test suite's conftest and the
+multi-chip dry-run child) is to deregister the plugin and pin the
+platform at the config level before the first array op.
+
+Keep this the single copy of the workaround: tests/conftest.py and
+``__graft_entry__``'s re-exec stub both import it.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "force_cpu_platform",
+    "set_virtual_device_count",
+    "XLA_DEVICE_COUNT_FLAG",
+]
+
+XLA_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def set_virtual_device_count(env: dict, n_devices: int) -> None:
+    """Point ``env`` at an ``n_devices``-device virtual CPU platform.
+
+    Replaces (never appends next to) any inherited device-count flag —
+    two occurrences would leave the effective count at XLA's mercy.
+    ``XLA_FLAGS`` is read at backend init, so mutating ``os.environ``
+    with this before the first array op also works in-process.
+    """
+    import re
+
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        re.sub(rf"{XLA_DEVICE_COUNT_FLAG}=\S+", "", env.get("XLA_FLAGS", ""))
+        + f" {XLA_DEVICE_COUNT_FLAG}={n_devices}"
+    )
+
+
+def force_cpu_platform() -> None:
+    """Deregister the axon PJRT plugin and pin jax to the CPU platform.
+
+    Must run before jax's first backend init. Raises if the (private)
+    deregistration API has moved — failing loudly beats hanging forever
+    on an unreachable relay (the silent-failure mode this guards).
+    """
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

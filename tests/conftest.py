@@ -6,26 +6,22 @@ reference's Orleans-localhost multi-silo trick, ``TestApp/Program.cs:37-104``).
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The environment's sitecustomize registers a remote TPU PJRT plugin
 # ("axon") at interpreter startup; when its relay is unreachable, *any*
 # backend init — even CPU-only — hangs indefinitely. Tests are CPU-only by
 # design, so deregister the plugin before the first array op and pin the
 # platform at the config level (env vars were already snapshotted).
-try:
-    from jax._src import xla_bridge
+from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+    force_cpu_platform,
+    set_virtual_device_count,
+)
 
-    xla_bridge._backend_factories.pop("axon", None)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+set_virtual_device_count(os.environ, 8)
+force_cpu_platform()
 
 import numpy as np
 import pytest
