@@ -119,6 +119,13 @@ class ConcurrencyLimiter(RateLimiter):
             ok = self.available_permits() > 0
             self.metrics.record_decision(ok)
             return ConcurrencyLease(self, 0) if ok else FAILED_LEASE
+        # Same queue-fairness gate as the async path (≙ the family's
+        # TryLeaseUnsynchronized queue check): a sync caller must not
+        # overtake parked OLDEST_FIRST waiters.
+        if (len(self._queue)
+                and self.options.queue_processing_order
+                is QueueProcessingOrder.OLDEST_FIRST):
+            return self._failed()
         res = self.store.concurrency_acquire_blocking(
             self.options.instance_name, permits, self.options.permit_limit)
         return self._lease(permits) if res.granted else self._failed()
@@ -137,9 +144,19 @@ class ConcurrencyLimiter(RateLimiter):
         if (len(self._queue) == 0
                 or self.options.queue_processing_order
                 is QueueProcessingOrder.NEWEST_FIRST):
-            res = await self.store.concurrency_acquire(
+            # Shield the store round-trip: a cancel that lands mid-flight
+            # must not leak permits the store already granted. The op runs
+            # to completion; if it granted, the permits go straight back.
+            acq = asyncio.ensure_future(self.store.concurrency_acquire(
                 self.options.instance_name, permits,
-                self.options.permit_limit)
+                self.options.permit_limit))
+            try:
+                res = await asyncio.shield(acq)
+            except asyncio.CancelledError:
+                self.metrics.cancelled += 1
+                acq.add_done_callback(
+                    lambda t, n=permits: self._release_if_granted(t, n))
+                raise
             if res.granted:
                 return self._lease(permits)
         future, evicted = self._queue.try_enqueue(permits)
@@ -154,9 +171,33 @@ class ConcurrencyLimiter(RateLimiter):
             lease = await future
         except asyncio.CancelledError:
             self.metrics.cancelled += 1
+            # The drain may have granted to this waiter already (future
+            # resolved with a held lease, awaiting task cancelled before
+            # resuming) — release those permits or they leak forever:
+            # sweep_semas never reclaims slots with active > 0.
+            if future.done() and not future.cancelled():
+                granted = future.result()
+                if isinstance(granted, ConcurrencyLease) and granted.is_acquired:
+                    self._spawn_release(granted)
             raise
         self.metrics.record_decision(lease.is_acquired)
         return lease
+
+    def _release_if_granted(self, acq: asyncio.Task, permits: int) -> None:
+        """Done-callback for a cancelled-but-shielded store acquire: if the
+        store ended up granting, return the permits."""
+        if acq.cancelled() or acq.exception() is not None:
+            return
+        if acq.result().granted:
+            task = acq.get_loop().create_task(self.store.concurrency_release(
+                self.options.instance_name, permits))
+            self._drain_tasks.add(task)
+            task.add_done_callback(self._drain_tasks.discard)
+
+    def _spawn_release(self, lease: ConcurrencyLease) -> None:
+        task = asyncio.get_running_loop().create_task(lease.release_async())
+        self._drain_tasks.add(task)
+        task.add_done_callback(self._drain_tasks.discard)
 
     def _ensure_retry_task(self) -> None:
         """Parked waiters re-probe the store every ``retry_period_s`` —
@@ -281,6 +322,12 @@ class ConcurrencyLimiter(RateLimiter):
                 pass
             self._retry_task = None
         self._queue.fail_all(lambda: FAILED_LEASE)
+        # In-flight drain/compensating-release tasks must complete before
+        # shutdown — dropping one with the loop would strand permits in
+        # the SHARED store (other instances' capacity, not just ours).
+        if self._drain_tasks:
+            await asyncio.gather(*list(self._drain_tasks),
+                                 return_exceptions=True)
 
     def stats(self) -> dict:
         return {

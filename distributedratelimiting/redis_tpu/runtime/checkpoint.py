@@ -23,7 +23,14 @@ import tempfile
 __all__ = ["save_snapshot", "load_snapshot"]
 
 _MAGIC = "drl-tpu-snapshot"
-_VERSION = 1
+# v1: initial format (2-tuple wtable keys, no semaphore sections).
+# v2: wtable keys widened to 3-tuples; sema_dir/semas sections added.
+# Readers accept any version in _COMPAT — a v1 snapshot restores into a
+# v2 build (restore() treats the new sections as optional); an *unknown*
+# (newer) version fails loudly here instead of as an opaque KeyError deep
+# in restore() during a rollback.
+_VERSION = 2
+_COMPAT = frozenset({1, 2})
 
 
 def save_snapshot(store, path: str) -> None:
@@ -59,8 +66,9 @@ def load_snapshot(store, path: str) -> None:
         payload = pickle.load(f)
     if payload.get("magic") != _MAGIC:
         raise ValueError(f"{path} is not a rate-limiter snapshot")
-    if payload.get("version") != _VERSION:
+    if payload.get("version") not in _COMPAT:
         raise ValueError(
-            f"snapshot version {payload.get('version')} != {_VERSION}"
+            f"snapshot version {payload.get('version')} not supported "
+            f"(this build reads {sorted(_COMPAT)})"
         )
     store.restore(payload["snapshot"])

@@ -67,6 +67,7 @@ class RemoteBucketStore(BucketStore):
         request_timeout_s: float = 30.0,
         clock: Clock | None = None,
         profiling_session: Callable[[], ProfilingSession | None] | None = None,
+        auth_token: str | None = None,
     ) -> None:
         if connection_factory is None and address is None and url is None:
             # ≙ the reference's ctor validation "some Redis config present"
@@ -80,6 +81,9 @@ class RemoteBucketStore(BucketStore):
             address = (host or "127.0.0.1", int(port))
         self._address = address
         self._request_timeout_s = request_timeout_s
+        # Shared secret presented in a HELLO as the first frame after
+        # connect (≙ the AUTH in a Redis Configuration string).
+        self._auth_token = auth_token
         # The client clock exists only to satisfy the BucketStore interface
         # (e.g. local diagnostics); the SERVER is the time authority.
         self.clock = clock or MonotonicClock()
@@ -128,7 +132,11 @@ class RemoteBucketStore(BucketStore):
             return self._io_loop
 
     def _submit(self, coro) -> "asyncio.Future":
-        loop = self._ensure_loop()
+        try:
+            loop = self._ensure_loop()
+        except Exception:
+            coro.close()  # never-awaited otherwise (post-close fast-fail)
+            raise
         return asyncio.run_coroutine_threadsafe(coro, loop)
 
     async def _await_on_io(self, coro):
@@ -159,10 +167,33 @@ class RemoteBucketStore(BucketStore):
             except Exception as exc:
                 log.could_not_connect_to_store(exc)
                 raise
+            reader_task = asyncio.ensure_future(self._read_loop(reader))
+            if self._auth_token is not None:
+                # HELLO must complete before the connection is published —
+                # no other request can slip ahead of the auth handshake
+                # (requests gate on self._writer, still None here).
+                self._seq = (self._seq + 1) & 0xFFFFFFFF
+                seq = self._seq
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._pending[seq] = fut
+                try:
+                    wire.write_frame(writer, wire.encode_request(
+                        seq, wire.OP_HELLO, self._auth_token))
+                    await writer.drain()
+                    await asyncio.wait_for(fut, self._request_timeout_s)
+                except Exception as exc:
+                    self._pending.pop(seq, None)
+                    reader_task.cancel()
+                    writer.close()
+                    log.could_not_connect_to_store(exc)
+                    raise
             self._reader, self._writer = reader, writer
-            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+            self._reader_task = reader_task
 
     async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        # A protocol-level failure (e.g. version mismatch) is a better
+        # reason to hand in-flight futures than a generic lost-connection.
+        reason: Exception = ConnectionError("connection to store lost")
         try:
             while True:
                 body = await wire.read_frame(reader)
@@ -178,8 +209,10 @@ class RemoteBucketStore(BucketStore):
                     fut.set_result(vals)
         except Exception as exc:
             log.error_evaluating_kernel(exc)
+            if isinstance(exc, wire.RemoteStoreError):
+                reason = exc
         finally:
-            self._drop_connection(ConnectionError("connection to store lost"))
+            self._drop_connection(reason)
 
     def _drop_connection(self, exc: Exception) -> None:
         """Fail all in-flight requests; the next use reconnects."""

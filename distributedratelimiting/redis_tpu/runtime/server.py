@@ -30,6 +30,13 @@ from distributedratelimiting.redis_tpu.utils import log
 __all__ = ["BucketStoreServer"]
 
 
+def _recover_seq(body: bytes) -> int:
+    """Best-effort seq extraction from a frame body ([version][u32 seq]…)
+    so even a malformed frame gets a *routable* error reply — a reply with
+    the wrong seq would strand the client's future for its whole timeout."""
+    return int.from_bytes(body[1:5], "little") if len(body) >= 5 else 0
+
+
 class BucketStoreServer:
     """Serve a :class:`BucketStore` over TCP.
 
@@ -42,7 +49,8 @@ class BucketStoreServer:
     """
 
     def __init__(self, store: BucketStore, *, host: str = "127.0.0.1",
-                 port: int = 0, snapshot_path: str | None = None) -> None:
+                 port: int = 0, snapshot_path: str | None = None,
+                 auth_token: str | None = None) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -50,6 +58,10 @@ class BucketStoreServer:
         # BGSAVE writing its configured dump file — clients never supply
         # paths, so the wire cannot be used to write arbitrary files).
         self.snapshot_path = snapshot_path
+        # Shared-secret auth (≙ the AUTH the reference inherits from the
+        # Redis Configuration string, …Options.cs:30-40): when set, a
+        # connection's first frame must be a HELLO carrying this token.
+        self.auth_token = auth_token
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._save_task: asyncio.Task | None = None
@@ -74,6 +86,7 @@ class BucketStoreServer:
         self.connections_served += 1
         write_lock = asyncio.Lock()
         request_tasks: set[asyncio.Task] = set()
+        authed = self.auth_token is None
         conn_task = asyncio.current_task()
         if conn_task is not None:
             self._conn_tasks.add(conn_task)
@@ -82,6 +95,44 @@ class BucketStoreServer:
             while True:
                 body = await wire.read_frame(reader)
                 if body is None:
+                    break
+                # Version + auth are connection-level gates, checked in
+                # order here (not in per-request tasks, which complete out
+                # of order): a bad frame gets one best-effort error reply,
+                # then the connection drops.
+                if body and body[0] != wire.PROTOCOL_VERSION:
+                    await self._reply(writer, write_lock, wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR,
+                        f"protocol version mismatch: peer speaks "
+                        f"v{body[0]}, server speaks "
+                        f"v{wire.PROTOCOL_VERSION}"))
+                    break
+                op = body[5] if len(body) >= 6 else 0
+                if op == wire.OP_HELLO:
+                    try:
+                        seq, _, token, _, _, _ = wire.decode_request(body)
+                    except Exception:  # malformed HELLO: routable error, drop
+                        await self._reply(writer, write_lock,
+                                          wire.encode_response(
+                                              _recover_seq(body),
+                                              wire.RESP_ERROR,
+                                              "malformed HELLO frame"))
+                        break
+                    if self.auth_token is not None and token != self.auth_token:
+                        await self._reply(writer, write_lock,
+                                          wire.encode_response(
+                                              seq, wire.RESP_ERROR,
+                                              "authentication failed"))
+                        break
+                    authed = True
+                    await self._reply(writer, write_lock,
+                                      wire.encode_response(
+                                          seq, wire.RESP_EMPTY))
+                    continue
+                if not authed:
+                    await self._reply(writer, write_lock, wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR,
+                        "authentication required: send HELLO first"))
                     break
                 task = asyncio.ensure_future(
                     self._serve_request(body, writer, write_lock)
@@ -99,13 +150,21 @@ class BucketStoreServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    async def _reply(self, writer: asyncio.StreamWriter,
+                     write_lock: asyncio.Lock, resp: bytes) -> None:
+        # The lock keeps concurrent request tasks' frames from
+        # interleaving; a vanished client just drops the reply (its
+        # futures die with the socket).
+        async with write_lock:
+            try:
+                wire.write_frame(writer, resp)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
     async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
                              write_lock: asyncio.Lock) -> None:
-        # The seq is always the first 4 bytes — recover it before decoding
-        # so even a malformed/unknown request gets a *routable* error reply
-        # (a reply with the wrong seq would strand the client's future for
-        # its whole timeout).
-        seq = int.from_bytes(body[:4], "little") if len(body) >= 4 else 0
+        seq = _recover_seq(body)
         try:
             seq, op, key, count, a, b = wire.decode_request(body)
             if op == wire.OP_ACQUIRE:
@@ -176,12 +235,7 @@ class BucketStoreServer:
             log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
         self.requests_served += 1
-        async with write_lock:  # frames must not interleave
-            try:
-                wire.write_frame(writer, resp)
-                await writer.drain()
-            except (ConnectionResetError, BrokenPipeError):
-                pass  # client went away; its futures die with the socket
+        await self._reply(writer, write_lock, resp)  # client went away; its futures die with the socket
 
     def _stats_json(self) -> str:
         import json
@@ -237,6 +291,9 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--sweep-period", type=float, default=0.0,
                         help="active TTL-expiry period in seconds "
                         "(0 = on-demand sweeps only; device backend only)")
+    parser.add_argument("--auth-token", default=None,
+                        help="shared secret; when set, clients must HELLO "
+                        "with it before any other op (≙ Redis AUTH)")
     args = parser.parse_args(argv)
 
     async def serve() -> None:
@@ -264,7 +321,8 @@ def main(argv: list[str] | None = None) -> None:
         if args.sweep_period > 0 and hasattr(store, "start_sweeper"):
             store.start_sweeper(args.sweep_period)
         server = BucketStoreServer(store, host=args.host, port=args.port,
-                                   snapshot_path=args.snapshot_path)
+                                   snapshot_path=args.snapshot_path,
+                                   auth_token=args.auth_token)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         try:

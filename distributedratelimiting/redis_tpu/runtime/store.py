@@ -737,12 +737,15 @@ class DeviceBucketStore(BucketStore):
         self._sema_dir.add_slots(old_n, old_n * 2)
 
     def _sema_dispatch(self, key: str, delta: int, limit: int):
-        if delta == 0:
-            # Read-only probe: must not allocate a directory slot either.
+        if delta <= 0:
+            # Read-only probe — and release of an unknown key (a spurious
+            # or buggy double-release): neither may allocate a directory
+            # slot; a nothing-to-release no-op beats a dead slot lingering
+            # for the full TTL.
             with self._lock:
                 slot = self._sema_dir.lookup(key)
             if slot is None:
-                return None  # unknown key ⇒ zero held (probe trivially ok)
+                return None  # unknown key ⇒ zero held
         else:
             slot = self._sema_slot(key)
         with self.profiler.span("sema"), self._lock:
@@ -779,11 +782,15 @@ class DeviceBucketStore(BucketStore):
 
     async def concurrency_release(self, key: str, count: int) -> None:
         out = self._sema_dispatch(key, -count, 0)
+        if out is None:  # unknown key: nothing to release
+            return
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, lambda: np.asarray(out))
 
     def concurrency_release_blocking(self, key: str, count: int) -> None:
-        np.asarray(self._sema_dispatch(key, -count, 0))
+        out = self._sema_dispatch(key, -count, 0)
+        if out is not None:
+            np.asarray(out)
 
     # -- sliding window ----------------------------------------------------
     async def window_acquire(self, key: str, count: int, limit: float,
@@ -1021,7 +1028,9 @@ class InProcessBucketStore(BucketStore):
         self.concurrency_release_blocking(key, count)
 
     def concurrency_release_blocking(self, key, count):
-        self._semas[key] = max(0, self._semas.get(key, 0) - count)
+        if key not in self._semas:
+            return  # unknown key: nothing to release, create nothing
+        self._semas[key] = max(0, self._semas[key] - count)
 
     async def window_acquire(self, key, count, limit, window_sec):
         return self.window_acquire_blocking(key, count, limit, window_sec)

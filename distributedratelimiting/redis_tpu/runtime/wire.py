@@ -12,13 +12,25 @@ replies out of completion order.
 
 Frame layout (all integers little-endian):
 
-    [u32 length][u32 seq][u8 op][payload…]
+    [u32 length][u8 version][u32 seq][u8 op][payload…]
+
+``version`` is :data:`PROTOCOL_VERSION`; a mismatch raises before any
+payload is interpreted, so any *future* revision (v2+, all carrying the
+byte) is reliably detectable rather than silently misparsed. (A legacy
+v1 peer — whose layout had no version byte — is detected probabilistically:
+its seq's low byte sits where the version now is, so 1-in-256 v1 frames
+can slip past the gate; v1 predates any release, so this is theoretical.)
+The reference inherits version/auth negotiation from the Redis
+``Configuration`` string (``RedisTokenBucketRateLimiterOptions.cs:30-40``);
+see ``OP_HELLO`` for the auth analogue.
 
 Request payloads:
     ACQUIRE / WINDOW : [u16 klen][key utf-8][i32 count][f64 a][f64 b]
                        (a, b) = (capacity, fill_rate) / (limit, window_s)
     PEEK             : [u16 klen][key utf-8][f64 capacity][f64 fill_rate]
     SYNC             : [u16 klen][key utf-8][f64 local_count][f64 decay_rate]
+    HELLO            : [u16 tlen][token utf-8] (shared-secret auth; must be
+                       the first frame when the server requires a token)
     PING / SAVE / STATS : empty (SAVE writes the server-configured
                        checkpoint path — clients never supply paths)
 
@@ -27,8 +39,14 @@ Response payloads:
     OK_VALUE    : [f64 value]
     OK_PAIR     : [f64 a][f64 b]
     OK_EMPTY    : empty
-    OK_TEXT     : [u16 mlen][text utf-8] (STATS reply: a JSON object)
-    ERROR       : [u16 mlen][message utf-8]
+    OK_TEXT     : [u32 mlen][text utf-8] (STATS reply: a JSON object —
+                  u32 so a large stats payload can never be truncated
+                  mid-UTF-8; bounded by MAX_FRAME)
+    ERROR       : [u16 mlen][message utf-8] (truncated on a codepoint
+                  boundary if oversized)
+
+Version history: v1 had no version byte and a u16 OK_TEXT length; v2
+(current) added the version byte, HELLO, and the u32 OK_TEXT length.
 """
 
 from __future__ import annotations
@@ -37,13 +55,16 @@ import struct
 
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
-    "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW",
+    "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_ERROR",
-    "MAX_FRAME", "RemoteStoreError", "op_name",
+    "MAX_FRAME", "PROTOCOL_VERSION", "RemoteStoreError",
+    "ProtocolVersionError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
     "read_frame", "write_frame",
 ]
+
+PROTOCOL_VERSION = 2
 
 OP_ACQUIRE = 1
 OP_PEEK = 2
@@ -54,6 +75,7 @@ OP_SAVE = 6    # ≙ Redis BGSAVE: checkpoint the store server-side
 OP_STATS = 7   # server + store metrics as JSON text
 OP_SEMA = 8    # concurrency semaphore: count = signed delta, a = limit
 OP_FWINDOW = 9  # fixed-window acquire: (a, b) = (limit, window_s)
+OP_HELLO = 10  # shared-secret auth handshake (≙ Redis AUTH)
 
 _OP_NAMES = {
     OP_ACQUIRE: "acquire",
@@ -65,6 +87,7 @@ _OP_NAMES = {
     OP_STATS: "stats",
     OP_SEMA: "sema",
     OP_FWINDOW: "fixed_window_acquire",
+    OP_HELLO: "hello",
 }
 
 
@@ -84,11 +107,14 @@ RESP_ERROR = 127
 #: (or hostile) and the connection is dropped rather than buffered.
 MAX_FRAME = 1 << 20
 
-_HDR = struct.Struct("<IIB")          # length covers [seq][op][payload]
+_HDR = struct.Struct("<IBIB")         # length covers [version][seq][op][payload]
+_VER_SEQ_OP = struct.Struct("<BIB")
+_BODY_OFF = _VER_SEQ_OP.size          # payload offset inside a frame body
 _DECISION = struct.Struct("<Bd")
 _VALUE = struct.Struct("<d")
 _PAIR = struct.Struct("<dd")
 _KEYED = struct.Struct("<H")
+_TEXTLEN = struct.Struct("<I")
 _ACQ_TAIL = struct.Struct("<idd")
 _F64x2 = struct.Struct("<dd")
 
@@ -96,6 +122,19 @@ _F64x2 = struct.Struct("<dd")
 class RemoteStoreError(RuntimeError):
     """Server-side failure relayed to the client (≙ a Redis script error
     surfaced through ``ScriptEvaluateAsync``)."""
+
+
+class ProtocolVersionError(RemoteStoreError):
+    """Peer speaks a different protocol revision; the frame was not
+    interpreted past its version byte."""
+
+
+def _check_version(ver: int) -> None:
+    if ver != PROTOCOL_VERSION:
+        raise ProtocolVersionError(
+            f"protocol version mismatch: peer speaks v{ver}, "
+            f"this build speaks v{PROTOCOL_VERSION}"
+        )
 
 
 def _keyed(key: str, tail: bytes) -> bytes:
@@ -111,23 +150,33 @@ def _split_key(payload: bytes) -> tuple[str, bytes]:
     return key, payload[2 + klen:]
 
 
+def _codepoint_truncate(mb: bytes, limit: int) -> bytes:
+    """Truncate utf-8 bytes to ``limit`` on a codepoint boundary."""
+    if len(mb) <= limit:
+        return mb
+    return mb[:limit].decode("utf-8", "ignore").encode("utf-8")
+
+
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
                    a: float = 0.0, b: float = 0.0) -> bytes:
     if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
         payload = _keyed(key, _F64x2.pack(a, b))
+    elif op == OP_HELLO:
+        payload = _keyed(key, b"")  # key carries the auth token
     elif op in (OP_PING, OP_SAVE, OP_STATS):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
-    return _HDR.pack(5 + len(payload), seq, op) + payload
+    return _HDR.pack(_BODY_OFF + len(payload), PROTOCOL_VERSION, seq, op) + payload
 
 
-def decode_request(seq_op_payload: bytes) -> tuple[int, int, str, int, float, float]:
+def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
     """Returns ``(seq, op, key, count, a, b)``."""
-    seq, op = struct.unpack_from("<IB", seq_op_payload, 0)
-    body = seq_op_payload[5:]
+    ver, seq, op = _VER_SEQ_OP.unpack_from(frame, 0)
+    _check_version(ver)
+    body = frame[_BODY_OFF:]
     if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         key, tail = _split_key(body)
         count, a, b = _ACQ_TAIL.unpack(tail)
@@ -136,6 +185,9 @@ def decode_request(seq_op_payload: bytes) -> tuple[int, int, str, int, float, fl
         key, tail = _split_key(body)
         a, b = _F64x2.unpack(tail)
         return seq, op, key, 0, a, b
+    if op == OP_HELLO:
+        token, _ = _split_key(body)
+        return seq, op, token, 0, 0.0, 0.0
     if op in (OP_PING, OP_SAVE, OP_STATS):
         return seq, op, "", 0, 0.0, 0.0
     raise RemoteStoreError(f"unknown op {op}")
@@ -150,19 +202,31 @@ def encode_response(seq: int, kind: int, *vals) -> bytes:
         payload = _PAIR.pack(float(vals[0]), float(vals[1]))
     elif kind == RESP_EMPTY:
         payload = b""
-    elif kind in (RESP_ERROR, RESP_TEXT):
-        mb = str(vals[0]).encode("utf-8")[:0xFFFF]
+    elif kind == RESP_ERROR:
+        mb = _codepoint_truncate(str(vals[0]).encode("utf-8"), 0xFFFF)
         payload = _KEYED.pack(len(mb)) + mb
+    elif kind == RESP_TEXT:
+        # u32 length: a large payload (e.g. MeshBucketStore stats with many
+        # tiers) must never be silently truncated into undecodable JSON —
+        # oversize is a loud error instead, bounded by MAX_FRAME.
+        mb = str(vals[0]).encode("utf-8")
+        if _BODY_OFF + _TEXTLEN.size + len(mb) > MAX_FRAME:
+            raise ValueError(
+                f"text payload of {len(mb)} bytes exceeds MAX_FRAME"
+            )
+        payload = _TEXTLEN.pack(len(mb)) + mb
     else:
         raise ValueError(f"unknown response kind {kind}")
-    return _HDR.pack(5 + len(payload), seq, kind) + payload
+    return _HDR.pack(_BODY_OFF + len(payload), PROTOCOL_VERSION, seq, kind) + payload
 
 
-def decode_response(seq_kind_payload: bytes) -> tuple[int, int, tuple]:
-    """Returns ``(seq, kind, values)``; raises nothing — errors travel as
-    ``(RESP_ERROR, (message,))`` so the client can fail just that future."""
-    seq, kind = struct.unpack_from("<IB", seq_kind_payload, 0)
-    body = seq_kind_payload[5:]
+def decode_response(frame: bytes) -> tuple[int, int, tuple]:
+    """Returns ``(seq, kind, values)``; server-side failures travel as
+    ``(RESP_ERROR, (message,))`` so the client can fail just that future.
+    Raises only for protocol-level breakage (version mismatch)."""
+    ver, seq, kind = _VER_SEQ_OP.unpack_from(frame, 0)
+    _check_version(ver)
+    body = frame[_BODY_OFF:]
     if kind == RESP_DECISION:
         granted, remaining = _DECISION.unpack(body)
         return seq, kind, (bool(granted), remaining)
@@ -172,14 +236,18 @@ def decode_response(seq_kind_payload: bytes) -> tuple[int, int, tuple]:
         return seq, kind, _PAIR.unpack(body)
     if kind == RESP_EMPTY:
         return seq, kind, ()
-    if kind in (RESP_ERROR, RESP_TEXT):
+    if kind == RESP_ERROR:
         (mlen,) = _KEYED.unpack_from(body, 0)
         return seq, kind, (body[2:2 + mlen].decode("utf-8"),)
+    if kind == RESP_TEXT:
+        (mlen,) = _TEXTLEN.unpack_from(body, 0)
+        return seq, kind, (body[4:4 + mlen].decode("utf-8"),)
     raise RemoteStoreError(f"unknown response kind {kind}")
 
 
 async def read_frame(reader) -> bytes | None:
-    """Read one ``[seq][op][payload]`` body; ``None`` on clean EOF."""
+    """Read one ``[version][seq][op][payload]`` body; ``None`` on clean
+    EOF."""
     import asyncio
 
     try:
@@ -187,7 +255,7 @@ async def read_frame(reader) -> bytes | None:
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (length,) = struct.unpack("<I", hdr)
-    if not 5 <= length <= MAX_FRAME:
+    if not _BODY_OFF <= length <= MAX_FRAME:
         raise RemoteStoreError(f"bad frame length {length}")
     try:
         return await reader.readexactly(length)

@@ -176,3 +176,34 @@ def test_fixed_window_table_checkpoint_roundtrip(tmp_path):
     load_snapshot(dev2, path)
     assert dev2.fixed_window_acquire_blocking("f", 1, 5.0, 1.0).granted
     assert not dev2.fixed_window_acquire_blocking("f", 1, 5.0, 1.0).granted
+
+
+def test_v1_snapshot_restores_into_v2_build(tmp_path):
+    """Rollforward compat: a v1 file (no sema sections, 2-tuple wtable
+    keys) loads cleanly — restore treats the newer sections as optional."""
+    import pickle
+
+    clock = ManualClock()
+    dev = _store(clock)
+    dev.acquire_blocking("a", 3, 10.0, 1.0)
+    snap = dev.snapshot()
+    del snap["semas"], snap["sema_dir"]  # what a v1 writer never wrote
+    path = str(tmp_path / "v1.bin")
+    with open(path, "wb") as f:
+        pickle.dump({"magic": "drl-tpu-snapshot", "version": 1,
+                     "snapshot": snap}, f, protocol=5)
+    dev2 = _store(clock)
+    load_snapshot(dev2, path)
+    assert dev2.acquire_blocking("a", 7, 10.0, 1.0).granted
+    assert not dev2.acquire_blocking("a", 1, 10.0, 1.0).granted
+
+
+def test_unknown_newer_version_fails_loudly(tmp_path):
+    import pickle
+
+    path = str(tmp_path / "future.bin")
+    with open(path, "wb") as f:
+        pickle.dump({"magic": "drl-tpu-snapshot", "version": 99,
+                     "snapshot": {}}, f, protocol=5)
+    with pytest.raises(ValueError, match="version 99 not supported"):
+        load_snapshot(_store(ManualClock()), path)

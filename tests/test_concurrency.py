@@ -217,6 +217,77 @@ class TestConcurrencyLimiter:
 
         run(main())
 
+    def test_cancel_after_grant_releases_permits(self):
+        """A waiter cancelled after the drain granted it (future resolved,
+        awaiting task not yet resumed) must release the held permits —
+        otherwise the semaphore's capacity shrinks forever."""
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=1, queue_limit=4,
+                                          instance_name="c8"),
+                InProcessBucketStore())
+            first = await lim.acquire_async(1)
+            waiter = asyncio.create_task(lim.acquire_async(1))
+            await asyncio.sleep(0.01)
+            # release_async drains synchronously on this loop: the waiter's
+            # future is resolved with a held lease before we regain control.
+            await first.release_async()
+            waiter.cancel()  # cancel before the waiter task resumes
+            with pytest.raises(asyncio.CancelledError):
+                await waiter
+            await asyncio.sleep(0.05)  # let the compensating release run
+            assert lim.available_permits() == 1
+            await lim.aclose()
+
+        run(main())
+
+    def test_cancel_midflight_fast_path_releases_grant(self):
+        """A cancel landing while the fast-path store acquire is in flight
+        must not leak the grant the store goes on to make."""
+        class SlowStore(InProcessBucketStore):
+            async def concurrency_acquire(self, key, delta, limit,
+                                          ttl_s=86400.0):
+                await asyncio.sleep(0.05)
+                return await super().concurrency_acquire(key, delta, limit,
+                                                         ttl_s)
+
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=2, queue_limit=4,
+                                          instance_name="c9"),
+                SlowStore())
+            t = asyncio.create_task(lim.acquire_async(2))
+            await asyncio.sleep(0.01)  # t is awaiting the shielded store op
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            await asyncio.sleep(0.2)  # store op completes; release runs
+            assert lim.available_permits() == 2
+            await lim.aclose()
+
+        run(main())
+
+    def test_sync_acquire_does_not_overtake_oldest_first_waiters(self):
+        """The sync path applies the same queue-fairness gate as async:
+        with a parked OLDEST_FIRST waiter, acquire() fails fast even when
+        the store has free permits."""
+        async def main():
+            lim = ConcurrencyLimiter(
+                ConcurrencyLimiterOptions(permit_limit=2, queue_limit=4,
+                                          instance_name="c10"),
+                InProcessBucketStore())
+            a = await lim.acquire_async(1)
+            w = asyncio.create_task(lim.acquire_async(2))  # parks: only 1 free
+            await asyncio.sleep(0.01)
+            assert not w.done()
+            lease = lim.acquire(1)  # 1 permit IS free, but a waiter is ahead
+            assert not lease.is_acquired
+            await a.release_async()  # 2 free -> waiter drains
+            assert (await asyncio.wait_for(w, 2.0)).is_acquired
+            await lim.aclose()
+
+        run(main())
+
 
 class TestDistributedConcurrency:
     def test_two_instances_share_one_semaphore_over_tcp(self):
@@ -310,3 +381,17 @@ class TestProbeIsReadOnly:
         store = InProcessBucketStore()
         store.concurrency_acquire_blocking("ghost", 0, 5)
         assert "ghost" not in store._semas
+
+
+class TestSpuriousRelease:
+    @pytest.mark.parametrize("make_store", [InProcessBucketStore, device_store])
+    def test_release_of_unknown_key_allocates_nothing(self, make_store):
+        store = make_store()
+        store.concurrency_release_blocking("never-acquired", 3)
+        if isinstance(store, DeviceBucketStore):
+            assert store._sema_dir.lookup("never-acquired") is None
+        else:
+            assert "never-acquired" not in store._semas
+        # And the semaphore still behaves normally afterwards.
+        assert store.concurrency_acquire_blocking("never-acquired", 2, 3).granted
+        assert not store.concurrency_acquire_blocking("never-acquired", 2, 3).granted

@@ -68,6 +68,40 @@ class TestWireProtocol:
 
         run(main())
 
+    def test_version_mismatch_detected_not_misparsed(self):
+        frame = wire.encode_request(3, wire.OP_PING)
+        body = bytearray(frame[4:])
+        body[0] = wire.PROTOCOL_VERSION + 1  # a future revision
+        with pytest.raises(wire.ProtocolVersionError, match="version mismatch"):
+            wire.decode_request(bytes(body))
+        resp = wire.encode_response(3, wire.RESP_EMPTY)
+        rbody = bytearray(resp[4:])
+        rbody[0] = 1  # the v1 layout had no version byte at all
+        with pytest.raises(wire.ProtocolVersionError):
+            wire.decode_response(bytes(rbody))
+
+    def test_large_stats_text_not_truncated(self):
+        # > u16 bound: the v1 encoder would have truncated this mid-payload.
+        text = '{"x": "' + "й" * 50_000 + '"}'
+        seq, kind, (out,) = wire.decode_response(
+            wire.encode_response(5, wire.RESP_TEXT, text)[4:])
+        assert out == text
+
+    def test_text_beyond_max_frame_is_loud(self):
+        with pytest.raises(ValueError, match="MAX_FRAME"):
+            wire.encode_response(5, wire.RESP_TEXT, "x" * (wire.MAX_FRAME + 8))
+
+    def test_error_truncates_on_codepoint_boundary(self):
+        msg = "е" * 40_000  # 2 bytes each -> 80_000 bytes > u16 bound
+        seq, kind, (out,) = wire.decode_response(
+            wire.encode_response(5, wire.RESP_ERROR, msg)[4:])
+        assert out == "е" * 32_767  # 0xFFFF // 2, cleanly decodable
+
+    def test_hello_roundtrip(self):
+        frame = wire.encode_request(2, wire.OP_HELLO, "s3cret")
+        seq, op, token, _, _, _ = wire.decode_request(frame[4:])
+        assert (seq, op, token) == (2, wire.OP_HELLO, "s3cret")
+
 
 class TestClientServer:
     def test_acquire_over_tcp(self):
@@ -204,6 +238,80 @@ class TestClientServer:
         store = RemoteBucketStore(url="localhost:1")
         with pytest.raises(NotImplementedError):
             store.snapshot()
+
+
+class TestAuthAndVersion:
+    def test_auth_required_server_rejects_tokenless_client(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         auth_token="hunter2") as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                with pytest.raises(wire.RemoteStoreError,
+                                   match="authentication required"):
+                    await store.acquire("k", 1, 5.0, 1.0)
+                await store.aclose()
+
+        run(main())
+
+    def test_wrong_token_fails_connect(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         auth_token="hunter2") as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port),
+                                          auth_token="wrong")
+                with pytest.raises(wire.RemoteStoreError,
+                                   match="authentication failed"):
+                    await store.acquire("k", 1, 5.0, 1.0)
+                await store.aclose()
+
+        run(main())
+
+    def test_right_token_works_and_reconnects(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         auth_token="hunter2") as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port),
+                                          auth_token="hunter2")
+                assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                # Hello is per-connection: force a reconnect and keep going.
+                await store._await_on_io(_drop(store))
+                assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                await store.aclose()
+
+        run(main())
+
+    def test_hello_optional_when_server_has_no_token(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port),
+                                          auth_token="anything")
+                assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                await store.aclose()
+
+        run(main())
+
+    def test_server_rejects_mismatched_version_frame(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                reader, writer = await asyncio.open_connection(srv.host,
+                                                               srv.port)
+                good = wire.encode_request(9, wire.OP_PING)
+                bad = good[:4] + bytes([wire.PROTOCOL_VERSION + 1]) + good[5:]
+                writer.write(bad)
+                await writer.drain()
+                body = await wire.read_frame(reader)
+                seq, kind, vals = wire.decode_response(body)
+                assert kind == wire.RESP_ERROR
+                assert "version mismatch" in vals[0]
+                # The connection is then dropped, not left misparsing.
+                assert await wire.read_frame(reader) is None
+                writer.close()
+
+        run(main())
+
+
+async def _drop(store):
+    store._drop_connection(ConnectionError("test-forced reconnect"))
 
 
 class TestDistributedLimiters:
