@@ -146,6 +146,57 @@ def bench_single_batch(jnp, K, clock, state):
     return iters * BATCH / dt
 
 
+async def bench_e2e_bulk(store_mod, limiter_mod, options_mod):
+    """End-to-end BULK serving path: ``acquire_many`` arrays through the
+    partitioned limiter — key→slot resolve + packing + scanned dispatch +
+    single-fetch readback all included; several calls overlap in flight.
+    Returns (verdict-only decisions/s, with-remaining decisions/s)."""
+    store = store_mod.DeviceBucketStore(n_slots=1 << 21, max_batch=8192)
+    lim = limiter_mod.PartitionedRateLimiter(
+        options_mod.TokenBucketOptions(
+            token_limit=10_000_000, tokens_per_period=10_000_000,
+            instance_name="bulk"), store)
+    n = 1 << 17
+    rng = np.random.default_rng(2)
+    pool = [f"user{i}" for i in range(1_000_000)]
+    calls = [[pool[j] for j in rng.integers(0, len(pool), n)]
+             for _ in range(8)]
+
+    async def run_round(with_remaining):
+        await lim.acquire_many(calls[0], with_remaining=with_remaining)  # warm
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *(lim.acquire_many(c, with_remaining=with_remaining)
+              for c in calls))
+        dt = time.perf_counter() - t0
+        return sum(len(r) for r in results) / dt
+
+    verdict_only = max([await run_round(False) for _ in range(2)])
+    with_remaining = await run_round(True)
+    await store.aclose()
+    return verdict_only, with_remaining
+
+
+def bench_pallas_sweep(store_mod):
+    """Assert the COMPILED (non-interpret) Pallas streaming sweep works on
+    this platform: force it on, trigger a sweep over reclaimable slots, and
+    report whether the Mosaic path ran without falling back."""
+    from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+
+    clock = ManualClock()
+    store = store_mod.DeviceBucketStore(n_slots=1024, clock=clock,
+                                        use_pallas_sweep=True)
+    for i in range(64):
+        store.acquire_blocking(f"sweep{i}", 1, 10.0, 10.0)
+    clock.advance_seconds(5.0)  # everything refills → TTL-expired
+    table = next(iter(store._tables.values()))
+    table._sweep(None)
+    ok = (store.use_pallas_sweep
+          and store.metrics.pallas_sweep_failures == 0
+          and store.metrics.slots_evicted >= 64)
+    return bool(ok)
+
+
 async def bench_e2e_async(store_mod, limiter_mod, options_mod):
     """End-to-end asyncio path: micro-batched partitioned limiter driven by
     a closed-loop worker pool deep enough to keep several flush readbacks in
@@ -203,8 +254,12 @@ def main():
     throughput, state = bench_kernel_throughput(jnp, K, clock)
     compact, state = bench_compact_throughput(jnp, K, clock, state)
     single = bench_single_batch(jnp, K, clock, state)
+    del state  # free the 10M-slot table before the serving-path stores
+    bulk_rate, bulk_with_rem = asyncio.run(
+        bench_e2e_bulk(store_mod, partitioned, options_mod))
     e2e_rate, p99 = asyncio.run(
         bench_e2e_async(store_mod, partitioned, options_mod))
+    pallas_ok = bench_pallas_sweep(store_mod) if platform == "tpu" else None
 
     print(json.dumps({
         "metric": "permit_decisions_per_sec_per_chip",
@@ -217,8 +272,11 @@ def main():
         "scan_depth": SCAN_K,
         "compact_path_decisions_per_sec": round(compact),
         "single_batch_decisions_per_sec": round(single),
+        "e2e_bulk_decisions_per_sec": round(bulk_rate),
+        "e2e_bulk_with_remaining_decisions_per_sec": round(bulk_with_rem),
         "e2e_async_decisions_per_sec": round(e2e_rate),
         "e2e_p99_low_load_ms": round(p99 * 1e3, 3),
+        "pallas_sweep_ok": pallas_ok,
     }))
 
 

@@ -59,6 +59,7 @@ from distributedratelimiting.redis_tpu.models.partitioned import PartitionedRate
 from distributedratelimiting.redis_tpu.runtime.store import (
     AcquireResult,
     BucketStore,
+    BulkAcquireResult,
     DeviceBucketStore,
     InProcessBucketStore,
     SyncResult,
@@ -101,6 +102,7 @@ __all__ = [
     "ConcurrencyLease",
     "PartitionedRateLimiter",
     "AcquireResult",
+    "BulkAcquireResult",
     "SyncResult",
     "BucketStore",
     "BucketStoreServer",

@@ -45,6 +45,8 @@ __all__ = [
     "acquire_batch_packed",
     "acquire_scan",
     "acquire_scan_compact",
+    "acquire_scan_compact_packed",
+    "acquire_scan_compact_bits",
     "acquire_scan_packed24",
     "pack_slots24",
     "SLOT24_PAD",
@@ -313,6 +315,64 @@ def acquire_scan_compact(state: BucketState, slots_k, counts_k, nows_k,
         body, state, (slots_k, counts_k, nows_k)
     )
     return state, granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan_compact_packed(state: BucketState, slots_k, counts_k,
+                                nows_k, capacity, fill_rate_per_tick, *,
+                                handle_duplicates: bool = True):
+    """:func:`acquire_scan_compact` with a SINGLE packed result array.
+
+    Device→host fetches on tunneled links are round-trip-bound (~tens of
+    ms each regardless of size), so the bulk serving path must resolve a
+    whole call with ONE fetch: ``out f32[K, 2, B]`` stacks ``granted``
+    (0/1, row 0) and ``remaining`` (row 1) per scanned batch. Same
+    decision semantics as the unpacked variant.
+
+    Returns ``(new_state, out f32[K, 2, B])``.
+    """
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, remaining = acquire_core(
+            st, slots, counts.astype(jnp.int32), slots >= 0, now, capacity,
+            fill_rate_per_tick, handle_duplicates=handle_duplicates,
+        )
+        return st, jnp.stack([granted.astype(jnp.float32), remaining])
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan_compact_bits(state: BucketState, slots_k, counts_k,
+                              nows_k, capacity, fill_rate_per_tick, *,
+                              handle_duplicates: bool = True):
+    """Verdict-only scanned dispatch: grants return BIT-PACKED.
+
+    For bulk callers that don't need per-request ``remaining`` (admission
+    gates), the result shrinks from 8 bytes/decision to 1 *bit*/decision —
+    ``out u8[K, B//8]``, little-endian bit order (host side:
+    ``np.unpackbits(..., bitorder="little")``). On tunneled links this
+    turns the device→host fetch from the dominant cost into noise.
+    Requires ``B % 8 == 0`` (every batch size here is a power of two).
+
+    Returns ``(new_state, grant_bits u8[K, B//8])``.
+    """
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, _ = acquire_core(
+            st, slots, counts.astype(jnp.int32), slots >= 0, now, capacity,
+            fill_rate_per_tick, handle_duplicates=handle_duplicates,
+        )
+        bits = (granted.reshape(-1, 8).astype(jnp.uint8)
+                << jnp.arange(8, dtype=jnp.uint8)).sum(
+                    axis=1, dtype=jnp.uint8)
+        return st, bits
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
 
 
 #: Padding sentinel for the 24-bit packed slot layout (all-ones 24 bits).

@@ -276,6 +276,12 @@ def main(argv: list[str] | None = None) -> None:
     """
     import argparse
 
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
+
     parser = argparse.ArgumentParser(description="TPU bucket-store server")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=6380)

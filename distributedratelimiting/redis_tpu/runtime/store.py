@@ -46,6 +46,7 @@ from distributedratelimiting.redis_tpu.utils.tracing import Profiler, ProfilingS
 
 __all__ = [
     "AcquireResult",
+    "BulkAcquireResult",
     "SyncResult",
     "BucketStore",
     "DeviceBucketStore",
@@ -72,6 +73,39 @@ def _shift_ts(ts, shift: int):
 class AcquireResult(NamedTuple):
     granted: bool
     remaining: float  # post-decision token estimate (≙ Lua reply new_v)
+
+
+class BulkAcquireResult:
+    """Vectorized decision results: numpy arrays, not per-request objects.
+
+    The bulk serving path exists because building one Python object (and
+    resolving one future) per decision caps a process near ~50K decisions/s
+    regardless of device speed; callers that hold many keys' requests get
+    the verdicts as two arrays and index only what they need."""
+
+    __slots__ = ("granted", "remaining")
+
+    def __init__(self, granted: np.ndarray,
+                 remaining: np.ndarray | None) -> None:
+        self.granted = granted        # bool[n]
+        # f32[n]; None when the caller opted out (``with_remaining=False``,
+        # the verdict-only fast path — fetches 1 bit/decision).
+        self.remaining = remaining
+
+    def __len__(self) -> int:
+        return len(self.granted)
+
+    def __getitem__(self, i: int) -> AcquireResult:
+        r = 0.0 if self.remaining is None else float(self.remaining[i])
+        return AcquireResult(bool(self.granted[i]), r)
+
+    def __iter__(self):
+        for i in range(len(self.granted)):
+            yield self[i]
+
+    @property
+    def granted_count(self) -> int:
+        return int(np.count_nonzero(self.granted))
 
 
 class SyncResult(NamedTuple):
@@ -114,6 +148,43 @@ class BucketStore(abc.ABC):
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
         """Read-only availability estimate (``GetAvailablePermits``)."""
+
+    # -- bulk token bucket (one call, many keys) ---------------------------
+    async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
+                           capacity: float, fill_rate_per_sec: float, *,
+                           with_remaining: bool = True) -> "BulkAcquireResult":
+        """Vectorized acquire: decide ``len(keys)`` requests in one call —
+        one await resolves them all (no per-request future). Duplicate keys
+        serialize in request order; on batched device stores the in-batch
+        serialization is *conservative* (an earlier same-key request's
+        demand reserves ahead of later ones even if it is denied — the same
+        property as the micro-batched serving path; over-admission is
+        impossible, and the decisions are exact whenever in-call duplicates
+        are all granted or keys are distinct). ``with_remaining=False``
+        lets a verdict-only caller skip the per-request remaining estimates
+        (the device store then fetches 1 bit per decision). Default
+        implementation: a pipelined gather over the per-key path;
+        :class:`DeviceBucketStore` overrides with scanned whole-array
+        kernel launches."""
+        results = await asyncio.gather(
+            *(self.acquire(k, int(c), capacity, fill_rate_per_sec)
+              for k, c in zip(keys, counts)))
+        return BulkAcquireResult(
+            np.fromiter((r.granted for r in results), bool, len(results)),
+            np.fromiter((r.remaining for r in results), np.float32,
+                        len(results)) if with_remaining else None)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], capacity: float,
+                              fill_rate_per_sec: float, *,
+                              with_remaining: bool = True) -> "BulkAcquireResult":
+        results = [self.acquire_blocking(k, int(c), capacity,
+                                         fill_rate_per_sec)
+                   for k, c in zip(keys, counts)]
+        return BulkAcquireResult(
+            np.fromiter((r.granted for r in results), bool, len(results)),
+            np.fromiter((r.remaining for r in results), np.float32,
+                        len(results)) if with_remaining else None)
 
     # -- decaying global counter (approximate algorithm's shared tier) -----
     @abc.abstractmethod
@@ -357,8 +428,10 @@ class _DeviceTable(_PackedLaunchMixin):
             except Exception as exc:  # experimental platform — fall back
                 # Disable after the first failure: a broken Pallas path
                 # would otherwise re-trace and re-fail inside the store
-                # lock on every sweep.
+                # lock on every sweep. The counter makes the silent
+                # fallback observable (the TPU bench asserts it stays 0).
                 self.store.use_pallas_sweep = False
+                self.store.metrics.pallas_sweep_failures += 1
                 log.error_evaluating_kernel(exc)
                 freed_np = None
         if freed_np is None:
@@ -411,6 +484,111 @@ class _DeviceTable(_PackedLaunchMixin):
             )
             self.store.metrics.record_launch(b, len(reqs))
             return out
+
+    # -- bulk decision path ------------------------------------------------
+    #: Max scanned batches per bulk dispatch: 32 × 4096 ≈ 768KB of compact
+    #: operands — under the tunneled link's ~1MB sustained-transfer cliff
+    #: (benchmarks/RESULTS.md) while amortizing dispatch overhead. K is
+    #: chosen per call from {1, 2, 4, …, 32}, so the jit cache holds at
+    #: most 6 bulk variants per table.
+    _BULK_MAX_K = 32
+
+    def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
+                     with_remaining: bool = True) -> list[tuple]:
+        """Dispatch a whole key array as scanned kernel launches; returns
+        per-dispatch device handles (no readback — callers overlap it)."""
+        n = len(keys)
+        b = self.store.max_batch
+        outs: list[tuple] = []
+        # u8 counts ride the 5-bytes/decision compact path; rare oversized
+        # counts fall back to the split layout with an explicit mask.
+        compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
+        with self.store.profiler.span("acquire_many", n), self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            now = self.store.now_ticks_checked()
+            pos = 0
+            while pos < n:
+                rows = -(-(n - pos) // b)  # ceil
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take = min(k * b, n - pos)
+                s = np.full((k * b,), -1, np.int32)
+                s[:take] = slots[pos:pos + take]
+                nows = np.full((k,), now, np.int32)
+                if compact and not with_remaining and b % 8 == 0:
+                    c = np.zeros((k * b,), np.uint8)
+                    c[:take] = counts_np[pos:pos + take]
+                    self.state, out = K.acquire_scan_compact_bits(
+                        self.state, jnp.asarray(s.reshape(k, b)),
+                        jnp.asarray(c.reshape(k, b)), jnp.asarray(nows),
+                        self.cap_dev, self.rate_dev,
+                    )
+                elif compact:
+                    c = np.zeros((k * b,), np.uint8)
+                    c[:take] = counts_np[pos:pos + take]
+                    self.state, out = K.acquire_scan_compact_packed(
+                        self.state, jnp.asarray(s.reshape(k, b)),
+                        jnp.asarray(c.reshape(k, b)), jnp.asarray(nows),
+                        self.cap_dev, self.rate_dev,
+                    )
+                else:
+                    c = np.zeros((k * b,), np.int32)
+                    c[:take] = counts_np[pos:pos + take]
+                    self.state, granted, remaining = K.acquire_scan(
+                        self.state, jnp.asarray(s.reshape(k, b)),
+                        jnp.asarray(c.reshape(k, b)),
+                        jnp.asarray((s >= 0).reshape(k, b)),
+                        jnp.asarray(nows), self.cap_dev, self.rate_dev,
+                    )
+                    # One lazy device op so the fetch below stays single.
+                    out = jnp.stack(
+                        [granted.astype(jnp.float32), remaining], axis=1)
+                outs.append((out, take))
+                self.store.metrics.record_launch(k * b, take)
+                pos += take
+        return outs
+
+    @staticmethod
+    def _gather_bulk(outs: list[tuple], n: int,
+                     with_remaining: bool = True) -> BulkAcquireResult:
+        granted = np.empty((n,), bool)
+        remaining = np.empty((n,), np.float32) if with_remaining else None
+        pos = 0
+        for out, take in outs:
+            # ONE device→host fetch per dispatch (fetches are RTT-bound on
+            # tunneled links — this is the bulk path's whole latency story).
+            out_np = np.asarray(out)
+            if out_np.dtype == np.uint8:       # bit-packed grants
+                bits = np.unpackbits(out_np.reshape(-1), bitorder="little")
+                granted[pos:pos + take] = bits[:take].astype(bool)
+            else:                              # f32[K, 2, B]
+                granted[pos:pos + take] = (
+                    out_np[:, 0, :].reshape(-1)[:take] > 0.5)
+                if remaining is not None:
+                    remaining[pos:pos + take] = (
+                        out_np[:, 1, :].reshape(-1)[:take])
+            pos += take
+        return BulkAcquireResult(granted, remaining)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], *,
+                              with_remaining: bool = True) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._launch_many(keys, counts_np, with_remaining)
+        return self._gather_bulk(outs, len(keys), with_remaining)
+
+    async def acquire_many(self, keys: Sequence[str],
+                           counts: Sequence[int], *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._launch_many(keys, counts_np, with_remaining)
+        loop = asyncio.get_running_loop()
+        # ONE await resolves the whole call; the readback runs off-loop so
+        # the event loop keeps serving (and other bulk calls' dispatches
+        # overlap this one's transfer).
+        return await loop.run_in_executor(
+            None, self._gather_bulk, outs, len(keys), with_remaining)
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
@@ -629,6 +807,24 @@ class DeviceBucketStore(BucketStore):
     def acquire_blocking(self, key: str, count: int, capacity: float,
                          fill_rate_per_sec: float) -> AcquireResult:
         return self._table(capacity, fill_rate_per_sec).acquire_blocking(key, count)
+
+    async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
+                           capacity: float, fill_rate_per_sec: float, *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        """Bulk path: the whole array rides scanned kernel launches — no
+        per-request futures, one await per call (the batching the
+        reference's README promised but never built, ``README.md:7``)."""
+        await self.connect()
+        table = self._table(capacity, fill_rate_per_sec)
+        return await table.acquire_many(keys, counts,
+                                        with_remaining=with_remaining)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], capacity: float,
+                              fill_rate_per_sec: float, *,
+                              with_remaining: bool = True) -> BulkAcquireResult:
+        return self._table(capacity, fill_rate_per_sec).acquire_many_blocking(
+            keys, counts, with_remaining=with_remaining)
 
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:

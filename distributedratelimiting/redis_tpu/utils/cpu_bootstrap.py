@@ -16,9 +16,23 @@ from __future__ import annotations
 
 __all__ = [
     "force_cpu_platform",
+    "maybe_force_cpu_from_env",
     "set_virtual_device_count",
     "XLA_DEVICE_COUNT_FLAG",
 ]
+
+#: When this env var is "1", console entry points (store server, testapp)
+#: pin jax to CPU before first use. Needed because the environment's
+#: sitecustomize overrides ``JAX_PLATFORMS`` programmatically, so child
+#: processes cannot opt out of the remote-TPU plugin via env alone.
+FORCE_CPU_ENV = "DRLT_FORCE_CPU_PLATFORM"
+
+
+def maybe_force_cpu_from_env() -> None:
+    import os
+
+    if os.environ.get(FORCE_CPU_ENV) == "1":
+        force_cpu_platform()
 
 XLA_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
 

@@ -81,6 +81,16 @@ class LimiterMetrics:
         if latency_s is not None:
             self.acquire_latency.record(latency_s)
 
+    def record_bulk(self, n: int, granted: int,
+                    latency_s: float | None = None) -> None:
+        """One bulk call = ``n`` decisions; latency recorded once (it is
+        the whole call's, not any single request's)."""
+        self.decisions += n
+        self.grants += granted
+        self.denials += n - granted
+        if latency_s is not None:
+            self.acquire_latency.record(latency_s)
+
     @property
     def denial_rate(self) -> float:
         return self.denials / self.decisions if self.decisions else 0.0
@@ -111,6 +121,10 @@ class StoreMetrics:
     rows_valid: int = 0
     sweeps: int = 0
     slots_evicted: int = 0
+    # Pallas streaming-sweep fallbacks: nonzero means the compiled Mosaic
+    # path failed on this platform and sweeps silently use the XLA kernel —
+    # the bench asserts this stays 0 on real TPU.
+    pallas_sweep_failures: int = 0
 
     def record_launch(self, batch_rows: int, valid_rows: int) -> None:
         self.launches += 1
@@ -129,4 +143,5 @@ class StoreMetrics:
             "batch_occupancy": self.batch_occupancy,
             "sweeps": self.sweeps,
             "slots_evicted": self.slots_evicted,
+            "pallas_sweep_failures": self.pallas_sweep_failures,
         }

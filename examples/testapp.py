@@ -167,7 +167,7 @@ def cmd_convergence(args) -> int:
     server = subprocess.Popen(
         [sys.executable, "-m",
          "distributedratelimiting.redis_tpu.runtime.server",
-         "--port", str(port), "--backend", "inprocess"],
+         "--port", str(port), "--backend", args.backend],
         cwd=REPO_ROOT, stdout=subprocess.PIPE, text=True,
     )
     try:
@@ -242,6 +242,11 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("convergence", help="server + N workers, check bound")
     p.add_argument("--instances", type=int, default=4)
     p.add_argument("--seconds", type=float, default=8.0)
+    p.add_argument("--backend", choices=("inprocess", "device"),
+                   default="inprocess",
+                   help="store behind the server: device = the TPU/"
+                   "device-resident DeviceBucketStore (the production "
+                   "topology: N processes → TCP → device store)")
     p.set_defaults(fn=cmd_convergence)
 
     args = parser.parse_args(argv)
@@ -250,4 +255,9 @@ def main(argv: list[str] | None = None) -> int:
 
 if __name__ == "__main__":
     sys.path.insert(0, REPO_ROOT)
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
     sys.exit(main())

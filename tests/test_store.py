@@ -265,3 +265,171 @@ class TestSweepPinning:
             assert not dev.acquire_blocking("a", 1, 10.0, 10.0).granted
 
         run(main())
+
+
+class TestBulkAcquire:
+    """acquire_many: one call decides a whole key array, semantics
+    identical to issuing the requests in order (duplicates serialize)."""
+
+    def test_bulk_agrees_with_sequential_inprocess_reference(self, clock, rng):
+        """Exact parity on duplicate-free calls (duplicates across calls
+        and across time are fine — only in-call duplicates are decided
+        conservatively, covered by the next test)."""
+        dev = device_store(clock, max_batch=8)  # force multi-chunk dispatch
+        ref = InProcessBucketStore(clock=clock)
+        cap, rate = 10.0, 4.0
+        for _ in range(4):
+            perm = rng.permutation(24)
+            keys = [f"k{i}" for i in perm]
+            counts = [int(rng.integers(0, 4)) for _ in range(24)]
+            bulk = dev.acquire_many_blocking(keys, counts, cap, rate)
+            seq = [ref.acquire_blocking(k, c, cap, rate)
+                   for k, c in zip(keys, counts)]
+            assert [bool(g) for g in bulk.granted] == [r.granted for r in seq]
+            np.testing.assert_allclose(
+                bulk.remaining, [r.remaining for r in seq], atol=1e-4)
+            clock.advance_seconds(0.5)
+
+    def test_bulk_duplicates_conservative_never_over_admit(self, clock, rng):
+        """In-call duplicates: total granted permits per key never exceed
+        what the bucket held (the invariant); denials may be conservative
+        relative to a serial replay (the documented trade)."""
+        dev = device_store(clock, max_batch=8)
+        cap, rate = 10.0, 0.0  # no refill: clean conservation accounting
+        keys = [f"k{rng.integers(4)}" for _ in range(60)]
+        counts = [int(rng.integers(0, 5)) for _ in range(60)]
+        bulk = dev.acquire_many_blocking(keys, counts, cap, rate)
+        spent: dict[str, int] = {}
+        for k, c, g in zip(keys, counts, bulk.granted):
+            if g:
+                spent[k] = spent.get(k, 0) + c
+        assert all(v <= cap for v in spent.values()), spent
+
+    def test_bulk_async_single_await(self, clock):
+        dev = device_store(clock, max_batch=8)
+
+        async def main():
+            res = await dev.acquire_many(
+                [f"a{i}" for i in range(20)], [1] * 20, 5.0, 1.0)
+            assert len(res) == 20
+            # cap 5: every fresh key grants once... all distinct keys here.
+            assert res.granted_count == 20
+            # Same key 8 times, cap 5 -> exactly 5 grants in-order.
+            res2 = await dev.acquire_many(["hot"] * 8, [1] * 8, 5.0, 1.0)
+            assert [bool(g) for g in res2.granted] == [True] * 5 + [False] * 3
+            await dev.aclose()
+
+        run(main())
+
+    def test_bulk_oversized_counts_fall_back_to_split_layout(self, clock):
+        dev = device_store(clock, max_batch=8)
+        res = dev.acquire_many_blocking(
+            ["big", "big", "small"], [300, 300, 1], 500.0, 1.0)
+        assert [bool(g) for g in res.granted] == [True, False, True]
+
+    def test_bulk_result_indexing_and_iter(self, clock):
+        dev = device_store(clock)
+        res = dev.acquire_many_blocking(["x", "y"], [1, 9], 5.0, 1.0)
+        assert res[0].granted and not res[1].granted
+        as_list = list(res)
+        assert as_list[0].granted and not as_list[1].granted
+        assert len(res) == 2 and res.granted_count == 1
+
+    def test_bulk_empty_call(self, clock):
+        dev = device_store(clock)
+        res = dev.acquire_many_blocking([], [], 5.0, 1.0)
+        assert len(res) == 0 and res.granted_count == 0
+
+    def test_bulk_default_path_on_inprocess_and_remote_parity(self, clock):
+        ref = InProcessBucketStore(clock=clock)
+        res = ref.acquire_many_blocking(["a"] * 7, [1] * 7, 5.0, 1.0)
+        assert [bool(g) for g in res.granted] == [True] * 5 + [False] * 2
+
+        async def main():
+            ref2 = InProcessBucketStore(clock=clock)
+            res2 = await ref2.acquire_many(["b"] * 7, [1] * 7, 5.0, 1.0)
+            assert res2.granted_count == 5
+
+        run(main())
+
+
+class TestBulkLimiter:
+    def test_partitioned_acquire_many(self, clock):
+        from distributedratelimiting.redis_tpu.models.options import (
+            TokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.models.partitioned import (
+            PartitionedRateLimiter,
+        )
+
+        dev = device_store(clock, max_batch=8)
+        lim = PartitionedRateLimiter(
+            TokenBucketOptions(token_limit=5, tokens_per_period=1,
+                               instance_name="bulk"), dev)
+
+        async def main():
+            res = await lim.acquire_many([f"u{i % 10}" for i in range(50)])
+            assert len(res) == 50
+            # 10 partitions x cap 5 = 50 grants possible; 5 requests each.
+            assert res.granted_count == 50
+            res2 = await lim.acquire_many(["u0"] * 3)
+            assert res2.granted_count == 0  # u0 drained
+            assert lim.metrics.decisions == 53
+            return True
+
+        assert run(main())
+
+    def test_partitioned_bulk_per_resource_permits_validated(self, clock):
+        from distributedratelimiting.redis_tpu.models.options import (
+            TokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.models.partitioned import (
+            PartitionedRateLimiter,
+        )
+
+        dev = device_store(clock)
+        lim = PartitionedRateLimiter(
+            TokenBucketOptions(token_limit=5, tokens_per_period=1,
+                               instance_name="bulk2"), dev)
+        with pytest.raises(ValueError):
+            lim.acquire_many_blocking(["a", "b"], [1, 99])  # over limit
+        with pytest.raises(ValueError):
+            lim.acquire_many_blocking(["a", "b"], [1])  # length mismatch
+        res = lim.acquire_many_blocking(["a", "b"], [2, 9 - 5])
+        assert res.granted_count == 2
+
+
+class TestBulkVerdictOnly:
+    def test_bits_path_matches_full_path(self, clock, rng):
+        dev = device_store(clock, max_batch=8)
+        dev2 = device_store(ManualClock(), max_batch=8)
+        keys = [f"k{rng.integers(12)}" for _ in range(64)]
+        full = dev.acquire_many_blocking(keys, [1] * 64, 5.0, 1.0)
+        bits = dev2.acquire_many_blocking(keys, [1] * 64, 5.0, 1.0,
+                                          with_remaining=False)
+        assert bits.remaining is None
+        assert [bool(g) for g in bits.granted] == \
+               [bool(g) for g in full.granted]
+        assert bits[0].remaining == 0.0  # indexing still works
+
+
+def test_partitioned_bulk_zero_permit_probe_always_granted():
+    """Bulk keeps the single-request contract: permits=0 is granted
+    unconditionally, even riding beside a denied same-key request."""
+    from distributedratelimiting.redis_tpu.models.options import (
+        TokenBucketOptions,
+    )
+    from distributedratelimiting.redis_tpu.models.partitioned import (
+        PartitionedRateLimiter,
+    )
+
+    clock = ManualClock()
+    dev = device_store(clock)
+    lim = PartitionedRateLimiter(
+        TokenBucketOptions(token_limit=5, tokens_per_period=1,
+                           instance_name="zp"), dev)
+    lim.acquire("k", 2)  # bucket at 3
+    res = lim.acquire_many_blocking(["k", "k"], [5, 0])
+    assert not res[0].granted         # 5 > 3
+    assert res[1].granted             # probe: unconditional, as in acquire()
+    assert lim.acquire("k", 0).is_acquired
