@@ -326,9 +326,12 @@ def duplicate_prefix(slots, counts, valid):
     ``i`` is granted only if the refilled balance covers ``prefix[i] +
     counts[i]``. Counting *all* earlier same-slot demand (granted or not) can
     only under-admit relative to true serial order — never over-admit —
-    preserving atomicity (invariant 3) at batch granularity. The host
-    micro-batcher additionally coalesces duplicates across flushes so this
-    conservative path is rare (SURVEY.md §7 "Hard parts").
+    preserving atomicity (invariant 3) at batch granularity. The serving
+    flush path additionally coalesces same-key requests gathered into one
+    flush into grouped rows (``store._DeviceTable._flush`` →
+    ``kernels.acquire_batch_packed_grouped``), so hot keys occupy one row
+    instead of many and this in-kernel sort only serves paths that ship no
+    host prefix (SURVEY.md §7 "Hard parts").
 
     Implemented as a stable sort by slot + segmented exclusive prefix sum —
     O(B log B) with O(B) memory traffic, cheap enough that the dup-safe

@@ -43,6 +43,7 @@ __all__ = [
     "acquire_core",
     "acquire_batch",
     "acquire_batch_packed",
+    "acquire_batch_packed_grouped",
     "acquire_scan",
     "acquire_scan_compact",
     "acquire_scan_compact_packed",
@@ -59,6 +60,7 @@ __all__ = [
     "rebase_sema_epoch",
     "window_acquire_batch",
     "window_acquire_batch_packed",
+    "window_acquire_batch_packed_grouped",
     "window_acquire_scan",
     "window_acquire_scan_compact",
     "sweep_expired",
@@ -247,6 +249,64 @@ def acquire_batch_packed(state: BucketState, packed, capacity,
     )
     out = jnp.stack([granted.astype(jnp.float32), remaining])
     return new_state, out
+
+
+@partial(jax.jit, donate_argnums=0)
+def acquire_batch_packed_grouped(state: BucketState, packed, capacity,
+                                 fill_rate_per_tick):
+    """Coalesced-duplicates flush kernel: one row per ``(key, count)``
+    GROUP instead of one row per request (SURVEY.md §7 "Hard parts" —
+    Zipf hot keys hammering one slot must not eat the whole batch).
+
+    ``packed i32[5, B]``: row 0 slots (-1 ⇒ padding), row 1 per-request
+    count ``c``, row 2 broadcast batch timestamp, row 3 host-computed
+    same-slot demand prefix (earlier groups' total integer demand), row 4
+    group size ``n`` (number of identical requests).
+
+    Grant rule — exactly the per-row conservative serialization, closed
+    over ``n`` identical requests: the first ``n_granted = clamp(floor(
+    (refilled − prefix) / c), 0, n)`` members are granted (``c == 0``
+    probe groups grant all ``n``, consuming nothing). Consumption is
+    ``n_granted · c``, so a group decision is bit-identical to ``n``
+    per-row decisions with cumulative prefixes.
+
+    Returns ``(new_state, out f32[2, B])``: ``out[0] = n_granted`` per
+    group, ``out[1] = post-consumption remaining`` (every member's view).
+    """
+    slots = packed[0]
+    counts = packed[1]
+    now = packed[2, 0]
+    prefix = jnp.asarray(packed[3], jnp.float32)
+    n_reqs = packed[4]
+    size = state.tokens.shape[0]
+    valid = _valid_slots(slots, slots >= 0, size)
+    gs = _gather_slots(slots, valid)
+
+    refilled = bm.refill_or_init(state.tokens[gs], state.last_ts[gs],
+                                 state.exists[gs], now, capacity,
+                                 fill_rate_per_tick)
+    c = jnp.asarray(counts, jnp.float32)
+    n = jnp.asarray(n_reqs, jnp.float32)
+    avail = refilled - prefix
+    n_granted = jnp.where(
+        c > 0,
+        jnp.clip(jnp.floor(avail / jnp.maximum(c, 1.0)), 0.0, n),
+        # c == 0 probe group: granted iff the balance covers the prefix —
+        # the same `refilled >= prefix + 0` rule as the per-row kernel.
+        jnp.where(avail >= 0, n, 0.0),
+    )
+    n_granted = jnp.where(valid, n_granted, 0.0)
+    consumed = n_granted * c
+    remaining = jnp.where(valid, jnp.maximum(avail - consumed, 0.0), 0.0)
+
+    ss = _scatter_slots(slots, valid, size)
+    new_tokens = state.tokens.at[ss].set(refilled, mode="drop")
+    new_tokens = new_tokens.at[ss].add(-consumed, mode="drop")
+    new_last_ts = state.last_ts.at[ss].set(jnp.asarray(now, jnp.int32),
+                                           mode="drop")
+    new_exists = state.exists.at[ss].set(True, mode="drop")
+    out = jnp.stack([n_granted, remaining])
+    return BucketState(new_tokens, new_last_ts, new_exists), out
 
 
 @partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
@@ -731,6 +791,61 @@ def window_acquire_batch_packed(state: WindowState, packed, limit,
     )
     out = jnp.stack([granted.astype(jnp.float32), remaining])
     return new_state, out
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("interpolate",))
+def window_acquire_batch_packed_grouped(state: WindowState, packed, limit,
+                                        window_ticks, *,
+                                        interpolate: bool = True):
+    """Coalesced-duplicates window flush — the window-table analogue of
+    :func:`acquire_batch_packed_grouped` (same ``packed i32[5, B]``
+    layout). Grant rule per group: ``n_granted = clamp(floor((limit −
+    est − prefix) / c), 0, n)`` (``c == 0`` probes grant all ``n`` iff the
+    window estimate plus prefix still fits the limit), bit-identical to
+    ``n`` per-row decisions with cumulative prefixes.
+
+    Returns ``(new_state, out f32[2, B])``: ``out[0] = n_granted``,
+    ``out[1] = post-consumption remaining``.
+    """
+    slots = packed[0]
+    counts = packed[1]
+    now = packed[2, 0]
+    prefix = jnp.asarray(packed[3], jnp.float32)
+    n_reqs = packed[4]
+    size = state.prev_count.shape[0]
+    valid = _valid_slots(slots, slots >= 0, size)
+    gs = _gather_slots(slots, valid)
+
+    prev_new, curr_new, idx_new = bm.sliding_window_advance(
+        state.prev_count[gs], state.curr_count[gs], state.window_idx[gs],
+        state.exists[gs], now, window_ticks,
+    )
+    if interpolate:
+        est = bm.sliding_window_estimate(prev_new, curr_new, idx_new, now,
+                                         window_ticks)
+    else:
+        est = curr_new
+
+    c = jnp.asarray(counts, jnp.float32)
+    n = jnp.asarray(n_reqs, jnp.float32)
+    avail = jnp.asarray(limit, jnp.float32) - est - prefix
+    n_granted = jnp.where(
+        c > 0,
+        jnp.clip(jnp.floor(avail / jnp.maximum(c, 1.0)), 0.0, n),
+        jnp.where(avail >= 0, n, 0.0),
+    )
+    n_granted = jnp.where(valid, n_granted, 0.0)
+    consumed = n_granted * c
+    remaining = jnp.where(valid, jnp.maximum(avail - consumed, 0.0), 0.0)
+
+    ss = _scatter_slots(slots, valid, size)
+    prev_arr = state.prev_count.at[ss].set(prev_new, mode="drop")
+    curr_arr = state.curr_count.at[ss].set(curr_new, mode="drop")
+    curr_arr = curr_arr.at[ss].add(consumed, mode="drop")
+    idx_arr = state.window_idx.at[ss].set(idx_new, mode="drop")
+    ex_arr = state.exists.at[ss].set(True, mode="drop")
+    out = jnp.stack([n_granted, remaining])
+    return WindowState(prev_arr, curr_arr, idx_arr, ex_arr), out
 
 
 @partial(jax.jit, donate_argnums=0)

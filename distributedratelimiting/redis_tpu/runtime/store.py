@@ -334,20 +334,107 @@ def _resolve_with_reclaim(directory, keys: list[str], sweep, grow) -> np.ndarray
 
 
 class _PackedLaunchMixin:
-    """Shared readback convention for tables whose ``_launch`` returns the
-    packed ``f32[2, B]`` result (row 0 grants, row 1 remaining)."""
+    """Shared flush machinery for tables whose ``_launch`` returns the
+    packed ``f32[2, B]`` result (row 0 grants, row 1 remaining): readback
+    convention plus cross-submit same-key coalescing. Duplicate keys in
+    one flush collapse to one launch row per ``(key, count)`` group via
+    the table's ``_launch_grouped`` (the Zipf hot-key win — a hot key no
+    longer eats the batch), verdicts fanned back out in arrival order.
+    Decision semantics are bit-identical to the per-row conservative
+    serialization (``bucket_math.duplicate_prefix``); keys whose in-flush
+    counts are mixed fall back to per-row entries with exact cumulative
+    prefixes."""
 
     async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
-        out = self._launch(reqs)
+        groups = (self._coalesce(reqs)
+                  if self.store.coalesce_duplicates else None)
         loop = asyncio.get_running_loop()
         # Block for device results on an executor thread so the event loop
         # keeps accumulating the next flush; readbacks of distinct flushes
         # overlap (see MicroBatcher). One packed array = one transfer.
+        if groups is None:
+            out = self._launch(reqs)
+            out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+            return [
+                AcquireResult(bool(out_np[0, i] > 0.5), float(out_np[1, i]))
+                for i in range(len(reqs))
+            ]
+        out = self._dispatch_grouped(groups)
         out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
-        return [
-            AcquireResult(bool(out_np[0, i] > 0.5), float(out_np[1, i]))
-            for i in range(len(reqs))
-        ]
+        results: list[AcquireResult | None] = [None] * len(reqs)
+        for g, (_, count, _, members, _) in enumerate(groups):
+            n_granted = int(out_np[0, g])
+            # Reconstruct each member's exact per-row remaining view from
+            # the group result: avail = post-consumption remaining +
+            # consumed (clamping matches the per-row kernel's, since a
+            # negative avail yields 0 either way).
+            avail = float(out_np[1, g]) + n_granted * count
+            for j, idx in enumerate(members):
+                granted = j < n_granted
+                results[idx] = AcquireResult(
+                    granted,
+                    max(avail - j * count - (count if granted else 0), 0.0))
+        return results  # type: ignore[return-value]
+
+    @staticmethod
+    def _coalesce(reqs: Sequence[_AcquireReq]):
+        """Group requests for the grouped kernels; ``None`` when there are
+        no duplicates (the classic single-row-per-request path is used)."""
+        by_key: dict[str, list[int]] = {}
+        for i, r in enumerate(reqs):
+            by_key.setdefault(r.key, []).append(i)
+        if len(by_key) == len(reqs):
+            return None
+        # (key, count, n, member_indices, prefix)
+        groups: list[tuple[str, int, int, list[int], int]] = []
+        for key, members in by_key.items():
+            counts = {reqs[i].count for i in members}
+            if len(counts) == 1:
+                groups.append((key, counts.pop(), len(members), members, 0))
+            else:
+                # Mixed counts for one key: per-request rows with exact
+                # cumulative prefixes (identical to the per-row kernel).
+                pref = 0
+                for i in members:
+                    # Saturate like _build_packed does — a huge cumulative
+                    # prefix must under-admit, not overflow the i32 operand.
+                    groups.append((key, reqs[i].count, 1, [i],
+                                   min(pref, 2**31 - 1)))
+                    pref += reqs[i].count
+        return groups
+
+    def _dispatch_grouped(self, groups):
+        """Pack groups into the shared i32[5, B] operand and hand it to
+        the table's grouped kernel (``_launch_grouped``)."""
+        with self.store.profiler.span("acquire_batch_grouped",
+                                      len(groups)), self.store._lock:
+            slots = self.resolve_slots([g[0] for g in groups])
+            b = self.store.max_batch
+            now = self.store.now_ticks_checked()
+            packed = np.full((5, b), -1, np.int32)
+            packed[1] = 0
+            packed[3] = 0
+            packed[4] = 0
+            n = len(groups)
+            packed[0, :n] = slots
+            packed[1, :n] = [g[1] for g in groups]
+            packed[2] = now
+            packed[3, :n] = [g[4] for g in groups]
+            packed[4, :n] = [g[2] for g in groups]
+            out = self._launch_grouped(jnp.asarray(packed))
+            n_reqs = sum(g[2] for g in groups)
+            self.store.metrics.record_launch(b, n)
+            self.store.metrics.rows_coalesced += n_reqs - n
+            return out
+
+    def _warm_grouped(self) -> None:
+        """Compile the grouped kernel at table construction (all-padding
+        operand, state values untouched). Lazily compiling it on the first
+        duplicate-containing flush would land a ~1s TPU compile inside the
+        store lock at an unpredictable point mid-serving."""
+        packed = np.full((5, self.store.max_batch), -1, np.int32)
+        packed[1:] = 0
+        jax.block_until_ready(self._launch_grouped(jnp.asarray(packed)))
 
     def acquire_blocking(self, key: str, count: int) -> AcquireResult:
         out_np = np.asarray(self._launch([_AcquireReq(key, count)]))
@@ -377,6 +464,8 @@ class _DeviceTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
         )
+        if store.coalesce_duplicates:
+            self._warm_grouped()
 
     # -- slot management ---------------------------------------------------
     def resolve_slots(self, keys: list[str]) -> np.ndarray:
@@ -461,6 +550,12 @@ class _DeviceTable(_PackedLaunchMixin):
         self.n_slots = new_n
 
     # -- decision paths ----------------------------------------------------
+    def _launch_grouped(self, packed):
+        self.state, out = K.acquire_batch_packed_grouped(
+            self.state, packed, self.cap_dev, self.rate_dev,
+        )
+        return out
+
     def _launch(self, reqs: Sequence[_AcquireReq]):
         """Build padded arrays and dispatch one acquire kernel launch.
 
@@ -630,6 +725,8 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
         )
+        if store.coalesce_duplicates:
+            self._warm_grouped()
 
     def resolve_slots(self, keys: list[str]) -> np.ndarray:
         return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
@@ -668,6 +765,13 @@ class _DeviceWindowTable(_PackedLaunchMixin):
         self.dir.add_slots(old_n, old_n * 2)
         self.n_slots = old_n * 2
 
+    def _launch_grouped(self, packed):
+        self.state, out = K.window_acquire_batch_packed_grouped(
+            self.state, packed, self.limit_dev, self.window_dev,
+            interpolate=not self.fixed,
+        )
+        return out
+
     def _launch(self, reqs: Sequence[_AcquireReq]):
         # Same dispatch discipline as _DeviceTable.
         with self.store.profiler.span("window_acquire_batch", len(reqs)), \
@@ -697,6 +801,7 @@ class DeviceBucketStore(BucketStore):
         max_delay_s: float = 200e-6,
         max_inflight: int = 8,
         use_pallas_sweep: bool | None = None,
+        coalesce_duplicates: bool = True,
         profiling_session: Callable[[], ProfilingSession | None] | None = None,
         rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS,
     ) -> None:
@@ -708,6 +813,9 @@ class DeviceBucketStore(BucketStore):
         if use_pallas_sweep is None:
             use_pallas_sweep = jax.devices()[0].platform == "tpu"
         self.use_pallas_sweep = use_pallas_sweep
+        # Flush-level same-key coalescing (False = ablation/debug: every
+        # request is its own launch row, in-kernel prefix serialization).
+        self.coalesce_duplicates = coalesce_duplicates
         self.n_slots_default = n_slots
         self.counter_slots = counter_slots
         self.max_batch = max_batch

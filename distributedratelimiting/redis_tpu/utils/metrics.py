@@ -125,6 +125,9 @@ class StoreMetrics:
     # path failed on this platform and sweeps silently use the XLA kernel —
     # the bench asserts this stays 0 on real TPU.
     pallas_sweep_failures: int = 0
+    # Duplicate requests merged away by flush coalescing (requests minus
+    # launch rows) — the Zipf hot-key win's direct measure.
+    rows_coalesced: int = 0
 
     def record_launch(self, batch_rows: int, valid_rows: int) -> None:
         self.launches += 1
@@ -144,4 +147,5 @@ class StoreMetrics:
             "sweeps": self.sweeps,
             "slots_evicted": self.slots_evicted,
             "pallas_sweep_failures": self.pallas_sweep_failures,
+            "rows_coalesced": self.rows_coalesced,
         }

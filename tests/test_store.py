@@ -433,3 +433,161 @@ def test_partitioned_bulk_zero_permit_probe_always_granted():
     assert not res[0].granted         # 5 > 3
     assert res[1].granted             # probe: unconditional, as in acquire()
     assert lim.acquire("k", 0).is_acquired
+
+
+class TestFlushCoalescing:
+    """Same-key requests in one flush collapse to one launch row
+    (grouped kernel), verdicts identical to per-row serialization."""
+
+    def test_hot_key_one_row_first_n_granted(self, clock):
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+
+        async def main():
+            results = await asyncio.gather(
+                *(dev.acquire("hot", 1, 5.0, 1.0) for _ in range(32)))
+            grants = [r.granted for r in results]
+            assert grants == [True] * 5 + [False] * 27
+            # 32 requests rode as ONE launch row.
+            assert dev.metrics.rows_coalesced == 31
+            assert dev.metrics.rows_valid == 1
+            await dev.aclose()
+
+        run(main())
+
+    def test_mixed_hot_and_cold_keys(self, clock):
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+
+        async def main():
+            reqs = [("hot", 1)] * 10 + [("cold1", 2), ("cold2", 2)] \
+                + [("hot", 1)] * 10
+            results = await asyncio.gather(
+                *(dev.acquire(k, c, 5.0, 1.0) for k, c in reqs))
+            hot = [r.granted for i, r in enumerate(results)
+                   if reqs[i][0] == "hot"]
+            assert sum(hot) == 5 and hot == [True] * 5 + [False] * 15
+            assert all(r.granted for i, r in enumerate(results)
+                       if reqs[i][0] != "hot")
+            # 22 requests -> 3 rows (hot group + 2 singles).
+            assert dev.metrics.rows_coalesced == 19
+            await dev.aclose()
+
+        run(main())
+
+    def test_mixed_counts_same_key_stay_exact(self, clock):
+        """A key with differing counts in one flush falls back to exact
+        per-row cumulative prefixes: 3+1+1 at cap 5 -> all granted, then
+        denial."""
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+
+        async def main():
+            counts = [3, 1, 1, 2]
+            results = await asyncio.gather(
+                *(dev.acquire("mk", c, 5.0, 1.0) for c in counts))
+            assert [r.granted for r in results] == [True, True, True, False]
+            await dev.aclose()
+
+        run(main())
+
+    def test_zero_count_probe_groups(self, clock):
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+
+        async def main():
+            # Probes beside real requests: granted while balance covers the
+            # earlier (conservative) demand.
+            results = await asyncio.gather(
+                dev.acquire("p", 2, 5.0, 1.0),
+                dev.acquire("p", 0, 5.0, 1.0),
+                dev.acquire("p", 0, 5.0, 1.0),
+            )
+            assert [r.granted for r in results] == [True, True, True]
+            await dev.aclose()
+
+        run(main())
+
+    def test_coalesced_agrees_with_serial_inprocess(self, clock, rng):
+        """Differential: duplicate-heavy async traffic vs the serial
+        reference, uniform counts per key (the coalesced regime)."""
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+        ref = InProcessBucketStore(clock=clock)
+        cap, rate = 12.0, 3.0
+
+        async def main():
+            for round_ in range(6):
+                clock.advance_seconds(1.0)
+                keys = [f"k{rng.integers(3)}" for _ in range(24)]
+                got = await asyncio.gather(
+                    *(dev.acquire(k, 1, cap, rate) for k in keys))
+                want = [ref.acquire_blocking(k, 1, cap, rate) for k in keys]
+                # Per-key grant totals must match (arrival order inside one
+                # flush is the gather order — same as the serial replay).
+                for key in set(keys):
+                    got_n = sum(g.granted for g, kk in zip(got, keys)
+                                if kk == key)
+                    want_n = sum(w.granted for w, kk in zip(want, keys)
+                                 if kk == key)
+                    assert got_n == want_n, (round_, key)
+            await dev.aclose()
+
+        run(main())
+
+    def test_window_table_hot_key_coalesces(self, clock):
+        """Window limiters share the coalescing machinery: a hot key is one
+        launch row, first-n-granted semantics."""
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+
+        async def main():
+            results = await asyncio.gather(
+                *(dev.window_acquire("hot", 1, 5.0, 10.0) for _ in range(20)))
+            grants = [r.granted for r in results]
+            assert grants == [True] * 5 + [False] * 15
+            assert dev.metrics.rows_coalesced == 19
+            # Serial reference agreement on a fresh store.
+            ref = InProcessBucketStore(clock=clock)
+            want = [ref.window_acquire_blocking("hot", 1, 5.0, 10.0)
+                    for _ in range(20)]
+            assert grants == [w.granted for w in want]
+            await dev.aclose()
+
+        run(main())
+
+    def test_ablation_toggle_off_uses_per_row_path(self, clock, rng):
+        """coalesce_duplicates=False re-enables the per-row host-prefix
+        flush; decisions agree with the serial reference the same way."""
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005,
+                           coalesce_duplicates=False)
+        ref = InProcessBucketStore(clock=clock)
+
+        async def main():
+            keys = [f"k{rng.integers(3)}" for _ in range(24)]
+            got = await asyncio.gather(
+                *(dev.acquire(k, 1, 12.0, 3.0) for k in keys))
+            want = [ref.acquire_blocking(k, 1, 12.0, 3.0) for k in keys]
+            for key in set(keys):
+                assert (sum(g.granted for g, kk in zip(got, keys) if kk == key)
+                        == sum(w.granted for w, kk in zip(want, keys)
+                               if kk == key))
+            assert dev.metrics.rows_coalesced == 0
+            await dev.aclose()
+
+        run(main())
+
+    def test_coalesced_remaining_matches_per_row_view(self, clock):
+        """Each member's remaining is its exact per-row conservative view,
+        not the group-wide post-consumption value."""
+        dev = device_store(clock, max_batch=64, max_delay_s=0.005)
+        off = device_store(ManualClock(), max_batch=64, max_delay_s=0.005,
+                           coalesce_duplicates=False)
+
+        async def main():
+            got = await asyncio.gather(
+                *(dev.acquire("h", 1, 5.0, 1.0) for _ in range(8)))
+            want = await asyncio.gather(
+                *(off.acquire("h", 1, 5.0, 1.0) for _ in range(8)))
+            assert [(r.granted, r.remaining) for r in got] == \
+                   [(r.granted, r.remaining) for r in want]
+            # First grant sees 4 left, not the group's post-consumption 0.
+            assert got[0] == (True, 4.0)
+            await dev.aclose()
+            await off.aclose()
+
+        run(main())
