@@ -41,6 +41,12 @@ class TokenBucketOptions:
     tokens_per_period: int = 1
     replenishment_period_s: float = 1.0
     instance_name: str = "rate-limiter"
+    #: Expected live key count (partitioned/keyed usage). When set and the
+    #: store supports reservation (DeviceBucketStore), the backing table
+    #: is pre-sized at limiter construction so the serving path never hits
+    #: a growth (grow recompiles kernels for the new size — a p99 cliff
+    #: the pre-size avoids entirely; see DESIGN.md "Table growth").
+    expected_keys: int | None = None
 
     def __post_init__(self) -> None:
         if self.token_limit <= 0:
@@ -52,6 +58,8 @@ class TokenBucketOptions:
                 "replenishment_period_s must be > 0 (a zero period would "
                 "make the fill rate infinite)"
             )
+        if self.expected_keys is not None and self.expected_keys <= 0:
+            raise ValueError("expected_keys must be > 0 when set")
 
     @property
     def fill_rate_per_second(self) -> float:
@@ -120,12 +128,16 @@ class FixedWindowOptions:
     permit_limit: int = 100
     window_s: float = 1.0
     instance_name: str = "rate-limiter"
+    #: See TokenBucketOptions.expected_keys — pre-sizes the window table.
+    expected_keys: int | None = None
 
     def __post_init__(self) -> None:
         if self.permit_limit <= 0:
             raise ValueError("permit_limit must be > 0")
         if self.window_s <= 0:
             raise ValueError("window_s must be > 0")
+        if self.expected_keys is not None and self.expected_keys <= 0:
+            raise ValueError("expected_keys must be > 0 when set")
 
 
 @dataclass(frozen=True)
@@ -135,9 +147,13 @@ class SlidingWindowOptions:
     permit_limit: int = 100
     window_s: float = 1.0
     instance_name: str = "rate-limiter"
+    #: See TokenBucketOptions.expected_keys — pre-sizes the window table.
+    expected_keys: int | None = None
 
     def __post_init__(self) -> None:
         if self.permit_limit <= 0:
             raise ValueError("permit_limit must be > 0")
         if self.window_s <= 0:
             raise ValueError("window_s must be > 0")
+        if self.expected_keys is not None and self.expected_keys <= 0:
+            raise ValueError("expected_keys must be > 0 when set")

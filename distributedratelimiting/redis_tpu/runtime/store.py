@@ -317,17 +317,24 @@ def _build_packed(reqs: Sequence[_AcquireReq], slots: Sequence[int], b: int,
     return packed
 
 
-def _resolve_with_reclaim(directory, keys: list[str], sweep, grow) -> np.ndarray:
+def _resolve_with_reclaim(directory, keys: list[str], sweep, grow, *,
+                          min_free: int = 0) -> np.ndarray:
     """Batch key→slot resolution with the shared reclaim discipline: on
     free-list exhaustion mid-batch, sweep expired slots (pinning the ones
     already resolved for this batch), grow if still dry, re-resolve —
     already-allocated keys are idempotent lookups, and each dry iteration
-    doubles capacity, so the loop terminates."""
+    doubles capacity, so the loop terminates.
+
+    ``min_free`` adds sweep-first *hysteresis*: when a sweep reclaims only
+    a trickle (≤ ``min_free`` slots), the table grows anyway — otherwise a
+    near-full table of live keys re-runs a full sweep on nearly every
+    allocation (each freeing a slot or two), a throughput cliff worse than
+    one doubling."""
     slots = directory.resolve_batch(keys)
     while (slots < 0).any():
         pinned = {int(s) for s in slots[slots >= 0]}
         sweep(pinned)
-        if directory.free_count == 0:
+        if directory.free_count <= min_free:
             grow()
         slots = directory.resolve_batch(keys)
     return slots
@@ -436,6 +443,30 @@ class _PackedLaunchMixin:
         packed[1:] = 0
         jax.block_until_ready(self._launch_grouped(jnp.asarray(packed)))
 
+    # -- growth de-cliffing -------------------------------------------------
+    def _maybe_pregrow(self) -> None:
+        """When the table crosses 75% occupancy, pre-compile the serving
+        kernels for the doubled size on a background thread — OUTSIDE the
+        store lock — so the eventual ``_grow`` swap finds them in the jit
+        cache instead of stalling the serving path for the recompile
+        (~1 s/size on TPU; see DESIGN.md "Table growth")."""
+        target = self.n_slots * 2
+        if (self._pregrow_target < target
+                and self.dir.free_count * 4 < self.n_slots):
+            self._pregrow_target = target
+            threading.Thread(
+                target=self._pregrow_worker, args=(target,),
+                name="table-pregrow", daemon=True,
+            ).start()
+
+    def _pregrow_worker(self, n_slots: int) -> None:
+        try:
+            with self.store.profiler.span("pregrow_warm", n_slots):
+                self._warm_for_size(n_slots)
+            self.store.metrics.pregrows += 1
+        except Exception as exc:  # a failed warm only costs the old cliff
+            log.error_evaluating_kernel(exc)
+
     def acquire_blocking(self, key: str, count: int) -> AcquireResult:
         out_np = np.asarray(self._launch([_AcquireReq(key, count)]))
         return AcquireResult(bool(out_np[0, 0] > 0.5), float(out_np[1, 0]))
@@ -464,13 +495,35 @@ class _DeviceTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
         )
+        self._pregrow_target = 0
         if store.coalesce_duplicates:
             self._warm_grouped()
 
     # -- slot management ---------------------------------------------------
     def resolve_slots(self, keys: list[str]) -> np.ndarray:
         """Batch key→slot resolution (the host hot path — one native call)."""
-        return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
+        slots = _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow,
+                                      min_free=self.n_slots // 16)
+        self._maybe_pregrow()
+        return slots
+
+    def _warm_for_size(self, n_slots: int) -> None:
+        """One dummy pass of every serving+sweep kernel at ``n_slots`` —
+        populates the jit cache for the post-grow shapes."""
+        b = self.store.max_batch
+        state = K.init_bucket_state(n_slots)
+        packed = np.full((4, b), -1, np.int32)
+        packed[1:] = 0
+        state, out = K.acquire_batch_packed(
+            state, jnp.asarray(packed), self.cap_dev, self.rate_dev)
+        state, _ = K.sweep_expired(state, jnp.int32(0), self.cap_dev,
+                                   self.rate_dev)
+        if self.store.coalesce_duplicates:
+            packed5 = np.full((5, b), -1, np.int32)
+            packed5[1:] = 0
+            state, out = K.acquire_batch_packed_grouped(
+                state, jnp.asarray(packed5), self.cap_dev, self.rate_dev)
+        jax.block_until_ready(out)
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
         """Reclaim slots whose buckets have sat full-refilled past TTL
@@ -725,11 +778,32 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
         )
+        self._pregrow_target = 0
         if store.coalesce_duplicates:
             self._warm_grouped()
 
     def resolve_slots(self, keys: list[str]) -> np.ndarray:
-        return _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow)
+        slots = _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow,
+                                      min_free=self.n_slots // 16)
+        self._maybe_pregrow()
+        return slots
+
+    def _warm_for_size(self, n_slots: int) -> None:
+        b = self.store.max_batch
+        state = K.init_window_state(n_slots)
+        packed = np.full((4, b), -1, np.int32)
+        packed[1:] = 0
+        state, out = K.window_acquire_batch_packed(
+            state, jnp.asarray(packed), self.limit_dev, self.window_dev,
+            interpolate=not self.fixed)
+        state, _ = K.sweep_windows(state, jnp.int32(0), self.window_dev)
+        if self.store.coalesce_duplicates:
+            packed5 = np.full((5, b), -1, np.int32)
+            packed5[1:] = 0
+            state, out = K.window_acquire_batch_packed_grouped(
+                state, jnp.asarray(packed5), self.limit_dev, self.window_dev,
+                interpolate=not self.fixed)
+        jax.block_until_ready(out)
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
         with self.store.profiler.span("sweep_windows", self.n_slots):
