@@ -154,8 +154,16 @@ class ConcurrencyLimiter(RateLimiter):
                 res = await asyncio.shield(acq)
             except asyncio.CancelledError:
                 self.metrics.cancelled += 1
-                acq.add_done_callback(
-                    lambda t, n=permits: self._release_if_granted(t, n))
+                # Track a wrapper that awaits the in-flight store op AND its
+                # compensating release as ONE drain task: if only the
+                # release (created later by a done-callback) were tracked,
+                # an aclose() racing the still-in-flight acquire would find
+                # nothing to await and the granted permits would strand in
+                # the SHARED store.
+                cleanup = acq.get_loop().create_task(
+                    self._await_release_if_granted(acq, permits))
+                self._drain_tasks.add(cleanup)
+                cleanup.add_done_callback(self._drain_tasks.discard)
                 raise
             if res.granted:
                 return self._lease(permits)
@@ -183,16 +191,17 @@ class ConcurrencyLimiter(RateLimiter):
         self.metrics.record_decision(lease.is_acquired)
         return lease
 
-    def _release_if_granted(self, acq: asyncio.Task, permits: int) -> None:
-        """Done-callback for a cancelled-but-shielded store acquire: if the
-        store ended up granting, return the permits."""
-        if acq.cancelled() or acq.exception() is not None:
-            return
-        if acq.result().granted:
-            task = acq.get_loop().create_task(self.store.concurrency_release(
-                self.options.instance_name, permits))
-            self._drain_tasks.add(task)
-            task.add_done_callback(self._drain_tasks.discard)
+    async def _await_release_if_granted(self, acq: asyncio.Task,
+                                        permits: int) -> None:
+        """Cleanup for a cancelled-but-shielded store acquire: wait for the
+        store's verdict; if it granted, return the permits."""
+        try:
+            res = await acq
+        except (asyncio.CancelledError, Exception):
+            return  # acquire never granted — nothing to return
+        if res.granted:
+            await self.store.concurrency_release(
+                self.options.instance_name, permits)
 
     def _spawn_release(self, lease: ConcurrencyLease) -> None:
         task = asyncio.get_running_loop().create_task(lease.release_async())

@@ -113,14 +113,9 @@ class PartitionedRateLimiter:
         return keys, counts
 
     def _record_bulk(self, res, counts, t0: float) -> None:
-        # Zero-permit requests are unconditionally granted on the
-        # single-request paths (lines above); keep bulk identical — the
-        # device's conservative in-batch prefix could otherwise deny a
-        # probe that rode along with a denied same-key request.
-        if 0 in counts:
-            import numpy as np
-
-            res.granted[np.asarray(counts) == 0] = True
+        # Zero-permit probes are granted at the STORE layer on every bulk
+        # path (BucketStore._grant_probes / the per-request kernel), so the
+        # limiter needs no patch-up here.
         self.metrics.record_bulk(len(res), res.granted_count,
                                  time.perf_counter() - t0)
 

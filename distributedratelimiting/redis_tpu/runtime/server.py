@@ -22,6 +22,7 @@ multiplexed Redis connection).
 from __future__ import annotations
 
 import asyncio
+import hmac
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
@@ -118,7 +119,8 @@ class BucketStoreServer:
                                               wire.RESP_ERROR,
                                               "malformed HELLO frame"))
                         break
-                    if self.auth_token is not None and token != self.auth_token:
+                    if self.auth_token is not None and not hmac.compare_digest(
+                            token.encode(), self.auth_token.encode()):
                         await self._reply(writer, write_lock,
                                           wire.encode_response(
                                               seq, wire.RESP_ERROR,

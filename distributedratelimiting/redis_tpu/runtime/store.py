@@ -509,7 +509,14 @@ class _DeviceTable(_PackedLaunchMixin):
 
     def _warm_for_size(self, n_slots: int) -> None:
         """One dummy pass of every serving+sweep kernel at ``n_slots`` —
-        populates the jit cache for the post-grow shapes."""
+        populates the jit cache for the post-grow shapes. Includes the
+        K=``_BULK_MAX_K`` scan variants (the large-``acquire_many`` shape)
+        so the first post-grow bulk call doesn't hit the ~1s recompile
+        cliff the pregrow machinery exists to remove; smaller tail-K
+        chunks may still compile lazily (cheaper, and off the common
+        path). The dummy state is freed eagerly at the end — the warm
+        runs concurrently with the live table, so holding it would keep
+        transient device memory at ~3× through the 75%-occupancy window."""
         b = self.store.max_batch
         state = K.init_bucket_state(n_slots)
         packed = np.full((4, b), -1, np.int32)
@@ -523,7 +530,20 @@ class _DeviceTable(_PackedLaunchMixin):
             packed5[1:] = 0
             state, out = K.acquire_batch_packed_grouped(
                 state, jnp.asarray(packed5), self.cap_dev, self.rate_dev)
+        k = self._BULK_MAX_K
+        s = np.full((k, b), -1, np.int32)
+        nows = np.zeros((k,), np.int32)
+        c8 = np.zeros((k, b), np.uint8)
+        state, out = K.acquire_scan_compact_packed(
+            state, jnp.asarray(s), jnp.asarray(c8), jnp.asarray(nows),
+            self.cap_dev, self.rate_dev)
+        if b % 8 == 0:
+            state, out = K.acquire_scan_compact_bits(
+                state, jnp.asarray(s), jnp.asarray(c8), jnp.asarray(nows),
+                self.cap_dev, self.rate_dev)
         jax.block_until_ready(out)
+        for arr in state:
+            arr.delete()
 
     def _sweep(self, pinned: set[int] | None = None) -> None:
         """Reclaim slots whose buckets have sat full-refilled past TTL
@@ -719,12 +739,25 @@ class _DeviceTable(_PackedLaunchMixin):
             pos += take
         return BulkAcquireResult(granted, remaining)
 
+    @staticmethod
+    def _grant_probes(res: BulkAcquireResult,
+                      counts_np: np.ndarray) -> BulkAcquireResult:
+        """Zero-permit probes are granted unconditionally on every
+        single-request path (the kernel's ``new_v >= 0`` is always true);
+        the bulk path's conservative in-batch prefix could deny a probe
+        riding beside denied same-key demand — override here so direct
+        store callers see one contract (not just limiters that patch up)."""
+        if (counts_np == 0).any():
+            res.granted[counts_np == 0] = True
+        return res
+
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], *,
                               with_remaining: bool = True) -> BulkAcquireResult:
         counts_np = np.asarray(counts, np.int64)
         outs = self._launch_many(keys, counts_np, with_remaining)
-        return self._gather_bulk(outs, len(keys), with_remaining)
+        return self._grant_probes(
+            self._gather_bulk(outs, len(keys), with_remaining), counts_np)
 
     async def acquire_many(self, keys: Sequence[str],
                            counts: Sequence[int], *,
@@ -735,8 +768,9 @@ class _DeviceTable(_PackedLaunchMixin):
         # ONE await resolves the whole call; the readback runs off-loop so
         # the event loop keeps serving (and other bulk calls' dispatches
         # overlap this one's transfer).
-        return await loop.run_in_executor(
+        res = await loop.run_in_executor(
             None, self._gather_bulk, outs, len(keys), with_remaining)
+        return self._grant_probes(res, counts_np)
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
