@@ -46,6 +46,9 @@ class PartitionedRateLimiter:
         self.store = store
         self.partition_key = partition_key
         self.metrics = LimiterMetrics()
+        # Lazily-bound per-config hot path (store.acquire_submitter):
+        # created on first acquire_async so construction stays device-free.
+        self._submit = None
 
     def _key(self, resource: object) -> str:
         # Key concatenation, one store bucket per partition (dead ref :42).
@@ -91,11 +94,13 @@ class PartitionedRateLimiter:
         self._check_permits(permits)
         if permits == 0:
             return SUCCESSFUL_LEASE
+        submit = self._submit
+        if submit is None:
+            submit = self._submit = self.store.acquire_submitter(
+                self.options.token_limit, self.options.fill_rate_per_second)
+            await self.store.connect()
         t0 = time.perf_counter()
-        res = await self.store.acquire(
-            self._key(resource), permits, self.options.token_limit,
-            self.options.fill_rate_per_second,
-        )
+        res = await submit(self._key(resource), permits)
         return self._lease(res.granted, res.remaining, permits,
                            time.perf_counter() - t0)
 

@@ -439,6 +439,24 @@ def acquire_scan_compact_bits(state: BucketState, slots_k, counts_k,
 SLOT24_PAD = (1 << 24) - 1
 
 
+def pack_compact5(slots, counts):
+    """Host-side packing for :func:`acquire_scan_compact_fused`: i32 slot
+    ids (-1 = padding) + u8 counts → little-endian u8[..., 5] (bytes 0-3
+    the slot, byte 4 the count). One array per dispatch: on tunneled links
+    each host→device transfer pays a per-transfer floor on top of
+    bandwidth, so the 5-byte layout must travel as ONE operand — shipping
+    slots and counts separately halves the sustained rate (measured; see
+    benchmarks/RESULTS.md round-4 notes)."""
+    import numpy as np
+
+    slots = np.asarray(slots, np.int32)
+    out = np.empty((*slots.shape, 5), np.uint8)
+    out[..., :4] = slots.astype("<i4").view(np.uint8).reshape(
+        *slots.shape, 4)
+    out[..., 4] = counts
+    return out
+
+
 def pack_slots24(slots):
     """Host-side packing for :func:`acquire_scan_packed24`: i32 slot ids
     (or ``SLOT24_PAD`` for padding rows) → little-endian u8[..., 3].
@@ -459,6 +477,39 @@ def pack_slots24(slots):
     out[..., 1] = (slots >> 8) & 0xFF
     out[..., 2] = (slots >> 16) & 0xFF
     return out
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan_compact_fused(state: BucketState, fused, nows_k, capacity,
+                               fill_rate_per_tick, *,
+                               handle_duplicates: bool = True):
+    """:func:`acquire_scan_compact` with slots + counts fused into ONE
+    operand array: ``fused u8[K, B, 5]`` from :func:`pack_compact5`.
+    Decision semantics identical; transfer count per dispatch drops from
+    two arrays to one, which on per-transfer-floor-bound links (the
+    tunneled TPU) roughly doubles the sustained rate of the mixed-count
+    path. Padding rows carry slot -1 (all-ones bytes 0-3).
+
+    Returns ``(new_state, granted bool[K, B], remaining f32[K, B])``.
+    """
+    p = fused.astype(jnp.int32)
+    # int32 bit-ops reassemble the LE slot exactly, including -1 padding
+    # (0xFF in byte 3 lands the sign bit via the <<24).
+    slots_k = p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16) | (p[..., 3] << 24)
+    counts_k = p[..., 4]
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, remaining = acquire_core(
+            st, slots, counts, slots >= 0, now, capacity,
+            fill_rate_per_tick, handle_duplicates=handle_duplicates,
+        )
+        return st, (granted, remaining)
+
+    state, (granted, remaining) = jax.lax.scan(
+        body, state, (slots_k, counts_k, nows_k)
+    )
+    return state, granted, remaining
 
 
 @partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))

@@ -231,6 +231,30 @@ class MeshBucketStore(BucketStore):
         return self._sharded(capacity, fill_rate_per_sec
                              ).acquire_batch_blocking([(key, count)])[0]
 
+    async def acquire_many(self, keys, counts, capacity: float,
+                           fill_rate_per_sec: float, *,
+                           with_remaining: bool = True):
+        """Bulk path over the mesh: the whole array rides the scanned
+        two-level step (sharded acquire + psum per scanned batch) — no
+        per-request futures. This is what a BucketStoreServer fronting a
+        pod slice serves OP_ACQUIRE_MANY with."""
+        await self.connect()
+        self._maybe_rebase_all()
+        store = self._sharded(capacity, fill_rate_per_sec)
+        loop = asyncio.get_running_loop()
+        # The fused launches + readback block; run off-loop so the event
+        # loop keeps serving other connections' traffic.
+        return await loop.run_in_executor(
+            None, lambda: store.acquire_many_blocking(
+                keys, counts, with_remaining=with_remaining))
+
+    def acquire_many_blocking(self, keys, counts, capacity: float,
+                              fill_rate_per_sec: float, *,
+                              with_remaining: bool = True):
+        self._maybe_rebase_all()
+        return self._sharded(capacity, fill_rate_per_sec).acquire_many_blocking(
+            keys, counts, with_remaining=with_remaining)
+
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
         # Read-only: never allocates a slot or writes device state.

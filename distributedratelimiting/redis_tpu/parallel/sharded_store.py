@@ -32,14 +32,17 @@ from distributedratelimiting.redis_tpu.ops import bucket_math as bm
 from distributedratelimiting.redis_tpu.ops import kernels as K
 from distributedratelimiting.redis_tpu.parallel.mesh import SHARD_AXIS
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.directory import make_directory
 from distributedratelimiting.redis_tpu.runtime.store import (
     AcquireResult,
+    BulkAcquireResult,
     _pad_size,
     _REBASE_MARGIN_TICKS,
     _REBASE_THRESHOLD_TICKS,
     _shift_ts,
 )
 from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
+from distributedratelimiting.redis_tpu.utils.native import load_directory_lib
 
 __all__ = [
     "GlobalCounter",
@@ -48,6 +51,7 @@ __all__ = [
     "make_two_level_scan_step",
     "ShardedDeviceStore",
     "shard_of_key",
+    "route_keys",
 ]
 
 
@@ -86,6 +90,27 @@ def shard_of_key(key: str, n_shards: int) -> int:
     on every host routes identically — the distributed directory needs no
     coordination."""
     return zlib.crc32(key.encode()) % n_shards
+
+
+def route_keys(keys: "Sequence[str] | list[str]", n_shards: int) -> np.ndarray:
+    """Vectorized :func:`shard_of_key` over a batch: one native C call for
+    the whole batch when the directory library is built (the same zero-copy
+    list[str] path the key directory uses), a Python crc32 loop otherwise.
+    Both agree bit-for-bit with ``zlib.crc32(key) % n_shards``."""
+    import ctypes
+
+    n = len(keys)
+    lib = load_directory_lib()
+    if lib is not None and lib.has_pylist:
+        if not isinstance(keys, list):
+            keys = list(keys)
+        out = np.empty(n, np.int32)
+        if lib.dir_route_pylist(
+                keys, n_shards,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))) == 0:
+            return out
+    return np.fromiter((zlib.crc32(k.encode()) % n_shards for k in keys),
+                       np.int32, n)
 
 
 def make_sharded_acquire_step(mesh, *, handle_duplicates: bool = True):
@@ -255,36 +280,90 @@ class ShardedDeviceStore:
         )
         self._step = make_two_level_step(mesh,
                                          handle_duplicates=handle_duplicates)
-        self.directory: dict[str, tuple[int, int]] = {}
-        self.free: list[list[int]] = [
-            list(range(per_shard_slots - 1, -1, -1)) for _ in range(self.n_shards)
-        ]
+        self._scan_step = make_two_level_scan_step(
+            mesh, handle_duplicates=handle_duplicates)
+        # One key→local-slot directory per shard (C++ batch-resolve when
+        # buildable — runtime/directory.py); routing key→shard is crc32.
+        self.dirs = [make_directory(per_shard_slots)
+                     for _ in range(self.n_shards)]
         import threading
 
         self._lock = threading.RLock()
 
     # -- slot routing ------------------------------------------------------
-    def _slot_for(self, key: str,
-                  new_allocs: list[str] | None = None,
-                  pinned: set[tuple[int, int]] | None = None) -> tuple[int, int]:
-        loc = self.directory.get(key)
-        if loc is None:
-            shard = shard_of_key(key, self.n_shards)
-            if not self.free[shard]:
-                # Try reclaiming expired slots before failing, as the
-                # single-chip allocator does (store.py _allocate).
+    @property
+    def directory(self) -> dict[str, tuple[int, int]]:
+        """Merged ``key → (shard, local slot)`` view (diagnostics/tests;
+        the serving path never materializes this)."""
+        return {
+            key: (shard, local)
+            for shard, d in enumerate(self.dirs)
+            for key, local in d.to_dict().items()
+        }
+
+    def _resolve_batch(self, keys: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized key→(shard, local) resolution for a whole batch: one
+        native routing call + one directory batch-resolve per touched shard
+        (the mesh analogue of the single-chip one-C-call-per-flush resolve).
+        On free-list exhaustion: sweep (pinning this batch's already-
+        resolved slots), then grow every shard, re-resolving until all keys
+        land — the single-chip reclaim discipline (store.py
+        ``_resolve_with_reclaim``), with growth keeping the geometry
+        homogeneous across shards."""
+        shards = route_keys(keys, self.n_shards)
+        locs = np.empty(len(keys), np.int32)
+        # (shard, local) pairs already resolved for THIS batch, across
+        # every shard processed so far — a sweep triggered by a later
+        # shard's exhaustion must not reclaim an earlier shard's
+        # TTL-expired slot that this batch is about to dispatch to (the
+        # mid-batch cross-contamination hazard). Kept as pairs, not flat
+        # ids: growth mid-loop re-lays the flat index space.
+        resolved: list[tuple[int, int]] = []
+        for shard in np.unique(shards):
+            idx = np.nonzero(shards == shard)[0]
+            sub = [keys[i] for i in idx.tolist()]
+            d = self.dirs[shard]
+            slots = d.resolve_batch(sub)
+            while (slots < 0).any():
+                pinned = {s * self.per_shard + l for s, l in resolved}
+                pinned.update(int(shard) * self.per_shard + int(s)
+                              for s in slots[slots >= 0])
                 self._sweep_locked(pinned)
-            if not self.free[shard]:
-                raise RuntimeError(
-                    f"shard {shard} is out of slots even after a sweep "
-                    f"(per_shard_slots={self.per_shard}); size the table for "
-                    "the live key population"
-                )
-            loc = (shard, self.free[shard].pop())
-            self.directory[key] = loc
-            if new_allocs is not None:
-                new_allocs.append(key)
-        return loc
+                if d.free_count * 16 <= self.per_shard:
+                    # Sweep-first hysteresis: a trickle of reclaimed slots
+                    # on a near-full table would re-sweep on every batch —
+                    # grow instead (all shards, keeping geometry uniform).
+                    self._grow()
+                slots = d.resolve_batch(sub)
+            locs[idx] = slots
+            resolved.extend((int(shard), int(s)) for s in slots)
+        return shards, locs
+
+    def _grow(self) -> None:
+        """Double every shard's slot capacity in place. The sharded layout
+        is contiguous per shard, so growth re-lays the flat arrays as
+        ``[n_shards, per_shard]`` blocks padded to twice the width — one
+        host round-trip, amortized O(log growth) times over a store's life
+        (the single-chip table's doubling discipline, store.py ``_grow``).
+        Kernels recompile at the new shape on next launch."""
+        old, new = self.per_shard, self.per_shard * 2
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+
+        def widen(arr):
+            host = np.asarray(arr).reshape(self.n_shards, old)
+            out = np.zeros((self.n_shards, new), host.dtype)
+            out[:, :old] = host
+            return jax.device_put(out.reshape(-1), sharding)
+
+        self.state = K.BucketState(
+            tokens=widen(self.state.tokens),
+            last_ts=widen(self.state.last_ts),
+            exists=widen(self.state.exists),
+        )
+        for d in self.dirs:
+            d.add_slots(old, new)
+        self.per_shard = new
+        self.metrics.pregrows += 1
 
     def now_ticks_checked(self) -> int:
         """Store clock read with the same int32-overflow protection as the
@@ -320,10 +399,10 @@ class ShardedDeviceStore:
         """Read-only availability estimate: never allocates a slot or
         writes device state (the ``GetAvailablePermits`` contract)."""
         with self._lock:
-            loc = self.directory.get(key)
-            if loc is None:
+            shard = shard_of_key(key, self.n_shards)
+            local = self.dirs[shard].lookup(key)
+            if local is None:
                 return float(np.floor(self.capacity))
-            shard, local = loc
             idx = shard * self.per_shard + local
             now = self.now_ticks_checked()
             # One jitted gather with the index as an OPERAND (a Python-int
@@ -351,36 +430,33 @@ class ShardedDeviceStore:
         with self._lock:
             return self._acquire_locked(requests, decay)
 
+    def _group_by_shard(self, shards: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-request row position within its shard's queue (stable in
+        request order — duplicate keys keep arrival order for the kernel's
+        prefix serialization) plus the per-shard load histogram."""
+        n = len(shards)
+        shard_counts = np.bincount(shards, minlength=self.n_shards)
+        starts = np.zeros(self.n_shards + 1, np.int64)
+        np.cumsum(shard_counts, out=starts[1:])
+        order = np.argsort(shards, kind="stable")
+        jpos = np.empty(n, np.int64)
+        jpos[order] = np.arange(n) - starts[shards[order]]
+        return jpos, shard_counts
+
     def _acquire_locked(self, requests, decay) -> list[AcquireResult]:
-        groups: list[list[int]] = [[] for _ in range(self.n_shards)]
-        locs: list[tuple[int, int]] = []
-        new_allocs: list[str] = []
-        pinned: set[tuple[int, int]] = set()
-        try:
-            for i, (key, _count) in enumerate(requests):
-                shard, local = self._slot_for(key, new_allocs, pinned)
-                locs.append((shard, local))
-                groups[shard].append(i)
-                pinned.add((shard, local))
-        except RuntimeError:
-            # Roll back this batch's fresh allocations: their device
-            # `exists` bits were never set, so the TTL sweep could never
-            # reclaim them — without rollback they would leak forever.
-            for key in new_allocs:
-                shard, local = self.directory.pop(key)
-                self.free[shard].append(local)
-            raise
-        b_local = _pad_size(max((len(g) for g in groups), default=1), floor=8)
+        n = len(requests)
+        keys = [k for k, _ in requests]
+        counts = np.fromiter((c for _, c in requests), np.int64, n)
+        shards, locs = self._resolve_batch(keys)
+        jpos, shard_counts = self._group_by_shard(shards)
+        b_local = _pad_size(int(shard_counts.max(initial=1)), floor=8)
         slots_np = np.full((self.n_shards, b_local), -1, np.int32)
         counts_np = np.zeros((self.n_shards, b_local), np.int32)
         valid_np = np.zeros((self.n_shards, b_local), bool)
-        pos: list[tuple[int, int]] = [(-1, -1)] * len(requests)
-        for shard, idxs in enumerate(groups):
-            for j, i in enumerate(idxs):
-                slots_np[shard, j] = locs[i][1]
-                counts_np[shard, j] = requests[i][1]
-                valid_np[shard, j] = True
-                pos[i] = (shard, j)
+        slots_np[shards, jpos] = locs
+        counts_np[shards, jpos] = counts
+        valid_np[shards, jpos] = True
         now = self.now_ticks_checked()
         self.state, granted, remaining, self.gcounter = self._step(
             self.state,
@@ -388,12 +464,82 @@ class ShardedDeviceStore:
             jnp.int32(now), jnp.float32(self.capacity),
             jnp.float32(self.rate_per_tick), self.gcounter, jnp.float32(decay),
         )
-        g_np = np.asarray(granted)
-        r_np = np.asarray(remaining)
-        self.metrics.record_launch(self.n_shards * b_local, len(requests))
-        return [
-            AcquireResult(bool(g_np[s, j]), float(r_np[s, j])) for s, j in pos
-        ]
+        g_np = np.asarray(granted)[shards, jpos]
+        r_np = np.asarray(remaining)[shards, jpos]
+        self.metrics.record_launch(self.n_shards * b_local, n)
+        return [AcquireResult(bool(g), float(r)) for g, r in zip(g_np, r_np)]
+
+    # -- bulk decisions (the mesh serving surface for acquire_many) --------
+    #: Max scanned batches per fused dispatch (see DeviceBucketStore
+    #: _BULK_MAX_K: bounds the jit cache to power-of-two K variants).
+    _BULK_MAX_K = 32
+    #: Per-shard row width of one scanned batch.
+    _BULK_B = 2048
+
+    def acquire_many_blocking(
+        self, keys: Sequence[str], counts: Sequence[int], *,
+        with_remaining: bool = True,
+        decay_rate_per_sec: float | None = None,
+    ) -> BulkAcquireResult:
+        """Whole-array bulk acquire over the mesh: vectorized key→(shard,
+        local) resolve, batch laid out ``[n_shards, K, B]``, decided by the
+        scanned two-level step (sharded acquire + one psum per scanned
+        batch). This is the serving surface for
+        :func:`make_two_level_scan_step` — each dispatch decides up to
+        ``n_shards × K × B`` requests in one fused launch."""
+        n = len(keys)
+        decay = (decay_rate_per_sec if decay_rate_per_sec is not None
+                 else self.fill_rate_per_sec) / bm.TICKS_PER_SECOND
+        counts_np = np.asarray(counts, np.int64)
+        granted_out = np.empty(n, bool)
+        rem_out = np.empty(n, np.float32) if with_remaining else None
+        if n == 0:
+            return BulkAcquireResult(granted_out, rem_out)
+        with self._lock:
+            shards, locs = self._resolve_batch(list(keys))
+            jpos, shard_counts = self._group_by_shard(shards)
+            max_rows = int(shard_counts.max(initial=1))
+            b = _pad_size(min(max_rows, self._BULK_B), floor=8)
+            cap = jnp.float32(self.capacity)
+            rate = jnp.float32(self.rate_per_tick)
+            decay_dev = jnp.float32(decay)
+            pos = 0
+            while pos < max_rows:
+                rows = -(-(max_rows - pos) // b)  # ceil
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take_rows = k * b
+                sel = (jpos >= pos) & (jpos < pos + take_rows)
+                rel = (jpos[sel] - pos).astype(np.int64)
+                s_sel = shards[sel]
+                slots_chunk = np.full((self.n_shards, k, b), -1, np.int32)
+                counts_chunk = np.zeros((self.n_shards, k, b), np.int32)
+                valid_chunk = np.zeros((self.n_shards, k, b), bool)
+                slots_chunk[s_sel, rel // b, rel % b] = locs[sel]
+                counts_chunk[s_sel, rel // b, rel % b] = counts_np[sel]
+                valid_chunk[s_sel, rel // b, rel % b] = True
+                now = self.now_ticks_checked()
+                nows = np.full((k,), now, np.int32)
+                self.state, granted, remaining, self.gcounter = self._scan_step(
+                    self.state, jnp.asarray(slots_chunk),
+                    jnp.asarray(counts_chunk), jnp.asarray(valid_chunk),
+                    jnp.asarray(nows), cap, rate, self.gcounter, decay_dev,
+                )
+                g_np = np.asarray(granted)
+                granted_out[sel] = g_np[s_sel, rel // b, rel % b] > 0.5
+                if rem_out is not None:
+                    r_np = np.asarray(remaining)
+                    rem_out[sel] = r_np[s_sel, rel // b, rel % b]
+                self.metrics.record_launch(self.n_shards * take_rows,
+                                           int(sel.sum()))
+                pos += take_rows
+        if (counts_np == 0).any():
+            # Zero-permit probes are granted unconditionally on every
+            # single-request path; the bulk path's conservative in-batch
+            # prefix could deny one riding beside denied same-key demand.
+            granted_out[counts_np == 0] = True
+        return BulkAcquireResult(granted_out, rem_out)
 
     @property
     def global_score(self) -> float:
@@ -411,8 +557,7 @@ class ShardedDeviceStore:
                 "per_shard": self.per_shard,
                 "capacity": self.capacity,
                 "fill_rate_per_sec": self.fill_rate_per_sec,
-                "directory": dict(self.directory),
-                "free": [list(f) for f in self.free],
+                "directories": [d.to_dict() for d in self.dirs],
                 "tokens": np.asarray(self.state.tokens),
                 "last_ts": np.asarray(self.state.last_ts),
                 "exists": np.asarray(self.state.exists),
@@ -426,12 +571,18 @@ class ShardedDeviceStore:
 
     def restore(self, snap: dict) -> None:
         with self._lock:
-            if (snap["n_shards"] != self.n_shards
-                    or snap["per_shard"] != self.per_shard):
+            if snap["n_shards"] != self.n_shards:
+                # Re-sharding a snapshot is real key-redistribution work;
+                # adopting a different per-shard WIDTH is not — the state
+                # arrays and directories below are rebuilt wholesale from
+                # the snapshot, so a store that grew (per-shard doubling)
+                # before checkpointing restores into a fresh store fine.
                 raise ValueError(
                     f"snapshot geometry {snap['n_shards']}x{snap['per_shard']}"
-                    f" != store geometry {self.n_shards}x{self.per_shard}"
+                    f" != store geometry {self.n_shards}x{self.per_shard} "
+                    "(shard count must match)"
                 )
+            self.per_shard = int(snap["per_shard"])
             if (snap["capacity"] != self.capacity
                     or snap["fill_rate_per_sec"] != self.fill_rate_per_sec):
                 # Token balances are only meaningful under the config they
@@ -461,8 +612,8 @@ class ShardedDeviceStore:
                 ),
                 NamedSharding(self.mesh, P()),
             )
-            self.directory = dict(snap["directory"])
-            self.free = [list(f) for f in snap["free"]]
+            for d, mapping in zip(self.dirs, snap["directories"]):
+                d.load(mapping, self.per_shard)
 
     def sweep(self) -> int:
         """TTL eviction across all shards (elementwise → partitioned by XLA
@@ -470,8 +621,8 @@ class ShardedDeviceStore:
         with self._lock:
             return self._sweep_locked(None)
 
-    def _sweep_locked(self, pinned: set[tuple[int, int]] | None) -> int:
-        """``pinned`` (shard, local) pairs — slots already resolved for an
+    def _sweep_locked(self, pinned: set[int] | None) -> int:
+        """``pinned`` flat slot ids — slots already resolved for an
         in-flight batch — are exempt from reclamation (same mid-batch
         cross-contamination hazard as the single-chip store's sweep)."""
         now = self.now_ticks_checked()
@@ -482,14 +633,15 @@ class ShardedDeviceStore:
         freed_np = np.asarray(freed)
         n_freed = 0
         if freed_np.any():
-            dead = set(np.nonzero(freed_np)[0].tolist())
+            dead = np.nonzero(freed_np)[0].astype(np.int64)
             if pinned:
-                dead -= {s * self.per_shard + l for s, l in pinned}
-            for k in [k for k, (s, l) in self.directory.items()
-                      if s * self.per_shard + l in dead]:
-                s, l = self.directory.pop(k)
-                self.free[s].append(l)
-                n_freed += 1
+                dead = dead[~np.isin(dead, np.fromiter(pinned, np.int64,
+                                                       len(pinned)))]
+            dead_shards = dead // self.per_shard
+            dead_locals = (dead % self.per_shard).astype(np.int32)
+            for shard in np.unique(dead_shards):
+                n_freed += self.dirs[shard].remove_slots(
+                    dead_locals[dead_shards == shard])
         self.metrics.sweeps += 1
         self.metrics.slots_evicted += n_freed
         return n_freed

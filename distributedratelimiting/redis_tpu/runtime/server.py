@@ -23,10 +23,12 @@ from __future__ import annotations
 
 import asyncio
 import hmac
+import time
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils.metrics import LatencyHistogram
 
 __all__ = ["BucketStoreServer"]
 
@@ -68,6 +70,13 @@ class BucketStoreServer:
         self._save_task: asyncio.Task | None = None
         self.connections_served = 0
         self.requests_served = 0
+        # Server-side serving latency: request decoded (arrival) →
+        # result ready (before the reply hits the socket). This is the
+        # latency the FRAMEWORK is accountable for — client-observed
+        # latency adds the network RTT, which on a tunneled test link
+        # swamps it (benchmarks/RESULTS.md p99 decomposition). Exposed
+        # via OP_STATS as serving_p50_ms/serving_p99_ms.
+        self.serving_latency = LatencyHistogram()
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -87,6 +96,12 @@ class BucketStoreServer:
         self.connections_served += 1
         write_lock = asyncio.Lock()
         request_tasks: set[asyncio.Task] = set()
+        # Bulk frames chain per connection: a chunked acquire_many arrives
+        # as several ACQUIRE_MANY frames whose duplicate keys must decide
+        # in chunk order (the documented request-order serialization,
+        # store.py acquire_many) — independent tasks could race chunk 2
+        # past chunk 1. Non-bulk ops stay fully concurrent.
+        bulk_tail: asyncio.Task | None = None
         authed = self.auth_token is None
         conn_task = asyncio.current_task()
         if conn_task is not None:
@@ -136,9 +151,14 @@ class BucketStoreServer:
                         _recover_seq(body), wire.RESP_ERROR,
                         "authentication required: send HELLO first"))
                     break
-                task = asyncio.ensure_future(
-                    self._serve_request(body, writer, write_lock)
-                )
+                if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
+                    task = asyncio.ensure_future(self._serve_request(
+                        body, writer, write_lock, after=bulk_tail))
+                    bulk_tail = task
+                else:
+                    task = asyncio.ensure_future(
+                        self._serve_request(body, writer, write_lock)
+                    )
                 request_tasks.add(task)
                 task.add_done_callback(request_tasks.discard)
         except wire.RemoteStoreError as exc:
@@ -152,6 +172,12 @@ class BucketStoreServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    #: Only await drain once the transport's buffer passes this —
+    #: per-reply drains cost an extra await/req on the hot path while the
+    #: buffer is nearly always empty; past the mark, drain applies real
+    #: backpressure against a slow-reading client.
+    _DRAIN_HIGH_WATER = 256 * 1024
+
     async def _reply(self, writer: asyncio.StreamWriter,
                      write_lock: asyncio.Lock, resp: bytes) -> None:
         # The lock keeps concurrent request tasks' frames from
@@ -160,14 +186,37 @@ class BucketStoreServer:
         async with write_lock:
             try:
                 wire.write_frame(writer, resp)
-                await writer.drain()
+                if (writer.transport.get_write_buffer_size()
+                        > self._DRAIN_HIGH_WATER):
+                    await writer.drain()
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
     async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
-                             write_lock: asyncio.Lock) -> None:
+                             write_lock: asyncio.Lock,
+                             after: "asyncio.Task | None" = None) -> None:
         seq = _recover_seq(body)
+        t_arrival = time.perf_counter()
+        if after is not None:
+            # Per-connection bulk ordering (see _serve_connection). The
+            # predecessor's own failure was already replied/logged there.
+            await asyncio.gather(after, return_exceptions=True)
         try:
+            if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
+                # Bulk frames carry arrays, not the scalar request shape —
+                # decode + serve them on their own path. One frame = one
+                # store.acquire_many call = (on a device store) a handful
+                # of scanned kernel launches for thousands of decisions.
+                seq, keys, counts, capacity, rate, with_rem = (
+                    wire.decode_bulk_request(body))
+                res = await self.store.acquire_many(
+                    keys, counts, capacity, rate, with_remaining=with_rem)
+                resp = wire.encode_bulk_response(seq, res.granted,
+                                                 res.remaining)
+                self.requests_served += 1
+                self.serving_latency.record(time.perf_counter() - t_arrival)
+                await self._reply(writer, write_lock, resp)
+                return
             seq, op, key, count, a, b = wire.decode_request(body)
             if op == wire.OP_ACQUIRE:
                 res = await self.store.acquire(key, count, a, b)
@@ -237,6 +286,7 @@ class BucketStoreServer:
             log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
         self.requests_served += 1
+        self.serving_latency.record(time.perf_counter() - t_arrival)
         await self._reply(writer, write_lock, resp)  # client went away; its futures die with the socket
 
     def _stats_json(self) -> str:
@@ -245,6 +295,9 @@ class BucketStoreServer:
         payload = {
             "connections_served": self.connections_served,
             "requests_served": self.requests_served,
+            "serving_p50_ms": self.serving_latency.p50 * 1e3,
+            "serving_p99_ms": self.serving_latency.p99 * 1e3,
+            "serving_samples": self.serving_latency.total,
         }
         metrics = getattr(self.store, "metrics", None)
         if metrics is not None:

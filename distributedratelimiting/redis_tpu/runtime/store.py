@@ -149,6 +149,21 @@ class BucketStore(abc.ABC):
                       fill_rate_per_sec: float) -> float:
         """Read-only availability estimate (``GetAvailablePermits``)."""
 
+    def acquire_submitter(self, capacity: float, fill_rate_per_sec: float):
+        """Per-request hot-path factory: returns an async ``(key, count) →
+        AcquireResult`` bound to one bucket config, with per-call routing
+        (config→table lookup, connect check) hoisted out of the loop.
+        Limiters cache one per config — at ~20µs/decision budgets the
+        hoisted work is a measurable share (benchmarks/RESULTS.md r04
+        per-request ceiling note). Default: a thin binding over
+        :meth:`acquire`; :class:`DeviceBucketStore` overrides with a
+        direct micro-batcher binding."""
+        async def submit(key: str, count: int) -> AcquireResult:
+            return await self.acquire(key, count, capacity,
+                                      fill_rate_per_sec)
+
+        return submit
+
     # -- bulk token bucket (one call, many keys) ---------------------------
     async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
                            capacity: float, fill_rate_per_sec: float, *,
@@ -751,25 +766,125 @@ class _DeviceTable(_PackedLaunchMixin):
             res.granted[counts_np == 0] = True
         return res
 
+    @staticmethod
+    def _bulk_groups(slots: np.ndarray, counts_np: np.ndarray):
+        """Slot-grouped view of a bulk call for duplicate coalescing, or
+        ``None`` when it wouldn't pay. Fully vectorized (stable argsort +
+        segment boundaries — request order is preserved within each slot's
+        segment, which is what makes group decisions bit-identical to the
+        per-row conservative serialization). Declines when <25% of rows
+        would be saved, or when any key's counts are mixed (the scan
+        path's exact prefixes handle that rare shape)."""
+        n = len(slots)
+        order = np.argsort(slots, kind="stable")
+        s_sorted = slots[order]
+        seg_start = np.r_[True, s_sorted[1:] != s_sorted[:-1]]
+        n_groups = int(seg_start.sum())
+        if n_groups * 4 > n * 3:
+            return None
+        starts = np.nonzero(seg_start)[0]
+        lengths = np.diff(np.r_[starts, n])
+        c_sorted = counts_np[order]
+        first_c = c_sorted[starts]
+        if not np.array_equal(c_sorted, np.repeat(first_c, lengths)):
+            return None
+        seg_id = np.cumsum(seg_start) - 1
+        rank = np.arange(n) - starts[seg_id]
+        return order, seg_id, rank, starts, lengths, first_c
+
+    def _launch_many_grouped(self, keys: Sequence[str],
+                             counts_np: np.ndarray, with_remaining: bool):
+        """Coalesced bulk dispatch: one launch row per ``(key, count)``
+        group via the grouped flush kernel — under Zipf hot keys the
+        transferred bytes (the bulk path's real cost) shrink by the
+        duplicate fraction. Returns a readback closure, or ``None`` when
+        grouping doesn't pay (caller falls back to the scan path)."""
+        n = len(keys)
+        if n == 0:
+            return None
+        with self.store.profiler.span("acquire_many_grouped", n), \
+                self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            g = self._bulk_groups(slots, counts_np)
+            if g is None:
+                return None
+            order, seg_id, rank, starts, lengths, first_c = g
+            gslots = slots[order][starts]
+            gcounts = np.minimum(first_c, 2**31 - 1).astype(np.int32)
+            b = self.store.max_batch
+            now = self.store.now_ticks_checked()
+            outs: list[tuple] = []
+            for pos in range(0, len(gslots), b):
+                m = min(b, len(gslots) - pos)
+                packed = np.full((5, b), -1, np.int32)
+                packed[1] = 0
+                packed[3] = 0  # one group per slot per call ⇒ prefix 0
+                packed[4] = 0
+                packed[0, :m] = gslots[pos:pos + m]
+                packed[1, :m] = gcounts[pos:pos + m]
+                packed[2] = now
+                packed[4, :m] = np.minimum(lengths[pos:pos + m], 2**31 - 1)
+                out = self._launch_grouped(jnp.asarray(packed))
+                outs.append((out, m))
+                self.store.metrics.record_launch(b, m)
+            self.store.metrics.rows_coalesced += n - len(gslots)
+
+        def gather() -> BulkAcquireResult:
+            n_g = np.empty(len(gslots), np.float32)
+            rem_g = np.empty(len(gslots), np.float32)
+            pos = 0
+            for out, m in outs:
+                out_np = np.asarray(out)  # one fetch per dispatch
+                n_g[pos:pos + m] = out_np[0, :m]
+                rem_g[pos:pos + m] = out_np[1, :m]
+                pos += m
+            granted_sorted = rank < n_g[seg_id]
+            granted = np.empty(n, bool)
+            granted[order] = granted_sorted
+            remaining = None
+            if with_remaining:
+                c = first_c[seg_id].astype(np.float32)
+                # Each member's per-row remaining view, reconstructed from
+                # the group result exactly as the flush path does
+                # (_PackedLaunchMixin._flush).
+                avail = rem_g[seg_id] + n_g[seg_id] * c
+                rem_sorted = np.maximum(
+                    avail - rank * c - np.where(granted_sorted, c, 0.0), 0.0)
+                remaining = np.empty(n, np.float32)
+                remaining[order] = rem_sorted.astype(np.float32)
+            return BulkAcquireResult(granted, remaining)
+
+        return gather
+
+    def _bulk_plan(self, keys: Sequence[str], counts_np: np.ndarray,
+                   with_remaining: bool):
+        """Choose + dispatch the bulk strategy; returns the readback
+        closure (callers run it inline or on an executor)."""
+        if self.store.coalesce_duplicates:
+            gather = self._launch_many_grouped(keys, counts_np,
+                                               with_remaining)
+            if gather is not None:
+                return gather
+        outs = self._launch_many(keys, counts_np, with_remaining)
+        return lambda: self._gather_bulk(outs, len(keys), with_remaining)
+
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], *,
                               with_remaining: bool = True) -> BulkAcquireResult:
         counts_np = np.asarray(counts, np.int64)
-        outs = self._launch_many(keys, counts_np, with_remaining)
-        return self._grant_probes(
-            self._gather_bulk(outs, len(keys), with_remaining), counts_np)
+        gather = self._bulk_plan(keys, counts_np, with_remaining)
+        return self._grant_probes(gather(), counts_np)
 
     async def acquire_many(self, keys: Sequence[str],
                            counts: Sequence[int], *,
                            with_remaining: bool = True) -> BulkAcquireResult:
         counts_np = np.asarray(counts, np.int64)
-        outs = self._launch_many(keys, counts_np, with_remaining)
+        gather = self._bulk_plan(keys, counts_np, with_remaining)
         loop = asyncio.get_running_loop()
         # ONE await resolves the whole call; the readback runs off-loop so
         # the event loop keeps serving (and other bulk calls' dispatches
         # overlap this one's transfer).
-        res = await loop.run_in_executor(
-            None, self._gather_bulk, outs, len(keys), with_remaining)
+        res = await loop.run_in_executor(None, gather)
         return self._grant_probes(res, counts_np)
 
     def peek_blocking(self, key: str) -> float:
@@ -1019,6 +1134,17 @@ class DeviceBucketStore(BucketStore):
         await self.connect()
         table = self._table(capacity, fill_rate_per_sec)
         return await table.batcher.submit(_AcquireReq(key, count))
+
+    def acquire_submitter(self, capacity: float, fill_rate_per_sec: float):
+        """Hot-path binding: resolve the table ONCE; each call is then one
+        ``MicroBatcher.submit`` — no connect check, no config→table lock,
+        no arg re-validation per request."""
+        submit = self._table(capacity, fill_rate_per_sec).batcher.submit
+
+        async def fast(key: str, count: int) -> AcquireResult:
+            return await submit(_AcquireReq(key, count))
+
+        return fast
 
     def acquire_blocking(self, key: str, count: int, capacity: float,
                          fill_rate_per_sec: float) -> AcquireResult:

@@ -33,6 +33,15 @@ Request payloads:
                        the first frame when the server requires a token)
     PING / SAVE / STATS : empty (SAVE writes the server-configured
                        checkpoint path — clients never supply paths)
+    ACQUIRE_MANY     : [u8 flags][f64 capacity][f64 fill_rate][u32 n]
+                       [u16 klen × n][key blob utf-8][u32 count × n]
+                       — one frame decides n keys' requests (the bulk path;
+                       flags bit 0 = caller wants per-request remaining).
+                       Length/count arrays are raw little-endian vectors so
+                       both ends move them with numpy, not per-key packing.
+                       Clients split larger bulks into multiple frames via
+                       :func:`bulk_chunk_spans` (every chunk ≤ MAX_FRAME)
+                       and pipeline the chunks on one connection.
 
 Response payloads:
     OK_DECISION : [u8 granted][f64 remaining]
@@ -42,25 +51,36 @@ Response payloads:
     OK_TEXT     : [u32 mlen][text utf-8] (STATS reply: a JSON object —
                   u32 so a large stats payload can never be truncated
                   mid-UTF-8; bounded by MAX_FRAME)
+    OK_BULK     : [u8 flags][u32 n][granted bits, (n+7)//8 bytes, LSB-first]
+                  [f32 remaining × n, present iff flags bit 0] — 1 bit per
+                  verdict (+4B optional estimate), so a full MAX_FRAME
+                  request's reply stays well under MAX_FRAME
     ERROR       : [u16 mlen][message utf-8] (truncated on a codepoint
                   boundary if oversized)
 
 Version history: v1 had no version byte and a u16 OK_TEXT length; v2
 (current) added the version byte, HELLO, and the u32 OK_TEXT length.
+ACQUIRE_MANY/OK_BULK are a v2 extension: an older v2 server replies
+``ERROR unknown op`` to the new request, which clients surface cleanly.
 """
 
 from __future__ import annotations
 
 import struct
 
+import numpy as np
+
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
+    "OP_ACQUIRE_MANY",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
-    "RESP_ERROR",
+    "RESP_BULK", "RESP_ERROR",
     "MAX_FRAME", "PROTOCOL_VERSION", "RemoteStoreError",
     "ProtocolVersionError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
+    "encode_bulk_request", "decode_bulk_request", "encode_bulk_response",
+    "bulk_chunk_spans",
     "read_frame", "write_frame",
 ]
 
@@ -76,6 +96,7 @@ OP_STATS = 7   # server + store metrics as JSON text
 OP_SEMA = 8    # concurrency semaphore: count = signed delta, a = limit
 OP_FWINDOW = 9  # fixed-window acquire: (a, b) = (limit, window_s)
 OP_HELLO = 10  # shared-secret auth handshake (≙ Redis AUTH)
+OP_ACQUIRE_MANY = 11  # bulk acquire: n keys' decisions in one frame
 
 _OP_NAMES = {
     OP_ACQUIRE: "acquire",
@@ -88,6 +109,7 @@ _OP_NAMES = {
     OP_SEMA: "sema",
     OP_FWINDOW: "fixed_window_acquire",
     OP_HELLO: "hello",
+    OP_ACQUIRE_MANY: "acquire_many",
 }
 
 
@@ -101,6 +123,7 @@ RESP_VALUE = 65
 RESP_PAIR = 66
 RESP_EMPTY = 67
 RESP_TEXT = 68
+RESP_BULK = 69
 RESP_ERROR = 127
 
 #: Upper bound on a frame body; a peer announcing more is protocol-broken
@@ -190,6 +213,9 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
         return seq, op, token, 0, 0.0, 0.0
     if op in (OP_PING, OP_SAVE, OP_STATS):
         return seq, op, "", 0, 0.0, 0.0
+    if op == OP_ACQUIRE_MANY:
+        raise RemoteStoreError(
+            "ACQUIRE_MANY frames decode via decode_bulk_request")
     raise RemoteStoreError(f"unknown op {op}")
 
 
@@ -242,7 +268,136 @@ def decode_response(frame: bytes) -> tuple[int, int, tuple]:
     if kind == RESP_TEXT:
         (mlen,) = _TEXTLEN.unpack_from(body, 0)
         return seq, kind, (body[4:4 + mlen].decode("utf-8"),)
+    if kind == RESP_BULK:
+        return seq, kind, _decode_bulk_response_body(body)
     raise RemoteStoreError(f"unknown response kind {kind}")
+
+
+# -- bulk acquire (OP_ACQUIRE_MANY / RESP_BULK) -----------------------------
+
+_BULK_REQ_HEAD = struct.Struct("<BddI")   # flags, capacity, fill_rate, n
+_BULK_RESP_HEAD = struct.Struct("<BI")    # flags, n
+
+#: Per-request wire overhead in an ACQUIRE_MANY frame: u16 klen + u32 count.
+BULK_PER_KEY_OVERHEAD = 6
+#: Default per-frame payload budget for client-side chunking — headroom
+#: under MAX_FRAME for the frame header + bulk head.
+BULK_CHUNK_BUDGET = MAX_FRAME - 64
+
+_FLAG_WITH_REMAINING = 1
+
+
+def bulk_chunk_spans(key_blob_lens: "np.ndarray",
+                     budget: int | None = None) -> list[tuple[int, int]]:
+    """Split a bulk call into contiguous ``[start, end)`` spans whose
+    encoded ACQUIRE_MANY payloads each fit ``budget`` bytes (default
+    :data:`BULK_CHUNK_BUDGET`, read at call time). Vectorized (cumsum +
+    searchsorted per span) so a million-key bulk costs a handful of numpy
+    ops, not a Python loop."""
+    if budget is None:
+        budget = BULK_CHUNK_BUDGET
+    n = len(key_blob_lens)
+    if n == 0:
+        return []
+    cum = np.cumsum(np.asarray(key_blob_lens, np.int64)
+                    + BULK_PER_KEY_OVERHEAD)
+    spans: list[tuple[int, int]] = []
+    start, base = 0, 0
+    while start < n:
+        end = int(np.searchsorted(cum, base + budget, side="right"))
+        if end == start:
+            end = start + 1  # one oversized key still fits a frame alone
+        spans.append((start, end))
+        base = int(cum[end - 1])
+        start = end
+    return spans
+
+
+def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
+                        counts: "np.ndarray", capacity: float,
+                        fill_rate: float, *,
+                        with_remaining: bool = True) -> bytes:
+    """Encode one ACQUIRE_MANY frame. ``key_blobs`` are pre-encoded utf-8
+    keys (callers encode once, then slice chunks out of the same list);
+    ``counts`` any integer array-like, sent as u32."""
+    n = len(key_blobs)
+    klens = np.fromiter((len(b) for b in key_blobs), np.int64, n)
+    if n and int(klens.max()) > 0xFFFF:
+        raise ValueError("key exceeds 65535 utf-8 bytes")
+    flags = _FLAG_WITH_REMAINING if with_remaining else 0
+    payload = b"".join((
+        _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
+        klens.astype("<u2").tobytes(),
+        b"".join(key_blobs),
+        np.asarray(counts, "<u4").tobytes(),
+    ))
+    length = _BODY_OFF + len(payload)
+    if length > MAX_FRAME:
+        raise ValueError(
+            f"bulk frame of {length} bytes exceeds MAX_FRAME; chunk the "
+            "call with bulk_chunk_spans()"
+        )
+    return _HDR.pack(length, PROTOCOL_VERSION, seq, OP_ACQUIRE_MANY) + payload
+
+
+def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
+                                               float, float, bool]:
+    """Returns ``(seq, keys, counts[i64], capacity, fill_rate,
+    with_remaining)``."""
+    ver, seq, op = _VER_SEQ_OP.unpack_from(frame, 0)
+    _check_version(ver)
+    if op != OP_ACQUIRE_MANY:
+        raise RemoteStoreError(f"expected ACQUIRE_MANY, got op {op}")
+    body = frame[_BODY_OFF:]
+    flags, capacity, fill_rate, n = _BULK_REQ_HEAD.unpack_from(body, 0)
+    off = _BULK_REQ_HEAD.size
+    klens = np.frombuffer(body, "<u2", n, off).astype(np.int64)
+    off += 2 * n
+    total = int(klens.sum())
+    blob = body[off:off + total]
+    if len(blob) != total:
+        raise RemoteStoreError("truncated ACQUIRE_MANY key blob")
+    counts = np.frombuffer(body, "<u4", n, off + total).astype(np.int64)
+    ends = np.cumsum(klens)
+    starts = ends - klens
+    if blob.isascii():
+        # Fast path: byte offsets == char offsets, one decode for the blob.
+        text = blob.decode("ascii")
+        keys = [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
+    else:
+        keys = [blob[s:e].decode("utf-8")
+                for s, e in zip(starts.tolist(), ends.tolist())]
+    return seq, keys, counts, capacity, fill_rate, bool(flags & _FLAG_WITH_REMAINING)
+
+
+def encode_bulk_response(seq: int, granted: "np.ndarray",
+                         remaining: "np.ndarray | None") -> bytes:
+    n = len(granted)
+    flags = 0 if remaining is None else _FLAG_WITH_REMAINING
+    parts = [
+        _BULK_RESP_HEAD.pack(flags, n),
+        np.packbits(np.asarray(granted, bool), bitorder="little").tobytes(),
+    ]
+    if remaining is not None:
+        parts.append(np.asarray(remaining, "<f4").tobytes())
+    payload = b"".join(parts)
+    return _HDR.pack(_BODY_OFF + len(payload), PROTOCOL_VERSION, seq,
+                     RESP_BULK) + payload
+
+
+def _decode_bulk_response_body(body: bytes) -> tuple["np.ndarray",
+                                                     "np.ndarray | None"]:
+    flags, n = _BULK_RESP_HEAD.unpack_from(body, 0)
+    off = _BULK_RESP_HEAD.size
+    nbits = (n + 7) // 8
+    granted = np.unpackbits(
+        np.frombuffer(body, np.uint8, nbits, off), bitorder="little",
+    )[:n].astype(bool)
+    remaining = None
+    if flags & _FLAG_WITH_REMAINING:
+        remaining = np.frombuffer(body, "<f4", n, off + nbits).astype(
+            np.float32)
+    return granted, remaining
 
 
 async def read_frame(reader) -> bytes | None:

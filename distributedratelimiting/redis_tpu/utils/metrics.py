@@ -128,6 +128,9 @@ class StoreMetrics:
     # Duplicate requests merged away by flush coalescing (requests minus
     # launch rows) — the Zipf hot-key win's direct measure.
     rows_coalesced: int = 0
+    # Table growths (single-chip: background pre-warm compilations;
+    # sharded: in-place per-shard doublings).
+    pregrows: int = 0
 
     def record_launch(self, batch_rows: int, valid_rows: int) -> None:
         self.launches += 1
@@ -148,4 +151,5 @@ class StoreMetrics:
             "slots_evicted": self.slots_evicted,
             "pallas_sweep_failures": self.pallas_sweep_failures,
             "rows_coalesced": self.rows_coalesced,
+            "pregrows": self.pregrows,
         }

@@ -76,10 +76,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dir_dump.argtypes = [c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
                              c.POINTER(c.c_int32)]
     lib.dir_dump.restype = c.c_int64
+    lib.dir_route_batch.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_int32,
+        c.POINTER(c.c_int32)]
+    lib.dir_route_batch.restype = None
     try:
         lib.dir_resolve_pylist.argtypes = [c.c_void_p, c.py_object,
                                            c.POINTER(c.c_int32)]
         lib.dir_resolve_pylist.restype = c.c_int64
+        lib.dir_route_pylist.argtypes = [c.py_object, c.c_int32,
+                                         c.POINTER(c.c_int32)]
+        lib.dir_route_pylist.restype = c.c_int64
         lib.has_pylist = True
     except AttributeError:  # built without Python.h
         lib.has_pylist = False

@@ -98,6 +98,31 @@ void rehash(Directory* d) {
   }
 }
 
+// CRC-32 (ISO-HDLC, the zlib/crc32 polynomial) for shard routing: the
+// sharded store routes key -> shard by crc32(key) % n_shards on every
+// client host, so the C path must agree bit-for-bit with Python's
+// zlib.crc32 (sharded_store.shard_of_key).
+uint32_t g_crc_table[256];
+bool g_crc_ready = false;
+
+void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+    g_crc_table[i] = c;
+  }
+  g_crc_ready = true;
+}
+
+inline uint32_t crc32_of(const char* data, int64_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (int64_t i = 0; i < len; ++i)
+    c = g_crc_table[(c ^ static_cast<unsigned char>(data[i])) & 0xFFu] ^
+        (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 // Find the table index holding `key`, or the empty index where it belongs.
 inline uint64_t probe(const Directory* d, uint64_t h, const char* key,
                       uint32_t len) {
@@ -279,6 +304,18 @@ int64_t dir_dump(void* h, char* keys_out, int64_t* offsets_out,
   return n;
 }
 
+// Batch shard routing: out[i] = crc32(key_i) % n_shards. Standalone (no
+// directory handle) — routing happens before any per-shard directory is
+// touched. keys/offsets layout as in dir_resolve_batch.
+void dir_route_batch(const char* keys, const int64_t* offsets, int64_t n,
+                     int32_t n_shards, int32_t* out) {
+  if (!g_crc_ready) crc_init();
+  for (int64_t k = 0; k < n; ++k)
+    out[k] = static_cast<int32_t>(
+        crc32_of(keys + offsets[k], offsets[k + 1] - offsets[k]) %
+        static_cast<uint32_t>(n_shards));
+}
+
 #ifdef DRL_WITH_PYTHON
 // Zero-copy batch resolve over a Python list[str]: reads each key's
 // cached UTF-8 via PyUnicode_AsUTF8AndSize — no encode, no concat, no
@@ -321,6 +358,26 @@ int64_t dir_resolve_pylist(void* h, PyObject* keys, int32_t* out_slots) {
     if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) rehash(d);
   }
   return unresolved;
+}
+
+// Zero-copy batch shard routing over a Python list[str] (GIL held, as
+// dir_resolve_pylist). Returns 0, or -1 on a non-str element (caller
+// falls back to the encode path).
+int64_t dir_route_pylist(PyObject* keys, int32_t n_shards, int32_t* out) {
+  if (!g_crc_ready) crc_init();
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    PyObject* s = PyList_GET_ITEM(keys, k);
+    Py_ssize_t len;
+    const char* key = PyUnicode_AsUTF8AndSize(s, &len);
+    if (key == nullptr) {
+      PyErr_Clear();
+      return -1;
+    }
+    out[k] = static_cast<int32_t>(crc32_of(key, len) %
+                                  static_cast<uint32_t>(n_shards));
+  }
+  return 0;
 }
 #endif  // DRL_WITH_PYTHON
 

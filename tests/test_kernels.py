@@ -331,6 +331,47 @@ class TestAcquireScanCompact:
         assert list(np.asarray(granted[0])) == [True, False, True]
 
 
+class TestAcquireScanCompactFused:
+    def test_matches_unfused(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(13)
+        n, b, k = 70_000, 32, 4  # n > 2**16: exercises all four slot bytes
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[0, :3] = 5          # duplicates
+        slots[1, :2] = -1         # padding rows
+        counts = rng.integers(0, 255, (k, b)).astype(np.uint8)
+        nows = np.arange(1, k + 1, dtype=np.int32) * 10
+
+        s1 = K.init_bucket_state(n)
+        s1, g1, r1 = K.acquire_scan_compact(
+            s1, jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(nows),
+            jnp.float32(200.0), jnp.float32(0.5))
+        s2 = K.init_bucket_state(n)
+        s2, g2, r2 = K.acquire_scan_compact_fused(
+            s2, jnp.asarray(K.pack_compact5(slots, counts)),
+            jnp.asarray(nows), jnp.float32(200.0), jnp.float32(0.5))
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1.tokens),
+                                   np.asarray(s2.tokens), rtol=1e-6)
+
+    def test_pack_compact5_layout(self):
+        import numpy as np
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        slots = np.array([0, 1, 255, 65536, (1 << 24) + 7, -1], np.int32)
+        counts = np.array([1, 2, 3, 4, 5, 0], np.uint8)
+        fused = K.pack_compact5(slots, counts)
+        assert fused.shape == (6, 5)
+        # LE i32 reassembly from bytes 0-3, count in byte 4.
+        back = fused[:, :4].copy().view("<i4").reshape(-1)
+        np.testing.assert_array_equal(back, slots)
+        np.testing.assert_array_equal(fused[:, 4], counts)
+
+
 class TestAcquireScanPacked24:
     def test_matches_sequential_unit_batches(self):
         import numpy as np

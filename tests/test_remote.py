@@ -7,6 +7,7 @@ shared server, per-test free ports."""
 
 import asyncio
 
+import numpy as np
 import pytest
 
 from distributedratelimiting.redis_tpu.models.approximate import (
@@ -239,6 +240,26 @@ class TestClientServer:
         with pytest.raises(NotImplementedError):
             store.snapshot()
 
+    def test_stats_report_serving_latency(self):
+        # Server-side request-arrival → result-ready histogram: the
+        # framework-accountable latency (north star p99 < 2ms), measured
+        # where the RTT of the client's link cannot pollute it.
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    for _ in range(20):
+                        await store.acquire("k", 1, 100.0, 1.0)
+                    stats = await store.stats()
+                    assert stats["serving_samples"] == 20
+                    assert stats["serving_p99_ms"] > 0
+                    assert (stats["serving_p50_ms"]
+                            <= stats["serving_p99_ms"])
+                finally:
+                    await store.aclose()
+
+        run(main())
+
 
 class TestAuthAndVersion:
     def test_auth_required_server_rejects_tokenless_client(self):
@@ -312,6 +333,254 @@ class TestAuthAndVersion:
 
 async def _drop(store):
     store._drop_connection(ConnectionError("test-forced reconnect"))
+
+
+class TestBulkWire:
+    def test_bulk_request_roundtrip(self):
+        keys = ["user:1", "ключ-🔑", "", "z" * 100]
+        blobs = [k.encode() for k in keys]
+        counts = np.asarray([1, 2, 0, 7], np.uint32)
+        frame = wire.encode_bulk_request(5, blobs, counts, 100.0, 2.5,
+                                         with_remaining=True)
+        seq, out_keys, out_counts, cap, rate, with_rem = (
+            wire.decode_bulk_request(frame[4:]))
+        assert (seq, out_keys, cap, rate, with_rem) == (5, keys, 100.0, 2.5,
+                                                        True)
+        assert out_counts.tolist() == [1, 2, 0, 7]
+
+    def test_bulk_response_roundtrip(self):
+        granted = np.asarray([True, False, True, True, False], bool)
+        remaining = np.asarray([4.0, 0.0, 2.5, 1.0, 0.0], np.float32)
+        seq, kind, (g, r) = wire.decode_response(
+            wire.encode_bulk_response(9, granted, remaining)[4:])
+        assert kind == wire.RESP_BULK
+        assert g.tolist() == granted.tolist()
+        assert r.tolist() == remaining.tolist()
+        # Verdict-only variant: 1 bit per decision, no remaining payload.
+        seq, kind, (g, r) = wire.decode_response(
+            wire.encode_bulk_response(9, granted, None)[4:])
+        assert g.tolist() == granted.tolist() and r is None
+
+    def test_chunk_spans_cover_and_fit(self):
+        rng = np.random.default_rng(0)
+        lens = rng.integers(1, 60, 5000)
+        budget = 4096
+        spans = wire.bulk_chunk_spans(lens, budget)
+        assert spans[0][0] == 0 and spans[-1][1] == len(lens)
+        for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+            assert e0 == s1  # contiguous, no gaps or overlaps
+        for s, e in spans:
+            assert (lens[s:e] + wire.BULK_PER_KEY_OVERHEAD).sum() <= budget
+
+    def test_oversized_unchunked_frame_is_loud(self):
+        blobs = [b"k" * 60_000] * 20  # ~1.2MB in one frame
+        with pytest.raises(ValueError, match="MAX_FRAME"):
+            wire.encode_bulk_request(1, blobs, np.ones(20, np.uint32),
+                                     1.0, 1.0)
+
+
+class TestBulkClientServer:
+    def test_bulk_acquire_over_tcp(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    keys = [f"k{i % 4}" for i in range(12)]
+                    res = await store.acquire_many(
+                        keys, [1] * 12, 2.0, 1.0)
+                    # 4 buckets × capacity 2: first two requests per key
+                    # grant, the third declines (request order preserved).
+                    assert res.granted.tolist() == [True] * 8 + [False] * 4
+                    assert res.remaining is not None
+                    assert res.remaining[:4].tolist() == [1.0] * 4
+                    # Verdict-only round trip.
+                    res2 = await store.acquire_many(
+                        keys, [1] * 12, 2.0, 1.0, with_remaining=False)
+                    assert res2.remaining is None
+                    assert not res2.granted.any()
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_bulk_blocking_from_sync_context(self):
+        import threading
+
+        async def setup():
+            srv = BucketStoreServer(InProcessBucketStore())
+            await srv.start()
+            return srv
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        srv = asyncio.run_coroutine_threadsafe(setup(), loop).result(10)
+        store = RemoteBucketStore(url=f"{srv.host}:{srv.port}")
+        try:
+            res = store.acquire_many_blocking(
+                ["a", "b"], [3, 11], 10.0, 1.0)
+            assert res.granted.tolist() == [True, False]
+            assert res.remaining.tolist() == [7.0, 10.0]
+        finally:
+            run(store.aclose())
+            asyncio.run_coroutine_threadsafe(srv.aclose(), loop).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=5)
+
+    def test_bulk_chunked_across_frames(self, monkeypatch):
+        # Force tiny chunks so one call spans many frames; results must
+        # reassemble in request order across frame boundaries.
+        import distributedratelimiting.redis_tpu.runtime.wire as wire_mod
+
+        monkeypatch.setattr(wire_mod, "BULK_CHUNK_BUDGET", 256)
+
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    n = 200
+                    keys = [f"key-{i:04d}" for i in range(n)]
+                    res = await store.acquire_many(
+                        keys, [1] * n, 1.0, 1.0)
+                    assert len(res) == n
+                    assert res.granted.all()  # n distinct keys, capacity 1
+                    res2 = await store.acquire_many(
+                        keys, [1] * n, 1.0, 1.0)
+                    assert not res2.granted.any()
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_bulk_cross_chunk_duplicates_decide_in_order(self, monkeypatch):
+        # Chunks of one bulk call are separate frames; the server chains
+        # them per connection so a duplicate key spanning a chunk boundary
+        # keeps request-order semantics (the grant lands on the EARLIER
+        # occurrence). A slow store amplifies any ordering race.
+        import distributedratelimiting.redis_tpu.runtime.wire as wire_mod
+
+        monkeypatch.setattr(wire_mod, "BULK_CHUNK_BUDGET", 64)
+
+        class SlowFirstStore(InProcessBucketStore):
+            calls = 0
+
+            async def acquire_many(self, keys, *a, **kw):
+                SlowFirstStore.calls += 1
+                if SlowFirstStore.calls == 1:
+                    await asyncio.sleep(0.05)  # chunk 2 would overtake
+                return await super().acquire_many(keys, *a, **kw)
+
+        async def main():
+            async with BucketStoreServer(SlowFirstStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    # "dup" appears once per chunk (budget 64 → ~4/chunk);
+                    # bucket holds 1 token → exactly the FIRST wins.
+                    keys = ["dup", "aaa1", "bbb1", "ccc1",
+                            "dup", "aaa2", "bbb2", "ccc2"]
+                    res = await store.acquire_many(
+                        keys, [1] * 8, 1.0, 0.0)
+                    assert res.granted.tolist() == [
+                        True, True, True, True,
+                        False, True, True, True]
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_bulk_empty_call_never_touches_wire(self):
+        async def main():
+            store = RemoteBucketStore(address=("256.0.0.1", 1))
+            try:
+                res = await store.acquire_many([], [], 1.0, 1.0)
+                assert len(res) == 0 and res.remaining is not None
+            finally:
+                await store.aclose()
+
+        run(main())
+
+    def test_bulk_server_error_relayed(self):
+        class ExplodingStore(InProcessBucketStore):
+            async def acquire_many(self, keys, *a, **kw):
+                raise RuntimeError("bulk kernel exploded")
+
+        async def main():
+            async with BucketStoreServer(ExplodingStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    with pytest.raises(wire.RemoteStoreError,
+                                       match="bulk kernel exploded"):
+                        await store.acquire_many(["a"], [1], 5.0, 1.0)
+                    # Connection survives; the single-key path still works.
+                    assert (await store.acquire("a", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_bulk_mid_call_disconnect_fails_cleanly(self):
+        # A server that reads one frame then drops the connection: the
+        # bulk call's futures must fail with ConnectionError, and a retry
+        # against a healthy server must succeed (lazy reconnect).
+        async def main():
+            async def rude_server(reader, writer):
+                await wire.read_frame(reader)
+                writer.close()
+
+            srv = await asyncio.start_server(rude_server, "127.0.0.1", 0)
+            host, port = srv.sockets[0].getsockname()[:2]
+            store = RemoteBucketStore(address=(host, port))
+            try:
+                with pytest.raises(ConnectionError):
+                    await store.acquire_many(["a", "b"], [1, 1], 5.0, 1.0)
+            finally:
+                await store.aclose()
+                srv.close()
+                await srv.wait_closed()
+
+        run(main())
+
+    def test_bulk_with_auth(self):
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         auth_token="hunter2") as srv:
+                # Tokenless client: bulk is rejected like any other op.
+                bad = RemoteBucketStore(address=(srv.host, srv.port))
+                with pytest.raises(wire.RemoteStoreError,
+                                   match="authentication required"):
+                    await bad.acquire_many(["a"], [1], 5.0, 1.0)
+                await bad.aclose()
+                good = RemoteBucketStore(address=(srv.host, srv.port),
+                                         auth_token="hunter2")
+                try:
+                    res = await good.acquire_many(["a", "b"], [1, 1], 5.0, 1.0)
+                    assert res.granted.all()
+                finally:
+                    await good.aclose()
+
+        run(main())
+
+    def test_bulk_against_device_store(self):
+        # The real deployment shape: RemoteBucketStore -> TCP ->
+        # DeviceBucketStore's scanned bulk path.
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            DeviceBucketStore,
+        )
+
+        async def main():
+            async with BucketStoreServer(DeviceBucketStore(n_slots=1024)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    n = 300
+                    keys = [f"dk{i}" for i in range(n)]
+                    res = await store.acquire_many(keys, [1] * n, 10.0, 1.0)
+                    assert res.granted.all()
+                    assert np.allclose(res.remaining, 9.0)
+                finally:
+                    await store.aclose()
+
+        run(main())
 
 
 class TestDistributedLimiters:

@@ -107,19 +107,96 @@ def test_duplicate_keys_in_one_batch_never_over_admit(mesh, clock):
     assert sum(r.granted for r in results) == 5
 
 
-def test_failed_allocation_rolls_back_no_leak(mesh, clock):
-    """Regression: an exhaustion error mid-batch must roll back that
-    batch's fresh allocations (their exists bits were never set, so a sweep
-    could never reclaim them)."""
+def test_shard_overflow_grows_and_keeps_serving(mesh, clock):
+    """A shard filling past capacity must grow (per-shard doubling, geometry
+    kept homogeneous) and keep serving — the single-chip table's behavior,
+    previously a hard RuntimeError on the mesh."""
     tiny = ShardedDeviceStore(mesh, 10.0, 5.0, per_shard_slots=2, clock=clock)
-    with pytest.raises(RuntimeError):
-        tiny.acquire_batch_blocking([(f"x{i}", 1) for i in range(64)])
-    # Nothing leaked: all slots are free again and the directory is empty.
-    assert len(tiny.directory) == 0
-    assert all(len(f) == 2 for f in tiny.free)
-    # The store remains fully usable.
-    res = tiny.acquire_batch_blocking([("y1", 1), ("y2", 1)])
+    res = tiny.acquire_batch_blocking([(f"x{i}", 1) for i in range(64)])
     assert all(r.granted for r in res)
+    assert tiny.per_shard > 2  # grew past the initial geometry
+    assert tiny.metrics.pregrows > 0
+    assert len(tiny.directory) == 64
+    # Earlier keys' state survived the growth re-layout.
+    res2 = tiny.acquire_batch_blocking([(f"x{i}", 10) for i in range(64)])
+    assert not any(r.granted for r in res2)  # 9 tokens left each, not 10
+    # And new keys keep landing.
+    res3 = tiny.acquire_batch_blocking([(f"y{i}", 1) for i in range(32)])
+    assert all(r.granted for r in res3)
+
+
+def test_growth_preserves_balances_exactly(mesh, clock):
+    store = ShardedDeviceStore(mesh, 100.0, 0.0, per_shard_slots=4,
+                               clock=clock)
+    store.acquire_batch_blocking([("a", 30), ("b", 7)])
+    before = {k: store.peek_blocking(k) for k in ("a", "b")}
+    store._grow()
+    after = {k: store.peek_blocking(k) for k in ("a", "b")}
+    assert before == after == {"a": 70.0, "b": 93.0}
+
+
+class TestShardedBulk:
+    def test_bulk_agrees_with_serial(self, mesh, clock, rng):
+        sharded = ShardedDeviceStore(mesh, 20.0, 8.0, per_shard_slots=64,
+                                     clock=clock)
+        ref = InProcessBucketStore(clock=clock)
+        for _ in range(5):
+            clock.advance_ticks(int(rng.integers(0, TICKS_PER_SECOND)))
+            keys = [f"k{i}" for i in rng.choice(60, size=40, replace=False)]
+            counts = [int(c) for c in rng.integers(0, 6, size=40)]
+            got = sharded.acquire_many_blocking(keys, counts)
+            want = [ref.acquire_blocking(k, c, 20.0, 8.0)
+                    for k, c in zip(keys, counts)]
+            for g, w, k, c in zip(got, want, keys, counts):
+                assert g.granted == w.granted, (k, c)
+                assert abs(g.remaining - w.remaining) < 1e-2
+
+    def test_bulk_multi_chunk_when_shard_load_exceeds_width(self, mesh,
+                                                            clock):
+        # Shrink the scan width so one call needs several fused dispatches.
+        sharded = ShardedDeviceStore(mesh, 1e9, 0.0, per_shard_slots=2048,
+                                     clock=clock)
+        sharded._BULK_B = 8
+        n = 4096
+        keys = [f"bk{i}" for i in range(n)]
+        res = sharded.acquire_many_blocking(keys, [1] * n,
+                                            with_remaining=False)
+        assert res.remaining is None
+        assert res.granted.all()
+        assert sharded.metrics.launches > 1
+
+    def test_bulk_duplicates_never_over_admit(self, mesh, clock):
+        sharded = ShardedDeviceStore(mesh, 5.0, 0.0, per_shard_slots=16,
+                                     clock=clock)
+        res = sharded.acquire_many_blocking(["hot"] * 12, [1] * 12)
+        assert int(res.granted.sum()) == 5
+
+    def test_bulk_zero_count_probe_granted(self, mesh, clock):
+        sharded = ShardedDeviceStore(mesh, 3.0, 0.0, per_shard_slots=16,
+                                     clock=clock)
+        res = sharded.acquire_many_blocking(
+            ["p", "p", "p", "p", "p"], [3, 3, 0, 1, 0])
+        # First drains the bucket, second denied, probes granted anyway.
+        assert res.granted.tolist() == [True, False, True, False, True]
+
+    def test_bulk_feeds_global_tier(self, mesh, clock):
+        sharded = ShardedDeviceStore(mesh, 10.0, 0.0, per_shard_slots=16,
+                                     clock=clock)
+        res = sharded.acquire_many_blocking(
+            [f"g{i}" for i in range(32)], [2] * 32,
+            decay_rate_per_sec=0.0)
+        assert res.granted.all()
+        assert sharded.global_score == 64.0
+
+
+def test_route_keys_matches_scalar(mesh):
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        route_keys,
+    )
+
+    keys = [f"key-{i}" for i in range(500)] + ["ключ-🔑", "", "x" * 300]
+    want = [shard_of_key(k, 8) for k in keys]
+    assert route_keys(keys, 8).tolist() == want
 
 
 class TestTwoLevelScanStep:
@@ -199,13 +276,37 @@ class TestShardedSnapshotRestore:
         (r0,) = s2.acquire_batch_blocking([("k0", 5)])
         assert r0.granted
 
-    def test_geometry_mismatch_rejected(self, mesh):
-        a = ShardedDeviceStore(mesh, capacity=5.0, fill_rate_per_sec=1.0,
-                               per_shard_slots=16)
+    def test_shard_count_mismatch_rejected(self, mesh):
+        a = ShardedDeviceStore(create_mesh(4), capacity=5.0,
+                               fill_rate_per_sec=1.0, per_shard_slots=16)
         b = ShardedDeviceStore(mesh, capacity=5.0, fill_rate_per_sec=1.0,
-                               per_shard_slots=32)
+                               per_shard_slots=16)
         with pytest.raises(ValueError, match="geometry"):
             b.restore(a.snapshot())
+
+    def test_post_growth_snapshot_restores_into_fresh_store(self, mesh):
+        """A store that grew before checkpointing must restore into a
+        fresh store built at the ORIGINAL size — restore adopts the
+        snapshot's per-shard width (growth made width mutable; rejecting
+        it would make every post-growth checkpoint unloadable)."""
+        clock = ManualClock()
+        a = ShardedDeviceStore(mesh, capacity=10.0, fill_rate_per_sec=0.0,
+                               per_shard_slots=2, clock=clock)
+        a.acquire_batch_blocking([(f"k{i}", 7) for i in range(64)])  # grows
+        assert a.per_shard > 2
+        snap = a.snapshot()
+
+        b = ShardedDeviceStore(mesh, capacity=10.0, fill_rate_per_sec=0.0,
+                               per_shard_slots=2, clock=ManualClock())
+        b.restore(snap)
+        assert b.per_shard == a.per_shard
+        # Balances carried over: 3 tokens left per key.
+        (r0, r1) = b.acquire_batch_blocking([("k0", 3), ("k1", 4)])
+        assert r0.granted and not r1.granted
+        # And the restored store still grows past its adopted width.
+        res = b.acquire_batch_blocking(
+            [(f"fresh{i}", 1) for i in range(8 * b.per_shard * b.n_shards // 4)])
+        assert all(r.granted for r in res)
 
     def test_config_mismatch_rejected(self, mesh):
         a = ShardedDeviceStore(mesh, capacity=10.0, fill_rate_per_sec=1.0,

@@ -340,6 +340,48 @@ class TestBulkAcquire:
         res = dev.acquire_many_blocking([], [], 5.0, 1.0)
         assert len(res) == 0 and res.granted_count == 0
 
+    def test_bulk_zipf_duplicates_coalesce_into_grouped_rows(self, clock,
+                                                             rng):
+        """Heavy duplication routes the bulk call through the grouped
+        kernel: launch rows ≈ distinct (key, count) groups, duplicates
+        recorded in rows_coalesced, and decisions identical to the scan
+        path's conservative serialization."""
+        dev = device_store(clock, max_batch=64)
+        cap, rate = 10.0, 0.0
+        keys = [f"hot{rng.zipf(1.2) % 8}" for _ in range(400)]
+        counts = [1] * 400
+        res = dev.acquire_many_blocking(keys, counts, cap, rate)
+        assert dev.metrics.rows_coalesced >= 400 - 8 * 2
+        # Per key: exactly cap grants, on the FIRST occurrences.
+        seen: dict[str, int] = {}
+        for k, g in zip(keys, res.granted):
+            before = seen.get(k, 0)
+            assert bool(g) == (before < cap), (k, before)
+            seen[k] = before + 1
+
+        # Remaining view matches the per-row reconstruction.
+        dev2 = device_store(clock, max_batch=64, coalesce_duplicates=False)
+        res2 = dev2.acquire_many_blocking(keys, counts, cap, rate)
+        np.testing.assert_array_equal(res.granted, res2.granted)
+        np.testing.assert_allclose(res.remaining, res2.remaining, atol=1e-4)
+
+    def test_bulk_mixed_counts_per_key_fall_back_to_scan(self, clock):
+        dev = device_store(clock, max_batch=8)
+        # "m" has mixed counts in one call → whole call on the scan path,
+        # exact cumulative prefixes.
+        res = dev.acquire_many_blocking(
+            ["m", "m", "m", "n", "n"], [3, 1, 2, 2, 2], 5.0, 0.0)
+        assert [bool(g) for g in res.granted] == [True, True, False,
+                                                  True, True]
+
+    def test_bulk_grouped_zero_count_probes(self, clock):
+        dev = device_store(clock, max_batch=8)
+        res = dev.acquire_many_blocking(
+            ["p", "p", "p", "p"], [0, 0, 0, 0], 3.0, 0.0)
+        assert res.granted.all()
+        # Probes consumed nothing.
+        assert dev.acquire_many_blocking(["p"], [3], 3.0, 0.0).granted[0]
+
     def test_bulk_default_path_on_inprocess_and_remote_parity(self, clock):
         ref = InProcessBucketStore(clock=clock)
         res = ref.acquire_many_blocking(["a"] * 7, [1] * 7, 5.0, 1.0)
