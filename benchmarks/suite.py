@@ -267,6 +267,33 @@ def bench_sliding_window_10m_bursty(smoke: bool = False) -> dict:
         decided += occ
     jax.block_until_ready(granted)
     dt = time.perf_counter() - t0
+
+    # The same workload against the MESH store: keyed sliding windows
+    # sharded over every visible device (ShardedWindowStore — the serving
+    # path MeshBucketStore.window_acquire rides), end-to-end with string
+    # keys, routing, and per-shard directories.
+    from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        ShardedWindowStore,
+    )
+
+    mesh = create_mesh(len(jax.devices()))
+    ws = ShardedWindowStore(
+        mesh, limit=100.0, window_sec=1.0,
+        per_shard_slots=1 << (10 if smoke else 17))
+    pool = [f"wkey{i}" for i in range(2_000 if smoke else 500_000)]
+    n_bulk = 1 << (10 if smoke else 17)
+    calls = [[pool[j] for j in rng.integers(0, len(pool), n_bulk)]
+             for _ in range(3)]
+    ones = [1] * n_bulk
+    ws.acquire_many_blocking(calls[0], ones, with_remaining=False)  # warm
+    t0 = time.perf_counter()
+    served = 0
+    for c in calls:
+        served += len(ws.acquire_many_blocking(c, ones,
+                                               with_remaining=False))
+    mesh_rate = served / (time.perf_counter() - t0)
+
     return {
         "config": "sliding_window_10m_bursty",
         "metric": "decisions_per_sec",
@@ -274,6 +301,8 @@ def bench_sliding_window_10m_bursty(smoke: bool = False) -> dict:
         "unit": "decisions/s",
         "n_keys": n_slots,
         "arrivals": "poisson bursts (0.9B/0.2B alternating)",
+        "mesh_window_serving_decisions_per_sec": round(mesh_rate),
+        "mesh_window_devices": mesh.devices.size,
     }
 
 
@@ -377,12 +406,104 @@ def bench_two_level_mesh(smoke: bool = False) -> dict:
     }
 
 
+def bench_psum_cadence(smoke: bool = False) -> dict:
+    """Ablation (SURVEY.md §7 "Two-level sync cadence"): per-BATCH psum
+    (one collective per scanned batch — the fused two-level step) vs
+    per-LAUNCH psum (one collective after K batches — the reference's
+    per-period sync posture). Grant decisions are identical; the trade is
+    collective count vs global-counter staleness (bounded by one launch's
+    wall time, ≙ the reference's staleness ≤ ReplenishmentPeriod)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedratelimiting.redis_tpu.ops import kernels as K
+    from distributedratelimiting.redis_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        create_mesh,
+    )
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        init_global_counter,
+        make_two_level_scan_step,
+        make_two_level_scan_step_deferred,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh(n_dev)
+    per_shard = 1 << (10 if smoke else 18)
+    b_local = 256 if smoke else 8192
+    scan_k = 4 if smoke else 16
+    iters = 4 if smoke else 40
+    rng = np.random.default_rng(6)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+    def fresh():
+        state = K.BucketState(
+            tokens=jax.device_put(
+                jnp.zeros((n_dev * per_shard,), jnp.float32), sharding),
+            last_ts=jax.device_put(
+                jnp.zeros((n_dev * per_shard,), jnp.int32), sharding),
+            exists=jax.device_put(
+                jnp.zeros((n_dev * per_shard,), bool), sharding),
+        )
+        return state, jax.device_put(init_global_counter(),
+                                     NamedSharding(mesh, P()))
+
+    staged = [
+        (rng.integers(0, per_shard,
+                      (n_dev, scan_k, b_local)).astype(np.int32),
+         np.ones((n_dev, scan_k, b_local), np.int32),
+         np.ones((n_dev, scan_k, b_local), bool))
+        for _ in range(4)
+    ]
+    cap, rate, decay = jnp.float32(1e9), jnp.float32(1.0), jnp.float32(1.0)
+
+    out = {"config": "psum_cadence", "metric": "aggregate_decisions_per_sec",
+           "unit": "decisions/s", "n_devices": n_dev, "scan_depth": scan_k}
+    grants, gvals = {}, {}
+    for name, factory in (
+        ("per_batch", make_two_level_scan_step),
+        ("per_launch", make_two_level_scan_step_deferred),
+    ):
+        step = factory(mesh, handle_duplicates=False)
+        state, g = fresh()
+
+        def dispatch(state, g, arrays, base):
+            slots, counts, valid = arrays
+            nows = np.arange(scan_k, dtype=np.int32) + base
+            return step(state, jnp.asarray(slots), jnp.asarray(counts),
+                        jnp.asarray(valid), jnp.asarray(nows), cap, rate,
+                        g, decay)
+
+        state, granted, _, g = dispatch(state, g, staged[0], 1)
+        jax.block_until_ready(granted)
+        grants[name] = np.asarray(granted).copy()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state, granted, _, g = dispatch(
+                state, g, staged[i % 4], (i + 1) * scan_k + 1)
+        jax.block_until_ready(granted)
+        dt = time.perf_counter() - t0
+        out[f"{name}_decisions_per_sec"] = round(
+            iters * n_dev * scan_k * b_local / dt)
+        gvals[name] = float(np.asarray(g.value))
+    # Decisions are cadence-independent (the acquire path reads no global
+    # state inside a launch); counters differ only by decay granularity.
+    assert np.array_equal(grants["per_batch"], grants["per_launch"])
+    out["value"] = out["per_batch_decisions_per_sec"]
+    out["global_counter_per_batch"] = gvals["per_batch"]
+    out["global_counter_per_launch"] = gvals["per_launch"]
+    return out
+
+
 CONFIGS = {
     "single_bucket_cpu": bench_single_bucket_cpu,
     "partitioned_10k_uniform": bench_partitioned_10k_uniform,
     "approximate_1m_zipf": bench_approximate_1m_zipf,
     "sliding_window_10m_bursty": bench_sliding_window_10m_bursty,
     "two_level_mesh": bench_two_level_mesh,
+    "psum_cadence": bench_psum_cadence,
 }
 
 

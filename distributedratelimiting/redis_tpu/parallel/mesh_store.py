@@ -14,9 +14,14 @@ Routing of the abstract surface:
   per ``(capacity, fill_rate)`` config (mirroring ``DeviceBucketStore``'s
   one homogeneous table per config), each micro-batched so concurrent
   acquires across all keys coalesce into single fused launches.
-- **Windows, decaying counters, semaphores** — delegated to an inner
-  single-device :class:`DeviceBucketStore`: these tables are small (one
-  row per *limiter*, not per key) and their traffic is per-period, not
+- **Sliding/fixed windows** — also key-sharded
+  (:class:`ShardedWindowStore`, one per ``(limit, window, fixed?)``
+  config): window keys scale with the keyed workload exactly like bucket
+  keys (BASELINE config 4 is 10M window keys), and the hot path needs no
+  collectives either.
+- **Decaying counters, semaphores** — delegated to an inner single-device
+  :class:`DeviceBucketStore`: these tables are small (one row per
+  *limiter*, not per key) and their traffic is per-period, not
   per-request, so sharding them would buy nothing and cost a collective.
 
 Both layers share one clock: a single time authority for every table
@@ -34,6 +39,7 @@ import jax
 from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
 from distributedratelimiting.redis_tpu.parallel.sharded_store import (
     ShardedDeviceStore,
+    ShardedWindowStore,
 )
 from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
@@ -69,6 +75,11 @@ class _CombinedMetrics:
                 f"bucket[cap={cap},rate={rate}]": s.metrics.snapshot()
                 for (cap, rate), s in self._store._shards.items()
             }
+            shards.update({
+                f"window[limit={limit},wticks={wt},fixed={fx}]":
+                    s.metrics.snapshot()
+                for (limit, wt, fx), s in self._store._windows.items()
+            })
         for sub in shards.values():
             for k in ("launches", "rows_processed", "rows_valid",
                       "sweeps", "slots_evicted"):
@@ -113,6 +124,9 @@ class MeshBucketStore(BucketStore):
         self._shards: dict[tuple[float, float], ShardedDeviceStore] = {}
         self._batchers: dict[tuple[float, float],
                              MicroBatcher[_AcquireReq, AcquireResult]] = {}
+        self._windows: dict[tuple[float, int, bool], ShardedWindowStore] = {}
+        self._wbatchers: dict[tuple[float, int, bool],
+                              MicroBatcher[_AcquireReq, AcquireResult]] = {}
         self._registry_lock = threading.RLock()
         self._connected = False
         self._connect_gate = asyncio.Lock()
@@ -147,9 +161,13 @@ class MeshBucketStore(BucketStore):
                 stack.enter_context(self._aux._lock)
                 for key in sorted(self._shards):
                     stack.enter_context(self._shards[key]._lock)
+                for key in sorted(self._windows):
+                    stack.enter_context(self._windows[key]._lock)
                 self._aux.force_rebase(offset)
                 for store in self._shards.values():
                     store.force_rebase(offset)
+                for wstore in self._windows.values():
+                    wstore.force_rebase(offset)
                 self.clock.rebase(offset)  # type: ignore[attr-defined]
 
     # -- lifecycle ---------------------------------------------------------
@@ -171,7 +189,8 @@ class MeshBucketStore(BucketStore):
                 pass
             self._sweeper_task = None
         with self._registry_lock:
-            batchers = list(self._batchers.values())
+            batchers = (list(self._batchers.values())
+                        + list(self._wbatchers.values()))
         for b in batchers:
             await b.aclose()
         await self._aux.aclose()
@@ -192,19 +211,20 @@ class MeshBucketStore(BucketStore):
                 self._shards[key] = store
             return store
 
-    def _batcher(self, capacity: float, fill_rate_per_sec: float
-                 ) -> MicroBatcher[_AcquireReq, AcquireResult]:
-        key = (float(capacity), float(fill_rate_per_sec))
+    def _get_batcher(self, cache: dict, key, store_getter
+                     ) -> MicroBatcher[_AcquireReq, AcquireResult]:
+        """Shared batcher factory for the sharded tiers (buckets and
+        windows): per-config MicroBatcher whose flush runs the tier's
+        fused launch + readback off-loop so the event loop keeps
+        accumulating the next flush."""
         with self._registry_lock:
-            batcher = self._batchers.get(key)
+            batcher = cache.get(key)
             if batcher is None:
-                sharded = self._sharded(capacity, fill_rate_per_sec)
+                store = store_getter()
 
                 async def flush(reqs: Sequence[_AcquireReq],
-                                _s=sharded) -> list[AcquireResult]:
+                                _s=store) -> list[AcquireResult]:
                     loop = asyncio.get_running_loop()
-                    # The fused launch + readback blocks; run it off-loop
-                    # so the loop keeps accumulating the next flush.
                     return await loop.run_in_executor(
                         None, _s.acquire_batch_blocking,
                         [(r.key, r.count) for r in reqs],
@@ -215,8 +235,15 @@ class MeshBucketStore(BucketStore):
                     max_delay_s=self.max_delay_s,
                     max_inflight=self.max_inflight,
                 )
-                self._batchers[key] = batcher
+                cache[key] = batcher
             return batcher
+
+    def _batcher(self, capacity: float, fill_rate_per_sec: float
+                 ) -> MicroBatcher[_AcquireReq, AcquireResult]:
+        key = (float(capacity), float(fill_rate_per_sec))
+        return self._get_batcher(
+            self._batchers, key,
+            lambda: self._sharded(capacity, fill_rate_per_sec))
 
     async def acquire(self, key: str, count: int, capacity: float,
                       fill_rate_per_sec: float) -> AcquireResult:
@@ -230,6 +257,33 @@ class MeshBucketStore(BucketStore):
         self._maybe_rebase_all()
         return self._sharded(capacity, fill_rate_per_sec
                              ).acquire_batch_blocking([(key, count)])[0]
+
+    # -- sharded window tier -----------------------------------------------
+    def _sharded_window(self, limit: float, window_sec: float,
+                        fixed: bool) -> ShardedWindowStore:
+        from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+        key = (float(limit), int(window_sec * bm.TICKS_PER_SECOND), fixed)
+        with self._registry_lock:
+            store = self._windows.get(key)
+            if store is None:
+                store = ShardedWindowStore(
+                    self.mesh, limit=limit, window_sec=window_sec,
+                    fixed=fixed, per_shard_slots=self.per_shard_slots,
+                    clock=self.clock,
+                    rebase_threshold_ticks=_NEVER_REBASE,
+                )
+                self._windows[key] = store
+            return store
+
+    def _wbatcher(self, limit: float, window_sec: float, fixed: bool
+                  ) -> MicroBatcher[_AcquireReq, AcquireResult]:
+        from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+        key = (float(limit), int(window_sec * bm.TICKS_PER_SECOND), fixed)
+        return self._get_batcher(
+            self._wbatchers, key,
+            lambda: self._sharded_window(limit, window_sec, fixed))
 
     async def acquire_many(self, keys, counts, capacity: float,
                            fill_rate_per_sec: float, *,
@@ -275,24 +329,28 @@ class MeshBucketStore(BucketStore):
         return self._aux.sync_counter_blocking(key, local_count,
                                                decay_rate_per_sec)
 
+    # -- key-sharded windows (BASELINE config 4 at mesh scale) --------------
     async def window_acquire(self, key, count, limit, window_sec):
+        await self.connect()
         self._maybe_rebase_all()
-        return await self._aux.window_acquire(key, count, limit, window_sec)
+        return await self._wbatcher(limit, window_sec, False).submit(
+            _AcquireReq(key, count))
 
     def window_acquire_blocking(self, key, count, limit, window_sec):
         self._maybe_rebase_all()
-        return self._aux.window_acquire_blocking(key, count, limit,
-                                                 window_sec)
+        return self._sharded_window(limit, window_sec, False
+                                    ).acquire_batch_blocking([(key, count)])[0]
 
     async def fixed_window_acquire(self, key, count, limit, window_sec):
+        await self.connect()
         self._maybe_rebase_all()
-        return await self._aux.fixed_window_acquire(key, count, limit,
-                                                    window_sec)
+        return await self._wbatcher(limit, window_sec, True).submit(
+            _AcquireReq(key, count))
 
     def fixed_window_acquire_blocking(self, key, count, limit, window_sec):
         self._maybe_rebase_all()
-        return self._aux.fixed_window_acquire_blocking(key, count, limit,
-                                                       window_sec)
+        return self._sharded_window(limit, window_sec, True
+                                    ).acquire_batch_blocking([(key, count)])[0]
 
     async def concurrency_acquire(self, key, count, limit):
         self._maybe_rebase_all()
@@ -316,7 +374,8 @@ class MeshBucketStore(BucketStore):
         sweep_all — the server's --sweep-period hooks this)."""
         self._aux.sweep_all()
         with self._registry_lock:
-            stores = list(self._shards.values())
+            stores = (list(self._shards.values())
+                      + list(self._windows.values()))
         for store in stores:
             store.sweep()
 
@@ -336,9 +395,18 @@ class MeshBucketStore(BucketStore):
                     key: store.snapshot()
                     for key, store in self._shards.items()
                 },
+                "windows": {
+                    key: store.snapshot()
+                    for key, store in self._windows.items()
+                },
             }
 
     def restore(self, snap: dict) -> None:
         self._aux.restore(snap["aux"])
         for (cap, rate), sub in snap["shards"].items():
             self._sharded(cap, rate).restore(sub)
+        from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+        for (limit, wticks, fixed), sub in snap.get("windows", {}).items():
+            self._sharded_window(limit, wticks / bm.TICKS_PER_SECOND,
+                                 fixed).restore(sub)

@@ -49,7 +49,10 @@ __all__ = [
     "make_sharded_acquire_step",
     "make_two_level_step",
     "make_two_level_scan_step",
+    "make_two_level_scan_step_deferred",
+    "make_sharded_window_scan_step",
     "ShardedDeviceStore",
+    "ShardedWindowStore",
     "shard_of_key",
     "route_keys",
 ]
@@ -242,55 +245,174 @@ def make_two_level_scan_step(mesh, *, handle_duplicates: bool = True):
     return jax.jit(mapped, donate_argnums=(0, 7))
 
 
-class ShardedDeviceStore:
-    """Host runtime for one key-sharded, homogeneous-config bucket table.
+def make_two_level_scan_step_deferred(mesh, *, handle_duplicates: bool = True):
+    """Cadence ablation counterpart of :func:`make_two_level_scan_step`:
+    the K scanned batches run with NO collectives (acquire only,
+    accumulating each chip's consumed count); ONE psum + ONE global-counter
+    decay-and-add runs after the scan — i.e. per-LAUNCH sync instead of
+    per-batch, the analogue of the reference's per-``ReplenishmentPeriod``
+    sync against per-request (SURVEY.md §7 "Two-level sync cadence").
 
-    Mirrors ``_DeviceTable``'s role in the single-chip store, scaled over a
-    mesh: host directory maps key → (shard, local slot); requests are
-    grouped by shard, padded to a common per-shard width, and decided in
-    one launch of the sharded step. The global tier (two-level) is fused
-    into the same launch.
+    Grant decisions are bit-identical to the per-batch variant — the
+    acquire path never reads the global counter inside a launch (fair-share
+    feedback happens between launches, in the approximate limiter). What
+    changes is (a) collective count: 1/launch vs K/launch, and (b) the
+    returned counter's decay granularity: one ``Δt·decay`` step at the last
+    batch's ``now`` instead of K steps — staleness bounded by one launch's
+    time span, exactly the reference's staleness ≤ period bound with
+    "period" = launch cadence. Measured trade: benchmarks/RESULTS.md
+    "Psum cadence ablation".
+    """
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    gspecs = GlobalCounter(P(), P(), P(), P())
+    batch_spec = P(SHARD_AXIS, None, None)
+
+    def block(state, slots, counts, valid, nows, capacity, rate,
+              gcounter, decay_rate):
+        def body(carry, xs):
+            st, consumed_acc = carry
+            sl, ct, va, now = xs
+            st, granted, remaining = K.acquire_core(
+                st, sl, ct, va, now, capacity, rate,
+                handle_duplicates=handle_duplicates,
+            )
+            consumed = jnp.sum(jnp.asarray(ct, jnp.float32) * granted)
+            return (st, consumed_acc + consumed), (granted, remaining)
+
+        # The accumulator is per-shard ("varying" over the mesh axis inside
+        # shard_map); the initial zero must be cast to match.
+        zero = jax.lax.pcast(jnp.zeros((), jnp.float32), (SHARD_AXIS,),
+                             to="varying")
+        (state, consumed_total), (granted, remaining) = jax.lax.scan(
+            body, (state, zero),
+            (slots[0], counts[0], valid[0], nows),
+        )
+        total = jax.lax.psum(consumed_total, SHARD_AXIS)  # ONE per launch
+        last_now = nows[-1]
+        decayed, new_period = bm.decay_core(
+            gcounter.value, gcounter.period, gcounter.last_ts,
+            gcounter.exists, last_now, decay_rate,
+        )
+        gcounter = GlobalCounter(
+            value=decayed + total, period=new_period,
+            last_ts=jnp.asarray(last_now, jnp.int32),
+            exists=jnp.asarray(True),
+        )
+        return state, granted[None], remaining[None], gcounter
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
+                  P(), P(), P(), gspecs, P()),
+        out_specs=(state_specs, batch_spec, batch_spec, gspecs),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 7))
+
+
+class _ShardedKeyedTable:
+    """Shared host runtime for key-sharded device tables (buckets and
+    windows): per-shard native key directories, one vectorized crc32
+    routing call per batch, sweep/grow reclaim with cross-shard pinning,
+    and per-shard doubling growth. Subclasses provide the device pieces:
+
+    - ``_widen_state(old, new)`` — re-lay the sharded state arrays at the
+      doubled per-shard width;
+    - ``_device_sweep()`` — run the table's TTL sweep kernel against the
+      current clock and return the freed-mask as a host bool array.
+
+    Requires attributes: ``n_shards``, ``per_shard``, ``dirs``, ``_lock``,
+    ``metrics``.
     """
 
-    def __init__(self, mesh, capacity: float, fill_rate_per_sec: float,
-                 *, per_shard_slots: int = 2**14,
-                 clock: Clock | None = None,
-                 handle_duplicates: bool = True,
-                 rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS) -> None:
-        self.mesh = mesh
-        self.n_shards = mesh.devices.size
-        self.per_shard = per_shard_slots
-        self.capacity = float(capacity)
-        self.fill_rate_per_sec = float(fill_rate_per_sec)
-        self.rate_per_tick = fill_rate_per_sec / bm.TICKS_PER_SECOND
-        self.clock = clock or MonotonicClock()
-        self.metrics = StoreMetrics()
-        # See DeviceBucketStore: a composing store coordinates rebases.
-        self._rebase_threshold = rebase_threshold_ticks
+    #: Max scanned batches per fused dispatch / per-shard row width of one
+    #: scanned batch (bounds the jit cache to power-of-two K variants —
+    #: see DeviceBucketStore._BULK_MAX_K).
+    _BULK_MAX_K = 32
+    _BULK_B = 2048
 
-        n_total = self.n_shards * per_shard_slots
-        sharding = NamedSharding(mesh, P(SHARD_AXIS))
-        self.state = K.BucketState(
-            tokens=jax.device_put(jnp.zeros((n_total,), jnp.float32), sharding),
-            last_ts=jax.device_put(jnp.zeros((n_total,), jnp.int32), sharding),
-            exists=jax.device_put(jnp.zeros((n_total,), bool), sharding),
-        )
-        self.gcounter = jax.device_put(
-            init_global_counter(), NamedSharding(mesh, P())
-        )
-        self._step = make_two_level_step(mesh,
-                                         handle_duplicates=handle_duplicates)
-        self._scan_step = make_two_level_scan_step(
-            mesh, handle_duplicates=handle_duplicates)
-        # One key→local-slot directory per shard (C++ batch-resolve when
-        # buildable — runtime/directory.py); routing key→shard is crc32.
-        self.dirs = [make_directory(per_shard_slots)
-                     for _ in range(self.n_shards)]
-        import threading
+    # -- hooks -------------------------------------------------------------
+    def _widen_state(self, old: int, new: int) -> None:
+        raise NotImplementedError
 
-        self._lock = threading.RLock()
+    def _device_sweep(self) -> np.ndarray:
+        raise NotImplementedError
 
-    # -- slot routing ------------------------------------------------------
+    def force_rebase(self, offset: int) -> None:
+        """Shift the table's stored time state by ``-offset`` ticks WITHOUT
+        touching the clock (the composing store's coordinated-rebase
+        hook)."""
+        raise NotImplementedError
+
+    # -- shared machinery --------------------------------------------------
+    def now_ticks_checked(self) -> int:
+        """Store clock read with int32-overflow protection: rebase the
+        table and the clock together before ~24 days of tick time can
+        overflow (composing stores disable this via
+        ``rebase_threshold_ticks`` and coordinate one rebase across every
+        table sharing the clock)."""
+        now = self.clock.now_ticks()
+        if now >= self._rebase_threshold:
+            with self._lock:
+                now = self.clock.now_ticks()
+                if now >= self._rebase_threshold:
+                    offset = now - _REBASE_MARGIN_TICKS
+                    self.force_rebase(offset)
+                    self.clock.rebase(offset)  # type: ignore[attr-defined]
+                    now = self.clock.now_ticks()
+        return now
+
+    def _bulk_decide(self, keys: Sequence[str], counts: Sequence[int],
+                     with_remaining: bool, launch_chunk) -> BulkAcquireResult:
+        """Shared whole-array bulk path: vectorized key→(shard, local)
+        resolve, ``[n_shards, K, B]`` chunk layout, readback fan-out, and
+        the zero-permit probe override. ``launch_chunk(slots, counts,
+        valid, nows)`` runs the table's scanned step and returns the
+        ``(granted, remaining)`` device arrays."""
+        n = len(keys)
+        counts_np = np.asarray(counts, np.int64)
+        granted_out = np.empty(n, bool)
+        rem_out = np.empty(n, np.float32) if with_remaining else None
+        if n == 0:
+            return BulkAcquireResult(granted_out, rem_out)
+        with self._lock:
+            shards, locs = self._resolve_batch(list(keys))
+            jpos, shard_counts = self._group_by_shard(shards)
+            max_rows = int(shard_counts.max(initial=1))
+            b = _pad_size(min(max_rows, self._BULK_B), floor=8)
+            pos = 0
+            while pos < max_rows:
+                rows = -(-(max_rows - pos) // b)  # ceil
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take_rows = k * b
+                sel = (jpos >= pos) & (jpos < pos + take_rows)
+                rel = (jpos[sel] - pos).astype(np.int64)
+                s_sel = shards[sel]
+                slots_chunk = np.full((self.n_shards, k, b), -1, np.int32)
+                counts_chunk = np.zeros((self.n_shards, k, b), np.int32)
+                valid_chunk = np.zeros((self.n_shards, k, b), bool)
+                slots_chunk[s_sel, rel // b, rel % b] = locs[sel]
+                counts_chunk[s_sel, rel // b, rel % b] = counts_np[sel]
+                valid_chunk[s_sel, rel // b, rel % b] = True
+                nows = np.full((k,), self.now_ticks_checked(), np.int32)
+                granted, remaining = launch_chunk(
+                    jnp.asarray(slots_chunk), jnp.asarray(counts_chunk),
+                    jnp.asarray(valid_chunk), jnp.asarray(nows))
+                g_np = np.asarray(granted)
+                granted_out[sel] = g_np[s_sel, rel // b, rel % b] > 0.5
+                if rem_out is not None:
+                    r_np = np.asarray(remaining)
+                    rem_out[sel] = r_np[s_sel, rel // b, rel % b]
+                self.metrics.record_launch(self.n_shards * take_rows,
+                                           int(sel.sum()))
+                pos += take_rows
+        if (counts_np == 0).any():
+            # Zero-permit probes are granted unconditionally on every
+            # single-request path; the bulk path's conservative in-batch
+            # prefix could deny one riding beside denied same-key demand.
+            granted_out[counts_np == 0] = True
+        return BulkAcquireResult(granted_out, rem_out)
     @property
     def directory(self) -> dict[str, tuple[int, int]]:
         """Merged ``key → (shard, local slot)`` view (diagnostics/tests;
@@ -347,39 +469,126 @@ class ShardedDeviceStore:
         (the single-chip table's doubling discipline, store.py ``_grow``).
         Kernels recompile at the new shape on next launch."""
         old, new = self.per_shard, self.per_shard * 2
-        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
-
-        def widen(arr):
-            host = np.asarray(arr).reshape(self.n_shards, old)
-            out = np.zeros((self.n_shards, new), host.dtype)
-            out[:, :old] = host
-            return jax.device_put(out.reshape(-1), sharding)
-
-        self.state = K.BucketState(
-            tokens=widen(self.state.tokens),
-            last_ts=widen(self.state.last_ts),
-            exists=widen(self.state.exists),
-        )
+        self._widen_state(old, new)
         for d in self.dirs:
             d.add_slots(old, new)
         self.per_shard = new
         self.metrics.pregrows += 1
 
-    def now_ticks_checked(self) -> int:
-        """Store clock read with the same int32-overflow protection as the
-        single-chip store: rebase every epoch-bearing array (sharded state,
-        replicated global counter) and the clock together before ~24 days
-        of tick time can overflow."""
-        now = self.clock.now_ticks()
-        if now >= self._rebase_threshold:
-            with self._lock:
-                now = self.clock.now_ticks()
-                if now >= self._rebase_threshold:
-                    offset = now - _REBASE_MARGIN_TICKS
-                    self.force_rebase(offset)
-                    self.clock.rebase(offset)
-                    now = self.clock.now_ticks()
-        return now
+    def _widen_host(self, arr, old: int, new: int) -> np.ndarray:
+        host = np.asarray(arr).reshape(self.n_shards, old)
+        out = np.zeros((self.n_shards, new), host.dtype)
+        out[:, :old] = host
+        return out.reshape(-1)
+
+    def _group_by_shard(self, shards: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-request row position within its shard's queue (stable in
+        request order — duplicate keys keep arrival order for the kernel's
+        prefix serialization) plus the per-shard load histogram."""
+        n = len(shards)
+        shard_counts = np.bincount(shards, minlength=self.n_shards)
+        starts = np.zeros(self.n_shards + 1, np.int64)
+        np.cumsum(shard_counts, out=starts[1:])
+        order = np.argsort(shards, kind="stable")
+        jpos = np.empty(n, np.int64)
+        jpos[order] = np.arange(n) - starts[shards[order]]
+        return jpos, shard_counts
+
+    def sweep(self) -> int:
+        """TTL eviction across all shards (elementwise → partitioned by XLA
+        along the existing sharding, no resharding)."""
+        with self._lock:
+            return self._sweep_locked(None)
+
+    def _sweep_locked(self, pinned: set[int] | None) -> int:
+        """``pinned`` flat slot ids — slots already resolved for an
+        in-flight batch — are exempt from reclamation (same mid-batch
+        cross-contamination hazard as the single-chip store's sweep)."""
+        freed_np = self._device_sweep()
+        n_freed = 0
+        if freed_np.any():
+            dead = np.nonzero(freed_np)[0].astype(np.int64)
+            if pinned:
+                dead = dead[~np.isin(dead, np.fromiter(pinned, np.int64,
+                                                       len(pinned)))]
+            dead_shards = dead // self.per_shard
+            dead_locals = (dead % self.per_shard).astype(np.int32)
+            for shard in np.unique(dead_shards):
+                n_freed += self.dirs[shard].remove_slots(
+                    dead_locals[dead_shards == shard])
+        self.metrics.sweeps += 1
+        self.metrics.slots_evicted += n_freed
+        return n_freed
+
+
+class ShardedDeviceStore(_ShardedKeyedTable):
+    """Host runtime for one key-sharded, homogeneous-config bucket table.
+
+    Mirrors ``_DeviceTable``'s role in the single-chip store, scaled over a
+    mesh: host directory maps key → (shard, local slot); requests are
+    grouped by shard, padded to a common per-shard width, and decided in
+    one launch of the sharded step. The global tier (two-level) is fused
+    into the same launch.
+    """
+
+    def __init__(self, mesh, capacity: float, fill_rate_per_sec: float,
+                 *, per_shard_slots: int = 2**14,
+                 clock: Clock | None = None,
+                 handle_duplicates: bool = True,
+                 rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS) -> None:
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.per_shard = per_shard_slots
+        self.capacity = float(capacity)
+        self.fill_rate_per_sec = float(fill_rate_per_sec)
+        self.rate_per_tick = fill_rate_per_sec / bm.TICKS_PER_SECOND
+        self.clock = clock or MonotonicClock()
+        self.metrics = StoreMetrics()
+        # See DeviceBucketStore: a composing store coordinates rebases.
+        self._rebase_threshold = rebase_threshold_ticks
+
+        n_total = self.n_shards * per_shard_slots
+        sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self.state = K.BucketState(
+            tokens=jax.device_put(jnp.zeros((n_total,), jnp.float32), sharding),
+            last_ts=jax.device_put(jnp.zeros((n_total,), jnp.int32), sharding),
+            exists=jax.device_put(jnp.zeros((n_total,), bool), sharding),
+        )
+        self.gcounter = jax.device_put(
+            init_global_counter(), NamedSharding(mesh, P())
+        )
+        self._step = make_two_level_step(mesh,
+                                         handle_duplicates=handle_duplicates)
+        self._scan_step = make_two_level_scan_step(
+            mesh, handle_duplicates=handle_duplicates)
+        # One key→local-slot directory per shard (C++ batch-resolve when
+        # buildable — runtime/directory.py); routing key→shard is crc32.
+        self.dirs = [make_directory(per_shard_slots)
+                     for _ in range(self.n_shards)]
+        import threading
+
+        self._lock = threading.RLock()
+
+    # -- _ShardedKeyedTable hooks ------------------------------------------
+    def _widen_state(self, old: int, new: int) -> None:
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self.state = K.BucketState(
+            tokens=jax.device_put(
+                self._widen_host(self.state.tokens, old, new), sharding),
+            last_ts=jax.device_put(
+                self._widen_host(self.state.last_ts, old, new), sharding),
+            exists=jax.device_put(
+                self._widen_host(self.state.exists, old, new), sharding),
+        )
+
+    def _device_sweep(self) -> np.ndarray:
+        now = self.now_ticks_checked()
+        self.state, freed = K.sweep_expired(
+            self.state, jnp.int32(now), jnp.float32(self.capacity),
+            jnp.float32(self.rate_per_tick),
+        )
+        return np.asarray(freed)
 
     def force_rebase(self, offset: int) -> None:
         """Shift table + global-counter timestamps without touching the
@@ -430,20 +639,6 @@ class ShardedDeviceStore:
         with self._lock:
             return self._acquire_locked(requests, decay)
 
-    def _group_by_shard(self, shards: np.ndarray
-                        ) -> tuple[np.ndarray, np.ndarray]:
-        """Per-request row position within its shard's queue (stable in
-        request order — duplicate keys keep arrival order for the kernel's
-        prefix serialization) plus the per-shard load histogram."""
-        n = len(shards)
-        shard_counts = np.bincount(shards, minlength=self.n_shards)
-        starts = np.zeros(self.n_shards + 1, np.int64)
-        np.cumsum(shard_counts, out=starts[1:])
-        order = np.argsort(shards, kind="stable")
-        jpos = np.empty(n, np.int64)
-        jpos[order] = np.arange(n) - starts[shards[order]]
-        return jpos, shard_counts
-
     def _acquire_locked(self, requests, decay) -> list[AcquireResult]:
         n = len(requests)
         keys = [k for k, _ in requests]
@@ -470,76 +665,30 @@ class ShardedDeviceStore:
         return [AcquireResult(bool(g), float(r)) for g, r in zip(g_np, r_np)]
 
     # -- bulk decisions (the mesh serving surface for acquire_many) --------
-    #: Max scanned batches per fused dispatch (see DeviceBucketStore
-    #: _BULK_MAX_K: bounds the jit cache to power-of-two K variants).
-    _BULK_MAX_K = 32
-    #: Per-shard row width of one scanned batch.
-    _BULK_B = 2048
-
     def acquire_many_blocking(
         self, keys: Sequence[str], counts: Sequence[int], *,
         with_remaining: bool = True,
         decay_rate_per_sec: float | None = None,
     ) -> BulkAcquireResult:
-        """Whole-array bulk acquire over the mesh: vectorized key→(shard,
-        local) resolve, batch laid out ``[n_shards, K, B]``, decided by the
-        scanned two-level step (sharded acquire + one psum per scanned
-        batch). This is the serving surface for
-        :func:`make_two_level_scan_step` — each dispatch decides up to
-        ``n_shards × K × B`` requests in one fused launch."""
-        n = len(keys)
+        """Whole-array bulk acquire over the mesh: the shared
+        ``_bulk_decide`` chunking (``[n_shards, K, B]`` layout) over the
+        scanned two-level step — sharded acquire + one psum per scanned
+        batch. Each dispatch decides up to ``n_shards × K × B`` requests
+        in one fused launch."""
         decay = (decay_rate_per_sec if decay_rate_per_sec is not None
                  else self.fill_rate_per_sec) / bm.TICKS_PER_SECOND
-        counts_np = np.asarray(counts, np.int64)
-        granted_out = np.empty(n, bool)
-        rem_out = np.empty(n, np.float32) if with_remaining else None
-        if n == 0:
-            return BulkAcquireResult(granted_out, rem_out)
-        with self._lock:
-            shards, locs = self._resolve_batch(list(keys))
-            jpos, shard_counts = self._group_by_shard(shards)
-            max_rows = int(shard_counts.max(initial=1))
-            b = _pad_size(min(max_rows, self._BULK_B), floor=8)
-            cap = jnp.float32(self.capacity)
-            rate = jnp.float32(self.rate_per_tick)
-            decay_dev = jnp.float32(decay)
-            pos = 0
-            while pos < max_rows:
-                rows = -(-(max_rows - pos) // b)  # ceil
-                k = 1
-                while k < rows and k < self._BULK_MAX_K:
-                    k *= 2
-                take_rows = k * b
-                sel = (jpos >= pos) & (jpos < pos + take_rows)
-                rel = (jpos[sel] - pos).astype(np.int64)
-                s_sel = shards[sel]
-                slots_chunk = np.full((self.n_shards, k, b), -1, np.int32)
-                counts_chunk = np.zeros((self.n_shards, k, b), np.int32)
-                valid_chunk = np.zeros((self.n_shards, k, b), bool)
-                slots_chunk[s_sel, rel // b, rel % b] = locs[sel]
-                counts_chunk[s_sel, rel // b, rel % b] = counts_np[sel]
-                valid_chunk[s_sel, rel // b, rel % b] = True
-                now = self.now_ticks_checked()
-                nows = np.full((k,), now, np.int32)
-                self.state, granted, remaining, self.gcounter = self._scan_step(
-                    self.state, jnp.asarray(slots_chunk),
-                    jnp.asarray(counts_chunk), jnp.asarray(valid_chunk),
-                    jnp.asarray(nows), cap, rate, self.gcounter, decay_dev,
-                )
-                g_np = np.asarray(granted)
-                granted_out[sel] = g_np[s_sel, rel // b, rel % b] > 0.5
-                if rem_out is not None:
-                    r_np = np.asarray(remaining)
-                    rem_out[sel] = r_np[s_sel, rel // b, rel % b]
-                self.metrics.record_launch(self.n_shards * take_rows,
-                                           int(sel.sum()))
-                pos += take_rows
-        if (counts_np == 0).any():
-            # Zero-permit probes are granted unconditionally on every
-            # single-request path; the bulk path's conservative in-batch
-            # prefix could deny one riding beside denied same-key demand.
-            granted_out[counts_np == 0] = True
-        return BulkAcquireResult(granted_out, rem_out)
+        cap = jnp.float32(self.capacity)
+        rate = jnp.float32(self.rate_per_tick)
+        decay_dev = jnp.float32(decay)
+
+        def launch_chunk(slots, counts_dev, valid, nows):
+            self.state, granted, remaining, self.gcounter = self._scan_step(
+                self.state, slots, counts_dev, valid, nows, cap, rate,
+                self.gcounter, decay_dev,
+            )
+            return granted, remaining
+
+        return self._bulk_decide(keys, counts, with_remaining, launch_chunk)
 
     @property
     def global_score(self) -> float:
@@ -615,34 +764,191 @@ class ShardedDeviceStore:
             for d, mapping in zip(self.dirs, snap["directories"]):
                 d.load(mapping, self.per_shard)
 
-    def sweep(self) -> int:
-        """TTL eviction across all shards (elementwise → partitioned by XLA
-        along the existing sharding, no resharding)."""
-        with self._lock:
-            return self._sweep_locked(None)
 
-    def _sweep_locked(self, pinned: set[int] | None) -> int:
-        """``pinned`` flat slot ids — slots already resolved for an
-        in-flight batch — are exempt from reclamation (same mid-batch
-        cross-contamination hazard as the single-chip store's sweep)."""
-        now = self.now_ticks_checked()
-        self.state, freed = K.sweep_expired(
-            self.state, jnp.int32(now), jnp.float32(self.capacity),
-            jnp.float32(self.rate_per_tick),
+
+
+def make_sharded_window_scan_step(mesh, *, interpolate: bool = True,
+                                  handle_duplicates: bool = True):
+    """Scanned key-sharded window step: K micro-batches per launch inside
+    each shard's block (the window analogue of
+    :func:`make_two_level_scan_step`, minus the global tier — windows have
+    no cross-key state, so the hot path needs ZERO collectives).
+    ``interpolate=False`` gives fixed-window semantics over the same state.
+
+    Batch layout: ``slots_k/counts_k/valid_k: [n_shards, K, B_local]``
+    (sharded on axis 0, shard-LOCAL slot ids), ``nows_k: i32[K]``
+    replicated. Returns ``(new_state, granted, remaining)``.
+    """
+    state_specs = K.WindowState(P(SHARD_AXIS), P(SHARD_AXIS),
+                                P(SHARD_AXIS), P(SHARD_AXIS))
+    batch_spec = P(SHARD_AXIS, None, None)
+
+    def block(state, slots, counts, valid, nows, limit, window_ticks):
+        def body(st, xs):
+            sl, ct, va, now = xs
+            st, granted, remaining = K._window_acquire_core(
+                st, sl, ct, va, now, limit, window_ticks,
+                handle_duplicates=handle_duplicates,
+                interpolate=interpolate,
+            )
+            return st, (granted, remaining)
+
+        state, (granted, remaining) = jax.lax.scan(
+            body, state, (slots[0], counts[0], valid[0], nows),
         )
-        freed_np = np.asarray(freed)
-        n_freed = 0
-        if freed_np.any():
-            dead = np.nonzero(freed_np)[0].astype(np.int64)
-            if pinned:
-                dead = dead[~np.isin(dead, np.fromiter(pinned, np.int64,
-                                                       len(pinned)))]
-            dead_shards = dead // self.per_shard
-            dead_locals = (dead % self.per_shard).astype(np.int32)
-            for shard in np.unique(dead_shards):
-                n_freed += self.dirs[shard].remove_slots(
-                    dead_locals[dead_shards == shard])
-        self.metrics.sweeps += 1
-        self.metrics.slots_evicted += n_freed
-        return n_freed
+        return state, granted[None], remaining[None]
 
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
+                  P(), P(), P()),
+        out_specs=(state_specs, batch_spec, batch_spec),
+    )
+    return jax.jit(mapped, donate_argnums=0)
+
+
+class ShardedWindowStore(_ShardedKeyedTable):
+    """Key-sharded sliding/fixed-window table over a mesh — BASELINE
+    config 4 at mesh scale. Mirrors :class:`ShardedDeviceStore`'s host
+    runtime (same directories, routing, growth, sweeps) over
+    ``WindowState`` with the scanned window step; one homogeneous
+    ``(limit, window, fixed?)`` config per instance, matching the
+    single-chip ``_DeviceWindowTable``."""
+
+    def __init__(self, mesh, limit: float, window_sec: float, *,
+                 fixed: bool = False, per_shard_slots: int = 2**14,
+                 clock: Clock | None = None,
+                 handle_duplicates: bool = True,
+                 rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS) -> None:
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.per_shard = per_shard_slots
+        self.limit = float(limit)
+        self.window_ticks = int(window_sec * bm.TICKS_PER_SECOND)
+        self.fixed = fixed
+        self.clock = clock or MonotonicClock()
+        self.metrics = StoreMetrics()
+        # See ShardedDeviceStore: a composing store coordinates rebases.
+        self._rebase_threshold = rebase_threshold_ticks
+        n_total = self.n_shards * per_shard_slots
+        sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        init = K.init_window_state(n_total)
+        self.state = K.WindowState(
+            prev_count=jax.device_put(init.prev_count, sharding),
+            curr_count=jax.device_put(init.curr_count, sharding),
+            window_idx=jax.device_put(init.window_idx, sharding),
+            exists=jax.device_put(init.exists, sharding),
+        )
+        self._scan_step = make_sharded_window_scan_step(
+            mesh, interpolate=not fixed,
+            handle_duplicates=handle_duplicates)
+        self.dirs = [make_directory(per_shard_slots)
+                     for _ in range(self.n_shards)]
+        import threading
+
+        self._lock = threading.RLock()
+
+    # -- _ShardedKeyedTable hooks ------------------------------------------
+    def _widen_state(self, old: int, new: int) -> None:
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self.state = K.WindowState(
+            prev_count=jax.device_put(
+                self._widen_host(self.state.prev_count, old, new), sharding),
+            curr_count=jax.device_put(
+                self._widen_host(self.state.curr_count, old, new), sharding),
+            window_idx=jax.device_put(
+                self._widen_host(self.state.window_idx, old, new), sharding),
+            exists=jax.device_put(
+                self._widen_host(self.state.exists, old, new), sharding),
+        )
+
+    def _device_sweep(self) -> np.ndarray:
+        self.state, freed = K.sweep_windows(
+            self.state, jnp.int32(self.now_ticks_checked()),
+            jnp.int32(self.window_ticks),
+        )
+        return np.asarray(freed)
+
+    def force_rebase(self, offset_ticks: int) -> None:
+        """Window tables rebase by whole windows (see
+        ``kernels.rebase_window_epoch``) — called by the composing store's
+        coordinated rebase, or by ``now_ticks_checked`` standalone."""
+        with self._lock:
+            self.state = K.rebase_window_epoch(
+                self.state, jnp.int32(offset_ticks // self.window_ticks))
+
+    # -- decisions ---------------------------------------------------------
+    def acquire_many_blocking(
+        self, keys: Sequence[str], counts: Sequence[int], *,
+        with_remaining: bool = True,
+    ) -> BulkAcquireResult:
+        """Whole-array bulk window acquire over the mesh — the shared
+        ``_bulk_decide`` chunking over the scanned window step."""
+        limit_dev = jnp.float32(self.limit)
+        window_dev = jnp.int32(self.window_ticks)
+
+        def launch_chunk(slots, counts_dev, valid, nows):
+            self.state, granted, remaining = self._scan_step(
+                self.state, slots, counts_dev, valid, nows,
+                limit_dev, window_dev,
+            )
+            return granted, remaining
+
+        return self._bulk_decide(keys, counts, with_remaining, launch_chunk)
+
+    def acquire_batch_blocking(
+        self, requests: Sequence[tuple[str, int]],
+    ) -> list[AcquireResult]:
+        res = self.acquire_many_blocking(
+            [k for k, _ in requests], [c for _, c in requests])
+        return list(res)
+
+    # -- checkpoint --------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "now_ticks": self.clock.now_ticks(),
+                "n_shards": self.n_shards,
+                "per_shard": self.per_shard,
+                "limit": self.limit,
+                "window_ticks": self.window_ticks,
+                "fixed": self.fixed,
+                "directories": [d.to_dict() for d in self.dirs],
+                "prev_count": np.asarray(self.state.prev_count),
+                "curr_count": np.asarray(self.state.curr_count),
+                "window_idx": np.asarray(self.state.window_idx),
+                "exists": np.asarray(self.state.exists),
+            }
+
+    def restore(self, snap: dict) -> None:
+        with self._lock:
+            if snap["n_shards"] != self.n_shards:
+                raise ValueError(
+                    f"snapshot geometry {snap['n_shards']}x"
+                    f"{snap['per_shard']} != store geometry "
+                    f"{self.n_shards}x{self.per_shard} (shard count must "
+                    "match)")
+            if (snap["limit"] != self.limit
+                    or snap["window_ticks"] != self.window_ticks
+                    or snap["fixed"] != self.fixed):
+                raise ValueError("snapshot config != store config")
+            self.per_shard = int(snap["per_shard"])
+            # Window indices re-align by whole windows, with the SAME
+            # signed clamp as the single-chip restore (_shift_ts): a large
+            # negative shift must leave stale indices negative — i.e.
+            # long-expired — not clip them to "current window", which
+            # would enforce stale counts against fresh requests.
+            shift_w = ((int(self.clock.now_ticks()) - int(snap["now_ticks"]))
+                       // self.window_ticks)
+            idx = _shift_ts(snap["window_idx"], shift_w)
+            sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+            self.state = K.WindowState(
+                prev_count=jax.device_put(
+                    jnp.asarray(snap["prev_count"]), sharding),
+                curr_count=jax.device_put(
+                    jnp.asarray(snap["curr_count"]), sharding),
+                window_idx=jax.device_put(jnp.asarray(idx), sharding),
+                exists=jax.device_put(jnp.asarray(snap["exists"]), sharding),
+            )
+            for d, mapping in zip(self.dirs, snap["directories"]):
+                d.load(mapping, self.per_shard)

@@ -61,9 +61,15 @@ class MicroBatcher(Generic[TReq, TRes]):
         if len(self._pending) >= self._max_batch:
             self._start_flush(loop)
         elif self._timer is None:
-            self._timer = loop.call_later(
-                self._max_delay_s, self._start_flush, loop
-            )
+            # Flush-on-idle: with no flush in flight there is nothing to
+            # overlap the wait with — delay only adds latency (and the
+            # loop's timer granularity inflates a µs deadline to ~1ms).
+            # call_later(0) still runs after this loop pass, so every
+            # same-pass submitter joins the batch. The deadline proper
+            # applies only while the pipeline is busy, where in-flight
+            # flushes provide the batching back-pressure it exists for.
+            delay = 0.0 if not self._tasks else self._max_delay_s
+            self._timer = loop.call_later(delay, self._start_flush, loop)
         return await fut
 
     def _start_flush(self, loop: asyncio.AbstractEventLoop) -> None:
@@ -106,7 +112,15 @@ class MicroBatcher(Generic[TReq, TRes]):
             self._start_flush(loop)
             await asyncio.sleep(0)
         while self._tasks:
-            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+            tasks = list(self._tasks)
+            await asyncio.gather(*tasks, return_exceptions=True)
+            # Remove the awaited tasks ourselves: their done-callback
+            # discards are only QUEUED on the loop, and awaiting a gather
+            # whose children are all already finished does not yield — so
+            # `while self._tasks` alone livelocks (measured: a tight
+            # never-suspending spin) when aclose runs before the callbacks
+            # get a loop pass.
+            self._tasks.difference_update(tasks)
 
     async def aclose(self) -> None:
         self._closed = True

@@ -9,6 +9,26 @@ def run(coro):
     return asyncio.run(coro)
 
 
+def test_aclose_immediately_after_sequential_submits_terminates():
+    """Regression: aclose right after a submit resumes (the flush task
+    finished but its done-callback discard is still queued on the loop)
+    must terminate — flush_now's drain loop used to spin forever because
+    awaiting a gather of already-finished tasks never yields."""
+
+    async def main():
+        async def flush(reqs):
+            return [r * 2 for r in reqs]
+
+        b = MicroBatcher(flush, max_batch=4, max_delay_s=1e-4)
+        for i in range(5):
+            assert await b.submit(i) == i * 2
+        # No intervening yield: the last flush task is done but still in
+        # b._tasks when aclose starts.
+        await asyncio.wait_for(b.aclose(), timeout=5)
+
+    run(main())
+
+
 def test_concurrent_submits_share_one_flush():
     async def main():
         batches = []

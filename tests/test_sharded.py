@@ -189,6 +189,85 @@ class TestShardedBulk:
         assert sharded.global_score == 64.0
 
 
+class TestShardedWindowStore:
+    def test_agrees_with_serial_sliding_window(self, mesh, clock, rng):
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            ShardedWindowStore,
+        )
+
+        ws = ShardedWindowStore(mesh, limit=10.0, window_sec=1.0,
+                                per_shard_slots=32, clock=clock)
+        ref = InProcessBucketStore(clock=clock)
+        for _ in range(8):
+            clock.advance_ticks(int(rng.integers(0, TICKS_PER_SECOND // 2)))
+            keys = [f"w{i}" for i in rng.choice(30, size=20, replace=False)]
+            counts = [int(c) for c in rng.integers(0, 4, size=20)]
+            got = ws.acquire_many_blocking(keys, counts)
+            want = [ref.window_acquire_blocking(k, c, 10.0, 1.0)
+                    for k, c in zip(keys, counts)]
+            for g, w, k, c in zip(got, want, keys, counts):
+                assert g.granted == w.granted, (k, c)
+
+    def test_fixed_window_semantics(self, mesh, clock):
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            ShardedWindowStore,
+        )
+
+        ws = ShardedWindowStore(mesh, limit=3.0, window_sec=1.0, fixed=True,
+                                per_shard_slots=16, clock=clock)
+        res = ws.acquire_many_blocking(["f"] * 4, [1] * 4)
+        assert res.granted.tolist() == [True, True, True, False]
+        clock.advance_seconds(1.0)  # fresh window: full limit again
+        assert ws.acquire_many_blocking(["f"], [3]).granted[0]
+
+    def test_growth_and_sweep(self, mesh, clock):
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            ShardedWindowStore,
+        )
+
+        ws = ShardedWindowStore(mesh, limit=5.0, window_sec=1.0,
+                                per_shard_slots=2, clock=clock)
+        res = ws.acquire_many_blocking([f"wk{i}" for i in range(64)],
+                                       [1] * 64)
+        assert res.granted.all() and ws.per_shard > 2
+        clock.advance_seconds(3.0)  # > 2 windows idle → expire
+        assert ws.sweep() == 64
+        assert len(ws.directory) == 0
+
+    def test_standalone_clock_overflow_rebases(self, mesh):
+        """A standalone ShardedWindowStore (no composing MeshBucketStore
+        coordinating rebases) must self-rebase before int32 tick overflow
+        rather than crash on the i32 now operand."""
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            ShardedWindowStore,
+        )
+
+        clock = ManualClock(start_ticks=2**30 - 10)
+        ws = ShardedWindowStore(mesh, limit=5.0, window_sec=1.0,
+                                per_shard_slots=16, clock=clock)
+        assert ws.acquire_many_blocking(["o"], [5]).granted[0]
+        clock.advance_ticks(100)  # crosses the rebase threshold
+        res = ws.acquire_many_blocking(["o"], [1])
+        assert not res.granted[0]  # same window post-rebase: still drained
+        assert clock.now_ticks() < 2**30  # the clock epoch was rebased
+
+    def test_snapshot_restore_across_epochs(self, mesh):
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            ShardedWindowStore,
+        )
+
+        c1 = ManualClock(start_ticks=5 * TICKS_PER_SECOND)
+        a = ShardedWindowStore(mesh, limit=4.0, window_sec=1.0,
+                               per_shard_slots=16, clock=c1)
+        a.acquire_many_blocking(["s"], [4])
+        snap = a.snapshot()
+        c2 = ManualClock(start_ticks=TICKS_PER_SECOND)
+        b = ShardedWindowStore(mesh, limit=4.0, window_sec=1.0,
+                               per_shard_slots=16, clock=c2)
+        b.restore(snap)
+        assert not b.acquire_many_blocking(["s"], [1]).granted[0]
+
+
 def test_route_keys_matches_scalar(mesh):
     from distributedratelimiting.redis_tpu.parallel.sharded_store import (
         route_keys,
