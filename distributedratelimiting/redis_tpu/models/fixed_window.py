@@ -40,3 +40,9 @@ class FixedWindowRateLimiter(SlidingWindowRateLimiter):
             self.options.instance_name, permits, self.options.permit_limit,
             self.options.window_s,
         )
+
+    def _retry_after(self, permits: int, remaining: float) -> float:
+        # Fixed windows release nothing until the boundary; the window
+        # phase lives with the store (time authority), so the sure bound
+        # is one full window.
+        return self.options.window_s

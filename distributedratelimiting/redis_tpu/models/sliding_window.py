@@ -50,11 +50,25 @@ class SlidingWindowRateLimiter(RateLimiter):
             if permits > 0:
                 self._idle_since = None
             return SUCCESSFUL_LEASE
-        # A denied request can retry once enough of the window slides by;
-        # the worst case is one full window.
         return RateLimitLease(False, {
-            MetadataName.RETRY_AFTER: self.options.window_s,
+            MetadataName.RETRY_AFTER: self._retry_after(permits, remaining),
         })
+
+    def _retry_after(self, permits: int, remaining: float) -> float:
+        """Earliest time a retry could succeed. The interpolated window
+        releases the previous window's count linearly as it slides, at
+        most ``permit_limit / window_s`` permits/sec — so covering the
+        deficit needs at least ``deficit / limit × window`` seconds
+        (exact when the previous window was full; optimistic otherwise),
+        and one full window always suffices. The fixed-window subclass
+        overrides: counts release only at the boundary, so the sure bound
+        is the full window."""
+        deficit = permits - remaining
+        return min(
+            self.options.window_s,
+            max(0.0, deficit / self.options.permit_limit
+                * self.options.window_s),
+        )
 
     # Store-call hooks — the fixed-window subclass overrides ONLY these.
     def _store_acquire_blocking(self, permits: int):

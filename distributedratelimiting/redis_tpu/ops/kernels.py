@@ -435,6 +435,65 @@ def acquire_scan_compact_bits(state: BucketState, slots_k, counts_k,
     return state, out
 
 
+def _unpack_compact5(fused):
+    """Device-side unpack of the :func:`pack_compact5` layout: LE i32 slot
+    from bytes 0-3 (int32 bit-ops land -1 padding exactly via the sign bit
+    in ``<<24``), count from byte 4."""
+    p = fused.astype(jnp.int32)
+    slots_k = (p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16)
+               | (p[..., 3] << 24))
+    return slots_k, p[..., 4]
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan_fused_bits(state: BucketState, fused, nows_k, capacity,
+                            fill_rate_per_tick, *,
+                            handle_duplicates: bool = True):
+    """The bulk serving path's minimum-transfer dispatch: ONE fused
+    operand up (:func:`pack_compact5`, 5 bytes/decision), ONE bit-packed
+    result down (1 bit/decision) — per-transfer floors on tunneled links
+    make the transfer COUNT matter as much as the bytes (RESULTS.md r04).
+
+    Returns ``(new_state, grant_bits u8[K, B//8])`` (little-endian bit
+    order, ``B % 8 == 0``)."""
+    slots_k, counts_k = _unpack_compact5(fused)
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, _ = acquire_core(
+            st, slots, counts, slots >= 0, now, capacity,
+            fill_rate_per_tick, handle_duplicates=handle_duplicates,
+        )
+        bits = (granted.reshape(-1, 8).astype(jnp.uint8)
+                << jnp.arange(8, dtype=jnp.uint8)).sum(
+                    axis=1, dtype=jnp.uint8)
+        return st, bits
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
+
+
+@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+def acquire_scan_fused_packed(state: BucketState, fused, nows_k, capacity,
+                              fill_rate_per_tick, *,
+                              handle_duplicates: bool = True):
+    """Fused-input variant of :func:`acquire_scan_compact_packed`: one
+    operand up, one ``f32[K, 2, B]`` result down (row 0 grants, row 1
+    remaining)."""
+    slots_k, counts_k = _unpack_compact5(fused)
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, remaining = acquire_core(
+            st, slots, counts, slots >= 0, now, capacity,
+            fill_rate_per_tick, handle_duplicates=handle_duplicates,
+        )
+        return st, jnp.stack([granted.astype(jnp.float32), remaining])
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
+
+
 #: Padding sentinel for the 24-bit packed slot layout (all-ones 24 bits).
 SLOT24_PAD = (1 << 24) - 1
 
@@ -492,11 +551,7 @@ def acquire_scan_compact_fused(state: BucketState, fused, nows_k, capacity,
 
     Returns ``(new_state, granted bool[K, B], remaining f32[K, B])``.
     """
-    p = fused.astype(jnp.int32)
-    # int32 bit-ops reassemble the LE slot exactly, including -1 padding
-    # (0xFF in byte 3 lands the sign bit via the <<24).
-    slots_k = p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16) | (p[..., 3] << 24)
-    counts_k = p[..., 4]
+    slots_k, counts_k = _unpack_compact5(fused)
 
     def body(st, xs):
         slots, counts, now = xs

@@ -403,6 +403,7 @@ class MeshBucketStore(BucketStore):
 
     def restore(self, snap: dict) -> None:
         self._aux.restore(snap["aux"])
+        self._migrate_legacy_aux_windows()
         for (cap, rate), sub in snap["shards"].items():
             self._sharded(cap, rate).restore(sub)
         from distributedratelimiting.redis_tpu.ops import bucket_math as bm
@@ -410,3 +411,53 @@ class MeshBucketStore(BucketStore):
         for (limit, wticks, fixed), sub in snap.get("windows", {}).items():
             self._sharded_window(limit, wticks / bm.TICKS_PER_SECOND,
                                  fixed).restore(sub)
+
+    def _migrate_legacy_aux_windows(self) -> None:
+        """Snapshots taken before window serving moved to the sharded tier
+        hold window tables inside the aux store; leaving them there would
+        silently reset every window key (the serving path reads
+        ``self._windows``, init-on-miss) — up to one full extra limit per
+        key right after a planned restart. Move each restored aux window
+        row into the sharded tier (aux restore already re-aligned the
+        window indices to this process's epoch) and drop the aux table."""
+        import numpy as np
+
+        from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+
+        for key3 in list(self._aux._wtables):
+            limit, wticks, fixed = key3
+            table = self._aux._wtables[key3]
+            mapping = table.dir.to_dict()  # key → aux slot
+            del self._aux._wtables[key3]
+            if not mapping:
+                continue
+            ws = self._sharded_window(limit, wticks / bm.TICKS_PER_SECOND,
+                                      fixed)
+            keys = list(mapping)
+            aux_slots = np.fromiter((mapping[k] for k in keys), np.int64,
+                                    len(keys))
+            with ws._lock:
+                shards, locs = ws._resolve_batch(keys)  # grows as needed
+                flat = shards.astype(np.int64) * ws.per_shard + locs
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from distributedratelimiting.redis_tpu.ops import kernels as K
+                from distributedratelimiting.redis_tpu.parallel.mesh import (
+                    SHARD_AXIS,
+                )
+
+                sharding = NamedSharding(ws.mesh, P(SHARD_AXIS))
+                host = {
+                    name: np.array(getattr(ws.state, name))  # writable copy
+                    for name in ("prev_count", "curr_count", "window_idx",
+                                 "exists")
+                }
+                for name in host:
+                    src = np.asarray(getattr(table.state, name))
+                    host[name][flat] = src[aux_slots]
+                ws.state = K.WindowState(**{
+                    name: jax.device_put(jnp.asarray(arr), sharding)
+                    for name, arr in host.items()
+                })

@@ -413,6 +413,7 @@ class _ShardedKeyedTable:
             # prefix could deny one riding beside denied same-key demand.
             granted_out[counts_np == 0] = True
         return BulkAcquireResult(granted_out, rem_out)
+
     @property
     def directory(self) -> dict[str, tuple[int, int]]:
         """Merged ``key → (shard, local slot)`` view (diagnostics/tests;
@@ -763,8 +764,6 @@ class ShardedDeviceStore(_ShardedKeyedTable):
             )
             for d, mapping in zip(self.dirs, snap["directories"]):
                 d.load(mapping, self.per_shard)
-
-
 
 
 def make_sharded_window_scan_step(mesh, *, interpolate: bool = True,

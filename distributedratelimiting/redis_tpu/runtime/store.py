@@ -683,8 +683,10 @@ class _DeviceTable(_PackedLaunchMixin):
         n = len(keys)
         b = self.store.max_batch
         outs: list[tuple] = []
-        # u8 counts ride the 5-bytes/decision compact path; rare oversized
-        # counts fall back to the split layout with an explicit mask.
+        # u8 counts ride the 5-bytes/decision fused path (slots + counts
+        # in ONE operand — transfer count matters as much as bytes on
+        # per-transfer-floor-bound links); rare oversized counts fall back
+        # to the split layout with an explicit mask.
         compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
         with self.store.profiler.span("acquire_many", n), self.store._lock:
             slots = self.resolve_slots(list(keys))
@@ -699,22 +701,21 @@ class _DeviceTable(_PackedLaunchMixin):
                 s = np.full((k * b,), -1, np.int32)
                 s[:take] = slots[pos:pos + take]
                 nows = np.full((k,), now, np.int32)
-                if compact and not with_remaining and b % 8 == 0:
+                if compact:
                     c = np.zeros((k * b,), np.uint8)
                     c[:take] = counts_np[pos:pos + take]
-                    self.state, out = K.acquire_scan_compact_bits(
-                        self.state, jnp.asarray(s.reshape(k, b)),
-                        jnp.asarray(c.reshape(k, b)), jnp.asarray(nows),
-                        self.cap_dev, self.rate_dev,
-                    )
-                elif compact:
-                    c = np.zeros((k * b,), np.uint8)
-                    c[:take] = counts_np[pos:pos + take]
-                    self.state, out = K.acquire_scan_compact_packed(
-                        self.state, jnp.asarray(s.reshape(k, b)),
-                        jnp.asarray(c.reshape(k, b)), jnp.asarray(nows),
-                        self.cap_dev, self.rate_dev,
-                    )
+                    fused = jnp.asarray(K.pack_compact5(
+                        s.reshape(k, b), c.reshape(k, b)))
+                    if not with_remaining and b % 8 == 0:
+                        self.state, out = K.acquire_scan_fused_bits(
+                            self.state, fused, jnp.asarray(nows),
+                            self.cap_dev, self.rate_dev,
+                        )
+                    else:
+                        self.state, out = K.acquire_scan_fused_packed(
+                            self.state, fused, jnp.asarray(nows),
+                            self.cap_dev, self.rate_dev,
+                        )
                 else:
                     c = np.zeros((k * b,), np.int32)
                     c[:take] = counts_np[pos:pos + take]

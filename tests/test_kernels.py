@@ -358,6 +358,29 @@ class TestAcquireScanCompactFused:
         np.testing.assert_allclose(np.asarray(s1.tokens),
                                    np.asarray(s2.tokens), rtol=1e-6)
 
+    def test_fused_bits_matches_compact_bits(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(17)
+        n, b, k = 500, 64, 3
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[2, :8] = -1
+        counts = rng.integers(1, 4, (k, b)).astype(np.uint8)
+        nows = np.arange(1, k + 1, dtype=np.int32)
+        s1 = K.init_bucket_state(n)
+        s1, bits1 = K.acquire_scan_compact_bits(
+            s1, jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(nows),
+            jnp.float32(4.0), jnp.float32(0.1))
+        s2 = K.init_bucket_state(n)
+        s2, bits2 = K.acquire_scan_fused_bits(
+            s2, jnp.asarray(K.pack_compact5(slots, counts)),
+            jnp.asarray(nows), jnp.float32(4.0), jnp.float32(0.1))
+        np.testing.assert_array_equal(np.asarray(bits1), np.asarray(bits2))
+        np.testing.assert_allclose(np.asarray(s1.tokens),
+                                   np.asarray(s2.tokens), rtol=1e-6)
+
     def test_pack_compact5_layout(self):
         import numpy as np
         from distributedratelimiting.redis_tpu.ops import kernels as K

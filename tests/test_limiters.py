@@ -325,6 +325,24 @@ class TestSlidingWindowLimiter:
         with pytest.raises(ValueError):
             lim.acquire(11)
 
+    def test_retry_after_scales_with_deficit(self, store):
+        """The denied lease's retry_after is the sliding release bound
+        ``deficit / limit × window`` (clamped to one window), not a flat
+        window constant: the interpolated window releases the previous
+        count linearly as it slides."""
+        lim = SlidingWindowRateLimiter(
+            SlidingWindowOptions(permit_limit=10, window_s=5.0), store)
+        assert lim.acquire(8).is_acquired
+        denied = lim.acquire(5)  # remaining 2 → deficit 3
+        assert not denied.is_acquired
+        ok, retry = denied.try_get_metadata(MetadataName.RETRY_AFTER)
+        assert ok and retry == pytest.approx(3 / 10 * 5.0)
+        # A tiny deficit asks a tiny wait; never more than one window.
+        denied2 = lim.acquire(3)  # deficit 1
+        _, retry2 = denied2.try_get_metadata(MetadataName.RETRY_AFTER)
+        assert retry2 == pytest.approx(1 / 10 * 5.0)
+        assert retry2 <= 5.0
+
 
 class TestPartitionedLimiter:
     def test_partitions_independent(self, store):

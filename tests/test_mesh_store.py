@@ -35,6 +35,34 @@ def store():
                            max_delay_s=2e-3)
 
 
+def test_legacy_aux_window_snapshot_migrates_to_sharded_tier():
+    """A snapshot taken when windows were served by the aux store must
+    restore into the sharded window tier — otherwise every window key
+    resets to a full fresh limit after a planned restart."""
+
+    async def main():
+        clock = ManualClock()
+        # Forge the legacy shape: drive windows through the AUX store of a
+        # mesh store, then snapshot with the window state under aux.
+        legacy = MeshBucketStore(clock=clock, per_shard_slots=16)
+        await legacy.connect()
+        legacy._aux.window_acquire_blocking("w", 3, 3.0, 1.0)
+        snap = legacy.snapshot()
+        snap.pop("windows", None)  # what an old snapshot looks like
+        assert snap["aux"]["wtables"]
+        await legacy.aclose()
+
+        fresh = MeshBucketStore(clock=ManualClock(), per_shard_slots=16)
+        await fresh.connect()
+        fresh.restore(snap)
+        # The key is at its limit — served from the SHARDED tier now.
+        assert not fresh.window_acquire_blocking("w", 1, 3.0, 1.0).granted
+        assert not fresh._aux._wtables  # aux copy dropped, no double state
+        await fresh.aclose()
+
+    run(main())
+
+
 class TestBucketTier:
     def test_blocking_semantics_match_reference(self, store):
         clock = store.clock

@@ -334,6 +334,64 @@ class TestTwoLevelScanStep:
                                    float(np.asarray(g2.value)), rtol=1e-6)
 
 
+class TestDeferredScanStep:
+    def test_matches_per_batch_cadence(self, mesh):
+        """The per-launch-psum variant must produce identical grants and
+        table state; with decay 0 the global counters are exactly equal
+        (pure sums), so the one-psum accumulator is fully checked."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+        from distributedratelimiting.redis_tpu.parallel.mesh import SHARD_AXIS
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            init_global_counter,
+            make_two_level_scan_step,
+            make_two_level_scan_step_deferred,
+        )
+
+        n_dev = mesh.devices.size
+        per_shard, b, k = 16, 8, 3
+        sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        rng = np.random.default_rng(23)
+        slots = rng.integers(0, per_shard, (n_dev, k, b)).astype(np.int32)
+        counts = rng.integers(0, 3, (n_dev, k, b)).astype(np.int32)
+        valid = np.ones((n_dev, k, b), bool)
+        nows = np.array([4, 9, 13], np.int32)
+        cap, rate = jnp.float32(5.0), jnp.float32(0.25)
+
+        def fresh():
+            state = K.BucketState(
+                tokens=jax.device_put(
+                    jnp.zeros((n_dev * per_shard,), jnp.float32), sharding),
+                last_ts=jax.device_put(
+                    jnp.zeros((n_dev * per_shard,), jnp.int32), sharding),
+                exists=jax.device_put(
+                    jnp.zeros((n_dev * per_shard,), bool), sharding),
+            )
+            g = jax.device_put(init_global_counter(),
+                               NamedSharding(mesh, P()))
+            return state, g
+
+        outs = {}
+        for name, factory in (("batch", make_two_level_scan_step),
+                              ("launch", make_two_level_scan_step_deferred)):
+            step = factory(mesh)
+            s, g = fresh()
+            s, granted, rem, g = step(
+                s, jnp.asarray(slots), jnp.asarray(counts),
+                jnp.asarray(valid), jnp.asarray(nows), cap, rate, g,
+                jnp.float32(0.0))
+            outs[name] = (np.asarray(granted), np.asarray(rem),
+                          np.asarray(s.tokens), float(np.asarray(g.value)))
+        np.testing.assert_array_equal(outs["batch"][0], outs["launch"][0])
+        np.testing.assert_allclose(outs["batch"][1], outs["launch"][1],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(outs["batch"][2], outs["launch"][2],
+                                   rtol=1e-6)
+        assert outs["batch"][3] == outs["launch"][3] > 0
+
+
 class TestShardedSnapshotRestore:
     def test_roundtrip_across_clock_epochs(self, mesh):
         c1 = ManualClock(start_ticks=300_000)
