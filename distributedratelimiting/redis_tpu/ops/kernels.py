@@ -606,19 +606,22 @@ def acquire_scan_packed24(state: BucketState, packed, nows_k, capacity,
     return state, granted, remaining
 
 
-@partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))
+@partial(jax.jit, donate_argnums=0,
+         static_argnames=("handle_duplicates", "interpolate"))
 def window_acquire_scan(state: WindowState, slots_k, counts_k, valid_k,
                         nows_k, limit, window_ticks, *,
-                        handle_duplicates: bool = True):
+                        handle_duplicates: bool = True,
+                        interpolate: bool = True):
     """Pipelined sliding-window dispatch: K micro-batches in ONE launch via
     ``lax.scan`` — the window analogue of :func:`acquire_scan`, with the
-    same per-batch ``now`` time-authority property."""
+    same per-batch ``now`` time-authority property. ``interpolate=False``
+    gives fixed-window semantics."""
 
     def body(st, xs):
         slots, counts, valid, now = xs
         st, granted, remaining = _window_acquire_core(
             st, slots, counts, valid, now, limit, window_ticks,
-            handle_duplicates=handle_duplicates,
+            handle_duplicates=handle_duplicates, interpolate=interpolate,
         )
         return st, (granted, remaining)
 
@@ -626,6 +629,31 @@ def window_acquire_scan(state: WindowState, slots_k, counts_k, valid_k,
         body, state, (slots_k, counts_k, valid_k, nows_k)
     )
     return state, granted, remaining
+
+
+@partial(jax.jit, donate_argnums=0,
+         static_argnames=("handle_duplicates", "interpolate"))
+def window_acquire_scan_fused_packed(state: WindowState, fused, nows_k,
+                                     limit, window_ticks, *,
+                                     handle_duplicates: bool = True,
+                                     interpolate: bool = True):
+    """The window bulk path's minimum-transfer dispatch: ONE fused operand
+    up (:func:`pack_compact5`), ONE ``f32[K, 2, B]`` result down (row 0
+    grants, row 1 remaining) — the window analogue of
+    :func:`acquire_scan_fused_packed`. ``interpolate=False`` = fixed
+    windows."""
+    slots_k, counts_k = _unpack_compact5(fused)
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, remaining = _window_acquire_core(
+            st, slots, counts, slots >= 0, now, limit, window_ticks,
+            handle_duplicates=handle_duplicates, interpolate=interpolate,
+        )
+        return st, jnp.stack([granted.astype(jnp.float32), remaining])
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
 
 
 @partial(jax.jit, donate_argnums=0, static_argnames=("handle_duplicates",))

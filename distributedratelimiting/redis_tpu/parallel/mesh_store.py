@@ -352,6 +352,25 @@ class MeshBucketStore(BucketStore):
         return self._sharded_window(limit, window_sec, True
                                     ).acquire_batch_blocking([(key, count)])[0]
 
+    async def window_acquire_many(self, keys, counts, limit, window_sec, *,
+                                  fixed: bool = False,
+                                  with_remaining: bool = True):
+        await self.connect()
+        self._maybe_rebase_all()
+        store = self._sharded_window(limit, window_sec, fixed)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: store.acquire_many_blocking(
+                keys, counts, with_remaining=with_remaining))
+
+    def window_acquire_many_blocking(self, keys, counts, limit, window_sec,
+                                     *, fixed: bool = False,
+                                     with_remaining: bool = True):
+        self._maybe_rebase_all()
+        return self._sharded_window(limit, window_sec, fixed
+                                    ).acquire_many_blocking(
+            keys, counts, with_remaining=with_remaining)
+
     async def concurrency_acquire(self, key, count, limit):
         self._maybe_rebase_all()
         return await self._aux.concurrency_acquire(key, count, limit)

@@ -278,7 +278,8 @@ class RemoteBucketStore(BucketStore):
     # -- bulk path (OP_ACQUIRE_MANY) ----------------------------------------
     async def _bulk_io(self, key_blobs: list[bytes], counts_np: np.ndarray,
                        spans: list[tuple[int, int]], capacity: float,
-                       fill_rate: float, with_remaining: bool) -> list[tuple]:
+                       fill_rate: float, with_remaining: bool,
+                       kind: int = wire.BULK_KIND_BUCKET) -> list[tuple]:
         """Send every chunk of one bulk call pipelined on the connection,
         then await all replies. One wire round-trip (per ~MAX_FRAME of
         keys) carries thousands of decisions — this is what carries the
@@ -302,7 +303,7 @@ class RemoteBucketStore(BucketStore):
                         wire.write_frame(self._writer, wire.encode_bulk_request(
                             seq, key_blobs[start:end], counts_np[start:end],
                             capacity, fill_rate,
-                            with_remaining=with_remaining))
+                            with_remaining=with_remaining, kind=kind))
                     await self._writer.drain()
                 except Exception as exc:
                     self._drop_connection(
@@ -341,28 +342,63 @@ class RemoteBucketStore(BucketStore):
             np.zeros((0,), bool),
             np.zeros((0,), np.float32) if with_remaining else None)
 
-    async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
-                           capacity: float, fill_rate_per_sec: float, *,
-                           with_remaining: bool = True) -> BulkAcquireResult:
+    async def _bulk_call(self, keys, counts, a: float, b: float,
+                         with_remaining: bool, kind: int) -> BulkAcquireResult:
+        """One bulk round trip (any table kind): prepare → chunked
+        pipelined frames on the I/O loop → reassemble."""
         if len(keys) == 0:
             return self._bulk_empty(with_remaining)
         key_blobs, counts_np, spans = self._bulk_prepare(keys, counts)
         chunks = await self._await_on_io(self._bulk_io(
-            key_blobs, counts_np, spans, capacity, fill_rate_per_sec,
-            with_remaining))
+            key_blobs, counts_np, spans, a, b, with_remaining, kind=kind))
         return self._bulk_assemble(chunks, with_remaining)
+
+    def _bulk_call_blocking(self, keys, counts, a: float, b: float,
+                            with_remaining: bool,
+                            kind: int) -> BulkAcquireResult:
+        if len(keys) == 0:
+            return self._bulk_empty(with_remaining)
+        key_blobs, counts_np, spans = self._bulk_prepare(keys, counts)
+        chunks = self._submit(self._bulk_io(
+            key_blobs, counts_np, spans, a, b, with_remaining,
+            kind=kind)).result(self._request_timeout_s + 1.0)
+        return self._bulk_assemble(chunks, with_remaining)
+
+    async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
+                           capacity: float, fill_rate_per_sec: float, *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        return await self._bulk_call(keys, counts, capacity,
+                                     fill_rate_per_sec, with_remaining,
+                                     wire.BULK_KIND_BUCKET)
 
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], capacity: float,
                               fill_rate_per_sec: float, *,
                               with_remaining: bool = True) -> BulkAcquireResult:
-        if len(keys) == 0:
-            return self._bulk_empty(with_remaining)
-        key_blobs, counts_np, spans = self._bulk_prepare(keys, counts)
-        chunks = self._submit(self._bulk_io(
-            key_blobs, counts_np, spans, capacity, fill_rate_per_sec,
-            with_remaining)).result(self._request_timeout_s + 1.0)
-        return self._bulk_assemble(chunks, with_remaining)
+        return self._bulk_call_blocking(keys, counts, capacity,
+                                        fill_rate_per_sec, with_remaining,
+                                        wire.BULK_KIND_BUCKET)
+
+    async def window_acquire_many(self, keys: Sequence[str],
+                                  counts: Sequence[int], limit: float,
+                                  window_sec: float, *, fixed: bool = False,
+                                  with_remaining: bool = True
+                                  ) -> BulkAcquireResult:
+        """Bulk windows over the wire: same ACQUIRE_MANY framing with the
+        table-kind flag selecting the server's window tier."""
+        return await self._bulk_call(
+            keys, counts, limit, window_sec, with_remaining,
+            wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW)
+
+    def window_acquire_many_blocking(self, keys: Sequence[str],
+                                     counts: Sequence[int], limit: float,
+                                     window_sec: float, *,
+                                     fixed: bool = False,
+                                     with_remaining: bool = True
+                                     ) -> BulkAcquireResult:
+        return self._bulk_call_blocking(
+            keys, counts, limit, window_sec, with_remaining,
+            wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW)
 
     def _request_blocking(self, op: int, key: str = "", count: int = 0,
                           a: float = 0.0, b: float = 0.0) -> tuple:

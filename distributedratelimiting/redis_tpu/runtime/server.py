@@ -205,12 +205,18 @@ class BucketStoreServer:
             if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
                 # Bulk frames carry arrays, not the scalar request shape —
                 # decode + serve them on their own path. One frame = one
-                # store.acquire_many call = (on a device store) a handful
-                # of scanned kernel launches for thousands of decisions.
-                seq, keys, counts, capacity, rate, with_rem = (
+                # store bulk call = (on a device store) a handful of
+                # scanned kernel launches for thousands of decisions.
+                seq, keys, counts, a, b, with_rem, kind = (
                     wire.decode_bulk_request(body))
-                res = await self.store.acquire_many(
-                    keys, counts, capacity, rate, with_remaining=with_rem)
+                if kind == wire.BULK_KIND_BUCKET:
+                    res = await self.store.acquire_many(
+                        keys, counts, a, b, with_remaining=with_rem)
+                else:
+                    res = await self.store.window_acquire_many(
+                        keys, counts, a, b,
+                        fixed=(kind == wire.BULK_KIND_FWINDOW),
+                        with_remaining=with_rem)
                 resp = wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
                 self.requests_served += 1
@@ -340,11 +346,15 @@ def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="TPU bucket-store server")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=6380)
-    parser.add_argument("--backend", choices=("device", "inprocess"),
+    parser.add_argument("--backend", choices=("device", "mesh", "inprocess"),
                         default="device",
-                        help="device = TPU-resident store; inprocess = "
-                        "pure-Python store (CPU baseline / tests)")
-    parser.add_argument("--slots", type=int, default=2**17)
+                        help="device = single-chip TPU store; mesh = "
+                        "key-sharded over every visible chip (the "
+                        "pod-slice deployment); inprocess = pure-Python "
+                        "store (CPU baseline / tests)")
+    parser.add_argument("--slots", type=int, default=2**17,
+                        help="table slots (device backend) or per-shard "
+                        "slots (mesh backend)")
     parser.add_argument("--snapshot-path", default=None,
                         help="checkpoint file for OP_SAVE (≙ Redis BGSAVE "
                         "dump path); if it exists at startup, the store "
@@ -364,6 +374,12 @@ def main(argv: list[str] | None = None) -> None:
             )
 
             store: BucketStore = DeviceBucketStore(n_slots=args.slots)
+        elif args.backend == "mesh":
+            from distributedratelimiting.redis_tpu.parallel.mesh_store import (
+                MeshBucketStore,
+            )
+
+            store = MeshBucketStore(per_shard_slots=args.slots)
         else:
             from distributedratelimiting.redis_tpu.runtime.store import (
                 InProcessBucketStore,

@@ -201,6 +201,41 @@ class BucketStore(abc.ABC):
             np.fromiter((r.remaining for r in results), np.float32,
                         len(results)) if with_remaining else None)
 
+    # -- bulk windows (one call, many keys) --------------------------------
+    async def window_acquire_many(self, keys: Sequence[str],
+                                  counts: Sequence[int], limit: float,
+                                  window_sec: float, *, fixed: bool = False,
+                                  with_remaining: bool = True
+                                  ) -> "BulkAcquireResult":
+        """Vectorized window acquire (sliding by default, ``fixed=True``
+        for fixed windows) — the window analogue of :meth:`acquire_many`,
+        with the same in-call duplicate conservatism and probe semantics.
+        Default: pipelined gather over the per-key path; device stores
+        override with scanned whole-array launches."""
+        op = (self.fixed_window_acquire if fixed else self.window_acquire)
+        results = await asyncio.gather(
+            *(op(k, int(c), limit, window_sec)
+              for k, c in zip(keys, counts)))
+        return BulkAcquireResult(
+            np.fromiter((r.granted for r in results), bool, len(results)),
+            np.fromiter((r.remaining for r in results), np.float32,
+                        len(results)) if with_remaining else None)
+
+    def window_acquire_many_blocking(self, keys: Sequence[str],
+                                     counts: Sequence[int], limit: float,
+                                     window_sec: float, *,
+                                     fixed: bool = False,
+                                     with_remaining: bool = True
+                                     ) -> "BulkAcquireResult":
+        op = (self.fixed_window_acquire_blocking if fixed
+              else self.window_acquire_blocking)
+        results = [op(k, int(c), limit, window_sec)
+                   for k, c in zip(keys, counts)]
+        return BulkAcquireResult(
+            np.fromiter((r.granted for r in results), bool, len(results)),
+            np.fromiter((r.remaining for r in results), np.float32,
+                        len(results)) if with_remaining else None)
+
     # -- decaying global counter (approximate algorithm's shared tier) -----
     @abc.abstractmethod
     async def sync_counter(self, key: str, local_count: float,
@@ -487,6 +522,170 @@ class _PackedLaunchMixin:
         return AcquireResult(bool(out_np[0, 0] > 0.5), float(out_np[1, 0]))
 
 
+    # -- shared bulk machinery (acquire_many over any packed table) --------
+    #: Max scanned batches per bulk dispatch: 32 × 4096 ≈ 768KB of compact
+    #: operands — under the tunneled link's ~1MB sustained-transfer cliff
+    #: (benchmarks/RESULTS.md) while amortizing dispatch overhead. K is
+    #: chosen per call from {1, 2, 4, …, 32}, so the jit cache holds at
+    #: most 6 bulk variants per table.
+    _BULK_MAX_K = 32
+
+    @staticmethod
+    def _gather_bulk(outs: list[tuple], n: int,
+                     with_remaining: bool = True) -> BulkAcquireResult:
+        granted = np.empty((n,), bool)
+        remaining = np.empty((n,), np.float32) if with_remaining else None
+        pos = 0
+        for out, take in outs:
+            # ONE device→host fetch per dispatch (fetches are RTT-bound on
+            # tunneled links — this is the bulk path's whole latency story).
+            out_np = np.asarray(out)
+            if out_np.dtype == np.uint8:       # bit-packed grants
+                bits = np.unpackbits(out_np.reshape(-1), bitorder="little")
+                granted[pos:pos + take] = bits[:take].astype(bool)
+            else:                              # f32[K, 2, B]
+                granted[pos:pos + take] = (
+                    out_np[:, 0, :].reshape(-1)[:take] > 0.5)
+                if remaining is not None:
+                    remaining[pos:pos + take] = (
+                        out_np[:, 1, :].reshape(-1)[:take])
+            pos += take
+        return BulkAcquireResult(granted, remaining)
+
+    @staticmethod
+    def _grant_probes(res: BulkAcquireResult,
+                      counts_np: np.ndarray) -> BulkAcquireResult:
+        """Zero-permit probes are granted unconditionally on every
+        single-request path (the kernel's ``new_v >= 0`` is always true);
+        the bulk path's conservative in-batch prefix could deny a probe
+        riding beside denied same-key demand — override here so direct
+        store callers see one contract (not just limiters that patch up)."""
+        if (counts_np == 0).any():
+            res.granted[counts_np == 0] = True
+        return res
+
+    @staticmethod
+    def _bulk_groups(slots: np.ndarray, counts_np: np.ndarray):
+        """Slot-grouped view of a bulk call for duplicate coalescing, or
+        ``None`` when it wouldn't pay. Fully vectorized (stable argsort +
+        segment boundaries — request order is preserved within each slot's
+        segment, which is what makes group decisions bit-identical to the
+        per-row conservative serialization). Declines when <25% of rows
+        would be saved, or when any key's counts are mixed (the scan
+        path's exact prefixes handle that rare shape)."""
+        n = len(slots)
+        order = np.argsort(slots, kind="stable")
+        s_sorted = slots[order]
+        seg_start = np.r_[True, s_sorted[1:] != s_sorted[:-1]]
+        n_groups = int(seg_start.sum())
+        if n_groups * 4 > n * 3:
+            return None
+        starts = np.nonzero(seg_start)[0]
+        lengths = np.diff(np.r_[starts, n])
+        c_sorted = counts_np[order]
+        first_c = c_sorted[starts]
+        if not np.array_equal(c_sorted, np.repeat(first_c, lengths)):
+            return None
+        seg_id = np.cumsum(seg_start) - 1
+        rank = np.arange(n) - starts[seg_id]
+        return order, seg_id, rank, starts, lengths, first_c
+
+    def _launch_many_grouped(self, keys: Sequence[str],
+                             counts_np: np.ndarray, with_remaining: bool):
+        """Coalesced bulk dispatch: one launch row per ``(key, count)``
+        group via the grouped flush kernel — under Zipf hot keys the
+        transferred bytes (the bulk path's real cost) shrink by the
+        duplicate fraction. Returns a readback closure, or ``None`` when
+        grouping doesn't pay (caller falls back to the scan path)."""
+        n = len(keys)
+        if n == 0:
+            return None
+        with self.store.profiler.span("acquire_many_grouped", n), \
+                self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            g = self._bulk_groups(slots, counts_np)
+            if g is None:
+                return None
+            order, seg_id, rank, starts, lengths, first_c = g
+            gslots = slots[order][starts]
+            gcounts = np.minimum(first_c, 2**31 - 1).astype(np.int32)
+            b = self.store.max_batch
+            now = self.store.now_ticks_checked()
+            outs: list[tuple] = []
+            for pos in range(0, len(gslots), b):
+                m = min(b, len(gslots) - pos)
+                packed = np.full((5, b), -1, np.int32)
+                packed[1] = 0
+                packed[3] = 0  # one group per slot per call ⇒ prefix 0
+                packed[4] = 0
+                packed[0, :m] = gslots[pos:pos + m]
+                packed[1, :m] = gcounts[pos:pos + m]
+                packed[2] = now
+                packed[4, :m] = np.minimum(lengths[pos:pos + m], 2**31 - 1)
+                out = self._launch_grouped(jnp.asarray(packed))
+                outs.append((out, m))
+                self.store.metrics.record_launch(b, m)
+            self.store.metrics.rows_coalesced += n - len(gslots)
+
+        def gather() -> BulkAcquireResult:
+            n_g = np.empty(len(gslots), np.float32)
+            rem_g = np.empty(len(gslots), np.float32)
+            pos = 0
+            for out, m in outs:
+                out_np = np.asarray(out)  # one fetch per dispatch
+                n_g[pos:pos + m] = out_np[0, :m]
+                rem_g[pos:pos + m] = out_np[1, :m]
+                pos += m
+            granted_sorted = rank < n_g[seg_id]
+            granted = np.empty(n, bool)
+            granted[order] = granted_sorted
+            remaining = None
+            if with_remaining:
+                c = first_c[seg_id].astype(np.float32)
+                # Each member's per-row remaining view, reconstructed from
+                # the group result exactly as the flush path does
+                # (_PackedLaunchMixin._flush).
+                avail = rem_g[seg_id] + n_g[seg_id] * c
+                rem_sorted = np.maximum(
+                    avail - rank * c - np.where(granted_sorted, c, 0.0), 0.0)
+                remaining = np.empty(n, np.float32)
+                remaining[order] = rem_sorted.astype(np.float32)
+            return BulkAcquireResult(granted, remaining)
+
+        return gather
+
+    def _bulk_plan(self, keys: Sequence[str], counts_np: np.ndarray,
+                   with_remaining: bool):
+        """Choose + dispatch the bulk strategy; returns the readback
+        closure (callers run it inline or on an executor)."""
+        if self.store.coalesce_duplicates:
+            gather = self._launch_many_grouped(keys, counts_np,
+                                               with_remaining)
+            if gather is not None:
+                return gather
+        outs = self._launch_many(keys, counts_np, with_remaining)
+        return lambda: self._gather_bulk(outs, len(keys), with_remaining)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], *,
+                              with_remaining: bool = True) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        gather = self._bulk_plan(keys, counts_np, with_remaining)
+        return self._grant_probes(gather(), counts_np)
+
+    async def acquire_many(self, keys: Sequence[str],
+                           counts: Sequence[int], *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        gather = self._bulk_plan(keys, counts_np, with_remaining)
+        loop = asyncio.get_running_loop()
+        # ONE await resolves the whole call; the readback runs off-loop so
+        # the event loop keeps serving (and other bulk calls' dispatches
+        # overlap this one's transfer).
+        res = await loop.run_in_executor(None, gather)
+        return self._grant_probes(res, counts_np)
+
+
 class _DeviceTable(_PackedLaunchMixin):
     """One homogeneous-config bucket table: device arrays + host directory."""
 
@@ -669,13 +868,6 @@ class _DeviceTable(_PackedLaunchMixin):
             return out
 
     # -- bulk decision path ------------------------------------------------
-    #: Max scanned batches per bulk dispatch: 32 × 4096 ≈ 768KB of compact
-    #: operands — under the tunneled link's ~1MB sustained-transfer cliff
-    #: (benchmarks/RESULTS.md) while amortizing dispatch overhead. K is
-    #: chosen per call from {1, 2, 4, …, 32}, so the jit cache holds at
-    #: most 6 bulk variants per table.
-    _BULK_MAX_K = 32
-
     def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
                      with_remaining: bool = True) -> list[tuple]:
         """Dispatch a whole key array as scanned kernel launches; returns
@@ -732,161 +924,6 @@ class _DeviceTable(_PackedLaunchMixin):
                 self.store.metrics.record_launch(k * b, take)
                 pos += take
         return outs
-
-    @staticmethod
-    def _gather_bulk(outs: list[tuple], n: int,
-                     with_remaining: bool = True) -> BulkAcquireResult:
-        granted = np.empty((n,), bool)
-        remaining = np.empty((n,), np.float32) if with_remaining else None
-        pos = 0
-        for out, take in outs:
-            # ONE device→host fetch per dispatch (fetches are RTT-bound on
-            # tunneled links — this is the bulk path's whole latency story).
-            out_np = np.asarray(out)
-            if out_np.dtype == np.uint8:       # bit-packed grants
-                bits = np.unpackbits(out_np.reshape(-1), bitorder="little")
-                granted[pos:pos + take] = bits[:take].astype(bool)
-            else:                              # f32[K, 2, B]
-                granted[pos:pos + take] = (
-                    out_np[:, 0, :].reshape(-1)[:take] > 0.5)
-                if remaining is not None:
-                    remaining[pos:pos + take] = (
-                        out_np[:, 1, :].reshape(-1)[:take])
-            pos += take
-        return BulkAcquireResult(granted, remaining)
-
-    @staticmethod
-    def _grant_probes(res: BulkAcquireResult,
-                      counts_np: np.ndarray) -> BulkAcquireResult:
-        """Zero-permit probes are granted unconditionally on every
-        single-request path (the kernel's ``new_v >= 0`` is always true);
-        the bulk path's conservative in-batch prefix could deny a probe
-        riding beside denied same-key demand — override here so direct
-        store callers see one contract (not just limiters that patch up)."""
-        if (counts_np == 0).any():
-            res.granted[counts_np == 0] = True
-        return res
-
-    @staticmethod
-    def _bulk_groups(slots: np.ndarray, counts_np: np.ndarray):
-        """Slot-grouped view of a bulk call for duplicate coalescing, or
-        ``None`` when it wouldn't pay. Fully vectorized (stable argsort +
-        segment boundaries — request order is preserved within each slot's
-        segment, which is what makes group decisions bit-identical to the
-        per-row conservative serialization). Declines when <25% of rows
-        would be saved, or when any key's counts are mixed (the scan
-        path's exact prefixes handle that rare shape)."""
-        n = len(slots)
-        order = np.argsort(slots, kind="stable")
-        s_sorted = slots[order]
-        seg_start = np.r_[True, s_sorted[1:] != s_sorted[:-1]]
-        n_groups = int(seg_start.sum())
-        if n_groups * 4 > n * 3:
-            return None
-        starts = np.nonzero(seg_start)[0]
-        lengths = np.diff(np.r_[starts, n])
-        c_sorted = counts_np[order]
-        first_c = c_sorted[starts]
-        if not np.array_equal(c_sorted, np.repeat(first_c, lengths)):
-            return None
-        seg_id = np.cumsum(seg_start) - 1
-        rank = np.arange(n) - starts[seg_id]
-        return order, seg_id, rank, starts, lengths, first_c
-
-    def _launch_many_grouped(self, keys: Sequence[str],
-                             counts_np: np.ndarray, with_remaining: bool):
-        """Coalesced bulk dispatch: one launch row per ``(key, count)``
-        group via the grouped flush kernel — under Zipf hot keys the
-        transferred bytes (the bulk path's real cost) shrink by the
-        duplicate fraction. Returns a readback closure, or ``None`` when
-        grouping doesn't pay (caller falls back to the scan path)."""
-        n = len(keys)
-        if n == 0:
-            return None
-        with self.store.profiler.span("acquire_many_grouped", n), \
-                self.store._lock:
-            slots = self.resolve_slots(list(keys))
-            g = self._bulk_groups(slots, counts_np)
-            if g is None:
-                return None
-            order, seg_id, rank, starts, lengths, first_c = g
-            gslots = slots[order][starts]
-            gcounts = np.minimum(first_c, 2**31 - 1).astype(np.int32)
-            b = self.store.max_batch
-            now = self.store.now_ticks_checked()
-            outs: list[tuple] = []
-            for pos in range(0, len(gslots), b):
-                m = min(b, len(gslots) - pos)
-                packed = np.full((5, b), -1, np.int32)
-                packed[1] = 0
-                packed[3] = 0  # one group per slot per call ⇒ prefix 0
-                packed[4] = 0
-                packed[0, :m] = gslots[pos:pos + m]
-                packed[1, :m] = gcounts[pos:pos + m]
-                packed[2] = now
-                packed[4, :m] = np.minimum(lengths[pos:pos + m], 2**31 - 1)
-                out = self._launch_grouped(jnp.asarray(packed))
-                outs.append((out, m))
-                self.store.metrics.record_launch(b, m)
-            self.store.metrics.rows_coalesced += n - len(gslots)
-
-        def gather() -> BulkAcquireResult:
-            n_g = np.empty(len(gslots), np.float32)
-            rem_g = np.empty(len(gslots), np.float32)
-            pos = 0
-            for out, m in outs:
-                out_np = np.asarray(out)  # one fetch per dispatch
-                n_g[pos:pos + m] = out_np[0, :m]
-                rem_g[pos:pos + m] = out_np[1, :m]
-                pos += m
-            granted_sorted = rank < n_g[seg_id]
-            granted = np.empty(n, bool)
-            granted[order] = granted_sorted
-            remaining = None
-            if with_remaining:
-                c = first_c[seg_id].astype(np.float32)
-                # Each member's per-row remaining view, reconstructed from
-                # the group result exactly as the flush path does
-                # (_PackedLaunchMixin._flush).
-                avail = rem_g[seg_id] + n_g[seg_id] * c
-                rem_sorted = np.maximum(
-                    avail - rank * c - np.where(granted_sorted, c, 0.0), 0.0)
-                remaining = np.empty(n, np.float32)
-                remaining[order] = rem_sorted.astype(np.float32)
-            return BulkAcquireResult(granted, remaining)
-
-        return gather
-
-    def _bulk_plan(self, keys: Sequence[str], counts_np: np.ndarray,
-                   with_remaining: bool):
-        """Choose + dispatch the bulk strategy; returns the readback
-        closure (callers run it inline or on an executor)."""
-        if self.store.coalesce_duplicates:
-            gather = self._launch_many_grouped(keys, counts_np,
-                                               with_remaining)
-            if gather is not None:
-                return gather
-        outs = self._launch_many(keys, counts_np, with_remaining)
-        return lambda: self._gather_bulk(outs, len(keys), with_remaining)
-
-    def acquire_many_blocking(self, keys: Sequence[str],
-                              counts: Sequence[int], *,
-                              with_remaining: bool = True) -> BulkAcquireResult:
-        counts_np = np.asarray(counts, np.int64)
-        gather = self._bulk_plan(keys, counts_np, with_remaining)
-        return self._grant_probes(gather(), counts_np)
-
-    async def acquire_many(self, keys: Sequence[str],
-                           counts: Sequence[int], *,
-                           with_remaining: bool = True) -> BulkAcquireResult:
-        counts_np = np.asarray(counts, np.int64)
-        gather = self._bulk_plan(keys, counts_np, with_remaining)
-        loop = asyncio.get_running_loop()
-        # ONE await resolves the whole call; the readback runs off-loop so
-        # the event loop keeps serving (and other bulk calls' dispatches
-        # overlap this one's transfer).
-        res = await loop.run_in_executor(None, gather)
-        return self._grant_probes(res, counts_np)
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
@@ -1010,6 +1047,57 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             )
             self.store.metrics.record_launch(b, len(reqs))
             return out
+
+    # -- bulk path (window analogue of _DeviceTable._launch_many) ----------
+    def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
+                     with_remaining: bool = True) -> list[tuple]:
+        """Whole-array window dispatch: fused 5B/decision operands through
+        the scanned window kernel, one packed f32[K, 2, B] result per
+        dispatch. Counts above 255 fall back to the split scan layout."""
+        n = len(keys)
+        b = self.store.max_batch
+        outs: list[tuple] = []
+        compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
+        with self.store.profiler.span("window_acquire_many", n), \
+                self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            now = self.store.now_ticks_checked()
+            pos = 0
+            while pos < n:
+                rows = -(-(n - pos) // b)  # ceil
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take = min(k * b, n - pos)
+                s = np.full((k * b,), -1, np.int32)
+                s[:take] = slots[pos:pos + take]
+                nows = np.full((k,), now, np.int32)
+                if compact:
+                    c = np.zeros((k * b,), np.uint8)
+                    c[:take] = counts_np[pos:pos + take]
+                    self.state, out = K.window_acquire_scan_fused_packed(
+                        self.state, jnp.asarray(K.pack_compact5(
+                            s.reshape(k, b), c.reshape(k, b))),
+                        jnp.asarray(nows), self.limit_dev, self.window_dev,
+                        interpolate=not self.fixed,
+                    )
+                else:
+                    c32 = np.zeros((k * b,), np.int32)
+                    c32[:take] = np.minimum(counts_np[pos:pos + take],
+                                            2**31 - 1)
+                    self.state, granted, remaining = K.window_acquire_scan(
+                        self.state, jnp.asarray(s.reshape(k, b)),
+                        jnp.asarray(c32.reshape(k, b)),
+                        jnp.asarray((s >= 0).reshape(k, b)),
+                        jnp.asarray(nows), self.limit_dev, self.window_dev,
+                        interpolate=not self.fixed,
+                    )
+                    out = jnp.stack(
+                        [granted.astype(jnp.float32), remaining], axis=1)
+                outs.append((out, take))
+                self.store.metrics.record_launch(k * b, take)
+                pos += take
+        return outs
 
 
 class DeviceBucketStore(BucketStore):
@@ -1172,6 +1260,25 @@ class DeviceBucketStore(BucketStore):
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
         return self._table(capacity, fill_rate_per_sec).peek_blocking(key)
+
+    async def window_acquire_many(self, keys: Sequence[str],
+                                  counts: Sequence[int], limit: float,
+                                  window_sec: float, *, fixed: bool = False,
+                                  with_remaining: bool = True
+                                  ) -> BulkAcquireResult:
+        await self.connect()
+        table = self._wtable(limit, window_sec, fixed)
+        return await table.acquire_many(keys, counts,
+                                        with_remaining=with_remaining)
+
+    def window_acquire_many_blocking(self, keys: Sequence[str],
+                                     counts: Sequence[int], limit: float,
+                                     window_sec: float, *,
+                                     fixed: bool = False,
+                                     with_remaining: bool = True
+                                     ) -> BulkAcquireResult:
+        return self._wtable(limit, window_sec, fixed).acquire_many_blocking(
+            keys, counts, with_remaining=with_remaining)
 
     # -- decaying counter --------------------------------------------------
     def _counter_slot(self, key: str) -> int:

@@ -33,10 +33,13 @@ Request payloads:
                        the first frame when the server requires a token)
     PING / SAVE / STATS : empty (SAVE writes the server-configured
                        checkpoint path — clients never supply paths)
-    ACQUIRE_MANY     : [u8 flags][f64 capacity][f64 fill_rate][u32 n]
+    ACQUIRE_MANY     : [u8 flags][f64 a][f64 b][u32 n]
                        [u16 klen × n][key blob utf-8][u32 count × n]
                        — one frame decides n keys' requests (the bulk path;
-                       flags bit 0 = caller wants per-request remaining).
+                       flags bit 0 = caller wants per-request remaining;
+                       flags bits 1-2 = table kind: 0 token bucket with
+                       (a, b) = (capacity, fill_rate), 1 sliding window /
+                       2 fixed window with (a, b) = (limit, window_s)).
                        Length/count arrays are raw little-endian vectors so
                        both ends move them with numpy, not per-key packing.
                        Clients split larger bulks into multiple frames via
@@ -59,9 +62,11 @@ Response payloads:
                   boundary if oversized)
 
 Version history: v1 had no version byte and a u16 OK_TEXT length; v2
-(current) added the version byte, HELLO, and the u32 OK_TEXT length.
-ACQUIRE_MANY/OK_BULK are a v2 extension: an older v2 server replies
-``ERROR unknown op`` to the new request, which clients surface cleanly.
+added the version byte, HELLO, the u32 OK_TEXT length, and
+ACQUIRE_MANY/OK_BULK; v3 (current) gave ACQUIRE_MANY's flags byte the
+table-kind bits — a semantic change to an existing frame, so the version
+bumps (a v2 server would silently serve window frames as token buckets;
+the strict version check exists precisely to fail loudly instead).
 """
 
 from __future__ import annotations
@@ -81,10 +86,11 @@ __all__ = [
     "encode_request", "decode_request", "encode_response", "decode_response",
     "encode_bulk_request", "decode_bulk_request", "encode_bulk_response",
     "bulk_chunk_spans",
+    "BULK_KIND_BUCKET", "BULK_KIND_WINDOW", "BULK_KIND_FWINDOW",
     "read_frame", "write_frame",
 ]
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 
 OP_ACQUIRE = 1
 OP_PEEK = 2
@@ -286,6 +292,13 @@ BULK_CHUNK_BUDGET = MAX_FRAME - 64
 
 _FLAG_WITH_REMAINING = 1
 
+#: Bulk table kinds (flags bits 1-2): which table family decides the frame.
+BULK_KIND_BUCKET = 0
+BULK_KIND_WINDOW = 1
+BULK_KIND_FWINDOW = 2
+_KIND_SHIFT = 1
+_KIND_MASK = 0b110
+
 
 def bulk_chunk_spans(key_blob_lens: "np.ndarray",
                      budget: int | None = None) -> list[tuple[int, int]]:
@@ -316,15 +329,23 @@ def bulk_chunk_spans(key_blob_lens: "np.ndarray",
 def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         counts: "np.ndarray", capacity: float,
                         fill_rate: float, *,
-                        with_remaining: bool = True) -> bytes:
+                        with_remaining: bool = True,
+                        kind: int = BULK_KIND_BUCKET) -> bytes:
     """Encode one ACQUIRE_MANY frame. ``key_blobs`` are pre-encoded utf-8
     keys (callers encode once, then slice chunks out of the same list);
-    ``counts`` any integer array-like, sent as u32."""
+    ``counts`` any integer array-like, sent as u32. ``kind`` selects the
+    table family (bucket/window/fixed-window); for windows the (capacity,
+    fill_rate) slots carry (limit, window_s)."""
     n = len(key_blobs)
     klens = np.fromiter((len(b) for b in key_blobs), np.int64, n)
     if n and int(klens.max()) > 0xFFFF:
         raise ValueError("key exceeds 65535 utf-8 bytes")
-    flags = _FLAG_WITH_REMAINING if with_remaining else 0
+    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
+        # An out-of-range kind would shift into undefined flag bits and
+        # decode as some OTHER kind — fail at encode time instead.
+        raise ValueError(f"unknown bulk kind {kind}")
+    flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
+             | (kind << _KIND_SHIFT))
     payload = b"".join((
         _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
         klens.astype("<u2").tobytes(),
@@ -341,9 +362,8 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
 
 
 def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
-                                               float, float, bool]:
-    """Returns ``(seq, keys, counts[i64], capacity, fill_rate,
-    with_remaining)``."""
+                                               float, float, bool, int]:
+    """Returns ``(seq, keys, counts[i64], a, b, with_remaining, kind)``."""
     ver, seq, op = _VER_SEQ_OP.unpack_from(frame, 0)
     _check_version(ver)
     if op != OP_ACQUIRE_MANY:
@@ -367,7 +387,11 @@ def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
     else:
         keys = [blob[s:e].decode("utf-8")
                 for s, e in zip(starts.tolist(), ends.tolist())]
-    return seq, keys, counts, capacity, fill_rate, bool(flags & _FLAG_WITH_REMAINING)
+    kind = (flags & _KIND_MASK) >> _KIND_SHIFT
+    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
+        raise RemoteStoreError(f"unknown bulk kind {kind}")
+    return (seq, keys, counts, capacity, fill_rate,
+            bool(flags & _FLAG_WITH_REMAINING), kind)
 
 
 def encode_bulk_response(seq: int, granted: "np.ndarray",

@@ -342,11 +342,18 @@ class TestBulkWire:
         counts = np.asarray([1, 2, 0, 7], np.uint32)
         frame = wire.encode_bulk_request(5, blobs, counts, 100.0, 2.5,
                                          with_remaining=True)
-        seq, out_keys, out_counts, cap, rate, with_rem = (
+        seq, out_keys, out_counts, cap, rate, with_rem, kind = (
             wire.decode_bulk_request(frame[4:]))
-        assert (seq, out_keys, cap, rate, with_rem) == (5, keys, 100.0, 2.5,
-                                                        True)
+        assert (seq, out_keys, cap, rate, with_rem, kind) == (
+            5, keys, 100.0, 2.5, True, wire.BULK_KIND_BUCKET)
         assert out_counts.tolist() == [1, 2, 0, 7]
+        # Window-kind frames carry (limit, window_s) in the same slots.
+        wframe = wire.encode_bulk_request(
+            6, blobs[:1], counts[:1], 50.0, 2.0, with_remaining=False,
+            kind=wire.BULK_KIND_FWINDOW)
+        seq, _, _, a, b, with_rem, kind = wire.decode_bulk_request(wframe[4:])
+        assert (seq, a, b, with_rem, kind) == (
+            6, 50.0, 2.0, False, wire.BULK_KIND_FWINDOW)
 
     def test_bulk_response_roundtrip(self):
         granted = np.asarray([True, False, True, True, False], bool)
@@ -371,6 +378,19 @@ class TestBulkWire:
             assert e0 == s1  # contiguous, no gaps or overlaps
         for s, e in spans:
             assert (lens[s:e] + wire.BULK_PER_KEY_OVERHEAD).sum() <= budget
+
+    def test_unknown_bulk_kind_rejected_both_ends(self):
+        with pytest.raises(ValueError, match="unknown bulk kind"):
+            wire.encode_bulk_request(1, [b"k"], np.ones(1, np.uint32),
+                                     1.0, 1.0, kind=4)
+        # A reserved kind arriving on the wire is a protocol error, not
+        # silently served as some other table family.
+        good = wire.encode_bulk_request(1, [b"k"], np.ones(1, np.uint32),
+                                        1.0, 1.0)
+        body = bytearray(good[4:])
+        body[6] |= 0b110  # force kind bits to the reserved value 3
+        with pytest.raises(wire.RemoteStoreError, match="unknown bulk kind"):
+            wire.decode_bulk_request(bytes(body))
 
     def test_oversized_unchunked_frame_is_loud(self):
         blobs = [b"k" * 60_000] * 20  # ~1.2MB in one frame
@@ -558,6 +578,51 @@ class TestBulkClientServer:
                     assert res.granted.all()
                 finally:
                     await good.aclose()
+
+        run(main())
+
+    def test_window_bulk_over_tcp(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    keys = [f"w{i % 3}" for i in range(9)]
+                    res = await store.window_acquire_many(
+                        keys, [1] * 9, 2.0, 1.0)
+                    # 3 window keys × limit 2: first two per key grant.
+                    assert res.granted.tolist() == [True] * 6 + [False] * 3
+                    clock.advance_seconds(2.5)  # windows roll fully
+                    res2 = await store.window_acquire_many(
+                        ["w0"], [2], 2.0, 1.0, fixed=True)
+                    assert res2.granted.all()
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_window_bulk_against_device_store(self):
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            DeviceBucketStore,
+        )
+
+        async def main():
+            async with BucketStoreServer(DeviceBucketStore(n_slots=256)) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    n = 120
+                    keys = [f"wk{i}" for i in range(n)]
+                    res = await store.window_acquire_many(
+                        keys, [2] * n, 5.0, 1.0)
+                    assert res.granted.all()
+                    assert np.allclose(res.remaining, 3.0)
+                    # Fixed-window kind hits its own table family.
+                    res2 = await store.window_acquire_many(
+                        keys, [5] * n, 5.0, 1.0, fixed=True,
+                        with_remaining=False)
+                    assert res2.granted.all() and res2.remaining is None
+                finally:
+                    await store.aclose()
 
         run(main())
 

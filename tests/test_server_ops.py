@@ -74,6 +74,60 @@ class TestSaveOp:
         assert not s2.acquire_blocking("x", 5, 10.0, 1.0).granted
 
 
+class TestMeshBackendCLI:
+    def test_server_cli_serves_mesh_backend(self):
+        """`--backend mesh` from the console: the pod-slice deployment
+        unit (a TCP server fronting every visible chip) must be
+        launchable without code — here against the virtual 8-device CPU
+        mesh, exercising buckets, windows, and the bulk op end to end."""
+        import os
+        import re
+        import subprocess
+        import sys
+
+        from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+            XLA_DEVICE_COUNT_FLAG,
+        )
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DRLT_FORCE_CPU_PLATFORM="1",
+                   XLA_FLAGS=f"{XLA_DEVICE_COUNT_FLAG}=8")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m",
+             "distributedratelimiting.redis_tpu.runtime.server",
+             "--backend", "mesh", "--port", "0", "--slots", "64"],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            m = re.search(r"listening on (\S+):(\d+)", line)
+            assert m, line
+            host, port = m.group(1), int(m.group(2))
+
+            async def drive():
+                client = RemoteBucketStore(address=(host, port))
+                try:
+                    assert (await client.acquire("k", 1, 5.0, 1.0)).granted
+                    assert (await client.window_acquire(
+                        "w", 2, 3.0, 1.0)).granted
+                    res = await client.acquire_many(
+                        [f"b{i}" for i in range(32)], [1] * 32, 5.0, 1.0)
+                    assert res.granted.all()
+                    wres = await client.window_acquire_many(
+                        [f"wb{i}" for i in range(32)], [1] * 32, 5.0, 1.0)
+                    assert wres.granted.all()
+                    stats = await client.stats()
+                    assert any(k.startswith("bucket[")
+                               for k in stats["store"]["tiers"])
+                finally:
+                    await client.aclose()
+
+            run(drive())
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
 class TestStatsOp:
     def test_stats_reports_server_and_store_metrics(self):
         async def main():

@@ -382,6 +382,38 @@ class TestBulkAcquire:
         # Probes consumed nothing.
         assert dev.acquire_many_blocking(["p"], [3], 3.0, 0.0).granted[0]
 
+    def test_window_bulk_zipf_coalesces_and_agrees(self, clock, rng):
+        """window_acquire_many rides the same grouped coalescing as the
+        bucket bulk path (one launch row per (key, count) group), with
+        decisions identical to the per-row scan path."""
+        dev = device_store(clock, max_batch=64)
+        keys = [f"hw{rng.zipf(1.2) % 6}" for _ in range(300)]
+        res = dev.window_acquire_many_blocking(keys, [1] * 300, 4.0, 1.0)
+        assert dev.metrics.rows_coalesced >= 300 - 6 * 2
+        seen: dict[str, int] = {}
+        for k, g in zip(keys, res.granted):
+            before = seen.get(k, 0)
+            assert bool(g) == (before < 4), (k, before)
+            seen[k] = before + 1
+        dev2 = device_store(clock, max_batch=64, coalesce_duplicates=False)
+        res2 = dev2.window_acquire_many_blocking(keys, [1] * 300, 4.0, 1.0)
+        np.testing.assert_array_equal(res.granted, res2.granted)
+        np.testing.assert_allclose(res.remaining, res2.remaining, atol=1e-4)
+
+    def test_window_bulk_fixed_agrees_with_sequential(self, clock, rng):
+        dev = device_store(clock, max_batch=8)
+        ref = InProcessBucketStore(clock=clock)
+        for _ in range(3):
+            keys = [f"fw{i}" for i in rng.choice(12, size=8, replace=False)]
+            counts = [int(c) for c in rng.integers(0, 3, size=8)]
+            got = dev.window_acquire_many_blocking(keys, counts, 5.0, 1.0,
+                                                   fixed=True)
+            want = [ref.fixed_window_acquire_blocking(k, c, 5.0, 1.0)
+                    for k, c in zip(keys, counts)]
+            assert [bool(g) for g in got.granted] == [w.granted
+                                                      for w in want]
+            clock.advance_seconds(0.4)
+
     def test_bulk_default_path_on_inprocess_and_remote_parity(self, clock):
         ref = InProcessBucketStore(clock=clock)
         res = ref.acquire_many_blocking(["a"] * 7, [1] * 7, 5.0, 1.0)
