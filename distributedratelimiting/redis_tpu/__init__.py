@@ -56,6 +56,9 @@ from distributedratelimiting.redis_tpu.models.sliding_window import (
     SlidingWindowRateLimiter,
 )
 from distributedratelimiting.redis_tpu.models.partitioned import PartitionedRateLimiter
+from distributedratelimiting.redis_tpu.models.partitioned_window import (
+    PartitionedWindowRateLimiter,
+)
 from distributedratelimiting.redis_tpu.runtime.store import (
     AcquireResult,
     BucketStore,
@@ -101,6 +104,7 @@ __all__ = [
     "ConcurrencyLimiter",
     "ConcurrencyLease",
     "PartitionedRateLimiter",
+    "PartitionedWindowRateLimiter",
     "AcquireResult",
     "BulkAcquireResult",
     "SyncResult",

@@ -27,7 +27,9 @@ from __future__ import annotations
 import abc
 from typing import Any, Iterable
 
-__all__ = ["MetadataName", "RateLimitLease", "RateLimiter"]
+__all__ = ["MetadataName", "RateLimitLease", "RateLimiter",
+           "check_permits", "sliding_retry_after",
+           "bulk_permit_counts"]
 
 
 class MetadataName:
@@ -133,3 +135,44 @@ class RateLimiter(abc.ABC):
 
     async def __aexit__(self, *exc: object) -> None:
         await self.aclose()
+
+
+def check_permits(permits: int, limit: int | float) -> None:
+    """Shared argument gate (every limiter family): non-negative, and never
+    more than the configured limit — the reference throws the same way
+    (``RedisApproximateTokenBucketRateLimiter.cs:87-90``)."""
+    if permits < 0:
+        raise ValueError("permits must be >= 0")
+    if permits > limit:
+        raise ValueError(
+            f"permits ({permits}) cannot exceed the configured limit "
+            f"({limit})"
+        )
+
+
+def sliding_retry_after(permits: int, remaining: float, limit: float,
+                        window_s: float) -> float:
+    """Earliest time a denied sliding-window request could succeed. The
+    interpolated window releases the previous window's count linearly as
+    it slides, at most ``limit / window_s`` permits/sec — so covering the
+    deficit needs at least ``deficit / limit × window`` seconds (exact
+    when the previous window was full; optimistic otherwise), and one full
+    window always suffices. Single source of truth for every sliding
+    limiter (the fixed-window family returns the full window: counts
+    release only at the boundary, whose phase lives with the store)."""
+    deficit = permits - remaining
+    return min(window_s, max(0.0, deficit / limit * window_s))
+
+
+def bulk_permit_counts(resources, permits, limit: int | float) -> list[int]:
+    """Normalize a bulk call's ``permits`` (int applied to all, or a
+    per-resource sequence) into validated per-request counts."""
+    if isinstance(permits, int):
+        counts = [permits] * len(resources)
+    else:
+        counts = [int(p) for p in permits]
+        if len(counts) != len(resources):
+            raise ValueError("permits must be an int or match resources")
+    for c in counts:
+        check_permits(c, limit)
+    return counts

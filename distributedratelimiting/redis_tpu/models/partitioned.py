@@ -24,6 +24,8 @@ from distributedratelimiting.redis_tpu.models.base import (
     SUCCESSFUL_LEASE,
     MetadataName,
     RateLimitLease,
+    bulk_permit_counts,
+    check_permits,
 )
 from distributedratelimiting.redis_tpu.models.options import TokenBucketOptions
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
@@ -55,13 +57,7 @@ class PartitionedRateLimiter:
         return f"{self.options.instance_name}:{self.partition_key(resource)}"
 
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.token_limit:
-            raise ValueError(
-                f"permits ({permits}) cannot exceed token_limit "
-                f"({self.options.token_limit})"
-            )
+        check_permits(permits, self.options.token_limit)
 
     def _lease(self, granted: bool, remaining: float, permits: int,
                latency_s: float) -> RateLimitLease:
@@ -106,16 +102,9 @@ class PartitionedRateLimiter:
 
     # -- bulk path ---------------------------------------------------------
     def _bulk_args(self, resources, permits):
-        if isinstance(permits, int):
-            counts = [permits] * len(resources)
-        else:
-            counts = [int(p) for p in permits]
-            if len(counts) != len(resources):
-                raise ValueError("permits must be an int or match resources")
-        for c in counts:
-            self._check_permits(c)
-        keys = [self._key(r) for r in resources]
-        return keys, counts
+        counts = bulk_permit_counts(resources, permits,
+                                    self.options.token_limit)
+        return [self._key(r) for r in resources], counts
 
     def _record_bulk(self, res, counts, t0: float) -> None:
         # Zero-permit probes are granted at the STORE layer on every bulk

@@ -17,6 +17,8 @@ from distributedratelimiting.redis_tpu.models.base import (
     MetadataName,
     RateLimitLease,
     RateLimiter,
+    check_permits,
+    sliding_retry_after,
 )
 from distributedratelimiting.redis_tpu.models.options import SlidingWindowOptions
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
@@ -34,13 +36,7 @@ class SlidingWindowRateLimiter(RateLimiter):
         self._idle_since: float | None = time.monotonic()
 
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.permit_limit:
-            raise ValueError(
-                f"permits ({permits}) cannot exceed permit_limit "
-                f"({self.options.permit_limit})"
-            )
+        check_permits(permits, self.options.permit_limit)
 
     def _lease(self, granted: bool, remaining: float, permits: int,
                latency_s: float | None = None) -> RateLimitLease:
@@ -55,20 +51,11 @@ class SlidingWindowRateLimiter(RateLimiter):
         })
 
     def _retry_after(self, permits: int, remaining: float) -> float:
-        """Earliest time a retry could succeed. The interpolated window
-        releases the previous window's count linearly as it slides, at
-        most ``permit_limit / window_s`` permits/sec — so covering the
-        deficit needs at least ``deficit / limit × window`` seconds
-        (exact when the previous window was full; optimistic otherwise),
-        and one full window always suffices. The fixed-window subclass
-        overrides: counts release only at the boundary, so the sure bound
-        is the full window."""
-        deficit = permits - remaining
-        return min(
-            self.options.window_s,
-            max(0.0, deficit / self.options.permit_limit
-                * self.options.window_s),
-        )
+        """See :func:`~.base.sliding_retry_after` (single source of truth;
+        the fixed-window subclass overrides with the full-window bound)."""
+        return sliding_retry_after(permits, remaining,
+                                   self.options.permit_limit,
+                                   self.options.window_s)
 
     # Store-call hooks — the fixed-window subclass overrides ONLY these.
     def _store_acquire_blocking(self, permits: int):

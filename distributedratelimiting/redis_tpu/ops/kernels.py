@@ -633,6 +633,31 @@ def window_acquire_scan(state: WindowState, slots_k, counts_k, valid_k,
 
 @partial(jax.jit, donate_argnums=0,
          static_argnames=("handle_duplicates", "interpolate"))
+def window_acquire_scan_fused_bits(state: WindowState, fused, nows_k,
+                                   limit, window_ticks, *,
+                                   handle_duplicates: bool = True,
+                                   interpolate: bool = True):
+    """Verdict-only fused window dispatch: 1 bit/decision down (the window
+    analogue of :func:`acquire_scan_fused_bits`; ``B % 8 == 0``)."""
+    slots_k, counts_k = _unpack_compact5(fused)
+
+    def body(st, xs):
+        slots, counts, now = xs
+        st, granted, _ = _window_acquire_core(
+            st, slots, counts, slots >= 0, now, limit, window_ticks,
+            handle_duplicates=handle_duplicates, interpolate=interpolate,
+        )
+        bits = (granted.reshape(-1, 8).astype(jnp.uint8)
+                << jnp.arange(8, dtype=jnp.uint8)).sum(
+                    axis=1, dtype=jnp.uint8)
+        return st, bits
+
+    state, out = jax.lax.scan(body, state, (slots_k, counts_k, nows_k))
+    return state, out
+
+
+@partial(jax.jit, donate_argnums=0,
+         static_argnames=("handle_duplicates", "interpolate"))
 def window_acquire_scan_fused_packed(state: WindowState, fused, nows_k,
                                      limit, window_ticks, *,
                                      handle_duplicates: bool = True,

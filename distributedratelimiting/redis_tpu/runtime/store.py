@@ -529,6 +529,53 @@ class _PackedLaunchMixin:
     #: chosen per call from {1, 2, 4, …, 32}, so the jit cache holds at
     #: most 6 bulk variants per table.
     _BULK_MAX_K = 32
+    #: Profiler span name for the scan-path dispatch (per table family).
+    _BULK_SPAN = "acquire_many"
+
+    def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
+                     with_remaining: bool = True) -> list[tuple]:
+        """Dispatch a whole key array as scanned kernel launches; returns
+        per-dispatch device handles (no readback — callers overlap it).
+        The chunking/padding discipline is shared; the table family's
+        ``_launch_scan_chunk`` runs its own scanned kernel per chunk.
+        u8 counts ride the fused 5-bytes/decision layout (slots + counts
+        in ONE operand — transfer count matters as much as bytes on
+        per-transfer-floor-bound links); rare oversized counts fall back
+        to the split layout with an explicit mask."""
+        n = len(keys)
+        b = self.store.max_batch
+        outs: list[tuple] = []
+        compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
+        with self.store.profiler.span(self._BULK_SPAN, n), self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            now = self.store.now_ticks_checked()
+            pos = 0
+            while pos < n:
+                rows = -(-(n - pos) // b)  # ceil
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take = min(k * b, n - pos)
+                s = np.full((k * b,), -1, np.int32)
+                s[:take] = slots[pos:pos + take]
+                c = np.zeros((k * b,), np.uint8 if compact else np.int32)
+                c[:take] = np.minimum(counts_np[pos:pos + take], 2**31 - 1)
+                nows = np.full((k,), now, np.int32)
+                out = self._launch_scan_chunk(
+                    s.reshape(k, b), c.reshape(k, b), nows, compact,
+                    with_remaining)
+                outs.append((out, take))
+                self.store.metrics.record_launch(k * b, take)
+                pos += take
+        return outs
+
+    def _launch_scan_chunk(self, s: np.ndarray, c: np.ndarray,
+                           nows: np.ndarray, compact: bool,
+                           with_remaining: bool):
+        """One chunk's scanned dispatch — returns a device handle whose
+        layout ``_gather_bulk`` understands (u8 bit-packed grants or
+        ``f32[K, 2, B]``)."""
+        raise NotImplementedError
 
     @staticmethod
     def _gather_bulk(outs: list[tuple], n: int,
@@ -867,63 +914,31 @@ class _DeviceTable(_PackedLaunchMixin):
             self.store.metrics.record_launch(b, len(reqs))
             return out
 
-    # -- bulk decision path ------------------------------------------------
-    def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
-                     with_remaining: bool = True) -> list[tuple]:
-        """Dispatch a whole key array as scanned kernel launches; returns
-        per-dispatch device handles (no readback — callers overlap it)."""
-        n = len(keys)
-        b = self.store.max_batch
-        outs: list[tuple] = []
-        # u8 counts ride the 5-bytes/decision fused path (slots + counts
-        # in ONE operand — transfer count matters as much as bytes on
-        # per-transfer-floor-bound links); rare oversized counts fall back
-        # to the split layout with an explicit mask.
-        compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
-        with self.store.profiler.span("acquire_many", n), self.store._lock:
-            slots = self.resolve_slots(list(keys))
-            now = self.store.now_ticks_checked()
-            pos = 0
-            while pos < n:
-                rows = -(-(n - pos) // b)  # ceil
-                k = 1
-                while k < rows and k < self._BULK_MAX_K:
-                    k *= 2
-                take = min(k * b, n - pos)
-                s = np.full((k * b,), -1, np.int32)
-                s[:take] = slots[pos:pos + take]
-                nows = np.full((k,), now, np.int32)
-                if compact:
-                    c = np.zeros((k * b,), np.uint8)
-                    c[:take] = counts_np[pos:pos + take]
-                    fused = jnp.asarray(K.pack_compact5(
-                        s.reshape(k, b), c.reshape(k, b)))
-                    if not with_remaining and b % 8 == 0:
-                        self.state, out = K.acquire_scan_fused_bits(
-                            self.state, fused, jnp.asarray(nows),
-                            self.cap_dev, self.rate_dev,
-                        )
-                    else:
-                        self.state, out = K.acquire_scan_fused_packed(
-                            self.state, fused, jnp.asarray(nows),
-                            self.cap_dev, self.rate_dev,
-                        )
-                else:
-                    c = np.zeros((k * b,), np.int32)
-                    c[:take] = counts_np[pos:pos + take]
-                    self.state, granted, remaining = K.acquire_scan(
-                        self.state, jnp.asarray(s.reshape(k, b)),
-                        jnp.asarray(c.reshape(k, b)),
-                        jnp.asarray((s >= 0).reshape(k, b)),
-                        jnp.asarray(nows), self.cap_dev, self.rate_dev,
-                    )
-                    # One lazy device op so the fetch below stays single.
-                    out = jnp.stack(
-                        [granted.astype(jnp.float32), remaining], axis=1)
-                outs.append((out, take))
-                self.store.metrics.record_launch(k * b, take)
-                pos += take
-        return outs
+    # -- bulk decision path (chunk loop shared via _PackedLaunchMixin) -----
+    def _launch_scan_chunk(self, s: np.ndarray, c: np.ndarray,
+                           nows: np.ndarray, compact: bool,
+                           with_remaining: bool):
+        k, b = s.shape
+        if compact:
+            fused = jnp.asarray(K.pack_compact5(s, c))
+            if not with_remaining and b % 8 == 0:
+                self.state, out = K.acquire_scan_fused_bits(
+                    self.state, fused, jnp.asarray(nows),
+                    self.cap_dev, self.rate_dev,
+                )
+            else:
+                self.state, out = K.acquire_scan_fused_packed(
+                    self.state, fused, jnp.asarray(nows),
+                    self.cap_dev, self.rate_dev,
+                )
+            return out
+        self.state, granted, remaining = K.acquire_scan(
+            self.state, jnp.asarray(s), jnp.asarray(c),
+            jnp.asarray(s >= 0), jnp.asarray(nows),
+            self.cap_dev, self.rate_dev,
+        )
+        # One lazy device op so the fetch stays single.
+        return jnp.stack([granted.astype(jnp.float32), remaining], axis=1)
 
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
@@ -1048,56 +1063,34 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             self.store.metrics.record_launch(b, len(reqs))
             return out
 
-    # -- bulk path (window analogue of _DeviceTable._launch_many) ----------
-    def _launch_many(self, keys: Sequence[str], counts_np: np.ndarray,
-                     with_remaining: bool = True) -> list[tuple]:
-        """Whole-array window dispatch: fused 5B/decision operands through
-        the scanned window kernel, one packed f32[K, 2, B] result per
-        dispatch. Counts above 255 fall back to the split scan layout."""
-        n = len(keys)
-        b = self.store.max_batch
-        outs: list[tuple] = []
-        compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
-        with self.store.profiler.span("window_acquire_many", n), \
-                self.store._lock:
-            slots = self.resolve_slots(list(keys))
-            now = self.store.now_ticks_checked()
-            pos = 0
-            while pos < n:
-                rows = -(-(n - pos) // b)  # ceil
-                k = 1
-                while k < rows and k < self._BULK_MAX_K:
-                    k *= 2
-                take = min(k * b, n - pos)
-                s = np.full((k * b,), -1, np.int32)
-                s[:take] = slots[pos:pos + take]
-                nows = np.full((k,), now, np.int32)
-                if compact:
-                    c = np.zeros((k * b,), np.uint8)
-                    c[:take] = counts_np[pos:pos + take]
-                    self.state, out = K.window_acquire_scan_fused_packed(
-                        self.state, jnp.asarray(K.pack_compact5(
-                            s.reshape(k, b), c.reshape(k, b))),
-                        jnp.asarray(nows), self.limit_dev, self.window_dev,
-                        interpolate=not self.fixed,
-                    )
-                else:
-                    c32 = np.zeros((k * b,), np.int32)
-                    c32[:take] = np.minimum(counts_np[pos:pos + take],
-                                            2**31 - 1)
-                    self.state, granted, remaining = K.window_acquire_scan(
-                        self.state, jnp.asarray(s.reshape(k, b)),
-                        jnp.asarray(c32.reshape(k, b)),
-                        jnp.asarray((s >= 0).reshape(k, b)),
-                        jnp.asarray(nows), self.limit_dev, self.window_dev,
-                        interpolate=not self.fixed,
-                    )
-                    out = jnp.stack(
-                        [granted.astype(jnp.float32), remaining], axis=1)
-                outs.append((out, take))
-                self.store.metrics.record_launch(k * b, take)
-                pos += take
-        return outs
+    # -- bulk path (chunk loop shared via _PackedLaunchMixin) --------------
+    _BULK_SPAN = "window_acquire_many"
+
+    def _launch_scan_chunk(self, s: np.ndarray, c: np.ndarray,
+                           nows: np.ndarray, compact: bool,
+                           with_remaining: bool):
+        k, b = s.shape
+        if compact:
+            fused = jnp.asarray(K.pack_compact5(s, c))
+            if not with_remaining and b % 8 == 0:
+                self.state, out = K.window_acquire_scan_fused_bits(
+                    self.state, fused, jnp.asarray(nows),
+                    self.limit_dev, self.window_dev,
+                    interpolate=not self.fixed,
+                )
+            else:
+                self.state, out = K.window_acquire_scan_fused_packed(
+                    self.state, fused, jnp.asarray(nows),
+                    self.limit_dev, self.window_dev,
+                    interpolate=not self.fixed,
+                )
+            return out
+        self.state, granted, remaining = K.window_acquire_scan(
+            self.state, jnp.asarray(s), jnp.asarray(c),
+            jnp.asarray(s >= 0), jnp.asarray(nows),
+            self.limit_dev, self.window_dev, interpolate=not self.fixed,
+        )
+        return jnp.stack([granted.astype(jnp.float32), remaining], axis=1)
 
 
 class DeviceBucketStore(BucketStore):

@@ -50,6 +50,7 @@ __all__ = [
     "add_tpu_approximate_token_bucket_rate_limiter",
     "add_tpu_queueing_token_bucket_rate_limiter",
     "add_tpu_sliding_window_rate_limiter",
+    "add_tpu_partitioned_window_rate_limiter",
     "add_tpu_concurrency_limiter",
     "add_tpu_fixed_window_rate_limiter",
 ]
@@ -178,4 +179,24 @@ def add_tpu_sliding_window_rate_limiter(
     registry.add_singleton(
         service_name,
         lambda reg: SlidingWindowRateLimiter(configure(), _store_of(reg, store)),
+    )
+
+
+def add_tpu_partitioned_window_rate_limiter(
+    registry: ServiceRegistry,
+    configure: "Callable[[], SlidingWindowOptions | FixedWindowOptions]",
+    *,
+    store: BucketStore | None = None,
+    service_name: str = RATE_LIMITER,
+) -> None:
+    """Keyed window façade: one window per resource (sliding by default;
+    pass :class:`FixedWindowOptions` for boundary-reset semantics)."""
+    from distributedratelimiting.redis_tpu.models.partitioned_window import (
+        PartitionedWindowRateLimiter,
+    )
+
+    registry.add_singleton(
+        service_name,
+        lambda reg: PartitionedWindowRateLimiter(configure(),
+                                                 _store_of(reg, store)),
     )

@@ -344,6 +344,74 @@ class TestSlidingWindowLimiter:
         assert retry2 <= 5.0
 
 
+class TestPartitionedWindowLimiter:
+    def test_partitions_independent_sliding(self, store, clock):
+        from distributedratelimiting.redis_tpu.models.partitioned_window import (
+            PartitionedWindowRateLimiter,
+        )
+
+        lim = PartitionedWindowRateLimiter(
+            SlidingWindowOptions(permit_limit=3, window_s=1.0,
+                                 instance_name="w"), store)
+        assert lim.acquire("alice", 3).is_acquired
+        assert lim.acquire("bob", 3).is_acquired     # separate window
+        denied = lim.acquire("alice", 2)
+        assert not denied.is_acquired
+        ok, retry = denied.try_get_metadata(MetadataName.RETRY_AFTER)
+        assert ok and 0 < retry <= 1.0
+        clock.advance_seconds(2.5)
+        assert lim.acquire("alice", 3).is_acquired   # window slid away
+
+    def test_fixed_options_select_fixed_semantics(self, store, clock):
+        from distributedratelimiting.redis_tpu.models.options import (
+            FixedWindowOptions,
+        )
+        from distributedratelimiting.redis_tpu.models.partitioned_window import (
+            PartitionedWindowRateLimiter,
+        )
+
+        lim = PartitionedWindowRateLimiter(
+            FixedWindowOptions(permit_limit=2, window_s=1.0,
+                               instance_name="f"), store)
+        assert lim.fixed
+        assert lim.acquire("x", 2).is_acquired
+        denied = lim.acquire("x", 1)
+        assert not denied.is_acquired
+        _, retry = denied.try_get_metadata(MetadataName.RETRY_AFTER)
+        assert retry == 1.0  # fixed: the sure full-window bound
+        clock.advance_seconds(1.0)  # boundary reset, not gradual release
+        assert lim.acquire("x", 2).is_acquired
+
+    def test_bulk_acquire_many(self, store):
+        from distributedratelimiting.redis_tpu.models.partitioned_window import (
+            PartitionedWindowRateLimiter,
+        )
+
+        lim = PartitionedWindowRateLimiter(
+            SlidingWindowOptions(permit_limit=2, window_s=5.0,
+                                 instance_name="wb"), store)
+
+        async def main():
+            res = await lim.acquire_many(
+                [f"u{i % 4}" for i in range(12)], 1)
+            assert [bool(g) for g in res.granted] == [True] * 8 + [False] * 4
+            assert lim.metrics.decisions == 12
+
+        run(main())
+
+    def test_over_limit_raises(self, store):
+        from distributedratelimiting.redis_tpu.models.partitioned_window import (
+            PartitionedWindowRateLimiter,
+        )
+
+        lim = PartitionedWindowRateLimiter(
+            SlidingWindowOptions(permit_limit=5, window_s=1.0), store)
+        with pytest.raises(ValueError):
+            lim.acquire("x", 6)
+        with pytest.raises(ValueError):
+            lim.acquire_many_blocking(["a", "b"], [1, 9])
+
+
 class TestPartitionedLimiter:
     def test_partitions_independent(self, store):
         lim = PartitionedRateLimiter(
@@ -395,6 +463,18 @@ class TestRegistry:
             service_name="approx")
         assert isinstance(
             reg.resolve("approx"), ApproximateTokenBucketRateLimiter)
+
+        from distributedratelimiting.redis_tpu.models.partitioned_window import (
+            PartitionedWindowRateLimiter,
+        )
+        from distributedratelimiting.redis_tpu.utils.registry import (
+            add_tpu_partitioned_window_rate_limiter,
+        )
+
+        add_tpu_partitioned_window_rate_limiter(
+            reg, lambda: SlidingWindowOptions(permit_limit=5),
+            store=store, service_name="pwin")
+        assert isinstance(reg.resolve("pwin"), PartitionedWindowRateLimiter)
 
 
 class TestSyncOnlyRefresh:
