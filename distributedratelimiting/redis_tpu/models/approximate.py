@@ -39,6 +39,7 @@ from distributedratelimiting.redis_tpu.models.base import (
     MetadataName,
     RateLimitLease,
     RateLimiter,
+    check_permits,
 )
 from distributedratelimiting.redis_tpu.models.options import (
     ApproximateTokenBucketOptions,
@@ -81,13 +82,7 @@ class ApproximateTokenBucketRateLimiter(RateLimiter):
 
     # -- hot path ----------------------------------------------------------
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.token_limit:
-            raise ValueError(  # ≙ :87-90
-                f"permits ({permits}) cannot exceed token_limit "
-                f"({self.options.token_limit})"
-            )
+        check_permits(permits, self.options.token_limit)  # ≙ :87-90
         if self._disposed:
             raise RuntimeError("limiter is disposed")
 
@@ -142,6 +137,57 @@ class ApproximateTokenBucketRateLimiter(RateLimiter):
             return SUCCESSFUL_LEASE
         self.metrics.record_decision(False)
         return self._failed_lease(permits)
+
+    def acquire_many(self, permits) -> "BulkAcquireResult":
+        """Vectorized local bulk admission: decide a whole batch of permit
+        requests against this bucket in ONE numpy pass — no per-request
+        Python on the hot loop. Decisions use the same conservative
+        in-batch serialization as the device bulk paths: earlier requests'
+        demand reserves ahead of later ones within the call (cumulative
+        prefix vs the availability at call start), so over-admission is
+        impossible and the result equals a sequential replay whenever all
+        in-call requests fit. Zero-count probes grant while any
+        availability remains at their position. Skipped when waiters are
+        queued under OLDEST_FIRST (bulk callers must not overtake parked
+        requests — the same gate as ``_try_lease``)."""
+        import numpy as np
+
+        from distributedratelimiting.redis_tpu.runtime.queueing import (
+            QueueProcessingOrder,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            BulkAcquireResult,
+        )
+
+        counts = np.asarray(permits, np.int64)
+        if counts.size and (counts.min() < 0
+                            or counts.max() > self.options.token_limit):
+            self._check_permits(int(counts.min()))
+            self._check_permits(int(counts.max()))
+        if self._disposed:
+            raise RuntimeError("limiter is disposed")
+        self._maybe_refresh_inline()
+        n = counts.size
+        avail0 = self.available_tokens
+        if len(self._queue) > 0 and (
+                self.options.queue_processing_order
+                is QueueProcessingOrder.OLDEST_FIRST):
+            # Demand must not overtake parked waiters — but probes consume
+            # nothing, so they mirror acquire(0): granted while tokens
+            # remain (nothing else in the call is granted, so no prefix).
+            granted = (counts == 0) & (avail0 > 0)
+            remaining = np.full(n, max(avail0, 0.0), np.float32)
+            self.metrics.record_bulk(n, int(granted.sum()))
+            return BulkAcquireResult(granted, remaining)
+        cum = np.cumsum(counts)
+        before = cum - counts
+        granted = np.where(counts > 0, cum <= avail0, avail0 - before > 0)
+        total = int(counts[granted & (counts > 0)].sum()) if n else 0
+        if total:
+            self._consume(total)
+        remaining = np.maximum(avail0 - cum, 0.0).astype(np.float32)
+        self.metrics.record_bulk(n, int(granted.sum()))
+        return BulkAcquireResult(granted, remaining)
 
     async def acquire_async(self, permits: int = 1) -> RateLimitLease:
         """≙ ``WaitAsyncCore`` (``:116-183``): fast path, then park."""

@@ -27,6 +27,7 @@ from distributedratelimiting.redis_tpu.models.base import (
     FAILED_LEASE,
     RateLimitLease,
     RateLimiter,
+    check_permits,
 )
 from distributedratelimiting.redis_tpu.models.options import (
     ConcurrencyLimiterOptions,
@@ -93,13 +94,7 @@ class ConcurrencyLimiter(RateLimiter):
         self._drain_tasks: set[asyncio.Task] = set()  # strong refs
 
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.permit_limit:
-            raise ValueError(
-                f"permits ({permits}) cannot exceed permit_limit "
-                f"({self.options.permit_limit})"
-            )
+        check_permits(permits, self.options.permit_limit)
         if self._disposed:
             raise RuntimeError("limiter is disposed")
 

@@ -35,6 +35,7 @@ from distributedratelimiting.redis_tpu.models.base import (
     MetadataName,
     RateLimitLease,
     RateLimiter,
+    check_permits,
 )
 from distributedratelimiting.redis_tpu.models.options import (
     QueueingTokenBucketOptions,
@@ -67,13 +68,7 @@ class QueueingTokenBucketRateLimiter(RateLimiter):
 
     # -- helpers -----------------------------------------------------------
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.token_limit:
-            raise ValueError(
-                f"permits ({permits}) cannot exceed token_limit "
-                f"({self.options.token_limit})"
-            )
+        check_permits(permits, self.options.token_limit)
         if self._disposed:
             raise RuntimeError("limiter is disposed")
 

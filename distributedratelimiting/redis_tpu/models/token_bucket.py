@@ -27,6 +27,7 @@ from distributedratelimiting.redis_tpu.models.base import (
     MetadataName,
     RateLimitLease,
     RateLimiter,
+    check_permits,
 )
 from distributedratelimiting.redis_tpu.models.options import TokenBucketOptions
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
@@ -47,14 +48,8 @@ class TokenBucketRateLimiter(RateLimiter):
 
     # -- helpers -----------------------------------------------------------
     def _check_permits(self, permits: int) -> None:
-        if permits < 0:
-            raise ValueError("permits must be >= 0")
-        if permits > self.options.token_limit:
-            # ≙ throw-if-over-limit (:87-90 in the approximate variant).
-            raise ValueError(
-                f"permits ({permits}) cannot exceed token_limit "
-                f"({self.options.token_limit})"
-            )
+        # ≙ throw-if-over-limit (:87-90 in the approximate variant).
+        check_permits(permits, self.options.token_limit)
 
     def _lease(self, granted: bool, remaining: float, permits: int,
                latency_s: float | None = None) -> RateLimitLease:

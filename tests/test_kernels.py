@@ -358,6 +358,37 @@ class TestAcquireScanCompactFused:
         np.testing.assert_allclose(np.asarray(s1.tokens),
                                    np.asarray(s2.tokens), rtol=1e-6)
 
+    def test_window_fused_bits_matches_packed(self):
+        """The window verdict-only bit-packed path (production default for
+        window bulk with_remaining=False) must agree with the packed-result
+        fused variant bit for bit, for both window families."""
+        import numpy as np
+        import jax.numpy as jnp
+        from distributedratelimiting.redis_tpu.ops import kernels as K
+
+        rng = np.random.default_rng(19)
+        n, b, k = 300, 64, 3
+        slots = rng.integers(0, n, (k, b)).astype(np.int32)
+        slots[1, :5] = -1
+        counts = rng.integers(0, 3, (k, b)).astype(np.uint8)
+        nows = np.arange(1, k + 1, dtype=np.int32) * 400
+        fused = jnp.asarray(K.pack_compact5(slots, counts))
+        for interpolate in (True, False):
+            s1 = K.init_window_state(n)
+            s1, out = K.window_acquire_scan_fused_packed(
+                s1, fused, jnp.asarray(nows), jnp.float32(3.0),
+                jnp.int32(1024), interpolate=interpolate)
+            want = np.asarray(out)[:, 0, :].reshape(-1) > 0.5
+            s2 = K.init_window_state(n)
+            s2, bits = K.window_acquire_scan_fused_bits(
+                s2, fused, jnp.asarray(nows), jnp.float32(3.0),
+                jnp.int32(1024), interpolate=interpolate)
+            got = np.unpackbits(np.asarray(bits).reshape(-1),
+                                bitorder="little")[:k * b].astype(bool)
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_allclose(np.asarray(s1.curr_count),
+                                       np.asarray(s2.curr_count), rtol=1e-6)
+
     def test_fused_bits_matches_compact_bits(self):
         import numpy as np
         import jax.numpy as jnp
