@@ -137,6 +137,26 @@ inline uint64_t probe(const Directory* d, uint64_t h, const char* key,
   }
 }
 
+// Resolve-or-allocate one key against one directory (shared by every
+// batch-resolve entry point — the bookkeeping must stay identical across
+// them). Returns the slot, or -1 when the free-list is dry.
+inline int32_t resolve_one(Directory* d, const char* key, uint32_t len) {
+  uint64_t hash = fnv1a(key, len);
+  uint64_t i = probe(d, hash, key, len);
+  if (d->table[i].hash != 0) return d->table[i].slot;
+  if (d->free_slots.empty()) return -1;
+  int32_t slot = d->free_slots.back();
+  d->free_slots.pop_back();
+  uint64_t off = d->arena.size();
+  d->arena.insert(d->arena.end(), key, key + len);
+  d->table[i] = Bucket{hash, off, len, slot};
+  d->slot_to_bucket[slot] = static_cast<int32_t>(i);
+  d->live_bytes += len;
+  ++d->size;
+  if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) rehash(d);
+  return slot;
+}
+
 }  // namespace
 
 extern "C" {
@@ -163,29 +183,8 @@ int64_t dir_resolve_batch(void* h, const char* keys, const int64_t* offsets,
   for (int64_t k = 0; k < n; ++k) {
     const char* key = keys + offsets[k];
     uint32_t len = static_cast<uint32_t>(offsets[k + 1] - offsets[k]);
-    uint64_t hash = fnv1a(key, len);
-    uint64_t i = probe(d, hash, key, len);
-    if (d->table[i].hash != 0) {
-      out_slots[k] = d->table[i].slot;
-      continue;
-    }
-    if (d->free_slots.empty()) {
-      out_slots[k] = -1;
-      ++unresolved;
-      continue;
-    }
-    int32_t slot = d->free_slots.back();
-    d->free_slots.pop_back();
-    uint64_t off = d->arena.size();
-    d->arena.insert(d->arena.end(), key, key + len);
-    d->table[i] = Bucket{hash, off, len, slot};
-    d->slot_to_bucket[slot] = static_cast<int32_t>(i);
-    out_slots[k] = slot;
-    d->live_bytes += len;
-    ++d->size;
-    if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) {
-      rehash(d);
-    }
+    out_slots[k] = resolve_one(d, key, len);
+    if (out_slots[k] < 0) ++unresolved;
   }
   return unresolved;
 }
@@ -335,27 +334,39 @@ int64_t dir_resolve_pylist(void* h, PyObject* keys, int32_t* out_slots) {
       PyErr_Clear();
       return -1;
     }
-    uint64_t hash = fnv1a(key, static_cast<uint32_t>(len));
-    uint64_t i = probe(d, hash, key, static_cast<uint32_t>(len));
-    if (d->table[i].hash != 0) {
-      out_slots[k] = d->table[i].slot;
-      continue;
+    out_slots[k] = resolve_one(d, key, static_cast<uint32_t>(len));
+    if (out_slots[k] < 0) ++unresolved;
+  }
+  return unresolved;
+}
+
+// Fused route+resolve over a Python list[str]: for each key, crc32 picks
+// the shard, then that shard's directory resolves (allocating on miss) —
+// the whole mesh-store key resolution in ONE C pass instead of a route
+// call plus per-shard grouping and resolve calls on the Python side.
+// handles = n_shards Directory*; out_shards/out_locals get the routing
+// and the shard-local slot (-1 when that shard's free-list ran dry —
+// caller sweeps/grows and re-resolves). Returns the unresolved count, or
+// -1 on a non-str element (caller falls back to the split path).
+int64_t dir_resolve_sharded_pylist(PyObject* keys, void** handles,
+                                   int32_t n_shards, int32_t* out_shards,
+                                   int32_t* out_locals) {
+  if (!g_crc_ready) crc_init();
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  int64_t unresolved = 0;
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    PyObject* s = PyList_GET_ITEM(keys, k);
+    Py_ssize_t len;
+    const char* key = PyUnicode_AsUTF8AndSize(s, &len);
+    if (key == nullptr) {
+      PyErr_Clear();
+      return -1;
     }
-    if (d->free_slots.empty()) {
-      out_slots[k] = -1;
-      ++unresolved;
-      continue;
-    }
-    int32_t slot = d->free_slots.back();
-    d->free_slots.pop_back();
-    uint64_t off = d->arena.size();
-    d->arena.insert(d->arena.end(), key, key + len);
-    d->table[i] = Bucket{hash, off, static_cast<uint32_t>(len), slot};
-    d->slot_to_bucket[slot] = static_cast<int32_t>(i);
-    out_slots[k] = slot;
-    d->live_bytes += static_cast<uint64_t>(len);
-    ++d->size;
-    if (static_cast<uint64_t>(d->size) * 10 > d->table.size() * 7) rehash(d);
+    uint32_t shard = crc32_of(key, len) % static_cast<uint32_t>(n_shards);
+    out_shards[k] = static_cast<int32_t>(shard);
+    Directory* d = static_cast<Directory*>(handles[shard]);
+    out_locals[k] = resolve_one(d, key, static_cast<uint32_t>(len));
+    if (out_locals[k] < 0) ++unresolved;
   }
   return unresolved;
 }

@@ -268,6 +268,33 @@ class TestShardedWindowStore:
         assert not b.acquire_many_blocking(["s"], [1]).granted[0]
 
 
+def test_fused_and_split_resolve_agree(mesh, clock):
+    """The fused one-C-call route+resolve and the split
+    route/group/resolve fallback must agree on ROUTING and each be
+    self-consistent (stable slots, duplicate keys collapse, re-resolve
+    idempotent) through exhaustion-driven growth. Slot-id assignment
+    order is not a contract — the paths allocate in different orders."""
+    a = ShardedDeviceStore(mesh, 10.0, 1.0, per_shard_slots=4, clock=clock)
+    b = ShardedDeviceStore(mesh, 10.0, 1.0, per_shard_slots=4, clock=clock)
+    b._resolve_batch_fused = lambda keys: None  # force the split path
+    keys = [f"rk{i}" for i in range(96)] + ["rk0", "rk5"]  # + dups
+    sa, la = a._resolve_batch(list(keys))
+    sb, lb = b._resolve_batch(list(keys))
+    np.testing.assert_array_equal(sa, sb)  # identical crc32 routing
+    assert a.per_shard == b.per_shard  # same per-shard load ⇒ same growth
+    for sh, lo, store in ((sa, la, a), (sb, lb, b)):
+        # Duplicate keys resolved to their first slot.
+        assert lo[96] == lo[0] and sh[96] == sh[0]
+        assert lo[97] == lo[5] and sh[97] == sh[5]
+        # Directory agrees with the returned assignment.
+        for i in (0, 7, 42, 95):
+            assert store.dirs[sh[i]].lookup(keys[i]) == lo[i]
+        # Re-resolving is idempotent.
+        sh2, lo2 = store._resolve_batch(list(keys))
+        np.testing.assert_array_equal(sh, sh2)
+        np.testing.assert_array_equal(lo, lo2)
+
+
 def test_route_keys_matches_scalar(mesh):
     from distributedratelimiting.redis_tpu.parallel.sharded_store import (
         route_keys,
