@@ -504,10 +504,15 @@ class _PackedLaunchMixin:
         if (self._pregrow_target < target
                 and self.dir.free_count * 4 < self.n_slots):
             self._pregrow_target = target
-            threading.Thread(
+            t = threading.Thread(
                 target=self._pregrow_worker, args=(target,),
                 name="table-pregrow", daemon=True,
-            ).start()
+            )
+            # Tracked so aclose() can join: a daemon thread mid-XLA-compile
+            # at interpreter teardown aborts the process ("FATAL: exception
+            # not rethrown" out of the runtime's thread machinery).
+            self.store._bg_threads.add(t)
+            t.start()
 
     def _pregrow_worker(self, n_slots: int) -> None:
         try:
@@ -1143,6 +1148,10 @@ class DeviceBucketStore(BucketStore):
         self._connected = False
         self._connect_gate = asyncio.Lock()
         self._sweeper_task: asyncio.Task | None = None
+        # Live background pregrow-warm threads (see _maybe_pregrow);
+        # joined on aclose so process exit never tears XLA down under a
+        # mid-compile thread.
+        self._bg_threads: set[threading.Thread] = set()
 
     # -- connection lifecycle (lazy, idempotent) ---------------------------
     async def connect(self) -> None:
@@ -1490,6 +1499,17 @@ class DeviceBucketStore(BucketStore):
             await t.batcher.aclose()
         for t in self._wtables.values():
             await t.batcher.aclose()
+        # Join until no live warm threads remain: a bulk acquire running
+        # concurrently with this aclose can spawn a NEW pregrow thread
+        # after any one-shot snapshot — discard only what was joined.
+        while True:
+            live = [t for t in self._bg_threads if t.is_alive()]
+            if not live:
+                break
+            for t in live:
+                await asyncio.to_thread(t.join, 120.0)
+            self._bg_threads.difference_update(live)
+        self._bg_threads.clear()  # drop finished-thread references
 
     def snapshot(self) -> dict:
         """Pull all live state to host (planned-restart checkpoint).

@@ -33,6 +33,16 @@ def test_single_process_smoke():
     assert report["syncs"] > 0
 
 
+def test_bulk_demo():
+    proc = _run(["bulk", "--n", "5000", "--keys", "2000"], timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    # Fresh buckets/windows with limit 100 and ≤ ~3 hits per key: all grant.
+    assert report["bucket_granted"] == 5000
+    assert report["window_granted"] == 5000
+    assert report["bucket_decisions_per_sec"] > 0
+
+
 def test_multi_process_convergence():
     proc = _run(["convergence", "--instances", "2", "--seconds", "5"],
                 timeout=120)
