@@ -411,7 +411,10 @@ async def bench_serving_p99(store_mod):
     backing = store_mod.DeviceBucketStore(
         n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6, max_inflight=16)
     async with BucketStoreServer(backing) as srv:
-        store = RemoteBucketStore(address=(srv.host, srv.port))
+        # Per-request framing so every request is its own latency sample
+        # (client coalescing would make samples = flushes).
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
         try:
             async def worker(w, reqs):
                 for j in range(reqs):

@@ -152,8 +152,14 @@ class BucketStoreServer:
                         "authentication required: send HELLO first"))
                     break
                 if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
+                    # Only continuation chunks chain (duplicate keys
+                    # spanning a chunk boundary keep request order);
+                    # independent bulk frames — including every
+                    # client-coalesced flush — pipeline freely.
+                    after = (bulk_tail if wire.bulk_request_chained(body)
+                             else None)
                     task = asyncio.ensure_future(self._serve_request(
-                        body, writer, write_lock, after=bulk_tail))
+                        body, writer, write_lock, after=after))
                     bulk_tail = task
                 else:
                     task = asyncio.ensure_future(

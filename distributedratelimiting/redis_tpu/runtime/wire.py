@@ -63,10 +63,12 @@ Response payloads:
 
 Version history: v1 had no version byte and a u16 OK_TEXT length; v2
 added the version byte, HELLO, the u32 OK_TEXT length, and
-ACQUIRE_MANY/OK_BULK; v3 (current) gave ACQUIRE_MANY's flags byte the
-table-kind bits — a semantic change to an existing frame, so the version
-bumps (a v2 server would silently serve window frames as token buckets;
-the strict version check exists precisely to fail loudly instead).
+ACQUIRE_MANY/OK_BULK; v3 gave ACQUIRE_MANY's flags byte the table-kind
+bits; v4 (current) added the chained-chunk bit (chunk ordering became
+opt-in per frame — a v3 client relying on the old serialize-all-bulk
+behavior must not slip through). Semantic changes to an existing frame
+always bump the version: a silent misread loses decisions, the strict
+version check fails loudly instead.
 """
 
 from __future__ import annotations
@@ -90,7 +92,7 @@ __all__ = [
     "read_frame", "write_frame",
 ]
 
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 
 OP_ACQUIRE = 1
 OP_PEEK = 2
@@ -298,6 +300,11 @@ BULK_KIND_WINDOW = 1
 BULK_KIND_FWINDOW = 2
 _KIND_SHIFT = 1
 _KIND_MASK = 0b110
+#: Flags bit 3: this frame is a continuation chunk of the immediately
+#: preceding bulk frame on the connection — the server must decide it
+#: AFTER that frame (duplicate keys spanning a chunk boundary keep request
+#: order). Independent bulk frames (bit clear) run fully concurrent.
+_FLAG_CHAINED = 0b1000
 
 
 def bulk_chunk_spans(key_blob_lens: "np.ndarray",
@@ -330,7 +337,8 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         counts: "np.ndarray", capacity: float,
                         fill_rate: float, *,
                         with_remaining: bool = True,
-                        kind: int = BULK_KIND_BUCKET) -> bytes:
+                        kind: int = BULK_KIND_BUCKET,
+                        chained: bool = False) -> bytes:
     """Encode one ACQUIRE_MANY frame. ``key_blobs`` are pre-encoded utf-8
     keys (callers encode once, then slice chunks out of the same list);
     ``counts`` any integer array-like, sent as u32. ``kind`` selects the
@@ -345,7 +353,8 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
         # decode as some OTHER kind — fail at encode time instead.
         raise ValueError(f"unknown bulk kind {kind}")
     flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
-             | (kind << _KIND_SHIFT))
+             | (kind << _KIND_SHIFT)
+             | (_FLAG_CHAINED if chained else 0))
     payload = b"".join((
         _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
         klens.astype("<u2").tobytes(),
@@ -392,6 +401,13 @@ def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
         raise RemoteStoreError(f"unknown bulk kind {kind}")
     return (seq, keys, counts, capacity, fill_rate,
             bool(flags & _FLAG_WITH_REMAINING), kind)
+
+
+def bulk_request_chained(body: bytes) -> bool:
+    """Peek a bulk frame body's chained bit (the server's dispatch gate —
+    cheaper than a full decode). A truncated frame reads unchained; the
+    full decode raises the routable error for it."""
+    return len(body) > _BODY_OFF and bool(body[_BODY_OFF] & _FLAG_CHAINED)
 
 
 def encode_bulk_response(seq: int, granted: "np.ndarray",

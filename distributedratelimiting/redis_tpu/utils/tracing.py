@@ -104,15 +104,19 @@ class Profiler:
     def enabled(self) -> bool:
         return self.session_factory is not None
 
-    def span(self, command: str, rows: int = 1, *, annotate: bool = True):
+    def span(self, command: str, rows: int = 1, *, annotate: bool = True,
+             enabled: bool = True):
         """Context manager timing one dispatch. No-op (shared, allocation
         free) unless a session factory is registered.
 
         ``annotate=False`` skips the ``jax.profiler.TraceAnnotation``: trace
         annotations must nest strictly per thread, so spans that wrap
         ``await``s which interleave on one event loop (the remote client's
-        wire round-trips) record timings only."""
-        if self.session_factory is None:
+        wire round-trips) record timings only. ``enabled=False`` forces the
+        no-op — for inner dispatches whose rows an outer span already
+        counted (the coalesced-acquire flush would double-count its
+        requests otherwise)."""
+        if not enabled or self.session_factory is None:
             return _NULL_SPAN
         return self._timed_span(command, rows, annotate)
 

@@ -523,7 +523,9 @@ class TestBulkClientServer:
     def test_bulk_server_error_relayed(self):
         class ExplodingStore(InProcessBucketStore):
             async def acquire_many(self, keys, *a, **kw):
-                raise RuntimeError("bulk kernel exploded")
+                if "bad" in keys:
+                    raise RuntimeError("bulk kernel exploded")
+                return await super().acquire_many(keys, *a, **kw)
 
         async def main():
             async with BucketStoreServer(ExplodingStore()) as srv:
@@ -531,9 +533,11 @@ class TestBulkClientServer:
                 try:
                     with pytest.raises(wire.RemoteStoreError,
                                        match="bulk kernel exploded"):
-                        await store.acquire_many(["a"], [1], 5.0, 1.0)
-                    # Connection survives; the single-key path still works.
-                    assert (await store.acquire("a", 1, 5.0, 1.0)).granted
+                        await store.acquire_many(["bad"], [1], 5.0, 1.0)
+                    # Connection survives; later traffic (which also rides
+                    # bulk frames — client coalescing is on by default)
+                    # still works.
+                    assert (await store.acquire("good", 1, 5.0, 1.0)).granted
                 finally:
                     await store.aclose()
 
@@ -578,6 +582,35 @@ class TestBulkClientServer:
                     assert res.granted.all()
                 finally:
                     await good.aclose()
+
+        run(main())
+
+    def test_client_coalescing_shares_frames(self):
+        """Concurrent single acquires on one client must share
+        ACQUIRE_MANY frames: the server sees flushes, not requests —
+        and decisions still match per-request semantics."""
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    results = await asyncio.gather(
+                        *(store.acquire(f"k{i % 8}", 1, 4.0, 1.0)
+                          for i in range(64)))
+                    assert sum(r.granted for r in results) == 8 * 4
+                    assert srv.requests_served < 32  # frames ≪ requests
+                finally:
+                    await store.aclose()
+
+                off = RemoteBucketStore(address=(srv.host, srv.port),
+                                        coalesce_requests=False)
+                try:
+                    before = srv.requests_served
+                    await asyncio.gather(
+                        *(off.acquire(f"o{i}", 1, 4.0, 1.0)
+                          for i in range(16)))
+                    assert srv.requests_served - before == 16  # per-request
+                finally:
+                    await off.aclose()
 
         run(main())
 
