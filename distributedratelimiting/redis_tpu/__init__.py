@@ -73,6 +73,7 @@ from distributedratelimiting.redis_tpu.runtime.clock import (
     TICKS_PER_SECOND,
 )
 from distributedratelimiting.redis_tpu.parallel.mesh_store import MeshBucketStore
+from distributedratelimiting.redis_tpu.runtime.cluster import ClusterBucketStore
 from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
 from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
 from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
@@ -112,6 +113,7 @@ __all__ = [
     "BucketStoreServer",
     "DeviceBucketStore",
     "InProcessBucketStore",
+    "ClusterBucketStore",
     "MeshBucketStore",
     "RemoteBucketStore",
     "ManualClock",

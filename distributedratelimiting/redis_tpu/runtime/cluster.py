@@ -1,0 +1,388 @@
+"""Cluster store — client-side key sharding across N store servers.
+
+The reference's deployment is a star: every client talks to ONE shared
+Redis (SURVEY.md §5.8). One TPU host already replaces that Redis
+(:class:`~.server.BucketStoreServer` fronting a device store, or a whole
+pod slice via :class:`~..parallel.mesh_store.MeshBucketStore`). This module
+adds the horizontal dimension the reference's README gestured at with
+partitioning (``README.md:7-8``) at *cluster* scale: N independent store
+servers — each its own time authority for the keys it owns — with clients
+routing ``key → node`` by the same stable crc32 the in-mesh sharding uses
+(:func:`~..parallel.sharded_store.shard_of_key`). This is the
+Redis-Cluster shape, re-hosted: hash-slot routing lives in the client,
+nodes share nothing, and the DCN between hosts carries only each key's own
+traffic — no cross-node collectives, because keys never interact
+(SURVEY.md §5.7).
+
+Semantics carried over from the single-node client:
+
+- **Per-key semantics are exactly single-node semantics.** A key's
+  requests always land on the same node, and bulk splitting is
+  order-stable per node, so duplicate-key serialization (invariant 3 at
+  batch granularity) and store-as-time-authority (invariant 1) hold
+  per key. There is no cross-key ordering guarantee across nodes — the
+  same property as the reference's partitioned design (one Redis hash per
+  partition, no cross-partition atomicity).
+- **Degraded mode is per node** (invariant 9): a node failure affects only
+  the keys it owns. Single-key ops surface the error to the caller (the
+  approximate limiter's refresh already logs-and-skips; event id 1/2).
+  Bulk ops choose via ``partial_failures``: ``"raise"`` (default —
+  all-or-error, the caller retries) or ``"deny"`` (decide what we can:
+  failed nodes' rows come back denied with ``remaining == 0``, logged
+  once per failing node).
+- The **global decaying counter** of the approximate algorithm is itself
+  just a key (``sync_counter(key=instance_name)``), so it routes to one
+  node — every client instance syncs the same named counter against the
+  same node's clock, preserving the EWMA instance-count estimate
+  unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+    route_keys,
+    shard_of_key,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    BucketStore,
+    BulkAcquireResult,
+    SyncResult,
+)
+from distributedratelimiting.redis_tpu.utils import log
+
+__all__ = ["ClusterBucketStore"]
+
+
+class ClusterBucketStore(BucketStore):
+    """Key-sharded façade over N :class:`BucketStore` nodes.
+
+    Exactly one of ``stores``, ``addresses``, or ``urls`` must be given
+    (highest-precedence one wins — the same config ladder as
+    :class:`RemoteBucketStore`, lifted to lists)::
+
+        store = ClusterBucketStore(addresses=[("tpu-a", 6380), ("tpu-b", 6380)])
+        store = ClusterBucketStore(urls=["tpu-a:6380", "tpu-b:6380"])
+        store = ClusterBucketStore(stores=[node_a, node_b])   # tests / mixed
+
+    ``remote_kwargs`` (auth token, timeouts, coalescing knobs …) pass
+    through to each constructed :class:`RemoteBucketStore` when addresses
+    or urls are given.
+    """
+
+    def __init__(
+        self,
+        *,
+        stores: Sequence[BucketStore] | None = None,
+        addresses: Sequence[tuple[str, int]] | None = None,
+        urls: Sequence[str] | None = None,
+        partial_failures: str = "raise",
+        clock: Clock | None = None,
+        **remote_kwargs,
+    ) -> None:
+        if stores is not None:
+            nodes = list(stores)
+        elif addresses is not None:
+            nodes = [RemoteBucketStore(address=a, **remote_kwargs)
+                     for a in addresses]
+        elif urls is not None:
+            nodes = [RemoteBucketStore(url=u, **remote_kwargs) for u in urls]
+        else:
+            raise ValueError("one of stores, addresses, or urls is required")
+        if not nodes:
+            raise ValueError("cluster needs at least one node")
+        if partial_failures not in ("raise", "deny"):
+            raise ValueError("partial_failures must be 'raise' or 'deny'")
+        self.nodes: list[BucketStore] = nodes
+        self.n_nodes = len(nodes)
+        self._partial_failures = partial_failures
+        # Local clock satisfies the BucketStore interface (diagnostics
+        # only); each NODE is the time authority for the keys it owns.
+        self.clock = clock or MonotonicClock()
+
+        # Background loop for the blocking surface (same pattern as
+        # RemoteBucketStore): lets blocking callers fan out to all nodes
+        # concurrently from any thread, loop or no loop.
+        self._io_loop: asyncio.AbstractEventLoop | None = None
+        self._io_thread: threading.Thread | None = None
+        self._thread_gate = threading.Lock()
+        self._closed = False
+
+    # -- routing -----------------------------------------------------------
+    def node_of(self, key: str) -> BucketStore:
+        """The node that owns ``key`` (stable crc32 — every client on every
+        host routes identically, no coordination)."""
+        return self.nodes[shard_of_key(key, self.n_nodes)]
+
+    # -- blocking-surface plumbing ------------------------------------------
+    def _ensure_loop(self) -> asyncio.AbstractEventLoop:
+        loop = self._io_loop
+        if loop is not None:
+            return loop
+        with self._thread_gate:
+            if self._io_loop is None:
+                loop = asyncio.new_event_loop()
+                ready = threading.Event()
+
+                def run() -> None:
+                    asyncio.set_event_loop(loop)
+                    ready.set()
+                    loop.run_forever()
+
+                t = threading.Thread(target=run, name="cluster-store-io",
+                                     daemon=True)
+                t.start()
+                ready.wait()
+                self._io_loop = loop
+                self._io_thread = t
+        return self._io_loop
+
+    def _blocking(self, coro):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._ensure_loop()).result()
+
+    # -- lifecycle ----------------------------------------------------------
+    async def connect(self) -> None:
+        """Eagerly connect every node (each node also lazily connects on
+        first use, the reference's posture — this is for fail-fast setups)."""
+        await asyncio.gather(*(n.connect() for n in self.nodes))
+
+    async def aclose(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # return_exceptions: one node's failed close must not skip the
+        # others or leak the I/O loop thread below.
+        outs = await asyncio.gather(*(n.aclose() for n in self.nodes),
+                                    return_exceptions=True)
+        loop = self._io_loop
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)
+            if self._io_thread is not None:
+                self._io_thread.join(timeout=5.0)
+            loop.close()
+            self._io_loop = None
+        for out in outs:
+            if isinstance(out, BaseException):
+                raise out
+
+    # -- single-key ops: route and forward ----------------------------------
+    async def acquire(self, key: str, count: int, capacity: float,
+                      fill_rate_per_sec: float) -> AcquireResult:
+        return await self.node_of(key).acquire(key, count, capacity,
+                                               fill_rate_per_sec)
+
+    def acquire_blocking(self, key: str, count: int, capacity: float,
+                         fill_rate_per_sec: float) -> AcquireResult:
+        return self.node_of(key).acquire_blocking(key, count, capacity,
+                                                  fill_rate_per_sec)
+
+    def peek_blocking(self, key: str, capacity: float,
+                      fill_rate_per_sec: float) -> float:
+        return self.node_of(key).peek_blocking(key, capacity,
+                                               fill_rate_per_sec)
+
+    def acquire_submitter(self, capacity: float, fill_rate_per_sec: float):
+        # Hoist per-node submitters once; per request only the route runs.
+        subs = [n.acquire_submitter(capacity, fill_rate_per_sec)
+                for n in self.nodes]
+        n_nodes = self.n_nodes
+
+        async def submit(key: str, count: int) -> AcquireResult:
+            return await subs[shard_of_key(key, n_nodes)](key, count)
+
+        return submit
+
+    async def sync_counter(self, key: str, local_count: float,
+                           decay_rate_per_sec: float) -> SyncResult:
+        return await self.node_of(key).sync_counter(key, local_count,
+                                                    decay_rate_per_sec)
+
+    def sync_counter_blocking(self, key: str, local_count: float,
+                              decay_rate_per_sec: float) -> SyncResult:
+        return self.node_of(key).sync_counter_blocking(key, local_count,
+                                                       decay_rate_per_sec)
+
+    async def window_acquire(self, key: str, count: int, limit: float,
+                             window_sec: float) -> AcquireResult:
+        return await self.node_of(key).window_acquire(key, count, limit,
+                                                      window_sec)
+
+    def window_acquire_blocking(self, key: str, count: int, limit: float,
+                                window_sec: float) -> AcquireResult:
+        return self.node_of(key).window_acquire_blocking(key, count, limit,
+                                                         window_sec)
+
+    async def fixed_window_acquire(self, key: str, count: int, limit: float,
+                                   window_sec: float) -> AcquireResult:
+        return await self.node_of(key).fixed_window_acquire(
+            key, count, limit, window_sec)
+
+    def fixed_window_acquire_blocking(self, key: str, count: int,
+                                      limit: float,
+                                      window_sec: float) -> AcquireResult:
+        return self.node_of(key).fixed_window_acquire_blocking(
+            key, count, limit, window_sec)
+
+    async def concurrency_acquire(self, key: str, count: int,
+                                  limit: int) -> AcquireResult:
+        return await self.node_of(key).concurrency_acquire(key, count, limit)
+
+    def concurrency_acquire_blocking(self, key: str, count: int,
+                                     limit: int) -> AcquireResult:
+        return self.node_of(key).concurrency_acquire_blocking(key, count,
+                                                              limit)
+
+    async def concurrency_release(self, key: str, count: int) -> None:
+        await self.node_of(key).concurrency_release(key, count)
+
+    def concurrency_release_blocking(self, key: str, count: int) -> None:
+        self.node_of(key).concurrency_release_blocking(key, count)
+
+    # -- bulk ops: split by route, fan out, merge ---------------------------
+    def _split(self, keys: Sequence[str]):
+        """Group a bulk call by owning node, order-stably.
+
+        Returns ``(order, bounds, keys_list)`` where ``order`` is a stable
+        permutation grouping requests by node and ``bounds[j]:bounds[j+1]``
+        slices node ``j``'s group. Stability keeps each node's sub-batch in
+        arrival order, so per-node duplicate serialization is exactly the
+        single-node bulk semantics.
+        """
+        keys = keys if isinstance(keys, list) else list(keys)
+        routes = route_keys(keys, self.n_nodes)  # one native C pass
+        order = np.argsort(routes, kind="stable")
+        bounds = np.searchsorted(routes[order],
+                                 np.arange(self.n_nodes + 1))
+        return order, bounds, keys
+
+    async def _bulk_fan_out(self, keys, counts, call, with_remaining: bool
+                            ) -> BulkAcquireResult:
+        n = len(keys)
+        if n == 0:
+            return BulkAcquireResult(
+                np.zeros(0, bool),
+                np.zeros(0, np.float32) if with_remaining else None)
+        counts_np = np.asarray(counts, np.int64)
+        if self.n_nodes == 1:
+            return await call(self.nodes[0], keys, counts_np)
+        order, bounds, keys = self._split(keys)
+
+        async def node_call(j: int, lo: int, hi: int):
+            idx = order[lo:hi]
+            sub_keys = [keys[i] for i in idx]
+            try:
+                return await call(self.nodes[j], sub_keys, counts_np[idx])
+            except Exception as exc:
+                if self._partial_failures == "raise":
+                    raise
+                log.could_not_connect_to_store(exc)
+                return None  # rows stay denied
+
+        live = [(j, int(bounds[j]), int(bounds[j + 1]))
+                for j in range(self.n_nodes) if bounds[j] < bounds[j + 1]]
+        outs = await asyncio.gather(*(node_call(*t) for t in live))
+
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32) if with_remaining else None
+        for (j, lo, hi), out in zip(live, outs):
+            if out is None:
+                continue
+            idx = order[lo:hi]
+            granted[idx] = out.granted
+            if remaining is not None and out.remaining is not None:
+                remaining[idx] = out.remaining
+        return BulkAcquireResult(granted, remaining)
+
+    async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
+                           capacity: float, fill_rate_per_sec: float, *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        async def call(node, sub_keys, sub_counts):
+            return await node.acquire_many(
+                sub_keys, sub_counts, capacity, fill_rate_per_sec,
+                with_remaining=with_remaining)
+
+        return await self._bulk_fan_out(keys, counts, call, with_remaining)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], capacity: float,
+                              fill_rate_per_sec: float, *,
+                              with_remaining: bool = True
+                              ) -> BulkAcquireResult:
+        return self._blocking(self.acquire_many(
+            keys, counts, capacity, fill_rate_per_sec,
+            with_remaining=with_remaining))
+
+    async def window_acquire_many(self, keys: Sequence[str],
+                                  counts: Sequence[int], limit: float,
+                                  window_sec: float, *, fixed: bool = False,
+                                  with_remaining: bool = True
+                                  ) -> BulkAcquireResult:
+        async def call(node, sub_keys, sub_counts):
+            return await node.window_acquire_many(
+                sub_keys, sub_counts, limit, window_sec, fixed=fixed,
+                with_remaining=with_remaining)
+
+        return await self._bulk_fan_out(keys, counts, call, with_remaining)
+
+    def window_acquire_many_blocking(self, keys: Sequence[str],
+                                     counts: Sequence[int], limit: float,
+                                     window_sec: float, *,
+                                     fixed: bool = False,
+                                     with_remaining: bool = True
+                                     ) -> BulkAcquireResult:
+        return self._blocking(self.window_acquire_many(
+            keys, counts, limit, window_sec, fixed=fixed,
+            with_remaining=with_remaining))
+
+    # -- ops fan-out ---------------------------------------------------------
+    async def ping(self) -> None:
+        await asyncio.gather(*(n.ping() for n in self.nodes
+                               if hasattr(n, "ping")))
+
+    async def save(self) -> None:
+        """Checkpoint every node that supports it (≙ cluster-wide BGSAVE)."""
+        await asyncio.gather(*(n.save() for n in self.nodes
+                               if hasattr(n, "save")))
+
+    async def stats(self) -> dict:
+        """Per-node stats plus cluster-level sums of the numeric metrics.
+        ``nodes[j]`` is positionally node ``j``'s stats (``{}`` for nodes
+        without a stats surface) — consumers correlate by index."""
+
+        async def one(n: BucketStore) -> dict:
+            return await n.stats() if hasattr(n, "stats") else {}
+
+        per_node = await asyncio.gather(*(one(n) for n in self.nodes))
+        total: dict = {}
+        for s in per_node:
+            for k, v in s.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    total[k] = total.get(k, 0) + v
+        return {"n_nodes": self.n_nodes, "nodes": list(per_node),
+                "total": total}
+
+    # -- checkpoint ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Cluster checkpoint = each node's snapshot, keyed by position.
+        Remote nodes raise by design (state lives with the server — use
+        :meth:`save` for server-side checkpoints); in-process nodes
+        snapshot locally."""
+        return {"cluster": True, "n_nodes": self.n_nodes,
+                "nodes": [n.snapshot() for n in self.nodes]}
+
+    def restore(self, snap: dict) -> None:
+        if not snap.get("cluster") or snap.get("n_nodes") != self.n_nodes:
+            raise ValueError(
+                "snapshot is not a cluster snapshot for this topology "
+                f"(need n_nodes={self.n_nodes})")
+        for node, sub in zip(self.nodes, snap["nodes"]):
+            node.restore(sub)

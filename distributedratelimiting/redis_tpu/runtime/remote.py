@@ -449,7 +449,9 @@ class RemoteBucketStore(BucketStore):
         key = (float(capacity), float(fill_rate_per_sec))
         batcher = self._acquire_batchers.get(key)
         if batcher is None:
-            if len(self._acquire_batchers) >= self._MAX_ACQUIRE_BATCHERS:
+            if (self._closed
+                    or len(self._acquire_batchers)
+                    >= self._MAX_ACQUIRE_BATCHERS):
                 return None
 
             async def flush(reqs):
@@ -602,7 +604,9 @@ class RemoteBucketStore(BucketStore):
             # Drain coalescing batchers AFTER the drop: their flushes hit
             # the closed connection and fail every parked waiter cleanly
             # (reconnects are gated off by _closed).
-            for b in self._acquire_batchers.values():
+            # list(): a coalesced acquire queued just before shutdown can
+            # still insert a batcher while we await acloses.
+            for b in list(self._acquire_batchers.values()):
                 await b.aclose()
 
         await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(

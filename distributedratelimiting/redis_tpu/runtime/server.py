@@ -319,13 +319,17 @@ class BucketStoreServer:
     async def aclose(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Cancel live connection handlers BEFORE wait_closed(): since
+        # Python 3.12 wait_closed() waits for handler tasks too, so a
+        # server with connected clients would deadlock shutdown.
         for t in list(self._conn_tasks):
             t.cancel()
         if self._conn_tasks:
             await asyncio.gather(*list(self._conn_tasks),
                                  return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     async def __aenter__(self) -> "BucketStoreServer":
         await self.start()

@@ -1,0 +1,255 @@
+"""Cluster store tests: client-side key sharding across N store nodes.
+
+The Redis-Cluster shape of the reference's star topology (SURVEY.md §5.8)
+— N shared-nothing store servers, clients routing key→node by stable
+crc32. Per-key semantics must be exactly single-node semantics; failures
+must degrade per node (invariant 9)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.parallel.sharded_store import shard_of_key
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.cluster import ClusterBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_cluster(n_nodes: int, clock=None, **kw):
+    nodes = [InProcessBucketStore(clock=clock) for _ in range(n_nodes)]
+    return ClusterBucketStore(stores=nodes, **kw), nodes
+
+
+class TestConfig:
+    def test_some_config_required(self):
+        with pytest.raises(ValueError, match="stores, addresses, or urls"):
+            ClusterBucketStore()
+
+    def test_empty_cluster_rejected(self):
+        with pytest.raises(ValueError, match="at least one node"):
+            ClusterBucketStore(stores=[])
+
+    def test_bad_partial_failures_rejected(self):
+        with pytest.raises(ValueError, match="partial_failures"):
+            make_cluster(2, partial_failures="ignore")
+
+    def test_urls_build_remote_nodes(self):
+        store = ClusterBucketStore(urls=["h1:1234", "h2:1234"])
+        assert store.n_nodes == 2
+
+
+class TestRouting:
+    def test_same_key_same_node_capacity_enforced(self):
+        # If routing ever moved a key between nodes, each node's fresh
+        # bucket would re-grant; capacity holding proves stickiness.
+        async def main():
+            store, _ = make_cluster(4, clock=ManualClock())
+            got = [(await store.acquire("user:1", 1, 3.0, 1.0)).granted
+                   for _ in range(5)]
+            assert got == [True] * 3 + [False] * 2
+
+        run(main())
+
+    def test_keys_spread_across_nodes(self):
+        async def main():
+            store, nodes = make_cluster(4, clock=ManualClock())
+            for i in range(64):
+                await store.acquire(f"k{i}", 1, 10.0, 1.0)
+            touched = [len(n._buckets) for n in nodes]
+            assert sum(touched) == 64
+            assert all(t > 0 for t in touched)  # crc32 spreads 64 keys
+
+        run(main())
+
+    def test_routing_matches_shard_of_key(self):
+        store, nodes = make_cluster(3)
+        for key in ("a", "b", "user:42", "ключ"):
+            assert store.node_of(key) is nodes[shard_of_key(key, 3)]
+
+    def test_sync_counter_shared_across_clients(self):
+        # The approximate algorithm's global counter is one key → one
+        # node; two "client" calls must see each other's consumption.
+        async def main():
+            store, _ = make_cluster(4, clock=ManualClock())
+            r1 = await store.sync_counter("api", 10.0, 0.0)
+            r2 = await store.sync_counter("api", 5.0, 0.0)
+            assert r2.global_score == pytest.approx(r1.global_score + 5.0)
+
+        run(main())
+
+
+class TestBulk:
+    def test_bulk_matches_per_key_oracle(self):
+        async def main():
+            clock = ManualClock()
+            store, _ = make_cluster(3, clock=clock)
+            oracle = InProcessBucketStore(clock=clock)
+            keys = [f"k{i % 7}" for i in range(40)]  # duplicates included
+            counts = [(i % 3) + 1 for i in range(40)]
+            got = await store.acquire_many(keys, counts, 5.0, 1.0)
+            want = await oracle.acquire_many(keys, counts, 5.0, 1.0)
+            np.testing.assert_array_equal(got.granted, want.granted)
+            np.testing.assert_allclose(got.remaining, want.remaining)
+
+        run(main())
+
+    def test_duplicate_serialization_preserved(self):
+        # Same key twice in one bulk call: stable split keeps arrival
+        # order on the owning node, so the second request sees the first's
+        # consumption (invariant 3 at batch granularity).
+        async def main():
+            store, _ = make_cluster(4, clock=ManualClock())
+            res = await store.acquire_many(["dup", "dup"], [3, 3], 5.0, 1.0)
+            assert list(res.granted) == [True, False]
+
+        run(main())
+
+    def test_window_bulk_and_fixed(self):
+        async def main():
+            clock = ManualClock()
+            store, _ = make_cluster(3, clock=clock)
+            oracle = InProcessBucketStore(clock=clock)
+            keys = [f"w{i % 5}" for i in range(20)]
+            counts = [1] * 20
+            for fixed in (False, True):
+                got = await store.window_acquire_many(
+                    keys, counts, 3.0, 10.0, fixed=fixed)
+                want = await oracle.window_acquire_many(
+                    keys, counts, 3.0, 10.0, fixed=fixed)
+                np.testing.assert_array_equal(got.granted, want.granted)
+
+        run(main())
+
+    def test_empty_bulk(self):
+        async def main():
+            store, _ = make_cluster(2)
+            res = await store.acquire_many([], [], 5.0, 1.0)
+            assert len(res) == 0
+
+        run(main())
+
+    def test_verdict_only_bulk(self):
+        async def main():
+            store, _ = make_cluster(2, clock=ManualClock())
+            res = await store.acquire_many(
+                ["a", "b", "c"], [1, 1, 99], 5.0, 1.0, with_remaining=False)
+            assert list(res.granted) == [True, True, False]
+            assert res.remaining is None
+
+        run(main())
+
+    def test_blocking_bulk_from_sync_context(self):
+        store, _ = make_cluster(3, clock=ManualClock())
+        res = store.acquire_many_blocking(
+            [f"k{i}" for i in range(10)], [1] * 10, 5.0, 1.0)
+        assert res.granted.all()
+        run(store.aclose())
+
+
+class TestOverTcp:
+    def test_cluster_of_two_servers(self):
+        async def main():
+            clock = ManualClock()
+            async with BucketStoreServer(InProcessBucketStore(clock=clock)) as a:
+                async with BucketStoreServer(
+                        InProcessBucketStore(clock=clock)) as b:
+                    store = ClusterBucketStore(
+                        addresses=[(a.host, a.port), (b.host, b.port)])
+                    try:
+                        # Single-key ops route and hold capacity.
+                        got = [(await store.acquire("k", 1, 2.0, 1.0)).granted
+                               for _ in range(3)]
+                        assert got == [True, True, False]
+                        # Bulk spans both servers.
+                        keys = [f"k{i}" for i in range(32)]
+                        res = await store.acquire_many(
+                            keys, [1] * 32, 5.0, 1.0)
+                        assert res.granted.all()
+                        # Stats aggregate across nodes.
+                        stats = await store.stats()
+                        assert stats["n_nodes"] == 2
+                        assert len(stats["nodes"]) == 2
+                        # Coalescing collapses decisions into frames, so
+                        # the frame count is load-dependent; both nodes
+                        # must have served some.
+                        assert all(s["requests_served"] > 0
+                                   for s in stats["nodes"])
+                        await store.ping()
+                    finally:
+                        await store.aclose()
+
+        run(main())
+
+    def test_partial_failure_deny_decides_live_nodes(self):
+        async def main():
+            clock = ManualClock()
+            dead = BucketStoreServer(InProcessBucketStore(clock=clock))
+            await dead.start()
+            async with BucketStoreServer(
+                    InProcessBucketStore(clock=clock)) as live:
+                store = ClusterBucketStore(
+                    addresses=[(dead.host, dead.port),
+                               (live.host, live.port)],
+                    partial_failures="deny", request_timeout_s=2.0)
+                try:
+                    keys = [f"k{i}" for i in range(24)]
+                    routes = [shard_of_key(k, 2) for k in keys]
+                    assert 0 in routes and 1 in routes
+                    await dead.aclose()
+                    res = await store.acquire_many(keys, [1] * 24, 5.0, 1.0)
+                    for i, r in enumerate(routes):
+                        assert res.granted[i] == (r == 1), (i, r)
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_partial_failure_raise_propagates(self):
+        async def main():
+            dead = BucketStoreServer(InProcessBucketStore())
+            await dead.start()
+            async with BucketStoreServer(InProcessBucketStore()) as live:
+                store = ClusterBucketStore(
+                    addresses=[(dead.host, dead.port),
+                               (live.host, live.port)],
+                    request_timeout_s=2.0)
+                try:
+                    await dead.aclose()
+                    keys = [f"k{i}" for i in range(24)]
+                    with pytest.raises(Exception):
+                        await store.acquire_many(keys, [1] * 24, 5.0, 1.0)
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+
+class TestCheckpoint:
+    def test_snapshot_restore_roundtrip(self):
+        async def main():
+            clock = ManualClock()
+            store, _ = make_cluster(3, clock=clock)
+            for i in range(12):
+                await store.acquire(f"k{i}", 2, 5.0, 1.0)
+            snap = store.snapshot()
+
+            fresh, _ = make_cluster(3, clock=clock)
+            fresh.restore(snap)
+            # Restored consumption is visible: 3 left of 5 per key.
+            res = await fresh.acquire_many(
+                [f"k{i}" for i in range(12)], [4] * 12, 5.0, 1.0)
+            assert not res.granted.any()
+
+        run(main())
+
+    def test_restore_topology_mismatch_rejected(self):
+        store, _ = make_cluster(2)
+        other, _ = make_cluster(3)
+        with pytest.raises(ValueError, match="n_nodes"):
+            other.restore(store.snapshot())

@@ -260,6 +260,23 @@ class TestClientServer:
 
         run(main())
 
+    def test_server_close_with_connected_client_does_not_hang(self):
+        # Python 3.12's Server.wait_closed() waits for connection handler
+        # tasks; aclose must cancel them first or shutdown deadlocks
+        # whenever a client is still attached (found driving the cluster
+        # demo: killing one node of a live cluster hung forever).
+        async def main():
+            srv = BucketStoreServer(InProcessBucketStore())
+            await srv.start()
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                await asyncio.wait_for(srv.aclose(), timeout=5.0)
+            finally:
+                await store.aclose()
+
+        run(main())
+
 
 class TestAuthAndVersion:
     def test_auth_required_server_rejects_tokenless_client(self):
