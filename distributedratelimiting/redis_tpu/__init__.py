@@ -74,6 +74,9 @@ from distributedratelimiting.redis_tpu.runtime.clock import (
 )
 from distributedratelimiting.redis_tpu.parallel.mesh_store import MeshBucketStore
 from distributedratelimiting.redis_tpu.runtime.cluster import ClusterBucketStore
+from distributedratelimiting.redis_tpu.runtime.fp_store import (
+    FingerprintBucketStore,
+)
 from distributedratelimiting.redis_tpu.runtime.queueing import QueueProcessingOrder
 from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
 from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
@@ -114,6 +117,7 @@ __all__ = [
     "DeviceBucketStore",
     "InProcessBucketStore",
     "ClusterBucketStore",
+    "FingerprintBucketStore",
     "MeshBucketStore",
     "RemoteBucketStore",
     "ManualClock",

@@ -1,0 +1,262 @@
+"""Device-resident key directory: probe/insert on fingerprints, in-kernel.
+
+The classic store keeps key→slot routing on the host (`runtime/directory.py`
++ ``native/directory.cc``) and ships resolved slot ids to the device. This
+module moves the directory INTO device memory — the "device-side
+hashing/eviction/TTL without host round-trips per key" hard part called out
+in SURVEY.md §7: the host's entire per-batch duty shrinks to one hashing
+pass (``dir_fp64_pylist`` — 64-bit FNV-1a fingerprints), and the kernel
+itself finds-or-claims each key's slot against a fingerprint table in HBM,
+fused with the refill-and-decrement decision.
+
+Design (all shapes static, XLA-friendly — no data-dependent control flow):
+
+- **Table**: ``fp: u32[N, 2]`` — (lo, hi) halves of each slot's key
+  fingerprint; ``(0, 0)`` means EMPTY (the host hasher never emits it).
+  Bucket state stays the ordinary :class:`~.kernels.BucketState`; a freshly
+  claimed slot keeps ``exists=False`` so the decision kernel's init-on-miss
+  (invariant: ``RedisTokenBucketRateLimiter.cs:210-215``) initializes the
+  bucket — insert only writes the fingerprint.
+- **Probe**: each request scans a fixed window of ``L`` cells starting at
+  ``mix(fp) % N`` (one ``[B, L, 2]`` gather). Full-window scans make
+  deletion trivially safe: clearing a cell cannot hide a key placed later
+  in the window, because lookups never early-stop at an empty cell (the
+  tombstone problem of classic linear probing does not arise).
+- **Insert**: unresolved requests claim their window's first empty cell by
+  scattering their fingerprint ROW (``[B, 2]`` into ``[N, 2]`` — one
+  scatter, so a contested cell ends up with exactly one winner's coherent
+  pair) and re-gathering to see who won. Losers retry next round against
+  the updated occupancy; duplicates of the same new key pick the same cell
+  and all "win" (identical fingerprint). ``R`` rounds bound the retries;
+  requests still unresolved after ``R`` (pathological window pressure)
+  come back with slot ``-1`` — the caller denies and reports, and the
+  host can grow/sweep before the next batch.
+- **Sweep**: expired buckets (same TTL rule as :func:`~.kernels
+  .sweep_expired`) get BOTH ``exists`` and their fingerprint cleared — the
+  table self-expires with zero host bookkeeping (no free-lists).
+
+Collision disclosure: two distinct keys share a bucket iff their 64-bit
+fingerprints collide (probability ≈ n²/2⁶⁵ — about 3·10⁻⁶ at 10M keys);
+the classic host directory compares full key bytes and has no such case.
+The trade is explicit: this path removes the host table (RAM, insert cost,
+growth machinery) and its per-batch resolve from the serving path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+from distributedratelimiting.redis_tpu.ops import kernels as K
+
+__all__ = [
+    "init_fp_table",
+    "fp_resolve_core",
+    "fp_acquire_batch",
+    "fp_acquire_scan",
+    "fp_sweep_expired",
+    "FpResolveOut",
+]
+
+#: Golden-ratio multiplier for the lo/hi mix → base probe index. Plain
+#: int, NOT a jnp scalar: a module-level jnp constant initializes the
+#: backend at import time, before any force-CPU bootstrap can run — on
+#: the tunneled-TPU rig that wedges every process that imports the
+#: package while another holds the device (observed; cost hours).
+_MIX = 0x9E3779B1
+
+
+def init_fp_table(n: int) -> jax.Array:
+    """Empty fingerprint table: ``u32[n, 2]`` of zeros."""
+    return jnp.zeros((n, 2), jnp.uint32)
+
+
+class FpResolveOut(NamedTuple):
+    fp: jax.Array        # u32[N, 2] — table after inserts
+    slots: jax.Array     # i32[B] — resolved slot per request, -1 unresolved
+    resolved: jax.Array  # bool[B] — False only under window pressure
+
+
+def _base_index(kpair, n: int):
+    # np.uint32, not a bare int (jit would parse it int32 → overflow) and
+    # not jnp.uint32 at module scope (import-time backend init, above).
+    h = kpair[:, 0] * np.uint32(_MIX) ^ kpair[:, 1]
+    return (h % jnp.uint32(n)).astype(jnp.int32)
+
+
+def fp_resolve_core(fp, kpair, valid, *, probe_window: int,
+                    rounds: int) -> FpResolveOut:
+    """Find-or-claim a slot for each fingerprint (traceable core).
+
+    Args:
+      fp: ``u32[N, 2]`` table.
+      kpair: ``u32[B, 2]`` request fingerprints (never ``(0, 0)``).
+      valid: ``bool[B]`` — padding rows neither match nor insert.
+      probe_window: cells scanned per request (static).
+      rounds: insert retry rounds (static; ≥1).
+    """
+    n = fp.shape[0]
+    b = kpair.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)
+    base = _base_index(kpair, n)
+    # [B, L] candidate cells (wrapping window).
+    widx = (base[:, None]
+            + jnp.arange(probe_window, dtype=jnp.int32)[None, :]) % n
+
+    slots = jnp.full((b,), -1, jnp.int32)
+    resolved = ~valid  # padding rows are "done" (slot stays -1)
+
+    for _ in range(rounds):
+        cells = fp[widx]                        # [B, L, 2]
+        occ = (cells != 0).any(-1)              # [B, L]
+        match = (occ
+                 & (cells[..., 0] == kpair[:, None, 0])
+                 & (cells[..., 1] == kpair[:, None, 1]))
+        hit = match.any(1) & ~resolved
+        hpos = jnp.argmax(match, axis=1).astype(jnp.int32)
+        slots = jnp.where(hit, widx[rows, hpos], slots)
+        resolved = resolved | hit
+
+        free = ~occ
+        has_free = free.any(1)
+        need = ~resolved & has_free
+        tpos = jnp.argmax(free, axis=1).astype(jnp.int32)
+        target = jnp.where(need, widx[rows, tpos], n)  # n ⇒ dropped
+        # One scatter of whole (lo, hi) ROWS: a contested cell gets one
+        # winner's coherent pair (two per-half scatters could interleave
+        # different writers into a fingerprint that belongs to no key).
+        fp = fp.at[target].set(kpair, mode="drop")
+        got = fp[jnp.where(need, target, 0)]
+        won = need & (got == kpair).all(-1)
+        slots = jnp.where(won, target, slots)
+        resolved = resolved | won
+
+    return FpResolveOut(fp, slots, resolved)
+
+
+def _fp_acquire_core(fp, state, kpair, counts, valid, now, capacity,
+                     fill_rate_per_tick, *, probe_window: int, rounds: int,
+                     handle_duplicates: bool):
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    state, granted, remaining = K.acquire_core(
+        state, out.slots, counts, live, now, capacity, fill_rate_per_tick,
+        handle_duplicates=handle_duplicates)
+    return out.fp, state, granted, remaining, out.resolved
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates"))
+def fp_acquire_batch(fp, state: K.BucketState, kpair, counts, valid, now,
+                     capacity, fill_rate_per_tick, *, probe_window: int = 16,
+                     rounds: int = 4, handle_duplicates: bool = True):
+    """Fused directory-resolve + refill-and-decrement: ONE kernel launch
+    decides a batch straight from key fingerprints — the whole Lua-script
+    role (``RedisTokenBucketRateLimiter.cs:176-239``) including the key
+    lookup Redis does in its hash table before the script body runs.
+
+    Returns ``(fp, state, granted, remaining, resolved)``; unresolved rows
+    (window pressure, see module docstring) are denied with
+    ``remaining = 0`` and reported so the host can sweep/grow.
+    """
+    return _fp_acquire_core(fp, state, kpair, counts, valid, now, capacity,
+                            fill_rate_per_tick, probe_window=probe_window,
+                            rounds=rounds,
+                            handle_duplicates=handle_duplicates)
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates"))
+def fp_acquire_scan(fp, state: K.BucketState, kpairs_k, counts_k, valid_k,
+                    nows_k, capacity, fill_rate_per_tick, *,
+                    probe_window: int = 16, rounds: int = 4,
+                    handle_duplicates: bool = True):
+    """K-deep pipelined variant: ``lax.scan`` over ``[K, B, 2]``
+    fingerprints with the (table, state) pair as carry — one dispatch
+    decides ``K×B`` requests (the bulk/serving shape; each batch keeps its
+    own ``now`` operand exactly like :func:`~.kernels.acquire_scan`)."""
+
+    def body(carry, xs):
+        fp, st = carry
+        kp, cnt, val, now = xs
+        fp, st, granted, remaining, res = _fp_acquire_core(
+            fp, st, kp, cnt, val, now, capacity, fill_rate_per_tick,
+            probe_window=probe_window, rounds=rounds,
+            handle_duplicates=handle_duplicates)
+        return (fp, st), (granted, remaining, res)
+
+    (fp, state), (granted, remaining, resolved) = jax.lax.scan(
+        body, (fp, state), (kpairs_k, counts_k, valid_k, nows_k))
+    return fp, state, granted, remaining, resolved
+
+
+@partial(jax.jit, static_argnames=("probe_window",))
+def fp_peek_batch(fp, state: K.BucketState, kpair, valid, now, capacity,
+                  fill_rate_per_tick, *, probe_window: int = 16):
+    """Read-only availability estimate straight from fingerprints
+    (``GetAvailablePermits``): lookup WITHOUT insert — peeking at an
+    unseen key must not claim a slot — and missing keys report a full
+    bucket (init-on-miss semantics read-only)."""
+    n = fp.shape[0]
+    b = kpair.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)
+    base = _base_index(kpair, n)
+    widx = (base[:, None]
+            + jnp.arange(probe_window, dtype=jnp.int32)[None, :]) % n
+    cells = fp[widx]
+    occ = (cells != 0).any(-1)
+    match = (occ
+             & (cells[..., 0] == kpair[:, None, 0])
+             & (cells[..., 1] == kpair[:, None, 1]))
+    hit = match.any(1)
+    slots = jnp.where(hit, widx[rows, jnp.argmax(match, 1)], 0)
+    refilled = bm.refill_or_init(
+        state.tokens[slots], state.last_ts[slots], state.exists[slots] & hit,
+        now, capacity, fill_rate_per_tick)
+    return jnp.where(valid, jnp.floor(refilled), 0.0)
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds"))
+def fp_migrate_chunk(fp, state: K.BucketState, kpair, tokens, last_ts,
+                     exists, valid, *, probe_window: int = 16,
+                     rounds: int = 4):
+    """Growth/rehash step, on-device: claim slots for a chunk of OLD-table
+    entries in the new (larger) table, then scatter their bucket state to
+    the claimed slots. The host's whole role in a grow is reading the old
+    fingerprints back and chunking — placement and state movement never
+    leave the device. Returns ``(fp, state, n_unplaced)`` (``n_unplaced``
+    must read 0 at sane post-grow load factors)."""
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    ss = jnp.where(live, out.slots, fp.shape[0])  # n ⇒ dropped
+    new_state = K.BucketState(
+        state.tokens.at[ss].set(tokens, mode="drop"),
+        state.last_ts.at[ss].set(last_ts, mode="drop"),
+        state.exists.at[ss].set(exists, mode="drop"),
+    )
+    return out.fp, new_state, (valid & ~out.resolved).sum(dtype=jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def fp_sweep_expired(fp, state: K.BucketState, now, capacity,
+                     fill_rate_per_tick):
+    """TTL eviction with zero host bookkeeping: clear ``exists`` AND the
+    fingerprint of every expired slot (same TTL rule as
+    :func:`~.kernels.sweep_expired`, invariant 5). Freed cells become
+    claimable immediately; full-window probing makes the clear safe for
+    every other key (module docstring). Returns ``(fp, state, n_freed)``
+    — a scalar readback, not an N-byte mask."""
+    ttl = bm.time_to_full_ttl(state.tokens, capacity, fill_rate_per_tick)
+    expired = state.exists & (bm.elapsed_ticks(now, state.last_ts) >= ttl)
+    new_exists = state.exists & ~expired
+    fp = jnp.where(expired[:, None], jnp.uint32(0), fp)
+    return (fp, K.BucketState(state.tokens, state.last_ts, new_exists),
+            expired.sum(dtype=jnp.int32))

@@ -1,0 +1,410 @@
+"""Device-directory store: key→slot lives in HBM, not on the host.
+
+:class:`FingerprintBucketStore` is a :class:`~.store.DeviceBucketStore`
+whose token-bucket tier swaps the host-side key directory
+(``runtime/directory.py`` + ``native/directory.cc``) for the
+device-resident fingerprint table of :mod:`~..ops.fp_directory`. Per
+batch, the host's only duty is one 64-bit hashing pass over the keys
+(``dir_fp64_pylist``); the kernel finds-or-claims each key's slot and
+decides it in the SAME launch. What this buys over the host directory:
+
+- no host table at all for buckets — no arena RAM at 10M keys, no
+  GIL-held insert pass, no host free-list bookkeeping on sweeps (TTL
+  eviction clears fingerprints on device, `fp_sweep_expired`);
+- growth is a device-side rehash (``fp_migrate_chunk``): the host reads
+  fingerprints back and chunks, placement + state movement stay on
+  device.
+
+The trade (made explicit, not hidden): requests ship 8-byte fingerprints
+instead of packed slot ids, so per-decision transfer is larger than the
+packed24 host-directory path — on transfer-bound links the classic store
+stays the throughput champion, while this store wins where host CPU and
+memory are the scarce resource (the SURVEY.md §7 "device-side
+hashing/eviction/TTL" regime). Fingerprint collisions (two keys sharing a
+bucket) occur with probability ≈ n²/2⁶⁵ — about 3·10⁻⁶ at 10M keys —
+versus never for the byte-comparing host directory; see
+``ops/fp_directory.py`` for the full disclosure.
+
+Aux tiers (windows, decaying counters, concurrency semaphores) are
+inherited unchanged — they keep the host directory. The bucket tier is
+the hot, 10M-key one; the aux tiers' key cardinality is per-limiter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedratelimiting.redis_tpu.ops import fp_directory as F
+from distributedratelimiting.redis_tpu.ops import kernels as K
+from distributedratelimiting.redis_tpu.runtime.batcher import MicroBatcher
+from distributedratelimiting.redis_tpu.runtime.store import (
+    AcquireResult,
+    BulkAcquireResult,
+    DeviceBucketStore,
+    _AcquireReq,
+    _grant_zero_probes,
+    _pad_size,
+    _rate_per_tick,
+    _shift_ts,
+)
+from distributedratelimiting.redis_tpu.utils.native import load_directory_lib
+
+__all__ = ["FingerprintBucketStore", "fingerprints"]
+
+_FNV_OFFSET = 1469598103934665603
+_FNV_PRIME = 1099511628211
+
+
+def _fp64_py(key: str) -> int:
+    """Pure-Python FNV-1a 64 — must stay bit-identical to the native
+    ``dir_fp64_pylist`` (fingerprints live in device tables and
+    checkpoints; every process must hash keys the same way)."""
+    h = _FNV_OFFSET
+    for byte in key.encode():
+        h = ((h ^ byte) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h or _FNV_OFFSET
+
+
+def fingerprints(keys: Sequence[str]) -> np.ndarray:
+    """Hash a key batch to ``u32[n, 2]`` (lo, hi) fingerprints — one
+    native C pass when the directory library is built, the identical
+    pure-Python FNV elsewhere. Never returns the all-zero EMPTY
+    sentinel."""
+    n = len(keys)
+    out = np.empty((n, 2), np.uint32)
+    lib = load_directory_lib()
+    if lib is not None and getattr(lib, "has_pylist", False) and n:
+        ks = keys if isinstance(keys, list) else list(keys)
+        if lib.dir_fp64_pylist(
+                ks, out.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint32))) == 0:
+            return out
+    for i, k in enumerate(keys):
+        h = _fp64_py(k)
+        out[i, 0] = h & 0xFFFFFFFF
+        out[i, 1] = h >> 32
+    return out
+
+
+class _FpTable:
+    """One homogeneous-config bucket table with a device-resident
+    directory. External interface mirrors ``store._DeviceTable`` (the
+    parent store's methods are reused wholesale); internally every launch
+    carries fingerprints and the probe/insert happens in-kernel."""
+
+    #: Scan depth cap for bulk dispatches (mirrors _PackedLaunchMixin).
+    _BULK_MAX_K = 16
+    #: Grow when (occupied / n_slots) crosses this after window pressure.
+    _GROW_AT = 0.7
+
+    def __init__(self, store: "FingerprintBucketStore", capacity: float,
+                 fill_rate_per_sec: float, n_slots: int) -> None:
+        self.store = store
+        self.capacity = float(capacity)
+        self.fill_rate_per_sec = float(fill_rate_per_sec)
+        self.rate_per_tick = _rate_per_tick(fill_rate_per_sec)
+        self.n_slots = n_slots
+        self.fp = F.init_fp_table(n_slots)
+        self.state = K.init_bucket_state(n_slots)
+        self.cap_dev = jnp.float32(self.capacity)
+        self.rate_dev = jnp.float32(self.rate_per_tick)
+        self.probe_window = store.probe_window
+        self.rounds = store.insert_rounds
+        self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
+            self._flush,
+            max_batch=store.max_batch,
+            max_delay_s=store.max_delay_s,
+            max_inflight=store.max_inflight,
+        )
+
+    # -- launches (donated state: dispatch under the store lock) -----------
+    def _launch_batch(self, kpair: np.ndarray, counts: np.ndarray,
+                      valid: np.ndarray):
+        """One fused resolve+acquire dispatch; returns device handles."""
+        store = self.store
+        with store._lock:
+            now = store.now_ticks_checked()
+            self.fp, self.state, granted, remaining, resolved = (
+                F.fp_acquire_batch(
+                    self.fp, self.state, jnp.asarray(kpair),
+                    jnp.asarray(counts), jnp.asarray(valid), jnp.int32(now),
+                    self.cap_dev, self.rate_dev,
+                    probe_window=self.probe_window, rounds=self.rounds))
+            store.metrics.record_launch(len(valid), int(valid.sum()))
+        return granted, remaining, resolved
+
+    def _postprocess(self, granted_np, remaining_np, resolved_np,
+                     counts_np, m: int):
+        """Shared readback fixups: zero-permit probes always grant
+        (``_grant_probes`` contract) and window-pressure rows are counted
+        + relieved."""
+        granted = granted_np[:m].copy()
+        _grant_zero_probes(granted, counts_np[:m])
+        pressure = int((~resolved_np[:m]).sum())
+        if pressure:
+            self.store.metrics.fp_unresolved += pressure
+            self._relieve_pressure()
+        return granted, remaining_np[:m], resolved_np[:m]
+
+    async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
+        n = len(reqs)
+        b = _pad_size(n)
+        kpair = np.zeros((b, 2), np.uint32)
+        kpair[:n] = fingerprints([r.key for r in reqs])
+        counts = np.zeros((b,), np.int32)
+        counts[:n] = [min(r.count, 2**31 - 1) for r in reqs]
+        valid = np.zeros((b,), bool)
+        valid[:n] = True
+        granted_d, remaining_d, resolved_d = self._launch_batch(
+            kpair, counts, valid)
+        loop = asyncio.get_running_loop()
+        g, r, res = await loop.run_in_executor(
+            None, lambda: (np.asarray(granted_d), np.asarray(remaining_d),
+                           np.asarray(resolved_d)))
+        g, r, _ = self._postprocess(g, r, res, counts, n)
+        return [AcquireResult(bool(g[i]), float(r[i])) for i in range(n)]
+
+    def acquire_blocking(self, key: str, count: int) -> AcquireResult:
+        b = 64
+        kpair = np.zeros((b, 2), np.uint32)
+        kpair[0] = fingerprints([key])[0]
+        counts = np.zeros((b,), np.int32)
+        counts[0] = min(count, 2**31 - 1)
+        valid = np.zeros((b,), bool)
+        valid[0] = True
+        granted_d, remaining_d, resolved_d = self._launch_batch(
+            kpair, counts, valid)
+        g, r, _ = self._postprocess(
+            np.asarray(granted_d), np.asarray(remaining_d),
+            np.asarray(resolved_d), counts, 1)
+        return AcquireResult(bool(g[0]), float(r[0]))
+
+    # -- bulk --------------------------------------------------------------
+    def _bulk_dispatch(self, keys: Sequence[str], counts_np: np.ndarray):
+        """Chunked scan dispatches over the whole key array; returns
+        ``[(handles, take, counts_chunk), ...]`` with no readback."""
+        n = len(keys)
+        fps = fingerprints(list(keys))
+        b = self.store.max_batch
+        outs = []
+        store = self.store
+        pos = 0
+        with store.profiler.span("acquire_many_fp", n), store._lock:
+            now = store.now_ticks_checked()
+            while pos < n:
+                rows = -(-(n - pos) // b)
+                k = 1
+                while k < rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take = min(k * b, n - pos)
+                kpair = np.zeros((k * b, 2), np.uint32)
+                kpair[:take] = fps[pos:pos + take]
+                counts = np.zeros((k * b,), np.int32)
+                counts[:take] = np.minimum(counts_np[pos:pos + take],
+                                           2**31 - 1)
+                valid = np.zeros((k * b,), bool)
+                valid[:take] = True
+                nows = np.full((k,), now, np.int32)
+                self.fp, self.state, granted, remaining, resolved = (
+                    F.fp_acquire_scan(
+                        self.fp, self.state,
+                        jnp.asarray(kpair.reshape(k, b, 2)),
+                        jnp.asarray(counts.reshape(k, b)),
+                        jnp.asarray(valid.reshape(k, b)),
+                        jnp.asarray(nows), self.cap_dev, self.rate_dev,
+                        probe_window=self.probe_window, rounds=self.rounds))
+                outs.append(((granted, remaining, resolved), take))
+                store.metrics.record_launch(k * b, take)
+                pos += take
+        return outs
+
+    def _gather_bulk(self, outs, counts_np: np.ndarray,
+                     with_remaining: bool) -> BulkAcquireResult:
+        n = len(counts_np)
+        granted = np.empty((n,), bool)
+        remaining = np.empty((n,), np.float32) if with_remaining else None
+        pressure = 0
+        pos = 0
+        for (g_d, r_d, res_d), take in outs:
+            g = np.asarray(g_d).reshape(-1)[:take]
+            res = np.asarray(res_d).reshape(-1)[:take]
+            granted[pos:pos + take] = g
+            if remaining is not None:
+                remaining[pos:pos + take] = np.asarray(
+                    r_d).reshape(-1)[:take]
+            pressure += int((~res).sum())
+            pos += take
+        _grant_zero_probes(granted, counts_np)
+        if pressure:
+            self.store.metrics.fp_unresolved += pressure
+            self._relieve_pressure()
+        return BulkAcquireResult(granted, remaining)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], *,
+                              with_remaining: bool = True
+                              ) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._bulk_dispatch(keys, counts_np)
+        return self._gather_bulk(outs, counts_np, with_remaining)
+
+    async def acquire_many(self, keys: Sequence[str],
+                           counts: Sequence[int], *,
+                           with_remaining: bool = True) -> BulkAcquireResult:
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._bulk_dispatch(keys, counts_np)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._gather_bulk(outs, counts_np, with_remaining))
+
+    # -- reads -------------------------------------------------------------
+    def peek_blocking(self, key: str) -> float:
+        b = 64
+        kpair = np.zeros((b, 2), np.uint32)
+        kpair[0] = fingerprints([key])[0]
+        valid = np.zeros((b,), bool)
+        valid[0] = True
+        with self.store._lock:
+            est = F.fp_peek_batch(
+                self.fp, self.state, jnp.asarray(kpair), jnp.asarray(valid),
+                jnp.int32(self.store.now_ticks_checked()), self.cap_dev,
+                self.rate_dev, probe_window=self.probe_window)
+        return float(np.asarray(est)[0])
+
+    # -- maintenance -------------------------------------------------------
+    def _occupancy(self) -> int:
+        # Under the store lock: concurrent launches donate self.fp, and a
+        # readback racing a donation dies with "Array has been deleted".
+        with self.store._lock:
+            return int(np.asarray((np.asarray(self.fp) != 0).any(-1).sum()))
+
+    def _relieve_pressure(self) -> None:
+        """Window pressure response: sweep expired slots; grow when the
+        table is past the growth threshold OR the sweep freed (almost)
+        nothing — with only live keys, one full probe window can fill at
+        modest load factors, and without the freed-nothing clause the key
+        hashing there would be denied forever while paying a full-table
+        sweep per attempt. The denied requests are NOT retried here —
+        deny-and-heal keeps the launch path deterministic; the caller's
+        next attempt lands in the relieved table."""
+        with self.store._lock:
+            before = self.store.metrics.slots_evicted
+            self._sweep()
+            freed = self.store.metrics.slots_evicted - before
+            if (freed < max(1, self.n_slots // 16)
+                    or self._occupancy() >= self._GROW_AT * self.n_slots):
+                self._grow()
+
+    def _sweep(self, pinned=None) -> None:
+        store = self.store
+        with store.profiler.span("sweep_fp", self.n_slots), store._lock:
+            now = store.now_ticks_checked()
+            self.fp, self.state, n_freed = F.fp_sweep_expired(
+                self.fp, self.state, jnp.int32(now), self.cap_dev,
+                self.rate_dev)
+            store.metrics.sweeps += 1
+            store.metrics.slots_evicted += int(np.asarray(n_freed))
+
+    def _grow(self) -> None:
+        """Double the table with a device-side rehash: read old
+        fingerprints back, then per chunk claim slots in the new table and
+        scatter the old bucket state across (``fp_migrate_chunk``) — the
+        host never computes a placement."""
+        store = self.store
+        with store._lock:
+            old_fp = np.asarray(self.fp)
+            occupied = np.nonzero((old_fp != 0).any(-1))[0]
+            old_tokens = np.asarray(self.state.tokens)
+            old_ts = np.asarray(self.state.last_ts)
+            old_exists = np.asarray(self.state.exists)
+            new_n = self.n_slots * 2
+            fp = F.init_fp_table(new_n)
+            state = K.init_bucket_state(new_n)
+            b = self.store.max_batch
+            unplaced = 0
+            for pos in range(0, len(occupied), b):
+                idx = occupied[pos:pos + b]
+                m = len(idx)
+                kpair = np.zeros((b, 2), np.uint32)
+                kpair[:m] = old_fp[idx]
+                tok = np.zeros((b,), np.float32)
+                tok[:m] = old_tokens[idx]
+                ts = np.zeros((b,), np.int32)
+                ts[:m] = old_ts[idx]
+                ex = np.zeros((b,), bool)
+                ex[:m] = old_exists[idx]
+                valid = np.zeros((b,), bool)
+                valid[:m] = True
+                fp, state, n_un = F.fp_migrate_chunk(
+                    fp, state, jnp.asarray(kpair), jnp.asarray(tok),
+                    jnp.asarray(ts), jnp.asarray(ex), jnp.asarray(valid),
+                    probe_window=self.probe_window, rounds=self.rounds)
+                unplaced += int(np.asarray(n_un))
+            if unplaced:
+                # Halved load factor makes this effectively unreachable;
+                # refuse to lose state silently if it ever isn't.
+                raise RuntimeError(
+                    f"fingerprint rehash left {unplaced} entries unplaced")
+            self.fp, self.state, self.n_slots = fp, state, new_n
+            store.metrics.pregrows += 1
+
+    def rebase(self, offset: int) -> None:
+        self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
+
+    # -- checkpoint form ---------------------------------------------------
+    def to_snap(self) -> dict:
+        return {
+            "fp": np.asarray(self.fp),
+            "probe_window": self.probe_window,
+            "tokens": np.asarray(self.state.tokens),
+            "last_ts": np.asarray(self.state.last_ts),
+            "exists": np.asarray(self.state.exists),
+        }
+
+    def load_snap(self, data: dict, shift: int) -> None:
+        if "fp" not in data:
+            raise ValueError(
+                "checkpoint's bucket tables use the host key directory — "
+                "restore into a DeviceBucketStore")
+        # Adopt the snapshot's probe window along with its size: a key
+        # placed at offset 12 of a 16-cell window is invisible to an
+        # 8-cell scan — restoring into a narrower window would silently
+        # orphan such entries (and later duplicate their fingerprints).
+        self.probe_window = int(data.get("probe_window", self.probe_window))
+        self.n_slots = len(data["tokens"])
+        self.fp = jnp.asarray(data["fp"])
+        self.state = K.BucketState(
+            tokens=jnp.asarray(data["tokens"]),
+            last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
+            exists=jnp.asarray(data["exists"]),
+        )
+
+
+class FingerprintBucketStore(DeviceBucketStore):
+    """``DeviceBucketStore`` with the bucket tier's key directory moved
+    into device memory (module docstring). Drop-in: same ``BucketStore``
+    surface, same limiter compatibility, checkpoints interchange only
+    with other fingerprint stores (the snapshot carries fingerprints, not
+    key strings — keys are not recoverable from a fingerprint table)."""
+
+    def __init__(self, *, probe_window: int = 16, insert_rounds: int = 4,
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.probe_window = probe_window
+        self.insert_rounds = insert_rounds
+
+    def _table(self, capacity: float, fill_rate_per_sec: float) -> _FpTable:
+        key = (float(capacity), float(fill_rate_per_sec))
+        with self._lock:
+            table = self._tables.get(key)
+            if table is None:
+                table = _FpTable(self, capacity, fill_rate_per_sec,
+                                 self.n_slots_default)
+                self._tables[key] = table
+            return table

@@ -321,6 +321,15 @@ def _rate_per_tick(rate_per_sec: float) -> float:
     return rate_per_sec / bm.TICKS_PER_SECOND
 
 
+def _grant_zero_probes(granted: np.ndarray, counts_np: np.ndarray) -> None:
+    """The zero-permit-probe contract in one place (shared by the
+    host-directory mixin and the fingerprint store): probes always grant
+    — the kernel's conservative in-batch prefix could deny one riding
+    beside denied same-key demand."""
+    if (counts_np == 0).any():
+        granted[counts_np == 0] = True
+
+
 def _pad_size(n: int, floor: int = 64) -> int:
     """Pad batches to a power of two ≥ ``floor`` so the jit cache stays
     small (one compilation per size bucket, not per batch length)."""
@@ -612,8 +621,7 @@ class _PackedLaunchMixin:
         the bulk path's conservative in-batch prefix could deny a probe
         riding beside denied same-key demand — override here so direct
         store callers see one contract (not just limiters that patch up)."""
-        if (counts_np == 0).any():
-            res.granted[counts_np == 0] = True
+        _grant_zero_probes(res.granted, counts_np)
         return res
 
     @staticmethod
@@ -960,6 +968,34 @@ class _DeviceTable(_PackedLaunchMixin):
 
     def rebase(self, offset: int) -> None:
         self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
+
+    # -- checkpoint form (swapped wholesale by _FpTable) -------------------
+    def to_snap(self) -> dict:
+        return {
+            "directory": self.dir.to_dict(),
+            "tokens": np.asarray(self.state.tokens),
+            "last_ts": np.asarray(self.state.last_ts),
+            "exists": np.asarray(self.state.exists),
+        }
+
+    def load_snap(self, data: dict, shift: int) -> None:
+        if "directory" not in data:
+            raise ValueError(
+                "checkpoint's bucket tables use the device-resident "
+                "fingerprint directory — restore into a "
+                "FingerprintBucketStore")
+        # Adopt the snapshot's size: tables grow independently by
+        # doubling at runtime, so a post-growth checkpoint has no
+        # reason to match a fresh store's default size — a restore
+        # that raised here would crash-loop exactly the planned
+        # restart it exists for.
+        self.n_slots = len(data["tokens"])
+        self.state = K.BucketState(
+            tokens=jnp.asarray(data["tokens"]),
+            last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
+            exists=jnp.asarray(data["exists"]),
+        )
+        self.dir.load(data["directory"], self.n_slots)
 
 
 class _DeviceWindowTable(_PackedLaunchMixin):
@@ -1518,12 +1554,7 @@ class DeviceBucketStore(BucketStore):
         with self._lock:
             tables = {}
             for (cap, rate), t in self._tables.items():
-                tables[(cap, rate)] = {
-                    "directory": t.dir.to_dict(),
-                    "tokens": np.asarray(t.state.tokens),
-                    "last_ts": np.asarray(t.state.last_ts),
-                    "exists": np.asarray(t.state.exists),
-                }
+                tables[(cap, rate)] = t.to_snap()
             wtables = {}
             for (limit, wt, fixed), t in self._wtables.items():
                 wtables[(limit, wt, fixed)] = {
@@ -1561,19 +1592,7 @@ class DeviceBucketStore(BucketStore):
         with self._lock:
             shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
             for (cap, rate), data in snap["tables"].items():
-                table = self._table(cap, rate)
-                # Adopt the snapshot's size: tables grow independently by
-                # doubling at runtime, so a post-growth checkpoint has no
-                # reason to match a fresh store's default size — a restore
-                # that raised here would crash-loop exactly the planned
-                # restart it exists for.
-                table.n_slots = len(data["tokens"])
-                table.state = K.BucketState(
-                    tokens=jnp.asarray(data["tokens"]),
-                    last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
-                    exists=jnp.asarray(data["exists"]),
-                )
-                table.dir.load(data["directory"], table.n_slots)
+                self._table(cap, rate).load_snap(data, shift)
             for wkey, data in snap.get("wtables", {}).items():
                 # Pre-fixed-window snapshots carry 2-tuple keys (sliding).
                 limit, wt = wkey[0], wkey[1]

@@ -131,6 +131,9 @@ class StoreMetrics:
     # Table growths (single-chip: background pre-warm compilations;
     # sharded: in-place per-shard doublings).
     pregrows: int = 0
+    # Device-resident directory: requests denied because no probe-window
+    # slot could be claimed (table pressure — a sweep/grow follows).
+    fp_unresolved: int = 0
 
     def record_launch(self, batch_rows: int, valid_rows: int) -> None:
         self.launches += 1
@@ -152,4 +155,5 @@ class StoreMetrics:
             "pallas_sweep_failures": self.pallas_sweep_failures,
             "rows_coalesced": self.rows_coalesced,
             "pregrows": self.pregrows,
+            "fp_unresolved": self.fp_unresolved,
         }

@@ -91,6 +91,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.py_object, c.POINTER(c.c_void_p), c.c_int32,
             c.POINTER(c.c_int32), c.POINTER(c.c_int32)]
         lib.dir_resolve_sharded_pylist.restype = c.c_int64
+        lib.dir_fp64_pylist.argtypes = [c.py_object,
+                                        c.POINTER(c.c_uint32)]
+        lib.dir_fp64_pylist.restype = c.c_int64
         lib.has_pylist = True
     except AttributeError:  # built without Python.h
         lib.has_pylist = False

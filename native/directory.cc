@@ -371,6 +371,37 @@ int64_t dir_resolve_sharded_pylist(PyObject* keys, void** handles,
   return unresolved;
 }
 
+// 64-bit key fingerprints (FNV-1a) over a Python list[str], for the
+// device-resident fingerprint directory: the DEVICE probes/inserts on
+// these, so the host needs only this single hashing pass per batch — no
+// host-side table at all. out[2k]/out[2k+1] = low/high u32 halves. An
+// all-zero fingerprint is the table's EMPTY sentinel, so the (2^-64)
+// hash that lands there is remapped to the FNV offset basis. Returns 0,
+// or -1 on a non-str element (caller falls back to the Python hasher).
+int64_t dir_fp64_pylist(PyObject* keys, uint32_t* out) {
+  constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
+  constexpr uint64_t kFnvPrime = 1099511628211ULL;
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    PyObject* s = PyList_GET_ITEM(keys, k);
+    Py_ssize_t len;
+    const char* key = PyUnicode_AsUTF8AndSize(s, &len);
+    if (key == nullptr) {
+      PyErr_Clear();
+      return -1;
+    }
+    uint64_t h = kFnvOffset;
+    for (Py_ssize_t i = 0; i < len; ++i) {
+      h ^= static_cast<unsigned char>(key[i]);
+      h *= kFnvPrime;
+    }
+    if (h == 0) h = kFnvOffset;
+    out[2 * k] = static_cast<uint32_t>(h);
+    out[2 * k + 1] = static_cast<uint32_t>(h >> 32);
+  }
+  return 0;
+}
+
 // Zero-copy batch shard routing over a Python list[str] (GIL held, as
 // dir_resolve_pylist). Returns 0, or -1 on a non-str element (caller
 // falls back to the encode path).

@@ -1,0 +1,345 @@
+"""Device-resident fingerprint directory: kernels + store.
+
+The probe/insert/TTL design and its disclosed trade-offs live in
+``ops/fp_directory.py``; the store integration in ``runtime/fp_store.py``.
+Differential anchor: `FingerprintBucketStore` must decide exactly like
+`InProcessBucketStore` under a shared manual clock."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.ops import fp_directory as F
+from distributedratelimiting.redis_tpu.ops import kernels as K
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.fp_store import (
+    FingerprintBucketStore,
+    fingerprints,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    DeviceBucketStore,
+    InProcessBucketStore,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestFingerprints:
+    def test_native_and_python_agree(self):
+        # The pure-Python FNV fallback must match the native pass
+        # bit-for-bit — fingerprints persist in tables and checkpoints.
+        from distributedratelimiting.redis_tpu.runtime import fp_store
+
+        keys = ["a", "user:42", "ключ-🔑", "", "x" * 300]
+        got = fingerprints(keys)
+        for i, k in enumerate(keys):
+            h = fp_store._fp64_py(k)
+            assert got[i, 0] == h & 0xFFFFFFFF
+            assert got[i, 1] == h >> 32
+
+    def test_never_empty_sentinel(self):
+        fps = fingerprints([f"k{i}" for i in range(1000)])
+        assert ((fps != 0).any(axis=1)).all()
+
+
+def _resolve(fp, keys, valid=None, probe_window=8, rounds=4):
+    k = jnp.asarray(fingerprints(keys))
+    v = jnp.ones((len(keys),), bool) if valid is None else jnp.asarray(valid)
+    return F.fp_resolve_core(fp, k, v, probe_window=probe_window,
+                             rounds=rounds)
+
+
+class TestResolveKernel:
+    def test_insert_then_hit_same_slot(self):
+        fp = F.init_fp_table(64)
+        out1 = _resolve(fp, ["alpha", "beta", "gamma"])
+        assert np.asarray(out1.resolved).all()
+        out2 = _resolve(out1.fp, ["gamma", "alpha", "beta"])
+        s1 = np.asarray(out1.slots)
+        s2 = np.asarray(out2.slots)
+        assert s2[0] == s1[2] and s2[1] == s1[0] and s2[2] == s1[1]
+
+    def test_distinct_keys_distinct_slots(self):
+        fp = F.init_fp_table(256)
+        keys = [f"k{i}" for i in range(100)]
+        out = _resolve(fp, keys)
+        slots = np.asarray(out.slots)
+        assert np.asarray(out.resolved).all()
+        assert len(np.unique(slots)) == 100
+
+    def test_in_batch_duplicates_share_slot(self):
+        fp = F.init_fp_table(64)
+        out = _resolve(fp, ["dup", "x", "dup", "dup"])
+        slots = np.asarray(out.slots)
+        assert slots[0] == slots[2] == slots[3] != slots[1]
+
+    def test_padding_rows_do_not_insert(self):
+        fp = F.init_fp_table(64)
+        out = _resolve(fp, ["a", "b"], valid=np.array([True, False]))
+        assert int((np.asarray(out.fp) != 0).any(-1).sum()) == 1
+        assert np.asarray(out.slots)[1] == -1
+
+    def test_window_pressure_reports_unresolved(self):
+        # 4-slot table, window 4: the 5th distinct key cannot be placed.
+        fp = F.init_fp_table(4)
+        out = _resolve(fp, [f"k{i}" for i in range(6)], probe_window=4)
+        res = np.asarray(out.resolved)
+        assert res.sum() == 4
+        assert (np.asarray(out.slots)[~res] == -1).all()
+
+    def test_sweep_frees_cells_for_reuse(self):
+        fp = F.init_fp_table(4)
+        state = K.init_bucket_state(4)
+        out = _resolve(fp, [f"k{i}" for i in range(4)], probe_window=4)
+        # Touch the buckets so exists=True and TTL applies.
+        state, _, _ = K.acquire_core(
+            state, out.slots, jnp.ones((4,), jnp.int32),
+            jnp.ones((4,), bool), jnp.int32(0), jnp.float32(5.0),
+            jnp.float32(1.0 / 1024.0))
+        far = 10_000_000  # way past time-to-full TTL
+        fp2, state2, n_freed = F.fp_sweep_expired(
+            out.fp, state, jnp.int32(far), jnp.float32(5.0),
+            jnp.float32(1.0 / 1024.0))
+        assert int(n_freed) == 4
+        out2 = _resolve(fp2, ["fresh1", "fresh2"], probe_window=4)
+        assert np.asarray(out2.resolved).all()
+
+    def test_peek_does_not_insert(self):
+        fp = F.init_fp_table(64)
+        state = K.init_bucket_state(64)
+        k = jnp.asarray(fingerprints(["ghost"]))
+        est = F.fp_peek_batch(fp, state, k, jnp.ones((1,), bool),
+                              jnp.int32(0), jnp.float32(7.0),
+                              jnp.float32(0.0), probe_window=8)
+        assert float(np.asarray(est)[0]) == 7.0  # full bucket on miss
+        assert int((np.asarray(fp) != 0).any(-1).sum()) == 0
+
+    def test_migrate_preserves_state(self):
+        fp = F.init_fp_table(8)
+        state = K.init_bucket_state(8)
+        keys = [f"k{i}" for i in range(6)]
+        out = _resolve(fp, keys, probe_window=8)
+        tokens = jnp.asarray(np.arange(8, dtype=np.float32))
+        state = K.BucketState(tokens, state.last_ts,
+                              jnp.ones((8,), bool))
+        new_fp = F.init_fp_table(16)
+        new_state = K.init_bucket_state(16)
+        kpair = out.fp[np.asarray(out.slots)]
+        new_fp, new_state, n_un = F.fp_migrate_chunk(
+            new_fp, new_state, kpair, tokens[out.slots],
+            state.last_ts[out.slots], state.exists[out.slots],
+            jnp.ones((6,), bool), probe_window=8)
+        assert int(n_un) == 0
+        re = _resolve(new_fp, keys, probe_window=8)
+        old_tokens = np.asarray(tokens)[np.asarray(out.slots)]
+        new_tokens = np.asarray(new_state.tokens)[np.asarray(re.slots)]
+        np.testing.assert_allclose(new_tokens, old_tokens)
+
+
+class TestFingerprintStore:
+    def test_capacity_enforced_async_path(self):
+        async def main():
+            store = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            got = [(await store.acquire("k", 1, 3.0, 1.0)).granted
+                   for _ in range(5)]
+            assert got == [True] * 3 + [False] * 2
+            await store.aclose()
+
+        run(main())
+
+    def test_refill_over_time(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock)
+            for _ in range(3):
+                assert (await store.acquire("k", 1, 3.0, 1.0)).granted
+            assert not (await store.acquire("k", 1, 3.0, 1.0)).granted
+            clock.advance_seconds(2.0)
+            assert (await store.acquire("k", 2, 3.0, 1.0)).granted
+            await store.aclose()
+
+        run(main())
+
+    def test_bulk_matches_host_directory_store(self):
+        # Same kernel core, different directory: the fingerprint store
+        # must decide bit-identically to the host-directory device store
+        # (including the documented CONSERVATIVE in-batch duplicate rule,
+        # which an exact serial oracle intentionally differs from).
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=1024, clock=clock)
+            oracle = DeviceBucketStore(n_slots=1024, clock=clock)
+            rng = np.random.default_rng(7)
+            keys = [f"k{i}" for i in rng.integers(0, 40, 300)]
+            counts = rng.integers(0, 4, 300).tolist()
+            got = await store.acquire_many(keys, counts, 5.0, 1.0)
+            want = await oracle.acquire_many(keys, counts, 5.0, 1.0)
+            np.testing.assert_array_equal(got.granted, want.granted)
+            np.testing.assert_allclose(got.remaining, want.remaining,
+                                       atol=1e-4)
+            await store.aclose()
+            await oracle.aclose()
+
+        run(main())
+
+    def test_bulk_distinct_keys_match_exact_oracle(self):
+        # With no in-call duplicates the decisions are exact — the serial
+        # InProcess oracle applies directly.
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=1024, clock=clock)
+            oracle = InProcessBucketStore(clock=clock)
+            rng = np.random.default_rng(11)
+            keys = [f"k{i}" for i in range(300)]
+            counts = rng.integers(0, 7, 300).tolist()
+            got = await store.acquire_many(keys, counts, 5.0, 1.0)
+            want = await oracle.acquire_many(keys, counts, 5.0, 1.0)
+            np.testing.assert_array_equal(got.granted, want.granted)
+            np.testing.assert_allclose(got.remaining, want.remaining,
+                                       atol=1e-4)
+            await store.aclose()
+
+        run(main())
+
+    def test_bulk_duplicate_serialization(self):
+        async def main():
+            store = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            res = await store.acquire_many(["hot"] * 8, [1] * 8, 5.0, 0.0)
+            assert list(res.granted) == [True] * 5 + [False] * 3
+            await store.aclose()
+
+        run(main())
+
+    def test_peek_and_blocking(self):
+        store = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+        assert store.peek_blocking("fresh", 9.0, 1.0) == 9.0
+        r = store.acquire_blocking("fresh", 4, 9.0, 1.0)
+        assert r.granted and r.remaining == pytest.approx(5.0)
+        assert store.peek_blocking("fresh", 9.0, 1.0) == 5.0
+        run(store.aclose())
+
+    def test_pressure_grows_table_and_keeps_state(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=64, clock=clock,
+                                           probe_window=8)
+            table = store._table(5.0, 0.0)
+            # Consume 2 of 5 on a marker key, then slam enough distinct
+            # keys to exceed the probe windows → pressure → grow.
+            assert (await store.acquire("marker", 2, 5.0, 0.0)).granted
+            keys = [f"f{i}" for i in range(200)]
+            res = await store.acquire_many(keys, [1] * 200, 5.0, 0.0)
+            assert store.metrics.fp_unresolved > 0
+            assert table.n_slots >= 128  # at least one doubling
+            # Marker's consumption survived the device-side rehash.
+            assert store.peek_blocking("marker", 5.0, 0.0) == 3.0
+            # Deny-and-heal converges: each pressured call sweeps/grows,
+            # so within a few retries every key is placeable and grants.
+            for _ in range(3):
+                res = await store.acquire_many(keys, [1] * 200, 5.0, 0.0)
+                if res.granted.all():
+                    break
+            assert res.granted.all()
+            assert table.n_slots >= 256
+            await store.aclose()
+
+        run(main())
+
+    def test_snapshot_restore_roundtrip(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock)
+            for i in range(10):
+                await store.acquire(f"k{i}", 2, 5.0, 1.0)
+            snap = store.snapshot()
+            fresh = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            fresh.restore(snap)
+            res = await fresh.acquire_many(
+                [f"k{i}" for i in range(10)], [4] * 10, 5.0, 1.0)
+            assert not res.granted.any()  # 3 left of 5 per key
+            await store.aclose()
+            await fresh.aclose()
+
+        run(main())
+
+    def test_restore_adopts_snapshot_probe_window(self):
+        # A key placed deep in a 16-cell window must stay visible after
+        # restoring into a store configured with a narrower window — the
+        # snapshot's geometry wins (else deep entries are orphaned and
+        # their consumption forgotten).
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock,
+                                           probe_window=16)
+            for i in range(40):
+                await store.acquire(f"k{i}", 2, 5.0, 0.0)
+            snap = store.snapshot()
+            narrow = FingerprintBucketStore(n_slots=256, clock=ManualClock(),
+                                            probe_window=4)
+            narrow.restore(snap)
+            assert narrow._table(5.0, 0.0).probe_window == 16
+            res = await narrow.acquire_many(
+                [f"k{i}" for i in range(40)], [4] * 40, 5.0, 0.0)
+            assert not res.granted.any()  # consumption all remembered
+            await store.aclose()
+            await narrow.aclose()
+
+        run(main())
+
+    def test_cross_type_restore_rejected(self):
+        async def main():
+            host_store = DeviceBucketStore(n_slots=256, clock=ManualClock())
+            await host_store.acquire("k", 1, 5.0, 1.0)
+            snap = host_store.snapshot()
+            fp_store = FingerprintBucketStore(n_slots=256,
+                                              clock=ManualClock())
+            with pytest.raises(ValueError, match="host key directory"):
+                fp_store.restore(snap)
+            fp_store2 = FingerprintBucketStore(n_slots=256,
+                                               clock=ManualClock())
+            await fp_store2.acquire("k", 1, 5.0, 1.0)
+            snap2 = fp_store2.snapshot()
+            host_store2 = DeviceBucketStore(n_slots=256, clock=ManualClock())
+            with pytest.raises(ValueError, match="fingerprint"):
+                host_store2.restore(snap2)
+            for s in (host_store, fp_store, fp_store2, host_store2):
+                await s.aclose()
+
+        run(main())
+
+    def test_aux_tiers_inherited(self):
+        async def main():
+            store = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            # Windows, counters, semaphores ride the parent store.
+            assert (await store.window_acquire("w", 1, 3.0, 10.0)).granted
+            r = await store.sync_counter("c", 5.0, 0.0)
+            assert r.global_score == pytest.approx(5.0)
+            assert (await store.concurrency_acquire("s", 1, 2)).granted
+            await store.concurrency_release("s", 1)
+            await store.aclose()
+
+        run(main())
+
+    def test_limiter_integration(self):
+        from distributedratelimiting.redis_tpu.models.options import (
+            TokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.models.token_bucket import (
+            TokenBucketRateLimiter,
+        )
+
+        async def main():
+            store = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            limiter = TokenBucketRateLimiter(
+                TokenBucketOptions(token_limit=3, tokens_per_period=1,
+                                   instance_name="api"), store)
+            got = [(await limiter.acquire_async(1)).is_acquired
+                   for _ in range(5)]
+            assert got == [True] * 3 + [False] * 2
+            await store.aclose()
+
+        run(main())
