@@ -507,6 +507,121 @@ def bench_psum_cadence(smoke: bool = False) -> dict:
     return out
 
 
+def bench_cluster_bulk(smoke: bool = False) -> dict:
+    """Cluster scale-out: bulk decisions through N shared-nothing store
+    servers over localhost TCP, keys crc32-routed client-side
+    (`ClusterBucketStore`) — per-node sub-batches fan out concurrently,
+    so the aggregate rides N servers' pipelines."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.runtime.cluster import (
+        ClusterBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    n_nodes = 2 if smoke else 3
+    n = 1 << (10 if smoke else 16)
+    calls = 2 if smoke else 4
+
+    async def main():
+        backings = [DeviceBucketStore(n_slots=1 << (10 if smoke else 18),
+                                      max_batch=4096)
+                    for _ in range(n_nodes)]
+        servers = []
+        for b in backings:
+            srv = BucketStoreServer(b)
+            await srv.start()
+            servers.append(srv)
+        store = ClusterBucketStore(
+            addresses=[(s.host, s.port) for s in servers])
+        rng = np.random.default_rng(5)
+        pool = [f"user{i}" for i in range(200_000)]
+        batches = [[pool[j] for j in rng.integers(0, len(pool), n)]
+                   for _ in range(calls)]
+        counts = [1] * n
+        # Warm the exact shapes (connect + compile on every node).
+        await asyncio.gather(*(store.acquire_many(
+            b, counts, 1e7, 1e7, with_remaining=False) for b in batches))
+        t0 = time.perf_counter()
+        await asyncio.gather(*(store.acquire_many(
+            b, counts, 1e7, 1e7, with_remaining=False) for b in batches))
+        dt = time.perf_counter() - t0
+        rate = calls * n / dt
+        await store.aclose()
+        for s in servers:
+            await s.aclose()
+        for b in backings:
+            await b.aclose()
+        return rate
+
+    rate = asyncio.run(main())
+    return {
+        "config": "cluster_bulk",
+        "metric": "decisions_per_sec",
+        "value": round(rate),
+        "unit": "decisions/s",
+        "n_nodes": n_nodes,
+        "keys_per_call": n,
+    }
+
+
+def bench_fp_directory(smoke: bool = False) -> dict:
+    """Device-resident directory vs host directory: the same bulk
+    workload through `FingerprintBucketStore` (in-kernel probe/insert on
+    fingerprints; host duty = one hashing pass) and `DeviceBucketStore`
+    (native host directory + packed slot operands). Reports both so the
+    trade (operand bytes vs host work — docs/DESIGN.md §5b) stays
+    measured, not asserted."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.runtime.fp_store import (
+        FingerprintBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    n = 1 << (10 if smoke else 17)
+    n_slots = 1 << (12 if smoke else 21)
+    calls = 2 if smoke else 4
+
+    def run_store(store) -> float:
+        rng = np.random.default_rng(9)
+        pool = [f"user{i}" for i in range(500_000)]
+        batches = [[pool[j] for j in rng.integers(0, len(pool), n)]
+                   for _ in range(calls)]
+        counts = [1] * n
+        for b in batches:  # warm: insert pass + compile at exact shapes
+            store.acquire_many_blocking(b, counts, 1e7, 1e7,
+                                        with_remaining=False)
+        t0 = time.perf_counter()
+        for b in batches:
+            store.acquire_many_blocking(b, counts, 1e7, 1e7,
+                                        with_remaining=False)
+        return calls * n / (time.perf_counter() - t0)
+
+    fp_store = FingerprintBucketStore(n_slots=n_slots)
+    fp_rate = run_store(fp_store)
+    asyncio.run(fp_store.aclose())
+    host_store = DeviceBucketStore(n_slots=n_slots)
+    host_rate = run_store(host_store)
+    asyncio.run(host_store.aclose())
+    return {
+        "config": "fp_directory",
+        "metric": "decisions_per_sec",
+        "value": round(fp_rate),
+        "unit": "decisions/s",
+        "host_directory_decisions_per_sec": round(host_rate),
+        "keys_per_call": n,
+        "n_slots": n_slots,
+    }
+
+
 CONFIGS = {
     "single_bucket_cpu": bench_single_bucket_cpu,
     "partitioned_10k_uniform": bench_partitioned_10k_uniform,
@@ -514,6 +629,8 @@ CONFIGS = {
     "sliding_window_10m_bursty": bench_sliding_window_10m_bursty,
     "two_level_mesh": bench_two_level_mesh,
     "psum_cadence": bench_psum_cadence,
+    "cluster_bulk": bench_cluster_bulk,
+    "fp_directory": bench_fp_directory,
 }
 
 
