@@ -604,6 +604,40 @@ def main():
     }))
 
 
+def _await_backend_window(max_wait_s: float = 600.0) -> None:
+    """Wait for a healthy device-init window before committing this
+    process to backend init. On the tunneled-TPU rig, init hangs
+    *forever* in some windows and succeeds in 0.1s in others (flapping
+    minute to minute, observed r04); a hung init in THIS process is
+    unrecoverable, so each probe runs in a disposable child with a
+    timeout. Proceeds after ``max_wait_s`` regardless — the probe is
+    best-effort protection, not a gate."""
+    import os
+    import subprocess
+    import time as _time
+
+    deadline = _time.monotonic() + max_wait_s
+    while _time.monotonic() < deadline:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=60, capture_output=True, env=os.environ.copy())
+            if r.returncode == 0:
+                return
+            # Deterministic failure (bad install/config), not a hang:
+            # retrying would stall 10 minutes to fail the same way.
+            print("bench: device init fails outright; proceeding to the "
+                  "real error", file=sys.stderr, flush=True)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        print("bench: device init window unhealthy; retrying...",
+              file=sys.stderr, flush=True)
+        _time.sleep(10)
+    print("bench: no healthy init window found; proceeding anyway",
+          file=sys.stderr, flush=True)
+
+
 if __name__ == "__main__":
     if "--serving-p99-child" in sys.argv:
         _serving_p99_child()
@@ -615,4 +649,5 @@ if __name__ == "__main__":
         i = sys.argv.index("--nproc-client")
         _nproc_client(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3])
         sys.exit(0)
+    _await_backend_window()
     sys.exit(main())

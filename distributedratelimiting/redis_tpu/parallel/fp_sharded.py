@@ -1,0 +1,266 @@
+"""Mesh-sharded device-resident directory: fingerprints over ICI.
+
+Combines the two flagship designs: the key-sharded mesh tables of
+:mod:`~.sharded_store` (keys never interact ⇒ zero hot-path collectives;
+the only collective is the two-level global tier's psum — SURVEY.md §5.7/8)
+with the device-resident fingerprint directory of
+:mod:`~..ops.fp_directory` (in-kernel probe/insert; the host's per-batch
+duty is one hashing pass).
+
+Routing falls out for free: the fingerprint IS the route. Shard =
+``fp_lo % n_shards`` — no second hash, no crc32 pass; every host routes
+identically because every host hashes identically. Each shard holds an
+independent fingerprint table + bucket state slice in its own HBM and
+probes shard-locally; TTL sweeps stay elementwise (the single-chip
+``fp_sweep_expired`` applied to sharded arrays preserves the sharding
+with no collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from distributedratelimiting.redis_tpu.ops import bucket_math as bm
+from distributedratelimiting.redis_tpu.ops import fp_directory as F
+from distributedratelimiting.redis_tpu.ops import kernels as K
+from distributedratelimiting.redis_tpu.parallel.mesh import SHARD_AXIS
+from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+    GlobalCounter,
+    global_tier_update,
+    init_global_counter,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.store import (
+    BulkAcquireResult,
+    _grant_zero_probes,
+    _rate_per_tick,
+    _REBASE_MARGIN_TICKS,
+    _REBASE_THRESHOLD_TICKS,
+)
+
+__all__ = ["make_sharded_fp_scan_step", "ShardedFpDeviceStore"]
+
+
+def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
+                              rounds: int = 4,
+                              handle_duplicates: bool = True):
+    """Jitted sharded fused resolve+acquire with the psum global tier.
+
+    Layout: ``fp u32[N, 2]`` and bucket state sharded along keys
+    (``P(SHARD_AXIS)``); batch ``kpairs_k u32[n_shards, K, B, 2]`` /
+    ``counts_k`` / ``valid_k`` sharded on axis 0 with shard-LOCAL
+    fingerprints; ``nows_k i32[K]`` replicated. Each scanned batch runs
+    probe/insert + decision in-shard, then one scalar psum feeds the
+    replicated decaying global counter (the approximate algorithm's
+    shared tier — cadence trade documented in RESULTS.md "Psum cadence").
+
+    Returns ``(fp, state, granted, remaining, resolved, gcounter)``.
+    """
+    fp_spec = P(SHARD_AXIS, None)
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    gspecs = GlobalCounter(P(), P(), P(), P())
+    batch_spec = P(SHARD_AXIS, None, None)
+    kpair_spec = P(SHARD_AXIS, None, None, None)
+
+    def block(fp, state, kpairs, counts, valid, nows, capacity, rate,
+              gcounter, decay_rate):
+        def body(carry, xs):
+            f, st, g = carry
+            kp, ct, va, now = xs
+            f, st, granted, remaining, resolved = F._fp_acquire_core(
+                f, st, kp, ct, va, now, capacity, rate,
+                probe_window=probe_window, rounds=rounds,
+                handle_duplicates=handle_duplicates)
+            consumed = jnp.sum(jnp.asarray(ct, jnp.float32) * granted)
+            total = jax.lax.psum(consumed, SHARD_AXIS)
+            g = global_tier_update(g, total, now, decay_rate)
+            return (f, st, g), (granted, remaining, resolved)
+
+        (fp, state, gcounter), (granted, remaining, resolved) = jax.lax.scan(
+            body, (fp, state, gcounter),
+            (kpairs[0], counts[0], valid[0], nows))
+        return (fp, state, granted[None], remaining[None], resolved[None],
+                gcounter)
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(fp_spec, state_specs, kpair_spec, batch_spec, batch_spec,
+                  P(), P(), P(), gspecs, P()),
+        out_specs=(fp_spec, state_specs, batch_spec, batch_spec, batch_spec,
+                   gspecs),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1, 8))
+
+
+class ShardedFpDeviceStore:
+    """Serving wrapper: bulk decisions against mesh-sharded fingerprint
+    tables. One homogeneous config per instance (like
+    :class:`~.sharded_store.ShardedDeviceStore`); the bulk path hashes
+    once, routes by ``fp_lo % n_shards`` (vectorized numpy — the
+    fingerprint is the route), groups order-stably per shard, and decides
+    the whole call in scanned fused launches.
+
+    Window pressure (a request whose shard-local probe window can't place
+    it) denies the row and counts it in ``fp_unresolved`` — per-shard
+    growth is not implemented on the mesh tier yet; size shards for the
+    expected keyspace (the single-chip :class:`~..runtime.fp_store
+    .FingerprintBucketStore` grows; a mesh deployment presizes, as the
+    sharded host-directory store documents for its pre-growth era).
+    """
+
+    _BULK_MAX_K = 8
+
+    def __init__(self, mesh, *, capacity: float, fill_rate_per_sec: float,
+                 per_shard_slots: int = 1 << 16, batch: int = 512,
+                 probe_window: int = 16, rounds: int = 4,
+                 decay_rate_per_sec: float = 0.0,
+                 clock: Clock | None = None,
+                 rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS
+                 ) -> None:
+        import threading
+
+        self.mesh = mesh
+        # Donated-state launches must serialize (the codebase-wide rule:
+        # a second launch while one is in flight would reuse a deleted
+        # buffer); sweeps and rebases take the same lock.
+        self._lock = threading.RLock()
+        self._rebase_threshold = rebase_threshold_ticks
+        self.n_shards = mesh.devices.size
+        self.capacity = float(capacity)
+        self.rate_per_tick = _rate_per_tick(fill_rate_per_sec)
+        self.decay_per_tick = _rate_per_tick(decay_rate_per_sec)
+        self.per_shard_slots = per_shard_slots
+        self.batch = batch
+        self.probe_window = probe_window
+        self.clock = clock or MonotonicClock()
+        self.fp_unresolved = 0
+
+        shard = NamedSharding(mesh, P(SHARD_AXIS))
+        fp_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
+        n = per_shard_slots * self.n_shards
+        self.fp = jax.device_put(F.init_fp_table(n), fp_shard)
+        st = K.init_bucket_state(n)
+        self.state = K.BucketState(*(jax.device_put(a, shard) for a in st))
+        self.gcounter = jax.device_put(
+            init_global_counter(), NamedSharding(mesh, P()))
+        self._step = make_sharded_fp_scan_step(
+            mesh, probe_window=probe_window, rounds=rounds)
+
+    @property
+    def global_score(self) -> float:
+        return float(np.asarray(self.gcounter.value))
+
+    def now_ticks_checked(self) -> int:
+        """Clock read with int32-overflow protection (the codebase-wide
+        rule: rebase table + clock together before ~24 days of tick time
+        can overflow the i32 ``now`` operand)."""
+        now = self.clock.now_ticks()
+        if now >= self._rebase_threshold:
+            with self._lock:
+                now = self.clock.now_ticks()
+                if now >= self._rebase_threshold:
+                    offset = now - _REBASE_MARGIN_TICKS
+                    self.force_rebase(offset)
+                    self.clock.rebase(offset)  # type: ignore[attr-defined]
+                    now = self.clock.now_ticks()
+        return now
+
+    def force_rebase(self, offset: int) -> None:
+        """Shift bucket + global-counter timestamps without touching the
+        clock (fingerprints carry no time state)."""
+        with self._lock:
+            self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
+            self.gcounter = GlobalCounter(
+                value=self.gcounter.value, period=self.gcounter.period,
+                last_ts=jnp.maximum(
+                    self.gcounter.last_ts - jnp.int32(offset), 0),
+                exists=self.gcounter.exists)
+
+    def acquire_many_blocking(self, keys: Sequence[str],
+                              counts: Sequence[int], *,
+                              with_remaining: bool = True
+                              ) -> BulkAcquireResult:
+        from distributedratelimiting.redis_tpu.runtime.fp_store import (
+            fingerprints,
+        )
+
+        n = len(keys)
+        if n == 0:
+            return BulkAcquireResult(
+                np.zeros(0, bool),
+                np.zeros(0, np.float32) if with_remaining else None)
+        counts_np = np.asarray(counts, np.int64)
+        fps = fingerprints(list(keys))
+        routes = fps[:, 0] % np.uint32(self.n_shards)
+        order = np.argsort(routes, kind="stable")  # per-shard arrival order
+        bounds = np.searchsorted(routes[order], np.arange(self.n_shards + 1))
+        per_shard = np.diff(bounds)
+        rows = int(per_shard.max())
+
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32) if with_remaining else None
+        now = self.now_ticks_checked()
+        b = self.batch
+        pos = 0  # row offset within each shard's group, advanced per launch
+        self._lock.acquire()  # donated-state launches serialize
+        try:
+            while pos < rows:
+                k = 1
+                need_rows = -(-(rows - pos) // b)
+                while k < need_rows and k < self._BULK_MAX_K:
+                    k *= 2
+                take = k * b
+                kpairs = np.zeros((self.n_shards, k * b, 2), np.uint32)
+                cts = np.zeros((self.n_shards, k * b), np.int32)
+                val = np.zeros((self.n_shards, k * b), bool)
+                sel = []  # (shard, local slice, global order slice)
+                for s in range(self.n_shards):
+                    lo = bounds[s] + pos
+                    hi = min(bounds[s + 1], lo + take)
+                    m = max(0, hi - lo)
+                    if m == 0:
+                        continue
+                    idx = order[lo:hi]
+                    kpairs[s, :m] = fps[idx]
+                    cts[s, :m] = np.minimum(counts_np[idx], 2**31 - 1)
+                    val[s, :m] = True
+                    sel.append((s, m, idx))
+                nows = np.full((k,), now, np.int32)
+                (self.fp, self.state, g_d, r_d, res_d,
+                 self.gcounter) = self._step(
+                    self.fp, self.state,
+                    jnp.asarray(kpairs.reshape(self.n_shards, k, b, 2)),
+                    jnp.asarray(cts.reshape(self.n_shards, k, b)),
+                    jnp.asarray(val.reshape(self.n_shards, k, b)),
+                    jnp.asarray(nows), jnp.float32(self.capacity),
+                    jnp.float32(self.rate_per_tick), self.gcounter,
+                    jnp.float32(self.decay_per_tick))
+                g_np = np.asarray(g_d).reshape(self.n_shards, -1)
+                r_np = np.asarray(r_d).reshape(self.n_shards, -1)
+                res_np = np.asarray(res_d).reshape(self.n_shards, -1)
+                for s, m, idx in sel:
+                    granted[idx] = g_np[s, :m]
+                    if remaining is not None:
+                        remaining[idx] = r_np[s, :m]
+                    self.fp_unresolved += int((~res_np[s, :m]).sum())
+                pos += take
+        finally:
+            self._lock.release()
+        _grant_zero_probes(granted, counts_np)
+        return BulkAcquireResult(granted, remaining)
+
+    def sweep(self) -> int:
+        """Elementwise TTL sweep across every shard — the single-chip
+        kernel applied to the sharded arrays (sharding is preserved, no
+        collectives). Returns slots freed."""
+        with self._lock:
+            self.fp, self.state, n_freed = F.fp_sweep_expired(
+                self.fp, self.state, jnp.int32(self.now_ticks_checked()),
+                jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
+            return int(np.asarray(n_freed))

@@ -69,6 +69,20 @@ class GlobalCounter(NamedTuple):
     exists: jax.Array   # bool[]
 
 
+def global_tier_update(g: GlobalCounter, total, now,
+                       decay_rate) -> GlobalCounter:
+    """ONE recurrence of the two-level global tier (SURVEY.md invariant
+    6): decay the replicated counter to ``now``, add the psum'd
+    consumption, refresh the period EWMA. The single definition keeps
+    every step variant (per-batch, per-launch, fingerprint) bit-identical
+    by construction."""
+    decayed, new_period = bm.decay_core(
+        g.value, g.period, g.last_ts, g.exists, now, decay_rate)
+    return GlobalCounter(value=decayed + total, period=new_period,
+                         last_ts=jnp.asarray(now, jnp.int32),
+                         exists=jnp.asarray(True))
+
+
 def init_global_counter() -> GlobalCounter:
     return GlobalCounter(
         value=jnp.float32(0), period=jnp.float32(0),
@@ -168,16 +182,7 @@ def make_two_level_step(mesh, *, handle_duplicates: bool = True):
             jnp.asarray(counts[0], jnp.float32) * granted
         )
         total = jax.lax.psum(consumed, SHARD_AXIS)  # the only collective
-        decayed, new_period = bm.decay_core(
-            gcounter.value, gcounter.period, gcounter.last_ts,
-            gcounter.exists, now, decay_rate,
-        )
-        new_g = GlobalCounter(
-            value=decayed + total,
-            period=new_period,
-            last_ts=jnp.asarray(now, jnp.int32),
-            exists=jnp.asarray(True),
-        )
+        new_g = global_tier_update(gcounter, total, now, decay_rate)
         return new_state, granted[None], remaining[None], new_g
 
     mapped = shard_map(
@@ -219,14 +224,7 @@ def make_two_level_scan_step(mesh, *, handle_duplicates: bool = True):
             )
             consumed = jnp.sum(jnp.asarray(ct, jnp.float32) * granted)
             total = jax.lax.psum(consumed, SHARD_AXIS)
-            decayed, new_period = bm.decay_core(
-                g.value, g.period, g.last_ts, g.exists, now, decay_rate,
-            )
-            g = GlobalCounter(
-                value=decayed + total, period=new_period,
-                last_ts=jnp.asarray(now, jnp.int32),
-                exists=jnp.asarray(True),
-            )
+            g = global_tier_update(g, total, now, decay_rate)
             return (st, g), (granted, remaining)
 
         # Blocks see [1, K, B] slices; scan over K.
@@ -288,16 +286,7 @@ def make_two_level_scan_step_deferred(mesh, *, handle_duplicates: bool = True):
             (slots[0], counts[0], valid[0], nows),
         )
         total = jax.lax.psum(consumed_total, SHARD_AXIS)  # ONE per launch
-        last_now = nows[-1]
-        decayed, new_period = bm.decay_core(
-            gcounter.value, gcounter.period, gcounter.last_ts,
-            gcounter.exists, last_now, decay_rate,
-        )
-        gcounter = GlobalCounter(
-            value=decayed + total, period=new_period,
-            last_ts=jnp.asarray(last_now, jnp.int32),
-            exists=jnp.asarray(True),
-        )
+        gcounter = global_tier_update(gcounter, total, nows[-1], decay_rate)
         return state, granted[None], remaining[None], gcounter
 
     mapped = shard_map(

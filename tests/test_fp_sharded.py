@@ -1,0 +1,116 @@
+"""Mesh-sharded fingerprint directory (virtual 8-device CPU mesh).
+
+The fingerprint-is-the-route design: shard = fp_lo % n_shards, per-shard
+in-kernel probe/insert, psum global tier. Differential anchor: decisions
+must match the single-chip fingerprint store for duplicate-free calls."""
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+    ShardedFpDeviceStore,
+)
+from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(8)
+
+
+def make_store(mesh, **kw):
+    kw.setdefault("capacity", 5.0)
+    kw.setdefault("fill_rate_per_sec", 0.0)
+    kw.setdefault("per_shard_slots", 256)
+    kw.setdefault("batch", 32)
+    kw.setdefault("clock", ManualClock())
+    return ShardedFpDeviceStore(mesh, **kw)
+
+
+class TestShardedFp:
+    def test_fresh_keys_grant_across_shards(self, mesh):
+        store = make_store(mesh)
+        keys = [f"k{i}" for i in range(200)]
+        res = store.acquire_many_blocking(keys, [1] * 200)
+        assert res.granted.all()
+        assert store.fp_unresolved == 0
+        # Keys actually spread: every shard's table holds some entries.
+        fp = np.asarray(store.fp).reshape(8, -1, 2)
+        per_shard = (fp != 0).any(-1).sum(axis=1)
+        assert (per_shard > 0).all()
+
+    def test_capacity_enforced_across_calls(self, mesh):
+        store = make_store(mesh)
+        r1 = store.acquire_many_blocking(["a", "b"], [3, 5])
+        assert list(r1.granted) == [True, True]
+        r2 = store.acquire_many_blocking(["a", "b"], [3, 1])
+        assert list(r2.granted) == [False, False]  # 2 left / 0 left
+
+    def test_in_call_duplicates_serialize(self, mesh):
+        store = make_store(mesh)
+        res = store.acquire_many_blocking(["dup"] * 8, [1] * 8)
+        assert list(res.granted) == [True] * 5 + [False] * 3
+
+    def test_global_counter_sees_all_shards(self, mesh):
+        store = make_store(mesh)
+        keys = [f"g{i}" for i in range(100)]
+        res = store.acquire_many_blocking(keys, [2] * 100)
+        assert res.granted.all()
+        assert store.global_score == pytest.approx(200.0)
+
+    def test_matches_single_chip_fp_store(self, mesh):
+        from distributedratelimiting.redis_tpu.runtime.fp_store import (
+            FingerprintBucketStore,
+        )
+
+        clock = ManualClock()
+        store = make_store(mesh, clock=clock)
+        single = FingerprintBucketStore(n_slots=1 << 12, clock=clock)
+        rng = np.random.default_rng(3)
+        keys = [f"k{i}" for i in range(300)]
+        counts = rng.integers(0, 7, 300).tolist()
+        got = store.acquire_many_blocking(keys, counts)
+        want = single.acquire_many_blocking(keys, counts, 5.0, 0.0)
+        np.testing.assert_array_equal(got.granted, want.granted)
+        np.testing.assert_allclose(got.remaining, want.remaining, atol=1e-4)
+        import asyncio
+
+        asyncio.run(single.aclose())
+
+    def test_refill_over_time(self, mesh):
+        clock = ManualClock()
+        store = make_store(mesh, fill_rate_per_sec=1.0, clock=clock)
+        assert store.acquire_many_blocking(["r"], [5]).granted.all()
+        assert not store.acquire_many_blocking(["r"], [1]).granted.any()
+        clock.advance_seconds(3.0)
+        assert store.acquire_many_blocking(["r"], [3]).granted.all()
+
+    def test_window_pressure_denied_and_counted(self, mesh):
+        store = make_store(mesh, per_shard_slots=8, probe_window=4)
+        keys = [f"p{i}" for i in range(400)]
+        res = store.acquire_many_blocking(keys, [1] * 400)
+        assert store.fp_unresolved > 0
+        assert int(res.granted.sum()) < 400
+
+    def test_sweep_frees_expired(self, mesh):
+        clock = ManualClock()
+        store = make_store(mesh, fill_rate_per_sec=1.0, clock=clock)
+        keys = [f"s{i}" for i in range(50)]
+        store.acquire_many_blocking(keys, [1] * 50)
+        clock.advance_seconds(3600.0)  # way past time-to-full TTL
+        freed = store.sweep()
+        assert freed == 50
+
+    def test_zero_permit_probe_granted(self, mesh):
+        store = make_store(mesh)
+        store.acquire_many_blocking(["z"], [5])
+        res = store.acquire_many_blocking(["z", "z"], [0, 1])
+        assert bool(res.granted[0]) and not bool(res.granted[1])
+
+    def test_verdict_only(self, mesh):
+        store = make_store(mesh)
+        res = store.acquire_many_blocking(["v1", "v2"], [1, 99],
+                                          with_remaining=False)
+        assert list(res.granted) == [True, False]
+        assert res.remaining is None
