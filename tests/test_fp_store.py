@@ -324,6 +324,45 @@ class TestFingerprintStore:
 
         run(main())
 
+    def test_concurrent_mixed_traffic_with_growth(self):
+        # Race posture: async micro-batched acquires + blocking bulk calls
+        # from threads + growth pressure, all against one table. The
+        # donated-buffer discipline (launches under store._lock) must hold:
+        # no "Array has been deleted", no lost state, aggregate
+        # conservation (a cap-K key never grants more than K + refill).
+        import threading
+
+        async def main():
+            store = FingerprintBucketStore(n_slots=128, clock=ManualClock(),
+                                           probe_window=8)
+            errors = []
+
+            def bulk_worker(w):
+                try:
+                    keys = [f"b{w}-{i}" for i in range(150)]
+                    for _ in range(3):
+                        store.acquire_many_blocking(keys, [1] * 150, 5.0, 0.0)
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=bulk_worker, args=(w,))
+                       for w in range(3)]
+            for t in threads:
+                t.start()
+            granted_hot = 0
+            for _ in range(40):
+                r = await store.acquire("hot", 1, 10.0, 0.0)
+                granted_hot += int(r.granted)
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert granted_hot == 10  # cap-10, zero refill: exactly 10
+            # Table grew under the 450-distinct-key pressure and survived.
+            assert store._table(5.0, 0.0).n_slots > 128
+            await store.aclose()
+
+        run(main())
+
     def test_limiter_integration(self):
         from distributedratelimiting.redis_tpu.models.options import (
             TokenBucketOptions,

@@ -43,6 +43,18 @@ def test_bulk_demo():
     assert report["bucket_decisions_per_sec"] > 0
 
 
+def test_cluster_demo():
+    proc = _run(["cluster", "--nodes", "3", "--n", "300"], timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["granted_all_nodes_up"] == 300
+    assert sum(report["key_spread"]) == 300
+    after = report["after_node0_killed"]
+    # Node 0's keys deny, every live node's key still grants.
+    assert after["granted"] == 300 - report["key_spread"][0]
+    assert after["live_node_grants"] == after["granted"]
+
+
 def test_multi_process_convergence():
     proc = _run(["convergence", "--instances", "2", "--seconds", "5"],
                 timeout=120)
