@@ -59,7 +59,13 @@ __all__ = [
     "fp_resolve_core",
     "fp_acquire_batch",
     "fp_acquire_scan",
+    "fp_peek_batch",
+    "fp_migrate_chunk",
     "fp_sweep_expired",
+    "fp_window_acquire_batch",
+    "fp_window_acquire_scan",
+    "fp_migrate_window_chunk",
+    "fp_sweep_windows",
     "FpResolveOut",
 ]
 
@@ -243,6 +249,97 @@ def fp_migrate_chunk(fp, state: K.BucketState, kpair, tokens, last_ts,
         state.exists.at[ss].set(exists, mode="drop"),
     )
     return out.fp, new_state, (valid & ~out.resolved).sum(dtype=jnp.int32)
+
+
+def _fp_window_core(fp, state, kpair, counts, valid, now, limit,
+                    window_ticks, *, probe_window: int, rounds: int,
+                    handle_duplicates: bool, interpolate: bool):
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    state, granted, remaining = K._window_acquire_core(
+        state, out.slots, counts, live, now, limit, window_ticks,
+        handle_duplicates=handle_duplicates, interpolate=interpolate)
+    return out.fp, state, granted, remaining, out.resolved
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates",
+                          "interpolate"))
+def fp_window_acquire_batch(fp, state: K.WindowState, kpair, counts, valid,
+                            now, limit, window_ticks, *,
+                            probe_window: int = 16, rounds: int = 4,
+                            handle_duplicates: bool = True,
+                            interpolate: bool = True):
+    """Fused resolve + sliding/fixed-window decision — the window-family
+    analogue of :func:`fp_acquire_batch` (``interpolate=False`` = fixed
+    window). Same insert/claim discipline; a freshly claimed slot's
+    window state initializes via the core's init-on-miss."""
+    return _fp_window_core(fp, state, kpair, counts, valid, now, limit,
+                           window_ticks, probe_window=probe_window,
+                           rounds=rounds,
+                           handle_duplicates=handle_duplicates,
+                           interpolate=interpolate)
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates",
+                          "interpolate"))
+def fp_window_acquire_scan(fp, state: K.WindowState, kpairs_k, counts_k,
+                           valid_k, nows_k, limit, window_ticks, *,
+                           probe_window: int = 16, rounds: int = 4,
+                           handle_duplicates: bool = True,
+                           interpolate: bool = True):
+    """K-deep scanned window variant (the bulk shape), mirroring
+    :func:`fp_acquire_scan`."""
+
+    def body(carry, xs):
+        fp, st = carry
+        kp, cnt, val, now = xs
+        fp, st, granted, remaining, res = _fp_window_core(
+            fp, st, kp, cnt, val, now, limit, window_ticks,
+            probe_window=probe_window, rounds=rounds,
+            handle_duplicates=handle_duplicates, interpolate=interpolate)
+        return (fp, st), (granted, remaining, res)
+
+    (fp, state), (granted, remaining, resolved) = jax.lax.scan(
+        body, (fp, state), (kpairs_k, counts_k, valid_k, nows_k))
+    return fp, state, granted, remaining, resolved
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds"))
+def fp_migrate_window_chunk(fp, state: K.WindowState, kpair, prev_count,
+                            curr_count, window_idx, exists, valid, *,
+                            probe_window: int = 16, rounds: int = 4):
+    """Window-table growth step (the :func:`fp_migrate_chunk` analogue):
+    claim slots in the new table, scatter the four window-state arrays
+    across."""
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    ss = jnp.where(live, out.slots, fp.shape[0])  # n ⇒ dropped
+    new_state = K.WindowState(
+        state.prev_count.at[ss].set(prev_count, mode="drop"),
+        state.curr_count.at[ss].set(curr_count, mode="drop"),
+        state.window_idx.at[ss].set(window_idx, mode="drop"),
+        state.exists.at[ss].set(exists, mode="drop"),
+    )
+    return out.fp, new_state, (valid & ~out.resolved).sum(dtype=jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def fp_sweep_windows(fp, state: K.WindowState, now, window_ticks):
+    """Window-table TTL eviction with fingerprint clearing: a slot idle
+    two full windows carries no information (:func:`~.kernels
+    .sweep_windows`); its cell becomes claimable immediately."""
+    idx_now = (jnp.asarray(now, jnp.int32)
+               // jnp.asarray(window_ticks, jnp.int32))
+    expired = state.exists & (idx_now - state.window_idx >= 2)
+    fp = jnp.where(expired[:, None], jnp.uint32(0), fp)
+    new_state = K.WindowState(state.prev_count, state.curr_count,
+                              state.window_idx, state.exists & ~expired)
+    return fp, new_state, expired.sum(dtype=jnp.int32)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

@@ -205,11 +205,13 @@ class ShardedFpDeviceStore:
 
         granted = np.zeros(n, bool)
         remaining = np.zeros(n, np.float32) if with_remaining else None
-        now = self.now_ticks_checked()
         b = self.batch
         pos = 0  # row offset within each shard's group, advanced per launch
         self._lock.acquire()  # donated-state launches serialize
         try:
+            # Sampled under the lock: a concurrent epoch rebase must not
+            # pair a pre-rebase `now` with post-rebase state.
+            now = self.now_ticks_checked()
             while pos < rows:
                 k = 1
                 need_rows = -(-(rows - pos) // b)

@@ -25,9 +25,13 @@ bucket) occur with probability ≈ n²/2⁶⁵ — about 3·10⁻⁶ at 10M keys
 versus never for the byte-comparing host directory; see
 ``ops/fp_directory.py`` for the full disclosure.
 
-Aux tiers (windows, decaying counters, concurrency semaphores) are
-inherited unchanged — they keep the host directory. The bucket tier is
-the hot, 10M-key one; the aux tiers' key cardinality is per-limiter.
+The keyed hot tiers — token buckets AND sliding/fixed windows (the two
+10M-key table families, BASELINE configs 3-4) — both run on the
+device-resident directory (:class:`_FpTable` / :class:`_FpWindowTable`).
+The remaining aux tiers (decaying counters, concurrency semaphores) are
+inherited with the host directory: their key cardinality is per-limiter,
+not per-end-user, so a host table of a few dozen entries is the right
+tool there.
 """
 
 from __future__ import annotations
@@ -123,6 +127,30 @@ class _FpTable:
             max_inflight=store.max_inflight,
         )
 
+    # -- kernel bindings (the window subclass swaps these) ------------------
+    def _call_batch(self, kpair, counts, valid, now):
+        """Run one fused resolve+decide batch, updating the table in
+        place; returns ``(granted, remaining, resolved)`` device handles.
+        Caller holds the store lock (donated buffers)."""
+        self.fp, self.state, granted, remaining, resolved = (
+            F.fp_acquire_batch(
+                self.fp, self.state, jnp.asarray(kpair),
+                jnp.asarray(counts), jnp.asarray(valid), jnp.int32(now),
+                self.cap_dev, self.rate_dev,
+                probe_window=self.probe_window, rounds=self.rounds))
+        return granted, remaining, resolved
+
+    def _call_scan(self, kpairs, counts, valid, nows):
+        """Scanned bulk variant of :meth:`_call_batch` (``[K, B]``
+        batches, one dispatch). Caller holds the store lock."""
+        self.fp, self.state, granted, remaining, resolved = (
+            F.fp_acquire_scan(
+                self.fp, self.state, jnp.asarray(kpairs),
+                jnp.asarray(counts), jnp.asarray(valid), jnp.asarray(nows),
+                self.cap_dev, self.rate_dev,
+                probe_window=self.probe_window, rounds=self.rounds))
+        return granted, remaining, resolved
+
     # -- launches (donated state: dispatch under the store lock) -----------
     def _launch_batch(self, kpair: np.ndarray, counts: np.ndarray,
                       valid: np.ndarray):
@@ -130,14 +158,9 @@ class _FpTable:
         store = self.store
         with store._lock:
             now = store.now_ticks_checked()
-            self.fp, self.state, granted, remaining, resolved = (
-                F.fp_acquire_batch(
-                    self.fp, self.state, jnp.asarray(kpair),
-                    jnp.asarray(counts), jnp.asarray(valid), jnp.int32(now),
-                    self.cap_dev, self.rate_dev,
-                    probe_window=self.probe_window, rounds=self.rounds))
+            out = self._call_batch(kpair, counts, valid, now)
             store.metrics.record_launch(len(valid), int(valid.sum()))
-        return granted, remaining, resolved
+        return out
 
     def _postprocess(self, granted_np, remaining_np, resolved_np,
                      counts_np, m: int):
@@ -211,15 +234,9 @@ class _FpTable:
                 valid = np.zeros((k * b,), bool)
                 valid[:take] = True
                 nows = np.full((k,), now, np.int32)
-                self.fp, self.state, granted, remaining, resolved = (
-                    F.fp_acquire_scan(
-                        self.fp, self.state,
-                        jnp.asarray(kpair.reshape(k, b, 2)),
-                        jnp.asarray(counts.reshape(k, b)),
-                        jnp.asarray(valid.reshape(k, b)),
-                        jnp.asarray(nows), self.cap_dev, self.rate_dev,
-                        probe_window=self.probe_window, rounds=self.rounds))
-                outs.append(((granted, remaining, resolved), take))
+                outs.append((self._call_scan(
+                    kpair.reshape(k, b, 2), counts.reshape(k, b),
+                    valid.reshape(k, b), nows), take))
                 store.metrics.record_launch(k * b, take)
                 pos += take
         return outs
@@ -386,6 +403,132 @@ class _FpTable:
         )
 
 
+class _FpWindowTable(_FpTable):
+    """Sliding/fixed-window table with the device-resident directory —
+    the window-family counterpart of :class:`_FpTable` (shares its flush,
+    bulk, pressure, and lock machinery; swaps the kernel bindings, sweep
+    rule, growth migrate, and checkpoint form)."""
+
+    def __init__(self, store: "FingerprintBucketStore", limit: float,
+                 window_ticks: int, n_slots: int, *,
+                 fixed: bool = False) -> None:
+        self.store = store
+        self.limit = float(limit)
+        self.window_ticks = int(window_ticks)
+        self.fixed = fixed
+        self.n_slots = n_slots
+        self.fp = F.init_fp_table(n_slots)
+        self.state = K.init_window_state(n_slots)
+        self.limit_dev = jnp.float32(self.limit)
+        self.window_dev = jnp.int32(self.window_ticks)
+        self.probe_window = store.probe_window
+        self.rounds = store.insert_rounds
+        self.batcher: MicroBatcher[_AcquireReq, AcquireResult] = MicroBatcher(
+            self._flush,
+            max_batch=store.max_batch,
+            max_delay_s=store.max_delay_s,
+            max_inflight=store.max_inflight,
+        )
+
+    def _call_batch(self, kpair, counts, valid, now):
+        self.fp, self.state, granted, remaining, resolved = (
+            F.fp_window_acquire_batch(
+                self.fp, self.state, jnp.asarray(kpair),
+                jnp.asarray(counts), jnp.asarray(valid), jnp.int32(now),
+                self.limit_dev, self.window_dev,
+                probe_window=self.probe_window, rounds=self.rounds,
+                interpolate=not self.fixed))
+        return granted, remaining, resolved
+
+    def _call_scan(self, kpairs, counts, valid, nows):
+        self.fp, self.state, granted, remaining, resolved = (
+            F.fp_window_acquire_scan(
+                self.fp, self.state, jnp.asarray(kpairs),
+                jnp.asarray(counts), jnp.asarray(valid), jnp.asarray(nows),
+                self.limit_dev, self.window_dev,
+                probe_window=self.probe_window, rounds=self.rounds,
+                interpolate=not self.fixed))
+        return granted, remaining, resolved
+
+    def peek_blocking(self, key: str) -> float:
+        raise NotImplementedError(
+            "window tables expose no peek (matching _DeviceWindowTable)")
+
+    def _sweep(self, pinned=None) -> None:
+        store = self.store
+        with store.profiler.span("sweep_fp_windows", self.n_slots), \
+                store._lock:
+            now = store.now_ticks_checked()
+            self.fp, self.state, n_freed = F.fp_sweep_windows(
+                self.fp, self.state, jnp.int32(now), self.window_dev)
+            store.metrics.sweeps += 1
+            store.metrics.slots_evicted += int(np.asarray(n_freed))
+
+    def _grow(self) -> None:
+        store = self.store
+        with store._lock:
+            old_fp = np.asarray(self.fp)
+            occupied = np.nonzero((old_fp != 0).any(-1))[0]
+            olds = [np.asarray(a) for a in self.state]
+            new_n = self.n_slots * 2
+            fp = F.init_fp_table(new_n)
+            state = K.init_window_state(new_n)
+            b = self.store.max_batch
+            unplaced = 0
+            for pos in range(0, len(occupied), b):
+                idx = occupied[pos:pos + b]
+                m = len(idx)
+                kpair = np.zeros((b, 2), np.uint32)
+                kpair[:m] = old_fp[idx]
+                cols = []
+                for arr in olds:
+                    col = np.zeros((b,), arr.dtype)
+                    col[:m] = arr[idx]
+                    cols.append(col)
+                valid = np.zeros((b,), bool)
+                valid[:m] = True
+                fp, state, n_un = F.fp_migrate_window_chunk(
+                    fp, state, jnp.asarray(kpair),
+                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
+                    probe_window=self.probe_window, rounds=self.rounds)
+                unplaced += int(np.asarray(n_un))
+            if unplaced:
+                raise RuntimeError(
+                    f"fingerprint window rehash left {unplaced} unplaced")
+            self.fp, self.state, self.n_slots = fp, state, new_n
+            store.metrics.pregrows += 1
+
+    def rebase(self, offset_ticks: int) -> None:
+        self.state = K.rebase_window_epoch(
+            self.state, jnp.int32(offset_ticks // self.window_ticks))
+
+    def to_snap(self) -> dict:
+        return {
+            "fp": np.asarray(self.fp),
+            "probe_window": self.probe_window,
+            "prev_count": np.asarray(self.state.prev_count),
+            "curr_count": np.asarray(self.state.curr_count),
+            "window_idx": np.asarray(self.state.window_idx),
+            "exists": np.asarray(self.state.exists),
+        }
+
+    def load_snap(self, data: dict, shift: int) -> None:
+        if "fp" not in data:
+            raise ValueError(
+                "checkpoint's window tables use the host key directory — "
+                "restore into a DeviceBucketStore")
+        self.probe_window = int(data.get("probe_window", self.probe_window))
+        self.n_slots = len(data["prev_count"])
+        self.fp = jnp.asarray(data["fp"])
+        self.state = K.WindowState(
+            prev_count=jnp.asarray(data["prev_count"]),
+            curr_count=jnp.asarray(data["curr_count"]),
+            window_idx=jnp.asarray(
+                _shift_ts(data["window_idx"], shift // self.window_ticks)),
+            exists=jnp.asarray(data["exists"]),
+        )
+
+
 class FingerprintBucketStore(DeviceBucketStore):
     """``DeviceBucketStore`` with the bucket tier's key directory moved
     into device memory (module docstring). Drop-in: same ``BucketStore``
@@ -399,12 +542,5 @@ class FingerprintBucketStore(DeviceBucketStore):
         self.probe_window = probe_window
         self.insert_rounds = insert_rounds
 
-    def _table(self, capacity: float, fill_rate_per_sec: float) -> _FpTable:
-        key = (float(capacity), float(fill_rate_per_sec))
-        with self._lock:
-            table = self._tables.get(key)
-            if table is None:
-                table = _FpTable(self, capacity, fill_rate_per_sec,
-                                 self.n_slots_default)
-                self._tables[key] = table
-            return table
+    _TABLE_CLS = _FpTable
+    _WTABLE_CLS = _FpWindowTable

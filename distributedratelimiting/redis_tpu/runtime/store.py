@@ -1071,6 +1071,32 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             self.state, jnp.int32(offset_ticks // self.window_ticks)
         )
 
+    # -- checkpoint form (swapped wholesale by _FpWindowTable) -------------
+    def to_snap(self) -> dict:
+        return {
+            "directory": self.dir.to_dict(),
+            "prev_count": np.asarray(self.state.prev_count),
+            "curr_count": np.asarray(self.state.curr_count),
+            "window_idx": np.asarray(self.state.window_idx),
+            "exists": np.asarray(self.state.exists),
+        }
+
+    def load_snap(self, data: dict, shift: int) -> None:
+        if "directory" not in data:
+            raise ValueError(
+                "checkpoint's window tables use the device-resident "
+                "fingerprint directory — restore into a "
+                "FingerprintBucketStore")
+        self.n_slots = len(data["prev_count"])
+        self.state = K.WindowState(
+            prev_count=jnp.asarray(data["prev_count"]),
+            curr_count=jnp.asarray(data["curr_count"]),
+            window_idx=jnp.asarray(
+                _shift_ts(data["window_idx"], shift // self.window_ticks)),
+            exists=jnp.asarray(data["exists"]),
+        )
+        self.dir.load(data["directory"], self.n_slots)
+
     def _grow(self) -> None:
         old_n = self.n_slots
         self.state = K.WindowState(
@@ -1233,24 +1259,30 @@ class DeviceBucketStore(BucketStore):
             )
 
     # -- table routing -----------------------------------------------------
-    def _table(self, capacity: float, fill_rate_per_sec: float) -> _DeviceTable:
+    # Subclasses swap the constructed table classes (the fingerprint store
+    # substitutes its device-directory tables) without copying the keying
+    # or locking below.
+    _TABLE_CLS: type = None  # type: ignore[assignment]  # set after class
+    _WTABLE_CLS: type = None  # type: ignore[assignment]
+
+    def _table(self, capacity: float, fill_rate_per_sec: float) -> "_DeviceTable":
         key = (float(capacity), float(fill_rate_per_sec))
         with self._lock:
             table = self._tables.get(key)
             if table is None:
-                table = _DeviceTable(self, capacity, fill_rate_per_sec,
-                                     self.n_slots_default)
+                table = self._TABLE_CLS(self, capacity, fill_rate_per_sec,
+                                        self.n_slots_default)
                 self._tables[key] = table
             return table
 
     def _wtable(self, limit: float, window_sec: float,
-                fixed: bool = False) -> _DeviceWindowTable:
+                fixed: bool = False) -> "_DeviceWindowTable":
         wt = int(window_sec * bm.TICKS_PER_SECOND)
         key = (float(limit), wt, fixed)
         with self._lock:
             table = self._wtables.get(key)
             if table is None:
-                table = _DeviceWindowTable(self, limit, wt,
+                table = self._WTABLE_CLS(self, limit, wt,
                                            self.n_slots_default, fixed=fixed)
                 self._wtables[key] = table
             return table
@@ -1557,13 +1589,7 @@ class DeviceBucketStore(BucketStore):
                 tables[(cap, rate)] = t.to_snap()
             wtables = {}
             for (limit, wt, fixed), t in self._wtables.items():
-                wtables[(limit, wt, fixed)] = {
-                    "directory": t.dir.to_dict(),
-                    "prev_count": np.asarray(t.state.prev_count),
-                    "curr_count": np.asarray(t.state.curr_count),
-                    "window_idx": np.asarray(t.state.window_idx),
-                    "exists": np.asarray(t.state.exists),
-                }
+                wtables[(limit, wt, fixed)] = t.to_snap()
             return {
                 "now_ticks": self.clock.now_ticks(),
                 "tables": tables,
@@ -1597,16 +1623,8 @@ class DeviceBucketStore(BucketStore):
                 # Pre-fixed-window snapshots carry 2-tuple keys (sliding).
                 limit, wt = wkey[0], wkey[1]
                 fixed = wkey[2] if len(wkey) > 2 else False
-                table = self._wtable(limit, wt / bm.TICKS_PER_SECOND, fixed)
-                table.n_slots = len(data["prev_count"])
-                table.state = K.WindowState(
-                    prev_count=jnp.asarray(data["prev_count"]),
-                    curr_count=jnp.asarray(data["curr_count"]),
-                    window_idx=jnp.asarray(
-                        _shift_ts(data["window_idx"], shift // wt)),
-                    exists=jnp.asarray(data["exists"]),
-                )
-                table.dir.load(data["directory"], table.n_slots)
+                self._wtable(limit, wt / bm.TICKS_PER_SECOND,
+                             fixed).load_snap(data, shift)
             c = snap["counters"]
             self._counters = K.CounterState(
                 value=jnp.asarray(c["value"]),
@@ -1625,6 +1643,12 @@ class DeviceBucketStore(BucketStore):
                 )
                 self._sema_dir.load(snap["sema_dir"],
                                     self._semas.active.shape[0])
+
+
+# Table classes are defined after DeviceBucketStore, so the bindings
+# land here (subclasses override the attributes, not the methods).
+DeviceBucketStore._TABLE_CLS = _DeviceTable
+DeviceBucketStore._WTABLE_CLS = _DeviceWindowTable
 
 
 class InProcessBucketStore(BucketStore):

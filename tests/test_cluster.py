@@ -230,6 +230,57 @@ class TestOverTcp:
         run(main())
 
 
+class TestLimitersOnCluster:
+    def test_exact_limiter_shares_bucket_through_cluster(self):
+        from distributedratelimiting.redis_tpu.models.options import (
+            TokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.models.token_bucket import (
+            TokenBucketRateLimiter,
+        )
+
+        async def main():
+            store, _ = make_cluster(3, clock=ManualClock())
+            lims = [TokenBucketRateLimiter(
+                TokenBucketOptions(token_limit=6, instance_name="shared"),
+                store) for _ in range(2)]
+            granted = 0
+            for lim in lims:
+                for _ in range(6):
+                    granted += (await lim.acquire_async(1)).is_acquired
+            assert granted == 6  # one bucket on one owning node, not two
+
+        run(main())
+
+    def test_approximate_limiter_syncs_through_cluster(self):
+        # The approximate algorithm's global counter is one key → one
+        # node; two limiter instances sharing the cluster must converge
+        # on it exactly as against a single store.
+        from distributedratelimiting.redis_tpu.models.approximate import (
+            ApproximateTokenBucketRateLimiter,
+        )
+        from distributedratelimiting.redis_tpu.models.options import (
+            ApproximateTokenBucketOptions,
+        )
+
+        async def main():
+            store, _ = make_cluster(3, clock=ManualClock())
+            opts = ApproximateTokenBucketOptions(
+                token_limit=100, tokens_per_period=10,
+                replenishment_period_s=3600.0, instance_name="approx")
+            a = ApproximateTokenBucketRateLimiter(opts, store)
+            b = ApproximateTokenBucketRateLimiter(opts, store)
+            for _ in range(30):
+                assert a.acquire(1).is_acquired
+            await a.refresh()     # push a's 30 into the shared counter
+            await b.refresh()     # b pulls the global score
+            assert b._global_score == pytest.approx(30.0)
+            await a.aclose()
+            await b.aclose()
+
+        run(main())
+
+
 class TestCheckpoint:
     def test_snapshot_restore_roundtrip(self):
         async def main():

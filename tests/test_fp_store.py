@@ -324,6 +324,89 @@ class TestFingerprintStore:
 
         run(main())
 
+    def test_window_tier_uses_fingerprint_directory(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock)
+            # Sliding window limit 3 per 10s.
+            got = [(await store.window_acquire("w", 1, 3.0, 10.0)).granted
+                   for _ in range(5)]
+            assert got == [True] * 3 + [False] * 2
+            # Window table really is fingerprint-backed (no host dir).
+            wt = store._wtable(3.0, 10.0)
+            assert not hasattr(wt, "dir")
+            assert int((np.asarray(wt.fp) != 0).any(-1).sum()) == 1
+            # New window ⇒ interpolated estimate decays.
+            clock.advance_seconds(15.0)
+            assert (await store.window_acquire("w", 1, 3.0, 10.0)).granted
+            await store.aclose()
+
+        run(main())
+
+    def test_window_bulk_matches_host_directory_store(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=1024, clock=clock)
+            oracle = DeviceBucketStore(n_slots=1024, clock=clock)
+            rng = np.random.default_rng(5)
+            keys = [f"w{i}" for i in rng.integers(0, 50, 300)]
+            counts = rng.integers(0, 3, 300).tolist()
+            for fixed in (False, True):
+                got = await store.window_acquire_many(
+                    keys, counts, 4.0, 10.0, fixed=fixed)
+                want = await oracle.window_acquire_many(
+                    keys, counts, 4.0, 10.0, fixed=fixed)
+                np.testing.assert_array_equal(got.granted, want.granted)
+                np.testing.assert_allclose(got.remaining, want.remaining,
+                                           atol=1e-4)
+            await store.aclose()
+            await oracle.aclose()
+
+        run(main())
+
+    def test_window_growth_preserves_state(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=64, clock=clock,
+                                           probe_window=8)
+            wt = store._wtable(5.0, 60.0)
+            # Consume 4 of 5 on a marker key, then flood distinct keys.
+            r = await store.window_acquire_many(["wm"], [4], 5.0, 60.0)
+            assert r.granted.all()
+            keys = [f"wf{i}" for i in range(200)]
+            for _ in range(4):
+                res = await store.window_acquire_many(
+                    keys, [1] * 200, 5.0, 60.0)
+                if res.granted.all():
+                    break
+            assert res.granted.all()
+            assert wt.n_slots >= 256
+            # Marker's 4-of-5 consumption survived the window rehash.
+            r2 = await store.window_acquire_many(["wm"], [2], 5.0, 60.0)
+            assert not r2.granted.any()
+            await store.aclose()
+
+        run(main())
+
+    def test_window_snapshot_roundtrip_and_cross_type(self):
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock)
+            await store.window_acquire("w", 3, 5.0, 60.0)
+            snap = store.snapshot()
+            fresh = FingerprintBucketStore(n_slots=256, clock=ManualClock())
+            fresh.restore(snap)
+            r = await fresh.window_acquire("w", 3, 5.0, 60.0)
+            assert not r.granted  # 3 of 5 already consumed pre-snapshot
+            host = DeviceBucketStore(n_slots=256, clock=ManualClock())
+            with pytest.raises(ValueError, match="fingerprint"):
+                host.restore(snap)
+            await store.aclose()
+            await fresh.aclose()
+            await host.aclose()
+
+        run(main())
+
     def test_concurrent_mixed_traffic_with_growth(self):
         # Race posture: async micro-batched acquires + blocking bulk calls
         # from threads + growth pressure, all against one table. The

@@ -278,6 +278,50 @@ class TestClientServer:
         run(main())
 
 
+class TestWireFuzz:
+    def test_garbage_frames_never_kill_the_server(self):
+        # Adversarial/corrupt peers: random frame bodies (valid length
+        # prefix, arbitrary bytes — including truncated ops, huge counts,
+        # bad UTF-8, random bulk flags). The server may error-reply or
+        # drop the connection, but must neither crash nor stop serving
+        # well-formed clients.
+        import random
+
+        async def main():
+            rng = random.Random(0xFA22)
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                for round_no in range(40):
+                    reader, writer = await asyncio.open_connection(
+                        srv.host, srv.port)
+                    try:
+                        for _ in range(rng.randint(1, 4)):
+                            body = bytes(rng.randrange(256) for _ in range(
+                                rng.choice((0, 1, 5, 6, 23, 64, 300))))
+                            writer.write(
+                                len(body).to_bytes(4, "little") + body)
+                        await writer.drain()
+                        # Read whatever comes back until the server drops
+                        # us or stops replying; content is unconstrained.
+                        try:
+                            await asyncio.wait_for(reader.read(4096), 0.2)
+                        except asyncio.TimeoutError:
+                            pass
+                    finally:
+                        writer.close()
+                        try:
+                            await writer.wait_closed()
+                        except (ConnectionResetError, BrokenPipeError):
+                            pass
+                # The server must still serve a well-formed client.
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    assert (await store.acquire("ok", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+
 class TestAuthAndVersion:
     def test_auth_required_server_rejects_tokenless_client(self):
         async def main():
