@@ -206,6 +206,39 @@ async def bench_e2e_bulk(store_mod, limiter_mod, options_mod):
     return verdict_only, with_remaining
 
 
+async def bench_fp_bulk():
+    """Device-resident-directory bulk path: the same whole-array workload
+    through `FingerprintBucketStore` — key→slot probe/insert happens
+    IN-KERNEL on 64-bit fingerprints; the host's per-call duty is one
+    native hashing pass (no host directory). Reported beside the
+    host-directory bulk number so the operand-bytes vs host-work trade
+    (docs/DESIGN.md §5b) is tracked per round on the real chip."""
+    from distributedratelimiting.redis_tpu.runtime.fp_store import (
+        FingerprintBucketStore,
+    )
+
+    store = FingerprintBucketStore(n_slots=1 << 21, max_batch=8192)
+    n = 1 << 17
+    rng = np.random.default_rng(3)
+    pool = [f"user{i}" for i in range(1_000_000)]
+    calls = [[pool[j] for j in rng.integers(0, len(pool), n)]
+             for _ in range(4)]
+    counts = [1] * n
+
+    async def run_round():
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *(store.acquire_many(c, counts, 10_000_000.0, 10_000_000.0,
+                                 with_remaining=False) for c in calls))
+        dt = time.perf_counter() - t0
+        return sum(len(r) for r in results) / dt
+
+    await run_round()  # warm: insert pass + compile at the exact shapes
+    rate = max([await run_round() for _ in range(2)])
+    await store.aclose()
+    return rate
+
+
 async def bench_e2e_remote_bulk(store_mod):
     """End-to-end REMOTE bulk path: acquire_many through a real localhost
     socket — wire encode + chunking + server decode + scanned device
@@ -563,6 +596,7 @@ def main():
     del state  # free the 10M-slot table before the serving-path stores
     bulk_rate, bulk_with_rem = asyncio.run(
         bench_e2e_bulk(store_mod, partitioned, options_mod))
+    fp_bulk = asyncio.run(bench_fp_bulk())
     remote_bulk = asyncio.run(bench_e2e_remote_bulk(store_mod))
     e2e_rate, p99 = asyncio.run(
         bench_e2e_async(store_mod, partitioned, options_mod))
@@ -586,6 +620,7 @@ def main():
         "single_batch_decisions_per_sec": round(single),
         "e2e_bulk_decisions_per_sec": round(bulk_rate),
         "e2e_bulk_with_remaining_decisions_per_sec": round(bulk_with_rem),
+        "e2e_fp_bulk_decisions_per_sec": round(fp_bulk),
         "e2e_remote_bulk_decisions_per_sec": round(remote_bulk),
         "e2e_async_decisions_per_sec": round(e2e_rate),
         "e2e_async_nproc_decisions_per_sec": round(nproc_rate),

@@ -328,21 +328,26 @@ class _FpTable:
             store.metrics.sweeps += 1
             store.metrics.slots_evicted += int(np.asarray(n_freed))
 
+    # Growth hooks (the window subclass swaps these three):
+    def _init_fresh(self, n: int):
+        return F.init_fp_table(n), K.init_bucket_state(n)
+
+    def _migrate_kernel(self):
+        return F.fp_migrate_chunk
+
     def _grow(self) -> None:
         """Double the table with a device-side rehash: read old
         fingerprints back, then per chunk claim slots in the new table and
-        scatter the old bucket state across (``fp_migrate_chunk``) — the
+        scatter the old per-slot state across (the migrate kernel) — the
         host never computes a placement."""
         store = self.store
         with store._lock:
             old_fp = np.asarray(self.fp)
             occupied = np.nonzero((old_fp != 0).any(-1))[0]
-            old_tokens = np.asarray(self.state.tokens)
-            old_ts = np.asarray(self.state.last_ts)
-            old_exists = np.asarray(self.state.exists)
+            olds = [np.asarray(a) for a in self.state]
             new_n = self.n_slots * 2
-            fp = F.init_fp_table(new_n)
-            state = K.init_bucket_state(new_n)
+            fp, state = self._init_fresh(new_n)
+            migrate = self._migrate_kernel()
             b = self.store.max_batch
             unplaced = 0
             for pos in range(0, len(occupied), b):
@@ -350,17 +355,16 @@ class _FpTable:
                 m = len(idx)
                 kpair = np.zeros((b, 2), np.uint32)
                 kpair[:m] = old_fp[idx]
-                tok = np.zeros((b,), np.float32)
-                tok[:m] = old_tokens[idx]
-                ts = np.zeros((b,), np.int32)
-                ts[:m] = old_ts[idx]
-                ex = np.zeros((b,), bool)
-                ex[:m] = old_exists[idx]
+                cols = []
+                for arr in olds:
+                    col = np.zeros((b,), arr.dtype)
+                    col[:m] = arr[idx]
+                    cols.append(col)
                 valid = np.zeros((b,), bool)
                 valid[:m] = True
-                fp, state, n_un = F.fp_migrate_chunk(
-                    fp, state, jnp.asarray(kpair), jnp.asarray(tok),
-                    jnp.asarray(ts), jnp.asarray(ex), jnp.asarray(valid),
+                fp, state, n_un = migrate(
+                    fp, state, jnp.asarray(kpair),
+                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
                     probe_window=self.probe_window, rounds=self.rounds)
                 unplaced += int(np.asarray(n_un))
             if unplaced:
@@ -464,39 +468,11 @@ class _FpWindowTable(_FpTable):
             store.metrics.sweeps += 1
             store.metrics.slots_evicted += int(np.asarray(n_freed))
 
-    def _grow(self) -> None:
-        store = self.store
-        with store._lock:
-            old_fp = np.asarray(self.fp)
-            occupied = np.nonzero((old_fp != 0).any(-1))[0]
-            olds = [np.asarray(a) for a in self.state]
-            new_n = self.n_slots * 2
-            fp = F.init_fp_table(new_n)
-            state = K.init_window_state(new_n)
-            b = self.store.max_batch
-            unplaced = 0
-            for pos in range(0, len(occupied), b):
-                idx = occupied[pos:pos + b]
-                m = len(idx)
-                kpair = np.zeros((b, 2), np.uint32)
-                kpair[:m] = old_fp[idx]
-                cols = []
-                for arr in olds:
-                    col = np.zeros((b,), arr.dtype)
-                    col[:m] = arr[idx]
-                    cols.append(col)
-                valid = np.zeros((b,), bool)
-                valid[:m] = True
-                fp, state, n_un = F.fp_migrate_window_chunk(
-                    fp, state, jnp.asarray(kpair),
-                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
-                    probe_window=self.probe_window, rounds=self.rounds)
-                unplaced += int(np.asarray(n_un))
-            if unplaced:
-                raise RuntimeError(
-                    f"fingerprint window rehash left {unplaced} unplaced")
-            self.fp, self.state, self.n_slots = fp, state, new_n
-            store.metrics.pregrows += 1
+    def _init_fresh(self, n: int):
+        return F.init_fp_table(n), K.init_window_state(n)
+
+    def _migrate_kernel(self):
+        return F.fp_migrate_window_chunk
 
     def rebase(self, offset_ticks: int) -> None:
         self.state = K.rebase_window_epoch(
