@@ -228,6 +228,25 @@ def fp_peek_batch(fp, state: K.BucketState, kpair, valid, now, capacity,
     return jnp.where(valid, jnp.floor(refilled), 0.0)
 
 
+def _fp_migrate_core(fp, state, kpair, cols, valid, *, probe_window: int,
+                     rounds: int):
+    """Claim slots for old-table entries in the new table and scatter
+    their per-slot state columns across (traceable core — also the
+    per-shard block body of the mesh migrate step). Returns the per-entry
+    ``placed`` mask: under heavy in-chunk window contention (tiny or
+    crowded tables) the bounded insert rounds can leave entries unplaced,
+    and the host retries exactly those in another pass — each pass places
+    at least one contender per contested cell, so retries terminate."""
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    ss = jnp.where(live, out.slots, fp.shape[0])  # n ⇒ dropped
+    new_state = type(state)(*(
+        getattr(state, f).at[ss].set(c, mode="drop")
+        for f, c in zip(state._fields, cols)))
+    return out.fp, new_state, live
+
+
 @partial(jax.jit, donate_argnums=(0, 1),
          static_argnames=("probe_window", "rounds"))
 def fp_migrate_chunk(fp, state: K.BucketState, kpair, tokens, last_ts,
@@ -236,19 +255,11 @@ def fp_migrate_chunk(fp, state: K.BucketState, kpair, tokens, last_ts,
     """Growth/rehash step, on-device: claim slots for a chunk of OLD-table
     entries in the new (larger) table, then scatter their bucket state to
     the claimed slots. The host's whole role in a grow is reading the old
-    fingerprints back and chunking — placement and state movement never
-    leave the device. Returns ``(fp, state, n_unplaced)`` (``n_unplaced``
-    must read 0 at sane post-grow load factors)."""
-    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
-                          rounds=rounds)
-    live = valid & out.resolved
-    ss = jnp.where(live, out.slots, fp.shape[0])  # n ⇒ dropped
-    new_state = K.BucketState(
-        state.tokens.at[ss].set(tokens, mode="drop"),
-        state.last_ts.at[ss].set(last_ts, mode="drop"),
-        state.exists.at[ss].set(exists, mode="drop"),
-    )
-    return out.fp, new_state, (valid & ~out.resolved).sum(dtype=jnp.int32)
+    fingerprints back, chunking, and retrying unplaced entries —
+    placement and state movement never leave the device. Returns
+    ``(fp, state, placed bool[B])``."""
+    return _fp_migrate_core(fp, state, kpair, (tokens, last_ts, exists),
+                            valid, probe_window=probe_window, rounds=rounds)
 
 
 def _fp_window_core(fp, state, kpair, counts, valid, now, limit,
@@ -314,18 +325,10 @@ def fp_migrate_window_chunk(fp, state: K.WindowState, kpair, prev_count,
                             probe_window: int = 16, rounds: int = 4):
     """Window-table growth step (the :func:`fp_migrate_chunk` analogue):
     claim slots in the new table, scatter the four window-state arrays
-    across."""
-    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
-                          rounds=rounds)
-    live = valid & out.resolved
-    ss = jnp.where(live, out.slots, fp.shape[0])  # n ⇒ dropped
-    new_state = K.WindowState(
-        state.prev_count.at[ss].set(prev_count, mode="drop"),
-        state.curr_count.at[ss].set(curr_count, mode="drop"),
-        state.window_idx.at[ss].set(window_idx, mode="drop"),
-        state.exists.at[ss].set(exists, mode="drop"),
-    )
-    return out.fp, new_state, (valid & ~out.resolved).sum(dtype=jnp.int32)
+    across. Returns ``(fp, state, placed bool[B])``."""
+    return _fp_migrate_core(
+        fp, state, kpair, (prev_count, curr_count, window_idx, exists),
+        valid, probe_window=probe_window, rounds=rounds)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

@@ -44,7 +44,35 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     _REBASE_THRESHOLD_TICKS,
 )
 
-__all__ = ["make_sharded_fp_scan_step", "ShardedFpDeviceStore"]
+__all__ = ["make_sharded_fp_scan_step", "make_sharded_fp_migrate_step",
+           "ShardedFpDeviceStore"]
+
+
+def make_sharded_fp_migrate_step(mesh, *, probe_window: int = 16,
+                                 rounds: int = 4):
+    """Jitted per-shard rehash chunk for mesh growth: each shard claims
+    slots for a chunk of ITS old entries in its doubled slice and
+    scatters the bucket state across — no collectives (shard =
+    ``fp_lo % n_shards`` is invariant under resize, so entries never move
+    between shards; only within their shard's table)."""
+    fp_spec = P(SHARD_AXIS, None)
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    kpair_spec = P(SHARD_AXIS, None, None)
+    col_spec = P(SHARD_AXIS, None)
+
+    def block(fp, state, kpair, tokens, ts, exists, valid):
+        fp, state, placed = F._fp_migrate_core(
+            fp, state, kpair[0], (tokens[0], ts[0], exists[0]), valid[0],
+            probe_window=probe_window, rounds=rounds)
+        return fp, state, placed[None]
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(fp_spec, state_specs, kpair_spec, col_spec, col_spec,
+                  col_spec, col_spec),
+        out_specs=(fp_spec, state_specs, P(SHARD_AXIS)),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
 
 
 def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
@@ -107,11 +135,14 @@ class ShardedFpDeviceStore:
     the whole call in scanned fused launches.
 
     Window pressure (a request whose shard-local probe window can't place
-    it) denies the row and counts it in ``fp_unresolved`` — per-shard
-    growth is not implemented on the mesh tier yet; size shards for the
-    expected keyspace (the single-chip :class:`~..runtime.fp_store
-    .FingerprintBucketStore` grows; a mesh deployment presizes, as the
-    sharded host-directory store documents for its pre-growth era).
+    it) denies the row, counts it in ``fp_unresolved``, and heals:
+    sweep, then — if the sweep freed (almost) nothing — an all-shard
+    doubling via the device-side per-shard rehash
+    (:func:`make_sharded_fp_migrate_step`; entries never cross shards
+    because the route ``fp_lo % n_shards`` is resize-invariant). Denied
+    requests are not retried in-call (deny-and-heal, as on the single
+    chip); the caller's next attempt lands in the relieved table. Set
+    ``auto_grow=False`` to presize instead.
     """
 
     _BULK_MAX_K = 8
@@ -121,6 +152,7 @@ class ShardedFpDeviceStore:
                  probe_window: int = 16, rounds: int = 4,
                  decay_rate_per_sec: float = 0.0,
                  clock: Clock | None = None,
+                 auto_grow: bool = True,
                  rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS
                  ) -> None:
         import threading
@@ -138,8 +170,11 @@ class ShardedFpDeviceStore:
         self.per_shard_slots = per_shard_slots
         self.batch = batch
         self.probe_window = probe_window
+        self.rounds = rounds
         self.clock = clock or MonotonicClock()
+        self.auto_grow = auto_grow
         self.fp_unresolved = 0
+        self.grows = 0
 
         shard = NamedSharding(mesh, P(SHARD_AXIS))
         fp_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
@@ -212,6 +247,7 @@ class ShardedFpDeviceStore:
             # Sampled under the lock: a concurrent epoch rebase must not
             # pair a pre-rebase `now` with post-rebase state.
             now = self.now_ticks_checked()
+            call_pressure = 0
             while pos < rows:
                 k = 1
                 need_rows = -(-(rows - pos) // b)
@@ -250,19 +286,105 @@ class ShardedFpDeviceStore:
                     granted[idx] = g_np[s, :m]
                     if remaining is not None:
                         remaining[idx] = r_np[s, :m]
-                    self.fp_unresolved += int((~res_np[s, :m]).sum())
+                    call_pressure += int((~res_np[s, :m]).sum())
                 pos += take
+            self.fp_unresolved += call_pressure
+            if call_pressure and self.auto_grow:
+                # Deny-and-heal (single-chip discipline, both clauses —
+                # see _FpTable._relieve_pressure): sweep, then grow when
+                # the sweep freed (almost) nothing OR the table is past
+                # the growth threshold (live keys can saturate a probe
+                # window at modest load factors).
+                n_total = self.per_shard_slots * self.n_shards
+                freed = self._sweep_locked()
+                if (freed < max(1, n_total // 16)
+                        or self._occupancy() >= 0.7 * n_total):
+                    self._grow_locked()
         finally:
             self._lock.release()
         _grant_zero_probes(granted, counts_np)
         return BulkAcquireResult(granted, remaining)
+
+    def _occupancy(self) -> int:
+        # Caller holds the lock (donated buffers).
+        return int(np.asarray((np.asarray(self.fp) != 0).any(-1).sum()))
+
+    def _grow_locked(self) -> None:
+        """All-shard doubling via the device-side per-shard rehash: each
+        shard's entries re-place within the shard's doubled slice (the
+        route is resize-invariant, so nothing crosses shards)."""
+        old_fp = np.asarray(self.fp).reshape(self.n_shards, -1, 2)
+        olds = [np.asarray(a).reshape(self.n_shards, -1)
+                for a in self.state]
+        per_new = old_fp.shape[1] * 2  # committed only after the rehash
+        n = per_new * self.n_shards
+        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+        fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+        fp = jax.device_put(F.init_fp_table(n), fp_shard)
+        st = K.init_bucket_state(n)
+        state = K.BucketState(*(jax.device_put(a, shard) for a in st))
+        migrate = make_sharded_fp_migrate_step(
+            self.mesh, probe_window=self.probe_window, rounds=self.rounds)
+        pending = [np.nonzero((old_fp[s] != 0).any(-1))[0]
+                   for s in range(self.n_shards)]
+        b = self.batch
+        # Unplaced entries (bounded insert rounds under in-chunk window
+        # contention) retry in later passes; zero-progress ⇒ genuinely
+        # unplaceable (see _FpTable._grow — same discipline).
+        while any(len(p) for p in pending):
+            next_pending = [[] for _ in range(self.n_shards)]
+            rows = max(len(p) for p in pending)
+            pos = 0
+            while pos < rows:
+                kpair = np.zeros((self.n_shards, b, 2), np.uint32)
+                cols = [np.zeros((self.n_shards, b), a.dtype)
+                        for a in olds]
+                valid = np.zeros((self.n_shards, b), bool)
+                chunk_idx = [None] * self.n_shards
+                for s in range(self.n_shards):
+                    idx = pending[s][pos:pos + b]
+                    m = len(idx)
+                    if m == 0:
+                        continue
+                    chunk_idx[s] = idx
+                    kpair[s, :m] = old_fp[s][idx]
+                    for c, a in zip(cols, olds):
+                        c[s, :m] = a[s][idx]
+                    valid[s, :m] = True
+                fp, state, placed = migrate(
+                    fp, state, jnp.asarray(kpair),
+                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid))
+                placed_np = np.asarray(placed).reshape(self.n_shards, -1)
+                for s in range(self.n_shards):
+                    idx = chunk_idx[s]
+                    if idx is None:
+                        continue
+                    miss = ~placed_np[s, :len(idx)]
+                    if miss.any():
+                        next_pending[s].append(idx[miss])
+                pos += b
+            new_pending = [
+                np.concatenate(p) if p else np.zeros((0,), np.int64)
+                for p in next_pending]
+            if (sum(len(p) for p in new_pending)
+                    >= sum(len(p) for p in pending)):
+                raise RuntimeError(
+                    "sharded fingerprint rehash cannot place "
+                    f"{sum(len(p) for p in new_pending)} entries")
+            pending = new_pending
+        self.fp, self.state = fp, state
+        self.per_shard_slots = per_new
+        self.grows += 1
 
     def sweep(self) -> int:
         """Elementwise TTL sweep across every shard — the single-chip
         kernel applied to the sharded arrays (sharding is preserved, no
         collectives). Returns slots freed."""
         with self._lock:
-            self.fp, self.state, n_freed = F.fp_sweep_expired(
-                self.fp, self.state, jnp.int32(self.now_ticks_checked()),
-                jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
-            return int(np.asarray(n_freed))
+            return self._sweep_locked()
+
+    def _sweep_locked(self) -> int:
+        self.fp, self.state, n_freed = F.fp_sweep_expired(
+            self.fp, self.state, jnp.int32(self.now_ticks_checked()),
+            jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
+        return int(np.asarray(n_freed))

@@ -343,35 +343,47 @@ class _FpTable:
         store = self.store
         with store._lock:
             old_fp = np.asarray(self.fp)
-            occupied = np.nonzero((old_fp != 0).any(-1))[0]
+            pending = np.nonzero((old_fp != 0).any(-1))[0]
             olds = [np.asarray(a) for a in self.state]
             new_n = self.n_slots * 2
             fp, state = self._init_fresh(new_n)
             migrate = self._migrate_kernel()
             b = self.store.max_batch
-            unplaced = 0
-            for pos in range(0, len(occupied), b):
-                idx = occupied[pos:pos + b]
-                m = len(idx)
-                kpair = np.zeros((b, 2), np.uint32)
-                kpair[:m] = old_fp[idx]
-                cols = []
-                for arr in olds:
-                    col = np.zeros((b,), arr.dtype)
-                    col[:m] = arr[idx]
-                    cols.append(col)
-                valid = np.zeros((b,), bool)
-                valid[:m] = True
-                fp, state, n_un = migrate(
-                    fp, state, jnp.asarray(kpair),
-                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
-                    probe_window=self.probe_window, rounds=self.rounds)
-                unplaced += int(np.asarray(n_un))
-            if unplaced:
-                # Halved load factor makes this effectively unreachable;
-                # refuse to lose state silently if it ever isn't.
-                raise RuntimeError(
-                    f"fingerprint rehash left {unplaced} entries unplaced")
+            # Entries a pass can't place (bounded insert rounds under
+            # in-chunk window contention) are retried in later passes;
+            # each pass places ≥1 contender per contested cell, so a pass
+            # with zero progress means the table is genuinely unplaceable.
+            while len(pending):
+                next_pending = []
+                for pos in range(0, len(pending), b):
+                    idx = pending[pos:pos + b]
+                    m = len(idx)
+                    kpair = np.zeros((b, 2), np.uint32)
+                    kpair[:m] = old_fp[idx]
+                    cols = []
+                    for arr in olds:
+                        col = np.zeros((b,), arr.dtype)
+                        col[:m] = arr[idx]
+                        cols.append(col)
+                    valid = np.zeros((b,), bool)
+                    valid[:m] = True
+                    fp, state, placed = migrate(
+                        fp, state, jnp.asarray(kpair),
+                        *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
+                        probe_window=self.probe_window, rounds=self.rounds)
+                    miss = ~np.asarray(placed)[:m]
+                    if miss.any():
+                        next_pending.append(idx[miss])
+                if not next_pending:
+                    break
+                next_pending = np.concatenate(next_pending)
+                if len(next_pending) >= len(pending):
+                    # Halved load factor makes this effectively
+                    # unreachable; refuse to lose state silently.
+                    raise RuntimeError(
+                        f"fingerprint rehash cannot place "
+                        f"{len(next_pending)} entries")
+                pending = next_pending
             self.fp, self.state, self.n_slots = fp, state, new_n
             store.metrics.pregrows += 1
 

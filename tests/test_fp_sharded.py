@@ -93,6 +93,23 @@ class TestShardedFp:
         assert store.fp_unresolved > 0
         assert int(res.granted.sum()) < 400
 
+    def test_pressure_grows_all_shards_and_keeps_state(self, mesh):
+        store = make_store(mesh, per_shard_slots=16, probe_window=8)
+        marker = store.acquire_many_blocking(["marker"], [2])
+        assert marker.granted.all()
+        keys = [f"g{i}" for i in range(600)]
+        for _ in range(5):
+            res = store.acquire_many_blocking(keys, [1] * 600)
+            if res.granted.all():
+                break
+        assert res.granted.all()
+        assert store.grows >= 1
+        assert store.per_shard_slots >= 32
+        # Marker's consumption survived the per-shard device rehash:
+        # capacity 5, consumed 2 ⇒ a 4-token ask must deny.
+        r2 = store.acquire_many_blocking(["marker"], [4])
+        assert not r2.granted.any()
+
     def test_sweep_frees_expired(self, mesh):
         clock = ManualClock()
         store = make_store(mesh, fill_rate_per_sec=1.0, clock=clock)

@@ -129,11 +129,11 @@ class TestResolveKernel:
         new_fp = F.init_fp_table(16)
         new_state = K.init_bucket_state(16)
         kpair = out.fp[np.asarray(out.slots)]
-        new_fp, new_state, n_un = F.fp_migrate_chunk(
+        new_fp, new_state, placed = F.fp_migrate_chunk(
             new_fp, new_state, kpair, tokens[out.slots],
             state.last_ts[out.slots], state.exists[out.slots],
             jnp.ones((6,), bool), probe_window=8)
-        assert int(n_un) == 0
+        assert np.asarray(placed).all()
         re = _resolve(new_fp, keys, probe_window=8)
         old_tokens = np.asarray(tokens)[np.asarray(out.slots)]
         new_tokens = np.asarray(new_state.tokens)[np.asarray(re.slots)]
