@@ -365,6 +365,12 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--slots", type=int, default=2**17,
                         help="table slots (device backend) or per-shard "
                         "slots (mesh backend)")
+    parser.add_argument("--directory", choices=("host", "fp"),
+                        default="host",
+                        help="key-directory home for the device backend: "
+                        "host = native C++ host table (default); fp = "
+                        "device-resident fingerprint directory (in-kernel "
+                        "probe/insert — see docs/OPERATIONS.md §2)")
     parser.add_argument("--snapshot-path", default=None,
                         help="checkpoint file for OP_SAVE (≙ Redis BGSAVE "
                         "dump path); if it exists at startup, the store "
@@ -379,11 +385,19 @@ def main(argv: list[str] | None = None) -> None:
 
     async def serve() -> None:
         if args.backend == "device":
-            from distributedratelimiting.redis_tpu.runtime.store import (
-                DeviceBucketStore,
-            )
+            if args.directory == "fp":
+                from distributedratelimiting.redis_tpu.runtime.fp_store import (
+                    FingerprintBucketStore,
+                )
 
-            store: BucketStore = DeviceBucketStore(n_slots=args.slots)
+                store: BucketStore = FingerprintBucketStore(
+                    n_slots=args.slots)
+            else:
+                from distributedratelimiting.redis_tpu.runtime.store import (
+                    DeviceBucketStore,
+                )
+
+                store = DeviceBucketStore(n_slots=args.slots)
         elif args.backend == "mesh":
             from distributedratelimiting.redis_tpu.parallel.mesh_store import (
                 MeshBucketStore,

@@ -127,6 +127,48 @@ class TestMeshBackendCLI:
             proc.terminate()
             proc.wait(timeout=10)
 
+    def test_server_cli_fp_directory(self):
+        """`--directory fp` from the console: the device-resident
+        fingerprint directory deployable without code — buckets and keyed
+        windows decided straight from fingerprints over TCP."""
+        import os
+        import re
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DRLT_FORCE_CPU_PLATFORM="1")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m",
+             "distributedratelimiting.redis_tpu.runtime.server",
+             "--directory", "fp", "--port", "0", "--slots", "256"],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            m = re.search(r"listening on (\S+):(\d+)", line)
+            assert m, line
+            host, port = m.group(1), int(m.group(2))
+
+            async def drive():
+                client = RemoteBucketStore(address=(host, port))
+                try:
+                    got = [(await client.acquire("k", 1, 3.0, 0.0)).granted
+                           for _ in range(5)]
+                    assert got == [True] * 3 + [False] * 2
+                    assert (await client.window_acquire(
+                        "w", 2, 3.0, 10.0)).granted
+                    res = await client.acquire_many(
+                        [f"b{i}" for i in range(32)], [1] * 32, 5.0, 1.0)
+                    assert res.granted.all()
+                finally:
+                    await client.aclose()
+
+            run(drive())
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
 
 class TestStatsOp:
     def test_stats_reports_server_and_store_metrics(self):
