@@ -140,6 +140,50 @@ class TestResolveKernel:
         np.testing.assert_allclose(new_tokens, old_tokens)
 
 
+class TestTableInvariants:
+    def test_churn_preserves_uniqueness_and_findability(self):
+        # Property check over random insert/expire/re-insert churn: every
+        # live fingerprint occupies exactly ONE cell (duplicate cells
+        # would let one key's consumption split across buckets), and
+        # every live key resolves to its cell within the probe window
+        # (full-window scans make TTL clears safe — this is the claim).
+        rng = np.random.default_rng(17)
+        clock = ManualClock()
+        store = FingerprintBucketStore(n_slots=256, clock=clock,
+                                       probe_window=8)
+        table = store._table(5.0, 1.0)
+        pool = [f"c{i}" for i in range(120)]
+
+        async def churn():
+            for cycle in range(6):
+                batch = [pool[j] for j in rng.integers(0, len(pool), 80)]
+                await store.acquire_many(batch, [1] * 80, 5.0, 1.0)
+                clock.advance_seconds(rng.choice([0.5, 2.0, 3600.0]))
+                store.sweep_all()
+            fp = np.asarray(table.fp)
+            live = fp[(fp != 0).any(-1)]
+            # Uniqueness: no fingerprint occupies two cells.
+            packed = live[:, 0].astype(np.uint64) << 32 | live[:, 1]
+            assert len(np.unique(packed)) == len(packed)
+            # Findability: re-resolving every live fingerprint hits
+            # (insert-free peek must see full table coverage).
+            from distributedratelimiting.redis_tpu.ops import (
+                fp_directory as F,
+            )
+            import jax.numpy as jnp
+
+            out = F.fp_resolve_core(
+                jnp.asarray(fp), jnp.asarray(live),
+                jnp.ones((len(live),), bool),
+                probe_window=table.probe_window, rounds=1)
+            assert np.asarray(out.resolved).all()
+            slots = np.asarray(out.slots)
+            assert len(np.unique(slots)) == len(slots)
+            await store.aclose()
+
+        run(churn())
+
+
 class TestFingerprintStore:
     def test_capacity_enforced_async_path(self):
         async def main():
