@@ -44,33 +44,82 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     _REBASE_THRESHOLD_TICKS,
 )
 
-__all__ = ["make_sharded_fp_scan_step", "make_sharded_fp_migrate_step",
-           "ShardedFpDeviceStore"]
+__all__ = ["make_sharded_fp_scan_step",
+           "make_sharded_fp_window_scan_step",
+           "make_sharded_fp_migrate_step",
+           "ShardedFpDeviceStore", "ShardedFpWindowStore"]
 
 
-def make_sharded_fp_migrate_step(mesh, *, probe_window: int = 16,
+def make_sharded_fp_migrate_step(mesh, state_cls=None, *,
+                                 probe_window: int = 16,
                                  rounds: int = 4):
     """Jitted per-shard rehash chunk for mesh growth: each shard claims
     slots for a chunk of ITS old entries in its doubled slice and
-    scatters the bucket state across — no collectives (shard =
+    scatters the per-slot state columns across — no collectives (shard =
     ``fp_lo % n_shards`` is invariant under resize, so entries never move
-    between shards; only within their shard's table)."""
+    between shards; only within their shard's table). ``state_cls`` picks
+    the table family (:class:`~..ops.kernels.BucketState` default, or
+    ``WindowState``)."""
+    state_cls = state_cls or K.BucketState
+    nf = len(state_cls._fields)
     fp_spec = P(SHARD_AXIS, None)
-    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    state_specs = state_cls(*([P(SHARD_AXIS)] * nf))
     kpair_spec = P(SHARD_AXIS, None, None)
     col_spec = P(SHARD_AXIS, None)
 
-    def block(fp, state, kpair, tokens, ts, exists, valid):
+    def block(fp, state, kpair, *rest):
+        cols, valid = rest[:-1], rest[-1]
         fp, state, placed = F._fp_migrate_core(
-            fp, state, kpair[0], (tokens[0], ts[0], exists[0]), valid[0],
+            fp, state, kpair[0], tuple(c[0] for c in cols), valid[0],
             probe_window=probe_window, rounds=rounds)
         return fp, state, placed[None]
 
     mapped = shard_map(
         block, mesh=mesh,
-        in_specs=(fp_spec, state_specs, kpair_spec, col_spec, col_spec,
-                  col_spec, col_spec),
+        in_specs=(fp_spec, state_specs, kpair_spec)
+        + (col_spec,) * (nf + 1),
         out_specs=(fp_spec, state_specs, P(SHARD_AXIS)),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
+def make_sharded_fp_window_scan_step(mesh, *, probe_window: int = 16,
+                                     rounds: int = 4,
+                                     handle_duplicates: bool = True,
+                                     interpolate: bool = True):
+    """Window-family analogue of :func:`make_sharded_fp_scan_step` —
+    fused in-shard probe/insert + sliding/fixed-window decision, no
+    collectives at all (windows have no cross-key state; the global tier
+    is the approximate BUCKET algorithm's). ``interpolate=False`` =
+    fixed-window semantics. Returns
+    ``(fp, state, granted, remaining, resolved)``."""
+    fp_spec = P(SHARD_AXIS, None)
+    state_specs = K.WindowState(P(SHARD_AXIS), P(SHARD_AXIS),
+                                P(SHARD_AXIS), P(SHARD_AXIS))
+    batch_spec = P(SHARD_AXIS, None, None)
+    kpair_spec = P(SHARD_AXIS, None, None, None)
+
+    def block(fp, state, kpairs, counts, valid, nows, limit, window_ticks):
+        def body(carry, xs):
+            f, st = carry
+            kp, ct, va, now = xs
+            f, st, granted, remaining, resolved = F._fp_window_core(
+                f, st, kp, ct, va, now, limit, window_ticks,
+                probe_window=probe_window, rounds=rounds,
+                handle_duplicates=handle_duplicates,
+                interpolate=interpolate)
+            return (f, st), (granted, remaining, resolved)
+
+        (fp, state), (granted, remaining, resolved) = jax.lax.scan(
+            body, (fp, state), (kpairs[0], counts[0], valid[0], nows))
+        return (fp, state, granted[None], remaining[None], resolved[None])
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(fp_spec, state_specs, kpair_spec, batch_spec, batch_spec,
+                  P(), P(), P()),
+        out_specs=(fp_spec, state_specs, batch_spec, batch_spec,
+                   batch_spec),
     )
     return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -176,16 +225,37 @@ class ShardedFpDeviceStore:
         self.fp_unresolved = 0
         self.grows = 0
 
-        shard = NamedSharding(mesh, P(SHARD_AXIS))
         fp_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
         n = per_shard_slots * self.n_shards
         self.fp = jax.device_put(F.init_fp_table(n), fp_shard)
-        st = K.init_bucket_state(n)
-        self.state = K.BucketState(*(jax.device_put(a, shard) for a in st))
+        self.state = self._fresh_sharded_state(n)
         self.gcounter = jax.device_put(
             init_global_counter(), NamedSharding(mesh, P()))
-        self._step = make_sharded_fp_scan_step(
-            mesh, probe_window=probe_window, rounds=rounds)
+        self._step = self._make_step()
+
+    # -- table-family hooks (the window subclass swaps these) --------------
+    def _init_state_host(self, n: int):
+        return K.init_bucket_state(n)
+
+    def _fresh_sharded_state(self, n: int):
+        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+        st = self._init_state_host(n)
+        return type(st)(*(jax.device_put(a, shard) for a in st))
+
+    def _make_step(self):
+        return make_sharded_fp_scan_step(
+            self.mesh, probe_window=self.probe_window, rounds=self.rounds)
+
+    def _launch(self, kpairs, cts, val, nows):
+        """One scanned fused dispatch (caller holds the lock); updates
+        the table in place, returns (granted, remaining, resolved)."""
+        (self.fp, self.state, g_d, r_d, res_d,
+         self.gcounter) = self._step(
+            self.fp, self.state, jnp.asarray(kpairs), jnp.asarray(cts),
+            jnp.asarray(val), jnp.asarray(nows),
+            jnp.float32(self.capacity), jnp.float32(self.rate_per_tick),
+            self.gcounter, jnp.float32(self.decay_per_tick))
+        return g_d, r_d, res_d
 
     @property
     def global_score(self) -> float:
@@ -270,15 +340,10 @@ class ShardedFpDeviceStore:
                     val[s, :m] = True
                     sel.append((s, m, idx))
                 nows = np.full((k,), now, np.int32)
-                (self.fp, self.state, g_d, r_d, res_d,
-                 self.gcounter) = self._step(
-                    self.fp, self.state,
-                    jnp.asarray(kpairs.reshape(self.n_shards, k, b, 2)),
-                    jnp.asarray(cts.reshape(self.n_shards, k, b)),
-                    jnp.asarray(val.reshape(self.n_shards, k, b)),
-                    jnp.asarray(nows), jnp.float32(self.capacity),
-                    jnp.float32(self.rate_per_tick), self.gcounter,
-                    jnp.float32(self.decay_per_tick))
+                g_d, r_d, res_d = self._launch(
+                    kpairs.reshape(self.n_shards, k, b, 2),
+                    cts.reshape(self.n_shards, k, b),
+                    val.reshape(self.n_shards, k, b), nows)
                 g_np = np.asarray(g_d).reshape(self.n_shards, -1)
                 r_np = np.asarray(r_d).reshape(self.n_shards, -1)
                 res_np = np.asarray(res_d).reshape(self.n_shards, -1)
@@ -318,13 +383,12 @@ class ShardedFpDeviceStore:
                 for a in self.state]
         per_new = old_fp.shape[1] * 2  # committed only after the rehash
         n = per_new * self.n_shards
-        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
         fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
         fp = jax.device_put(F.init_fp_table(n), fp_shard)
-        st = K.init_bucket_state(n)
-        state = K.BucketState(*(jax.device_put(a, shard) for a in st))
+        state = self._fresh_sharded_state(n)
         migrate = make_sharded_fp_migrate_step(
-            self.mesh, probe_window=self.probe_window, rounds=self.rounds)
+            self.mesh, type(self.state), probe_window=self.probe_window,
+            rounds=self.rounds)
         pending = [np.nonzero((old_fp[s] != 0).any(-1))[0]
                    for s in range(self.n_shards)]
         b = self.batch
@@ -384,7 +448,58 @@ class ShardedFpDeviceStore:
             return self._sweep_locked()
 
     def _sweep_locked(self) -> int:
+        # `now` FIRST: now_ticks_checked can fire an epoch rebase that
+        # donates-and-replaces self.state — arguments already evaluated
+        # would then reference deleted (or stale pre-rebase) buffers.
+        now = self.now_ticks_checked()
         self.fp, self.state, n_freed = F.fp_sweep_expired(
-            self.fp, self.state, jnp.int32(self.now_ticks_checked()),
+            self.fp, self.state, jnp.int32(now),
             jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
         return int(np.asarray(n_freed))
+
+
+class ShardedFpWindowStore(ShardedFpDeviceStore):
+    """Sliding/fixed-window tables with the device-resident directory
+    over a mesh — the window member of the fp family's matrix (single
+    chip × mesh, buckets × windows). No collectives at all: windows have
+    no cross-key state, and the global tier belongs to the approximate
+    bucket algorithm. Everything else (route-by-fingerprint bulk path,
+    pressure heal, per-shard rehash growth, epoch rebase) is inherited.
+    """
+
+    def __init__(self, mesh, *, limit: float, window_sec: float,
+                 fixed: bool = False, **kw) -> None:
+        self.limit = float(limit)
+        self.window_ticks = int(
+            window_sec * bm.TICKS_PER_SECOND)
+        self.fixed = fixed
+        # capacity/fill-rate are bucket-family operands; unused here (the
+        # base stores them, the window step never reads them).
+        super().__init__(mesh, capacity=limit, fill_rate_per_sec=0.0, **kw)
+
+    def _init_state_host(self, n: int):
+        return K.init_window_state(n)
+
+    def _make_step(self):
+        return make_sharded_fp_window_scan_step(
+            self.mesh, probe_window=self.probe_window, rounds=self.rounds,
+            interpolate=not self.fixed)
+
+    def _launch(self, kpairs, cts, val, nows):
+        self.fp, self.state, g_d, r_d, res_d = self._step(
+            self.fp, self.state, jnp.asarray(kpairs), jnp.asarray(cts),
+            jnp.asarray(val), jnp.asarray(nows), jnp.float32(self.limit),
+            jnp.int32(self.window_ticks))
+        return g_d, r_d, res_d
+
+    def _sweep_locked(self) -> int:
+        now = self.now_ticks_checked()  # before the args (rebase hazard)
+        self.fp, self.state, n_freed = F.fp_sweep_windows(
+            self.fp, self.state, jnp.int32(now),
+            jnp.int32(self.window_ticks))
+        return int(np.asarray(n_freed))
+
+    def force_rebase(self, offset: int) -> None:
+        with self._lock:
+            self.state = K.rebase_window_epoch(
+                self.state, jnp.int32(offset // self.window_ticks))

@@ -125,6 +125,67 @@ class TestShardedFp:
         res = store.acquire_many_blocking(["z", "z"], [0, 1])
         assert bool(res.granted[0]) and not bool(res.granted[1])
 
+    def test_window_store_sliding_and_fixed(self, mesh):
+        from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+            ShardedFpWindowStore,
+        )
+        from distributedratelimiting.redis_tpu.runtime.fp_store import (
+            FingerprintBucketStore,
+        )
+        import asyncio
+
+        clock = ManualClock()
+        store = ShardedFpWindowStore(
+            mesh, limit=3.0, window_sec=10.0, per_shard_slots=256,
+            batch=32, clock=clock)
+        # Capacity within one window, across calls and shards.
+        keys = [f"w{i}" for i in range(40)]
+        r1 = store.acquire_many_blocking(keys, [2] * 40)
+        assert r1.granted.all()
+        r2 = store.acquire_many_blocking(keys, [2] * 40)
+        assert not r2.granted.any()  # 2+2 > 3 within the window
+        # Differential vs the single-chip fp window tier.
+        single = FingerprintBucketStore(n_slots=1 << 12, clock=clock)
+        rng = np.random.default_rng(23)
+        dkeys = [f"d{i}" for i in rng.integers(0, 60, 200)]
+        counts = rng.integers(0, 3, 200).tolist()
+        got = store.acquire_many_blocking(dkeys, counts)
+        want = single.window_acquire_many_blocking(dkeys, counts, 3.0, 10.0)
+        np.testing.assert_array_equal(got.granted, want.granted)
+        # New window: counts roll and interpolation decays.
+        clock.advance_seconds(25.0)
+        assert store.acquire_many_blocking(["w0"], [3]).granted.all()
+        # Fixed-window variant differs from sliding where interpolation
+        # would deny.
+        fstore = ShardedFpWindowStore(
+            mesh, limit=3.0, window_sec=10.0, fixed=True,
+            per_shard_slots=256, batch=32, clock=clock)
+        assert fstore.acquire_many_blocking(["f"], [3]).granted.all()
+        clock.advance_seconds(10.5)  # fresh fixed window: full limit again
+        assert fstore.acquire_many_blocking(["f"], [3]).granted.all()
+        asyncio.run(single.aclose())
+
+    def test_window_store_growth(self, mesh):
+        from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+            ShardedFpWindowStore,
+        )
+
+        clock = ManualClock()
+        store = ShardedFpWindowStore(
+            mesh, limit=5.0, window_sec=60.0, per_shard_slots=16,
+            batch=32, probe_window=8, clock=clock)
+        marker = store.acquire_many_blocking(["wm"], [4])
+        assert marker.granted.all()
+        keys = [f"wg{i}" for i in range(600)]
+        for _ in range(5):
+            res = store.acquire_many_blocking(keys, [1] * 600)
+            if res.granted.all():
+                break
+        assert res.granted.all()
+        assert store.grows >= 1
+        # Marker's 4-of-5 survived the window rehash.
+        assert not store.acquire_many_blocking(["wm"], [2]).granted.any()
+
     def test_verdict_only(self, mesh):
         store = make_store(mesh)
         res = store.acquire_many_blocking(["v1", "v2"], [1, 99],
