@@ -622,6 +622,49 @@ def bench_fp_directory(smoke: bool = False) -> dict:
     }
 
 
+def bench_fp_mesh(smoke: bool = False) -> dict:
+    """Mesh-sharded fingerprint tier: bulk decisions through
+    `ShardedFpDeviceStore` over every visible device — in-kernel
+    probe/insert per shard, fingerprint-as-route, psum global tier.
+    The fp analogue of `two_level_mesh`."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+        ShardedFpDeviceStore,
+    )
+    from distributedratelimiting.redis_tpu.parallel.mesh import create_mesh
+
+    import jax
+
+    mesh = create_mesh(len(jax.devices()))
+    n = 1 << (10 if smoke else 16)
+    calls = 2 if smoke else 4
+    store = ShardedFpDeviceStore(
+        mesh, capacity=1e9, fill_rate_per_sec=1.0,
+        per_shard_slots=1 << (8 if smoke else 16),
+        batch=128 if smoke else 2048)
+    rng = np.random.default_rng(13)
+    pool = [f"user{i}" for i in range(200_000)]
+    batches = [[pool[j] for j in rng.integers(0, len(pool), n)]
+               for _ in range(calls)]
+    counts = [1] * n
+    for b in batches:  # warm: inserts + compile at exact shapes
+        store.acquire_many_blocking(b, counts, with_remaining=False)
+    t0 = time.perf_counter()
+    for b in batches:
+        store.acquire_many_blocking(b, counts, with_remaining=False)
+    rate = calls * n / (time.perf_counter() - t0)
+    return {
+        "config": "fp_mesh",
+        "metric": "decisions_per_sec",
+        "value": round(rate),
+        "unit": "decisions/s",
+        "n_devices": mesh.devices.size,
+        "keys_per_call": n,
+        "global_score": store.global_score,
+    }
+
+
 CONFIGS = {
     "single_bucket_cpu": bench_single_bucket_cpu,
     "partitioned_10k_uniform": bench_partitioned_10k_uniform,
@@ -631,6 +674,7 @@ CONFIGS = {
     "psum_cadence": bench_psum_cadence,
     "cluster_bulk": bench_cluster_bulk,
     "fp_directory": bench_fp_directory,
+    "fp_mesh": bench_fp_mesh,
 }
 
 
