@@ -10,10 +10,13 @@ duty is one hashing pass).
 Routing falls out for free: the fingerprint IS the route. Shard =
 ``fp_lo % n_shards`` — no second hash, no crc32 pass; every host routes
 identically because every host hashes identically. Each shard holds an
-independent fingerprint table + bucket state slice in its own HBM and
-probes shard-locally; TTL sweeps stay elementwise (the single-chip
-``fp_sweep_expired`` applied to sharded arrays preserves the sharding
-with no collectives).
+independent fingerprint table + state slice in its own HBM and probes
+shard-locally; TTL sweeps stay elementwise (the single-chip sweep
+kernels applied to sharded arrays preserve the sharding with no
+collectives), and growth is a per-shard device rehash (the route is
+resize-invariant). Both table families ship: token buckets
+(:class:`ShardedFpDeviceStore`, with the psum global tier) and
+sliding/fixed windows (:class:`ShardedFpWindowStore`, collective-free).
 """
 
 from __future__ import annotations

@@ -281,6 +281,34 @@ class TestLimitersOnCluster:
         run(main())
 
 
+class TestComposition:
+    def test_cluster_of_fingerprint_stores(self):
+        # Node-type agnosticism: a cluster whose nodes are device stores
+        # with the device-resident directory — two orthogonal tiers
+        # composing (client-side sharding × in-kernel key resolution).
+        from distributedratelimiting.redis_tpu.runtime.fp_store import (
+            FingerprintBucketStore,
+        )
+
+        async def main():
+            clock = ManualClock()
+            nodes = [FingerprintBucketStore(n_slots=256, clock=clock)
+                     for _ in range(2)]
+            store = ClusterBucketStore(stores=nodes)
+            keys = [f"k{i}" for i in range(50)]
+            res = await store.acquire_many(keys, [2] * 50, 5.0, 0.0)
+            assert res.granted.all()
+            res2 = await store.acquire_many(keys, [4] * 50, 5.0, 0.0)
+            assert not res2.granted.any()  # 3 left of 5 per key
+            # Per-key stickiness through both tiers.
+            got = [(await store.acquire("k0", 1, 5.0, 0.0)).granted
+                   for _ in range(4)]
+            assert got == [True] * 3 + [False]
+            await store.aclose()
+
+        run(main())
+
+
 class TestCheckpoint:
     def test_snapshot_restore_roundtrip(self):
         async def main():
