@@ -28,6 +28,7 @@ __version__ = "0.1.0"
 from distributedratelimiting.redis_tpu.models.base import (
     MetadataName,
     RateLimitLease,
+    RateLimiterStatistics,
     RateLimiter,
 )
 from distributedratelimiting.redis_tpu.models.concurrency import (
@@ -93,6 +94,7 @@ from distributedratelimiting.redis_tpu.utils.registry import (
 __all__ = [
     "MetadataName",
     "RateLimitLease",
+    "RateLimiterStatistics",
     "RateLimiter",
     "TokenBucketOptions",
     "ApproximateTokenBucketOptions",

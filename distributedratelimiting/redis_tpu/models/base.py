@@ -25,6 +25,7 @@ lease classes have no Dispose override; SURVEY.md §2 #9).
 from __future__ import annotations
 
 import abc
+from dataclasses import dataclass
 from typing import Any, Iterable
 
 __all__ = ["MetadataName", "RateLimitLease", "RateLimiter",
@@ -91,6 +92,16 @@ SUCCESSFUL_LEASE = RateLimitLease(True)
 FAILED_LEASE = RateLimitLease(False)
 
 
+@dataclass(frozen=True)
+class RateLimiterStatistics:
+    """≙ ``System.Threading.RateLimiting.RateLimiterStatistics``."""
+
+    current_available_permits: int
+    total_successful_leases: int
+    total_failed_leases: int
+    current_queued_count: int
+
+
 class RateLimiter(abc.ABC):
     """Abstract rate limiter (≙ ``System.Threading.RateLimiting.RateLimiter``)."""
 
@@ -114,6 +125,24 @@ class RateLimiter(abc.ABC):
     def idle_duration(self) -> float | None:
         """Seconds since the limiter last had consumption in flight, or
         ``None`` if active (≙ ``IdleDuration``, ``…cs:33-34,503-506``)."""
+
+    def get_statistics(self) -> "RateLimiterStatistics":
+        """Point-in-time snapshot (≙ the modern .NET
+        ``RateLimiter.GetStatistics()``, which post-dates the reference's
+        preview dependency — parity-plus): available permits, lifetime
+        successful/failed leases, and the current queued count. Backed by
+        the limiter's :class:`~..utils.metrics.LimiterMetrics` (every
+        concrete family records decisions there) and the waiter queue
+        when the family has one."""
+        metrics = getattr(self, "metrics", None)
+        queue = getattr(self, "_queue", None)
+        return RateLimiterStatistics(
+            current_available_permits=self.available_permits(),
+            total_successful_leases=(metrics.grants if metrics else 0),
+            total_failed_leases=(metrics.denials if metrics else 0),
+            current_queued_count=(len(queue) if queue is not None
+                                  and hasattr(queue, "__len__") else 0),
+        )
 
     @abc.abstractmethod
     async def aclose(self) -> None:

@@ -549,3 +549,41 @@ class TestSyncOnlyRefresh:
         clock.advance_seconds(1.0)  # global decays 10 → 0
         assert lim.acquire(1).is_acquired  # replenished without any loop
         assert lim.metrics.syncs >= 2
+
+
+class TestStatistics:
+    def test_get_statistics_counts_and_queue(self):
+        # ≙ the modern .NET RateLimiter.GetStatistics() (parity-plus):
+        # lifetime grant/denial counts, availability estimate, queued.
+        import asyncio
+
+        from distributedratelimiting.redis_tpu.models.approximate import (
+            ApproximateTokenBucketRateLimiter,
+        )
+        from distributedratelimiting.redis_tpu.models.base import (
+            RateLimiterStatistics,
+        )
+        from distributedratelimiting.redis_tpu.models.options import (
+            ApproximateTokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.runtime.clock import (
+            ManualClock,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        lim = ApproximateTokenBucketRateLimiter(
+            ApproximateTokenBucketOptions(
+                token_limit=3, tokens_per_period=1,
+                replenishment_period_s=3600.0, instance_name="stats"),
+            InProcessBucketStore(clock=ManualClock()))
+        for _ in range(5):
+            lim.acquire(1)
+        stats = lim.get_statistics()
+        assert isinstance(stats, RateLimiterStatistics)
+        assert stats.total_successful_leases == 3
+        assert stats.total_failed_leases == 2
+        assert stats.current_available_permits == 0
+        assert stats.current_queued_count == 0
+        asyncio.run(lim.aclose())
