@@ -587,3 +587,40 @@ class TestStatistics:
         assert stats.current_available_permits == 0
         assert stats.current_queued_count == 0
         asyncio.run(lim.aclose())
+
+    def test_get_statistics_reports_queued_waiters(self):
+        import asyncio
+
+        from distributedratelimiting.redis_tpu.models.approximate import (
+            ApproximateTokenBucketRateLimiter,
+        )
+        from distributedratelimiting.redis_tpu.models.options import (
+            ApproximateTokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.runtime.clock import (
+            ManualClock,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        async def main():
+            lim = ApproximateTokenBucketRateLimiter(
+                ApproximateTokenBucketOptions(
+                    token_limit=1, tokens_per_period=1,
+                    replenishment_period_s=3600.0, queue_limit=4,
+                    instance_name="qstats"),
+                InProcessBucketStore(clock=ManualClock()))
+            assert lim.acquire(1).is_acquired
+            waiter = asyncio.ensure_future(lim.acquire_async(1))
+            await asyncio.sleep(0)  # parks on the waiter queue
+            assert lim.get_statistics().current_queued_count == 1
+            waiter.cancel()
+            try:
+                await waiter
+            except asyncio.CancelledError:
+                pass
+            assert lim.get_statistics().current_queued_count == 0
+            await lim.aclose()
+
+        asyncio.run(main())
