@@ -39,6 +39,7 @@ from distributedratelimiting.redis_tpu.parallel.sharded_store import (
     init_global_counter,
 )
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.utils.metrics import StoreMetrics
 from distributedratelimiting.redis_tpu.runtime.store import (
     BulkAcquireResult,
     _grant_zero_probes,
@@ -225,8 +226,10 @@ class ShardedFpDeviceStore:
         self.rounds = rounds
         self.clock = clock or MonotonicClock()
         self.auto_grow = auto_grow
+        self.metrics = StoreMetrics()
         self.fp_unresolved = 0
         self.grows = 0
+        self._peek_step = None
 
         fp_shard = NamedSharding(mesh, P(SHARD_AXIS, None))
         n = per_shard_slots * self.n_shards
@@ -347,6 +350,8 @@ class ShardedFpDeviceStore:
                     kpairs.reshape(self.n_shards, k, b, 2),
                     cts.reshape(self.n_shards, k, b),
                     val.reshape(self.n_shards, k, b), nows)
+                self.metrics.record_launch(self.n_shards * k * b,
+                                           int(val.sum()))
                 g_np = np.asarray(g_d).reshape(self.n_shards, -1)
                 r_np = np.asarray(r_d).reshape(self.n_shards, -1)
                 res_np = np.asarray(res_d).reshape(self.n_shards, -1)
@@ -357,6 +362,7 @@ class ShardedFpDeviceStore:
                     call_pressure += int((~res_np[s, :m]).sum())
                 pos += take
             self.fp_unresolved += call_pressure
+            self.metrics.fp_unresolved += call_pressure
             if call_pressure and self.auto_grow:
                 # Deny-and-heal (single-chip discipline, both clauses —
                 # see _FpTable._relieve_pressure): sweep, then grow when
@@ -442,6 +448,7 @@ class ShardedFpDeviceStore:
         self.fp, self.state = fp, state
         self.per_shard_slots = per_new
         self.grows += 1
+        self.metrics.pregrows += 1
 
     def sweep(self) -> int:
         """Elementwise TTL sweep across every shard — the single-chip
@@ -458,7 +465,146 @@ class ShardedFpDeviceStore:
         self.fp, self.state, n_freed = F.fp_sweep_expired(
             self.fp, self.state, jnp.int32(now),
             jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
-        return int(np.asarray(n_freed))
+        freed = int(np.asarray(n_freed))
+        self.metrics.sweeps += 1
+        self.metrics.slots_evicted += freed
+        return freed
+
+    # -- per-request flush surface (the mesh front-end's batcher) ----------
+    def acquire_batch_blocking(
+            self, requests: "Sequence[tuple[str, int]]"
+    ) -> "list[AcquireResult]":
+        """Decide a batch of ``(key, count)`` requests in one bulk call;
+        results in request order (same in-call duplicate conservatism as
+        :meth:`acquire_many_blocking`)."""
+        return list(self.acquire_many_blocking(
+            [k for k, _ in requests], [c for _, c in requests]))
+
+    def peek_blocking(self, key: str) -> float:
+        """Read-only availability estimate — shard-local lookup WITHOUT
+        insert (peeking at an unseen key must not claim a slot)."""
+        from distributedratelimiting.redis_tpu.runtime.fp_store import (
+            fingerprints,
+        )
+
+        if self._peek_step is None:
+            self._peek_step = _make_sharded_fp_peek_step(
+                self.mesh, probe_window=self.probe_window)
+        fp1 = fingerprints([key])[0]
+        shard = int(fp1[0] % np.uint32(self.n_shards))
+        kpair = np.zeros((self.n_shards, 8, 2), np.uint32)
+        valid = np.zeros((self.n_shards, 8), bool)
+        kpair[shard, 0] = fp1
+        valid[shard, 0] = True
+        with self._lock:
+            now = self.now_ticks_checked()
+            est = self._peek_step(
+                self.fp, self.state, jnp.asarray(kpair),
+                jnp.asarray(valid), jnp.int32(now),
+                jnp.float32(self.capacity), jnp.float32(self.rate_per_tick))
+        return float(np.asarray(est)[shard, 0])
+
+    # -- checkpoint --------------------------------------------------------
+    def _config_snap(self) -> dict:
+        return {"capacity": self.capacity,
+                "rate_per_tick": self.rate_per_tick}
+
+    def _check_config_snap(self, snap: dict) -> None:
+        want = self._config_snap()
+        got = {k: snap.get(k) for k in want}
+        if got != want:
+            raise ValueError(
+                f"snapshot config {got} != store config {want} — a "
+                "fingerprint snapshot restores only into a same-config "
+                "store")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {
+                "now_ticks": self.clock.now_ticks(),
+                "n_shards": self.n_shards,
+                "per_shard": self.per_shard_slots,
+                "probe_window": self.probe_window,
+                "fp": np.asarray(self.fp),
+                "gcounter": {
+                    "value": float(np.asarray(self.gcounter.value)),
+                    "period": float(np.asarray(self.gcounter.period)),
+                    "last_ts": int(np.asarray(self.gcounter.last_ts)),
+                    "exists": bool(np.asarray(self.gcounter.exists)),
+                },
+            }
+            snap.update(self._config_snap())
+            for f in type(self.state)._fields:
+                snap[f] = np.asarray(getattr(self.state, f))
+            return snap
+
+    def restore(self, snap: dict) -> None:
+        from distributedratelimiting.redis_tpu.runtime.store import _shift_ts
+
+        with self._lock:
+            if "fp" not in snap:
+                raise ValueError(
+                    "snapshot's tables use the host key directory — "
+                    "restore into the host-directory sharded store")
+            if snap["n_shards"] != self.n_shards:
+                raise ValueError(
+                    f"snapshot shard count {snap['n_shards']} != "
+                    f"store {self.n_shards} (fingerprints route by "
+                    "fp % n_shards — re-sharding is key redistribution)")
+            self._check_config_snap(snap)
+            shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
+            self.per_shard_slots = int(snap["per_shard"])
+            new_pw = int(snap.get("probe_window", self.probe_window))
+            if new_pw != self.probe_window:
+                # The jitted steps bake probe_window in at construction;
+                # entries placed deep in a wider window would be
+                # invisible to a narrower scan.
+                self.probe_window = new_pw
+                self._step = self._make_step()
+                self._peek_step = None
+            g = snap.get("gcounter")
+            if g is not None:
+                self.gcounter = jax.device_put(GlobalCounter(
+                    value=jnp.float32(g["value"]),
+                    period=jnp.float32(g["period"]),
+                    last_ts=jnp.int32(max(0, g["last_ts"] + shift)),
+                    exists=jnp.asarray(g["exists"])),
+                    NamedSharding(self.mesh, P()))
+            fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+            shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+            self.fp = jax.device_put(jnp.asarray(snap["fp"]), fp_shard)
+            cls = type(self.state)
+            cols = []
+            for f in cls._fields:
+                a = snap[f]
+                if f == "last_ts":
+                    a = _shift_ts(a, shift)
+                elif f == "window_idx":
+                    a = _shift_ts(a, shift // self.window_ticks)
+                cols.append(jax.device_put(jnp.asarray(a), shard))
+            self.state = cls(*cols)
+
+
+def _make_sharded_fp_peek_step(mesh, *, probe_window: int):
+    """Shard-local read-only lookup: the key's fingerprint sits in ITS
+    shard's batch row; every shard probes its own slice (wrong-shard rows
+    are invalid ⇒ 0)."""
+    fp_spec = P(SHARD_AXIS, None)
+    state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    kpair_spec = P(SHARD_AXIS, None, None)
+    row_spec = P(SHARD_AXIS, None)
+
+    def block(fp, state, kpair, valid, now, capacity, rate):
+        est = F.fp_peek_batch(fp, state, kpair[0], valid[0], now, capacity,
+                              rate, probe_window=probe_window)
+        return est[None]
+
+    mapped = shard_map(
+        block, mesh=mesh,
+        in_specs=(fp_spec, state_specs, kpair_spec, row_spec, P(), P(), P()),
+        out_specs=row_spec,
+    )
+    return jax.jit(mapped)
 
 
 class ShardedFpWindowStore(ShardedFpDeviceStore):
@@ -495,12 +641,24 @@ class ShardedFpWindowStore(ShardedFpDeviceStore):
             jnp.int32(self.window_ticks))
         return g_d, r_d, res_d
 
+    def peek_blocking(self, key: str) -> float:
+        raise NotImplementedError(
+            "window tables expose no peek (matching the single-chip "
+            "window tiers)")
+
+    def _config_snap(self) -> dict:
+        return {"limit": self.limit, "window_ticks": self.window_ticks,
+                "fixed": self.fixed}
+
     def _sweep_locked(self) -> int:
         now = self.now_ticks_checked()  # before the args (rebase hazard)
         self.fp, self.state, n_freed = F.fp_sweep_windows(
             self.fp, self.state, jnp.int32(now),
             jnp.int32(self.window_ticks))
-        return int(np.asarray(n_freed))
+        freed = int(np.asarray(n_freed))
+        self.metrics.sweeps += 1
+        self.metrics.slots_evicted += freed
+        return freed
 
     def force_rebase(self, offset: int) -> None:
         with self._lock:

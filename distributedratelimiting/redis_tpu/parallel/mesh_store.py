@@ -105,7 +105,16 @@ class MeshBucketStore(BucketStore):
         max_delay_s: float = 200e-6,
         max_inflight: int = 8,
         aux_slots: int = 2**14,
+        directory: str = "host",
     ) -> None:
+        if directory not in ("host", "fp"):
+            raise ValueError("directory must be 'host' or 'fp'")
+        # Key-directory home for the sharded keyed tiers (buckets +
+        # windows): "host" = per-shard native C tables; "fp" = the
+        # device-resident fingerprint directory (docs/OPERATIONS.md §2).
+        # Aux tiers (counters/semaphores) keep the host directory either
+        # way — their cardinality is per-limiter.
+        self.directory = directory
         self.mesh = mesh if mesh is not None else create_mesh(
             len(jax.devices()))
         self.clock = clock or MonotonicClock()
@@ -202,7 +211,15 @@ class MeshBucketStore(BucketStore):
         with self._registry_lock:  # event loop + blocking threads race here
             store = self._shards.get(key)
             if store is None:
-                store = ShardedDeviceStore(
+                if self.directory == "fp":
+                    from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+                        ShardedFpDeviceStore,
+                    )
+
+                    cls = ShardedFpDeviceStore
+                else:
+                    cls = ShardedDeviceStore
+                store = cls(
                     self.mesh, capacity=capacity,
                     fill_rate_per_sec=fill_rate_per_sec,
                     per_shard_slots=self.per_shard_slots, clock=self.clock,
@@ -267,7 +284,15 @@ class MeshBucketStore(BucketStore):
         with self._registry_lock:
             store = self._windows.get(key)
             if store is None:
-                store = ShardedWindowStore(
+                if self.directory == "fp":
+                    from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+                        ShardedFpWindowStore,
+                    )
+
+                    wcls = ShardedFpWindowStore
+                else:
+                    wcls = ShardedWindowStore
+                store = wcls(
                     self.mesh, limit=limit, window_sec=window_sec,
                     fixed=fixed, per_shard_slots=self.per_shard_slots,
                     clock=self.clock,
@@ -443,6 +468,14 @@ class MeshBucketStore(BucketStore):
 
         from distributedratelimiting.redis_tpu.ops import bucket_math as bm
 
+        if self._aux._wtables and self.directory == "fp":
+            # The migration scatters into host-directory slots; the fp
+            # tier has no host directory to scatter into. Refuse BEFORE
+            # touching the aux tables so nothing is lost.
+            raise ValueError(
+                "legacy snapshot holds aux-tier window tables; restore it "
+                "into a directory='host' mesh store (its windows then "
+                "re-checkpoint in the sharded form)")
         for key3 in list(self._aux._wtables):
             limit, wticks, fixed = key3
             table = self._aux._wtables[key3]

@@ -367,7 +367,7 @@ def main(argv: list[str] | None = None) -> None:
                         "slots (mesh backend)")
     parser.add_argument("--directory", choices=("host", "fp"),
                         default="host",
-                        help="key-directory home for the device backend: "
+                        help="key-directory home for the device and mesh backends: "
                         "host = native C++ host table (default); fp = "
                         "device-resident fingerprint directory (in-kernel "
                         "probe/insert — see docs/OPERATIONS.md §2)")
@@ -403,7 +403,8 @@ def main(argv: list[str] | None = None) -> None:
                 MeshBucketStore,
             )
 
-            store = MeshBucketStore(per_shard_slots=args.slots)
+            store = MeshBucketStore(per_shard_slots=args.slots,
+                                    directory=args.directory)
         else:
             from distributedratelimiting.redis_tpu.runtime.store import (
                 InProcessBucketStore,

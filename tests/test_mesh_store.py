@@ -222,3 +222,56 @@ class TestAuxOnlyRebase:
         store.window_acquire_blocking("w", 1, 3.0, 1.0)  # triggers rebase
         assert clock.now_ticks() < 2**30
         assert store.window_acquire_blocking("w", 2, 3.0, 1.0).granted
+
+
+class TestFpDirectoryMesh:
+    def test_mesh_store_with_fp_directory(self):
+        # The full store surface over a mesh with the device-resident
+        # directory for buckets AND windows (aux tiers keep the host
+        # directory) — drop-in via directory="fp".
+        import asyncio
+
+        from distributedratelimiting.redis_tpu.parallel.fp_sharded import (
+            ShardedFpDeviceStore,
+            ShardedFpWindowStore,
+        )
+
+        async def main():
+            clock = ManualClock()
+            store = MeshBucketStore(per_shard_slots=256, clock=clock,
+                                    directory="fp")
+            # Buckets: capacity + refill through the fp tier.
+            got = [(await store.acquire("k", 1, 3.0, 1.0)).granted
+                   for _ in range(5)]
+            assert got == [True] * 3 + [False] * 2
+            clock.advance_seconds(2.0)
+            assert (await store.acquire("k", 2, 3.0, 1.0)).granted
+            assert isinstance(store._shards[(3.0, 1.0)],
+                              ShardedFpDeviceStore)
+            # Bulk across shards.
+            res = await store.acquire_many(
+                [f"b{i}" for i in range(64)], [1] * 64, 5.0, 1.0)
+            assert res.granted.all()
+            # Windows ride the fp tier too.
+            assert (await store.window_acquire("w", 2, 3.0, 10.0)).granted
+            assert not (await store.window_acquire("w", 2, 3.0, 10.0)).granted
+            assert any(isinstance(w, ShardedFpWindowStore)
+                       for w in store._windows.values())
+            # Peek doesn't insert; aux tiers (counters) still work.
+            assert store.peek_blocking("ghost", 9.0, 1.0) == 9.0
+            r = await store.sync_counter("c", 5.0, 0.0)
+            assert r.global_score == pytest.approx(5.0)
+            # Checkpoint round-trips through the fp snapshot form.
+            snap = store.snapshot()
+            fresh = MeshBucketStore(per_shard_slots=256,
+                                    clock=ManualClock(), directory="fp")
+            fresh.restore(snap)
+            assert not (await fresh.acquire("k", 3, 3.0, 1.0)).granted
+            await store.aclose()
+            await fresh.aclose()
+
+        asyncio.run(main())
+
+    def test_bad_directory_rejected(self):
+        with pytest.raises(ValueError, match="directory"):
+            MeshBucketStore(directory="cuckoo")
