@@ -5,8 +5,21 @@ v5e-8 with p99 acquire < 2ms, i.e. >= 6.25M decisions/sec/chip.
 ``vs_baseline`` is measured throughput / 6.25M (the per-chip north-star
 share — the reference itself publishes no numbers, BASELINE.md).
 
-Prints ONE JSON line. Extra keys carry secondary measurements (single-batch
-dispatch rate, end-to-end asyncio path, p99) without changing the schema.
+Emission contract (the r04 lesson, VERDICT.md round 4 #1): the bench
+prints the FULL result JSON after *every* completed section with
+``"partial": true`` — the driver's tail capture parses the LAST JSON
+line, so a timeout/wedge mid-run still leaves every finished metric on
+record. The final line has ``"partial": false``. A global wall-clock
+budget (``BENCH_BUDGET_S``, default 1200s) bounds the whole run: when it
+runs out, remaining sections are marked ``skipped_budget`` and the bench
+exits 0 with what it has. The device is NEVER initialised in this
+process until a disposable-child probe has seen a healthy init window
+(``BENCH_PROBE_S``); if no window appears, device sections are marked
+``skipped_unhealthy_device`` and the CPU stand-in sections still run.
+Each device section runs on a timeout-guarded daemon thread so a tunnel
+wedge mid-run costs one section, not the whole evidence pipeline.
+Kill-test hooks: ``BENCH_SIM_WEDGE=1`` makes the probe child hang;
+``BENCH_SIM_HANG_SECTION=<name>`` wedges one named section.
 
 Method (headline): steady-state device throughput of the batched
 refill-and-decrement kernel over a 10M-slot HBM table — batches of 8K
@@ -28,7 +41,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -479,7 +494,7 @@ async def bench_serving_p99(store_mod):
             # Warm (compile + connect), then reset the histogram so the
             # p99 reflects steady state, not the first compile.
             await asyncio.gather(*(worker(w, 10) for w in range(64)))
-            srv.serving_latency.__init__()
+            srv.serving_latency.reset()
             await asyncio.gather(*(worker(w, 160) for w in range(64)))
             stats = await store.stats()
         finally:
@@ -489,13 +504,17 @@ async def bench_serving_p99(store_mod):
             stats["serving_samples"])
 
 
-def bench_serving_p99_cpu() -> tuple[float, float, int] | None:
-    """Run the same serving-p99 probe in a CPU-platform child process:
-    the co-located-device stand-in (device round trip µs-class), isolating
-    the framework's own serving overhead for the <2ms north star."""
-    import os
+def bench_serving_p99_cpu(timeout_s: float = 600.0) -> dict | None:
+    """Co-located-device stand-in for the <2ms serving north star, now a
+    TWO-process rig (VERDICT r4 #3b): the server child owns the store +
+    kernel on its own core; a separate load child drives closed-loop
+    per-request traffic at depths 4/16/64. The p99 is the SERVER's own
+    arrival→ready histogram over a post-warmup window (stats reset flag),
+    so client-side Python scheduling no longer pollutes the number the
+    way the old single-process probe did. Returns the per-depth dict, or
+    None if either child failed."""
+    import concurrent.futures
     import subprocess
-    import sys
 
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         FORCE_CPU_ENV,
@@ -503,33 +522,111 @@ def bench_serving_p99_cpu() -> tuple[float, float, int] | None:
 
     env = os.environ.copy()
     env[FORCE_CPU_ENV] = "1"
+    deadline = time.monotonic() + timeout_s
+    server = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serving-server-child"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
+    # No `with` around the executor: its shutdown joins the reader thread,
+    # which only returns at EOF — a child that never prints would turn the
+    # timeout below into a circular wait. The finally's kill/close EOFs
+    # the pipe, so the parked thread always unblocks before process exit.
+    pool = concurrent.futures.ThreadPoolExecutor(1)
     try:
-        proc = subprocess.run(
+        line = pool.submit(server.stdout.readline).result(
+            timeout=min(120.0, timeout_s))
+        addr = json.loads(line)
+        load = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--serving-p99-child"],
-            env=env, capture_output=True, timeout=600, text=True)
-        if proc.returncode != 0:
+             "--serving-load-child", addr["host"], str(addr["port"])],
+            env=env, capture_output=True, text=True,
+            timeout=max(deadline - time.monotonic(), 30.0))
+        if load.returncode != 0:
             return None
-        line = proc.stdout.strip().splitlines()[-1]
-        out = json.loads(line)
-        return out["p99_ms"], out["p50_ms"], out["samples"]
+        return json.loads(load.stdout.strip().splitlines()[-1])
     except Exception:  # child hung/died: skip the co-located stand-in
         return None
+    finally:
+        try:
+            server.stdin.close()  # the server child parks on stdin EOF
+            server.wait(timeout=10)
+        except Exception:
+            server.kill()
+        pool.shutdown(wait=False)
 
 
-def _serving_p99_child() -> None:
+def _serving_server_child() -> None:
+    """Server half of the co-located stand-in: owns the (CPU-platform)
+    device store and its kernel; parks until the parent closes stdin."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
 
     maybe_force_cpu_from_env()
     from distributedratelimiting.redis_tpu.runtime import store as store_mod
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
 
-    p99, p50, n = asyncio.run(bench_serving_p99(store_mod))
-    print(json.dumps({"p99_ms": p99, "p50_ms": p50, "samples": n}))
+    async def run() -> None:
+        backing = store_mod.DeviceBucketStore(
+            n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6,
+            max_inflight=16)
+        async with BucketStoreServer(backing) as srv:
+            print(json.dumps({"host": srv.host, "port": srv.port}),
+                  flush=True)
+            await asyncio.get_running_loop().run_in_executor(
+                None, sys.stdin.read)
+        await backing.aclose()
+
+    asyncio.run(run())
 
 
-def bench_e2e_async_nproc_cpu() -> tuple[float, int]:
+def _serving_load_child(host: str, port: str) -> None:
+    """Load half: closed-loop per-request acquires at a depth sweep; each
+    depth's window is warm → stats(reset) → ≥10K measured samples →
+    stats. Reports the server-side serving histogram AND the store's
+    flush histogram (dispatch+kernel+readback) so serving p99 decomposes
+    into device-side floor vs framework queueing."""
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+
+    async def run() -> None:
+        store = RemoteBucketStore(address=(host, int(port)),
+                                  coalesce_requests=False)
+        out: dict = {}
+
+        async def worker(w: int, reqs: int) -> None:
+            for j in range(reqs):
+                await store.acquire(f"user{(w * 11 + j) % 10000}", 1,
+                                    10_000_000.0, 10_000_000.0)
+
+        for depth in (4, 16, 64):
+            await asyncio.gather(*(worker(w, 40) for w in range(depth)))
+            await store.stats(reset=True)
+            reqs = max(10240 // depth, 160)
+            await asyncio.gather(*(worker(w, reqs) for w in range(depth)))
+            stats = await store.stats()
+            flush = stats.get("store", {})
+            out[f"d{depth}"] = {
+                "p99_ms": stats["serving_p99_ms"],
+                "p50_ms": stats["serving_p50_ms"],
+                "samples": stats["serving_samples"],
+                "flush_p99_ms": flush.get("flush_p99_ms"),
+                "flush_p50_ms": flush.get("flush_p50_ms"),
+            }
+        await store.aclose()
+        print(json.dumps(out), flush=True)
+
+    asyncio.run(run())
+
+
+def bench_e2e_async_nproc_cpu(timeout_s: float = 600.0) -> tuple[float, int]:
     """Run the N-process scaling bench with a CPU-platform server child.
 
     The metric is per-request PYTHON/SOCKET scaling across processes —
@@ -555,7 +652,7 @@ def bench_e2e_async_nproc_cpu() -> tuple[float, int]:
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--nproc-child"],
-            env=env, capture_output=True, timeout=600, text=True)
+            env=env, capture_output=True, timeout=timeout_s, text=True)
         if proc.returncode != 0:
             return 0.0, 0
         out = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -576,8 +673,159 @@ def _nproc_child() -> None:
     print(json.dumps({"rate": rate, "clients": len(rates)}))
 
 
-def main():
-    import jax
+# --------------------------------------------------------------------------
+# Orchestration: incremental, budget-bounded, hang-tolerant (r04 post-mortem:
+# one JSON at the end of main() + a 10-min probe + an unguarded
+# jax.devices() produced ZERO bytes of evidence when the tunnel flapped).
+# --------------------------------------------------------------------------
+
+_T0 = time.monotonic()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
+PROBE_S = float(os.environ.get("BENCH_PROBE_S", "240"))
+SECTION_TIMEOUT_S = float(os.environ.get("BENCH_SECTION_TIMEOUT_S", "420"))
+SIM_WEDGE = os.environ.get("BENCH_SIM_WEDGE") == "1"
+SIM_HANG_SECTION = os.environ.get("BENCH_SIM_HANG_SECTION", "")
+
+RESULT: dict = {
+    "metric": "permit_decisions_per_sec_per_chip",
+    "value": None,
+    "unit": "decisions/s",
+    "vs_baseline": None,
+    "platform": None,
+    "n_keys": N_SLOTS,
+    "batch": BATCH,
+    "scan_depth": SCAN_K,
+    "link_upload_mb_per_s": None,
+    "compact_path_decisions_per_sec": None,
+    "single_batch_decisions_per_sec": None,
+    "e2e_bulk_decisions_per_sec": None,
+    "e2e_bulk_with_remaining_decisions_per_sec": None,
+    "e2e_fp_bulk_decisions_per_sec": None,
+    "e2e_remote_bulk_decisions_per_sec": None,
+    "e2e_async_decisions_per_sec": None,
+    "e2e_async_nproc_decisions_per_sec": None,
+    "e2e_async_nproc_clients": None,
+    "e2e_p99_low_load_ms": None,
+    "serving_p99_ms": None,
+    "serving_p50_ms": None,
+    "serving_p99_samples": None,
+    # Co-located-device stand-in (two CPU-platform children, server and
+    # load on separate cores): the framework's own serving overhead, the
+    # number the <2ms north star bounds. Headline keys are the depth-64
+    # window; d4/d16 plus the flush histogram (device dispatch + kernel +
+    # readback) give the queueing-vs-kernel decomposition.
+    "serving_p99_colocated_ms": None,
+    "serving_p50_colocated_ms": None,
+    "serving_p99_colocated_d4_ms": None,
+    "serving_p99_colocated_d16_ms": None,
+    "flush_p99_colocated_ms": None,
+    "flush_p50_colocated_ms": None,
+    "pallas_sweep_ok": None,
+    "device_probe": None,
+    "budget_s": BUDGET_S,
+    "elapsed_s": 0.0,
+    "section_status": {},
+    "partial": True,
+}
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T0)
+
+
+def _emit() -> None:
+    """Print the full result JSON as one line; the driver's tail capture
+    parses the LAST line, so every call supersedes the previous one."""
+    RESULT["elapsed_s"] = round(time.monotonic() - _T0, 1)
+    print(json.dumps(RESULT), flush=True)
+
+
+def _section(name: str, fn, timeout_s: float | None = None):
+    """Run one bench section on a timeout-guarded daemon thread.
+
+    Returns (status, value): status is "ok" | "hung" | "skipped_budget" |
+    "error". A hung section leaves its thread parked (it cannot be
+    cancelled mid-device-op) but the orchestrator moves on and the final
+    exit path uses os._exit so a parked thread cannot block process exit.
+    Always emits the partial JSON before returning.
+    """
+    if _remaining() < 20.0:
+        RESULT["section_status"][name] = "skipped_budget"
+        _emit()
+        return "skipped_budget", None
+    if SIM_HANG_SECTION == name:
+        fn = lambda: time.sleep(1e6)  # noqa: E731 — kill-test hook
+    timeout = min(timeout_s or SECTION_TIMEOUT_S, max(_remaining(), 20.0))
+    box: dict = {}
+
+    def target():
+        try:
+            box["v"] = fn()
+        except BaseException as e:  # noqa: BLE001 — a section must never
+            box["e"] = f"{type(e).__name__}: {e}"  # take down the bench
+    th = threading.Thread(target=target, daemon=True, name=f"bench-{name}")
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        print(f"bench: section {name} hung (> {timeout:.0f}s)",
+              file=sys.stderr, flush=True)
+        RESULT["section_status"][name] = "hung"
+        _emit()
+        return "hung", None
+    if "e" in box:
+        print(f"bench: section {name} failed: {box['e']}",
+              file=sys.stderr, flush=True)
+        RESULT["section_status"][name] = f"error: {box['e'][:200]}"
+        _emit()
+        return "error", None
+    RESULT["section_status"][name] = "ok"
+    _emit()
+    return "ok", box.get("v")
+
+
+def _probe_device(max_wait_s: float) -> str | None:
+    """Look for a healthy device-init window WITHOUT initialising the
+    backend in this process: each probe is a disposable child with a
+    60s timeout (a hung init in the committed process is unrecoverable —
+    the exact r04 wedge). Returns the device platform string, or None if
+    no healthy window appeared (deterministic init errors also return
+    None: retrying cannot fix a bad install, and proceeding to init
+    in-process is exactly what r04 proved fatal)."""
+    import subprocess
+
+    code = ("import time; time.sleep(1e6)" if SIM_WEDGE
+            else "import jax; print(jax.devices()[0].platform)")
+    deadline = time.monotonic() + max_wait_s
+    attempt = 0
+    while True:
+        attempt += 1
+        child_timeout = min(60.0, max(deadline - time.monotonic(), 5.0))
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], timeout=child_timeout,
+                capture_output=True, text=True, env=os.environ.copy())
+            if r.returncode == 0:
+                return r.stdout.strip().splitlines()[-1]
+            err = (r.stderr or "").strip()[-400:]
+            print("bench: device init fails deterministically; device "
+                  f"sections will be skipped. Child stderr tail: {err}",
+                  file=sys.stderr, flush=True)
+            RESULT["device_probe_error"] = err[-200:]
+            return None
+        except subprocess.TimeoutExpired:
+            print(f"bench: device init window unhealthy "
+                  f"(probe {attempt} timed out)", file=sys.stderr, flush=True)
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(5)
+
+
+def _run_device_sections() -> bool:
+    """Run every device-touching section in order, sharing kernel state.
+    Returns True if any section hung (tunnel wedged — caller must use
+    os._exit so the parked thread can't block exit). After a hang, the
+    remaining device sections are skipped: the tunnel serialises device
+    work, so a wedged fetch poisons every later dispatch."""
     import jax.numpy as jnp
 
     from distributedratelimiting.redis_tpu.models import partitioned
@@ -586,96 +834,165 @@ def main():
     from distributedratelimiting.redis_tpu.runtime import store as store_mod
     from distributedratelimiting.redis_tpu.runtime.clock import MonotonicClock
 
-    platform = jax.devices()[0].platform
     clock = MonotonicClock()
+    ctx: dict = {}
+    wedged = False
 
-    link_mb_s = bench_link_probe(jnp)
-    throughput, state = bench_kernel_throughput(jnp, K, clock)
-    compact, state = bench_compact_throughput(jnp, K, clock, state)
-    single = bench_single_batch(jnp, K, clock, state)
-    del state  # free the 10M-slot table before the serving-path stores
-    bulk_rate, bulk_with_rem = asyncio.run(
-        bench_e2e_bulk(store_mod, partitioned, options_mod))
-    fp_bulk = asyncio.run(bench_fp_bulk())
-    remote_bulk = asyncio.run(bench_e2e_remote_bulk(store_mod))
-    e2e_rate, p99 = asyncio.run(
-        bench_e2e_async(store_mod, partitioned, options_mod))
-    nproc_rate, nproc_clients = bench_e2e_async_nproc_cpu()
-    serving_p99, serving_p50, serving_n = asyncio.run(
-        bench_serving_p99(store_mod))
-    cpu_serving = bench_serving_p99_cpu()
-    pallas_ok = bench_pallas_sweep(store_mod) if platform == "tpu" else None
-
-    print(json.dumps({
-        "metric": "permit_decisions_per_sec_per_chip",
-        "value": round(throughput),
-        "unit": "decisions/s",
-        "vs_baseline": round(throughput / NORTH_STAR_PER_CHIP, 3),
-        "platform": platform,
-        "n_keys": N_SLOTS,
-        "batch": BATCH,
-        "scan_depth": SCAN_K,
-        "link_upload_mb_per_s": round(link_mb_s, 1),
-        "compact_path_decisions_per_sec": round(compact),
-        "single_batch_decisions_per_sec": round(single),
-        "e2e_bulk_decisions_per_sec": round(bulk_rate),
-        "e2e_bulk_with_remaining_decisions_per_sec": round(bulk_with_rem),
-        "e2e_fp_bulk_decisions_per_sec": round(fp_bulk),
-        "e2e_remote_bulk_decisions_per_sec": round(remote_bulk),
-        "e2e_async_decisions_per_sec": round(e2e_rate),
-        "e2e_async_nproc_decisions_per_sec": round(nproc_rate),
-        "e2e_async_nproc_clients": nproc_clients,
-        "e2e_p99_low_load_ms": round(p99 * 1e3, 3),
-        "serving_p99_ms": round(serving_p99, 3),
-        "serving_p50_ms": round(serving_p50, 3),
-        "serving_p99_samples": serving_n,
-        # Co-located-device stand-in (CPU platform child): the framework's
-        # own serving overhead, the number the <2ms north star bounds.
-        "serving_p99_colocated_ms": (None if cpu_serving is None
-                                     else round(cpu_serving[0], 3)),
-        "serving_p50_colocated_ms": (None if cpu_serving is None
-                                     else round(cpu_serving[1], 3)),
-        "pallas_sweep_ok": pallas_ok,
-    }))
-
-
-def _await_backend_window(max_wait_s: float = 600.0) -> None:
-    """Wait for a healthy device-init window before committing this
-    process to backend init. On the tunneled-TPU rig, init hangs
-    *forever* in some windows and succeeds in 0.1s in others (flapping
-    minute to minute, observed r04); a hung init in THIS process is
-    unrecoverable, so each probe runs in a disposable child with a
-    timeout. Proceeds after ``max_wait_s`` regardless — the probe is
-    best-effort protection, not a gate."""
-    import os
-    import subprocess
-    import time as _time
-
-    deadline = _time.monotonic() + max_wait_s
-    while _time.monotonic() < deadline:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=60, capture_output=True, env=os.environ.copy())
-            if r.returncode == 0:
-                return
-            # Deterministic failure (bad install/config), not a hang:
-            # retrying would stall 10 minutes to fail the same way.
-            print("bench: device init fails outright; proceeding to the "
-                  "real error", file=sys.stderr, flush=True)
+    def run(name, fn, keys, timeout_s=None):
+        nonlocal wedged
+        if wedged:
+            RESULT["section_status"][name] = "skipped_after_hang"
+            _emit()
             return
-        except subprocess.TimeoutExpired:
-            pass
-        print("bench: device init window unhealthy; retrying...",
-              file=sys.stderr, flush=True)
-        _time.sleep(10)
-    print("bench: no healthy init window found; proceeding anyway",
-          file=sys.stderr, flush=True)
+        status, value = _section(name, fn, timeout_s)
+        if status == "hung":
+            wedged = True
+        elif status == "ok" and keys:
+            vals = value if isinstance(value, tuple) else (value,)
+            for k, v in zip(keys, vals):
+                RESULT[k] = v
+            _emit()  # _section's emit predates the stores: re-emit so the
+            # tail never shows this section "ok" with its metrics null
+
+    def sec_link():
+        return round(bench_link_probe(jnp), 1)
+
+    def sec_headline():
+        rate, state = bench_kernel_throughput(jnp, K, clock)
+        ctx["state"] = state
+        RESULT["vs_baseline"] = round(rate / NORTH_STAR_PER_CHIP, 3)
+        return round(rate)
+
+    def sec_compact():
+        rate, state = bench_compact_throughput(jnp, K, clock, ctx["state"])
+        ctx["state"] = state
+        return round(rate)
+
+    def sec_single():
+        rate = bench_single_batch(jnp, K, clock, ctx["state"])
+        del ctx["state"]  # free the 10M-slot table before serving stores
+        return round(rate)
+
+    def sec_bulk():
+        a, b = asyncio.run(bench_e2e_bulk(store_mod, partitioned,
+                                          options_mod))
+        return round(a), round(b)
+
+    def sec_fp_bulk():
+        return round(asyncio.run(bench_fp_bulk()))
+
+    def sec_remote_bulk():
+        return round(asyncio.run(bench_e2e_remote_bulk(store_mod)))
+
+    def sec_e2e_async():
+        rate, p99 = asyncio.run(
+            bench_e2e_async(store_mod, partitioned, options_mod))
+        return round(rate), round(p99 * 1e3, 3)
+
+    def sec_serving_p99():
+        p99, p50, n = asyncio.run(bench_serving_p99(store_mod))
+        return round(p99, 3), round(p50, 3), n
+
+    def sec_pallas():
+        return bench_pallas_sweep(store_mod)
+
+    run("link_probe", sec_link, ["link_upload_mb_per_s"], timeout_s=120)
+    run("headline", sec_headline, ["value"])
+    run("compact", sec_compact, ["compact_path_decisions_per_sec"])
+    run("single_batch", sec_single, ["single_batch_decisions_per_sec"])
+    run("e2e_bulk", sec_bulk, ["e2e_bulk_decisions_per_sec",
+                               "e2e_bulk_with_remaining_decisions_per_sec"])
+    run("fp_bulk", sec_fp_bulk, ["e2e_fp_bulk_decisions_per_sec"])
+    run("remote_bulk", sec_remote_bulk,
+        ["e2e_remote_bulk_decisions_per_sec"])
+    run("e2e_async", sec_e2e_async,
+        ["e2e_async_decisions_per_sec", "e2e_p99_low_load_ms"])
+    run("serving_p99", sec_serving_p99,
+        ["serving_p99_ms", "serving_p50_ms", "serving_p99_samples"])
+    if RESULT["platform"] == "tpu":
+        run("pallas_sweep", sec_pallas, ["pallas_sweep_ok"])
+    return wedged
+
+
+def main() -> int:
+    _emit()  # first line lands before any device or child work
+    platform = _probe_device(min(PROBE_S, max(_remaining() - 60.0, 5.0)))
+    RESULT["device_probe"] = "ok" if platform else "unhealthy"
+    RESULT["platform"] = platform or "unavailable"
+    _emit()
+
+    wedged = False
+    if platform:
+        wedged = _run_device_sections()
+    else:
+        for name in ("link_probe", "headline", "compact", "single_batch",
+                     "e2e_bulk", "fp_bulk", "remote_bulk", "e2e_async",
+                     "serving_p99"):
+            RESULT["section_status"][name] = "skipped_unhealthy_device"
+        _emit()
+
+    def sec_nproc():
+        rate, clients = bench_e2e_async_nproc_cpu(
+            timeout_s=min(600.0, max(_remaining(), 30.0)))
+        if clients == 0:  # child died/timed out: a failed section must
+            # never read as a measured rate of 0 (evidence fidelity)
+            raise RuntimeError("nproc CPU child failed or timed out")
+        return rate, clients
+
+    status, value = _section("nproc", sec_nproc, timeout_s=620)
+    if status == "ok":
+        RESULT["e2e_async_nproc_decisions_per_sec"] = round(value[0])
+        RESULT["e2e_async_nproc_clients"] = value[1]
+        _emit()
+
+    def sec_serving_cpu():
+        out = bench_serving_p99_cpu(
+            timeout_s=min(600.0, max(_remaining(), 30.0)))
+        if out is None:
+            raise RuntimeError("serving-p99 CPU children failed or timed out")
+        return out
+
+    status, value = _section("serving_p99_colocated", sec_serving_cpu,
+                             timeout_s=620)
+    if status == "ok" and value is not None:
+        d64, d16, d4 = value["d64"], value["d16"], value["d4"]
+        RESULT["serving_p99_colocated_ms"] = round(d64["p99_ms"], 3)
+        RESULT["serving_p50_colocated_ms"] = round(d64["p50_ms"], 3)
+        RESULT["serving_p99_colocated_d4_ms"] = round(d4["p99_ms"], 3)
+        RESULT["serving_p99_colocated_d16_ms"] = round(d16["p99_ms"], 3)
+        if d64.get("flush_p99_ms") is not None:
+            RESULT["flush_p99_colocated_ms"] = round(d64["flush_p99_ms"], 3)
+            RESULT["flush_p50_colocated_ms"] = round(d64["flush_p50_ms"], 3)
+        _emit()
+
+    # Second chance for the chip: if the first probe found no window but
+    # budget remains, re-probe and run the device sections late — a
+    # flapping tunnel (r04: healthy/wedged minute to minute) often opens
+    # a window while the CPU sections run.
+    if not platform and not wedged and _remaining() > 360.0:
+        platform = _probe_device(min(120.0, _remaining() - 300.0))
+        if platform:
+            RESULT["device_probe"] = "ok_late"
+            RESULT["platform"] = platform
+            _emit()
+            wedged = _run_device_sections()
+
+    RESULT["partial"] = False
+    _emit()
+    if wedged:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)  # a parked daemon thread mid-device-op can hang exit
+    return 0
 
 
 if __name__ == "__main__":
-    if "--serving-p99-child" in sys.argv:
-        _serving_p99_child()
+    if "--serving-server-child" in sys.argv:
+        _serving_server_child()
+        sys.exit(0)
+    if "--serving-load-child" in sys.argv:
+        i = sys.argv.index("--serving-load-child")
+        _serving_load_child(sys.argv[i + 1], sys.argv[i + 2])
         sys.exit(0)
     if "--nproc-child" in sys.argv:
         _nproc_child()
@@ -684,5 +1001,4 @@ if __name__ == "__main__":
         i = sys.argv.index("--nproc-client")
         _nproc_client(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3])
         sys.exit(0)
-    _await_backend_window()
     sys.exit(main())

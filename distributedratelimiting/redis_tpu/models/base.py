@@ -140,8 +140,16 @@ class RateLimiter(abc.ABC):
             current_available_permits=self.available_permits(),
             total_successful_leases=(metrics.grants if metrics else 0),
             total_failed_leases=(metrics.denials if metrics else 0),
-            current_queued_count=(len(queue) if queue is not None
-                                  and hasattr(queue, "__len__") else 0),
+            # Queued PERMITS, not parked waiters: the .NET
+            # ``CurrentQueuedCount`` sums permit counts (the reference's
+            # accounting does too, ``RedisTokenBucketRateLimiter.cs:129``
+            # ``_queueCount += permitCount``) — a waiter parked for 5
+            # permits must report 5, which ``WaiterQueue.queue_count``
+            # already tracks.
+            current_queued_count=(queue.queue_count
+                                  if queue is not None
+                                  and hasattr(queue, "queue_count")
+                                  else 0),
         )
 
     @abc.abstractmethod

@@ -147,5 +147,24 @@ class PartitionedRateLimiter:
             self.options.fill_rate_per_second,
         ))
 
+    def get_statistics(self, resource: object) -> "RateLimiterStatistics":
+        """Point-in-time snapshot for one resource (≙ the modern .NET
+        ``PartitionedRateLimiter<TResource>.GetStatistics(resource)``).
+        Available permits are per-resource (a read-only peek); lease
+        counters are limiter-wide — partitions here share one device
+        table rather than owning one ``RateLimiter`` each, so per-
+        partition lease history isn't tracked (documented deviation).
+        Never queues, so ``current_queued_count`` is structurally 0."""
+        from distributedratelimiting.redis_tpu.models.base import (
+            RateLimiterStatistics,
+        )
+
+        return RateLimiterStatistics(
+            current_available_permits=self.available_permits(resource),
+            total_successful_leases=self.metrics.grants,
+            total_failed_leases=self.metrics.denials,
+            current_queued_count=0,
+        )
+
     async def aclose(self) -> None:
         pass

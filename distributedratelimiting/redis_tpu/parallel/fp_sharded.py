@@ -130,42 +130,61 @@ def make_sharded_fp_window_scan_step(mesh, *, probe_window: int = 16,
 
 def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
                               rounds: int = 4,
-                              handle_duplicates: bool = True):
+                              handle_duplicates: bool = True,
+                              sync_cadence: str = "batch"):
     """Jitted sharded fused resolve+acquire with the psum global tier.
 
     Layout: ``fp u32[N, 2]`` and bucket state sharded along keys
     (``P(SHARD_AXIS)``); batch ``kpairs_k u32[n_shards, K, B, 2]`` /
     ``counts_k`` / ``valid_k`` sharded on axis 0 with shard-LOCAL
     fingerprints; ``nows_k i32[K]`` replicated. Each scanned batch runs
-    probe/insert + decision in-shard, then one scalar psum feeds the
-    replicated decaying global counter (the approximate algorithm's
-    shared tier — cadence trade documented in RESULTS.md "Psum cadence").
+    probe/insert + decision in-shard; the scalar psum feeding the
+    replicated decaying global counter runs per scanned batch
+    (``sync_cadence="batch"``) or once per launch over the accumulated
+    consumed count (``"launch"`` — same deployable cadence trade as
+    :func:`~.sharded_store.make_two_level_scan_step_deferred`; grants are
+    bit-identical, counter staleness ≤ one launch's span).
 
     Returns ``(fp, state, granted, remaining, resolved, gcounter)``.
     """
+    if sync_cadence not in ("batch", "launch"):
+        raise ValueError("sync_cadence must be 'batch' or 'launch'")
     fp_spec = P(SHARD_AXIS, None)
     state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
     gspecs = GlobalCounter(P(), P(), P(), P())
     batch_spec = P(SHARD_AXIS, None, None)
     kpair_spec = P(SHARD_AXIS, None, None, None)
+    deferred = sync_cadence == "launch"
 
     def block(fp, state, kpairs, counts, valid, nows, capacity, rate,
               gcounter, decay_rate):
         def body(carry, xs):
-            f, st, g = carry
+            f, st, g, consumed_acc = carry
             kp, ct, va, now = xs
             f, st, granted, remaining, resolved = F._fp_acquire_core(
                 f, st, kp, ct, va, now, capacity, rate,
                 probe_window=probe_window, rounds=rounds,
                 handle_duplicates=handle_duplicates)
             consumed = jnp.sum(jnp.asarray(ct, jnp.float32) * granted)
-            total = jax.lax.psum(consumed, SHARD_AXIS)
-            g = global_tier_update(g, total, now, decay_rate)
-            return (f, st, g), (granted, remaining, resolved)
+            if deferred:
+                consumed_acc = consumed_acc + consumed
+            else:
+                total = jax.lax.psum(consumed, SHARD_AXIS)
+                g = global_tier_update(g, total, now, decay_rate)
+            return (f, st, g, consumed_acc), (granted, remaining, resolved)
 
-        (fp, state, gcounter), (granted, remaining, resolved) = jax.lax.scan(
-            body, (fp, state, gcounter),
+        # The accumulator is per-shard ("varying" over the mesh axis inside
+        # shard_map); the initial zero must be cast to match.
+        zero = jax.lax.pcast(jnp.zeros((), jnp.float32), (SHARD_AXIS,),
+                             to="varying")
+        ((fp, state, gcounter, consumed_total),
+         (granted, remaining, resolved)) = jax.lax.scan(
+            body, (fp, state, gcounter, zero),
             (kpairs[0], counts[0], valid[0], nows))
+        if deferred:
+            total = jax.lax.psum(consumed_total, SHARD_AXIS)  # ONE/launch
+            gcounter = global_tier_update(gcounter, total, nows[-1],
+                                          decay_rate)
         return (fp, state, granted[None], remaining[None], resolved[None],
                 gcounter)
 
@@ -206,10 +225,17 @@ class ShardedFpDeviceStore:
                  decay_rate_per_sec: float = 0.0,
                  clock: Clock | None = None,
                  auto_grow: bool = True,
+                 sync_cadence: str = "batch",
                  rebase_threshold_ticks: int = _REBASE_THRESHOLD_TICKS
                  ) -> None:
         import threading
 
+        if sync_cadence not in ("batch", "launch"):
+            raise ValueError("sync_cadence must be 'batch' or 'launch'")
+        # Global-tier psum cadence; irrelevant to the window subclass
+        # (its step has no global tier) but accepted uniformly so the
+        # mesh store can pass one config to every sharded tier.
+        self.sync_cadence = sync_cadence
         self.mesh = mesh
         # Donated-state launches must serialize (the codebase-wide rule:
         # a second launch while one is in flight would reuse a deleted
@@ -250,7 +276,8 @@ class ShardedFpDeviceStore:
 
     def _make_step(self):
         return make_sharded_fp_scan_step(
-            self.mesh, probe_window=self.probe_window, rounds=self.rounds)
+            self.mesh, probe_window=self.probe_window, rounds=self.rounds,
+            sync_cadence=self.sync_cadence)
 
     def _launch(self, kpairs, cts, val, nows):
         """One scanned fused dispatch (caller holds the lock); updates

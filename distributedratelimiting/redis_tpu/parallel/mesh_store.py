@@ -23,6 +23,10 @@ Routing of the abstract surface:
   :class:`DeviceBucketStore`: these tables are small (one row per
   *limiter*, not per key) and their traffic is per-period, not
   per-request, so sharding them would buy nothing and cost a collective.
+  They are not capacity-capped: the aux tables grow by doubling past
+  ``aux_slots`` (tested >16K keys each,
+  ``tests/test_mesh_store.py::TestMeshAuxCardinality``; posture
+  documented in docs/OPERATIONS.md §3).
 
 Both layers share one clock: a single time authority for every table
 (invariant 1), one rebase path, one snapshot epoch.
@@ -106,9 +110,18 @@ class MeshBucketStore(BucketStore):
         max_inflight: int = 8,
         aux_slots: int = 2**14,
         directory: str = "host",
+        sync_cadence: str = "batch",
     ) -> None:
         if directory not in ("host", "fp"):
             raise ValueError("directory must be 'host' or 'fp'")
+        if sync_cadence not in ("batch", "launch"):
+            raise ValueError("sync_cadence must be 'batch' or 'launch'")
+        # Global-tier psum cadence for the sharded bucket tiers: "batch"
+        # (K collectives per scanned launch, counter staleness ≤ one
+        # batch) or "launch" (ONE collective per launch, staleness ≤ one
+        # launch's span, ~+22% bulk throughput measured —
+        # docs/OPERATIONS.md §3, benchmarks/RESULTS.md "Psum cadence").
+        self.sync_cadence = sync_cadence
         # Key-directory home for the sharded keyed tiers (buckets +
         # windows): "host" = per-shard native C tables; "fp" = the
         # device-resident fingerprint directory (docs/OPERATIONS.md §2).
@@ -223,6 +236,7 @@ class MeshBucketStore(BucketStore):
                     self.mesh, capacity=capacity,
                     fill_rate_per_sec=fill_rate_per_sec,
                     per_shard_slots=self.per_shard_slots, clock=self.clock,
+                    sync_cadence=self.sync_cadence,
                     rebase_threshold_ticks=_NEVER_REBASE,
                 )
                 self._shards[key] = store

@@ -19,6 +19,7 @@ execute serially in submission order via state-buffer donation.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Awaitable, Callable, Generic, Sequence, TypeVar
 
 TReq = TypeVar("TReq")
@@ -35,12 +36,17 @@ class MicroBatcher(Generic[TReq, TRes]):
         max_batch: int = 4096,
         max_delay_s: float = 200e-6,
         max_inflight: int = 8,
+        flush_latency=None,
     ) -> None:
         if max_batch <= 0:
             raise ValueError("max_batch must be positive")
         self._flush_fn = flush_fn
         self._max_batch = max_batch
         self._max_delay_s = max_delay_s
+        # Optional LatencyHistogram: wall time of each flush_fn await
+        # (dispatch + kernel + readback) — the device-side share of the
+        # serving-latency decomposition.
+        self._flush_latency = flush_latency
         self._pending: list[tuple[TReq, asyncio.Future]] = []
         self._timer: asyncio.TimerHandle | None = None
         self._inflight = asyncio.Semaphore(max_inflight)
@@ -92,8 +98,11 @@ class MicroBatcher(Generic[TReq, TRes]):
     async def _run_flush(self, batch: list[tuple[TReq, asyncio.Future]]) -> None:
         async with self._inflight:
             requests = [r for r, _ in batch]
+            t0 = time.perf_counter() if self._flush_latency is not None else 0.0
             try:
                 results = await self._flush_fn(requests)
+                if self._flush_latency is not None:
+                    self._flush_latency.record(time.perf_counter() - t0)
             except BaseException as exc:  # noqa: BLE001 — fan the failure out
                 for _, fut in batch:
                     if not fut.done():

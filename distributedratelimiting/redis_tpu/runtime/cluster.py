@@ -168,7 +168,12 @@ class ClusterBucketStore(BucketStore):
             loop.call_soon_threadsafe(loop.stop)
             if self._io_thread is not None:
                 self._io_thread.join(timeout=5.0)
-            loop.close()
+            # Close only a stopped loop: if the join timed out the loop
+            # thread is still running, and loop.close() would raise
+            # RuntimeError here — masking any node-close exception
+            # collected above (the daemon thread dies with the process).
+            if self._io_thread is None or not self._io_thread.is_alive():
+                loop.close()
             self._io_loop = None
         for out in outs:
             if isinstance(out, BaseException):

@@ -582,12 +582,15 @@ class RemoteBucketStore(BucketStore):
         server has no snapshot path."""
         await self._request(wire.OP_SAVE)
 
-    async def stats(self) -> dict:
+    async def stats(self, reset: bool = False) -> dict:
         """Server + store metrics (requests served, kernel launches, batch
-        occupancy, sweeps …) as a dict."""
+        occupancy, sweeps …) as a dict. ``reset=True`` additionally asks
+        the server to start a fresh serving-latency window after the
+        snapshot — measurement runs use it to exclude warmup."""
         import json
 
-        (text,) = await self._request(wire.OP_STATS)
+        (text,) = await self._request(wire.OP_STATS,
+                                      count=1 if reset else 0)
         return json.loads(text)
 
     # -- lifecycle ----------------------------------------------------------

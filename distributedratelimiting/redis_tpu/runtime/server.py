@@ -289,6 +289,12 @@ class BucketStoreServer:
             elif op == wire.OP_STATS:
                 resp = wire.encode_response(
                     seq, wire.RESP_TEXT, self._stats_json())
+                if count:  # reset flag: start a fresh measurement window
+                    self.serving_latency.reset()
+                    metrics = getattr(self.store, "metrics", None)
+                    if metrics is not None and hasattr(metrics,
+                                                       "flush_latency"):
+                        metrics.flush_latency.reset()
             else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
@@ -371,6 +377,14 @@ def main(argv: list[str] | None = None) -> None:
                         "host = native C++ host table (default); fp = "
                         "device-resident fingerprint directory (in-kernel "
                         "probe/insert — see docs/OPERATIONS.md §2)")
+    parser.add_argument("--sync-cadence", choices=("batch", "launch"),
+                        default="batch",
+                        help="global-tier psum cadence for the mesh "
+                        "backend's sharded bucket tiers: batch = one "
+                        "collective per scanned batch; launch = one per "
+                        "launch (~+22%% bulk throughput, counter "
+                        "staleness bounded by one launch's span — "
+                        "docs/OPERATIONS.md §3)")
     parser.add_argument("--snapshot-path", default=None,
                         help="checkpoint file for OP_SAVE (≙ Redis BGSAVE "
                         "dump path); if it exists at startup, the store "
@@ -404,7 +418,8 @@ def main(argv: list[str] | None = None) -> None:
             )
 
             store = MeshBucketStore(per_shard_slots=args.slots,
-                                    directory=args.directory)
+                                    directory=args.directory,
+                                    sync_cadence=args.sync_cadence)
         else:
             from distributedratelimiting.redis_tpu.runtime.store import (
                 InProcessBucketStore,

@@ -768,6 +768,7 @@ class _DeviceTable(_PackedLaunchMixin):
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
+            flush_latency=store.metrics.flush_latency,
         )
         self._pregrow_target = 0
         if store.coalesce_duplicates:
@@ -1020,6 +1021,7 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
+            flush_latency=store.metrics.flush_latency,
         )
         self._pregrow_target = 0
         if store.coalesce_duplicates:

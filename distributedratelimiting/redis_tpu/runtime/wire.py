@@ -196,7 +196,12 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
         payload = _keyed(key, _F64x2.pack(a, b))
     elif op == OP_HELLO:
         payload = _keyed(key, b"")  # key carries the auth token
-    elif op in (OP_PING, OP_SAVE, OP_STATS):
+    elif op == OP_STATS:
+        # Optional one-byte flag: nonzero count asks the server to reset
+        # its serving-latency histogram after snapshotting (steady-state
+        # measurement windows). Absent byte = plain snapshot.
+        payload = b"\x01" if count else b""
+    elif op in (OP_PING, OP_SAVE):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
@@ -219,7 +224,9 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
     if op == OP_HELLO:
         token, _ = _split_key(body)
         return seq, op, token, 0, 0.0, 0.0
-    if op in (OP_PING, OP_SAVE, OP_STATS):
+    if op == OP_STATS:
+        return seq, op, "", (body[0] if body else 0), 0.0, 0.0
+    if op in (OP_PING, OP_SAVE):
         return seq, op, "", 0, 0.0, 0.0
     if op == OP_ACQUIRE_MANY:
         raise RemoteStoreError(

@@ -192,3 +192,22 @@ class TestShardedFp:
                                           with_remaining=False)
         assert list(res.granted) == [True, False]
         assert res.remaining is None
+
+
+class TestFpSyncCadence:
+    def test_launch_cadence_matches_batch(self, mesh):
+        """Deferred psum on the fp tier: identical grants, same global
+        score (decay 0 ⇒ pure sums, so the accumulator is fully checked)."""
+        keys = [f"c{i}" for i in range(150)]
+        counts = [2] * len(keys)
+        outs = {}
+        for cadence in ("batch", "launch"):
+            store = make_store(mesh, sync_cadence=cadence)
+            res = store.acquire_many_blocking(keys, counts)
+            outs[cadence] = (np.asarray(res.granted), store.global_score)
+        np.testing.assert_array_equal(outs["batch"][0], outs["launch"][0])
+        assert outs["batch"][1] == outs["launch"][1] == 300.0
+
+    def test_invalid_cadence_rejected(self, mesh):
+        with pytest.raises(ValueError, match="sync_cadence"):
+            make_store(mesh, sync_cadence="hourly")

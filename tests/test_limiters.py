@@ -607,14 +607,17 @@ class TestStatistics:
         async def main():
             lim = ApproximateTokenBucketRateLimiter(
                 ApproximateTokenBucketOptions(
-                    token_limit=1, tokens_per_period=1,
+                    token_limit=3, tokens_per_period=1,
                     replenishment_period_s=3600.0, queue_limit=4,
                     instance_name="qstats"),
                 InProcessBucketStore(clock=ManualClock()))
-            assert lim.acquire(1).is_acquired
-            waiter = asyncio.ensure_future(lim.acquire_async(1))
+            assert lim.acquire(3).is_acquired
+            # 3 permits from ONE waiter: CurrentQueuedCount counts queued
+            # permits, not parked tasks (.NET semantics; the reference
+            # sums permit counts too, RedisTokenBucketRateLimiter.cs:129).
+            waiter = asyncio.ensure_future(lim.acquire_async(3))
             await asyncio.sleep(0)  # parks on the waiter queue
-            assert lim.get_statistics().current_queued_count == 1
+            assert lim.get_statistics().current_queued_count == 3
             waiter.cancel()
             try:
                 await waiter
@@ -624,3 +627,39 @@ class TestStatistics:
             await lim.aclose()
 
         asyncio.run(main())
+
+    def test_partitioned_get_statistics_per_resource(self):
+        """≙ PartitionedRateLimiter<TResource>.GetStatistics(resource):
+        available permits are a per-resource read-only peek; lease
+        counters are limiter-wide (partitions share one table here —
+        documented deviation); the family never queues."""
+        import asyncio
+
+        from distributedratelimiting.redis_tpu.models.partitioned import (
+            PartitionedRateLimiter,
+        )
+        from distributedratelimiting.redis_tpu.models.options import (
+            TokenBucketOptions,
+        )
+        from distributedratelimiting.redis_tpu.runtime.clock import (
+            ManualClock,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        lim = PartitionedRateLimiter(
+            TokenBucketOptions(token_limit=3, tokens_per_period=1,
+                               replenishment_period_s=3600.0,
+                               instance_name="pstats"),
+            InProcessBucketStore(clock=ManualClock()))
+        for _ in range(4):
+            lim.acquire("a", 1)
+        s_a = lim.get_statistics("a")
+        s_b = lim.get_statistics("b")
+        assert s_a.current_available_permits == 0
+        assert s_b.current_available_permits == 3  # untouched partition
+        assert s_a.total_successful_leases == 3
+        assert s_a.total_failed_leases == 1
+        assert s_a.current_queued_count == 0
+        asyncio.run(lim.aclose())

@@ -189,6 +189,40 @@ class TestStatsOp:
 
         run(main())
 
+    def test_stats_reset_flag_opens_fresh_window(self):
+        """``stats(reset=True)`` must zero the serving-latency AND flush
+        histograms IN PLACE (the MicroBatcher holds its reference) after
+        snapshotting — the measurement-window contract the serving-p99
+        rig relies on. A plain ``stats()`` must not reset."""
+
+        async def main():
+            store = DeviceBucketStore(n_slots=64, counter_slots=8,
+                                      clock=ManualClock(), max_batch=64)
+            async with BucketStoreServer(store) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    for i in range(8):
+                        await client.acquire(f"w{i}", 1, 10.0, 1.0)
+                    s1 = await client.stats()        # plain: no reset
+                    s2 = await client.stats(reset=True)
+                    s3 = await client.stats()
+                    await client.acquire("post", 1, 10.0, 1.0)
+                    s4 = await client.stats()
+                finally:
+                    await client.aclose()
+            assert s1["serving_samples"] >= 8
+            assert s2["serving_samples"] >= s1["serving_samples"]  # pre-reset snap
+            assert s2["store"]["flush_samples"] >= 1
+            # Post-reset: only the stats ops themselves have landed.
+            assert s3["serving_samples"] <= 2
+            assert s3["store"]["flush_samples"] == 0
+            # New samples land in the SAME (in-place-reset) histograms.
+            assert s4["serving_samples"] > s3["serving_samples"]
+            assert s4["store"]["flush_samples"] >= 1
+
+        run(main())
+
 
 class TestActiveSweeper:
     def test_sweep_all_evicts_expired_buckets(self):

@@ -479,3 +479,27 @@ class TestShardedSnapshotRestore:
                                per_shard_slots=16)
         with pytest.raises(ValueError, match="config"):
             b.restore(a.snapshot())
+
+
+class TestSyncCadenceOption:
+    """The deployable form of the psum-cadence ablation: the store option
+    must select the deferred step and preserve decision semantics."""
+
+    def test_launch_cadence_matches_batch(self, mesh):
+        keys = [f"c{i}" for i in range(200)]
+        counts = [2] * len(keys)
+        outs = {}
+        for cadence in ("batch", "launch"):
+            store = ShardedDeviceStore(
+                mesh, capacity=5.0, fill_rate_per_sec=0.0,
+                per_shard_slots=64, clock=ManualClock(),
+                sync_cadence=cadence)
+            res = store.acquire_many_blocking(keys, counts)
+            outs[cadence] = (np.asarray(res.granted), store.global_score)
+        np.testing.assert_array_equal(outs["batch"][0], outs["launch"][0])
+        assert outs["batch"][1] == outs["launch"][1] == 400.0
+
+    def test_invalid_cadence_rejected(self, mesh):
+        with pytest.raises(ValueError, match="sync_cadence"):
+            ShardedDeviceStore(mesh, capacity=5.0, fill_rate_per_sec=1.0,
+                               per_shard_slots=16, sync_cadence="never")
