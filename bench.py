@@ -504,7 +504,8 @@ async def bench_serving_p99(store_mod):
             stats["serving_samples"])
 
 
-def bench_serving_p99_cpu(timeout_s: float = 600.0) -> dict | None:
+def bench_serving_p99_cpu(timeout_s: float = 600.0,
+                          backing: str = "device") -> dict | None:
     """Co-located-device stand-in for the <2ms serving north star, now a
     TWO-process rig (VERDICT r4 #3b): the server child owns the store +
     kernel on its own core; a separate load child drives closed-loop
@@ -512,7 +513,16 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0) -> dict | None:
     arrival→ready histogram over a post-warmup window (stats reset flag),
     so client-side Python scheduling no longer pollutes the number the
     way the old single-process probe did. Returns the per-depth dict, or
-    None if either child failed."""
+    None if either child failed.
+
+    ``backing="instant"`` swaps the XLA-CPU device store for
+    ``InProcessBucketStore`` — a pure-Python kernel that answers in
+    microseconds. The serving p99 against it is the FRAMEWORK's own
+    overhead (wire + asyncio + per-request handling) with the kernel
+    removed; (device-backed p99 − instant p99) isolates what the
+    stand-in's XLA-CPU flush contributes, which is the part a real
+    co-located TPU replaces with its ~0.04 ms kernel + PCIe-class RTT
+    (VERDICT r5 #3's decomposition)."""
     import concurrent.futures
     import subprocess
 
@@ -524,7 +534,8 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0) -> dict | None:
     env[FORCE_CPU_ENV] = "1"
     deadline = time.monotonic() + timeout_s
     server = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--serving-server-child"],
+        [sys.executable, os.path.abspath(__file__), "--serving-server-child",
+         backing],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
     # No `with` around the executor: its shutdown joins the reader thread,
     # which only returns at EOF — a child that never prints would turn the
@@ -554,9 +565,12 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0) -> dict | None:
         pool.shutdown(wait=False)
 
 
-def _serving_server_child() -> None:
+def _serving_server_child(backing_kind: str = "device") -> None:
     """Server half of the co-located stand-in: owns the (CPU-platform)
-    device store and its kernel; parks until the parent closes stdin."""
+    device store and its kernel — or, for ``backing_kind="instant"``, the
+    pure-Python ``InProcessBucketStore`` whose microsecond kernel makes
+    the serving histogram a pure framework-overhead measurement. Parks
+    until the parent closes stdin."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -568,9 +582,12 @@ def _serving_server_child() -> None:
     )
 
     async def run() -> None:
-        backing = store_mod.DeviceBucketStore(
-            n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6,
-            max_inflight=16)
+        if backing_kind == "instant":
+            backing = store_mod.InProcessBucketStore()
+        else:
+            backing = store_mod.DeviceBucketStore(
+                n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6,
+                max_inflight=16)
         async with BucketStoreServer(backing) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
@@ -720,6 +737,14 @@ RESULT: dict = {
     "serving_p99_colocated_d16_ms": None,
     "flush_p99_colocated_ms": None,
     "flush_p50_colocated_ms": None,
+    # Same rig with InProcessBucketStore (pure-Python microsecond
+    # kernel): serving latency with the kernel term removed — the
+    # framework-overhead floor of the decomposition; see
+    # bench_serving_p99_cpu(backing="instant").
+    "serving_p99_instant_ms": None,
+    "serving_p50_instant_ms": None,
+    "serving_p99_instant_d4_ms": None,
+    "serving_p99_instant_d16_ms": None,
     "pallas_sweep_ok": None,
     "device_probe": None,
     "budget_s": BUDGET_S,
@@ -965,6 +990,24 @@ def main() -> int:
             RESULT["flush_p50_colocated_ms"] = round(d64["flush_p50_ms"], 3)
         _emit()
 
+    def sec_serving_instant():
+        out = bench_serving_p99_cpu(
+            timeout_s=min(300.0, max(_remaining(), 30.0)),
+            backing="instant")
+        if out is None:
+            raise RuntimeError("instant-serving children failed/timed out")
+        return out
+
+    status, value = _section("serving_p99_instant", sec_serving_instant,
+                             timeout_s=320)
+    if status == "ok" and value is not None:
+        d64, d16, d4 = value["d64"], value["d16"], value["d4"]
+        RESULT["serving_p99_instant_ms"] = round(d64["p99_ms"], 3)
+        RESULT["serving_p50_instant_ms"] = round(d64["p50_ms"], 3)
+        RESULT["serving_p99_instant_d4_ms"] = round(d4["p99_ms"], 3)
+        RESULT["serving_p99_instant_d16_ms"] = round(d16["p99_ms"], 3)
+        _emit()
+
     # Second chance for the chip: if the first probe found no window but
     # budget remains, re-probe and run the device sections late — a
     # flapping tunnel (r04: healthy/wedged minute to minute) often opens
@@ -988,7 +1031,9 @@ def main() -> int:
 
 if __name__ == "__main__":
     if "--serving-server-child" in sys.argv:
-        _serving_server_child()
+        i = sys.argv.index("--serving-server-child")
+        kind = sys.argv[i + 1] if len(sys.argv) > i + 1 else "device"
+        _serving_server_child(kind)
         sys.exit(0)
     if "--serving-load-child" in sys.argv:
         i = sys.argv.index("--serving-load-child")

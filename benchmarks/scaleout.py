@@ -1,0 +1,201 @@
+"""Aggregate scale-out curve: N store servers × M bulk-client processes.
+
+The 50M/s north star (BASELINE.json) is an *aggregate serving* target —
+kernel-path numbers don't speak to it. This harness measures the only
+aggregate the environment can produce: N shared-nothing
+``BucketStoreServer`` processes on this box, M client processes each
+bulk-driving a ``ClusterBucketStore`` (client-side crc32 key sharding,
+per-node sub-batches fanned out concurrently — the same composition the
+reference would reach with N Redis nodes and cluster-aware clients,
+``RedisRateLimiting.Redis/README.md``'s horizontal-scale story).
+
+Run: ``python -m benchmarks.scaleout [--nodes 1,2,4,8] [--clients 2]
+[--seconds 6] [--backing cpu|device]``
+Prints one JSON line per node count; the parent measures aggregate
+decisions/s across all client processes against wall clock.
+
+Interpretation contract (RESULTS.md "Aggregate scale-out curve"): on a
+single-core box every server and client timeshares one CPU, so the curve
+measures *composition overhead* (does adding nodes cost throughput?),
+not parallel speedup — the per-node ceiling × N model only applies when
+each node owns its own core/chip. The harness therefore also records
+``nproc`` so the reader can tell which regime a record came from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import concurrent.futures
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Child roles ---------------------------------------------------------------
+
+
+def _server_child() -> None:
+    """One store-server process: CPU-platform device store (the serving
+    stand-in) or the real device, prints its address, parks on stdin."""
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    async def run() -> None:
+        backing = DeviceBucketStore(n_slots=1 << 18, max_batch=4096)
+        async with BucketStoreServer(backing) as srv:
+            print(json.dumps({"host": srv.host, "port": srv.port}),
+                  flush=True)
+            await asyncio.get_running_loop().run_in_executor(
+                None, sys.stdin.read)
+        await backing.aclose()
+
+    asyncio.run(run())
+
+
+def _client_child(addrs_json: str, seconds: str) -> None:
+    """One bulk-client process: closed-loop ``acquire_many`` against the
+    whole cluster for the given duration; prints its decision count."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.runtime.cluster import (
+        ClusterBucketStore,
+    )
+
+    addrs = [tuple(a) for a in json.loads(addrs_json)]
+    dur = float(seconds)
+    n = 1 << 16
+    rng = np.random.default_rng(os.getpid())
+    pool = [f"user{i}" for i in range(200_000)]
+    batches = [[pool[j] for j in rng.integers(0, len(pool), n)]
+               for _ in range(4)]
+    counts = [1] * n
+
+    async def run() -> None:
+        # Generous request timeout: at N=8 on a single-core box the warm
+        # call rides an 8-process XLA-CPU compile stampede and can exceed
+        # the default 30 s (observed) without anything being wrong.
+        store = ClusterBucketStore(addresses=addrs,
+                                   request_timeout_s=180.0)
+        # Warm every node connection + kernel shape.
+        await store.acquire_many(batches[0], counts, 1e7, 1e7,
+                                 with_remaining=False)
+        done = 0
+        t0 = time.perf_counter()
+        i = 0
+        while time.perf_counter() - t0 < dur:
+            await store.acquire_many(batches[i % len(batches)], counts,
+                                     1e7, 1e7, with_remaining=False)
+            done += n
+            i += 1
+        dt = time.perf_counter() - t0
+        await store.aclose()
+        print(json.dumps({"decisions": done, "dt": dt}), flush=True)
+
+    asyncio.run(run())
+
+
+# Parent orchestration ------------------------------------------------------
+
+
+def _measure(n_nodes: int, n_clients: int, seconds: float,
+             backing: str) -> dict:
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        FORCE_CPU_ENV,
+    )
+
+    env = os.environ.copy()
+    if backing == "cpu":
+        env[FORCE_CPU_ENV] = "1"
+    me = os.path.abspath(__file__)
+    # Children run this file by path, outside the package: put the repo
+    # root on their import path.
+    root = os.path.dirname(os.path.dirname(me))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    servers = [subprocess.Popen(
+        [sys.executable, me, "--server-child"], env=env,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        for _ in range(n_nodes)]
+    pool = concurrent.futures.ThreadPoolExecutor(1)
+    try:
+        addrs = []
+        for s in servers:
+            # Pooled readline with a timeout (bench.py's guard): during a
+            # tunnel outage a --backing device server child hangs in
+            # device init and never prints its address.
+            line = pool.submit(s.stdout.readline).result(timeout=180.0)
+            a = json.loads(line)
+            addrs.append([a["host"], a["port"]])
+        addrs_json = json.dumps(addrs)
+        t0 = time.perf_counter()
+        clients = [subprocess.Popen(
+            [sys.executable, me, "--client-child", addrs_json,
+             str(seconds)], env=env, stdout=subprocess.PIPE, text=True)
+            for _ in range(n_clients)]
+        outs = []
+        try:
+            for c in clients:
+                out, _ = c.communicate(timeout=seconds * 8 + 240)
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            for c in clients:  # a timed-out/garbled client must not keep
+                if c.poll() is None:  # spinning against dying servers
+                    c.kill()
+        wall = time.perf_counter() - t0
+        per_client = [o["decisions"] / o["dt"] for o in outs]
+        return {
+            "config": "scaleout",
+            "n_nodes": n_nodes,
+            "n_clients": n_clients,
+            "backing": backing,
+            # Clients start together and run identical closed-loop
+            # windows, so the aggregate is the sum of per-client rates
+            # over their own measured windows (parent wall clock would
+            # fold one-time compile/warmup into the denominator).
+            "aggregate_decisions_per_sec": round(sum(per_client)),
+            "per_client_decisions_per_sec": [round(r) for r in per_client],
+            "wall_incl_warm_s": round(wall, 1),
+            "nproc": os.cpu_count(),
+        }
+    finally:
+        for s in servers:
+            try:
+                s.stdin.close()
+                s.wait(timeout=10)
+            except Exception:
+                s.kill()
+        pool.shutdown(wait=False)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", default="1,2,4,8")
+    p.add_argument("--clients", type=int, default=2)
+    p.add_argument("--seconds", type=float, default=6.0)
+    p.add_argument("--backing", choices=("cpu", "device"), default="cpu")
+    args = p.parse_args(argv)
+    for n in [int(x) for x in args.nodes.split(",")]:
+        print(json.dumps(_measure(n, args.clients, args.seconds,
+                                  args.backing)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--server-child" in sys.argv:
+        _server_child()
+        sys.exit(0)
+    if "--client-child" in sys.argv:
+        i = sys.argv.index("--client-child")
+        _client_child(sys.argv[i + 1], sys.argv[i + 2])
+        sys.exit(0)
+    sys.exit(main())

@@ -58,12 +58,15 @@ __all__ = [
     "init_fp_table",
     "fp_resolve_core",
     "fp_acquire_batch",
-    "fp_acquire_scan",
+    "fp_acquire_scan_fused",
+    "fp_acquire_scan_fused_bits",
+    "pack_fp12",
     "fp_peek_batch",
     "fp_migrate_chunk",
     "fp_sweep_expired",
     "fp_window_acquire_batch",
-    "fp_window_acquire_scan",
+    "fp_window_acquire_scan_fused",
+    "fp_window_acquire_scan_fused_bits",
     "fp_migrate_window_chunk",
     "fp_sweep_windows",
     "FpResolveOut",
@@ -117,7 +120,8 @@ def fp_resolve_core(fp, kpair, valid, *, probe_window: int,
     slots = jnp.full((b,), -1, jnp.int32)
     resolved = ~valid  # padding rows are "done" (slot stays -1)
 
-    for _ in range(rounds):
+    def probe(fp, slots, resolved):
+        """Match pass: find each unresolved request's cell if present."""
         cells = fp[widx]                        # [B, L, 2]
         occ = (cells != 0).any(-1)              # [B, L]
         match = (occ
@@ -126,8 +130,22 @@ def fp_resolve_core(fp, kpair, valid, *, probe_window: int,
         hit = match.any(1) & ~resolved
         hpos = jnp.argmax(match, axis=1).astype(jnp.int32)
         slots = jnp.where(hit, widx[rows, hpos], slots)
-        resolved = resolved | hit
+        return slots, resolved | hit, occ
 
+    # Steady-state fast path: one pure gather resolves every present key.
+    # The insert machinery (scatter + verify re-gather, the expensive part
+    # of this kernel) runs ONLY while some request is still unresolved —
+    # a `while_loop` whose condition reduces on device, so a warm serving
+    # batch costs one probe gather and zero insert rounds.
+    slots, resolved, _ = probe(fp, slots, resolved)
+
+    def round_needed(carry):
+        _, _, resolved, r = carry
+        return (r < rounds) & ~resolved.all()
+
+    def insert_round(carry):
+        fp, slots, resolved, r = carry
+        slots, resolved, occ = probe(fp, slots, resolved)
         free = ~occ
         has_free = free.any(1)
         need = ~resolved & has_free
@@ -141,7 +159,11 @@ def fp_resolve_core(fp, kpair, valid, *, probe_window: int,
         won = need & (got == kpair).all(-1)
         slots = jnp.where(won, target, slots)
         resolved = resolved | won
+        return fp, slots, resolved, r + 1
 
+    fp, slots, resolved, _ = jax.lax.while_loop(
+        round_needed, insert_round,
+        (fp, slots, resolved, jnp.int32(0)))
     return FpResolveOut(fp, slots, resolved)
 
 
@@ -177,29 +199,108 @@ def fp_acquire_batch(fp, state: K.BucketState, kpair, counts, valid, now,
                             handle_duplicates=handle_duplicates)
 
 
+def pack_fp12(fps: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Host-side packing for the fused fp dispatches: ``u32[B, 3]`` =
+    (lo, hi, count), padding rows marked by count ``0xFFFFFFFF``. ONE
+    operand array per dispatch instead of three (kpair/counts/valid) —
+    per-transfer floors on tunneled links make the transfer COUNT matter
+    as much as the bytes (the :func:`~.kernels.pack_compact5` lesson,
+    RESULTS.md r04). 12 bytes/decision.
+
+    ``fps`` is ``u32[B, 2]`` (padding rows arbitrary), ``counts`` is the
+    valid prefix's counts — rows past ``len(counts)`` become padding.
+    """
+    b = fps.shape[0]
+    fused = np.empty((b, 3), np.uint32)
+    fused[:, :2] = fps
+    fused[:, 2] = np.uint32(0xFFFFFFFF)
+    n = len(counts)
+    # Clamp BOTH sides: a negative count must stay a valid row (it grants,
+    # like every other path's kernel does for count ≤ 0), not wrap into
+    # the uint32 sign-bit range and get silently reclassified as padding.
+    fused[:n, 2] = np.clip(counts, 0, 2**31 - 1).astype(np.uint32)
+    return fused
+
+
+def _unpack_fp12(fused):
+    """Device-side unpack of :func:`pack_fp12`: the count column read as
+    i32 makes padding exactly ``-1`` via the sign bit."""
+    kpair = fused[..., :2]
+    counts = fused[..., 2].astype(jnp.int32)
+    valid = counts >= 0
+    return kpair, jnp.maximum(counts, 0), valid
+
+
+def _bitpack2(granted, resolved):
+    """Pack two bool[B] planes into ``u8[2, B//8]`` (little-endian bit
+    order, host side ``np.unpackbits(..., bitorder="little")``): plane 0
+    grants, plane 1 resolve status — ONE device→host fetch carries both
+    verdict and window-pressure report at 2 bits/decision."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    g = (granted.reshape(-1, 8).astype(jnp.uint8) << shifts).sum(
+        axis=1, dtype=jnp.uint8)
+    r = (resolved.reshape(-1, 8).astype(jnp.uint8) << shifts).sum(
+        axis=1, dtype=jnp.uint8)
+    return jnp.stack([g, r])
+
+
 @partial(jax.jit, donate_argnums=(0, 1),
          static_argnames=("probe_window", "rounds", "handle_duplicates"))
-def fp_acquire_scan(fp, state: K.BucketState, kpairs_k, counts_k, valid_k,
-                    nows_k, capacity, fill_rate_per_tick, *,
-                    probe_window: int = 16, rounds: int = 4,
-                    handle_duplicates: bool = True):
-    """K-deep pipelined variant: ``lax.scan`` over ``[K, B, 2]``
-    fingerprints with the (table, state) pair as carry — one dispatch
-    decides ``K×B`` requests (the bulk/serving shape; each batch keeps its
-    own ``now`` operand exactly like :func:`~.kernels.acquire_scan`)."""
+def fp_acquire_scan_fused_bits(fp, state: K.BucketState, fused_k, nows_k,
+                               capacity, fill_rate_per_tick, *,
+                               probe_window: int = 16, rounds: int = 4,
+                               handle_duplicates: bool = True):
+    """Minimum-transfer fp bulk dispatch: ONE fused operand up
+    (:func:`pack_fp12`), ONE bit-packed result down — the fp analogue of
+    :func:`~.kernels.acquire_scan_fused_bits`. On high-RTT tunnel days
+    the fetch count, not the kernel, dominates the fp bulk path (measured
+    ~70 ms/fetch, r05), so the verdict-only path ships granted+resolved
+    as two bit-planes in a single ``u8[K, 2, B//8]`` array.
+
+    Returns ``(fp, state, bits u8[K, 2, B//8])``; ``B % 8 == 0``.
+    """
 
     def body(carry, xs):
         fp, st = carry
-        kp, cnt, val, now = xs
-        fp, st, granted, remaining, res = _fp_acquire_core(
-            fp, st, kp, cnt, val, now, capacity, fill_rate_per_tick,
-            probe_window=probe_window, rounds=rounds,
+        fused, now = xs
+        kpair, counts, valid = _unpack_fp12(fused)
+        fp, st, granted, _, res = _fp_acquire_core(
+            fp, st, kpair, counts, valid, now, capacity,
+            fill_rate_per_tick, probe_window=probe_window, rounds=rounds,
             handle_duplicates=handle_duplicates)
-        return (fp, st), (granted, remaining, res)
+        return (fp, st), _bitpack2(granted, res)
 
-    (fp, state), (granted, remaining, resolved) = jax.lax.scan(
-        body, (fp, state), (kpairs_k, counts_k, valid_k, nows_k))
-    return fp, state, granted, remaining, resolved
+    (fp, state), bits = jax.lax.scan(body, (fp, state), (fused_k, nows_k))
+    return fp, state, bits
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates"))
+def fp_acquire_scan_fused(fp, state: K.BucketState, fused_k, nows_k,
+                          capacity, fill_rate_per_tick, *,
+                          probe_window: int = 16, rounds: int = 4,
+                          handle_duplicates: bool = True):
+    """Fused-operand fp bulk dispatch WITH per-request remaining: ONE
+    operand up, ONE ``f32[K, 2, B]`` result down — row 0 encodes
+    ``granted + 2·resolved`` (both recovered exactly from the small
+    integer), row 1 is remaining. One fetch replaces three.
+
+    Returns ``(fp, state, out f32[K, 2, B])``.
+    """
+
+    def body(carry, xs):
+        fp, st = carry
+        fused, now = xs
+        kpair, counts, valid = _unpack_fp12(fused)
+        fp, st, granted, remaining, res = _fp_acquire_core(
+            fp, st, kpair, counts, valid, now, capacity,
+            fill_rate_per_tick, probe_window=probe_window, rounds=rounds,
+            handle_duplicates=handle_duplicates)
+        code = granted.astype(jnp.float32) + 2.0 * res.astype(jnp.float32)
+        return (fp, st), jnp.stack([code, remaining])
+
+    (fp, state), out = jax.lax.scan(body, (fp, state), (fused_k, nows_k))
+    return fp, state, out
 
 
 @partial(jax.jit, static_argnames=("probe_window",))
@@ -296,26 +397,55 @@ def fp_window_acquire_batch(fp, state: K.WindowState, kpair, counts, valid,
 @partial(jax.jit, donate_argnums=(0, 1),
          static_argnames=("probe_window", "rounds", "handle_duplicates",
                           "interpolate"))
-def fp_window_acquire_scan(fp, state: K.WindowState, kpairs_k, counts_k,
-                           valid_k, nows_k, limit, window_ticks, *,
-                           probe_window: int = 16, rounds: int = 4,
-                           handle_duplicates: bool = True,
-                           interpolate: bool = True):
-    """K-deep scanned window variant (the bulk shape), mirroring
-    :func:`fp_acquire_scan`."""
+def fp_window_acquire_scan_fused_bits(fp, state: K.WindowState, fused_k,
+                                      nows_k, limit, window_ticks, *,
+                                      probe_window: int = 16,
+                                      rounds: int = 4,
+                                      handle_duplicates: bool = True,
+                                      interpolate: bool = True):
+    """Window-family analogue of :func:`fp_acquire_scan_fused_bits`:
+    one :func:`pack_fp12` operand up, ``u8[K, 2, B//8]`` bit-planes down
+    (granted, resolved)."""
 
     def body(carry, xs):
         fp, st = carry
-        kp, cnt, val, now = xs
-        fp, st, granted, remaining, res = _fp_window_core(
-            fp, st, kp, cnt, val, now, limit, window_ticks,
+        fused, now = xs
+        kpair, counts, valid = _unpack_fp12(fused)
+        fp, st, granted, _, res = _fp_window_core(
+            fp, st, kpair, counts, valid, now, limit, window_ticks,
             probe_window=probe_window, rounds=rounds,
             handle_duplicates=handle_duplicates, interpolate=interpolate)
-        return (fp, st), (granted, remaining, res)
+        return (fp, st), _bitpack2(granted, res)
 
-    (fp, state), (granted, remaining, resolved) = jax.lax.scan(
-        body, (fp, state), (kpairs_k, counts_k, valid_k, nows_k))
-    return fp, state, granted, remaining, resolved
+    (fp, state), bits = jax.lax.scan(body, (fp, state), (fused_k, nows_k))
+    return fp, state, bits
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds", "handle_duplicates",
+                          "interpolate"))
+def fp_window_acquire_scan_fused(fp, state: K.WindowState, fused_k, nows_k,
+                                 limit, window_ticks, *,
+                                 probe_window: int = 16, rounds: int = 4,
+                                 handle_duplicates: bool = True,
+                                 interpolate: bool = True):
+    """Window-family analogue of :func:`fp_acquire_scan_fused`: one
+    operand up, one ``f32[K, 2, B]`` result down (row 0 =
+    ``granted + 2·resolved``, row 1 = remaining)."""
+
+    def body(carry, xs):
+        fp, st = carry
+        fused, now = xs
+        kpair, counts, valid = _unpack_fp12(fused)
+        fp, st, granted, remaining, res = _fp_window_core(
+            fp, st, kpair, counts, valid, now, limit, window_ticks,
+            probe_window=probe_window, rounds=rounds,
+            handle_duplicates=handle_duplicates, interpolate=interpolate)
+        code = granted.astype(jnp.float32) + 2.0 * res.astype(jnp.float32)
+        return (fp, st), jnp.stack([code, remaining])
+
+    (fp, state), out = jax.lax.scan(body, (fp, state), (fused_k, nows_k))
+    return fp, state, out
 
 
 @partial(jax.jit, donate_argnums=(0, 1),

@@ -230,6 +230,60 @@ class TestFingerprintStore:
 
         run(main())
 
+    def test_bulk_verdict_only_matches_host_directory_store(self):
+        # The with_remaining=False path ships bit-packed verdicts (the
+        # u8[K, 2, B//8] bit-planes) — its grants must equal both the
+        # host-directory store's and its own with_remaining=True path
+        # (same kernel, different result encoding).
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=1024, clock=clock)
+            full = FingerprintBucketStore(n_slots=1024, clock=clock)
+            oracle = DeviceBucketStore(n_slots=1024, clock=clock)
+            rng = np.random.default_rng(11)
+            keys = [f"k{i}" for i in rng.integers(0, 40, 300)]
+            counts = rng.integers(0, 4, 300).tolist()
+            got = await store.acquire_many(keys, counts, 5.0, 1.0,
+                                           with_remaining=False)
+            ref = await full.acquire_many(keys, counts, 5.0, 1.0)
+            want = await oracle.acquire_many(keys, counts, 5.0, 1.0,
+                                             with_remaining=False)
+            assert got.remaining is None
+            np.testing.assert_array_equal(got.granted, want.granted)
+            np.testing.assert_array_equal(got.granted, ref.granted)
+            await store.aclose()
+            await full.aclose()
+            await oracle.aclose()
+
+        run(main())
+
+    def test_bulk_verdict_only_odd_max_batch(self):
+        # max_batch not divisible by 8 cannot use bit-planes; the path
+        # must fall back to the f32 fused result instead of crashing
+        # (classic-store guard parity, store.py bits path).
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=1024, max_batch=60,
+                                           clock=clock)
+            oracle = DeviceBucketStore(n_slots=1024, clock=clock)
+            rng = np.random.default_rng(13)
+            # Distinct keys: the in-batch duplicate-serialization rule is
+            # batch-boundary-dependent, and max_batch=60 chunks batches
+            # differently from the oracle's default — duplicates would
+            # legitimately diverge.
+            keys = [f"k{i}" for i in range(150)]
+            counts = rng.integers(0, 4, 150).tolist()
+            got = await store.acquire_many(keys, counts, 5.0, 1.0,
+                                           with_remaining=False)
+            want = await oracle.acquire_many(keys, counts, 5.0, 1.0,
+                                             with_remaining=False)
+            assert got.remaining is None
+            np.testing.assert_array_equal(got.granted, want.granted)
+            await store.aclose()
+            await oracle.aclose()
+
+        run(main())
+
     def test_bulk_distinct_keys_match_exact_oracle(self):
         # With no in-call duplicates the decisions are exact — the serial
         # InProcess oracle applies directly.
@@ -405,6 +459,37 @@ class TestFingerprintStore:
                                            atol=1e-4)
             await store.aclose()
             await oracle.aclose()
+
+        run(main())
+
+    def test_window_bulk_verdict_only_matches_full_path(self):
+        # The window-family bit-plane path (with_remaining=False through
+        # fp_window_acquire_scan_fused_bits) must grant identically to
+        # the f32 fused path and the host-directory oracle, for both
+        # sliding and fixed windows.
+        async def main():
+            clock = ManualClock()
+            rng = np.random.default_rng(17)
+            keys = [f"w{i}" for i in rng.integers(0, 50, 300)]
+            counts = rng.integers(0, 3, 300).tolist()
+            for fixed in (False, True):
+                store = FingerprintBucketStore(n_slots=1024, clock=clock)
+                full = FingerprintBucketStore(n_slots=1024, clock=clock)
+                oracle = DeviceBucketStore(n_slots=1024, clock=clock)
+                got = await store.window_acquire_many(
+                    keys, counts, 4.0, 10.0, fixed=fixed,
+                    with_remaining=False)
+                ref = await full.window_acquire_many(
+                    keys, counts, 4.0, 10.0, fixed=fixed)
+                want = await oracle.window_acquire_many(
+                    keys, counts, 4.0, 10.0, fixed=fixed,
+                    with_remaining=False)
+                assert got.remaining is None
+                np.testing.assert_array_equal(got.granted, ref.granted)
+                np.testing.assert_array_equal(got.granted, want.granted)
+                await store.aclose()
+                await full.aclose()
+                await oracle.aclose()
 
         run(main())
 
