@@ -639,9 +639,15 @@ def bench_fp_mesh(smoke: bool = False) -> dict:
     mesh = create_mesh(len(jax.devices()))
     n = 1 << (10 if smoke else 16)
     calls = 2 if smoke else 4
+    # Provision by TOTAL slot budget (2^19 ≈ 3.6× the ~145K unique keys
+    # the workload draws), not per-shard: the r05 TPU run of the old
+    # per-shard=2^16 config on a 1-device mesh left the table 8× under
+    # water — permanent window pressure, sweep+grow cycles inside the
+    # timed loop, 14.6K dec/s (RESULTS.md r05 suite table).
+    total_slots = 1 << (11 if smoke else 19)
     store = ShardedFpDeviceStore(
         mesh, capacity=1e9, fill_rate_per_sec=1.0,
-        per_shard_slots=1 << (8 if smoke else 16),
+        per_shard_slots=max(256, total_slots // mesh.devices.size),
         batch=128 if smoke else 2048)
     rng = np.random.default_rng(13)
     pool = [f"user{i}" for i in range(200_000)]
