@@ -243,6 +243,13 @@ class ShardedFpDeviceStore:
         self._lock = threading.RLock()
         self._rebase_threshold = rebase_threshold_ticks
         self.n_shards = mesh.devices.size
+        if per_shard_slots < probe_window:
+            # Same contract as _FpTable: the non-wrapping placement
+            # (n - L + 1 modulus) is undefined below one window per
+            # shard, and would silently wrap to garbage bases.
+            raise ValueError(
+                f"per_shard_slots ({per_shard_slots}) must be >= "
+                f"probe_window ({probe_window})")
         self.capacity = float(capacity)
         self.rate_per_tick = _rate_per_tick(fill_rate_per_sec)
         self.decay_per_tick = _rate_per_tick(decay_rate_per_sec)
@@ -417,65 +424,93 @@ class ShardedFpDeviceStore:
         old_fp = np.asarray(self.fp).reshape(self.n_shards, -1, 2)
         olds = [np.asarray(a).reshape(self.n_shards, -1)
                 for a in self.state]
-        per_new = old_fp.shape[1] * 2  # committed only after the rehash
-        n = per_new * self.n_shards
-        fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
-        fp = jax.device_put(F.init_fp_table(n), fp_shard)
-        state = self._fresh_sharded_state(n)
-        migrate = make_sharded_fp_migrate_step(
-            self.mesh, type(self.state), probe_window=self.probe_window,
-            rounds=self.rounds)
-        pending = [np.nonzero((old_fp[s] != 0).any(-1))[0]
-                   for s in range(self.n_shards)]
-        b = self.batch
-        # Unplaced entries (bounded insert rounds under in-chunk window
-        # contention) retry in later passes; zero-progress ⇒ genuinely
-        # unplaceable (see _FpTable._grow — same discipline).
-        while any(len(p) for p in pending):
-            next_pending = [[] for _ in range(self.n_shards)]
-            rows = max(len(p) for p in pending)
-            pos = 0
-            while pos < rows:
-                kpair = np.zeros((self.n_shards, b, 2), np.uint32)
-                cols = [np.zeros((self.n_shards, b), a.dtype)
-                        for a in olds]
-                valid = np.zeros((self.n_shards, b), bool)
-                chunk_idx = [None] * self.n_shards
-                for s in range(self.n_shards):
-                    idx = pending[s][pos:pos + b]
-                    m = len(idx)
-                    if m == 0:
-                        continue
-                    chunk_idx[s] = idx
-                    kpair[s, :m] = old_fp[s][idx]
-                    for c, a in zip(cols, olds):
-                        c[s, :m] = a[s][idx]
-                    valid[s, :m] = True
-                fp, state, placed = migrate(
-                    fp, state, jnp.asarray(kpair),
-                    *(jnp.asarray(c) for c in cols), jnp.asarray(valid))
-                placed_np = np.asarray(placed).reshape(self.n_shards, -1)
-                for s in range(self.n_shards):
-                    idx = chunk_idx[s]
-                    if idx is None:
-                        continue
-                    miss = ~placed_np[s, :len(idx)]
-                    if miss.any():
-                        next_pending[s].append(idx[miss])
-                pos += b
-            new_pending = [
-                np.concatenate(p) if p else np.zeros((0,), np.int64)
-                for p in next_pending]
-            if (sum(len(p) for p in new_pending)
-                    >= sum(len(p) for p in pending)):
-                raise RuntimeError(
-                    "sharded fingerprint rehash cannot place "
-                    f"{sum(len(p) for p in new_pending)} entries")
-            pending = new_pending
-        self.fp, self.state = fp, state
-        self.per_shard_slots = per_new
+        self._rehash_locked(old_fp, olds, old_fp.shape[1] * 2)
         self.grows += 1
         self.metrics.pregrows += 1
+
+    def _rehash_locked(self, old_fp: np.ndarray, olds: list,
+                       per_start: int,
+                       probe_window: int | None = None) -> None:
+        """Re-place every shard's live entries into fresh sharded tables
+        (``old_fp`` is ``[S, per_old, 2]``, ``olds`` state columns in
+        field order, same shape) — the shared driver behind growth and
+        legacy-snapshot adoption. Caller holds the lock; nothing mutates
+        until placement succeeds. ``probe_window`` lets snapshot adoption
+        place under the snapshot's geometry before the caller commits it.
+
+        A shard entry whose whole window fills with other entries is
+        unplaceable at a given size — a density accident; double and
+        retry (load halves per attempt, so this converges), with a cap
+        so a pathological set still fails loudly. Same discipline as
+        _FpTable._rehash."""
+        pw = self.probe_window if probe_window is None else probe_window
+        entries = [np.nonzero((old_fp[s] != 0).any(-1))[0]
+                   for s in range(self.n_shards)]
+        migrate = make_sharded_fp_migrate_step(
+            self.mesh, type(self.state), probe_window=pw,
+            rounds=self.rounds)
+        b = self.batch
+        per_new = per_start  # committed only after the rehash
+        leftover = 0
+        for _attempt in range(4):
+            n = per_new * self.n_shards
+            fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+            fp = jax.device_put(F.init_fp_table(n), fp_shard)
+            state = self._fresh_sharded_state(n)
+            pending = entries
+            stuck = False
+            # Unplaced entries (bounded insert rounds under in-chunk
+            # window contention) retry in later passes; zero-progress ⇒
+            # some window is genuinely full at this size.
+            while any(len(p) for p in pending):
+                next_pending = [[] for _ in range(self.n_shards)]
+                rows = max(len(p) for p in pending)
+                pos = 0
+                while pos < rows:
+                    kpair = np.zeros((self.n_shards, b, 2), np.uint32)
+                    cols = [np.zeros((self.n_shards, b), a.dtype)
+                            for a in olds]
+                    valid = np.zeros((self.n_shards, b), bool)
+                    chunk_idx = [None] * self.n_shards
+                    for s in range(self.n_shards):
+                        idx = pending[s][pos:pos + b]
+                        m = len(idx)
+                        if m == 0:
+                            continue
+                        chunk_idx[s] = idx
+                        kpair[s, :m] = old_fp[s][idx]
+                        for c, a in zip(cols, olds):
+                            c[s, :m] = a[s][idx]
+                        valid[s, :m] = True
+                    fp, state, placed = migrate(
+                        fp, state, jnp.asarray(kpair),
+                        *(jnp.asarray(c) for c in cols), jnp.asarray(valid))
+                    placed_np = np.asarray(placed).reshape(self.n_shards, -1)
+                    for s in range(self.n_shards):
+                        idx = chunk_idx[s]
+                        if idx is None:
+                            continue
+                        miss = ~placed_np[s, :len(idx)]
+                        if miss.any():
+                            next_pending[s].append(idx[miss])
+                    pos += b
+                new_pending = [
+                    np.concatenate(p) if p else np.zeros((0,), np.int64)
+                    for p in next_pending]
+                if (sum(len(p) for p in new_pending)
+                        >= sum(len(p) for p in pending)):
+                    stuck = True
+                    leftover = sum(len(p) for p in new_pending)
+                    break
+                pending = new_pending
+            if not stuck:
+                self.fp, self.state = fp, state
+                self.per_shard_slots = per_new
+                return
+            per_new *= 2
+        raise RuntimeError(
+            f"sharded fingerprint rehash cannot place {leftover} entries "
+            f"even at {per_new // 2} slots/shard")
 
     def sweep(self) -> int:
         """Elementwise TTL sweep across every shard — the single-chip
@@ -552,6 +587,7 @@ class ShardedFpDeviceStore:
                 "n_shards": self.n_shards,
                 "per_shard": self.per_shard_slots,
                 "probe_window": self.probe_window,
+                "placement": F.PLACEMENT_VERSION,
                 "fp": np.asarray(self.fp),
                 "gcounter": {
                     "value": float(np.asarray(self.gcounter.value)),
@@ -580,8 +616,37 @@ class ShardedFpDeviceStore:
                     "fp % n_shards — re-sharding is key redistribution)")
             self._check_config_snap(snap)
             shift = int(self.clock.now_ticks()) - int(snap["now_ticks"])
-            self.per_shard_slots = int(snap["per_shard"])
             new_pw = int(snap.get("probe_window", self.probe_window))
+            cls = type(self.state)
+            raw_cols = []
+            for f in cls._fields:
+                a = snap[f]
+                if f == "last_ts":
+                    a = _shift_ts(a, shift)
+                elif f == "window_idx":
+                    a = _shift_ts(a, shift // self.window_ticks)
+                raw_cols.append(np.asarray(a))
+            # Install the tables FIRST — the legacy re-place below can
+            # raise, and config committed before a failed install would
+            # leave a half-restored store whose probe geometry no longer
+            # matches its live tables.
+            if snap.get("placement") != F.PLACEMENT_VERSION:
+                # Pre-v2 snapshots placed entries with the wrapping h % n
+                # window; verbatim install under the non-wrapping
+                # placement would orphan nearly every key. Re-place
+                # through the migrate kernel (shard routing is
+                # placement-invariant, so entries stay in their shards).
+                self._rehash_locked(
+                    np.asarray(snap["fp"]).reshape(self.n_shards, -1, 2),
+                    [c.reshape(self.n_shards, -1) for c in raw_cols],
+                    int(snap["per_shard"]), probe_window=new_pw)
+            else:
+                fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+                shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+                self.fp = jax.device_put(jnp.asarray(snap["fp"]), fp_shard)
+                self.state = cls(*(jax.device_put(jnp.asarray(a), shard)
+                                   for a in raw_cols))
+                self.per_shard_slots = int(snap["per_shard"])
             if new_pw != self.probe_window:
                 # The jitted steps bake probe_window in at construction;
                 # entries placed deep in a wider window would be
@@ -597,19 +662,6 @@ class ShardedFpDeviceStore:
                     last_ts=jnp.int32(max(0, g["last_ts"] + shift)),
                     exists=jnp.asarray(g["exists"])),
                     NamedSharding(self.mesh, P()))
-            fp_shard = NamedSharding(self.mesh, P(SHARD_AXIS, None))
-            shard = NamedSharding(self.mesh, P(SHARD_AXIS))
-            self.fp = jax.device_put(jnp.asarray(snap["fp"]), fp_shard)
-            cls = type(self.state)
-            cols = []
-            for f in cls._fields:
-                a = snap[f]
-                if f == "last_ts":
-                    a = _shift_ts(a, shift)
-                elif f == "window_idx":
-                    a = _shift_ts(a, shift // self.window_ticks)
-                cols.append(jax.device_put(jnp.asarray(a), shard))
-            self.state = cls(*cols)
 
 
 def _make_sharded_fp_peek_step(mesh, *, probe_window: int):

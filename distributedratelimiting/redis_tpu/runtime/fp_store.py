@@ -63,6 +63,7 @@ __all__ = ["FingerprintBucketStore", "fingerprints"]
 
 _FNV_OFFSET = 1469598103934665603
 _FNV_PRIME = 1099511628211
+_PLACEMENT_VERSION = F.PLACEMENT_VERSION
 
 
 def _fp64_py(key: str) -> int:
@@ -109,6 +110,12 @@ class _FpTable:
 
     def __init__(self, store: "FingerprintBucketStore", capacity: float,
                  fill_rate_per_sec: float, n_slots: int) -> None:
+        if n_slots < store.probe_window:
+            # n - L + 1 must stay positive: the non-wrapping window
+            # placement (_base_index) is undefined below one window.
+            raise ValueError(
+                f"n_slots ({n_slots}) must be >= probe_window "
+                f"({store.probe_window})")
         self.store = store
         self.capacity = float(capacity)
         self.fill_rate_per_sec = float(fill_rate_per_sec)
@@ -261,8 +268,11 @@ class _FpTable:
         remaining = np.empty((n,), np.float32) if with_remaining else None
         pressure = 0
         pos = 0
-        for out_d, take in outs:
-            arr = np.asarray(out_d)  # the dispatch's ONE fetch
+        # One device_get over every dispatch's handle: lets the runtime
+        # overlap the fetches instead of paying one link RTT per dispatch
+        # sequentially (multi-dispatch calls on a ~70 ms-RTT day).
+        arrs = jax.device_get([h for h, _ in outs])
+        for arr, (_, take) in zip(arrs, outs):
             if arr.dtype == np.uint8:  # u8[K, 2, B//8] bit-planes
                 granted[pos:pos + take] = np.unpackbits(
                     arr[:, 0, :].reshape(-1),
@@ -366,17 +376,40 @@ class _FpTable:
         host never computes a placement."""
         store = self.store
         with store._lock:
-            old_fp = np.asarray(self.fp)
-            pending = np.nonzero((old_fp != 0).any(-1))[0]
-            olds = [np.asarray(a) for a in self.state]
-            new_n = self.n_slots * 2
+            self._rehash(np.asarray(self.fp),
+                         [np.asarray(a) for a in self.state],
+                         self.n_slots * 2)
+            store.metrics.pregrows += 1
+
+    def _rehash(self, old_fp: np.ndarray, olds: list, start_n: int,
+                probe_window: int | None = None) -> None:
+        """Re-place every live entry into a fresh table via the migrate
+        kernel, doubling and retrying when placement gets stuck — the
+        shared driver behind growth AND legacy-snapshot adoption (caller
+        holds the store lock; ``olds`` are state columns in field order).
+        Mutates nothing until placement succeeds, so a raise leaves the
+        table exactly as it was. ``probe_window`` lets snapshot adoption
+        place under the snapshot's geometry before committing it.
+
+        An entry whose whole window fills with OTHER entries is
+        unplaceable at a given size — a density accident, not a bug
+        (observed at ~0.8 load). Doubling always converges (load halves
+        per attempt); the attempt cap makes a pathological hash set fail
+        loudly instead of allocating forever."""
+        pw = self.probe_window if probe_window is None else probe_window
+        entries = np.nonzero((old_fp != 0).any(-1))[0]
+        migrate = self._migrate_kernel()
+        b = self.store.max_batch
+        new_n = start_n
+        leftover = 0
+        for _attempt in range(4):
             fp, state = self._init_fresh(new_n)
-            migrate = self._migrate_kernel()
-            b = self.store.max_batch
+            pending = entries
+            stuck = False
             # Entries a pass can't place (bounded insert rounds under
-            # in-chunk window contention) are retried in later passes;
-            # each pass places ≥1 contender per contested cell, so a pass
-            # with zero progress means the table is genuinely unplaceable.
+            # in-chunk window contention) retry in later passes; each
+            # pass places ≥1 contender per contested cell, so a pass
+            # with zero progress means some window is genuinely full.
             while len(pending):
                 next_pending = []
                 for pos in range(0, len(pending), b):
@@ -393,8 +426,10 @@ class _FpTable:
                     valid[:m] = True
                     fp, state, placed = migrate(
                         fp, state, jnp.asarray(kpair),
-                        *(jnp.asarray(c) for c in cols), jnp.asarray(valid),
-                        probe_window=self.probe_window, rounds=self.rounds)
+                        *(jnp.asarray(c) for c in cols),
+                        jnp.asarray(valid),
+                        probe_window=pw,
+                        rounds=self.rounds)
                     miss = ~np.asarray(placed)[:m]
                     if miss.any():
                         next_pending.append(idx[miss])
@@ -402,14 +437,17 @@ class _FpTable:
                     break
                 next_pending = np.concatenate(next_pending)
                 if len(next_pending) >= len(pending):
-                    # Halved load factor makes this effectively
-                    # unreachable; refuse to lose state silently.
-                    raise RuntimeError(
-                        f"fingerprint rehash cannot place "
-                        f"{len(next_pending)} entries")
+                    stuck, leftover = True, len(next_pending)
+                    break
                 pending = next_pending
-            self.fp, self.state, self.n_slots = fp, state, new_n
-            store.metrics.pregrows += 1
+            if not stuck:
+                self.fp, self.state, self.n_slots = fp, state, new_n
+                self.probe_window = pw
+                return
+            new_n *= 2
+        raise RuntimeError(
+            f"fingerprint rehash cannot place {leftover} entries even "
+            f"at {new_n // 2} slots")
 
     def rebase(self, offset: int) -> None:
         self.state = K.rebase_bucket_epoch(self.state, jnp.int32(offset))
@@ -419,6 +457,7 @@ class _FpTable:
         return {
             "fp": np.asarray(self.fp),
             "probe_window": self.probe_window,
+            "placement": _PLACEMENT_VERSION,
             "tokens": np.asarray(self.state.tokens),
             "last_ts": np.asarray(self.state.last_ts),
             "exists": np.asarray(self.state.exists),
@@ -433,13 +472,27 @@ class _FpTable:
         # placed at offset 12 of a 16-cell window is invisible to an
         # 8-cell scan — restoring into a narrower window would silently
         # orphan such entries (and later duplicate their fingerprints).
-        self.probe_window = int(data.get("probe_window", self.probe_window))
+        pw = int(data.get("probe_window", self.probe_window))
+        cols = [np.asarray(data["tokens"]),
+                np.asarray(_shift_ts(data["last_ts"], shift)),
+                np.asarray(data["exists"])]
+        if data.get("placement") != _PLACEMENT_VERSION:
+            # Pre-v2 snapshots placed entries with a WRAPPING h % n
+            # window; installing them verbatim under today's non-wrapping
+            # placement would silently orphan nearly every key. Re-place
+            # everything through the migrate kernel instead — it commits
+            # table AND probe_window only on success, so a failed restore
+            # leaves this table fully intact.
+            self._rehash(np.asarray(data["fp"]), cols, len(cols[0]),
+                         probe_window=pw)
+            return
+        self.probe_window = pw
         self.n_slots = len(data["tokens"])
         self.fp = jnp.asarray(data["fp"])
         self.state = K.BucketState(
-            tokens=jnp.asarray(data["tokens"]),
-            last_ts=jnp.asarray(_shift_ts(data["last_ts"], shift)),
-            exists=jnp.asarray(data["exists"]),
+            tokens=jnp.asarray(cols[0]),
+            last_ts=jnp.asarray(cols[1]),
+            exists=jnp.asarray(cols[2]),
         )
 
 
@@ -452,6 +505,10 @@ class _FpWindowTable(_FpTable):
     def __init__(self, store: "FingerprintBucketStore", limit: float,
                  window_ticks: int, n_slots: int, *,
                  fixed: bool = False) -> None:
+        if n_slots < store.probe_window:
+            raise ValueError(
+                f"n_slots ({n_slots}) must be >= probe_window "
+                f"({store.probe_window})")
         self.store = store
         self.limit = float(limit)
         self.window_ticks = int(window_ticks)
@@ -524,6 +581,7 @@ class _FpWindowTable(_FpTable):
         return {
             "fp": np.asarray(self.fp),
             "probe_window": self.probe_window,
+            "placement": _PLACEMENT_VERSION,
             "prev_count": np.asarray(self.state.prev_count),
             "curr_count": np.asarray(self.state.curr_count),
             "window_idx": np.asarray(self.state.window_idx),
@@ -535,15 +593,26 @@ class _FpWindowTable(_FpTable):
             raise ValueError(
                 "checkpoint's window tables use the host key directory — "
                 "restore into a DeviceBucketStore")
-        self.probe_window = int(data.get("probe_window", self.probe_window))
+        pw = int(data.get("probe_window", self.probe_window))
+        cols = [np.asarray(data["prev_count"]),
+                np.asarray(data["curr_count"]),
+                np.asarray(_shift_ts(data["window_idx"],
+                                     shift // self.window_ticks)),
+                np.asarray(data["exists"])]
+        if data.get("placement") != _PLACEMENT_VERSION:
+            # Pre-v2 wrapping placement: re-place via the migrate kernel
+            # (see _FpTable.load_snap — commit-on-success).
+            self._rehash(np.asarray(data["fp"]), cols, len(cols[0]),
+                         probe_window=pw)
+            return
+        self.probe_window = pw
         self.n_slots = len(data["prev_count"])
         self.fp = jnp.asarray(data["fp"])
         self.state = K.WindowState(
-            prev_count=jnp.asarray(data["prev_count"]),
-            curr_count=jnp.asarray(data["curr_count"]),
-            window_idx=jnp.asarray(
-                _shift_ts(data["window_idx"], shift // self.window_ticks)),
-            exists=jnp.asarray(data["exists"]),
+            prev_count=jnp.asarray(cols[0]),
+            curr_count=jnp.asarray(cols[1]),
+            window_idx=jnp.asarray(cols[2]),
+            exists=jnp.asarray(cols[3]),
         )
 
 

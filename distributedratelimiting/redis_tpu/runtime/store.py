@@ -597,10 +597,12 @@ class _PackedLaunchMixin:
         granted = np.empty((n,), bool)
         remaining = np.empty((n,), np.float32) if with_remaining else None
         pos = 0
-        for out, take in outs:
-            # ONE device→host fetch per dispatch (fetches are RTT-bound on
-            # tunneled links — this is the bulk path's whole latency story).
-            out_np = np.asarray(out)
+        # ONE device→host fetch per dispatch, and ONE device_get across
+        # dispatches so those fetches overlap instead of serializing a
+        # link RTT each (fetches are RTT-bound on tunneled links — this
+        # is the bulk path's whole latency story).
+        arrs = jax.device_get([h for h, _ in outs])
+        for out_np, (_, take) in zip(arrs, outs):
             if out_np.dtype == np.uint8:       # bit-packed grants
                 bits = np.unpackbits(out_np.reshape(-1), bitorder="little")
                 granted[pos:pos + take] = bits[:take].astype(bool)

@@ -257,6 +257,27 @@ class TestFingerprintStore:
 
         run(main())
 
+    def test_bulk_negative_count_stays_valid_row(self):
+        # pack_fp12 clamps counts on BOTH sides: a negative ask must stay
+        # a valid row (kernel grants count<=0 like every other path), not
+        # wrap into uint32 sign-bit range and read as a padding row.
+        async def main():
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=256, clock=clock)
+            res = await store.acquire_many(["neg", "pos"], [-1, 1],
+                                           5.0, 0.0)
+            assert bool(res.granted[0]) and bool(res.granted[1])
+            # The clamped ask consumed nothing: the bucket is still full,
+            # so a full-capacity ask grants and the next one is denied —
+            # i.e. the row resolved into a real bucket, not padding.
+            res2 = await store.acquire_many(["neg", "neg"], [5, 1],
+                                            5.0, 0.0,
+                                            with_remaining=False)
+            assert bool(res2.granted[0]) and not bool(res2.granted[1])
+            await store.aclose()
+
+        run(main())
+
     def test_bulk_verdict_only_odd_max_batch(self):
         # max_batch not divisible by 8 cannot use bit-planes; the path
         # must fall back to the f32 fused result instead of crashing
@@ -361,6 +382,49 @@ class TestFingerprintStore:
             assert not res.granted.any()  # 3 left of 5 per key
             await store.aclose()
             await fresh.aclose()
+
+        run(main())
+
+    def test_restore_replaces_legacy_wrapping_placement(self):
+        # Pre-v2 snapshots placed entries at base = mix(fp) % n (wrapping
+        # window). Restoring one must RE-PLACE entries through the
+        # migrate kernel, not install the table verbatim — under today's
+        # non-wrapping base = mix(fp) % (n - L + 1) most legacy positions
+        # are invisible to the probe, and their state would silently
+        # reset.
+        async def main():
+            from distributedratelimiting.redis_tpu.runtime.fp_store import (
+                fingerprints,
+            )
+
+            n = 256
+            keys = [f"legacy{i}" for i in range(20)]
+            fps = fingerprints(keys)
+            h = (fps[:, 0] * np.uint32(0x9E3779B1)) ^ fps[:, 1]
+            base_old = h % np.uint32(n)
+            fp_tab = np.zeros((n, 2), np.uint32)
+            tokens = np.zeros((n,), np.float32)
+            last_ts = np.zeros((n,), np.int32)
+            exists = np.zeros((n,), bool)
+            for i, b in enumerate(base_old):
+                assert not fp_tab[b].any(), "test keys must not collide"
+                fp_tab[b] = fps[i]       # sparse table: old code placed
+                tokens[b] = float(i)     # each key at its window's base
+                exists[b] = True
+            clock = ManualClock()
+            store = FingerprintBucketStore(n_slots=n, clock=clock)
+            store.acquire_blocking("warm", 1, 100.0, 0.0)  # make the table
+            snap = store.snapshot()
+            key0 = next(iter(snap["tables"]))
+            legacy = {"fp": fp_tab, "probe_window": 16,  # no "placement":
+                      "tokens": tokens, "last_ts": last_ts,  # a v1 form
+                      "exists": exists}
+            snap["tables"] = {key0: legacy}
+            store.restore(snap)
+            for i, k in enumerate(keys):
+                got = store.peek_blocking(k, 100.0, 0.0)
+                assert got == float(int(i)), (k, got, i)
+            await store.aclose()
 
         run(main())
 
