@@ -26,6 +26,17 @@ def test_cli_runs_named_config(capsys):
     assert json.loads(line)["config"] == "single_bucket_cpu"
 
 
+def test_scaleout_harness_smoke():
+    # The aggregate scale-out harness (benchmarks/scaleout.py) spawns
+    # real server/client processes over localhost TCP; keep it runnable.
+    from benchmarks import scaleout
+
+    out = scaleout._measure(1, 1, 1.0, "cpu")
+    assert out["n_nodes"] == 1 and out["n_clients"] == 1
+    assert out["aggregate_decisions_per_sec"] > 0
+    json.dumps(out)
+
+
 def test_two_level_global_tier_accumulates():
     result = suite.CONFIGS["two_level_mesh"](smoke=True)
     # Every request grants (huge capacity), so the psum-fed global counter

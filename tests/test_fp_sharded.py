@@ -194,6 +194,65 @@ class TestShardedFp:
         assert res.remaining is None
 
 
+class TestShardedFpCheckpoint:
+    def test_snapshot_restore_roundtrip(self, mesh):
+        store = make_store(mesh)
+        keys = [f"r{i}" for i in range(60)]
+        store.acquire_many_blocking(keys, [3] * 60)  # 2 of 5 left each
+        snap = store.snapshot()
+        other = make_store(mesh)
+        other.restore(snap)
+        res = other.acquire_many_blocking(keys, [3] * 60,
+                                          with_remaining=False)
+        assert not res.granted.any()  # consumption survived
+        res2 = other.acquire_many_blocking(keys, [2] * 60,
+                                           with_remaining=False)
+        assert res2.granted.all()
+
+    def test_restore_replaces_legacy_placement(self, mesh):
+        # A snapshot without the placement marker (pre-v2, wrapping
+        # window bases) must be re-placed through the migrate kernel —
+        # verbatim install under the non-wrapping placement would orphan
+        # nearly every key and silently reset its consumption.
+        store = make_store(mesh)
+        keys = [f"lg{i}" for i in range(60)]
+        store.acquire_many_blocking(keys, [5] * 60)  # drain to 0
+        snap = store.snapshot()
+        snap.pop("placement")
+        # Move every entry to its OLD wrapping base so the snapshot
+        # really is in v1 form (sparse tables: old code placed each key
+        # at its window's first cell).
+        fp = np.array(snap["fp"])
+        n_shards = snap["n_shards"]
+        per = snap["per_shard"]
+        cols = {f: np.array(snap[f])
+                for f in ("tokens", "last_ts", "exists")}
+        fp_sh = fp.reshape(n_shards, per, 2)
+        cols_sh = {f: a.reshape(n_shards, per) for f, a in cols.items()}
+        new_fp = np.zeros_like(fp_sh)
+        new_cols = {f: np.zeros_like(a) for f, a in cols_sh.items()}
+        for s in range(n_shards):
+            live = np.nonzero((fp_sh[s] != 0).any(-1))[0]
+            for i in live:
+                pair = fp_sh[s][i]
+                h = np.uint32(
+                    (int(pair[0]) * 0x9E3779B1) & 0xFFFFFFFF) ^ pair[1]
+                b = int(h % np.uint32(per))  # the v1 wrapping base
+                assert not new_fp[s][b].any(), "collision in test data"
+                new_fp[s][b] = pair
+                for f in new_cols:
+                    new_cols[f][s][b] = cols_sh[f][s][i]
+        snap["fp"] = new_fp.reshape(fp.shape)
+        for f, a in new_cols.items():
+            snap[f] = a.reshape(cols[f].shape)
+        other = make_store(mesh)
+        other.restore(snap)
+        res = other.acquire_many_blocking(keys, [1] * 60,
+                                          with_remaining=False)
+        assert not res.granted.any(), \
+            "legacy restore lost drained-bucket state"
+
+
 class TestFpSyncCadence:
     def test_launch_cadence_matches_batch(self, mesh):
         """Deferred psum on the fp tier: identical grants, same global
