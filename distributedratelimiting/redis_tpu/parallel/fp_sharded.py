@@ -95,35 +95,37 @@ def make_sharded_fp_window_scan_step(mesh, *, probe_window: int = 16,
     fused in-shard probe/insert + sliding/fixed-window decision, no
     collectives at all (windows have no cross-key state; the global tier
     is the approximate BUCKET algorithm's). ``interpolate=False`` =
-    fixed-window semantics. Returns
-    ``(fp, state, granted, remaining, resolved)``."""
+    fixed-window semantics. Same one-operand/one-result transfer shape:
+    takes ``fused_k u32[n_shards, K, B, 3]``, returns
+    ``(fp, state, out f32[n_shards, K, 2, B])``."""
     fp_spec = P(SHARD_AXIS, None)
     state_specs = K.WindowState(P(SHARD_AXIS), P(SHARD_AXIS),
                                 P(SHARD_AXIS), P(SHARD_AXIS))
-    batch_spec = P(SHARD_AXIS, None, None)
-    kpair_spec = P(SHARD_AXIS, None, None, None)
+    fused_spec = P(SHARD_AXIS, None, None, None)
+    out_spec = P(SHARD_AXIS, None, None, None)
 
-    def block(fp, state, kpairs, counts, valid, nows, limit, window_ticks):
+    def block(fp, state, fused, nows, limit, window_ticks):
         def body(carry, xs):
             f, st = carry
-            kp, ct, va, now = xs
+            fu, now = xs
+            kp, ct, va = F._unpack_fp12(fu)
             f, st, granted, remaining, resolved = F._fp_window_core(
                 f, st, kp, ct, va, now, limit, window_ticks,
                 probe_window=probe_window, rounds=rounds,
                 handle_duplicates=handle_duplicates,
                 interpolate=interpolate)
-            return (f, st), (granted, remaining, resolved)
+            code = (granted.astype(jnp.float32)
+                    + 2.0 * resolved.astype(jnp.float32))
+            return (f, st), jnp.stack([code, remaining])
 
-        (fp, state), (granted, remaining, resolved) = jax.lax.scan(
-            body, (fp, state), (kpairs[0], counts[0], valid[0], nows))
-        return (fp, state, granted[None], remaining[None], resolved[None])
+        (fp, state), out = jax.lax.scan(
+            body, (fp, state), (fused[0], nows))
+        return (fp, state, out[None])
 
     mapped = shard_map(
         block, mesh=mesh,
-        in_specs=(fp_spec, state_specs, kpair_spec, batch_spec, batch_spec,
-                  P(), P(), P()),
-        out_specs=(fp_spec, state_specs, batch_spec, batch_spec,
-                   batch_spec),
+        in_specs=(fp_spec, state_specs, fused_spec, P(), P(), P()),
+        out_specs=(fp_spec, state_specs, out_spec),
     )
     return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -135,32 +137,35 @@ def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
     """Jitted sharded fused resolve+acquire with the psum global tier.
 
     Layout: ``fp u32[N, 2]`` and bucket state sharded along keys
-    (``P(SHARD_AXIS)``); batch ``kpairs_k u32[n_shards, K, B, 2]`` /
-    ``counts_k`` / ``valid_k`` sharded on axis 0 with shard-LOCAL
-    fingerprints; ``nows_k i32[K]`` replicated. Each scanned batch runs
-    probe/insert + decision in-shard; the scalar psum feeding the
-    replicated decaying global counter runs per scanned batch
-    (``sync_cadence="batch"``) or once per launch over the accumulated
-    consumed count (``"launch"`` — same deployable cadence trade as
+    (``P(SHARD_AXIS)``); batch ``fused_k u32[n_shards, K, B, 3]`` (the
+    :func:`~..ops.fp_directory.pack_fp12` layout — ONE operand array per
+    launch, shard-LOCAL fingerprints) sharded on axis 0; ``nows_k
+    i32[K]`` replicated. Each scanned batch runs probe/insert + decision
+    in-shard; the scalar psum feeding the replicated decaying global
+    counter runs per scanned batch (``sync_cadence="batch"``) or once
+    per launch over the accumulated consumed count (``"launch"`` — same
+    deployable cadence trade as
     :func:`~.sharded_store.make_two_level_scan_step_deferred`; grants are
     bit-identical, counter staleness ≤ one launch's span).
 
-    Returns ``(fp, state, granted, remaining, resolved, gcounter)``.
+    Returns ``(fp, state, out f32[n_shards, K, 2, B], gcounter)`` — the
+    result rides ONE array per launch: row 0 encodes
+    ``granted + 2·resolved`` exactly, row 1 is remaining.
     """
     if sync_cadence not in ("batch", "launch"):
         raise ValueError("sync_cadence must be 'batch' or 'launch'")
     fp_spec = P(SHARD_AXIS, None)
     state_specs = K.BucketState(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
     gspecs = GlobalCounter(P(), P(), P(), P())
-    batch_spec = P(SHARD_AXIS, None, None)
-    kpair_spec = P(SHARD_AXIS, None, None, None)
+    fused_spec = P(SHARD_AXIS, None, None, None)
+    out_spec = P(SHARD_AXIS, None, None, None)
     deferred = sync_cadence == "launch"
 
-    def block(fp, state, kpairs, counts, valid, nows, capacity, rate,
-              gcounter, decay_rate):
+    def block(fp, state, fused, nows, capacity, rate, gcounter, decay_rate):
         def body(carry, xs):
             f, st, g, consumed_acc = carry
-            kp, ct, va, now = xs
+            fu, now = xs
+            kp, ct, va = F._unpack_fp12(fu)
             f, st, granted, remaining, resolved = F._fp_acquire_core(
                 f, st, kp, ct, va, now, capacity, rate,
                 probe_window=probe_window, rounds=rounds,
@@ -171,31 +176,29 @@ def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
             else:
                 total = jax.lax.psum(consumed, SHARD_AXIS)
                 g = global_tier_update(g, total, now, decay_rate)
-            return (f, st, g, consumed_acc), (granted, remaining, resolved)
+            code = (granted.astype(jnp.float32)
+                    + 2.0 * resolved.astype(jnp.float32))
+            return (f, st, g, consumed_acc), jnp.stack([code, remaining])
 
         # The accumulator is per-shard ("varying" over the mesh axis inside
         # shard_map); the initial zero must be cast to match.
         zero = jax.lax.pcast(jnp.zeros((), jnp.float32), (SHARD_AXIS,),
                              to="varying")
-        ((fp, state, gcounter, consumed_total),
-         (granted, remaining, resolved)) = jax.lax.scan(
-            body, (fp, state, gcounter, zero),
-            (kpairs[0], counts[0], valid[0], nows))
+        ((fp, state, gcounter, consumed_total), out) = jax.lax.scan(
+            body, (fp, state, gcounter, zero), (fused[0], nows))
         if deferred:
             total = jax.lax.psum(consumed_total, SHARD_AXIS)  # ONE/launch
             gcounter = global_tier_update(gcounter, total, nows[-1],
                                           decay_rate)
-        return (fp, state, granted[None], remaining[None], resolved[None],
-                gcounter)
+        return (fp, state, out[None], gcounter)
 
     mapped = shard_map(
         block, mesh=mesh,
-        in_specs=(fp_spec, state_specs, kpair_spec, batch_spec, batch_spec,
-                  P(), P(), P(), gspecs, P()),
-        out_specs=(fp_spec, state_specs, batch_spec, batch_spec, batch_spec,
-                   gspecs),
+        in_specs=(fp_spec, state_specs, fused_spec, P(), P(), P(), gspecs,
+                  P()),
+        out_specs=(fp_spec, state_specs, out_spec, gspecs),
     )
-    return jax.jit(mapped, donate_argnums=(0, 1, 8))
+    return jax.jit(mapped, donate_argnums=(0, 1, 6))
 
 
 class ShardedFpDeviceStore:
@@ -286,16 +289,15 @@ class ShardedFpDeviceStore:
             self.mesh, probe_window=self.probe_window, rounds=self.rounds,
             sync_cadence=self.sync_cadence)
 
-    def _launch(self, kpairs, cts, val, nows):
+    def _launch(self, fused, nows):
         """One scanned fused dispatch (caller holds the lock); updates
-        the table in place, returns (granted, remaining, resolved)."""
-        (self.fp, self.state, g_d, r_d, res_d,
-         self.gcounter) = self._step(
-            self.fp, self.state, jnp.asarray(kpairs), jnp.asarray(cts),
-            jnp.asarray(val), jnp.asarray(nows),
+        the table in place, returns the ``f32[S, K, 2, B]`` result
+        handle (code row = granted + 2·resolved, row 1 = remaining)."""
+        self.fp, self.state, out, self.gcounter = self._step(
+            self.fp, self.state, jnp.asarray(fused), jnp.asarray(nows),
             jnp.float32(self.capacity), jnp.float32(self.rate_per_tick),
             self.gcounter, jnp.float32(self.decay_per_tick))
-        return g_d, r_d, res_d
+        return out
 
     @property
     def global_score(self) -> float:
@@ -358,16 +360,27 @@ class ShardedFpDeviceStore:
             # pair a pre-rebase `now` with post-rebase state.
             now = self.now_ticks_checked()
             call_pressure = 0
+            # Per-DEVICE operand budget (each shard's slice rides its own
+            # host→device link): scan depth shrinks before one device's
+            # slice crosses the ~768KB-1MB transfer collapse — the
+            # single-chip fp store's _BULK_BYTE_BUDGET discipline.
+            max_k = self._BULK_MAX_K
+            while max_k > 1 and max_k * b * 12 > 640 * 1024:
+                max_k //= 2
             while pos < rows:
                 k = 1
                 need_rows = -(-(rows - pos) // b)
-                while k < need_rows and k < self._BULK_MAX_K:
+                while k < need_rows and k < max_k:
                     k *= 2
                 take = k * b
-                kpairs = np.zeros((self.n_shards, k * b, 2), np.uint32)
-                cts = np.zeros((self.n_shards, k * b), np.int32)
-                val = np.zeros((self.n_shards, k * b), bool)
+                # ONE fused operand per launch (pack_fp12 layout: lo, hi,
+                # count; 0xFFFFFFFF count ⇒ padding) and ONE result array
+                # back — transfer-count discipline, same as the
+                # single-chip fp bulk path.
+                fused = np.zeros((self.n_shards, k * b, 3), np.uint32)
+                fused[:, :, 2] = np.uint32(0xFFFFFFFF)
                 sel = []  # (shard, local slice, global order slice)
+                n_valid = 0
                 for s in range(self.n_shards):
                     lo = bounds[s] + pos
                     hi = min(bounds[s + 1], lo + take)
@@ -375,25 +388,22 @@ class ShardedFpDeviceStore:
                     if m == 0:
                         continue
                     idx = order[lo:hi]
-                    kpairs[s, :m] = fps[idx]
-                    cts[s, :m] = np.minimum(counts_np[idx], 2**31 - 1)
-                    val[s, :m] = True
+                    fused[s, :m] = F.pack_fp12(fps[idx], counts_np[idx])
+                    n_valid += m
                     sel.append((s, m, idx))
                 nows = np.full((k,), now, np.int32)
-                g_d, r_d, res_d = self._launch(
-                    kpairs.reshape(self.n_shards, k, b, 2),
-                    cts.reshape(self.n_shards, k, b),
-                    val.reshape(self.n_shards, k, b), nows)
-                self.metrics.record_launch(self.n_shards * k * b,
-                                           int(val.sum()))
-                g_np = np.asarray(g_d).reshape(self.n_shards, -1)
-                r_np = np.asarray(r_d).reshape(self.n_shards, -1)
-                res_np = np.asarray(res_d).reshape(self.n_shards, -1)
+                out_d = self._launch(
+                    fused.reshape(self.n_shards, k, b, 3), nows)
+                self.metrics.record_launch(self.n_shards * k * b, n_valid)
+                out_np = np.asarray(out_d)  # [S, K, 2, B]
+                code = out_np[:, :, 0, :].reshape(
+                    self.n_shards, -1).astype(np.int32)
+                r_np = out_np[:, :, 1, :].reshape(self.n_shards, -1)
                 for s, m, idx in sel:
-                    granted[idx] = g_np[s, :m]
+                    granted[idx] = (code[s, :m] & 1).astype(bool)
                     if remaining is not None:
                         remaining[idx] = r_np[s, :m]
-                    call_pressure += int((~res_np[s, :m]).sum())
+                    call_pressure += int((~((code[s, :m] & 2) > 0)).sum())
                 pos += take
             self.fp_unresolved += call_pressure
             self.metrics.fp_unresolved += call_pressure
@@ -713,12 +723,11 @@ class ShardedFpWindowStore(ShardedFpDeviceStore):
             self.mesh, probe_window=self.probe_window, rounds=self.rounds,
             interpolate=not self.fixed)
 
-    def _launch(self, kpairs, cts, val, nows):
-        self.fp, self.state, g_d, r_d, res_d = self._step(
-            self.fp, self.state, jnp.asarray(kpairs), jnp.asarray(cts),
-            jnp.asarray(val), jnp.asarray(nows), jnp.float32(self.limit),
-            jnp.int32(self.window_ticks))
-        return g_d, r_d, res_d
+    def _launch(self, fused, nows):
+        self.fp, self.state, out = self._step(
+            self.fp, self.state, jnp.asarray(fused), jnp.asarray(nows),
+            jnp.float32(self.limit), jnp.int32(self.window_ticks))
+        return out
 
     def peek_blocking(self, key: str) -> float:
         raise NotImplementedError(

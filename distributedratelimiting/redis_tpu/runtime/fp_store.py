@@ -105,6 +105,12 @@ class _FpTable:
 
     #: Scan depth cap for bulk dispatches (mirrors _PackedLaunchMixin).
     _BULK_MAX_K = 16
+    #: Per-dispatch operand byte budget: the tunnel's sustained rate
+    #: collapses ~5-10x when one dispatch's operands cross ~768KB-1MB
+    #: (RESULTS.md "Transfer-bound analysis"); the classic store pins its
+    #: compact path at 640KB with margin — same discipline here, at the
+    #: fused layout's 12 B/decision.
+    _BULK_BYTE_BUDGET = 640 * 1024
     #: Grow when (occupied / n_slots) crosses this after window pressure.
     _GROW_AT = 0.7
 
@@ -240,10 +246,13 @@ class _FpTable:
         pos = 0
         with store.profiler.span("acquire_many_fp", n), store._lock:
             now = store.now_ticks_checked()
+            max_k = self._BULK_MAX_K
+            while max_k > 1 and max_k * b * 12 > self._BULK_BYTE_BUDGET:
+                max_k //= 2
             while pos < n:
                 rows = -(-(n - pos) // b)
                 k = 1
-                while k < rows and k < self._BULK_MAX_K:
+                while k < rows and k < max_k:
                     k *= 2
                 take = min(k * b, n - pos)
                 kp = np.zeros((k * b, 2), np.uint32)
