@@ -123,16 +123,32 @@ def test_batched_duplicates_match_serialized_singles():
 
 
 @pytest.mark.parametrize("seed", [20, 21])
-def test_bulk_paths_match_serial_reference(seed):
+@pytest.mark.parametrize("directory", ["host", "fp"])
+def test_bulk_paths_match_serial_reference(seed, directory):
     """Differential fuzz of the BULK surfaces (buckets + sliding/fixed
-    windows, grouped coalescing on): duplicate-free random bulk calls
-    must decide identically to a serial per-request replay; time advances
-    between calls exercise refill/rollover inside the bulk kernels."""
+    windows, grouped coalescing on), parametrized over BOTH key-directory
+    homes: duplicate-free random bulk calls must decide identically to a
+    serial per-request replay — the directory must be decision-invisible.
+    Time advances between calls exercise refill/rollover inside the bulk
+    kernels; the randomized ``with_remaining`` flag exercises both result
+    encodings (f32 fused and, on the fp store, bit-plane verdicts), and
+    ``remaining`` is asserted against the reference whenever present."""
+    from distributedratelimiting.redis_tpu.runtime.fp_store import (
+        FingerprintBucketStore,
+    )
+
     rng = np.random.default_rng(seed)
     clock_a = ManualClock()
     clock_b = ManualClock()
-    dev = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock_a,
-                            max_batch=16)  # forces multi-chunk dispatches
+    cls = DeviceBucketStore if directory == "host" else FingerprintBucketStore
+    # 256 slots for 40 keys: pressure-free for the fp directory's 16-cell
+    # probe windows. Under window pressure the fp store's documented
+    # deny-and-heal contract legitimately diverges from the serial
+    # reference for one call (observed at 64 slots: one full window →
+    # a zero-count probe came back remaining=0); the equivalence claim
+    # fuzzed here is the pressure-free one, asserted at the bottom.
+    dev = cls(n_slots=256, counter_slots=8, clock=clock_a,
+              max_batch=16)  # forces multi-chunk dispatches
     ref = InProcessBucketStore(clock=clock_b)
     keys = [f"k{i}" for i in range(40)]
 
@@ -141,27 +157,42 @@ def test_bulk_paths_match_serial_reference(seed):
         sub = [keys[i] for i in picked]
         counts = [int(c) for c in rng.integers(0, 4, size=24)]
         family = step % 3
+        wr = bool(rng.random() < 0.5)
         if family == 0:
-            got = dev.acquire_many_blocking(sub, counts, 8.0, 2.0)
+            got = dev.acquire_many_blocking(sub, counts, 8.0, 2.0,
+                                            with_remaining=wr)
             want = [ref.acquire_blocking(k, c, 8.0, 2.0)
                     for k, c in zip(sub, counts)]
         elif family == 1:
-            got = dev.window_acquire_many_blocking(sub, counts, 6.0, 1.0)
+            got = dev.window_acquire_many_blocking(sub, counts, 6.0, 1.0,
+                                                   with_remaining=wr)
             want = [ref.window_acquire_blocking(k, c, 6.0, 1.0)
                     for k, c in zip(sub, counts)]
         else:
             got = dev.window_acquire_many_blocking(sub, counts, 6.0, 1.0,
-                                                   fixed=True)
+                                                   fixed=True,
+                                                   with_remaining=wr)
             want = [ref.fixed_window_acquire_blocking(k, c, 6.0, 1.0)
                     for k, c in zip(sub, counts)]
-        for g, w, k, c in zip(got, want, sub, counts):
-            assert g.granted == w.granted, (
-                f"seed={seed} step={step} family={family} key={k} "
-                f"count={c}: device={g} reference={w}")
+        for i, (w, k, c) in enumerate(zip(want, sub, counts)):
+            assert bool(got.granted[i]) == w.granted, (
+                f"seed={seed} step={step} family={family} wr={wr} "
+                f"dir={directory} key={k} count={c}: "
+                f"device={bool(got.granted[i])} reference={w}")
+            if wr:
+                assert got.remaining[i] == pytest.approx(w.remaining,
+                                                         abs=1e-3), (
+                    f"seed={seed} step={step} family={family} "
+                    f"dir={directory} key={k}: remaining "
+                    f"{got.remaining[i]} != {w.remaining}")
         if rng.random() < 0.5:
             dt = float(rng.random() * 2.0)
             clock_a.advance_seconds(dt)
             clock_b.advance_seconds(dt)
+    if directory == "fp":
+        assert dev.metrics.fp_unresolved == 0, \
+            "trace hit window pressure — the fuzz no longer tests the " \
+            "pressure-free equivalence contract; grow n_slots"
 
 
 @pytest.mark.parametrize("seed", [30, 31])
