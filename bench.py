@@ -458,12 +458,16 @@ async def bench_e2e_async(store_mod, limiter_mod, options_mod):
     return throughput, p99_low
 
 
-async def bench_serving_p99(store_mod):
+async def bench_serving_p99(store_mod, on_d64=None):
     """SERVER-side p99: request-arrival → result-ready on a
     BucketStoreServer fronting the device store — ≥10K samples from the
-    server's own histogram (utils/metrics.LatencyHistogram), at a bounded
+    server's own histogram (utils/metrics.LatencyHistogram) at a bounded
     closed-loop depth (64 in flight) so the number is steady-state serving
-    latency, not open-loop queueing blowup.
+    latency, not open-loop queueing blowup; then a short depth-4 window
+    (640 samples — low-confidence by design, the sample count is emitted
+    with it) to separate link RTT from queueing. ``on_d64`` fires with
+    the depth-64 numbers as soon as they exist, so a tunnel wedge during
+    the extra window cannot discard the headline measurement.
 
     On THIS environment the device itself sits behind a network tunnel, so
     every micro-batch flush carries that tunnel's RTT and the TPU number
@@ -497,11 +501,22 @@ async def bench_serving_p99(store_mod):
             srv.serving_latency.reset()
             await asyncio.gather(*(worker(w, 160) for w in range(64)))
             stats = await store.stats()
+            if on_d64 is not None:
+                on_d64(stats["serving_p99_ms"], stats["serving_p50_ms"],
+                       stats["serving_samples"])
+            # Low-depth window too: over a high-RTT tunnel the depth-64
+            # number is queueing on the link RTT; depth 4 reads as
+            # ~one flush RTT and separates link latency from queueing
+            # in the recorded evidence.
+            srv.serving_latency.reset()
+            await asyncio.gather(*(worker(w, 160) for w in range(4)))
+            stats4 = await store.stats()
         finally:
             await store.aclose()
     await backing.aclose()
     return (stats["serving_p99_ms"], stats["serving_p50_ms"],
-            stats["serving_samples"])
+            stats["serving_samples"], stats4["serving_p99_ms"],
+            stats4["serving_p50_ms"], stats4["serving_samples"])
 
 
 def bench_serving_p99_cpu(timeout_s: float = 600.0,
@@ -726,6 +741,9 @@ RESULT: dict = {
     "serving_p99_ms": None,
     "serving_p50_ms": None,
     "serving_p99_samples": None,
+    "serving_p99_d4_ms": None,
+    "serving_p50_d4_ms": None,
+    "serving_p99_d4_samples": None,
     # Co-located-device stand-in (two CPU-platform children, server and
     # load on separate cores): the framework's own serving overhead, the
     # number the <2ms north star bounds. Headline keys are the depth-64
@@ -915,8 +933,18 @@ def _run_device_sections() -> bool:
         return round(rate), round(p99 * 1e3, 3)
 
     def sec_serving_p99():
-        p99, p50, n = asyncio.run(bench_serving_p99(store_mod))
-        return round(p99, 3), round(p50, 3), n
+        def on_d64(p99, p50, n):
+            # Land the headline numbers the moment they exist: a wedge
+            # during the extra depth-4 window must not discard them.
+            RESULT["serving_p99_ms"] = round(p99, 3)
+            RESULT["serving_p50_ms"] = round(p50, 3)
+            RESULT["serving_p99_samples"] = n
+            _emit()
+
+        p99, p50, n, p99_d4, p50_d4, n4 = asyncio.run(
+            bench_serving_p99(store_mod, on_d64=on_d64))
+        return (round(p99, 3), round(p50, 3), n,
+                round(p99_d4, 3), round(p50_d4, 3), n4)
 
     def sec_pallas():
         return bench_pallas_sweep(store_mod)
@@ -933,7 +961,9 @@ def _run_device_sections() -> bool:
     run("e2e_async", sec_e2e_async,
         ["e2e_async_decisions_per_sec", "e2e_p99_low_load_ms"])
     run("serving_p99", sec_serving_p99,
-        ["serving_p99_ms", "serving_p50_ms", "serving_p99_samples"])
+        ["serving_p99_ms", "serving_p50_ms", "serving_p99_samples",
+         "serving_p99_d4_ms", "serving_p50_d4_ms",
+         "serving_p99_d4_samples"])
     if RESULT["platform"] == "tpu":
         run("pallas_sweep", sec_pallas, ["pallas_sweep_ok"])
     return wedged
