@@ -520,7 +520,8 @@ async def bench_serving_p99(store_mod, on_d64=None):
 
 
 def bench_serving_p99_cpu(timeout_s: float = 600.0,
-                          backing: str = "device") -> dict | None:
+                          backing: str = "device",
+                          native: bool = False) -> dict | None:
     """Co-located-device stand-in for the <2ms serving north star, now a
     TWO-process rig (VERDICT r4 #3b): the server child owns the store +
     kernel on its own core; a separate load child drives closed-loop
@@ -548,9 +549,12 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
     env = os.environ.copy()
     env[FORCE_CPU_ENV] = "1"
     deadline = time.monotonic() + timeout_s
+    server_argv = [sys.executable, os.path.abspath(__file__),
+                   "--serving-server-child", backing]
+    if native:
+        server_argv.append("native")
     server = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--serving-server-child",
-         backing],
+        server_argv,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
     # No `with` around the executor: its shutdown joins the reader thread,
     # which only returns at EOF — a child that never prints would turn the
@@ -561,9 +565,11 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
         line = pool.submit(server.stdout.readline).result(
             timeout=min(120.0, timeout_s))
         addr = json.loads(line)
+        load_flag = ("--native-load-child" if native
+                     else "--serving-load-child")
         load = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--serving-load-child", addr["host"], str(addr["port"])],
+             load_flag, addr["host"], str(addr["port"])],
             env=env, capture_output=True, text=True,
             timeout=max(deadline - time.monotonic(), 30.0))
         if load.returncode != 0:
@@ -580,12 +586,14 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
         pool.shutdown(wait=False)
 
 
-def _serving_server_child(backing_kind: str = "device") -> None:
+def _serving_server_child(backing_kind: str = "device",
+                          native: bool = False) -> None:
     """Server half of the co-located stand-in: owns the (CPU-platform)
     device store and its kernel — or, for ``backing_kind="instant"``, the
     pure-Python ``InProcessBucketStore`` whose microsecond kernel makes
-    the serving histogram a pure framework-overhead measurement. Parks
-    until the parent closes stdin."""
+    the serving histogram a pure framework-overhead measurement. With
+    ``native=True`` the sockets are served by the C++ epoll front-end
+    (native/frontend.cc). Parks until the parent closes stdin."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -603,12 +611,56 @@ def _serving_server_child(backing_kind: str = "device") -> None:
             backing = store_mod.DeviceBucketStore(
                 n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6,
                 max_inflight=16)
-        async with BucketStoreServer(backing) as srv:
+        async with BucketStoreServer(backing,
+                                     native_frontend=native) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
             await asyncio.get_running_loop().run_in_executor(
                 None, sys.stdin.read)
         await backing.aclose()
+
+    asyncio.run(run())
+
+
+def _native_load_child(host: str, port: str) -> None:
+    """Load half of the native-front-end rig: the C closed-loop load
+    generator (native_frontend.native_loadgen) at a depth sweep, with the
+    server's own C-side histogram sampled per window — both directions of
+    the ceiling (req/s and p99) come from native measurement, so Python
+    client scheduling bounds neither."""
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_loadgen,
+    )
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+
+    async def run() -> None:
+        store = RemoteBucketStore(address=(host, int(port)),
+                                  coalesce_requests=False)
+        out: dict = {}
+        # Warm: connects, compiles nothing (instant backing), seeds keys.
+        await asyncio.to_thread(native_loadgen, host, int(port),
+                                conns=4, depth=16, reqs_per_conn=2000)
+        for depth in (4, 16, 64, 256):
+            await store.stats(reset=True)
+            replies, _, elapsed = await asyncio.to_thread(
+                native_loadgen, host, int(port), conns=4, depth=depth,
+                reqs_per_conn=50000)
+            stats = await store.stats()
+            out[f"d{depth}"] = {
+                "rate": replies / elapsed,
+                "p50_ms": stats["serving_p50_ms"],
+                "p99_ms": stats["serving_p99_ms"],
+                "samples": stats["serving_samples"],
+            }
+        await store.aclose()
+        print(json.dumps(out), flush=True)
 
     asyncio.run(run())
 
@@ -763,6 +815,15 @@ RESULT: dict = {
     "serving_p50_instant_ms": None,
     "serving_p99_instant_d4_ms": None,
     "serving_p99_instant_d16_ms": None,
+    # Native C++ front-end (native/frontend.cc) over the instant backing,
+    # driven by the C load generator: the per-request serving ceiling
+    # with per-request Python removed from BOTH ends — the number that
+    # supersedes the ~13K req/s/core asyncio wire ceiling.
+    "serving_native_req_per_s_d64": None,
+    "serving_native_req_per_s_d256": None,
+    "serving_native_p50_d16_ms": None,
+    "serving_native_p99_d16_ms": None,
+    "serving_native_p99_d64_ms": None,
     "pallas_sweep_ok": None,
     "device_probe": None,
     "budget_s": BUDGET_S,
@@ -1038,6 +1099,28 @@ def main() -> int:
         RESULT["serving_p99_instant_d16_ms"] = round(d16["p99_ms"], 3)
         _emit()
 
+    def sec_serving_native():
+        out = bench_serving_p99_cpu(
+            timeout_s=min(300.0, max(_remaining(), 30.0)),
+            backing="instant", native=True)
+        if out is None:
+            raise RuntimeError("native-frontend children failed/timed out")
+        return out
+
+    status, value = _section("serving_native", sec_serving_native,
+                             timeout_s=320)
+    if status == "ok" and value is not None:
+        RESULT["serving_native_req_per_s_d64"] = round(value["d64"]["rate"])
+        RESULT["serving_native_req_per_s_d256"] = round(
+            value["d256"]["rate"])
+        RESULT["serving_native_p50_d16_ms"] = round(
+            value["d16"]["p50_ms"], 3)
+        RESULT["serving_native_p99_d16_ms"] = round(
+            value["d16"]["p99_ms"], 3)
+        RESULT["serving_native_p99_d64_ms"] = round(
+            value["d64"]["p99_ms"], 3)
+        _emit()
+
     # Second chance for the chip: if the first probe found no window but
     # budget remains, re-probe and run the device sections late — a
     # flapping tunnel (r04: healthy/wedged minute to minute) often opens
@@ -1063,7 +1146,12 @@ if __name__ == "__main__":
     if "--serving-server-child" in sys.argv:
         i = sys.argv.index("--serving-server-child")
         kind = sys.argv[i + 1] if len(sys.argv) > i + 1 else "device"
-        _serving_server_child(kind)
+        native = len(sys.argv) > i + 2 and sys.argv[i + 2] == "native"
+        _serving_server_child(kind, native=native)
+        sys.exit(0)
+    if "--native-load-child" in sys.argv:
+        i = sys.argv.index("--native-load-child")
+        _native_load_child(sys.argv[i + 1], sys.argv[i + 2])
         sys.exit(0)
     if "--serving-load-child" in sys.argv:
         i = sys.argv.index("--serving-load-child")

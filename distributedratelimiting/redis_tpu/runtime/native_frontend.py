@@ -1,0 +1,377 @@
+"""Python half of the native serving front-end (``native/frontend.cc``).
+
+The C++ side owns the sockets: an epoll IO thread accepts connections,
+parses the v4 wire protocol, answers PING itself, accumulates per-request
+ACQUIRE/WINDOW/FWINDOW frames into micro-batches (timerfd deadline +
+flush-on-idle + max-batch, mirroring :class:`~.batcher.MicroBatcher`'s
+policy), and encodes/writes every reply natively. This module is the
+*decision* half: a pump thread blocks in ``fe_wait`` (GIL released) and
+dispatches each batch onto the server's asyncio loop as ONE store bulk
+call — so Python cost is per-flush, not per-request. Non-hot ops (HELLO,
+PEEK, SYNC, SEMA, STATS, SAVE, ACQUIRE_MANY, …) arrive as passthrough
+frames and are served by the same :class:`~.server.BucketStoreServer`
+handler the asyncio path uses; :mod:`~.wire` stays the single protocol
+authority for those shapes.
+
+Why this exists: the per-request serving ceiling of the asyncio socket
+path is ~13K req/s/core even with a zero-cost kernel — per-request
+framing plus task scheduling, measured in benchmarks/RESULTS.md
+("Per-request socket ceiling isolated"). The reference's answer to that
+class of cost is the Redis *server* — a C epoll loop. This is that
+component for the TPU store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import threading
+
+import numpy as np
+
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils.metrics import LatencyHistogram
+from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+
+__all__ = ["NativeFrontend", "native_loadgen"]
+
+# Bound to locals for the batch-group dispatch; wire.py stays the single
+# source of the values (frontend.cc mirrors them and is covered by the
+# protocol-parity tests).
+_OP_BUCKET = wire.OP_ACQUIRE
+_OP_WINDOW = wire.OP_WINDOW
+_OP_FWINDOW = wire.OP_FWINDOW
+
+
+class NativeFrontend:
+    """Own the C++ listener for a :class:`~.server.BucketStoreServer`.
+
+    Lifecycle: constructed inside ``server.start()`` on the running loop;
+    ``aclose()`` (from ``server.aclose()``) stops the IO thread, fails the
+    pump out of its wait, and frees the handle.
+    """
+
+    def __init__(self, server, *, host: str, port: int,
+                 max_batch: int = 4096, deadline_us: int = 300) -> None:
+        lib = load_frontend_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native front-end unavailable (no compiler or "
+                "DRL_TPU_NO_NATIVE=1) — use the asyncio server")
+        self._lib = lib
+        self._server = server
+        self._loop = asyncio.get_running_loop()
+        # The C side binds numeric IPv4 only — resolve names here so
+        # --host localhost works exactly like the asyncio listener.
+        # (IPv6 listeners are asyncio-path-only for now.)
+        import socket
+
+        try:
+            infos = socket.getaddrinfo(host, port, socket.AF_INET,
+                                       socket.SOCK_STREAM)
+            numeric_host = infos[0][4][0]
+        except socket.gaierror as exc:
+            raise OSError(
+                f"native front-end cannot resolve {host!r} as IPv4: {exc}"
+            ) from exc
+        self._h = lib.fe_start(numeric_host.encode(), port, max_batch,
+                               deadline_us,
+                               1 if server.auth_token is not None else 0)
+        if not self._h:
+            raise OSError(f"native front-end failed to bind {host}:{port}")
+        self.port = lib.fe_port(self._h)
+        self.host = host
+        self._stopping = False
+        # Per-connection tail task for chained ACQUIRE_MANY chunks — the
+        # same request-order contract the asyncio server keeps
+        # (server.py `bulk_tail`). Entries drop when their task is still
+        # the tail at completion, so the dict tracks active bulk conns
+        # only.
+        self._bulk_tails: dict[int, asyncio.Task] = {}
+        # Loop tasks still holding the C handle: aclose must drain these
+        # BETWEEN fe_stop (no new work) and fe_free (handle invalid) — a
+        # straggler batch completing after fe_free would call
+        # fe_complete through a dangling pointer.
+        self._loop_tasks: set[asyncio.Task] = set()
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="native-frontend-pump")
+        self._pump.start()
+
+    def _track(self, coro) -> None:
+        """Schedule ``coro`` on the loop from the pump thread, tracked
+        for shutdown draining."""
+        def _schedule() -> None:
+            task = asyncio.ensure_future(coro)
+            self._loop_tasks.add(task)
+            task.add_done_callback(self._loop_tasks.discard)
+
+        self._loop.call_soon_threadsafe(_schedule)
+
+    # -- pump thread -------------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        lib, h = self._lib, self._h
+        while not self._stopping:
+            kind = lib.fe_wait(h, 200)
+            if kind == -1:
+                break
+            try:
+                if kind == 1:
+                    self._dispatch_batch()
+                elif kind == 2:
+                    self._dispatch_passthrough()
+            except Exception as exc:  # noqa: BLE001 — the pump is the one
+                # thread every connection depends on: it must survive any
+                # single bad batch/frame (the items get error replies via
+                # fe_fail where possible; the connections stay up).
+                log.error_evaluating_kernel(exc)
+                if kind == 1:
+                    try:
+                        self._lib.fe_fail(self._h, self._lib.fe_batch_id(
+                            self._h), repr(exc)[:200].encode())
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def _dispatch_batch(self) -> None:
+        lib, h = self._lib, self._h
+        bid = lib.fe_batch_id(h)
+        n = lib.fe_batch_n(h)
+        if n <= 0:
+            return
+        kb = lib.fe_batch_key_bytes(h)
+        blob = ctypes.create_string_buffer(max(int(kb), 1))
+        klens = np.empty(n, np.int32)
+        counts = np.empty(n, np.int32)
+        ops = np.empty(n, np.uint8)
+        seqs = np.empty(n, np.uint32)
+        conn_ids = np.empty(n, np.uint64)
+        a_arr = np.empty(n, np.float64)
+        b_arr = np.empty(n, np.float64)
+        c = ctypes
+        lib.fe_batch_copy(
+            h, blob,
+            klens.ctypes.data_as(c.POINTER(c.c_int32)),
+            counts.ctypes.data_as(c.POINTER(c.c_int32)),
+            ops.ctypes.data_as(c.POINTER(c.c_uint8)),
+            seqs.ctypes.data_as(c.POINTER(c.c_uint32)),
+            conn_ids.ctypes.data_as(c.POINTER(c.c_uint64)),
+            a_arr.ctypes.data_as(c.POINTER(c.c_double)),
+            b_arr.ctypes.data_as(c.POINTER(c.c_double)))
+        # Decode keys off-loop (the pump has idle time while the loop
+        # runs store calls); ascii fast path matches wire.py's.
+        raw = blob.raw[:int(kb)]
+        ends = np.cumsum(klens.astype(np.int64))
+        starts = ends - klens
+        if raw.isascii():
+            text = raw.decode("ascii")
+            keys = [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
+        else:
+            # surrogateescape: wire keys are bytes; invalid UTF-8 still
+            # maps 1:1 to a stable str key (and round-trips), so one
+            # hostile/corrupt key rate-limits under its own identity
+            # instead of poisoning its whole batch with a decode error.
+            keys = [raw[s:e].decode("utf-8", "surrogateescape")
+                    for s, e in zip(starts.tolist(), ends.tolist())]
+        self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr))
+
+    def _dispatch_passthrough(self) -> None:
+        lib, h = self._lib, self._h
+        conn_id = lib.fe_pt_conn(h)
+        ln = lib.fe_pt_len(h)
+        buf = ctypes.create_string_buffer(max(ln, 1))
+        lib.fe_pt_copy(h, buf)
+        body = buf.raw[:ln]
+        self._track(self._serve_passthrough(int(conn_id), body))
+
+    # -- loop-side serving -------------------------------------------------
+
+    async def _serve_batch(self, bid: int, keys: list[str],
+                           counts: np.ndarray, ops: np.ndarray,
+                           a_arr: np.ndarray, b_arr: np.ndarray) -> None:
+        n = len(keys)
+        try:
+            granted = np.zeros(n, np.uint8)
+            remaining = np.zeros(n, np.float64)
+            # Single-config fast path: every frame carries the same
+            # (op, capacity, rate) — the overwhelmingly common shape (one
+            # limiter config per fleet). O(n) numpy check, one bulk call.
+            if ((ops == ops[0]).all() and (a_arr == a_arr[0]).all()
+                    and (b_arr == b_arr[0]).all()):
+                groups = [(int(ops[0]), float(a_arr[0]), float(b_arr[0]),
+                           None)]
+            else:
+                rec = np.empty(n, dtype=[("op", np.uint8),
+                                         ("a", np.float64),
+                                         ("b", np.float64)])
+                rec["op"], rec["a"], rec["b"] = ops, a_arr, b_arr
+                uniq, inverse = np.unique(rec, return_inverse=True)
+                groups = [(int(u["op"]), float(u["a"]), float(u["b"]),
+                           np.nonzero(inverse == gi)[0])
+                          for gi, u in enumerate(uniq)]
+            for op, a, b, idx in groups:
+                if idx is None:
+                    gkeys, gcounts = keys, counts
+                else:
+                    gkeys = [keys[i] for i in idx.tolist()]
+                    gcounts = counts[idx]
+                if op == _OP_BUCKET:
+                    res = await self._server.store.acquire_many(
+                        gkeys, gcounts, a, b, with_remaining=True)
+                else:
+                    res = await self._server.store.window_acquire_many(
+                        gkeys, gcounts, a, b, fixed=(op == _OP_FWINDOW),
+                        with_remaining=True)
+                g = np.asarray(res.granted, np.uint8)
+                r = (np.zeros(len(gkeys), np.float64)
+                     if res.remaining is None
+                     else np.asarray(res.remaining, np.float64))
+                if idx is None:
+                    granted, remaining = g, r
+                else:
+                    granted[idx] = g
+                    remaining[idx] = r
+            c = ctypes
+            self._lib.fe_complete(
+                self._h, bid,
+                np.ascontiguousarray(granted).ctypes.data_as(
+                    c.POINTER(c.c_uint8)),
+                np.ascontiguousarray(remaining).ctypes.data_as(
+                    c.POINTER(c.c_double)))
+        except Exception as exc:  # noqa: BLE001 — every request must get
+            log.error_evaluating_kernel(exc)  # a routable error reply
+            self._lib.fe_fail(self._h, bid, repr(exc)[:200].encode())
+
+    async def _serve_passthrough(self, conn_id: int, body: bytes) -> None:
+        try:
+            op = body[5] if len(body) >= 6 else 0
+            if op == wire.OP_HELLO:
+                await self._serve_hello(conn_id, body)
+                return
+            if op != wire.OP_ACQUIRE_MANY:
+                await self._serve_passthrough_inner(conn_id, body)
+                return
+            # Bulk frames run as their own tasks so a long store call
+            # can't stall the pump's other passthrough work; chained
+            # chunks order behind the connection's tail.
+            prev = (self._bulk_tails.get(conn_id)
+                    if wire.bulk_request_chained(body) else None)
+            task = asyncio.ensure_future(
+                self._serve_passthrough_inner(conn_id, body, after=prev))
+            self._loop_tasks.add(task)  # it calls fe_send: aclose must
+            task.add_done_callback(self._loop_tasks.discard)  # drain it
+            self._bulk_tails[conn_id] = task
+
+            def _clear(t, cid=conn_id):
+                if self._bulk_tails.get(cid) is t:
+                    del self._bulk_tails[cid]
+
+            task.add_done_callback(_clear)
+        except Exception as exc:  # noqa: BLE001
+            log.error_evaluating_kernel(exc)
+
+    async def _serve_passthrough_inner(self, conn_id: int, body: bytes,
+                                       after: "asyncio.Task | None" = None
+                                       ) -> None:
+        if after is not None:
+            await asyncio.gather(after, return_exceptions=True)
+        resp = await self._server.handle_frame_body(body)
+        self._send(conn_id, resp)
+
+    async def _serve_hello(self, conn_id: int, body: bytes) -> None:
+        import hmac
+
+        srv = self._server
+        try:
+            seq, _, token, _, _, _ = wire.decode_request(body)
+        except Exception:
+            self._send(conn_id, wire.encode_response(
+                0, wire.RESP_ERROR, "malformed HELLO frame"))
+            self._lib.fe_close_conn(self._h, conn_id)
+            return
+        if srv.auth_token is not None and not hmac.compare_digest(
+                token.encode(), srv.auth_token.encode()):
+            self._send(conn_id, wire.encode_response(
+                seq, wire.RESP_ERROR, "authentication failed"))
+            self._lib.fe_close_conn(self._h, conn_id)
+            return
+        self._lib.fe_set_authed(self._h, conn_id, 1)
+        self._send(conn_id, wire.encode_response(seq, wire.RESP_EMPTY))
+
+    def _send(self, conn_id: int, resp: bytes) -> None:
+        self._lib.fe_send(self._h, conn_id, resp, len(resp))
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def counts(self) -> tuple[int, int, int]:
+        """One locked C call for ``(requests_served, connections_served,
+        batches_flushed)`` — stats readers take the front-end mutex once,
+        not once per counter."""
+        c = ctypes
+        req = c.c_longlong()
+        conns = c.c_longlong()
+        batches = c.c_longlong()
+        self._lib.fe_counts(self._h, c.byref(req), c.byref(conns),
+                            c.byref(batches))
+        return req.value, conns.value, batches.value
+
+    def latency_histogram(self) -> LatencyHistogram:
+        """Snapshot the C-side serving histogram into the shared Python
+        class (same 82 log-1.25 buckets, so quantiles read identically)."""
+        counts = np.zeros(LatencyHistogram.N_BUCKETS, np.uint64)
+        total = self._lib.fe_hist(
+            self._h, counts.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64)))
+        hist = LatencyHistogram()
+        hist.counts = [int(x) for x in counts]
+        hist.total = int(total)
+        return hist
+
+    def reset_latency(self) -> None:
+        self._lib.fe_hist_reset(self._h)
+
+    async def aclose(self) -> None:
+        if self._stopping:
+            return
+        self._stopping = True
+        # Order matters: (1) fe_stop joins the IO thread — no new frames;
+        # (2) the pump sees -1 from fe_wait and exits — no new loop
+        # tasks; (3) drain the loop tasks still in flight, whose
+        # fe_complete/fe_send calls need the handle alive (the sockets
+        # are gone, so completions just fall into the void); only then
+        # (4) free the handle.
+        await asyncio.to_thread(self._lib.fe_stop, self._h)
+        await asyncio.to_thread(self._pump.join, 5.0)
+        while self._loop_tasks:
+            # Loop, not a one-shot gather: a bulk passthrough parent can
+            # spawn its _serve_passthrough_inner child AFTER the snapshot
+            # was taken — the child also holds the handle.
+            await asyncio.gather(*list(self._loop_tasks),
+                                 return_exceptions=True)
+        self._lib.fe_free(self._h)
+        self._h = None
+
+
+def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
+                   reqs_per_conn: int = 10000, keyspace: int = 1000,
+                   capacity: float = 1e7, fill_rate: float = 1e7
+                   ) -> tuple[int, int, float]:
+    """Closed-loop native measurement client: ``conns`` connections each
+    keeping ``depth`` pipelined ACQUIRE requests in flight. Returns
+    ``(replies, granted, elapsed_s)``. Runs in C (one epoll thread) so a
+    Python client's ~14µs/request scheduling floor doesn't bound the
+    measurement — the asymmetric rig the per-request ceiling analysis
+    called for (benchmarks/RESULTS.md)."""
+    lib = load_frontend_lib()
+    if lib is None:
+        raise RuntimeError("native front-end library unavailable")
+    c = ctypes
+    elapsed = c.c_double()
+    replies = c.c_longlong()
+    granted = c.c_longlong()
+    rc = lib.fe_loadgen(host.encode(), port, conns, depth, reqs_per_conn,
+                        keyspace, capacity, fill_rate, c.byref(elapsed),
+                        c.byref(replies), c.byref(granted))
+    if rc != 0:
+        raise OSError("native loadgen failed to connect")
+    return replies.value, granted.value, elapsed.value

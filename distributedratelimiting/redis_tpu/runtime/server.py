@@ -53,10 +53,18 @@ class BucketStoreServer:
 
     def __init__(self, store: BucketStore, *, host: str = "127.0.0.1",
                  port: int = 0, snapshot_path: str | None = None,
-                 auth_token: str | None = None) -> None:
+                 auth_token: str | None = None,
+                 native_frontend: bool = False) -> None:
         self.store = store
         self.host = host
         self.port = port
+        # Native front-end (native/frontend.cc): the C++ epoll loop owns
+        # the sockets and hands per-request micro-batches to Python once
+        # per flush — the serving path's answer to the measured ~13K
+        # req/s/core asyncio per-request ceiling (benchmarks/RESULTS.md
+        # "Per-request socket ceiling isolated").
+        self.native_frontend = native_frontend
+        self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
         # paths, so the wire cannot be used to write arbitrary files).
@@ -84,6 +92,28 @@ class BucketStoreServer:
         localhost-cluster trick, ≙ ``UseLocalhostClustering`` with per-
         instance port offsets, ``TestApp/Program.cs:43-52``)."""
         await self.store.connect()
+        if self.native_frontend:
+            from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+                NativeFrontend,
+            )
+
+            try:
+                self._native = NativeFrontend(self, host=self.host,
+                                              port=self.port)
+            except RuntimeError as exc:
+                # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
+                # serve anyway on the asyncio path — availability over
+                # peak throughput, loudly (the operator asked for native
+                # and is getting ~10× less per-request headroom).
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native front-end unavailable (%s); falling back to "
+                    "the asyncio socket path", exc)
+                self.native_frontend = False
+            else:
+                self.port = self._native.port
+                return self.host, self.port
         self._server = await asyncio.start_server(
             self._serve_connection, self.host, self.port
         )
@@ -201,12 +231,25 @@ class BucketStoreServer:
     async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
                              write_lock: asyncio.Lock,
                              after: "asyncio.Task | None" = None) -> None:
-        seq = _recover_seq(body)
         t_arrival = time.perf_counter()
         if after is not None:
             # Per-connection bulk ordering (see _serve_connection). The
             # predecessor's own failure was already replied/logged there.
             await asyncio.gather(after, return_exceptions=True)
+        resp = await self.handle_frame_body(body)
+        self.requests_served += 1
+        self.serving_latency.record(time.perf_counter() - t_arrival)
+        await self._reply(writer, write_lock, resp)  # client went away ⇒
+        # its futures die with the socket
+
+    async def handle_frame_body(self, body: bytes) -> bytes:
+        """Serve one frame body and return the encoded reply — the shared
+        dispatch behind both the asyncio socket path and the native
+        front-end's passthrough lane (runtime/native_frontend.py). Store
+        and decode failures come back as routable RESP_ERROR frames, never
+        as raises (except cancellation), so one bad request can never take
+        a connection down with it."""
+        seq = _recover_seq(body)
         try:
             if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
                 # Bulk frames carry arrays, not the scalar request shape —
@@ -223,12 +266,8 @@ class BucketStoreServer:
                         keys, counts, a, b,
                         fixed=(kind == wire.BULK_KIND_FWINDOW),
                         with_remaining=with_rem)
-                resp = wire.encode_bulk_response(seq, res.granted,
+                return wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
-                self.requests_served += 1
-                self.serving_latency.record(time.perf_counter() - t_arrival)
-                await self._reply(writer, write_lock, resp)
-                return
             seq, op, key, count, a, b = wire.decode_request(body)
             if op == wire.OP_ACQUIRE:
                 res = await self.store.acquire(key, count, a, b)
@@ -290,6 +329,8 @@ class BucketStoreServer:
                 resp = wire.encode_response(
                     seq, wire.RESP_TEXT, self._stats_json())
                 if count:  # reset flag: start a fresh measurement window
+                    if self._native is not None:
+                        self._native.reset_latency()
                     self.serving_latency.reset()
                     metrics = getattr(self.store, "metrics", None)
                     if metrics is not None and hasattr(metrics,
@@ -303,26 +344,45 @@ class BucketStoreServer:
         except Exception as exc:  # relay (with the recovered seq), never
             log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
-        self.requests_served += 1
-        self.serving_latency.record(time.perf_counter() - t_arrival)
-        await self._reply(writer, write_lock, resp)  # client went away; its futures die with the socket
+        return resp
 
     def _stats_json(self) -> str:
         import json
 
-        payload = {
-            "connections_served": self.connections_served,
-            "requests_served": self.requests_served,
-            "serving_p50_ms": self.serving_latency.p50 * 1e3,
-            "serving_p99_ms": self.serving_latency.p99 * 1e3,
-            "serving_samples": self.serving_latency.total,
-        }
+        if self._native is not None:
+            # The C front-end owns the sockets and the hot-path histogram
+            # (arrival→completion measured in C, same 82-bucket
+            # convention); passthrough ops served here also count into
+            # its requests_served via fe_send.
+            hist = self._native.latency_histogram()
+            requests, connections, batches = self._native.counts()
+            payload = {
+                "connections_served": connections,
+                "requests_served": requests,
+                "serving_p50_ms": hist.p50 * 1e3,
+                "serving_p99_ms": hist.p99 * 1e3,
+                "serving_samples": hist.total,
+                "native_frontend": True,
+                "batches_flushed": batches,
+            }
+        else:
+            payload = {
+                "connections_served": self.connections_served,
+                "requests_served": self.requests_served,
+                "serving_p50_ms": self.serving_latency.p50 * 1e3,
+                "serving_p99_ms": self.serving_latency.p99 * 1e3,
+                "serving_samples": self.serving_latency.total,
+            }
         metrics = getattr(self.store, "metrics", None)
         if metrics is not None:
             payload["store"] = metrics.snapshot()
         return json.dumps(payload)
 
     async def aclose(self) -> None:
+        if self._native is not None:
+            await self._native.aclose()
+            self._native = None
+            return
         if self._server is not None:
             self._server.close()
         # Cancel live connection handlers BEFORE wait_closed(): since
@@ -395,6 +455,12 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--auth-token", default=None,
                         help="shared secret; when set, clients must HELLO "
                         "with it before any other op (≙ Redis AUTH)")
+    parser.add_argument("--native-frontend", action="store_true",
+                        help="serve sockets from the C++ epoll front-end "
+                        "(native/frontend.cc): per-request frames batch "
+                        "in C and reach Python once per flush — lifts "
+                        "the per-request serving ceiling ~an order of "
+                        "magnitude per core (docs/OPERATIONS.md)")
     args = parser.parse_args(argv)
 
     async def serve() -> None:
@@ -439,7 +505,8 @@ def main(argv: list[str] | None = None) -> None:
             store.start_sweeper(args.sweep_period)
         server = BucketStoreServer(store, host=args.host, port=args.port,
                                    snapshot_path=args.snapshot_path,
-                                   auth_token=args.auth_token)
+                                   auth_token=args.auth_token,
+                                   native_frontend=args.native_frontend)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         try:

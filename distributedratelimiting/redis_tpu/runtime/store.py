@@ -1692,6 +1692,48 @@ class InProcessBucketStore(BucketStore):
     def acquire_blocking(self, key, count, capacity, fill_rate_per_sec):
         return self._acquire_core(key, count, capacity, fill_rate_per_sec)
 
+    async def acquire_many(self, keys, counts, capacity, fill_rate_per_sec,
+                           *, with_remaining: bool = True):
+        """Serial-core bulk: one in-order pass over the batch with NO
+        task-per-key (the base class's gather spends ~10µs/key on task
+        scheduling — measurable when this store backs the native front-end
+        as the zero-cost-kernel stand-in). Still awaits ``self.acquire``
+        per key: the per-key method stays the single override point for
+        test fakes and subclasses, and awaiting a non-suspending
+        coroutine costs no loop round trip."""
+        await self.connect()
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        # Direct-core loop only when per-key acquire is NOT overridden:
+        # subclasses/test fakes that intercept acquire() must see every
+        # bulk key too (a coroutine frame per key costs ~20% on the
+        # native-front-end stand-in, so the unsubclassed store skips it).
+        direct = type(self).acquire is InProcessBucketStore.acquire
+        for i, (k, c) in enumerate(zip(keys, counts)):
+            r = (self._acquire_core(k, int(c), capacity, fill_rate_per_sec)
+                 if direct else
+                 await self.acquire(k, int(c), capacity, fill_rate_per_sec))
+            granted[i] = r.granted
+            if remaining is not None:
+                remaining[i] = r.remaining
+        return BulkAcquireResult(granted, remaining)
+
+    async def window_acquire_many(self, keys, counts, limit, window_sec, *,
+                                  fixed: bool = False,
+                                  with_remaining: bool = True):
+        await self.connect()
+        op = (self.fixed_window_acquire if fixed else self.window_acquire)
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        for i, (k, c) in enumerate(zip(keys, counts)):
+            r = await op(k, int(c), limit, window_sec)
+            granted[i] = r.granted
+            if remaining is not None:
+                remaining[i] = r.remaining
+        return BulkAcquireResult(granted, remaining)
+
     def peek_blocking(self, key, capacity, fill_rate_per_sec):
         now = self.clock.now_ticks()
         bkey = (key, float(capacity), float(fill_rate_per_sec))

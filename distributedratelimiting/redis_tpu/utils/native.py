@@ -124,3 +124,89 @@ def load_directory_lib() -> ctypes.CDLL | None:
     except Exception:
         _LIB = None
     return _LIB
+
+
+_FE_LIB: ctypes.CDLL | None = None
+_FE_TRIED = False
+
+
+def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.fe_start.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int]
+    lib.fe_start.restype = c.c_void_p
+    lib.fe_port.argtypes = [c.c_void_p]
+    lib.fe_port.restype = c.c_int
+    lib.fe_wait.argtypes = [c.c_void_p, c.c_int]
+    lib.fe_wait.restype = c.c_int
+    lib.fe_batch_id.argtypes = [c.c_void_p]
+    lib.fe_batch_id.restype = c.c_longlong
+    lib.fe_batch_n.argtypes = [c.c_void_p]
+    lib.fe_batch_n.restype = c.c_int
+    lib.fe_batch_key_bytes.argtypes = [c.c_void_p]
+    lib.fe_batch_key_bytes.restype = c.c_longlong
+    lib.fe_batch_copy.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+        c.POINTER(c.c_uint8), c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
+        c.POINTER(c.c_double), c.POINTER(c.c_double)]
+    lib.fe_batch_copy.restype = None
+    lib.fe_complete.argtypes = [c.c_void_p, c.c_longlong,
+                                c.POINTER(c.c_uint8), c.POINTER(c.c_double)]
+    lib.fe_complete.restype = None
+    lib.fe_fail.argtypes = [c.c_void_p, c.c_longlong, c.c_char_p]
+    lib.fe_fail.restype = None
+    lib.fe_pt_conn.argtypes = [c.c_void_p]
+    lib.fe_pt_conn.restype = c.c_longlong
+    lib.fe_pt_len.argtypes = [c.c_void_p]
+    lib.fe_pt_len.restype = c.c_int
+    lib.fe_pt_copy.argtypes = [c.c_void_p, c.c_char_p]
+    lib.fe_pt_copy.restype = None
+    lib.fe_send.argtypes = [c.c_void_p, c.c_uint64, c.c_char_p, c.c_int]
+    lib.fe_send.restype = None
+    lib.fe_set_authed.argtypes = [c.c_void_p, c.c_uint64, c.c_int]
+    lib.fe_set_authed.restype = None
+    lib.fe_close_conn.argtypes = [c.c_void_p, c.c_uint64]
+    lib.fe_close_conn.restype = None
+    lib.fe_counts.argtypes = [c.c_void_p, c.POINTER(c.c_longlong),
+                              c.POINTER(c.c_longlong),
+                              c.POINTER(c.c_longlong)]
+    lib.fe_counts.restype = None
+    lib.fe_hist.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+    lib.fe_hist.restype = c.c_longlong
+    lib.fe_hist_reset.argtypes = [c.c_void_p]
+    lib.fe_hist_reset.restype = None
+    lib.fe_stop.argtypes = [c.c_void_p]
+    lib.fe_stop.restype = None
+    lib.fe_free.argtypes = [c.c_void_p]
+    lib.fe_free.restype = None
+    lib.fe_loadgen.argtypes = [
+        c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
+        c.c_double, c.POINTER(c.c_double), c.POINTER(c.c_longlong),
+        c.POINTER(c.c_longlong)]
+    lib.fe_loadgen.restype = c.c_int
+    return lib
+
+
+def load_frontend_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the native serving front-end
+    (``native/frontend.cc``); ``None`` on any failure — the server then
+    falls back to the asyncio socket path. Loaded as plain ``CDLL`` (NOT
+    PyDLL): its blocking ``fe_wait`` must release the GIL so the pump
+    thread's wait never stalls the event loop."""
+    global _FE_LIB, _FE_TRIED
+    if _FE_TRIED:
+        return _FE_LIB
+    _FE_TRIED = True
+    if os.environ.get("DRL_TPU_NO_NATIVE"):
+        return None
+    src = _REPO_NATIVE / "frontend.cc"
+    out = _REPO_NATIVE / "build" / "_frontend.so"
+    try:
+        if not src.exists():
+            return None
+        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+            if not _build(src, out):
+                return None
+        _FE_LIB = _bind_frontend(ctypes.CDLL(str(out)))
+    except Exception:
+        _FE_LIB = None
+    return _FE_LIB

@@ -1,0 +1,92 @@
+"""Consumer-style drive of the native serving front-end + core flows.
+
+Run: python examples/native_frontend_demo.py [cpu|tpu]
+(JAX_PLATFORMS=cpu for the CPU backend; the verify skill drives this
+file from outside the repo tree on both backends.)
+
+Starts a BucketStoreServer(native_frontend=True) over a DeviceBucketStore,
+talks to it only through the public client (RemoteBucketStore) plus one
+raw-socket check, and exercises: burst->drain->refill, duplicate-key
+batch serialization, zero-probe, window ops, stats, and the native
+load generator.
+"""
+import asyncio
+import sys
+import time
+
+
+async def main(platform: str) -> None:
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_loadgen,
+    )
+
+    clock = ManualClock()
+    backing = DeviceBucketStore(n_slots=1 << 14, clock=clock)
+    srv = BucketStoreServer(backing, native_frontend=True)
+    await srv.start()
+    print(f"[{platform}] native front-end listening on {srv.host}:{srv.port}")
+    store = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+
+    # Burst -> drain on one hot key: capacity 5, zero refill while the
+    # manual clock is frozen. 32 concurrent one-token asks -> exactly 5.
+    results = await asyncio.gather(
+        *(store.acquire("hot", 1, 5.0, 1.0) for _ in range(32)))
+    grants = sum(r.granted for r in results)
+    assert grants == 5, f"duplicate serialization broke: {grants} grants"
+    print(f"[{platform}] burst: exactly 5/32 granted (cap 5, frozen clock)")
+
+    # Timed refill: advance the injected clock 3s at 1 token/s.
+    clock.advance_seconds(3.0)
+    r = await store.acquire("hot", 3, 5.0, 1.0)
+    assert r.granted, "3s at 1 tok/s should refill 3"
+    r = await store.acquire("hot", 1, 5.0, 1.0)
+    assert not r.granted, "bucket should be empty again"
+    print(f"[{platform}] refill: 3 tokens after 3s, then empty — exact")
+
+    # Zero-permit probe + window family through the same socket.
+    assert (await store.acquire("fresh", 0, 5.0, 1.0)).granted
+    w = await store.window_acquire("w", 2, 10.0, 60.0)
+    assert w.granted and abs(w.remaining - 8.0) < 1e-6
+    f = await store.fixed_window_acquire("fw", 10, 10.0, 60.0)
+    assert f.granted
+    assert not (await store.fixed_window_acquire("fw", 1, 10.0, 60.0)).granted
+    print(f"[{platform}] zero-probe + sliding/fixed windows OK")
+
+    # Stats surface reports the native front-end.
+    st = await store.stats()
+    assert st["native_frontend"] is True and st["requests_served"] >= 38
+    print(f"[{platform}] stats: native_frontend=True, "
+          f"requests={st['requests_served']}, "
+          f"batches={st['batches_flushed']}, "
+          f"p99={st['serving_p99_ms']:.3f}ms")
+
+    # Native load generator: closed-loop C client, big-capacity bucket.
+    replies, granted, elapsed = await asyncio.to_thread(
+        native_loadgen, srv.host, srv.port, conns=2, depth=32,
+        reqs_per_conn=5000, capacity=1e9, fill_rate=1e9)
+    assert replies == 10000 and granted == replies
+    print(f"[{platform}] native loadgen: {replies/elapsed:,.0f} req/s "
+          f"({replies} replies, all granted)")
+
+    await store.aclose()
+    await srv.aclose()
+    await backing.aclose()
+    print(f"[{platform}] clean shutdown OK")
+
+
+if __name__ == "__main__":
+    platform = sys.argv[1] if len(sys.argv) > 1 else "?"
+    t0 = time.time()
+    asyncio.run(main(platform))
+    print(f"[{platform}] PASS in {time.time() - t0:.1f}s")

@@ -1,0 +1,420 @@
+"""Native serving front-end (native/frontend.cc + runtime/native_frontend.py).
+
+The C++ epoll front-end must speak the exact v4 wire protocol the asyncio
+server speaks — every test here drives it through the unmodified
+:class:`RemoteBucketStore` client (and one raw socket for the malformed
+cases), so protocol drift between the two server halves fails loudly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+
+pytestmark = pytest.mark.skipif(
+    load_frontend_lib() is None,
+    reason="native front-end library unavailable (no compiler?)")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _with_server(fn, **server_kw):
+    async with BucketStoreServer(InProcessBucketStore(), native_frontend=True,
+                                 **server_kw) as srv:
+        await fn(srv)
+
+
+def test_per_request_acquire_and_refill_semantics():
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            r = await store.acquire("k", 4, 10.0, 1.0)
+            assert r.granted and r.remaining == pytest.approx(6.0)
+            r = await store.acquire("k", 7, 10.0, 1.0)
+            assert not r.granted  # all-or-nothing: 6 < 7
+            r = await store.acquire("k", 6, 10.0, 1.0)
+            assert r.granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_window_ops_route_by_op_byte():
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            w = await store.window_acquire("w", 2, 5.0, 60.0)
+            assert w.granted and w.remaining == pytest.approx(3.0)
+            f = await store.fixed_window_acquire("f", 5, 5.0, 60.0)
+            assert f.granted
+            f2 = await store.fixed_window_acquire("f", 1, 5.0, 60.0)
+            assert not f2.granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_concurrent_burst_batches_with_exact_grants():
+    """64 concurrent single-permit acquires on one 40-token bucket: the
+    front-end batches them into few flushes, and exactly 40 grant (the
+    store's in-batch duplicate serialization holds through the native
+    path)."""
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            results = await asyncio.gather(
+                *(store.acquire("hot", 1, 40.0, 1e-9) for _ in range(64)))
+            assert sum(r.granted for r in results) == 40
+            stats = await store.stats()
+            assert stats["native_frontend"] is True
+            # NOTE: no strict batch-count assert — under core starvation
+            # the scheduler can legally deliver one frame per flush (the
+            # exact 40-grant count above is the deterministic semantic;
+            # coalescing itself is covered by the bench's batch metrics).
+            assert 1 <= stats["batches_flushed"] <= 64 + stats[
+                "requests_served"]
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_mixed_configs_one_batch():
+    """Frames with different (capacity, rate) in one burst split into
+    per-config store calls with results scattered back correctly."""
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            small = [store.acquire(f"s{i}", 1, 1.0, 1e-9) for i in range(8)]
+            big = [store.acquire("b", 1, 100.0, 1e-9) for _ in range(8)]
+            results = await asyncio.gather(*small, *big)
+            assert all(r.granted for r in results[:8])     # distinct keys
+            assert all(r.granted for r in results[8:])     # capacity 100
+            r2 = await store.acquire("s0", 1, 1.0, 1e-9)
+            assert not r2.granted                          # 1-cap spent
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_bulk_passthrough_and_stats():
+    async def body2(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port))
+        try:
+            keys = [f"u{i % 10}" for i in range(1000)]
+            res = await store.acquire_many(keys, [1] * 1000, 30.0, 1e-9)
+            # 10 distinct keys, 100 requests each, capacity 30:
+            assert int(res.granted.sum()) == 10 * 30
+            st = await store.stats()
+            assert st["requests_served"] >= 1
+        finally:
+            await store.aclose()
+
+    run(_with_server(body2))
+
+
+def test_ping_and_peek_and_sync_passthrough():
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            await store.ping()
+            await store.acquire("p", 3, 10.0, 1.0)
+            # peek is a blocking client call; run off-loop because the
+            # server's passthrough handler shares this test's event loop.
+            avail = await asyncio.to_thread(store.peek_blocking,
+                                            "p", 10.0, 1.0)
+            assert avail == pytest.approx(7.0)
+            res = await store.sync_counter("c", 5.0, 1.0)
+            assert res.global_score == pytest.approx(5.0)
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_auth_required_flow():
+    async def ok(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  auth_token="sekrit",
+                                  coalesce_requests=False)
+        try:
+            r = await store.acquire("k", 1, 10.0, 1.0)
+            assert r.granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(ok, auth_token="sekrit"))
+
+    async def bad(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  auth_token="wrong",
+                                  coalesce_requests=False)
+        try:
+            with pytest.raises(wire.RemoteStoreError):
+                await store.acquire("k", 1, 10.0, 1.0)
+        finally:
+            await store.aclose()
+
+    run(_with_server(bad, auth_token="sekrit"))
+
+    async def unauthed(srv):
+        # No HELLO at all: the C side rejects the first scalar op.
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            with pytest.raises((wire.RemoteStoreError, TimeoutError,
+                                ConnectionError)):
+                await store.acquire("k", 1, 10.0, 1.0)
+        finally:
+            await store.aclose()
+
+    run(_with_server(unauthed, auth_token="sekrit"))
+
+
+def test_hello_pipelined_with_request_in_one_segment():
+    """HELLO + ACQUIRE written in one TCP segment must both serve (the
+    asyncio path handles this by reading frames sequentially; the native
+    path parks post-HELLO frames until Python resolves auth)."""
+    async def body(srv):
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        burst = (wire.encode_request(1, wire.OP_HELLO, "sekrit")
+                 + wire.encode_request(2, wire.OP_ACQUIRE, "k", 1,
+                                       10.0, 1.0))
+        writer.write(burst)
+        await writer.drain()
+        f1 = await asyncio.wait_for(wire.read_frame(reader), 10)
+        f2 = await asyncio.wait_for(wire.read_frame(reader), 10)
+        by_seq = {}
+        for f in (f1, f2):
+            seq, kind, vals = wire.decode_response(f)
+            by_seq[seq] = (kind, vals)
+        assert by_seq[1][0] == wire.RESP_EMPTY          # HELLO ok
+        assert by_seq[2][0] == wire.RESP_DECISION       # acquire served
+        assert by_seq[2][1][0] is True
+        writer.close()
+
+    run(_with_server(body, auth_token="sekrit"))
+
+
+def test_loadgen_terminates_against_auth_server():
+    """The C load generator never HELLOs; an auth-protected server closes
+    each conn after one error — the loadgen must return promptly (EOF
+    detection), not spin on dead fds."""
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_loadgen,
+    )
+
+    async def body(srv):
+        replies, granted, elapsed = await asyncio.wait_for(
+            asyncio.to_thread(native_loadgen, srv.host, srv.port,
+                              conns=2, depth=4, reqs_per_conn=100), 30)
+        assert granted == 0
+        assert replies < 200  # conns died early; no grants, no spin
+
+    run(_with_server(body, auth_token="sekrit"))
+
+
+def test_malformed_frames_get_error_reply_then_close():
+    async def body(srv):
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        # Bad version byte: one RESP_ERROR, then the server closes.
+        body_bytes = bytes([9]) + struct.pack("<I", 7) + bytes([wire.OP_PING])
+        writer.write(struct.pack("<I", len(body_bytes)) + body_bytes)
+        await writer.drain()
+        frame = await wire.read_frame(reader)
+        assert frame is not None
+        _, kind, vals = wire.decode_response(frame)
+        assert kind == wire.RESP_ERROR and "version" in vals[0]
+        assert await reader.read(1) == b""  # closed
+        writer.close()
+
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        # Oversized length prefix: error + close, no buffering attempt.
+        writer.write(struct.pack("<I", wire.MAX_FRAME + 1))
+        await writer.drain()
+        frame = await wire.read_frame(reader)
+        assert frame is not None
+        _, kind, vals = wire.decode_response(frame)
+        assert kind == wire.RESP_ERROR
+        writer.close()
+
+    run(_with_server(body))
+
+
+def test_zero_count_probe():
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            r = await store.acquire("z", 0, 5.0, 1.0)
+            assert r.granted  # zero-permit probe on a fresh bucket
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_latency_histogram_and_reset():
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            for i in range(50):
+                await store.acquire(f"h{i}", 1, 10.0, 1.0)
+            st = await store.stats(reset=True)
+            assert st["serving_samples"] >= 50
+            assert st["serving_p99_ms"] > 0
+            st2 = await store.stats()
+            assert st2["serving_samples"] < 50  # reset took
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_native_loadgen_smoke():
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_loadgen,
+    )
+
+    async def body(srv):
+        replies, granted, elapsed = await asyncio.to_thread(
+            native_loadgen, srv.host, srv.port, conns=2, depth=8,
+            reqs_per_conn=500)
+        assert replies == 2 * 500
+        assert granted == replies  # huge capacity: everything grants
+        assert elapsed > 0
+
+    run(_with_server(body))
+
+
+def test_chained_bulk_chunks_keep_order():
+    """A chunked acquire_many whose duplicate keys span chunk boundaries
+    must decide in request order through the passthrough lane (the
+    chained-frame bit's contract)."""
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port))
+        try:
+            # Force multi-chunk by shrinking the chunk budget.
+            import distributedratelimiting.redis_tpu.runtime.wire as w
+            old = w.BULK_CHUNK_BUDGET
+            w.BULK_CHUNK_BUDGET = 4096
+            try:
+                keys = [f"dup{i % 3}" for i in range(2000)]
+                res = await store.acquire_many(keys, [1] * 2000, 100.0, 1e-9)
+            finally:
+                w.BULK_CHUNK_BUDGET = old
+            # 3 keys x 100 capacity: exactly the FIRST 100 requests of
+            # each key grant (request order), the rest deny.
+            g = np.asarray(res.granted)
+            assert int(g.sum()) == 300
+            for m in range(3):
+                idx = np.arange(2000) % 3 == m
+                assert g[idx][:100].all() and not g[idx][100:].any()
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_invalid_utf8_key_does_not_wedge_the_pump():
+    """A key with invalid UTF-8 must neither kill the pump thread nor
+    poison its batch: it rate-limits under its own (surrogateescape)
+    identity and the connection keeps serving."""
+    async def body(srv):
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        bad_key = b"k\x80\xffbad"
+        payload = (struct.pack("<H", len(bad_key)) + bad_key
+                   + struct.pack("<idd", 1, 10.0, 1.0))
+        body_bytes = (bytes([wire.PROTOCOL_VERSION]) + struct.pack("<I", 5)
+                      + bytes([wire.OP_ACQUIRE]) + payload)
+        writer.write(struct.pack("<I", len(body_bytes)) + body_bytes)
+        await writer.drain()
+        frame = await asyncio.wait_for(wire.read_frame(reader), 10)
+        assert frame is not None
+        seq, kind, vals = wire.decode_response(frame)
+        assert seq == 5 and kind == wire.RESP_DECISION and vals[0] is True
+        writer.close()
+
+        # The pump survived: a normal client still gets served.
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            assert (await store.acquire("fine", 1, 10.0, 1.0)).granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_shutdown_with_inflight_batch_is_clean():
+    """aclose while a batch's store call is still awaiting must drain the
+    task before freeing the C handle (use-after-free guard)."""
+    class SlowStore(InProcessBucketStore):
+        async def acquire_many(self, *a, **kw):
+            await asyncio.sleep(0.3)
+            return await super().acquire_many(*a, **kw)
+
+    async def body():
+        srv = BucketStoreServer(SlowStore(), native_frontend=True)
+        await srv.start()
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        writer.write(wire.encode_request(1, wire.OP_ACQUIRE, "k", 1,
+                                         10.0, 1.0))
+        await writer.drain()
+        await asyncio.sleep(0.05)  # batch flushed, store call in flight
+        await srv.aclose()         # must drain the batch, then free
+        writer.close()
+
+    run(body())
+
+
+def test_hostname_resolves_for_native_listener():
+    async def body():
+        srv = BucketStoreServer(InProcessBucketStore(), host="localhost",
+                                native_frontend=True)
+        await srv.start()
+        try:
+            store = RemoteBucketStore(address=("127.0.0.1", srv.port),
+                                      coalesce_requests=False)
+            try:
+                assert (await store.acquire("k", 1, 10.0, 1.0)).granted
+            finally:
+                await store.aclose()
+        finally:
+            await srv.aclose()
+
+    run(body())
+
+
+def test_clean_shutdown_with_live_connection():
+    async def body():
+        srv = BucketStoreServer(InProcessBucketStore(), native_frontend=True)
+        await srv.start()
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        r = await store.acquire("k", 1, 10.0, 1.0)
+        assert r.granted
+        await srv.aclose()  # with the client still connected
+        await store.aclose()
+
+    run(body())
