@@ -848,7 +848,16 @@ def sema_batch_packed(state: SemaState, packed):
     ``exists=False`` starts at 0 held.
 
     Returns ``(new_state, out f32[2, B])``: row 0 ok (0/1 — releases are
-    always 1), row 1 post-op active count as seen by that row.
+    always 1), row 1 post-op active count as seen by that row — computed
+    from the same serialization prefix that admitted the row, so
+    duplicate acquire rows read their own serialized value, not the
+    post-batch total.
+
+    Caller contract: a batch must not mix releases with other rows of
+    the SAME slot — the state write clamps the slot's NET delta at zero,
+    which would let an over-release swallow a granted acquire's permit
+    (`DeviceBucketStore.concurrency_acquire_many` routes such rows
+    through sequential single-op dispatches instead).
     """
     slots = packed[0]
     deltas = packed[1]
@@ -888,10 +897,17 @@ def sema_batch_packed(state: SemaState, packed):
                                          mode="drop")
     ex_arr = state.exists.at[touch].set(True, mode="drop")
 
-    after = active_arr[gs]
+    # Per-row post-op view: active + earlier same-slot demand + this
+    # row's applied delta, clamped like the state itself. For a single
+    # row per slot this equals the slot's new value; for duplicate
+    # acquire rows it is each row's serialized count (the post-batch
+    # gather the old code used reported the FINAL total to every row).
+    after = jnp.maximum(
+        active_old.astype(jnp.float32) + prefix.astype(jnp.float32)
+        + applied.astype(jnp.float32), 0.0)
     out = jnp.stack([
         ok.astype(jnp.float32),
-        jnp.where(valid, after, 0).astype(jnp.float32),
+        jnp.where(valid, after, 0.0),
     ])
     return SemaState(active_arr, ts_arr, ex_arr), out
 

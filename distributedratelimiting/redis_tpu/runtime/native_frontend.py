@@ -7,11 +7,13 @@ flush-on-idle + max-batch, mirroring :class:`~.batcher.MicroBatcher`'s
 policy), and encodes/writes every reply natively. This module is the
 *decision* half: a pump thread blocks in ``fe_wait`` (GIL released) and
 dispatches each batch onto the server's asyncio loop as ONE store bulk
-call — so Python cost is per-flush, not per-request. Non-hot ops (HELLO,
-PEEK, SYNC, SEMA, STATS, SAVE, ACQUIRE_MANY, …) arrive as passthrough
-frames and are served by the same :class:`~.server.BucketStoreServer`
-handler the asyncio path uses; :mod:`~.wire` stays the single protocol
-authority for those shapes.
+call — so Python cost is per-flush, not per-request. The hot set is the
+four per-request decision ops: ACQUIRE, WINDOW, FWINDOW, and SEMA
+(signed-delta semaphore rows batch into ``concurrency_acquire_many``).
+Non-hot ops (HELLO, PEEK, SYNC, STATS, SAVE, ACQUIRE_MANY, …) arrive as
+passthrough frames and are served by the same
+:class:`~.server.BucketStoreServer` handler the asyncio path uses;
+:mod:`~.wire` stays the single protocol authority for those shapes.
 
 Why this exists: the per-request serving ceiling of the asyncio socket
 path is ~13K req/s/core even with a zero-cost kernel — per-request
@@ -42,6 +44,7 @@ __all__ = ["NativeFrontend", "native_loadgen"]
 _OP_BUCKET = wire.OP_ACQUIRE
 _OP_WINDOW = wire.OP_WINDOW
 _OP_FWINDOW = wire.OP_FWINDOW
+_OP_SEMA = wire.OP_SEMA
 
 
 class NativeFrontend:
@@ -218,6 +221,11 @@ class NativeFrontend:
                 if op == _OP_BUCKET:
                     res = await self._server.store.acquire_many(
                         gkeys, gcounts, a, b, with_remaining=True)
+                elif op == _OP_SEMA:
+                    # Signed deltas; a carries the permit limit (the
+                    # same frame layout the scalar wire op uses).
+                    res = await self._server.store.concurrency_acquire_many(
+                        gkeys, gcounts, int(a))
                 else:
                     res = await self._server.store.window_acquire_many(
                         gkeys, gcounts, a, b, fixed=(op == _OP_FWINDOW),

@@ -282,6 +282,34 @@ class BucketStore(abc.ABC):
     @abc.abstractmethod
     def concurrency_release_blocking(self, key: str, count: int) -> None: ...
 
+    async def concurrency_acquire_many(self, keys: Sequence[str],
+                                       deltas: Sequence[int], limit: int
+                                       ) -> "BulkAcquireResult":
+        """Vectorized semaphore ops: decide ``len(keys)`` signed deltas in
+        one call — +n acquires (all-or-nothing against ``limit``), -n
+        releases (always succeed, clamped at zero held), 0 probes.
+        Same-key rows serialize in request order, acquire admission
+        conservative against earlier in-call acquires (the token-bucket
+        bulk contract applied to held permits). Result rows: ``granted``
+        (releases always True), ``remaining`` = post-op active count
+        (0.0 for releases, matching the scalar wire reply). Default:
+        in-order loop over the per-key path; :class:`DeviceBucketStore`
+        overrides with single packed kernel dispatches."""
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32)
+        for i, (k, d) in enumerate(zip(keys, deltas)):
+            d = int(d)
+            if d >= 0:
+                r = await self.concurrency_acquire(k, d, int(limit))
+                granted[i] = r.granted
+                remaining[i] = r.remaining
+            else:
+                await self.concurrency_release(k, -d)
+                granted[i] = True
+                remaining[i] = 0.0
+        return BulkAcquireResult(granted, remaining)
+
     # -- lifecycle / ops ---------------------------------------------------
     @abc.abstractmethod
     async def aclose(self) -> None: ...
@@ -1511,6 +1539,81 @@ class DeviceBucketStore(BucketStore):
         out = self._sema_dispatch(key, -count, 0)
         if out is not None:
             np.asarray(out)
+
+    async def concurrency_acquire_many(self, keys, deltas, limit):
+        """Packed-kernel bulk semaphore ops: one ``sema_batch_packed``
+        dispatch per 4096-row chunk (chunks run in request order on the
+        donated state, so cross-chunk duplicates stay serialized).
+        Acquire rows resolve-with-allocate; probe/release rows look up
+        only — an unknown key answers (True, 0.0) host-side, never
+        allocating (same contract as the scalar path).
+
+        Same-key rows that MIX a release with anything else bypass the
+        packed dispatch and run as sequential single-op dispatches: the
+        kernel clamps a slot's net batch delta at zero, which would let
+        an over-release swallow a granted acquire's permit (per-op
+        semantics must survive over-release, not amplify it)."""
+        await self.connect()
+        n = len(keys)
+        deltas_np = np.asarray(deltas, np.int64)
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32)
+        slots = np.full(n, -1, np.int64)
+        acq_idx = np.nonzero(deltas_np > 0)[0]
+        other_idx = np.nonzero(deltas_np <= 0)[0]
+        # Mixed-sign duplicate hazard: keys with a release AND ≥2 rows.
+        release_keys = {keys[i] for i in np.nonzero(deltas_np < 0)[0]}
+        if release_keys:
+            counts_by_key: dict[str, int] = {}
+            for k in keys:
+                counts_by_key[k] = counts_by_key.get(k, 0) + 1
+            hazard_keys = {k for k in release_keys if counts_by_key[k] > 1}
+        else:
+            hazard_keys = set()
+        hazard = (np.fromiter((k in hazard_keys for k in keys), bool, n)
+                  if hazard_keys else np.zeros(n, bool))
+        outs = []
+        with self.profiler.span("sema_bulk", n), self._lock:
+            if len(acq_idx):
+                resolved = _resolve_with_reclaim(
+                    self._sema_dir, [keys[i] for i in acq_idx.tolist()],
+                    lambda pinned: self._sweep_semas(), self._grow_semas)
+                slots[acq_idx] = np.asarray(resolved, np.int64)
+            for i in other_idx.tolist():
+                s = self._sema_dir.lookup(keys[i])
+                slots[i] = -1 if s is None else s
+            known = slots >= 0
+            granted[~known] = True  # unknown-key probe/release: no-op OK
+            idx_known = np.nonzero(known & ~hazard)[0]
+            for s0 in range(0, len(idx_known), 4096):
+                sub = idx_known[s0:s0 + 4096]
+                b = _pad_size(len(sub), floor=8)
+                packed = np.full((4, b), -1, np.int32)
+                packed[1] = 0
+                packed[2] = 0
+                packed[0, :len(sub)] = slots[sub]
+                packed[1, :len(sub)] = deltas_np[sub]
+                packed[2, :len(sub)] = int(limit)
+                packed[3] = self.now_ticks_checked()
+                self._semas, out = K.sema_batch_packed(
+                    self._semas, jnp.asarray(packed))
+                outs.append((sub, out))
+            for i in np.nonzero(known & hazard)[0].tolist():
+                d = int(deltas_np[i])
+                # Mirror the scalar entry points: acquires and probes
+                # carry the real limit, releases carry 0 (ignored).
+                out = self._sema_dispatch(keys[i], d,
+                                          int(limit) if d >= 0 else 0)
+                outs.append((np.array([i]), out))
+        loop = asyncio.get_running_loop()
+        for sub, out in outs:
+            out_np = await loop.run_in_executor(
+                None, lambda o=out: np.asarray(o))
+            m = len(sub)
+            granted[sub] = out_np[0, :m] > 0.5
+            remaining[sub] = np.where(deltas_np[sub] < 0, 0.0,
+                                      out_np[1, :m])
+        return BulkAcquireResult(granted, remaining)
 
     # -- sliding window ----------------------------------------------------
     async def window_acquire(self, key: str, count: int, limit: float,

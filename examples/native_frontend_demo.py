@@ -63,9 +63,20 @@ async def main(platform: str) -> None:
     assert not (await store.fixed_window_acquire("fw", 1, 10.0, 60.0)).granted
     print(f"[{platform}] zero-probe + sliding/fixed windows OK")
 
+    # Concurrency semaphore rides the same hot batch path: 30 concurrent
+    # holds on a limit-10 key grant exactly 10; releases restore.
+    results = await asyncio.gather(
+        *(store.concurrency_acquire("gpu", 1, 10) for _ in range(30)))
+    assert sum(r.granted for r in results) == 10
+    await asyncio.gather(
+        *(store.concurrency_release("gpu", 1) for _ in range(10)))
+    r = await store.concurrency_acquire("gpu", 10, 10)
+    assert r.granted and abs(r.remaining - 10.0) < 1e-6
+    print(f"[{platform}] semaphore: exactly 10/30 held, release restores")
+
     # Stats surface reports the native front-end.
     st = await store.stats()
-    assert st["native_frontend"] is True and st["requests_served"] >= 38
+    assert st["native_frontend"] is True and st["requests_served"] >= 38, st
     print(f"[{platform}] stats: native_frontend=True, "
           f"requests={st['requests_served']}, "
           f"batches={st['batches_flushed']}, "

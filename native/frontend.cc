@@ -66,6 +66,7 @@ constexpr size_t kMaxConnOut = 64u << 20;  // runaway outbox => drop conn
 constexpr uint8_t OP_ACQUIRE = 1;
 constexpr uint8_t OP_WINDOW = 4;
 constexpr uint8_t OP_PING = 5;
+constexpr uint8_t OP_SEMA = 8;  // signed count: +acquire / -release / 0 probe
 constexpr uint8_t OP_FWINDOW = 9;
 constexpr uint8_t OP_HELLO = 10;
 
@@ -378,7 +379,8 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
   switch (op) {
       case OP_ACQUIRE:
       case OP_WINDOW:
-      case OP_FWINDOW: {
+      case OP_FWINDOW:
+      case OP_SEMA: {
         // [u16 klen][key utf-8][i32 count][f64 a][f64 b]
         if (len < kBodyOff + 2 + 20) {
           std::string err = encode_error(seq, "truncated request");
@@ -411,7 +413,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         break;
       }
       default: {
-        // HELLO, PEEK, SYNC, SEMA, STATS, SAVE, ACQUIRE_MANY, unknown:
+        // HELLO, PEEK, SYNC, STATS, SAVE, ACQUIRE_MANY, unknown:
         // Python decides (including the unknown-op error) — the wire
         // module stays the single authority for every non-hot shape.
         Passthrough ptf;

@@ -395,3 +395,110 @@ class TestSpuriousRelease:
         # And the semaphore still behaves normally afterwards.
         assert store.concurrency_acquire_blocking("never-acquired", 2, 3).granted
         assert not store.concurrency_acquire_blocking("never-acquired", 2, 3).granted
+
+
+class TestBulkSemaphore:
+    """concurrency_acquire_many — the packed bulk path the native
+    front-end batches OP_SEMA frames into."""
+
+    def test_mixed_batch_with_duplicates_serializes_in_order(self):
+        async def main():
+            store = device_store()
+            try:
+                keys = ["a", "a", "a", "b", "a", "b"]
+                deltas = [2, 2, 2, 1, -2, 0]
+                # limit 4: a gets 2+2 then denies the third; the release
+                # afterward applies; b's probe sees its own held count.
+                res = await store.concurrency_acquire_many(keys, deltas, 4)
+                assert res.granted.tolist() == [True, True, False, True,
+                                                True, True]
+                # post-release state: a holds 2, b holds 1
+                r = await store.concurrency_acquire("a", 2, 4)
+                assert r.granted and r.remaining == pytest.approx(4.0)
+            finally:
+                await store.aclose()
+
+        run(main())
+
+    def test_unknown_key_release_and_probe_allocate_nothing(self):
+        async def main():
+            store = device_store()
+            try:
+                res = await store.concurrency_acquire_many(
+                    ["ghost", "phantom"], [-3, 0], 5)
+                assert res.granted.tolist() == [True, True]
+                assert res.remaining.tolist() == [0.0, 0.0]
+                assert store._sema_dir.lookup("ghost") is None
+                assert store._sema_dir.lookup("phantom") is None
+            finally:
+                await store.aclose()
+
+        run(main())
+
+    def test_matches_scalar_path_on_distinct_keys(self):
+        # Exactness contract: bulk decisions equal the scalar path's
+        # whenever in-call keys are distinct (duplicates serialize
+        # conservatively — covered by the mixed-batch test above).
+        async def main():
+            bulk = device_store()
+            scalar = device_store()
+            try:
+                rng = np.random.default_rng(7)
+                keys = [f"k{i}" for i in range(50)]
+                deltas = [int(rng.integers(-2, 4)) for _ in range(50)]
+                # Seed both stores with identical held state first.
+                seed = [(k, 2) for k in keys[::3]]
+                await bulk.concurrency_acquire_many(
+                    [k for k, _ in seed], [d for _, d in seed], 6)
+                for k, d in seed:
+                    await scalar.concurrency_acquire(k, d, 6)
+                res = await bulk.concurrency_acquire_many(keys, deltas, 6)
+                for i, (k, d) in enumerate(zip(keys, deltas)):
+                    if d >= 0:
+                        r = await scalar.concurrency_acquire(k, d, 6)
+                        assert res.granted[i] == r.granted, i
+                        assert res.remaining[i] == pytest.approx(
+                            r.remaining), i
+                    else:
+                        await scalar.concurrency_release(k, -d)
+                        assert bool(res.granted[i]) is True
+            finally:
+                await bulk.aclose()
+                await scalar.aclose()
+
+        run(main())
+
+    def test_over_release_with_acquire_same_batch_keeps_the_permit(self):
+        """Regression: the kernel clamps a slot's NET batch delta at
+        zero, so an over-release plus a granted acquire in one packed
+        dispatch would lose the permit — such rows must serialize."""
+        async def main():
+            store = device_store()
+            try:
+                await store.concurrency_acquire("k", 2, 4)
+                res = await store.concurrency_acquire_many(
+                    ["k", "k"], [-5, 1], 4)
+                assert res.granted.tolist() == [True, True]
+                # Serial semantics: release clamps to 0 held, acquire
+                # lands 1. The store must still hold that permit.
+                r = await store.concurrency_acquire("k", 0, 4)
+                assert r.remaining == pytest.approx(1.0)
+            finally:
+                await store.aclose()
+
+        run(main())
+
+    def test_duplicate_acquires_report_serialized_remaining(self):
+        """Regression: each duplicate acquire row's `remaining` is its
+        own serialized post-op count, not the post-batch total."""
+        async def main():
+            store = device_store()
+            try:
+                res = await store.concurrency_acquire_many(
+                    ["k", "k", "k"], [1, 1, 1], 10)
+                assert res.granted.all()
+                assert res.remaining.tolist() == [1.0, 2.0, 3.0]
+            finally:
+                await store.aclose()
+
+        run(main())

@@ -190,6 +190,29 @@ def test_auth_required_flow():
     run(_with_server(unauthed, auth_token="sekrit"))
 
 
+def test_concurrency_semaphore_batched_natively():
+    """OP_SEMA rides the hot batch path: concurrent holds against one
+    limit grant exactly `limit`, releases restore capacity — all through
+    the unmodified client."""
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            results = await asyncio.gather(
+                *(store.concurrency_acquire("gpu", 1, 10)
+                  for _ in range(30)))
+            assert sum(r.granted for r in results) == 10
+            await asyncio.gather(
+                *(store.concurrency_release("gpu", 1) for _ in range(4)))
+            r = await store.concurrency_acquire("gpu", 4, 10)
+            assert r.granted and r.remaining == pytest.approx(10.0)
+            assert not (await store.concurrency_acquire("gpu", 1, 10)).granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
 def test_hello_pipelined_with_request_in_one_segment():
     """HELLO + ACQUIRE written in one TCP segment must both serve (the
     asyncio path handles this by reading frames sequentially; the native
