@@ -897,13 +897,16 @@ def sema_batch_packed(state: SemaState, packed):
                                          mode="drop")
     ex_arr = state.exists.at[touch].set(True, mode="drop")
 
-    # Per-row post-op view: active + earlier same-slot demand + this
-    # row's applied delta, clamped like the state itself. For a single
-    # row per slot this equals the slot's new value; for duplicate
-    # acquire rows it is each row's serialized count (the post-batch
-    # gather the old code used reported the FINAL total to every row).
+    # Per-row post-op view: active + earlier same-slot APPLIED deltas +
+    # this row's applied delta, clamped like the state itself. Admission
+    # used the conservative demand prefix above (earlier acquires count
+    # whether granted or not — no scan needed, never over-admits), but
+    # the REPORTED count sums only what actually landed, so a denied
+    # row can never read an impossible held value above the limit. For
+    # a single row per slot this equals the slot's new value.
+    applied_prefix = bm.duplicate_prefix(slots, applied, valid)
     after = jnp.maximum(
-        active_old.astype(jnp.float32) + prefix.astype(jnp.float32)
+        active_old.astype(jnp.float32) + applied_prefix.astype(jnp.float32)
         + applied.astype(jnp.float32), 0.0)
     out = jnp.stack([
         ok.astype(jnp.float32),

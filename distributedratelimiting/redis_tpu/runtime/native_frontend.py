@@ -196,22 +196,37 @@ class NativeFrontend:
         try:
             granted = np.zeros(n, np.uint8)
             remaining = np.zeros(n, np.float64)
-            # Single-config fast path: every frame carries the same
-            # (op, capacity, rate) — the overwhelmingly common shape (one
-            # limiter config per fleet). O(n) numpy check, one bulk call.
-            if ((ops == ops[0]).all() and (a_arr == a_arr[0]).all()
-                    and (b_arr == b_arr[0]).all()):
+            # SEMA rows go as ONE store call in arrival order with
+            # per-row limits: grouping them by (a, b) like the bucket
+            # ops would execute releases (a=0) in a separate group from
+            # acquires (a=limit), reordering same-key pipelined
+            # acquire→release pairs and leaking held permits.
+            sema_mask = ops == _OP_SEMA
+            groups: list = []
+            if sema_mask.any():
+                groups.append((_OP_SEMA, 0.0, 0.0,
+                               np.nonzero(sema_mask)[0]))
+            rest = np.nonzero(~sema_mask)[0]
+            if len(rest) == n and ((ops == ops[0]).all()
+                                   and (a_arr == a_arr[0]).all()
+                                   and (b_arr == b_arr[0]).all()):
+                # Single-config fast path: every frame carries the same
+                # (op, capacity, rate) — the overwhelmingly common shape
+                # (one limiter config per fleet). One bulk call.
                 groups = [(int(ops[0]), float(a_arr[0]), float(b_arr[0]),
                            None)]
-            else:
-                rec = np.empty(n, dtype=[("op", np.uint8),
-                                         ("a", np.float64),
-                                         ("b", np.float64)])
-                rec["op"], rec["a"], rec["b"] = ops, a_arr, b_arr
+            elif len(rest):
+                rec = np.empty(len(rest), dtype=[("op", np.uint8),
+                                                 ("a", np.float64),
+                                                 ("b", np.float64)])
+                rec["op"] = ops[rest]
+                rec["a"] = a_arr[rest]
+                rec["b"] = b_arr[rest]
                 uniq, inverse = np.unique(rec, return_inverse=True)
-                groups = [(int(u["op"]), float(u["a"]), float(u["b"]),
-                           np.nonzero(inverse == gi)[0])
-                          for gi, u in enumerate(uniq)]
+                groups.extend(
+                    (int(u["op"]), float(u["a"]), float(u["b"]),
+                     rest[np.nonzero(inverse == gi)[0]])
+                    for gi, u in enumerate(uniq))
             for op, a, b, idx in groups:
                 if idx is None:
                     gkeys, gcounts = keys, counts
@@ -222,10 +237,11 @@ class NativeFrontend:
                     res = await self._server.store.acquire_many(
                         gkeys, gcounts, a, b, with_remaining=True)
                 elif op == _OP_SEMA:
-                    # Signed deltas; a carries the permit limit (the
-                    # same frame layout the scalar wire op uses).
+                    # Signed deltas; each row's `a` carries its permit
+                    # limit (releases wire a=0, ignored per-row).
                     res = await self._server.store.concurrency_acquire_many(
-                        gkeys, gcounts, int(a))
+                        gkeys, gcounts,
+                        a_arr[idx].astype(np.int64))
                 else:
                     res = await self._server.store.window_acquire_many(
                         gkeys, gcounts, a, b, fixed=(op == _OP_FWINDOW),

@@ -283,25 +283,33 @@ class BucketStore(abc.ABC):
     def concurrency_release_blocking(self, key: str, count: int) -> None: ...
 
     async def concurrency_acquire_many(self, keys: Sequence[str],
-                                       deltas: Sequence[int], limit: int
+                                       deltas: Sequence[int],
+                                       limit: "int | Sequence[int]"
                                        ) -> "BulkAcquireResult":
         """Vectorized semaphore ops: decide ``len(keys)`` signed deltas in
-        one call — +n acquires (all-or-nothing against ``limit``), -n
-        releases (always succeed, clamped at zero held), 0 probes.
-        Same-key rows serialize in request order, acquire admission
-        conservative against earlier in-call acquires (the token-bucket
-        bulk contract applied to held permits). Result rows: ``granted``
-        (releases always True), ``remaining`` = post-op active count
-        (0.0 for releases, matching the scalar wire reply). Default:
-        in-order loop over the per-key path; :class:`DeviceBucketStore`
-        overrides with single packed kernel dispatches."""
+        one call — +n acquires (all-or-nothing against the row's limit),
+        -n releases (always succeed, clamped at zero held), 0 probes.
+        ``limit`` is a scalar or one per row (the native front-end sends
+        a whole micro-batch as ONE call with per-row limits so same-key
+        acquires and releases keep arrival order). Same-key rows
+        serialize in request order; duplicate-acquire admission may be
+        *conservative* on batched stores — an earlier same-key acquire's
+        demand reserves ahead of later rows even if it is denied — and
+        exact on serial stores, the same latitude :meth:`acquire_many`
+        documents for buckets. Result rows: ``granted`` (releases always
+        True), ``remaining`` = post-op active count from the row's own
+        serialized view (0.0 for releases, matching the scalar wire
+        reply). Default: in-order loop over the per-key path;
+        :class:`DeviceBucketStore` overrides with packed kernel
+        dispatches."""
         n = len(keys)
+        limits = self._sema_limits(limit, n)
         granted = np.empty(n, bool)
         remaining = np.empty(n, np.float32)
         for i, (k, d) in enumerate(zip(keys, deltas)):
             d = int(d)
             if d >= 0:
-                r = await self.concurrency_acquire(k, d, int(limit))
+                r = await self.concurrency_acquire(k, d, int(limits[i]))
                 granted[i] = r.granted
                 remaining[i] = r.remaining
             else:
@@ -309,6 +317,18 @@ class BucketStore(abc.ABC):
                 granted[i] = True
                 remaining[i] = 0.0
         return BulkAcquireResult(granted, remaining)
+
+    @staticmethod
+    def _sema_limits(limit, n: int) -> np.ndarray:
+        """Broadcast a scalar-or-per-row ``limit`` to ``i64[n]``."""
+        arr = np.asarray(limit, np.int64)
+        if arr.ndim == 0:
+            return np.full(n, int(arr), np.int64)
+        if arr.shape != (n,):
+            raise ValueError(
+                f"limit must be a scalar or one per row: got shape "
+                f"{arr.shape} for {n} rows")
+        return arr
 
     # -- lifecycle / ops ---------------------------------------------------
     @abc.abstractmethod
@@ -1556,22 +1576,25 @@ class DeviceBucketStore(BucketStore):
         await self.connect()
         n = len(keys)
         deltas_np = np.asarray(deltas, np.int64)
+        limits_np = self._sema_limits(limit, n)
         granted = np.zeros(n, bool)
         remaining = np.zeros(n, np.float32)
         slots = np.full(n, -1, np.int64)
         acq_idx = np.nonzero(deltas_np > 0)[0]
         other_idx = np.nonzero(deltas_np <= 0)[0]
         # Mixed-sign duplicate hazard: keys with a release AND ≥2 rows.
-        release_keys = {keys[i] for i in np.nonzero(deltas_np < 0)[0]}
-        if release_keys:
-            counts_by_key: dict[str, int] = {}
-            for k in keys:
-                counts_by_key[k] = counts_by_key.get(k, 0) + 1
-            hazard_keys = {k for k in release_keys if counts_by_key[k] > 1}
+        # Vectorized — releases are ~half of steady-state sema traffic,
+        # so this branch runs on most flushes and must not reintroduce
+        # per-request Python into the per-flush path.
+        if (deltas_np < 0).any():
+            uniq_inv = np.unique(np.asarray(keys, object),
+                                 return_inverse=True, return_counts=True)
+            _, inv, cnt = uniq_inv
+            rel_key = np.zeros(len(cnt), bool)
+            rel_key[inv[deltas_np < 0]] = True
+            hazard = rel_key[inv] & (cnt[inv] > 1)
         else:
-            hazard_keys = set()
-        hazard = (np.fromiter((k in hazard_keys for k in keys), bool, n)
-                  if hazard_keys else np.zeros(n, bool))
+            hazard = np.zeros(n, bool)
         outs = []
         with self.profiler.span("sema_bulk", n), self._lock:
             if len(acq_idx):
@@ -1593,7 +1616,7 @@ class DeviceBucketStore(BucketStore):
                 packed[2] = 0
                 packed[0, :len(sub)] = slots[sub]
                 packed[1, :len(sub)] = deltas_np[sub]
-                packed[2, :len(sub)] = int(limit)
+                packed[2, :len(sub)] = limits_np[sub]
                 packed[3] = self.now_ticks_checked()
                 self._semas, out = K.sema_batch_packed(
                     self._semas, jnp.asarray(packed))
@@ -1601,10 +1624,20 @@ class DeviceBucketStore(BucketStore):
             for i in np.nonzero(known & hazard)[0].tolist():
                 d = int(deltas_np[i])
                 # Mirror the scalar entry points: acquires and probes
-                # carry the real limit, releases carry 0 (ignored).
+                # carry the row's limit, releases carry 0 (ignored).
                 out = self._sema_dispatch(keys[i], d,
-                                          int(limit) if d >= 0 else 0)
-                outs.append((np.array([i]), out))
+                                          int(limits_np[i]) if d >= 0
+                                          else 0)
+                if out is None:
+                    # Key vanished between the top-of-call lookup and
+                    # this row (an interleaved acquire row's resolve can
+                    # sweep a zero-held stale slot): same contract as
+                    # the scalar path — unknown-key release/probe is a
+                    # successful no-op.
+                    granted[i] = True
+                    remaining[i] = 0.0
+                else:
+                    outs.append((np.array([i]), out))
         loop = asyncio.get_running_loop()
         for sub, out in outs:
             out_np = await loop.run_in_executor(

@@ -410,6 +410,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
       case OP_PING: {
         std::string resp = encode_empty(seq);
         send_to_conn(fe, c, resp.data(), resp.size());
+        fe->requests_served++;  // the asyncio server counts pings too
         break;
       }
       default: {

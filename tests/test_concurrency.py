@@ -502,3 +502,31 @@ class TestBulkSemaphore:
                 await store.aclose()
 
         run(main())
+
+    def test_denied_duplicate_rows_report_possible_counts(self):
+        """Regression: a denied row's `remaining` must sum only APPLIED
+        earlier demand — not denied demand — so it can never read a held
+        count above the limit."""
+        async def main():
+            store = device_store()
+            try:
+                res = await store.concurrency_acquire_many(
+                    ["k", "k", "k"], [3, 3, 3], 4)
+                assert res.granted.tolist() == [True, False, False]
+                assert res.remaining.tolist() == [3.0, 3.0, 3.0]
+            finally:
+                await store.aclose()
+
+        run(main())
+
+    def test_per_row_limits(self):
+        async def main():
+            store = device_store()
+            try:
+                res = await store.concurrency_acquire_many(
+                    ["a", "b", "c"], [2, 2, 2], [1, 2, 3])
+                assert res.granted.tolist() == [False, True, True]
+            finally:
+                await store.aclose()
+
+        run(main())

@@ -429,6 +429,43 @@ def test_hostname_resolves_for_native_listener():
     run(body())
 
 
+def test_approximate_limiter_converges_through_native_frontend():
+    """The flagship two-level algorithm over the native serving path:
+    two approximate limiter instances on separate TCP clients share one
+    global decaying counter (OP_SYNC rides the passthrough lane) and
+    converge on each other's load."""
+    from distributedratelimiting.redis_tpu.models.approximate import (
+        ApproximateTokenBucketRateLimiter,
+    )
+    from distributedratelimiting.redis_tpu.models.options import (
+        ApproximateTokenBucketOptions,
+    )
+
+    async def body(srv):
+        stores = [RemoteBucketStore(address=(srv.host, srv.port))
+                  for _ in range(2)]
+        lims = [ApproximateTokenBucketRateLimiter(
+            ApproximateTokenBucketOptions(
+                token_limit=100, tokens_per_period=10,
+                instance_name="global"), s) for s in stores]
+        try:
+            for lim in lims:
+                for _ in range(30):
+                    lim._try_lease(1)
+            for lim in lims:
+                await lim.refresh()
+            assert sum(l._global_score for l in lims) >= 60
+            for lim in lims:
+                assert lim.available_tokens < 100 - 30
+        finally:
+            for lim in lims:
+                await lim.aclose()
+            for s in stores:
+                await s.aclose()
+
+    run(_with_server(body))
+
+
 def test_clean_shutdown_with_live_connection():
     async def body():
         srv = BucketStoreServer(InProcessBucketStore(), native_frontend=True)
@@ -441,3 +478,32 @@ def test_clean_shutdown_with_live_connection():
         await store.aclose()
 
     run(body())
+
+
+def test_pipelined_sema_acquire_release_keeps_order():
+    """Regression: an acquire→release pair for one key pipelined into a
+    single micro-batch must decide in arrival order — config-grouping
+    them apart (releases wire a=0) executed releases first and leaked
+    the acquired permit permanently."""
+    async def body(srv):
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        burst = (wire.encode_request(1, wire.OP_SEMA, "gpu", 1, 10.0, 0.0)
+                 + wire.encode_request(2, wire.OP_SEMA, "gpu", -1, 0.0,
+                                       0.0))
+        writer.write(burst)
+        await writer.drain()
+        for _ in range(2):
+            f = await asyncio.wait_for(wire.read_frame(reader), 10)
+            seq, kind, vals = wire.decode_response(f)
+            assert kind == wire.RESP_DECISION and vals[0] is True
+        writer.close()
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            # Probe: zero held — the release really followed the acquire.
+            r = await store.concurrency_acquire("gpu", 0, 10)
+            assert r.granted and r.remaining == pytest.approx(0.0)
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
