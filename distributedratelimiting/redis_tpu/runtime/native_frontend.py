@@ -101,15 +101,19 @@ class NativeFrontend:
                                       name="native-frontend-pump")
         self._pump.start()
 
+    def _track_task(self, coro) -> asyncio.Task:
+        """Start ``coro`` as a loop task tracked for shutdown draining
+        (every task holding the C handle must finish before fe_free).
+        Loop-thread only."""
+        task = asyncio.ensure_future(coro)
+        self._loop_tasks.add(task)
+        task.add_done_callback(self._loop_tasks.discard)
+        return task
+
     def _track(self, coro) -> None:
         """Schedule ``coro`` on the loop from the pump thread, tracked
         for shutdown draining."""
-        def _schedule() -> None:
-            task = asyncio.ensure_future(coro)
-            self._loop_tasks.add(task)
-            task.add_done_callback(self._loop_tasks.discard)
-
-        self._loop.call_soon_threadsafe(_schedule)
+        self._loop.call_soon_threadsafe(self._track_task, coro)
 
     # -- pump thread -------------------------------------------------------
 
@@ -163,19 +167,11 @@ class NativeFrontend:
             b_arr.ctypes.data_as(c.POINTER(c.c_double)))
         # Decode keys off-loop (the pump has idle time while the loop
         # runs store calls); ascii fast path matches wire.py's.
-        raw = blob.raw[:int(kb)]
-        ends = np.cumsum(klens.astype(np.int64))
-        starts = ends - klens
-        if raw.isascii():
-            text = raw.decode("ascii")
-            keys = [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
-        else:
-            # surrogateescape: wire keys are bytes; invalid UTF-8 still
-            # maps 1:1 to a stable str key (and round-trips), so one
-            # hostile/corrupt key rate-limits under its own identity
-            # instead of poisoning its whole batch with a decode error.
-            keys = [raw[s:e].decode("utf-8", "surrogateescape")
-                    for s, e in zip(starts.tolist(), ends.tolist())]
+        # surrogateescape: wire keys are bytes; invalid UTF-8 still maps
+        # 1:1 to a stable str key, so a hostile/corrupt key rate-limits
+        # under its own identity instead of poisoning its whole batch.
+        keys = wire.decode_key_blob(blob.raw[:int(kb)], klens,
+                                    errors="surrogateescape")
         self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr))
 
     def _dispatch_passthrough(self) -> None:
@@ -280,10 +276,8 @@ class NativeFrontend:
             # chunks order behind the connection's tail.
             prev = (self._bulk_tails.get(conn_id)
                     if wire.bulk_request_chained(body) else None)
-            task = asyncio.ensure_future(
+            task = self._track_task(
                 self._serve_passthrough_inner(conn_id, body, after=prev))
-            self._loop_tasks.add(task)  # it calls fe_send: aclose must
-            task.add_done_callback(self._loop_tasks.discard)  # drain it
             self._bulk_tails[conn_id] = task
 
             def _clear(t, cid=conn_id):
@@ -305,7 +299,6 @@ class NativeFrontend:
     async def _serve_hello(self, conn_id: int, body: bytes) -> None:
         import hmac
 
-        srv = self._server
         try:
             seq, _, token, _, _, _ = wire.decode_request(body)
         except Exception:
@@ -313,8 +306,9 @@ class NativeFrontend:
                 0, wire.RESP_ERROR, "malformed HELLO frame"))
             self._lib.fe_close_conn(self._h, conn_id)
             return
-        if srv.auth_token is not None and not hmac.compare_digest(
-                token.encode(), srv.auth_token.encode()):
+        auth_token = self._server.auth_token
+        if auth_token is not None and not hmac.compare_digest(
+                token.encode(), auth_token.encode()):
             self._send(conn_id, wire.encode_response(
                 seq, wire.RESP_ERROR, "authentication failed"))
             self._lib.fe_close_conn(self._h, conn_id)
@@ -376,16 +370,27 @@ class NativeFrontend:
         self._h = None
 
 
+#: Ops the load generator can drive (all share the keyed-request frame
+#: layout; (a, b) mean (capacity, rate) / (limit, window_s) / (limit, -)).
+_LOADGEN_OPS = {
+    "acquire": wire.OP_ACQUIRE,
+    "window": wire.OP_WINDOW,
+    "fixed_window": wire.OP_FWINDOW,
+    "sema": wire.OP_SEMA,
+}
+
+
 def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
                    reqs_per_conn: int = 10000, keyspace: int = 1000,
-                   capacity: float = 1e7, fill_rate: float = 1e7
-                   ) -> tuple[int, int, float]:
+                   capacity: float = 1e7, fill_rate: float = 1e7,
+                   op: str = "acquire") -> tuple[int, int, float]:
     """Closed-loop native measurement client: ``conns`` connections each
-    keeping ``depth`` pipelined ACQUIRE requests in flight. Returns
-    ``(replies, granted, elapsed_s)``. Runs in C (one epoll thread) so a
-    Python client's ~14µs/request scheduling floor doesn't bound the
-    measurement — the asymmetric rig the per-request ceiling analysis
-    called for (benchmarks/RESULTS.md)."""
+    keeping ``depth`` pipelined requests of ``op`` (acquire / window /
+    fixed_window / sema) in flight. Returns ``(replies, granted,
+    elapsed_s)``. Runs in C (one epoll thread) so a Python client's
+    ~14µs/request scheduling floor doesn't bound the measurement — the
+    asymmetric rig the per-request ceiling analysis called for
+    (benchmarks/RESULTS.md)."""
     lib = load_frontend_lib()
     if lib is None:
         raise RuntimeError("native front-end library unavailable")
@@ -394,8 +399,9 @@ def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
     replies = c.c_longlong()
     granted = c.c_longlong()
     rc = lib.fe_loadgen(host.encode(), port, conns, depth, reqs_per_conn,
-                        keyspace, capacity, fill_rate, c.byref(elapsed),
-                        c.byref(replies), c.byref(granted))
+                        keyspace, capacity, fill_rate, _LOADGEN_OPS[op],
+                        c.byref(elapsed), c.byref(replies),
+                        c.byref(granted))
     if rc != 0:
         raise OSError("native loadgen failed to connect")
     return replies.value, granted.value, elapsed.value

@@ -394,15 +394,7 @@ def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
     if len(blob) != total:
         raise RemoteStoreError("truncated ACQUIRE_MANY key blob")
     counts = np.frombuffer(body, "<u4", n, off + total).astype(np.int64)
-    ends = np.cumsum(klens)
-    starts = ends - klens
-    if blob.isascii():
-        # Fast path: byte offsets == char offsets, one decode for the blob.
-        text = blob.decode("ascii")
-        keys = [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
-    else:
-        keys = [blob[s:e].decode("utf-8")
-                for s, e in zip(starts.tolist(), ends.tolist())]
+    keys = decode_key_blob(blob, klens)
     kind = (flags & _KIND_MASK) >> _KIND_SHIFT
     if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
         raise RemoteStoreError(f"unknown bulk kind {kind}")
@@ -415,6 +407,23 @@ def bulk_request_chained(body: bytes) -> bool:
     cheaper than a full decode). A truncated frame reads unchained; the
     full decode raises the routable error for it."""
     return len(body) > _BODY_OFF and bool(body[_BODY_OFF] & _FLAG_CHAINED)
+
+
+def decode_key_blob(blob: bytes, klens: "np.ndarray", *,
+                    errors: str = "strict") -> list[str]:
+    """Split a concatenated key blob into strings by per-key lengths —
+    one decode for the whole blob on the (overwhelming) ascii fast path.
+    Shared by the bulk-frame decoder (strict utf-8, a bad blob is a
+    routable frame error) and the native front-end's batch handoff
+    (``errors="surrogateescape"`` — there a hostile key must rate-limit
+    under its own stable identity rather than poison its batch)."""
+    ends = np.cumsum(np.asarray(klens, np.int64))
+    starts = ends - klens
+    if blob.isascii():
+        text = blob.decode("ascii")
+        return [text[s:e] for s, e in zip(starts.tolist(), ends.tolist())]
+    return [blob[s:e].decode("utf-8", errors)
+            for s, e in zip(starts.tolist(), ends.tolist())]
 
 
 def encode_bulk_response(seq: int, granted: "np.ndarray",

@@ -180,8 +180,8 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.fe_free.restype = None
     lib.fe_loadgen.argtypes = [
         c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
-        c.c_double, c.POINTER(c.c_double), c.POINTER(c.c_longlong),
-        c.POINTER(c.c_longlong)]
+        c.c_double, c.c_int, c.POINTER(c.c_double),
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.fe_loadgen.restype = c.c_int
     return lib
 

@@ -898,14 +898,14 @@ struct LgConn {
   size_t in_off = 0;
 };
 
-std::string lg_request(uint32_t seq, const std::string& key, double a,
-                       double b) {
+std::string lg_request(uint32_t seq, uint8_t op, const std::string& key,
+                       double a, double b) {
   std::string s;
   uint16_t klen = uint16_t(key.size());
   wr_u32(&s, uint32_t(kBodyOff + 2 + klen + 20));
   s.push_back(char(kVersion));
   wr_u32(&s, seq);
-  s.push_back(char(OP_ACQUIRE));
+  s.push_back(char(op));
   s.append(reinterpret_cast<const char*>(&klen), 2);
   s.append(key);
   int32_t count = 1;
@@ -919,8 +919,9 @@ std::string lg_request(uint32_t seq, const std::string& key, double a,
 
 int fe_loadgen(const char* host, int port, int n_conns, int depth,
                int reqs_per_conn, int keyspace, double a, double b,
-               double* out_elapsed_s, long long* out_replies,
+               int op, double* out_elapsed_s, long long* out_replies,
                long long* out_granted) {
+  uint8_t op8 = uint8_t(op > 0 ? op : OP_ACQUIRE);
   std::vector<LgConn> conns{size_t(n_conns)};
   int epfd = epoll_create1(0);
   sockaddr_in addr{};
@@ -955,7 +956,7 @@ int fe_loadgen(const char* host, int port, int n_conns, int depth,
     for (int d = 0; d < depth && d < reqs_per_conn; d++) {
       std::string key =
           "lg" + std::to_string(i) + "-" + std::to_string(d % keyspace);
-      burst += lg_request(uint32_t(conns[size_t(i)].sent++), key, a, b);
+      burst += lg_request(uint32_t(conns[size_t(i)].sent++), op8, key, a, b);
     }
     ssize_t r = ::send(conns[size_t(i)].fd, burst.data(), burst.size(),
                        MSG_NOSIGNAL);
@@ -1010,7 +1011,7 @@ int fe_loadgen(const char* host, int port, int n_conns, int depth,
         for (int d = 0; d < completed && c.sent < reqs_per_conn; d++) {
           std::string key = "lg" + std::to_string(events[e].data.u32) + "-" +
                             std::to_string(c.sent % keyspace);
-          burst += lg_request(uint32_t(c.sent++), key, a, b);
+          burst += lg_request(uint32_t(c.sent++), op8, key, a, b);
         }
         ssize_t r = ::send(c.fd, burst.data(), burst.size(), MSG_NOSIGNAL);
         (void)r;

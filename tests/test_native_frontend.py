@@ -507,3 +507,63 @@ def test_pipelined_sema_acquire_release_keeps_order():
             await store.aclose()
 
     run(_with_server(body))
+
+
+def test_connection_churn_leaks_nothing():
+    """500 short-lived connections (one op each, then close): the IO
+    thread must reap every socket — no fd growth, and the server keeps
+    serving afterward."""
+    import os
+
+    def count_fds() -> int:
+        return len(os.listdir("/proc/self/fd"))
+
+    async def body(srv):
+        before = count_fds()
+        for i in range(500):
+            reader, writer = await asyncio.open_connection(srv.host,
+                                                           srv.port)
+            writer.write(wire.encode_request(1, wire.OP_ACQUIRE,
+                                             f"churn{i}", 1, 10.0, 1.0))
+            await writer.drain()
+            f = await asyncio.wait_for(wire.read_frame(reader), 10)
+            assert f is not None
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        # Give the IO thread a beat to reap the last EOFs.
+        await asyncio.sleep(0.3)
+        after = count_fds()
+        assert after <= before + 8, (before, after)  # no per-conn leak
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            st = await store.stats()
+            assert st["connections_served"] >= 500
+            assert (await store.acquire("post-churn", 1, 10.0, 1.0)).granted
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+
+
+def test_native_loadgen_op_sweep():
+    """The C load generator drives every hot op kind; sema permits leak
+    nothing because the keyspace bounds the distinct keys and the huge
+    limit grants everything."""
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_loadgen,
+    )
+
+    async def body(srv):
+        for op in ("acquire", "window", "fixed_window", "sema"):
+            replies, granted, elapsed = await asyncio.to_thread(
+                native_loadgen, srv.host, srv.port, conns=2, depth=8,
+                reqs_per_conn=300, keyspace=50, capacity=1e9,
+                fill_rate=1e9, op=op)
+            assert replies == 600, op
+            assert granted == 600, op
+
+    run(_with_server(body))
