@@ -391,6 +391,10 @@ def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
     ~14µs/request scheduling floor doesn't bound the measurement — the
     asymmetric rig the per-request ceiling analysis called for
     (benchmarks/RESULTS.md)."""
+    if op not in _LOADGEN_OPS:
+        raise ValueError(
+            f"unknown loadgen op {op!r}; choose from "
+            f"{sorted(_LOADGEN_OPS)}")
     lib = load_frontend_lib()
     if lib is None:
         raise RuntimeError("native front-end library unavailable")

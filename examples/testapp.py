@@ -299,7 +299,8 @@ def cmd_cluster(args) -> int:
     async def main():
         servers = []
         for _ in range(args.nodes):
-            srv = BucketStoreServer(InProcessBucketStore())
+            srv = BucketStoreServer(InProcessBucketStore(),
+                                    native_frontend=args.native_frontend)
             await srv.start()
             servers.append(srv)
         store = ClusterBucketStore(
@@ -372,6 +373,9 @@ def main(argv: list[str] | None = None) -> int:
                        "client-side key routing; kills a node to show "
                        "per-node degraded mode")
     p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--native-frontend", action="store_true",
+                   help="serve each node's sockets from the C++ epoll "
+                   "front-end (native/frontend.cc)")
     p.add_argument("--n", type=int, default=1000,
                    help="keys in the bulk call")
     p.set_defaults(fn=cmd_cluster)
