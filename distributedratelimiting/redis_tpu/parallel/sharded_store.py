@@ -106,7 +106,7 @@ def shard_of_key(key: str, n_shards: int) -> int:
     """Stable key→shard routing (host side). crc32 so every client process
     on every host routes identically — the distributed directory needs no
     coordination."""
-    return zlib.crc32(key.encode()) % n_shards
+    return zlib.crc32(key.encode("utf-8", "surrogateescape")) % n_shards
 
 
 def route_keys(keys: "Sequence[str] | list[str]", n_shards: int) -> np.ndarray:
@@ -126,7 +126,9 @@ def route_keys(keys: "Sequence[str] | list[str]", n_shards: int) -> np.ndarray:
                 keys, n_shards,
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))) == 0:
             return out
-    return np.fromiter((zlib.crc32(k.encode()) % n_shards for k in keys),
+    return np.fromiter(
+        (zlib.crc32(k.encode("utf-8", "surrogateescape")) % n_shards
+         for k in keys),
                        np.int32, n)
 
 

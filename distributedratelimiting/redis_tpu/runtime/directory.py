@@ -131,9 +131,20 @@ class NativeKeyDirectory(KeyDirectory):
         if h and getattr(self, "_lib", None) is not None:
             self._lib.dir_free(h)
 
-    def resolve_batch(self, keys: list[str]) -> np.ndarray:
+    def resolve_batch(self, keys) -> np.ndarray:
         out = np.empty(len(keys), np.int32)
         out_ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        blob = getattr(keys, "blob", None)
+        if blob is not None:
+            # Wire-blob fast path (wire.KeyBlob): the frame's key bytes
+            # probe the table directly — no Python strings anywhere.
+            self._lib.dir_resolve_batch(
+                self._h, blob,
+                keys.offsets.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)),
+                len(keys), out_ptr,
+            )
+            return out
         if self._lib.has_pylist:
             # Zero-copy: C reads each str's cached UTF-8 directly.
             if not isinstance(keys, list):
@@ -143,7 +154,7 @@ class NativeKeyDirectory(KeyDirectory):
                 return out
             # Non-str element: fall through to the encode path, which will
             # raise the natural AttributeError/TypeError.
-        encoded = [k.encode("utf-8") for k in keys]
+        encoded = [k.encode("utf-8", "surrogateescape") for k in keys]
         offsets = np.zeros(len(keys) + 1, np.int64)
         np.cumsum([len(e) for e in encoded], out=offsets[1:])
         blob = b"".join(encoded)
@@ -155,7 +166,7 @@ class NativeKeyDirectory(KeyDirectory):
         return out
 
     def lookup(self, key: str) -> int | None:
-        kb = key.encode("utf-8")
+        kb = key.encode("utf-8", "surrogateescape")
         slot = self._lib.dir_lookup(self._h, kb, len(kb))
         return None if slot < 0 else int(slot)
 
@@ -198,8 +209,12 @@ class NativeKeyDirectory(KeyDirectory):
             slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         raw = keys_buf.raw
+        # surrogateescape: the KeyBlob serving lane inserts raw key BYTES
+        # (byte-identity keys, wire.py ACQUIRE_MANY notes) — a snapshot
+        # must round-trip them, not crash on the first non-UTF-8 key.
         return {
-            raw[offsets[i]:offsets[i + 1]].decode("utf-8"): int(slots[i])
+            raw[offsets[i]:offsets[i + 1]].decode(
+                "utf-8", "surrogateescape"): int(slots[i])
             for i in range(count)
         }
 
@@ -209,7 +224,11 @@ class NativeKeyDirectory(KeyDirectory):
         lib.dir_free(h)
         self._h = lib.dir_new(n_slots)
         for key, slot in mapping.items():
-            kb = key.encode("utf-8")
+            # surrogateescape: snapshots from a PyKeyDirectory-backed
+            # server may carry byte-identity keys (wire.KeyBlob lane) as
+            # surrogate-bearing strs; a strict encode would crash-loop
+            # the restore this path exists to serve.
+            kb = key.encode("utf-8", "surrogateescape")
             if lib.dir_insert(self._h, kb, len(kb), int(slot)) != 0:
                 raise ValueError(f"duplicate key in restore mapping: {key!r}")
         used = set(mapping.values())

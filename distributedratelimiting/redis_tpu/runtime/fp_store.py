@@ -71,7 +71,7 @@ def _fp64_py(key: str) -> int:
     ``dir_fp64_pylist`` (fingerprints live in device tables and
     checkpoints; every process must hash keys the same way)."""
     h = _FNV_OFFSET
-    for byte in key.encode():
+    for byte in key.encode("utf-8", "surrogateescape"):
         h = ((h ^ byte) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
     return h or _FNV_OFFSET
 

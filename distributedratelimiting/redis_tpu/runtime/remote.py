@@ -345,7 +345,8 @@ class RemoteBucketStore(BucketStore):
     def _bulk_prepare(self, keys: Sequence[str], counts: Sequence[int]
                       ) -> tuple[list[bytes], np.ndarray,
                                  list[tuple[int, int]]]:
-        key_blobs = [k.encode("utf-8") for k in keys]
+        key_blobs = [k.encode("utf-8", "surrogateescape")
+                     for k in keys]
         counts_np = np.asarray(counts, np.uint32)
         lens = np.fromiter((len(b) for b in key_blobs), np.int64, len(keys))
         return key_blobs, counts_np, wire.bulk_chunk_spans(lens)

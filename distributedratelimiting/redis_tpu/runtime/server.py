@@ -256,8 +256,12 @@ class BucketStoreServer:
                 # decode + serve them on their own path. One frame = one
                 # store bulk call = (on a device store) a handful of
                 # scanned kernel launches for thousands of decisions.
+                # as_view: keys stay a zero-copy KeyBlob over the frame
+                # bytes — device-backed stores resolve them natively
+                # without materializing per-key Python strings; serial
+                # stores iterate the view like the list they used to get.
                 seq, keys, counts, a, b, with_rem, kind = (
-                    wire.decode_bulk_request(body))
+                    wire.decode_bulk_request(body, as_view=True))
                 if kind == wire.BULK_KIND_BUCKET:
                     res = await self.store.acquire_many(
                         keys, counts, a, b, with_remaining=with_rem)

@@ -609,7 +609,9 @@ class _PackedLaunchMixin:
         outs: list[tuple] = []
         compact = n > 0 and int(counts_np.max(initial=0)) <= 0xFF
         with self.store.profiler.span(self._BULK_SPAN, n), self.store._lock:
-            slots = self.resolve_slots(list(keys))
+            # keys may be a wire.KeyBlob: the native directory resolves
+            # straight from the frame's byte blob (zero Python strings).
+            slots = self.resolve_slots(keys)
             now = self.store.now_ticks_checked()
             pos = 0
             while pos < n:
@@ -712,7 +714,7 @@ class _PackedLaunchMixin:
             return None
         with self.store.profiler.span("acquire_many_grouped", n), \
                 self.store._lock:
-            slots = self.resolve_slots(list(keys))
+            slots = self.resolve_slots(keys)  # KeyBlob-aware (see above)
             g = self._bulk_groups(slots, counts_np)
             if g is None:
                 return None

@@ -42,6 +42,12 @@ Request payloads:
                        2 fixed window with (a, b) = (limit, window_s)).
                        Length/count arrays are raw little-endian vectors so
                        both ends move them with numpy, not per-key packing.
+                       Keys are byte strings end-to-end on the serving
+                       path (the server resolves them from the frame blob
+                       natively — ``KeyBlob``); invalid UTF-8 rate-limits
+                       under its own stable identity rather than erroring
+                       the frame, matching the native front-end's
+                       per-request lane.
                        Clients split larger bulks into multiple frames via
                        :func:`bulk_chunk_spans` (every chunk ≤ MAX_FRAME)
                        and pipeline the chunks on one connection.
@@ -87,7 +93,7 @@ __all__ = [
     "ProtocolVersionError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
     "encode_bulk_request", "decode_bulk_request", "encode_bulk_response",
-    "bulk_chunk_spans",
+    "bulk_chunk_spans", "KeyBlob", "decode_key_blob",
     "BULK_KIND_BUCKET", "BULK_KIND_WINDOW", "BULK_KIND_FWINDOW",
     "read_frame", "write_frame",
 ]
@@ -169,7 +175,9 @@ def _check_version(ver: int) -> None:
 
 
 def _keyed(key: str, tail: bytes) -> bytes:
-    kb = key.encode("utf-8")
+    # surrogateescape: byte-identity keys round-trip through str (the
+    # serving side treats keys as bytes — see the ACQUIRE_MANY notes).
+    kb = key.encode("utf-8", "surrogateescape")
     if len(kb) > 0xFFFF:
         raise ValueError("key exceeds 65535 utf-8 bytes")
     return _KEYED.pack(len(kb)) + kb + tail
@@ -177,7 +185,10 @@ def _keyed(key: str, tail: bytes) -> bytes:
 
 def _split_key(payload: bytes) -> tuple[str, bytes]:
     (klen,) = _KEYED.unpack_from(payload, 0)
-    key = payload[2:2 + klen].decode("utf-8")
+    # surrogateescape, matching _keyed: a byte-identity key admitted by
+    # the bulk lane must round-trip through scalar ops (PEEK/SYNC/
+    # single ACQUIRE) too, not error only there.
+    key = payload[2:2 + klen].decode("utf-8", "surrogateescape")
     return key, payload[2 + klen:]
 
 
@@ -377,9 +388,15 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
     return _HDR.pack(length, PROTOCOL_VERSION, seq, OP_ACQUIRE_MANY) + payload
 
 
-def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
-                                               float, float, bool, int]:
-    """Returns ``(seq, keys, counts[i64], a, b, with_remaining, kind)``."""
+def decode_bulk_request(frame: bytes, *, as_view: bool = False
+                        ) -> tuple[int, "list[str] | KeyBlob", "np.ndarray",
+                                   float, float, bool, int]:
+    """Returns ``(seq, keys, counts[i64], a, b, with_remaining, kind)``.
+
+    ``as_view=True`` returns the keys as a :class:`KeyBlob` instead of a
+    list — the server's hot path, where a device-backed store resolves
+    keys straight from the blob in native code and Python never
+    materializes per-key strings."""
     ver, seq, op = _VER_SEQ_OP.unpack_from(frame, 0)
     _check_version(ver)
     if op != OP_ACQUIRE_MANY:
@@ -394,7 +411,12 @@ def decode_bulk_request(frame: bytes) -> tuple[int, list[str], "np.ndarray",
     if len(blob) != total:
         raise RemoteStoreError("truncated ACQUIRE_MANY key blob")
     counts = np.frombuffer(body, "<u4", n, off + total).astype(np.int64)
-    keys = decode_key_blob(blob, klens)
+    if as_view:
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum(klens, out=offsets[1:])
+        keys: "list[str] | KeyBlob" = KeyBlob(blob, offsets)
+    else:
+        keys = decode_key_blob(blob, klens)
     kind = (flags & _KIND_MASK) >> _KIND_SHIFT
     if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
         raise RemoteStoreError(f"unknown bulk kind {kind}")
@@ -407,6 +429,47 @@ def bulk_request_chained(body: bytes) -> bool:
     cheaper than a full decode). A truncated frame reads unchained; the
     full decode raises the routable error for it."""
     return len(body) > _BODY_OFF and bool(body[_BODY_OFF] & _FLAG_CHAINED)
+
+
+class KeyBlob:
+    """Zero-copy view of a bulk frame's keys: the concatenated utf-8
+    blob plus ``i64[n+1]`` boundary offsets. The serving path hands this
+    straight to the native key directory (``dir_resolve_batch`` probes
+    the blob in C), so a 100K-key frame costs ZERO Python string
+    objects on the device-store hot path. Sequence duck-typing
+    (``len``/iteration/indexing, decoding lazily with surrogateescape —
+    the same stable-identity-for-any-bytes rule as the native
+    front-end's batch lane) keeps every other store working unchanged:
+    serial stores just iterate it like the list they used to get."""
+
+    __slots__ = ("blob", "offsets")
+
+    def __init__(self, blob: bytes, offsets: "np.ndarray") -> None:
+        self.blob = blob
+        self.offsets = offsets  # i64[n+1], offsets[0] == 0
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> str:
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return self.blob[self.offsets[i]:self.offsets[i + 1]].decode(
+            "utf-8", "surrogateescape")
+
+    def __iter__(self):
+        o = self.offsets.tolist()
+        blob = self.blob
+        for s, e in zip(o, o[1:]):
+            yield blob[s:e].decode("utf-8", "surrogateescape")
+
+    def tolist(self) -> list[str]:
+        return decode_key_blob(self.blob,
+                               np.diff(self.offsets),
+                               errors="surrogateescape")
 
 
 def decode_key_blob(blob: bytes, klens: "np.ndarray", *,

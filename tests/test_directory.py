@@ -139,3 +139,55 @@ def test_arena_compaction_under_key_churn():
     assert nd.arena_bytes == sum(len(k) for k in final)
     for k in final:
         assert nd.lookup(k) is not None
+
+
+def test_native_blob_resolve_matches_list_resolve():
+    """wire.KeyBlob resolves to the same slots as the list[str] path —
+    the zero-copy serving lane and the classic path are one directory."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.runtime.directory import (
+        make_directory,
+    )
+    from distributedratelimiting.redis_tpu.runtime.wire import KeyBlob
+
+    d = make_directory(64)
+    keys = [f"k{i % 20}" for i in range(50)] + ["dup", "dup"]
+    blobs = [k.encode() for k in keys]
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    view = KeyBlob(b"".join(blobs), offsets)
+    via_view = d.resolve_batch(view)
+    via_list = d.resolve_batch(list(keys))
+    assert (via_view == via_list).all()
+    assert len(set(via_view.tolist())) == 21  # 20 distinct + "dup"
+
+
+def test_byte_identity_keys_survive_snapshot_and_restore():
+    """Regression (review): a byte-identity key inserted via the KeyBlob
+    lane must survive to_dict (strict decode crashed it) and a
+    cross-backend load (strict encode crashed it)."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.runtime.directory import (
+        NativeKeyDirectory, PyKeyDirectory, make_directory,
+    )
+    from distributedratelimiting.redis_tpu.runtime.wire import KeyBlob
+
+    d = make_directory(8)
+    bad = b"\xff\x80key"
+    offsets = np.array([0, len(bad)], np.int64)
+    slot = int(d.resolve_batch(KeyBlob(bad, offsets))[0])
+    assert slot >= 0
+    snap = d.to_dict()  # must not raise
+    assert len(snap) == 1
+
+    # Cross-backend restore in both directions.
+    py = PyKeyDirectory(8)
+    py.load(snap, 8)
+    assert py.resolve_batch(KeyBlob(bad, offsets))[0] == slot
+    d2 = make_directory(8)
+    d2.load(snap, 8)
+    assert int(d2.resolve_batch(KeyBlob(bad, offsets))[0]) == slot
+    if isinstance(d2, NativeKeyDirectory):
+        assert d2.lookup(snap and next(iter(snap))) == slot

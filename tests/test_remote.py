@@ -810,3 +810,52 @@ def test_post_close_use_fails_fast_without_thread_leak():
             assert threading.active_count() == before  # no resurrected loop
 
     run(main())
+
+
+def test_bulk_frame_with_invalid_utf8_key_serves_by_byte_identity():
+    """Bulk keys are byte strings end-to-end on the serving path: an
+    invalid-UTF-8 key rate-limits under its own stable identity instead
+    of erroring the whole frame (matching the native front-end's
+    per-request lane)."""
+    import numpy as np
+
+    async def main():
+        async with BucketStoreServer(InProcessBucketStore()) as srv:
+            reader, writer = await asyncio.open_connection(srv.host,
+                                                           srv.port)
+            bad = b"\xff\x80key"
+            frame = wire.encode_bulk_request(
+                9, [bad, bad, b"ok"], np.array([1, 1, 1]), 1.0, 1e-9,
+                with_remaining=False)
+            writer.write(frame)
+            await writer.drain()
+            resp = await asyncio.wait_for(wire.read_frame(reader), 10)
+            seq, kind, (granted, _) = wire.decode_response(resp)
+            assert seq == 9 and kind == wire.RESP_BULK
+            # Capacity 1: the duplicate bad key grants once, not twice —
+            # both rows resolved to ONE stable identity.
+            assert granted.tolist() == [True, False, True]
+            writer.close()
+
+    run(main())
+
+
+def test_byte_identity_key_round_trips_scalar_ops_too():
+    """A byte-identity key admitted via the bulk lane must also serve
+    through scalar ops on the same server (surrogateescape end-to-end,
+    not bulk-only)."""
+    async def main():
+        async with BucketStoreServer(InProcessBucketStore()) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                key = b"\xff\x80weird".decode("utf-8", "surrogateescape")
+                r = await store.acquire(key, 2, 5.0, 1e-9)
+                assert r.granted and r.remaining == 3.0
+                avail = await asyncio.to_thread(store.peek_blocking,
+                                                key, 5.0, 1e-9)
+                assert avail == 3.0  # same identity as the acquire
+            finally:
+                await store.aclose()
+
+    run(main())
