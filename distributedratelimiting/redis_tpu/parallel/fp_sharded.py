@@ -343,7 +343,7 @@ class ShardedFpDeviceStore:
                 np.zeros(0, bool),
                 np.zeros(0, np.float32) if with_remaining else None)
         counts_np = np.asarray(counts, np.int64)
-        fps = fingerprints(list(keys))
+        fps = fingerprints(keys)  # KeyBlob-aware
         routes = fps[:, 0] % np.uint32(self.n_shards)
         order = np.argsort(routes, kind="stable")  # per-shard arrival order
         bounds = np.searchsorted(routes[order], np.arange(self.n_shards + 1))

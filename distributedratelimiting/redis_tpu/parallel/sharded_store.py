@@ -118,6 +118,18 @@ def route_keys(keys: "Sequence[str] | list[str]", n_shards: int) -> np.ndarray:
 
     n = len(keys)
     lib = load_directory_lib()
+    blob = getattr(keys, "blob", None)
+    if lib is not None and blob is not None:
+        # wire.KeyBlob fast path: crc32-route straight off the frame's
+        # key bytes (no Python strings — the mesh serving lane's half of
+        # the zero-copy bulk path).
+        out = np.empty(n, np.int32)
+        lib.dir_route_batch(
+            blob,
+            keys.offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, n_shards,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
     if lib is not None and lib.has_pylist:
         if not isinstance(keys, list):
             keys = list(keys)
@@ -366,7 +378,7 @@ class _ShardedKeyedTable:
         if n == 0:
             return BulkAcquireResult(granted_out, rem_out)
         with self._lock:
-            shards, locs = self._resolve_batch(list(keys))
+            shards, locs = self._resolve_batch(keys)  # KeyBlob-aware
             jpos, shard_counts = self._group_by_shard(shards)
             max_rows = int(shard_counts.max(initial=1))
             b = _pad_size(min(max_rows, self._BULK_B), floor=8)
@@ -427,6 +439,8 @@ class _ShardedKeyedTable:
         fused = self._resolve_batch_fused(keys)
         if fused is not None:
             return fused
+        if not isinstance(keys, list):
+            keys = list(keys)  # split path indexes str refs via numpy
         shards = route_keys(keys, self.n_shards)
         locs = np.empty(len(keys), np.int32)
         # Object-array gather: numpy fancy indexing moves the str refs at
@@ -501,7 +515,8 @@ class _ShardedKeyedTable:
         if not fused_ok:
             return None
         lib = load_directory_lib()
-        if not isinstance(keys, list):
+        blob = getattr(keys, "blob", None)
+        if blob is None and not isinstance(keys, list):
             keys = list(keys)
         n = len(keys)
         shards = np.empty(n, np.int32)
@@ -514,6 +529,14 @@ class _ShardedKeyedTable:
             # the underlying native handle.
             handles = (ctypes.c_void_p * self.n_shards)(
                 *(d._h for d in self.dirs))
+            if blob is not None:
+                # wire.KeyBlob zero-copy lane: route + probe straight off
+                # the frame's key bytes (no Python strings).
+                return int(lib.dir_resolve_sharded_batch(
+                    blob,
+                    keys.offsets.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)),
+                    n, handles, self.n_shards, sh_ptr, lo_ptr))
             return int(lib.dir_resolve_sharded_pylist(
                 keys, handles, self.n_shards, sh_ptr, lo_ptr))
 

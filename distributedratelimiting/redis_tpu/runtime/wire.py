@@ -446,7 +446,10 @@ class KeyBlob:
 
     def __init__(self, blob: bytes, offsets: "np.ndarray") -> None:
         self.blob = blob
-        self.offsets = offsets  # i64[n+1], offsets[0] == 0
+        # Contiguous i64 is a hard requirement: four native lanes
+        # reinterpret this buffer as int64* — a stray int32/strided
+        # array would read garbage offsets in C (no Python-level error).
+        self.offsets = np.ascontiguousarray(offsets, np.int64)
 
     def __len__(self) -> int:
         return len(self.offsets) - 1

@@ -80,6 +80,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_int32,
         c.POINTER(c.c_int32)]
     lib.dir_route_batch.restype = None
+    lib.dir_resolve_sharded_batch.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64,
+        c.POINTER(c.c_void_p), c.c_int32, c.POINTER(c.c_int32),
+        c.POINTER(c.c_int32)]
+    lib.dir_resolve_sharded_batch.restype = c.c_int64
+    lib.dir_fp64_batch.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64,
+        c.POINTER(c.c_uint32)]
+    lib.dir_fp64_batch.restype = c.c_int64
     try:
         lib.dir_resolve_pylist.argtypes = [c.c_void_p, c.py_object,
                                            c.POINTER(c.c_int32)]

@@ -53,6 +53,24 @@ struct Directory {
   }
 };
 
+// 64-bit key fingerprint (FNV-1a with the all-zero remap) for the
+// device-resident fingerprint directory. ONE definition shared by the
+// blob and pylist entry points — fingerprints live in device tables and
+// checkpoints, so every process must hash bit-identically (the Python
+// fallback _fp64_py mirrors this; note fnv1a() below is NOT the same
+// function: its |1 remap serves the host directory's empty sentinel).
+inline uint64_t fp64_of(const char* key, int64_t len) {
+  constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
+  constexpr uint64_t kFnvPrime = 1099511628211ULL;
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(key[i]);
+    h *= kFnvPrime;
+  }
+  if (h == 0) h = kFnvOffset;
+  return h;
+}
+
 inline uint64_t fnv1a(const char* data, uint32_t len) {
   uint64_t h = 1469598103934665603ULL;
   for (uint32_t i = 0; i < len; ++i) {
@@ -315,6 +333,37 @@ void dir_route_batch(const char* keys, const int64_t* offsets, int64_t n,
         static_cast<uint32_t>(n_shards));
 }
 
+// Blob variants of the sharded resolve and the fp64 hash: the serving
+// path's zero-copy lane (wire.KeyBlob) hands a bulk frame's key bytes
+// straight through — no Python strings, no GIL needed, plain C ABI.
+int64_t dir_resolve_sharded_batch(const char* blob, const int64_t* offsets,
+                                  int64_t n, void** handles,
+                                  int32_t n_shards, int32_t* out_shards,
+                                  int32_t* out_locals) {
+  if (!g_crc_ready) crc_init();
+  int64_t unresolved = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    const char* key = blob + offsets[k];
+    uint32_t len = static_cast<uint32_t>(offsets[k + 1] - offsets[k]);
+    uint32_t shard = crc32_of(key, len) % static_cast<uint32_t>(n_shards);
+    out_shards[k] = static_cast<int32_t>(shard);
+    Directory* d = static_cast<Directory*>(handles[shard]);
+    out_locals[k] = resolve_one(d, key, len);
+    if (out_locals[k] < 0) ++unresolved;
+  }
+  return unresolved;
+}
+
+int64_t dir_fp64_batch(const char* blob, const int64_t* offsets, int64_t n,
+                       uint32_t* out) {
+  for (int64_t k = 0; k < n; ++k) {
+    uint64_t h = fp64_of(blob + offsets[k], offsets[k + 1] - offsets[k]);
+    out[2 * k] = static_cast<uint32_t>(h);
+    out[2 * k + 1] = static_cast<uint32_t>(h >> 32);
+  }
+  return 0;
+}
+
 #ifdef DRL_WITH_PYTHON
 // Zero-copy batch resolve over a Python list[str]: reads each key's
 // cached UTF-8 via PyUnicode_AsUTF8AndSize — no encode, no concat, no
@@ -379,8 +428,6 @@ int64_t dir_resolve_sharded_pylist(PyObject* keys, void** handles,
 // hash that lands there is remapped to the FNV offset basis. Returns 0,
 // or -1 on a non-str element (caller falls back to the Python hasher).
 int64_t dir_fp64_pylist(PyObject* keys, uint32_t* out) {
-  constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
-  constexpr uint64_t kFnvPrime = 1099511628211ULL;
   Py_ssize_t n = PyList_GET_SIZE(keys);
   for (Py_ssize_t k = 0; k < n; ++k) {
     PyObject* s = PyList_GET_ITEM(keys, k);
@@ -390,12 +437,7 @@ int64_t dir_fp64_pylist(PyObject* keys, uint32_t* out) {
       PyErr_Clear();
       return -1;
     }
-    uint64_t h = kFnvOffset;
-    for (Py_ssize_t i = 0; i < len; ++i) {
-      h ^= static_cast<unsigned char>(key[i]);
-      h *= kFnvPrime;
-    }
-    if (h == 0) h = kFnvOffset;
+    uint64_t h = fp64_of(key, len);
     out[2 * k] = static_cast<uint32_t>(h);
     out[2 * k + 1] = static_cast<uint32_t>(h >> 32);
   }

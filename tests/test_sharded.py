@@ -503,3 +503,43 @@ class TestSyncCadenceOption:
         with pytest.raises(ValueError, match="sync_cadence"):
             ShardedDeviceStore(mesh, capacity=5.0, fill_rate_per_sec=1.0,
                                per_shard_slots=16, sync_cadence="never")
+
+
+def test_keyblob_routes_and_resolves_identically():
+    """The zero-copy mesh lane: routing and fused resolve from a
+    wire.KeyBlob agree bit-for-bit with the list[str] path."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        route_keys,
+    )
+    from distributedratelimiting.redis_tpu.runtime.wire import KeyBlob
+
+    keys = [f"mk{i % 37}" for i in range(300)] + ["\udcff\udc80odd"]
+    blobs = [k.encode("utf-8", "surrogateescape") for k in keys]
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    view = KeyBlob(b"".join(blobs), offsets)
+    assert (route_keys(view, 8) == route_keys(list(keys), 8)).all()
+
+
+def test_mesh_bulk_accepts_keyblob(mesh):
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        ShardedDeviceStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.wire import KeyBlob
+
+    store = ShardedDeviceStore(mesh, 4.0, 1e-9, per_shard_slots=64)
+    keys = [f"zb{i % 50}" for i in range(400)]
+    blobs = [k.encode() for k in keys]
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    view = KeyBlob(b"".join(blobs), offsets)
+    res = store.acquire_many_blocking(view, [1] * 400,
+                                      with_remaining=True)
+    # 50 distinct keys, 8 requests each, capacity 4 => 200 grants.
+    assert int(np.asarray(res.granted).sum()) == 200
+    res2 = store.acquire_many_blocking(list(keys), [1] * 400)
+    assert int(np.asarray(res2.granted).sum()) == 0  # all spent
