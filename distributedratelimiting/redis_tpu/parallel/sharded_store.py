@@ -508,16 +508,21 @@ class _ShardedKeyedTable:
         fused_ok = getattr(self, "_fused_ok", None)
         if fused_ok is None:
             lib = load_directory_lib()
+            # Blob inputs need only the plain C ABI; the pylist branch
+            # additionally needs the CPython-API build (has_pylist).
             fused_ok = self._fused_ok = bool(
-                lib is not None and lib.has_pylist
+                lib is not None
                 and all(isinstance(d, NativeKeyDirectory)
                         for d in self.dirs))
         if not fused_ok:
             return None
         lib = load_directory_lib()
         blob = getattr(keys, "blob", None)
-        if blob is None and not isinstance(keys, list):
-            keys = list(keys)
+        if blob is None:
+            if not lib.has_pylist:
+                return None  # split path handles the encode fallback
+            if not isinstance(keys, list):
+                keys = list(keys)
         n = len(keys)
         shards = np.empty(n, np.int32)
         locs = np.empty(n, np.int32)

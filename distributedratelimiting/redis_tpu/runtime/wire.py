@@ -357,27 +357,48 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         with_remaining: bool = True,
                         kind: int = BULK_KIND_BUCKET,
                         chained: bool = False) -> bytes:
-    """Encode one ACQUIRE_MANY frame. ``key_blobs`` are pre-encoded utf-8
-    keys (callers encode once, then slice chunks out of the same list);
-    ``counts`` any integer array-like, sent as u32. ``kind`` selects the
-    table family (bucket/window/fixed-window); for windows the (capacity,
-    fill_rate) slots carry (limit, window_s)."""
+    """Encode one ACQUIRE_MANY frame from per-key byte blobs. A thin
+    wrapper over :func:`encode_bulk_request_span` (ONE definition of the
+    frame layout — the two entry points must stay wire-identical);
+    ``kind`` selects the table family (bucket/window/fixed-window); for
+    windows the (capacity, fill_rate) slots carry (limit, window_s)."""
     n = len(key_blobs)
     klens = np.fromiter((len(b) for b in key_blobs), np.int64, n)
-    if n and int(klens.max()) > 0xFFFF:
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(klens, out=offsets[1:])
+    return encode_bulk_request_span(
+        seq, b"".join(key_blobs), offsets, klens,
+        np.asarray(counts, np.uint32), 0, n, capacity, fill_rate,
+        with_remaining=with_remaining, kind=kind, chained=chained)
+
+
+def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
+                             klens: "np.ndarray", counts: "np.ndarray",
+                             start: int, end: int, capacity: float,
+                             fill_rate: float, *,
+                             with_remaining: bool = True,
+                             kind: int = BULK_KIND_BUCKET,
+                             chained: bool = False) -> bytes:
+    """Encode one ACQUIRE_MANY chunk by SLICING a whole-call key blob —
+    the client-side half of the zero-copy lane. ``_bulk_prepare`` joins
+    and encodes the call's keys once; each chunk's payload is then two
+    array casts and one bytes slice instead of a per-key join (the
+    per-chunk ``b"".join(key_blobs[s:e])`` plus its length genexpr were
+    the client's top profile entries at 131K keys/call)."""
+    n = end - start
+    kl = klens[start:end]
+    if n and int(kl.max()) > 0xFFFF:
         raise ValueError("key exceeds 65535 utf-8 bytes")
     if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
-        # An out-of-range kind would shift into undefined flag bits and
-        # decode as some OTHER kind — fail at encode time instead.
         raise ValueError(f"unknown bulk kind {kind}")
     flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
              | (kind << _KIND_SHIFT)
              | (_FLAG_CHAINED if chained else 0))
     payload = b"".join((
         _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
-        klens.astype("<u2").tobytes(),
-        b"".join(key_blobs),
-        np.asarray(counts, "<u4").tobytes(),
+        kl.astype("<u2").tobytes(),
+        blob[offsets[start]:offsets[end]],
+        np.asarray(counts[start:end], "<u4").tobytes(),
     ))
     length = _BODY_OFF + len(payload)
     if length > MAX_FRAME:
@@ -416,7 +437,10 @@ def decode_bulk_request(frame: bytes, *, as_view: bool = False
         np.cumsum(klens, out=offsets[1:])
         keys: "list[str] | KeyBlob" = KeyBlob(blob, offsets)
     else:
-        keys = decode_key_blob(blob, klens)
+        # surrogateescape, like the view's lazy decode: the documented
+        # contract is byte-identity keys on every lane — the two decode
+        # modes must not disagree about which frames are valid.
+        keys = decode_key_blob(blob, klens, errors="surrogateescape")
     kind = (flags & _KIND_MASK) >> _KIND_SHIFT
     if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
         raise RemoteStoreError(f"unknown bulk kind {kind}")
@@ -479,10 +503,10 @@ def decode_key_blob(blob: bytes, klens: "np.ndarray", *,
                     errors: str = "strict") -> list[str]:
     """Split a concatenated key blob into strings by per-key lengths —
     one decode for the whole blob on the (overwhelming) ascii fast path.
-    Shared by the bulk-frame decoder (strict utf-8, a bad blob is a
-    routable frame error) and the native front-end's batch handoff
-    (``errors="surrogateescape"`` — there a hostile key must rate-limit
-    under its own stable identity rather than poison its batch)."""
+    Shared by the bulk-frame decoder and the native front-end's batch
+    handoff — both pass ``errors="surrogateescape"`` (byte-identity
+    keys: a hostile key rate-limits under its own stable identity
+    rather than poisoning its batch)."""
     ends = np.cumsum(np.asarray(klens, np.int64))
     starts = ends - klens
     if blob.isascii():

@@ -543,3 +543,29 @@ def test_mesh_bulk_accepts_keyblob(mesh):
     assert int(np.asarray(res.granted).sum()) == 200
     res2 = store.acquire_many_blocking(list(keys), [1] * 400)
     assert int(np.asarray(res2.granted).sum()) == 0  # all spent
+
+
+def test_fused_blob_resolve_matches_list_resolve(mesh):
+    """dir_resolve_sharded_batch (the KeyBlob fused lane) assigns the
+    same (shard, local) pairs as the list[str] pylist lane — including a
+    byte-identity key."""
+    import numpy as np
+
+    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+        ShardedDeviceStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.wire import KeyBlob
+
+    a = ShardedDeviceStore(mesh, 10.0, 1.0, per_shard_slots=64)
+    b = ShardedDeviceStore(mesh, 10.0, 1.0, per_shard_slots=64)
+    keys = [f"fz{i % 60}" for i in range(200)]
+    keys.append(b"\xff\x80odd".decode("utf-8", "surrogateescape"))
+    blobs = [k.encode("utf-8", "surrogateescape") for k in keys]
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(x) for x in blobs], out=offsets[1:])
+    view = KeyBlob(b"".join(blobs), offsets)
+    with a._lock, b._lock:
+        sh_v, lo_v = a._resolve_batch(view)
+        sh_l, lo_l = b._resolve_batch(list(keys))
+    assert (sh_v == sh_l).all()
+    assert (lo_v == lo_l).all()
