@@ -54,7 +54,9 @@ class BucketStoreServer:
     def __init__(self, store: BucketStore, *, host: str = "127.0.0.1",
                  port: int = 0, snapshot_path: str | None = None,
                  auth_token: str | None = None,
-                 native_frontend: bool = False) -> None:
+                 native_frontend: bool = False,
+                 native_max_batch: int = 4096,
+                 native_deadline_us: int = 300) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -64,6 +66,18 @@ class BucketStoreServer:
         # req/s/core asyncio per-request ceiling (benchmarks/RESULTS.md
         # "Per-request socket ceiling isolated").
         self.native_frontend = native_frontend
+        # The C batcher's own knobs (≙ the store micro-batcher's
+        # max_batch/max_delay_s, OPERATIONS.md §3): flush size cap and
+        # the timerfd deadline for the oldest pending request. Fail-fast
+        # like MicroBatcher does — fe_start would silently coerce
+        # nonpositive values to its defaults, running a config the
+        # operator didn't ask for.
+        if native_max_batch <= 0:
+            raise ValueError("native_max_batch must be positive")
+        if native_deadline_us <= 0:
+            raise ValueError("native_deadline_us must be positive")
+        self.native_max_batch = native_max_batch
+        self.native_deadline_us = native_deadline_us
         self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
@@ -98,8 +112,10 @@ class BucketStoreServer:
             )
 
             try:
-                self._native = NativeFrontend(self, host=self.host,
-                                              port=self.port)
+                self._native = NativeFrontend(
+                    self, host=self.host, port=self.port,
+                    max_batch=self.native_max_batch,
+                    deadline_us=self.native_deadline_us)
             except RuntimeError as exc:
                 # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
                 # serve anyway on the asyncio path — availability over
@@ -465,6 +481,12 @@ def main(argv: list[str] | None = None) -> None:
                         "in C and reach Python once per flush — lifts "
                         "the per-request serving ceiling ~an order of "
                         "magnitude per core (docs/OPERATIONS.md)")
+    parser.add_argument("--fe-max-batch", type=int, default=4096,
+                        help="native front-end: max per-request frames "
+                        "per micro-batch flush")
+    parser.add_argument("--fe-deadline-us", type=int, default=300,
+                        help="native front-end: flush deadline for the "
+                        "oldest pending request, microseconds")
     args = parser.parse_args(argv)
 
     async def serve() -> None:
@@ -510,7 +532,9 @@ def main(argv: list[str] | None = None) -> None:
         server = BucketStoreServer(store, host=args.host, port=args.port,
                                    snapshot_path=args.snapshot_path,
                                    auth_token=args.auth_token,
-                                   native_frontend=args.native_frontend)
+                                   native_frontend=args.native_frontend,
+                                   native_max_batch=args.fe_max_batch,
+                                   native_deadline_us=args.fe_deadline_us)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         try:

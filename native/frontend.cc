@@ -320,15 +320,38 @@ void flush_out(Frontend* fe, Conn* c) {
 // time under load, and completion immediately restarts service).
 void maybe_flush_after_complete(Frontend* fe);
 
-void flush_pending(Frontend* fe) {
-  // mu held. pending -> ready queue; wake the pump.
+void flush_pending(Frontend* fe, bool include_tail) {
+  // mu held. pending -> ready queue in <= max_batch chunks (max_batch
+  // bounds flush SIZE like the asyncio MicroBatcher's, not just the
+  // flush trigger). A size-triggered flush (include_tail=false) emits
+  // only FULL chunks and keeps the sub-max_batch tail pending to
+  // coalesce with the next arrivals — the MicroBatcher's remainder
+  // semantics (batcher.py); deadline/idle flushes drain everything
+  // (the tail is as overdue as the rest).
   if (fe->pending.empty()) return;
-  Batch b;
-  b.id = fe->next_batch_id++;
-  b.items = std::move(fe->pending);
-  fe->pending.clear();
-  fe->ready.push_back(std::move(b));
-  fe->batches_flushed++;
+  size_t n = fe->pending.size();
+  size_t limit = include_tail ? n : (n / fe->max_batch) * fe->max_batch;
+  if (limit == 0) return;
+  size_t pos = 0;
+  while (pos < limit) {
+    size_t take = limit - pos;
+    if (take > fe->max_batch) take = fe->max_batch;
+    Batch b;
+    b.id = fe->next_batch_id++;
+    b.items.assign(std::make_move_iterator(fe->pending.begin() + pos),
+                   std::make_move_iterator(fe->pending.begin() + pos +
+                                           take));
+    pos += take;
+    fe->ready.push_back(std::move(b));
+    fe->batches_flushed++;
+  }
+  if (limit == n) {
+    fe->pending.clear();
+  } else {
+    fe->pending.erase(fe->pending.begin(),
+                      fe->pending.begin() + static_cast<ptrdiff_t>(limit));
+    fe->pending_oldest_ns = fe->pending.front().t_ns;
+  }
   fe->cv.notify_one();
 }
 
@@ -336,7 +359,7 @@ void maybe_flush_after_complete(Frontend* fe) {
   // mu held (called from fe_complete / fe_fail).
   if (!fe->pending.empty() && fe->ready.empty() && fe->pt.empty() &&
       fe->inflight.empty()) {
-    flush_pending(fe);
+    flush_pending(fe, /*include_tail=*/true);  // pipeline idle: drain
   }
 }
 
@@ -510,7 +533,7 @@ void io_loop(Frontend* fe) {
         uint64_t junk;
         while (read(fe->tfd, &junk, 8) == 8) {
         }
-        flush_pending(fe);
+        flush_pending(fe, /*include_tail=*/true);  // deadline: all due
         continue;
       }
       auto itc = fe->conns.find(tag);
@@ -572,7 +595,9 @@ void io_loop(Frontend* fe) {
                        fe->pt.empty() && fe->inflight.empty();
       bool due = now_ns() >= fe->pending_oldest_ns + fe->deadline_ns;
       if (fe->pending.size() >= fe->max_batch || idle_pump || due) {
-        flush_pending(fe);
+        // Size-only trigger holds the sub-max_batch tail to coalesce;
+        // idle/deadline triggers drain it (see flush_pending).
+        flush_pending(fe, /*include_tail=*/idle_pump || due);
       }
     }
     arm_deadline(fe);

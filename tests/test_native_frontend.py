@@ -636,3 +636,26 @@ def test_save_checkpoint_through_native_server(tmp_path):
         assert r.granted
 
     run(body())
+
+
+def test_native_batching_knobs_configurable():
+    """max_batch=1 forces one flush per request — the knob demonstrably
+    reaches the C batcher."""
+    async def body():
+        srv = BucketStoreServer(InProcessBucketStore(),
+                                native_frontend=True,
+                                native_max_batch=1, native_deadline_us=50)
+        await srv.start()
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            await asyncio.gather(
+                *(store.acquire(f"knob{i}", 1, 10.0, 1.0)
+                  for i in range(20)))
+            st = await store.stats()
+            assert st["batches_flushed"] >= 20  # no coalescing at cap 1
+        finally:
+            await store.aclose()
+            await srv.aclose()
+
+    run(body())
