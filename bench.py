@@ -521,7 +521,8 @@ async def bench_serving_p99(store_mod, on_d64=None):
 
 def bench_serving_p99_cpu(timeout_s: float = 600.0,
                           backing: str = "device",
-                          native: bool = False) -> dict | None:
+                          native: bool = False,
+                          tier0: bool = False) -> dict | None:
     """Co-located-device stand-in for the <2ms serving north star, now a
     TWO-process rig (VERDICT r4 #3b): the server child owns the store +
     kernel on its own core; a separate load child drives closed-loop
@@ -553,6 +554,8 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
                    "--serving-server-child", backing]
     if native:
         server_argv.append("native")
+    if tier0:
+        server_argv.append("tier0")
     server = subprocess.Popen(
         server_argv,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
@@ -567,9 +570,12 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
         addr = json.loads(line)
         load_flag = ("--native-load-child" if native
                      else "--serving-load-child")
+        load_argv = [sys.executable, os.path.abspath(__file__),
+                     load_flag, addr["host"], str(addr["port"])]
+        if tier0:
+            load_argv.append("hot")  # hot-key workload: tier-0's case
         load = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             load_flag, addr["host"], str(addr["port"])],
+            load_argv,
             env=env, capture_output=True, text=True,
             timeout=max(deadline - time.monotonic(), 30.0))
         if load.returncode != 0:
@@ -587,7 +593,8 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
 
 
 def _serving_server_child(backing_kind: str = "device",
-                          native: bool = False) -> None:
+                          native: bool = False,
+                          tier0: bool = False) -> None:
     """Server half of the co-located stand-in: owns the (CPU-platform)
     device store and its kernel — or, for ``backing_kind="instant"``, the
     pure-Python ``InProcessBucketStore`` whose microsecond kernel makes
@@ -611,8 +618,18 @@ def _serving_server_child(backing_kind: str = "device",
             backing = store_mod.DeviceBucketStore(
                 n_slots=1 << 17, max_batch=4096, max_delay_s=300e-6,
                 max_inflight=16)
+        native_tier0 = False
+        if tier0:
+            from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+                Tier0Config,
+            )
+
+            # Tight sync cadence: the bench window is seconds long and the
+            # hit-rate/overadmit gauges should reflect settled envelopes.
+            native_tier0 = Tier0Config(sync_interval_s=0.01)
         async with BucketStoreServer(backing,
-                                     native_frontend=native) as srv:
+                                     native_frontend=native,
+                                     native_tier0=native_tier0) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
             await asyncio.get_running_loop().run_in_executor(
@@ -622,12 +639,16 @@ def _serving_server_child(backing_kind: str = "device",
     asyncio.run(run())
 
 
-def _native_load_child(host: str, port: str) -> None:
+def _native_load_child(host: str, port: str,
+                       workload: str = "uniform") -> None:
     """Load half of the native-front-end rig: the C closed-loop load
     generator (native_frontend.native_loadgen) at a depth sweep, with the
     server's own C-side histogram sampled per window — both directions of
     the ceiling (req/s and p99) come from native measurement, so Python
-    client scheduling bounds neither."""
+    client scheduling bounds neither. ``workload="hot"`` collapses the
+    keyspace to one key per connection — the tier-0 admission cache's
+    target shape — and reports the server's tier-0 gauges beside the
+    rates."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -640,18 +661,22 @@ def _native_load_child(host: str, port: str) -> None:
         RemoteBucketStore,
     )
 
+    keyspace = 1 if workload == "hot" else 1000
+
     async def run() -> None:
         store = RemoteBucketStore(address=(host, int(port)),
                                   coalesce_requests=False)
         out: dict = {}
-        # Warm: connects, compiles nothing (instant backing), seeds keys.
+        # Warm: connects, compiles nothing (instant backing), seeds keys
+        # (and, for the hot workload, installs the tier-0 replicas).
         await asyncio.to_thread(native_loadgen, host, int(port),
-                                conns=4, depth=16, reqs_per_conn=2000)
+                                conns=4, depth=16, reqs_per_conn=2000,
+                                keyspace=keyspace)
         for depth in (4, 16, 64, 256):
             await store.stats(reset=True)
             replies, _, elapsed = await asyncio.to_thread(
                 native_loadgen, host, int(port), conns=4, depth=depth,
-                reqs_per_conn=50000)
+                reqs_per_conn=50000, keyspace=keyspace)
             stats = await store.stats()
             out[f"d{depth}"] = {
                 "rate": replies / elapsed,
@@ -659,6 +684,9 @@ def _native_load_child(host: str, port: str) -> None:
                 "p99_ms": stats["serving_p99_ms"],
                 "samples": stats["serving_samples"],
             }
+        stats = await store.stats()
+        if "tier0" in stats:
+            out["tier0"] = stats["tier0"]
         await store.aclose()
         print(json.dumps(out), flush=True)
 
@@ -824,6 +852,18 @@ RESULT: dict = {
     "serving_native_p50_d16_ms": None,
     "serving_native_p99_d16_ms": None,
     "serving_native_p99_d64_ms": None,
+    # Tier-0 admission cache over the same rig, hot-key workload (one key
+    # per loadgen connection): decisions answered inside the C epoll loop
+    # from the per-key replica table, reconciled by the async debit pump.
+    # The ratio vs serving_native_req_per_s_d256 is the tentpole's win;
+    # hit_rate and the overadmit gauges audit the epsilon contract.
+    "serving_native_tier0_req_per_s_d64": None,
+    "serving_native_tier0_req_per_s_d256": None,
+    "serving_native_tier0_p99_d64_ms": None,
+    "serving_native_tier0_hit_rate": None,
+    "serving_native_tier0_overadmit_total": None,
+    "serving_native_tier0_overadmit_max": None,
+    "serving_native_tier0_speedup_vs_off": None,
     "pallas_sweep_ok": None,
     "device_probe": None,
     "budget_s": BUDGET_S,
@@ -1121,6 +1161,37 @@ def main() -> int:
             value["d64"]["p99_ms"], 3)
         _emit()
 
+    def sec_serving_native_tier0():
+        out = bench_serving_p99_cpu(
+            timeout_s=min(300.0, max(_remaining(), 30.0)),
+            backing="instant", native=True, tier0=True)
+        if out is None:
+            raise RuntimeError("tier0-frontend children failed/timed out")
+        return out
+
+    status, value = _section("serving_native_tier0",
+                             sec_serving_native_tier0, timeout_s=320)
+    if status == "ok" and value is not None:
+        RESULT["serving_native_tier0_req_per_s_d64"] = round(
+            value["d64"]["rate"])
+        RESULT["serving_native_tier0_req_per_s_d256"] = round(
+            value["d256"]["rate"])
+        RESULT["serving_native_tier0_p99_d64_ms"] = round(
+            value["d64"]["p99_ms"], 3)
+        t0 = value.get("tier0") or {}
+        if t0:
+            RESULT["serving_native_tier0_hit_rate"] = round(
+                t0.get("hit_rate", 0.0), 4)
+            RESULT["serving_native_tier0_overadmit_total"] = t0.get(
+                "overadmit_total")
+            RESULT["serving_native_tier0_overadmit_max"] = t0.get(
+                "overadmit_max")
+        off = RESULT["serving_native_req_per_s_d256"]
+        if off:
+            RESULT["serving_native_tier0_speedup_vs_off"] = round(
+                value["d256"]["rate"] / off, 2)
+        _emit()
+
     # Second chance for the chip: if the first probe found no window but
     # budget remains, re-probe and run the device sections late — a
     # flapping tunnel (r04: healthy/wedged minute to minute) often opens
@@ -1146,12 +1217,15 @@ if __name__ == "__main__":
     if "--serving-server-child" in sys.argv:
         i = sys.argv.index("--serving-server-child")
         kind = sys.argv[i + 1] if len(sys.argv) > i + 1 else "device"
-        native = len(sys.argv) > i + 2 and sys.argv[i + 2] == "native"
-        _serving_server_child(kind, native=native)
+        rest = sys.argv[i + 2:]
+        _serving_server_child(kind, native="native" in rest,
+                              tier0="tier0" in rest)
         sys.exit(0)
     if "--native-load-child" in sys.argv:
         i = sys.argv.index("--native-load-child")
-        _native_load_child(sys.argv[i + 1], sys.argv[i + 2])
+        workload = (sys.argv[i + 3]
+                    if len(sys.argv) > i + 3 else "uniform")
+        _native_load_child(sys.argv[i + 1], sys.argv[i + 2], workload)
         sys.exit(0)
     if "--serving-load-child" in sys.argv:
         i = sys.argv.index("--serving-load-child")

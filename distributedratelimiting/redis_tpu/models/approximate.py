@@ -50,7 +50,46 @@ from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import log
 from distributedratelimiting.redis_tpu.utils.metrics import LimiterMetrics
 
-__all__ = ["ApproximateTokenBucketRateLimiter"]
+__all__ = [
+    "ApproximateTokenBucketRateLimiter",
+    "headroom_budget",
+    "overadmit_epsilon",
+]
+
+
+# -- shared local-replica policy (this limiter + the tier-0 edge cache) ----
+#
+# The native front-end's tier-0 admission cache (native/frontend.cc) is
+# this file's algorithm re-hosted below the wire: local decisions against
+# a replicated envelope, reconciled by an async sync. Both layers size
+# their local confidence with the same two formulas so the documented
+# over-admission bound holds everywhere it is quoted (docs/OPERATIONS.md
+# "Tier-0 approximate admission"; the C mirror is ``t0_budget_of`` in
+# native/frontend.cc — keep the three in sync).
+
+def headroom_budget(available: float, *, fraction: float = 0.5,
+                    min_budget: float = 64.0,
+                    max_budget: float = float(1 << 20)) -> float:
+    """Confident local admission budget carved from an observed
+    availability: ``floor(min(available × fraction, max_budget))``, or 0
+    when that falls below ``min_budget`` (too little headroom to be worth
+    — or safe — deciding locally; the caller must fall through to the
+    authoritative path)."""
+    b = min(available * fraction, max_budget)
+    return float(math.floor(b)) if b >= min_budget else 0.0
+
+
+def overadmit_epsilon(budget: float, fill_rate_per_sec: float,
+                      sync_period_s: float) -> float:
+    """Worst-case over-admission of a local replica admitting against a
+    budget refreshed every ``sync_period_s``: one budget of grants may be
+    outstanding (harvested but not yet debited) while a second budget is
+    admitted against the stale envelope, plus whatever the authority
+    refills during one sync period — ``2·budget + fill_rate·period``.
+    This is the epsilon the tier-0 differential test audits, and (with
+    ``budget = 0``) the classic staleness bound of this limiter: peers'
+    consumption within one replenishment period."""
+    return 2.0 * budget + fill_rate_per_sec * sync_period_s
 
 
 class ApproximateTokenBucketRateLimiter(RateLimiter):
@@ -348,6 +387,10 @@ class ApproximateTokenBucketRateLimiter(RateLimiter):
             "instance_count_estimate": self._instance_count,
             "available_tokens": self.available_tokens,
             "queue_count": self._queue.queue_count,
+            # The documented staleness bound, via the shared formula.
+            "staleness_epsilon": overadmit_epsilon(
+                0.0, self.options.fill_rate_per_second,
+                self.options.replenishment_period_s),
             **self.metrics.snapshot(),
         }
 

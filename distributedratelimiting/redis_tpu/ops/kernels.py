@@ -51,6 +51,7 @@ __all__ = [
     "acquire_scan_packed24",
     "pack_slots24",
     "SLOT24_PAD",
+    "debit_batch_packed",
     "sync_batch",
     "sync_batch_packed",
     "SemaState",
@@ -937,6 +938,58 @@ def rebase_sema_epoch(state: SemaState, offset_ticks):
         jnp.maximum(state.last_ts - offset_ticks, 0),
         state.exists,
     )
+
+
+@partial(jax.jit, donate_argnums=0)
+def debit_batch_packed(state: BucketState, packed, capacity,
+                       fill_rate_per_tick):
+    """Saturating bulk debit — the tier-0 replica reconciliation kernel.
+
+    The native front-end's tier-0 cache admits permits locally and drains
+    the accumulated counts here in one launch: refill exactly like
+    :func:`acquire_batch_packed`, then subtract each row's drained amount
+    clamped at zero. This is :func:`sync_batch`'s decaying-counter
+    semantic mirrored onto the bucket table (``score == capacity −
+    tokens``: the counter's decay-then-add is the bucket's
+    refill-then-subtract, both saturating), which keeps ONE authority —
+    the same table the exact fall-through path decides against — so
+    tier-0 and per-request decisions reconcile without double-accounting.
+
+    ``packed i32[3, B]``: row 0 slots (-1 ⇒ padding), row 1 the float32
+    drained amounts bitcast to int32 (exact, like the counter-sync
+    operand), row 2 the batch timestamp (store-stamped time,
+    invariant 1). Duplicate slots are serialized conservatively via the
+    demand prefix (callers pre-aggregate per key, so duplicates only
+    arise from misuse and can at worst under-debit, never corrupt).
+
+    Returns ``(new_state, out f32[2, B])``: row 0 the post-debit balance
+    (each row's serialized view), row 1 the clamped shortfall — the part
+    of the drained amount that found no tokens, i.e. the observed
+    over-admission the sync pump surfaces as a gauge.
+    """
+    slots = packed[0]
+    amounts = jax.lax.bitcast_convert_type(packed[1], jnp.float32)
+    now = packed[2, 0]
+    size = state.tokens.shape[0]
+    valid = _valid_slots(slots, slots >= 0, size)
+    gs = _gather_slots(slots, valid)
+    refilled = bm.refill_or_init(state.tokens[gs], state.last_ts[gs],
+                                 state.exists[gs], now, capacity,
+                                 fill_rate_per_tick)
+    prefix = bm.duplicate_prefix(slots, amounts, valid)
+    avail = jnp.maximum(refilled - prefix, 0.0)
+    applied = jnp.where(valid, jnp.minimum(amounts, avail), 0.0)
+    shortfall = jnp.where(valid, amounts - applied, 0.0)
+    remaining = jnp.where(valid, avail - applied, 0.0)
+
+    ss = _scatter_slots(slots, valid, size)
+    new_tokens = state.tokens.at[ss].set(refilled, mode="drop")
+    new_tokens = new_tokens.at[ss].add(-applied, mode="drop")
+    new_last_ts = state.last_ts.at[ss].set(jnp.asarray(now, jnp.int32),
+                                           mode="drop")
+    new_exists = state.exists.at[ss].set(True, mode="drop")
+    out = jnp.stack([remaining, shortfall])
+    return BucketState(new_tokens, new_last_ts, new_exists), out
 
 
 @partial(jax.jit, donate_argnums=0)

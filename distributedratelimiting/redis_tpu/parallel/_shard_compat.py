@@ -1,0 +1,39 @@
+"""Version compatibility for the shard_map-based kernels.
+
+Two jax API gaps this package spans:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to the top
+  level in jax ≥ 0.5. The experimental version's replication checker
+  also predates scan-carry "varying" types — the exact mismatch its own
+  error message prescribes ``check_rep=False`` for — so the fallback
+  disables it. Decisions are value-identical either way; only the static
+  typing pass differs.
+- ``jax.lax.pcast`` (typed-replication casts) only exists where that
+  checker does; without it the cast is unnecessary.
+
+One home for both shims so the sharded stores cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pcast_varying"]
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:
+    from functools import partial as _partial
+
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    shard_map = _partial(_shard_map_exp, check_rep=False)
+
+
+def pcast_varying(x, axis: str):
+    """Mark a scan-carry init as per-shard ("varying" over ``axis``)
+    where this jax has the typed-replication API; elsewhere (check_rep
+    disabled above) the cast is unnecessary — the value is identical."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return x

@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distributedratelimiting.redis_tpu.parallel._shard_compat import (
+    pcast_varying,
+    shard_map,
+)
 
 from distributedratelimiting.redis_tpu.ops import bucket_math as bm
 from distributedratelimiting.redis_tpu.ops import fp_directory as F
@@ -182,8 +185,7 @@ def make_sharded_fp_scan_step(mesh, *, probe_window: int = 16,
 
         # The accumulator is per-shard ("varying" over the mesh axis inside
         # shard_map); the initial zero must be cast to match.
-        zero = jax.lax.pcast(jnp.zeros((), jnp.float32), (SHARD_AXIS,),
-                             to="varying")
+        zero = pcast_varying(jnp.zeros((), jnp.float32), SHARD_AXIS)
         ((fp, state, gcounter, consumed_total), out) = jax.lax.scan(
             body, (fp, state, gcounter, zero), (fused[0], nows))
         if deferred:

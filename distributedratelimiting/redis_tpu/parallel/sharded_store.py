@@ -25,7 +25,10 @@ from typing import NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from distributedratelimiting.redis_tpu.parallel._shard_compat import (
+    pcast_varying,
+    shard_map,
+)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributedratelimiting.redis_tpu.ops import bucket_math as bm
@@ -293,8 +296,7 @@ def make_two_level_scan_step_deferred(mesh, *, handle_duplicates: bool = True):
 
         # The accumulator is per-shard ("varying" over the mesh axis inside
         # shard_map); the initial zero must be cast to match.
-        zero = jax.lax.pcast(jnp.zeros((), jnp.float32), (SHARD_AXIS,),
-                             to="varying")
+        zero = pcast_varying(jnp.zeros((), jnp.float32), SHARD_AXIS)
         (state, consumed_total), (granted, remaining) = jax.lax.scan(
             body, (state, zero),
             (slots[0], counts[0], valid[0], nows),

@@ -27,16 +27,57 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import logging
 import threading
+import time
+from dataclasses import dataclass
 
 import numpy as np
 
 from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import log
-from distributedratelimiting.redis_tpu.utils.metrics import LatencyHistogram
+from distributedratelimiting.redis_tpu.utils.metrics import (
+    LatencyHistogram,
+    Tier0Metrics,
+)
 from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
 
-__all__ = ["NativeFrontend", "native_loadgen"]
+__all__ = ["NativeFrontend", "Tier0Config", "native_loadgen"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Tier0Config:
+    """Knobs of the front-end's tier-0 admission cache (see
+    docs/OPERATIONS.md "Tier-0 approximate admission" for the decision
+    table and the epsilon derivation). The budget policy mirrors
+    :func:`~.models.approximate.headroom_budget`; the documented
+    over-admission bound per key is
+    ``overadmit_epsilon(headroom_budget(capacity, ...),
+    fill_rate, sync_interval_s)``."""
+
+    #: Replica table slots (rounded up to a power of two). Memory is
+    #: bounded: slots × (entry + key ≤ 256 B) ≈ 1.5 MB at the default.
+    slots: int = 4096
+    #: Fraction of the last-synced balance granted as local headroom.
+    budget_fraction: float = 0.5
+    #: Below this budget a key is not hosted locally (small buckets keep
+    #: exact per-request semantics — also what keeps tier-0 semantically
+    #: invisible to low-capacity workloads like the parity fuzz).
+    min_budget: float = 64.0
+    #: Budget ceiling (bounds epsilon for huge-capacity buckets).
+    max_budget: float = float(1 << 20)
+    #: Sync pump cadence: how often local grants drain into the store.
+    sync_interval_s: float = 0.02
+    #: Max age of the envelope a local decision may be served from.
+    #: Generous relative to the sync interval on purpose: during a device
+    #: outage this is how long tier-0 keeps answering from its last-known
+    #: envelope instead of stalling behind the dead store.
+    max_stale_s: float = 2.0
+    #: Idle replica eviction.
+    ttl_s: float = 30.0
 
 # Bound to locals for the batch-group dispatch; wire.py stays the single
 # source of the values (frontend.cc mirrors them and is covered by the
@@ -56,7 +97,8 @@ class NativeFrontend:
     """
 
     def __init__(self, server, *, host: str, port: int,
-                 max_batch: int = 4096, deadline_us: int = 300) -> None:
+                 max_batch: int = 4096, deadline_us: int = 300,
+                 tier0: "Tier0Config | bool | None" = None) -> None:
         lib = load_frontend_lib()
         if lib is None:
             raise RuntimeError(
@@ -97,9 +139,53 @@ class NativeFrontend:
         # straggler batch completing after fe_free would call
         # fe_complete through a dangling pointer.
         self._loop_tasks: set[asyncio.Task] = set()
+        # Tier-0 admission cache: decisions served from the C-side replica
+        # table; this side runs the sync pump (harvest → bulk debit →
+        # ack) that keeps every replica's envelope honest.
+        self._tier0: Tier0Config | None = None
+        self._t0_task: asyncio.Task | None = None
+        self.t0_metrics = Tier0Metrics()
+        #: drained-but-unreconciled amounts surviving a failed sync round
+        #: (degraded mode: carried into the next round, never dropped).
+        self._t0_carry: dict[tuple[str, float, float], float] = {}
+        if tier0:
+            self._tier0_setup(
+                tier0 if isinstance(tier0, Tier0Config) else Tier0Config())
         self._pump = threading.Thread(target=self._pump_loop, daemon=True,
                                       name="native-frontend-pump")
         self._pump.start()
+
+    def _tier0_setup(self, cfg: Tier0Config) -> None:
+        if not getattr(self._lib, "has_tier0", False):
+            logger.warning("tier-0 requested but the loaded front-end "
+                           "binary predates the tier-0 ABI; serving "
+                           "without it")
+            return
+        store = self._server.store
+        if type(store).debit_many is BucketStore.debit_many:
+            # No reconciliation entry point on this store: local grants
+            # could never drain back, so the envelope would be a lie.
+            logger.warning(
+                "tier-0 requested but %s has no debit_many "
+                "reconciliation path; serving without tier-0",
+                type(store).__name__)
+            return
+        slots = self._lib.fe_t0_configure(
+            self._h, int(cfg.slots), float(cfg.budget_fraction),
+            float(cfg.min_budget), float(cfg.max_budget),
+            max(1, int(cfg.max_stale_s * 1e3)),
+            max(1, int(cfg.ttl_s * 1e3)))
+        self._tier0 = cfg
+        # Harvest buffers, allocated once: sized so even a full table of
+        # max-length (256 B) keys drains in one round, and the pump's
+        # per-round cost is the C call, not buffer churn.
+        self._t0_blob = ctypes.create_string_buffer(slots * 256)
+        self._t0_klens = np.zeros(slots, np.int32)
+        self._t0_amounts = np.zeros(slots, np.float64)
+        self._t0_caps = np.zeros(slots, np.float64)
+        self._t0_rates = np.zeros(slots, np.float64)
+        self._t0_task = asyncio.get_running_loop().create_task(
+            self._t0_sync_loop())
 
     def _track_task(self, coro) -> asyncio.Task:
         """Start ``coro`` as a loop task tracked for shutdown draining
@@ -307,8 +393,12 @@ class NativeFrontend:
             self._lib.fe_close_conn(self._h, conn_id)
             return
         auth_token = self._server.auth_token
+        # surrogateescape mirrors the wire decode: a token with invalid
+        # UTF-8 must compare (and fail) cleanly — a raising .encode()
+        # here left the connection stuck in auth_pending forever.
         if auth_token is not None and not hmac.compare_digest(
-                token.encode(), auth_token.encode()):
+                token.encode("utf-8", "surrogateescape"),
+                auth_token.encode()):
             self._send(conn_id, wire.encode_response(
                 seq, wire.RESP_ERROR, "authentication failed"))
             self._lib.fe_close_conn(self._h, conn_id)
@@ -318,6 +408,130 @@ class NativeFrontend:
 
     def _send(self, conn_id: int, resp: bytes) -> None:
         self._lib.fe_send(self._h, conn_id, resp, len(resp))
+
+    # -- tier-0 sync pump --------------------------------------------------
+
+    def _t0_harvest(self) -> dict[tuple[str, float, float], float]:
+        """Drain accumulated local grants out of the C replica table:
+        ``{(key, capacity, rate): amount}``. Buffers are preallocated in
+        ``_tier0_setup`` (the pump runs this every ``sync_interval_s``)."""
+        c = ctypes
+        blob, klens = self._t0_blob, self._t0_klens
+        amounts, caps, rates = (self._t0_amounts, self._t0_caps,
+                                self._t0_rates)
+        n = self._lib.fe_t0_harvest(
+            self._h, blob, len(blob),
+            klens.ctypes.data_as(c.POINTER(c.c_int32)),
+            amounts.ctypes.data_as(c.POINTER(c.c_double)),
+            caps.ctypes.data_as(c.POINTER(c.c_double)),
+            rates.ctypes.data_as(c.POINTER(c.c_double)), len(klens))
+        if n <= 0:
+            return {}
+        # string_at copies only the used prefix (blob.raw would
+        # materialize the whole preallocated buffer every round).
+        used = ctypes.string_at(blob, int(klens[:n].sum()))
+        keys = wire.decode_key_blob(used, klens[:n],
+                                    errors="surrogateescape")
+        return {(k, float(caps[i]), float(rates[i])): float(amounts[i])
+                for i, k in enumerate(keys)}
+
+    def _t0_ack(self, keys: list[str], cap: float, rate: float,
+                remaining: np.ndarray) -> None:
+        c = ctypes
+        n = len(keys)
+        kb = [k.encode("utf-8", "surrogateescape") for k in keys]
+        blob = b"".join(kb)
+        klens = np.fromiter((len(b) for b in kb), np.int32, n)
+        caps = np.full(n, cap, np.float64)
+        rates = np.full(n, rate, np.float64)
+        rem = np.ascontiguousarray(remaining, np.float64)
+        self._lib.fe_t0_ack(
+            self._h, blob,
+            klens.ctypes.data_as(c.POINTER(c.c_int32)),
+            caps.ctypes.data_as(c.POINTER(c.c_double)),
+            rates.ctypes.data_as(c.POINTER(c.c_double)),
+            rem.ctypes.data_as(c.POINTER(c.c_double)), n)
+
+    async def _t0_sync_loop(self) -> None:
+        """Reconciliation pump: every ``sync_interval_s``, harvest each
+        replica's locally-granted permits, debit them from the
+        authoritative store in one bulk launch per (capacity, rate)
+        config, and ack the fresh balances back into the replica table
+        (which re-sizes every key's budget). A failed round (device
+        unhealthy — the r04/r05 outage mode) carries its amounts into the
+        next round instead of dropping them; meanwhile the C side keeps
+        serving within each key's last-acked envelope."""
+        cfg = self._tier0
+        assert cfg is not None
+        store = self._server.store
+        while True:
+            await asyncio.sleep(cfg.sync_interval_s)
+            # Everything harvested was already zeroed out of the C table:
+            # from here until it is debited it exists ONLY in `merged`,
+            # so every exit path — per-config failure, unexpected error,
+            # cancellation mid-await (aclose) — must route the undrained
+            # remainder back into the carry dict. The finally below is
+            # that single restore point; successful groups pop themselves
+            # out of `merged` first.
+            merged = self._t0_carry
+            self._t0_carry = {}
+            try:
+                for ident, amount in self._t0_harvest().items():
+                    merged[ident] = merged.get(ident, 0.0) + amount
+                if not merged:
+                    continue
+                by_cfg: dict[tuple[float, float], list[tuple[str, float]]] = {}
+                for (key, cap, rate), amount in merged.items():
+                    by_cfg.setdefault((cap, rate), []).append((key, amount))
+                for (cap, rate), rows in by_cfg.items():
+                    keys = [k for k, _ in rows]
+                    amounts = [a for _, a in rows]
+                    try:
+                        remaining, shortfall = await store.debit_many(
+                            keys, amounts, cap, rate)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:  # degraded: rows stay in
+                        # `merged` and re-carry via the finally
+                        log.error_evaluating_kernel(exc)
+                        self.t0_metrics.sync_failures += 1
+                        continue
+                    self._t0_ack(keys, cap, rate, remaining)
+                    self.t0_metrics.record_sync(len(keys), shortfall,
+                                                time.monotonic())
+                    for k, _ in rows:
+                        merged.pop((k, cap, rate), None)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # the pump must outlive any bad round
+                log.error_evaluating_kernel(exc)
+                self.t0_metrics.sync_failures += 1
+            finally:
+                for ident, amount in merged.items():
+                    if amount > 0.0:
+                        self._t0_carry[ident] = (
+                            self._t0_carry.get(ident, 0.0) + amount)
+
+    def tier0_stats(self) -> dict | None:
+        """Merged C + pump-side tier-0 gauges (``None`` when disabled)."""
+        if self._tier0 is None:
+            return None
+        counts = (ctypes.c_longlong * 6)()
+        self._lib.fe_t0_counts(self._h, counts)
+        hits, denies, misses, installs, evictions, entries = (
+            int(v) for v in counts)
+        eligible = hits + denies + misses
+        return {
+            "hits": hits,
+            "local_denies": denies,
+            "misses": misses,
+            "hit_rate": (hits + denies) / eligible if eligible else 0.0,
+            "installs": installs,
+            "evictions": evictions,
+            "entries": entries,
+            "carry_keys": len(self._t0_carry),
+            **self.t0_metrics.snapshot(time.monotonic()),
+        }
 
     # -- stats / lifecycle -------------------------------------------------
 
@@ -352,12 +566,20 @@ class NativeFrontend:
         if self._stopping:
             return
         self._stopping = True
-        # Order matters: (1) fe_stop joins the IO thread — no new frames;
-        # (2) the pump sees -1 from fe_wait and exits — no new loop
-        # tasks; (3) drain the loop tasks still in flight, whose
+        # Order matters: (0) stop the tier-0 sync pump — it reads the C
+        # handle (harvest/ack); (1) fe_stop joins the IO thread — no new
+        # frames; (2) the pump sees -1 from fe_wait and exits — no new
+        # loop tasks; (3) drain the loop tasks still in flight, whose
         # fe_complete/fe_send calls need the handle alive (the sockets
         # are gone, so completions just fall into the void); only then
         # (4) free the handle.
+        if self._t0_task is not None:
+            self._t0_task.cancel()
+            try:
+                await self._t0_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._t0_task = None
         await asyncio.to_thread(self._lib.fe_stop, self._h)
         await asyncio.to_thread(self._pump.join, 5.0)
         while self._loop_tasks:
@@ -366,6 +588,16 @@ class NativeFrontend:
             # was taken — the child also holds the handle.
             await asyncio.gather(*list(self._loop_tasks),
                                  return_exceptions=True)
+        if self._pump.is_alive():
+            # The pump blew past the join timeout: it may still be inside
+            # fe_wait/fe_batch_copy holding the handle. Freeing now would
+            # be a use-after-free on its next C call — leak the handle
+            # (one struct + sockets already closed) and say so instead.
+            logger.error(
+                "native front-end pump thread still alive after 5s; "
+                "leaking the C handle instead of freeing under it")
+            self._h = None
+            return
         self._lib.fe_free(self._h)
         self._h = None
 

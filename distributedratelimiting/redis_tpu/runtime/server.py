@@ -56,7 +56,8 @@ class BucketStoreServer:
                  auth_token: str | None = None,
                  native_frontend: bool = False,
                  native_max_batch: int = 4096,
-                 native_deadline_us: int = 300) -> None:
+                 native_deadline_us: int = 300,
+                 native_tier0=False) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -78,6 +79,12 @@ class BucketStoreServer:
             raise ValueError("native_deadline_us must be positive")
         self.native_max_batch = native_max_batch
         self.native_deadline_us = native_deadline_us
+        # Tier-0 admission cache (native front-end only): False/None off,
+        # True for defaults, or a native_frontend.Tier0Config instance.
+        # Hot ACQUIRE keys with confident headroom then decide inside the
+        # C epoll loop — no batcher, no Python, no device round trip —
+        # reconciled by an async bulk debit (docs/OPERATIONS.md §3).
+        self.native_tier0 = native_tier0
         self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
@@ -115,7 +122,8 @@ class BucketStoreServer:
                 self._native = NativeFrontend(
                     self, host=self.host, port=self.port,
                     max_batch=self.native_max_batch,
-                    deadline_us=self.native_deadline_us)
+                    deadline_us=self.native_deadline_us,
+                    tier0=self.native_tier0)
             except RuntimeError as exc:
                 # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
                 # serve anyway on the asyncio path — availability over
@@ -125,11 +133,20 @@ class BucketStoreServer:
 
                 logging.getLogger(__name__).warning(
                     "native front-end unavailable (%s); falling back to "
-                    "the asyncio socket path", exc)
+                    "the asyncio socket path%s", exc,
+                    " — tier-0 admission cache DISABLED with it"
+                    if self.native_tier0 else "")
                 self.native_frontend = False
             else:
                 self.port = self._native.port
                 return self.host, self.port
+        elif self.native_tier0:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native_tier0 is set but native_frontend is off — the "
+                "tier-0 admission cache only exists inside the native "
+                "front-end and is NOT active")
         self._server = await asyncio.start_server(
             self._serve_connection, self.host, self.port
         )
@@ -385,6 +402,9 @@ class BucketStoreServer:
                 "native_frontend": True,
                 "batches_flushed": batches,
             }
+            tier0 = self._native.tier0_stats()
+            if tier0 is not None:
+                payload["tier0"] = tier0
         else:
             payload = {
                 "connections_served": self.connections_served,
@@ -487,7 +507,25 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--fe-deadline-us", type=int, default=300,
                         help="native front-end: flush deadline for the "
                         "oldest pending request, microseconds")
+    parser.add_argument("--fe-tier0", action="store_true",
+                        help="native front-end: enable the tier-0 "
+                        "admission cache — hot ACQUIRE keys with "
+                        "confident headroom decide locally in the C "
+                        "epoll loop and reconcile via an async bulk "
+                        "debit; over-admission bounded by the documented "
+                        "epsilon (docs/OPERATIONS.md §3)")
+    parser.add_argument("--fe-tier0-sync-ms", type=float, default=20.0,
+                        help="tier-0 sync pump cadence, milliseconds")
+    parser.add_argument("--fe-tier0-min-budget", type=float, default=64.0,
+                        help="tier-0: smallest local budget worth "
+                        "hosting; smaller buckets stay exact")
+    parser.add_argument("--fe-tier0-fraction", type=float, default=0.5,
+                        help="tier-0: fraction of the last-synced "
+                        "balance granted as local headroom")
     args = parser.parse_args(argv)
+    if args.fe_tier0 and not args.native_frontend:
+        parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
+                     "admission cache lives inside the C front-end)")
 
     async def serve() -> None:
         if args.backend == "device":
@@ -529,12 +567,23 @@ def main(argv: list[str] | None = None) -> None:
                       flush=True)
         if args.sweep_period > 0 and hasattr(store, "start_sweeper"):
             store.start_sweeper(args.sweep_period)
+        native_tier0 = False
+        if args.fe_tier0:
+            from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+                Tier0Config,
+            )
+
+            native_tier0 = Tier0Config(
+                sync_interval_s=args.fe_tier0_sync_ms / 1e3,
+                min_budget=args.fe_tier0_min_budget,
+                budget_fraction=args.fe_tier0_fraction)
         server = BucketStoreServer(store, host=args.host, port=args.port,
                                    snapshot_path=args.snapshot_path,
                                    auth_token=args.auth_token,
                                    native_frontend=args.native_frontend,
                                    native_max_batch=args.fe_max_batch,
-                                   native_deadline_us=args.fe_deadline_us)
+                                   native_deadline_us=args.fe_deadline_us,
+                                   native_tier0=native_tier0)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         try:

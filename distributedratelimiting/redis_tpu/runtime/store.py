@@ -245,6 +245,44 @@ class BucketStore(abc.ABC):
     def sync_counter_blocking(self, key: str, local_count: float,
                               decay_rate_per_sec: float) -> SyncResult: ...
 
+    async def sync_counters_many(self, keys: Sequence[str],
+                                 local_counts: Sequence[float],
+                                 decay_rate_per_sec: float
+                                 ) -> tuple[np.ndarray, np.ndarray]:
+        """Bulk decaying-counter sync — the entry point for EXTERNAL
+        replica fleets (a host process running many approximate-limiter
+        replicas, or an edge tier reconciling a whole key table) to drain
+        their accumulated local counts in one call instead of one
+        :meth:`sync_counter` round trip per key. Returns ``(global_scores
+        f64[n], period_ewmas f64[n])`` row-for-row with ``keys``.
+        Default: a sequential loop (same-key rows keep request order);
+        :class:`DeviceBucketStore` overrides with ONE ``sync_batch``
+        launch for the whole fleet."""
+        scores = np.empty(len(keys), np.float64)
+        periods = np.empty(len(keys), np.float64)
+        for i, (k, c) in enumerate(zip(keys, local_counts)):
+            res = await self.sync_counter(k, float(c), decay_rate_per_sec)
+            scores[i] = res.global_score
+            periods[i] = res.period_ewma_ticks
+        return scores, periods
+
+    async def debit_many(self, keys: Sequence[str],
+                         amounts: Sequence[float], capacity: float,
+                         fill_rate_per_sec: float
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """Saturating bulk debit — the reconciliation half of the native
+        front-end's tier-0 admission cache: drain permits the edge
+        already granted locally out of the authoritative bucket table
+        (refill, then subtract clamped at zero). Returns ``(remaining
+        f64[n], shortfall f64[n])``: the post-debit balance per key and
+        the part of each drained amount that found no tokens (the
+        observed over-admission). Callers pre-aggregate per key. Not
+        every store hosts tier-0 replicas; the front-end feature-detects
+        this method and disables tier-0 when it is absent."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support tier-0 replica "
+            "reconciliation (debit_many)")
+
     # -- sliding window ----------------------------------------------------
     @abc.abstractmethod
     async def window_acquire(self, key: str, count: int, limit: float,
@@ -1006,6 +1044,29 @@ class _DeviceTable(_PackedLaunchMixin):
         # One lazy device op so the fetch stays single.
         return jnp.stack([granted.astype(jnp.float32), remaining], axis=1)
 
+    def _debit_launch(self, keys: Sequence[str], amounts: Sequence[float]):
+        """One saturating-debit launch (tier-0 reconciliation): refill,
+        subtract the drained local grants clamped at zero, return the
+        packed ``f32[2, B]`` result (post-debit balance, shortfall).
+        Same single-transfer/locking discipline as ``_launch``."""
+        n = len(keys)
+        with self.store.profiler.span("debit_batch", n), self.store._lock:
+            slots = self.resolve_slots(list(keys))
+            b = _pad_size(n, floor=64)
+            now = self.store.now_ticks_checked()
+            packed = np.full((3, b), -1, np.int32)
+            packed[1] = 0
+            packed[0, :n] = slots
+            # Float amounts travel bitcast in the int32 row (exact) —
+            # the counter-sync operand convention.
+            packed[1, :n] = np.asarray(amounts, np.float32).view(np.int32)
+            packed[2] = now
+            self.state, out = K.debit_batch_packed(
+                self.state, jnp.asarray(packed), self.cap_dev, self.rate_dev,
+            )
+            self.store.metrics.record_launch(b, n)
+            return out
+
     def peek_blocking(self, key: str) -> float:
         with self.store._lock:
             slot = self.dir.lookup(key)
@@ -1440,14 +1501,30 @@ class DeviceBucketStore(BucketStore):
 
     def _sync_dispatch(self, key: str, local_count: float,
                        decay_rate_per_sec: float):
-        slot = self._counter_slot(key)
-        with self.profiler.span("sync_counter"), self._lock:
-            b = _pad_size(1, floor=8)
+        return self._sync_dispatch_many([key], [local_count],
+                                        decay_rate_per_sec)
+
+    def _sync_dispatch_many(self, keys: Sequence[str],
+                            local_counts: Sequence[float],
+                            decay_rate_per_sec: float):
+        """ONE ``sync_batch`` launch for a whole fleet of counters — the
+        device half of :meth:`sync_counters_many` (and, with one row, of
+        the classic per-limiter :meth:`sync_counter`)."""
+        n = len(keys)
+        with self._lock:
+            slots = _resolve_with_reclaim(
+                self._counter_dir, list(keys),
+                lambda pinned: self._sweep_counters(),
+                self._grow_counters,
+            )
+        with self.profiler.span("sync_counter", n), self._lock:
+            b = _pad_size(n, floor=8)
             packed = np.full((3, b), -1, np.int32)
             packed[1] = 0
-            packed[0, 0] = slot
+            packed[0, :n] = slots
             # Float local counts travel bitcast in the int32 row (exact).
-            packed[1, 0] = np.float32(local_count).view(np.int32)
+            packed[1, :n] = np.asarray(local_counts,
+                                       np.float32).view(np.int32)
             packed[2] = self.now_ticks_checked()
             rate = self._decay_rate_dev.get(decay_rate_per_sec)
             if rate is None:
@@ -1476,6 +1553,39 @@ class DeviceBucketStore(BucketStore):
         out_np = np.asarray(self._sync_dispatch(key, local_count,
                                                 decay_rate_per_sec))
         return SyncResult(float(out_np[0, 0]), float(out_np[1, 0]))
+
+    async def sync_counters_many(self, keys: Sequence[str],
+                                 local_counts: Sequence[float],
+                                 decay_rate_per_sec: float
+                                 ) -> tuple[np.ndarray, np.ndarray]:
+        """Bulk replica sync: the whole fleet's local counts land in ONE
+        ``sync_batch`` launch against the counter table (duplicate keys'
+        adds accumulate — pre-aggregate per key for exact EWMAs, see
+        :func:`~.ops.kernels.sync_batch`)."""
+        await self.connect()
+        n = len(keys)
+        out = self._sync_dispatch_many(keys, local_counts,
+                                       decay_rate_per_sec)
+        loop = asyncio.get_running_loop()
+        out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+        return (out_np[0, :n].astype(np.float64),
+                out_np[1, :n].astype(np.float64))
+
+    async def debit_many(self, keys: Sequence[str],
+                         amounts: Sequence[float], capacity: float,
+                         fill_rate_per_sec: float
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """Tier-0 reconciliation against the authoritative bucket table:
+        one saturating-debit launch per (capacity, rate) table (see
+        :func:`~.ops.kernels.debit_batch_packed`)."""
+        await self.connect()
+        n = len(keys)
+        table = self._table(capacity, fill_rate_per_sec)
+        out = table._debit_launch(keys, amounts)
+        loop = asyncio.get_running_loop()
+        out_np = await loop.run_in_executor(None, lambda: np.asarray(out))
+        return (out_np[0, :n].astype(np.float64),
+                out_np[1, :n].astype(np.float64))
 
     # -- concurrency semaphore ---------------------------------------------
     def _sema_slot(self, key: str) -> int:
@@ -1881,6 +1991,31 @@ class InProcessBucketStore(BucketStore):
         tokens, ts = entry
         rate = _rate_per_tick(fill_rate_per_sec)
         return float(np.floor(min(float(capacity), tokens + max(0, now - ts) * rate)))
+
+    async def debit_many(self, keys, amounts, capacity, fill_rate_per_sec):
+        """Serial saturating debit — identical semantics to the device
+        kernel (:func:`~.ops.kernels.debit_batch_packed`): refill, then
+        subtract clamped at zero, reporting the clamped shortfall."""
+        now = self.clock.now_ticks()
+        rate = _rate_per_tick(fill_rate_per_sec)
+        n = len(keys)
+        remaining = np.empty(n, np.float64)
+        shortfall = np.empty(n, np.float64)
+        for i, (k, amt) in enumerate(zip(keys, amounts)):
+            amt = float(amt)
+            bkey = (k, float(capacity), float(fill_rate_per_sec))
+            entry = self._buckets.get(bkey)
+            if entry is None:
+                refilled = float(capacity)
+            else:
+                tokens, ts = entry
+                refilled = min(float(capacity),
+                               tokens + max(0, now - ts) * rate)
+            applied = min(amt, max(refilled, 0.0))
+            self._buckets[bkey] = (refilled - applied, now)
+            remaining[i] = refilled - applied
+            shortfall[i] = amt - applied
+        return remaining, shortfall
 
     async def sync_counter(self, key, local_count, decay_rate_per_sec):
         return self.sync_counter_blocking(key, local_count, decay_rate_per_sec)

@@ -127,6 +127,49 @@ class LimiterMetrics:
 
 
 @dataclass
+class Tier0Metrics:
+    """Python-side half of the native front-end's tier-0 admission-cache
+    observability (the C side counts hits/denies/misses/installs/
+    evictions; ``NativeFrontend.tier0_stats`` merges both). Tracks the
+    sync pump: reconciliation rounds, degraded-mode failures, and the
+    over-admission the saturating debit actually observed — the gauges
+    the documented epsilon bound is audited against."""
+
+    syncs: int = 0
+    sync_failures: int = 0
+    keys_synced: int = 0
+    #: Total drained permits that found no tokens (clamped shortfall) —
+    #: realized over-admission, to be compared against epsilon.
+    overadmit_total: float = 0.0
+    #: Largest single-key shortfall seen in any one sync round.
+    overadmit_max: float = 0.0
+    #: monotonic timestamp of the last successful sync (0 = never) —
+    #: ``last_sync_age_s`` in snapshots is the staleness gauge.
+    last_sync_mono: float = 0.0
+
+    def record_sync(self, n_keys: int, shortfalls, now_mono: float) -> None:
+        self.syncs += 1
+        self.keys_synced += n_keys
+        if len(shortfalls):
+            total = float(sum(shortfalls))
+            self.overadmit_total += total
+            self.overadmit_max = max(self.overadmit_max,
+                                     float(max(shortfalls)))
+        self.last_sync_mono = now_mono
+
+    def snapshot(self, now_mono: float) -> dict:
+        return {
+            "syncs": self.syncs,
+            "sync_failures": self.sync_failures,
+            "keys_synced": self.keys_synced,
+            "overadmit_total": self.overadmit_total,
+            "overadmit_max": self.overadmit_max,
+            "last_sync_age_s": (now_mono - self.last_sync_mono
+                                if self.last_sync_mono else -1.0),
+        }
+
+
+@dataclass
 class StoreMetrics:
     """Per-store (device) counters: kernel launches and batch occupancy."""
 

@@ -12,6 +12,7 @@ platform) silently yields ``None`` and callers fall back. Set
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import pathlib
 import subprocess
@@ -23,9 +24,32 @@ _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
 
+def _source_hash(src: pathlib.Path) -> str:
+    return hashlib.sha256(src.read_bytes()).hexdigest()
+
+
+def _hash_path(out: pathlib.Path) -> pathlib.Path:
+    return out.with_name(out.name + ".hash")
+
+
+def _is_stale(src: pathlib.Path, out: pathlib.Path) -> bool:
+    """A binary is fresh only when its sidecar records the CURRENT source
+    hash. Mtime comparison is not enough: a fresh clone materializes
+    source and committed binary with equal mtimes, so source/binary
+    drift in the repo would silently serve the stale ``.so``."""
+    if not out.exists():
+        return True
+    try:
+        return _hash_path(out).read_text().strip() != _source_hash(src)
+    except OSError:
+        return True  # no/unreadable sidecar: rebuild to establish one
+
+
 def _build(src: pathlib.Path, out: pathlib.Path) -> bool:
     """Prefer a build with the CPython API enabled (zero-copy list[str]
-    resolve); fall back to the plain C ABI if headers are unavailable."""
+    resolve); fall back to the plain C ABI if headers are unavailable.
+    A successful build stamps the source-hash sidecar ``_is_stale``
+    checks on load."""
     import sysconfig
 
     out.parent.mkdir(parents=True, exist_ok=True)
@@ -42,6 +66,11 @@ def _build(src: pathlib.Path, out: pathlib.Path) -> bool:
         except (OSError, subprocess.TimeoutExpired):
             return False
         if proc.returncode == 0 and out.exists():
+            try:
+                _hash_path(out).write_text(_source_hash(src) + "\n")
+            except OSError:
+                pass  # read-only checkout: next load re-checks and
+                # rebuilds into the same (tmpfs/overlay) place
             return True
     return False
 
@@ -123,7 +152,7 @@ def load_directory_lib() -> ctypes.CDLL | None:
     try:
         if not src.exists():
             return None
-        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+        if _is_stale(src, out):
             if not _build(src, out):
                 return None
         # PyDLL: calls hold the GIL, required for dir_resolve_pylist (which
@@ -192,6 +221,26 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_double, c.c_int, c.POINTER(c.c_double),
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.fe_loadgen.restype = c.c_int
+    try:
+        lib.fe_t0_configure.argtypes = [
+            c.c_void_p, c.c_int, c.c_double, c.c_double, c.c_double,
+            c.c_int, c.c_int]
+        lib.fe_t0_configure.restype = c.c_int
+        lib.fe_t0_harvest.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int, c.POINTER(c.c_int32),
+            c.POINTER(c.c_double), c.POINTER(c.c_double),
+            c.POINTER(c.c_double), c.c_int]
+        lib.fe_t0_harvest.restype = c.c_int
+        lib.fe_t0_ack.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int32),
+            c.POINTER(c.c_double), c.POINTER(c.c_double),
+            c.POINTER(c.c_double), c.c_int]
+        lib.fe_t0_ack.restype = None
+        lib.fe_t0_counts.argtypes = [c.c_void_p, c.POINTER(c.c_longlong)]
+        lib.fe_t0_counts.restype = None
+        lib.has_tier0 = True
+    except AttributeError:  # stale binary without the tier-0 ABI
+        lib.has_tier0 = False
     return lib
 
 
@@ -212,7 +261,7 @@ def load_frontend_lib() -> ctypes.CDLL | None:
     try:
         if not src.exists():
             return None
-        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+        if _is_stale(src, out):
             if not _build(src, out):
                 return None
         _FE_LIB = _bind_frontend(ctypes.CDLL(str(out)))

@@ -42,6 +42,7 @@
 #include <sys/timerfd.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cmath>
@@ -186,6 +187,66 @@ struct Conn {
 // Bound on bytes a connection may pipeline behind an unresolved HELLO.
 constexpr size_t kMaxHeld = 256u << 10;
 
+// ---------------------------------------------------------------------
+// Tier-0 admission cache: a bounded per-key replica of the store's view,
+// serving ACQUIRE permits/denies locally whenever the replica shows
+// confident headroom against its last-synced value — the approximate
+// local-decision/async-sync split (models/approximate.py) re-hosted
+// BELOW the wire, so a hot key's decision never leaves this file. The
+// Python sync pump drains each replica's accumulated local grants into
+// one bulk saturating-debit launch (store.debit_many — sync_batch's
+// decaying-counter semantic mirrored onto the bucket table, where
+// score == capacity − tokens), pulls back fresh balances, and acks them
+// here; budgets shrink/widen with the observed balance, so
+// over-admission is bounded by the documented epsilon
+// (2·budget + fill_rate·sync_interval, models/approximate.py
+// overadmit_epsilon). Policy formula mirrored from
+// models/approximate.py::headroom_budget — keep the two in sync.
+// ---------------------------------------------------------------------
+
+struct T0Entry {
+  std::string key;
+  double cap = 0.0, rate = 0.0;   // config identity ((a, b) of the frames)
+  double last_remaining = 0.0;    // last authoritative balance (acked)
+  double admitted = 0.0;          // local grants since the last ack
+  double pending = 0.0;           // local grants not yet harvested
+  double budget = 0.0;            // confident local admission headroom
+  uint64_t last_ack_ns = 0;       // staleness anchor
+  uint64_t last_touch_ns = 0;     // TTL anchor
+  bool live = false;
+};
+
+struct T0Config {
+  bool enabled = false;
+  size_t mask = 0;                // slots - 1 (power of two)
+  double fraction = 0.5;          // budget = floor(balance * fraction)
+  double min_budget = 64.0;       // below this, not worth hosting locally
+  double max_budget = 1048576.0;
+  uint64_t stale_ns = 0;          // max decision age since last ack
+  uint64_t ttl_ns = 0;            // idle eviction
+};
+
+// Linear-probe window and the key-size cap that bounds table memory
+// (slots × (entry + key) — ~1.5 MB at the 4096-slot default).
+constexpr size_t kT0Probe = 8;
+constexpr size_t kT0MaxKey = 256;
+
+uint64_t t0_hash(const std::string& k) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+  for (unsigned char ch : k) {
+    h ^= ch;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+double t0_budget_of(const T0Config& cfg, double avail) {
+  double b = avail * cfg.fraction;
+  if (b > cfg.max_budget) b = cfg.max_budget;
+  if (b < cfg.min_budget) return 0.0;
+  return std::floor(b);
+}
+
 struct Frontend {
   int listen_fd = -1, epfd = -1, evfd = -1, tfd = -1;
   int port = 0;
@@ -214,7 +275,110 @@ struct Frontend {
   int64_t batches_flushed = 0;
   uint64_t hist[kHistBuckets] = {0};
   int64_t hist_total = 0;
+
+  // Tier-0 admission cache (empty/disabled until fe_t0_configure).
+  T0Config t0;
+  std::vector<T0Entry> t0tab;
+  size_t t0_scan = 0;  // harvest resume cursor (fairness under overflow)
+  int64_t t0_hits = 0;          // local grants
+  int64_t t0_local_denies = 0;  // confident local denies
+  int64_t t0_misses = 0;        // eligible requests that fell through
+  int64_t t0_installs = 0;
+  int64_t t0_evictions = 0;
 };
+
+T0Entry* t0_find(Frontend* fe, const std::string& key, double cap,
+                 double rate) {
+  // mu held.
+  if (fe->t0tab.empty()) return nullptr;
+  size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
+  for (size_t p = 0; p < kT0Probe; p++) {
+    T0Entry& e = fe->t0tab[(idx + p) & fe->t0.mask];
+    if (e.live && e.cap == cap && e.rate == rate && e.key == key) return &e;
+  }
+  return nullptr;
+}
+
+void t0_install(Frontend* fe, const std::string& key, double cap,
+                double rate, double remaining, uint64_t now) {
+  // mu held. Seed/refresh a replica from an authoritative device
+  // decision (fe_complete). A refresh keeps `admitted`: the device
+  // balance predates our un-drained local grants, so the envelope stays
+  // conservative until the next sync acks them away.
+  if (fe->t0tab.empty() || key.size() > kT0MaxKey) return;
+  T0Entry* e = t0_find(fe, key, cap, rate);
+  if (e == nullptr) {
+    double budget = t0_budget_of(fe->t0, remaining);
+    if (budget <= 0.0) return;  // headroom too small to host locally
+    size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
+    for (size_t p = 0; p < kT0Probe && e == nullptr; p++) {
+      T0Entry& cand = fe->t0tab[(idx + p) & fe->t0.mask];
+      if (!cand.live) {
+        e = &cand;
+      } else if (cand.pending == 0.0 &&
+                 now - cand.last_touch_ns > fe->t0.ttl_ns) {
+        fe->t0_evictions++;  // reuse an idle slot (un-drained grants pin)
+        e = &cand;
+      }
+    }
+    if (e == nullptr) return;  // probe window live: bounded table, skip
+    e->key = key;
+    e->cap = cap;
+    e->rate = rate;
+    e->admitted = 0.0;
+    e->pending = 0.0;
+    e->live = true;
+    e->last_remaining = remaining;
+    e->budget = budget;
+    e->last_ack_ns = now;
+    e->last_touch_ns = now;
+    fe->t0_installs++;
+    return;
+  }
+  e->last_remaining = remaining;
+  e->budget = t0_budget_of(fe->t0, std::max(remaining - e->admitted, 0.0));
+  e->last_ack_ns = now;
+  e->last_touch_ns = now;
+}
+
+int t0_decide(Frontend* fe, const std::string& key, int32_t count,
+              double cap, double rate, double* rem_out) {
+  // mu held. 1 = grant locally, 0 = deny locally, -1 = fall through to
+  // the device path. The estimate reported with local replies is the
+  // envelope's own conservative view (last acked balance minus local
+  // grants — refill since the ack is credit the next sync will restore).
+  T0Entry* e = t0_find(fe, key, cap, rate);
+  if (e == nullptr) {
+    fe->t0_misses++;
+    return -1;
+  }
+  uint64_t now = now_ns();
+  if (now - e->last_ack_ns > fe->t0.stale_ns) {
+    fe->t0_misses++;  // envelope too old: device decides (and re-seeds)
+    return -1;
+  }
+  e->last_touch_ns = now;
+  double cnt = double(count);
+  if (e->admitted + cnt <= e->budget) {
+    e->admitted += cnt;
+    e->pending += cnt;
+    fe->t0_hits++;
+    *rem_out = std::max(e->last_remaining - e->admitted, 0.0);
+    return 1;
+  }
+  // Confident deny: even crediting FULL refill since the last ack, the
+  // last-synced balance cannot cover this request — uncertainty falls
+  // through instead of guessing.
+  double elapsed_s = double(now - e->last_ack_ns) * 1e-9;
+  double optimistic = e->last_remaining - e->admitted + rate * elapsed_s;
+  if (optimistic < cnt) {
+    fe->t0_local_denies++;
+    *rem_out = std::max(e->last_remaining - e->admitted, 0.0);
+    return 0;
+  }
+  fe->t0_misses++;
+  return -1;
+}
 
 void hist_record(Frontend* fe, double seconds) {
   int idx = 0;
@@ -277,6 +441,50 @@ void send_to_conn(Frontend* fe, Conn* c, const char* data, size_t len) {
     ev.data.u64 = c->id;
     epoll_ctl(fe->epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
+}
+
+void queue_to_conn(Conn* c, const char* data, size_t len) {
+  // mu held. Append-only variant of send_to_conn for replies generated
+  // inside a parse burst (tier-0 local decisions, PING): the caller
+  // flushes ONCE per burst via flush_queued, collapsing per-reply
+  // send() syscalls — at tier-0 rates the syscall per reply, not the
+  // decision, is the serving ceiling.
+  if (c->closing) return;
+  if (c->out.size() - c->out_off + len > kMaxConnOut) {
+    c->closing = true;  // unbounded outbox = dead/hostile reader
+    c->out.clear();
+    c->out_off = 0;
+    return;
+  }
+  c->out.append(data, len);
+}
+
+void flush_queued(Frontend* fe, Conn* c) {
+  // mu held. Push burst-queued replies out with one send(); arm
+  // EPOLLOUT for any leftover. Never closes/frees the connection (hard
+  // errors mark `closing` and the IO loop reaps on the next event), so
+  // callers keep their pointer.
+  if (c->out_off >= c->out.size() || c->want_write) return;
+  ssize_t n = ::send(c->fd, c->out.data() + c->out_off,
+                     c->out.size() - c->out_off, MSG_NOSIGNAL);
+  if (n >= 0) {
+    c->out_off += size_t(n);
+  } else if (errno != EAGAIN && errno != EWOULDBLOCK) {
+    c->closing = true;
+    c->out.clear();
+    c->out_off = 0;
+    return;
+  }
+  if (c->out_off >= c->out.size()) {
+    c->out.clear();
+    c->out_off = 0;
+    return;
+  }
+  c->want_write = true;
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLOUT;
+  ev.data.u64 = c->id;
+  epoll_ctl(fe->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
 void flush_out(Frontend* fe, Conn* c) {
@@ -368,6 +576,8 @@ void maybe_flush_after_complete(Frontend* fe) {
 // from parse_frames (IO thread) and from fe_set_authed's held-frame
 // replay (loop thread); mu held either way.
 bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
+  if (c->closing) return true;  // replies would be dropped: stop mutating
+                                // store state for a dying connection
   uint8_t ver = body[0];
   uint32_t seq = rd_u32(body + 1);
   uint8_t op = body[5];
@@ -426,13 +636,27 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         it.a = rd_f64(kp + klen + 4);
         it.b = rd_f64(kp + klen + 12);
         it.t_ns = now_ns();
+        if (op == OP_ACQUIRE && fe->t0.enabled && it.count > 0) {
+          // Tier-0: answer from the local replica when it is confident
+          // either way; zero-permit probes and every other op keep the
+          // exact device path.
+          double rem = 0.0;
+          int verdict = t0_decide(fe, it.key, it.count, it.a, it.b, &rem);
+          if (verdict >= 0) {
+            std::string resp = encode_decision(seq, verdict == 1, rem);
+            queue_to_conn(c, resp.data(), resp.size());
+            hist_record(fe, double(now_ns() - it.t_ns) * 1e-9);
+            fe->requests_served++;
+            break;
+          }
+        }
         if (fe->pending.empty()) fe->pending_oldest_ns = it.t_ns;
         fe->pending.push_back(std::move(it));
         break;
       }
       case OP_PING: {
         std::string resp = encode_empty(seq);
-        send_to_conn(fe, c, resp.data(), resp.size());
+        queue_to_conn(c, resp.data(), resp.size());
         fe->requests_served++;  // the asyncio server counts pings too
         break;
       }
@@ -456,6 +680,10 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
 bool parse_frames(Frontend* fe, Conn* c) {
   // mu held.
   for (;;) {
+    if (c->closing) {  // drop pipelined input behind a fatal reply — the
+      c->in_off = c->in.size();  // store must not mutate for dead replies
+      break;
+    }
     size_t avail = c->in.size() - c->in_off;
     if (avail < 4) break;
     const uint8_t* p = c->in.data() + c->in_off;
@@ -475,6 +703,8 @@ bool parse_frames(Frontend* fe, Conn* c) {
     c->in.erase(c->in.begin(), c->in.begin() + ptrdiff_t(c->in_off));
     c->in_off = 0;
   }
+  // One send() for the whole burst's queued replies (tier-0/PING).
+  flush_queued(fe, c);
   return true;
 }
 
@@ -763,6 +993,11 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
     if (itc != fe->conns.end()) {
       send_to_conn(fe, itc->second, resp.data(), resp.size());
     }
+    if (fe->t0.enabled && item.op == OP_ACQUIRE && granted[i] != 0) {
+      // Every granted fall-through decision is an authoritative balance
+      // observation: seed/refresh the key's tier-0 replica from it.
+      t0_install(fe, item.key, item.a, item.b, remaining[i], t);
+    }
     hist_record(fe, double(t - item.t_ns) * 1e-9);
     fe->requests_served++;
     i++;
@@ -845,6 +1080,8 @@ void fe_set_authed(void* h, uint64_t conn_id, int authed) {
     } else {
       close_conn(fe, c);
     }
+  } else {
+    flush_queued(fe, c);  // replayed tier-0/PING replies
   }
   // Replayed hot items joined `pending` from this (loop) thread: wake
   // the IO thread so its flush/deadline evaluation sees them.
@@ -903,6 +1140,111 @@ void fe_stop(void* h) {
 }
 
 void fe_free(void* h) { delete static_cast<Frontend*>(h); }
+
+// ---------------------------------------------------------------------
+// Tier-0 admission cache ABI (see the T0Entry block above). All calls
+// take the global mutex; the harvest/ack pair is driven by the Python
+// sync pump (runtime/native_frontend.py _t0_sync_loop).
+// ---------------------------------------------------------------------
+
+// Enable tier-0 with a bounded replica table. Returns the (power-of-two
+// rounded) slot count actually allocated.
+int fe_t0_configure(void* h, int slots, double fraction, double min_budget,
+                    double max_budget, int stale_ms, int ttl_ms) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  size_t n = 1;
+  while (n < size_t(slots > 0 ? slots : 4096)) n <<= 1;
+  fe->t0tab.assign(n, T0Entry{});
+  fe->t0.mask = n - 1;
+  fe->t0.fraction = fraction > 0 ? fraction : 0.5;
+  fe->t0.min_budget = min_budget > 0 ? min_budget : 1.0;
+  fe->t0.max_budget = max_budget > 0 ? max_budget : 1048576.0;
+  fe->t0.stale_ns =
+      uint64_t(stale_ms > 0 ? stale_ms : 1000) * 1000000ull;
+  fe->t0.ttl_ns = uint64_t(ttl_ms > 0 ? ttl_ms : 30000) * 1000000ull;
+  fe->t0.enabled = true;
+  return int(n);
+}
+
+// Drain accumulated local grants: copies up to max_n (key, amount, cap,
+// rate) rows out (key_blob concatenated, klens delimiting) and zeroes
+// each entry's pending. Entries that do not fit stay pending for the
+// next round — the scan resumes from a rotating cursor, so an
+// overflowing round cannot starve the tail of the table (every entry's
+// grants reconcile within a bounded number of rounds). Idle
+// pending-free entries are TTL-evicted in the same pass. Returns the
+// row count.
+int fe_t0_harvest(void* h, char* key_blob, int blob_cap, int32_t* klens,
+                  double* amounts, double* caps, double* rates, int max_n) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  size_t total = fe->t0tab.size();
+  if (total == 0) return 0;
+  uint64_t now = now_ns();
+  int n = 0;
+  size_t off = 0;
+  size_t i = fe->t0_scan;
+  for (size_t scanned = 0; scanned < total; scanned++, i++) {
+    T0Entry& e = fe->t0tab[i % total];
+    if (!e.live) continue;
+    if (e.pending > 0.0) {
+      if (n >= max_n || off + e.key.size() > size_t(blob_cap)) break;
+      std::memcpy(key_blob + off, e.key.data(), e.key.size());
+      off += e.key.size();
+      klens[n] = int32_t(e.key.size());
+      amounts[n] = e.pending;
+      caps[n] = e.cap;
+      rates[n] = e.rate;
+      e.pending = 0.0;
+      n++;
+    } else if (now - e.last_touch_ns > fe->t0.ttl_ns) {
+      e.live = false;
+      fe->t0_evictions++;
+    }
+  }
+  fe->t0_scan = i % total;  // resume where the scan stopped
+  return n;
+}
+
+// Complete a sync round: install fresh authoritative balances for the
+// harvested keys and recompute their budgets. Grants made after the
+// harvest (still in `pending`) remain outstanding against the new
+// envelope; the drained portion is reflected in the balance itself.
+void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
+               const double* caps, const double* rates,
+               const double* remainings, int n) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  uint64_t now = now_ns();
+  size_t off = 0;
+  for (int i = 0; i < n; i++) {
+    std::string key(key_blob + off, size_t(klens[i]));
+    off += size_t(klens[i]);
+    T0Entry* e = t0_find(fe, key, caps[i], rates[i]);
+    if (e == nullptr) continue;  // evicted while the sync was in flight
+    e->last_remaining = remainings[i];
+    e->admitted = e->pending;
+    e->budget = t0_budget_of(
+        fe->t0, std::max(remainings[i] - e->admitted, 0.0));
+    e->last_ack_ns = now;
+    e->last_touch_ns = now;
+  }
+}
+
+// out[6]: hits, local denies, misses, installs, evictions, live entries.
+void fe_t0_counts(void* h, long long* out) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  long long live = 0;
+  for (const T0Entry& e : fe->t0tab) live += e.live ? 1 : 0;
+  out[0] = fe->t0_hits;
+  out[1] = fe->t0_local_denies;
+  out[2] = fe->t0_misses;
+  out[3] = fe->t0_installs;
+  out[4] = fe->t0_evictions;
+  out[5] = live;
+}
 
 // ---------------------------------------------------------------------
 // Native closed-loop load generator: the measurement client for the
@@ -1018,7 +1360,9 @@ int fe_loadgen(const char* host, int port, int n_conns, int depth,
         uint32_t len = rd_u32(c.in.data() + c.in_off);
         if (avail < 4 + size_t(len)) break;
         const uint8_t* body = c.in.data() + c.in_off + 4;
-        if (body[5] == RESP_DECISION && len >= kBodyOff + 1 && body[6]) {
+        // Length check FIRST: body[5]/body[6] on a short frame (len < 7)
+        // would read past the buffered input.
+        if (len >= kBodyOff + 1 && body[5] == RESP_DECISION && body[6]) {
           granted++;
         }
         c.in_off += 4 + len;

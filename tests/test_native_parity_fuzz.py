@@ -29,15 +29,21 @@ pytestmark = pytest.mark.skipif(
     reason="native front-end library unavailable (no compiler?)")
 
 
-@pytest.mark.parametrize("seed", [11, 23, 47])
-def test_native_and_asyncio_servers_answer_identically(seed):
+# tier0=True runs the same fuzz with the tier-0 admission cache armed:
+# at the fuzz's capacity (10) every key sits below the default
+# min_budget confidence gate, so tier-0 must be semantically INVISIBLE —
+# identical replies, never a locally-guessed decision.
+@pytest.mark.parametrize("seed,tier0", [(11, False), (23, False),
+                                        (47, False), (11, True),
+                                        (47, True)])
+def test_native_and_asyncio_servers_answer_identically(seed, tier0):
     async def main():
         clocks = [ManualClock(), ManualClock()]
         servers = [
             BucketStoreServer(InProcessBucketStore(clock=clocks[0]),
                               native_frontend=False),
             BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
-                              native_frontend=True),
+                              native_frontend=True, native_tier0=tier0),
         ]
         for s in servers:
             await s.start()
