@@ -37,6 +37,8 @@ class MicroBatcher(Generic[TReq, TRes]):
         max_delay_s: float = 200e-6,
         max_inflight: int = 8,
         flush_latency=None,
+        queue_latency=None,
+        flush_observer=None,
     ) -> None:
         if max_batch <= 0:
             raise ValueError("max_batch must be positive")
@@ -47,7 +49,16 @@ class MicroBatcher(Generic[TReq, TRes]):
         # (dispatch + kernel + readback) — the device-side share of the
         # serving-latency decomposition.
         self._flush_latency = flush_latency
-        self._pending: list[tuple[TReq, asyncio.Future]] = []
+        # Optional LatencyHistogram: enqueue → flush dispatch, recorded
+        # once per flush for the OLDEST member (the conservative envelope
+        # of queue wait — per-member records would cost a hist insert per
+        # request on the hot path; the oldest member's wait bounds them
+        # all and is what drives the p99).
+        self._queue_latency = queue_latency
+        # Optional callable(n_requests, wall_s, error_repr | None), fired
+        # once per completed flush — the flight-recorder feed.
+        self._flush_observer = flush_observer
+        self._pending: list[tuple[TReq, asyncio.Future, float]] = []
         self._timer: asyncio.TimerHandle | None = None
         self._inflight = asyncio.Semaphore(max_inflight)
         self._tasks: set[asyncio.Task] = set()  # strong refs to in-flight flushes
@@ -63,7 +74,10 @@ class MicroBatcher(Generic[TReq, TRes]):
             raise RuntimeError("batcher is closed")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((request, fut))
+        # The enqueue stamp is one perf_counter read (~60ns) on a path
+        # already paying a future + list append; it is what makes the
+        # queue stage a measured histogram instead of an inference.
+        self._pending.append((request, fut, time.perf_counter()))
         if len(self._pending) >= self._max_batch:
             self._start_flush(loop)
         elif self._timer is None:
@@ -95,20 +109,39 @@ class MicroBatcher(Generic[TReq, TRes]):
                 self._max_delay_s, self._start_flush, loop
             )
 
-    async def _run_flush(self, batch: list[tuple[TReq, asyncio.Future]]) -> None:
+    async def _run_flush(self,
+                         batch: list[tuple[TReq, asyncio.Future, float]]
+                         ) -> None:
         async with self._inflight:
-            requests = [r for r, _ in batch]
-            t0 = time.perf_counter() if self._flush_latency is not None else 0.0
+            requests = [r for r, _, _ in batch]
+            t0 = time.perf_counter()
+            if self._queue_latency is not None:
+                # batch[0] is the oldest submitter: its wait envelopes
+                # every other member's (arrival order is append order).
+                self._queue_latency.record(t0 - batch[0][2])
             try:
                 results = await self._flush_fn(requests)
-                if self._flush_latency is not None:
-                    self._flush_latency.record(time.perf_counter() - t0)
             except BaseException as exc:  # noqa: BLE001 — fan the failure out
-                for _, fut in batch:
+                if self._flush_observer is not None:
+                    try:
+                        self._flush_observer(len(batch),
+                                             time.perf_counter() - t0,
+                                             repr(exc))
+                    except Exception:  # noqa: BLE001 — observer bugs must
+                        pass           # not mask the flush failure
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
-            for (_, fut), res in zip(batch, results):
+            dt = time.perf_counter() - t0
+            if self._flush_latency is not None:
+                self._flush_latency.record(dt)
+            if self._flush_observer is not None:
+                try:
+                    self._flush_observer(len(batch), dt, None)
+                except Exception:  # noqa: BLE001 — an observer bug must
+                    pass  # never fail a flush that already succeeded
+            for (_, fut, _), res in zip(batch, results):
                 if not fut.done():  # caller may have cancelled while queued
                     fut.set_result(res)
 

@@ -358,6 +358,36 @@ class ClusterBucketStore(BucketStore):
         await asyncio.gather(*(n.save() for n in self.nodes
                                if hasattr(n, "save")))
 
+    async def cluster_metrics(self) -> str:
+        """Fleet-wide OpenMetrics exposition: scrape every node's
+        ``OP_METRICS`` text and merge — each sample re-emitted per node
+        with a ``node="<j>"`` label (positional, same convention as
+        :meth:`stats`) plus an aggregated summed series without it, so
+        one scrape answers both "what is the fleet doing" and "which
+        node is the outlier". Nodes without a metrics surface (bare
+        in-process stores in tests) contribute nothing rather than
+        failing the scrape."""
+        from distributedratelimiting.redis_tpu.utils.metrics import (
+            aggregate_openmetrics,
+        )
+
+        async def one(n: BucketStore) -> str:
+            # callable check: on device stores `metrics` is the
+            # StoreMetrics ATTRIBUTE, not the remote scrape method.
+            if not callable(getattr(n, "metrics", None)):
+                return ""
+            try:
+                return await n.metrics()
+            except Exception as exc:  # a down node must not kill the
+                log.could_not_connect_to_store(exc)  # fleet scrape
+                return ""
+
+        texts = await asyncio.gather(*(one(n) for n in self.nodes))
+        return aggregate_openmetrics(texts)
+
+    def cluster_metrics_blocking(self) -> str:
+        return self._blocking(self.cluster_metrics())
+
     async def stats(self) -> dict:
         """Per-node stats plus cluster-level sums of the numeric metrics.
         ``nodes[j]`` is positionally node ``j``'s stats (``{}`` for nodes

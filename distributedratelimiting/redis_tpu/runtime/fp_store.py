@@ -146,6 +146,9 @@ class _FpTable:
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
+            flush_latency=store.metrics.flush_latency,
+            queue_latency=store.metrics.queue_latency,
+            flush_observer=store._flush_observer,
         )
 
     # -- kernel bindings (the window subclass swaps these) ------------------
@@ -542,6 +545,9 @@ class _FpWindowTable(_FpTable):
             max_batch=store.max_batch,
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
+            flush_latency=store.metrics.flush_latency,
+            queue_latency=store.metrics.queue_latency,
+            flush_observer=store._flush_observer,
         )
 
     def _call_batch(self, kpair, counts, valid, now):

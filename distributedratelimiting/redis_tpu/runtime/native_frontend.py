@@ -145,6 +145,9 @@ class NativeFrontend:
         self._tier0: Tier0Config | None = None
         self._t0_task: asyncio.Task | None = None
         self.t0_metrics = Tier0Metrics()
+        # Consecutive failed sync rounds — the degraded-mode streak that
+        # trips the server's flight recorder (0 while healthy).
+        self._t0_fail_streak = 0
         #: drained-but-unreconciled amounts surviving a failed sync round
         #: (degraded mode: carried into the next round, never dropped).
         self._t0_carry: dict[tuple[str, float, float], float] = {}
@@ -276,6 +279,19 @@ class NativeFrontend:
                            a_arr: np.ndarray, b_arr: np.ndarray) -> None:
         n = len(keys)
         try:
+            hh = getattr(self._server, "heavy_hitters", None)
+            if hh is not None:
+                # Keys are already materialized for the store call; one
+                # C-speed Counter pass + a bounded top-2K merge
+                # (utils/heavy_hitters.py overhead discipline). Rows with
+                # count <= 0 (SEMA releases/probes) are not admission
+                # demand — filter only when any exist (rare outside
+                # semaphore traffic; the mask check is one vector op).
+                if (counts <= 0).any():
+                    hh.offer_many([k for k, c in zip(keys, counts)
+                                   if c > 0])
+                else:
+                    hh.offer_many(keys)
             granted = np.zeros(n, np.uint8)
             remaining = np.zeros(n, np.float64)
             # SEMA rows go as ONE store call in arrival order with
@@ -464,6 +480,8 @@ class NativeFrontend:
         cfg = self._tier0
         assert cfg is not None
         store = self._server.store
+        recorder = getattr(self._server, "flight_recorder", None)
+        hh = getattr(self._server, "heavy_hitters", None)
         while True:
             await asyncio.sleep(cfg.sync_interval_s)
             # Everything harvested was already zeroed out of the C table:
@@ -475,10 +493,18 @@ class NativeFrontend:
             # out of `merged` first.
             merged = self._t0_carry
             self._t0_carry = {}
+            round_failures = 0
+            round_keys = 0
+            round_shortfall = 0.0
             try:
                 for ident, amount in self._t0_harvest().items():
                     merged[ident] = merged.get(ident, 0.0) + amount
+                    if hh is not None:
+                        # The keys the sync pump drains ARE the tier-0 hot
+                        # set — the telemetry that explains hit rate.
+                        hh.offer(ident[0], amount)
                 if not merged:
+                    self._t0_fail_streak = 0
                     continue
                 by_cfg: dict[tuple[float, float], list[tuple[str, float]]] = {}
                 for (key, cap, rate), amount in merged.items():
@@ -495,10 +521,13 @@ class NativeFrontend:
                         # `merged` and re-carry via the finally
                         log.error_evaluating_kernel(exc)
                         self.t0_metrics.sync_failures += 1
+                        round_failures += 1
                         continue
                     self._t0_ack(keys, cap, rate, remaining)
                     self.t0_metrics.record_sync(len(keys), shortfall,
                                                 time.monotonic())
+                    round_keys += len(keys)
+                    round_shortfall += float(sum(shortfall))
                     for k, _ in rows:
                         merged.pop((k, cap, rate), None)
             except asyncio.CancelledError:
@@ -506,11 +535,40 @@ class NativeFrontend:
             except Exception as exc:  # the pump must outlive any bad round
                 log.error_evaluating_kernel(exc)
                 self.t0_metrics.sync_failures += 1
+                round_failures += 1
             finally:
                 for ident, amount in merged.items():
                     if amount > 0.0:
                         self._t0_carry[ident] = (
                             self._t0_carry.get(ident, 0.0) + amount)
+                self._t0_record_round(recorder, round_keys,
+                                      round_shortfall, round_failures)
+
+    #: Consecutive failed sync rounds that count as a degraded-mode
+    #: streak and trip the flight recorder.
+    T0_STREAK_DUMP = 3
+
+    def _t0_record_round(self, recorder, n_keys: int, shortfall: float,
+                         failures: int) -> None:
+        """Per-sync flight-recorder frame + the degraded-mode triggers:
+        a dump on entry into a failure streak of :data:`T0_STREAK_DUMP`
+        rounds (rate-limited inside the recorder), so the outage window
+        leaves captured state instead of prose."""
+        if failures:
+            self._t0_fail_streak += 1
+        else:
+            self._t0_fail_streak = 0
+        if recorder is None:
+            return
+        recorder.record("t0_sync", keys=n_keys, shortfall=shortfall,
+                        failures=failures,
+                        streak=self._t0_fail_streak,
+                        carry_keys=len(self._t0_carry))
+        if self._t0_fail_streak == self.T0_STREAK_DUMP:
+            recorder.auto_dump(
+                "t0_sync_streak",
+                {"streak": self._t0_fail_streak,
+                 "carry_keys": len(self._t0_carry)})
 
     def tier0_stats(self) -> dict | None:
         """Merged C + pump-side tier-0 gauges (``None`` when disabled)."""
@@ -550,6 +608,10 @@ class NativeFrontend:
     def latency_histogram(self) -> LatencyHistogram:
         """Snapshot the C-side serving histogram into the shared Python
         class (same 82 log-1.25 buckets, so quantiles read identically)."""
+        if getattr(self._lib, "has_stage_hist", False):
+            hist = self._stage_histogram(0)
+            if hist is not None:
+                return hist
         counts = np.zeros(LatencyHistogram.N_BUCKETS, np.uint64)
         total = self._lib.fe_hist(
             self._h, counts.ctypes.data_as(
@@ -559,8 +621,38 @@ class NativeFrontend:
         hist.total = int(total)
         return hist
 
+    def _stage_histogram(self, stage: int) -> LatencyHistogram | None:
+        counts = np.zeros(LatencyHistogram.N_BUCKETS, np.uint64)
+        sum_s = ctypes.c_double()
+        total = self._lib.fe_stage_hist(
+            self._h, stage,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.byref(sum_s))
+        if total < 0:
+            return None
+        hist = LatencyHistogram()
+        hist.counts = [int(x) for x in counts]
+        hist.total = int(total)
+        hist.sum_s = float(sum_s.value)
+        return hist
+
+    def stage_histograms(self) -> "dict[str, LatencyHistogram] | None":
+        """The C side's per-stage decomposition of the serving span:
+        ``queue`` (frame parsed → batch cut) and ``exec`` (batch cut →
+        completion = Python dispatch + store + kernel). ``None`` when the
+        loaded binary predates the stage-hist ABI."""
+        if not getattr(self._lib, "has_stage_hist", False):
+            return None
+        out: dict[str, LatencyHistogram] = {}
+        for stage, name in ((1, "native_queue"), (2, "native_exec")):
+            hist = self._stage_histogram(stage)
+            if hist is None:
+                return None
+            out[name] = hist
+        return out
+
     def reset_latency(self) -> None:
-        self._lib.fe_hist_reset(self._h)
+        self._lib.fe_hist_reset(self._h)  # stage hists reset with it
 
     async def aclose(self) -> None:
         if self._stopping:

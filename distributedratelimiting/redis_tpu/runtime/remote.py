@@ -604,16 +604,29 @@ class RemoteBucketStore(BucketStore):
         server has no snapshot path."""
         await self._request(wire.OP_SAVE)
 
-    async def stats(self, reset: bool = False) -> dict:
+    async def stats(self, reset: bool = False,
+                    dump_flight: bool = False) -> dict:
         """Server + store metrics (requests served, kernel launches, batch
         occupancy, sweeps …) as a dict. ``reset=True`` additionally asks
-        the server to start a fresh serving-latency window after the
-        snapshot — measurement runs use it to exclude warmup."""
+        the server to start a fresh serving/stage-latency window after the
+        snapshot — measurement runs use it to exclude warmup.
+        ``dump_flight=True`` triggers an explicit flight-recorder dump on
+        the server first (the returned ``flight_recorder.last_dump_path``
+        names the file on the SERVER's disk)."""
         import json
 
-        (text,) = await self._request(wire.OP_STATS,
-                                      count=1 if reset else 0)
+        flags = ((wire.STATS_FLAG_RESET if reset else 0)
+                 | (wire.STATS_FLAG_FLIGHT_DUMP if dump_flight else 0))
+        (text,) = await self._request(wire.OP_STATS, count=flags)
         return json.loads(text)
+
+    async def metrics(self) -> str:
+        """The server's OpenMetrics text exposition (``OP_METRICS``) —
+        the same bytes its HTTP ``/metrics`` endpoint serves, for
+        consumers already on the wire (``ClusterBucketStore.
+        cluster_metrics`` scrapes every node through this)."""
+        (text,) = await self._request(wire.OP_METRICS)
+        return text
 
     # -- lifecycle ----------------------------------------------------------
     async def aclose(self) -> None:

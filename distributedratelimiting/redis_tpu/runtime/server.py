@@ -28,9 +28,24 @@ import time
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import log
-from distributedratelimiting.redis_tpu.utils.metrics import LatencyHistogram
+from distributedratelimiting.redis_tpu.utils.flight_recorder import (
+    FlightRecorder,
+)
+from distributedratelimiting.redis_tpu.utils.heavy_hitters import HeavyHitters
+from distributedratelimiting.redis_tpu.utils.metrics import (
+    LatencyHistogram,
+    MetricsRegistry,
+)
 
 __all__ = ["BucketStoreServer"]
+
+
+#: Scalar keyed admission ops fed to the heavy-hitter sketch. Module
+#: constant on purpose: an inline `(wire.OP_..., ...)` tuple rebuilds
+#: from four global lookups on every request (~0.35µs — measured as its
+#: own line item in the plane's overhead audit).
+_HOT_KEYED_OPS = frozenset(
+    (wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW, wire.OP_SEMA))
 
 
 def _recover_seq(body: bytes) -> int:
@@ -57,7 +72,12 @@ class BucketStoreServer:
                  native_frontend: bool = False,
                  native_max_batch: int = 4096,
                  native_deadline_us: int = 300,
-                 native_tier0=False) -> None:
+                 native_tier0=False,
+                 metrics_port: int | None = None,
+                 observability: bool = True,
+                 heavy_hitters_k: int = 64,
+                 flight_dir: str | None = None,
+                 flight_capacity: int = 512) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -106,6 +126,25 @@ class BucketStoreServer:
         # swamps it (benchmarks/RESULTS.md p99 decomposition). Exposed
         # via OP_STATS as serving_p50_ms/serving_p99_ms.
         self.serving_latency = LatencyHistogram()
+        # Reply stage (result ready → reply handed to the transport):
+        # with the store's queue/flush histograms this completes the
+        # per-stage decomposition — serving ≈ queue + flush + reply.
+        self.reply_latency = LatencyHistogram()
+        # The observability plane: heavy-hitter key telemetry, flight
+        # recorder, and the OpenMetrics registry behind OP_METRICS and
+        # the /metrics HTTP endpoint. Pull-only by design; disable
+        # wholesale with observability=False (the ablation the
+        # serving_metrics_overhead bench section compares against).
+        self.observability = observability
+        self.heavy_hitters = (HeavyHitters(heavy_hitters_k)
+                              if observability and heavy_hitters_k > 0
+                              else None)
+        self.flight_recorder = (FlightRecorder(flight_capacity,
+                                               dump_dir=flight_dir)
+                                if observability else None)
+        self.metrics_port = metrics_port
+        self._metrics_server: asyncio.AbstractServer | None = None
+        self._registry: MetricsRegistry | None = None
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -113,6 +152,13 @@ class BucketStoreServer:
         localhost-cluster trick, ≙ ``UseLocalhostClustering`` with per-
         instance port offsets, ``TestApp/Program.cs:43-52``)."""
         await self.store.connect()
+        metrics = getattr(self.store, "metrics", None)
+        if (self.flight_recorder is not None and metrics is not None
+                and hasattr(metrics, "flight_recorder")):
+            # The store's flush observer feeds the ring (one frame per
+            # flush) and fires the degraded-entry auto-dump on a flush
+            # error — see DeviceBucketStore._flush_observer.
+            metrics.flight_recorder = self.flight_recorder
         if self.native_frontend:
             from distributedratelimiting.redis_tpu.runtime.native_frontend import (
                 NativeFrontend,
@@ -139,6 +185,7 @@ class BucketStoreServer:
                 self.native_frontend = False
             else:
                 self.port = self._native.port
+                await self._start_metrics_http()
                 return self.host, self.port
         elif self.native_tier0:
             import logging
@@ -152,7 +199,162 @@ class BucketStoreServer:
         )
         sock = self._server.sockets[0]
         self.host, self.port = sock.getsockname()[:2]
+        await self._start_metrics_http()
         return self.host, self.port
+
+    # -- /metrics HTTP exposition -------------------------------------------
+    async def _start_metrics_http(self) -> None:
+        """Bind the stdlib-asyncio ``/metrics`` listener when
+        ``metrics_port`` is set (0 = ephemeral; the bound port lands back
+        in ``self.metrics_port``). Independent of the wire listener, so
+        it serves identically whether the sockets are owned by asyncio or
+        by the native C front-end."""
+        if self.metrics_port is None:
+            return
+        self._metrics_server = await asyncio.start_server(
+            self._serve_metrics_http, self.host, self.metrics_port)
+        self.metrics_port = (
+            self._metrics_server.sockets[0].getsockname()[1])
+
+    async def _serve_metrics_http(self, reader: asyncio.StreamReader,
+                                  writer: asyncio.StreamWriter) -> None:
+        """Minimal one-shot HTTP/1.1 responder: GET /metrics → the
+        OpenMetrics exposition; GET /flight → explicit flight-recorder
+        dump (returns the path). Anything fancier belongs in a real
+        scraper-side proxy — this exists so ``curl``/Prometheus can reach
+        the plane with zero dependencies."""
+        import json
+
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            parts = request_line.split()
+            path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
+            while True:  # drain headers; no bodies on GET
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            route = path.split("?", 1)[0]
+            if route in ("/metrics", "/"):
+                body = self.registry.render().encode("utf-8")
+                status, ctype = "200 OK", MetricsRegistry.CONTENT_TYPE
+            elif route == "/flight" and self.flight_recorder is not None:
+                # Rate-limited on purpose: the metrics listener carries
+                # no auth (unlike the wire's OP_STATS trigger behind
+                # HELLO), so an unthrottled dump here would let any peer
+                # that can reach the port fill the disk. A suppressed
+                # request answers {"dumped": null, "suppressed": true}.
+                dump_path = self.flight_recorder.auto_dump("http_trigger")
+                body = json.dumps({"dumped": dump_path,
+                                   "suppressed": dump_path is None}
+                                  ).encode()
+                status, ctype = "200 OK", "application/json"
+            else:
+                body, status, ctype = b"not found\n", "404 Not Found", \
+                    "text/plain"
+            writer.write(
+                (f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 f"Connection: close\r\n\r\n").encode("latin-1") + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionResetError,
+                BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The server's OpenMetrics registry (built lazily — families
+        read live counters through callables, so construction order
+        doesn't matter)."""
+        if self._registry is None:
+            self._registry = self._build_registry()
+        return self._registry
+
+    def _build_registry(self) -> MetricsRegistry:
+        reg = MetricsRegistry()
+        reg.counter("connections_served", "Accepted TCP connections",
+                    lambda: (self._native.counts()[1]
+                             if self._native is not None
+                             else self.connections_served))
+        reg.counter("requests_served", "Requests answered on any lane",
+                    lambda: (self._native.counts()[0]
+                             if self._native is not None
+                             else self.requests_served))
+        reg.counter("batches_flushed",
+                    "Native front-end micro-batches handed to Python",
+                    lambda: (self._native.counts()[2]
+                             if self._native is not None else 0))
+        reg.gauge("native_frontend", "1 when the C front-end owns the "
+                  "sockets", lambda: 1.0 if self._native is not None
+                  else 0.0)
+        reg.histogram("serving_latency_seconds",
+                      "Request arrival to result ready",
+                      lambda: (self._native.latency_histogram()
+                               if self._native is not None
+                               else self.serving_latency))
+        # Per-stage decomposition: serving ≈ queue + flush + reply on the
+        # asyncio path; the native path adds its own C-measured
+        # queue/exec split. One family, one label.
+        metrics = getattr(self.store, "metrics", None)
+        reg.histogram("stage_latency_seconds",
+                      "Per-stage share of the serving span",
+                      lambda: getattr(metrics, "queue_latency", None),
+                      labels={"stage": "queue"})
+        reg.histogram("stage_latency_seconds",
+                      "Per-stage share of the serving span",
+                      lambda: getattr(metrics, "flush_latency", None),
+                      labels={"stage": "flush"})
+        reg.histogram("stage_latency_seconds",
+                      "Per-stage share of the serving span",
+                      lambda: self.reply_latency,
+                      labels={"stage": "reply"})
+        for stage in ("native_queue", "native_exec"):
+            reg.histogram(
+                "stage_latency_seconds",
+                "Per-stage share of the serving span",
+                lambda s=stage: ((self._native.stage_histograms() or {})
+                                 .get(s) if self._native is not None
+                                 else None),
+                labels={"stage": stage})
+        reg.register_numeric_dict(
+            "store", "store metrics",
+            lambda: metrics.snapshot() if metrics is not None else None,
+            counters={"launches", "rows_processed", "rows_valid", "sweeps",
+                      "slots_evicted", "pallas_sweep_failures",
+                      "rows_coalesced", "pregrows", "fp_unresolved"})
+        reg.register_numeric_dict(
+            "tier0", "tier-0 admission cache",
+            lambda: (self._native.tier0_stats()
+                     if self._native is not None else None),
+            counters={"hits", "local_denies", "misses", "installs",
+                      "evictions", "syncs", "sync_failures",
+                      "keys_synced"})
+        if self.heavy_hitters is not None:
+            hh = self.heavy_hitters
+            reg.gauge("hot_keys_offered",
+                      "Total admission weight offered to the top-K sketch",
+                      lambda: hh.offered)
+            reg.labeled_gauges(
+                "hot_key_count",
+                "Top-K admission weight per key (space-saving sketch; "
+                "count may overshoot by at most hot_key_error)",
+                lambda: [({"key": k}, c) for k, c, _ in hh.top()])
+            reg.labeled_gauges(
+                "hot_key_error",
+                "Space-saving overcount bound per tracked key",
+                lambda: [({"key": k}, e) for k, _, e in hh.top()])
+        if self.flight_recorder is not None:
+            reg.register_numeric_dict(
+                "flight", "flight recorder",
+                self.flight_recorder.snapshot,
+                counters={"frames_recorded", "dumps_written",
+                          "dumps_suppressed"})
+        return reg
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
                                 writer: asyncio.StreamWriter) -> None:
@@ -271,9 +473,14 @@ class BucketStoreServer:
             await asyncio.gather(after, return_exceptions=True)
         resp = await self.handle_frame_body(body)
         self.requests_served += 1
-        self.serving_latency.record(time.perf_counter() - t_arrival)
+        t_ready = time.perf_counter()
+        self.serving_latency.record(t_ready - t_arrival)
         await self._reply(writer, write_lock, resp)  # client went away ⇒
         # its futures die with the socket
+        # Reply stage: result ready → frame handed to the transport
+        # (includes any backpressure drain) — the fan-out share of the
+        # decomposition.
+        self.reply_latency.record(time.perf_counter() - t_ready)
 
     async def handle_frame_body(self, body: bytes) -> bytes:
         """Serve one frame body and return the encoded reply — the shared
@@ -306,6 +513,21 @@ class BucketStoreServer:
                 return wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
             seq, op, key, count, a, b = wire.decode_request(body)
+            hh = self.heavy_hitters
+            if hh is not None and count > 0 and op in _HOT_KEYED_OPS:
+                # Hot-key telemetry: scalar admission lane (the bulk
+                # KeyBlob lane stays zero-copy and is deliberately not
+                # counted — utils/heavy_hitters.py overhead discipline).
+                # count > 0 gates out SEMA releases (signed delta < 0)
+                # and zero-permit probes — neither is admission demand,
+                # and counting releases would double-weight semaphore
+                # keys. Unit-weight requests (the overwhelming shape)
+                # stage through the buffered feed: one list append here,
+                # the sketch merge amortized across the buffer.
+                if count > 1:
+                    hh.offer(key, count)
+                else:
+                    hh.offer_buffered(key)
             if op == wire.OP_ACQUIRE:
                 res = await self.store.acquire(key, count, a, b)
                 resp = wire.encode_response(
@@ -363,16 +585,30 @@ class BucketStoreServer:
                     await asyncio.shield(self._save_task)
                     resp = wire.encode_response(seq, wire.RESP_EMPTY)
             elif op == wire.OP_STATS:
+                if (count & wire.STATS_FLAG_FLIGHT_DUMP
+                        and self.flight_recorder is not None):
+                    # Explicit operator trigger (OP_SAVE-style): dump
+                    # BEFORE snapshotting so the stats payload carries
+                    # the fresh path.
+                    self.flight_recorder.dump("stats_trigger")
                 resp = wire.encode_response(
                     seq, wire.RESP_TEXT, self._stats_json())
-                if count:  # reset flag: start a fresh measurement window
+                if count & wire.STATS_FLAG_RESET:
+                    # Start a fresh measurement window (serving + every
+                    # stage histogram, both halves of the stack).
                     if self._native is not None:
                         self._native.reset_latency()
                     self.serving_latency.reset()
+                    self.reply_latency.reset()
                     metrics = getattr(self.store, "metrics", None)
-                    if metrics is not None and hasattr(metrics,
-                                                       "flush_latency"):
-                        metrics.flush_latency.reset()
+                    if metrics is not None:
+                        if hasattr(metrics, "flush_latency"):
+                            metrics.flush_latency.reset()
+                        if hasattr(metrics, "queue_latency"):
+                            metrics.queue_latency.reset()
+            elif op == wire.OP_METRICS:
+                resp = wire.encode_response(
+                    seq, wire.RESP_TEXT, self.registry.render())
             else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
@@ -416,9 +652,36 @@ class BucketStoreServer:
         metrics = getattr(self.store, "metrics", None)
         if metrics is not None:
             payload["store"] = metrics.snapshot()
+        # Per-stage decomposition: "serving p99 = queue + flush + reply"
+        # as a scrape, not a bench-time inference.
+        stages: dict = {}
+
+        def stage(name: str, hist: "LatencyHistogram | None") -> None:
+            if hist is not None and hist.total:
+                stages[name] = {"p50_ms": hist.p50 * 1e3,
+                                "p99_ms": hist.p99 * 1e3,
+                                "samples": hist.total}
+
+        stage("queue", getattr(metrics, "queue_latency", None))
+        stage("flush", getattr(metrics, "flush_latency", None))
+        stage("reply", self.reply_latency)
+        if self._native is not None:
+            for name, hist in (self._native.stage_histograms()
+                               or {}).items():
+                stage(name, hist)
+        if stages:
+            payload["stages"] = stages
+        if self.heavy_hitters is not None:
+            payload["hot_keys"] = self.heavy_hitters.snapshot()
+        if self.flight_recorder is not None:
+            payload["flight_recorder"] = self.flight_recorder.snapshot()
         return json.dumps(payload)
 
     async def aclose(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            await self._metrics_server.wait_closed()
+            self._metrics_server = None
         if self._native is not None:
             await self._native.aclose()
             self._native = None
@@ -522,6 +785,18 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--fe-tier0-fraction", type=float, default=0.5,
                         help="tier-0: fraction of the last-synced "
                         "balance granted as local headroom")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve the OpenMetrics exposition over HTTP "
+                        "on this port (GET /metrics; 0 picks a free "
+                        "port). The same text is always available on the "
+                        "wire as OP_METRICS.")
+    parser.add_argument("--flight-dir", default=None,
+                        help="flight-recorder dump directory (default "
+                        "$DRL_TPU_FLIGHT_DIR or the system tempdir)")
+    parser.add_argument("--no-observability", action="store_true",
+                        help="disable the observability plane (heavy-"
+                        "hitter telemetry + flight recorder); stage "
+                        "latency stamps and OP_STATS remain")
     args = parser.parse_args(argv)
     if args.fe_tier0 and not args.native_frontend:
         parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
@@ -583,9 +858,16 @@ def main(argv: list[str] | None = None) -> None:
                                    native_frontend=args.native_frontend,
                                    native_max_batch=args.fe_max_batch,
                                    native_deadline_us=args.fe_deadline_us,
-                                   native_tier0=native_tier0)
+                                   native_tier0=native_tier0,
+                                   metrics_port=args.metrics_port,
+                                   observability=not args.no_observability,
+                                   flight_dir=args.flight_dir)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
+        if server.metrics_port is not None:
+            print(f"metrics exposition on "
+                  f"http://{host}:{server.metrics_port}/metrics",
+                  flush=True)
         try:
             await asyncio.Event().wait()
         finally:

@@ -859,6 +859,8 @@ class _DeviceTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
             flush_latency=store.metrics.flush_latency,
+            queue_latency=store.metrics.queue_latency,
+            flush_observer=store._flush_observer,
         )
         self._pregrow_target = 0
         if store.coalesce_duplicates:
@@ -1135,6 +1137,8 @@ class _DeviceWindowTable(_PackedLaunchMixin):
             max_delay_s=store.max_delay_s,
             max_inflight=store.max_inflight,
             flush_latency=store.metrics.flush_latency,
+            queue_latency=store.metrics.queue_latency,
+            flush_observer=store._flush_observer,
         )
         self._pregrow_target = 0
         if store.coalesce_duplicates:
@@ -1341,6 +1345,21 @@ class DeviceBucketStore(BucketStore):
             # on the first hot-path acquire (mirrors lazy ConnectAsync).
             jax.block_until_ready(jnp.zeros((8,)))
             self._connected = True
+
+    def _flush_observer(self, n: int, wall_s: float,
+                        error: str | None) -> None:
+        """Per-flush flight-recorder feed (MicroBatcher ``flush_observer``).
+        One attribute check per flush when no recorder is attached; a
+        flush FAILURE is the store's degraded-mode entry, so it also
+        fires a rate-limited auto-dump — the outage window's lead-in
+        frames land on disk while they still exist."""
+        rec = self.metrics.flight_recorder
+        if rec is None:
+            return
+        rec.record("flush", n=n, wall_ms=round(wall_s * 1e3, 3),
+                   error=error)
+        if error is not None:
+            rec.auto_dump("flush_error", {"error": error})
 
     def now_ticks_checked(self) -> int:
         """Read the store clock; rebase every table's epoch before int32

@@ -72,9 +72,13 @@ added the version byte, HELLO, the u32 OK_TEXT length, and
 ACQUIRE_MANY/OK_BULK; v3 gave ACQUIRE_MANY's flags byte the table-kind
 bits; v4 (current) added the chained-chunk bit (chunk ordering became
 opt-in per frame — a v3 client relying on the old serialize-all-bulk
-behavior must not slip through). Semantic changes to an existing frame
-always bump the version: a silent misread loses decisions, the strict
-version check fails loudly instead.
+behavior must not slip through). OP_METRICS (OpenMetrics exposition)
+and the OP_STATS flag BITS (reset / flight-dump) arrived within v4: a
+new op and a widened already-optional flag byte change no existing
+frame's meaning, so an old server answers with a routable error rather
+than a misread. Semantic changes to an existing frame always bump the
+version: a silent misread loses decisions, the strict version check
+fails loudly instead.
 """
 
 from __future__ import annotations
@@ -86,7 +90,8 @@ import numpy as np
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
-    "OP_ACQUIRE_MANY",
+    "OP_ACQUIRE_MANY", "OP_METRICS",
+    "STATS_FLAG_RESET", "STATS_FLAG_FLIGHT_DUMP",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_BULK", "RESP_ERROR",
     "MAX_FRAME", "PROTOCOL_VERSION", "RemoteStoreError",
@@ -111,6 +116,16 @@ OP_SEMA = 8    # concurrency semaphore: count = signed delta, a = limit
 OP_FWINDOW = 9  # fixed-window acquire: (a, b) = (limit, window_s)
 OP_HELLO = 10  # shared-secret auth handshake (≙ Redis AUTH)
 OP_ACQUIRE_MANY = 11  # bulk acquire: n keys' decisions in one frame
+OP_METRICS = 12  # OpenMetrics text exposition (RESP_TEXT reply). A new
+# op on the existing frame layout needs no version bump: an older server
+# answers it with a routable unknown-op error, never a misparse.
+
+#: OP_STATS flag bits (the optional one-byte payload): bit 0 resets the
+#: serving/stage latency windows after the snapshot; bit 1 asks the
+#: flight recorder for an explicit JSONL dump (the ``OP_SAVE``-style
+#: operator trigger — the dump path comes back in the stats payload).
+STATS_FLAG_RESET = 1
+STATS_FLAG_FLIGHT_DUMP = 2
 
 _OP_NAMES = {
     OP_ACQUIRE: "acquire",
@@ -124,6 +139,7 @@ _OP_NAMES = {
     OP_FWINDOW: "fixed_window_acquire",
     OP_HELLO: "hello",
     OP_ACQUIRE_MANY: "acquire_many",
+    OP_METRICS: "metrics",
 }
 
 
@@ -208,11 +224,12 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
     elif op == OP_HELLO:
         payload = _keyed(key, b"")  # key carries the auth token
     elif op == OP_STATS:
-        # Optional one-byte flag: nonzero count asks the server to reset
-        # its serving-latency histogram after snapshotting (steady-state
-        # measurement windows). Absent byte = plain snapshot.
-        payload = b"\x01" if count else b""
-    elif op in (OP_PING, OP_SAVE):
+        # Optional one-byte flag bitmask (STATS_FLAG_*): bit 0 resets the
+        # serving/stage latency windows after snapshotting (steady-state
+        # measurement), bit 1 triggers a flight-recorder dump. Absent
+        # byte = plain snapshot.
+        payload = bytes([count & 0xFF]) if count else b""
+    elif op in (OP_PING, OP_SAVE, OP_METRICS):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
@@ -237,7 +254,7 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
         return seq, op, token, 0, 0.0, 0.0
     if op == OP_STATS:
         return seq, op, "", (body[0] if body else 0), 0.0, 0.0
-    if op in (OP_PING, OP_SAVE):
+    if op in (OP_PING, OP_SAVE, OP_METRICS):
         return seq, op, "", 0, 0.0, 0.0
     if op == OP_ACQUIRE_MANY:
         raise RemoteStoreError(

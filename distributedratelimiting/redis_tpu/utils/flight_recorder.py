@@ -1,0 +1,119 @@
+"""Flight recorder — a bounded ring of recent serving-state frames that
+dumps itself to JSONL when things go wrong.
+
+The r04/r05 outage windows were diagnosed from prose (RESULTS.md
+"degraded window" notes): by the time anyone looked, the state that
+explained the window — flush sizes and latencies leading in, sync
+failure streaks, carry growth — was gone. This module keeps the last N
+frames in memory at ~zero cost (one dict append per flush/sync round)
+and writes them out the moment a degraded-mode trigger fires, so the
+next outage leaves evidence instead of recollection.
+
+Frames are whatever the feeding layer records — the store's flush
+observer records ``flush`` frames (batch size, wall time, error), the
+tier-0 sync pump records ``t0_sync`` frames (keys drained, shortfall,
+failure streak). Triggers: degraded-mode entry (first failure after
+healthy operation), a sync-failure streak, or an explicit operator
+request (``OP_STATS`` flag bit 1 / the ``/flight`` HTTP path). Automatic
+dumps are rate-limited so a flapping trigger cannot fill a disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from collections import deque
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Bounded in-memory frame ring with triggered JSONL dumps."""
+
+    def __init__(self, capacity: int = 512,
+                 dump_dir: str | None = None,
+                 min_dump_interval_s: float = 30.0,
+                 name: str = "store") -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._frames: deque[dict] = deque(maxlen=capacity)
+        self.capacity = capacity
+        self.dump_dir = dump_dir or os.environ.get(
+            "DRL_TPU_FLIGHT_DIR") or tempfile.gettempdir()
+        self.min_dump_interval_s = min_dump_interval_s
+        self.name = name
+        self.frames_recorded = 0
+        self.dumps_written = 0
+        self.dumps_suppressed = 0
+        self.last_dump_path: str | None = None
+        self._last_dump_mono = 0.0
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one frame. Cheap by design (one dict + deque append);
+        called once per flush / sync round, never per request."""
+        frame = {"t": time.time(), "mono": time.monotonic(), "kind": kind}
+        frame.update(fields)
+        self._frames.append(frame)
+        self.frames_recorded += 1
+
+    def frames(self) -> list[dict]:
+        return list(self._frames)
+
+    def dump(self, reason: str, extra: dict | None = None, *,
+             force: bool = True) -> str | None:
+        """Write the ring to ``<dump_dir>/flight-<name>-<ts>-<reason>.jsonl``
+        (header line first, then frames oldest→newest) and return the
+        path. ``force=False`` applies the rate limit — automatic triggers
+        use it; explicit operator requests bypass it. Returns ``None``
+        when suppressed or the write fails (a full disk must never take
+        the serving path down with it)."""
+        now = time.monotonic()
+        if not force and (now - self._last_dump_mono
+                          < self.min_dump_interval_s):
+            self.dumps_suppressed += 1
+            return None
+        safe_reason = "".join(c if c.isalnum() or c in "-_" else "_"
+                              for c in reason)[:64]
+        path = os.path.join(
+            self.dump_dir,
+            f"flight-{self.name}-{int(time.time() * 1e3)}-{safe_reason}"
+            ".jsonl")
+        header = {
+            "kind": "header",
+            "reason": reason,
+            "dumped_at": time.time(),
+            "frames": len(self._frames),
+            "frames_recorded": self.frames_recorded,
+            "capacity": self.capacity,
+        }
+        if extra:
+            header.update(extra)
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(json.dumps(header) + "\n")
+                for frame in self._frames:
+                    f.write(json.dumps(frame, default=repr) + "\n")
+        except OSError:
+            return None
+        self._last_dump_mono = now
+        self.dumps_written += 1
+        self.last_dump_path = path
+        return path
+
+    def auto_dump(self, reason: str, extra: dict | None = None
+                  ) -> str | None:
+        """Rate-limited trigger for automatic (degraded-mode) dumps."""
+        return self.dump(reason, extra, force=False)
+
+    def snapshot(self) -> dict:
+        """JSON-shaped status for OP_STATS embedding."""
+        return {
+            "frames": len(self._frames),
+            "frames_recorded": self.frames_recorded,
+            "dumps_written": self.dumps_written,
+            "dumps_suppressed": self.dumps_suppressed,
+            "last_dump_path": self.last_dump_path,
+            "dump_dir": self.dump_dir,
+        }

@@ -6,12 +6,22 @@ framework fills since the north-star metric is decisions/sec + p99 latency.
 Counters are plain ints guarded by the GIL (single event loop); latency uses
 fixed log-spaced buckets so p50/p99 are O(1) to read and recording is
 allocation-free.
+
+:class:`MetricsRegistry` is the exposition layer over those counters: it
+names and namespaces every family (``drl_`` prefix) and renders OpenMetrics
+text — served by the store server both as the ``OP_METRICS`` wire op and
+as a plain HTTP ``/metrics`` endpoint (``--metrics-port``), and aggregated
+across cluster nodes by :func:`aggregate_openmetrics` /
+``ClusterBucketStore.cluster_metrics``. Exposition is pull-only: rendering
+walks live callables at scrape time; nothing on the serving path pays for
+it between scrapes.
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+from typing import Callable, Iterable, Mapping
 
 
 class LatencyHistogram:
@@ -31,6 +41,7 @@ class LatencyHistogram:
     def __init__(self) -> None:
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
+        self.sum_s = 0.0  # running sum → OpenMetrics _sum / mean
 
     def reset(self) -> None:
         """Zero in place. Holders keep their reference (the MicroBatcher
@@ -38,6 +49,7 @@ class LatencyHistogram:
         reset must NOT swap in a fresh object."""
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
+        self.sum_s = 0.0
 
     def record(self, seconds: float) -> None:
         if seconds <= self.MIN_S:
@@ -49,6 +61,14 @@ class LatencyHistogram:
             )
         self.counts[idx] += 1
         self.total += 1
+        self.sum_s += seconds
+
+    @classmethod
+    def bucket_upper_bounds(cls) -> list[float]:
+        """Upper edge of each bucket in seconds (bucket ``i`` holds samples
+        ≤ ``MIN_S·BASE^i``; the last bucket is the overflow catch-all and
+        renders as ``+Inf`` in OpenMetrics exposition)."""
+        return [cls.MIN_S * (cls.BASE ** i) for i in range(cls.N_BUCKETS)]
 
     def quantile(self, q: float) -> float:
         """Upper bound of the bucket containing quantile ``q`` (0..1)."""
@@ -196,6 +216,17 @@ class StoreMetrics:
     # minus flush p99 is the framework's own queueing/fan-out share —
     # the decomposition the <2ms north star needs (VERDICT r4 #3b).
     flush_latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    # Stage 1 of the per-request decomposition: enqueue → flush dispatch,
+    # recorded once per flush for the OLDEST request in the batch (its
+    # wait upper-bounds every other member's, so this is the conservative
+    # envelope of queueing — and costs one perf_counter diff per flush,
+    # not per request). serving p99 ≈ queue + flush + reply, each its own
+    # scrapeable histogram instead of a bench-time inference.
+    queue_latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    # Optional FlightRecorder (utils/flight_recorder.py) fed one frame per
+    # flush by the store's flush observer; attached by the serving layer,
+    # excluded from snapshot() (not a number).
+    flight_recorder: object | None = None
 
     def record_launch(self, batch_rows: int, valid_rows: int) -> None:
         self.launches += 1
@@ -221,4 +252,338 @@ class StoreMetrics:
             "flush_p50_ms": self.flush_latency.p50 * 1e3,
             "flush_p99_ms": self.flush_latency.p99 * 1e3,
             "flush_samples": self.flush_latency.total,
+            "queue_p50_ms": self.queue_latency.p50 * 1e3,
+            "queue_p99_ms": self.queue_latency.p99 * 1e3,
+            "queue_samples": self.queue_latency.total,
         }
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+def _escape_label(value: str) -> str:
+    """OpenMetrics label-value escaping: backslash, double-quote, newline."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_labels(labels: "Mapping[str, str] | None") -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _format_value(v: float) -> str:
+    """Compact numeric rendering: integers stay integral, floats use
+    repr (full precision — scrapers diff counters, so rounding loses
+    information)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
+
+
+class MetricsRegistry:
+    """Named metric families rendered as OpenMetrics text exposition.
+
+    Families are registered once with a *callable* that reads the live
+    value at scrape time — the registry holds no state of its own, so
+    registration costs the serving path nothing and a scrape sees the
+    counters exactly as the GIL-guarded writers left them. Three family
+    kinds cover everything the framework tracks:
+
+    - ``counter(name, help, fn)`` — monotonically increasing; rendered
+      with the OpenMetrics-required ``_total`` sample suffix.
+    - ``gauge(name, help, fn)`` — point-in-time value.
+    - ``histogram(name, help, fn)`` — ``fn`` returns a
+      :class:`LatencyHistogram` (or None to skip); rendered as cumulative
+      ``_bucket{le=...}`` series plus ``_count``/``_sum``.
+
+    ``labels`` lets one family carry several series (e.g. per-stage
+    latency: ``drl_stage_latency_seconds{stage="queue"}``); register the
+    same ``name`` repeatedly with distinct label sets.
+    ``register_numeric_dict`` bulk-adopts an existing ``snapshot()``-style
+    dict (StoreMetrics, Tier0Metrics, LimiterMetrics) as one gauge/counter
+    family per numeric key.
+    """
+
+    NAMESPACE = "drl"
+
+    def __init__(self, namespace: str | None = None) -> None:
+        self.namespace = namespace if namespace is not None else self.NAMESPACE
+        # name -> (type, help); insertion-ordered so exposition is stable.
+        self._families: dict[str, tuple[str, str]] = {}
+        # (name, labels-tuple, kind, fn) sample sources in registration order.
+        self._samples: list[tuple[str, tuple, str, Callable]] = []
+
+    def _full(self, name: str) -> str:
+        return f"{self.namespace}_{name}" if self.namespace else name
+
+    def _add(self, name: str, mtype: str, help_text: str,
+             fn: Callable, labels: "Mapping[str, str] | None") -> None:
+        full = self._full(name)
+        prev = self._families.get(full)
+        if prev is not None and prev[0] != mtype:
+            raise ValueError(
+                f"metric {full} already registered as {prev[0]}, "
+                f"not {mtype}")
+        self._families.setdefault(full, (mtype, help_text))
+        self._samples.append(
+            (full, tuple((labels or {}).items()), mtype, fn))
+
+    def counter(self, name: str, help_text: str, fn: Callable[[], float],
+                labels: "Mapping[str, str] | None" = None) -> None:
+        self._add(name, "counter", help_text, fn, labels)
+
+    def gauge(self, name: str, help_text: str, fn: Callable[[], float],
+              labels: "Mapping[str, str] | None" = None) -> None:
+        self._add(name, "gauge", help_text, fn, labels)
+
+    def histogram(self, name: str, help_text: str,
+                  fn: "Callable[[], LatencyHistogram | None]",
+                  labels: "Mapping[str, str] | None" = None) -> None:
+        self._add(name, "histogram", help_text, fn, labels)
+
+    def labeled_gauges(self, name: str, help_text: str,
+                       fn: "Callable[[], Iterable[tuple[dict, float]]]"
+                       ) -> None:
+        """One gauge family whose SERIES SET is dynamic at scrape time —
+        ``fn`` yields ``(labels_dict, value)`` pairs (the heavy-hitter
+        top-K, whose keys change between scrapes)."""
+        self._add(name, "gauge", help_text, fn, {"__dynamic__": "1"})
+
+    def register_numeric_dict(self, prefix: str, help_prefix: str,
+                              fn: "Callable[[], Mapping | None]",
+                              counters: "set[str] | frozenset[str]" = frozenset(),
+                              labels: "Mapping[str, str] | None" = None
+                              ) -> None:
+        """Adopt a ``snapshot()``-style dict wholesale: every numeric key
+        becomes ``<prefix>_<key>`` (counter when named in ``counters``,
+        gauge otherwise; non-numeric and nested values are skipped). The
+        key set is re-read per scrape, so optional keys (e.g. tier-0 off)
+        simply don't render."""
+
+        def emit():
+            d = fn()
+            if not d:
+                return []
+            out = []
+            for k, v in d.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                out.append((k, float(v)))
+            return out
+
+        # Registered as one dynamic family per numeric key at scrape time:
+        # store under a sentinel so render() expands names per key.
+        full = self._full(prefix)
+        self._families.setdefault(full, ("dict", help_prefix))
+        self._samples.append(
+            (full, tuple((labels or {}).items()) + (
+                ("__counters__", frozenset(counters)),), "dict", emit))
+
+    # -- rendering -----------------------------------------------------------
+    CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8")
+
+    def render(self) -> str:
+        """The full OpenMetrics text exposition, terminated by ``# EOF``."""
+        lines: list[str] = []
+        seen_type: set[str] = set()
+
+        def type_line(name: str, mtype: str, help_text: str) -> None:
+            if name in seen_type:
+                return
+            seen_type.add(name)
+            lines.append(f"# TYPE {name} {mtype}")
+            if help_text:
+                lines.append(f"# HELP {name} {_escape_label(help_text)}")
+
+        for full, labels_t, kind, fn in self._samples:
+            mtype, help_text = self._families[full]
+            if kind == "dict":
+                labels = dict(labels_t)
+                counters = labels.pop("__counters__", frozenset())
+                try:
+                    items = fn()
+                except Exception:
+                    continue  # a broken reader must not kill the scrape
+                lbl = _format_labels(labels)
+                for key, value in items:
+                    name = f"{full}_{key}"
+                    is_counter = key in counters
+                    type_line(name, "counter" if is_counter else "gauge",
+                              help_text and f"{help_text}: {key}")
+                    suffix = "_total" if is_counter else ""
+                    lines.append(
+                        f"{name}{suffix}{lbl} {_format_value(value)}")
+                continue
+            labels = dict(labels_t)
+            dynamic = labels.pop("__dynamic__", None)
+            try:
+                value = fn()
+            except Exception:
+                continue
+            type_line(full, mtype, help_text)
+            if dynamic:
+                for series_labels, v in value:
+                    lines.append(f"{full}{_format_labels(series_labels)} "
+                                 f"{_format_value(v)}")
+            elif mtype == "histogram":
+                if value is None:
+                    continue
+                self._render_histogram(lines, full, labels, value)
+            else:
+                if value is None:
+                    continue
+                suffix = "_total" if mtype == "counter" else ""
+                lines.append(f"{full}{suffix}{_format_labels(labels)} "
+                             f"{_format_value(value)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_histogram(lines: list[str], full: str, labels: dict,
+                          hist: LatencyHistogram) -> None:
+        bounds = hist.bucket_upper_bounds()
+        cum = 0
+        for i, c in enumerate(hist.counts):
+            cum += c
+            if c == 0 and i < len(hist.counts) - 1:
+                continue  # sparse: only emit buckets that move the cdf
+            le = ("+Inf" if i == len(hist.counts) - 1
+                  else repr(bounds[i]))
+            lbl = _format_labels({**labels, "le": le})
+            lines.append(f"{full}_bucket{lbl} {cum}")
+        lbl = _format_labels(labels)
+        lines.append(f"{full}_count{lbl} {hist.total}")
+        lines.append(f"{full}_sum{lbl} {_format_value(hist.sum_s)}")
+
+
+def parse_openmetrics(text: str) -> tuple[dict[str, str],
+                                          list[tuple[str, tuple, float]]]:
+    """Minimal OpenMetrics parser for aggregation: returns
+    ``(types_by_name, samples)`` where each sample is
+    ``(sample_name, ((label, value), ...), float)``. Handles the subset
+    :class:`MetricsRegistry` emits (no exemplars, no timestamps)."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, tuple, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line == "# EOF":
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            lbl_text, _, val_text = rest.rpartition("}")
+            labels = []
+            for piece in _split_labels(lbl_text):
+                k, _, v = piece.partition("=")
+                labels.append((k, _unescape_label(v.strip('"'))))
+            labels_t = tuple(labels)
+        else:
+            name, _, val_text = line.rpartition(" ")
+            labels_t = ()
+        try:
+            samples.append((name.strip(), labels_t, float(val_text)))
+        except ValueError:
+            continue
+    return types, samples
+
+
+def _split_labels(text: str) -> list[str]:
+    """Split ``a="x",b="y"`` on commas outside quotes."""
+    out, buf, in_q, esc = [], [], False, False
+    for ch in text:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            buf.append(ch)
+            continue
+        if ch == "," and not in_q:
+            out.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
+def _unescape_label(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def aggregate_openmetrics(node_texts: "Iterable[str]",
+                          node_label: str = "node") -> str:
+    """Merge N nodes' OpenMetrics expositions into one: every sample is
+    re-emitted per node with a ``node="<i>"`` label, and samples that sum
+    meaningfully (counters, histogram ``_bucket``/``_count``/``_sum``, and
+    additive gauges) also get an aggregated series without the node label.
+    Non-additive gauges (rates, quantile gauges) aggregate as sums too —
+    consumers who care read the per-node series; the summed series is the
+    fleet-roll-up convention (the same one ``ClusterBucketStore.stats``
+    already uses for its JSON totals). Output is grouped per family (one
+    ``# TYPE`` line, then that family's aggregated + per-node samples,
+    contiguously) — OpenMetrics forbids interleaving a family's samples
+    with another's, and compliant scrapers enforce it."""
+    agg: dict[tuple[str, tuple], float] = {}
+    agg_order: list[tuple[str, tuple]] = []
+    per_node: dict[str, list[str]] = {}  # family -> per-node sample lines
+    types: dict[str, str] = {}
+    fam_order: list[str] = []
+
+    def base_family(sample_name: str) -> str:
+        for suffix in ("_bucket", "_count", "_sum", "_total"):
+            if sample_name.endswith(suffix):
+                root = sample_name[: -len(suffix)]
+                if root in types:
+                    return root
+        return sample_name
+
+    for i, text in enumerate(node_texts):
+        node_types, samples = parse_openmetrics(text)
+        types.update(node_types)
+        for name, labels_t, value in samples:
+            key = (name, labels_t)
+            if key not in agg:
+                agg[key] = 0.0
+                agg_order.append(key)
+            agg[key] += value
+            fam = base_family(name)
+            if fam not in per_node:
+                per_node[fam] = []
+                fam_order.append(fam)
+            lbl = _format_labels(dict(labels_t) | {node_label: str(i)})
+            per_node[fam].append(f"{name}{lbl} {_format_value(value)}")
+    agg_by_family: dict[str, list[str]] = {}
+    for name, labels_t in agg_order:
+        fam = base_family(name)
+        agg_by_family.setdefault(fam, []).append(
+            f"{name}{_format_labels(dict(labels_t))} "
+            f"{_format_value(agg[(name, labels_t)])}")
+    lines: list[str] = []
+    for fam in fam_order:
+        if fam in types:
+            lines.append(f"# TYPE {fam} {types[fam]}")
+        lines.extend(agg_by_family.get(fam, []))
+        lines.extend(per_node[fam])
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

@@ -162,6 +162,7 @@ struct Item {
 struct Batch {
   int64_t id;
   std::vector<Item> items;
+  uint64_t t_flush_ns = 0;  // batch cut — per-stage decomposition anchor
 };
 
 struct Passthrough {
@@ -275,6 +276,15 @@ struct Frontend {
   int64_t batches_flushed = 0;
   uint64_t hist[kHistBuckets] = {0};
   int64_t hist_total = 0;
+  double hist_sum = 0.0;
+  // Per-stage decomposition of the serving span (same bucket convention):
+  // stage 0 = queue (frame parsed -> batch cut), stage 1 = exec (batch
+  // cut -> fe_complete/fe_fail, i.e. Python dispatch + store + kernel).
+  // serving ~= queue + exec + reply-write; exported via fe_stage_hist.
+  static constexpr int kStages = 2;
+  uint64_t stage_hist[kStages][kHistBuckets] = {{0}};
+  int64_t stage_total[kStages] = {0};
+  double stage_sum[kStages] = {0.0};
 
   // Tier-0 admission cache (empty/disabled until fe_t0_configure).
   T0Config t0;
@@ -380,15 +390,26 @@ int t0_decide(Frontend* fe, const std::string& key, int32_t count,
   return -1;
 }
 
-void hist_record(Frontend* fe, double seconds) {
+int hist_bucket(double seconds) {
   int idx = 0;
   if (seconds > 1e-6) {
     idx = int(std::log(seconds / 1e-6) * kInvLogBase) + 1;
     if (idx > kHistBuckets - 1) idx = kHistBuckets - 1;
     if (idx < 0) idx = 0;
   }
-  fe->hist[idx]++;
+  return idx;
+}
+
+void hist_record(Frontend* fe, double seconds) {
+  fe->hist[hist_bucket(seconds)]++;
   fe->hist_total++;
+  fe->hist_sum += seconds;
+}
+
+void stage_record(Frontend* fe, int stage, double seconds) {
+  fe->stage_hist[stage][hist_bucket(seconds)]++;
+  fe->stage_total[stage]++;
+  fe->stage_sum[stage] += seconds;
 }
 
 void set_nonblock(int fd) {
@@ -541,11 +562,13 @@ void flush_pending(Frontend* fe, bool include_tail) {
   size_t limit = include_tail ? n : (n / fe->max_batch) * fe->max_batch;
   if (limit == 0) return;
   size_t pos = 0;
+  uint64_t t_cut = now_ns();
   while (pos < limit) {
     size_t take = limit - pos;
     if (take > fe->max_batch) take = fe->max_batch;
     Batch b;
     b.id = fe->next_batch_id++;
+    b.t_flush_ns = t_cut;
     b.items.assign(std::make_move_iterator(fe->pending.begin() + pos),
                    std::make_move_iterator(fe->pending.begin() + pos +
                                            take));
@@ -985,6 +1008,8 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
   auto it = fe->inflight.find(batch_id);
   if (it == fe->inflight.end()) return;
   uint64_t t = now_ns();
+  uint64_t t_flush = it->second.t_flush_ns;
+  double exec_s = double(t - t_flush) * 1e-9;
   size_t i = 0;
   for (const Item& item : it->second.items) {
     std::string resp =
@@ -999,6 +1024,8 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
       t0_install(fe, item.key, item.a, item.b, remaining[i], t);
     }
     hist_record(fe, double(t - item.t_ns) * 1e-9);
+    stage_record(fe, 0, double(t_flush - item.t_ns) * 1e-9);  // queue
+    stage_record(fe, 1, exec_s);  // Python dispatch + store + kernel
     fe->requests_served++;
     i++;
   }
@@ -1013,6 +1040,8 @@ void fe_fail(void* h, long long batch_id, const char* msg) {
   auto it = fe->inflight.find(batch_id);
   if (it == fe->inflight.end()) return;
   uint64_t t = now_ns();
+  uint64_t t_flush = it->second.t_flush_ns;
+  double exec_s = double(t - t_flush) * 1e-9;
   for (const Item& item : it->second.items) {
     std::string resp = encode_error(item.seq, msg);
     auto itc = fe->conns.find(item.conn_id);
@@ -1020,6 +1049,8 @@ void fe_fail(void* h, long long batch_id, const char* msg) {
       send_to_conn(fe, itc->second, resp.data(), resp.size());
     }
     hist_record(fe, double(t - item.t_ns) * 1e-9);
+    stage_record(fe, 0, double(t_flush - item.t_ns) * 1e-9);
+    stage_record(fe, 1, exec_s);
     fe->requests_served++;
   }
   fe->inflight.erase(it);
@@ -1117,11 +1148,36 @@ long long fe_hist(void* h, uint64_t* counts) {
   return fe->hist_total;
 }
 
+// Per-stage latency histograms (same 82-bucket convention as fe_hist).
+// stage: 0 = serving (arrival -> completion, the fe_hist span), 1 =
+// queue (arrival -> batch cut), 2 = exec (batch cut -> completion).
+// Copies bucket counts into `counts`, writes the running sum of seconds
+// into `sum_out`, returns the sample total. Unknown stage returns -1.
+long long fe_stage_hist(void* h, int stage, uint64_t* counts,
+                        double* sum_out) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  if (stage == 0) {
+    std::memcpy(counts, fe->hist, sizeof fe->hist);
+    *sum_out = fe->hist_sum;
+    return fe->hist_total;
+  }
+  int s = stage - 1;
+  if (s < 0 || s >= Frontend::kStages) return -1;
+  std::memcpy(counts, fe->stage_hist[s], sizeof fe->stage_hist[s]);
+  *sum_out = fe->stage_sum[s];
+  return fe->stage_total[s];
+}
+
 void fe_hist_reset(void* h) {
   Frontend* fe = static_cast<Frontend*>(h);
   std::lock_guard<std::mutex> lk(fe->mu);
   std::memset(fe->hist, 0, sizeof fe->hist);
   fe->hist_total = 0;
+  fe->hist_sum = 0.0;
+  std::memset(fe->stage_hist, 0, sizeof fe->stage_hist);
+  std::memset(fe->stage_total, 0, sizeof fe->stage_total);
+  for (int s = 0; s < Frontend::kStages; s++) fe->stage_sum[s] = 0.0;
 }
 
 void fe_stop(void* h) {

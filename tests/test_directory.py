@@ -113,6 +113,7 @@ class TestEquivalence:
 
 
 @needs_native
+@pytest.mark.jax_backend
 def test_store_uses_native_directory():
     from distributedratelimiting.redis_tpu.runtime.store import DeviceBucketStore
 
