@@ -785,7 +785,8 @@ def _serving_load_child(host: str, port: str) -> None:
     asyncio.run(run())
 
 
-def bench_metrics_overhead() -> tuple[float, float, float, int]:
+def bench_metrics_overhead() -> tuple[float, float, float, int,
+                                      float, float]:
     """``serving_metrics_overhead`` section: the observability plane's
     whole-cost audit. Same closed-loop per-request rig (asyncio server,
     instant in-process backing so the kernel contributes nothing) run
@@ -794,8 +795,17 @@ def bench_metrics_overhead() -> tuple[float, float, float, int]:
     mid-run) vs ``observability=False``. The documented contract is
     <3% throughput cost with the plane on; exposition itself is
     pull-only, so the scrape rides the measured window to keep the
-    audit honest. Returns (on_rate, off_rate, overhead_pct — the
-    median of paired per-window deltas, scrape_bytes)."""
+    audit honest.
+
+    A third arm audits DISTRIBUTED TRACING at the production default
+    (head sampling 1%): tracing toggles on the process-global tracer
+    around ABBA window blocks on the plane-enabled rig, so the delta
+    isolates the tracing hooks (coin flips, context captures, span
+    machinery on the sampled 1%) under the same <3% contract.
+
+    Returns (on_rate, off_rate, overhead_pct — the median of paired
+    per-window deltas, scrape_bytes, tracing_on_rate,
+    tracing_overhead_pct)."""
     from distributedratelimiting.redis_tpu.runtime.remote import (
         RemoteBucketStore,
     )
@@ -805,8 +815,9 @@ def bench_metrics_overhead() -> tuple[float, float, float, int]:
     from distributedratelimiting.redis_tpu.runtime.store import (
         InProcessBucketStore,
     )
+    from distributedratelimiting.redis_tpu.utils import tracing
 
-    async def main() -> tuple[float, float, int]:
+    async def main() -> tuple[float, float, float, int, float, float]:
         async def make(observability: bool):
             srv = BucketStoreServer(
                 InProcessBucketStore(), observability=observability,
@@ -858,7 +869,32 @@ def bench_metrics_overhead() -> tuple[float, float, float, int]:
             await writer.drain()
             data = await reader.read()
             writer.close()
-            return on_rate, off_rate, median_delta * 100.0, len(data)
+            # Tracing arm: same ABBA discipline, toggling the global
+            # tracer around window blocks on the SAME enabled rig (both
+            # rigs share the process-global tracer, so a two-rig pairing
+            # would contaminate the control side).
+            tblocks = []
+            try:
+                for _ in range(4):
+                    tracing.configure(enabled=True, sample_rate=0.01,
+                                      keep_rate=0.1)
+                    a1 = await window(store_on)
+                    tracing.configure(enabled=False)
+                    b1 = await window(store_on)
+                    b2 = await window(store_on)
+                    tracing.configure(enabled=True, sample_rate=0.01,
+                                      keep_rate=0.1)
+                    a2 = await window(store_on)
+                    tracing.configure(enabled=False)
+                    tblocks.append(((a1 + a2) / 2, (b1 + b2) / 2))
+            finally:
+                tracing.configure(enabled=False)
+                tracing.get_tracer().reset()
+            trace_rate = max(a for a, _ in tblocks)
+            tdeltas = sorted((b - a) / b for a, b in tblocks)
+            trace_pct = tdeltas[len(tdeltas) // 2] * 100.0
+            return (on_rate, off_rate, median_delta * 100.0, len(data),
+                    trace_rate, trace_pct)
         finally:
             await store_on.aclose()
             await store_off.aclose()
@@ -1009,6 +1045,10 @@ RESULT: dict = {
     "serving_metrics_off_req_per_s": None,
     "serving_metrics_overhead_pct": None,
     "serving_metrics_scrape_bytes": None,
+    # Distributed-tracing arm of the same audit: head-sampled (1%)
+    # tracing toggled on the plane-enabled rig; same <3% contract.
+    "serving_tracing_on_req_per_s": None,
+    "serving_tracing_overhead_pct": None,
     "pallas_sweep_ok": None,
     "device_probe": None,
     "budget_s": BUDGET_S,
@@ -1348,16 +1388,20 @@ def main() -> int:
         _emit()
 
     def sec_metrics_overhead():
-        on_rate, off_rate, pct, scraped = bench_metrics_overhead()
-        return (round(on_rate), round(off_rate), round(pct, 2), scraped)
+        (on_rate, off_rate, pct, scraped,
+         trace_rate, trace_pct) = bench_metrics_overhead()
+        return (round(on_rate), round(off_rate), round(pct, 2), scraped,
+                round(trace_rate), round(trace_pct, 2))
 
     status, value = _section("serving_metrics_overhead",
-                             sec_metrics_overhead, timeout_s=180)
+                             sec_metrics_overhead, timeout_s=240)
     if status == "ok" and value is not None:
         (RESULT["serving_metrics_on_req_per_s"],
          RESULT["serving_metrics_off_req_per_s"],
          RESULT["serving_metrics_overhead_pct"],
-         RESULT["serving_metrics_scrape_bytes"]) = value
+         RESULT["serving_metrics_scrape_bytes"],
+         RESULT["serving_tracing_on_req_per_s"],
+         RESULT["serving_tracing_overhead_pct"]) = value
         _emit()
 
     # Second chance for the chip: if the first probe found no window but

@@ -14,12 +14,25 @@ Run: ``python -m benchmarks.scaleout [--nodes 1,2,4,8] [--clients 2]
 Prints one JSON line per node count; the parent measures aggregate
 decisions/s across all client processes against wall clock.
 
-Interpretation contract (RESULTS.md "Aggregate scale-out curve"): on a
-single-core box every server and client timeshares one CPU, so the curve
-measures *composition overhead* (does adding nodes cost throughput?),
-not parallel speedup — the per-node ceiling × N model only applies when
-each node owns its own core/chip. The harness therefore also records
-``nproc`` so the reader can tell which regime a record came from.
+Topology is configurable (VERDICT r5 item 7 — the harness only):
+
+- ``--hosts a:6380,b:6380`` drives EXTERNAL, already-running store
+  servers (one JSONL record for the whole list) instead of spawning
+  localhost children — the real multi-host measurement.
+- ``--config topo.json`` reads the same knobs from a file
+  (``{"nodes": [...], "clients": N, "seconds": S, "backing": ...,
+  "hosts": [...], "cores": N}``); CLI flags override file values.
+- ``--cores`` records the core count the operator ACTUALLY gave the rig
+  (taskset/cgroup), for the interpretation contract below; it defaults
+  to ``os.cpu_count()``.
+
+Interpretation contract (RESULTS.md "Aggregate scale-out curve"): when
+every server and client timeshares one CPU, the curve measures
+*composition overhead* (does adding nodes cost throughput?), not
+parallel speedup — the per-node ceiling × N model only applies when
+each node owns its own core/chip. The harness therefore records
+``nproc`` and ``cores`` so the reader can tell which regime a record
+came from.
 """
 
 from __future__ import annotations
@@ -109,7 +122,8 @@ def _client_child(addrs_json: str, seconds: str) -> None:
 
 
 def _measure(n_nodes: int, n_clients: int, seconds: float,
-             backing: str) -> dict:
+             backing: str, hosts: "list[list] | None" = None,
+             cores: int | None = None) -> dict:
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         FORCE_CPU_ENV,
     )
@@ -122,20 +136,28 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
     # root on their import path.
     root = os.path.dirname(os.path.dirname(me))
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    servers = [subprocess.Popen(
+    # External topology: the operator's already-running servers replace
+    # the spawned localhost children; everything else is identical.
+    servers = [] if hosts else [subprocess.Popen(
         [sys.executable, me, "--server-child"], env=env,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
         for _ in range(n_nodes)]
     pool = concurrent.futures.ThreadPoolExecutor(1)
     try:
-        addrs = []
-        for s in servers:
-            # Pooled readline with a timeout (bench.py's guard): during a
-            # tunnel outage a --backing device server child hangs in
-            # device init and never prints its address.
-            line = pool.submit(s.stdout.readline).result(timeout=180.0)
-            a = json.loads(line)
-            addrs.append([a["host"], a["port"]])
+        if hosts:
+            addrs = [[h, int(p)] for h, p in
+                     (a if isinstance(a, (list, tuple))
+                      else a.rsplit(":", 1) for a in hosts)]
+            n_nodes = len(addrs)
+        else:
+            addrs = []
+            for s in servers:
+                # Pooled readline with a timeout (bench.py's guard):
+                # during a tunnel outage a --backing device server child
+                # hangs in device init and never prints its address.
+                line = pool.submit(s.stdout.readline).result(timeout=180.0)
+                a = json.loads(line)
+                addrs.append([a["host"], a["port"]])
         addrs_json = json.dumps(addrs)
         t0 = time.perf_counter()
         clients = [subprocess.Popen(
@@ -157,7 +179,7 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
             "config": "scaleout",
             "n_nodes": n_nodes,
             "n_clients": n_clients,
-            "backing": backing,
+            "backing": backing if not hosts else "external",
             # Clients start together and run identical closed-loop
             # windows, so the aggregate is the sum of per-client rates
             # over their own measured windows (parent wall clock would
@@ -166,6 +188,8 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
             "per_client_decisions_per_sec": [round(r) for r in per_client],
             "wall_incl_warm_s": round(wall, 1),
             "nproc": os.cpu_count(),
+            "cores": cores if cores is not None else os.cpu_count(),
+            "hosts": [f"{h}:{p}" for h, p in addrs] if hosts else None,
         }
     finally:
         for s in servers:
@@ -179,14 +203,44 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--nodes", default="1,2,4,8")
-    p.add_argument("--clients", type=int, default=2)
-    p.add_argument("--seconds", type=float, default=6.0)
-    p.add_argument("--backing", choices=("cpu", "device"), default="cpu")
+    p.add_argument("--nodes", default=None,
+                   help="comma-separated node counts to spawn locally "
+                   "(default 1,2,4,8; ignored when --hosts is given)")
+    p.add_argument("--clients", type=int, default=None)
+    p.add_argument("--seconds", type=float, default=None)
+    p.add_argument("--backing", choices=("cpu", "device"), default=None)
+    p.add_argument("--hosts", default=None,
+                   help="comma-separated host:port of EXTERNAL servers "
+                   "to drive instead of spawning localhost children")
+    p.add_argument("--cores", type=int, default=None,
+                   help="core count the rig actually owns (recorded in "
+                   "the JSONL; default os.cpu_count())")
+    p.add_argument("--config", default=None,
+                   help="JSON file supplying the same knobs (nodes, "
+                   "clients, seconds, backing, hosts, cores); CLI "
+                   "flags override it")
     args = p.parse_args(argv)
-    for n in [int(x) for x in args.nodes.split(",")]:
-        print(json.dumps(_measure(n, args.clients, args.seconds,
-                                  args.backing)), flush=True)
+    cfg: dict = {}
+    if args.config:
+        with open(args.config, encoding="utf-8") as f:
+            cfg = json.load(f)
+    nodes = (args.nodes.split(",") if args.nodes
+             else cfg.get("nodes", [1, 2, 4, 8]))
+    clients = args.clients if args.clients is not None else cfg.get(
+        "clients", 2)
+    seconds = args.seconds if args.seconds is not None else cfg.get(
+        "seconds", 6.0)
+    backing = args.backing or cfg.get("backing", "cpu")
+    hosts = (args.hosts.split(",") if args.hosts
+             else cfg.get("hosts") or None)
+    cores = args.cores if args.cores is not None else cfg.get("cores")
+    if hosts:
+        print(json.dumps(_measure(len(hosts), clients, seconds, backing,
+                                  hosts=hosts, cores=cores)), flush=True)
+        return 0
+    for n in [int(x) for x in nodes]:
+        print(json.dumps(_measure(n, clients, seconds, backing,
+                                  cores=cores)), flush=True)
     return 0
 
 

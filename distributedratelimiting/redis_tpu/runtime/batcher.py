@@ -22,8 +22,15 @@ import asyncio
 import time
 from typing import Awaitable, Callable, Generic, Sequence, TypeVar
 
+from distributedratelimiting.redis_tpu.utils import tracing
+
 TReq = TypeVar("TReq")
 TRes = TypeVar("TRes")
+
+#: The process-global tracer, bound once: configure() mutates the same
+#: instance, and the submit hot path pays one attribute read, not a
+#: function call, to learn tracing is off.
+_TRACER = tracing.get_tracer()
 
 __all__ = ["MicroBatcher"]
 
@@ -55,10 +62,17 @@ class MicroBatcher(Generic[TReq, TRes]):
         # request on the hot path; the oldest member's wait bounds them
         # all and is what drives the p99).
         self._queue_latency = queue_latency
-        # Optional callable(n_requests, wall_s, error_repr | None), fired
-        # once per completed flush — the flight-recorder feed.
+        # Optional callable(n_requests, wall_s, error_repr | None,
+        # trace_id | None), fired once per completed flush — the
+        # flight-recorder feed (trace_id cross-references the frame to
+        # its exported trace).
         self._flush_observer = flush_observer
-        self._pending: list[tuple[TReq, asyncio.Future, float]] = []
+        # (request, future, enqueue_stamp, trace_ctx). The trace ctx is
+        # None on every untraced request — captured only because the
+        # flush runs in its own task, where the submitter's context
+        # variable does not follow.
+        self._pending: list[tuple[TReq, asyncio.Future, float,
+                                  "tracing.TraceContext | None"]] = []
         self._timer: asyncio.TimerHandle | None = None
         self._inflight = asyncio.Semaphore(max_inflight)
         self._tasks: set[asyncio.Task] = set()  # strong refs to in-flight flushes
@@ -76,8 +90,12 @@ class MicroBatcher(Generic[TReq, TRes]):
         fut: asyncio.Future = loop.create_future()
         # The enqueue stamp is one perf_counter read (~60ns) on a path
         # already paying a future + list append; it is what makes the
-        # queue stage a measured histogram instead of an inference.
-        self._pending.append((request, fut, time.perf_counter()))
+        # queue stage a measured histogram instead of an inference. The
+        # ambient-trace capture costs one contextvar read and is None on
+        # the untraced path.
+        self._pending.append((request, fut, time.perf_counter(),
+                              tracing.current_context()
+                              if _TRACER.enabled else None))
         if len(self._pending) >= self._max_batch:
             self._start_flush(loop)
         elif self._timer is None:
@@ -110,38 +128,70 @@ class MicroBatcher(Generic[TReq, TRes]):
             )
 
     async def _run_flush(self,
-                         batch: list[tuple[TReq, asyncio.Future, float]]
+                         batch: list[tuple[TReq, asyncio.Future, float,
+                                           "tracing.TraceContext | None"]]
                          ) -> None:
         async with self._inflight:
-            requests = [r for r, _, _ in batch]
+            requests = [r for r, _, _, _ in batch]
             t0 = time.perf_counter()
             if self._queue_latency is not None:
                 # batch[0] is the oldest submitter: its wait envelopes
                 # every other member's (arrival order is append order).
                 self._queue_latency.record(t0 - batch[0][2])
+            # The flush is SHARED: one span, parented on the first traced
+            # member (the elected trace); every other traced member's
+            # queue span carries flush_span_id so its trace still names
+            # the flush it rode. Queue spans are recorded at flush time
+            # (enqueue stamp -> dispatch) — no per-request cost beyond
+            # the ctx capture in submit().
+            elected, elected_enq = next(
+                ((c, t) for _, _, t, c in batch if c is not None),
+                (None, t0))
+            tracer = _TRACER
+            fspan = (tracer.start_span("batch.flush", parent=elected,
+                                       attrs={"n": len(batch)})
+                     if elected is not None else tracing._NULL_SPAN)
+            if elected is not None:
+                fid = (f"{fspan.context.span_id:016x}"
+                       if fspan.context is not None else None)
+                for _, _, t_enq, ctx in batch:
+                    if ctx is not None:
+                        tracer.record_span(
+                            "batch.queue", ctx, t_enq, t0,
+                            attrs=None if fid is None
+                            else {"flush_span_id": fid})
+                if self._queue_latency is not None:
+                    # The exemplar pairs the elected member's OWN wait
+                    # with its trace id — the sample above (oldest
+                    # member's envelope) may belong to a different,
+                    # untraced request.
+                    self._queue_latency.exemplar(t0 - elected_enq,
+                                                 elected.trace_id)
+            trace_id = None if elected is None else elected.trace_id
             try:
-                results = await self._flush_fn(requests)
+                with fspan:
+                    results = await self._flush_fn(requests)
             except BaseException as exc:  # noqa: BLE001 — fan the failure out
                 if self._flush_observer is not None:
                     try:
                         self._flush_observer(len(batch),
                                              time.perf_counter() - t0,
-                                             repr(exc))
+                                             repr(exc), trace_id)
                     except Exception:  # noqa: BLE001 — observer bugs must
                         pass           # not mask the flush failure
-                for _, fut, _ in batch:
+                for _, fut, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
             dt = time.perf_counter() - t0
             if self._flush_latency is not None:
-                self._flush_latency.record(dt)
+                self._flush_latency.record(dt, trace_id=trace_id)
             if self._flush_observer is not None:
                 try:
-                    self._flush_observer(len(batch), dt, None)
+                    self._flush_observer(len(batch), dt, None, trace_id)
                 except Exception:  # noqa: BLE001 — an observer bug must
                     pass  # never fail a flush that already succeeded
-            for (_, fut, _), res in zip(batch, results):
+            for (_, fut, _, _), res in zip(batch, results):
                 if not fut.done():  # caller may have cancelled while queued
                     fut.set_result(res)
 

@@ -57,7 +57,7 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     BulkAcquireResult,
     SyncResult,
 )
-from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils import log, tracing
 
 __all__ = ["ClusterBucketStore"]
 
@@ -281,20 +281,42 @@ class ClusterBucketStore(BucketStore):
             return await call(self.nodes[0], keys, counts_np)
         order, bounds, keys = self._split(keys)
 
+        tracer = tracing.get_tracer()
+        live = [(j, int(bounds[j]), int(bounds[j + 1]))
+                for j in range(self.n_nodes) if bounds[j] < bounds[j + 1]]
+        # The whole fan-out is one span (a new root when the caller has
+        # none, subject to the head-sampling coin): the per-node
+        # children parent on it EXPLICITLY — if the coin fails here,
+        # the nodes must not re-flip it N times and litter the buffer
+        # with unrooted single-node traces.
+        fspan = (tracer.start_span("cluster.fan_out",
+                                   attrs={"nodes": len(live),
+                                          "rows": int(n)})
+                 if tracer.enabled else tracing._NULL_SPAN)
+        fctx = fspan.context
+
         async def node_call(j: int, lo: int, hi: int):
             idx = order[lo:hi]
             sub_keys = [keys[i] for i in idx]
-            try:
-                return await call(self.nodes[j], sub_keys, counts_np[idx])
-            except Exception as exc:
-                if self._partial_failures == "raise":
-                    raise
-                log.could_not_connect_to_store(exc)
-                return None  # rows stay denied
+            # One child span per node: the fan-out share of a traced bulk
+            # call decomposes into which node was slow.
+            nspan = (tracer.start_span("cluster.node", parent=fctx,
+                                       attrs={"node": j,
+                                              "rows": int(hi - lo)})
+                     if fctx is not None else tracing._NULL_SPAN)
+            with nspan:
+                try:
+                    return await call(self.nodes[j], sub_keys,
+                                      counts_np[idx])
+                except Exception as exc:
+                    if self._partial_failures == "raise":
+                        raise
+                    nspan.set_status("degraded")
+                    log.could_not_connect_to_store(exc)
+                    return None  # rows stay denied
 
-        live = [(j, int(bounds[j]), int(bounds[j + 1]))
-                for j in range(self.n_nodes) if bounds[j] < bounds[j + 1]]
-        outs = await asyncio.gather(*(node_call(*t) for t in live))
+        with fspan:
+            outs = await asyncio.gather(*(node_call(*t) for t in live))
 
         granted = np.zeros(n, bool)
         remaining = np.zeros(n, np.float32) if with_remaining else None

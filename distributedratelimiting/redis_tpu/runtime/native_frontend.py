@@ -36,7 +36,7 @@ import numpy as np
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
-from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils import log, tracing
 from distributedratelimiting.redis_tpu.utils.metrics import (
     LatencyHistogram,
     Tier0Metrics,
@@ -261,7 +261,27 @@ class NativeFrontend:
         # under its own identity instead of poisoning its whole batch.
         keys = wire.decode_key_blob(blob.raw[:int(kb)], klens,
                                     errors="surrogateescape")
-        self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr))
+        traces = None
+        if (getattr(lib, "has_trace", False)
+                and tracing.get_tracer().enabled
+                and lib.fe_batch_traced_n(h) > 0):
+            # Trace contexts ride as parallel arrays (flag bit 0 marks
+            # traced rows) — feature-detected like fe_stage_hist, so a
+            # stale binary just serves untraced. The traced_n gate keeps
+            # the common all-untraced batch (at 1% head sampling, ~99%
+            # of them) at one C int call, no allocations.
+            tr_hi = np.zeros(n, np.uint64)
+            tr_lo = np.zeros(n, np.uint64)
+            tr_par = np.zeros(n, np.uint64)
+            tr_fl = np.zeros(n, np.uint8)
+            lib.fe_batch_traces(
+                h, tr_hi.ctypes.data_as(c.POINTER(c.c_uint64)),
+                tr_lo.ctypes.data_as(c.POINTER(c.c_uint64)),
+                tr_par.ctypes.data_as(c.POINTER(c.c_uint64)),
+                tr_fl.ctypes.data_as(c.POINTER(c.c_uint8)))
+            traces = (tr_hi, tr_lo, tr_par, tr_fl)
+        self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr,
+                                      traces))
 
     def _dispatch_passthrough(self) -> None:
         lib, h = self._lib, self._h
@@ -276,8 +296,10 @@ class NativeFrontend:
 
     async def _serve_batch(self, bid: int, keys: list[str],
                            counts: np.ndarray, ops: np.ndarray,
-                           a_arr: np.ndarray, b_arr: np.ndarray) -> None:
+                           a_arr: np.ndarray, b_arr: np.ndarray,
+                           traces=None) -> None:
         n = len(keys)
+        t_start = time.perf_counter()
         try:
             hh = getattr(self._server, "heavy_hitters", None)
             if hh is not None:
@@ -325,34 +347,54 @@ class NativeFrontend:
                     (int(u["op"]), float(u["a"]), float(u["b"]),
                      rest[np.nonzero(inverse == gi)[0]])
                     for gi, u in enumerate(uniq))
-            for op, a, b, idx in groups:
-                if idx is None:
-                    gkeys, gcounts = keys, counts
-                else:
-                    gkeys = [keys[i] for i in idx.tolist()]
-                    gcounts = counts[idx]
-                if op == _OP_BUCKET:
-                    res = await self._server.store.acquire_many(
-                        gkeys, gcounts, a, b, with_remaining=True)
-                elif op == _OP_SEMA:
-                    # Signed deltas; each row's `a` carries its permit
-                    # limit (releases wire a=0, ignored per-row).
-                    res = await self._server.store.concurrency_acquire_many(
-                        gkeys, gcounts,
-                        a_arr[idx].astype(np.int64))
-                else:
-                    res = await self._server.store.window_acquire_many(
-                        gkeys, gcounts, a, b, fixed=(op == _OP_FWINDOW),
-                        with_remaining=True)
-                g = np.asarray(res.granted, np.uint8)
-                r = (np.zeros(len(gkeys), np.float64)
-                     if res.remaining is None
-                     else np.asarray(res.remaining, np.float64))
-                if idx is None:
-                    granted, remaining = g, r
-                else:
-                    granted[idx] = g
-                    remaining[idx] = r
+            # Elected dispatch span (first traced row): the store-level
+            # profiler spans of this batch's bulk calls nest under it,
+            # so a native-lane trace decomposes like the asyncio lane's.
+            espan = tracing._NULL_SPAN
+            if traces is not None:
+                tr_hi, tr_lo, tr_par, tr_fl = traces
+                idxs = np.nonzero(tr_fl & 1)[0]
+                tracer = tracing.get_tracer()
+                if len(idxs) and tracer.enabled:
+                    i0 = int(idxs[0])
+                    espan = tracer.start_span(
+                        "fe.dispatch",
+                        parent=tracing.TraceContext(
+                            int(tr_hi[i0]), int(tr_lo[i0]),
+                            int(tr_par[i0]), 1 if tr_fl[i0] & 2 else 0),
+                        attrs={"n": n})
+            with espan:
+                for op, a, b, idx in groups:
+                    if idx is None:
+                        gkeys, gcounts = keys, counts
+                    else:
+                        gkeys = [keys[i] for i in idx.tolist()]
+                        gcounts = counts[idx]
+                    if op == _OP_BUCKET:
+                        res = await self._server.store.acquire_many(
+                            gkeys, gcounts, a, b, with_remaining=True)
+                    elif op == _OP_SEMA:
+                        # Signed deltas; each row's `a` carries its permit
+                        # limit (releases wire a=0, ignored per-row).
+                        res = await self._server.store.concurrency_acquire_many(
+                            gkeys, gcounts,
+                            a_arr[idx].astype(np.int64))
+                    else:
+                        res = await self._server.store.window_acquire_many(
+                            gkeys, gcounts, a, b,
+                            fixed=(op == _OP_FWINDOW),
+                            with_remaining=True)
+                    g = np.asarray(res.granted, np.uint8)
+                    r = (np.zeros(len(gkeys), np.float64)
+                         if res.remaining is None
+                         else np.asarray(res.remaining, np.float64))
+                    if idx is None:
+                        granted, remaining = g, r
+                    else:
+                        granted[idx] = g
+                        remaining[idx] = r
+            if traces is not None:
+                self._record_batch_spans(traces, granted, ops, t_start)
             c = ctypes
             self._lib.fe_complete(
                 self._h, bid,
@@ -362,7 +404,32 @@ class NativeFrontend:
                     c.POINTER(c.c_double)))
         except Exception as exc:  # noqa: BLE001 — every request must get
             log.error_evaluating_kernel(exc)  # a routable error reply
+            if traces is not None:
+                self._record_batch_spans(traces, None, ops, t_start)
             self._lib.fe_fail(self._h, bid, repr(exc)[:200].encode())
+
+    def _record_batch_spans(self, traces, granted, ops: np.ndarray,
+                            t_start: float) -> None:
+        """One ``fe.batch`` span per traced row of a native micro-batch,
+        parented on the row's wire context (the sampled minority — rows
+        without the trace flag cost nothing here). ``granted=None``
+        marks the whole batch errored."""
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            return
+        tr_hi, tr_lo, tr_par, tr_fl = traces
+        t_end = time.perf_counter()
+        for i in np.nonzero(tr_fl & 1)[0].tolist():
+            ctx = tracing.TraceContext(int(tr_hi[i]), int(tr_lo[i]),
+                                       int(tr_par[i]),
+                                       1 if tr_fl[i] & 2 else 0)
+            if granted is None:
+                status = "error"
+            else:
+                status = "ok" if granted[i] else "denied"
+            tracer.record_span(
+                "fe.batch", ctx, t_start, t_end, status=status,
+                attrs={"op": wire.op_name(int(ops[i]))})
 
     async def _serve_passthrough(self, conn_id: int, body: bytes) -> None:
         try:
@@ -484,6 +551,7 @@ class NativeFrontend:
         hh = getattr(self._server, "heavy_hitters", None)
         while True:
             await asyncio.sleep(cfg.sync_interval_s)
+            self._harvest_tier0_traces()
             # Everything harvested was already zeroed out of the C table:
             # from here until it is debited it exists ONLY in `merged`,
             # so every exit path — per-config failure, unexpected error,
@@ -543,6 +611,40 @@ class NativeFrontend:
                             self._t0_carry.get(ident, 0.0) + amount)
                 self._t0_record_round(recorder, round_keys,
                                       round_shortfall, round_failures)
+
+    def _harvest_tier0_traces(self) -> None:
+        """Drain the C-side ring of traced tier-0 local decisions into
+        the tracer (one completed ``fe.tier0`` span each) — this is how
+        a request that never left the epoll loop still contributes its
+        hop to the exported trace. Start/duration were stamped in C on
+        CLOCK_MONOTONIC, the same epoch ``perf_counter`` reads."""
+        lib = self._lib
+        if not getattr(lib, "has_trace", False):
+            return
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            return
+        buf = np.zeros(6 * 256, np.uint64)
+        while True:
+            got = lib.fe_trace_harvest(
+                self._h, buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint64)), 256)
+            if got <= 0:
+                return
+            recs = buf[:6 * got].reshape(got, 6)
+            for hi, lo, parent, start_ns, dur_ns, meta in recs.tolist():
+                ctx = tracing.TraceContext(
+                    int(hi), int(lo), int(parent),
+                    1 if int(meta) & 2 else 0)
+                granted = bool(int(meta) & 0x100)
+                tracer.record_span(
+                    "fe.tier0", ctx, start_ns * 1e-9,
+                    (start_ns + dur_ns) * 1e-9,
+                    status="ok" if granted else "denied",
+                    attrs={"op": wire.op_name((int(meta) >> 16) & 0xFF),
+                           "local": True})
+            if got < 256:
+                return
 
     #: Consecutive failed sync rounds that count as a degraded-mode
     #: streak and trip the flight recorder.

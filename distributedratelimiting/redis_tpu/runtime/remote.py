@@ -48,7 +48,7 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     BulkAcquireResult,
     SyncResult,
 )
-from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils import log, tracing
 from distributedratelimiting.redis_tpu.utils.tracing import Profiler, ProfilingSession
 
 __all__ = ["RemoteBucketStore"]
@@ -105,6 +105,13 @@ class RemoteBucketStore(BucketStore):
         # RedisTokenBucketRateLimiter.cs:166-174): here each profiled
         # command is one wire round-trip to the store server.
         self.profiler = Profiler(profiling_session)
+        # Distributed tracing: when the process-global tracer samples a
+        # request, the client span's context rides the frame as the
+        # version-gated trace tail (wire.py). Latched off for this
+        # connection the first time an old server answers a stamped
+        # frame with its routable "unknown op" error — the OP_METRICS
+        # compatibility posture, feature-detected instead of negotiated.
+        self._peer_traces = True
 
         # Client-side frame coalescing: concurrent single-key acquires
         # against one bucket config share ACQUIRE_MANY frames — one frame
@@ -253,15 +260,48 @@ class RemoteBucketStore(BucketStore):
 
     # -- request path (on the I/O loop) -------------------------------------
     async def _request_io(self, op: int, key: str, count: int,
-                          a: float, b: float) -> tuple:
+                          a: float, b: float,
+                          parent: "tracing.TraceContext | None" = None
+                          ) -> tuple:
         # rows=1: one wire command = one request (the permit count is the
         # command's argument, not its row count — keep units consistent
         # with the device store's per-batch rows).
-        with self.profiler.span(wire.op_name(op), 1, annotate=False):
-            return await self._request_io_unprofiled(op, key, count, a, b)
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            with self.profiler.span(wire.op_name(op), 1, annotate=False):
+                return await self._request_io_unprofiled(op, key, count,
+                                                         a, b)
+        # The trace starts HERE (the client wire layer): `parent` is the
+        # caller-side ambient context, captured before hopping onto the
+        # I/O loop where contextvars don't follow (cluster fan-out spans
+        # arrive this way).
+        span = tracer.start_span(f"client.{wire.op_name(op)}",
+                                 parent=parent)
+        with span, self.profiler.span(wire.op_name(op), 1,
+                                      annotate=False):
+            trace = span.context if self._peer_traces else None
+            try:
+                vals = await self._request_io_unprofiled(
+                    op, key, count, a, b, trace)
+            except wire.RemoteStoreError as exc:
+                if trace is not None and "unknown op" in str(exc):
+                    # Old peer: it parsed the frame far enough to route
+                    # an error but does not speak the trace tail. Latch
+                    # stamping off and retry bare — once per connection
+                    # lifetime, not per request.
+                    self._peer_traces = False
+                    span.set_attr("trace_tail", "unsupported_peer")
+                    vals = await self._request_io_unprofiled(
+                        op, key, count, a, b, None)
+                else:
+                    raise
+            if vals and vals[0] is False:
+                span.set_status("denied")
+            return vals
 
     async def _request_io_unprofiled(self, op: int, key: str, count: int,
-                                     a: float, b: float) -> tuple:
+                                     a: float, b: float,
+                                     trace=None) -> tuple:
         await self._connect_io()
         if self._writer is None or self._io_loop is None:
             raise ConnectionError("store client is closed")
@@ -273,7 +313,8 @@ class RemoteBucketStore(BucketStore):
             try:
                 wire.write_frame(
                     self._writer,
-                    wire.encode_request(seq, op, key, count, a, b),
+                    wire.encode_request(seq, op, key, count, a, b,
+                                        trace=trace),
                 )
                 # Drain only under real buffer pressure — a per-request
                 # drain await costs a task switch on a hot pipelined
@@ -296,7 +337,11 @@ class RemoteBucketStore(BucketStore):
 
     async def _request(self, op: int, key: str = "", count: int = 0,
                        a: float = 0.0, b: float = 0.0) -> tuple:
-        return await self._await_on_io(self._request_io(op, key, count, a, b))
+        # Capture the ambient trace context on the CALLER's side — the
+        # coroutine body runs on the I/O loop thread, where the caller's
+        # contextvars are invisible.
+        return await self._await_on_io(self._request_io(
+            op, key, count, a, b, tracing.current_context()))
 
     # -- bulk path (OP_ACQUIRE_MANY) ----------------------------------------
     async def _bulk_io(self, blob: bytes, offsets: np.ndarray,
@@ -304,15 +349,29 @@ class RemoteBucketStore(BucketStore):
                        spans: list[tuple[int, int]], capacity: float,
                        fill_rate: float, with_remaining: bool,
                        kind: int = wire.BULK_KIND_BUCKET,
-                       profile: bool = True) -> list[tuple]:
+                       profile: bool = True,
+                       parent: "tracing.TraceContext | None" = None
+                       ) -> list[tuple]:
         """Send every chunk of one bulk call pipelined on the connection,
         then await all replies. One wire round-trip (per ~MAX_FRAME of
         keys) carries thousands of decisions — this is what carries the
         local bulk path's throughput across the process boundary, where
         the reference paid one RTT per decision
-        (``RedisTokenBucketRateLimiter.cs:63``)."""
-        with self.profiler.span("acquire_many", len(klens),
-                                annotate=False, enabled=profile):
+        (``RedisTokenBucketRateLimiter.cs:63``).
+
+        Tracing: one ``client.acquire_many`` span covers the whole call
+        (all chunks); every chunk frame carries the span's context as
+        the bulk trace tail — old servers ignore it by construction, so
+        no latch is needed on this lane. ``parent`` is the caller-side
+        ambient context (coalesced flushes arrive with the flush span
+        ambient instead)."""
+        tracer = tracing.get_tracer()
+        tspan = (tracer.start_span("client.acquire_many", parent=parent,
+                                   attrs={"rows": int(len(klens))})
+                 if tracer.enabled else tracing._NULL_SPAN)
+        with tspan, self.profiler.span("acquire_many", len(klens),
+                                       annotate=False, enabled=profile):
+            trace = tspan.context if self._peer_traces else None
             await self._connect_io()
             if self._writer is None or self._io_loop is None:
                 raise ConnectionError("store client is closed")
@@ -330,7 +389,7 @@ class RemoteBucketStore(BucketStore):
                                 seq, blob, offsets, klens, counts_np,
                                 start, end, capacity, fill_rate,
                                 with_remaining=with_remaining, kind=kind,
-                                chained=(i > 0)))
+                                chained=(i > 0), trace=trace))
                     await self._writer.drain()
                 except Exception as exc:
                     self._drop_connection(
@@ -394,7 +453,7 @@ class RemoteBucketStore(BucketStore):
             keys, counts)
         chunks = await self._await_on_io(self._bulk_io(
             blob, offsets, klens, counts_np, spans, a, b, with_remaining,
-            kind=kind))
+            kind=kind, parent=tracing.current_context()))
         return self._bulk_assemble(chunks, with_remaining)
 
     def _bulk_call_blocking(self, keys, counts, a: float, b: float,
@@ -406,7 +465,8 @@ class RemoteBucketStore(BucketStore):
             keys, counts)
         chunks = self._submit(self._bulk_io(
             blob, offsets, klens, counts_np, spans, a, b, with_remaining,
-            kind=kind)).result(self._request_timeout_s + 1.0)
+            kind=kind, parent=tracing.current_context())).result(
+            self._request_timeout_s + 1.0)
         return self._bulk_assemble(chunks, with_remaining)
 
     async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
@@ -447,7 +507,8 @@ class RemoteBucketStore(BucketStore):
 
     def _request_blocking(self, op: int, key: str = "", count: int = 0,
                           a: float = 0.0, b: float = 0.0) -> tuple:
-        return self._submit(self._request_io(op, key, count, a, b)).result(
+        return self._submit(self._request_io(
+            op, key, count, a, b, tracing.current_context())).result(
             self._request_timeout_s + 1.0
         )
 
@@ -501,25 +562,37 @@ class RemoteBucketStore(BucketStore):
 
     async def _acquire_coalesced_io(self, key: str, count: int,
                                     capacity: float,
-                                    fill_rate_per_sec: float) -> AcquireResult:
+                                    fill_rate_per_sec: float,
+                                    parent: "tracing.TraceContext | None"
+                                    = None) -> AcquireResult:
         batcher = self._acquire_batcher(capacity, fill_rate_per_sec)
         if batcher is None:  # config cap hit: per-request framing
             granted, remaining = await self._request_io(
-                wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
+                wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec,
+                parent)
             return AcquireResult(granted, remaining)
         # Same per-command profiling contract as the per-request path —
         # the span covers submit → flush → wire round trip → fan-out (the
-        # latency this caller actually observed).
-        with self.profiler.span(wire.op_name(wire.OP_ACQUIRE), 1,
-                                annotate=False):
-            return await batcher.submit((key, count))
+        # latency this caller actually observed). The trace span opened
+        # here is what the batcher captures as the member context, so a
+        # coalesced request's trace still names its shared flush.
+        tracer = tracing.get_tracer()
+        tspan = (tracer.start_span("client.acquire", parent=parent)
+                 if tracer.enabled else tracing._NULL_SPAN)
+        with tspan, self.profiler.span(wire.op_name(wire.OP_ACQUIRE), 1,
+                                       annotate=False):
+            res = await batcher.submit((key, count))
+            if not res.granted:
+                tspan.set_status("denied")
+            return res
 
     # -- BucketStore API ----------------------------------------------------
     async def acquire(self, key: str, count: int, capacity: float,
                       fill_rate_per_sec: float) -> AcquireResult:
         if self._coalesce:
             return await self._await_on_io(self._acquire_coalesced_io(
-                key, count, capacity, fill_rate_per_sec))
+                key, count, capacity, fill_rate_per_sec,
+                tracing.current_context()))
         granted, remaining = await self._request(
             wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
         return AcquireResult(granted, remaining)
@@ -528,7 +601,8 @@ class RemoteBucketStore(BucketStore):
                          fill_rate_per_sec: float) -> AcquireResult:
         if self._coalesce:
             return self._submit(self._acquire_coalesced_io(
-                key, count, capacity, fill_rate_per_sec)).result(
+                key, count, capacity, fill_rate_per_sec,
+                tracing.current_context())).result(
                 self._request_timeout_s + 1.0)
         granted, remaining = self._request_blocking(
             wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
@@ -627,6 +701,18 @@ class RemoteBucketStore(BucketStore):
         cluster_metrics`` scrapes every node through this)."""
         (text,) = await self._request(wire.OP_METRICS)
         return text
+
+    async def traces(self, drain: bool = False) -> dict:
+        """The server's kept traces as Chrome-trace-event JSON
+        (``OP_TRACES``) — the same payload its HTTP ``/traces`` endpoint
+        serves, for consumers already on the wire. ``drain=True``
+        empties the server's buffer after export (size-capped at
+        MAX_FRAME; the newest traces win)."""
+        import json
+
+        (text,) = await self._request(wire.OP_TRACES,
+                                      count=1 if drain else 0)
+        return json.loads(text)
 
     # -- lifecycle ----------------------------------------------------------
     async def aclose(self) -> None:

@@ -27,7 +27,7 @@ import time
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
-from distributedratelimiting.redis_tpu.utils import log
+from distributedratelimiting.redis_tpu.utils import log, tracing
 from distributedratelimiting.redis_tpu.utils.flight_recorder import (
     FlightRecorder,
 )
@@ -77,7 +77,8 @@ class BucketStoreServer:
                  observability: bool = True,
                  heavy_hitters_k: int = 64,
                  flight_dir: str | None = None,
-                 flight_capacity: int = 512) -> None:
+                 flight_capacity: int = 512,
+                 tracing_config: "bool | dict | None" = None) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -145,6 +146,17 @@ class BucketStoreServer:
         self.metrics_port = metrics_port
         self._metrics_server: asyncio.AbstractServer | None = None
         self._registry: MetricsRegistry | None = None
+        # Distributed tracing rides the PROCESS-global tracer (every
+        # layer — client, batcher, store, native pump — references it at
+        # call time): True enables with defaults, a dict passes knobs
+        # through (sample_rate, latency_threshold_s, …), None leaves
+        # whatever the process already configured.
+        if tracing_config is not None:
+            if isinstance(tracing_config, dict):
+                tracing.configure(**tracing_config)
+            else:
+                tracing.configure(enabled=bool(tracing_config))
+        self.tracer = tracing.get_tracer()
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -216,27 +228,54 @@ class BucketStoreServer:
         self.metrics_port = (
             self._metrics_server.sockets[0].getsockname()[1])
 
+    #: Content type served when the scraper did NOT Accept openmetrics:
+    #: the Prometheus text 0.0.4 format (same sample lines, exemplar
+    #: annotations suppressed — they are an OpenMetrics-only construct).
+    PLAIN_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
     async def _serve_metrics_http(self, reader: asyncio.StreamReader,
                                   writer: asyncio.StreamWriter) -> None:
         """Minimal one-shot HTTP/1.1 responder: GET /metrics → the
-        OpenMetrics exposition; GET /flight → explicit flight-recorder
-        dump (returns the path). Anything fancier belongs in a real
-        scraper-side proxy — this exists so ``curl``/Prometheus can reach
-        the plane with zero dependencies."""
+        exposition (content negotiated on ``Accept:`` — scrapers asking
+        for ``application/openmetrics-text`` get the full OpenMetrics
+        answer with exemplars; everyone else gets Prometheus text
+        0.0.4); GET /flight → explicit flight-recorder dump (returns the
+        path); GET /traces → Chrome-trace-event JSON of the kept traces
+        (``?drain=1`` empties the buffer), loadable in Perfetto.
+        Anything fancier belongs in a real scraper-side proxy — this
+        exists so ``curl``/Prometheus can reach the plane with zero
+        dependencies."""
         import json
 
         try:
             request_line = await asyncio.wait_for(reader.readline(), 10.0)
             parts = request_line.split()
             path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
-            while True:  # drain headers; no bodies on GET
+            accept = ""
+            while True:  # drain headers (Accept drives negotiation)
                 line = await asyncio.wait_for(reader.readline(), 10.0)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            route = path.split("?", 1)[0]
+                if line[:7].lower() == b"accept:":
+                    accept = line[7:].decode("latin-1", "replace").strip()
+            route, _, query = path.partition("?")
             if route in ("/metrics", "/"):
-                body = self.registry.render().encode("utf-8")
-                status, ctype = "200 OK", MetricsRegistry.CONTENT_TYPE
+                openmetrics = "application/openmetrics-text" in accept
+                body = self.registry.render(
+                    exemplars=openmetrics).encode("utf-8")
+                status = "200 OK"
+                ctype = (MetricsRegistry.CONTENT_TYPE if openmetrics
+                         else self.PLAIN_CONTENT_TYPE)
+            elif route == "/traces":
+                from urllib.parse import parse_qs
+
+                # Proper param parse: the drain is destructive, so a
+                # substring match (?nodrain=1, ?drain=10) must not
+                # trigger it.
+                drain = parse_qs(query).get("drain", ["0"])[-1] == "1"
+                body = self.tracer.export_chrome_json(
+                    drain=drain).encode("utf-8")
+                status, ctype = "200 OK", "application/json"
             elif route == "/flight" and self.flight_recorder is not None:
                 # Rate-limited on purpose: the metrics listener carries
                 # no auth (unlike the wire's OP_STATS trigger behind
@@ -354,6 +393,12 @@ class BucketStoreServer:
                 self.flight_recorder.snapshot,
                 counters={"frames_recorded", "dumps_written",
                           "dumps_suppressed"})
+        reg.register_numeric_dict(
+            "trace", "distributed tracer",
+            lambda: (self.tracer.snapshot()
+                     if self.tracer.enabled else None),
+            counters={"spans_recorded", "traces_kept", "traces_dropped",
+                      "traces_evicted"})
         return reg
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
@@ -488,7 +533,59 @@ class BucketStoreServer:
         front-end's passthrough lane (runtime/native_frontend.py). Store
         and decode failures come back as routable RESP_ERROR frames, never
         as raises (except cancellation), so one bad request can never take
-        a connection down with it."""
+        a connection down with it.
+
+        Trace-stamped frames (scalar op flag / bulk flags bit 4, see
+        wire.py) are stripped here and served inside a ``server.<op>``
+        span parented on the client's wire context; the span's status is
+        sniffed from the encoded reply (denied decision / error), which
+        is what lets the tail sampler keep every denied request's trace.
+        """
+        tctx = None
+        if len(body) >= 6:
+            if body[5] & wire.TRACE_FLAG:
+                try:
+                    body, tctx = wire.strip_trace(body)
+                except wire.RemoteStoreError as exc:
+                    return wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR, repr(exc))
+            elif body[5] == wire.OP_ACQUIRE_MANY:
+                tctx = wire.bulk_trace_tail(body)
+        if tctx is None or not self.tracer.enabled:
+            return await self._handle_frame_inner(body)
+        op = body[5] if len(body) >= 6 else 0
+        with self.tracer.start_span(
+                f"server.{wire.op_name(op)}", parent=tctx) as span:
+            resp = await self._handle_frame_inner(body)
+            kind = resp[9] if len(resp) >= 10 else 0
+            if kind == wire.RESP_ERROR:
+                span.set_status("error")
+            elif (kind == wire.RESP_DECISION and len(resp) >= 11
+                    and resp[10] == 0):
+                span.set_status("denied")
+            elif kind == wire.RESP_BULK and len(resp) >= 15:
+                # Bulk reply: [u8 flags][u32 n][granted bits…] at offset
+                # 10. Any denied row marks the span — the coalesced
+                # lane's denials must reach the tail sampler too (the
+                # traced minority pays this popcount, nobody else).
+                n = int.from_bytes(resp[11:15], "little")
+                nbits = (n + 7) // 8
+                granted = sum(bin(b).count("1")
+                              for b in resp[15:15 + nbits])
+                if granted < n:
+                    span.set_status("denied")
+                    span.set_attr("denied_rows", n - granted)
+            if span.context is not None:
+                # Exemplar on the serving histogram: the span's own
+                # duration IS (within µs) the serving stage for this
+                # request — the jump from a histogram bucket to the
+                # exported trace that filled it.
+                self.serving_latency.exemplar(
+                    time.perf_counter() - span.start_s,
+                    span.context.trace_id)
+        return resp
+
+    async def _handle_frame_inner(self, body: bytes) -> bytes:
         seq = _recover_seq(body)
         try:
             if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
@@ -609,6 +706,13 @@ class BucketStoreServer:
             elif op == wire.OP_METRICS:
                 resp = wire.encode_response(
                     seq, wire.RESP_TEXT, self.registry.render())
+            elif op == wire.OP_TRACES:
+                # Chrome-trace JSON capped under MAX_FRAME (newest traces
+                # win); flag bit 0 drains the buffer after export.
+                resp = wire.encode_response(
+                    seq, wire.RESP_TEXT, self.tracer.export_chrome_json(
+                        max_bytes=wire.MAX_FRAME - 256,
+                        drain=bool(count & 1)))
             else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
@@ -675,6 +779,8 @@ class BucketStoreServer:
             payload["hot_keys"] = self.heavy_hitters.snapshot()
         if self.flight_recorder is not None:
             payload["flight_recorder"] = self.flight_recorder.snapshot()
+        if self.tracer.enabled:
+            payload["tracing"] = self.tracer.snapshot()
         return json.dumps(payload)
 
     async def aclose(self) -> None:
@@ -797,6 +903,25 @@ def main(argv: list[str] | None = None) -> None:
                         help="disable the observability plane (heavy-"
                         "hitter telemetry + flight recorder); stage "
                         "latency stamps and OP_STATS remain")
+    parser.add_argument("--trace", action="store_true",
+                        help="enable distributed tracing: sampled "
+                        "requests record span trees across every hop "
+                        "(wire, dispatch, batcher, kernel launch, "
+                        "tier-0), exported as Perfetto-loadable JSON on "
+                        "GET /traces and the OP_TRACES wire op "
+                        "(docs/OPERATIONS.md §6)")
+    parser.add_argument("--trace-sample", type=float, default=0.01,
+                        help="head-sampling rate: fraction of new "
+                        "traces recorded at all (non-sampled requests "
+                        "take the allocation-free null path)")
+    parser.add_argument("--trace-latency-ms", type=float, default=50.0,
+                        help="tail-sampling latency threshold: recorded "
+                        "traces with any span at/above this are always "
+                        "kept (denied/queued/error/degraded always keep "
+                        "regardless)")
+    parser.add_argument("--trace-buffer", type=int, default=256,
+                        help="bounded in-memory kept-trace buffer "
+                        "(oldest evicted first)")
     args = parser.parse_args(argv)
     if args.fe_tier0 and not args.native_frontend:
         parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
@@ -861,7 +986,14 @@ def main(argv: list[str] | None = None) -> None:
                                    native_tier0=native_tier0,
                                    metrics_port=args.metrics_port,
                                    observability=not args.no_observability,
-                                   flight_dir=args.flight_dir)
+                                   flight_dir=args.flight_dir,
+                                   tracing_config={
+                                       "enabled": True,
+                                       "sample_rate": args.trace_sample,
+                                       "latency_threshold_s":
+                                           args.trace_latency_ms / 1e3,
+                                       "max_traces": args.trace_buffer,
+                                   } if args.trace else None)
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         if server.metrics_port is not None:

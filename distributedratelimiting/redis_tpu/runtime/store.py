@@ -1347,19 +1347,23 @@ class DeviceBucketStore(BucketStore):
             self._connected = True
 
     def _flush_observer(self, n: int, wall_s: float,
-                        error: str | None) -> None:
+                        error: str | None,
+                        trace_id: str | None = None) -> None:
         """Per-flush flight-recorder feed (MicroBatcher ``flush_observer``).
         One attribute check per flush when no recorder is attached; a
         flush FAILURE is the store's degraded-mode entry, so it also
         fires a rate-limited auto-dump — the outage window's lead-in
-        frames land on disk while they still exist."""
+        frames land on disk while they still exist. ``trace_id`` (the
+        flush's elected trace, when any member was sampled) stamps the
+        frame so a flight dump cross-references its exported trace."""
         rec = self.metrics.flight_recorder
         if rec is None:
             return
         rec.record("flush", n=n, wall_ms=round(wall_s * 1e3, 3),
-                   error=error)
+                   error=error, trace_id=trace_id)
         if error is not None:
-            rec.auto_dump("flush_error", {"error": error})
+            rec.auto_dump("flush_error", {"error": error,
+                                          "trace_id": trace_id})
 
     def now_ticks_checked(self) -> int:
         """Read the store clock; rebase every table's epoch before int32

@@ -79,6 +79,19 @@ frame's meaning, so an old server answers with a routable error rather
 than a misread. Semantic changes to an existing frame always bump the
 version: a silent misread loses decisions, the strict version check
 fails loudly instead.
+
+Trace context (within v4, same compatibility posture as OP_METRICS):
+a sampled request may carry a 25-byte trace tail —
+``[u64 trace_hi][u64 trace_lo][u64 parent span][u8 flags]`` — appended
+after the payload. Scalar keyed frames signal it with :data:`TRACE_FLAG`
+(bit 7) on the op byte; ACQUIRE_MANY signals it with flags bit 4. An
+old peer stays safe on BOTH lanes: a flagged scalar op decodes as
+"unknown op 129" — a routable error, never a misparse (clients latch
+off stamping and retry bare on seeing it) — while an old bulk decoder
+reads its arrays by explicit counts and simply never looks at the tail,
+so traced bulk frames interoperate unchanged. OP_TRACES (Chrome-trace
+JSON export) is a new op on the existing layout, routable-error on old
+servers like OP_METRICS.
 """
 
 from __future__ import annotations
@@ -87,10 +100,14 @@ import struct
 
 import numpy as np
 
+from distributedratelimiting.redis_tpu.utils.tracing import TraceContext
+
 __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
-    "OP_ACQUIRE_MANY", "OP_METRICS",
+    "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
+    "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
+    "strip_trace", "bulk_trace_tail",
     "STATS_FLAG_RESET", "STATS_FLAG_FLIGHT_DUMP",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_BULK", "RESP_ERROR",
@@ -119,6 +136,19 @@ OP_ACQUIRE_MANY = 11  # bulk acquire: n keys' decisions in one frame
 OP_METRICS = 12  # OpenMetrics text exposition (RESP_TEXT reply). A new
 # op on the existing frame layout needs no version bump: an older server
 # answers it with a routable unknown-op error, never a misparse.
+OP_TRACES = 13  # Chrome-trace-event JSON export of the server's kept
+# traces (RESP_TEXT reply); optional one-byte flag: bit 0 drains the
+# buffer after export. Same compatibility posture as OP_METRICS.
+
+#: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
+#: payload. Only sampled requests carry it; an old server answers the
+#: flagged op with a routable "unknown op" error (clients latch off).
+TRACE_FLAG = 0x80
+_TRACE_TAIL = struct.Struct("<QQQB")  # trace_hi, trace_lo, span_id, flags
+TRACE_TAIL_LEN = _TRACE_TAIL.size
+#: ACQUIRE_MANY flags bit 4: the same 25-byte tail follows the counts
+#: array. Old bulk decoders read by explicit counts and ignore the tail.
+BULK_FLAG_TRACED = 0b10000
 
 #: OP_STATS flag bits (the optional one-byte payload): bit 0 resets the
 #: serving/stage latency windows after the snapshot; bit 1 asks the
@@ -140,6 +170,7 @@ _OP_NAMES = {
     OP_HELLO: "hello",
     OP_ACQUIRE_MANY: "acquire_many",
     OP_METRICS: "metrics",
+    OP_TRACES: "traces",
 }
 
 
@@ -216,24 +247,50 @@ def _codepoint_truncate(mb: bytes, limit: int) -> bytes:
 
 
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
-                   a: float = 0.0, b: float = 0.0) -> bytes:
+                   a: float = 0.0, b: float = 0.0,
+                   trace=None) -> bytes:
     if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
         payload = _keyed(key, _F64x2.pack(a, b))
     elif op == OP_HELLO:
         payload = _keyed(key, b"")  # key carries the auth token
-    elif op == OP_STATS:
-        # Optional one-byte flag bitmask (STATS_FLAG_*): bit 0 resets the
-        # serving/stage latency windows after snapshotting (steady-state
-        # measurement), bit 1 triggers a flight-recorder dump. Absent
-        # byte = plain snapshot.
+    elif op in (OP_STATS, OP_TRACES):
+        # Optional one-byte flag bitmask. STATS (STATS_FLAG_*): bit 0
+        # resets the serving/stage latency windows after snapshotting
+        # (steady-state measurement), bit 1 triggers a flight-recorder
+        # dump. TRACES: bit 0 drains the trace buffer after export.
+        # Absent byte = plain snapshot/export.
         payload = bytes([count & 0xFF]) if count else b""
     elif op in (OP_PING, OP_SAVE, OP_METRICS):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
+    if trace is not None:
+        # Sampled request: append the 25-byte trace tail and set the
+        # op-byte flag. Untraced frames stay byte-identical to plain v4.
+        op |= TRACE_FLAG
+        payload += _TRACE_TAIL.pack(trace[0], trace[1], trace[2],
+                                    trace[3] & 0xFF)
     return _HDR.pack(_BODY_OFF + len(payload), PROTOCOL_VERSION, seq, op) + payload
+
+
+def strip_trace(body: bytes):
+    """Split a scalar frame body's trace tail: returns ``(plain_body,
+    TraceContext | None)`` where ``plain_body`` is byte-identical to the
+    frame an untraced peer would have sent (op flag cleared, tail
+    removed). The server calls this BEFORE :func:`decode_request`, which
+    stays strict — on an old server the flagged op raises the routable
+    "unknown op" error instead (never a misparse)."""
+    if len(body) < _BODY_OFF or not body[5] & TRACE_FLAG:
+        return body, None
+    if len(body) < _BODY_OFF + TRACE_TAIL_LEN:
+        raise RemoteStoreError("truncated trace tail")
+    hi, lo, span, flags = _TRACE_TAIL.unpack_from(body,
+                                                  len(body) - TRACE_TAIL_LEN)
+    plain = (body[:5] + bytes([body[5] & ~TRACE_FLAG])
+             + body[_BODY_OFF:len(body) - TRACE_TAIL_LEN])
+    return plain, TraceContext(hi, lo, span, flags)
 
 
 def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
@@ -252,7 +309,7 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
     if op == OP_HELLO:
         token, _ = _split_key(body)
         return seq, op, token, 0, 0.0, 0.0
-    if op == OP_STATS:
+    if op in (OP_STATS, OP_TRACES):
         return seq, op, "", (body[0] if body else 0), 0.0, 0.0
     if op in (OP_PING, OP_SAVE, OP_METRICS):
         return seq, op, "", 0, 0.0, 0.0
@@ -373,7 +430,8 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         fill_rate: float, *,
                         with_remaining: bool = True,
                         kind: int = BULK_KIND_BUCKET,
-                        chained: bool = False) -> bytes:
+                        chained: bool = False,
+                        trace=None) -> bytes:
     """Encode one ACQUIRE_MANY frame from per-key byte blobs. A thin
     wrapper over :func:`encode_bulk_request_span` (ONE definition of the
     frame layout — the two entry points must stay wire-identical);
@@ -386,7 +444,8 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
     return encode_bulk_request_span(
         seq, b"".join(key_blobs), offsets, klens,
         np.asarray(counts, np.uint32), 0, n, capacity, fill_rate,
-        with_remaining=with_remaining, kind=kind, chained=chained)
+        with_remaining=with_remaining, kind=kind, chained=chained,
+        trace=trace)
 
 
 def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
@@ -395,7 +454,8 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
                              fill_rate: float, *,
                              with_remaining: bool = True,
                              kind: int = BULK_KIND_BUCKET,
-                             chained: bool = False) -> bytes:
+                             chained: bool = False,
+                             trace=None) -> bytes:
     """Encode one ACQUIRE_MANY chunk by SLICING a whole-call key blob —
     the client-side half of the zero-copy lane. ``_bulk_prepare`` joins
     and encodes the call's keys once; each chunk's payload is then two
@@ -410,13 +470,20 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
         raise ValueError(f"unknown bulk kind {kind}")
     flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
              | (kind << _KIND_SHIFT)
-             | (_FLAG_CHAINED if chained else 0))
-    payload = b"".join((
+             | (_FLAG_CHAINED if chained else 0)
+             | (BULK_FLAG_TRACED if trace is not None else 0))
+    parts = [
         _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
         kl.astype("<u2").tobytes(),
         blob[offsets[start]:offsets[end]],
         np.asarray(counts[start:end], "<u4").tobytes(),
-    ))
+    ]
+    if trace is not None:
+        # The trace tail rides AFTER the arrays: an old decoder reads
+        # them by explicit counts and never touches it.
+        parts.append(_TRACE_TAIL.pack(trace[0], trace[1], trace[2],
+                                      trace[3] & 0xFF))
+    payload = b"".join(parts)
     length = _BODY_OFF + len(payload)
     if length > MAX_FRAME:
         raise ValueError(
@@ -470,6 +537,20 @@ def bulk_request_chained(body: bytes) -> bool:
     cheaper than a full decode). A truncated frame reads unchained; the
     full decode raises the routable error for it."""
     return len(body) > _BODY_OFF and bool(body[_BODY_OFF] & _FLAG_CHAINED)
+
+
+def bulk_trace_tail(body: bytes) -> "TraceContext | None":
+    """Read an ACQUIRE_MANY frame body's trace tail (flags bit 4), or
+    ``None`` when absent. The tail sits at the very end of the payload;
+    :func:`decode_bulk_request` reads its arrays by explicit counts, so
+    the same frame decodes identically with the tail present — the
+    old-peer compatibility property the fuzz tests pin down."""
+    if (len(body) <= _BODY_OFF + TRACE_TAIL_LEN
+            or not body[_BODY_OFF] & BULK_FLAG_TRACED):
+        return None
+    hi, lo, span, flags = _TRACE_TAIL.unpack_from(body,
+                                                  len(body) - TRACE_TAIL_LEN)
+    return TraceContext(hi, lo, span, flags)
 
 
 class KeyBlob:

@@ -20,6 +20,7 @@ it between scrapes.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Mapping
 
@@ -32,7 +33,13 @@ class LatencyHistogram:
     was too coarse exactly where the <2ms p99 north star lives (the
     0.5-16ms decade spans ~15 buckets now vs ~10 before at twice the
     width; VERDICT r4 weak #2). Still O(1) memory and allocation-free
-    recording."""
+    recording.
+
+    Exemplars: ``record(seconds, trace_id=...)`` (or :meth:`exemplar`)
+    attaches the most recent trace id observed per bucket, rendered as
+    OpenMetrics exemplars on the ``_bucket`` series — the jump-off from
+    "the p99 moved" to the exact exported trace that moved it. Lazy: a
+    histogram that never sees a trace id allocates nothing extra."""
 
     BASE = 1.25
     MIN_S = 1e-6
@@ -42,6 +49,9 @@ class LatencyHistogram:
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
         self.sum_s = 0.0  # running sum → OpenMetrics _sum / mean
+        # bucket idx -> (trace_id, observed value, unix ts); None until
+        # the first traced observation.
+        self.exemplars: dict[int, tuple[str, float, float]] | None = None
 
     def reset(self) -> None:
         """Zero in place. Holders keep their reference (the MicroBatcher
@@ -50,18 +60,34 @@ class LatencyHistogram:
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
         self.sum_s = 0.0
+        self.exemplars = None
 
-    def record(self, seconds: float) -> None:
+    def _bucket_index(self, seconds: float) -> int:
         if seconds <= self.MIN_S:
-            idx = 0
-        else:
-            idx = min(
-                self.N_BUCKETS - 1,
-                int(math.log(seconds / self.MIN_S, self.BASE)) + 1,
-            )
+            return 0
+        return min(
+            self.N_BUCKETS - 1,
+            int(math.log(seconds / self.MIN_S, self.BASE)) + 1,
+        )
+
+    def record(self, seconds: float, trace_id: str | None = None) -> None:
+        idx = self._bucket_index(seconds)
         self.counts[idx] += 1
         self.total += 1
         self.sum_s += seconds
+        if trace_id is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[idx] = (trace_id, seconds, time.time())
+
+    def exemplar(self, seconds: float, trace_id: str) -> None:
+        """Attach an exemplar WITHOUT counting a sample — for callers
+        whose sample is recorded elsewhere with a marginally different
+        measurement of the same request (the server's serving span)."""
+        if self.exemplars is None:
+            self.exemplars = {}
+        self.exemplars[self._bucket_index(seconds)] = (
+            trace_id, seconds, time.time())
 
     @classmethod
     def bucket_upper_bounds(cls) -> list[float]:
@@ -391,8 +417,12 @@ class MetricsRegistry:
     CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
                     "charset=utf-8")
 
-    def render(self) -> str:
-        """The full OpenMetrics text exposition, terminated by ``# EOF``."""
+    def render(self, exemplars: bool = True) -> str:
+        """The full OpenMetrics text exposition, terminated by ``# EOF``.
+        ``exemplars=False`` suppresses exemplar annotations — for the
+        Prometheus text-0.0.4 fallback the HTTP listener serves to
+        scrapers that did not ``Accept`` openmetrics (exemplars are an
+        OpenMetrics-only construct)."""
         lines: list[str] = []
         seen_type: set[str] = set()
 
@@ -437,7 +467,8 @@ class MetricsRegistry:
             elif mtype == "histogram":
                 if value is None:
                     continue
-                self._render_histogram(lines, full, labels, value)
+                self._render_histogram(lines, full, labels, value,
+                                       exemplars)
             else:
                 if value is None:
                     continue
@@ -449,17 +480,26 @@ class MetricsRegistry:
 
     @staticmethod
     def _render_histogram(lines: list[str], full: str, labels: dict,
-                          hist: LatencyHistogram) -> None:
+                          hist: LatencyHistogram,
+                          exemplars: bool = True) -> None:
         bounds = hist.bucket_upper_bounds()
+        ex = hist.exemplars if exemplars else None
         cum = 0
         for i, c in enumerate(hist.counts):
             cum += c
-            if c == 0 and i < len(hist.counts) - 1:
+            if (c == 0 and i < len(hist.counts) - 1
+                    and (ex is None or i not in ex)):
                 continue  # sparse: only emit buckets that move the cdf
             le = ("+Inf" if i == len(hist.counts) - 1
                   else repr(bounds[i]))
             lbl = _format_labels({**labels, "le": le})
-            lines.append(f"{full}_bucket{lbl} {cum}")
+            line = f"{full}_bucket{lbl} {cum}"
+            if ex is not None and i in ex:
+                # OpenMetrics exemplar: `value # {labels} ex_value ex_ts`
+                tid, val, ts = ex[i]
+                line += (f' # {{trace_id="{_escape_label(tid)}"}} '
+                         f"{_format_value(val)} {round(ts, 3)}")
+            lines.append(line)
         lbl = _format_labels(labels)
         lines.append(f"{full}_count{lbl} {hist.total}")
         lines.append(f"{full}_sum{lbl} {_format_value(hist.sum_s)}")
@@ -470,13 +510,19 @@ def parse_openmetrics(text: str) -> tuple[dict[str, str],
     """Minimal OpenMetrics parser for aggregation: returns
     ``(types_by_name, samples)`` where each sample is
     ``(sample_name, ((label, value), ...), float)``. Handles the subset
-    :class:`MetricsRegistry` emits (no exemplars, no timestamps)."""
+    :class:`MetricsRegistry` emits (exemplar annotations are stripped;
+    timestamps are not emitted)."""
     types: dict[str, str] = {}
     samples: list[tuple[str, tuple, float]] = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line == "# EOF":
             continue
+        # Exemplars ride after ` # {...}` on bucket lines — aggregation
+        # sums sample values, so they drop here (quote-aware: a label
+        # VALUE may legitimately contain " # ").
+        if not line.startswith("#"):
+            line = _strip_exemplar(line)
         if line.startswith("#"):
             parts = line.split(None, 3)
             if len(parts) >= 4 and parts[1] == "TYPE":
@@ -498,6 +544,34 @@ def parse_openmetrics(text: str) -> tuple[dict[str, str],
         except ValueError:
             continue
     return types, samples
+
+
+def _strip_exemplar(line: str) -> str:
+    """Drop a sample line's exemplar annotation (`` # {...} val ts``).
+    The split must happen AFTER the label set's closing brace — label
+    values are user-controlled (hot keys) and may contain ``\" # \"``
+    themselves — so the label block is skipped with the same
+    quote/escape rules :func:`_split_labels` uses."""
+    start = 0
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        in_q = esc = False
+        for i in range(brace + 1, len(line)):
+            ch = line[i]
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_q = not in_q
+            elif ch == "}" and not in_q:
+                start = i + 1
+                break
+        else:
+            return line  # unterminated label set: leave as-is
+    cut = line.find(" # ", start)
+    return line[:cut].rstrip() if cut != -1 else line
 
 
 def _split_labels(text: str) -> list[str]:

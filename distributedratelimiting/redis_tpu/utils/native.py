@@ -245,6 +245,20 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.has_stage_hist = True
     except AttributeError:  # stale binary without the stage-hist ABI
         lib.has_stage_hist = False
+    try:
+        lib.fe_batch_traced_n.argtypes = [c.c_void_p]
+        lib.fe_batch_traced_n.restype = c.c_int
+        lib.fe_batch_traces.argtypes = [c.c_void_p, c.POINTER(c.c_uint64),
+                                        c.POINTER(c.c_uint64),
+                                        c.POINTER(c.c_uint64),
+                                        c.POINTER(c.c_uint8)]
+        lib.fe_batch_traces.restype = None
+        lib.fe_trace_harvest.argtypes = [c.c_void_p,
+                                         c.POINTER(c.c_uint64), c.c_int]
+        lib.fe_trace_harvest.restype = c.c_int
+        lib.has_trace = True
+    except AttributeError:  # stale binary without the trace ABI
+        lib.has_trace = False
     lib.fe_stop.argtypes = [c.c_void_p]
     lib.fe_stop.restype = None
     lib.fe_free.argtypes = [c.c_void_p]

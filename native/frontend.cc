@@ -71,6 +71,14 @@ constexpr uint8_t OP_SEMA = 8;  // signed count: +acquire / -release / 0 probe
 constexpr uint8_t OP_FWINDOW = 9;
 constexpr uint8_t OP_HELLO = 10;
 
+// Op-byte bit 7 (wire.py TRACE_FLAG): a 25-byte trace tail —
+// [u64 trace_hi][u64 trace_lo][u64 parent span][u8 flags] — follows the
+// payload. Only sampled requests carry it; parsing it here keeps traced
+// hot frames on the batch/tier-0 fast lanes instead of demoting them to
+// passthrough.
+constexpr uint8_t TRACE_FLAG = 0x80;
+constexpr size_t kTraceTail = 25;
+
 constexpr uint8_t RESP_DECISION = 64;
 constexpr uint8_t RESP_EMPTY = 67;
 constexpr uint8_t RESP_ERROR = 127;
@@ -157,6 +165,11 @@ struct Item {
   double a, b;
   std::string key;
   uint64_t t_ns;  // arrival (frame fully parsed) — serving latency start
+  // Trace context (all zero when the frame carried no tail). tr_flags
+  // bit 0 = traced-present, bit 1 = wire sampled flag — the layout
+  // fe_batch_traces hands to Python.
+  uint64_t tr_hi = 0, tr_lo = 0, tr_parent = 0;
+  uint8_t tr_flags = 0;
 };
 
 struct Batch {
@@ -168,6 +181,14 @@ struct Batch {
 struct Passthrough {
   uint64_t conn_id;
   std::string frame;  // full body: [ver][seq][op][payload]
+};
+
+// One traced C-local decision, exported to Python as six u64s:
+// hi, lo, parent, start_ns (CLOCK_MONOTONIC — the same epoch Python's
+// perf_counter reads), dur_ns, meta (bits 0-7 wire flags, bit 8
+// granted, bits 16-23 op).
+struct TraceRec {
+  uint64_t hi, lo, parent, start_ns, dur_ns, meta;
 };
 
 struct Conn {
@@ -295,7 +316,34 @@ struct Frontend {
   int64_t t0_misses = 0;        // eligible requests that fell through
   int64_t t0_installs = 0;
   int64_t t0_evictions = 0;
+
+  // Completed-span records for traced requests decided entirely in C
+  // (tier-0 local grant/deny): Python's sync pump harvests these via
+  // fe_trace_harvest and emits them as spans, so locally-granted
+  // requests still leave a trace. Bounded; overflow drops oldest.
+  std::deque<TraceRec> trace_ring;
+  int64_t trace_dropped = 0;
 };
+
+constexpr size_t kTraceRing = 1024;
+
+void trace_ring_push(Frontend* fe, const Item& it, bool granted,
+                     uint64_t end_ns) {
+  // mu held.
+  if (fe->trace_ring.size() >= kTraceRing) {
+    fe->trace_ring.pop_front();
+    fe->trace_dropped++;
+  }
+  TraceRec r;
+  r.hi = it.tr_hi;
+  r.lo = it.tr_lo;
+  r.parent = it.tr_parent;
+  r.start_ns = it.t_ns;
+  r.dur_ns = end_ns - it.t_ns;
+  r.meta = uint64_t(it.tr_flags) | (granted ? 0x100u : 0u) |
+           (uint64_t(it.op) << 16);
+  fe->trace_ring.push_back(r);
+}
 
 T0Entry* t0_find(Frontend* fe, const std::string& key, double cap,
                  double rate) {
@@ -603,7 +651,12 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
                                 // store state for a dying connection
   uint8_t ver = body[0];
   uint32_t seq = rd_u32(body + 1);
-  uint8_t op = body[5];
+  uint8_t rawop = body[5];
+  // The trace flag gates a 25-byte tail after the payload; the base op
+  // routes. Non-hot flagged ops fall to the passthrough default with
+  // the ORIGINAL body — Python's wire module strips the tail there.
+  bool traced = (rawop & TRACE_FLAG) != 0;
+  uint8_t op = rawop & uint8_t(~TRACE_FLAG);
   if (ver != kVersion) {
     std::string err = encode_error(seq, "protocol version mismatch");
     send_to_conn(fe, c, err.data(), err.size());
@@ -637,14 +690,15 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
       case OP_WINDOW:
       case OP_FWINDOW:
       case OP_SEMA: {
-        // [u16 klen][key utf-8][i32 count][f64 a][f64 b]
-        if (len < kBodyOff + 2 + 20) {
+        // [u16 klen][key utf-8][i32 count][f64 a][f64 b] (+ trace tail)
+        size_t tail = traced ? kTraceTail : 0;
+        if (len < kBodyOff + 2 + 20 + tail) {
           std::string err = encode_error(seq, "truncated request");
           send_to_conn(fe, c, err.data(), err.size());
           return false;
         }
         uint16_t klen = rd_u16(body + kBodyOff);
-        if (len != kBodyOff + 2 + size_t(klen) + 20) {
+        if (len != kBodyOff + 2 + size_t(klen) + 20 + tail) {
           std::string err = encode_error(seq, "malformed request");
           send_to_conn(fe, c, err.data(), err.size());
           return false;
@@ -659,16 +713,27 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         it.a = rd_f64(kp + klen + 4);
         it.b = rd_f64(kp + klen + 12);
         it.t_ns = now_ns();
+        if (traced) {
+          const uint8_t* tp = body + len - kTraceTail;
+          std::memcpy(&it.tr_hi, tp, 8);
+          std::memcpy(&it.tr_lo, tp + 8, 8);
+          std::memcpy(&it.tr_parent, tp + 16, 8);
+          it.tr_flags = uint8_t(1 | (tp[24] & 1) << 1);
+        }
         if (op == OP_ACQUIRE && fe->t0.enabled && it.count > 0) {
           // Tier-0: answer from the local replica when it is confident
           // either way; zero-permit probes and every other op keep the
-          // exact device path.
+          // exact device path. A traced local decision leaves a span
+          // record for the Python harvest — locally-granted requests
+          // still trace.
           double rem = 0.0;
           int verdict = t0_decide(fe, it.key, it.count, it.a, it.b, &rem);
           if (verdict >= 0) {
             std::string resp = encode_decision(seq, verdict == 1, rem);
             queue_to_conn(c, resp.data(), resp.size());
-            hist_record(fe, double(now_ns() - it.t_ns) * 1e-9);
+            uint64_t t_end = now_ns();
+            if (traced) trace_ring_push(fe, it, verdict == 1, t_end);
+            hist_record(fe, double(t_end - it.t_ns) * 1e-9);
             fe->requests_served++;
             break;
           }
@@ -996,6 +1061,59 @@ void fe_batch_copy(void* h, char* key_blob, int32_t* klens, int32_t* counts,
     bs[i] = item.b;
     i++;
   }
+}
+
+// Count the current batch's traced rows — the one-int gate the pump
+// checks before paying fe_batch_traces' array allocations (at 1% head
+// sampling ~99% of batches carry none).
+int fe_batch_traced_n(void* h) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  auto it = fe->inflight.find(fe->cur_batch_id);
+  if (it == fe->inflight.end()) return 0;
+  int n = 0;
+  for (const Item& item : it->second.items) n += item.tr_flags & 1;
+  return n;
+}
+
+// Copy the current batch's trace contexts as parallel arrays (zeros /
+// flag bit 0 clear for untraced rows). Same contract as fe_batch_copy:
+// call between fe_wait returning 1 and fe_complete/fe_fail.
+void fe_batch_traces(void* h, uint64_t* hi, uint64_t* lo, uint64_t* parent,
+                     uint8_t* flags) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  auto it = fe->inflight.find(fe->cur_batch_id);
+  if (it == fe->inflight.end()) return;
+  size_t i = 0;
+  for (const Item& item : it->second.items) {
+    hi[i] = item.tr_hi;
+    lo[i] = item.tr_lo;
+    parent[i] = item.tr_parent;
+    flags[i] = item.tr_flags;
+    i++;
+  }
+}
+
+// Drain up to `max` traced tier-0 local decisions (6 u64 each: hi, lo,
+// parent, start_ns, dur_ns, meta). Returns the record count.
+int fe_trace_harvest(void* h, uint64_t* out, int max) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<std::mutex> lk(fe->mu);
+  int n = 0;
+  while (n < max && !fe->trace_ring.empty()) {
+    const TraceRec& r = fe->trace_ring.front();
+    out[0] = r.hi;
+    out[1] = r.lo;
+    out[2] = r.parent;
+    out[3] = r.start_ns;
+    out[4] = r.dur_ns;
+    out[5] = r.meta;
+    out += 6;
+    n++;
+    fe->trace_ring.pop_front();
+  }
+  return n;
 }
 
 // Complete a batch: encode one RESP_DECISION per item, write natively,

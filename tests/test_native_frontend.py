@@ -659,3 +659,96 @@ def test_native_batching_knobs_configurable():
             await srv.aclose()
 
     run(body())
+
+
+# -- distributed tracing through the native lanes ----------------------------
+
+from distributedratelimiting.redis_tpu.utils import tracing  # noqa: E402
+
+
+@pytest.fixture
+def tracer():
+    tr = tracing.configure(enabled=True, sample_rate=1.0, keep_rate=1.0,
+                           latency_threshold_s=10.0)
+    tr.reset()
+    yield tr
+    tracing.configure(enabled=False)
+    tr.reset()
+
+
+def test_traced_acquire_through_native_batch_lane(tracer):
+    """A trace-stamped ACQUIRE parses in C (trace tail), batches
+    normally, and leaves causally-linked client/fe spans — the
+    feature-detected fe_batch_traces ABI."""
+    if not getattr(load_frontend_lib(), "has_trace", False):
+        pytest.skip("front-end binary predates the trace ABI")
+
+    async def body(srv):
+        store = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+        try:
+            res = await store.acquire("tracee", 50, 5.0, 1.0)
+            assert not res.granted  # denied: the tail sampler keeps it
+        finally:
+            await store.aclose()
+
+    run(_with_server(body))
+    traces = [t for t in tracer.traces()
+              if any(s["status"] == "denied" for s in t["spans"])]
+    assert traces, tracer.traces()
+    spans = traces[0]["spans"]
+    names = {s["name"] for s in spans}
+    assert "client.acquire" in names
+    assert "fe.batch" in names  # the C lane's dispatch record
+    fe = next(s for s in spans if s["name"] == "fe.batch")
+    client = next(s for s in spans if s["name"] == "client.acquire")
+    assert fe["parent_id"] == client["span_id"]
+    assert fe["status"] == "denied"
+
+
+def test_traced_tier0_local_decision_still_traces(tracer):
+    """Tier-0 local grants never reach Python on the serving path; the
+    harvested C trace ring still contributes their ``fe.tier0`` spans —
+    'locally-granted requests still trace'."""
+    lib = load_frontend_lib()
+    if not (getattr(lib, "has_trace", False)
+            and getattr(lib, "has_tier0", False)):
+        pytest.skip("front-end binary predates the trace/tier-0 ABI")
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        Tier0Config,
+    )
+
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(
+                backing, native_frontend=True,
+                native_tier0=Tier0Config(sync_interval_s=0.01,
+                                         min_budget=8.0)) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                for _ in range(200):
+                    r = await store.acquire("hot", 1, 1000.0, 1e-9)
+                    assert r.granted
+                st = await store.stats()
+                assert st["tier0"]["hits"] >= 100  # tier-0 really served
+                await asyncio.sleep(0.05)  # harvest rounds
+            finally:
+                await store.aclose()
+
+    run(body())
+    t0_spans = [s for t in tracer.traces() for s in t["spans"]
+                if s["name"] == "fe.tier0"]
+    assert t0_spans, "no tier-0 spans harvested"
+    assert all(s["attrs"]["local"] for s in t0_spans)
+    assert any(s["status"] == "ok" for s in t0_spans)
+    # each tier-0 span parents on its request's client span in the SAME
+    # exported trace (merged by trace id)
+    merged = [t for t in tracer.traces()
+              if any(s["name"] == "fe.tier0" for s in t["spans"])]
+    linked = 0
+    for t in merged:
+        ids = {s["span_id"] for s in t["spans"]}
+        linked += sum(1 for s in t["spans"]
+                      if s["name"] == "fe.tier0" and s["parent_id"] in ids)
+    assert linked > 0

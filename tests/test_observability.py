@@ -56,9 +56,20 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def _http_get(host: str, port: int, path: str) -> tuple[int, bytes]:
+async def _http_get(host: str, port: int, path: str,
+                    accept: str | None = None) -> tuple[int, bytes]:
+    status, body, _ = await _http_get_full(host, port, path, accept)
+    return status, body
+
+
+async def _http_get_full(host: str, port: int, path: str,
+                         accept: str | None = None
+                         ) -> tuple[int, bytes, str]:
     reader, writer = await asyncio.open_connection(host, port)
-    writer.write(f"GET {path} HTTP/1.1\r\nHost: test\r\n\r\n".encode())
+    req = f"GET {path} HTTP/1.1\r\nHost: test\r\n"
+    if accept is not None:
+        req += f"Accept: {accept}\r\n"
+    writer.write((req + "\r\n").encode())
     await writer.drain()
     data = await reader.read()
     writer.close()
@@ -68,7 +79,11 @@ async def _http_get(host: str, port: int, path: str) -> tuple[int, bytes]:
         pass
     head, _, body = data.partition(b"\r\n\r\n")
     status = int(head.split()[1])
-    return status, body
+    ctype = ""
+    for line in head.decode("latin-1").split("\r\n"):
+        if line.lower().startswith("content-type:"):
+            ctype = line.split(":", 1)[1].strip()
+    return status, body, ctype
 
 
 # -- LatencyHistogram edge cases --------------------------------------------
@@ -78,6 +93,50 @@ class TestLatencyHistogram:
         h = LatencyHistogram()
         assert h.quantile(0.5) == 0.0
         assert h.p99 == 0.0
+
+    def test_exemplars_render_and_parse(self):
+        """A traced observation renders as an OpenMetrics exemplar on
+        its bucket line; the aggregation parser strips it; the plain
+        rendering suppresses it; reset clears it."""
+        h = LatencyHistogram()
+        h.record(0.004)
+        h.record(0.004, trace_id="cafe" * 8)
+        reg = MetricsRegistry()
+        reg.histogram("x_seconds", "test", lambda: h)
+        text = reg.render()
+        ex_lines = [ln for ln in text.splitlines() if " # {" in ln]
+        assert len(ex_lines) == 1
+        assert 'trace_id="' + "cafe" * 8 + '"' in ex_lines[0]
+        # value 2 (cumulative count) precedes the exemplar annotation
+        assert ex_lines[0].split(" # ")[0].endswith(" 2")
+        # the parser (aggregation path) reads the sample value cleanly
+        _, samples = parse_openmetrics(text)
+        bucket = [v for n, l, v in samples if n == "drl_x_seconds_bucket"
+                  and v == 2.0]
+        assert bucket
+        assert " # {" not in reg.render(exemplars=False)
+        # exemplar() annotates without counting
+        h2 = LatencyHistogram()
+        h2.exemplar(0.01, "beef" * 8)
+        assert h2.total == 0 and h2.exemplars
+        h2.reset()
+        assert h2.exemplars is None
+
+    def test_exemplar_strip_spares_label_values_containing_hash(self):
+        """Hot keys are user-controlled label values: a key containing
+        ' # ' must survive the exemplar strip (only the annotation
+        AFTER the label set's closing brace drops)."""
+        text = ('# TYPE drl_hot_key_count gauge\n'
+                'drl_hot_key_count{key="tenant # 7"} 12\n'
+                'drl_x_bucket{le="0.01",key="a # b"} 5'
+                ' # {trace_id="cafe"} 0.003 1.5\n'
+                '# EOF\n')
+        _, samples = parse_openmetrics(text)
+        by_name = {n: (dict(l), v) for n, l, v in samples}
+        assert by_name["drl_hot_key_count"] == (
+            {"key": "tenant # 7"}, 12.0)
+        assert by_name["drl_x_bucket"] == (
+            {"le": "0.01", "key": "a # b"}, 5.0)
 
     def test_quantile_at_exact_bucket_boundaries(self):
         """A sample recorded exactly on a bucket's upper edge must read
@@ -418,6 +477,20 @@ class TestAsyncioServerExposition:
                 status, _ = await _http_get(srv.host, srv.metrics_port,
                                             "/nope")
                 assert status == 404
+                # Content negotiation: scrapers that Accept openmetrics
+                # get the full ctype; everyone else gets Prometheus
+                # text 0.0.4 (and no exemplar annotations).
+                _, _, ctype = await _http_get_full(
+                    srv.host, srv.metrics_port, "/metrics",
+                    accept="application/openmetrics-text; version=1.0.0")
+                assert ctype == MetricsRegistry.CONTENT_TYPE
+                _, _, ctype = await _http_get_full(
+                    srv.host, srv.metrics_port, "/metrics")
+                assert ctype == BucketStoreServer.PLAIN_CONTENT_TYPE
+                _, _, ctype = await _http_get_full(
+                    srv.host, srv.metrics_port, "/metrics",
+                    accept="text/plain")
+                assert ctype == BucketStoreServer.PLAIN_CONTENT_TYPE
                 # stats carries the decomposition numerically
                 stats = await store.stats()
                 stages = stats["stages"]
@@ -706,8 +779,9 @@ def test_cluster_metrics_aggregates_two_nodes():
 
 def test_batcher_queue_stage_and_flush_observer():
     """The queue-stage histogram records the oldest member's wait once
-    per flush; the observer sees (n, wall, error) including failures —
-    the flight recorder's feed contract."""
+    per flush; the observer sees (n, wall, error, trace_id) including
+    failures — the flight recorder's feed contract (trace_id is None
+    whenever no member of the flush was sampled)."""
     from distributedratelimiting.redis_tpu.runtime.batcher import (
         MicroBatcher,
     )
@@ -728,6 +802,7 @@ def test_batcher_queue_stage_and_flush_observer():
         assert qhist.total >= 1
         assert seen and seen[0][0] == 8 and seen[0][2] is None
         assert seen[0][1] >= 0.001
+        assert seen[0][3] is None  # untraced flush: no elected trace
 
         async def bad_flush(reqs):
             raise RuntimeError("boom")
@@ -745,7 +820,7 @@ def test_batcher_queue_stage_and_flush_observer():
         # succeeded (nor be re-invoked on a phantom error path).
         calls = []
 
-        def exploding_observer(n, dt, err):
+        def exploding_observer(n, dt, err, trace_id=None):
             calls.append(err)
             raise ValueError("observer bug")
 

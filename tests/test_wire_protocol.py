@@ -1,7 +1,14 @@
 """Wire-protocol encoder parity: the span (zero-copy) and list entry
-points must emit byte-identical ACQUIRE_MANY frames."""
+points must emit byte-identical ACQUIRE_MANY frames — plus the trace
+tail's round-trip and old-peer compatibility contracts."""
+
+import random
+import struct
+
+import pytest
 
 from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.utils.tracing import TraceContext
 
 def test_span_encoder_matches_list_encoder_bytes():
     """encode_bulk_request_span must emit byte-identical frames to
@@ -33,6 +40,112 @@ def test_span_encoder_matches_list_encoder_bytes():
     b2 = wire.encode_bulk_request_span(3, blob, offsets, klens, counts,
                                        1, 4, 5.0, 1.0)
     assert a == b2
+
+
+# -- trace-context wire round-trips ------------------------------------------
+
+def _random_ctx(rng: random.Random) -> TraceContext:
+    return TraceContext(rng.getrandbits(64), rng.getrandbits(64),
+                        rng.getrandbits(64), rng.getrandbits(1))
+
+
+class TestTraceTailScalar:
+    def test_fuzz_strip_trace_roundtrip(self):
+        """Fuzz: any keyed op with any context — strip_trace recovers
+        the context exactly and yields a body byte-identical to the
+        frame an untraced client would have sent."""
+        rng = random.Random(0xDE7)
+        ops = (wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW,
+               wire.OP_SEMA, wire.OP_PEEK, wire.OP_SYNC)
+        for _ in range(200):
+            op = rng.choice(ops)
+            key = "".join(chr(rng.randrange(32, 0x2FF))
+                          for _ in range(rng.randrange(0, 40)))
+            count = rng.randrange(-5, 1000)
+            a, b = rng.random() * 1e9, rng.random() * 1e3
+            seq = rng.getrandbits(32)
+            ctx = _random_ctx(rng)
+            traced = wire.encode_request(seq, op, key, count, a, b,
+                                         trace=ctx)
+            bare = wire.encode_request(seq, op, key, count, a, b)
+            assert traced != bare
+            plain, got = wire.strip_trace(traced[4:])
+            assert got == ctx
+            assert plain == bare[4:]
+            # untraced bodies pass through strip_trace untouched
+            same, none = wire.strip_trace(bare[4:])
+            assert none is None and same == bare[4:]
+
+    def test_old_peer_sees_routable_unknown_op(self):
+        """An old decoder (today's decode_request IS the old peer's —
+        new servers strip first) must answer a traced frame with the
+        routable unknown-op error, never a misparse."""
+        ctx = TraceContext(1, 2, 3, 1)
+        frame = wire.encode_request(9, wire.OP_ACQUIRE, "k", 1, 5.0, 1.0,
+                                    trace=ctx)
+        with pytest.raises(wire.RemoteStoreError, match="unknown op"):
+            wire.decode_request(frame[4:])
+
+    def test_truncated_trace_tail_is_loud(self):
+        frame = wire.encode_request(9, wire.OP_PING, trace=TraceContext(
+            1, 2, 3, 1))
+        body = frame[4:]
+        # op byte flagged but tail sliced off: strip_trace must raise
+        # the routable error, not misread payload bytes as a context.
+        broken = body[:5] + bytes([body[5]])  # header only, no tail
+        with pytest.raises(wire.RemoteStoreError):
+            wire.strip_trace(broken)
+
+
+class TestTraceTailBulk:
+    def test_fuzz_bulk_tail_roundtrip_and_old_decoder(self):
+        """Fuzz: traced ACQUIRE_MANY frames decode IDENTICALLY through
+        decode_bulk_request (whose array reads by explicit counts are
+        exactly the old peer's parse — the tail is invisible to it),
+        while bulk_trace_tail recovers the context."""
+        import numpy as np
+
+        rng = random.Random(0xBEEF)
+        for _ in range(60):
+            n = rng.randrange(1, 30)
+            key_blobs = [bytes(rng.randrange(33, 127)
+                               for _ in range(rng.randrange(1, 20)))
+                         for _ in range(n)]
+            counts = np.array([rng.randrange(0, 99) for _ in range(n)],
+                              np.uint32)
+            kind = rng.choice((wire.BULK_KIND_BUCKET,
+                               wire.BULK_KIND_WINDOW,
+                               wire.BULK_KIND_FWINDOW))
+            chained = rng.random() < 0.5
+            with_rem = rng.random() < 0.5
+            ctx = _random_ctx(rng)
+            traced = wire.encode_bulk_request(
+                5, key_blobs, counts, 7.0, 2.0, with_remaining=with_rem,
+                kind=kind, chained=chained, trace=ctx)
+            bare = wire.encode_bulk_request(
+                5, key_blobs, counts, 7.0, 2.0, with_remaining=with_rem,
+                kind=kind, chained=chained)
+            assert wire.bulk_trace_tail(traced[4:]) == ctx
+            assert wire.bulk_trace_tail(bare[4:]) is None
+            dec_t = wire.decode_bulk_request(traced[4:])
+            dec_b = wire.decode_bulk_request(bare[4:])
+            assert dec_t[1] == dec_b[1]                      # keys
+            assert (dec_t[2] == dec_b[2]).all()              # counts
+            assert dec_t[3:] == dec_b[3:]                    # a/b/flags
+            # chained-bit peek is tail-agnostic too
+            assert (wire.bulk_request_chained(traced[4:])
+                    == wire.bulk_request_chained(bare[4:]) == chained)
+
+    def test_trace_tail_layout_is_the_documented_struct(self):
+        """Pin the wire layout: 25 bytes, <QQQB, at the very end."""
+        ctx = TraceContext(0x0102030405060708, 0x1112131415161718,
+                           0x2122232425262728, 1)
+        frame = wire.encode_request(1, wire.OP_ACQUIRE, "k", 1, 1.0, 1.0,
+                                    trace=ctx)
+        assert wire.TRACE_TAIL_LEN == 25
+        hi, lo, span, flags = struct.unpack(
+            "<QQQB", frame[-wire.TRACE_TAIL_LEN:])
+        assert (hi, lo, span, flags) == tuple(ctx)
 
 
 def test_client_bulk_nonascii_fallback_roundtrip():
