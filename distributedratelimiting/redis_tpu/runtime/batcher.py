@@ -111,6 +111,9 @@ class MicroBatcher(Generic[TReq, TRes]):
         return await fut
 
     def _start_flush(self, loop: asyncio.AbstractEventLoop) -> None:
+        # Loop-thread-only by design: reached from submit() (a coroutine
+        # on `loop`) or from the call_later timer it arms (loop thread by
+        # definition) — never from a foreign thread.
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
@@ -118,11 +121,13 @@ class MicroBatcher(Generic[TReq, TRes]):
             return
         batch = self._pending[: self._max_batch]
         del self._pending[: len(batch)]
+        # drl-check: ok(task-off-loop) loop-thread-only (see above)
         task = loop.create_task(self._run_flush(batch))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
         # Anything past max_batch re-arms the deadline.
         if self._pending and self._timer is None:
+            # drl-check: ok(task-off-loop) loop-thread-only (see above)
             self._timer = loop.call_later(
                 self._max_delay_s, self._start_flush, loop
             )

@@ -167,7 +167,9 @@ class ClusterBucketStore(BucketStore):
         if loop is not None:
             loop.call_soon_threadsafe(loop.stop)
             if self._io_thread is not None:
-                self._io_thread.join(timeout=5.0)
+                # to_thread: a 5s worst-case join must not stall the
+                # CALLER's event loop (drl-check async-blocking).
+                await asyncio.to_thread(self._io_thread.join, 5.0)
             # Close only a stopped loop: if the join timed out the loop
             # thread is still running, and loop.close() would raise
             # RuntimeError here — masking any node-close exception

@@ -194,6 +194,9 @@ class NativeFrontend:
         """Start ``coro`` as a loop task tracked for shutdown draining
         (every task holding the C handle must finish before fe_free).
         Loop-thread only."""
+        # Loop-thread only: the pump thread reaches this exclusively
+        # through call_soon_threadsafe (_track).
+        # drl-check: ok(task-off-loop)
         task = asyncio.ensure_future(coro)
         self._loop_tasks.add(task)
         task.add_done_callback(self._loop_tasks.discard)

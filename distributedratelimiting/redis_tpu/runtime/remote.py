@@ -737,8 +737,17 @@ class RemoteBucketStore(BucketStore):
             shutdown(), loop))
         loop.call_soon_threadsafe(loop.stop)
         if self._io_thread is not None:
-            self._io_thread.join(timeout=5.0)
-        loop.close()
+            # to_thread: a 5s worst-case join must not stall the
+            # CALLER's event loop (drl-check async-blocking).
+            await asyncio.to_thread(self._io_thread.join, 5.0)
+        # Close only a stopped loop (drl-check unguarded-loop-close,
+        # the pump-alive use-after-free class): if the join timed out
+        # the I/O thread is still running the loop — close() under it
+        # would raise and hand the live thread a closed loop. Leak it
+        # instead (daemon thread, dies with the process) — the same
+        # guard cluster.py aclose carries.
+        if self._io_thread is None or not self._io_thread.is_alive():
+            loop.close()
         self._io_loop = None
 
     def snapshot(self) -> dict:

@@ -42,6 +42,14 @@
 #include <sys/timerfd.h>
 #include <unistd.h>
 
+#if defined(__SANITIZE_THREAD__)
+#define DRL_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define DRL_TSAN 1
+#endif
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
@@ -58,6 +66,78 @@
 #include <vector>
 
 namespace {
+
+#if defined(DRL_TSAN)
+// TSan tracks pthread mutexes by ADDRESS. std::mutex's constexpr
+// constructor never calls pthread_mutex_init, so when `new Frontend()`
+// lands on a heap block where some earlier allocation (ours or an
+// uninstrumented library's) destroyed a mutex, TSan still sees the
+// destroyed one: every lock of the new mutex reports "mutex is already
+// destroyed" and the lost happens-before cascades into hundreds of
+// false races. An explicitly initialized mutex makes the birth visible
+// to the pthread interceptors. The paired condition variable wraps a
+// pthread_cond_t directly for the same reason (std::condition_variable
+// demands std::mutex, and condition_variable_any hides another
+// constexpr-constructed internal std::mutex that re-creates the exact
+// problem). Production builds keep plain std::mutex/condition_variable.
+class TsanVisibleMutex {
+ public:
+  TsanVisibleMutex() { pthread_mutex_init(&m_, nullptr); }
+  ~TsanVisibleMutex() { pthread_mutex_destroy(&m_); }
+  TsanVisibleMutex(const TsanVisibleMutex&) = delete;
+  TsanVisibleMutex& operator=(const TsanVisibleMutex&) = delete;
+  void lock() { pthread_mutex_lock(&m_); }
+  void unlock() { pthread_mutex_unlock(&m_); }
+  bool try_lock() { return pthread_mutex_trylock(&m_) == 0; }
+  pthread_mutex_t* native() { return &m_; }
+
+ private:
+  pthread_mutex_t m_;
+};
+
+class TsanVisibleCondVar {
+ public:
+  TsanVisibleCondVar() {
+    pthread_condattr_t attr;
+    pthread_condattr_init(&attr);
+    pthread_condattr_setclock(&attr, CLOCK_MONOTONIC);
+    pthread_cond_init(&c_, &attr);
+    pthread_condattr_destroy(&attr);
+  }
+  ~TsanVisibleCondVar() { pthread_cond_destroy(&c_); }
+  TsanVisibleCondVar(const TsanVisibleCondVar&) = delete;
+  TsanVisibleCondVar& operator=(const TsanVisibleCondVar&) = delete;
+  void notify_one() { pthread_cond_signal(&c_); }
+  void notify_all() { pthread_cond_broadcast(&c_); }
+  template <class Pred>
+  bool wait_for(std::unique_lock<TsanVisibleMutex>& lk,
+                std::chrono::milliseconds ms, Pred pred) {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    ts.tv_sec += time_t(ms.count() / 1000);
+    ts.tv_nsec += long((ms.count() % 1000) * 1000000);
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (!pred()) {
+      if (pthread_cond_timedwait(&c_, lk.mutex()->native(), &ts) ==
+          ETIMEDOUT) {
+        return pred();
+      }
+    }
+    return true;
+  }
+
+ private:
+  pthread_cond_t c_;
+};
+using FeMutex = TsanVisibleMutex;
+using FeCondVar = TsanVisibleCondVar;
+#else
+using FeMutex = std::mutex;
+using FeCondVar = std::condition_variable;
+#endif
 
 constexpr uint8_t kVersion = 4;
 constexpr uint32_t kMaxFrame = 1u << 20;
@@ -278,8 +358,8 @@ struct Frontend {
   std::thread io;
   std::atomic<bool> stopping{false};
 
-  std::mutex mu;
-  std::condition_variable cv;
+  FeMutex mu;
+  FeCondVar cv;
   std::unordered_map<uint64_t, Conn*> conns;
   uint64_t next_conn_id = 16;  // tags 0-2 are listen/eventfd/timerfd
   std::vector<Item> pending;
@@ -819,7 +899,7 @@ void io_loop(Frontend* fe) {
       if (errno == EINTR) continue;
       break;
     }
-    std::unique_lock<std::mutex> lk(fe->mu);
+    std::unique_lock<FeMutex> lk(fe->mu);
     for (int i = 0; i < n; i++) {
       uint64_t tag = events[i].data.u64;
       if (tag == 0) {  // listen socket
@@ -921,7 +1001,7 @@ void io_loop(Frontend* fe) {
     arm_deadline(fe);
   }
   // Shutdown: fail the pump out of its wait and close every socket.
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   for (auto& [id, c] : fe->conns) {
     ::close(c->fd);
     delete c;
@@ -995,7 +1075,7 @@ int fe_port(void* h) { return static_cast<Frontend*>(h)->port; }
 // (use fe_pt_*), 0 = timeout, -1 = stopping.
 int fe_wait(void* h, int timeout_ms) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::unique_lock<std::mutex> lk(fe->mu);
+  std::unique_lock<FeMutex> lk(fe->mu);
   fe->pump_waiting = true;
   bool got = fe->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
     return fe->stopping.load() || !fe->pt.empty() || !fe->ready.empty();
@@ -1023,14 +1103,14 @@ long long fe_batch_id(void* h) {
 
 int fe_batch_n(void* h) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(fe->cur_batch_id);
   return it == fe->inflight.end() ? 0 : int(it->second.items.size());
 }
 
 long long fe_batch_key_bytes(void* h) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(fe->cur_batch_id);
   if (it == fe->inflight.end()) return 0;
   long long total = 0;
@@ -1044,7 +1124,7 @@ void fe_batch_copy(void* h, char* key_blob, int32_t* klens, int32_t* counts,
                    uint8_t* ops, uint32_t* seqs, uint64_t* conn_ids,
                    double* as, double* bs) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(fe->cur_batch_id);
   if (it == fe->inflight.end()) return;
   size_t off = 0;
@@ -1068,7 +1148,7 @@ void fe_batch_copy(void* h, char* key_blob, int32_t* klens, int32_t* counts,
 // sampling ~99% of batches carry none).
 int fe_batch_traced_n(void* h) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(fe->cur_batch_id);
   if (it == fe->inflight.end()) return 0;
   int n = 0;
@@ -1082,7 +1162,7 @@ int fe_batch_traced_n(void* h) {
 void fe_batch_traces(void* h, uint64_t* hi, uint64_t* lo, uint64_t* parent,
                      uint8_t* flags) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(fe->cur_batch_id);
   if (it == fe->inflight.end()) return;
   size_t i = 0;
@@ -1099,7 +1179,7 @@ void fe_batch_traces(void* h, uint64_t* hi, uint64_t* lo, uint64_t* parent,
 // parent, start_ns, dur_ns, meta). Returns the record count.
 int fe_trace_harvest(void* h, uint64_t* out, int max) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   int n = 0;
   while (n < max && !fe->trace_ring.empty()) {
     const TraceRec& r = fe->trace_ring.front();
@@ -1122,7 +1202,7 @@ int fe_trace_harvest(void* h, uint64_t* out, int max) {
 void fe_complete(void* h, long long batch_id, const uint8_t* granted,
                  const double* remaining) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(batch_id);
   if (it == fe->inflight.end()) return;
   uint64_t t = now_ns();
@@ -1154,7 +1234,7 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
 // Fail a batch (store raised): every item gets a routable error reply.
 void fe_fail(void* h, long long batch_id, const char* msg) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto it = fe->inflight.find(batch_id);
   if (it == fe->inflight.end()) return;
   uint64_t t = now_ns();
@@ -1191,7 +1271,7 @@ void fe_pt_copy(void* h, char* buf) {
 // Send a pre-encoded reply frame (passthrough responses).
 void fe_send(void* h, uint64_t conn_id, const char* data, int len) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto itc = fe->conns.find(conn_id);
   if (itc == fe->conns.end()) return;
   send_to_conn(fe, itc->second, data, size_t(len));
@@ -1200,7 +1280,7 @@ void fe_send(void* h, uint64_t conn_id, const char* data, int len) {
 
 void fe_set_authed(void* h, uint64_t conn_id, int authed) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto itc = fe->conns.find(conn_id);
   if (itc == fe->conns.end()) return;
   Conn* c = itc->second;
@@ -1239,7 +1319,7 @@ void fe_set_authed(void* h, uint64_t conn_id, int authed) {
 
 void fe_close_conn(void* h, uint64_t conn_id) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   auto itc = fe->conns.find(conn_id);
   if (itc == fe->conns.end()) return;
   Conn* c = itc->second;
@@ -1253,7 +1333,7 @@ void fe_close_conn(void* h, uint64_t conn_id) {
 void fe_counts(void* h, long long* requests, long long* connections,
                long long* batches) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   *requests = fe->requests_served;
   *connections = fe->connections_served;
   *batches = fe->batches_flushed;
@@ -1261,7 +1341,7 @@ void fe_counts(void* h, long long* requests, long long* connections,
 
 long long fe_hist(void* h, uint64_t* counts) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   std::memcpy(counts, fe->hist, sizeof fe->hist);
   return fe->hist_total;
 }
@@ -1274,7 +1354,7 @@ long long fe_hist(void* h, uint64_t* counts) {
 long long fe_stage_hist(void* h, int stage, uint64_t* counts,
                         double* sum_out) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   if (stage == 0) {
     std::memcpy(counts, fe->hist, sizeof fe->hist);
     *sum_out = fe->hist_sum;
@@ -1289,7 +1369,7 @@ long long fe_stage_hist(void* h, int stage, uint64_t* counts,
 
 void fe_hist_reset(void* h) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   std::memset(fe->hist, 0, sizeof fe->hist);
   fe->hist_total = 0;
   fe->hist_sum = 0.0;
@@ -1303,7 +1383,7 @@ void fe_stop(void* h) {
   fe->stopping.store(true);
   wake_io(fe);
   {
-    std::lock_guard<std::mutex> lk(fe->mu);
+    std::lock_guard<FeMutex> lk(fe->mu);
     fe->cv.notify_all();
   }
   if (fe->io.joinable()) fe->io.join();
@@ -1326,7 +1406,7 @@ void fe_free(void* h) { delete static_cast<Frontend*>(h); }
 int fe_t0_configure(void* h, int slots, double fraction, double min_budget,
                     double max_budget, int stale_ms, int ttl_ms) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   size_t n = 1;
   while (n < size_t(slots > 0 ? slots : 4096)) n <<= 1;
   fe->t0tab.assign(n, T0Entry{});
@@ -1352,7 +1432,7 @@ int fe_t0_configure(void* h, int slots, double fraction, double min_budget,
 int fe_t0_harvest(void* h, char* key_blob, int blob_cap, int32_t* klens,
                   double* amounts, double* caps, double* rates, int max_n) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   size_t total = fe->t0tab.size();
   if (total == 0) return 0;
   uint64_t now = now_ns();
@@ -1389,7 +1469,7 @@ void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
                const double* caps, const double* rates,
                const double* remainings, int n) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   uint64_t now = now_ns();
   size_t off = 0;
   for (int i = 0; i < n; i++) {
@@ -1409,7 +1489,7 @@ void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
 // out[6]: hits, local denies, misses, installs, evictions, live entries.
 void fe_t0_counts(void* h, long long* out) {
   Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<std::mutex> lk(fe->mu);
+  std::lock_guard<FeMutex> lk(fe->mu);
   long long live = 0;
   for (const T0Entry& e : fe->t0tab) live += e.live ? 1 : 0;
   out[0] = fe->t0_hits;

@@ -1,0 +1,338 @@
+"""The checkers get checked: each drl-check analyzer must (a) pass the
+live tree — the repo ships conformant — and (b) catch its seeded
+divergence EXACTLY once, with the right rule and file:line. The seeded
+fixtures mutate copies of the real sources, so the wire/ABI tests also
+pin that the extractors still recognize the real files' shapes (a
+refactor that blinds an extractor fails the seeded test, not just the
+live one)."""
+
+from __future__ import annotations
+
+import pathlib
+import textwrap
+
+import pytest
+
+from tools.drl_check import (
+    build_freshness,
+    concurrency_lint,
+    jax_lint,
+    run_all,
+    wire_conformance,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+WIRE = ROOT / "distributedratelimiting" / "redis_tpu" / "runtime" / "wire.py"
+NATIVE_PY = (ROOT / "distributedratelimiting" / "redis_tpu" / "utils"
+             / "native.py")
+FRONTEND = ROOT / "native" / "frontend.cc"
+DIRECTORY = ROOT / "native" / "directory.cc"
+
+
+# -- the live tree is clean -------------------------------------------------
+
+def test_live_tree_is_clean():
+    findings = run_all(ROOT)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_extractors_see_the_real_sources():
+    """Guard against vacuous cleanliness: the models must be richly
+    populated from the real files, or a parse regression would read as
+    'clean'."""
+    py = wire_conformance.extract_py_model(WIRE)
+    c = wire_conformance.extract_c_model(FRONTEND)
+    assert len(py.constants) >= 20 and len(py.structs) >= 8
+    assert {"OP_ACQUIRE", "RESP_DECISION", "kVersion",
+            "kMaxFrame"} <= set(c.constants)
+    bound = wire_conformance._py_bound_symbols(NATIVE_PY)
+    assert len([s for s in bound if s.startswith("fe_")]) >= 15
+    assert len([s for s in bound if s.startswith("dir_")]) >= 10
+
+
+# -- seeded divergences: wire constants / layout / ABI ----------------------
+
+def _mutated_frontend(tmp_path: pathlib.Path, old: str, new: str
+                      ) -> pathlib.Path:
+    text = FRONTEND.read_text()
+    assert old in text, f"fixture anchor gone from frontend.cc: {old!r}"
+    out = tmp_path / "frontend.cc"
+    out.write_text(text.replace(old, new, 1))
+    return out
+
+
+def test_wire_constant_drift_fires_once(tmp_path):
+    cc = _mutated_frontend(tmp_path,
+                           "constexpr uint8_t OP_FWINDOW = 9;",
+                           "constexpr uint8_t OP_FWINDOW = 77;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    f = findings[0]
+    assert "OP_FWINDOW" in f.message and "77" in f.message
+    assert f.file.endswith("frontend.cc")
+    assert FRONTEND.read_text().splitlines()[f.line - 1].startswith(
+        "constexpr uint8_t OP_FWINDOW")  # same line in the original
+    # The other side of the diff names wire.py's definition.
+    assert any("wire.py" in rf for rf, _, _ in f.related)
+
+
+def test_wire_version_drift_fires(tmp_path):
+    cc = _mutated_frontend(tmp_path, "constexpr uint8_t kVersion = 4;",
+                           "constexpr uint8_t kVersion = 5;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    assert "PROTOCOL_VERSION" in findings[0].message
+
+
+def test_wire_layout_drift_fires_once(tmp_path):
+    # Shift the second f64 of the keyed-request tail: field order/width
+    # no longer matches struct _ACQ_TAIL ("<idd").
+    cc = _mutated_frontend(tmp_path, "it.b = rd_f64(kp + klen + 12);",
+                           "it.b = rd_f64(kp + klen + 8);")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-layout"]
+    assert "_ACQ_TAIL" in findings[0].message
+
+
+def test_missing_fe_export_fires_both_ways(tmp_path):
+    # Rename an exported symbol: the binding can't resolve (one finding
+    # at the Python binding site) and the renamed export is dead surface
+    # (one finding at the C definition site).
+    cc = _mutated_frontend(tmp_path, "int fe_batch_n(void* h)",
+                           "int fe_batch_count(void* h)")
+    findings = wire_conformance.check_abi(NATIVE_PY, [cc, DIRECTORY],
+                                          tmp_path)
+    rules = sorted((f.rule, "fe_batch_n" in f.message
+                    or "fe_batch_count" in f.message) for f in findings)
+    assert rules == [("abi-export", True), ("abi-export", True)]
+    by_file = {pathlib.Path(f.file).name for f in findings}
+    assert by_file == {"native.py", "frontend.cc"}
+
+
+def test_conditional_pylist_exports_are_recognized():
+    """dir_*_pylist live inside #ifdef DRL_WITH_PYTHON; the extractor
+    must still see them (they are feature-detected, not absent)."""
+    exported = wire_conformance._c_exported_symbols(DIRECTORY)
+    assert exported["dir_resolve_pylist"][1] is True  # conditional
+    assert exported["dir_new"][1] is False
+
+
+def test_endianness_must_be_pinned(tmp_path):
+    wire = tmp_path / "wire.py"
+    wire.write_text(WIRE.read_text().replace(
+        '_DECISION = struct.Struct("<Bd")',
+        '_DECISION = struct.Struct("Bd")', 1))
+    findings = wire_conformance.check_wire(wire, FRONTEND, tmp_path)
+    endian = [f for f in findings if f.rule == "wire-endian"]
+    assert len(endian) == 1 and "_DECISION" in endian[0].message
+    # Dropping '<' also changes the struct's size (native alignment pads
+    # "Bd" to 16), so the layout cross-check fires alongside — both
+    # symptoms of the same seeded bug, nothing else.
+    assert {f.rule for f in findings} == {"wire-endian", "wire-layout"}
+
+
+# -- seeded divergences: concurrency lint -----------------------------------
+
+def test_lock_across_await_fires_once():
+    src = textwrap.dedent("""\
+        import asyncio
+
+        class S:
+            async def flush(self):
+                with self._lock:
+                    await self.store.sync()
+    """)
+    findings = concurrency_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("lock-across-await", 5)]
+
+
+def test_loop_affinity_violation_fires_once():
+    src = textwrap.dedent("""\
+        import asyncio
+
+        class Pump:
+            def on_ready(self, loop, coro):
+                return loop.create_task(coro)
+    """)
+    findings = concurrency_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [("task-off-loop", 5)]
+
+
+def test_get_running_loop_guard_exempts():
+    src = textwrap.dedent("""\
+        import asyncio
+
+        def spawn(coro):
+            loop = asyncio.get_running_loop()
+            return loop.create_task(coro)
+    """)
+    assert concurrency_lint.check_source(src, "snippet.py") == []
+
+
+def test_blocking_call_in_async_fires_once():
+    src = textwrap.dedent("""\
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+    """)
+    findings = concurrency_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [("async-blocking", 4)]
+
+
+def test_unguarded_loop_close_fires_and_guard_exempts():
+    bad = textwrap.dedent("""\
+        async def aclose(self):
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5.0)
+            self._loop.close()
+    """)
+    findings = [f for f in concurrency_lint.check_source(bad, "snippet.py")
+                if f.rule == "unguarded-loop-close"]
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unguarded-loop-close", 4)]
+    good = bad.replace("self._loop.close()",
+                       "if not self._thread.is_alive():\n"
+                       "        pass\n"
+                       "    else:\n"
+                       "        self._loop.close()")
+    # is_alive() anywhere in the function counts as the guard.
+    assert not [f for f in concurrency_lint.check_source(good, "s.py")
+                if f.rule == "unguarded-loop-close"]
+
+
+def test_suppression_comment_silences_exactly_that_rule():
+    src = textwrap.dedent("""\
+        import asyncio
+
+        class Pump:
+            def on_ready(self, loop, coro):
+                # drl-check: ok(task-off-loop)
+                return loop.create_task(coro)
+    """)
+    assert concurrency_lint.check_source(src, "snippet.py") == []
+    # A different rule's annotation does NOT silence it.
+    wrong = src.replace("ok(task-off-loop)", "ok(async-blocking)")
+    assert len(concurrency_lint.check_source(wrong, "snippet.py")) == 1
+
+
+# -- seeded divergences: JAX lint -------------------------------------------
+
+def test_traced_branch_fires_once():
+    src = textwrap.dedent("""\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def kernel(x, n, mode="exact"):
+            if x.shape[0] > 4:
+                return x
+            if mode == "exact":
+                return x
+            if n > 0:
+                return x
+            return x
+    """)
+    findings = jax_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [("traced-branch", 10)]
+    assert "'n'" in findings[0].message
+
+
+def test_jit_rewrap_fires_once_and_cached_builder_exempt():
+    src = textwrap.dedent("""\
+        import functools
+        import jax
+
+        def hot_path(x):
+            return jax.jit(lambda y: y + 1)(x)
+
+        @functools.lru_cache
+        def builder(n):
+            return jax.jit(lambda y: y * n)
+    """)
+    findings = jax_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [("jit-rewrap", 5)]
+
+
+def test_static_unhashable_default_fires_once():
+    src = textwrap.dedent("""\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def kernel(x, cfg={}):
+            return x
+    """)
+    findings = jax_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("jit-static-unhashable", 5)]
+
+
+# -- build freshness --------------------------------------------------------
+
+def _fake_native(tmp_path: pathlib.Path) -> pathlib.Path:
+    native = tmp_path / "native"
+    (native / "build").mkdir(parents=True)
+    (native / "frontend.cc").write_text("// v1\n")
+    (native / "directory.cc").write_text("// v1\n")
+    return native
+
+
+def test_stale_binary_fires_on_hash_mismatch(tmp_path):
+    native = _fake_native(tmp_path)
+    so = native / "build" / "_frontend.so"
+    so.write_bytes(b"ELF")
+    so.with_name("_frontend.so.hash").write_text("0" * 64 + "\n")
+    findings = build_freshness.check_native_dir(native, tmp_path)
+    assert [f.rule for f in findings] == ["stale-binary"]
+    assert "_frontend.so" in findings[0].file
+
+
+def test_stale_binary_fires_on_missing_sidecar(tmp_path):
+    native = _fake_native(tmp_path)
+    (native / "build" / "_directory.so").write_bytes(b"ELF")
+    findings = build_freshness.check_native_dir(native, tmp_path)
+    assert [f.rule for f in findings] == ["stale-binary"]
+    assert "sidecar" in findings[0].message
+
+
+def test_fresh_binary_is_clean(tmp_path):
+    import hashlib
+
+    native = _fake_native(tmp_path)
+    so = native / "build" / "_frontend.so"
+    so.write_bytes(b"ELF")
+    src_hash = hashlib.sha256(
+        (native / "frontend.cc").read_bytes()).hexdigest()
+    so.with_name("_frontend.so.hash").write_text(src_hash + "\n")
+    assert build_freshness.check_native_dir(native, tmp_path) == []
+
+
+def test_no_binary_at_all_is_clean(tmp_path):
+    native = _fake_native(tmp_path)
+    assert build_freshness.check_native_dir(native, tmp_path) == []
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path):
+    from tools.drl_check.__main__ import main
+
+    assert main(["--root", str(ROOT)]) == 0
+    # A seeded-divergent tree exits 1. Reuse the constant-drift fixture
+    # through a minimal tree shim: real wire.py, mutated frontend.cc.
+    shim = tmp_path / "repo"
+    (shim / "distributedratelimiting" / "redis_tpu" / "runtime").mkdir(
+        parents=True)
+    (shim / "distributedratelimiting" / "redis_tpu" / "utils").mkdir()
+    (shim / "native").mkdir()
+    (shim / "distributedratelimiting" / "redis_tpu" / "runtime"
+     / "wire.py").write_text(WIRE.read_text())
+    (shim / "distributedratelimiting" / "redis_tpu" / "utils"
+     / "native.py").write_text(NATIVE_PY.read_text())
+    (shim / "native" / "frontend.cc").write_text(
+        FRONTEND.read_text().replace("constexpr uint8_t OP_SEMA = 8;",
+                                     "constexpr uint8_t OP_SEMA = 9;", 1))
+    (shim / "native" / "directory.cc").write_text(DIRECTORY.read_text())
+    assert main(["--root", str(shim), "--only", "wire"]) == 1

@@ -91,6 +91,7 @@ def test_debit_many_inprocess_saturates_and_reports_shortfall():
     run(body())
 
 
+@pytest.mark.jax_backend
 def test_debit_many_device_matches_inprocess():
     from distributedratelimiting.redis_tpu.runtime.store import (
         DeviceBucketStore,
@@ -116,6 +117,7 @@ def test_debit_many_device_matches_inprocess():
     run(body())
 
 
+@pytest.mark.jax_backend
 def test_sync_counters_many_one_launch_matches_singles():
     from distributedratelimiting.redis_tpu.runtime.store import (
         DeviceBucketStore,

@@ -1,0 +1,58 @@
+"""drl-check — repo-specific static conformance and lint suite.
+
+The stack keeps four mirrored implementations of one agreement: the
+Python wire codecs (``runtime/wire.py``, the normative protocol spec —
+see docs/DESIGN.md §10), the C parser (``native/frontend.cc``), the
+ctypes ABI bindings (``utils/native.py``), and the jitted JAX kernels
+(``ops/``). Runtime fuzz tests exercise the agreement; this package
+checks it *statically*, so drift is a failed ``make check`` instead of
+a production misparse. Four analyzers:
+
+- :mod:`.wire_conformance` — extracts the wire model (opcodes, flag
+  bits, frame layouts, version gates) from ``wire.py`` via ``ast`` and
+  from ``frontend.cc`` via constant/offset parsing, diffs the two, and
+  cross-checks every ``fe_*``/``dir_*`` symbol the ctypes loader binds
+  against the C exports.
+- :mod:`.concurrency_lint` — AST checks for the asyncio/thread races
+  this repo has actually shipped fixes for: blocking calls in
+  ``async def``, locks held across ``await``, loop-affine calls from
+  sync code, and unguarded ``loop.close()`` after a timed join.
+- :mod:`.jax_lint` — JAX hot-path hygiene in ``ops/`` and
+  ``runtime/store.py``: Python branches on traced values, per-call
+  ``jax.jit`` re-wrapping, unhashable static arguments.
+- :mod:`.build_freshness` — verifies ``native/build/*.so.hash``
+  sidecars against the current source hashes, so analysis results are
+  never reported against a binary built from different source.
+
+Run ``python -m tools.drl_check`` (exit 0 = clean); suppress a
+deliberate exception with ``# drl-check: ok(<rule>)`` on (or one line
+above) the flagged line, with a reason.
+"""
+
+from __future__ import annotations
+
+from tools.drl_check.common import Finding  # re-export for consumers
+
+__all__ = ["Finding", "run_all"]
+
+
+def run_all(repo_root=None) -> "list[Finding]":
+    """Run every analyzer against the live tree; returns all findings
+    (empty = clean)."""
+    import pathlib
+
+    from tools.drl_check import (
+        build_freshness,
+        concurrency_lint,
+        jax_lint,
+        wire_conformance,
+    )
+
+    root = pathlib.Path(repo_root) if repo_root else (
+        pathlib.Path(__file__).resolve().parents[2])
+    findings: list[Finding] = []
+    findings += wire_conformance.check(root)
+    findings += concurrency_lint.check(root)
+    findings += jax_lint.check(root)
+    findings += build_freshness.check(root)
+    return findings

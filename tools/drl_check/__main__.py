@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.drl_check [--json] [--only ANALYZER]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = analyzer crash (a bug in the
+checker itself, never silently swallowed into a fake 'clean')."""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+
+from tools.drl_check import (
+    build_freshness,
+    concurrency_lint,
+    jax_lint,
+    wire_conformance,
+)
+
+_ANALYZERS = {
+    "wire": wire_conformance.check,
+    "concurrency": concurrency_lint.check,
+    "jax": jax_lint.check,
+    "freshness": build_freshness.check,
+}
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="drl-check",
+        description="repo-specific wire/ABI conformance + concurrency "
+                    "and JAX hot-path lints (see tools/drl_check)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--only", choices=sorted(_ANALYZERS),
+                        action="append",
+                        help="run only this analyzer (repeatable)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: inferred from this "
+                             "package's location)")
+    args = parser.parse_args(argv)
+
+    root = pathlib.Path(args.root) if args.root else \
+        pathlib.Path(__file__).resolve().parents[2]
+    selected = args.only or sorted(_ANALYZERS)
+
+    findings = []
+    for name in selected:
+        try:
+            findings += _ANALYZERS[name](root)
+        except Exception as exc:  # noqa: BLE001 — checker bug: loud, rc 2
+            print(f"drl-check: analyzer {name!r} crashed: {exc!r}",
+                  file=sys.stderr)
+            return 2
+
+    if args.json:
+        print(json.dumps([{
+            "rule": f.rule, "file": f.file, "line": f.line,
+            "message": f.message,
+            "related": [list(r) for r in f.related],
+        } for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            by_rule = collections.Counter(f.rule for f in findings)
+            summary = ", ".join(f"{n} {rule}"
+                                for rule, n in sorted(by_rule.items()))
+            print(f"drl-check: {len(findings)} finding"
+                  f"{'s' if len(findings) != 1 else ''} ({summary})")
+        else:
+            print(f"drl-check: clean ({', '.join(selected)})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

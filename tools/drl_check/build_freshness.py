@@ -1,0 +1,77 @@
+"""Stale-binary gate: conformance results must describe the binary that
+is actually loaded.
+
+``utils/native.py`` stamps every successful build with a
+``<name>.so.hash`` sidecar holding the sha256 of the source it was
+compiled from, and refuses to load a binary whose sidecar disagrees
+with the current source (rebuild-on-load). This check enforces the same
+invariant *statically* for every built artifact — production,
+``build/asan/``, and ``build/tsan/`` — so ``make check`` cannot report
+a clean conformance diff for ``frontend.cc`` while the ``.so`` under
+test was built from a different revision of it.
+
+Rule ``stale-binary``: a ``.so`` exists whose sidecar is missing or
+records a hash other than the current source's. (No ``.so`` at all is
+fine — the loader builds on first import.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pathlib
+
+from tools.drl_check.common import Finding, rel
+
+__all__ = ["check", "check_native_dir"]
+
+#: artifact name → source it must be built from.
+_ARTIFACTS = {
+    "_directory.so": "directory.cc",
+    "_frontend.so": "frontend.cc",
+}
+
+
+def _sha256(path: pathlib.Path) -> str:
+    return hashlib.sha256(path.read_bytes()).hexdigest()
+
+
+def check_native_dir(native: pathlib.Path,
+                     root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    build = native / "build"
+    if not build.exists():
+        return findings
+    for so_name, src_name in _ARTIFACTS.items():
+        src = native / src_name
+        if not src.exists():
+            continue
+        src_hash = _sha256(src)
+        for so in sorted(build.rglob(so_name)):
+            sidecar = so.with_name(so.name + ".hash")
+            if not sidecar.exists():
+                findings.append(Finding(
+                    "stale-binary",
+                    f"{rel(so, root)} has no source-hash sidecar — it "
+                    "cannot be proven to match the current "
+                    f"{src_name}; rebuild (make -C native, or delete "
+                    "the .so and let the loader rebuild)",
+                    rel(so, root), 1,
+                    ((rel(src, root), 1, f"current sha256 {src_hash[:12]}…"),
+                     )))
+                continue
+            recorded = sidecar.read_text().strip()
+            if recorded != src_hash:
+                findings.append(Finding(
+                    "stale-binary",
+                    f"{rel(so, root)} was built from "
+                    f"{src_name}@{recorded[:12]}… but the tree has "
+                    f"{src_hash[:12]}… — analysis of the source does "
+                    "not describe this binary; rebuild before trusting "
+                    "either", rel(so, root), 1,
+                    ((rel(src, root), 1,
+                      f"current sha256 {src_hash[:12]}…"),)))
+    return findings
+
+
+def check(root: pathlib.Path) -> list[Finding]:
+    return check_native_dir(root / "native", root)

@@ -1,0 +1,125 @@
+"""Shared plumbing for the drl-check analyzers: findings, suppression
+comments, and safe constant evaluation for the two source languages."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+__all__ = [
+    "Finding", "Suppressions", "const_eval_py", "const_eval_c",
+    "rel", "iter_py_files",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. ``related`` carries the other side of a
+    cross-language diff (file, line, note) so a conformance error names
+    BOTH locations."""
+
+    rule: str
+    message: str
+    file: str
+    line: int
+    related: tuple[tuple[str, int, str], ...] = ()
+
+    def format(self) -> str:
+        out = [f"{self.file}:{self.line}: error[{self.rule}]: "
+               f"{self.message}"]
+        for f, ln, note in self.related:
+            out.append(f"    {f}:{ln}: {note}")
+        return "\n".join(out)
+
+
+#: ``# drl-check: ok(rule[, rule])`` (Python) / ``// drl-check: ok(rule)``
+#: (C++) — suppresses matching rules on the same line or the line below
+#: (i.e. the comment may sit on its own line directly above the code).
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*drl-check:\s*ok\(\s*([\w\-, ]+?)\s*\)")
+
+
+class Suppressions:
+    """Per-file map of suppression comments."""
+
+    def __init__(self, text: str) -> None:
+        self._by_line: dict[int, set[str]] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self._by_line.setdefault(i, set()).update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            if rule in self._by_line.get(ln, ()):
+                return True
+        return False
+
+
+def rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    """Repo-relative path when possible (stable finding identity)."""
+    try:
+        return str(path.resolve().relative_to(root.resolve()))
+    except ValueError:
+        return str(path)
+
+
+def iter_py_files(root: pathlib.Path) -> "list[pathlib.Path]":
+    return sorted(p for p in root.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+# -- constant evaluation ----------------------------------------------------
+
+def const_eval_py(node: ast.AST,
+                  struct_sizes: "dict[str, int] | None" = None) -> int | None:
+    """Evaluate a module-level constant expression: int literals, the
+    arithmetic the wire module actually uses (``1 << 20``, ``0b10000``),
+    and ``<struct_name>.size`` when ``struct_sizes`` knows the struct."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_eval_py(node.operand, struct_sizes)
+        return None if inner is None else -inner
+    if isinstance(node, ast.BinOp):
+        left = const_eval_py(node.left, struct_sizes)
+        right = const_eval_py(node.right, struct_sizes)
+        if left is None or right is None:
+            return None
+        ops = {ast.LShift: lambda a, b: a << b,
+               ast.RShift: lambda a, b: a >> b,
+               ast.BitOr: lambda a, b: a | b,
+               ast.BitAnd: lambda a, b: a & b,
+               ast.Add: lambda a, b: a + b,
+               ast.Sub: lambda a, b: a - b,
+               ast.Mult: lambda a, b: a * b}
+        fn = ops.get(type(node.op))
+        return None if fn is None else fn(left, right)
+    if (struct_sizes is not None and isinstance(node, ast.Attribute)
+            and node.attr == "size" and isinstance(node.value, ast.Name)
+            and node.value.id in struct_sizes):
+        return struct_sizes[node.value.id]
+    return None
+
+
+_C_CONST_ALLOWED = re.compile(r"^[0-9a-fA-FxX\s()<>|&+\-*uUlL]+$")
+
+
+def const_eval_c(expr: str) -> int | None:
+    """Evaluate a C constant initializer (``1u << 20``, ``0x80``, plain
+    ints). Strips integer suffixes, then evaluates an allow-listed
+    arithmetic expression — anything else returns ``None``."""
+    expr = expr.strip()
+    if not _C_CONST_ALLOWED.match(expr):
+        return None
+    cleaned = re.sub(r"(?<=[0-9a-fA-F])[uUlL]+", "", expr)
+    try:
+        value = eval(compile(cleaned, "<c-const>", "eval"),  # noqa: S307
+                     {"__builtins__": {}}, {})
+    except Exception:
+        return None
+    return value if isinstance(value, int) else None

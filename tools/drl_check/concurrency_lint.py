@@ -1,0 +1,253 @@
+"""Concurrency lint: AST checks for the asyncio/thread bug classes this
+repo has actually shipped fixes for (the pump-alive use-after-free in
+PR 1, the loop/thread shutdown ordering audits since).
+
+Rules:
+
+- ``async-blocking`` — a blocking call (``time.sleep``, ``subprocess``,
+  ``concurrent.futures``-style ``.result()``, thread ``.join()``)
+  inside an ``async def``: it stalls the whole event loop, which on the
+  serving path stalls every connection.
+- ``lock-across-await`` — a *synchronous* lock (``threading.Lock`` et
+  al., recognized by name) held across an ``await``: any other task
+  needing the lock on the same loop deadlocks; any thread needing it
+  blocks for the full await.
+- ``task-off-loop`` — ``create_task`` / ``ensure_future`` /
+  ``call_soon`` / ``call_later`` / ``call_at`` from a synchronous
+  function: loop-affine APIs that are only safe on the loop thread.
+  Sync code reached from another thread must use
+  ``call_soon_threadsafe`` (never flagged). Functions that call
+  ``asyncio.get_running_loop()`` are exempt — it raises off-loop, so it
+  IS the affinity guard. Functions that are loop-thread-only by design
+  (timer callbacks, ``call_soon_threadsafe`` targets) annotate with
+  ``# drl-check: ok(task-off-loop)``.
+- ``unguarded-loop-close`` — ``loop.close()`` after a *timed*
+  ``thread.join()`` with no ``is_alive()`` guard: if the join timed
+  out, the loop thread is still running and close() either raises or
+  hands the running thread a closed loop (the use-after-free class
+  fixed for the native pump in PR 1; ``cluster.py`` carries the model
+  guard).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.drl_check.common import (
+    Finding,
+    Suppressions,
+    iter_py_files,
+    rel,
+)
+
+__all__ = ["check", "check_file", "check_source"]
+
+#: Dotted-call suffixes that block the loop. ``.result``/``.join`` are
+#: receiver-gated below (too many innocent methods share the names).
+_BLOCKING_CALLS = {
+    ("time", "sleep"),
+    ("os", "system"),
+    ("os", "popen"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("socket", "create_connection"),
+}
+_LOCKISH = ("lock", "gate", "mutex", "sem")
+_THREADISH = ("thread", "pump", "worker")
+_LOOP_AFFINE = {"create_task", "call_soon", "call_later", "call_at"}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...]:
+    """('time', 'sleep') for ``time.sleep`` — best effort, '' for
+    non-name parts."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "")
+    return tuple(reversed(parts))
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """Per-function-scope analysis; nested defs get their own scope (a
+    sync helper nested in an async def is not 'in' the async def)."""
+
+    def __init__(self, path: str, supp: Suppressions) -> None:
+        self.path = path
+        self.supp = supp
+        self.findings: list[Finding] = []
+        self._stack: list[ast.AST] = []  # enclosing function nodes
+
+    # -- scope plumbing
+    def _in_async(self) -> bool:
+        return bool(self._stack) and isinstance(self._stack[-1],
+                                                ast.AsyncFunctionDef)
+
+    def _in_sync_fn(self) -> bool:
+        return bool(self._stack) and isinstance(self._stack[-1],
+                                                ast.FunctionDef)
+
+    @staticmethod
+    def _loop_guarded(fn: ast.AST) -> bool:
+        """True when the function calls ``get_running_loop()`` in its own
+        scope: that call raises off the loop thread, so a sync function
+        holding its result is proven loop-affine (nested defs guard
+        themselves, not the enclosing scope)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if (isinstance(n, ast.Call)
+                    and _dotted(n.func)[-1] == "get_running_loop"):
+                return True
+            stack.extend(ast.iter_child_nodes(n))
+        return False
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if not self.supp.suppressed(line, rule):
+            self.findings.append(Finding(rule, message, self.path, line))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+        self._check_loop_close(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+        self._check_loop_close(node)
+
+    # -- rules
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        name = dotted[-1]
+        recv = ".".join(dotted[:-1]).lower()
+        if self._in_async():
+            if dotted[-2:] in _BLOCKING_CALLS:
+                self._emit("async-blocking", node.lineno,
+                           f"blocking call {'.'.join(dotted)}() inside "
+                           "'async def' stalls the event loop — await an "
+                           "async equivalent or use asyncio.to_thread")
+            elif name == "result" and len(dotted) > 1 \
+                    and (node.args or node.keywords):
+                # A timeout argument marks the blocking
+                # concurrent.futures wait; a bare .result() on a
+                # done-checked asyncio future is a non-blocking read.
+                self._emit("async-blocking", node.lineno,
+                           f"{'.'.join(dotted)}(timeout) blocks inside "
+                           "'async def' — wrap the future with "
+                           "asyncio.wrap_future and await it")
+            elif name == "join" and any(t in recv for t in _THREADISH):
+                self._emit("async-blocking", node.lineno,
+                           f"{'.'.join(dotted)}() joins a thread inside "
+                           "'async def' — use asyncio.to_thread(x.join,…)")
+        if self._in_sync_fn() and not self._loop_guarded(self._stack[-1]):
+            if name in _LOOP_AFFINE:
+                self._emit("task-off-loop", node.lineno,
+                           f"loop-affine {'.'.join(dotted)}() in a "
+                           "synchronous function: only safe on the loop "
+                           "thread — use call_soon_threadsafe from other "
+                           "threads, or annotate if this function is "
+                           "loop-thread-only by design")
+            elif dotted[-2:] in {("asyncio", "ensure_future"),
+                                 ("asyncio", "create_task")}:
+                self._emit("task-off-loop", node.lineno,
+                           f"{'.'.join(dotted)}() in a synchronous "
+                           "function creates a task off-loop — same "
+                           "affinity contract as loop.create_task")
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(
+            any(t in _expr_text(item.context_expr).lower()
+                for t in _LOCKISH)
+            for item in node.items)
+        if lockish and self._in_async():
+            awaits = [n for n in self._body_walk(node)
+                      if isinstance(n, ast.Await)]
+            if awaits:
+                self._emit("lock-across-await", node.lineno,
+                           "synchronous lock held across 'await' (first "
+                           f"await at line {awaits[0].lineno}): tasks "
+                           "needing it deadlock the loop; use "
+                           "asyncio.Lock or release before awaiting")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _body_walk(node: ast.With):
+        """Walk the with-body without descending into nested defs (an
+        await inside a nested async def is not held-across)."""
+        stack = list(node.body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _check_loop_close(self, fn: ast.AST) -> None:
+        closes: list[ast.Call] = []
+        timed_join = False
+        guarded = False
+        # Own scope only: nested defs run their own check — walking into
+        # them would double-report their close/join pairs up the stack.
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if isinstance(n, ast.Call) and isinstance(n.func,
+                                                      ast.Attribute):
+                recv = _expr_text(n.func.value).lower()
+                if n.func.attr == "close" and "loop" in recv:
+                    closes.append(n)
+                elif n.func.attr == "join" and (n.args or n.keywords) \
+                        and any(t in recv for t in _THREADISH):
+                    # Receiver-gated like async-blocking: a timed THREAD
+                    # join, not str.join(parts)/b"".join(...).
+                    timed_join = True
+            if isinstance(n, ast.Attribute) and n.attr == "is_alive":
+                guarded = True
+        if closes and timed_join and not guarded:
+            for call in closes:
+                self._emit(
+                    "unguarded-loop-close", call.lineno,
+                    "loop.close() after a timed thread join with no "
+                    "is_alive() guard: a timed-out join leaves the loop "
+                    "thread running — closing under it raises or "
+                    "use-after-frees (guard like cluster.py aclose)")
+
+
+def check_source(source: str, path: str) -> list[Finding]:
+    tree = ast.parse(source)
+    visitor = _FnVisitor(path, Suppressions(source))
+    visitor.visit(tree)
+    return sorted(visitor.findings, key=lambda f: f.line)
+
+
+def check_file(py: pathlib.Path, root: pathlib.Path) -> list[Finding]:
+    return check_source(py.read_text(), rel(py, root))
+
+
+def check(root: pathlib.Path) -> list[Finding]:
+    pkg = root / "distributedratelimiting"
+    findings: list[Finding] = []
+    for py in iter_py_files(pkg):
+        findings += check_file(py, root)
+    return findings

@@ -1,0 +1,228 @@
+"""JAX hot-path lint over the kernel layer (``ops/`` and
+``runtime/store.py``).
+
+Rules:
+
+- ``traced-branch`` — a Python-level ``if``/``while`` on a value derived
+  from a *traced* (non-static) parameter inside a jitted function:
+  either a ``ConcretizationTypeError`` at trace time or, worse, a branch
+  baked in at trace time that silently stops tracking the runtime value.
+  Shape/dtype/None tests are exempt (static under jit by construction).
+- ``jit-rewrap`` — ``jax.jit(...)`` called inside a function body: every
+  call builds a fresh wrapper whose cache is thrown away, so the kernel
+  re-traces (and re-compiles) per call. Decorate at module level or
+  cache the wrapper (``lru_cache``-style builders are exempt).
+- ``jit-static-unhashable`` — a parameter named static (via
+  ``static_argnames``/``static_argnums``) whose default is a mutable
+  literal (list/dict/set): static args key the jit cache by hash, so the
+  first call raises ``TypeError: unhashable``; even when callers always
+  override, the default documents an illegal call.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.drl_check.common import Finding, Suppressions, rel
+
+__all__ = ["check", "check_file", "check_source"]
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "")
+    return tuple(reversed(parts))
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """``jax.jit`` or bare ``jit`` (the conventional import alias)."""
+    d = _dotted(node)
+    return d[-1] == "jit" and (len(d) == 1 or d[-2] in ("jax", ""))
+
+
+class _JitSpec:
+    """Static-parameter model of one jitted function."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.static_names: set[str] = set()
+        self.static_nums: set[int] = set()
+
+    def resolve_static(self) -> set[str]:
+        args = self.fn.args
+        names = set(self.static_names)
+        positional = [a.arg for a in (args.posonlyargs + args.args)]
+        for i in self.static_nums:
+            if 0 <= i < len(positional):
+                names.add(positional[i])
+        return names
+
+
+def _jit_spec_from_decorators(fn: ast.AST) -> _JitSpec | None:
+    """Recognize ``@jax.jit``, ``@jit``, ``@jax.jit(...)``, and
+    ``@(functools.)partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec):
+            return _JitSpec(fn)
+        if isinstance(dec, ast.Call):
+            is_partial = _dotted(dec.func)[-1] == "partial" and dec.args \
+                and _is_jit_ref(dec.args[0])
+            if not (is_partial or _is_jit_ref(dec.func)):
+                continue
+            spec = _JitSpec(fn)
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            spec.static_names.add(el.value)
+                elif kw.arg == "static_argnums":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, int):
+                            spec.static_nums.add(el.value)
+            return spec
+    return None
+
+
+#: Wrappers under which a traced name stays static/legal in a branch
+#: test: shape metadata, type tests, None tests, Python-int casts of
+#: shape components.
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable",
+                 "int", "bool", "float", "str", "type"}
+
+
+def _branch_uses_traced(test: ast.AST, traced: set[str]) -> str | None:
+    """The first traced parameter the branch test reads as a VALUE (not
+    through a static wrapper), or None."""
+
+    def scan(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            return None  # x.shape / x.ndim … — static under jit
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)[-1]
+            if name in _STATIC_CALLS:
+                return None  # len(x), isinstance(x, …) — static
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in node.ops):
+            return None  # `x is None` — identity, static
+        if isinstance(node, ast.Name) and node.id in traced:
+            return node.id
+        for child in ast.iter_child_nodes(node):
+            hit = scan(child)
+            if hit is not None:
+                return hit
+        return None
+
+    return scan(test)
+
+
+#: Enclosing-function shapes that legitimately build-and-return a jitted
+#: callable (the result is cached by the caller / a lru_cache).
+_BUILDER_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, supp: Suppressions) -> None:
+        self.path = path
+        self.supp = supp
+        self.findings: list[Finding] = []
+        self._fn_stack: list[ast.AST] = []
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if not self.supp.suppressed(line, rule):
+            self.findings.append(Finding(rule, message, self.path, line))
+
+    def _visit_fn(self, node: ast.AST) -> None:
+        spec = _jit_spec_from_decorators(node)
+        if spec is not None:
+            self._check_jitted(node, spec)
+        self._fn_stack.append(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_ref(node.func) and self._fn_stack:
+            fn = self._fn_stack[-1]
+            decorated = {_dotted(d.func if isinstance(d, ast.Call) else d
+                                 )[-1]
+                         for d in getattr(fn, "decorator_list", [])}
+            if not decorated & _BUILDER_DECORATORS:
+                self._emit(
+                    "jit-rewrap", node.lineno,
+                    "jax.jit(...) called inside a function body: each "
+                    "call builds a fresh wrapper and re-traces — "
+                    "decorate at module level, or cache the built "
+                    "wrapper (lru_cache'd builders are exempt)")
+        self.generic_visit(node)
+
+    def _check_jitted(self, fn: ast.AST, spec: _JitSpec) -> None:
+        static = spec.resolve_static()
+        args = fn.args
+        all_params = [a.arg for a in (args.posonlyargs + args.args
+                                      + args.kwonlyargs)]
+        traced = {p for p in all_params if p not in static}
+
+        # jit-static-unhashable: mutable default on a static parameter.
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) \
+            + list(args.defaults)
+        pairs = list(zip(pos, defaults)) \
+            + list(zip(args.kwonlyargs, args.kw_defaults))
+        for arg, default in pairs:
+            if arg.arg in static and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)):
+                self._emit(
+                    "jit-static-unhashable", default.lineno,
+                    f"static argument {arg.arg!r} defaults to a mutable "
+                    "literal: static args key the jit cache by hash, so "
+                    "calls relying on the default raise TypeError — use "
+                    "a hashable default (tuple / frozen config / None)")
+
+        # traced-branch: Python control flow on a traced value.
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hit = _branch_uses_traced(node.test, traced)
+                if hit is not None:
+                    kind = ("while" if isinstance(node, ast.While)
+                            else "if")
+                    self._emit(
+                        "traced-branch", node.lineno,
+                        f"Python-level '{kind}' on traced parameter "
+                        f"{hit!r} inside a jitted function: branches "
+                        "must be jnp.where / lax.cond / lax.select (or "
+                        f"mark {hit!r} static if it is config, at the "
+                        "cost of a cache entry per value)")
+
+
+def check_source(source: str, path: str) -> list[Finding]:
+    tree = ast.parse(source)
+    visitor = _Visitor(path, Suppressions(source))
+    visitor.visit(tree)
+    return sorted(visitor.findings, key=lambda f: f.line)
+
+
+def check_file(py: pathlib.Path, root: pathlib.Path) -> list[Finding]:
+    return check_source(py.read_text(), rel(py, root))
+
+
+def check(root: pathlib.Path) -> list[Finding]:
+    """Scan the jit-heavy layers: every ``ops/`` module plus the device
+    store (``runtime/store.py``), per the hot-path inventory."""
+    pkg = root / "distributedratelimiting" / "redis_tpu"
+    paths = sorted((pkg / "ops").glob("*.py")) + [pkg / "runtime" /
+                                                  "store.py"]
+    findings: list[Finding] = []
+    for py in paths:
+        if py.exists():
+            findings += check_file(py, root)
+    return findings

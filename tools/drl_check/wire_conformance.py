@@ -1,0 +1,404 @@
+"""Wire/ABI conformance: diff the two protocol implementations.
+
+``runtime/wire.py`` is the normative spec (docs/DESIGN.md §10);
+``native/frontend.cc`` re-implements the hot subset in C. This analyzer
+extracts a wire model from each side and diffs them:
+
+- **Constants** (``wire-const``): every opcode / response kind / flag
+  bit / version / size bound the C side mirrors must exist in the
+  Python module with the same value. The C side deliberately names only
+  the ops it fast-paths (everything else is passthrough — Python stays
+  the authority), so the strict direction is C → Python.
+- **Frame layouts** (``wire-layout``): the C parser's hand-written
+  offset arithmetic for the keyed-request tail, the decision/error
+  replies, and the trace tail must match the ``struct`` formats that
+  define them in Python (field order, width, total size).
+- **Endianness** (``wire-endian``): every ``struct.Struct`` format in
+  ``wire.py`` must pin little-endian (``<``) — the C side assumes an LE
+  host and does raw ``memcpy``.
+- **ctypes ABI** (``abi-export``): every ``fe_*``/``dir_*`` symbol the
+  loader (``utils/native.py``) binds must be exported by the
+  corresponding ``.cc``, and vice versa — a symbol on one side only is
+  either a binding that can never resolve or dead C surface nothing
+  feature-detects.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import struct as struct_mod
+
+from tools.drl_check.common import (
+    Finding,
+    const_eval_c,
+    const_eval_py,
+    rel,
+)
+
+__all__ = ["check", "check_wire", "check_abi",
+           "extract_py_model", "extract_c_model"]
+
+
+# -- Python-side model ------------------------------------------------------
+
+class PyWireModel:
+    def __init__(self) -> None:
+        self.constants: dict[str, tuple[int, int]] = {}   # name -> (value, line)
+        self.structs: dict[str, tuple[str, int]] = {}     # name -> (fmt, line)
+
+    def struct_size(self, name: str) -> int | None:
+        if name not in self.structs:
+            return None
+        return struct_mod.calcsize(self.structs[name][0])
+
+    def field_offsets(self, name: str) -> "list[tuple[str, int]] | None":
+        """Per-field (format char, byte offset) of a struct format."""
+        if name not in self.structs:
+            return None
+        fmt = self.structs[name][0]
+        body = fmt[1:] if fmt[:1] in "<>=!@" else fmt
+        prefix = fmt[:1] if fmt[:1] in "<>=!@" else ""
+        out: list[tuple[str, int]] = []
+        seen = ""
+        for ch in body:
+            out.append((ch, struct_mod.calcsize(prefix + seen)))
+            seen += ch
+        return out
+
+
+def extract_py_model(wire_py: pathlib.Path) -> PyWireModel:
+    tree = ast.parse(wire_py.read_text())
+    model = PyWireModel()
+    struct_sizes: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "Struct"
+                and value.args
+                and isinstance(value.args[0], ast.Constant)
+                and isinstance(value.args[0].value, str)):
+            fmt = value.args[0].value
+            model.structs[target.id] = (fmt, node.lineno)
+            struct_sizes[target.id] = struct_mod.calcsize(fmt)
+            continue
+        const = const_eval_py(value, struct_sizes)
+        if const is not None:
+            model.constants[target.id] = (const, node.lineno)
+    return model
+
+
+# -- C-side model -----------------------------------------------------------
+
+class CWireModel:
+    def __init__(self) -> None:
+        self.constants: dict[str, tuple[int, int]] = {}
+        self.text = ""
+        self.lines: list[str] = []
+
+    def line_of(self, offset: int) -> int:
+        return self.text.count("\n", 0, offset) + 1
+
+
+_C_CONST_RE = re.compile(
+    r"constexpr\s+(?:uint8_t|uint16_t|uint32_t|uint64_t|int|size_t|double)"
+    r"\s+(\w+)\s*=\s*([^;]+);")
+
+
+def extract_c_model(frontend_cc: pathlib.Path) -> CWireModel:
+    model = CWireModel()
+    model.text = frontend_cc.read_text()
+    model.lines = model.text.splitlines()
+    for m in _C_CONST_RE.finditer(model.text):
+        value = const_eval_c(m.group(2))
+        if value is not None:
+            model.constants[m.group(1)] = (value, model.line_of(m.start()))
+    return model
+
+
+# -- the conformance diff ---------------------------------------------------
+
+#: C names whose Python counterpart has a different spelling. Everything
+#: matching _MIRRORED_PREFIX maps by identity.
+_C_TO_PY = {
+    "kVersion": "PROTOCOL_VERSION",
+    "kMaxFrame": "MAX_FRAME",
+    "kBodyOff": "_BODY_OFF",
+    "kTraceTail": "TRACE_TAIL_LEN",
+}
+_MIRRORED_PREFIX = re.compile(
+    r"^(OP_|RESP_|TRACE_FLAG$|STATS_FLAG_|BULK_FLAG_)")
+
+#: The wire.py names C hard-codes via the mapped k-constants; used for
+#: the Python-side existence direction of the diff.
+_PY_FROM_C = set(_C_TO_PY.values())
+
+
+def _diff_constants(py: PyWireModel, c: CWireModel, wire_rel: str,
+                    cc_rel: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for c_name, (c_val, c_line) in sorted(c.constants.items()):
+        py_name = _C_TO_PY.get(c_name)
+        if py_name is None:
+            if not _MIRRORED_PREFIX.match(c_name):
+                continue  # internal C tunable (kMaxConnOut, kT0Probe, …)
+            py_name = c_name
+        if py_name not in py.constants:
+            findings.append(Finding(
+                "wire-const",
+                f"{c_name} = {c_val} mirrors wire constant {py_name!r}, "
+                f"which {wire_rel} does not define",
+                cc_rel, c_line,
+                ((wire_rel, 1, f"no assignment to {py_name}"),)))
+            continue
+        py_val, py_line = py.constants[py_name]
+        if py_val != c_val:
+            findings.append(Finding(
+                "wire-const",
+                f"{c_name} = {c_val} disagrees with {py_name} = {py_val} "
+                f"({wire_rel}:{py_line})",
+                cc_rel, c_line,
+                ((wire_rel, py_line,
+                  f"python side defines {py_name} = {py_val}"),)))
+    return findings
+
+
+def _check_endianness(py: PyWireModel, wire_rel: str) -> list[Finding]:
+    findings = []
+    for name, (fmt, line) in sorted(py.structs.items()):
+        if not fmt.startswith("<"):
+            findings.append(Finding(
+                "wire-endian",
+                f"struct format {name} = {fmt!r} does not pin "
+                "little-endian ('<'); frontend.cc memcpy-decodes assuming "
+                "an LE wire", wire_rel, line))
+    return findings
+
+
+def _c_region(c: CWireModel, start_pat: str, end_pat: str
+              ) -> tuple[str, int] | None:
+    """Text between two regex anchors, plus the start line number."""
+    m = re.search(start_pat, c.text)
+    if m is None:
+        return None
+    m_end = re.search(end_pat, c.text[m.end():])
+    end = m.end() + (m_end.start() if m_end else len(c.text) - m.end())
+    return c.text[m.start():end], c.line_of(m.start())
+
+
+def _layout_checks(py: PyWireModel, c: CWireModel, wire_rel: str,
+                   cc_rel: str) -> list[Finding]:
+    """Cross-check frontend.cc's hand-written offset arithmetic against
+    the struct formats that define the layouts in wire.py."""
+    findings: list[Finding] = []
+
+    def mismatch(line: int, msg: str, py_struct: str) -> None:
+        py_line = py.structs.get(py_struct, ("", 1))[1]
+        findings.append(Finding(
+            "wire-layout", msg, cc_rel, line,
+            ((wire_rel, py_line,
+              f"layout defined by {py_struct} = "
+              f"{py.structs.get(py_struct, ('?',))[0]!r}"),)))
+
+    # 1. Keyed-request frame: [u16 klen][key][i32 count][f64 a][f64 b].
+    keyed = py.struct_size("_KEYED")
+    acq = py.struct_size("_ACQ_TAIL")
+    region = _c_region(c, r"case OP_ACQUIRE:", r"case OP_PING:")
+    if region and keyed is not None and acq is not None:
+        text, base = region
+        m = re.search(
+            r"len\s*!=\s*kBodyOff\s*\+\s*(\d+)\s*\+\s*size_t\(klen\)"
+            r"\s*\+\s*(\d+)", text)
+        if m is None:
+            mismatch(base, "cannot find the keyed-request length check "
+                     "(kBodyOff + <keyed> + klen + <tail>) in the "
+                     "OP_ACQUIRE case", "_ACQ_TAIL")
+        else:
+            c_keyed, c_tail = int(m.group(1)), int(m.group(2))
+            at_line = base + text.count("\n", 0, m.start())
+            if c_keyed != keyed:
+                mismatch(at_line,
+                         f"keyed header width {c_keyed} != "
+                         f"struct.calcsize(_KEYED) = {keyed}", "_KEYED")
+            if c_tail != acq:
+                mismatch(at_line,
+                         f"request tail width {c_tail} != "
+                         f"struct.calcsize(_ACQ_TAIL) = {acq}", "_ACQ_TAIL")
+        # Field reads: rd_i32(kp + klen), rd_f64(kp + klen + 4 / + 12).
+        expected = py.field_offsets("_ACQ_TAIL") or []
+        type_of = {"i": "rd_i32", "d": "rd_f64", "I": "rd_u32",
+                   "H": "rd_u16", "Q": "rd_u64"}
+        reads = [(m.group(1), int(m.group(2) or 0),
+                  base + text.count("\n", 0, m.start()))
+                 for m in re.finditer(
+                     r"(rd_\w+)\(\s*\w+\s*\+\s*klen(?:\s*\+\s*(\d+))?\s*\)",
+                     text)]
+        want = [(type_of.get(ch, "?"), off) for ch, off in expected]
+        got = [(fn, off) for fn, off, _ in reads]
+        if want != got:
+            at = reads[0][2] if reads else base
+            mismatch(at,
+                     f"keyed-request tail reads {got} do not match "
+                     f"_ACQ_TAIL field layout {want}", "_ACQ_TAIL")
+
+    # 2. Decision reply: [u8 granted][f64 remaining] == _DECISION.
+    decision = py.struct_size("_DECISION")
+    region = _c_region(c, r"std::string encode_decision",
+                       r"std::string encode_empty")
+    if region and decision is not None:
+        text, base = region
+        m = re.search(r"kBodyOff\s*\+\s*(\d+)", text)
+        if m is None or int(m.group(1)) != decision:
+            got = "absent" if m is None else m.group(1)
+            at = base if m is None else base + text.count("\n", 0, m.start())
+            mismatch(at,
+                     f"encode_decision payload width {got} != "
+                     f"struct.calcsize(_DECISION) = {decision}", "_DECISION")
+
+    # 3. Error reply: [u16 mlen][msg] — header width mirrors _KEYED.
+    region = _c_region(c, r"std::string encode_error", r"struct Item")
+    if region and keyed is not None:
+        text, base = region
+        m = re.search(r"kBodyOff\s*\+\s*(\d+)\s*\+\s*mlen", text)
+        if m is None or int(m.group(1)) != keyed:
+            got = "absent" if m is None else m.group(1)
+            at = base if m is None else base + text.count("\n", 0, m.start())
+            mismatch(at,
+                     f"encode_error length-prefix width {got} != "
+                     f"struct.calcsize(_KEYED) = {keyed}", "_KEYED")
+
+    # 4. Trace tail: [u64 hi][u64 lo][u64 parent][u8 flags] — the C parse
+    # memcpys at fixed offsets that must match _TRACE_TAIL's field table.
+    tail_fields = py.field_offsets("_TRACE_TAIL")
+    region = _c_region(c, r"if \(traced\) \{", r"if \(op == OP_ACQUIRE")
+    if region and tail_fields is not None:
+        text, base = region
+        got_offsets = sorted(
+            int(m.group(1) or 0) for m in re.finditer(
+                r"std::memcpy\(&it\.tr_\w+,\s*tp(?:\s*\+\s*(\d+))?,\s*8\)",
+                text))
+        flag_reads = [int(m.group(1))
+                      for m in re.finditer(r"tp\[(\d+)\]", text)]
+        want_q = sorted(off for ch, off in tail_fields if ch == "Q")
+        want_b = [off for ch, off in tail_fields if ch == "B"]
+        if got_offsets != want_q or sorted(set(flag_reads)) != want_b:
+            mismatch(base,
+                     f"trace-tail parse offsets u64@{got_offsets} "
+                     f"flags@{sorted(set(flag_reads))} do not match "
+                     f"_TRACE_TAIL layout u64@{want_q} flags@{want_b}",
+                     "_TRACE_TAIL")
+    return findings
+
+
+# -- ctypes ABI cross-check -------------------------------------------------
+
+_PY_SYMBOL_RE = re.compile(r"^(fe_|dir_)\w+$")
+# A C export: return type then the symbol then '(' at (possibly indented)
+# line start, inside an extern "C" region.
+_C_DEF_RE = re.compile(
+    r"^[ \t]*(?:[A-Za-z_][\w:<>]*[*\s]+)+((?:fe_|dir_)\w+)\s*\(",
+    re.MULTILINE)
+
+
+def _py_bound_symbols(native_py: pathlib.Path) -> dict[str, int]:
+    """Every ``lib.fe_*`` / ``lib.dir_*`` attribute the ctypes loader
+    touches (binding ``argtypes``/``restype`` or calling) → first line."""
+    tree = ast.parse(native_py.read_text())
+    symbols: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and _PY_SYMBOL_RE.match(node.attr)):
+            symbols.setdefault(node.attr, node.lineno)
+    return symbols
+
+
+def _c_exported_symbols(cc: pathlib.Path) -> dict[str, tuple[int, bool]]:
+    """extern-"C" definitions → (line, conditional) where ``conditional``
+    marks symbols inside ``#ifdef DRL_WITH_PYTHON`` (present only in
+    builds with CPython headers — the loader feature-detects them)."""
+    text = cc.read_text()
+    # Track the DRL_WITH_PYTHON conditional spans (no nesting in-tree).
+    cond_spans: list[tuple[int, int]] = []
+    start = None
+    depth = 0
+    for m in re.finditer(r"^[ \t]*#[ \t]*(ifdef|ifndef|if|endif)\b.*$",
+                         text, re.MULTILINE):
+        directive = m.group(1)
+        if directive in ("ifdef", "ifndef", "if"):
+            if start is None and "DRL_WITH_PYTHON" in m.group(0) \
+                    and directive == "ifdef":
+                start = m.end()
+                depth = 1
+            elif start is not None:
+                depth += 1
+        elif directive == "endif" and start is not None:
+            depth -= 1
+            if depth == 0:
+                cond_spans.append((start, m.start()))
+                start = None
+    out: dict[str, tuple[int, bool]] = {}
+    for m in _C_DEF_RE.finditer(text):
+        line = text.count("\n", 0, m.start()) + 1
+        conditional = any(s <= m.start() < e for s, e in cond_spans)
+        out.setdefault(m.group(1), (line, conditional))
+    return out
+
+
+def check_abi(native_py: pathlib.Path, cc_files: "list[pathlib.Path]",
+              root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    bound = _py_bound_symbols(native_py)
+    exported: dict[str, tuple[str, int, bool]] = {}
+    for cc in cc_files:
+        cc_rel = rel(cc, root)
+        for name, (line, cond) in _c_exported_symbols(cc).items():
+            exported.setdefault(name, (cc_rel, line, cond))
+    py_rel = rel(native_py, root)
+    for name, line in sorted(bound.items()):
+        if name not in exported:
+            findings.append(Finding(
+                "abi-export",
+                f"ctypes binds {name!r} but no native source exports it "
+                "— the binding can never resolve (or resolves against a "
+                "stale binary)", py_rel, line,
+                tuple((rel(cc, root), 1, "searched this file")
+                      for cc in cc_files)))
+    for name, (cc_rel, line, _cond) in sorted(exported.items()):
+        if name not in bound:
+            findings.append(Finding(
+                "abi-export",
+                f"native export {name!r} has no ctypes binding in "
+                f"{py_rel} — dead ABI surface nothing feature-detects",
+                cc_rel, line, ((py_rel, 1, "no lib.<symbol> reference"),)))
+    return findings
+
+
+# -- entry points -----------------------------------------------------------
+
+def check_wire(wire_py: pathlib.Path, frontend_cc: pathlib.Path,
+               root: pathlib.Path) -> list[Finding]:
+    py = extract_py_model(wire_py)
+    c = extract_c_model(frontend_cc)
+    wire_rel = rel(wire_py, root)
+    cc_rel = rel(frontend_cc, root)
+    findings = _diff_constants(py, c, wire_rel, cc_rel)
+    findings += _check_endianness(py, wire_rel)
+    findings += _layout_checks(py, c, wire_rel, cc_rel)
+    return findings
+
+
+def check(root: pathlib.Path) -> list[Finding]:
+    pkg = root / "distributedratelimiting" / "redis_tpu"
+    findings = check_wire(pkg / "runtime" / "wire.py",
+                          root / "native" / "frontend.cc", root)
+    findings += check_abi(pkg / "utils" / "native.py",
+                          [root / "native" / "frontend.cc",
+                           root / "native" / "directory.cc"], root)
+    return findings
