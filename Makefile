@@ -7,7 +7,7 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: check lint test native asan-test tsan-test
+.PHONY: check lint test native asan-test tsan-test chaos-test
 
 check: lint test asan-test tsan-test
 
@@ -28,6 +28,14 @@ lint:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 	  --continue-on-collection-errors -p no:cacheprovider
+
+# Chaos harness: the seeded fault-injection soak (docs/OPERATIONS.md §8)
+# — a live topology driven through a deterministic fault schedule, plus
+# the at-most-once retry differential. Also part of tier-1; this target
+# runs it alone, verbosely, for failure-mode work.
+chaos-test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -v \
+	  -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).
 native:

@@ -182,8 +182,10 @@ class MicroBatcher(Generic[TReq, TRes]):
                         self._flush_observer(len(batch),
                                              time.perf_counter() - t0,
                                              repr(exc), trace_id)
-                    except Exception:  # noqa: BLE001 — observer bugs must
-                        pass           # not mask the flush failure
+                    # observer bugs must not mask the flush failure
+                    # drl-check: ok(swallowed-exception)
+                    except Exception:  # noqa: BLE001
+                        pass
                 for _, fut, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
@@ -194,8 +196,10 @@ class MicroBatcher(Generic[TReq, TRes]):
             if self._flush_observer is not None:
                 try:
                     self._flush_observer(len(batch), dt, None, trace_id)
-                except Exception:  # noqa: BLE001 — an observer bug must
-                    pass  # never fail a flush that already succeeded
+                # an observer bug must never fail a flush that succeeded
+                # drl-check: ok(swallowed-exception)
+                except Exception:  # noqa: BLE001
+                    pass
             for (_, fut, _, _), res in zip(batch, results):
                 if not fut.done():  # caller may have cancelled while queued
                     fut.set_result(res)

@@ -11,7 +11,12 @@ reference's failover posture (``RedisTokenBucketRateLimiter.cs:210-215``).
 
 Format: one pickle (protocol 5 — numpy arrays serialize as raw buffers),
 written atomically via temp-file + rename so a crash mid-write leaves the
-previous checkpoint intact.
+previous checkpoint intact. Since v3 the store state is nested as its own
+pickle with a CRC-32 over those bytes, so a torn or bit-flipped file is
+detected and raised as :class:`SnapshotCorruptError` — a TYPED error
+naming the recovery path (delete the file; the store initializes empty
+and self-heals, the init-on-miss posture above) — never an opaque
+``pickle`` traceback from the middle of a server start.
 """
 
 from __future__ import annotations
@@ -19,27 +24,47 @@ from __future__ import annotations
 import os
 import pickle
 import tempfile
+import zlib
 
-__all__ = ["save_snapshot", "load_snapshot"]
+__all__ = ["save_snapshot", "load_snapshot", "SnapshotCorruptError"]
 
 _MAGIC = "drl-tpu-snapshot"
 # v1: initial format (2-tuple wtable keys, no semaphore sections).
 # v2: wtable keys widened to 3-tuples; sema_dir/semas sections added.
-# Readers accept any version in _COMPAT — a v1 snapshot restores into a
-# v2 build (restore() treats the new sections as optional); an *unknown*
-# (newer) version fails loudly here instead of as an opaque KeyError deep
-# in restore() during a rollback.
-_VERSION = 2
-_COMPAT = frozenset({1, 2})
+# v3: store state nested as its own pickle ("snapshot_pickle") with a
+#     CRC-32 checksum ("crc32") over those bytes.
+# Readers accept any version in _COMPAT — a v1/v2 snapshot restores into
+# a v3 build (no checksum to verify; restore() treats newer sections as
+# optional); an *unknown* (newer) version fails loudly here instead of as
+# an opaque KeyError deep in restore() during a rollback.
+_VERSION = 3
+_COMPAT = frozenset({1, 2, 3})
+
+#: Unpickling failure modes a torn/corrupt file produces. AttributeError/
+#: ImportError cover a payload whose pickled class moved or never existed
+#: (bit flips in the class name land here); ValueError covers truncated
+#: numpy buffer reconstruction.
+_UNPICKLE_ERRORS = (pickle.UnpicklingError, EOFError, AttributeError,
+                    ImportError, IndexError, ValueError)
+
+
+class SnapshotCorruptError(ValueError):
+    """The checkpoint file is torn or corrupt (truncated write, bit
+    flip, checksum mismatch). Recovery: delete the file and restart —
+    the store initializes empty and self-heals to full buckets, the
+    documented init-on-miss posture. Subclasses :class:`ValueError` so
+    pre-typed catches keep working."""
 
 
 def save_snapshot(store, path: str) -> None:
     """Pull ``store``'s live state to host and write it to ``path``
     atomically."""
+    snap_bytes = pickle.dumps(store.snapshot(), protocol=5)
     payload = {
         "magic": _MAGIC,
         "version": _VERSION,
-        "snapshot": store.snapshot(),
+        "crc32": zlib.crc32(snap_bytes),
+        "snapshot_pickle": snap_bytes,
     }
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".snapshot-")
@@ -61,14 +86,44 @@ def load_snapshot(store, path: str) -> None:
     """Restore ``store`` from a checkpoint file written by
     :func:`save_snapshot`. Timestamps re-align to this process's clock
     epoch inside ``store.restore``. Only load files you wrote — the format
-    is pickle (trusted-operator checkpoint, not an interchange format)."""
+    is pickle (trusted-operator checkpoint, not an interchange format).
+
+    Raises :class:`SnapshotCorruptError` for a torn or bit-flipped file
+    (including a v3 checksum mismatch) and plain :class:`ValueError` for
+    a file that is simply not a snapshot or speaks an unknown newer
+    version."""
     with open(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload.get("magic") != _MAGIC:
+        try:
+            payload = pickle.load(f)
+        except _UNPICKLE_ERRORS as exc:
+            raise SnapshotCorruptError(
+                f"{path} is torn or corrupt ({exc!r}); delete it to fall "
+                "back to init-on-miss (state self-heals to full buckets)"
+            ) from exc
+    if not isinstance(payload, dict) or payload.get("magic") != _MAGIC:
         raise ValueError(f"{path} is not a rate-limiter snapshot")
     if payload.get("version") not in _COMPAT:
         raise ValueError(
             f"snapshot version {payload.get('version')} not supported "
             f"(this build reads {sorted(_COMPAT)})"
         )
-    store.restore(payload["snapshot"])
+    if "snapshot_pickle" in payload:  # v3: verify before unpickling
+        blob = payload["snapshot_pickle"]
+        crc = zlib.crc32(blob)
+        if crc != payload.get("crc32"):
+            raise SnapshotCorruptError(
+                f"{path} failed its checksum (crc32 {crc:#010x} != "
+                f"recorded {payload.get('crc32', 0):#010x}); delete it "
+                "to fall back to init-on-miss")
+        try:
+            snap = pickle.loads(blob)
+        except _UNPICKLE_ERRORS as exc:  # pragma: no cover — crc catches
+            raise SnapshotCorruptError(                 # almost all of these
+                f"{path} snapshot body is corrupt ({exc!r})") from exc
+    else:  # v1/v2: the state rides in the outer pickle, no checksum
+        if "snapshot" not in payload:
+            raise SnapshotCorruptError(
+                f"{path} carries neither a v3 snapshot body nor a "
+                "v1/v2 'snapshot' section")
+        snap = payload["snapshot"]
+    store.restore(snap)

@@ -35,13 +35,37 @@ Semantics carried over from the single-node client:
   node — every client instance syncs the same named counter against the
   same node's clock, preserving the EWMA instance-count estimate
   unchanged.
+
+The chaos plane (docs/OPERATIONS.md §8) adds per-node **circuit
+breakers** and a **degraded-mode fallback** on top:
+
+- ``breaker=True`` (or a :class:`~..utils.resilience.BreakerConfig`)
+  gives each node a closed/open/half-open breaker. While OPEN the
+  node's keyspace is never dialed — callers shed fast
+  (:class:`NodeUnavailableError`) instead of queueing behind a dead
+  peer's timeout; after the recovery window ONE request probes the node
+  with a health op (``ping``) and a success re-closes it (rejoin).
+- ``degraded_fallback=True`` serves a quarantined node's admission
+  traffic from a client-local fair-share envelope instead of erroring:
+  each key admits against ``headroom_budget(capacity,
+  fraction=degraded_fraction)`` tokens refilled at ``fraction ×
+  fill_rate`` — the approximate limiter's confidence policy re-used at
+  the cluster edge, so over-admission during an outage window stays
+  bounded by the same ``overadmit_epsilon`` family of formulas. The
+  degraded state is DISCARDED when the node rejoins: the authoritative
+  store rules again (the reference's wiped-state self-heal posture).
+- Every node failure is a structured log event (id 3) plus a
+  ``cluster_node_errors`` counter; breaker transitions are event id 4,
+  flight-recorder frames, and OpenMetrics gauges
+  (:meth:`metrics_registry`) — partitions are visible, not invisible.
 """
 
 from __future__ import annotations
 
 import asyncio
 import threading
-from typing import Sequence
+import time
+from typing import Callable, Sequence
 
 import numpy as np
 
@@ -58,8 +82,79 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     SyncResult,
 )
 from distributedratelimiting.redis_tpu.utils import log, tracing
+from distributedratelimiting.redis_tpu.utils.resilience import (
+    BreakerConfig,
+    CircuitBreaker,
+)
 
-__all__ = ["ClusterBucketStore"]
+__all__ = ["ClusterBucketStore", "NodeUnavailableError"]
+
+
+class NodeUnavailableError(ConnectionError):
+    """The key's owning node is quarantined (circuit open) and no
+    degraded fallback is configured — shed fast, by design."""
+
+
+class _DegradedKeyspace:
+    """Client-local fair-share admission for keys whose owning node is
+    quarantined.
+
+    Each ``(node, key, config)`` serves from a conservative local
+    envelope: ``headroom_budget(capacity, fraction)`` tokens refilled at
+    ``fraction × fill_rate`` — the same confidence policy the
+    approximate limiter and the tier-0 edge cache use, re-hosted at the
+    cluster edge (models/approximate.py's shared-formula discipline).
+    Windows degrade as token buckets with ``(limit, limit/window)``.
+    State is per-client and DISCARDED on rejoin (``clear_node``): when
+    the authoritative node returns, its state rules — the wiped-state
+    self-heal posture of the reference.
+    """
+
+    #: Bounded memory under hostile key cardinality: oldest-inserted
+    #: entries evict first (a re-touched key re-inserts at full budget —
+    #: conservative only in the over-admission direction by one budget,
+    #: which the epsilon bound already charges for).
+    _MAX_KEYS = 1 << 16
+
+    def __init__(self, fraction: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError("degraded_fraction must be in (0, 1]")
+        self._fraction = fraction
+        self._clock = clock
+        self._buckets: dict[tuple, tuple[float, float]] = {}
+
+    def acquire(self, node: int, key: str, count: int, capacity: float,
+                fill_rate_per_sec: float) -> AcquireResult:
+        from distributedratelimiting.redis_tpu.models.approximate import (
+            headroom_budget,
+        )
+
+        budget = headroom_budget(capacity, fraction=self._fraction,
+                                 min_budget=1.0)
+        now = self._clock()
+        k = (node, key, float(capacity), float(fill_rate_per_sec))
+        entry = self._buckets.get(k)
+        if entry is None:
+            if len(self._buckets) >= self._MAX_KEYS:
+                self._buckets.pop(next(iter(self._buckets)))
+            tokens = budget
+        else:
+            tokens, ts = entry
+            tokens = min(budget, tokens + (now - ts)
+                         * fill_rate_per_sec * self._fraction)
+        granted = tokens >= count
+        if granted and count > 0:
+            tokens -= count
+        self._buckets[k] = (tokens, now)
+        return AcquireResult(bool(granted), float(max(tokens, 0.0)))
+
+    def clear_node(self, node: int) -> None:
+        for k in [k for k in self._buckets if k[0] == node]:
+            del self._buckets[k]
+
+    def __len__(self) -> int:
+        return len(self._buckets)
 
 
 class ClusterBucketStore(BucketStore):
@@ -76,6 +171,14 @@ class ClusterBucketStore(BucketStore):
     ``remote_kwargs`` (auth token, timeouts, coalescing knobs …) pass
     through to each constructed :class:`RemoteBucketStore` when addresses
     or urls are given.
+
+    Resilience knobs (all off by default — behavior is then exactly the
+    pre-chaos-plane cluster): ``breaker`` arms per-node circuit
+    breakers, ``degraded_fallback`` serves quarantined keyspaces from
+    the local fair-share envelope, ``flight_recorder`` receives breaker
+    and node-error frames. Breaker state mutates under the GIL from
+    whichever loop carries the request — transitions are coarse
+    (per-node, per-failure) and tolerate that by construction.
     """
 
     def __init__(
@@ -86,6 +189,12 @@ class ClusterBucketStore(BucketStore):
         urls: Sequence[str] | None = None,
         partial_failures: str = "raise",
         clock: Clock | None = None,
+        breaker: "BreakerConfig | bool | None" = None,
+        breaker_clock: Callable[[], float] = time.monotonic,
+        degraded_fallback: bool = False,
+        degraded_fraction: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        flight_recorder=None,
         **remote_kwargs,
     ) -> None:
         if stores is not None:
@@ -108,6 +217,28 @@ class ClusterBucketStore(BucketStore):
         # only); each NODE is the time authority for the keys it owns.
         self.clock = clock or MonotonicClock()
 
+        # -- chaos plane ---------------------------------------------------
+        self.flight_recorder = flight_recorder
+        self._degraded = (_DegradedKeyspace(degraded_fraction)
+                          if degraded_fallback else None)
+        if breaker:
+            config = breaker if isinstance(breaker, BreakerConfig) \
+                else BreakerConfig()
+            self._breakers: "list[CircuitBreaker] | None" = [
+                self._make_breaker(j, config, breaker_clock)
+                for j in range(self.n_nodes)]
+        else:
+            self._breakers = None
+        self._probe_timeout_s = probe_timeout_s
+        #: Per-node store-operation failures (satellite: partitions are
+        #: visible — every increment pairs with log event id 3).
+        self.node_errors = [0] * self.n_nodes
+        #: Requests failed fast against quarantined nodes (no fallback).
+        self.shed = 0
+        #: Decisions served by the local degraded fallback.
+        self.degraded_decisions = 0
+        self._registry = None
+
         # Background loop for the blocking surface (same pattern as
         # RemoteBucketStore): lets blocking callers fan out to all nodes
         # concurrently from any thread, loop or no loop.
@@ -116,11 +247,122 @@ class ClusterBucketStore(BucketStore):
         self._thread_gate = threading.Lock()
         self._closed = False
 
+    @property
+    def _resilient(self) -> bool:
+        return self._breakers is not None or self._degraded is not None
+
+    def _make_breaker(self, j: int, config: BreakerConfig,
+                      clock: Callable[[], float]) -> CircuitBreaker:
+        def on_transition(old: str, new: str) -> None:
+            log.breaker_transition(j, old, new)
+            if self.flight_recorder is not None:
+                self.flight_recorder.record("breaker", node=j, old=old,
+                                            new=new)
+                if new == CircuitBreaker.OPEN:
+                    self.flight_recorder.auto_dump("breaker_open",
+                                                   {"node": j})
+            if new == CircuitBreaker.CLOSED and self._degraded is not None:
+                # Rejoin: the authoritative node rules again; local
+                # degraded state self-heals away (wiped-state posture).
+                self._degraded.clear_node(j)
+
+        return CircuitBreaker(config, clock=clock,
+                              on_transition=on_transition)
+
     # -- routing -----------------------------------------------------------
     def node_of(self, key: str) -> BucketStore:
         """The node that owns ``key`` (stable crc32 — every client on every
         host routes identically, no coordination)."""
         return self.nodes[shard_of_key(key, self.n_nodes)]
+
+    # -- failure bookkeeping -------------------------------------------------
+    def _note_node_error(self, j: int, exc: BaseException) -> None:
+        """Every SERVING-path node failure funnels here: counter +
+        structured log (event id 3) + breaker failure + flight-recorder
+        frame. Nothing is silently swallowed (the old ``except: pass``
+        posture). Diagnostics scrapes use :meth:`_note_scrape_error`
+        instead — a failed scrape is visible but must not advance the
+        breaker that gates admission traffic."""
+        self._note_scrape_error(j, exc)
+        if self._breakers is not None:
+            self._breakers[j].record_failure()
+        if self.flight_recorder is not None:
+            self.flight_recorder.record("node_error", node=j,
+                                        error=repr(exc))
+
+    def _note_scrape_error(self, j: int, exc: BaseException) -> None:
+        """Counter + log for a failed metrics/stats scrape (no breaker,
+        no flight frame — see :meth:`_note_node_error`)."""
+        self.node_errors[j] += 1
+        log.cluster_node_error(j, exc)
+
+    def _shed_or_fallback(self, j: int, fallback):
+        """The quarantined-node decision: serve the degraded fallback
+        when configured, else shed fast with a typed error."""
+        if fallback is None or self._degraded is None:
+            self.shed += 1
+            raise NodeUnavailableError(
+                f"cluster node {j} is quarantined (circuit open)")
+        self.degraded_decisions += 1
+        return fallback()
+
+    async def _probe(self, j: int) -> bool:
+        """Half-open health probe: ping the node (nodes without a ping
+        surface let the real request itself settle the probe). Returns
+        whether the node may be used for the request that won the
+        probe slot."""
+        node = self.nodes[j]
+        assert self._breakers is not None
+        ping = getattr(node, "ping", None)
+        if not callable(ping):
+            return True
+        try:
+            try:
+                coro = ping(timeout_s=self._probe_timeout_s)
+            except TypeError:  # in-process nodes: plain ping()
+                coro = ping()
+            await coro
+        except asyncio.CancelledError:
+            # Cancellation is no verdict on the node: free the slot so
+            # the next caller probes instead of rejecting forever.
+            self._breakers[j].release_probe()
+            raise
+        except Exception as exc:
+            self._note_node_error(j, exc)  # records the breaker failure
+            return False                   # → back to OPEN
+        self._breakers[j].record_success()
+        return True
+
+    async def _guarded_call(self, j: int, call, fallback=None):
+        """Run one node operation under the node's breaker: OPEN sheds
+        (or serves the fallback), HALF_OPEN probes first, failures are
+        noted (counter + log + breaker) and — when a fallback exists —
+        absorbed into a degraded decision instead of an error."""
+        br = self._breakers[j] if self._breakers is not None else None
+        if br is not None:
+            verdict = br.allow()
+            if verdict == "probe" and not await self._probe(j):
+                verdict = "reject"
+            if verdict == "reject":
+                return self._shed_or_fallback(j, fallback)
+        try:
+            res = await call()
+        except asyncio.CancelledError:
+            if br is not None:
+                # The probe-winning request may be the one cancelled (a
+                # ping-less node settles via the real call): free the
+                # slot — no-op otherwise.
+                br.release_probe()
+            raise
+        except Exception as exc:
+            self._note_node_error(j, exc)
+            if fallback is not None and self._degraded is not None:
+                self.degraded_decisions += 1
+                return fallback()
+            raise
+        if br is not None:
+            br.record_success()
+        return res
 
     # -- blocking-surface plumbing ------------------------------------------
     def _ensure_loop(self) -> asyncio.AbstractEventLoop:
@@ -181,23 +423,51 @@ class ClusterBucketStore(BucketStore):
             if isinstance(out, BaseException):
                 raise out
 
-    # -- single-key ops: route and forward ----------------------------------
+    # -- single-key ops: route, guard, forward -------------------------------
     async def acquire(self, key: str, count: int, capacity: float,
                       fill_rate_per_sec: float) -> AcquireResult:
-        return await self.node_of(key).acquire(key, count, capacity,
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            return await self.nodes[j].acquire(key, count, capacity,
                                                fill_rate_per_sec)
+        return await self._guarded_call(
+            j,
+            lambda: self.nodes[j].acquire(key, count, capacity,
+                                          fill_rate_per_sec),
+            fallback=lambda: self._degraded.acquire(
+                j, key, count, capacity, fill_rate_per_sec))
 
     def acquire_blocking(self, key: str, count: int, capacity: float,
                          fill_rate_per_sec: float) -> AcquireResult:
+        if self._resilient:
+            return self._blocking(self.acquire(key, count, capacity,
+                                               fill_rate_per_sec))
         return self.node_of(key).acquire_blocking(key, count, capacity,
                                                   fill_rate_per_sec)
 
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
+        # No degraded value exists for a peek — it reports the
+        # AUTHORITATIVE balance; a quarantined node surfaces the typed
+        # shed error instead of a made-up number.
+        if self._breakers is not None:
+            j = shard_of_key(key, self.n_nodes)
+            if self._breakers[j].quarantined():
+                self.shed += 1
+                raise NodeUnavailableError(
+                    f"cluster node {j} is quarantined (circuit open)")
         return self.node_of(key).peek_blocking(key, capacity,
                                                fill_rate_per_sec)
 
     def acquire_submitter(self, capacity: float, fill_rate_per_sec: float):
+        if self._resilient:
+            # The guarded path costs a route + breaker check per
+            # request; resilience was asked for explicitly.
+            async def submit(key: str, count: int) -> AcquireResult:
+                return await self.acquire(key, count, capacity,
+                                          fill_rate_per_sec)
+
+            return submit
         # Hoist per-node submitters once; per request only the route runs.
         subs = [n.acquire_submitter(capacity, fill_rate_per_sec)
                 for n in self.nodes]
@@ -210,48 +480,104 @@ class ClusterBucketStore(BucketStore):
 
     async def sync_counter(self, key: str, local_count: float,
                            decay_rate_per_sec: float) -> SyncResult:
-        return await self.node_of(key).sync_counter(key, local_count,
+        # No fallback on purpose: the approximate limiter OWNS its
+        # degraded mode (keep serving from the last-known global score);
+        # it needs the error, not a made-up sync result.
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            return await self.nodes[j].sync_counter(key, local_count,
                                                     decay_rate_per_sec)
+        return await self._guarded_call(
+            j, lambda: self.nodes[j].sync_counter(key, local_count,
+                                                  decay_rate_per_sec))
 
     def sync_counter_blocking(self, key: str, local_count: float,
                               decay_rate_per_sec: float) -> SyncResult:
+        if self._resilient:
+            return self._blocking(self.sync_counter(key, local_count,
+                                                    decay_rate_per_sec))
         return self.node_of(key).sync_counter_blocking(key, local_count,
                                                        decay_rate_per_sec)
 
     async def window_acquire(self, key: str, count: int, limit: float,
                              window_sec: float) -> AcquireResult:
-        return await self.node_of(key).window_acquire(key, count, limit,
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            return await self.nodes[j].window_acquire(key, count, limit,
                                                       window_sec)
+        return await self._guarded_call(
+            j,
+            lambda: self.nodes[j].window_acquire(key, count, limit,
+                                                 window_sec),
+            fallback=lambda: self._degraded.acquire(
+                j, key, count, limit, limit / window_sec))
 
     def window_acquire_blocking(self, key: str, count: int, limit: float,
                                 window_sec: float) -> AcquireResult:
+        if self._resilient:
+            return self._blocking(self.window_acquire(key, count, limit,
+                                                      window_sec))
         return self.node_of(key).window_acquire_blocking(key, count, limit,
                                                          window_sec)
 
     async def fixed_window_acquire(self, key: str, count: int, limit: float,
                                    window_sec: float) -> AcquireResult:
-        return await self.node_of(key).fixed_window_acquire(
-            key, count, limit, window_sec)
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            return await self.nodes[j].fixed_window_acquire(
+                key, count, limit, window_sec)
+        return await self._guarded_call(
+            j,
+            lambda: self.nodes[j].fixed_window_acquire(key, count, limit,
+                                                       window_sec),
+            fallback=lambda: self._degraded.acquire(
+                j, key, count, limit, limit / window_sec))
 
     def fixed_window_acquire_blocking(self, key: str, count: int,
                                       limit: float,
                                       window_sec: float) -> AcquireResult:
+        if self._resilient:
+            return self._blocking(self.fixed_window_acquire(
+                key, count, limit, window_sec))
         return self.node_of(key).fixed_window_acquire_blocking(
             key, count, limit, window_sec)
 
     async def concurrency_acquire(self, key: str, count: int,
                                   limit: int) -> AcquireResult:
-        return await self.node_of(key).concurrency_acquire(key, count, limit)
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            return await self.nodes[j].concurrency_acquire(key, count,
+                                                           limit)
+        # Semaphores are strict: a made-up degraded grant could exceed
+        # the concurrency limit the moment the node returns. Deny.
+        return await self._guarded_call(
+            j,
+            lambda: self.nodes[j].concurrency_acquire(key, count, limit),
+            fallback=lambda: AcquireResult(False, 0.0))
 
     def concurrency_acquire_blocking(self, key: str, count: int,
                                      limit: int) -> AcquireResult:
+        if self._resilient:
+            return self._blocking(self.concurrency_acquire(key, count,
+                                                           limit))
         return self.node_of(key).concurrency_acquire_blocking(key, count,
                                                               limit)
 
     async def concurrency_release(self, key: str, count: int) -> None:
-        await self.node_of(key).concurrency_release(key, count)
+        j = shard_of_key(key, self.n_nodes)
+        if not self._resilient:
+            await self.nodes[j].concurrency_release(key, count)
+            return
+        # A release against a quarantined node is absorbed (None): the
+        # node's semaphore state resets with it anyway (init-on-miss).
+        await self._guarded_call(
+            j, lambda: self.nodes[j].concurrency_release(key, count),
+            fallback=lambda: None)
 
     def concurrency_release_blocking(self, key: str, count: int) -> None:
+        if self._resilient:
+            self._blocking(self.concurrency_release(key, count))
+            return
         self.node_of(key).concurrency_release_blocking(key, count)
 
     # -- bulk ops: split by route, fan out, merge ---------------------------
@@ -271,15 +597,43 @@ class ClusterBucketStore(BucketStore):
                                  np.arange(self.n_nodes + 1))
         return order, bounds, keys
 
-    async def _bulk_fan_out(self, keys, counts, call, with_remaining: bool
-                            ) -> BulkAcquireResult:
+    def _bulk_degraded(self, j: int, sub_keys, sub_counts,
+                       degraded_row) -> BulkAcquireResult:
+        """Serve one node's bulk rows from the degraded fallback (a
+        Python loop — this is the outage path, not the hot path)."""
+        n = len(sub_keys)
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32)
+        for i, (k, c) in enumerate(zip(sub_keys, sub_counts)):
+            res = degraded_row(j, k, int(c))
+            granted[i] = res.granted
+            remaining[i] = res.remaining
+        self.degraded_decisions += n
+        return BulkAcquireResult(granted, remaining)
+
+    def _bulk_reject(self, j: int, sub_keys, sub_counts, degraded_row
+                     ) -> "BulkAcquireResult | None":
+        """A quarantined node's bulk group: degraded rows when possible,
+        else the partial_failures contract ('deny' → None, rows stay
+        denied; 'raise' → typed shed error)."""
+        if degraded_row is not None and self._degraded is not None:
+            return self._bulk_degraded(j, sub_keys, sub_counts,
+                                       degraded_row)
+        self.shed += len(sub_keys)
+        if self._partial_failures == "raise":
+            raise NodeUnavailableError(
+                f"cluster node {j} is quarantined (circuit open)")
+        return None
+
+    async def _bulk_fan_out(self, keys, counts, call, with_remaining: bool,
+                            degraded_row=None) -> BulkAcquireResult:
         n = len(keys)
         if n == 0:
             return BulkAcquireResult(
                 np.zeros(0, bool),
                 np.zeros(0, np.float32) if with_remaining else None)
         counts_np = np.asarray(counts, np.int64)
-        if self.n_nodes == 1:
+        if self.n_nodes == 1 and not self._resilient:
             return await call(self.nodes[0], keys, counts_np)
         order, bounds, keys = self._split(keys)
 
@@ -300,6 +654,7 @@ class ClusterBucketStore(BucketStore):
         async def node_call(j: int, lo: int, hi: int):
             idx = order[lo:hi]
             sub_keys = [keys[i] for i in idx]
+            sub_counts = counts_np[idx]
             # One child span per node: the fan-out share of a traced bulk
             # call decomposes into which node was slow.
             nspan = (tracer.start_span("cluster.node", parent=fctx,
@@ -307,15 +662,37 @@ class ClusterBucketStore(BucketStore):
                                               "rows": int(hi - lo)})
                      if fctx is not None else tracing._NULL_SPAN)
             with nspan:
+                br = (self._breakers[j] if self._breakers is not None
+                      else None)
+                if br is not None:
+                    verdict = br.allow()
+                    if verdict == "probe" and not await self._probe(j):
+                        verdict = "reject"
+                    if verdict == "reject":
+                        nspan.set_status("degraded")
+                        nspan.set_attr("breaker", br.state)
+                        return self._bulk_reject(j, sub_keys, sub_counts,
+                                                 degraded_row)
                 try:
-                    return await call(self.nodes[j], sub_keys,
-                                      counts_np[idx])
+                    out = await call(self.nodes[j], sub_keys, sub_counts)
+                except asyncio.CancelledError:
+                    if br is not None:
+                        br.release_probe()  # no-op unless we held it
+                    raise
                 except Exception as exc:
+                    self._note_node_error(j, exc)
+                    nspan.set_status("degraded")
+                    if degraded_row is not None \
+                            and self._degraded is not None:
+                        return self._bulk_degraded(j, sub_keys,
+                                                   sub_counts,
+                                                   degraded_row)
                     if self._partial_failures == "raise":
                         raise
-                    nspan.set_status("degraded")
-                    log.could_not_connect_to_store(exc)
                     return None  # rows stay denied
+                if br is not None:
+                    br.record_success()
+                return out
 
         with fspan:
             outs = await asyncio.gather(*(node_call(*t) for t in live))
@@ -339,7 +716,12 @@ class ClusterBucketStore(BucketStore):
                 sub_keys, sub_counts, capacity, fill_rate_per_sec,
                 with_remaining=with_remaining)
 
-        return await self._bulk_fan_out(keys, counts, call, with_remaining)
+        degraded_row = (
+            (lambda j, k, c: self._degraded.acquire(
+                j, k, c, capacity, fill_rate_per_sec))
+            if self._degraded is not None else None)
+        return await self._bulk_fan_out(keys, counts, call, with_remaining,
+                                        degraded_row)
 
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], capacity: float,
@@ -360,7 +742,12 @@ class ClusterBucketStore(BucketStore):
                 sub_keys, sub_counts, limit, window_sec, fixed=fixed,
                 with_remaining=with_remaining)
 
-        return await self._bulk_fan_out(keys, counts, call, with_remaining)
+        degraded_row = (
+            (lambda j, k, c: self._degraded.acquire(
+                j, k, c, limit, limit / window_sec))
+            if self._degraded is not None else None)
+        return await self._bulk_fan_out(keys, counts, call, with_remaining,
+                                        degraded_row)
 
     def window_acquire_many_blocking(self, keys: Sequence[str],
                                      counts: Sequence[int], limit: float,
@@ -382,6 +769,65 @@ class ClusterBucketStore(BucketStore):
         await asyncio.gather(*(n.save() for n in self.nodes
                                if hasattr(n, "save")))
 
+    # -- metrics -------------------------------------------------------------
+    def metrics_registry(self):
+        """The cluster client's own OpenMetrics families: per-node error
+        counters and breaker state, shed / degraded decision counters,
+        and the wire clients' retry/timeout sums. Appended to the fleet
+        scrape by :meth:`cluster_metrics`."""
+        from distributedratelimiting.redis_tpu.utils.metrics import (
+            MetricsRegistry,
+        )
+
+        if self._registry is not None:
+            return self._registry
+        reg = MetricsRegistry()
+        for j in range(self.n_nodes):
+            reg.counter("cluster_node_errors",
+                        "Store-operation failures per cluster node",
+                        lambda j=j: self.node_errors[j],
+                        labels={"node": str(j)})
+        if self._breakers is not None:
+            for j, br in enumerate(self._breakers):
+                reg.gauge("cluster_breaker_state",
+                          "Circuit state per node: 0 closed, 1 "
+                          "half-open, 2 open",
+                          br.state_gauge, labels={"node": str(j)})
+                reg.counter("cluster_breaker_opens",
+                            "Times the node's circuit tripped open",
+                            lambda b=br: b.opens,
+                            labels={"node": str(j)})
+                reg.counter("cluster_breaker_probes",
+                            "Half-open probes admitted",
+                            lambda b=br: b.probes,
+                            labels={"node": str(j)})
+        reg.counter("cluster_shed",
+                    "Requests failed fast against quarantined nodes",
+                    lambda: self.shed)
+        reg.counter("cluster_degraded_decisions",
+                    "Decisions served by the local fair-share fallback",
+                    lambda: self.degraded_decisions)
+        reg.gauge("cluster_degraded_keys",
+                  "Keys currently held by the degraded fallback",
+                  lambda: (len(self._degraded)
+                           if self._degraded is not None else 0))
+        reg.counter("cluster_client_retries",
+                    "Wire-client retries, summed over nodes",
+                    lambda: self._sum_node_stat("retries"))
+        reg.counter("cluster_client_timeouts",
+                    "Wire-client request timeouts, summed over nodes",
+                    lambda: self._sum_node_stat("timeouts"))
+        self._registry = reg
+        return reg
+
+    def _sum_node_stat(self, key: str) -> int:
+        total = 0
+        for n in self.nodes:
+            stats_fn = getattr(n, "resilience_stats", None)
+            if callable(stats_fn):
+                total += stats_fn().get(key, 0)
+        return total
+
     async def cluster_metrics(self) -> str:
         """Fleet-wide OpenMetrics exposition: scrape every node's
         ``OP_METRICS`` text and merge — each sample re-emitted per node
@@ -390,12 +836,13 @@ class ClusterBucketStore(BucketStore):
         one scrape answers both "what is the fleet doing" and "which
         node is the outlier". Nodes without a metrics surface (bare
         in-process stores in tests) contribute nothing rather than
-        failing the scrape."""
+        failing the scrape. The cluster client's own resilience families
+        (breakers, shed, retries) are appended after the merge."""
         from distributedratelimiting.redis_tpu.utils.metrics import (
             aggregate_openmetrics,
         )
 
-        async def one(n: BucketStore) -> str:
+        async def one(j: int, n: BucketStore) -> str:
             # callable check: on device stores `metrics` is the
             # StoreMetrics ATTRIBUTE, not the remote scrape method.
             if not callable(getattr(n, "metrics", None)):
@@ -403,11 +850,21 @@ class ClusterBucketStore(BucketStore):
             try:
                 return await n.metrics()
             except Exception as exc:  # a down node must not kill the
-                log.could_not_connect_to_store(exc)  # fleet scrape
+                # fleet scrape — but it must be SEEN, not swallowed.
+                self._note_scrape_error(j, exc)
                 return ""
 
-        texts = await asyncio.gather(*(one(n) for n in self.nodes))
-        return aggregate_openmetrics(texts)
+        texts = await asyncio.gather(*(one(j, n)
+                                       for j, n in enumerate(self.nodes)))
+        merged = aggregate_openmetrics(texts)
+        own = self.metrics_registry().render()
+        # Both are complete expositions; splice ours before the EOF
+        # terminator (families stay contiguous — each side emits its
+        # own distinct family names).
+        eof = "# EOF\n"
+        if merged.endswith(eof):
+            merged = merged[:-len(eof)]
+        return merged + own
 
     def cluster_metrics_blocking(self) -> str:
         return self._blocking(self.cluster_metrics())
@@ -415,19 +872,43 @@ class ClusterBucketStore(BucketStore):
     async def stats(self) -> dict:
         """Per-node stats plus cluster-level sums of the numeric metrics.
         ``nodes[j]`` is positionally node ``j``'s stats (``{}`` for nodes
-        without a stats surface) — consumers correlate by index."""
+        without a stats surface) — consumers correlate by index. The
+        ``resilience`` section carries breaker snapshots and the chaos
+        counters."""
 
-        async def one(n: BucketStore) -> dict:
-            return await n.stats() if hasattr(n, "stats") else {}
+        async def one(j: int, n: BucketStore) -> dict:
+            if not hasattr(n, "stats"):
+                return {}
+            try:
+                return await n.stats()
+            except Exception as exc:
+                # A down node must not kill the fleet stats — an ops
+                # surface that dies DURING the outage it should be
+                # describing. Visible (event 3 + counter), not silent.
+                self._note_scrape_error(j, exc)
+                return {}
 
-        per_node = await asyncio.gather(*(one(n) for n in self.nodes))
+        per_node = await asyncio.gather(*(one(j, n)
+                                          for j, n in
+                                          enumerate(self.nodes)))
         total: dict = {}
         for s in per_node:
             for k, v in s.items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     total[k] = total.get(k, 0) + v
-        return {"n_nodes": self.n_nodes, "nodes": list(per_node),
-                "total": total}
+        out = {"n_nodes": self.n_nodes, "nodes": list(per_node),
+               "total": total}
+        resilience: dict = {
+            "node_errors": list(self.node_errors),
+            "shed": self.shed,
+            "degraded_decisions": self.degraded_decisions,
+        }
+        if self._breakers is not None:
+            resilience["breakers"] = [b.snapshot() for b in self._breakers]
+        if self._degraded is not None:
+            resilience["degraded_keys"] = len(self._degraded)
+        out["resilience"] = resilience
+        return out
 
     # -- checkpoint ----------------------------------------------------------
     def snapshot(self) -> dict:

@@ -246,6 +246,13 @@ def make_directory(n_slots: int) -> KeyDirectory:
     if lib is not None:
         try:
             return NativeKeyDirectory(n_slots, lib)
-        except Exception:
-            pass
+        except Exception as exc:
+            # The Python directory is a full functional fallback, but a
+            # silently slower serving path is the kind of invisible
+            # degradation the chaos plane exists to surface.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native key directory unavailable (%r); falling back to "
+                "the Python directory", exc)
     return PyKeyDirectory(n_slots)

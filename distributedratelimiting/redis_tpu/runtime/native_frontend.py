@@ -36,7 +36,7 @@ import numpy as np
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
-from distributedratelimiting.redis_tpu.utils import log, tracing
+from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.metrics import (
     LatencyHistogram,
     Tier0Metrics,
@@ -229,6 +229,9 @@ class NativeFrontend:
                     try:
                         self._lib.fe_fail(self._h, self._lib.fe_batch_id(
                             self._h), repr(exc)[:200].encode())
+                    # the batch failure above was already logged;
+                    # fe_fail itself dying adds nothing
+                    # drl-check: ok(swallowed-exception)
                     except Exception:  # noqa: BLE001
                         pass
 
@@ -577,6 +580,10 @@ class NativeFrontend:
                 if not merged:
                     self._t0_fail_streak = 0
                     continue
+                if faults._INJECTOR is not None:  # chaos seam: a fault
+                    # here fails the round — harvested rows re-carry via
+                    # the finally, the degraded streak advances.
+                    await faults._INJECTOR.on_event("t0.sync")
                 by_cfg: dict[tuple[float, float], list[tuple[str, float]]] = {}
                 for (key, cap, rate), amount in merged.items():
                     by_cfg.setdefault((cap, rate), []).append((key, amount))

@@ -35,6 +35,7 @@ and blocking callers (from any thread).
 from __future__ import annotations
 
 import asyncio
+import random
 import threading
 from typing import Awaitable, Callable, Sequence
 
@@ -48,14 +49,33 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     BulkAcquireResult,
     SyncResult,
 )
-from distributedratelimiting.redis_tpu.utils import log, tracing
+from distributedratelimiting.redis_tpu.utils import faults, log, tracing
+from distributedratelimiting.redis_tpu.utils.resilience import RetryPolicy
 from distributedratelimiting.redis_tpu.utils.tracing import Profiler, ProfilingSession
 
-__all__ = ["RemoteBucketStore"]
+__all__ = ["RemoteBucketStore", "StoreTimeoutError"]
 
 ConnectionFactory = Callable[
     [], Awaitable[tuple[asyncio.StreamReader, asyncio.StreamWriter]]
 ]
+
+
+class StoreTimeoutError(asyncio.TimeoutError):
+    """The store did not answer within the request timeout.
+
+    Typed so callers can tell "the STORE went quiet" apart from their
+    own ``asyncio.wait_for`` deadlines (it still subclasses
+    :class:`asyncio.TimeoutError`, so existing catches keep working).
+    Never retried by the client: the frame was sent, and whether it was
+    executed is unknowable — the at-most-once contract (docs/DESIGN.md
+    §11) forbids replaying it."""
+
+
+#: Ops safe to retry even after their frame may have reached the wire:
+#: executing them twice changes no admission state. Everything else —
+#: ACQUIRE, WINDOW, FWINDOW, SEMA, SYNC, mutating STATS/TRACES flags —
+#: retries only on provably-never-sent failures (connect phase).
+_IDEMPOTENT_OPS = frozenset((wire.OP_PEEK, wire.OP_PING, wire.OP_METRICS))
 
 
 class RemoteBucketStore(BucketStore):
@@ -82,6 +102,11 @@ class RemoteBucketStore(BucketStore):
         coalesce_requests: bool = True,
         coalesce_max_batch: int = 512,
         coalesce_max_delay_s: float = 200e-6,
+        retry_policy: "RetryPolicy | None" = RetryPolicy(),
+        reconnect_backoff_base_s: float = 0.05,
+        reconnect_backoff_max_s: float = 5.0,
+        propagate_deadlines: bool = False,
+        resilience_seed: int | None = None,
     ) -> None:
         if connection_factory is None and address is None and url is None:
             # ≙ the reference's ctor validation "some Redis config present"
@@ -112,6 +137,34 @@ class RemoteBucketStore(BucketStore):
         # frame with its routable "unknown op" error — the OP_METRICS
         # compatibility posture, feature-detected instead of negotiated.
         self._peer_traces = True
+
+        # -- resilience (docs/OPERATIONS.md §8, DESIGN.md §11) ---------
+        # Bounded, jittered retries. At-most-once for admission: an op
+        # outside _IDEMPOTENT_OPS retries ONLY when the failure happened
+        # before its frame could have been sent (the connect phase) — a
+        # replayed ACQUIRE double-debits. retry_policy=None disables.
+        self._retry_policy = retry_policy
+        # Reconnect backoff: after a failed dial, further dial attempts
+        # fail fast until the (jittered, exponentially growing) window
+        # passes — the retry-amplification damper: a dead server costs
+        # each client one dial per window, not one per request.
+        self._backoff_base_s = reconnect_backoff_base_s
+        self._backoff_max_s = reconnect_backoff_max_s
+        self._backoff_until = 0.0          # I/O-loop time()
+        self._connect_failures = 0
+        # Deadline propagation: stamp every scalar request with this
+        # call's remaining budget so a backlogged server sheds expired
+        # work instead of answering the dead. Off by default — stamped
+        # scalar ops leave the native front-end's C fast lane for the
+        # passthrough lane. Latched off per connection on the first
+        # "unknown op" answer from a pre-deadline peer.
+        self._propagate_deadlines = propagate_deadlines
+        self._peer_deadlines = True
+        # Seedable rng (jitter): deterministic under the chaos harness.
+        self._rng = random.Random(resilience_seed)
+        # Resilience counters (resilience_stats()).
+        self._retries = 0
+        self._timeouts = 0
 
         # Client-side frame coalescing: concurrent single-key acquires
         # against one bucket config share ACQUIRE_MANY frames — one frame
@@ -182,6 +235,18 @@ class RemoteBucketStore(BucketStore):
         reference)."""
         await self._await_on_io(self._connect_io())
 
+    def _dial_failed(self, exc: Exception) -> None:
+        """Bookkeeping for a failed dial/handshake: log it and arm the
+        jittered exponential reconnect-backoff window."""
+        self._connect_failures += 1
+        delay = min(self._backoff_max_s,
+                    self._backoff_base_s
+                    * 2.0 ** (self._connect_failures - 1))
+        delay *= 0.5 + 0.5 * self._rng.random()  # jitter: [½, 1]×
+        assert self._io_loop is not None
+        self._backoff_until = self._io_loop.time() + delay
+        log.could_not_connect_to_store(exc)
+
     async def _connect_io(self) -> None:
         if self._writer is not None:
             return
@@ -189,7 +254,18 @@ class RemoteBucketStore(BucketStore):
         async with self._connect_gate:  # double-checked (≙ SemaphoreSlim(1,1))
             if self._writer is not None or self._closed:
                 return
+            now = asyncio.get_running_loop().time()
+            if now < self._backoff_until:
+                # Fail fast inside the backoff window instead of
+                # hammering a dead peer — concurrent requests shed here
+                # rather than amplifying the dial storm.
+                raise ConnectionError(
+                    f"reconnect backing off for another "
+                    f"{self._backoff_until - now:.2f}s "
+                    f"({self._connect_failures} failed dials)")
             try:
+                if faults._INJECTOR is not None:  # chaos seam; no-op in prod
+                    await faults._INJECTOR.on_event("client.connect")
                 if self._factory is not None:
                     reader, writer = await self._factory()
                 else:
@@ -197,8 +273,11 @@ class RemoteBucketStore(BucketStore):
                     reader, writer = await asyncio.open_connection(
                         self._address[0], self._address[1]
                     )
+                if faults._INJECTOR is not None:
+                    reader, writer = faults._INJECTOR.wrap_connection(
+                        reader, writer)
             except Exception as exc:
-                log.could_not_connect_to_store(exc)
+                self._dial_failed(exc)
                 raise
             reader_task = asyncio.ensure_future(self._read_loop(reader))
             if self._auth_token is not None:
@@ -218,8 +297,10 @@ class RemoteBucketStore(BucketStore):
                     self._pending.pop(seq, None)
                     reader_task.cancel()
                     writer.close()
-                    log.could_not_connect_to_store(exc)
+                    self._dial_failed(exc)
                     raise
+            self._connect_failures = 0
+            self._backoff_until = 0.0
             self._reader, self._writer = reader, writer
             self._reader_task = reader_task
 
@@ -251,6 +332,12 @@ class RemoteBucketStore(BucketStore):
         """Fail all in-flight requests; the next use reconnects."""
         if self._writer is not None:
             self._writer.close()
+        reader_task = self._reader_task
+        if (reader_task is not None
+                and reader_task is not asyncio.current_task()):
+            # A reader stalled mid-read (e.g. an injected read stall)
+            # would otherwise outlive the connection it served.
+            reader_task.cancel()
         self._reader = self._writer = None
         self._reader_task = None
         pending, self._pending = self._pending, {}
@@ -261,7 +348,8 @@ class RemoteBucketStore(BucketStore):
     # -- request path (on the I/O loop) -------------------------------------
     async def _request_io(self, op: int, key: str, count: int,
                           a: float, b: float,
-                          parent: "tracing.TraceContext | None" = None
+                          parent: "tracing.TraceContext | None" = None,
+                          timeout_s: "float | None" = None
                           ) -> tuple:
         # rows=1: one wire command = one request (the permit count is the
         # command's argument, not its row count — keep units consistent
@@ -269,8 +357,8 @@ class RemoteBucketStore(BucketStore):
         tracer = tracing.get_tracer()
         if not tracer.enabled:
             with self.profiler.span(wire.op_name(op), 1, annotate=False):
-                return await self._request_io_unprofiled(op, key, count,
-                                                         a, b)
+                return await self._request_io_unprofiled(
+                    op, key, count, a, b, timeout_s=timeout_s)
         # The trace starts HERE (the client wire layer): `parent` is the
         # caller-side ambient context, captured before hopping onto the
         # I/O loop where contextvars don't follow (cluster fan-out spans
@@ -282,17 +370,18 @@ class RemoteBucketStore(BucketStore):
             trace = span.context if self._peer_traces else None
             try:
                 vals = await self._request_io_unprofiled(
-                    op, key, count, a, b, trace)
+                    op, key, count, a, b, trace, timeout_s=timeout_s)
             except wire.RemoteStoreError as exc:
                 if trace is not None and "unknown op" in str(exc):
                     # Old peer: it parsed the frame far enough to route
                     # an error but does not speak the trace tail. Latch
                     # stamping off and retry bare — once per connection
-                    # lifetime, not per request.
+                    # lifetime, not per request. (The deadline tail has
+                    # its own, inner latch — it is tried and shed first.)
                     self._peer_traces = False
                     span.set_attr("trace_tail", "unsupported_peer")
                     vals = await self._request_io_unprofiled(
-                        op, key, count, a, b, None)
+                        op, key, count, a, b, None, timeout_s=timeout_s)
                 else:
                     raise
             if vals and vals[0] is False:
@@ -301,8 +390,60 @@ class RemoteBucketStore(BucketStore):
 
     async def _request_io_unprofiled(self, op: int, key: str, count: int,
                                      a: float, b: float,
-                                     trace=None) -> tuple:
-        await self._connect_io()
+                                     trace=None, *,
+                                     timeout_s: "float | None" = None
+                                     ) -> tuple:
+        """Send one request with the at-most-once retry contract
+        (docs/DESIGN.md §11): a failure in the CONNECT phase provably
+        never sent this request's frame, so any op may retry it; once
+        :meth:`_send_once` is entered the frame may have reached the
+        server, and only :data:`_IDEMPOTENT_OPS` may retry. Timeouts
+        (:class:`StoreTimeoutError`) and server-answered errors never
+        retry. Retry delays are the policy's jittered backoff, stretched
+        to at least the reconnect-backoff window."""
+        timeout = (self._request_timeout_s if timeout_s is None
+                   else timeout_s)
+        policy = self._retry_policy
+        attempt = 0
+        latched_here = False
+        while True:
+            sent = False
+            ddl = (timeout if (self._propagate_deadlines
+                               and self._peer_deadlines) else None)
+            try:
+                await self._connect_io()
+                sent = True  # past here the frame may be on the wire
+                return await self._send_once(op, key, count, a, b,
+                                             trace, ddl, timeout)
+            except wire.RemoteStoreError as exc:
+                if ddl is not None and "unknown op" in str(exc):
+                    # Pre-deadline peer: it routed an error without
+                    # executing, so re-sending is NOT a replay. Latch
+                    # stamping off for the connection and go again.
+                    self._peer_deadlines = False
+                    latched_here = True
+                    continue
+                if latched_here and "unknown op" in str(exc):
+                    # The BARE re-send was rejected too: the base op is
+                    # what the peer doesn't speak (e.g. a newer op) —
+                    # the deadline tail was never the problem, so undo
+                    # the latch before surfacing the error.
+                    self._peer_deadlines = True
+                raise  # the server answered: definitive, never retried
+            except (StoreTimeoutError, asyncio.CancelledError):
+                raise
+            except Exception:
+                attempt += 1
+                retryable = not sent or op in _IDEMPOTENT_OPS
+                if (policy is None or not retryable or self._closed
+                        or attempt >= policy.max_attempts):
+                    raise
+                await self._retry_sleep(attempt)
+
+    async def _send_once(self, op: int, key: str, count: int,
+                         a: float, b: float, trace,
+                         deadline_s: "float | None",
+                         timeout: float) -> tuple:
         if self._writer is None or self._io_loop is None:
             raise ConnectionError("store client is closed")
         self._seq = (self._seq + 1) & 0xFFFFFFFF
@@ -314,7 +455,8 @@ class RemoteBucketStore(BucketStore):
                 wire.write_frame(
                     self._writer,
                     wire.encode_request(seq, op, key, count, a, b,
-                                        trace=trace),
+                                        trace=trace,
+                                        deadline_s=deadline_s),
                 )
                 # Drain only under real buffer pressure — a per-request
                 # drain await costs a task switch on a hot pipelined
@@ -328,7 +470,13 @@ class RemoteBucketStore(BucketStore):
                     else ConnectionError(str(exc))
                 )
                 raise
-            return await asyncio.wait_for(fut, self._request_timeout_s)
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                self._timeouts += 1
+                raise StoreTimeoutError(
+                    f"store gave no reply within {timeout}s "
+                    f"(op {wire.op_name(op)})") from None
         finally:
             # Timeout / cancellation must not leak the future: against a
             # hung-but-connected server every timed-out request would
@@ -336,12 +484,43 @@ class RemoteBucketStore(BucketStore):
             self._pending.pop(seq, None)
 
     async def _request(self, op: int, key: str = "", count: int = 0,
-                       a: float = 0.0, b: float = 0.0) -> tuple:
+                       a: float = 0.0, b: float = 0.0,
+                       timeout_s: "float | None" = None) -> tuple:
         # Capture the ambient trace context on the CALLER's side — the
         # coroutine body runs on the I/O loop thread, where the caller's
         # contextvars are invisible.
         return await self._await_on_io(self._request_io(
-            op, key, count, a, b, tracing.current_context()))
+            op, key, count, a, b, tracing.current_context(), timeout_s))
+
+    async def _retry_sleep(self, attempt: int) -> None:
+        """One retry's backoff: the policy's jittered delay, stretched
+        to at least the reconnect-backoff window's remainder (no point
+        dialing before it opens). Counts the retry."""
+        self._retries += 1
+        delay = self._retry_policy.delay_s(attempt, self._rng)
+        remaining = (self._backoff_until
+                     - asyncio.get_running_loop().time())
+        if remaining > 0:
+            delay = max(delay, remaining)
+        await asyncio.sleep(delay)
+
+    async def _connect_with_retry(self) -> None:
+        """Connect with the retry policy: a dial failure provably sent
+        nothing, so retrying it is safe for every op (the bulk lane's
+        retry surface — post-send bulk failures never retry)."""
+        policy = self._retry_policy
+        attempt = 0
+        while True:
+            try:
+                return await self._connect_io()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                attempt += 1
+                if (policy is None or self._closed
+                        or attempt >= policy.max_attempts):
+                    raise
+                await self._retry_sleep(attempt)
 
     # -- bulk path (OP_ACQUIRE_MANY) ----------------------------------------
     async def _bulk_io(self, blob: bytes, offsets: np.ndarray,
@@ -350,7 +529,8 @@ class RemoteBucketStore(BucketStore):
                        fill_rate: float, with_remaining: bool,
                        kind: int = wire.BULK_KIND_BUCKET,
                        profile: bool = True,
-                       parent: "tracing.TraceContext | None" = None
+                       parent: "tracing.TraceContext | None" = None,
+                       timeout_s: "float | None" = None
                        ) -> list[tuple]:
         """Send every chunk of one bulk call pipelined on the connection,
         then await all replies. One wire round-trip (per ~MAX_FRAME of
@@ -369,10 +549,12 @@ class RemoteBucketStore(BucketStore):
         tspan = (tracer.start_span("client.acquire_many", parent=parent,
                                    attrs={"rows": int(len(klens))})
                  if tracer.enabled else tracing._NULL_SPAN)
+        timeout = (self._request_timeout_s if timeout_s is None
+                   else timeout_s)
         with tspan, self.profiler.span("acquire_many", len(klens),
                                        annotate=False, enabled=profile):
             trace = tspan.context if self._peer_traces else None
-            await self._connect_io()
+            await self._connect_with_retry()
             if self._writer is None or self._io_loop is None:
                 raise ConnectionError("store client is closed")
             futs: list[tuple[int, asyncio.Future]] = []
@@ -396,9 +578,14 @@ class RemoteBucketStore(BucketStore):
                         exc if isinstance(exc, ConnectionError)
                         else ConnectionError(str(exc)))
                     raise
-                return await asyncio.wait_for(
-                    asyncio.gather(*(f for _, f in futs)),
-                    self._request_timeout_s)
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.gather(*(f for _, f in futs)), timeout)
+                except asyncio.TimeoutError:
+                    self._timeouts += 1
+                    raise StoreTimeoutError(
+                        f"store gave no bulk reply within {timeout}s "
+                        f"({len(klens)} rows)") from None
             finally:
                 for seq, _ in futs:
                     self._pending.pop(seq, None)
@@ -444,7 +631,9 @@ class RemoteBucketStore(BucketStore):
             np.zeros((0,), np.float32) if with_remaining else None)
 
     async def _bulk_call(self, keys, counts, a: float, b: float,
-                         with_remaining: bool, kind: int) -> BulkAcquireResult:
+                         with_remaining: bool, kind: int,
+                         timeout_s: "float | None" = None
+                         ) -> BulkAcquireResult:
         """One bulk round trip (any table kind): prepare → chunked
         pipelined frames on the I/O loop → reassemble."""
         if len(keys) == 0:
@@ -453,36 +642,42 @@ class RemoteBucketStore(BucketStore):
             keys, counts)
         chunks = await self._await_on_io(self._bulk_io(
             blob, offsets, klens, counts_np, spans, a, b, with_remaining,
-            kind=kind, parent=tracing.current_context()))
+            kind=kind, parent=tracing.current_context(),
+            timeout_s=timeout_s))
         return self._bulk_assemble(chunks, with_remaining)
 
     def _bulk_call_blocking(self, keys, counts, a: float, b: float,
-                            with_remaining: bool,
-                            kind: int) -> BulkAcquireResult:
+                            with_remaining: bool, kind: int,
+                            timeout_s: "float | None" = None
+                            ) -> BulkAcquireResult:
         if len(keys) == 0:
             return self._bulk_empty(with_remaining)
         blob, offsets, klens, counts_np, spans = self._bulk_prepare(
             keys, counts)
         chunks = self._submit(self._bulk_io(
             blob, offsets, klens, counts_np, spans, a, b, with_remaining,
-            kind=kind, parent=tracing.current_context())).result(
-            self._request_timeout_s + 1.0)
+            kind=kind, parent=tracing.current_context(),
+            timeout_s=timeout_s)).result(self._blocking_timeout(timeout_s))
         return self._bulk_assemble(chunks, with_remaining)
 
     async def acquire_many(self, keys: Sequence[str], counts: Sequence[int],
                            capacity: float, fill_rate_per_sec: float, *,
-                           with_remaining: bool = True) -> BulkAcquireResult:
+                           with_remaining: bool = True,
+                           timeout_s: "float | None" = None
+                           ) -> BulkAcquireResult:
         return await self._bulk_call(keys, counts, capacity,
                                      fill_rate_per_sec, with_remaining,
-                                     wire.BULK_KIND_BUCKET)
+                                     wire.BULK_KIND_BUCKET, timeout_s)
 
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], capacity: float,
                               fill_rate_per_sec: float, *,
-                              with_remaining: bool = True) -> BulkAcquireResult:
+                              with_remaining: bool = True,
+                              timeout_s: "float | None" = None
+                              ) -> BulkAcquireResult:
         return self._bulk_call_blocking(keys, counts, capacity,
                                         fill_rate_per_sec, with_remaining,
-                                        wire.BULK_KIND_BUCKET)
+                                        wire.BULK_KIND_BUCKET, timeout_s)
 
     async def window_acquire_many(self, keys: Sequence[str],
                                   counts: Sequence[int], limit: float,
@@ -505,12 +700,21 @@ class RemoteBucketStore(BucketStore):
             keys, counts, limit, window_sec, with_remaining,
             wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW)
 
+    def _blocking_timeout(self, timeout_s: "float | None" = None) -> float:
+        """Grace timeout for a blocking ``.result()`` wait: the request
+        timeout plus the retry policy's worst-case backoff, plus one
+        second of slack (the inner wait_for fires first by design)."""
+        t = self._request_timeout_s if timeout_s is None else timeout_s
+        if self._retry_policy is not None:
+            t += self._retry_policy.max_total_delay_s()
+        return t + 1.0
+
     def _request_blocking(self, op: int, key: str = "", count: int = 0,
-                          a: float = 0.0, b: float = 0.0) -> tuple:
+                          a: float = 0.0, b: float = 0.0,
+                          timeout_s: "float | None" = None) -> tuple:
         return self._submit(self._request_io(
-            op, key, count, a, b, tracing.current_context())).result(
-            self._request_timeout_s + 1.0
-        )
+            op, key, count, a, b, tracing.current_context(),
+            timeout_s)).result(self._blocking_timeout(timeout_s))
 
     # -- client-side frame coalescing ---------------------------------------
     #: Cap on distinct (capacity, fill_rate) coalescing batchers: configs
@@ -587,25 +791,33 @@ class RemoteBucketStore(BucketStore):
             return res
 
     # -- BucketStore API ----------------------------------------------------
+    # ``timeout_s`` overrides ``request_timeout_s`` for ONE call (the
+    # per-call deadline the cluster's breaker probes and latency-bound
+    # callers use). A per-call timeout bypasses frame coalescing — the
+    # shared-flush lane cannot honor one member's tighter deadline.
     async def acquire(self, key: str, count: int, capacity: float,
-                      fill_rate_per_sec: float) -> AcquireResult:
-        if self._coalesce:
+                      fill_rate_per_sec: float, *,
+                      timeout_s: "float | None" = None) -> AcquireResult:
+        if self._coalesce and timeout_s is None:
             return await self._await_on_io(self._acquire_coalesced_io(
                 key, count, capacity, fill_rate_per_sec,
                 tracing.current_context()))
         granted, remaining = await self._request(
-            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
+            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec,
+            timeout_s=timeout_s)
         return AcquireResult(granted, remaining)
 
     def acquire_blocking(self, key: str, count: int, capacity: float,
-                         fill_rate_per_sec: float) -> AcquireResult:
-        if self._coalesce:
+                         fill_rate_per_sec: float, *,
+                         timeout_s: "float | None" = None) -> AcquireResult:
+        if self._coalesce and timeout_s is None:
             return self._submit(self._acquire_coalesced_io(
                 key, count, capacity, fill_rate_per_sec,
                 tracing.current_context())).result(
-                self._request_timeout_s + 1.0)
+                self._blocking_timeout())
         granted, remaining = self._request_blocking(
-            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec)
+            wire.OP_ACQUIRE, key, count, capacity, fill_rate_per_sec,
+            timeout_s=timeout_s)
         return AcquireResult(granted, remaining)
 
     def peek_blocking(self, key: str, capacity: float,
@@ -615,15 +827,20 @@ class RemoteBucketStore(BucketStore):
         return value
 
     async def sync_counter(self, key: str, local_count: float,
-                           decay_rate_per_sec: float) -> SyncResult:
+                           decay_rate_per_sec: float, *,
+                           timeout_s: "float | None" = None) -> SyncResult:
         score, ewma = await self._request(
-            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec)
+            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec,
+            timeout_s=timeout_s)
         return SyncResult(score, ewma)
 
     def sync_counter_blocking(self, key: str, local_count: float,
-                              decay_rate_per_sec: float) -> SyncResult:
+                              decay_rate_per_sec: float, *,
+                              timeout_s: "float | None" = None
+                              ) -> SyncResult:
         score, ewma = self._request_blocking(
-            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec)
+            wire.OP_SYNC, key, 0, local_count, decay_rate_per_sec,
+            timeout_s=timeout_s)
         return SyncResult(score, ewma)
 
     async def concurrency_acquire(self, key: str, count: int,
@@ -669,8 +886,23 @@ class RemoteBucketStore(BucketStore):
             wire.OP_FWINDOW, key, count, limit, window_sec)
         return AcquireResult(granted, remaining)
 
-    async def ping(self) -> None:
-        await self._request(wire.OP_PING)
+    async def ping(self, *, timeout_s: "float | None" = None) -> None:
+        await self._request(wire.OP_PING, timeout_s=timeout_s)
+
+    def resilience_stats(self) -> dict:
+        """Client-side resilience counters: retries issued, request
+        timeouts (:class:`StoreTimeoutError`), consecutive dial
+        failures, and whether the reconnect backoff window is CURRENTLY
+        open (not merely "was ever armed")."""
+        loop = self._io_loop
+        backing_off = (loop is not None
+                       and self._backoff_until > loop.time())
+        return {
+            "retries": self._retries,
+            "timeouts": self._timeouts,
+            "connect_failures": self._connect_failures,
+            "backing_off": backing_off,
+        }
 
     async def save(self) -> None:
         """Ask the server to checkpoint its store to its configured path

@@ -27,7 +27,7 @@ import time
 
 from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
-from distributedratelimiting.redis_tpu.utils import log, tracing
+from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.flight_recorder import (
     FlightRecorder,
 )
@@ -120,6 +120,11 @@ class BucketStoreServer:
         self._save_task: asyncio.Task | None = None
         self.connections_served = 0
         self.requests_served = 0
+        # Requests dropped unexecuted because their client-stamped
+        # deadline (wire DEADLINE_FLAG tail) expired while the frame sat
+        # in this server's own queueing — answering them would serve the
+        # dead while live requests wait behind them.
+        self.requests_shed = 0
         # Server-side serving latency: request decoded (arrival) →
         # result ready (before the reply hits the socket). This is the
         # latency the FRAMEWORK is accountable for — client-observed
@@ -328,6 +333,10 @@ class BucketStoreServer:
                     "Native front-end micro-batches handed to Python",
                     lambda: (self._native.counts()[2]
                              if self._native is not None else 0))
+        reg.counter("requests_shed",
+                    "Requests dropped unexecuted: client deadline "
+                    "expired in server queueing",
+                    lambda: self.requests_shed)
         reg.gauge("native_frontend", "1 when the C front-end owns the "
                   "sockets", lambda: 1.0 if self._native is not None
                   else 0.0)
@@ -422,6 +431,11 @@ class BucketStoreServer:
                 body = await wire.read_frame(reader)
                 if body is None:
                     break
+                # Frame-read time is the arrival stamp: deadline shedding
+                # and serving latency both measure from the moment the
+                # bytes were in hand, so task-scheduling lag under load
+                # counts against the budget it actually consumed.
+                t_read = time.perf_counter()
                 # Version + auth are connection-level gates, checked in
                 # order here (not in per-request tasks, which complete out
                 # of order): a bad frame gets one best-effort error reply,
@@ -469,11 +483,13 @@ class BucketStoreServer:
                     after = (bulk_tail if wire.bulk_request_chained(body)
                              else None)
                     task = asyncio.ensure_future(self._serve_request(
-                        body, writer, write_lock, after=after))
+                        body, writer, write_lock, after=after,
+                        arrival_s=t_read))
                     bulk_tail = task
                 else:
                     task = asyncio.ensure_future(
-                        self._serve_request(body, writer, write_lock)
+                        self._serve_request(body, writer, write_lock,
+                                            arrival_s=t_read)
                     )
                 request_tasks.add(task)
                 task.add_done_callback(request_tasks.discard)
@@ -510,13 +526,23 @@ class BucketStoreServer:
 
     async def _serve_request(self, body: bytes, writer: asyncio.StreamWriter,
                              write_lock: asyncio.Lock,
-                             after: "asyncio.Task | None" = None) -> None:
-        t_arrival = time.perf_counter()
+                             after: "asyncio.Task | None" = None,
+                             arrival_s: "float | None" = None) -> None:
+        t_arrival = time.perf_counter() if arrival_s is None else arrival_s
         if after is not None:
             # Per-connection bulk ordering (see _serve_connection). The
             # predecessor's own failure was already replied/logged there.
             await asyncio.gather(after, return_exceptions=True)
-        resp = await self.handle_frame_body(body)
+        if faults._INJECTOR is not None:  # chaos seam; no-op in prod
+            try:
+                await faults._INJECTOR.on_event("server.dispatch")
+            except faults.BlackholeFault:
+                return  # no reply: the client's timeout owns this one
+            except Exception as exc:
+                await self._reply(writer, write_lock, wire.encode_response(
+                    _recover_seq(body), wire.RESP_ERROR, repr(exc)))
+                return
+        resp = await self.handle_frame_body(body, arrival_s=t_arrival)
         self.requests_served += 1
         t_ready = time.perf_counter()
         self.serving_latency.record(t_ready - t_arrival)
@@ -527,7 +553,8 @@ class BucketStoreServer:
         # decomposition.
         self.reply_latency.record(time.perf_counter() - t_ready)
 
-    async def handle_frame_body(self, body: bytes) -> bytes:
+    async def handle_frame_body(self, body: bytes,
+                                arrival_s: "float | None" = None) -> bytes:
         """Serve one frame body and return the encoded reply — the shared
         dispatch behind both the asyncio socket path and the native
         front-end's passthrough lane (runtime/native_frontend.py). Store
@@ -540,8 +567,16 @@ class BucketStoreServer:
         span parented on the client's wire context; the span's status is
         sniffed from the encoded reply (denied decision / error), which
         is what lets the tail sampler keep every denied request's trace.
+
+        Deadline-stamped frames (op-byte bit 6) are stripped next: when
+        ``arrival_s`` is given (the asyncio socket path stamps frame-read
+        time) and this server's own queueing already consumed the
+        client's budget, the request is SHED — a routable "deadline
+        exceeded" error, the store untouched — instead of doing work
+        whose caller has already timed out.
         """
         tctx = None
+        deadline_s = None
         if len(body) >= 6:
             if body[5] & wire.TRACE_FLAG:
                 try:
@@ -549,8 +584,23 @@ class BucketStoreServer:
                 except wire.RemoteStoreError as exc:
                     return wire.encode_response(
                         _recover_seq(body), wire.RESP_ERROR, repr(exc))
+            if body[5] & wire.DEADLINE_FLAG:
+                try:
+                    body, deadline_s = wire.strip_deadline(body)
+                except wire.RemoteStoreError as exc:
+                    return wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR, repr(exc))
             elif body[5] == wire.OP_ACQUIRE_MANY:
                 tctx = wire.bulk_trace_tail(body)
+        if deadline_s is not None and arrival_s is not None:
+            waited = time.perf_counter() - arrival_s
+            if waited > deadline_s:
+                self.requests_shed += 1
+                return wire.encode_response(
+                    _recover_seq(body), wire.RESP_ERROR,
+                    f"deadline exceeded: request waited "
+                    f"{waited * 1e3:.1f}ms against a "
+                    f"{deadline_s * 1e3:.1f}ms budget (shed unexecuted)")
         if tctx is None or not self.tracer.enabled:
             return await self._handle_frame_inner(body)
         op = body[5] if len(body) >= 6 else 0
@@ -753,6 +803,7 @@ class BucketStoreServer:
                 "serving_p99_ms": self.serving_latency.p99 * 1e3,
                 "serving_samples": self.serving_latency.total,
             }
+        payload["requests_shed"] = self.requests_shed
         metrics = getattr(self.store, "metrics", None)
         if metrics is not None:
             payload["store"] = metrics.snapshot()
@@ -962,9 +1013,18 @@ def main(argv: list[str] | None = None) -> None:
             from distributedratelimiting.redis_tpu.runtime import checkpoint
 
             if os.path.exists(args.snapshot_path):
-                checkpoint.load_snapshot(store, args.snapshot_path)
-                print(f"restored snapshot from {args.snapshot_path}",
-                      flush=True)
+                try:
+                    checkpoint.load_snapshot(store, args.snapshot_path)
+                except checkpoint.SnapshotCorruptError as exc:
+                    # Documented init-on-miss fallback: a torn snapshot
+                    # must not keep the store down — serve fresh (state
+                    # self-heals to full buckets) and say so loudly.
+                    print(f"WARNING: ignoring corrupt snapshot: {exc}\n"
+                          "starting with empty state (init-on-miss)",
+                          flush=True)
+                else:
+                    print(f"restored snapshot from {args.snapshot_path}",
+                          flush=True)
         if args.sweep_period > 0 and hasattr(store, "start_sweeper"):
             store.start_sweeper(args.sweep_period)
         native_tier0 = False

@@ -92,6 +92,19 @@ reads its arrays by explicit counts and simply never looks at the tail,
 so traced bulk frames interoperate unchanged. OP_TRACES (Chrome-trace
 JSON export) is a new op on the existing layout, routable-error on old
 servers like OP_METRICS.
+
+Deadline tail (within v4, same posture): a scalar request may carry an
+8-byte relative deadline — ``[f64 deadline_s]`` — appended after the
+payload (BEFORE any trace tail) and signalled with :data:`DEADLINE_FLAG`
+(op-byte bit 6). The value is the client's remaining budget in seconds,
+deliberately *relative*: client and server clocks never compare
+(invariant 1). A server strips it and sheds the request — routable
+"deadline exceeded" error, store untouched — when its own queueing has
+already consumed the budget, instead of answering the dead. An old
+server answers the flagged op with a routable "unknown op" error and
+the client latches deadline stamping off for the connection (the trace
+latch's posture); the native C front-end routes flagged scalar ops to
+the Python passthrough lane, which speaks this dialect.
 """
 
 from __future__ import annotations
@@ -107,7 +120,8 @@ __all__ = [
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
-    "strip_trace", "bulk_trace_tail",
+    "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
+    "strip_trace", "bulk_trace_tail", "strip_deadline",
     "STATS_FLAG_RESET", "STATS_FLAG_FLIGHT_DUMP",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_BULK", "RESP_ERROR",
@@ -149,6 +163,16 @@ TRACE_TAIL_LEN = _TRACE_TAIL.size
 #: ACQUIRE_MANY flags bit 4: the same 25-byte tail follows the counts
 #: array. Old bulk decoders read by explicit counts and ignore the tail.
 BULK_FLAG_TRACED = 0b10000
+
+#: Op-byte bit 6: an 8-byte relative-deadline tail (``_DEADLINE_TAIL``)
+#: follows the payload (before any trace tail). Old servers answer the
+#: flagged op with a routable "unknown op" error (clients latch off);
+#: scalar ops only — the bulk lane stays deadline-free by design (a
+#: bulk call is one caller's single decision batch, its timeout is its
+#: own).
+DEADLINE_FLAG = 0x40
+_DEADLINE_TAIL = struct.Struct("<d")  # remaining budget, seconds
+DEADLINE_TAIL_LEN = _DEADLINE_TAIL.size
 
 #: OP_STATS flag bits (the optional one-byte payload): bit 0 resets the
 #: serving/stage latency windows after the snapshot; bit 1 asks the
@@ -248,7 +272,7 @@ def _codepoint_truncate(mb: bytes, limit: int) -> bytes:
 
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
                    a: float = 0.0, b: float = 0.0,
-                   trace=None) -> bytes:
+                   trace=None, deadline_s: "float | None" = None) -> bytes:
     if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
@@ -266,6 +290,12 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
+    if deadline_s is not None:
+        # Tail order is fixed: deadline first, trace last — the server
+        # strips trace (bit 7), then deadline (bit 6). Frames without
+        # either stay byte-identical to plain v4.
+        op |= DEADLINE_FLAG
+        payload += _DEADLINE_TAIL.pack(deadline_s)
     if trace is not None:
         # Sampled request: append the 25-byte trace tail and set the
         # op-byte flag. Untraced frames stay byte-identical to plain v4.
@@ -291,6 +321,22 @@ def strip_trace(body: bytes):
     plain = (body[:5] + bytes([body[5] & ~TRACE_FLAG])
              + body[_BODY_OFF:len(body) - TRACE_TAIL_LEN])
     return plain, TraceContext(hi, lo, span, flags)
+
+
+def strip_deadline(body: bytes) -> "tuple[bytes, float | None]":
+    """Split a scalar frame body's deadline tail: ``(plain_body,
+    deadline_s | None)``. Call AFTER :func:`strip_trace` (the trace tail
+    rides last). Same strictness posture: an old server never reaches
+    here — the flagged op raises its routable "unknown op" error."""
+    if len(body) < _BODY_OFF or not body[5] & DEADLINE_FLAG:
+        return body, None
+    if len(body) < _BODY_OFF + DEADLINE_TAIL_LEN:
+        raise RemoteStoreError("truncated deadline tail")
+    (deadline_s,) = _DEADLINE_TAIL.unpack_from(
+        body, len(body) - DEADLINE_TAIL_LEN)
+    plain = (body[:5] + bytes([body[5] & ~DEADLINE_FLAG])
+             + body[_BODY_OFF:len(body) - DEADLINE_TAIL_LEN])
+    return plain, deadline_s
 
 
 def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:

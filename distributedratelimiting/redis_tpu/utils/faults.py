@@ -1,0 +1,371 @@
+"""Deterministic fault injection — the chaos plane's hand on the wire.
+
+A seeded :class:`FaultInjector` decides, per *seam occurrence*, whether
+to inject one of the classic distributed failure modes: connection
+reset, delay/jitter, partial frame, stall, blackhole (request vanishes,
+no reply), injected error, clock skew. Seams are named call sites the
+runtime consults when — and only when — an injector is installed:
+
+- ``client.connect`` — :meth:`RemoteBucketStore._connect_io` before the
+  dial (a fault here is *provably before anything was sent*, the case
+  the at-most-once retry contract may replay; docs/DESIGN.md §11).
+- ``client.read`` / ``client.write`` — the wrapped client transport
+  (per frame read / write).
+- ``server.dispatch`` — :meth:`BucketStoreServer._serve_request` before
+  the frame is served.
+- ``t0.sync`` — one tier-0 reconciliation round in
+  :meth:`NativeFrontend._t0_sync_loop` (a fault fails the round; rows
+  carry, the degraded streak advances).
+
+**Determinism.** Each seam owns its own ``random.Random`` seeded from
+``(seed, seam)`` and its own occurrence counter, and every occurrence
+draws exactly ``len(rules)`` uniforms — so the fault schedule is a pure
+function of per-seam occurrence index, independent of task interleaving
+across seams. :meth:`schedule_preview` replays that pure function
+without touching live state; the chaos soak asserts the realized
+:attr:`events` log equals the preview (same seed ⇒ same schedule).
+
+**Zero-cost when off.** Production code guards every seam with
+``faults._INJECTOR is not None`` — one module-global read. Nothing else
+of this module runs unless an injector is installed explicitly
+(:func:`install`) or via the ``DRL_TPU_FAULTS_CONFIG`` env var (a JSON
+file: ``{"seed": 7, "rules": {"server.dispatch": [{"kind": "delay",
+"probability": 0.1, "delay_s": 0.05}]}}``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import dataclass, field, replace
+from typing import Mapping, Sequence
+
+__all__ = [
+    "FaultRule", "FaultEvent", "FaultInjector", "FaultInjectedError",
+    "BlackholeFault", "SkewedClock", "install", "uninstall",
+    "get_injector",
+    "RESET", "DELAY", "PARTIAL_FRAME", "STALL", "BLACKHOLE", "ERROR",
+    "CLOCK_SKEW",
+]
+
+# Fault kinds. RESET raises ConnectionResetError at the seam; DELAY
+# sleeps delay_s (+ uniform jitter_s) then proceeds; PARTIAL_FRAME
+# (write seam) emits a prefix of the frame then breaks the connection;
+# STALL sleeps delay_s then proceeds (distinguished from DELAY only by
+# intent: use it with delays past the request timeout); BLACKHOLE
+# swallows the event — a write goes nowhere, a dispatch never replies;
+# ERROR raises FaultInjectedError (served as a routable store error);
+# CLOCK_SKEW contributes skew_s to SkewedClock readers.
+RESET = "reset"
+DELAY = "delay"
+PARTIAL_FRAME = "partial_frame"
+STALL = "stall"
+BLACKHOLE = "blackhole"
+ERROR = "error"
+CLOCK_SKEW = "clock_skew"
+
+_KINDS = frozenset({RESET, DELAY, PARTIAL_FRAME, STALL, BLACKHOLE,
+                    ERROR, CLOCK_SKEW})
+
+
+class FaultInjectedError(RuntimeError):
+    """An injected (non-transport) failure — served like a store error."""
+
+
+class BlackholeFault(Exception):
+    """Injected blackhole: the event must produce NO observable effect
+    (no reply, no write). Seams catch this specifically."""
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One injection rule on one seam.
+
+    Eligibility is by per-seam occurrence index: ``after <= i < until``
+    (``until=None`` = forever) — occurrence windows, not wall clock,
+    keep the schedule deterministic under arbitrary interleaving.
+    ``probability`` is the per-occurrence chance within the window;
+    ``max_faults`` caps the rule's total firings.
+    """
+
+    kind: str
+    probability: float = 1.0
+    after: int = 0
+    until: int | None = None
+    delay_s: float = 0.0
+    jitter_s: float = 0.0
+    skew_s: float = 0.0
+    max_faults: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One realized injection — the unit of the reproducible schedule."""
+
+    seam: str
+    occurrence: int
+    kind: str
+    delay_s: float = 0.0
+
+
+@dataclass
+class _SeamState:
+    rng: random.Random
+    count: int = 0
+    fired: dict[int, int] = field(default_factory=dict)  # rule idx → fires
+
+
+class FaultInjector:
+    """Seeded, schedule-deterministic fault source (module docstring)."""
+
+    def __init__(self, seed: int = 0,
+                 rules: "Mapping[str, Sequence[FaultRule]] | None" = None
+                 ) -> None:
+        self.seed = seed
+        self._rules: dict[str, tuple[FaultRule, ...]] = {
+            seam: tuple(rs) for seam, rs in (rules or {}).items()}
+        self._seams: dict[str, _SeamState] = {}
+        #: Realized injections, in per-seam occurrence order.
+        self.events: list[FaultEvent] = []
+
+    @staticmethod
+    def _seam_rng(seed: int, seam: str) -> random.Random:
+        return random.Random(f"{seed}/{seam}")
+
+    def _seam(self, seam: str) -> _SeamState:
+        st = self._seams.get(seam)
+        if st is None:
+            st = self._seams[seam] = _SeamState(
+                self._seam_rng(self.seed, seam))
+        return st
+
+    @staticmethod
+    def _decide_one(rules: "tuple[FaultRule, ...]", st: _SeamState
+                    ) -> "tuple[int, FaultRule, float] | None":
+        """One occurrence's decision: draws exactly ``len(rules)``
+        uniforms (+1 for jitter on a firing delay rule), so the rng
+        stream position is a pure function of the occurrence index."""
+        i = st.count
+        st.count += 1
+        hit: "tuple[int, FaultRule, float] | None" = None
+        for r_idx, rule in enumerate(rules):
+            u = st.rng.random()
+            if hit is not None:
+                continue  # stream length stays fixed; first hit wins
+            if i < rule.after or (rule.until is not None
+                                  and i >= rule.until):
+                continue
+            if (rule.max_faults is not None
+                    and st.fired.get(r_idx, 0) >= rule.max_faults):
+                continue
+            if u < rule.probability:
+                delay = rule.delay_s
+                if rule.jitter_s:
+                    delay += st.rng.random() * rule.jitter_s
+                hit = (r_idx, rule, delay)
+        return hit
+
+    def decide(self, seam: str) -> "FaultEvent | None":
+        """Advance ``seam`` by one occurrence; the injected event, if
+        any, is appended to :attr:`events` and returned."""
+        rules = self._rules.get(seam)
+        if not rules:
+            return None
+        st = self._seam(seam)
+        occurrence = st.count
+        hit = self._decide_one(rules, st)
+        if hit is None:
+            return None
+        r_idx, rule, delay = hit
+        st.fired[r_idx] = st.fired.get(r_idx, 0) + 1
+        ev = FaultEvent(seam, occurrence, rule.kind, delay)
+        self.events.append(ev)
+        return ev
+
+    def occurrence_count(self, seam: str) -> int:
+        """How many occurrences ``seam`` has seen (for comparing the
+        realized :attr:`events` against :meth:`schedule_preview`)."""
+        st = self._seams.get(seam)
+        return 0 if st is None else st.count
+
+    def schedule_preview(self, seam: str, n: int) -> list["FaultEvent"]:
+        """The first ``n`` occurrences' decisions for ``seam``, computed
+        on a FRESH rng — live state untouched. Equal to what a live run
+        realizes (the determinism contract the soak asserts)."""
+        rules = self._rules.get(seam, ())
+        st = _SeamState(self._seam_rng(self.seed, seam))
+        out: list[FaultEvent] = []
+        for _ in range(n):
+            occurrence = st.count
+            hit = self._decide_one(tuple(rules), st)
+            if hit is not None:
+                r_idx, rule, delay = hit
+                st.fired[r_idx] = st.fired.get(r_idx, 0) + 1
+                out.append(FaultEvent(seam, occurrence, rule.kind, delay))
+        return out
+
+    # -- seam application ---------------------------------------------------
+    async def on_event(self, seam: str) -> None:
+        """Async seam hook: sleep for DELAY/STALL, raise for
+        RESET/ERROR/BLACKHOLE, no-op otherwise."""
+        ev = self.decide(seam)
+        if ev is None:
+            return
+        import asyncio
+
+        if ev.kind in (DELAY, STALL):
+            await asyncio.sleep(ev.delay_s)
+        elif ev.kind == RESET:
+            raise ConnectionResetError(
+                f"injected connection reset ({seam}#{ev.occurrence})")
+        elif ev.kind == ERROR:
+            raise FaultInjectedError(
+                f"injected fault ({seam}#{ev.occurrence})")
+        elif ev.kind == BLACKHOLE:
+            raise BlackholeFault(seam)
+        # PARTIAL_FRAME / CLOCK_SKEW are transport/clock-specific; on a
+        # generic seam they are recorded but act as no-ops.
+
+    def wrap_connection(self, reader, writer):
+        """Client-transport seam: wrap an asyncio stream pair so every
+        frame read/write consults ``client.read`` / ``client.write``."""
+        return _FaultyReader(reader, self), _FaultyWriter(writer, self)
+
+    def clock_skew(self, seam: str = "clock") -> float:
+        """Total skew contributed by the seam's CLOCK_SKEW rules (static
+        — derived from the rule set, not the occurrence stream)."""
+        return sum(r.skew_s for r in self._rules.get(seam, ())
+                   if r.kind == CLOCK_SKEW)
+
+    def with_seed(self, seed: int) -> "FaultInjector":
+        """A fresh injector with the same rules under another seed."""
+        return FaultInjector(seed, {s: tuple(replace(r) for r in rs)
+                                    for s, rs in self._rules.items()})
+
+
+class _FaultyReader:
+    """StreamReader proxy injecting on each ``readexactly`` (the only
+    read the wire layer performs)."""
+
+    def __init__(self, inner, injector: FaultInjector) -> None:
+        self._inner = inner
+        self._inj = injector
+
+    async def readexactly(self, n: int) -> bytes:
+        import asyncio
+
+        ev = self._inj.decide("client.read")
+        if ev is not None:
+            if ev.kind in (DELAY, STALL):
+                await asyncio.sleep(ev.delay_s)
+            elif ev.kind == RESET:
+                raise ConnectionResetError(
+                    f"injected read reset (#{ev.occurrence})")
+            elif ev.kind == BLACKHOLE:
+                # Nothing ever arrives: hold the read until the caller's
+                # timeout (or cancellation on teardown) fires.
+                await asyncio.sleep(ev.delay_s or 3600.0)
+                raise ConnectionResetError(
+                    f"injected read blackhole (#{ev.occurrence})")
+        return await self._inner.readexactly(n)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _FaultyWriter:
+    """StreamWriter proxy injecting on each ``write``. ``transport``,
+    ``drain``, ``close`` … forward to the real writer."""
+
+    def __init__(self, inner, injector: FaultInjector) -> None:
+        self._inner = inner
+        self._inj = injector
+        self._broken = False
+
+    def write(self, data: bytes) -> None:
+        if self._broken:
+            raise ConnectionResetError("connection broken by injected "
+                                       "partial frame")
+        ev = self._inj.decide("client.write")
+        if ev is None:
+            self._inner.write(data)
+            return
+        if ev.kind == RESET:
+            self._inner.close()
+            raise ConnectionResetError(
+                f"injected write reset (#{ev.occurrence})")
+        if ev.kind == PARTIAL_FRAME:
+            # A torn frame: the peer sees a prefix, then EOF — its frame
+            # reader must treat the truncation as a clean drop, never a
+            # misparse.
+            self._inner.write(data[: max(1, len(data) // 2)])
+            self._inner.close()
+            self._broken = True
+            raise ConnectionResetError(
+                f"injected partial frame (#{ev.occurrence})")
+        if ev.kind == BLACKHOLE:
+            return  # swallowed: sent-nowhere, the reply never comes
+        self._inner.write(data)  # DELAY et al. are read-side concerns
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class SkewedClock:
+    """A :class:`~..runtime.clock.Clock` running ``skew_s`` ahead of its
+    base — the clock-skew fault. Wrapping a CLIENT's clock must change
+    nothing (the store is the time authority, invariant 1); wrapping a
+    node's store clock models divergent per-node time."""
+
+    def __init__(self, base, skew_s: float) -> None:
+        self._base = base
+        self.skew_s = skew_s
+
+    def now(self) -> float:
+        return self._base.now() + self.skew_s
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
+# -- process-global installation (the seams' gate) --------------------------
+
+_INJECTOR: "FaultInjector | None" = None
+
+
+def get_injector() -> "FaultInjector | None":
+    return _INJECTOR
+
+
+def install(injector: "FaultInjector | None"
+            ) -> "FaultInjector | None":
+    """Install (or, with ``None``, clear) the process-global injector;
+    returns the previous one so tests can restore it."""
+    global _INJECTOR
+    previous, _INJECTOR = _INJECTOR, injector
+    return previous
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def _maybe_install_from_env() -> None:
+    path = os.environ.get("DRL_TPU_FAULTS_CONFIG")
+    if not path:
+        return
+    with open(path, encoding="utf-8") as f:
+        cfg = json.load(f)
+    rules = {seam: tuple(FaultRule(**r) for r in rs)
+             for seam, rs in cfg.get("rules", {}).items()}
+    install(FaultInjector(int(cfg.get("seed", 0)), rules))
+
+
+_maybe_install_from_env()

@@ -5,6 +5,12 @@ Mirror of the reference's source-generated ``LoggerMessage`` partials
 same ids — 1 = could not connect/reach the store, 2 = error executing the
 store kernel. Called from the refresh path only, matching the reference's
 degraded-mode posture (log and keep serving; SURVEY.md invariant 9).
+
+The chaos plane (cluster breakers, node quarantine) adds two more:
+3 = a named cluster node failed a store operation (the event that makes
+partitions VISIBLE — the old code swallowed them), 4 = a node's circuit
+breaker changed state. Both carry the node index in ``extra`` so log
+pipelines can pivot per node.
 """
 
 from __future__ import annotations
@@ -15,6 +21,8 @@ logger = logging.getLogger("distributedratelimiting.redis_tpu")
 
 EVENT_COULD_NOT_CONNECT = 1
 EVENT_ERROR_EVALUATING = 2
+EVENT_CLUSTER_NODE_ERROR = 3
+EVENT_BREAKER_TRANSITION = 4
 
 
 def could_not_connect_to_store(exc: BaseException) -> None:
@@ -32,4 +40,27 @@ def error_evaluating_kernel(exc: BaseException) -> None:
         "Error executing store kernel",
         exc_info=exc,
         extra={"event_id": EVENT_ERROR_EVALUATING},
+    )
+
+
+def cluster_node_error(node: int, exc: BaseException) -> None:
+    """Event id 3 — a cluster node failed a store operation. Always
+    paired with the ``cluster_node_errors`` counter so a partition shows
+    up in BOTH the logs and the metrics plane."""
+    logger.error(
+        "Cluster node %d failed a store operation",
+        node,
+        exc_info=exc,
+        extra={"event_id": EVENT_CLUSTER_NODE_ERROR, "node": node},
+    )
+
+
+def breaker_transition(node: int, old: str, new: str) -> None:
+    """Event id 4 — a node's circuit breaker changed state (quarantine
+    on ``-> open``, probe on ``-> half_open``, rejoin on ``-> closed``)."""
+    logger.warning(
+        "Cluster node %d circuit breaker: %s -> %s",
+        node, old, new,
+        extra={"event_id": EVENT_BREAKER_TRANSITION, "node": node,
+               "breaker_old": old, "breaker_new": new},
     )

@@ -1,0 +1,815 @@
+"""Chaos plane: deterministic fault injection, at-most-once retries,
+per-node circuit breakers, and cluster failover with degraded fallback.
+
+The load-bearing guarantees under test:
+
+- **Schedule determinism**: the same seed reproduces the same fault
+  schedule — the realized event log equals the injector's pure-function
+  preview, occurrence for occurrence.
+- **At-most-once admission** (the differential test): every retried
+  ACQUIRE replays against a serial model — with one unique key per
+  logical request, no key is ever executed twice no matter which phase
+  the failure struck.
+- **Deadline shedding**: a server whose own queueing consumed the
+  client's budget sheds the request unexecuted (typed, counted,
+  exposed); pre-deadline peers answer a routable error and the client
+  latches stamping off.
+- **Breakers + degraded failover** (the seeded soak): a down node trips
+  its breaker, its keyspace serves from the local fair-share envelope
+  with over-admission inside the epsilon bound, the healthy node is
+  untouched, the breaker re-closes after the fault window, and teardown
+  strands nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.cluster import (
+    ClusterBucketStore,
+    NodeUnavailableError,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+    StoreTimeoutError,
+)
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+from distributedratelimiting.redis_tpu.utils.faults import (
+    FaultInjector,
+    FaultRule,
+)
+from distributedratelimiting.redis_tpu.utils.flight_recorder import (
+    FlightRecorder,
+)
+from distributedratelimiting.redis_tpu.utils.resilience import (
+    BreakerConfig,
+    CircuitBreaker,
+    RetryPolicy,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.uninstall()
+
+
+# -- fault injector: determinism --------------------------------------------
+
+_RULES = {
+    "client.connect": (FaultRule("reset", probability=0.3),
+                       FaultRule("delay", probability=0.2,
+                                 delay_s=0.001, jitter_s=0.002)),
+    "server.dispatch": (FaultRule("error", probability=0.15, after=10,
+                                  until=60, max_faults=5),),
+}
+
+
+class TestInjectorDeterminism:
+    def test_same_seed_same_schedule(self):
+        a = FaultInjector(7, _RULES).schedule_preview("client.connect", 200)
+        b = FaultInjector(7, _RULES).schedule_preview("client.connect", 200)
+        assert a == b and len(a) > 0
+
+    def test_different_seed_different_schedule(self):
+        a = FaultInjector(7, _RULES).schedule_preview("client.connect", 200)
+        b = FaultInjector(8, _RULES).schedule_preview("client.connect", 200)
+        assert a != b
+
+    def test_live_decisions_equal_preview(self):
+        inj = FaultInjector(42, _RULES)
+        for _ in range(120):
+            inj.decide("client.connect")
+        for _ in range(80):
+            inj.decide("server.dispatch")
+        for seam in _RULES:
+            realized = [e for e in inj.events if e.seam == seam]
+            preview = inj.schedule_preview(seam,
+                                           inj.occurrence_count(seam))
+            assert realized == preview
+
+    def test_occurrence_windows_and_caps(self):
+        inj = FaultInjector(1, {"s": (FaultRule("reset", probability=1.0,
+                                                after=3, until=6),)})
+        fired = [inj.decide("s") is not None for _ in range(10)]
+        assert fired == [False] * 3 + [True] * 3 + [False] * 4
+        inj2 = FaultInjector(1, {"s": (FaultRule("reset", probability=1.0,
+                                                 max_faults=2),)})
+        assert sum(inj2.decide("s") is not None for _ in range(10)) == 2
+
+    def test_interleaving_does_not_shift_seams(self):
+        # Per-seam rng streams: a seam's schedule is a pure function of
+        # ITS occurrence index, however other seams interleave.
+        lone = FaultInjector(9, _RULES)
+        for _ in range(50):
+            lone.decide("client.connect")
+        mixed = FaultInjector(9, _RULES)
+        for i in range(50):
+            mixed.decide("server.dispatch")  # interleaved noise
+            mixed.decide("client.connect")
+        assert ([e for e in lone.events if e.seam == "client.connect"]
+                == [e for e in mixed.events
+                    if e.seam == "client.connect"])
+
+
+# -- resilience primitives ---------------------------------------------------
+
+class TestCircuitBreaker:
+    def _clocked(self, **kw):
+        t = [0.0]
+        br = CircuitBreaker(BreakerConfig(**kw), clock=lambda: t[0])
+        return br, t
+
+    def test_trips_after_threshold_and_recovers(self):
+        br, t = self._clocked(failure_threshold=3, recovery_timeout_s=1.0)
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN and br.opens == 1
+        assert br.allow() == "reject" and br.quarantined()
+        t[0] = 1.5
+        assert not br.quarantined()
+        assert br.allow() == "probe"          # half-open: one probe slot
+        assert br.allow() == "reject"         # second caller sheds
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_half_open_failure_reopens(self):
+        br, t = self._clocked(failure_threshold=1, recovery_timeout_s=0.5)
+        br.record_failure()
+        t[0] = 1.0
+        assert br.allow() == "probe"
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN and br.opens == 2
+
+    def test_success_resets_consecutive_failures(self):
+        br, _ = self._clocked(failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_abandoned_probe_slot_is_reclaimed(self):
+        # A holder cancelled mid-probe must not wedge the node in
+        # reject-forever: release_probe frees the slot immediately, and
+        # even without it the slot self-reclaims after a recovery
+        # window.
+        br, t = self._clocked(failure_threshold=1, recovery_timeout_s=1.0)
+        br.record_failure()
+        t[0] = 1.5
+        assert br.allow() == "probe"
+        br.release_probe()                 # cancelled holder, explicit
+        assert br.allow() == "probe"       # slot immediately available
+        # Leak it this time (no release, no verdict):
+        assert br.allow() == "reject"
+        t[0] = 3.0                         # a recovery window passes
+        assert br.allow() == "probe"       # reclaimed, not wedged
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_transition_listener(self):
+        seen = []
+        br = CircuitBreaker(BreakerConfig(failure_threshold=1,
+                                          recovery_timeout_s=0.0),
+                            clock=lambda: 0.0,
+                            on_transition=lambda o, n: seen.append((o, n)))
+        br.record_failure()
+        br.allow()
+        br.record_success()
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+
+
+class TestRetryPolicy:
+    def test_delay_growth_cap_and_jitter_bounds(self):
+        import random
+
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                        multiplier=2.0, jitter=0.5)
+        rng = random.Random(0)
+        for attempt, raw in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.5)):
+            for _ in range(20):
+                d = p.delay_s(attempt, rng)
+                assert raw * 0.5 <= d <= raw
+        assert p.max_total_delay_s() == pytest.approx(0.1 + 0.2 + 0.4 + 0.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+
+
+# -- wire: the deadline tail --------------------------------------------------
+
+class TestDeadlineTail:
+    def test_roundtrip_and_strip_order(self):
+        frame = wire.encode_request(5, wire.OP_ACQUIRE, "k", 1, 10.0, 1.0,
+                                    deadline_s=0.25)
+        body = frame[4:]
+        assert body[5] & wire.DEADLINE_FLAG
+        plain, ddl = wire.strip_deadline(body)
+        assert ddl == 0.25
+        # The stripped body is byte-identical to an unstamped frame.
+        bare = wire.encode_request(5, wire.OP_ACQUIRE, "k", 1, 10.0, 1.0)
+        assert plain == bare[4:]
+
+    def test_with_trace_tail_trace_rides_last(self):
+        frame = wire.encode_request(
+            5, wire.OP_ACQUIRE, "k", 1, 10.0, 1.0,
+            trace=(1, 2, 3, 1), deadline_s=0.5)
+        body = frame[4:]
+        stripped, tctx = wire.strip_trace(body)
+        assert tctx is not None and tctx.trace_hi == 1
+        plain, ddl = wire.strip_deadline(stripped)
+        assert ddl == 0.5
+        seq, op, key, count, a, b = wire.decode_request(plain)
+        assert (seq, op, key, count, a, b) == (5, wire.OP_ACQUIRE, "k",
+                                               1, 10.0, 1.0)
+
+    def test_old_server_answers_routable_unknown_op(self):
+        frame = wire.encode_request(5, wire.OP_ACQUIRE, "k", 1, 10.0, 1.0,
+                                    deadline_s=0.25)
+        with pytest.raises(wire.RemoteStoreError, match="unknown op"):
+            wire.decode_request(frame[4:])
+
+    def test_truncated_tail_raises(self):
+        frame = wire.encode_request(5, wire.OP_PING, deadline_s=1.0)
+        body = frame[4:5] + bytes([frame[9] ]) + b""  # mangled short body
+        body = frame[4:10]  # header only, flag set, tail missing
+        with pytest.raises(wire.RemoteStoreError, match="truncated"):
+            wire.strip_deadline(body)
+
+
+# -- client resilience over a live wire --------------------------------------
+
+class CountingStore(InProcessBucketStore):
+    """Backing store that logs every executed acquire — the serial-model
+    side of the at-most-once differential."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.acquires: list[str] = []
+
+    async def acquire(self, key, count, capacity, fill_rate_per_sec):
+        self.acquires.append(key)
+        return await super().acquire(key, count, capacity,
+                                     fill_rate_per_sec)
+
+
+class TestClientResilience:
+    def test_connect_reset_retried_and_counted(self):
+        async def main():
+            faults.install(FaultInjector(3, {
+                "client.connect": (FaultRule("reset", probability=1.0,
+                                             max_faults=2),)}))
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    retry_policy=RetryPolicy(max_attempts=4,
+                                             base_delay_s=0.005),
+                    reconnect_backoff_base_s=0.005, resilience_seed=1)
+                try:
+                    assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                    assert store.resilience_stats()["retries"] == 2
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_timeout_is_typed_and_never_retried(self):
+        async def main():
+            faults.install(FaultInjector(3, {
+                "server.dispatch": (FaultRule("blackhole",
+                                              probability=1.0),)}))
+            backing = CountingStore()
+            async with BucketStoreServer(backing) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    request_timeout_s=0.15, resilience_seed=1)
+                try:
+                    with pytest.raises(StoreTimeoutError):
+                        await store.acquire("k", 1, 5.0, 1.0)
+                    # Typed: still an asyncio.TimeoutError for old catches.
+                    assert issubclass(StoreTimeoutError,
+                                      asyncio.TimeoutError)
+                    stats = store.resilience_stats()
+                    assert stats["timeouts"] == 1
+                    assert stats["retries"] == 0  # sent ⇒ never replayed
+                finally:
+                    await store.aclose()
+            assert backing.acquires == []  # blackholed before the store
+
+        run(main())
+
+    def test_per_call_timeout_override(self):
+        async def main():
+            faults.install(FaultInjector(3, {
+                "server.dispatch": (FaultRule("stall", probability=1.0,
+                                              delay_s=0.4),)}))
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port),
+                    request_timeout_s=30.0)  # default would hang 30s
+                try:
+                    t0 = asyncio.get_running_loop().time()
+                    with pytest.raises(StoreTimeoutError):
+                        await store.acquire("k", 1, 5.0, 1.0,
+                                            timeout_s=0.1)
+                    assert asyncio.get_running_loop().time() - t0 < 2.0
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_post_send_failure_not_retried_for_admission(self):
+        # A connection reset AFTER the frame was written may or may not
+        # have executed server-side: the client must surface the error,
+        # not replay the ACQUIRE.
+        async def main():
+            faults.install(FaultInjector(3, {
+                "client.write": (FaultRule("reset", probability=1.0,
+                                           after=1, max_faults=1),)}))
+            backing = CountingStore()
+            async with BucketStoreServer(backing) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    reconnect_backoff_base_s=0.005, resilience_seed=1)
+                try:
+                    assert (await store.acquire("w0", 1, 5.0, 1.0)).granted
+                    with pytest.raises(ConnectionError):
+                        await store.acquire("w1", 1, 5.0, 1.0)
+                    assert store.resilience_stats()["retries"] == 0
+                    # Next use reconnects and serves.
+                    assert (await store.acquire("w2", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+            assert backing.acquires.count("w1") == 0  # never reached
+
+        run(main())
+
+    def test_partial_frame_drops_cleanly_no_misparse(self):
+        async def main():
+            faults.install(FaultInjector(3, {
+                "client.write": (FaultRule("partial_frame",
+                                           probability=1.0, after=1,
+                                           max_faults=1),)}))
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    reconnect_backoff_base_s=0.005, resilience_seed=1)
+                try:
+                    assert (await store.acquire("p0", 1, 5.0, 1.0)).granted
+                    with pytest.raises(ConnectionError):
+                        await store.acquire("p1", 1, 5.0, 1.0)
+                    # The torn frame neither wedged the server nor
+                    # poisoned the next connection.
+                    assert (await store.acquire("p2", 1, 5.0, 1.0)).granted
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+
+class TestDeadlinePropagation:
+    def test_server_sheds_expired_work_unexecuted(self):
+        async def main():
+            faults.install(FaultInjector(3, {
+                "server.dispatch": (FaultRule("delay", probability=1.0,
+                                              delay_s=0.2),)}))
+            backing = CountingStore()
+            async with BucketStoreServer(backing) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    propagate_deadlines=True, request_timeout_s=0.08)
+                try:
+                    with pytest.raises(StoreTimeoutError):
+                        await store.acquire("k", 1, 5.0, 1.0)
+                    await asyncio.sleep(0.25)  # let the server catch up
+                    assert srv.requests_shed == 1
+                    # The shed is visible on the metrics plane too.
+                    assert ("drl_requests_shed_total 1"
+                            in srv.registry.render())
+                finally:
+                    await store.aclose()
+            assert backing.acquires == []  # shed BEFORE the store
+
+        run(main())
+
+    def test_pre_deadline_peer_latches_stamping_off(self):
+        # A fake old server: answers any bit-6-flagged op with the
+        # routable "unknown op" error (exactly what decode_request
+        # raises there) and serves bare frames normally.
+        async def main():
+            flagged = 0
+
+            async def old_server(reader, writer):
+                nonlocal flagged
+                while True:
+                    body = await wire.read_frame(reader)
+                    if body is None:
+                        break
+                    seq = int.from_bytes(body[1:5], "little")
+                    if body[5] & wire.DEADLINE_FLAG:
+                        flagged += 1
+                        resp = wire.encode_response(
+                            seq, wire.RESP_ERROR,
+                            f"unknown op {body[5]}")
+                    else:
+                        resp = wire.encode_response(
+                            seq, wire.RESP_DECISION, True, 1.0)
+                    writer.write(resp)
+                    await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(old_server, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            store = RemoteBucketStore(
+                address=("127.0.0.1", port), coalesce_requests=False,
+                propagate_deadlines=True)
+            try:
+                res = await store.acquire("k", 1, 5.0, 1.0)
+                assert res.granted  # latched off + re-sent bare
+                assert store._peer_deadlines is False
+                assert flagged == 1
+                # Subsequent requests go bare first time (no re-probe).
+                await store.acquire("k", 1, 5.0, 1.0)
+                assert flagged == 1
+            finally:
+                await store.aclose()
+                server.close()
+                await server.wait_closed()
+
+        run(main())
+
+
+# -- the at-most-once differential -------------------------------------------
+
+class TestAtMostOnceDifferential:
+    def test_retried_acquires_never_double_execute(self):
+        """One unique key per logical request: the serial model says
+        each key may execute AT MOST once, whatever the fault schedule
+        did to connects, reads, or dispatch. A replayed ACQUIRE would
+        show up as a key with two executions."""
+
+        async def main():
+            faults.install(FaultInjector(1234, {
+                "client.connect": (FaultRule("reset", probability=0.5),),
+                "client.read": (FaultRule("reset", probability=0.10),),
+            }))
+            backing = CountingStore()
+            async with BucketStoreServer(backing) as srv:
+                store = RemoteBucketStore(
+                    address=(srv.host, srv.port), coalesce_requests=False,
+                    retry_policy=RetryPolicy(max_attempts=4,
+                                             base_delay_s=0.003),
+                    reconnect_backoff_base_s=0.003, resilience_seed=5,
+                    request_timeout_s=2.0)
+                n = 120
+                outcomes: dict[str, str] = {}
+                try:
+                    for i in range(n):
+                        key = f"d{i}"
+                        try:
+                            res = await store.acquire(key, 1, 1.0, 1e-9)
+                            outcomes[key] = ("granted" if res.granted
+                                             else "denied")
+                        except (ConnectionError, OSError,
+                                wire.RemoteStoreError):
+                            outcomes[key] = "error"
+                finally:
+                    await store.aclose()
+
+            retries = store.resilience_stats()["retries"]
+            assert retries > 0, "the schedule must actually retry"
+            # Serial-model replay: every key executes at most once …
+            from collections import Counter
+
+            per_key = Counter(backing.acquires)
+            doubled = {k: c for k, c in per_key.items() if c > 1}
+            assert doubled == {}, f"double-executed keys: {doubled}"
+            # … and every client-observed GRANT maps to exactly one
+            # execution of its key (capacity 1, fill ~0: the model
+            # grants each key's single execution).
+            for key, outcome in outcomes.items():
+                if outcome == "granted":
+                    assert per_key[key] == 1
+
+        run(main())
+
+
+# -- cluster breakers + degraded failover ------------------------------------
+
+class FlakyNode(InProcessBucketStore):
+    """In-process node whose store ops can be failed on demand."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.fail = False
+
+    def _check(self):
+        if self.fail:
+            raise ConnectionError("injected node outage")
+
+    async def acquire(self, *a, **kw):
+        self._check()
+        return await super().acquire(*a, **kw)
+
+    async def acquire_many(self, *a, **kw):
+        self._check()
+        return await super().acquire_many(*a, **kw)
+
+    async def sync_counter(self, *a, **kw):
+        self._check()
+        return await super().sync_counter(*a, **kw)
+
+
+class TestClusterBreakers:
+    def _cluster(self, n=2, **kw):
+        nodes = [FlakyNode() for _ in range(n)]
+        kw.setdefault("breaker", BreakerConfig(failure_threshold=3,
+                                               recovery_timeout_s=0.15))
+        return ClusterBucketStore(stores=nodes, **kw), nodes
+
+    def test_breaker_opens_and_sheds_fast_without_fallback(self):
+        async def main():
+            store, nodes = self._cluster()
+            nodes[1].fail = True  # "hot" routes to node 1
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    await store.acquire("hot", 1, 100.0, 1.0)
+            # Breaker open: typed shed, no node I/O.
+            calls_before = nodes[1].fail
+            with pytest.raises(NodeUnavailableError):
+                await store.acquire("hot", 1, 100.0, 1.0)
+            assert store.shed == 1
+            assert store.node_errors[1] == 3
+            # The healthy node's keyspace is untouched.
+            assert (await store.acquire("alpha", 1, 100.0, 1.0)).granted
+            st = await store.stats()
+            assert st["resilience"]["breakers"][1]["state"] == "open"
+            assert st["resilience"]["breakers"][0]["state"] == "closed"
+            await store.aclose()
+            assert calls_before
+
+        run(main())
+
+    def test_degraded_fallback_serves_quarantined_keyspace(self):
+        async def main():
+            cap = 40.0
+            store, nodes = self._cluster(degraded_fallback=True,
+                                         degraded_fraction=0.5)
+            nodes[1].fail = True
+            # Every failure (and then every breaker-open rejection)
+            # serves from the local fair-share envelope instead of
+            # erroring: availability over accuracy.
+            grants = 0
+            for _ in range(60):
+                res = await store.acquire("hot", 1, cap, 1e-9)
+                grants += res.granted
+            budget = headroom_budget(cap, fraction=0.5, min_budget=1.0)
+            assert 0 < grants <= budget  # bounded by the shared formula
+            assert store.degraded_decisions == 60
+            # Node recovers → probe re-closes the breaker → degraded
+            # state is discarded and the authoritative bucket serves.
+            nodes[1].fail = False
+            await asyncio.sleep(0.2)  # recovery window elapses
+            res = await store.acquire("hot", 1, cap, 1e-9)
+            assert res.granted  # authoritative (fresh bucket: full cap)
+            st = await store.stats()
+            assert st["resilience"]["breakers"][1]["state"] == "closed"
+            assert st["resilience"]["degraded_keys"] == 0  # cleared
+            await store.aclose()
+
+        run(main())
+
+    def test_bulk_rows_degrade_per_node(self):
+        async def main():
+            store, nodes = self._cluster(degraded_fallback=True,
+                                         partial_failures="deny")
+            nodes[1].fail = True
+            keys = ["alpha", "hot", "d", "beta"]  # 0,1,0,1
+            res = await store.acquire_many(keys, [1, 1, 1, 1], 1000.0,
+                                           1.0)
+            assert res.granted[0] and res.granted[2]  # node 0: exact
+            assert res.granted[1] and res.granted[3]  # node 1: degraded
+            assert store.degraded_decisions == 2
+            await store.aclose()
+
+        run(main())
+
+    def test_sync_counter_gets_error_not_fake_result(self):
+        # The approximate limiter owns its degraded mode: it must see
+        # the failure, never a fabricated sync result.
+        async def main():
+            store, nodes = self._cluster(degraded_fallback=True)
+            nodes[1].fail = True
+            with pytest.raises(ConnectionError):
+                await store.sync_counter("hot", 5.0, 1.0)
+            await store.aclose()
+
+        run(main())
+
+    def test_metrics_registry_exposes_breaker_retry_shed(self):
+        async def main():
+            store, nodes = self._cluster(degraded_fallback=False)
+            nodes[1].fail = True
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    await store.acquire("hot", 1, 10.0, 1.0)
+            with pytest.raises(NodeUnavailableError):
+                await store.acquire("hot", 1, 10.0, 1.0)
+            text = store.metrics_registry().render()
+            assert 'drl_cluster_node_errors_total{node="1"} 3' in text
+            assert 'drl_cluster_breaker_state{node="1"} 2' in text
+            assert 'drl_cluster_breaker_opens_total{node="1"} 1' in text
+            assert "drl_cluster_shed_total 1" in text
+            assert "drl_cluster_degraded_decisions_total 0" in text
+            await store.aclose()
+
+        run(main())
+
+    def test_breaker_events_hit_flight_recorder(self):
+        async def main(tmp):
+            rec = FlightRecorder(64, dump_dir=tmp, name="cluster")
+            store, nodes = self._cluster(flight_recorder=rec)
+            nodes[1].fail = True
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    await store.acquire("hot", 1, 10.0, 1.0)
+            kinds = [f["kind"] for f in rec.frames()]
+            assert "node_error" in kinds and "breaker" in kinds
+            assert rec.dumps_written == 1  # breaker_open auto-dump
+            assert "breaker_open" in rec.last_dump_path
+            await store.aclose()
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            run(main(tmp))
+
+
+# -- the seeded chaos soak ----------------------------------------------------
+
+class TestChaosSoak:
+    SEED = 20260803
+
+    RULES = {
+        "client.connect": (
+            FaultRule("reset", probability=0.15),
+            FaultRule("delay", probability=0.2, delay_s=0.001,
+                      jitter_s=0.002),
+        ),
+        "client.read": (FaultRule("reset", probability=0.02),),
+        "server.dispatch": (
+            FaultRule("delay", probability=0.05, delay_s=0.002,
+                      jitter_s=0.002),
+        ),
+    }
+
+    def test_soak_invariants(self):
+        """Live 2-node TCP topology through a healthy → node-down →
+        recovered schedule, with seeded connection/dispatch chaos the
+        whole way. Asserts the acceptance invariants: bounded
+        over-admission, visible errors, breaker recovery, schedule
+        determinism, no stranded futures, clean aclose."""
+
+        async def main():
+            inj = FaultInjector(self.SEED, self.RULES)
+            faults.install(inj)
+            backing0 = InProcessBucketStore()
+            backing1 = FlakyNode()
+            srv0 = BucketStoreServer(backing0)
+            srv1 = BucketStoreServer(backing1)
+            await srv0.start()
+            await srv1.start()
+            cap_hot = 40.0
+            cluster = ClusterBucketStore(
+                addresses=[(srv0.host, srv0.port),
+                           (srv1.host, srv1.port)],
+                breaker=BreakerConfig(failure_threshold=3,
+                                      recovery_timeout_s=0.25),
+                degraded_fallback=True, degraded_fraction=0.5,
+                coalesce_requests=False,
+                request_timeout_s=1.0,
+                retry_policy=RetryPolicy(max_attempts=3,
+                                         base_delay_s=0.004),
+                reconnect_backoff_base_s=0.004,
+                resilience_seed=self.SEED,
+            )
+            hot_grants = 0
+            alpha_ok = 0
+            alpha_n = 0
+
+            async def drive(n: int):
+                nonlocal hot_grants, alpha_ok, alpha_n
+                for i in range(n):
+                    try:
+                        r = await cluster.acquire("hot", 1, cap_hot, 1e-9)
+                        hot_grants += r.granted
+                    except (ConnectionError, OSError, StoreTimeoutError,
+                            wire.RemoteStoreError):
+                        pass  # counted server-side; availability asserted
+                        # via alpha below
+                    alpha_n += 1
+                    try:
+                        r = await cluster.acquire("alpha", 1, 1e6, 1.0)
+                        alpha_ok += r.granted
+                    except (ConnectionError, OSError, StoreTimeoutError,
+                            wire.RemoteStoreError):
+                        pass
+
+            try:
+                # Phase A: healthy (chaos still jitters connects/reads).
+                await drive(50)
+                # Phase B: node 1 down hard — its keyspace must fail
+                # over to the degraded envelope, node 0 keeps serving.
+                backing1.fail = True
+                await drive(100)
+                st = await cluster.stats()
+                assert st["resilience"]["breakers"][1]["opens"] >= 1
+                assert st["resilience"]["node_errors"][1] > 0
+                # Phase C: node recovers; the half-open probe re-closes.
+                backing1.fail = False
+                await asyncio.sleep(0.3)
+                await drive(50)
+                st = await cluster.stats()
+                assert st["resilience"]["breakers"][1]["state"] == "closed"
+
+                # Over-admission: authoritative grants ≤ cap; each
+                # degraded episode adds at most one fair-share budget.
+                budget = headroom_budget(cap_hot, fraction=0.5,
+                                         min_budget=1.0)
+                episodes = st["resilience"]["breakers"][1]["opens"] + 1
+                assert hot_grants <= cap_hot + budget * episodes
+                assert hot_grants >= 10  # availability: it kept serving
+                # Healthy node barely noticed (only client-side chaos).
+                assert alpha_ok >= alpha_n * 0.7
+
+                # Schedule determinism: realized == pure-function preview.
+                for seam in self.RULES:
+                    realized = [e for e in inj.events if e.seam == seam]
+                    assert realized == inj.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                # And an identically-seeded injector would do it again.
+                twin = FaultInjector(self.SEED, self.RULES)
+                for seam in self.RULES:
+                    assert (twin.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                        == inj.schedule_preview(
+                            seam, inj.occurrence_count(seam)))
+
+                # No stranded futures on any node client.
+                for node in cluster.nodes:
+                    assert node._pending == {}
+            finally:
+                await cluster.aclose()
+                await srv0.aclose()
+                await srv1.aclose()
+                await backing0.aclose()
+                await backing1.aclose()
+
+            # Clean aclose: loops stopped, threads joined.
+            for node in cluster.nodes:
+                assert node._io_loop is None
+
+        run(main())
+
+    def test_soak_metrics_exposition_carries_resilience_families(self):
+        """The fleet scrape (cluster_metrics) must carry the breaker /
+        shed / retry families alongside the per-node store series."""
+
+        async def main():
+            backing = FlakyNode()
+            async with BucketStoreServer(backing) as srv:
+                cluster = ClusterBucketStore(
+                    addresses=[(srv.host, srv.port)],
+                    breaker=True, degraded_fallback=True,
+                    coalesce_requests=False, request_timeout_s=0.5)
+                try:
+                    await cluster.acquire("k", 1, 100.0, 1.0)
+                    text = await cluster.cluster_metrics()
+                    assert "drl_cluster_breaker_state" in text
+                    assert "drl_cluster_shed_total" in text
+                    assert "drl_cluster_client_retries_total" in text
+                    assert "drl_requests_served_total" in text  # node's
+                    assert text.rstrip().endswith("# EOF")
+                finally:
+                    await cluster.aclose()
+
+        run(main())

@@ -336,3 +336,79 @@ def test_cli_exit_codes(tmp_path):
                                      "constexpr uint8_t OP_SEMA = 9;", 1))
     (shim / "native" / "directory.cc").write_text(DIRECTORY.read_text())
     assert main(["--root", str(shim), "--only", "wire"]) == 1
+
+
+# -- seeded divergences: swallowed-exception ---------------------------------
+
+RUNTIME_PATH = "distributedratelimiting/redis_tpu/runtime/snippet.py"
+
+
+def test_swallowed_exception_fires_in_runtime_scope_only():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    findings = concurrency_lint.check_source(src, RUNTIME_PATH)
+    assert [f.rule for f in findings] == ["swallowed-exception"]
+    assert findings[0].line == 5
+    # Outside runtime/, the identical handler is a deliberate non-goal.
+    assert concurrency_lint.check_source(
+        src, "distributedratelimiting/redis_tpu/models/snippet.py") == []
+
+
+def test_swallowed_exception_bare_except_counts():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except:
+                return None
+    """)
+    assert [f.rule for f in concurrency_lint.check_source(
+        src, RUNTIME_PATH)] == ["swallowed-exception"]
+
+
+def test_swallowed_exception_visible_handlers_exempt():
+    bodies = [
+        "log.error_evaluating_kernel(exc)",          # structured log
+        "logger.warning('down: %r', exc)",           # any log spelling
+        "raise",                                     # re-raise
+        "self.metrics.sync_failures += 1",           # failure counter
+        "fut.set_exception(exc)",                    # error routing
+        "self.shed = self.shed + 1",                 # counter assignment
+    ]
+    for body in bodies:
+        src = textwrap.dedent(f"""
+            def f():
+                try:
+                    g()
+                except Exception as exc:
+                    {body}
+        """)
+        assert concurrency_lint.check_source(src, RUNTIME_PATH) == [], body
+
+
+def test_swallowed_exception_typed_handlers_exempt():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except (ValueError, OSError):
+                pass
+    """)
+    assert concurrency_lint.check_source(src, RUNTIME_PATH) == []
+
+
+def test_swallowed_exception_suppressible():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            # drl-check: ok(swallowed-exception)
+            except Exception:
+                pass
+    """)
+    assert concurrency_lint.check_source(src, RUNTIME_PATH) == []

@@ -191,6 +191,8 @@ class TestClientServer:
             RemoteBucketStore()
 
     def test_connect_failure_logged_and_retried(self):
+        # Default policy: a failed dial provably sent nothing, so the
+        # SAME call retries it (bounded, jittered) and self-heals.
         async def main():
             async with BucketStoreServer(InProcessBucketStore()) as srv:
                 attempts = 0
@@ -202,12 +204,39 @@ class TestClientServer:
                         raise ConnectionRefusedError("store down")
                     return await asyncio.open_connection(srv.host, srv.port)
 
-                store = RemoteBucketStore(connection_factory=flaky_factory)
+                store = RemoteBucketStore(connection_factory=flaky_factory,
+                                          reconnect_backoff_base_s=0.01,
+                                          resilience_seed=7)
+                try:
+                    assert (await store.acquire("k", 1, 5.0, 1.0)).granted
+                    assert attempts == 2
+                    assert store.resilience_stats()["retries"] == 1
+                finally:
+                    await store.aclose()
+
+        run(main())
+
+    def test_connect_failure_without_retry_policy_surfaces(self):
+        # retry_policy=None restores the reference posture exactly: the
+        # failure surfaces, the NEXT use retries the connect (lazy
+        # recovery, invariant 9).
+        async def main():
+            async with BucketStoreServer(InProcessBucketStore()) as srv:
+                attempts = 0
+
+                async def flaky_factory():
+                    nonlocal attempts
+                    attempts += 1
+                    if attempts == 1:
+                        raise ConnectionRefusedError("store down")
+                    return await asyncio.open_connection(srv.host, srv.port)
+
+                store = RemoteBucketStore(connection_factory=flaky_factory,
+                                          retry_policy=None,
+                                          reconnect_backoff_base_s=0.0)
                 try:
                     with pytest.raises(ConnectionRefusedError):
                         await store.acquire("k", 1, 5.0, 1.0)
-                    # Next use retries the connect (lazy recovery,
-                    # invariant 9).
                     assert (await store.acquire("k", 1, 5.0, 1.0)).granted
                     assert attempts == 2
                 finally:

@@ -344,3 +344,56 @@ def test_tier0_small_buckets_keep_exact_semantics():
                 await store.aclose()
 
     run(body())
+
+
+def test_tier0_streak_trips_flight_recorder_and_clears(tmp_path):
+    """Satellite coverage for the degraded-mode streak
+    (native_frontend.py `_t0_record_round`): T0_STREAK_DUMP consecutive
+    failed sync rounds are degraded entry — the flight recorder dumps —
+    and ONE successful round clears the streak and drains the carried
+    rows."""
+
+    async def body():
+        backing = _OutageStore()
+        cfg = _tier0_config(sync_interval_s=0.02)
+        async with BucketStoreServer(backing, native_frontend=True,
+                                     native_tier0=cfg,
+                                     flight_dir=str(tmp_path)) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                warm = 0
+                for _ in range(50):
+                    warm += (await store.acquire("hot", 1, 10000.0,
+                                                 1e-9)).granted
+                assert warm == 50
+                await asyncio.sleep(0.05)
+
+                backing.fail = True
+                for _ in range(50):  # keep the replica harvesting
+                    await store.acquire("hot", 1, 10000.0, 1e-9)
+                # ≥ T0_STREAK_DUMP failing rounds: the carry keeps each
+                # round non-empty, so the streak advances even without
+                # fresh traffic.
+                fe = srv._native
+                await asyncio.sleep(0.02 * (fe.T0_STREAK_DUMP + 4))
+                assert fe._t0_fail_streak >= fe.T0_STREAK_DUMP
+                snap = srv.flight_recorder.snapshot()
+                assert snap["dumps_written"] >= 1
+                assert "t0_sync_streak" in snap["last_dump_path"]
+                st = await store.stats()
+                assert st["tier0"]["carry_keys"] >= 1   # rows carried
+                assert st["tier0"]["sync_failures"] >= fe.T0_STREAK_DUMP
+
+                backing.fail = False
+                await asyncio.sleep(0.1)  # one good round is enough
+                assert fe._t0_fail_streak == 0          # streak cleared
+                st2 = await store.stats()
+                assert st2["tier0"]["carry_keys"] == 0  # carry drained
+                # Nothing was dropped: warm + outage grants reconciled.
+                tokens, _ = backing._buckets[("hot", 10000.0, 1e-9)]
+                assert tokens <= 10000.0 - warm
+            finally:
+                await store.aclose()
+
+    run(body())

@@ -27,6 +27,16 @@ Rules:
   hands the running thread a closed loop (the use-after-free class
   fixed for the native pump in PR 1; ``cluster.py`` carries the model
   guard).
+- ``swallowed-exception`` — an ``except Exception:`` (or bare
+  ``except:``) in ``runtime/`` whose handler neither logs, raises,
+  replies an error, nor touches a failure counter: the class of
+  invisible partition the chaos-plane PR dug out of ``cluster.py``
+  (a down node vanished into ``pass``). A handler counts as VISIBLE
+  when its body raises, calls anything log/warn-shaped, routes an
+  error onward (``_reply``/``_send``/``fe_fail``/``set_exception``),
+  or bumps a counter-shaped attribute (``…_failures``, ``…_errors``,
+  ``shed``, …). Deliberate swallows (observer-bug shields) annotate
+  ``# drl-check: ok(swallowed-exception)`` with their reason.
 """
 
 from __future__ import annotations
@@ -59,6 +69,17 @@ _LOCKISH = ("lock", "gate", "mutex", "sem")
 _THREADISH = ("thread", "pump", "worker")
 _LOOP_AFFINE = {"create_task", "call_soon", "call_later", "call_at"}
 
+#: swallowed-exception: call-name fragments that make a handler visible
+#: (logging in any spelling) …
+_VISIBLE_CALLISH = ("log", "warn", "print")
+#: … exact call names that route the failure onward instead of eating it …
+_VISIBLE_ROUTES = {"_reply", "_send", "fe_fail", "set_exception",
+                   "encode_response", "dump", "auto_dump",
+                   "_note_node_error", "_note_scrape_error"}
+#: … and attribute-name fragments that count as a failure metric.
+_COUNTERISH = ("failure", "error", "shed", "retr", "timeout",
+               "suppressed", "evicted", "cancelled", "dropped")
+
 
 def _dotted(node: ast.AST) -> tuple[str, ...]:
     """('time', 'sleep') for ``time.sleep`` — best effort, '' for
@@ -82,9 +103,11 @@ class _FnVisitor(ast.NodeVisitor):
     """Per-function-scope analysis; nested defs get their own scope (a
     sync helper nested in an async def is not 'in' the async def)."""
 
-    def __init__(self, path: str, supp: Suppressions) -> None:
+    def __init__(self, path: str, supp: Suppressions,
+                 runtime_scope: bool = False) -> None:
         self.path = path
         self.supp = supp
+        self.runtime_scope = runtime_scope  # swallowed-exception on/off
         self.findings: list[Finding] = []
         self._stack: list[ast.AST] = []  # enclosing function nodes
 
@@ -187,6 +210,46 @@ class _FnVisitor(ast.NodeVisitor):
                            "asyncio.Lock or release before awaiting")
         self.generic_visit(node)
 
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self.runtime_scope and self._swallows(node):
+            self._emit(
+                "swallowed-exception", node.lineno,
+                "'except Exception' swallows the failure with no log, "
+                "metric, raise, or error routing — a partition here is "
+                "invisible; log it (utils/log.py), bump a counter, or "
+                "annotate the deliberate shield")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _swallows(node: ast.ExceptHandler) -> bool:
+        """True for an Exception-wide handler whose body makes the
+        failure invisible (no raise / log-ish call / error routing /
+        counter-shaped attribute write)."""
+        t = node.type
+        wide = (t is None
+                or (isinstance(t, ast.Name)
+                    and t.id in ("Exception", "BaseException")))
+        if not wide:
+            return False
+        for n in ast.walk(node):
+            if isinstance(n, ast.Raise):
+                return False
+            if isinstance(n, ast.Call):
+                name = _dotted(n.func)[-1]
+                lowered = ".".join(_dotted(n.func)).lower()
+                if (name in _VISIBLE_ROUTES
+                        or any(t in lowered for t in _VISIBLE_CALLISH)):
+                    return False
+            if isinstance(n, (ast.AugAssign, ast.Assign)):
+                targets = ([n.target] if isinstance(n, ast.AugAssign)
+                           else n.targets)
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and any(c in tgt.attr.lower()
+                                    for c in _COUNTERISH)):
+                        return False
+        return True
+
     @staticmethod
     def _body_walk(node: ast.With):
         """Walk the with-body without descending into nested defs (an
@@ -234,9 +297,15 @@ class _FnVisitor(ast.NodeVisitor):
                     "use-after-frees (guard like cluster.py aclose)")
 
 
-def check_source(source: str, path: str) -> list[Finding]:
+def check_source(source: str, path: str,
+                 runtime_scope: "bool | None" = None) -> list[Finding]:
+    if runtime_scope is None:
+        # swallowed-exception is scoped to the serving runtime — the
+        # layer whose invisible failures ARE outages. Models, utils,
+        # and tools keep their deliberate broad catches unflagged.
+        runtime_scope = "runtime" in pathlib.PurePath(path).parts
     tree = ast.parse(source)
-    visitor = _FnVisitor(path, Suppressions(source))
+    visitor = _FnVisitor(path, Suppressions(source), runtime_scope)
     visitor.visit(tree)
     return sorted(visitor.findings, key=lambda f: f.line)
 
