@@ -7,9 +7,9 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: check lint test native asan-test tsan-test chaos-test
+.PHONY: check lint test native asan-test tsan-test chaos-test reshard-soak
 
-check: lint test asan-test tsan-test
+check: lint test chaos-test asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -36,6 +36,14 @@ test:
 chaos-test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -v \
 	  -p no:cacheprovider
+
+# Membership soak: join/leave/hot-split under seeded chaos load
+# (docs/OPERATIONS.md §9). `make reshard-soak SEED=...` replays any
+# schedule bit-for-bit — the same determinism contract as chaos-test.
+SEED ?= 20260803
+reshard-soak:
+	JAX_PLATFORMS=cpu DRL_RESHARD_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_reshard.py -v -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).
 native:

@@ -4,8 +4,9 @@ The 50M/s north star (BASELINE.json) is an *aggregate serving* target —
 kernel-path numbers don't speak to it. This harness measures the only
 aggregate the environment can produce: N shared-nothing
 ``BucketStoreServer`` processes on this box, M client processes each
-bulk-driving a ``ClusterBucketStore`` (client-side crc32 key sharding,
-per-node sub-batches fanned out concurrently — the same composition the
+bulk-driving a ``ClusterBucketStore`` (client-side placement-map
+routing — epoch-0 maps route exactly like crc32 % N — with per-node
+sub-batches fanned out concurrently; the same composition the
 reference would reach with N Redis nodes and cluster-aware clients,
 ``RedisRateLimiting.Redis/README.md``'s horizontal-scale story).
 

@@ -26,13 +26,18 @@ import pickle
 import tempfile
 import zlib
 
-__all__ = ["save_snapshot", "load_snapshot", "SnapshotCorruptError"]
+__all__ = ["save_snapshot", "load_snapshot", "SnapshotCorruptError",
+           "PlacementMismatchError"]
 
 _MAGIC = "drl-tpu-snapshot"
 # v1: initial format (2-tuple wtable keys, no semaphore sections).
 # v2: wtable keys widened to 3-tuples; sema_dir/semas sections added.
 # v3: store state nested as its own pickle ("snapshot_pickle") with a
-#     CRC-32 checksum ("crc32") over those bytes.
+#     CRC-32 checksum ("crc32") over those bytes. Since round 6 a v3
+#     payload may additionally carry "placement_epoch" (the cluster
+#     placement epoch the state was owned under — see runtime/
+#     placement.py); absent in older files and for placement-unaware
+#     servers, and ignored by older readers (optional payload key).
 # Readers accept any version in _COMPAT — a v1/v2 snapshot restores into
 # a v3 build (no checksum to verify; restore() treats newer sections as
 # optional); an *unknown* (newer) version fails loudly here instead of as
@@ -56,9 +61,22 @@ class SnapshotCorruptError(ValueError):
     pre-typed catches keep working."""
 
 
-def save_snapshot(store, path: str) -> None:
+class PlacementMismatchError(SnapshotCorruptError):
+    """The checkpoint was written under a different cluster placement
+    epoch than the caller expects: its key memberships belong to a
+    retired map, and restoring it would let a rejoining node serve (and
+    double-admit) keys it no longer owns. Recovery is the same
+    init-on-miss fallback as a torn file — which is why this subclasses
+    :class:`SnapshotCorruptError`: every existing fallback path already
+    does the right thing."""
+
+
+def save_snapshot(store, path: str,
+                  placement_epoch: "int | None" = None) -> None:
     """Pull ``store``'s live state to host and write it to ``path``
-    atomically."""
+    atomically. ``placement_epoch`` stamps the cluster placement epoch
+    the state was owned under (placement-aware servers pass it on
+    OP_SAVE) so a later restore can be held to the current map."""
     snap_bytes = pickle.dumps(store.snapshot(), protocol=5)
     payload = {
         "magic": _MAGIC,
@@ -66,6 +84,8 @@ def save_snapshot(store, path: str) -> None:
         "crc32": zlib.crc32(snap_bytes),
         "snapshot_pickle": snap_bytes,
     }
+    if placement_epoch is not None:
+        payload["placement_epoch"] = int(placement_epoch)
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".snapshot-")
     try:
@@ -82,11 +102,18 @@ def save_snapshot(store, path: str) -> None:
         raise
 
 
-def load_snapshot(store, path: str) -> None:
+def load_snapshot(store, path: str,
+                  expected_placement_epoch: "int | None" = None) -> None:
     """Restore ``store`` from a checkpoint file written by
     :func:`save_snapshot`. Timestamps re-align to this process's clock
     epoch inside ``store.restore``. Only load files you wrote — the format
     is pickle (trusted-operator checkpoint, not an interchange format).
+
+    ``expected_placement_epoch`` holds the file to a cluster placement
+    epoch: a mismatch (including a file with no recorded epoch) raises
+    :class:`PlacementMismatchError` BEFORE any state is unpickled into
+    the store — the rejoining-node init-on-miss gate. ``None`` skips the
+    check (single-node and placement-unaware deployments).
 
     Raises :class:`SnapshotCorruptError` for a torn or bit-flipped file
     (including a v3 checksum mismatch) and plain :class:`ValueError` for
@@ -107,6 +134,15 @@ def load_snapshot(store, path: str) -> None:
             f"snapshot version {payload.get('version')} not supported "
             f"(this build reads {sorted(_COMPAT)})"
         )
+    if expected_placement_epoch is not None:
+        recorded = payload.get("placement_epoch")
+        if recorded != expected_placement_epoch:
+            raise PlacementMismatchError(
+                f"{path} was written under placement epoch {recorded} "
+                f"but the cluster is at epoch {expected_placement_epoch}"
+                "; its key memberships are stale — delete it to fall "
+                "back to init-on-miss (migration re-ships any state "
+                "this node should own)")
     if "snapshot_pickle" in payload:  # v3: verify before unpickling
         blob = payload["snapshot_pickle"]
         crc = zlib.crc32(blob)

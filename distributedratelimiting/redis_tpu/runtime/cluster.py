@@ -7,12 +7,22 @@ pod slice via :class:`~..parallel.mesh_store.MeshBucketStore`). This module
 adds the horizontal dimension the reference's README gestured at with
 partitioning (``README.md:7-8``) at *cluster* scale: N independent store
 servers — each its own time authority for the keys it owns — with clients
-routing ``key → node`` by the same stable crc32 the in-mesh sharding uses
-(:func:`~..parallel.sharded_store.shard_of_key`). This is the
-Redis-Cluster shape, re-hosted: hash-slot routing lives in the client,
-nodes share nothing, and the DCN between hosts carries only each key's own
-traffic — no cross-node collectives, because keys never interact
-(SURVEY.md §5.7).
+routing ``key → node`` through an **epoch-versioned placement map**
+(:class:`~.placement.PlacementMap`): the same stable crc32 the in-mesh
+sharding uses picks a fixed *slot*, and the map assigns slots (plus
+per-key hot-split overrides) to nodes. This is the Redis-Cluster shape,
+re-hosted — hash-slot routing lives in the client, nodes share nothing,
+and the DCN between hosts carries only each key's own traffic
+(SURVEY.md §5.7) — but since round 6 the slot table is *live*:
+:meth:`~ClusterBucketStore.add_node` / :meth:`~ClusterBucketStore.
+drain_node` / :meth:`~ClusterBucketStore.split_hot_key` migrate slots
+(and their bucket state, through the MIGRATE_PULL/PUSH handoff with its
+bounded dual-ownership window — placement.py) instead of re-homing half
+the keyspace by arithmetic. The epoch-0 map routes bit-identically to
+the old ``crc32 % N``, so a cluster that never reshapes behaves exactly
+as before. A node answering the routable ``placement moved`` error
+makes the client refetch the map and re-route — the MOVED-redirect
+posture, no coordination service.
 
 Semantics carried over from the single-node client:
 
@@ -63,17 +73,21 @@ breakers** and a **degraded-mode fallback** on top:
 from __future__ import annotations
 
 import asyncio
+import math
 import threading
 import time
-from typing import Callable, Sequence
+from typing import Callable, Mapping, Sequence
 
 import numpy as np
 
-from distributedratelimiting.redis_tpu.parallel.sharded_store import (
-    route_keys,
-    shard_of_key,
-)
+from distributedratelimiting.redis_tpu.runtime import placement as placement_mod
+from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
+from distributedratelimiting.redis_tpu.runtime.placement import (
+    MOVED_ERROR_PREFIX,
+    PlacementError,
+    PlacementMap,
+)
 from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
 from distributedratelimiting.redis_tpu.runtime.store import (
     AcquireResult,
@@ -81,13 +95,13 @@ from distributedratelimiting.redis_tpu.runtime.store import (
     BulkAcquireResult,
     SyncResult,
 )
-from distributedratelimiting.redis_tpu.utils import log, tracing
+from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.resilience import (
     BreakerConfig,
     CircuitBreaker,
 )
 
-__all__ = ["ClusterBucketStore", "NodeUnavailableError"]
+__all__ = ["ClusterBucketStore", "NodeUnavailableError", "PlacementError"]
 
 
 class NodeUnavailableError(ConnectionError):
@@ -105,9 +119,12 @@ class _DegradedKeyspace:
     approximate limiter and the tier-0 edge cache use, re-hosted at the
     cluster edge (models/approximate.py's shared-formula discipline).
     Windows degrade as token buckets with ``(limit, limit/window)``.
-    State is per-client and DISCARDED on rejoin (``clear_node``): when
-    the authoritative node returns, its state rules — the wiped-state
-    self-heal posture of the reference.
+    State is per-client; on rejoin the envelope's GRANTS are drained
+    (``drain_node``) and debited against the authoritative node's
+    buckets — closing the unaccounted over-admission window the
+    discard-on-rejoin posture left open (a grant served locally during
+    the outage now costs the real bucket, so the post-rejoin admission
+    total stays inside the same epsilon bound as the outage itself).
     """
 
     #: Bounded memory under hostile key cardinality: oldest-inserted
@@ -116,6 +133,12 @@ class _DegradedKeyspace:
     #: which the epsilon bound already charges for).
     _MAX_KEYS = 1 << 16
 
+    #: Grants-ledger eviction batch: at the 2×_MAX_KEYS cap, the
+    #: smallest _EVICT_BATCH debts are shed in one heap pass instead of
+    #: one min() scan per insert (the next scan is this many inserts
+    #: away, so the amortized per-insert cost is ~O(log batch)).
+    _EVICT_BATCH = 1 << 12
+
     def __init__(self, fraction: float = 0.5,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if not 0.0 < fraction <= 1.0:
@@ -123,35 +146,62 @@ class _DegradedKeyspace:
         self._fraction = fraction
         self._clock = clock
         self._buckets: dict[tuple, tuple[float, float]] = {}
+        #: Grants served per ``(node, key, kind, a, b)`` during the
+        #: CURRENT outage — the rejoin-debit ledger (``drain_node``).
+        self._grants: dict[tuple, float] = {}
 
     def acquire(self, node: int, key: str, count: int, capacity: float,
-                fill_rate_per_sec: float) -> AcquireResult:
-        from distributedratelimiting.redis_tpu.models.approximate import (
-            headroom_budget,
-        )
-
-        budget = headroom_budget(capacity, fraction=self._fraction,
-                                 min_budget=1.0)
+                fill_rate_per_sec: float,
+                kind: str = "bucket") -> AcquireResult:
         now = self._clock()
-        k = (node, key, float(capacity), float(fill_rate_per_sec))
+        k = (node, key, kind, float(capacity), float(fill_rate_per_sec))
         entry = self._buckets.get(k)
         if entry is None:
             if len(self._buckets) >= self._MAX_KEYS:
-                self._buckets.pop(next(iter(self._buckets)))
-            tokens = budget
-        else:
-            tokens, ts = entry
-            tokens = min(budget, tokens + (now - ts)
-                         * fill_rate_per_sec * self._fraction)
-        granted = tokens >= count
+                # Evict the BUCKET only: its grants ledger row survives
+                # (bounded separately below) so the rejoin debit still
+                # charges every grant the outage served — eviction must
+                # not reopen the unaccounted-over-admission window.
+                del self._buckets[next(iter(self._buckets))]
+            if len(self._grants) >= 2 * self._MAX_KEYS:
+                # Ledger cap under truly hostile cardinality: shed the
+                # SMALLEST debts (least unaccounted admission) in one
+                # amortized batch — a per-insert min() scan of 128K
+                # entries would turn the degraded fallback into an O(n)
+                # hotspot on exactly the path meant to keep serving
+                # while a node is down. One heap pass per _EVICT_BATCH
+                # inserts ≈ O(log batch) per insert.
+                import heapq
+
+                for gk, _ in heapq.nsmallest(
+                        self._EVICT_BATCH, self._grants.items(),
+                        key=lambda kv: kv[1]):
+                    del self._grants[gk]
+        # The shared envelope formula (placement.envelope_step): the
+        # epsilon bound's two halves must never drift apart.
+        granted, tokens = placement_mod.envelope_step(
+            entry, now, count, capacity, fill_rate_per_sec,
+            self._fraction)
         if granted and count > 0:
-            tokens -= count
+            self._grants[k] = self._grants.get(k, 0.0) + count
         self._buckets[k] = (tokens, now)
-        return AcquireResult(bool(granted), float(max(tokens, 0.0)))
+        return AcquireResult(granted, max(tokens, 0.0))
+
+    def drain_node(self, node: int) -> list[tuple[str, str, float,
+                                                  float, float]]:
+        """Rejoin: collect the outage's grants as ``(key, kind, a, b,
+        count)`` debit rows and clear the node's degraded state — the
+        caller charges them to the authoritative buckets."""
+        out = [(k[1], k[2], k[3], k[4], granted)
+               for k, granted in self._grants.items() if k[0] == node]
+        self.clear_node(node)
+        return out
 
     def clear_node(self, node: int) -> None:
         for k in [k for k in self._buckets if k[0] == node]:
             del self._buckets[k]
+        for k in [k for k in self._grants if k[0] == node]:
+            del self._grants[k]
 
     def __len__(self) -> int:
         return len(self._buckets)
@@ -195,6 +245,9 @@ class ClusterBucketStore(BucketStore):
         degraded_fraction: float = 0.5,
         probe_timeout_s: float = 1.0,
         flight_recorder=None,
+        placement: "PlacementMap | None" = None,
+        slots_per_node: int = placement_mod.DEFAULT_SLOTS_PER_NODE,
+        handoff_window_s: float = 2.0,
         **remote_kwargs,
     ) -> None:
         if stores is not None:
@@ -212,7 +265,37 @@ class ClusterBucketStore(BucketStore):
             raise ValueError("partial_failures must be 'raise' or 'deny'")
         self.nodes: list[BucketStore] = nodes
         self.n_nodes = len(nodes)
+        self._remote_kwargs = dict(remote_kwargs)
         self._partial_failures = partial_failures
+        # Epoch-versioned keyspace ownership (placement.py). The default
+        # initial map routes bit-identically to the legacy crc32 % N, so
+        # a never-reshaped cluster behaves exactly as before.
+        self.placement = placement or PlacementMap.initial(
+            self.n_nodes, slots_per_node)
+        self._handoff_window_s = handoff_window_s
+        #: Nodes currently drained out of the slot table (still in
+        #: ``nodes`` — indices are stable identities; rejoin_node folds
+        #: them back in).
+        self.drained: set[int] = set()
+        #: Committed/aborted membership changes, in order — the reshard
+        #: soak's differential-audit source of truth (each event carries
+        #: the moved slots/keys plus the handoff window's [t_start,
+        #: t_end] in time.monotonic()). Bounded like every other ledger
+        #: here: a long-lived cluster resharding periodically keeps the
+        #: newest _MIGRATION_LOG_CAP events.
+        self.migration_log: list[dict] = []
+        self.migrations = 0
+        self.migration_aborts = 0
+        #: Degraded-envelope grants debited against rejoining nodes'
+        #: authoritative buckets (the rejoin-reconcile satellite).
+        self.rejoin_debits = 0
+        self._announced = False
+        # Membership ops serialize on this coordinator: two concurrent
+        # reshapes would read the same epoch, build conflicting targets,
+        # and cross-wire the per-epoch pull/push ledgers (the server
+        # side has _control_lock; this is the coordinator's half).
+        self._membership_lock = asyncio.Lock()
+        self._bg_tasks: set[asyncio.Task] = set()
         # Local clock satisfies the BucketStore interface (diagnostics
         # only); each NODE is the time authority for the keys it owns.
         self.clock = clock or MonotonicClock()
@@ -221,13 +304,15 @@ class ClusterBucketStore(BucketStore):
         self.flight_recorder = flight_recorder
         self._degraded = (_DegradedKeyspace(degraded_fraction)
                           if degraded_fallback else None)
+        self._breaker_clock = breaker_clock
         if breaker:
-            config = breaker if isinstance(breaker, BreakerConfig) \
-                else BreakerConfig()
+            self._breaker_config = breaker if isinstance(
+                breaker, BreakerConfig) else BreakerConfig()
             self._breakers: "list[CircuitBreaker] | None" = [
-                self._make_breaker(j, config, breaker_clock)
+                self._make_breaker(j, self._breaker_config, breaker_clock)
                 for j in range(self.n_nodes)]
         else:
+            self._breaker_config = BreakerConfig()
             self._breakers = None
         self._probe_timeout_s = probe_timeout_s
         #: Per-node store-operation failures (satellite: partitions are
@@ -262,18 +347,77 @@ class ClusterBucketStore(BucketStore):
                     self.flight_recorder.auto_dump("breaker_open",
                                                    {"node": j})
             if new == CircuitBreaker.CLOSED and self._degraded is not None:
-                # Rejoin: the authoritative node rules again; local
-                # degraded state self-heals away (wiped-state posture).
-                self._degraded.clear_node(j)
+                # Rejoin: the authoritative node rules again. The
+                # outage's envelope GRANTS are debited against its
+                # buckets (best-effort, async) instead of silently
+                # discarded — otherwise every degraded grant would be
+                # over-admission the authoritative state never heard of.
+                grants = self._degraded.drain_node(j)
+                if grants:
+                    self._spawn(self._rejoin_debit(j, grants))
 
         return CircuitBreaker(config, clock=clock,
                               on_transition=on_transition)
 
+    # -- background work ---------------------------------------------------
+    def _spawn(self, coro) -> None:
+        """Run a coroutine in the background, always on the cluster's
+        OWN I/O loop (a breaker transition can fire on any caller's
+        loop — cancelling foreign-loop tasks from aclose would not be
+        thread-safe). Tracked as concurrent futures, whose ``cancel`` is
+        thread-safe from wherever aclose runs."""
+        if self._closed:
+            coro.close()
+            return
+        fut = asyncio.run_coroutine_threadsafe(coro, self._ensure_loop())
+        self._bg_tasks.add(fut)
+        fut.add_done_callback(self._bg_tasks.discard)
+
+    async def _rejoin_debit(self, j: int,
+                            grants: "list[tuple[str, str, float, float, float]]"
+                            ) -> None:
+        """Charge a rejoined node's buckets for the grants its degraded
+        envelope served (satellite bugfix). Saturating by construction
+        (:func:`placement.saturating_drain`): the bucket lands at (or
+        near) empty, never negative, and a failure just leaves the grant
+        unreconciled (bounded by the envelope budget, the pre-existing
+        posture)."""
+        node = self.nodes[j]
+        for key, kind, a, b, count in grants:
+            n = int(math.ceil(count))
+            if n <= 0:
+                continue
+            try:
+                if kind in ("window", "fwindow"):
+                    window_sec = a / b if b > 0 else 1.0
+                    op = (node.fixed_window_acquire if kind == "fwindow"
+                          else node.window_acquire)
+                    await placement_mod.saturating_drain(
+                        lambda m: op(key, m, a, window_sec), n)
+                else:
+                    await placement_mod.saturating_drain(
+                        lambda m: node.acquire(key, m, a, b), n)
+                self.rejoin_debits += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # The node just rejoined; if it flaps again the breaker
+                # owns it — the unreconciled grant stays inside the
+                # envelope bound. Visible, not silent.
+                self._note_scrape_error(j, exc)
+
     # -- routing -----------------------------------------------------------
+    def node_index_of(self, key: str) -> int:
+        """Index of the node that owns ``key`` under the current
+        placement epoch — THE routing truth; every lane (scalar, bulk,
+        blocking, submitter) and every external consumer (examples,
+        benchmarks) goes through the map, never a modulus."""
+        return int(self.placement.node_of(key))
+
     def node_of(self, key: str) -> BucketStore:
-        """The node that owns ``key`` (stable crc32 — every client on every
-        host routes identically, no coordination)."""
-        return self.nodes[shard_of_key(key, self.n_nodes)]
+        """The node that owns ``key`` under the current placement epoch
+        (every client holding the same epoch routes identically)."""
+        return self.nodes[self.node_index_of(key)]
 
     # -- failure bookkeeping -------------------------------------------------
     def _note_node_error(self, j: int, exc: BaseException) -> None:
@@ -306,22 +450,29 @@ class ClusterBucketStore(BucketStore):
         self.degraded_decisions += 1
         return fallback()
 
+    async def _ping_node(self, j: int) -> bool:
+        """Await node ``j``'s ping surface under the probe timeout.
+        Returns False when the node has none (in-process nodes whose
+        liveness is settled elsewhere); ping failures propagate."""
+        ping = getattr(self.nodes[j], "ping", None)
+        if not callable(ping):
+            return False
+        try:
+            coro = ping(timeout_s=self._probe_timeout_s)
+        except TypeError:  # in-process nodes: plain ping()
+            coro = ping()
+        await coro
+        return True
+
     async def _probe(self, j: int) -> bool:
         """Half-open health probe: ping the node (nodes without a ping
         surface let the real request itself settle the probe). Returns
         whether the node may be used for the request that won the
         probe slot."""
-        node = self.nodes[j]
         assert self._breakers is not None
-        ping = getattr(node, "ping", None)
-        if not callable(ping):
-            return True
         try:
-            try:
-                coro = ping(timeout_s=self._probe_timeout_s)
-            except TypeError:  # in-process nodes: plain ping()
-                coro = ping()
-            await coro
+            if not await self._ping_node(j):
+                return True
         except asyncio.CancelledError:
             # Cancellation is no verdict on the node: free the slot so
             # the next caller probes instead of rejecting forever.
@@ -355,6 +506,19 @@ class ClusterBucketStore(BucketStore):
                 br.release_probe()
             raise
         except Exception as exc:
+            if (isinstance(exc, wire.RemoteStoreError)
+                    and (MOVED_ERROR_PREFIX in str(exc)
+                         or placement_mod.HANDOFF_DEFERRAL_PREFIX
+                         in str(exc))):
+                # Stale routing or a parked-key deferral mid-handoff:
+                # the node is HEALTHY — no breaker advance, no degraded
+                # absorption. _routed chases a move; a deferral clears
+                # within one handoff window (a breaker trip here would
+                # quarantine the node's whole keyspace as a side effect
+                # of a routine migration).
+                if br is not None:
+                    br.record_success()
+                raise
             self._note_node_error(j, exc)
             if fallback is not None and self._degraded is not None:
                 self.degraded_decisions += 1
@@ -363,6 +527,29 @@ class ClusterBucketStore(BucketStore):
         if br is not None:
             br.record_success()
         return res
+
+    async def _routed(self, key: str, make_call, make_fallback=None):
+        """Route ``key`` through the placement map, run the op under the
+        node's breaker, and chase at most one placement move: a node
+        answering the routable ``placement moved`` error means this
+        client's map is stale — refetch and re-route once, then let the
+        error surface (a second move mid-call is indistinguishable from
+        a flapping coordinator)."""
+        for attempt in (0, 1):
+            j = self.node_index_of(key)
+            try:
+                if not self._resilient:
+                    return await make_call(j)
+                return await self._guarded_call(
+                    j, lambda: make_call(j),
+                    fallback=(None if make_fallback is None
+                              else lambda: make_fallback(j)))
+            except wire.RemoteStoreError as exc:
+                if attempt == 0 and MOVED_ERROR_PREFIX in str(exc):
+                    await self.refresh_placement()
+                    if self.node_index_of(key) != j:
+                        continue
+                raise
 
     # -- blocking-surface plumbing ------------------------------------------
     def _ensure_loop(self) -> asyncio.AbstractEventLoop:
@@ -401,6 +588,13 @@ class ClusterBucketStore(BucketStore):
         if self._closed:
             return
         self._closed = True
+        # Background work (rejoin debits, placement refreshes) must not
+        # outlive the clients it would call through. These are
+        # concurrent futures on OUR I/O loop: cancel is thread-safe,
+        # and anything already running dies with the loop teardown
+        # below (never the caller's loop).
+        for f in list(self._bg_tasks):
+            f.cancel()
         # return_exceptions: one node's failed close must not skip the
         # others or leak the I/O loop thread below.
         outs = await asyncio.gather(*(n.aclose() for n in self.nodes),
@@ -423,19 +617,453 @@ class ClusterBucketStore(BucketStore):
             if isinstance(out, BaseException):
                 raise out
 
+    # -- elastic membership / live migration (docs/OPERATIONS.md §9) --------
+    @property
+    def active_nodes(self) -> list[int]:
+        """Node indices currently eligible to own slots (not drained)."""
+        return [j for j in range(self.n_nodes) if j not in self.drained]
+
+    async def refresh_placement(self) -> int:
+        """Adopt the highest placement epoch any reachable node reports
+        (the client half of the MOVED-redirect loop). A map naming node
+        indices this client has no transport for is ignored — this
+        client's topology must be extended (``add_node``) first."""
+        async def one(j: int, node: BucketStore) -> "dict | None":
+            fetch = getattr(node, "placement_fetch", None)
+            if not callable(fetch):
+                return None
+            if self._breakers is not None \
+                    and self._breakers[j].quarantined():
+                return None  # don't stall a refresh behind a dead node
+            try:
+                return await fetch(timeout_s=self._probe_timeout_s)
+            except Exception as exc:
+                self._note_scrape_error(j, exc)
+                return None
+
+        # Concurrent fan-out: a stale-mapped caller's MOVED chase waits
+        # one probe timeout, not one per node.
+        payloads = await asyncio.gather(*(one(j, n) for j, n in
+                                          enumerate(self.nodes)))
+        best = self.placement
+        for payload in payloads:
+            if payload is None:
+                continue
+            if payload.get("epoch", -1) > best.epoch and "map" in payload:
+                candidate = PlacementMap.from_dict(payload["map"])
+                if max(candidate.nodes_in_use(), default=0) < self.n_nodes:
+                    best = candidate
+        self.placement = best
+        return best.epoch
+
+    async def _health_gate(self, j: int) -> None:
+        """A node must prove liveness before taking ownership (the PR-5
+        health-gated-membership posture): its breaker must not be open,
+        and its ping must answer inside the probe timeout."""
+        if self._breakers is not None and self._breakers[j].quarantined():
+            raise PlacementError(
+                f"node {j} is quarantined (circuit open); it cannot "
+                "take ownership")
+        try:
+            await self._ping_node(j)
+        except Exception as exc:
+            if self._breakers is not None:
+                self._breakers[j].record_failure()
+            raise PlacementError(
+                f"node {j} failed its health probe: {exc!r}") from exc
+
+    async def _announce_to(self, j: int, payload: dict,
+                           strict: bool) -> None:
+        node = self.nodes[j]
+        ann = getattr(node, "placement_announce", None)
+        if not callable(ann):
+            return  # in-process node: client-side routing only
+        try:
+            await ann(payload)
+        except Exception as exc:
+            self._note_scrape_error(j, exc)
+            if strict:
+                raise
+
+    def _keep_for(self, slots: "set[int]", keys: "set[str]"):
+        # One shared selection rule with the server-side pull — the two
+        # lanes diverging here is exactly the drive-caught class of bug.
+        return placement_mod.keep_predicate(
+            self.placement.n_slots, self.placement.overrides, slots, keys)
+
+    async def _pull_from(self, src: int, slots: "list[int]",
+                         keys: "list[str]", target_epoch: int
+                         ) -> "dict | None":
+        """One source's export: the wire pull for remote nodes (parks +
+        debits server-side), a direct snapshot extract for in-process
+        ones. ``None`` = the source is unreachable — the dead-leave
+        case: its state is lost and the new owners serve init-on-miss
+        (the reference's wiped-state posture, now scoped to one node)."""
+        node = self.nodes[src]
+        req = {"target_epoch": target_epoch,
+               "window_s": self._handoff_window_s}
+        if slots:
+            req["slots"] = slots
+        if keys:
+            req["keys"] = keys
+        pull = getattr(node, "migrate_pull", None)
+        try:
+            if callable(pull):
+                out = await pull(req)
+                entries = out.get("entries") or {}
+                # Paged reply: a big export chunks server-side so every
+                # frame fits MAX_FRAME; pages 1..N-1 come from the
+                # handoff cache (idempotent — retries included).
+                for page in range(1, int(out.get("pages", 1))):
+                    more = await pull({**req, "page": page})
+                    entries = placement_mod.merge_entries(
+                        entries, more.get("entries") or {})
+                return entries
+            if hasattr(node, "snapshot"):
+                # In-process lane: balances ship EXACTLY (no envelope —
+                # there is no server-side park to serve one from), and
+                # the source is drained of the shipped amount in the
+                # same breath so a task interleaving between this pull
+                # and the commit cannot spend a balance the new owner
+                # already received (the remote lane's debit_source
+                # contract, keep_envelope=False).
+                # to_thread mirrors the server-side pull: a device
+                # store's snapshot() pulls whole slot arrays to host —
+                # run it off-loop so the export never stalls the
+                # coordinator's serving path.
+                entries = await asyncio.to_thread(
+                    placement_mod._export_from_store,
+                    node, self._keep_for(set(slots), set(keys)))
+                await placement_mod.debit_source(
+                    node, entries,
+                    placement_mod.DEFAULT_ENVELOPE_FRACTION,
+                    keep_envelope=False)
+                return entries
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                NodeUnavailableError) as exc:
+            self._note_node_error(src, exc)
+            # Ambiguity guard: a timed-out/reset pull may still have
+            # EXECUTED (parking + debiting the source). Declaring state
+            # lost is only sound when the node is actually dead — so
+            # probe it. Alive ⇒ abort the migration instead (the parked
+            # state unparks on the abort announce, or self-heals at
+            # window expiry); truly dead ⇒ init-on-miss is all there is.
+            try:
+                alive = await self._ping_node(src)
+            except asyncio.CancelledError:
+                raise
+            # The probe failing IS the verdict (node dead ⇒ state
+            # genuinely lost); the pull failure was counted above.
+            # drl-check: ok(swallowed-exception)
+            except Exception:
+                alive = False
+            if alive:
+                raise PlacementError(
+                    f"pull from node {src} failed ({exc!r}) but the "
+                    "node is alive — aborting rather than guessing "
+                    "its state was lost") from exc
+            return None
+        return {}
+
+    async def _apply_placement(self, target: PlacementMap,
+                               moves: "Mapping[int, int]",
+                               moved_keys: "Mapping[str, int] | None" = None,
+                               reason: str = "rebalance") -> None:
+        """One membership change, end to end: health-gate the new
+        owners, pull (park + debit) from the old ones, push the state
+        batches, then commit by announcing the target epoch — new owners
+        first, old owners last, so at every instant each key has at
+        least one node willing to serve it (authoritatively, or from the
+        old owner's bounded envelope). Any pre-commit failure aborts
+        cleanly back to the old epoch (the parked state unparks); the
+        soak asserts every migration lands in exactly one of those two
+        states. Callers hold ``_membership_lock``."""
+        moved_keys = dict(moved_keys or {})
+        src_of_slot = {int(s): int(self.placement.slot_owner[s])
+                       for s in moves}
+        key_src = {k: self.node_index_of(k) for k in moved_keys}
+        dsts = set(moves.values()) | set(moved_keys.values())
+        # A node may be both (slots in, slots out on one rebalance).
+        srcs = set(src_of_slot.values()) | set(key_src.values())
+        event = {
+            "type": "migrate", "reason": reason,
+            "from_epoch": self.placement.epoch,
+            "target_epoch": target.epoch,
+            "moves": {int(s): int(d) for s, d in moves.items()},
+            "keys": {k: int(d) for k, d in moved_keys.items()},
+            "t_start": time.monotonic(),
+        }
+        try:
+            # Inside the try: an injected fault here must take the abort
+            # path (typed PlacementError, bookkeeping, callers' drained-
+            # set rollback), not escape as a raw FaultInjectedError.
+            await faults.seam("cluster.migrate")
+            for j in sorted(dsts):
+                await self._health_gate(j)
+            if not self._announced:
+                # Bootstrap: nodes must hold the CURRENT map before any
+                # pull (the gate and slot arithmetic need it). Strict
+                # only for destinations — a DEAD source is the
+                # unplanned-leave case, and its pull below degrades to
+                # state-lost rather than blocking the drain.
+                for j in range(self.n_nodes):
+                    await self._announce_to(
+                        j, {"map": self.placement.to_dict(),
+                            "node_id": j},
+                        strict=(j in dsts))
+                self._announced = True
+            pulls: dict[int, dict] = {}
+            lost: list[int] = []
+            for src in sorted(srcs):
+                slots = [s for s, owner in src_of_slot.items()
+                         if owner == src]
+                keys = [k for k, owner in key_src.items()
+                        if owner == src]
+                if not slots and not keys:
+                    continue
+                await faults.seam("cluster.migrate")
+                entries = await self._pull_from(src, slots, keys,
+                                                target.epoch)
+                if entries is None:
+                    lost.append(src)
+                elif placement_mod.entry_count(entries):
+                    pulls[src] = entries
+            if lost:
+                event["state_lost_from"] = lost
+            for src, entries in pulls.items():
+                per_dst = placement_mod.split_entries(entries,
+                                                      target.node_of)
+                for dst, sub in sorted(per_dst.items()):
+                    if dst == src:
+                        continue  # state already lives there
+                    node = self.nodes[dst]
+                    push = getattr(node, "migrate_push", None)
+                    for bid, chunk in enumerate(
+                            placement_mod.chunk_entries(sub)):
+                        await faults.seam("cluster.migrate")
+                        if callable(push):
+                            # Batch ids are the receiver's exactly-once
+                            # dedup unit — namespace them by SOURCE so
+                            # two sources' chunk 0 never collide.
+                            await push({"target_epoch": target.epoch,
+                                        "batch": (src << 20) | bid,
+                                        "entries": chunk})
+                        else:
+                            await placement_mod.import_entries(node,
+                                                               chunk)
+        except Exception as exc:
+            # Clean abort to the old epoch: unpark every pulled source
+            # AND clear every destination's push ledger for the dead
+            # target epoch — a retried migration reuses it, and stale
+            # dedup entries would silently drop the retry's batches.
+            for j in sorted(srcs | dsts):
+                await self._announce_to(
+                    j, {"abort_epoch": target.epoch}, strict=False)
+            event.update(type="abort", error=repr(exc),
+                         t_end=time.monotonic())
+            self.migration_aborts += 1
+            self._log_migration(event)
+            if isinstance(exc, PlacementError):
+                raise
+            raise PlacementError(
+                f"migration to epoch {target.epoch} aborted: "
+                f"{exc!r}") from exc
+        # Commit: destinations adopt first (they start serving the
+        # moment a client learns the epoch), sources last (their parked
+        # state drops and 'moved' answers take over), bystanders after.
+        order = (sorted(dsts) + [j for j in sorted(srcs)
+                                 if j not in dsts]
+                 + [j for j in range(self.n_nodes)
+                    if j not in dsts and j not in srcs])
+        commit_errors = 0
+        for j in order:
+            try:
+                await faults.seam("cluster.migrate")
+                await self._announce_to(
+                    j, {"map": target.to_dict(), "node_id": j},
+                    strict=False)
+            except Exception as exc:
+                # Past the point of no return (state batches applied):
+                # the commit presses on. A straggler node keeps the old
+                # epoch until it answers a request with 'placement
+                # moved' or the next announce reaches it; visible here,
+                # in the event record, and in the node-error counter.
+                commit_errors += 1
+                self._note_scrape_error(j, exc)
+        self.placement = target
+        self.migrations += 1
+        event.update(type="commit", t_end=time.monotonic(),
+                     commit_errors=commit_errors)
+        self._log_migration(event)
+
+    _MIGRATION_LOG_CAP = 512
+
+    def _log_migration(self, event: dict) -> None:
+        self.migration_log.append(event)
+        if len(self.migration_log) > self._MIGRATION_LOG_CAP:
+            del self.migration_log[: -self._MIGRATION_LOG_CAP]
+        log.cluster_migration(event)
+
+    async def rebalance(self, reason: str = "rebalance") -> int:
+        """Even slot ownership over the active nodes, migrating state
+        along. No-op (same epoch) when already balanced."""
+        async with self._membership_lock:
+            return await self._rebalance_locked(reason)
+
+    async def _rebalance_locked(self, reason: str) -> int:
+        if not self._announced:
+            # A fresh coordinator may be attaching to an already-
+            # resharded fleet: adopt the fleet's highest epoch BEFORE
+            # computing a target, or the bootstrap announce below would
+            # push a stale map (and the destinations would rightly
+            # refuse it as stale, wedging every membership op until
+            # someone called refresh_placement by hand).
+            await self.refresh_placement()
+        active = self.active_nodes
+        moves = self.placement.rebalance_moves(active)
+        # Overrides pinned to a drained node follow the rebalance too.
+        stranded = {k: j for k, j in self.placement.overrides.items()
+                    if j not in active}
+        if not moves and not stranded:
+            return self.placement.epoch
+        counts = self.placement.slot_counts(self.n_nodes)
+        moved_keys = {k: min(active, key=lambda a: counts[a])
+                      for k in stranded}
+        target = self.placement.with_assignments(
+            moves, set_overrides=moved_keys or None)
+        await self._apply_placement(target, moves, moved_keys, reason)
+        return target.epoch
+
+    async def add_node(self, store: "BucketStore | None" = None, *,
+                       address: "tuple[str, int] | None" = None,
+                       url: "str | None" = None,
+                       rebalance: bool = True) -> int:
+        """Join: append a node (same config ladder as the constructor),
+        health-gate it, and — unless ``rebalance=False`` — migrate an
+        even share of slots (with their state) onto it. Returns the new
+        node's index. Node indices are stable identities: the list only
+        ever appends."""
+        if store is not None:
+            node: BucketStore = store
+        elif address is not None:
+            node = RemoteBucketStore(address=address,
+                                     **self._remote_kwargs)
+        elif url is not None:
+            node = RemoteBucketStore(url=url, **self._remote_kwargs)
+        else:
+            raise ValueError("one of store, address, or url is required")
+        async with self._membership_lock:
+            j = self.n_nodes
+            self.nodes.append(node)
+            self.n_nodes += 1
+            self.node_errors.append(0)
+            if self._breakers is not None:
+                self._breakers.append(self._make_breaker(
+                    j, self._breaker_config, self._breaker_clock))
+            self._registry = None  # per-node families re-enumerate lazily
+            try:
+                await self._health_gate(j)
+            except PlacementError:
+                self.drained.add(j)  # joined but unfit: owns nothing yet
+                raise
+            if rebalance:
+                await self._rebalance_locked(reason=f"join:{j}")
+            return j
+
+    async def drain_node(self, j: int) -> int:
+        """Planned leave: migrate node ``j``'s slots (and their state)
+        to the survivors, then stop routing to it. The node object stays
+        in ``nodes`` — indices are identities — and ``rejoin_node``
+        folds it back in."""
+        if not 0 <= j < self.n_nodes:
+            raise ValueError(f"no node {j}")
+        async with self._membership_lock:
+            if len(self.active_nodes) <= 1 and j in self.active_nodes:
+                raise PlacementError(
+                    "cannot drain the last active node")
+            self.drained.add(j)
+            try:
+                return await self._rebalance_locked(reason=f"drain:{j}")
+            except PlacementError:
+                self.drained.discard(j)  # the drain never happened
+                raise
+
+    async def rejoin_node(self, j: int) -> int:
+        """Fold a drained node back into the slot table (health-gated),
+        migrating an even share of slots back onto it."""
+        async with self._membership_lock:
+            if j not in self.drained:
+                return self.placement.epoch
+            await self._health_gate(j)
+            self.drained.discard(j)
+            try:
+                return await self._rebalance_locked(reason=f"rejoin:{j}")
+            except PlacementError:
+                self.drained.add(j)
+                raise
+
+    async def split_hot_key(self, key: str,
+                            target: "int | None" = None) -> int:
+        """Hot-shard split: pin one key to its own node via a placement
+        override, migrating its state along — the heavy-hitter sketch's
+        top-K is the feed (:meth:`split_hot_keys`). Returns the node the
+        key now lives on."""
+        async with self._membership_lock:
+            if not self._announced:
+                await self.refresh_placement()  # see _rebalance_locked
+            src = self.node_index_of(key)
+            if target is None:
+                counts = self.placement.slot_counts(self.n_nodes)
+                candidates = [j for j in self.active_nodes if j != src]
+                if not candidates:
+                    return src  # nowhere to split to
+                target = min(candidates, key=lambda a: int(counts[a]))
+            if target == src:
+                return src
+            if target in self.drained:
+                raise PlacementError(f"node {target} is drained")
+            new_map = self.placement.with_assignments(
+                set_overrides={key: target})
+            await self._apply_placement(new_map, {}, {key: target},
+                                        reason=f"hot-split:{key!r}")
+            return target
+
+    async def split_hot_keys(self, top_n: int = 1,
+                             min_count: float = 0.0) -> list[str]:
+        """Consult every node's heavy-hitter sketch (OP_STATS
+        ``hot_keys``) and split the fleet-wide top ``top_n`` keys that
+        are not already overrides. Returns the keys split."""
+        scores: dict[str, float] = {}
+        st = await self.stats()
+        for node_stats in st["nodes"]:
+            for row in (node_stats.get("hot_keys") or {}).get("top", ()):
+                scores[row["key"]] = scores.get(row["key"], 0.0) \
+                    + float(row["count"])
+        ranked = sorted(scores.items(), key=lambda kv: -kv[1])
+        split: list[str] = []
+        for key, count in ranked:
+            if len(split) >= top_n:
+                break
+            if count < min_count or key in self.placement.overrides:
+                continue
+            await self.split_hot_key(key)
+            # split_hot_key no-ops when there is nowhere to split to
+            # (single active node): only report keys actually pinned,
+            # or automation would claim an isolation that never
+            # happened — and re-claim it on every invocation.
+            if key in self.placement.overrides:
+                split.append(key)
+        return split
+
     # -- single-key ops: route, guard, forward -------------------------------
     async def acquire(self, key: str, count: int, capacity: float,
                       fill_rate_per_sec: float) -> AcquireResult:
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            return await self.nodes[j].acquire(key, count, capacity,
-                                               fill_rate_per_sec)
-        return await self._guarded_call(
-            j,
-            lambda: self.nodes[j].acquire(key, count, capacity,
-                                          fill_rate_per_sec),
-            fallback=lambda: self._degraded.acquire(
-                j, key, count, capacity, fill_rate_per_sec))
+        return await self._routed(
+            key,
+            lambda j: self.nodes[j].acquire(key, count, capacity,
+                                            fill_rate_per_sec),
+            lambda j: self._degraded.acquire(
+                j, key, count, capacity, fill_rate_per_sec, "bucket"))
 
     def acquire_blocking(self, key: str, count: int, capacity: float,
                          fill_rate_per_sec: float) -> AcquireResult:
@@ -450,14 +1078,25 @@ class ClusterBucketStore(BucketStore):
         # No degraded value exists for a peek — it reports the
         # AUTHORITATIVE balance; a quarantined node surfaces the typed
         # shed error instead of a made-up number.
-        if self._breakers is not None:
-            j = shard_of_key(key, self.n_nodes)
-            if self._breakers[j].quarantined():
+        for attempt in (0, 1):
+            j = self.node_index_of(key)
+            if self._breakers is not None \
+                    and self._breakers[j].quarantined():
                 self.shed += 1
                 raise NodeUnavailableError(
                     f"cluster node {j} is quarantined (circuit open)")
-        return self.node_of(key).peek_blocking(key, capacity,
-                                               fill_rate_per_sec)
+            try:
+                return self.nodes[j].peek_blocking(key, capacity,
+                                                   fill_rate_per_sec)
+            except wire.RemoteStoreError as exc:
+                # Same one-MOVED chase as every other keyed lane: a
+                # balance monitor doing only peeks must still converge
+                # a stale map after a migration.
+                if attempt == 0 and MOVED_ERROR_PREFIX in str(exc):
+                    self._blocking(self.refresh_placement())
+                    if self.node_index_of(key) != j:
+                        continue
+                raise
 
     def acquire_submitter(self, capacity: float, fill_rate_per_sec: float):
         if self._resilient:
@@ -468,13 +1107,29 @@ class ClusterBucketStore(BucketStore):
                                           fill_rate_per_sec)
 
             return submit
-        # Hoist per-node submitters once; per request only the route runs.
+        # Hoist per-node submitters once; per request only the route
+        # runs. A node that joins after the hoist gets its submitter
+        # lazily — the list only ever appends (indices are stable).
         subs = [n.acquire_submitter(capacity, fill_rate_per_sec)
                 for n in self.nodes]
-        n_nodes = self.n_nodes
 
         async def submit(key: str, count: int) -> AcquireResult:
-            return await subs[shard_of_key(key, n_nodes)](key, count)
+            # Same one-MOVED chase as _routed: the fast lane must still
+            # converge a stale map, or every call for a migrated key
+            # fails forever. The refresh costs only on the error path.
+            for attempt in (0, 1):
+                j = self.node_index_of(key)
+                while j >= len(subs):
+                    subs.append(self.nodes[len(subs)].acquire_submitter(
+                        capacity, fill_rate_per_sec))
+                try:
+                    return await subs[j](key, count)
+                except wire.RemoteStoreError as exc:
+                    if attempt == 0 and MOVED_ERROR_PREFIX in str(exc):
+                        await self.refresh_placement()
+                        if self.node_index_of(key) != j:
+                            continue
+                    raise
 
         return submit
 
@@ -483,13 +1138,9 @@ class ClusterBucketStore(BucketStore):
         # No fallback on purpose: the approximate limiter OWNS its
         # degraded mode (keep serving from the last-known global score);
         # it needs the error, not a made-up sync result.
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            return await self.nodes[j].sync_counter(key, local_count,
-                                                    decay_rate_per_sec)
-        return await self._guarded_call(
-            j, lambda: self.nodes[j].sync_counter(key, local_count,
-                                                  decay_rate_per_sec))
+        return await self._routed(
+            key, lambda j: self.nodes[j].sync_counter(
+                key, local_count, decay_rate_per_sec))
 
     def sync_counter_blocking(self, key: str, local_count: float,
                               decay_rate_per_sec: float) -> SyncResult:
@@ -501,16 +1152,12 @@ class ClusterBucketStore(BucketStore):
 
     async def window_acquire(self, key: str, count: int, limit: float,
                              window_sec: float) -> AcquireResult:
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            return await self.nodes[j].window_acquire(key, count, limit,
-                                                      window_sec)
-        return await self._guarded_call(
-            j,
-            lambda: self.nodes[j].window_acquire(key, count, limit,
-                                                 window_sec),
-            fallback=lambda: self._degraded.acquire(
-                j, key, count, limit, limit / window_sec))
+        return await self._routed(
+            key,
+            lambda j: self.nodes[j].window_acquire(key, count, limit,
+                                                   window_sec),
+            lambda j: self._degraded.acquire(
+                j, key, count, limit, limit / window_sec, "window"))
 
     def window_acquire_blocking(self, key: str, count: int, limit: float,
                                 window_sec: float) -> AcquireResult:
@@ -522,16 +1169,12 @@ class ClusterBucketStore(BucketStore):
 
     async def fixed_window_acquire(self, key: str, count: int, limit: float,
                                    window_sec: float) -> AcquireResult:
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            return await self.nodes[j].fixed_window_acquire(
-                key, count, limit, window_sec)
-        return await self._guarded_call(
-            j,
-            lambda: self.nodes[j].fixed_window_acquire(key, count, limit,
-                                                       window_sec),
-            fallback=lambda: self._degraded.acquire(
-                j, key, count, limit, limit / window_sec))
+        return await self._routed(
+            key,
+            lambda j: self.nodes[j].fixed_window_acquire(
+                key, count, limit, window_sec),
+            lambda j: self._degraded.acquire(
+                j, key, count, limit, limit / window_sec, "fwindow"))
 
     def fixed_window_acquire_blocking(self, key: str, count: int,
                                       limit: float,
@@ -544,16 +1187,13 @@ class ClusterBucketStore(BucketStore):
 
     async def concurrency_acquire(self, key: str, count: int,
                                   limit: int) -> AcquireResult:
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            return await self.nodes[j].concurrency_acquire(key, count,
-                                                           limit)
         # Semaphores are strict: a made-up degraded grant could exceed
         # the concurrency limit the moment the node returns. Deny.
-        return await self._guarded_call(
-            j,
-            lambda: self.nodes[j].concurrency_acquire(key, count, limit),
-            fallback=lambda: AcquireResult(False, 0.0))
+        return await self._routed(
+            key,
+            lambda j: self.nodes[j].concurrency_acquire(key, count,
+                                                        limit),
+            lambda j: AcquireResult(False, 0.0))
 
     def concurrency_acquire_blocking(self, key: str, count: int,
                                      limit: int) -> AcquireResult:
@@ -564,15 +1204,11 @@ class ClusterBucketStore(BucketStore):
                                                               limit)
 
     async def concurrency_release(self, key: str, count: int) -> None:
-        j = shard_of_key(key, self.n_nodes)
-        if not self._resilient:
-            await self.nodes[j].concurrency_release(key, count)
-            return
         # A release against a quarantined node is absorbed (None): the
         # node's semaphore state resets with it anyway (init-on-miss).
-        await self._guarded_call(
-            j, lambda: self.nodes[j].concurrency_release(key, count),
-            fallback=lambda: None)
+        await self._routed(
+            key, lambda j: self.nodes[j].concurrency_release(key, count),
+            lambda j: None)
 
     def concurrency_release_blocking(self, key: str, count: int) -> None:
         if self._resilient:
@@ -591,7 +1227,9 @@ class ClusterBucketStore(BucketStore):
         single-node bulk semantics.
         """
         keys = keys if isinstance(keys, list) else list(keys)
-        routes = route_keys(keys, self.n_nodes)  # one native C pass
+        # One native crc32 pass over the slot table, then the placement
+        # take — the map (not a modulus) is the routing truth.
+        routes = self.placement.route(keys)
         order = np.argsort(routes, kind="stable")
         bounds = np.searchsorted(routes[order],
                                  np.arange(self.n_nodes + 1))
@@ -680,6 +1318,23 @@ class ClusterBucketStore(BucketStore):
                         br.release_probe()  # no-op unless we held it
                     raise
                 except Exception as exc:
+                    if (isinstance(exc, wire.RemoteStoreError)
+                            and MOVED_ERROR_PREFIX in str(exc)):
+                        # Stale map, not node failure: the node is
+                        # HEALTHY — settle a half-open probe as a
+                        # success (the scalar lane's rule; leaking the
+                        # probe slot would quarantine the keyspace for a
+                        # recovery window per stale bulk frame); refresh
+                        # in the background so the NEXT call re-routes,
+                        # and this frame's rows follow the
+                        # partial_failures contract.
+                        if br is not None:
+                            br.record_success()
+                        self._spawn(self.refresh_placement())
+                        nspan.set_status("degraded")
+                        if self._partial_failures == "raise":
+                            raise
+                        return None  # rows stay denied
                     self._note_node_error(j, exc)
                     nspan.set_status("degraded")
                     if degraded_row is not None \
@@ -718,7 +1373,7 @@ class ClusterBucketStore(BucketStore):
 
         degraded_row = (
             (lambda j, k, c: self._degraded.acquire(
-                j, k, c, capacity, fill_rate_per_sec))
+                j, k, c, capacity, fill_rate_per_sec, "bucket"))
             if self._degraded is not None else None)
         return await self._bulk_fan_out(keys, counts, call, with_remaining,
                                         degraded_row)
@@ -744,7 +1399,8 @@ class ClusterBucketStore(BucketStore):
 
         degraded_row = (
             (lambda j, k, c: self._degraded.acquire(
-                j, k, c, limit, limit / window_sec))
+                j, k, c, limit, limit / window_sec,
+                "fwindow" if fixed else "window"))
             if self._degraded is not None else None)
         return await self._bulk_fan_out(keys, counts, call, with_remaining,
                                         degraded_row)
@@ -811,6 +1467,18 @@ class ClusterBucketStore(BucketStore):
                   "Keys currently held by the degraded fallback",
                   lambda: (len(self._degraded)
                            if self._degraded is not None else 0))
+        reg.gauge("cluster_placement_epoch",
+                  "Adopted placement map epoch",
+                  lambda: float(self.placement.epoch))
+        reg.counter("cluster_migrations",
+                    "Committed membership migrations",
+                    lambda: self.migrations)
+        reg.counter("cluster_migration_aborts",
+                    "Migrations cleanly aborted to the old epoch",
+                    lambda: self.migration_aborts)
+        reg.counter("cluster_rejoin_debits",
+                    "Degraded-envelope grants debited on node rejoin",
+                    lambda: self.rejoin_debits)
         reg.counter("cluster_client_retries",
                     "Wire-client retries, summed over nodes",
                     lambda: self._sum_node_stat("retries"))
@@ -902,12 +1570,23 @@ class ClusterBucketStore(BucketStore):
             "node_errors": list(self.node_errors),
             "shed": self.shed,
             "degraded_decisions": self.degraded_decisions,
+            "rejoin_debits": self.rejoin_debits,
         }
         if self._breakers is not None:
             resilience["breakers"] = [b.snapshot() for b in self._breakers]
         if self._degraded is not None:
             resilience["degraded_keys"] = len(self._degraded)
         out["resilience"] = resilience
+        out["placement"] = {
+            "epoch": self.placement.epoch,
+            "n_slots": self.placement.n_slots,
+            "slot_counts": self.placement.slot_counts(
+                self.n_nodes).tolist(),
+            "overrides": len(self.placement.overrides),
+            "drained": sorted(self.drained),
+            "migrations": self.migrations,
+            "migration_aborts": self.migration_aborts,
+        }
         return out
 
     # -- checkpoint ----------------------------------------------------------

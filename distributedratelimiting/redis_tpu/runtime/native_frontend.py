@@ -34,7 +34,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime import placement, wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.metrics import (
@@ -86,6 +86,11 @@ _OP_BUCKET = wire.OP_ACQUIRE
 _OP_WINDOW = wire.OP_WINDOW
 _OP_FWINDOW = wire.OP_FWINDOW
 _OP_SEMA = wire.OP_SEMA
+
+#: fe_complete's kRowSkip sentinel (frontend.cc): the row was already
+#: answered from Python via fe_send (per-row placement error on the
+#: batch lane) — C sends no decision reply and skips the tier-0 install.
+_ROW_SKIP = 2
 
 
 class NativeFrontend:
@@ -287,7 +292,7 @@ class NativeFrontend:
                 tr_fl.ctypes.data_as(c.POINTER(c.c_uint8)))
             traces = (tr_hi, tr_lo, tr_par, tr_fl)
         self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr,
-                                      traces))
+                                      traces, seqs, conn_ids))
 
     def _dispatch_passthrough(self) -> None:
         lib, h = self._lib, self._h
@@ -303,9 +308,11 @@ class NativeFrontend:
     async def _serve_batch(self, bid: int, keys: list[str],
                            counts: np.ndarray, ops: np.ndarray,
                            a_arr: np.ndarray, b_arr: np.ndarray,
-                           traces=None) -> None:
+                           traces=None, seqs: np.ndarray | None = None,
+                           conn_ids: np.ndarray | None = None) -> None:
         n = len(keys)
         t_start = time.perf_counter()
+        pgate = full = None
         try:
             hh = getattr(self._server, "heavy_hitters", None)
             if hh is not None:
@@ -320,6 +327,28 @@ class NativeFrontend:
                                    if c > 0])
                 else:
                     hh.offer_many(keys)
+            # Placement gate (runtime/placement.py): the C batch lane
+            # must honor keyspace ownership exactly like the asyncio
+            # lane's scalar gate. Dormant (None) until a map is
+            # announced; mid-handoff rows serve their fair-share
+            # envelope, moved rows answer the routable MOVED error and
+            # parked rows with no envelope value (SEMA, releases) answer
+            # the transient handoff deferral — both pre-encoded here and
+            # pushed through fe_send, with the kRowSkip sentinel telling
+            # fe_complete those rows are already answered. A stale .so
+            # without the row-skip ABI falls back to denying them (deny
+            # is admission-safe but strands stale clients and leaks SEMA
+            # permits — the loader rebuilds on source change, so the
+            # fallback is a transient condition, not a mode).
+            ps = self._server.placement
+            pgate = ps.bulk_gate(keys) if ps.active else None
+            if pgate is not None:
+                full = (n, keys, counts, ops, a_arr, b_arr)
+                serve_idx = np.nonzero(pgate[0])[0]
+                keys = [keys[int(i)] for i in serve_idx]
+                counts, ops = counts[serve_idx], ops[serve_idx]
+                a_arr, b_arr = a_arr[serve_idx], b_arr[serve_idx]
+                n = len(keys)
             granted = np.zeros(n, np.uint8)
             remaining = np.zeros(n, np.float64)
             # SEMA rows go as ONE store call in arrival order with
@@ -333,7 +362,7 @@ class NativeFrontend:
                 groups.append((_OP_SEMA, 0.0, 0.0,
                                np.nonzero(sema_mask)[0]))
             rest = np.nonzero(~sema_mask)[0]
-            if len(rest) == n and ((ops == ops[0]).all()
+            if n and len(rest) == n and ((ops == ops[0]).all()
                                    and (a_arr == a_arr[0]).all()
                                    and (b_arr == b_arr[0]).all()):
                 # Single-config fast path: every frame carries the same
@@ -399,6 +428,53 @@ class NativeFrontend:
                     else:
                         granted[idx] = g
                         remaining[idx] = r
+            if pgate is not None:
+                # Scatter the served subset back into the full batch,
+                # decide the parked rows from their handoff envelopes,
+                # and answer moved / non-envelope parked rows per-row.
+                n, keys, counts, ops, a_arr, b_arr = full
+                g_full = np.zeros(n, np.uint8)
+                r_full = np.zeros(n, np.float64)
+                g_full[serve_idx] = granted
+                r_full[serve_idx] = remaining
+                row_skip = (getattr(self._lib, "has_row_skip", False)
+                            and seqs is not None and conn_ids is not None)
+                ekinds = {_OP_BUCKET: "bucket", _OP_WINDOW: "window",
+                          _OP_FWINDOW: "fwindow"}
+                for i, handoff in pgate[1]:
+                    ekind = ekinds.get(int(ops[i]))
+                    if ekind is not None and counts[i] >= 0:
+                        gr, rem = ps.envelope_acquire(
+                            handoff, keys[i], int(counts[i]),
+                            float(a_arr[i]), float(b_arr[i]), ekind)
+                        g_full[i] = gr
+                        r_full[i] = rem
+                    elif row_skip:
+                        # Parked SEMA / release rows have no envelope
+                        # value: a denied decision would silently eat a
+                        # permit release (leaking held permits for the
+                        # migrated semaphore) — answer the same typed
+                        # transient error the asyncio lane does so the
+                        # caller retries after the window.
+                        ps.handoff_deferrals += 1
+                        self._send(int(conn_ids[i]), wire.encode_response(
+                            int(seqs[i]), wire.RESP_ERROR,
+                            f"{placement.HANDOFF_DEFERRAL_PREFIX} for "
+                            f"this key (target epoch "
+                            f"{handoff.target_epoch}); retry shortly"))
+                        g_full[i] = _ROW_SKIP
+                if row_skip and pgate[2].any():
+                    # Moved rows answer the routable MOVED error — the
+                    # signal the client's chase / background refresh
+                    # converges on (bulk_gate already counted them).
+                    for i in np.nonzero(pgate[2])[0].tolist():
+                        self._send(int(conn_ids[i]), wire.encode_response(
+                            int(seqs[i]), wire.RESP_ERROR,
+                            ps.moved_message(
+                                keys[i],
+                                int(ps.pmap.node_of(keys[i])))))
+                        g_full[i] = _ROW_SKIP
+                granted, remaining = g_full, r_full
             if traces is not None:
                 self._record_batch_spans(traces, granted, ops, t_start)
             c = ctypes
@@ -411,7 +487,12 @@ class NativeFrontend:
         except Exception as exc:  # noqa: BLE001 — every request must get
             log.error_evaluating_kernel(exc)  # a routable error reply
             if traces is not None:
-                self._record_batch_spans(traces, None, ops, t_start)
+                # The gate slices `ops` to the served subset; the trace
+                # arrays are full-batch, so restore the full ops before
+                # attributing error spans.
+                self._record_batch_spans(
+                    traces, None, ops if pgate is None else full[3],
+                    t_start)
             self._lib.fe_fail(self._h, bid, repr(exc)[:200].encode())
 
     def _record_batch_spans(self, traces, granted, ops: np.ndarray,
@@ -426,10 +507,17 @@ class NativeFrontend:
         tr_hi, tr_lo, tr_par, tr_fl = traces
         t_end = time.perf_counter()
         for i in np.nonzero(tr_fl & 1)[0].tolist():
+            if i >= len(ops):
+                # Defensive bound only: both call sites hand the
+                # full-batch ops (the error path restores them after
+                # the placement gate's subset slice).
+                break
             ctx = tracing.TraceContext(int(tr_hi[i]), int(tr_lo[i]),
                                        int(tr_par[i]),
                                        1 if tr_fl[i] & 2 else 0)
-            if granted is None:
+            if granted is None or granted[i] == _ROW_SKIP:
+                # Whole-batch failure, or a row pre-answered with a
+                # per-row placement error (MOVED / handoff deferral).
                 status = "error"
             else:
                 status = "ok" if granted[i] else "denied"

@@ -1,0 +1,1098 @@
+"""Epoch-versioned keyspace placement — the cluster's ownership map.
+
+Until round 6 the cluster's ``key → node`` split was a static
+``crc32(key) % N`` (the Redis-Cluster shape with the hash function where
+the slot table should be): every membership change re-homed ~half the
+keyspace instantly, with no way to move the state along — each join or
+leave was an availability *and* over-admission event. This module
+replaces the modulus with a **directory-driven placement map**:
+
+- The keyspace is split into ``n_slots`` fixed slots
+  (``slot = crc32(key) % n_slots``, the same stable crc32 every client
+  already routes by). A membership change reassigns *slots*, not the
+  hash function, so only the moved slots' keys re-home.
+- The map is **epoch-versioned**: every reassignment is a new epoch.
+  Nodes adopt maps monotonically (a stale announce is a typed, routable
+  error) and clients learn new epochs from a ``placement moved`` error +
+  refetch — the MOVED-redirect posture, not a coordination service.
+- **Hot-shard splitting**: a single key may carry an *override* pinning
+  it to a node regardless of its slot — the unit the heavy-hitter
+  sketch's top-K feeds (one hot tenant stops sharing a node with its
+  whole slot).
+- :meth:`PlacementMap.initial` assigns slot ``s`` to node ``s % N`` over
+  ``n_slots = N × slots_per_node`` slots, which makes epoch-0 routing
+  **bit-identical to the legacy ``crc32 % N``** for every N — adopting
+  the map is not itself a resharding event.
+
+Live migration ships bucket state along with ownership using the state
+primitives earlier rounds built: the export/import below normalizes any
+store's :meth:`snapshot` (host dict or device slot-array schema) into
+flat per-key entries, and the *generic* import lane replays them through
+the saturating **debit kernel** (``debit_many`` — the tier-0
+reconciliation primitive) so a device store adopts migrated balances
+with no snapshot surgery; stores with a host-dict schema take the exact
+merge lane. Checkpoints carry the placement epoch
+(:mod:`~.checkpoint`), so a rejoining node cannot serve a table whose
+key memberships predate the current map (typed mismatch → init-on-miss,
+the ``SnapshotCorruptError`` posture).
+
+**The dual-ownership bound.** "When Two is Worse Than One" (PAPERS.md)
+names the failure: during an ownership transfer, two nodes serving the
+same key independently double-admit. Here the handoff window *partitions
+the budget* instead of duplicating it: at PULL time the old owner debits
+every exported bucket by a fair-share envelope
+(:func:`~..models.approximate.headroom_budget`) and keeps serving the
+parked keys **only from that envelope** for a bounded ``window_s``;
+the new owner imports the debited remainder, and the old owner's own
+store is charged for the shipped amount at the same instant
+(:func:`debit_source`) so its authoritative residual IS the envelope.
+Old + new together can never admit more than the original balance plus
+one envelope per key per episode — the same epsilon family the tier-0
+cache and the degraded fallback are bounded by — in **every**
+termination order: commit (the target epoch's announce drops the parked
+state; ``placement moved`` answers take over), coordinator-driven
+abort (the old owner resumes authoritative serving from the envelope
+residual — under-admitting by the shipped amount until refill, the
+conservative direction), and window expiry (the old owner auto-aborts
+back to the old epoch; even if a slow commit already announced the new
+epoch to the destinations, the source's residual is bounded so the two
+owners' combined spend stays inside the envelope bound).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+import zlib
+from typing import Callable, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+
+__all__ = [
+    "PlacementMap", "NodePlacementState", "StalePlacementError",
+    "PlacementError", "extract_entries", "entry_count",
+    "split_entries", "chunk_entries", "debit_source",
+    "DEFAULT_SLOTS_PER_NODE", "DEFAULT_ENVELOPE_FRACTION",
+    "MOVED_ERROR_PREFIX", "HANDOFF_DEFERRAL_PREFIX",
+]
+
+#: Epoch-0 slots per node. The initial map's ``n_slots = N × this`` with
+#: slot ``s → s % N`` reproduces ``crc32 % N`` exactly (``crc32 % kN % N
+#: == crc32 % N``), and 16 slots/node keeps single-slot moves ≤ ~6% of a
+#: node's keyspace — the rebalance granularity.
+DEFAULT_SLOTS_PER_NODE = 16
+
+#: Stable prefix of the routable "wrong owner" error — clients detect it
+#: with a substring match (the trace/deadline "unknown op" latch posture)
+#: and refetch the map instead of failing the caller.
+MOVED_ERROR_PREFIX = "placement moved"
+
+#: Stable prefix of the transient "parked mid-handoff, no envelope
+#: value" error (PEEK/SYNC/SEMA on a parked key). A healthy node answers
+#: it for at most one handoff window — clients must treat it as
+#: retryable, never as node failure (breakers exempt it).
+HANDOFF_DEFERRAL_PREFIX = "placement handoff in progress"
+
+#: Fair-share fraction of the handoff envelope — the same default as the
+#: cluster's degraded fallback (one confidence policy family).
+DEFAULT_ENVELOPE_FRACTION = 0.5
+
+
+class PlacementError(RuntimeError):
+    """Membership/migration control-plane failure (health gate, state
+    push, commit) — the migration aborted cleanly to the old epoch."""
+
+
+class StalePlacementError(PlacementError):
+    """The announced/requested epoch is older than what this node has
+    already adopted (epochs are monotonic; re-announcing the current
+    epoch is idempotent, announcing an older one is a protocol error)."""
+
+
+def _slot_of(key: str, n_slots: int) -> int:
+    # byte-identical to parallel.sharded_store.shard_of_key — one hash
+    # family for in-mesh shards, cluster slots, and the native router.
+    return zlib.crc32(key.encode("utf-8", "surrogateescape")) % n_slots
+
+
+def keep_predicate(n_slots: int, overrides: Mapping,
+                   slots: "frozenset[int] | set[int]",
+                   keys: "frozenset[str] | set[str] | None"
+                   ) -> Callable[[str], bool]:
+    """THE ownership-transfer selection rule, shared by the server-side
+    pull and the cluster's in-process lane: the union of the named keys
+    and the slot set — a drain moves both its slots AND any override
+    pinned here. Override keys route independently of their slot (the
+    gate's rule), so a slot move never drags a pinned key's state
+    along."""
+    def keep(k: str) -> bool:
+        if keys and k in keys:
+            return True
+        return k not in overrides and _slot_of(k, n_slots) in slots
+    return keep
+
+
+class PlacementMap:
+    """Immutable epoch-versioned ``slot → node`` map plus per-key
+    overrides. Mutation = :meth:`with_assignments` → a new map at
+    ``epoch + 1`` (nodes and clients compare epochs, never diffs)."""
+
+    __slots__ = ("epoch", "n_slots", "slot_owner", "overrides",
+                 "_override_slot_cache")
+
+    def __init__(self, epoch: int, slot_owner: "Sequence[int] | np.ndarray",
+                 overrides: "Mapping[str, int] | None" = None) -> None:
+        self.epoch = int(epoch)
+        self.slot_owner = np.ascontiguousarray(slot_owner, np.int32)
+        self.n_slots = int(len(self.slot_owner))
+        if self.n_slots == 0:
+            raise ValueError("placement map needs at least one slot")
+        self.overrides: dict[str, int] = dict(overrides or {})
+        self._override_slot_cache: "np.ndarray | None" = None
+
+    def override_slots(self) -> np.ndarray:
+        """Sorted slots the override keys hash into — the bulk lanes'
+        prefilter: rows outside these slots can skip the per-key
+        override probe entirely (the map is immutable, so this is
+        computed once)."""
+        cache = self._override_slot_cache
+        if cache is None:
+            cache = np.unique(np.fromiter(
+                (_slot_of(k, self.n_slots) for k in self.overrides),
+                np.int64, len(self.overrides)))
+            self._override_slot_cache = cache
+        return cache
+
+    @classmethod
+    def initial(cls, n_nodes: int,
+                slots_per_node: int = DEFAULT_SLOTS_PER_NODE
+                ) -> "PlacementMap":
+        """Epoch-0 map whose routing is bit-identical to the legacy
+        ``crc32(key) % n_nodes`` (see module docstring)."""
+        if n_nodes < 1:
+            raise ValueError("placement needs at least one node")
+        n_slots = n_nodes * slots_per_node
+        return cls(0, np.arange(n_slots, dtype=np.int32) % n_nodes)
+
+    # -- routing -------------------------------------------------------------
+    def slot_of(self, key: str) -> int:
+        return _slot_of(key, self.n_slots)
+
+    def node_of(self, key: str) -> int:
+        ov = self.overrides.get(key)
+        if ov is not None:
+            return ov
+        return int(self.slot_owner[_slot_of(key, self.n_slots)])
+
+    def route(self, keys: Sequence[str]) -> np.ndarray:
+        """Vectorized :meth:`node_of` over a batch — one native crc32
+        pass (``route_keys``; KeyBlob-aware) plus a table take; override
+        fix-up only runs when overrides exist (they are few by design)."""
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            route_keys,
+        )
+
+        slots = route_keys(keys, self.n_slots)
+        owners = self.slot_owner[slots].astype(np.int64)
+        if self.overrides:
+            # Prefilter by slot: only rows that hash into an override
+            # key's slot pay the per-key probe — one vectorized isin
+            # keeps the zero-copy bulk lane zero-copy for every other
+            # row no matter how long the override table lives.
+            cand = np.isin(slots, self.override_slots())
+            if cand.any():
+                ov = self.overrides
+                for i in np.nonzero(cand)[0]:
+                    j = ov.get(keys[int(i)])
+                    if j is not None:
+                        owners[i] = j
+        return owners
+
+    # -- introspection -------------------------------------------------------
+    def owned_slots(self, node: int) -> np.ndarray:
+        return np.nonzero(self.slot_owner == node)[0].astype(np.int32)
+
+    def slot_counts(self, n_nodes: int) -> np.ndarray:
+        return np.bincount(self.slot_owner, minlength=n_nodes)
+
+    def nodes_in_use(self) -> set[int]:
+        used = set(np.unique(self.slot_owner).tolist())
+        used.update(self.overrides.values())
+        return {int(j) for j in used}
+
+    # -- evolution -----------------------------------------------------------
+    def with_assignments(self, moves: "Mapping[int, int] | None" = None,
+                         set_overrides: "Mapping[str, int] | None" = None,
+                         drop_overrides: "Iterable[str] | None" = None
+                         ) -> "PlacementMap":
+        """The next epoch: reassign ``moves`` (slot → new owner), add
+        ``set_overrides`` (key → node pins), drop ``drop_overrides``."""
+        owner = self.slot_owner.copy()
+        for slot, node in (moves or {}).items():
+            if not 0 <= slot < self.n_slots:
+                raise ValueError(f"slot {slot} out of range")
+            owner[slot] = node
+        ov = dict(self.overrides)
+        for k in drop_overrides or ():
+            ov.pop(k, None)
+        ov.update(set_overrides or {})
+        return PlacementMap(self.epoch + 1, owner, ov)
+
+    def rebalance_moves(self, active: Sequence[int]) -> dict[int, int]:
+        """Deterministic plan evening slot counts over ``active`` nodes:
+        slots leave over-target nodes (and every inactive node) in
+        ascending slot order and land on the most-underfilled active
+        node. Empty plan = already balanced."""
+        active = sorted(set(int(j) for j in active))
+        if not active:
+            raise ValueError("rebalance needs at least one active node")
+        base, extra = divmod(self.n_slots, len(active))
+        target = {j: base + (1 if i < extra else 0)
+                  for i, j in enumerate(active)}
+        have: dict[int, int] = {j: 0 for j in active}
+        for s in self.slot_owner.tolist():
+            if s in have:
+                have[s] += 1
+        moves: dict[int, int] = {}
+        deficit = {j: target[j] - have[j] for j in active}
+        receivers = [j for j in active if deficit[j] > 0]
+        if not receivers:
+            return {}
+        ri = 0
+        for slot in range(self.n_slots):
+            owner = int(self.slot_owner[slot])
+            give = owner not in target or have[owner] > target[owner]
+            if not give:
+                continue
+            while ri < len(receivers) and deficit[receivers[ri]] <= 0:
+                ri += 1
+            if ri >= len(receivers):
+                break
+            dst = receivers[ri]
+            moves[slot] = dst
+            deficit[dst] -= 1
+            if owner in have:
+                have[owner] -= 1
+        return moves
+
+    # -- codec ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "n_slots": self.n_slots,
+                "slot_owner": self.slot_owner.tolist(),
+                "overrides": dict(self.overrides)}
+
+    @classmethod
+    def from_dict(cls, data: Mapping) -> "PlacementMap":
+        m = cls(data["epoch"], data["slot_owner"],
+                data.get("overrides") or {})
+        if m.n_slots != data.get("n_slots", m.n_slots):
+            raise ValueError("placement map n_slots disagrees with its "
+                             "slot_owner table")
+        return m
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_json(cls, text: str) -> "PlacementMap":
+        return cls.from_dict(json.loads(text))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, PlacementMap)
+                and self.epoch == other.epoch
+                and np.array_equal(self.slot_owner, other.slot_owner)
+                and self.overrides == other.overrides)
+
+    def __repr__(self) -> str:
+        return (f"PlacementMap(epoch={self.epoch}, n_slots={self.n_slots},"
+                f" nodes={sorted(self.nodes_in_use())},"
+                f" overrides={len(self.overrides)})")
+
+
+# -- normalized state entries (the handoff payload) --------------------------
+#
+# One schema-free form for "a key's limiter state", JSON-safe so it rides
+# RESP_TEXT migration frames:
+#
+#   {"buckets":  [[key, capacity, rate_per_sec, tokens, age_ticks], …],
+#    "windows":  [[key, limit, wt_ticks, interp, prev, curr, idx_behind], …],
+#    "counters": [[key, value, period, age_ticks], …],
+#    "semas":    [[key, active], …]}
+#
+# Timestamps travel as AGES relative to the exporting snapshot's clock
+# (``now_ticks − ts``) — the two processes' clock epochs never compare
+# (invariant 1); the importer re-anchors against its own now.
+
+_EMPTY_ENTRIES = {"buckets": [], "windows": [], "counters": [], "semas": []}
+
+
+def entry_count(entries: Mapping) -> int:
+    return sum(len(entries.get(k, ())) for k in _EMPTY_ENTRIES)
+
+
+def extract_entries(snap: Mapping, keep: Callable[[str], bool]) -> dict:
+    """Filter a store snapshot down to the keys ``keep`` selects, in the
+    normalized entry form. Understands both snapshot schemas in-tree:
+    the host-dict form (:class:`~.store.InProcessBucketStore`) and the
+    device slot-array form (:class:`~.store.DeviceBucketStore` — per-
+    table key directory + SoA arrays)."""
+    if "buckets" not in snap and "tables" not in snap:
+        # Unknown schema must fail LOUDLY: an empty export would commit
+        # a migration that silently dropped the keyspace's state (the
+        # coordinator's abort path exists exactly for this).
+        raise ValueError(
+            "unrecognized snapshot schema (neither host-dict 'buckets' "
+            "nor device 'tables'); this store cannot export handoff "
+            "entries")
+    now = int(snap["now_ticks"])
+    out = {k: [] for k in _EMPTY_ENTRIES}
+    if "buckets" in snap:  # host-dict schema
+        for (key, cap, rate), (tokens, ts) in snap["buckets"].items():
+            if keep(key):
+                out["buckets"].append(
+                    [key, float(cap), float(rate), float(tokens),
+                     now - int(ts)])
+        for (key, limit, wt, interp), (prev, curr, idx) in \
+                snap.get("windows", {}).items():
+            if keep(key):
+                out["windows"].append(
+                    [key, float(limit), int(wt), int(bool(interp)),
+                     float(prev), float(curr), now // int(wt) - int(idx)])
+        for key, (v, p, ts) in snap.get("counters", {}).items():
+            if keep(key):
+                out["counters"].append(
+                    [key, float(v), float(p), now - int(ts)])
+        for key, active in snap.get("semas", {}).items():
+            if keep(key) and active:
+                out["semas"].append([key, int(active)])
+        return out
+    # device slot-array schema
+    for (cap, rate), t in snap.get("tables", {}).items():
+        tokens, last_ts = np.asarray(t["tokens"]), np.asarray(t["last_ts"])
+        exists = np.asarray(t["exists"])
+        for key, slot in t["directory"].items():
+            if exists[slot] and keep(key):
+                out["buckets"].append(
+                    [key, float(cap), float(rate), float(tokens[slot]),
+                     now - int(last_ts[slot])])
+    for (limit, wt, fixed), t in snap.get("wtables", {}).items():
+        prev, curr = np.asarray(t["prev_count"]), np.asarray(t["curr_count"])
+        idx, exists = np.asarray(t["window_idx"]), np.asarray(t["exists"])
+        for key, slot in t["directory"].items():
+            if exists[slot] and keep(key):
+                out["windows"].append(
+                    [key, float(limit), int(wt), int(not fixed),
+                     float(prev[slot]), float(curr[slot]),
+                     now // int(wt) - int(idx[slot])])
+    c = snap.get("counters")
+    if isinstance(c, dict) and "value" in c:
+        value, period = np.asarray(c["value"]), np.asarray(c["period"])
+        last_ts, exists = np.asarray(c["last_ts"]), np.asarray(c["exists"])
+        for key, slot in snap.get("counter_dir", {}).items():
+            if exists[slot] and keep(key):
+                out["counters"].append(
+                    [key, float(value[slot]), float(period[slot]),
+                     now - int(last_ts[slot])])
+    s = snap.get("semas")
+    if isinstance(s, dict) and "active" in s:
+        active, exists = np.asarray(s["active"]), np.asarray(s["exists"])
+        for key, slot in snap.get("sema_dir", {}).items():
+            if exists[slot] and keep(key) and int(active[slot]):
+                out["semas"].append([key, int(active[slot])])
+    return out
+
+
+def split_entries(entries: Mapping, owner_of: Callable[[str], int]
+                  ) -> dict[int, dict]:
+    """Partition one export by destination node (a drain fans one pull
+    out to several new owners)."""
+    out: dict[int, dict] = {}
+    for section in _EMPTY_ENTRIES:
+        for row in entries.get(section, ()):
+            dst = owner_of(row[0])
+            out.setdefault(dst, {k: [] for k in _EMPTY_ENTRIES})[
+                section].append(row)
+    return out
+
+
+#: Per-chunk serialized-size budget: well under wire.MAX_FRAME (1 MiB)
+#: after JSON framing + the push envelope. Rows are bounded by BOTH this
+#: and ``max_rows`` — long keys (up to 64 KiB on the keyed lane) must
+#: not produce a chunk no frame can carry.
+_CHUNK_BYTE_BUDGET = 700_000
+#: JSON overhead per row beyond the key text (brackets, numbers, commas).
+_ROW_OVERHEAD = 96
+
+
+def chunk_entries(entries: Mapping, max_rows: int = 4096) -> list[dict]:
+    """Split an export into batches bounded by row count AND serialized
+    size, so every MIGRATE_PUSH frame fits MAX_FRAME regardless of key
+    length. Each chunk carries its own batch id slot-in (the receiver's
+    exactly-once dedup unit)."""
+    chunks: list[dict] = []
+    cur = {k: [] for k in _EMPTY_ENTRIES}
+    n = 0
+    size = 0
+    for section in _EMPTY_ENTRIES:
+        for row in entries.get(section, ()):
+            # Size the key as it will actually serialize: ensure_ascii
+            # JSON expands every non-ASCII / surrogate-escaped char to a
+            # 6-byte \uXXXX escape, so a 60 KiB hostile key can be ~6x
+            # its character count on the wire.
+            row_size = len(json.dumps(str(row[0]))) + _ROW_OVERHEAD
+            if n and (n >= max_rows
+                      or size + row_size > _CHUNK_BYTE_BUDGET):
+                chunks.append(cur)
+                cur = {k: [] for k in _EMPTY_ENTRIES}
+                n = 0
+                size = 0
+            cur[section].append(row)
+            n += 1
+            size += row_size
+    if n or not chunks:
+        chunks.append(cur)
+    return chunks
+
+
+def merge_entries(a: Mapping, b: Mapping) -> dict:
+    """Concatenate two entry batches section-wise (the client half of a
+    paged pull: pages reassemble into the one export they were chunked
+    from)."""
+    out = {k: list(a.get(k, ())) for k in _EMPTY_ENTRIES}
+    for k in _EMPTY_ENTRIES:
+        out[k].extend(b.get(k, ()))
+    return out
+
+
+async def saturating_drain(op: Callable, n: int) -> None:
+    """Full-then-partial drain through a store's public acquire surface:
+    ask ``op`` for the whole amount; a denial retries once for the
+    bucket's reported remaining balance. The bucket lands at (or near)
+    empty, never negative — the fallback debit idiom shared by
+    :func:`debit_source`, :func:`import_entries`, and the cluster's
+    rejoin reconciliation."""
+    if n <= 0:
+        return
+    res = await op(n)
+    if not res.granted and res.remaining >= 1:
+        await op(int(res.remaining))
+
+
+async def _debit_buckets(store, by_config: Mapping) -> None:
+    """Charge ``{(cap, rate): ([keys], [amounts])}`` bucket debits
+    through the store's fastest lane: the saturating ``debit_many``
+    kernel when the store has one, else a best-effort
+    :func:`saturating_drain` through the public acquire surface — the
+    one debit path shared by :func:`debit_source` (the old owner's
+    pull-time charge) and :func:`import_entries` (the new owner's
+    replay)."""
+    for (cap, rate), (ks, amounts) in by_config.items():
+        debit = getattr(store, "debit_many", None)
+        if callable(debit):
+            await debit(ks, amounts, cap, rate)
+        else:  # best effort through the public surface
+            for k, amt in zip(ks, amounts):
+                await saturating_drain(
+                    lambda m, k=k: store.acquire(k, m, cap, rate),
+                    int(amt))
+
+
+def debit_export(entries: dict, fraction: float) -> dict:
+    """The dual-ownership budget split (module docstring): reduce every
+    exported bucket's tokens by the fair-share envelope the old owner
+    keeps serving from, and pre-charge every window's current count by
+    its envelope — old + new together stay within the original balance
+    plus one envelope."""
+    out = dict(entries)
+    out["buckets"] = [
+        [k, cap, rate,
+         max(0.0, tok - headroom_budget(cap, fraction=fraction,
+                                        min_budget=1.0)), age]
+        for k, cap, rate, tok, age in entries.get("buckets", ())]
+    out["windows"] = [
+        [k, limit, wt, interp, prev,
+         min(float(limit),
+             curr + headroom_budget(limit, fraction=fraction,
+                                    min_budget=1.0)), behind]
+        for k, limit, wt, interp, prev, curr, behind
+        in entries.get("windows", ())]
+    return out
+
+
+async def debit_source(store, entries: Mapping, fraction: float,
+                       keep_envelope: bool = True) -> None:
+    """The other half of the dual-ownership partition: charge the OLD
+    owner's own state for what the export shipped, at pull time.
+
+    Without this, a handoff window that expires AFTER the destinations
+    already adopted the target epoch (a slow commit under chaos delays)
+    would auto-abort the source back to its full, undebited balance
+    while the new owner serves the shipped remainder — the unbounded
+    two-owner spend "When Two is Worse Than One" forbids. With it the
+    bound holds in every termination order; a coordinator-driven abort
+    merely under-admits by the shipped amount until refill (the
+    conservative direction — see docs/DESIGN.md §12).
+
+    ``keep_envelope=True`` (the wire lane) leaves each bucket the
+    fair-share envelope :func:`debit_export` withheld from the shipped
+    copy — the source's store residual matches the in-memory envelope
+    it serves parked keys from. ``keep_envelope=False`` (the in-process
+    lane, which ships balances exactly and has no parked envelope)
+    drains the bucket entirely. Windows are charged to their limit in
+    both lanes: the source has no authoritative window authority to
+    keep — parked window keys serve from the envelope, and after an
+    abort the charge expires with the window itself.
+
+    Saturating by construction (``debit_many`` floors at zero; a raced
+    admission between the snapshot and the debit is already reflected
+    in the balance being debited), so any interleaving stays inside the
+    bound."""
+    by_config: dict[tuple, tuple[list, list]] = {}
+    for key, cap, rate, tokens, _age in entries.get("buckets", ()):
+        shipped = float(tokens)
+        if keep_envelope:
+            shipped -= headroom_budget(float(cap), fraction=fraction,
+                                       min_budget=1.0)
+        if shipped <= 0.0:
+            continue
+        ks, amounts = by_config.setdefault((float(cap), float(rate)),
+                                           ([], []))
+        ks.append(key)
+        amounts.append(shipped)
+    await _debit_buckets(store, by_config)
+    for key, limit, wt, interp, _prev, curr, _behind in \
+            entries.get("windows", ()):
+        charge = int(math.floor(float(limit) - float(curr)))
+        if charge <= 0:
+            continue
+        from distributedratelimiting.redis_tpu.ops import bucket_math
+        window_sec = wt / bucket_math.TICKS_PER_SECOND
+        if interp:
+            await store.window_acquire(key, charge, limit, window_sec)
+        else:
+            await store.fixed_window_acquire(key, charge, limit,
+                                             window_sec)
+
+
+def envelope_step(entry: "tuple[float, float] | None", now: float,
+                  count: int, cap: float, rate: float,
+                  fraction: float) -> "tuple[bool, float]":
+    """One fair-share-envelope admission step — THE shared formula the
+    epsilon over-admission bound depends on: a ``headroom_budget(cap,
+    fraction)`` bucket refilled at ``fraction × rate``, clamped to the
+    budget. ``entry`` is the stored ``(tokens, last_ts)`` or None for a
+    fresh key (born at full budget). Returns ``(granted, new_tokens)``;
+    callers persist ``(new_tokens, now)`` and own their eviction and
+    ledger policy. Shared by the handoff :class:`_FairShareEnvelope`
+    (old-owner side) and the cluster's ``_DegradedKeyspace`` (client
+    edge) so the two halves of the bound can never drift apart."""
+    budget = headroom_budget(cap, fraction=fraction, min_budget=1.0)
+    if entry is None:
+        tokens = budget
+    else:
+        tokens, ts = entry
+        tokens = min(budget, tokens + (now - ts) * rate * fraction)
+    granted = tokens >= count and count >= 0
+    if granted and count > 0:
+        tokens -= count
+    return bool(granted), float(tokens)
+
+
+class _FairShareEnvelope:
+    """Bounded local admission for parked keys during a handoff window —
+    the same confidence policy as the cluster's degraded fallback
+    (``headroom_budget(a, fraction)`` tokens refilled at ``fraction ×
+    rate``), hosted server-side by the OLD owner. Its budget is exactly
+    what :func:`debit_export` already subtracted from the shipped state,
+    so envelope grants spend a balance the new owner never received."""
+
+    _MAX_KEYS = 1 << 14
+
+    def __init__(self, fraction: float,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._fraction = fraction
+        self._clock = clock
+        self._buckets: dict[tuple, tuple[float, float]] = {}
+        self.decisions = 0
+
+    def acquire(self, key: str, count: int, a: float, b: float,
+                kind: str) -> tuple[bool, float]:
+        cap, rate = ((a, b) if kind == "bucket"
+                     else (a, a / b if b > 0 else 0.0))
+        now = self._clock()
+        k = (key, kind, float(a), float(b))
+        entry = self._buckets.get(k)
+        if entry is None and len(self._buckets) >= self._MAX_KEYS:
+            self._buckets.pop(next(iter(self._buckets)))
+        granted, tokens = envelope_step(entry, now, count, cap, rate,
+                                        self._fraction)
+        self._buckets[k] = (tokens, now)
+        self.decisions += 1
+        return granted, max(tokens, 0.0)
+
+
+class _Handoff:
+    """One in-flight outbound migration on the old owner: the parked
+    slot/key set, the cached (already-debited) export, and the envelope
+    that serves the parked keys until commit, abort, or expiry."""
+
+    __slots__ = ("target_epoch", "slots", "keys", "export", "chunks",
+                 "window_s", "started_s", "envelope")
+
+    def __init__(self, target_epoch: int, slots: frozenset,
+                 keys: "frozenset | None", export: dict, window_s: float,
+                 started_s: float, fraction: float,
+                 clock: Callable[[], float]) -> None:
+        self.target_epoch = target_epoch
+        self.slots = slots
+        self.keys = keys
+        self.export = export
+        # Paged once here (the export is immutable from now on): every
+        # page request serves a slice, never a re-chunk of the whole.
+        self.chunks = chunk_entries(export)
+        self.window_s = window_s
+        self.started_s = started_s
+        self.envelope = _FairShareEnvelope(fraction, clock)
+
+    def expired(self, now: float) -> bool:
+        return now - self.started_s > self.window_s
+
+
+class NodePlacementState:
+    """A serving node's placement half: the adopted map + this node's
+    id, parked outbound handoffs, and the exactly-once import ledger.
+    Engaged only once a map has been announced — a node that never hears
+    an announce serves exactly as before (placement-unaware)."""
+
+    #: Import ledger depth: applied-batch sets kept for this many most
+    #: recent epochs (re-deliveries are same-epoch by construction).
+    _LEDGER_EPOCHS = 8
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 envelope_fraction: float = DEFAULT_ENVELOPE_FRACTION
+                 ) -> None:
+        import asyncio
+
+        self._clock = clock
+        self._fraction = envelope_fraction
+        self.pmap: PlacementMap | None = None
+        self.node_id: int | None = None
+        self._handoffs: dict[int, _Handoff] = {}      # target epoch →
+        self._parked_slots: dict[int, _Handoff] = {}  # slot →
+        self._parked_keys: dict[str, _Handoff] = {}   # override key →
+        self._applied: dict[int, set[int]] = {}       # epoch → batch ids
+        # Target epochs whose handoff this node aborted LOCALLY (window
+        # expiry — coordinator presumed dead). A post-send wire retry of
+        # the original pull landing after the abort must NOT re-export:
+        # the first pull already debited the source, and a second
+        # export+debit double-charges it past the one-envelope bound.
+        # A coordinator abort announce clears the tombstone — the
+        # deliberate retry-same-epoch path stays open (and is the one
+        # place a second envelope is knowingly charged).
+        self._aborted_epochs: set[int] = set()
+        # Serializes pull/push bodies: their idempotency checks span an
+        # await (export off-thread, import through the store), and a
+        # post-send retry racing the original in-flight op must wait and
+        # hit the cache/ledger, not run a second export + source debit.
+        self._control_lock = asyncio.Lock()
+        # Visible counters (OP_STATS "placement" section + OpenMetrics).
+        self.moved_errors = 0
+        self.envelope_decisions = 0
+        self.handoff_deferrals = 0
+        self.announces = 0
+        self.stale_announces = 0
+        self.pulls = 0
+        self.pushes_applied = 0
+        self.pushes_duplicate = 0
+        self.rows_imported = 0
+        self.aborts = 0
+        self.expired_aborts = 0
+
+    @property
+    def active(self) -> bool:
+        return self.pmap is not None and self.node_id is not None
+
+    @property
+    def epoch(self) -> int:
+        return -1 if self.pmap is None else self.pmap.epoch
+
+    # -- control plane -------------------------------------------------------
+    def snapshot_payload(self) -> dict:
+        """The OP_PLACEMENT reply: the adopted map (or ``epoch: -1`` for
+        a placement-unaware node) plus this node's id and live handoff
+        state."""
+        out: dict = {"epoch": self.epoch, "node_id": self.node_id,
+                     "parked_slots": sorted(self._parked_slots),
+                     "parked_keys": sorted(self._parked_keys)}
+        if self.pmap is not None:
+            out["map"] = self.pmap.to_dict()
+        return out
+
+    def announce(self, payload: Mapping) -> int:
+        """Adopt an announced map (monotonic by epoch; idempotent at the
+        current epoch; a STALE epoch raises). ``abort_epoch`` payloads
+        instead cancel that target epoch's parked handoff — the
+        coordinator's clean-abort path. Returns the adopted epoch."""
+        self.announces += 1
+        abort = payload.get("abort_epoch")
+        if abort is not None:
+            self._abort(int(abort))
+            # The COORDINATOR aborted: it knows the migration failed
+            # and may retry the same target epoch — re-arm pull for it
+            # (unlike a local expiry abort, where a late wire retry of
+            # the original pull must keep hitting the tombstone).
+            self._aborted_epochs.discard(int(abort))
+            return self.epoch
+        pmap = PlacementMap.from_dict(payload["map"])
+        node_id = payload.get("node_id")
+        if self.pmap is not None:
+            if pmap.epoch < self.pmap.epoch:
+                self.stale_announces += 1
+                raise StalePlacementError(
+                    f"stale placement epoch {pmap.epoch} "
+                    f"(this node adopted {self.pmap.epoch})")
+            if pmap.epoch == self.pmap.epoch and pmap != self.pmap:
+                # Two coordinators raced to the same target epoch with
+                # different maps: adopting the second would split-brain
+                # slot ownership across the fleet with no error
+                # anywhere. Re-announcing the SAME map is idempotent;
+                # a conflicting twin loses loudly and must rebase onto
+                # the adopted epoch.
+                self.stale_announces += 1
+                raise StalePlacementError(
+                    f"conflicting placement map at epoch {pmap.epoch}: "
+                    "another coordinator already committed this epoch "
+                    "with a different assignment — rebase and retry")
+        self.pmap = pmap
+        if node_id is not None:
+            self.node_id = int(node_id)
+        # Commit: any handoff whose target epoch is now current (or
+        # behind it) has transferred ownership — drop the parked state;
+        # the map itself answers "moved" from here on.
+        for e in [e for e in self._handoffs
+                  if e <= pmap.epoch]:
+            self._unpark(self._handoffs.pop(e))
+        # Tombstones at or below the adopted epoch are unreachable
+        # (pull refuses non-future epochs outright) — drop them.
+        self._aborted_epochs = {e for e in self._aborted_epochs
+                                if e > pmap.epoch}
+        self._prune_ledger()
+        return pmap.epoch
+
+    def _abort(self, target_epoch: int) -> None:
+        # A retried migration REUSES the aborted target epoch (the
+        # adopted epoch never moved), so the push ledger for it must
+        # reset with the abort: deduping attempt 2's batches against
+        # attempt 1's would silently drop re-pushed state (init-on-miss
+        # at full capacity — over-admission); re-applying is merely
+        # conservative (the import's debit replay floors at zero).
+        self._applied.pop(target_epoch, None)
+        h = self._handoffs.pop(target_epoch, None)
+        if h is not None:
+            self._unpark(h)
+            self.aborts += 1
+            # The export for this epoch (and its source debit) is gone:
+            # refuse late re-pulls until the coordinator acknowledges
+            # the abort (announce with abort_epoch clears this).
+            self._aborted_epochs.add(target_epoch)
+
+    def _unpark(self, h: _Handoff) -> None:
+        for s in h.slots:
+            if self._parked_slots.get(s) is h:
+                del self._parked_slots[s]
+        for k in h.keys or ():
+            if self._parked_keys.get(k) is h:
+                del self._parked_keys[k]
+
+    async def pull(self, req: Mapping, store) -> dict:
+        """MIGRATE_PULL on the old owner: export the requested slots'
+        (or keys') state with the envelope debit applied, park them, and
+        start the handoff window. Idempotent per target epoch — a
+        re-delivered pull returns the cached export (the at-most-once
+        client may safely retry it).
+
+        Large exports page: the reply carries one :func:`chunk_entries`
+        chunk (so it always fits MAX_FRAME) plus the total ``pages``
+        count; the client fetches pages 1..N-1 with ``page`` in the
+        request, served from the cached handoff export."""
+        import asyncio
+
+        if not self.active:
+            raise PlacementError(
+                "no placement announced: pull requires an adopted map")
+        target_epoch = int(req["target_epoch"])
+        if target_epoch <= self.pmap.epoch:
+            raise StalePlacementError(
+                f"stale migration target epoch {target_epoch} "
+                f"(this node adopted {self.pmap.epoch})")
+        page = int(req.get("page", 0))
+        async with self._control_lock:
+            cached = self._handoffs.get(target_epoch)
+            if cached is not None:
+                return self._pull_page(cached, page, cached=True)
+            if target_epoch in self._aborted_epochs:
+                # This node already exported (and debited) for this
+                # epoch and then aborted it on window expiry; the
+                # cached export is gone. A silent re-export here would
+                # double-debit the source — this is a late wire retry
+                # of the original pull, not a coordinated new attempt.
+                # The coordinator's clean-abort announce re-arms it.
+                raise PlacementError(
+                    f"migration to epoch {target_epoch} was aborted on "
+                    "this node (handoff window expired); announce the "
+                    "abort and retry the migration")
+            slots = frozenset(int(s) for s in req.get("slots", ()))
+            keys = (frozenset(req["keys"]) if req.get("keys") else None)
+            window_s = float(req.get("window_s", 2.0))
+            keep = keep_predicate(self.pmap.n_slots, self.pmap.overrides,
+                                  slots, keys)
+            # snapshot() pulls device state to host — blocking; off-loop
+            # so one pull never stalls the serving path's event loop.
+            entries = await asyncio.to_thread(_export_from_store, store,
+                                              keep)
+            export = debit_export(entries, self._fraction)
+            h = _Handoff(target_epoch, slots, keys, export, window_s,
+                         self._clock(), self._fraction, self._clock)
+            self._handoffs[target_epoch] = h
+            for s in slots:
+                self._parked_slots[s] = h
+            for k in keys or ():
+                self._parked_keys[k] = h
+            # Charge this store for the shipped amount NOW (parked keys
+            # serve from the envelope meanwhile): the authoritative
+            # residual equals the envelope, so even a handoff that
+            # expires after a slow commit announced the new epoch cannot
+            # resume a full undebited balance alongside the new owner
+            # (see debit_source).
+            await debit_source(store, entries, self._fraction,
+                               keep_envelope=True)
+            self.pulls += 1
+            return self._pull_page(h, page, cached=False)
+
+    def _pull_page(self, h: _Handoff, page: int, cached: bool) -> dict:
+        if not 0 <= page < len(h.chunks):
+            raise PlacementError(
+                f"pull page {page} out of range (export has "
+                f"{len(h.chunks)} pages)")
+        return {"target_epoch": h.target_epoch, "node_id": self.node_id,
+                "entries": h.chunks[page], "pages": len(h.chunks),
+                "cached": cached}
+
+    async def push(self, req: Mapping, store) -> int:
+        """MIGRATE_PUSH on the new owner: import one handoff batch
+        exactly once — a re-delivered ``(target_epoch, batch)`` is a
+        counted no-op, never a double-apply (the lock covers the
+        in-flight duplicate too: the dedup check and the import span an
+        await)."""
+        target_epoch = int(req["target_epoch"])
+        batch = int(req.get("batch", 0))
+        async with self._control_lock:
+            applied = self._applied.setdefault(target_epoch, set())
+            if batch in applied:
+                self.pushes_duplicate += 1
+                return 0
+            n = await import_entries(store, req.get("entries") or {})
+            applied.add(batch)
+            self.pushes_applied += 1
+            self.rows_imported += n
+            self._prune_ledger()
+            return n
+
+    def _prune_ledger(self) -> None:
+        while len(self._applied) > self._LEDGER_EPOCHS:
+            del self._applied[min(self._applied)]
+
+    # -- serving gate --------------------------------------------------------
+    def gate(self, key: str):
+        """The serving-path ownership check. Returns ``None`` (serve
+        authoritatively), ``("envelope", handoff)`` (parked mid-handoff:
+        admission ops serve the fair-share envelope), or ``("moved",
+        owner)`` (answer the routable moved error). Expired handoffs
+        auto-abort here — coordinator loss must not strand a keyspace."""
+        if not self.active:
+            return None
+        h = self._parked_keys.get(key)
+        if h is None and key not in self.pmap.overrides:
+            # Override keys route (and migrate) independently of their
+            # slot — a parked slot does not park its split-out keys.
+            h = self._parked_slots.get(self.pmap.slot_of(key))
+        if h is not None:
+            if h.expired(self._clock()):
+                # The commit never came: abort back to the old epoch.
+                # Safe in BOTH races — if the target epoch was never
+                # announced no client routes to the new owner, and if a
+                # slow commit DID announce it, this store was already
+                # debited down to the envelope at pull time
+                # (debit_source), so resuming authoritative serving
+                # stays inside the dual-ownership bound.
+                self._abort(h.target_epoch)
+                self.expired_aborts += 1
+            else:
+                return ("envelope", h)
+        owner = self.pmap.node_of(key)
+        if owner != self.node_id:
+            self.moved_errors += 1
+            return ("moved", owner)
+        return None
+
+    def bulk_gate(self, keys: Sequence[str]):
+        """Ownership masks for one bulk frame. Returns ``None`` when
+        every row serves authoritatively (the overwhelming steady-state
+        — one vectorized crc32 pass plus a table compare), else
+        ``(serve_mask, envelope_rows, moved_mask)`` where
+        ``envelope_rows`` is ``[(row_index, handoff), …]``. Expired
+        handoffs auto-abort first, exactly like the scalar gate."""
+        if not self.active:
+            return None
+        from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+            route_keys,
+        )
+
+        now = self._clock()
+        for e in [e for e, h in self._handoffs.items()
+                  if h.expired(now)]:
+            self._abort(e)
+            self.expired_aborts += 1
+        pmap = self.pmap
+        slots = route_keys(keys, pmap.n_slots)
+        owners = pmap.slot_owner[slots]
+        parked = (np.isin(slots, np.fromiter(self._parked_slots,
+                                             np.int64,
+                                             len(self._parked_slots)))
+                  if self._parked_slots else
+                  np.zeros(len(slots), bool))
+        if pmap.overrides or self._parked_keys:
+            # Slot prefilter (route()'s discipline): only rows hashing
+            # into an override or parked key's slot pay the per-key
+            # probe — a long-lived hot-split table must not put every
+            # bulk frame's every row back on a Python string loop.
+            ov, pk = pmap.overrides, self._parked_keys
+            special = pmap.override_slots()
+            if pk:
+                special = np.union1d(special, np.fromiter(
+                    (_slot_of(k, pmap.n_slots) for k in pk),
+                    np.int64, len(pk)))
+            cand = np.isin(slots, special)
+            for i in np.nonzero(cand)[0]:
+                k = keys[int(i)]
+                j = ov.get(k)
+                if j is not None:
+                    owners[i] = j
+                    parked[i] = False  # overrides route independently
+                if k in pk:
+                    parked[i] = True
+        serve_mask = (owners == self.node_id) & ~parked
+        if serve_mask.all():
+            return None
+        moved_mask = (owners != self.node_id) & ~parked
+        envelope_rows = []
+        if parked.any():
+            for i in np.nonzero(parked)[0]:
+                k = keys[int(i)]
+                h = self._parked_keys.get(k)
+                if h is None:
+                    h = self._parked_slots.get(int(slots[i]))
+                if h is not None:
+                    envelope_rows.append((int(i), h))
+                else:  # raced an abort: the map still owns it here
+                    serve_mask[i] = owners[i] == self.node_id
+                    moved_mask[i] = not serve_mask[i]
+        self.moved_errors += int(moved_mask.sum())
+        return serve_mask, envelope_rows, moved_mask
+
+    def moved_message(self, key: str, owner: int) -> str:
+        return (f"{MOVED_ERROR_PREFIX}: key routes to node {owner} at "
+                f"epoch {self.pmap.epoch}")
+
+    def envelope_acquire(self, h: _Handoff, key: str, count: int,
+                         a: float, b: float, kind: str
+                         ) -> tuple[bool, float]:
+        self.envelope_decisions += 1
+        return h.envelope.acquire(key, count, a, b, kind)
+
+    def stats(self) -> dict:
+        out = {
+            "epoch": self.epoch,
+            "node_id": self.node_id,
+            "parked_slots": len(self._parked_slots),
+            "parked_keys": len(self._parked_keys),
+            "moved_errors": self.moved_errors,
+            "envelope_decisions": self.envelope_decisions,
+            "handoff_deferrals": self.handoff_deferrals,
+            "pulls": self.pulls,
+            "pushes_applied": self.pushes_applied,
+            "pushes_duplicate": self.pushes_duplicate,
+            "rows_imported": self.rows_imported,
+            "aborts": self.aborts,
+            "expired_aborts": self.expired_aborts,
+        }
+        if self.pmap is not None and self.node_id is not None:
+            out["owned_slots"] = int(
+                (self.pmap.slot_owner == self.node_id).sum())
+        return out
+
+
+# -- store import/export lanes ----------------------------------------------
+
+def _export_from_store(store, keep: Callable[[str], bool]) -> dict:
+    """Prefer a store's own ``export_entries`` override; fall back to
+    filtering its snapshot through the schema-aware extractor."""
+    exporter = getattr(store, "export_entries", None)
+    if callable(exporter):
+        return exporter(keep)
+    return extract_entries(store.snapshot(), keep)
+
+
+async def import_entries(store, entries: Mapping) -> int:
+    """Apply normalized entries to any store. A store-provided
+    ``import_entries`` override (the exact host-dict merge) wins;
+    otherwise the **generic replay lane** adopts the state through the
+    store's public ops:
+
+    - buckets via the saturating **debit kernel** (``debit_many`` — the
+      round-5/tier-0 state primitive): a fresh key initializes at full
+      capacity and ``capacity − tokens`` is debited away, landing the
+      migrated balance exactly, batched per ``(capacity, rate)`` config;
+    - windows by replaying the current window's count (prior windows'
+      interpolated share is dropped — conservative only toward admission,
+      inside the handoff epsilon);
+    - counters via ``sync_counter`` (a fresh counter adopts the pushed
+      value); semaphores via ``concurrency_acquire``.
+
+    Returns the number of rows applied."""
+    importer = getattr(store, "import_entries", None)
+    if callable(importer):
+        return await importer(entries)
+    n = 0
+    by_config: dict[tuple, tuple[list, list]] = {}
+    for key, cap, rate, tokens, _age in entries.get("buckets", ()):
+        ks, amounts = by_config.setdefault((float(cap), float(rate)),
+                                           ([], []))
+        ks.append(key)
+        amounts.append(max(0.0, float(cap) - float(tokens)))
+        n += 1
+    await _debit_buckets(store, by_config)
+    for key, limit, wt, interp, _prev, curr, behind in \
+            entries.get("windows", ()):
+        if behind == 0 and curr > 0:
+            from distributedratelimiting.redis_tpu.ops import bucket_math
+            window_sec = wt / bucket_math.TICKS_PER_SECOND
+            count = int(math.ceil(curr))
+            if interp:
+                await store.window_acquire(key, count, limit, window_sec)
+            else:
+                await store.fixed_window_acquire(key, count, limit,
+                                                 window_sec)
+        n += 1
+    for key, value, _period, _age in entries.get("counters", ()):
+        await store.sync_counter(key, float(value), 0.0)
+        n += 1
+    for key, active in entries.get("semas", ()):
+        await store.concurrency_acquire(key, int(active), int(active))
+        n += 1
+    return n

@@ -74,8 +74,15 @@ class StoreTimeoutError(asyncio.TimeoutError):
 #: Ops safe to retry even after their frame may have reached the wire:
 #: executing them twice changes no admission state. Everything else —
 #: ACQUIRE, WINDOW, FWINDOW, SEMA, SYNC, mutating STATS/TRACES flags —
-#: retries only on provably-never-sent failures (connect phase).
-_IDEMPOTENT_OPS = frozenset((wire.OP_PEEK, wire.OP_PING, wire.OP_METRICS))
+#: retries only on provably-never-sent failures (connect phase). The
+#: placement/migration control ops are *application-idempotent by
+#: design* (epoch-monotonic announce, per-epoch cached pull,
+#: batch-deduped push — wire.py), so a coordinator's retry mid-chaos
+#: can never double-apply a handoff.
+_IDEMPOTENT_OPS = frozenset((
+    wire.OP_PEEK, wire.OP_PING, wire.OP_METRICS, wire.OP_PLACEMENT,
+    wire.OP_PLACEMENT_ANNOUNCE, wire.OP_MIGRATE_PULL,
+    wire.OP_MIGRATE_PUSH))
 
 
 class RemoteBucketStore(BucketStore):
@@ -933,6 +940,54 @@ class RemoteBucketStore(BucketStore):
         cluster_metrics`` scrapes every node through this)."""
         (text,) = await self._request(wire.OP_METRICS)
         return text
+
+    # -- placement / migration control plane (runtime/placement.py) ---------
+    async def placement_fetch(self, *,
+                              timeout_s: "float | None" = None) -> dict:
+        """The node's adopted placement map + handoff state
+        (``OP_PLACEMENT``); ``{"epoch": -1, …}`` from a node no
+        coordinator has announced to yet."""
+        import json
+
+        (text,) = await self._request(wire.OP_PLACEMENT,
+                                      timeout_s=timeout_s)
+        return json.loads(text)
+
+    async def placement_announce(self, payload: dict, *,
+                                 timeout_s: "float | None" = None) -> int:
+        """Announce a placement map (``{"map": …, "node_id": j}``) or an
+        abort (``{"abort_epoch": e}``) to the node; returns the node's
+        adopted epoch. Stale epochs surface as
+        :class:`wire.RemoteStoreError`."""
+        import json
+
+        (epoch,) = await self._request(
+            wire.OP_PLACEMENT_ANNOUNCE, json.dumps(payload),
+            timeout_s=timeout_s)
+        return int(epoch)
+
+    async def migrate_pull(self, req: dict, *,
+                           timeout_s: "float | None" = None) -> dict:
+        """Export + park state on the old owner for a pending epoch
+        (``OP_MIGRATE_PULL``; idempotent per target epoch)."""
+        import json
+
+        (text,) = await self._request(wire.OP_MIGRATE_PULL,
+                                      json.dumps(req),
+                                      timeout_s=timeout_s)
+        return json.loads(text)
+
+    async def migrate_push(self, req: dict, *,
+                           timeout_s: "float | None" = None) -> int:
+        """Apply one handoff batch on the new owner
+        (``OP_MIGRATE_PUSH``; exactly-once per ``(epoch, batch)``).
+        Returns rows applied (0 for a deduplicated re-delivery)."""
+        import json
+
+        (applied,) = await self._request(wire.OP_MIGRATE_PUSH,
+                                         json.dumps(req),
+                                         timeout_s=timeout_s)
+        return int(applied)
 
     async def traces(self, drain: bool = False) -> dict:
         """The server's kept traces as Chrome-trace-event JSON

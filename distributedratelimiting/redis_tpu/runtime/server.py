@@ -25,7 +25,9 @@ import asyncio
 import hmac
 import time
 
-from distributedratelimiting.redis_tpu.runtime import wire
+import numpy as np
+
+from distributedratelimiting.redis_tpu.runtime import placement, wire
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.flight_recorder import (
@@ -46,6 +48,18 @@ __all__ = ["BucketStoreServer"]
 #: own line item in the plane's overhead audit).
 _HOT_KEYED_OPS = frozenset(
     (wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW, wire.OP_SEMA))
+
+#: Keyed ops the placement gate checks once a map is adopted. Admission
+#: ops on a parked (mid-handoff) key serve from the handoff's fair-share
+#: envelope; everything else gated answers the routable moved error.
+_PLACEMENT_GATED_OPS = frozenset(
+    (wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW, wire.OP_SEMA,
+     wire.OP_PEEK, wire.OP_SYNC))
+_ENVELOPE_KIND = {wire.OP_ACQUIRE: "bucket", wire.OP_WINDOW: "window",
+                  wire.OP_FWINDOW: "fwindow"}
+_BULK_ENVELOPE_KIND = {wire.BULK_KIND_BUCKET: "bucket",
+                       wire.BULK_KIND_WINDOW: "window",
+                       wire.BULK_KIND_FWINDOW: "fwindow"}
 
 
 def _recover_seq(body: bytes) -> int:
@@ -162,6 +176,10 @@ class BucketStoreServer:
             else:
                 tracing.configure(enabled=bool(tracing_config))
         self.tracer = tracing.get_tracer()
+        # Elastic-membership half: the epoch-versioned placement map +
+        # handoff state (docs/OPERATIONS.md §9). Dormant — zero serving
+        # cost — until a coordinator announces a map (OP_PLACEMENT_*).
+        self.placement = placement.NodePlacementState()
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -402,6 +420,14 @@ class BucketStoreServer:
                 self.flight_recorder.snapshot,
                 counters={"frames_recorded", "dumps_written",
                           "dumps_suppressed"})
+        reg.register_numeric_dict(
+            "placement", "placement/migration state",
+            lambda: (self.placement.stats()
+                     if self.placement.active else None),
+            counters={"moved_errors", "envelope_decisions",
+                      "handoff_deferrals", "pulls", "pushes_applied",
+                      "pushes_duplicate", "rows_imported", "aborts",
+                      "expired_aborts", "announces", "stale_announces"})
         reg.register_numeric_dict(
             "trace", "distributed tracer",
             lambda: (self.tracer.snapshot()
@@ -649,7 +675,25 @@ class BucketStoreServer:
                 # stores iterate the view like the list they used to get.
                 seq, keys, counts, a, b, with_rem, kind = (
                     wire.decode_bulk_request(body, as_view=True))
-                if kind == wire.BULK_KIND_BUCKET:
+                gate = (self.placement.bulk_gate(keys)
+                        if self.placement.active else None)
+                if gate is not None and gate[2].any():
+                    # Misrouted rows answer a FRAME-level moved error —
+                    # the same routable signal the scalar gate emits.
+                    # No row was applied (all-or-error), so the client
+                    # refreshes its map and resends the whole frame; a
+                    # bulk-only client would otherwise hold a stale map
+                    # forever (silent denial gave it no refresh trigger).
+                    i = int(np.nonzero(gate[2])[0][0])
+                    key = keys[int(i)]
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        self.placement.moved_message(
+                            key, int(self.placement.pmap.node_of(key))))
+                if gate is not None:
+                    res = await self._serve_bulk_gated(
+                        keys, counts, a, b, with_rem, kind, gate)
+                elif kind == wire.BULK_KIND_BUCKET:
                     res = await self.store.acquire_many(
                         keys, counts, a, b, with_remaining=with_rem)
                 else:
@@ -660,6 +704,36 @@ class BucketStoreServer:
                 return wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
             seq, op, key, count, a, b = wire.decode_request(body)
+            if self.placement.active and op in _PLACEMENT_GATED_OPS:
+                verdict = self.placement.gate(key)
+                if verdict is not None:
+                    what, info = verdict
+                    ekind = _ENVELOPE_KIND.get(op)
+                    if what == "envelope":
+                        if ekind is not None and count >= 0:
+                            granted, remaining = \
+                                self.placement.envelope_acquire(
+                                    info, key, count, a, b, ekind)
+                            return wire.encode_response(
+                                seq, wire.RESP_DECISION, granted,
+                                remaining)
+                        # Parked PEEK/SYNC/SEMA have no envelope value
+                        # and no authoritative owner yet (pre-commit) —
+                        # a MOVED here would name THIS node and send the
+                        # client in a circle. Answer a transient typed
+                        # error instead; the window bounds the wait.
+                        self.placement.handoff_deferrals += 1
+                        return wire.encode_response(
+                            seq, wire.RESP_ERROR,
+                            f"{placement.HANDOFF_DEFERRAL_PREFIX} for "
+                            f"this key (target epoch "
+                            f"{info.target_epoch}); retry shortly")
+                    # Plainly-misrouted keys answer the routable moved
+                    # error: the client refetches the map and re-routes
+                    # rather than reading a non-authority.
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        self.placement.moved_message(key, info))
             hh = self.heavy_hitters
             if hh is not None and count > 0 and op in _HOT_KEYED_OPS:
                 # Hot-key telemetry: scalar admission lane (the bulk
@@ -725,10 +799,16 @@ class BucketStoreServer:
                     # save is in flight piggyback on it (BGSAVE semantics)
                     # instead of queueing N redundant full-state pulls.
                     if self._save_task is None or self._save_task.done():
+                        # Placement-versioned checkpoint: a rejoining
+                        # node restoring this file can be held to the
+                        # cluster's current epoch (placement.py).
+                        epoch = (self.placement.epoch
+                                 if self.placement.active else None)
                         self._save_task = asyncio.ensure_future(
                             asyncio.to_thread(
                                 checkpoint.save_snapshot, self.store,
-                                self.snapshot_path))
+                                self.snapshot_path,
+                                placement_epoch=epoch))
                     await asyncio.shield(self._save_task)
                     resp = wire.encode_response(seq, wire.RESP_EMPTY)
             elif op == wire.OP_STATS:
@@ -756,6 +836,45 @@ class BucketStoreServer:
             elif op == wire.OP_METRICS:
                 resp = wire.encode_response(
                     seq, wire.RESP_TEXT, self.registry.render())
+            elif op == wire.OP_PLACEMENT:
+                import json
+
+                resp = wire.encode_response(
+                    seq, wire.RESP_TEXT,
+                    json.dumps(self.placement.snapshot_payload()))
+            elif op == wire.OP_PLACEMENT_ANNOUNCE:
+                import json
+
+                epoch = self.placement.announce(json.loads(key))
+                if self._native is not None and self.native_tier0:
+                    # The C tier-0 cache decides hot keys without the
+                    # gate; its epsilon bound still holds, but a
+                    # membership change deserves the operator's eye
+                    # (docs/OPERATIONS.md §9 failure modes).
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "placement epoch %d adopted with the tier-0 "
+                        "cache enabled: tier-0 keeps deciding hot keys "
+                        "until their budgets drain", epoch)
+                resp = wire.encode_response(seq, wire.RESP_VALUE,
+                                            float(epoch))
+            elif op == wire.OP_MIGRATE_PULL:
+                import json
+
+                await faults.seam("server.migrate")
+                out = await self.placement.pull(json.loads(key),
+                                                self.store)
+                resp = wire.encode_response(seq, wire.RESP_TEXT,
+                                            json.dumps(out))
+            elif op == wire.OP_MIGRATE_PUSH:
+                import json
+
+                await faults.seam("server.migrate")
+                applied = await self.placement.push(json.loads(key),
+                                                    self.store)
+                resp = wire.encode_response(seq, wire.RESP_VALUE,
+                                            float(applied))
             elif op == wire.OP_TRACES:
                 # Chrome-trace JSON capped under MAX_FRAME (newest traces
                 # win); flag bit 0 drains the buffer after export.
@@ -772,6 +891,47 @@ class BucketStoreServer:
             log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
         return resp
+
+    async def _serve_bulk_gated(self, keys, counts, a: float, b: float,
+                                with_rem: bool, kind: int, gate):
+        """One bulk frame under an active placement map with at least
+        one parked row (frames containing MISROUTED rows never reach
+        here — the caller answers those with a frame-level moved error
+        so stale bulk clients refresh their map): owned rows take the
+        normal store path, parked rows serve from their handoff
+        envelope. Row order is preserved."""
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            BulkAcquireResult,
+        )
+
+        serve_mask, envelope_rows, _moved = gate
+        n = len(keys)
+        counts_np = np.asarray(counts, np.int64)
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32) if with_rem else None
+        idx = np.nonzero(serve_mask)[0]
+        if len(idx):
+            sub_keys = [keys[int(i)] for i in idx]
+            if kind == wire.BULK_KIND_BUCKET:
+                res = await self.store.acquire_many(
+                    sub_keys, counts_np[idx], a, b,
+                    with_remaining=with_rem)
+            else:
+                res = await self.store.window_acquire_many(
+                    sub_keys, counts_np[idx], a, b,
+                    fixed=(kind == wire.BULK_KIND_FWINDOW),
+                    with_remaining=with_rem)
+            granted[idx] = res.granted
+            if remaining is not None and res.remaining is not None:
+                remaining[idx] = res.remaining
+        ekind = _BULK_ENVELOPE_KIND[kind]
+        for i, handoff in envelope_rows:
+            g, rem = self.placement.envelope_acquire(
+                handoff, keys[i], int(counts_np[i]), a, b, ekind)
+            granted[i] = g
+            if remaining is not None:
+                remaining[i] = rem
+        return BulkAcquireResult(granted, remaining)
 
     def _stats_json(self) -> str:
         import json
@@ -826,6 +986,8 @@ class BucketStoreServer:
                 stage(name, hist)
         if stages:
             payload["stages"] = stages
+        if self.placement.active:
+            payload["placement"] = self.placement.stats()
         if self.heavy_hitters is not None:
             payload["hot_keys"] = self.heavy_hitters.snapshot()
         if self.flight_recorder is not None:
@@ -912,6 +1074,12 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--sweep-period", type=float, default=0.0,
                         help="active TTL-expiry period in seconds "
                         "(0 = on-demand sweeps only; device backend only)")
+    parser.add_argument("--expect-placement-epoch", type=int, default=None,
+                        help="refuse a startup snapshot whose recorded "
+                        "placement epoch differs (typed mismatch → "
+                        "init-on-miss): a node rejoining a resharded "
+                        "cluster must not serve key memberships from a "
+                        "retired epoch (docs/OPERATIONS.md §9)")
     parser.add_argument("--auth-token", default=None,
                         help="shared secret; when set, clients must HELLO "
                         "with it before any other op (≙ Redis AUTH)")
@@ -1014,7 +1182,10 @@ def main(argv: list[str] | None = None) -> None:
 
             if os.path.exists(args.snapshot_path):
                 try:
-                    checkpoint.load_snapshot(store, args.snapshot_path)
+                    checkpoint.load_snapshot(
+                        store, args.snapshot_path,
+                        expected_placement_epoch=(
+                            args.expect_placement_epoch))
                 except checkpoint.SnapshotCorruptError as exc:
                     # Documented init-on-miss fallback: a torn snapshot
                     # must not keep the store down — serve fresh (state

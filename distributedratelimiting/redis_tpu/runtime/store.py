@@ -381,6 +381,18 @@ class BucketStore(abc.ABC):
     @abc.abstractmethod
     def restore(self, snap: dict) -> None: ...
 
+    def export_entries(self, keep) -> dict:
+        """Normalized per-key state for the keys ``keep`` selects — the
+        live-migration handoff unit (:mod:`~.placement`). Default:
+        filter this store's :meth:`snapshot` through the schema-aware
+        extractor (host-dict and device slot-array schemas both
+        understood). The matching import runs through
+        :func:`placement.import_entries`, whose generic lane replays
+        buckets via the saturating ``debit_many`` kernel."""
+        from distributedratelimiting.redis_tpu.runtime import placement
+
+        return placement.extract_entries(self.snapshot(), keep)
+
 
 def start_periodic_sweeper(sweep_all: Callable[[], None],
                            period_s: float) -> "asyncio.Task":
@@ -2119,6 +2131,52 @@ class InProcessBucketStore(BucketStore):
 
     async def aclose(self) -> None:
         pass
+
+    async def import_entries(self, entries: dict) -> int:
+        """Exact merge lane for migration handoffs (the generic replay
+        in :func:`placement.import_entries` is for stores whose state
+        only the kernels can write). Conservative on collisions — a
+        re-pushed batch or pre-existing local state must never inflate
+        a budget: buckets keep the smaller balance, windows sum their
+        counts (clamped to the limit), counters and semaphores keep the
+        larger value."""
+        now = self.clock.now_ticks()
+        n = 0
+        for key, cap, rate, tokens, age in entries.get("buckets", ()):
+            bkey = (key, float(cap), float(rate))
+            ts = now - int(age)
+            entry = self._buckets.get(bkey)
+            if entry is None:
+                self._buckets[bkey] = (float(tokens), ts)
+            else:
+                self._buckets[bkey] = (min(entry[0], float(tokens)),
+                                       max(entry[1], ts))
+            n += 1
+        for key, limit, wt, interp, prev, curr, behind in \
+                entries.get("windows", ()):
+            wkey = (key, float(limit), int(wt), bool(interp))
+            idx = now // int(wt) - int(behind)
+            entry = self._windows.get(wkey)
+            if entry is None or entry[2] < idx:
+                # no local state, or the LOCAL entry is the stale one
+                # (an earlier epoch's leftovers): the push wins outright
+                self._windows[wkey] = (float(prev), float(curr), idx)
+            elif entry[2] == idx:
+                self._windows[wkey] = (
+                    min(float(limit), entry[0] + float(prev)),
+                    min(float(limit), entry[1] + float(curr)), idx)
+            # a stale PUSHED window (older idx) carries no usage to keep
+            n += 1
+        for key, value, period, age in entries.get("counters", ()):
+            entry = self._counters.get(key)
+            if entry is None or entry[0] < value:
+                self._counters[key] = (float(value), float(period),
+                                       now - int(age))
+            n += 1
+        for key, active in entries.get("semas", ()):
+            self._semas[key] = max(self._semas.get(key, 0), int(active))
+            n += 1
+        return n
 
     def snapshot(self) -> dict:
         return {

@@ -76,7 +76,12 @@ behavior must not slip through). OP_METRICS (OpenMetrics exposition)
 and the OP_STATS flag BITS (reset / flight-dump) arrived within v4: a
 new op and a widened already-optional flag byte change no existing
 frame's meaning, so an old server answers with a routable error rather
-than a misread. Semantic changes to an existing frame always bump the
+than a misread. The placement/migration control plane (OP_PLACEMENT /
+OP_PLACEMENT_ANNOUNCE / OP_MIGRATE_PULL / OP_MIGRATE_PUSH, round 6)
+arrived the same way — and every one of them is additionally
+*application-idempotent* (epoch-monotonic announce, per-epoch cached
+pull, batch-deduped push), so the client may retry them even post-send
+without violating the at-most-once admission contract. Semantic changes to an existing frame always bump the
 version: a silent misread loses decisions, the strict version check
 fails loudly instead.
 
@@ -119,6 +124,8 @@ __all__ = [
     "OP_ACQUIRE", "OP_PEEK", "OP_SYNC", "OP_WINDOW", "OP_PING",
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
+    "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
+    "OP_MIGRATE_PUSH", "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
@@ -153,6 +160,32 @@ OP_METRICS = 12  # OpenMetrics text exposition (RESP_TEXT reply). A new
 OP_TRACES = 13  # Chrome-trace-event JSON export of the server's kept
 # traces (RESP_TEXT reply); optional one-byte flag: bit 0 drains the
 # buffer after export. Same compatibility posture as OP_METRICS.
+
+# -- placement / migration control plane (within v4, OP_METRICS posture:
+# new ops on the existing frame layout — an old server answers each with
+# a routable unknown-op error, never a misparse; see runtime/placement.py
+# and docs/DESIGN.md §12).
+OP_PLACEMENT = 14  # fetch the node's adopted placement map (empty
+# request → RESP_TEXT JSON: epoch, node_id, slot_owner, overrides,
+# parked handoff state; epoch -1 = placement-unaware node).
+OP_PLACEMENT_ANNOUNCE = 15  # adopt a placement map (or abort a target
+# epoch): [u32 mlen][json] → RESP_VALUE adopted epoch. Epoch-monotonic
+# and idempotent at the current epoch; a stale epoch is a routable
+# error, so announce retries are always safe.
+OP_MIGRATE_PULL = 16  # old owner: export + park the listed slots/keys
+# for a target epoch — [u32 mlen][json {target_epoch, slots|keys,
+# window_s}] → RESP_TEXT JSON {entries, …}. Idempotent per target epoch
+# (a re-delivered pull returns the cached, already-debited export).
+OP_MIGRATE_PUSH = 17  # new owner: import one handoff batch —
+# [u32 mlen][json {target_epoch, batch, entries}] → RESP_VALUE rows
+# applied. Exactly-once per (target_epoch, batch): a re-delivered batch
+# is a counted no-op, never a double-apply.
+
+#: Control ops whose request payload is one u32-length-prefixed UTF-8
+#: JSON text (rides in the ``key`` slot of encode/decode_request —
+#: ensure_ascii JSON, so the strict codec never meets a surrogate).
+TEXT_OPS = frozenset((OP_PLACEMENT_ANNOUNCE, OP_MIGRATE_PULL,
+                      OP_MIGRATE_PUSH))
 
 #: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
 #: payload. Only sampled requests carry it; an old server answers the
@@ -195,6 +228,10 @@ _OP_NAMES = {
     OP_ACQUIRE_MANY: "acquire_many",
     OP_METRICS: "metrics",
     OP_TRACES: "traces",
+    OP_PLACEMENT: "placement",
+    OP_PLACEMENT_ANNOUNCE: "placement_announce",
+    OP_MIGRATE_PULL: "migrate_pull",
+    OP_MIGRATE_PUSH: "migrate_push",
 }
 
 
@@ -286,7 +323,17 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
         # dump. TRACES: bit 0 drains the trace buffer after export.
         # Absent byte = plain snapshot/export.
         payload = bytes([count & 0xFF]) if count else b""
-    elif op in (OP_PING, OP_SAVE, OP_METRICS):
+    elif op in TEXT_OPS:
+        # Control-plane JSON rides in the key slot with the u32 length
+        # prefix RESP_TEXT already uses (migration blobs outgrow the u16
+        # keyed header); bounded by MAX_FRAME like every frame.
+        mb = key.encode("utf-8")
+        if _BODY_OFF + _TEXTLEN.size + len(mb) > MAX_FRAME:
+            raise ValueError(
+                f"control payload of {len(mb)} bytes exceeds MAX_FRAME; "
+                "chunk the migration batch")
+        payload = _TEXTLEN.pack(len(mb)) + mb
+    elif op in (OP_PING, OP_SAVE, OP_METRICS, OP_PLACEMENT):
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
@@ -357,7 +404,10 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
         return seq, op, token, 0, 0.0, 0.0
     if op in (OP_STATS, OP_TRACES):
         return seq, op, "", (body[0] if body else 0), 0.0, 0.0
-    if op in (OP_PING, OP_SAVE, OP_METRICS):
+    if op in TEXT_OPS:
+        (mlen,) = _TEXTLEN.unpack_from(body, 0)
+        return seq, op, body[4:4 + mlen].decode("utf-8"), 0, 0.0, 0.0
+    if op in (OP_PING, OP_SAVE, OP_METRICS, OP_PLACEMENT):
         return seq, op, "", 0, 0.0, 0.0
     if op == OP_ACQUIRE_MANY:
         raise RemoteStoreError(

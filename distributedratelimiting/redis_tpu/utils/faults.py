@@ -16,6 +16,13 @@ runtime consults when — and only when — an injector is installed:
 - ``t0.sync`` — one tier-0 reconciliation round in
   :meth:`NativeFrontend._t0_sync_loop` (a fault fails the round; rows
   carry, the degraded streak advances).
+- ``server.migrate`` — a MIGRATE_PULL/PUSH dispatch on the serving node
+  (:meth:`BucketStoreServer._handle_frame_inner`): a fault here fails
+  one handoff step — the coordinator's abort path must fire.
+- ``cluster.migrate`` — one membership-change step on the coordinator
+  (:meth:`ClusterBucketStore._apply_placement`: health gate, pull, each
+  push batch, each commit announce): the membership-change seam the
+  reshard soak drives.
 
 **Determinism.** Each seam owns its own ``random.Random`` seeded from
 ``(seed, seam)`` and its own occurrence counter, and every occurrence
@@ -44,7 +51,7 @@ from typing import Mapping, Sequence
 __all__ = [
     "FaultRule", "FaultEvent", "FaultInjector", "FaultInjectedError",
     "BlackholeFault", "SkewedClock", "install", "uninstall",
-    "get_injector",
+    "get_injector", "seam",
     "RESET", "DELAY", "PARTIAL_FRAME", "STALL", "BLACKHOLE", "ERROR",
     "CLOCK_SKEW",
 ]
@@ -355,6 +362,14 @@ def install(injector: "FaultInjector | None"
 
 def uninstall() -> None:
     install(None)
+
+
+async def seam(name: str) -> None:
+    """Consult the installed injector at a named seam — the cold-path
+    convenience (control-plane call sites); hot paths keep the inline
+    ``faults._INJECTOR is not None`` guard instead of paying a call."""
+    if _INJECTOR is not None:
+        await _INJECTOR.on_event(name)
 
 
 def _maybe_install_from_env() -> None:

@@ -11,6 +11,10 @@ The chaos plane (cluster breakers, node quarantine) adds two more:
 partitions VISIBLE — the old code swallowed them), 4 = a node's circuit
 breaker changed state. Both carry the node index in ``extra`` so log
 pipelines can pivot per node.
+
+The membership plane adds 5 = a migration committed or aborted (the
+full event dict — moved slots/keys, epochs, handoff window — rides in
+``extra``, mirroring ``ClusterBucketStore.migration_log``).
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ EVENT_COULD_NOT_CONNECT = 1
 EVENT_ERROR_EVALUATING = 2
 EVENT_CLUSTER_NODE_ERROR = 3
 EVENT_BREAKER_TRANSITION = 4
+EVENT_CLUSTER_MIGRATION = 5
 
 
 def could_not_connect_to_store(exc: BaseException) -> None:
@@ -63,4 +68,17 @@ def breaker_transition(node: int, old: str, new: str) -> None:
         node, old, new,
         extra={"event_id": EVENT_BREAKER_TRANSITION, "node": node,
                "breaker_old": old, "breaker_new": new},
+    )
+
+
+def cluster_migration(event: dict) -> None:
+    """Event id 5 — a membership migration committed or aborted. The
+    event dict is the same record ``ClusterBucketStore.migration_log``
+    keeps (type, reason, epochs, moved slots/keys, window times)."""
+    logger.warning(
+        "Cluster migration %s: %s -> epoch %s (%s)",
+        event.get("type"), event.get("from_epoch"),
+        event.get("target_epoch"), event.get("reason"),
+        extra={"event_id": EVENT_CLUSTER_MIGRATION,
+               "migration": dict(event)},
     )

@@ -293,6 +293,15 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.has_trace = True
     except AttributeError:  # stale binary without the trace ABI
         lib.has_trace = False
+    try:
+        lib.fe_has_row_skip.argtypes = []
+        lib.fe_has_row_skip.restype = c.c_int
+        lib.has_row_skip = True
+    except AttributeError:
+        # Stale binary whose fe_complete would read the kRowSkip
+        # sentinel as "granted" — Python must fall back to deny-only
+        # gating on the batch lane.
+        lib.has_row_skip = False
     lib.fe_stop.argtypes = [c.c_void_p]
     lib.fe_stop.restype = None
     lib.fe_free.argtypes = [c.c_void_p]

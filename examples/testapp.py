@@ -283,9 +283,6 @@ def cmd_cluster(args) -> int:
     its own store), one ClusterBucketStore routing keys across them,
     bulk + single-key traffic, then one node killed to show per-node
     degraded mode (deny policy)."""
-    from distributedratelimiting.redis_tpu.parallel.sharded_store import (
-        shard_of_key,
-    )
     from distributedratelimiting.redis_tpu.runtime.cluster import (
         ClusterBucketStore,
     )
@@ -308,14 +305,17 @@ def cmd_cluster(args) -> int:
             partial_failures="deny", request_timeout_s=3.0)
         keys = [f"user{i}" for i in range(args.n)]
         res = await store.acquire_many(keys, [1] * args.n, 100.0, 50.0)
+        # The placement map is the routing truth (no modulus): epoch 0
+        # routes exactly like the legacy crc32 % N, and a resharded
+        # cluster's spread follows the map automatically.
         spread = [0] * args.nodes
         for k in keys:
-            spread[shard_of_key(k, args.nodes)] += 1
+            spread[store.node_index_of(k)] += 1
         stats = await store.stats()
         await servers[0].aclose()  # kill node 0 → its keys deny, rest serve
         res2 = await store.acquire_many(keys, [1] * args.n, 100.0, 50.0)
         live = sum(1 for i, k in enumerate(keys)
-                   if shard_of_key(k, args.nodes) != 0 and res2.granted[i])
+                   if store.node_index_of(k) != 0 and res2.granted[i])
         print(json.dumps({
             "nodes": args.nodes,
             "key_spread": spread,

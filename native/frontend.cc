@@ -150,6 +150,14 @@ constexpr uint8_t OP_PING = 5;
 constexpr uint8_t OP_SEMA = 8;  // signed count: +acquire / -release / 0 probe
 constexpr uint8_t OP_FWINDOW = 9;
 constexpr uint8_t OP_HELLO = 10;
+// Placement / migration control plane (wire.py, round 6): never hot —
+// routed to the Python passthrough lane below. Named (and case-listed)
+// so drl-check's wire-conformance diff pins their values against
+// wire.py and a future fast-path cannot typo them.
+constexpr uint8_t OP_PLACEMENT = 14;
+constexpr uint8_t OP_PLACEMENT_ANNOUNCE = 15;
+constexpr uint8_t OP_MIGRATE_PULL = 16;
+constexpr uint8_t OP_MIGRATE_PUSH = 17;
 
 // Op-byte bit 7 (wire.py TRACE_FLAG): a 25-byte trace tail —
 // [u64 trace_hi][u64 trace_lo][u64 parent span][u8 flags] — follows the
@@ -828,10 +836,15 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         fe->requests_served++;  // the asyncio server counts pings too
         break;
       }
+      case OP_PLACEMENT:
+      case OP_PLACEMENT_ANNOUNCE:
+      case OP_MIGRATE_PULL:
+      case OP_MIGRATE_PUSH:
       default: {
-        // HELLO, PEEK, SYNC, STATS, SAVE, ACQUIRE_MANY, unknown:
-        // Python decides (including the unknown-op error) — the wire
-        // module stays the single authority for every non-hot shape.
+        // Placement/migration control ops, HELLO, PEEK, SYNC, STATS,
+        // SAVE, ACQUIRE_MANY, unknown: Python decides (including the
+        // unknown-op error) — the wire module stays the single
+        // authority for every non-hot shape.
         Passthrough ptf;
         ptf.conn_id = c->id;
         ptf.frame.assign(reinterpret_cast<const char*>(body), len);
@@ -1198,7 +1211,12 @@ int fe_trace_harvest(void* h, uint64_t* out, int max) {
 
 // Complete a batch: encode one RESP_DECISION per item, write natively,
 // record serving latency (arrival -> completion, the same span the
-// asyncio server's histogram covers).
+// asyncio server's histogram covers). granted[i] == kRowSkip marks a
+// row Python already answered via fe_send (per-row placement error on
+// the batch lane — MOVED / handoff deferral); it gets no decision
+// reply, no tier-0 install, and no second requests_served count.
+constexpr uint8_t kRowSkip = 2;
+
 void fe_complete(void* h, long long batch_id, const uint8_t* granted,
                  const double* remaining) {
   Frontend* fe = static_cast<Frontend*>(h);
@@ -1210,6 +1228,10 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
   double exec_s = double(t - t_flush) * 1e-9;
   size_t i = 0;
   for (const Item& item : it->second.items) {
+    if (granted[i] == kRowSkip) {
+      i++;
+      continue;
+    }
     std::string resp =
         encode_decision(item.seq, granted[i] != 0, remaining[i]);
     auto itc = fe->conns.find(item.conn_id);
@@ -1267,6 +1289,11 @@ void fe_pt_copy(void* h, char* buf) {
   Frontend* fe = static_cast<Frontend*>(h);
   std::memcpy(buf, fe->cur_pt.frame.data(), fe->cur_pt.frame.size());
 }
+
+// Feature probe: this binary's fe_complete honors the kRowSkip
+// sentinel. Python falls back to deny-only gating without it (a stale
+// .so must never read the sentinel as "granted").
+int fe_has_row_skip(void) { return 1; }
 
 // Send a pre-encoded reply frame (passthrough responses).
 void fe_send(void* h, uint64_t conn_id, const char* data, int len) {

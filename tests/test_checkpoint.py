@@ -266,3 +266,40 @@ def test_v3_roundtrip_carries_checksum(tmp_path):
     load_snapshot(s2, path)  # round-trips clean
     assert s2.acquire_blocking("x", 6, 10.0, 1.0).granted
     assert not s2.acquire_blocking("x", 1, 10.0, 1.0).granted
+
+
+def test_placement_epoch_gate(tmp_path):
+    """Satellite: placement-versioned checkpoints. A rejoining node held
+    to the cluster's current epoch refuses a snapshot from a retired
+    one — typed (PlacementMismatchError, a SnapshotCorruptError so every
+    init-on-miss fallback already handles it) and BEFORE any state loads."""
+    from distributedratelimiting.redis_tpu.runtime.checkpoint import (
+        PlacementMismatchError,
+        SnapshotCorruptError,
+    )
+
+    clock = ManualClock()
+    s = InProcessBucketStore(clock=clock)
+    s.acquire_blocking("x", 4, 10.0, 1.0)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(s, path, placement_epoch=3)
+
+    # matching epoch restores clean
+    s2 = InProcessBucketStore(clock=clock)
+    load_snapshot(s2, path, expected_placement_epoch=3)
+    assert not s2.acquire_blocking("x", 7, 10.0, 1.0).granted
+
+    # mismatched epoch: typed refusal, store untouched
+    s3 = InProcessBucketStore(clock=clock)
+    with pytest.raises(PlacementMismatchError):
+        load_snapshot(s3, path, expected_placement_epoch=5)
+    assert s3.snapshot()["buckets"] == {}
+    assert issubclass(PlacementMismatchError, SnapshotCorruptError)
+
+    # a file with NO recorded epoch also fails an epoch expectation
+    save_snapshot(s, path)
+    with pytest.raises(PlacementMismatchError):
+        load_snapshot(InProcessBucketStore(), path,
+                      expected_placement_epoch=3)
+    # …but loads fine with no expectation (single-node deployments)
+    load_snapshot(InProcessBucketStore(clock=clock), path)

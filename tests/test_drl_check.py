@@ -23,6 +23,8 @@ from tools.drl_check import (
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 WIRE = ROOT / "distributedratelimiting" / "redis_tpu" / "runtime" / "wire.py"
+SERVER = (ROOT / "distributedratelimiting" / "redis_tpu" / "runtime"
+          / "server.py")
 NATIVE_PY = (ROOT / "distributedratelimiting" / "redis_tpu" / "utils"
              / "native.py")
 FRONTEND = ROOT / "native" / "frontend.cc"
@@ -329,6 +331,8 @@ def test_cli_exit_codes(tmp_path):
     (shim / "native").mkdir()
     (shim / "distributedratelimiting" / "redis_tpu" / "runtime"
      / "wire.py").write_text(WIRE.read_text())
+    (shim / "distributedratelimiting" / "redis_tpu" / "runtime"
+     / "server.py").write_text(SERVER.read_text())
     (shim / "distributedratelimiting" / "redis_tpu" / "utils"
      / "native.py").write_text(NATIVE_PY.read_text())
     (shim / "native" / "frontend.cc").write_text(
@@ -412,3 +416,37 @@ def test_swallowed_exception_suppressible():
                 pass
     """)
     assert concurrency_lint.check_source(src, RUNTIME_PATH) == []
+
+
+# -- seeded divergences: wire-dispatch ---------------------------------------
+
+def test_undispatched_op_fires_once(tmp_path):
+    """Satellite: every OP_* in wire.py must have a server dispatch
+    handler. An op constant nothing in server.py references fires
+    wire-dispatch exactly once, with file:line on both sides."""
+    mutated = tmp_path / "wire.py"
+    text = WIRE.read_text()
+    anchor = "OP_MIGRATE_PUSH = 17"
+    assert anchor in text, "fixture anchor gone from wire.py"
+    mutated.write_text(text.replace(
+        anchor, anchor + "\nOP_GHOST = 99", 1))
+    findings = wire_conformance.check_dispatch(mutated, SERVER, tmp_path)
+    assert [f.rule for f in findings] == ["wire-dispatch"]
+    f = findings[0]
+    assert "OP_GHOST" in f.message and "99" in f.message
+    assert f.file.endswith("wire.py")
+    assert any("server.py" in rf for rf, _, _ in f.related)
+
+
+def test_dispatch_covers_every_live_op():
+    """The live pair is clean AND non-vacuously so: the extractor sees
+    every op (including the round-6 placement/migration four) and the
+    server references each."""
+    assert wire_conformance.check_dispatch(WIRE, SERVER, ROOT) == []
+    refs = wire_conformance._server_op_references(SERVER)
+    py = wire_conformance.extract_py_model(WIRE)
+    ops = {n for n in py.constants if n.startswith("OP_")}
+    assert {"OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
+            "OP_MIGRATE_PUSH"} <= ops
+    assert ops <= set(refs)
+    assert len(ops) >= 17

@@ -1,0 +1,1114 @@
+"""Placement-versioned cluster data plane: the epoch map, the wire
+control ops, the server-side gate/handoff state, the store export/import
+lanes, and the cluster's membership API (runtime/placement.py,
+docs/DESIGN.md §12).
+
+Load-bearing invariants pinned here:
+
+- **Epoch-0 compatibility**: the initial map routes bit-identically to
+  the legacy ``crc32 % N`` for every N — adopting the map is not itself
+  a resharding event.
+- **Monotonic epochs**: stale announces are typed, routable errors;
+  re-announcing the current epoch is idempotent.
+- **Exactly-once handoff**: a re-delivered MIGRATE_PUSH batch applies
+  exactly once; a re-delivered PULL returns the cached (already
+  debited) export.
+- **The dual-ownership budget split**: the exported balance plus the
+  old owner's envelope can never exceed the original balance plus one
+  envelope.
+- **Auto-abort**: an expired handoff window (dead coordinator) reverts
+  the old owner to authoritative serving — no stranded keyspace.
+- **Rejoin debit** (satellite bugfix): degraded-envelope grants are
+  charged to the authoritative bucket when the node rejoins, not
+  silently discarded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.parallel.sharded_store import (
+    shard_of_key,
+)
+from distributedratelimiting.redis_tpu.runtime import placement, wire
+from distributedratelimiting.redis_tpu.runtime.cluster import (
+    ClusterBucketStore,
+    PlacementError,
+)
+from distributedratelimiting.redis_tpu.runtime.placement import (
+    NodePlacementState,
+    PlacementMap,
+    StalePlacementError,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils.resilience import BreakerConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+KEYS = ["hot", "alpha", "beta", "k" * 40, "\udc80bytes", "zeta", "t:9"]
+
+
+# -- the map ------------------------------------------------------------------
+
+class TestPlacementMap:
+    def test_initial_routing_matches_legacy_modulus(self):
+        for n in (1, 2, 3, 5, 8, 13):
+            m = PlacementMap.initial(n)
+            for k in KEYS:
+                assert m.node_of(k) == shard_of_key(k, n), (n, k)
+
+    def test_route_vectorized_matches_scalar_with_overrides(self):
+        m = PlacementMap.initial(3).with_assignments(
+            {0: 2}, set_overrides={"hot": 1})
+        assert m.node_of("hot") == 1
+        routed = m.route(list(KEYS))
+        assert routed.tolist() == [m.node_of(k) for k in KEYS]
+
+    def test_with_assignments_bumps_epoch_and_preserves_rest(self):
+        m = PlacementMap.initial(2)
+        m2 = m.with_assignments({3: 1})
+        assert m2.epoch == 1 and m.epoch == 0
+        assert int(m2.slot_owner[3]) == 1
+        changed = np.nonzero(m.slot_owner != m2.slot_owner)[0]
+        assert changed.tolist() in ([], [3])
+
+    def test_json_round_trip(self):
+        m = PlacementMap.initial(4).with_assignments(
+            {1: 0, 2: 3}, set_overrides={"hot": 2})
+        assert PlacementMap.from_json(m.to_json()) == m
+
+    def test_rebalance_moves_even_out_counts(self):
+        m = PlacementMap.initial(3)  # 48 slots
+        m2 = m.with_assignments(m.rebalance_moves([0, 1, 2, 3]))
+        assert sorted(m2.slot_counts(4).tolist()) == [12, 12, 12, 12]
+        # leave: node 1 out, its slots redistribute
+        m3 = m2.with_assignments(m2.rebalance_moves([0, 2, 3]))
+        counts = m3.slot_counts(4)
+        assert counts[1] == 0 and sorted(counts.tolist()) == [0, 16, 16, 16]
+
+    def test_rebalance_already_balanced_is_empty(self):
+        m = PlacementMap.initial(4)
+        assert m.rebalance_moves(list(range(4))) == {}
+
+
+# -- wire ops -----------------------------------------------------------------
+
+class TestPlacementWire:
+    def test_text_ops_round_trip(self):
+        payload = '{"target_epoch": 3, "slots": [1, 2]}'
+        for op in (wire.OP_PLACEMENT_ANNOUNCE, wire.OP_MIGRATE_PULL,
+                   wire.OP_MIGRATE_PUSH):
+            frame = wire.encode_request(9, op, payload)
+            seq, dop, text, count, a, b = wire.decode_request(frame[4:])
+            assert (seq, dop, text) == (9, op, payload)
+
+    def test_fetch_is_empty_payload(self):
+        frame = wire.encode_request(4, wire.OP_PLACEMENT)
+        seq, op, key, *_ = wire.decode_request(frame[4:])
+        assert (seq, op, key) == (4, wire.OP_PLACEMENT, "")
+
+    def test_oversized_control_payload_raises(self):
+        with pytest.raises(ValueError, match="MAX_FRAME"):
+            wire.encode_request(1, wire.OP_MIGRATE_PUSH,
+                                "x" * (wire.MAX_FRAME + 1))
+
+    def test_op_names(self):
+        assert wire.op_name(wire.OP_PLACEMENT) == "placement"
+        assert wire.op_name(wire.OP_MIGRATE_PUSH) == "migrate_push"
+
+
+# -- the node-side state ------------------------------------------------------
+
+def _announce(ps: NodePlacementState, m: PlacementMap, node_id: int) -> int:
+    return ps.announce({"map": m.to_dict(), "node_id": node_id})
+
+
+class TestNodePlacementState:
+    def test_announce_monotonic_idempotent_stale(self):
+        ps = NodePlacementState()
+        m = PlacementMap.initial(2)
+        assert not ps.active
+        assert _announce(ps, m, 0) == 0
+        assert ps.active and ps.node_id == 0
+        assert _announce(ps, m, 0) == 0  # idempotent
+        m2 = m.with_assignments({0: 1})
+        assert _announce(ps, m2, 0) == 1
+        with pytest.raises(StalePlacementError):
+            _announce(ps, m, 0)
+        assert ps.stale_announces == 1
+
+    def test_gate_serve_moved_and_override(self):
+        ps = NodePlacementState()
+        m = PlacementMap.initial(2)
+        own = m.node_of("hot")
+        _announce(ps, m, own)
+        assert ps.gate("hot") is None  # owned → serve
+        _announce(ps, m.with_assignments(
+            set_overrides={"hot": 1 - own}), own)
+        verdict = ps.gate("hot")
+        assert verdict == ("moved", 1 - own)
+        assert ps.moved_errors == 1
+
+    def test_pull_idempotent_and_envelope_debits_export(self):
+        async def main():
+            store = InProcessBucketStore()
+            await store.acquire("hot", 2, 40.0, 0.0)  # 38 tokens left
+            ps = NodePlacementState()
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            slot = m.slot_of("hot")
+            req = {"target_epoch": 1, "slots": [slot], "window_s": 30.0}
+            out = await ps.pull(req, store)
+            assert out["cached"] is False
+            budget = headroom_budget(40.0, fraction=0.5, min_budget=1.0)
+            row = [r for r in out["entries"]["buckets"]
+                   if r[0] == "hot"][0]
+            assert row[3] == pytest.approx(38.0 - budget)
+            again = await ps.pull(req, store)
+            assert again["cached"] is True
+            assert again["entries"] == out["entries"]
+            assert ps.pulls == 1
+            # parked: admission serves the envelope, bounded by budget
+            grants = 0
+            for _ in range(int(budget) + 10):
+                verdict = ps.gate("hot")
+                assert verdict is not None and verdict[0] == "envelope"
+                granted, _rem = ps.envelope_acquire(
+                    verdict[1], "hot", 1, 40.0, 0.0, "bucket")
+                grants += granted
+            assert grants == int(budget)
+
+        run(main())
+
+    def test_push_applies_exactly_once(self):
+        async def main():
+            store = InProcessBucketStore()
+            entries = {"buckets": [["hot", 40.0, 0.0, 15.0, 0]]}
+            ps = NodePlacementState()
+            req = {"target_epoch": 1, "batch": 7, "entries": entries}
+            assert await ps.push(req, store) == 1
+            # re-delivered batch: counted no-op, state untouched
+            assert await ps.push(req, store) == 0
+            assert ps.pushes_duplicate == 1
+            assert store._buckets[("hot", 40.0, 0.0)][0] == 15.0
+            # a different batch id applies
+            assert await ps.push({"target_epoch": 1, "batch": 8,
+                                  "entries": {"buckets": [
+                                      ["cold", 10.0, 1.0, 3.0, 0]]}},
+                                 store) == 1
+
+        run(main())
+
+    def test_abort_resets_push_ledger(self):
+        """Regression (round-6 review): a retried migration REUSES the
+        aborted target epoch, so the abort must clear the exactly-once
+        ledger — deduping attempt 2's batches against attempt 1 would
+        silently drop re-pushed state (over-admission via init-on-miss);
+        re-applying is merely conservative."""
+
+        async def main():
+            store = InProcessBucketStore()
+            ps = NodePlacementState()
+            req = {"target_epoch": 1, "batch": 7,
+                   "entries": {"buckets": [["hot", 40.0, 0.0, 15.0, 0]]}}
+            assert await ps.push(req, store) == 1
+            ps.announce({"abort_epoch": 1})
+            retry = {"target_epoch": 1, "batch": 7,
+                     "entries": {"buckets": [["hot", 40.0, 0.0, 12.0, 0]]}}
+            assert await ps.push(retry, store) == 1
+            assert ps.pushes_duplicate == 0
+            # the re-apply stayed conservative: never above attempt 1
+            assert store._buckets[("hot", 40.0, 0.0)][0] <= 15.0
+
+        run(main())
+
+    def test_concurrent_duplicate_pull_debits_source_once(self):
+        """Regression (round-6 review): pull's idempotency check spans
+        an await (the off-thread export), so an in-flight duplicate — a
+        post-send retry racing the original — used to run a SECOND
+        export + source debit. The control lock makes the second caller
+        wait and hit the cache."""
+
+        async def main():
+            store = InProcessBucketStore()
+            await store.acquire("hot", 2, 40.0, 0.0)  # 38 left
+            ps = NodePlacementState()
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            req = {"target_epoch": 1, "slots": [m.slot_of("hot")],
+                   "window_s": 30.0}
+            a, b = await asyncio.gather(ps.pull(req, store),
+                                        ps.pull(req, store))
+            assert ps.pulls == 1
+            assert sorted([a["cached"], b["cached"]]) == [False, True]
+            assert a["entries"] == b["entries"]
+            budget = headroom_budget(40.0, fraction=0.5, min_budget=1.0)
+            # debited exactly once: the source holds ONE envelope, not
+            # the twice-debited floor
+            assert (store._buckets[("hot", 40.0, 0.0)][0]
+                    == pytest.approx(budget))
+
+        run(main())
+
+    def test_expired_abort_tombstones_pull_until_abort_announce(self):
+        """Regression (round-7 review): OP_MIGRATE_PULL is post-send
+        retry-safe only while the cached export lives. If the handoff
+        window expires (auto-abort — coordinator presumed dead) between
+        the original pull and its wire-level retry, a silent re-export
+        would debit the source a SECOND envelope. The late retry must
+        hit a typed refusal; the coordinator's abort announce re-arms
+        the epoch for the deliberate retry-same-epoch path."""
+
+        async def main():
+            now = [0.0]
+            store = InProcessBucketStore()
+            await store.acquire("hot", 2, 40.0, 0.0)  # 38 left
+            ps = NodePlacementState(clock=lambda: now[0])
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            req = {"target_epoch": 1, "slots": [m.slot_of("hot")],
+                   "window_s": 2.0}
+            await ps.pull(req, store)
+            budget = headroom_budget(40.0, fraction=0.5, min_budget=1.0)
+            assert (store._buckets[("hot", 40.0, 0.0)][0]
+                    == pytest.approx(budget))
+            now[0] = 3.0  # window expired: serving path auto-aborts
+            assert ps.gate("hot") is None  # authoritative again
+            assert ps.expired_aborts == 1
+            # The late wire retry of the original pull: typed refusal,
+            # NOT a second export + debit.
+            with pytest.raises(PlacementError,
+                               match="aborted on this node"):
+                await ps.pull(req, store)
+            assert (store._buckets[("hot", 40.0, 0.0)][0]
+                    == pytest.approx(budget))  # still ONE envelope
+            assert ps.pulls == 1
+            # Coordinator acknowledges the abort → a deliberate retry
+            # of the SAME target epoch works again (and knowingly
+            # charges the documented second envelope).
+            ps.announce({"abort_epoch": 1})
+            out = await ps.pull(req, store)
+            assert out["cached"] is False
+            assert ps.pulls == 2
+
+        run(main())
+
+    def test_pull_pages_large_export(self, monkeypatch):
+        """A pull whose export outgrows one frame pages: the reply
+        carries one chunk + the total page count, later pages come from
+        the handoff cache, and the reassembled pages equal the full
+        export. Out-of-range pages are typed errors."""
+
+        async def main():
+            monkeypatch.setattr(placement, "_CHUNK_BYTE_BUDGET", 150)
+            store = InProcessBucketStore()
+            for i in range(6):
+                await store.acquire(f"k{i}", 1, 40.0, 0.0)
+            ps = NodePlacementState()
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            req = {"target_epoch": 1,
+                   "slots": list(range(m.n_slots)), "window_s": 30.0}
+            first = await ps.pull(req, store)
+            assert first["pages"] > 1
+            assert ps.pulls == 1  # later pages never re-park/re-debit
+            entries = first["entries"]
+            for page in range(1, first["pages"]):
+                more = await ps.pull({**req, "page": page}, store)
+                assert more["cached"] is True
+                assert more["pages"] == first["pages"]
+                entries = placement.merge_entries(entries,
+                                                  more["entries"])
+            assert ps.pulls == 1
+            assert ({r[0] for r in entries["buckets"]}
+                    == {f"k{i}" for i in range(6)})
+            with pytest.raises(PlacementError):
+                await ps.pull({**req, "page": first["pages"]}, store)
+
+        run(main())
+
+    def test_pull_unions_slots_and_keys_override_independent(self):
+        """Regression (caught by the round-6 drive): a drain that moves
+        a node's slots AND an override pinned there must export BOTH —
+        and a slot move must never drag along a key pinned elsewhere."""
+
+        async def main():
+            store = InProcessBucketStore()
+            await store.acquire("hot", 1, 40.0, 0.0)     # override, here
+            await store.acquire("alpha", 1, 40.0, 0.0)   # slot member
+            ps = NodePlacementState()
+            m = PlacementMap.initial(1).with_assignments(
+                set_overrides={"hot": 0, "elsewhere": 1})
+            _announce(ps, m, 0)
+            slots = sorted({m.slot_of("alpha"), m.slot_of("hot"),
+                            m.slot_of("elsewhere")})
+            out = await ps.pull({"target_epoch": 2, "slots": slots,
+                                 "keys": ["hot"], "window_s": 30.0},
+                                store)
+            exported = {r[0] for r in out["entries"]["buckets"]}
+            assert exported == {"hot", "alpha"}
+            # 'elsewhere' is pinned to another node: its slot moving
+            # must not export it even if it had state here.
+
+        run(main())
+
+    def test_expired_window_auto_aborts_to_authoritative(self):
+        async def main():
+            t = [0.0]
+            ps = NodePlacementState(clock=lambda: t[0])
+            store = InProcessBucketStore()
+            await store.acquire("hot", 1, 40.0, 0.0)
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            await ps.pull({"target_epoch": 1,
+                           "slots": [m.slot_of("hot")],
+                           "window_s": 2.0}, store)
+            assert ps.gate("hot")[0] == "envelope"
+            t[0] = 5.0  # the commit never came
+            assert ps.gate("hot") is None  # authoritative again
+            assert ps.expired_aborts == 1
+
+        run(main())
+
+    def test_pull_debits_source_store_to_envelope(self):
+        """The expiry-race bound: at pull time the source's OWN store is
+        charged for the shipped amount, so its authoritative residual is
+        exactly the envelope. Even if the handoff expires after a slow
+        commit already announced the target epoch to the destinations,
+        old (residual) + new (shipped) can never exceed the original
+        balance plus one envelope."""
+
+        async def main():
+            t = [0.0]
+            ps = NodePlacementState(clock=lambda: t[0])
+            store = InProcessBucketStore()
+            await store.acquire("hot", 2, 40.0, 0.0)  # 38 tokens left
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            out = await ps.pull({"target_epoch": 1,
+                                 "slots": [m.slot_of("hot")],
+                                 "window_s": 2.0}, store)
+            budget = headroom_budget(40.0, fraction=0.5, min_budget=1.0)
+            shipped = [r for r in out["entries"]["buckets"]
+                       if r[0] == "hot"][0][3]
+            residual = store._buckets[("hot", 40.0, 0.0)][0]
+            assert shipped == pytest.approx(38.0 - budget)
+            assert residual == pytest.approx(budget)
+            # expiry-abort resumes authoritative serving from the
+            # residual — shipped + residual == the original balance.
+            t[0] = 5.0
+            assert ps.gate("hot") is None
+            assert shipped + residual == pytest.approx(38.0)
+
+        run(main())
+
+    def test_announce_conflicting_same_epoch_map_raises(self):
+        """Two coordinators racing to the same target epoch with
+        different maps must not split-brain: the second, conflicting
+        announce loses loudly (re-announcing the adopted map itself
+        stays idempotent)."""
+        ps = NodePlacementState()
+        m = PlacementMap.initial(2)
+        _announce(ps, m, 0)
+        target = m.with_assignments({0: 1})
+        _announce(ps, target, 0)
+        twin = m.with_assignments({1: 0})  # same epoch, different map
+        with pytest.raises(StalePlacementError):
+            ps.announce({"map": twin.to_dict(), "node_id": 0})
+        assert ps.epoch == target.epoch
+        assert ps.pmap == target
+        # the adopted map re-announced is still an idempotent no-op
+        assert ps.announce({"map": target.to_dict(),
+                            "node_id": 0}) == target.epoch
+
+    def test_commit_drops_parked_and_answers_moved(self):
+        async def main():
+            ps = NodePlacementState()
+            store = InProcessBucketStore()
+            await store.acquire("hot", 1, 40.0, 0.0)
+            m = PlacementMap.initial(2)
+            own = m.node_of("hot")
+            _announce(ps, m, own)
+            slot = m.slot_of("hot")
+            target = m.with_assignments({slot: 1 - own})
+            await ps.pull({"target_epoch": target.epoch,
+                           "slots": [slot], "window_s": 30.0}, store)
+            _announce(ps, target, own)  # commit
+            verdict = ps.gate("hot")
+            assert verdict == ("moved", 1 - own)
+
+        run(main())
+
+    def test_abort_announce_unparks(self):
+        async def main():
+            ps = NodePlacementState()
+            store = InProcessBucketStore()
+            m = PlacementMap.initial(1)
+            _announce(ps, m, 0)
+            await ps.pull({"target_epoch": 1, "slots": [m.slot_of("hot")],
+                           "window_s": 30.0}, store)
+            assert ps.gate("hot")[0] == "envelope"
+            ps.announce({"abort_epoch": 1})
+            assert ps.gate("hot") is None
+            assert ps.aborts == 1
+
+        run(main())
+
+    def test_bulk_gate_fast_path_and_masks(self):
+        async def main():
+            ps = NodePlacementState()
+            m = PlacementMap.initial(2)
+            own = m.node_of("alpha")
+            _announce(ps, m, own)
+            mine = [k for k in KEYS if m.node_of(k) == own]
+            assert ps.bulk_gate(mine) is None  # all owned → fast path
+            g = ps.bulk_gate(list(KEYS))
+            assert g is not None
+            serve_mask, env_rows, moved_mask = g
+            for i, k in enumerate(KEYS):
+                assert serve_mask[i] == (m.node_of(k) == own)
+                assert moved_mask[i] == (m.node_of(k) != own)
+            assert env_rows == []
+
+        run(main())
+
+
+# -- store export/import lanes ------------------------------------------------
+
+class TestStateLanes:
+    async def _seeded_store(self):
+        s = InProcessBucketStore()
+        await s.acquire("hot", 5, 50.0, 1.0)
+        await s.acquire("cold", 1, 10.0, 2.0)
+        await s.window_acquire("w", 3, 20.0, 10.0)
+        await s.fixed_window_acquire("f", 2, 9.0, 5.0)
+        await s.sync_counter("ctr", 4.0, 1.0)
+        await s.concurrency_acquire("sem", 2, 8)
+        return s
+
+    def test_export_filters_by_predicate(self):
+        s = run(self._seeded_store())
+        entries = s.export_entries(lambda k: k in ("hot", "w", "ctr"))
+        assert [r[0] for r in entries["buckets"]] == ["hot"]
+        assert [r[0] for r in entries["windows"]] == ["w"]
+        assert [r[0] for r in entries["counters"]] == ["ctr"]
+        assert entries["semas"] == []
+
+    def test_exact_lane_round_trip(self):
+        async def main():
+            src = await self._seeded_store()
+            entries = src.export_entries(lambda k: True)
+            dst = InProcessBucketStore()
+            n = await placement.import_entries(dst, entries)
+            assert n == placement.entry_count(entries)
+            assert dst._buckets[("hot", 50.0, 1.0)][0] == pytest.approx(
+                45.0, abs=1.0)
+            assert dst._semas["sem"] == 2
+            # idempotent-conservative: re-import never inflates
+            await placement.import_entries(dst, entries)
+            assert dst._buckets[("hot", 50.0, 1.0)][0] <= 45.0
+
+        run(main())
+
+    def test_generic_lane_uses_debit_kernel(self):
+        async def main():
+            src = await self._seeded_store()
+            entries = src.export_entries(lambda k: True)
+
+            class NoExact(InProcessBucketStore):
+                import_entries = None  # force the generic replay lane
+
+            dst = NoExact()
+            await placement.import_entries(dst, entries)
+            # debit lane lands the bucket balance exactly
+            assert dst._buckets[("hot", 50.0, 1.0)][0] == pytest.approx(
+                45.0, abs=1.0)
+            # current-window usage replays (conservative direction)
+            res = await dst.window_acquire("w", 18, 20.0, 10.0)
+            assert not res.granted  # 3 already charged
+
+        run(main())
+
+    def test_unknown_snapshot_schema_fails_loudly(self):
+        with pytest.raises(ValueError, match="snapshot schema"):
+            placement.extract_entries({"now_ticks": 0, "weird": {}},
+                                      lambda k: True)
+
+    def test_chunk_and_split(self):
+        entries = {"buckets": [[f"k{i}", 1.0, 1.0, 1.0, 0]
+                               for i in range(10)]}
+        chunks = placement.chunk_entries(entries, max_rows=4)
+        assert [placement.entry_count(c) for c in chunks] == [4, 4, 2]
+        split = placement.split_entries(entries,
+                                        lambda k: int(k[1:]) % 3)
+        assert sorted(split) == [0, 1, 2]
+        assert sum(placement.entry_count(s)
+                   for s in split.values()) == 10
+
+    def test_chunk_sizes_keys_as_serialized(self):
+        """Regression (round-6 review): chunk sizing must count the
+        JSON-escaped key length, not characters — ensure_ascii expands
+        every non-ASCII / surrogate-escaped char to a 6-byte \\uXXXX
+        escape, so a 60 KiB hostile key serializes ~6x its character
+        count and a character-counted chunk could exceed MAX_FRAME
+        (wedging the migration on every retry)."""
+        import json
+
+        hostile = "\udc80é" * 30_000  # 60k chars, ~420KB escaped
+        entries = {"buckets": [[hostile + str(i), 1.0, 1.0, 1.0, 0]
+                               for i in range(6)]}
+        chunks = placement.chunk_entries(entries)
+        assert len(chunks) > 1  # character-counting packed all 6
+        for c in chunks:
+            assert len(json.dumps(c)) < wire.MAX_FRAME
+
+
+# -- cluster integration ------------------------------------------------------
+
+class FlakyNode(InProcessBucketStore):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.fail = False
+
+    async def acquire(self, *a, **kw):
+        if self.fail:
+            raise ConnectionError("injected node outage")
+        return await super().acquire(*a, **kw)
+
+
+class TestClusterPlacement:
+    def test_default_routing_identical_to_legacy(self):
+        async def main():
+            nodes = [InProcessBucketStore() for _ in range(3)]
+            cluster = ClusterBucketStore(stores=nodes)
+            for k in KEYS:
+                assert cluster.node_index_of(k) == shard_of_key(k, 3)
+            assert cluster.node_of(KEYS[0]) is nodes[shard_of_key(KEYS[0], 3)]
+            await cluster.aclose()
+
+        run(main())
+
+    def test_in_process_membership_and_state_move(self):
+        async def main():
+            nodes = [InProcessBucketStore() for _ in range(2)]
+            cluster = ClusterBucketStore(stores=nodes)
+            for _ in range(5):
+                await cluster.acquire("hot", 1, 50.0, 0.0)
+            joined = await cluster.add_node(InProcessBucketStore())
+            assert joined == 2 and cluster.placement.epoch == 1
+            counts = cluster.placement.slot_counts(3)
+            assert counts.min() >= 10  # 32 slots over 3 nodes
+            # admission state followed any moved keys (in-process lane
+            # has no envelope: balances move exactly)
+            r = await cluster.acquire("hot", 1, 50.0, 0.0)
+            assert r.remaining == pytest.approx(44.0, abs=1.0)
+            await cluster.drain_node(0)
+            assert cluster.placement.slot_counts(3)[0] == 0
+            r = await cluster.acquire("hot", 1, 50.0, 0.0)
+            assert r.remaining == pytest.approx(43.0, abs=1.0)
+            assert [e["type"] for e in cluster.migration_log] == \
+                ["commit", "commit"]
+            await cluster.aclose()
+
+        run(main())
+
+    def test_in_process_pull_drains_source_exactly(self):
+        """The in-process lane ships balances exactly AND drains the
+        source in the same breath — a task interleaving between the
+        pull and the commit cannot spend a balance the new owner
+        already received."""
+
+        async def main():
+            nodes = [InProcessBucketStore() for _ in range(2)]
+            cluster = ClusterBucketStore(stores=nodes)
+            await cluster.acquire("hot", 5, 50.0, 0.0)  # 45 left
+            src = cluster.node_index_of("hot")
+            await cluster.drain_node(src)
+            drained = nodes[src]._buckets.get(("hot", 50.0, 0.0))
+            assert drained is not None and drained[0] == pytest.approx(0.0)
+            r = await cluster.acquire("hot", 1, 50.0, 0.0)
+            assert r.remaining == pytest.approx(44.0)
+            await cluster.aclose()
+
+        run(main())
+
+    def test_handoff_deferral_does_not_advance_breaker(self):
+        """A parked-key deferral is a HEALTHY node mid-handoff: it must
+        not advance the node's circuit breaker (a trip would quarantine
+        the node's entire keyspace as a side effect of a routine
+        migration)."""
+
+        async def main():
+            nodes = [InProcessBucketStore() for _ in range(2)]
+            cluster = ClusterBucketStore(
+                stores=nodes,
+                breaker=BreakerConfig(failure_threshold=1,
+                                      recovery_timeout_s=60.0))
+
+            async def deferred():
+                raise wire.RemoteStoreError(
+                    placement.HANDOFF_DEFERRAL_PREFIX
+                    + " for this key (target epoch 3); retry shortly")
+
+            with pytest.raises(wire.RemoteStoreError):
+                await cluster._guarded_call(0, deferred)
+            assert cluster._breakers[0].allow() == "allow"
+            r = await cluster.acquire("hot", 1, 10.0, 1.0)
+            assert r.granted
+            await cluster.aclose()
+
+        run(main())
+
+    def test_bulk_moved_settles_half_open_probe(self):
+        """Regression (round-6 review): the bulk fan-out's MOVED branch
+        must settle a half-open breaker probe as a success, like the
+        scalar lane — a healthy node answering 'placement moved' to a
+        stale bulk frame used to leak the probe slot and quarantine the
+        node's keyspace for a full recovery window."""
+
+        async def main():
+            class MovedBulkNode(FlakyNode):
+                moved_bulk = False
+
+                async def acquire_many(self, keys, counts, *a, **kw):
+                    if self.moved_bulk:
+                        raise wire.RemoteStoreError(
+                            placement.MOVED_ERROR_PREFIX
+                            + ": key routes to node 1 at epoch 1")
+                    return await super().acquire_many(keys, counts,
+                                                      *a, **kw)
+
+            nodes = [MovedBulkNode(), InProcessBucketStore()]
+            cluster = ClusterBucketStore(
+                stores=nodes, partial_failures="deny",
+                breaker=BreakerConfig(failure_threshold=1,
+                                      recovery_timeout_s=0.05))
+            key = next(k for k in KEYS if cluster.node_index_of(k) == 0)
+            # open the breaker, then age into HALF_OPEN
+            nodes[0].fail = True
+            with pytest.raises(ConnectionError):
+                await cluster.acquire(key, 1, 10.0, 1.0)
+            nodes[0].fail = False
+            await asyncio.sleep(0.06)
+            # the probe-winning request is a bulk frame answered MOVED
+            nodes[0].moved_bulk = True
+            res = await cluster.acquire_many([key], [1], 10.0, 1.0)
+            assert not res.granted[0]  # rows follow partial_failures
+            # the node is healthy: probe settled, breaker re-closed
+            assert cluster._breakers[0].allow() == "allow"
+            nodes[0].moved_bulk = False
+            r = await cluster.acquire(key, 1, 10.0, 1.0)
+            assert r.granted
+            await cluster.aclose()
+
+        run(main())
+
+    def test_health_gate_blocks_unfit_owner(self):
+        async def main():
+            nodes = [InProcessBucketStore(), FlakyNode()]
+            cluster = ClusterBucketStore(
+                stores=nodes,
+                breaker=BreakerConfig(failure_threshold=1,
+                                      recovery_timeout_s=60.0))
+            nodes[1].fail = True
+            key = next(k for k in KEYS if cluster.node_index_of(k) == 1)
+            with pytest.raises(ConnectionError):
+                await cluster.acquire(key, 1, 10.0, 1.0)
+            # node 1's breaker is open → it cannot take ownership:
+            # draining node 0 (whose slots would land on 1) must abort
+            # cleanly at the health gate, epoch unchanged.
+            with pytest.raises(PlacementError):
+                await cluster.drain_node(0)
+            assert cluster.migration_aborts == 1
+            assert cluster.placement.epoch == 0
+            assert cluster.migration_log[-1]["type"] == "abort"
+            await cluster.aclose()
+
+        run(main())
+
+    def test_drain_last_active_node_refused(self):
+        async def main():
+            cluster = ClusterBucketStore(stores=[InProcessBucketStore()])
+            with pytest.raises(PlacementError):
+                await cluster.drain_node(0)
+            await cluster.aclose()
+
+        run(main())
+
+    def test_rejoin_debit_reconciles_degraded_grants(self):
+        """Satellite bugfix: grants served by the degraded envelope
+        during an outage are debited against the node's buckets on
+        rejoin — not silently discarded."""
+
+        async def main():
+            cap = 40.0
+            nodes = [FlakyNode(), FlakyNode()]
+            cluster = ClusterBucketStore(
+                stores=nodes,
+                breaker=BreakerConfig(failure_threshold=2,
+                                      recovery_timeout_s=0.1),
+                degraded_fallback=True, degraded_fraction=0.5)
+            j = cluster.node_index_of("hot")
+            nodes[j].fail = True
+            grants = 0
+            for _ in range(10):
+                res = await cluster.acquire("hot", 1, cap, 0.0)
+                grants += res.granted
+            assert grants > 0
+            assert cluster.degraded_decisions >= grants
+            nodes[j].fail = False
+            await asyncio.sleep(0.15)
+            # next call probes, re-closes the breaker, and schedules the
+            # rejoin debit
+            await cluster.acquire("hot", 1, cap, 0.0)
+            for _ in range(50):
+                if cluster.rejoin_debits:
+                    break
+                await asyncio.sleep(0.01)
+            assert cluster.rejoin_debits >= 1
+            # the authoritative bucket was charged for the outage grants
+            tokens = nodes[j]._buckets[("hot", cap, 0.0)][0]
+            assert tokens <= cap - grants - 1  # -1: the probe-winning call
+            st = await cluster.stats()
+            assert st["resilience"]["rejoin_debits"] >= 1
+            assert st["resilience"]["degraded_keys"] == 0
+            await cluster.aclose()
+
+        run(main())
+
+    def test_degraded_grants_ledger_batch_eviction(self, monkeypatch):
+        """The grants-ledger cap sheds the smallest debts in one
+        amortized batch (review finding, round 7): a per-insert min()
+        scan of a 128K-entry dict would turn the degraded fallback into
+        an O(n) hotspot on exactly the path meant to keep serving while
+        a node is down. Semantics preserved: the bound holds and the
+        LARGEST debts (most unaccounted admission) survive for the
+        rejoin debit."""
+        from distributedratelimiting.redis_tpu.runtime.cluster import (
+            _DegradedKeyspace,
+        )
+
+        monkeypatch.setattr(_DegradedKeyspace, "_MAX_KEYS", 16)
+        monkeypatch.setattr(_DegradedKeyspace, "_EVICT_BATCH", 8)
+        dk = _DegradedKeyspace(fraction=1.0)
+        cap_entries = 2 * 16
+        big = {f"big{i}" for i in range(8)}
+        for k in sorted(big):
+            assert dk.acquire(0, k, 5, 100.0, 0.0).granted
+        i = 0
+        while len(dk._grants) < cap_entries:
+            assert dk.acquire(0, f"small{i}", 1, 100.0, 0.0).granted
+            i += 1
+        # The insert that hits the cap evicts one BATCH of the smallest
+        # debts, not one entry — and every big debt survives it.
+        dk.acquire(0, "overflow", 1, 100.0, 0.0)
+        assert len(dk._grants) == cap_entries - 8 + 1
+        survivors = {k[1] for k in dk._grants}
+        assert big <= survivors
+        # The ledger stays bounded under continued pressure.
+        for j in range(64):
+            dk.acquire(0, f"more{j}", 1, 100.0, 0.0)
+        assert len(dk._grants) <= cap_entries
+        drained = dict((row[0], row[4]) for row in dk.drain_node(0))
+        for k in big:
+            assert drained[k] == pytest.approx(5.0)
+
+    def test_moved_error_refresh_and_retry_over_tcp(self):
+        """A client whose map is stale chases exactly one MOVED redirect:
+        refetch from the fleet, re-route, serve."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(2)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            coordinator = ClusterBucketStore(
+                addresses=[(s.host, s.port) for s in servers],
+                coalesce_requests=False)
+            follower = ClusterBucketStore(
+                addresses=[(s.host, s.port) for s in servers],
+                coalesce_requests=False)
+            try:
+                await coordinator.acquire("hot", 1, 50.0, 0.0)
+                slot = coordinator.placement.slot_of("hot")
+                own = coordinator.node_index_of("hot")
+                target = coordinator.placement.with_assignments(
+                    {slot: 1 - own})
+                await coordinator._apply_placement(
+                    target, {slot: 1 - own}, reason="test-move")
+                # the follower still holds epoch 0 → routes to the old
+                # owner → gets MOVED → refreshes → serves
+                res = await follower.acquire("hot", 1, 50.0, 0.0)
+                assert res.granted
+                assert follower.placement.epoch == target.epoch
+            finally:
+                await coordinator.aclose()
+                await follower.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_submitter_chases_moved_over_tcp(self):
+        """Regression (round-6 review): the non-resilient hoisted
+        submitter lane must chase a MOVED exactly like _routed — a
+        stale-mapped submitter would otherwise fail every call for a
+        migrated key forever."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(2)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            coordinator = ClusterBucketStore(addresses=addrs,
+                                             coalesce_requests=False)
+            follower = ClusterBucketStore(addresses=addrs,
+                                          coalesce_requests=False)
+            try:
+                assert not follower._resilient  # the fast lane under test
+                submit = follower.acquire_submitter(50.0, 0.0)
+                assert (await submit("hot", 1)).granted
+                slot = coordinator.placement.slot_of("hot")
+                own = coordinator.node_index_of("hot")
+                target = coordinator.placement.with_assignments(
+                    {slot: 1 - own})
+                await coordinator._apply_placement(
+                    target, {slot: 1 - own}, reason="test-move")
+                res = await submit("hot", 1)
+                assert res.granted
+                assert follower.placement.epoch == target.epoch
+            finally:
+                await coordinator.aclose()
+                await follower.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_drain_pages_oversized_export_over_tcp(self):
+        """Regression (round-6 review): an export bigger than MAX_FRAME
+        must not wedge the drain — the pull pages, and every migrated
+        balance still lands exactly (minus the one envelope debit)."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(2)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            cluster = ClusterBucketStore(
+                addresses=[(s.host, s.port) for s in servers],
+                coalesce_requests=False)
+            try:
+                # ~20 × 60 KiB keys ≈ 1.2 MiB of export JSON > MAX_FRAME
+                keys = [f"K{i:02d}" + "x" * 60_000 for i in range(20)]
+                for k in keys:
+                    assert (await cluster.acquire(k, 1, 40.0, 0.0)).granted
+                moved = [k for k in keys
+                         if cluster.node_index_of(k) == 0]
+                assert moved  # the drained node held some of them
+                await cluster.drain_node(0)
+                budget = headroom_budget(40.0, fraction=0.5,
+                                         min_budget=1.0)
+                for k in keys:
+                    want = (40.0 - 1 - budget) if k in moved else 39.0
+                    got = backings[1]._buckets[(k, 40.0, 0.0)][0]
+                    assert got == pytest.approx(want), k[:8]
+            finally:
+                await cluster.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_metrics_carry_placement_families(self):
+        async def main():
+            cluster = ClusterBucketStore(
+                stores=[InProcessBucketStore(),
+                        InProcessBucketStore()])
+            await cluster.add_node(InProcessBucketStore())
+            text = cluster.metrics_registry().render()
+            assert "drl_cluster_placement_epoch 1" in text
+            assert "drl_cluster_migrations_total 1" in text
+            assert "drl_cluster_migration_aborts_total 0" in text
+            assert "drl_cluster_rejoin_debits_total 0" in text
+            await cluster.aclose()
+
+        run(main())
+
+
+class TestServerPlacementSurface:
+    def test_stats_and_metrics_expose_placement(self):
+        async def main():
+            backing = InProcessBucketStore()
+            async with BucketStoreServer(backing) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    st = await client.stats()
+                    assert "placement" not in st  # dormant until announced
+                    m = PlacementMap.initial(1)
+                    await client.placement_announce(
+                        {"map": m.to_dict(), "node_id": 0})
+                    st = await client.stats()
+                    assert st["placement"]["epoch"] == 0
+                    assert st["placement"]["owned_slots"] == m.n_slots
+                    text = await client.metrics()
+                    assert "drl_placement_epoch 0" in text
+                finally:
+                    await client.aclose()
+
+        run(main())
+
+    def test_bulk_lane_respects_gate(self):
+        async def main():
+            backing = InProcessBucketStore()
+            async with BucketStoreServer(backing) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    m = PlacementMap.initial(2)
+                    own = 0
+                    await client.placement_announce(
+                        {"map": m.to_dict(), "node_id": own})
+                    keys = list(KEYS)
+                    # A frame with ANY misrouted row answers a routable
+                    # frame-level moved error (all-or-error: no row is
+                    # applied) — the only refresh trigger a bulk-only
+                    # client has; silent denial would strand its stale
+                    # map forever.
+                    with pytest.raises(wire.RemoteStoreError,
+                                       match="placement moved"):
+                        await client.acquire_many(
+                            keys, [1] * len(keys), 100.0, 1.0)
+                    for k in keys:  # no row touched the store
+                        assert all(bk[0] != k for bk in backing._buckets)
+                    # A correctly-routed frame (owned rows only) serves.
+                    mine = [k for k in keys if m.node_of(k) == own]
+                    res = await client.acquire_many(
+                        mine, [1] * len(mine), 100.0, 1.0)
+                    assert all(res.granted)
+                finally:
+                    await client.aclose()
+
+        run(main())
+
+    def test_native_frontend_batch_lane_respects_gate(self):
+        """The C batch fast lane must honor keyspace ownership exactly
+        like the asyncio lanes (review finding, round 6): misrouted hot
+        scalar ops answer the routable MOVED error (per-row, via the
+        kRowSkip fe_send lane) — never authoritatively admitted by a
+        non-owner, and never silently denied (a stale client needs the
+        error to converge its map)."""
+        from distributedratelimiting.redis_tpu.utils.native import (
+            load_frontend_lib,
+        )
+
+        if load_frontend_lib() is None:
+            pytest.skip("native front-end library unavailable")
+
+        async def main():
+            backing = InProcessBucketStore()
+            async with BucketStoreServer(backing,
+                                         native_frontend=True) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    m = PlacementMap.initial(2)
+                    await client.placement_announce(
+                        {"map": m.to_dict(), "node_id": 0})
+                    mine = next(k for k in KEYS if m.node_of(k) == 0)
+                    foreign = next(k for k in KEYS if m.node_of(k) == 1)
+                    res = await client.acquire(mine, 1, 100.0, 1.0)
+                    assert res.granted
+                    with pytest.raises(wire.RemoteStoreError,
+                                       match="placement moved"):
+                        await client.acquire(foreign, 1, 100.0, 1.0)
+                    assert all(bk[0] != foreign
+                               for bk in backing._buckets)
+                finally:
+                    await client.aclose()
+
+        run(main())
+
+    def test_native_frontend_parked_sema_release_defers(self):
+        """A SEMA release for a parked key on the C batch lane must NOT
+        be swallowed as a denial (the permit would leak for the
+        migrated semaphore's lifetime): it answers the same transient
+        handoff-deferral error as the asyncio lane, and succeeds once
+        the handoff aborts/commits."""
+        from distributedratelimiting.redis_tpu.utils.native import (
+            load_frontend_lib,
+        )
+
+        if load_frontend_lib() is None:
+            pytest.skip("native front-end library unavailable")
+
+        async def main():
+            backing = InProcessBucketStore()
+            async with BucketStoreServer(backing,
+                                         native_frontend=True) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    m = PlacementMap.initial(1)
+                    await client.placement_announce(
+                        {"map": m.to_dict(), "node_id": 0})
+                    key = "sema-key"
+                    res = await client.concurrency_acquire(key, 1, 1)
+                    assert res.granted
+                    # Park the key's slot: a pull for a pending epoch.
+                    await client.migrate_pull(
+                        {"target_epoch": 1,
+                         "slots": [m.slot_of(key)],
+                         "window_s": 30.0})
+                    with pytest.raises(
+                            wire.RemoteStoreError,
+                            match=placement.HANDOFF_DEFERRAL_PREFIX):
+                        await client.concurrency_release(key, 1)
+                    # Abort the handoff: the release now lands and the
+                    # permit is actually returned (a second acquire at
+                    # limit 1 grants — nothing leaked).
+                    await client.placement_announce({"abort_epoch": 1})
+                    await client.concurrency_release(key, 1)
+                    res = await client.concurrency_acquire(key, 1, 1)
+                    assert res.granted
+                finally:
+                    await client.aclose()
+
+        run(main())
+
+    def test_gated_scalar_ops_answer_moved(self):
+        async def main():
+            backing = InProcessBucketStore()
+            async with BucketStoreServer(backing) as srv:
+                client = RemoteBucketStore(address=(srv.host, srv.port),
+                                           coalesce_requests=False)
+                try:
+                    m = PlacementMap.initial(2)
+                    await client.placement_announce(
+                        {"map": m.to_dict(), "node_id": 0})
+                    foreign = next(k for k in KEYS if m.node_of(k) == 1)
+                    with pytest.raises(wire.RemoteStoreError,
+                                       match="placement moved"):
+                        await client.acquire(foreign, 1, 10.0, 1.0)
+                    with pytest.raises(wire.RemoteStoreError,
+                                       match="placement moved"):
+                        await client.sync_counter(foreign, 1.0, 1.0)
+                finally:
+                    await client.aclose()
+
+        run(main())

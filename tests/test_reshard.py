@@ -1,0 +1,537 @@
+"""Membership soak: elastic join/leave and live key migration under
+seeded chaos load (the acceptance harness for the placement-versioned
+cluster data plane — docs/OPERATIONS.md §9, ISSUE 6).
+
+The soak drives a live 3-server TCP topology through **join → hot-shard
+split → drain → rejoin** while a *follower* client (stale maps, MOVED
+chasing) hammers the keyspace through seeded connection/dispatch chaos,
+and then audits the ground truth:
+
+- **Differential dual-ownership audit**: every authoritative admission,
+  as recorded by the backing stores themselves, must have been served
+  by the key's owner under the epoch timeline — or, inside a
+  migration's bounded handoff window, by one of exactly {old, new}
+  owner. No key is ever admitted by two owners outside a window.
+- **Epsilon envelope**: the hot key's total observed grants stay within
+  ``capacity + headroom_budget × episodes`` — each membership episode
+  can cost at most one fair-share envelope, the same bound family as
+  the PR-5 outage soak and the tier-0 cache.
+- **Complete-or-abort**: every entry in the migration log is a commit
+  or a clean abort; an abort leaves the epoch (and serving) untouched.
+- **Schedule determinism**: the realized fault schedule equals the
+  injector's pure-function preview, seam for seam (`make reshard-soak
+  SEED=...` replays any run bit-for-bit via ``DRL_RESHARD_SEED``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.cluster import (
+    ClusterBucketStore,
+    PlacementError,
+)
+from distributedratelimiting.redis_tpu.runtime.placement import PlacementMap
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    StoreTimeoutError,
+)
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+from distributedratelimiting.redis_tpu.utils.faults import (
+    FaultInjector,
+    FaultRule,
+)
+
+SEED = int(os.environ.get("DRL_RESHARD_SEED", "20260803"))
+
+_NET_ERRORS = (ConnectionError, OSError, StoreTimeoutError,
+               wire.RemoteStoreError)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.uninstall()
+
+
+class RecordingStore(InProcessBucketStore):
+    """Backing store that stamps every authoritative admission — the
+    ground truth the dual-ownership audit replays. Envelope decisions
+    (degraded or handoff) never reach a store, by design; their totals
+    are bounded by the epsilon assertion instead."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.admissions: list[tuple[str, float, bool]] = []
+
+    async def acquire(self, key, count, capacity, fill_rate_per_sec):
+        res = await super().acquire(key, count, capacity,
+                                    fill_rate_per_sec)
+        self.admissions.append((key, time.monotonic(),
+                                bool(res.granted and count > 0)))
+        return res
+
+
+def _owner_timeline(initial: PlacementMap, log: list[dict]):
+    """Reconstruct the committed map sequence: ``[(t_commit, map), …]``
+    starting from the initial map (t = -inf)."""
+    timeline = [(float("-inf"), initial)]
+    m = initial
+    for e in log:
+        if e["type"] != "commit":
+            continue
+        m = m.with_assignments(
+            {int(s): int(d) for s, d in e["moves"].items()},
+            set_overrides=e["keys"] or None)
+        timeline.append((e["t_end"], m))
+    return timeline
+
+
+def _audit_dual_ownership(initial: PlacementMap, log: list[dict],
+                          backings: "list[RecordingStore]") -> int:
+    """The differential audit: every store-level admission must come
+    from the key's owner at that instant — or from {old, new} owner
+    inside the admitting migration's handoff window. Returns the number
+    of admissions checked (the audit must not be vacuous)."""
+    timeline = _owner_timeline(initial, log)
+    windows = []  # (t_start, t_end, before_map, after_map, moved-pred)
+    for (t0, before), (t1, after), e in zip(
+            timeline, timeline[1:],
+            [e for e in log if e["type"] == "commit"]):
+        moved_slots = {int(s) for s in e["moves"]}
+        moved_keys = set(e["keys"])
+        windows.append((e["t_start"], e["t_end"], before, after,
+                        moved_slots, moved_keys))
+    checked = 0
+    for node_idx, store in enumerate(backings):
+        for key, t, granted in store.admissions:
+            if not granted:
+                continue
+            checked += 1
+            # owner under the committed timeline at time t
+            owner = next(m for tc, m in reversed(timeline) if tc <= t
+                         ).node_of(key)
+            if node_idx == owner:
+                continue
+            in_window = any(
+                t_start <= t <= t_end
+                and (key in moved_keys
+                     or before.slot_of(key) in moved_slots)
+                and node_idx in (before.node_of(key), after.node_of(key))
+                for t_start, t_end, before, after, moved_slots,
+                moved_keys in windows)
+            assert in_window, (
+                f"key {key!r} admitted by node {node_idx} at t={t:.4f} "
+                f"while node {owner} owned it, outside any handoff "
+                "window — dual ownership")
+    return checked
+
+
+class TestReshardSoak:
+    RULES = {
+        "client.connect": (
+            FaultRule("reset", probability=0.10),
+            FaultRule("delay", probability=0.2, delay_s=0.001,
+                      jitter_s=0.002),
+        ),
+        "server.dispatch": (
+            FaultRule("delay", probability=0.05, delay_s=0.002,
+                      jitter_s=0.002),
+        ),
+    }
+
+    def test_soak_membership_invariants(self):
+        """Join + hot-split + drain + rejoin under load and wire chaos:
+        ≥2 join/leave episodes, ≥1 hot-shard split, bounded
+        over-admission, the dual-ownership differential audit, and a
+        deterministic schedule."""
+
+        async def main():
+            inj = FaultInjector(SEED, self.RULES)
+            faults.install(inj)
+            backings = [RecordingStore() for _ in range(3)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            cap_hot = 40.0
+            common = dict(coalesce_requests=False, request_timeout_s=1.0,
+                          reconnect_backoff_base_s=0.004,
+                          resilience_seed=SEED)
+            # Coordinator runs membership; follower drives load with a
+            # map that goes stale at every commit (MOVED chasing). The
+            # follower knows the full node INVENTORY (addresses are
+            # deployment config) but starts on the same 2-node epoch-0
+            # map — ownership is only ever learned from the map.
+            coordinator = ClusterBucketStore(addresses=addrs[:2],
+                                             handoff_window_s=3.0,
+                                             **common)
+            initial = PlacementMap.initial(2)
+            follower = ClusterBucketStore(addresses=addrs,
+                                          placement=initial, **common)
+            assert coordinator.placement == initial
+
+            hot_grants = 0
+            cold_ok = 0
+            cold_n = 0
+            stop = asyncio.Event()
+
+            async def drive():
+                nonlocal hot_grants, cold_ok, cold_n
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        r = await follower.acquire("hot", 1, cap_hot,
+                                                   1e-9)
+                        hot_grants += r.granted
+                    except _NET_ERRORS:
+                        pass
+                    cold_n += 1
+                    try:
+                        r = await follower.acquire(f"cold{i % 16}", 1,
+                                                   1e6, 1.0)
+                        cold_ok += r.granted
+                    except _NET_ERRORS:
+                        pass
+                    await asyncio.sleep(0)
+
+            async def membership():
+                await asyncio.sleep(0.10)
+                # Episode 1 — JOIN: node 2 takes an even slot share,
+                # with its state, while traffic flows.
+                await coordinator.add_node(address=addrs[2])
+                await asyncio.sleep(0.10)
+                # Episode 2 — HOT-SHARD SPLIT, driven by the servers'
+                # space-saving heavy-hitter sketches ('hot' dominates
+                # every node's scalar admission lane).
+                split = await coordinator.split_hot_keys(top_n=1)
+                assert split == ["hot"], split
+                await asyncio.sleep(0.10)
+                # Episode 3 — LEAVE: drain node 0's slots (and state)
+                # onto the survivors.
+                await coordinator.drain_node(0)
+                await asyncio.sleep(0.10)
+                # Episode 4 — REJOIN: fold node 0 back in.
+                await coordinator.rejoin_node(0)
+                await asyncio.sleep(0.10)
+                stop.set()
+
+            driver = asyncio.ensure_future(drive())
+            try:
+                await asyncio.wait_for(membership(), 60.0)
+                await driver
+            finally:
+                driver.cancel()
+                try:
+                    await driver
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+            try:
+                log = coordinator.migration_log
+                # Every migration completed or cleanly aborted — and
+                # this seed's schedule commits all four episodes.
+                assert all(e["type"] in ("commit", "abort") for e in log)
+                commits = [e for e in log if e["type"] == "commit"]
+                assert len(commits) == 4
+                assert coordinator.placement.epoch == 4
+                assert coordinator.placement.overrides.get("hot") \
+                    is not None
+                # ≥2 join/leave episodes + ≥1 hot split, by reason.
+                reasons = [e["reason"] for e in commits]
+                assert sum(r.startswith(("join", "drain", "rejoin"))
+                           for r in reasons) >= 3
+                assert any(r.startswith("hot-split") for r in reasons)
+
+                # The follower converged on the final epoch via MOVED
+                # chasing alone.
+                assert follower.placement.epoch == 4
+
+                # Differential dual-ownership audit over the ground
+                # truth the stores recorded.
+                checked = _audit_dual_ownership(initial, log, backings)
+                assert checked >= 50, "audit must not be vacuous"
+
+                # Epsilon envelope: each membership episode can cost at
+                # most one fair-share envelope of the hot key's budget
+                # (the PULL debit keeps old + new inside one balance;
+                # the envelope itself is the bounded slack).
+                budget = headroom_budget(cap_hot, fraction=0.5,
+                                         min_budget=1.0)
+                episodes = len(commits) + 1
+                assert hot_grants <= cap_hot + budget * episodes, (
+                    hot_grants, budget, episodes)
+                assert hot_grants >= 10  # availability through churn
+                assert cold_ok >= cold_n * 0.5
+
+                # Schedule determinism: realized == pure preview, and a
+                # twin injector under the same seed agrees.
+                for seam in self.RULES:
+                    realized = [e for e in inj.events if e.seam == seam]
+                    assert realized == inj.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                twin = FaultInjector(SEED, self.RULES)
+                for seam in self.RULES:
+                    assert (twin.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                        == inj.schedule_preview(
+                            seam, inj.occurrence_count(seam)))
+            finally:
+                await follower.aclose()
+                await coordinator.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_migration_abort_leaves_old_epoch_serving(self):
+        """A handoff step failing mid-migration (seeded fault on the
+        server.migrate seam) aborts cleanly: epoch unchanged, nothing
+        stays parked, and the same change succeeds once the fault
+        clears."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(3)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            cluster = ClusterBucketStore(
+                addresses=addrs[:2], coalesce_requests=False,
+                request_timeout_s=1.0, retry_policy=None)
+            try:
+                for i in range(12):
+                    await cluster.acquire(f"k{i}", 1, 100.0, 1.0)
+                faults.install(FaultInjector(SEED, {
+                    "server.migrate": (FaultRule("error",
+                                                 probability=1.0),)}))
+                with pytest.raises(PlacementError):
+                    await cluster.add_node(address=addrs[2])
+                assert cluster.placement.epoch == 0
+                assert cluster.migration_aborts == 1
+                assert cluster.migration_log[-1]["type"] == "abort"
+                # the old owners still serve every key authoritatively
+                for i in range(12):
+                    r = await cluster.acquire(f"k{i}", 0, 100.0, 1.0)
+                    assert r.granted
+                # fault clears → the SAME reshape commits (node 2 is
+                # already a member; it just owns nothing yet)
+                faults.uninstall()
+                await cluster.rebalance(reason="retry")
+                assert cluster.placement.epoch == 1
+                assert cluster.placement.slot_counts(3).min() >= 10
+                for i in range(12):
+                    r = await cluster.acquire(f"k{i}", 0, 100.0, 1.0)
+                    assert r.granted
+            finally:
+                await cluster.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_fault_on_first_seam_aborts_typed_and_rolls_back_drain(self):
+        """Regression (round-6 review): the FIRST cluster.migrate seam
+        occurrence used to sit outside _apply_placement's try — an
+        injected fault there escaped as a raw FaultInjectedError,
+        skipping abort bookkeeping and leaking the drained-set mutation
+        (a later innocent rebalance would then silently migrate the
+        node's slots away)."""
+
+        async def main():
+            cluster = ClusterBucketStore(
+                stores=[InProcessBucketStore() for _ in range(3)])
+            try:
+                faults.install(FaultInjector(SEED, {
+                    "cluster.migrate": (FaultRule("error",
+                                                  probability=1.0),)}))
+                with pytest.raises(PlacementError):
+                    await cluster.drain_node(2)
+                assert 2 not in cluster.drained  # rollback happened
+                assert cluster.placement.epoch == 0
+                assert cluster.migration_log[-1]["type"] == "abort"
+                faults.uninstall()
+                await cluster.drain_node(2)
+                assert 2 in cluster.drained
+                assert cluster.placement.epoch == 1
+            finally:
+                faults.uninstall()
+                await cluster.aclose()
+
+        run(main())
+
+    def test_abort_after_partial_push_retries_exactly_once(self):
+        """Regression (round-6 review): an abort clears the destination
+        push ledger for its target epoch — the retry reuses the epoch
+        AND the batch ids, and stale ledger entries would dedup-drop the
+        re-pushed state (init-on-miss over-admission). Observable: the
+        retry's pushes count zero duplicates."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(3)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            cluster = ClusterBucketStore(
+                addresses=addrs[:2], coalesce_requests=False,
+                request_timeout_s=1.0, retry_policy=None)
+            try:
+                # Enough keys that BOTH sources ship nonempty batches to
+                # the new owner (seam order: pull, pull, push, push).
+                for i in range(40):
+                    await cluster.acquire(f"k{i}", 1, 100.0, 0.0)
+                faults.install(FaultInjector(SEED, {
+                    "server.migrate": (FaultRule("error", after=3,
+                                                 probability=1.0),)}))
+                with pytest.raises(PlacementError):
+                    await cluster.add_node(address=addrs[2])
+                # precondition for the regression: attempt 1 really did
+                # land a batch on the destination before the abort
+                assert servers[2].placement.pushes_applied >= 1
+                assert cluster.placement.epoch == 0
+                faults.uninstall()
+                await cluster.rebalance(reason="retry")
+                assert cluster.placement.epoch == 1
+                # the retry's re-pushed batches all APPLIED — none were
+                # deduped against the aborted attempt's ledger
+                assert servers[2].placement.pushes_duplicate == 0
+            finally:
+                faults.uninstall()
+                await cluster.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_concurrent_membership_ops_serialize(self):
+        """Regression (round-6 review): membership ops on one
+        coordinator used to race — two overlapping calls both read the
+        same epoch, built conflicting targets, and the second commit
+        silently overwrote the first's slot moves. The coordinator lock
+        serializes them: both commit, at distinct epochs, and the final
+        map reflects BOTH changes."""
+
+        async def main():
+            cluster = ClusterBucketStore(
+                stores=[InProcessBucketStore() for _ in range(3)])
+            try:
+                hot = next(f"k{i}" for i in range(64)
+                           if cluster.node_index_of(f"k{i}") == 0)
+                await cluster.acquire(hot, 1, 100.0, 1.0)
+                await asyncio.gather(
+                    cluster.drain_node(2),
+                    cluster.split_hot_key(hot, target=1))
+                assert cluster.placement.epoch == 2
+                commits = [e for e in cluster.migration_log
+                           if e["type"] == "commit"]
+                assert len(commits) == 2
+                # both changes survive in the final committed map
+                assert int(cluster.placement.slot_counts(3)[2]) == 0
+                assert cluster.placement.overrides.get(hot) == 1
+                r = await cluster.acquire(hot, 0, 100.0, 1.0)
+                assert r.granted
+            finally:
+                await cluster.aclose()
+
+        run(main())
+
+    def test_fresh_coordinator_adopts_fleet_epoch(self):
+        """Regression (round-6 review): a coordinator constructed AFTER
+        the fleet resharded (its map defaults to epoch 0) used to
+        bootstrap-announce the stale map strictly to destinations — the
+        nodes refused it as stale and every membership op aborted until
+        someone manually called refresh_placement(). The first
+        membership op now adopts the fleet's highest epoch first."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(3)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            first = ClusterBucketStore(
+                addresses=addrs, coalesce_requests=False,
+                request_timeout_s=1.0, retry_policy=None)
+            second = None
+            try:
+                for i in range(12):
+                    await first.acquire(f"k{i}", 1, 100.0, 1.0)
+                await first.drain_node(2)
+                assert first.placement.epoch == 1
+                # a brand-new coordinator process attaches to the fleet
+                second = ClusterBucketStore(
+                    addresses=addrs, coalesce_requests=False,
+                    request_timeout_s=1.0, retry_policy=None)
+                assert second.placement.epoch == 0  # stale by default
+                # its first membership op adopts epoch 1, then commits
+                # on top of it instead of aborting on a stale announce
+                await second.rebalance(reason="re-adopt")
+                assert second.placement.epoch == 2
+                for i in range(12):
+                    r = await second.acquire(f"k{i}", 0, 100.0, 1.0)
+                    assert r.granted
+            finally:
+                if second is not None:
+                    await second.aclose()
+                await first.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+    def test_dead_node_drain_loses_only_its_state(self):
+        """Unplanned leave: draining a DEAD node cannot pull its state —
+        the survivors adopt its keyspace init-on-miss (the reference's
+        wiped-state posture, scoped to one node) and the event records
+        the loss."""
+
+        async def main():
+            backings = [InProcessBucketStore() for _ in range(2)]
+            servers = [BucketStoreServer(b) for b in backings]
+            for s in servers:
+                await s.start()
+            addrs = [(s.host, s.port) for s in servers]
+            cluster = ClusterBucketStore(
+                addresses=addrs, coalesce_requests=False,
+                request_timeout_s=0.3, retry_policy=None,
+                reconnect_backoff_base_s=0.01)
+            try:
+                for i in range(12):
+                    await cluster.acquire(f"k{i}", 1, 100.0, 1.0)
+                # bootstrap-announce happens on first migration; do a
+                # no-op-ish one first so the death test isn't blocked on
+                # announcing to the corpse
+                await cluster.rebalance(reason="bootstrap")
+                await servers[1].aclose()  # node 1 dies hard
+                await cluster.drain_node(1)
+                assert cluster.placement.slot_counts(2)[1] == 0
+                ev = cluster.migration_log[-1]
+                assert ev["type"] == "commit"
+                assert ev.get("state_lost_from") == [1]
+                # every key serves again (node 1's keys: fresh buckets)
+                for i in range(12):
+                    r = await cluster.acquire(f"k{i}", 1, 100.0, 1.0)
+                    assert r.granted
+            finally:
+                await cluster.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())

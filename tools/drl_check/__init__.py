@@ -10,9 +10,10 @@ a production misparse. Four analyzers:
 
 - :mod:`.wire_conformance` — extracts the wire model (opcodes, flag
   bits, frame layouts, version gates) from ``wire.py`` via ``ast`` and
-  from ``frontend.cc`` via constant/offset parsing, diffs the two, and
-  cross-checks every ``fe_*``/``dir_*`` symbol the ctypes loader binds
-  against the C exports.
+  from ``frontend.cc`` via constant/offset parsing, diffs the two,
+  requires every ``OP_*`` constant to have a server dispatch handler
+  (``wire-dispatch``), and cross-checks every ``fe_*``/``dir_*`` symbol
+  the ctypes loader binds against the C exports.
 - :mod:`.concurrency_lint` — AST checks for the asyncio/thread races
   this repo has actually shipped fixes for: blocking calls in
   ``async def``, locks held across ``await``, loop-affine calls from

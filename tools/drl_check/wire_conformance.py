@@ -16,6 +16,10 @@ extracts a wire model from each side and diffs them:
 - **Endianness** (``wire-endian``): every ``struct.Struct`` format in
   ``wire.py`` must pin little-endian (``<``) — the C side assumes an LE
   host and does raw ``memcpy``.
+- **Dispatch coverage** (``wire-dispatch``): every ``OP_*`` constant in
+  ``wire.py`` must have a dispatch reference in ``runtime/server.py`` —
+  an op no handler answers is dead protocol surface (file:line on both
+  sides).
 - **ctypes ABI** (``abi-export``): every ``fe_*``/``dir_*`` symbol the
   loader (``utils/native.py``) binds must be exported by the
   corresponding ``.cc``, and vice versa — a symbol on one side only is
@@ -37,7 +41,7 @@ from tools.drl_check.common import (
     rel,
 )
 
-__all__ = ["check", "check_wire", "check_abi",
+__all__ = ["check", "check_wire", "check_abi", "check_dispatch",
            "extract_py_model", "extract_c_model"]
 
 
@@ -380,6 +384,49 @@ def check_abi(native_py: pathlib.Path, cc_files: "list[pathlib.Path]",
     return findings
 
 
+# -- op dispatch coverage ---------------------------------------------------
+
+def _server_op_references(server_py: pathlib.Path) -> dict[str, int]:
+    """Every ``wire.OP_*`` attribute the server module reads → first
+    line. Attribute access is the dispatch idiom throughout server.py
+    (comparisons, membership sets, handler branches)."""
+    tree = ast.parse(server_py.read_text())
+    refs: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr.startswith("OP_")
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "wire"):
+            refs.setdefault(node.attr, node.lineno)
+    return refs
+
+
+def check_dispatch(wire_py: pathlib.Path, server_py: pathlib.Path,
+                   root: pathlib.Path) -> list[Finding]:
+    """``wire-dispatch``: every ``OP_*`` constant wire.py defines must
+    be referenced by the server's dispatch (runtime/server.py). An op
+    without a handler is dead protocol surface — a client can emit a
+    frame the fleet answers only with 'unknown op', which reads as an
+    old-peer latch, not the bug it is."""
+    py = extract_py_model(wire_py)
+    refs = _server_op_references(server_py)
+    wire_rel = rel(wire_py, root)
+    server_rel = rel(server_py, root)
+    findings: list[Finding] = []
+    for name, (value, line) in sorted(py.constants.items()):
+        if not name.startswith("OP_"):
+            continue
+        if name not in refs:
+            findings.append(Finding(
+                "wire-dispatch",
+                f"{name} = {value} has no dispatch reference in "
+                f"{server_rel} — a frame carrying it is dead protocol "
+                "surface (answered 'unknown op')",
+                wire_rel, line,
+                ((server_rel, 1, f"no wire.{name} reference"),)))
+    return findings
+
+
 # -- entry points -----------------------------------------------------------
 
 def check_wire(wire_py: pathlib.Path, frontend_cc: pathlib.Path,
@@ -398,6 +445,8 @@ def check(root: pathlib.Path) -> list[Finding]:
     pkg = root / "distributedratelimiting" / "redis_tpu"
     findings = check_wire(pkg / "runtime" / "wire.py",
                           root / "native" / "frontend.cc", root)
+    findings += check_dispatch(pkg / "runtime" / "wire.py",
+                               pkg / "runtime" / "server.py", root)
     findings += check_abi(pkg / "utils" / "native.py",
                           [root / "native" / "frontend.cc",
                            root / "native" / "directory.cc"], root)
