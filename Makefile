@@ -7,9 +7,10 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: check lint test native asan-test tsan-test chaos-test reshard-soak
+.PHONY: check lint test native asan-test tsan-test chaos-test \
+        reshard-soak upgrade-soak
 
-check: lint test chaos-test asan-test tsan-test
+check: lint test chaos-test upgrade-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -44,6 +45,14 @@ SEED ?= 20260803
 reshard-soak:
 	JAX_PLATFORMS=cpu DRL_RESHARD_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_reshard.py -v -p no:cacheprovider
+
+# Rolling-restart soak: restart every node of a 3-node cluster under
+# seeded wire chaos + live traffic with a mid-roll live limit mutation
+# (docs/OPERATIONS.md §10). `make upgrade-soak SEED=...` replays any
+# schedule bit-for-bit, the chaos-test determinism contract.
+upgrade-soak:
+	JAX_PLATFORMS=cpu DRL_UPGRADE_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_upgrade.py -v -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).
 native:

@@ -286,6 +286,11 @@ class ClusterBucketStore(BucketStore):
         self.migration_log: list[dict] = []
         self.migrations = 0
         self.migration_aborts = 0
+        #: Live config mutations driven by this coordinator
+        #: (mutate_config; docs/OPERATIONS.md §10).
+        self.config_mutations = 0
+        self.config_aborts = 0
+        self.config_rebased_rows = 0
         #: Degraded-envelope grants debited against rejoining nodes'
         #: authoritative buckets (the rejoin-reconcile satellite).
         self.rejoin_debits = 0
@@ -1002,6 +1007,81 @@ class ClusterBucketStore(BucketStore):
                 self.drained.add(j)
                 raise
 
+    async def replace_node(self, j: int, *,
+                           address: "tuple[str, int] | None" = None,
+                           store: "BucketStore | None" = None) -> None:
+        """The rolling-restart "LB switch": swap node ``j``'s transport
+        for its restarted successor. The INDEX — the placement identity
+        — keeps its slots, so no map change and no migration happens
+        here; the state itself rode the drain-and-handoff shutdown
+        (``BucketStoreServer.shutdown(successor=…)``) or the restarted
+        process's checkpoint restore (docs/OPERATIONS.md §10). The
+        successor is health-gated before it takes the slot (its breaker
+        is rebuilt closed); on a failed gate the old transport stays —
+        a botched restart must not unseat a still-working node."""
+        if not 0 <= j < self.n_nodes:
+            raise ValueError(f"no node {j}")
+        if (address is None) == (store is None):
+            raise ValueError("exactly one of address= / store= required")
+        new = store if store is not None else RemoteBucketStore(
+            address=address, **self._remote_kwargs)
+        async with self._membership_lock:
+            old = self.nodes[j]
+            self.nodes[j] = new
+            try:
+                await self._health_gate(j)
+            except PlacementError:
+                self.nodes[j] = old
+                aclose = getattr(new, "aclose", None)
+                if callable(aclose) and store is None:
+                    await aclose()
+                raise
+            if self._breakers is not None:
+                # Fresh breaker, born closed: the restart gap's failures
+                # belong to the RETIRED transport, not the successor.
+                self._breakers[j] = self._make_breaker(
+                    j, self._breaker_config, self._breaker_clock)
+            await self._replay_config_to(j)
+        aclose = getattr(old, "aclose", None)
+        if callable(aclose):
+            try:
+                await aclose()
+            except Exception as exc:
+                self._note_scrape_error(j, exc)
+
+    async def _replay_config_to(self, j: int) -> None:
+        """Hand a (re)joining node the fleet's committed live-config
+        rules (the restart-survival half of mutate_config): fetch the
+        highest-version snapshot any OTHER node holds and adopt it onto
+        node ``j``. Idempotent and version-monotonic server-side, so a
+        duplicate replay is a no-op; a node restored from its
+        predecessor's drain already adopted the same rules there."""
+        ann = getattr(self.nodes[j], "config_announce", None)
+        if not callable(ann):
+            return
+        best: "dict | None" = None
+        for i, node in enumerate(self.nodes):
+            if i == j:
+                continue
+            fetch = getattr(node, "config_fetch", None)
+            if not callable(fetch):
+                continue
+            try:
+                payload = await fetch(timeout_s=self._probe_timeout_s)
+            except Exception as exc:
+                self._note_scrape_error(i, exc)
+                continue
+            if best is None or int(payload.get("version", 0)) > \
+                    int(best.get("version", 0)):
+                best = payload
+        if best and int(best.get("version", 0)) > 0:
+            try:
+                await ann({"adopt": best})
+            except Exception as exc:
+                # Visible, not fatal: the node serves; a stale gate is
+                # re-replayed by the next membership op or mutation.
+                self._note_scrape_error(j, exc)
+
     async def split_hot_key(self, key: str,
                             target: "int | None" = None) -> int:
         """Hot-shard split: pin one key to its own node via a placement
@@ -1054,6 +1134,112 @@ class ClusterBucketStore(BucketStore):
             if key in self.placement.overrides:
                 split.append(key)
         return split
+
+    # -- live config mutation (docs/OPERATIONS.md §10) -----------------------
+    async def mutate_config(self, kind: str,
+                            old: "tuple[float, float]",
+                            new: "tuple[float, float]") -> int:
+        """Cluster-wide live limit mutation: rewrite every node's
+        ``(kind, old) → new`` config in place — balances carried through
+        the epoch-rebase (runtime/liveconfig.py) — with no restart.
+
+        Two-phase under the coordinator lock, the placement plane's
+        discipline: **prepare** stages the rule on every node (pure
+        validation — any failure aborts the whole mutation cleanly back
+        to the old version, nothing served differently anywhere), then
+        **commit** flips the gates in node order (first node → rest;
+        from each node's flip, its stale traffic chases one routable
+        "config moved" error onto the new config). The target version
+        adopts the fleet's highest committed version + 1, so a fresh
+        coordinator attaching to an already-mutated fleet can't go
+        backwards — and a re-sent prepare/commit is idempotent at its
+        version, making the whole op post-send-retry-safe
+        (``_IDEMPOTENT_OPS``).
+
+        In-process nodes (no wire, no gate) rebase directly at their
+        commit position; their callers see the new config the moment
+        this returns. Returns the committed config version."""
+        from distributedratelimiting.redis_tpu.runtime import liveconfig
+
+        rule = liveconfig.ConfigRule(kind, tuple(old), tuple(new))
+        async with self._membership_lock:
+            # Adopt the fleet's highest committed version (reachable
+            # nodes only — a dead node catches up via re-prepare when
+            # the operator re-runs the mutation after its restart).
+            best = 0
+            for j, node in enumerate(self.nodes):
+                fetch = getattr(node, "config_fetch", None)
+                if not callable(fetch):
+                    continue
+                try:
+                    payload = await fetch(
+                        timeout_s=self._probe_timeout_s)
+                    best = max(best, int(payload.get("version", 0)))
+                except Exception as exc:
+                    self._note_scrape_error(j, exc)
+            version = best + 1
+            event = {"type": "config", "kind": kind,
+                     "old": list(rule.old), "new": list(rule.new),
+                     "version": version, "t_start": time.monotonic()}
+            wired = [j for j, n in enumerate(self.nodes)
+                     if callable(getattr(n, "config_announce", None))]
+            try:
+                await faults.seam("cluster.config")
+                # Phase 1 — prepare everywhere, strictly: a node that
+                # cannot stage the rule vetoes the mutation while the
+                # old config still serves everywhere.
+                for j in wired:
+                    await self.nodes[j].config_announce(
+                        {"prepare": rule.to_dict(), "version": version})
+            except Exception as exc:
+                for j in wired:
+                    try:
+                        await self.nodes[j].config_announce(
+                            {"abort": version})
+                    except Exception as abort_exc:
+                        self._note_scrape_error(j, abort_exc)
+                event.update(type="config_abort", error=repr(exc),
+                             t_end=time.monotonic())
+                self.config_aborts += 1
+                self._log_migration(event)
+                if isinstance(exc, liveconfig.ConfigError):
+                    raise
+                raise liveconfig.ConfigError(
+                    f"config mutation to version {version} aborted: "
+                    f"{exc!r}") from exc
+            # Phase 2 — commit, first node → rest. Past the first
+            # successful flip the mutation presses on (a straggler keeps
+            # serving the old table until the operator re-runs the
+            # mutation — visible in the event record, never silent).
+            commit_errors = 0
+            for j in wired:
+                try:
+                    await self.nodes[j].config_announce(
+                        {"commit": version})
+                except Exception as exc:
+                    commit_errors += 1
+                    self._note_scrape_error(j, exc)
+            for j, node in enumerate(self.nodes):
+                if j in wired:
+                    continue
+                try:
+                    self.config_rebased_rows += \
+                        await liveconfig._rebase_state(node, rule)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    # Past the point of no return (wired nodes already
+                    # committed): ANY rebase failure — not just the
+                    # typed enumeration one — degrades to init-on-miss
+                    # for this node's keys, counted + logged, never an
+                    # exception out of a mutation the fleet committed.
+                    commit_errors += 1
+                    self._note_scrape_error(j, exc)
+            self.config_mutations += 1
+            event.update(type="config_commit", t_end=time.monotonic(),
+                         commit_errors=commit_errors)
+            self._log_migration(event)
+            return version
 
     # -- single-key ops: route, guard, forward -------------------------------
     async def acquire(self, key: str, count: int, capacity: float,
@@ -1479,6 +1665,12 @@ class ClusterBucketStore(BucketStore):
         reg.counter("cluster_rejoin_debits",
                     "Degraded-envelope grants debited on node rejoin",
                     lambda: self.rejoin_debits)
+        reg.counter("cluster_config_mutations",
+                    "Committed live config mutations",
+                    lambda: self.config_mutations)
+        reg.counter("cluster_config_aborts",
+                    "Config mutations cleanly aborted to the old version",
+                    lambda: self.config_aborts)
         reg.counter("cluster_client_retries",
                     "Wire-client retries, summed over nodes",
                     lambda: self._sum_node_stat("retries"))
@@ -1586,6 +1778,11 @@ class ClusterBucketStore(BucketStore):
             "drained": sorted(self.drained),
             "migrations": self.migrations,
             "migration_aborts": self.migration_aborts,
+        }
+        out["config"] = {
+            "mutations": self.config_mutations,
+            "aborts": self.config_aborts,
+            "rebased_rows": self.config_rebased_rows,
         }
         return out
 

@@ -122,6 +122,15 @@ class _FpTable:
     #: Grow when (occupied / n_slots) crosses this after window pressure.
     _GROW_AT = 0.7
 
+    #: Dirty accounting for incremental checkpoints (store.py
+    #: ``enable_dirty_tracking``): slot placement happens in-kernel here
+    #: — the host never sees which slot a row landed in — so the gauge
+    #: counts dispatched rows instead, a documented UPPER bound on dirty
+    #: slots (duplicates re-count). ``None`` until armed; the v4 delta
+    #: itself is a structural diff over the slot arrays, exact either
+    #: way (runtime/checkpoint.py).
+    dirty_rows: "int | None" = None
+
     def __init__(self, store: "FingerprintBucketStore", capacity: float,
                  fill_rate_per_sec: float, n_slots: int) -> None:
         if n_slots < store.probe_window:
@@ -191,7 +200,10 @@ class _FpTable:
         with store._lock:
             now = store.now_ticks_checked()
             out = self._call_batch(kpair, counts, valid, now)
-            store.metrics.record_launch(len(valid), int(valid.sum()))
+            n_valid = int(valid.sum())
+            if self.dirty_rows is not None:
+                self.dirty_rows += n_valid
+            store.metrics.record_launch(len(valid), n_valid)
         return out
 
     def _postprocess(self, granted_np, remaining_np, resolved_np,
@@ -257,6 +269,8 @@ class _FpTable:
         pos = 0
         with store.profiler.span("acquire_many_fp", n), store._lock:
             now = store.now_ticks_checked()
+            if self.dirty_rows is not None:
+                self.dirty_rows += n
             max_k = self._BULK_MAX_K
             while max_k > 1 and max_k * b * 12 > self._BULK_BYTE_BUDGET:
                 max_k //= 2

@@ -1,0 +1,397 @@
+"""Live limit mutation — versioned bucket/window config rewrites with
+no restart and no dropped balances (ROADMAP item 5; docs/OPERATIONS.md
+§10, DESIGN.md §13).
+
+The reference's only way to change a limiter's ``(capacity, fill_rate)``
+is a redeploy: state is wiped and self-heals init-on-miss — every limit
+change is an over-admission event at production traffic. Here a config
+change is a first-class, epoch-versioned control operation:
+
+- A **rule** maps one retired config to its replacement:
+  ``(kind, old_a, old_b) → (new_a, new_b)`` where ``kind`` is
+  ``bucket`` / ``window`` / ``fwindow`` and ``(a, b)`` are the wire's
+  config operands (capacity+rate, or limit+window_s). Rules commit at a
+  **config version** that only moves forward — the placement plane's
+  epoch-monotonic announce discipline (``OP_PLACEMENT_ANNOUNCE``), so
+  ``OP_CONFIG`` is application-idempotent and post-send-retry-safe.
+- Commit is **two-phase per node**: ``prepare`` stages the rule
+  (validated, no behavior change — any failure aborts the whole
+  mutation cleanly back to the old version), ``commit`` flips the
+  serving gate and *rebases* the state. The coordinator
+  (:meth:`~.cluster.ClusterBucketStore.mutate_config`) drives all nodes
+  under its membership lock, commit order first-node → rest — the
+  placement plane's dst→rest discipline.
+- The **rebase** ships balances through the existing saturating
+  ``debit_many`` kernel: every key of the old table re-homes into the
+  (fresh, init-on-miss-full) new table debited by what it had already
+  *spent* — ``max(0, old_cap − tokens)`` — so device stores need no
+  slot surgery and a consumed budget stays consumed across the
+  mutation. Windows replay their current-window count. Saturating by
+  construction, the rebase can only under-admit, never over-admit.
+- **Stale clients chase one routable error**: a request carrying a
+  retired config answers ``config moved: {json}`` (the MOVED-redirect
+  posture — the store is untouched, so the re-send is not a replay);
+  the client learns the forwarding rule, re-sends once with the new
+  operands, and caches the translation for every later call.
+
+The over-admission bound: the gate flips BEFORE the old table is
+exported, so post-flip traffic lands on the new table only; requests
+already in flight past the gate when it flips are bounded by the
+serving pipeline's in-flight depth — the same epsilon family as the
+handoff window (DESIGN.md §13 derives the envelope).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+from typing import Callable, Mapping
+
+from distributedratelimiting.redis_tpu.runtime import wire
+
+__all__ = ["ConfigState", "ConfigRule", "StaleConfigError",
+           "ConfigError", "CONFIG_MOVED_PREFIX", "KINDS",
+           "OP_KINDS", "BULK_KINDS"]
+
+#: Stable prefix of the routable "retired config" error — clients detect
+#: it with a substring match (the placement MOVED posture) and re-send
+#: with the rule's new operands instead of failing the caller. The JSON
+#: payload after the prefix is the rule itself.
+CONFIG_MOVED_PREFIX = "config moved"
+
+#: Config families a rule may rewrite. Semaphore limits are deliberately
+#: excluded: a semaphore's limit is per-call state, not table identity —
+#: callers change it by passing a new limit.
+KINDS = ("bucket", "window", "fwindow")
+
+#: The config kind each gated wire op's ``(a, b)`` belongs to — THE one
+#: table every lane routes through (server dispatch, native batch lane,
+#: client translation); a copy per lane is exactly the drift a future
+#: op would slip past. PEEK gates too: a balance probe against a
+#: retired table would report a number nobody serves from anymore.
+OP_KINDS = {wire.OP_ACQUIRE: "bucket", wire.OP_WINDOW: "window",
+            wire.OP_FWINDOW: "fwindow", wire.OP_PEEK: "bucket"}
+
+#: Bulk-frame kind bits → config kind (the frame-level gate: one
+#: ``(kind, a, b)`` decides a whole ACQUIRE_MANY frame).
+BULK_KINDS = {wire.BULK_KIND_BUCKET: "bucket",
+              wire.BULK_KIND_WINDOW: "window",
+              wire.BULK_KIND_FWINDOW: "fwindow"}
+
+
+class ConfigError(RuntimeError):
+    """Config control-plane failure (validation, rebase) — the mutation
+    aborted cleanly at the old version."""
+
+
+class StaleConfigError(ConfigError):
+    """The announced version is not the node's ``version + 1`` (prepare)
+    or conflicts with an already-staged rule at the same version.
+    Versions are monotonic; re-announcing the current state is
+    idempotent, going backwards is a protocol error."""
+
+
+class ConfigRule:
+    """One committed (or staged) config rewrite."""
+
+    __slots__ = ("kind", "old", "new")
+
+    def __init__(self, kind: str, old: "tuple[float, float]",
+                 new: "tuple[float, float]") -> None:
+        if kind not in KINDS:
+            raise ConfigError(f"unknown config kind {kind!r}")
+        self.kind = kind
+        self.old = (float(old[0]), float(old[1]))
+        self.new = (float(new[0]), float(new[1]))
+        if self.old == self.new:
+            raise ConfigError("config rule rewrites a config to itself")
+        for a, b in (self.old, self.new):
+            if not (math.isfinite(a) and math.isfinite(b)) or a <= 0:
+                raise ConfigError(
+                    f"config operands must be finite with a > 0: ({a}, {b})")
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "old": list(self.old),
+                "new": list(self.new)}
+
+    @classmethod
+    def from_dict(cls, data: Mapping) -> "ConfigRule":
+        return cls(data["kind"], tuple(data["old"]), tuple(data["new"]))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ConfigRule) and self.kind == other.kind
+                and self.old == other.old and self.new == other.new)
+
+    def __repr__(self) -> str:
+        return f"ConfigRule({self.kind}, {self.old} -> {self.new})"
+
+
+def moved_message(kind: str, old: "tuple[float, float]",
+                  new: "tuple[float, float]", version: int) -> str:
+    """The routable retired-config error text: stable prefix + the rule
+    as JSON, so the client parses operands instead of scraping prose."""
+    return CONFIG_MOVED_PREFIX + ": " + json.dumps(
+        {"kind": kind, "old": list(old), "new": list(new),
+         "version": int(version)})
+
+
+def parse_moved(message: str) -> "tuple[str, tuple, tuple, int] | None":
+    """Inverse of :func:`moved_message`; ``None`` when the message is
+    not a config-moved error (or its payload is unreadable — a client
+    must fail the call rather than guess operands)."""
+    i = message.find(CONFIG_MOVED_PREFIX)
+    if i < 0:
+        return None
+    try:
+        data = json.loads(message[i + len(CONFIG_MOVED_PREFIX) + 1:])
+        old = (float(data["old"][0]), float(data["old"][1]))
+        new = (float(data["new"][0]), float(data["new"][1]))
+        return str(data["kind"]), old, new, int(data["version"])
+    except (ValueError, KeyError, IndexError, TypeError):
+        return None
+
+
+class ConfigState:
+    """A serving node's live-config half: the committed forwarding rules
+    plus one staged (prepared, uncommitted) mutation. Dormant — zero
+    serving cost — until the first rule commits (``active`` is a plain
+    attribute read on the hot path)."""
+
+    #: Committed rules kept in the forwarding map. Bounded like every
+    #: other ledger: a fleet cycling thousands of configs through
+    #: retirement keeps the newest rules (older retired configs then
+    #: answer plain denials from their own — long-idle — tables).
+    _MAX_RULES = 1 << 10
+
+    def __init__(self) -> None:
+        self.version = 0
+        #: ``(kind, old_a, old_b) → (new_a, new_b, version)`` — THE
+        #: serving gate's lookup. Chains compress on commit: committing
+        #: B→C rewrites an existing A→B rule to A→C, so a twice-moved
+        #: client chases one error, not one per hop.
+        self.rules: dict[tuple, tuple[float, float, int]] = {}
+        self._staged: "dict[int, ConfigRule]" = {}
+        # Serializes prepare/commit/abort bodies: a commit's rebase
+        # spans awaits (snapshot off-thread, debit through the store)
+        # and a post-send retry must hit the idempotent no-op, not run
+        # a second rebase.
+        self._lock = asyncio.Lock()
+        # Visible counters (OP_STATS "config" section + OpenMetrics).
+        self.moved_errors = 0
+        self.commits = 0
+        self.aborts = 0
+        self.adopts = 0
+        self.stale_announces = 0
+        self.rebased_rows = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    # -- serving gate --------------------------------------------------------
+    def forward(self, kind: str, a: float, b: float
+                ) -> "tuple[float, float, int] | None":
+        """The admission-path check: ``None`` (config current — the
+        overwhelming steady state, one dict probe) or the committed
+        ``(new_a, new_b, version)`` the caller must be redirected to."""
+        return self.rules.get((kind, float(a), float(b)))
+
+    def moved(self, kind: str, a: float, b: float,
+              fwd: "tuple[float, float, int]") -> str:
+        self.moved_errors += 1
+        return moved_message(kind, (a, b), (fwd[0], fwd[1]), fwd[2])
+
+    # -- control plane -------------------------------------------------------
+    def snapshot_payload(self) -> dict:
+        """The OP_CONFIG fetch reply: committed version + rules (staged
+        mutations are invisible until commit, by design)."""
+        return {"version": self.version,
+                "rules": [{"kind": k[0], "old": [k[1], k[2]],
+                           "new": [na, nb], "version": v}
+                          for k, (na, nb, v) in sorted(self.rules.items())]}
+
+    async def announce(self, payload: Mapping, store) -> int:
+        """One OP_CONFIG control frame: ``{"prepare": rule, "version":
+        v}`` stages, ``{"commit": v}`` flips the gate and rebases
+        through ``store``, ``{"abort": v}`` drops the staged rule, and
+        ``{"adopt": snapshot}`` installs another node's whole committed
+        rule set WITHOUT rebasing — the restart-survival lane: a
+        drained predecessor (or the coordinator's LB switch) hands the
+        successor the gates, whose state already arrived rebased
+        through the handoff. Every form is idempotent at its version; a
+        stale version raises the typed, routable error. Returns the
+        committed version."""
+        async with self._lock:
+            if "prepare" in payload:
+                return self._prepare(int(payload["version"]),
+                                     ConfigRule.from_dict(
+                                         payload["prepare"]))
+            if "commit" in payload:
+                return await self._commit(int(payload["commit"]), store)
+            if "abort" in payload:
+                self._staged.pop(int(payload["abort"]), None)
+                self.aborts += 1
+                return self.version
+            if "adopt" in payload:
+                return self._adopt(payload["adopt"])
+            if not payload:
+                return self.version
+            raise ConfigError(
+                f"unknown OP_CONFIG form {sorted(payload)!r}")
+
+    def _adopt(self, data: Mapping) -> int:
+        version = int(data.get("version", 0))
+        if version <= self.version:
+            return self.version  # idempotent: stale/duplicate no-op
+        rules: dict[tuple, tuple[float, float, int]] = {}
+        for row in data.get("rules", ()):
+            rule = ConfigRule.from_dict(row)  # validated, typed errors
+            rules[(rule.kind, rule.old[0], rule.old[1])] = (
+                rule.new[0], rule.new[1], int(row.get("version",
+                                                      version)))
+        self.rules = rules
+        self.version = version
+        self.adopts += 1
+        return self.version
+
+    def _prepare(self, version: int, rule: ConfigRule) -> int:
+        if version <= self.version:
+            self.stale_announces += 1
+            raise StaleConfigError(
+                f"stale config version {version} "
+                f"(this node committed {self.version})")
+        staged = self._staged.get(version)
+        if staged is not None and staged != rule:
+            # Two coordinators raced the same target version with
+            # different rules: the second loses loudly (the placement
+            # plane's conflicting-twin posture).
+            self.stale_announces += 1
+            raise StaleConfigError(
+                f"conflicting config rule already staged at version "
+                f"{version}; rebase and retry")
+        self._staged[version] = rule
+        return self.version
+
+    async def _commit(self, version: int, store) -> int:
+        if version <= self.version:
+            return self.version  # idempotent: a retried commit no-ops
+        rule = self._staged.pop(version, None)
+        if rule is None:
+            raise ConfigError(
+                f"commit for unstaged config version {version}; "
+                "prepare it first (or the abort already dropped it)")
+        # Gate FIRST: from this instant every new request carrying the
+        # old config answers the routable moved error and retries onto
+        # the new table — the old table quiesces (up to the in-flight
+        # pipeline depth, the documented epsilon) before it is exported.
+        old_key = (rule.kind, rule.old[0], rule.old[1])
+        self.rules[old_key] = (rule.new[0], rule.new[1], version)
+        # Chain compression: A→old becomes A→new, one chase per client —
+        # and a REVERT (new == A) deletes A's rule outright: A is
+        # current again, and an A→A self-rule would brick the config
+        # (forward() would bounce every A frame to itself, which the
+        # client rightly refuses to chase).
+        for k, (na, nb, _v) in list(self.rules.items()):
+            if k != old_key and (k[0], na, nb) == old_key:
+                if (k[1], k[2]) == rule.new:
+                    del self.rules[k]
+                else:
+                    self.rules[k] = (rule.new[0], rule.new[1], version)
+        while len(self.rules) > self._MAX_RULES:
+            self.rules.pop(next(iter(self.rules)))
+        self.version = version
+        try:
+            self.rebased_rows += await _rebase_state(store, rule)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            # The gate already flipped and the version advanced — the
+            # new config SERVES (init-on-miss full, the reference's
+            # whole posture for every deploy). A failed balance carry is
+            # degraded, visible, and bounded by that posture; unwinding
+            # the version here would split-brain the fleet's gates.
+            from distributedratelimiting.redis_tpu.utils import log
+
+            log.error_evaluating_kernel(exc)
+        self.commits += 1
+        return self.version
+
+    def stats(self) -> dict:
+        return {"version": self.version, "rules": len(self.rules),
+                "staged": len(self._staged),
+                "moved_errors": self.moved_errors,
+                "commits": self.commits, "aborts": self.aborts,
+                "adopts": self.adopts,
+                "stale_announces": self.stale_announces,
+                "rebased_rows": self.rebased_rows}
+
+
+async def _rebase_state(store, rule: ConfigRule) -> int:
+    """Carry the retired config's consumed budget into the new config's
+    table through the store's public state lanes — the epoch-rebase
+    step. Buckets: a fresh key under the new config is born full, so
+    debiting ``max(0, old_cap − tokens)`` (clamped into the new
+    capacity by the saturating kernel) lands ``new_cap − spent`` —
+    consumed budget survives, headroom re-scales to the new cap.
+    Windows: the current window's count replays (denials impossible to
+    over-admit — the replay can only consume). Stores whose snapshot
+    cannot enumerate keys (fingerprint directories) raise
+    :class:`ConfigError` — the coordinator aborts rather than silently
+    granting every key a fresh full budget."""
+    from distributedratelimiting.redis_tpu.runtime import placement
+
+    try:
+        snap = await asyncio.to_thread(store.snapshot)
+        entries = placement.extract_entries(snap, lambda _k: True)
+    except asyncio.CancelledError:
+        raise
+    except Exception as exc:
+        raise ConfigError(
+            f"store cannot enumerate keys for a config rebase "
+            f"({exc!r}); the mutation must abort — committing blind "
+            "would reset every bucket to a full budget") from exc
+    n = 0
+    if rule.kind == "bucket":
+        keys, amounts = [], []
+        for key, cap, rate, tokens, _age in entries.get("buckets", ()):
+            if (float(cap), float(rate)) != rule.old:
+                continue
+            spent = max(0.0, float(cap) - float(tokens))
+            if spent > 0.0:
+                keys.append(key)
+                amounts.append(spent)
+            n += 1
+        if keys:
+            await placement._debit_buckets(
+                store, {rule.new: (keys, amounts)})
+    else:
+        interp_want = rule.kind == "window"
+        new_limit, new_window = rule.new
+        from distributedratelimiting.redis_tpu.ops import bucket_math
+
+        old_wt = int(rule.old[1] * bucket_math.TICKS_PER_SECOND)
+        for key, limit, wt, interp, _prev, curr, behind in \
+                entries.get("windows", ()):
+            if (float(limit), int(wt)) != (rule.old[0], old_wt) \
+                    or bool(interp) != interp_want or behind != 0:
+                continue
+            # floor, not ceil: a fractional carry rounded UP past a
+            # fractional limit would be DENIED by the replay — and a
+            # denied replay records nothing, resetting the key to a
+            # fresh full budget (over-admission from the very mechanism
+            # meant to prevent it). Flooring under-carries by <1, the
+            # conservative direction.
+            count = int(math.floor(min(float(curr), new_limit)))
+            if count > 0:
+                if interp_want:
+                    await store.window_acquire(key, count, new_limit,
+                                               new_window)
+                else:
+                    await store.fixed_window_acquire(key, count,
+                                                     new_limit,
+                                                     new_window)
+            n += 1
+    return n
+
+

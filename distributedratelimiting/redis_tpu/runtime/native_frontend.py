@@ -34,7 +34,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from distributedratelimiting.redis_tpu.runtime import placement, wire
+from distributedratelimiting.redis_tpu.runtime import (
+    liveconfig,
+    placement,
+    wire,
+)
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.metrics import (
@@ -342,9 +346,49 @@ class NativeFrontend:
             # fallback is a transient condition, not a mode).
             ps = self._server.placement
             pgate = ps.bulk_gate(keys) if ps.active else None
-            if pgate is not None:
+            # Config gate (runtime/liveconfig.py): the C batch lane must
+            # honor retired configs exactly like the asyncio lane —
+            # mirror of the placement-gate treatment above. Dormant (one
+            # attribute read) until a rule commits; then rows carrying a
+            # retired (op-kind, a, b) are answered per-row with the
+            # routable "config moved" error (fe_send + kRowSkip) so the
+            # per-request client chases once and re-sends translated.
+            # One forward() probe per distinct config in the batch — the
+            # overwhelmingly common single-config batch probes once.
+            lc = self._server.liveconfig
+            cmoved: "list[tuple[int, tuple, tuple]] | None" = None
+            if lc.active and n:
+                # One forward() probe per DISTINCT config in the batch
+                # (numpy grouping — the rules dict stays populated
+                # forever once a mutation commits, so this path is
+                # steady-state for mutated fleets and must not pay a
+                # per-row Python loop on the fast lane). OP_KINDS is
+                # THE shared op→kind table; PEEK never rides a batch.
+                ckinds = liveconfig.OP_KINDS
+                rec = np.empty(n, dtype=[("op", np.uint8),
+                                         ("a", np.float64),
+                                         ("b", np.float64)])
+                rec["op"], rec["a"], rec["b"] = ops, a_arr, b_arr
+                uniq, inverse = np.unique(rec, return_inverse=True)
+                rows = []
+                for gi, u in enumerate(uniq):
+                    ck = ckinds.get(int(u["op"]))
+                    if ck is None:
+                        continue
+                    pk = (ck, float(u["a"]), float(u["b"]))
+                    fwd = lc.forward(*pk)
+                    if fwd is not None:
+                        rows.extend((int(i), pk, fwd) for i in
+                                    np.nonzero(inverse == gi)[0])
+                cmoved = rows or None
+            if pgate is not None or cmoved is not None:
                 full = (n, keys, counts, ops, a_arr, b_arr)
-                serve_idx = np.nonzero(pgate[0])[0]
+                serve_mask = (pgate[0].copy() if pgate is not None
+                              else np.ones(n, bool))
+                if cmoved is not None:
+                    for i, _pk, _fwd in cmoved:
+                        serve_mask[i] = False
+                serve_idx = np.nonzero(serve_mask)[0]
                 keys = [keys[int(i)] for i in serve_idx]
                 counts, ops = counts[serve_idx], ops[serve_idx]
                 a_arr, b_arr = a_arr[serve_idx], b_arr[serve_idx]
@@ -428,10 +472,11 @@ class NativeFrontend:
                     else:
                         granted[idx] = g
                         remaining[idx] = r
-            if pgate is not None:
+            if pgate is not None or cmoved is not None:
                 # Scatter the served subset back into the full batch,
                 # decide the parked rows from their handoff envelopes,
-                # and answer moved / non-envelope parked rows per-row.
+                # and answer moved / retired-config / non-envelope
+                # parked rows per-row.
                 n, keys, counts, ops, a_arr, b_arr = full
                 g_full = np.zeros(n, np.uint8)
                 r_full = np.zeros(n, np.float64)
@@ -441,7 +486,28 @@ class NativeFrontend:
                             and seqs is not None and conn_ids is not None)
                 ekinds = {_OP_BUCKET: "bucket", _OP_WINDOW: "window",
                           _OP_FWINDOW: "fwindow"}
-                for i, handoff in pgate[1]:
+                if cmoved is not None:
+                    for i, pk, fwd in cmoved:
+                        if row_skip:
+                            # The moved() counter + message — the same
+                            # routable error the asyncio lanes answer;
+                            # the store was never touched for this row,
+                            # so the client's translated re-send is not
+                            # a replay.
+                            self._send(int(conn_ids[i]),
+                                       wire.encode_response(
+                                           int(seqs[i]), wire.RESP_ERROR,
+                                           lc.moved(pk[0], pk[1], pk[2],
+                                                    fwd)))
+                            g_full[i] = _ROW_SKIP
+                        # Without the row-skip ABI (stale .so — a
+                        # transient condition, the loader rebuilds on
+                        # source change): deny. Admission-safe; the
+                        # stale client converges on its next scalar
+                        # call through the asyncio gate.
+                for i, handoff in (pgate[1] if pgate is not None else ()):
+                    if g_full[i] == _ROW_SKIP:
+                        continue  # already answered config-moved
                     ekind = ekinds.get(int(ops[i]))
                     if ekind is not None and counts[i] >= 0:
                         gr, rem = ps.envelope_acquire(
@@ -463,11 +529,13 @@ class NativeFrontend:
                             f"this key (target epoch "
                             f"{handoff.target_epoch}); retry shortly"))
                         g_full[i] = _ROW_SKIP
-                if row_skip and pgate[2].any():
+                if row_skip and pgate is not None and pgate[2].any():
                     # Moved rows answer the routable MOVED error — the
                     # signal the client's chase / background refresh
                     # converges on (bulk_gate already counted them).
                     for i in np.nonzero(pgate[2])[0].tolist():
+                        if g_full[i] == _ROW_SKIP:
+                            continue  # already answered config-moved
                         self._send(int(conn_ids[i]), wire.encode_response(
                             int(seqs[i]), wire.RESP_ERROR,
                             ps.moved_message(
@@ -487,11 +555,11 @@ class NativeFrontend:
         except Exception as exc:  # noqa: BLE001 — every request must get
             log.error_evaluating_kernel(exc)  # a routable error reply
             if traces is not None:
-                # The gate slices `ops` to the served subset; the trace
+                # The gates slice `ops` to the served subset; the trace
                 # arrays are full-batch, so restore the full ops before
                 # attributing error spans.
                 self._record_batch_spans(
-                    traces, None, ops if pgate is None else full[3],
+                    traces, None, ops if full is None else full[3],
                     t_start)
             self._lib.fe_fail(self._h, bid, repr(exc)[:200].encode())
 
@@ -612,6 +680,26 @@ class NativeFrontend:
         return {(k, float(caps[i]), float(rates[i])): float(amounts[i])
                 for i, k in enumerate(keys)}
 
+    def _t0_retire(self, cap: float, rate: float
+                   ) -> list[tuple[str, float]]:
+        """Kill every C replica of one retired (cap, rate) config and
+        return its un-harvested ``(key, amount)`` grants — one locked
+        ABI call (``fe_t0_retire``), so no grant slips between the
+        harvest and the kill (runtime/liveconfig.py)."""
+        c = ctypes
+        blob, klens = self._t0_blob, self._t0_klens
+        amounts = self._t0_amounts
+        n = self._lib.fe_t0_retire(
+            self._h, cap, rate, blob, len(blob),
+            klens.ctypes.data_as(c.POINTER(c.c_int32)),
+            amounts.ctypes.data_as(c.POINTER(c.c_double)), len(klens))
+        if n <= 0:
+            return []
+        used = ctypes.string_at(blob, int(klens[:n].sum()))
+        keys = wire.decode_key_blob(used, klens[:n],
+                                    errors="surrogateescape")
+        return [(k, float(amounts[i])) for i, k in enumerate(keys)]
+
     def _t0_ack(self, keys: list[str], cap: float, rate: float,
                 remaining: np.ndarray) -> None:
         c = ctypes
@@ -675,12 +763,42 @@ class NativeFrontend:
                 by_cfg: dict[tuple[float, float], list[tuple[str, float]]] = {}
                 for (key, cap, rate), amount in merged.items():
                     by_cfg.setdefault((cap, rate), []).append((key, amount))
+                lc = self._server.liveconfig
                 for (cap, rate), rows in by_cfg.items():
+                    # Retired config (live mutation committed since these
+                    # replicas were installed): kill the C replicas via
+                    # fe_t0_retire — one locked call that also returns
+                    # any grants admitted since the harvest above — and
+                    # debit EVERYTHING through the REPLACEMENT config's
+                    # table, the one the rebase carried the balances
+                    # into. Dead replicas make later stale frames fall
+                    # through to the batch lane's routable "config
+                    # moved" error (and _ROW_SKIP keeps them from
+                    # re-installing). Over-admission is bounded by one
+                    # sync interval's headroom — the same epsilon family
+                    # as the tier-0 budget itself. A stale .so without
+                    # the retire ABI falls back to a zero ack: admission-
+                    # safe (confident local denies), converging once the
+                    # loader rebuilds.
+                    fwd = (lc.forward("bucket", cap, rate)
+                           if lc.active else None)
+                    if fwd is not None and getattr(self._lib,
+                                                   "has_t0_retire",
+                                                   False):
+                        for key, amount in self._t0_retire(cap, rate):
+                            ident = (key, cap, rate)
+                            merged[ident] = merged.get(ident, 0.0) \
+                                + amount
+                        rows = [(k, a) for (k, c2, r2), a
+                                in merged.items()
+                                if (c2, r2) == (cap, rate)]
                     keys = [k for k, _ in rows]
                     amounts = [a for _, a in rows]
+                    dcap, drate = (cap, rate) if fwd is None else \
+                        (fwd[0], fwd[1])
                     try:
                         remaining, shortfall = await store.debit_many(
-                            keys, amounts, cap, rate)
+                            keys, amounts, dcap, drate)
                     except asyncio.CancelledError:
                         raise
                     except Exception as exc:  # degraded: rows stay in
@@ -689,7 +807,14 @@ class NativeFrontend:
                         self.t0_metrics.sync_failures += 1
                         round_failures += 1
                         continue
-                    self._t0_ack(keys, cap, rate, remaining)
+                    if fwd is not None:
+                        self.t0_metrics.retired_config_rows += len(keys)
+                        if not getattr(self._lib, "has_t0_retire",
+                                       False):
+                            remaining = np.zeros(len(keys), np.float64)
+                            self._t0_ack(keys, cap, rate, remaining)
+                    else:
+                        self._t0_ack(keys, cap, rate, remaining)
                     self.t0_metrics.record_sync(len(keys), shortfall,
                                                 time.monotonic())
                     round_keys += len(keys)

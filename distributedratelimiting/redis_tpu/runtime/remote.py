@@ -41,7 +41,7 @@ from typing import Awaitable, Callable, Sequence
 
 import numpy as np
 
-from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime import liveconfig, wire
 from distributedratelimiting.redis_tpu.runtime.clock import Clock, MonotonicClock
 from distributedratelimiting.redis_tpu.runtime.store import (
     AcquireResult,
@@ -75,14 +75,28 @@ class StoreTimeoutError(asyncio.TimeoutError):
 #: executing them twice changes no admission state. Everything else —
 #: ACQUIRE, WINDOW, FWINDOW, SEMA, SYNC, mutating STATS/TRACES flags —
 #: retries only on provably-never-sent failures (connect phase). The
-#: placement/migration control ops are *application-idempotent by
-#: design* (epoch-monotonic announce, per-epoch cached pull,
-#: batch-deduped push — wire.py), so a coordinator's retry mid-chaos
-#: can never double-apply a handoff.
+#: placement/migration/config control ops are *application-idempotent
+#: by design* (epoch-monotonic announce, per-epoch cached pull,
+#: batch-deduped push, version-monotonic OP_CONFIG — wire.py), so a
+#: coordinator's retry mid-chaos can never double-apply a handoff.
+#:
+#: EVERY ``wire.OP_*`` must appear in exactly one of these two sets —
+#: drl-check's ``wire-idempotency`` rule enforces it, so a future op
+#: cannot silently become post-send-retry-unsafe by omission.
 _IDEMPOTENT_OPS = frozenset((
     wire.OP_PEEK, wire.OP_PING, wire.OP_METRICS, wire.OP_PLACEMENT,
     wire.OP_PLACEMENT_ANNOUNCE, wire.OP_MIGRATE_PULL,
-    wire.OP_MIGRATE_PUSH))
+    wire.OP_MIGRATE_PUSH, wire.OP_CONFIG))
+
+#: The explicit NOT-idempotent half of the classification: admission
+#: ops double-debit on replay; HELLO re-auth mid-stream is a protocol
+#: error; STATS/TRACES flags mutate measurement windows; SAVE re-queues
+#: a device pull; ACQUIRE_MANY is the bulk admission lane (its retry
+#: surface is connect-phase only, _bulk_io).
+_NON_IDEMPOTENT_OPS = frozenset((
+    wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW, wire.OP_SEMA,
+    wire.OP_SYNC, wire.OP_HELLO, wire.OP_SAVE, wire.OP_STATS,
+    wire.OP_TRACES, wire.OP_ACQUIRE_MANY))
 
 
 class RemoteBucketStore(BucketStore):
@@ -144,6 +158,11 @@ class RemoteBucketStore(BucketStore):
         # frame with its routable "unknown op" error — the OP_METRICS
         # compatibility posture, feature-detected instead of negotiated.
         self._peer_traces = True
+        # Live-config forwarding (runtime/liveconfig.py): translations
+        # learned from "config moved" errors — a call carrying a retired
+        # (a, b) chases exactly one routable error, then every later
+        # call translates up front. {(kind, a, b) → (a, b)}.
+        self._config_fwd: dict[tuple, tuple[float, float]] = {}
 
         # -- resilience (docs/OPERATIONS.md §8, DESIGN.md §11) ---------
         # Bounded, jittered retries. At-most-once for admission: an op
@@ -201,39 +220,81 @@ class RemoteBucketStore(BucketStore):
     # -- background I/O loop ------------------------------------------------
     def _ensure_loop(self) -> asyncio.AbstractEventLoop:
         with self._thread_gate:
-            if self._closed:
-                # Post-close use must fail fast, not resurrect a loop
-                # thread that nothing would ever stop.
-                raise ConnectionError("store client is closed")
-            if self._io_loop is None:
-                loop = asyncio.new_event_loop()
-                ready = threading.Event()
+            return self._ensure_loop_locked()
 
-                def run() -> None:
-                    asyncio.set_event_loop(loop)
-                    self._connect_gate = asyncio.Lock()
-                    ready.set()
-                    loop.run_forever()
+    def _ensure_loop_locked(self) -> asyncio.AbstractEventLoop:
+        # _thread_gate held by the caller.
+        if self._closed:
+            # Post-close use must fail fast, not resurrect a loop
+            # thread that nothing would ever stop.
+            raise ConnectionError("store client is closed")
+        if self._io_loop is None:
+            loop = asyncio.new_event_loop()
+            ready = threading.Event()
 
-                t = threading.Thread(
-                    target=run, name="remote-bucket-store-io", daemon=True
-                )
-                t.start()
-                ready.wait()
-                self._io_loop = loop
-                self._io_thread = t
-            return self._io_loop
+            def run() -> None:
+                asyncio.set_event_loop(loop)
+                self._connect_gate = asyncio.Lock()
+                ready.set()
+                loop.run_forever()
+                # aclose stopped the loop with _closed already latched.
+                # Anything still here — a task suspended in a retry
+                # backoff at stop time, a coroutine a racing _submit
+                # enqueued behind the stop — would leave its caller
+                # waiting FOREVER on a future nothing resolves (the
+                # rolling-restart replace_node lane acloses LIVE nodes
+                # mid-traffic, where this race is routine, not
+                # theoretical). Flush the callback queue, cancel what
+                # remains, and let the cancellations deliver: every
+                # waiter gets a terminal result instead of a hang.
+                for _ in range(8):
+                    loop.run_until_complete(asyncio.sleep(0))
+                    leftovers = asyncio.all_tasks(loop)
+                    if not leftovers:
+                        break
+                    for task in leftovers:
+                        task.cancel()
+                    loop.run_until_complete(asyncio.gather(
+                        *leftovers, return_exceptions=True))
+
+            t = threading.Thread(
+                target=run, name="remote-bucket-store-io", daemon=True
+            )
+            t.start()
+            ready.wait()
+            self._io_loop = loop
+            self._io_thread = t
+        return self._io_loop
 
     def _submit(self, coro) -> "asyncio.Future":
-        try:
-            loop = self._ensure_loop()
-        except Exception:
-            coro.close()  # never-awaited otherwise (post-close fast-fail)
-            raise
-        return asyncio.run_coroutine_threadsafe(coro, loop)
+        # The whole submit runs under the gate aclose takes to latch
+        # _closed: a submission either sees _closed (fast-fail below)
+        # or lands in the loop's queue BEFORE aclose's shutdown+stop
+        # callbacks — never behind the stop, where it would sit
+        # unstarted forever.
+        with self._thread_gate:
+            try:
+                loop = self._ensure_loop_locked()
+            except Exception:
+                coro.close()  # never-awaited otherwise (post-close
+                raise         # fast-fail)
+            return asyncio.run_coroutine_threadsafe(coro, loop)
 
     async def _await_on_io(self, coro):
-        return await asyncio.wrap_future(self._submit(coro))
+        fut = self._submit(coro)
+        try:
+            return await asyncio.wrap_future(fut)
+        except asyncio.CancelledError:
+            # The I/O loop's shutdown drain cancels work it abandoned
+            # (see _ensure_loop_locked): surface that as the same typed
+            # connection error every other post-close path raises, not
+            # a bare cancellation the caller never asked for. A
+            # genuinely caller-driven cancel (client still open)
+            # re-raises untouched.
+            if self._closed and fut.cancelled():
+                raise ConnectionError(
+                    "store client is closed") from None
+            raise
 
     # -- connection lifecycle (on the I/O loop) -----------------------------
     async def connect(self) -> None:
@@ -672,9 +733,15 @@ class RemoteBucketStore(BucketStore):
                            with_remaining: bool = True,
                            timeout_s: "float | None" = None
                            ) -> BulkAcquireResult:
-        return await self._bulk_call(keys, counts, capacity,
-                                     fill_rate_per_sec, with_remaining,
-                                     wire.BULK_KIND_BUCKET, timeout_s)
+        # One config-moved chase, like the scalar lanes: the server
+        # answers a retired config frame-level without applying any row,
+        # so the translated re-send is not a replay.
+        return await self._chase_config(
+            "bucket", capacity, fill_rate_per_sec,
+            lambda a, b: self._bulk_call(keys, counts, a, b,
+                                         with_remaining,
+                                         wire.BULK_KIND_BUCKET,
+                                         timeout_s))
 
     def acquire_many_blocking(self, keys: Sequence[str],
                               counts: Sequence[int], capacity: float,
@@ -682,9 +749,11 @@ class RemoteBucketStore(BucketStore):
                               with_remaining: bool = True,
                               timeout_s: "float | None" = None
                               ) -> BulkAcquireResult:
-        return self._bulk_call_blocking(keys, counts, capacity,
-                                        fill_rate_per_sec, with_remaining,
-                                        wire.BULK_KIND_BUCKET, timeout_s)
+        return self._chase_config_blocking(
+            "bucket", capacity, fill_rate_per_sec,
+            lambda a, b: self._bulk_call_blocking(
+                keys, counts, a, b, with_remaining,
+                wire.BULK_KIND_BUCKET, timeout_s))
 
     async def window_acquire_many(self, keys: Sequence[str],
                                   counts: Sequence[int], limit: float,
@@ -693,9 +762,11 @@ class RemoteBucketStore(BucketStore):
                                   ) -> BulkAcquireResult:
         """Bulk windows over the wire: same ACQUIRE_MANY framing with the
         table-kind flag selecting the server's window tier."""
-        return await self._bulk_call(
-            keys, counts, limit, window_sec, with_remaining,
-            wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW)
+        kind = wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW
+        return await self._chase_config(
+            liveconfig.BULK_KINDS[kind], limit, window_sec,
+            lambda a, b: self._bulk_call(keys, counts, a, b,
+                                         with_remaining, kind))
 
     def window_acquire_many_blocking(self, keys: Sequence[str],
                                      counts: Sequence[int], limit: float,
@@ -703,9 +774,11 @@ class RemoteBucketStore(BucketStore):
                                      fixed: bool = False,
                                      with_remaining: bool = True
                                      ) -> BulkAcquireResult:
-        return self._bulk_call_blocking(
-            keys, counts, limit, window_sec, with_remaining,
-            wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW)
+        kind = wire.BULK_KIND_FWINDOW if fixed else wire.BULK_KIND_WINDOW
+        return self._chase_config_blocking(
+            liveconfig.BULK_KINDS[kind], limit, window_sec,
+            lambda a, b: self._bulk_call_blocking(
+                keys, counts, a, b, with_remaining, kind))
 
     def _blocking_timeout(self, timeout_s: "float | None" = None) -> float:
         """Grace timeout for a blocking ``.result()`` wait: the request
@@ -797,6 +870,93 @@ class RemoteBucketStore(BucketStore):
                 tspan.set_status("denied")
             return res
 
+    # -- live-config forwarding (runtime/liveconfig.py) ----------------------
+    def _fwd_config(self, kind: str, a: float, b: float
+                    ) -> tuple[float, float]:
+        """Translate a possibly-retired config through the learned
+        forwarding rules (cycle-safe — a REVERTED mutation can leave a
+        stale entry whose target maps back; the walk stops at the first
+        revisit, which IS the currently-serving config). The steady
+        state is one empty-dict truthiness test."""
+        fwd = self._config_fwd
+        if not fwd:
+            return a, b
+        key = (kind, float(a), float(b))
+        seen = set()
+        while key not in seen:
+            seen.add(key)
+            nxt = fwd.get(key)
+            if nxt is None:
+                break
+            key = (kind, nxt[0], nxt[1])
+        return key[1], key[2]
+
+    def _learn_config(self, exc: Exception, kind: str
+                      ) -> "tuple[float, float] | None":
+        """If ``exc`` is the routable "config moved" error, record the
+        rule and return the (transitively resolved) new operands to
+        retry with; ``None`` for every other error. Safe to retry: the
+        gate answered without touching the store, so the re-send is not
+        a replay (the placement MOVED contract)."""
+        parsed = liveconfig.parse_moved(str(exc))
+        if parsed is None:
+            return None
+        pkind, old, new, _version = parsed
+        if pkind != kind or old == new:
+            return None
+        self._config_fwd[(pkind, old[0], old[1])] = new
+        # A rule old→new contradicts any cached new→old (a revert
+        # retired the cached entry's world): evict it, or the resolve
+        # walk would bounce between the pair instead of landing on the
+        # serving config.
+        if self._config_fwd.get((pkind, new[0], new[1])) == old:
+            del self._config_fwd[(pkind, new[0], new[1])]
+        return self._fwd_config(pkind, new[0], new[1])
+
+    async def _chase_config(self, kind: str, a: float, b: float, call):
+        """THE live-config translation contract, shared by every keyed
+        lane: translate up front through the learned rules, and on the
+        routable "config moved" error learn the rule and re-send ONCE
+        with the new operands (the gate answered without touching the
+        store — not a replay). ``call(a, b)`` awaits the actual wire
+        op."""
+        a, b = self._fwd_config(kind, a, b)
+        try:
+            return await call(a, b)
+        except wire.RemoteStoreError as exc:
+            fwd = self._learn_config(exc, kind)
+            if fwd is None:
+                raise
+            return await call(fwd[0], fwd[1])
+
+    def _chase_config_blocking(self, kind: str, a: float, b: float,
+                               call):
+        a, b = self._fwd_config(kind, a, b)
+        try:
+            return call(a, b)
+        except wire.RemoteStoreError as exc:
+            fwd = self._learn_config(exc, kind)
+            if fwd is None:
+                raise
+            return call(fwd[0], fwd[1])
+
+    async def _keyed_admission(self, op: int, kind: str, key: str,
+                               count: int, a: float, b: float
+                               ) -> AcquireResult:
+        granted, remaining = await self._chase_config(
+            kind, a, b,
+            lambda a2, b2: self._request(op, key, count, a2, b2))
+        return AcquireResult(granted, remaining)
+
+    def _keyed_admission_blocking(self, op: int, kind: str, key: str,
+                                  count: int, a: float, b: float
+                                  ) -> AcquireResult:
+        granted, remaining = self._chase_config_blocking(
+            kind, a, b,
+            lambda a2, b2: self._request_blocking(op, key, count,
+                                                  a2, b2))
+        return AcquireResult(granted, remaining)
+
     # -- BucketStore API ----------------------------------------------------
     # ``timeout_s`` overrides ``request_timeout_s`` for ONE call (the
     # per-call deadline the cluster's breaker probes and latency-bound
@@ -805,6 +965,13 @@ class RemoteBucketStore(BucketStore):
     async def acquire(self, key: str, count: int, capacity: float,
                       fill_rate_per_sec: float, *,
                       timeout_s: "float | None" = None) -> AcquireResult:
+        return await self._chase_config(
+            "bucket", capacity, fill_rate_per_sec,
+            lambda a, b: self._acquire_once(key, count, a, b, timeout_s))
+
+    async def _acquire_once(self, key: str, count: int, capacity: float,
+                            fill_rate_per_sec: float,
+                            timeout_s: "float | None") -> AcquireResult:
         if self._coalesce and timeout_s is None:
             return await self._await_on_io(self._acquire_coalesced_io(
                 key, count, capacity, fill_rate_per_sec,
@@ -817,6 +984,14 @@ class RemoteBucketStore(BucketStore):
     def acquire_blocking(self, key: str, count: int, capacity: float,
                          fill_rate_per_sec: float, *,
                          timeout_s: "float | None" = None) -> AcquireResult:
+        return self._chase_config_blocking(
+            "bucket", capacity, fill_rate_per_sec,
+            lambda a, b: self._acquire_once_blocking(key, count, a, b,
+                                                     timeout_s))
+
+    def _acquire_once_blocking(self, key: str, count: int,
+                               capacity: float, fill_rate_per_sec: float,
+                               timeout_s: "float | None") -> AcquireResult:
         if self._coalesce and timeout_s is None:
             return self._submit(self._acquire_coalesced_io(
                 key, count, capacity, fill_rate_per_sec,
@@ -829,8 +1004,10 @@ class RemoteBucketStore(BucketStore):
 
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
-        (value,) = self._request_blocking(
-            wire.OP_PEEK, key, 0, capacity, fill_rate_per_sec)
+        (value,) = self._chase_config_blocking(
+            "bucket", capacity, fill_rate_per_sec,
+            lambda a, b: self._request_blocking(wire.OP_PEEK, key, 0,
+                                                a, b))
         return value
 
     async def sync_counter(self, key: str, local_count: float,
@@ -870,28 +1047,24 @@ class RemoteBucketStore(BucketStore):
 
     async def window_acquire(self, key: str, count: int, limit: float,
                              window_sec: float) -> AcquireResult:
-        granted, remaining = await self._request(
-            wire.OP_WINDOW, key, count, limit, window_sec)
-        return AcquireResult(granted, remaining)
+        return await self._keyed_admission(wire.OP_WINDOW, "window",
+                                           key, count, limit, window_sec)
 
     def window_acquire_blocking(self, key: str, count: int, limit: float,
                                 window_sec: float) -> AcquireResult:
-        granted, remaining = self._request_blocking(
-            wire.OP_WINDOW, key, count, limit, window_sec)
-        return AcquireResult(granted, remaining)
+        return self._keyed_admission_blocking(
+            wire.OP_WINDOW, "window", key, count, limit, window_sec)
 
     async def fixed_window_acquire(self, key: str, count: int, limit: float,
                                    window_sec: float) -> AcquireResult:
-        granted, remaining = await self._request(
-            wire.OP_FWINDOW, key, count, limit, window_sec)
-        return AcquireResult(granted, remaining)
+        return await self._keyed_admission(wire.OP_FWINDOW, "fwindow",
+                                           key, count, limit, window_sec)
 
     def fixed_window_acquire_blocking(self, key: str, count: int,
                                       limit: float,
                                       window_sec: float) -> AcquireResult:
-        granted, remaining = self._request_blocking(
-            wire.OP_FWINDOW, key, count, limit, window_sec)
-        return AcquireResult(granted, remaining)
+        return self._keyed_admission_blocking(
+            wire.OP_FWINDOW, "fwindow", key, count, limit, window_sec)
 
     async def ping(self, *, timeout_s: "float | None" = None) -> None:
         await self._request(wire.OP_PING, timeout_s=timeout_s)
@@ -989,6 +1162,32 @@ class RemoteBucketStore(BucketStore):
                                          timeout_s=timeout_s)
         return int(applied)
 
+    async def config_fetch(self, *,
+                           timeout_s: "float | None" = None) -> dict:
+        """The node's committed live-config state (``OP_CONFIG`` with an
+        empty payload): ``{"version": v, "rules": […]}`` —
+        ``{"version": 0, "rules": []}`` from a node no mutation has
+        reached yet (runtime/liveconfig.py)."""
+        import json
+
+        (text,) = await self._request(wire.OP_CONFIG, "{}",
+                                      timeout_s=timeout_s)
+        return json.loads(text)
+
+    async def config_announce(self, payload: dict, *,
+                              timeout_s: "float | None" = None) -> int:
+        """Drive one step of a live config mutation on the node:
+        ``{"prepare": rule, "version": v}`` / ``{"commit": v}`` /
+        ``{"abort": v}`` (two-phase; every form idempotent at its
+        version — runtime/liveconfig.py). Returns the node's committed
+        version; stale versions surface as
+        :class:`wire.RemoteStoreError`."""
+        import json
+
+        (version,) = await self._request(
+            wire.OP_CONFIG, json.dumps(payload), timeout_s=timeout_s)
+        return int(version)
+
     async def traces(self, drain: bool = False) -> dict:
         """The server's kept traces as Chrome-trace-event JSON
         (``OP_TRACES``) — the same payload its HTTP ``/traces`` endpoint
@@ -1005,7 +1204,10 @@ class RemoteBucketStore(BucketStore):
     async def aclose(self) -> None:
         if self._closed:
             return
-        self._closed = True
+        with self._thread_gate:
+            # Under the submit gate: every concurrent _submit either
+            # already enqueued (ahead of the stop below) or fails fast.
+            self._closed = True
         loop = self._io_loop
         if loop is None:
             return

@@ -27,7 +27,11 @@ import time
 
 import numpy as np
 
-from distributedratelimiting.redis_tpu.runtime import placement, wire
+from distributedratelimiting.redis_tpu.runtime import (
+    liveconfig,
+    placement,
+    wire,
+)
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.flight_recorder import (
@@ -57,6 +61,12 @@ _PLACEMENT_GATED_OPS = frozenset(
      wire.OP_PEEK, wire.OP_SYNC))
 _ENVELOPE_KIND = {wire.OP_ACQUIRE: "bucket", wire.OP_WINDOW: "window",
                   wire.OP_FWINDOW: "fwindow"}
+#: Keyed ops the live-config gate checks once a rule commits: a frame
+#: carrying a retired ``(a, b)`` answers the routable "config moved"
+#: error so the client re-sends with the new operands. THE table lives
+#: in liveconfig (shared with the native batch lane and the client —
+#: one table, zero drift).
+_CONFIG_GATED_OPS = liveconfig.OP_KINDS
 _BULK_ENVELOPE_KIND = {wire.BULK_KIND_BUCKET: "bucket",
                        wire.BULK_KIND_WINDOW: "window",
                        wire.BULK_KIND_FWINDOW: "fwindow"}
@@ -92,7 +102,8 @@ class BucketStoreServer:
                  heavy_hitters_k: int = 64,
                  flight_dir: str | None = None,
                  flight_capacity: int = 512,
-                 tracing_config: "bool | dict | None" = None) -> None:
+                 tracing_config: "bool | dict | None" = None,
+                 snapshot_incremental: bool = False) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -125,6 +136,20 @@ class BucketStoreServer:
         # BGSAVE writing its configured dump file — clients never supply
         # paths, so the wire cannot be used to write arbitrary files).
         self.snapshot_path = snapshot_path
+        # Incremental checkpoints (docs/OPERATIONS.md §10): OP_SAVE then
+        # writes a v4 delta against the previous save instead of a full
+        # v3 file — the chain manager owns base retention, integrity
+        # chaining, and compaction (runtime/checkpoint.py).
+        self._snapshot_chain = None
+        if snapshot_incremental and snapshot_path is not None:
+            from distributedratelimiting.redis_tpu.runtime.checkpoint import (
+                SnapshotChain,
+            )
+
+            self._snapshot_chain = SnapshotChain(snapshot_path)
+            dirty = getattr(store, "enable_dirty_tracking", None)
+            if callable(dirty):
+                dirty()  # arm the store's dirty accounting (OP_STATS)
         # Shared-secret auth (≙ the AUTH the reference inherits from the
         # Redis Configuration string, …Options.cs:30-40): when set, a
         # connection's first frame must be a HELLO carrying this token.
@@ -180,6 +205,15 @@ class BucketStoreServer:
         # handoff state (docs/OPERATIONS.md §9). Dormant — zero serving
         # cost — until a coordinator announces a map (OP_PLACEMENT_*).
         self.placement = placement.NodePlacementState()
+        # Live-config half (docs/OPERATIONS.md §10): committed forwarding
+        # rules behind OP_CONFIG. Dormant until the first rule commits.
+        self.liveconfig = liveconfig.ConfigState()
+        # Drain-and-handoff shutdown (shutdown()): while a drain is in
+        # flight, admission ops serve from this bounded fair-share
+        # envelope instead of the (already exported) store.
+        self._drain_envelope: "placement._FairShareEnvelope | None" = None
+        self._drain_deadline = 0.0
+        self._shutdown_done = False
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -429,6 +463,18 @@ class BucketStoreServer:
                       "pushes_duplicate", "rows_imported", "aborts",
                       "expired_aborts", "announces", "stale_announces"})
         reg.register_numeric_dict(
+            "config", "live-config mutation state",
+            lambda: (self.liveconfig.stats()
+                     if (self.liveconfig.active
+                         or self.liveconfig.version) else None),
+            counters={"moved_errors", "commits", "aborts",
+                      "stale_announces", "rebased_rows"})
+        reg.register_numeric_dict(
+            "snapshot_chain", "incremental checkpoint chain",
+            lambda: (self._snapshot_chain.stats()
+                     if self._snapshot_chain is not None else None),
+            counters={"full_saves", "delta_saves"})
+        reg.register_numeric_dict(
             "trace", "distributed tracer",
             lambda: (self.tracer.snapshot()
                      if self.tracer.enabled else None),
@@ -675,6 +721,23 @@ class BucketStoreServer:
                 # stores iterate the view like the list they used to get.
                 seq, keys, counts, a, b, with_rem, kind = (
                     wire.decode_bulk_request(body, as_view=True))
+                if self.liveconfig.active:
+                    # Frame-level config gate: one (kind, a, b) decides a
+                    # whole bulk frame, so one probe covers every row —
+                    # a retired config answers the routable moved error
+                    # (no row was applied) and the client re-sends the
+                    # frame with the new operands.
+                    ckind = liveconfig.BULK_KINDS.get(kind)
+                    fwd = (self.liveconfig.forward(ckind, a, b)
+                           if ckind is not None else None)
+                    if fwd is not None:
+                        return wire.encode_response(
+                            seq, wire.RESP_ERROR,
+                            self.liveconfig.moved(ckind, a, b, fwd))
+                env = self._drain_envelope
+                if env is not None:
+                    return self._serve_bulk_draining(
+                        seq, keys, counts, a, b, with_rem, kind, env)
                 gate = (self.placement.bulk_gate(keys)
                         if self.placement.active else None)
                 if gate is not None and gate[2].any():
@@ -704,6 +767,33 @@ class BucketStoreServer:
                 return wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
             seq, op, key, count, a, b = wire.decode_request(body)
+            if self.liveconfig.active and op in _CONFIG_GATED_OPS:
+                fwd = self.liveconfig.forward(_CONFIG_GATED_OPS[op], a, b)
+                if fwd is not None:
+                    # Retired config: routable moved error, store
+                    # untouched — the client re-sends once with the new
+                    # operands and caches the translation (the placement
+                    # MOVED posture; DESIGN.md §13).
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        self.liveconfig.moved(_CONFIG_GATED_OPS[op],
+                                              a, b, fwd))
+            env = self._drain_envelope
+            if env is not None and op in _PLACEMENT_GATED_OPS:
+                ekind = _ENVELOPE_KIND.get(op)
+                if ekind is not None and count >= 0:
+                    # Draining: the store's balances already shipped to
+                    # the successor — admission serves the bounded
+                    # fair-share envelope the export withheld, exactly
+                    # the mid-handoff parked-key treatment.
+                    granted, remaining = env.acquire(key, count, a, b,
+                                                     ekind)
+                    return wire.encode_response(
+                        seq, wire.RESP_DECISION, granted, remaining)
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    f"{placement.HANDOFF_DEFERRAL_PREFIX}: server is "
+                    "draining to its successor; retry shortly")
             if self.placement.active and op in _PLACEMENT_GATED_OPS:
                 verdict = self.placement.gate(key)
                 if verdict is not None:
@@ -804,11 +894,20 @@ class BucketStoreServer:
                         # cluster's current epoch (placement.py).
                         epoch = (self.placement.epoch
                                  if self.placement.active else None)
-                        self._save_task = asyncio.ensure_future(
-                            asyncio.to_thread(
-                                checkpoint.save_snapshot, self.store,
-                                self.snapshot_path,
-                                placement_epoch=epoch))
+                        if self._snapshot_chain is not None:
+                            # Incremental: a v4 delta against the last
+                            # save (the chain compacts to a full base
+                            # on its own thresholds).
+                            self._save_task = asyncio.ensure_future(
+                                asyncio.to_thread(
+                                    self._snapshot_chain.save,
+                                    self.store, epoch))
+                        else:
+                            self._save_task = asyncio.ensure_future(
+                                asyncio.to_thread(
+                                    checkpoint.save_snapshot, self.store,
+                                    self.snapshot_path,
+                                    placement_epoch=epoch))
                     await asyncio.shield(self._save_task)
                     resp = wire.encode_response(seq, wire.RESP_EMPTY)
             elif op == wire.OP_STATS:
@@ -875,6 +974,20 @@ class BucketStoreServer:
                                                     self.store)
                 resp = wire.encode_response(seq, wire.RESP_VALUE,
                                             float(applied))
+            elif op == wire.OP_CONFIG:
+                import json
+
+                payload = json.loads(key)
+                if not payload:
+                    resp = wire.encode_response(
+                        seq, wire.RESP_TEXT, json.dumps(
+                            self.liveconfig.snapshot_payload()))
+                else:
+                    await faults.seam("server.config")
+                    version = await self.liveconfig.announce(
+                        payload, self.store)
+                    resp = wire.encode_response(seq, wire.RESP_VALUE,
+                                                float(version))
             elif op == wire.OP_TRACES:
                 # Chrome-trace JSON capped under MAX_FRAME (newest traces
                 # win); flag bit 0 drains the buffer after export.
@@ -933,6 +1046,172 @@ class BucketStoreServer:
                 remaining[i] = rem
         return BulkAcquireResult(granted, remaining)
 
+    def _serve_bulk_draining(self, seq: int, keys, counts, a: float,
+                             b: float, with_rem: bool, kind: int,
+                             env) -> bytes:
+        """One bulk frame while the drain is in flight: every row serves
+        from the shutdown envelope (the store's balances already shipped
+        to the successor). Row order is preserved; SEMA never reaches
+        here (bulk frames carry admission kinds only)."""
+        ekind = _BULK_ENVELOPE_KIND[kind]
+        n = len(keys)
+        counts_np = np.asarray(counts, np.int64)
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32) if with_rem else None
+        for i in range(n):
+            g, rem = env.acquire(keys[i], int(counts_np[i]), a, b, ekind)
+            granted[i] = g
+            if remaining is not None:
+                remaining[i] = rem
+        return wire.encode_bulk_response(seq, granted, remaining)
+
+    # -- drain-and-handoff shutdown (docs/OPERATIONS.md §10) ----------------
+    async def shutdown(self, successor=None, *, window_s: float = 2.0,
+                       envelope_fraction: float =
+                       placement.DEFAULT_ENVELOPE_FRACTION) -> dict:
+        """Planned shutdown that ships state instead of wiping it.
+
+        With a ``successor`` store (any :class:`~.store.BucketStore` —
+        typically a :class:`~.remote.RemoteBucketStore` at the new
+        process), this reuses the migration handoff lane end to end:
+        the whole keyspace is exported with the fair-share envelope
+        debit applied, the local store is charged for the shipped
+        amount (:func:`placement.debit_source` — the dual-ownership
+        bound holds even if this process lingers), in-flight and
+        late-arriving admission traffic serves from the withheld
+        envelope for at most ``window_s``, and the exact remainder
+        lands on the successor through the MIGRATE_PUSH import lane
+        (batch-deduped — a retried push cannot double-apply).
+
+        With no successor, the final state goes to the configured
+        snapshot path instead (through the incremental chain when one
+        is armed) — the restarted process restores it and no state is
+        dropped. Returns a summary dict; idempotent once COMPLETE: a
+        failed drain re-opens for retry after falling back to a final
+        checkpoint (when one is configured) — the state must land
+        somewhere."""
+        if self._shutdown_done:
+            return {"already": True}
+        self._shutdown_done = True
+        # An OP_SAVE still writing must finish first: SnapshotChain has
+        # no internal lock, and a concurrent final save would interleave
+        # delta links (divergent prev_crc → SnapshotChainError → the
+        # restart falls back to init-on-miss, losing exactly the state
+        # this shutdown exists to keep).
+        if self._save_task is not None and not self._save_task.done():
+            try:
+                await asyncio.shield(self._save_task)
+            # The save's own OP_SAVE caller already saw this failure.
+            # drl-check: ok(swallowed-exception)
+            except Exception:
+                pass
+        try:
+            return await self._shutdown_body(successor, window_s,
+                                             envelope_fraction)
+        except asyncio.CancelledError:
+            self._shutdown_done = False
+            self._drain_envelope = None
+            raise
+        except Exception as exc:
+            # Resume authoritative serving from the (possibly already
+            # debited) store — the migration-abort posture: the residual
+            # IS the envelope, so un-gating under-admits at worst. Left
+            # armed, the envelope would cap this server forever.
+            self._drain_envelope = None
+            if successor is not None and self.snapshot_path is not None:
+                # The drain failed mid-flight (successor unreachable,
+                # push error) AFTER the source debit may have landed:
+                # the shipped-but-unreceived balance must not evaporate.
+                # Final checkpoint is the fallback home; the restarted
+                # process restores it.
+                try:
+                    path = await self._final_checkpoint()
+                except Exception as save_exc:
+                    log.error_evaluating_kernel(save_exc)
+                else:
+                    log.error_evaluating_kernel(exc)
+                    await self.aclose()
+                    return {"shipped_rows": 0, "checkpoint": path,
+                            "drain_error": repr(exc)}
+            self._shutdown_done = False  # retryable — nothing landed
+            raise
+
+    async def _final_checkpoint(self) -> str:
+        from distributedratelimiting.redis_tpu.runtime import checkpoint
+
+        epoch = (self.placement.epoch if self.placement.active else None)
+        if self._snapshot_chain is not None:
+            return await asyncio.to_thread(self._snapshot_chain.save,
+                                           self.store, epoch)
+        await asyncio.to_thread(checkpoint.save_snapshot, self.store,
+                                self.snapshot_path,
+                                placement_epoch=epoch)
+        return self.snapshot_path
+
+    async def _shutdown_body(self, successor, window_s: float,
+                             envelope_fraction: float) -> dict:
+        out: dict = {"shipped_rows": 0, "checkpoint": None}
+        if successor is not None:
+            env = placement._FairShareEnvelope(envelope_fraction)
+            entries = await asyncio.to_thread(
+                placement._export_from_store, self.store, lambda _k: True)
+            export = placement.debit_export(entries, envelope_fraction)
+            # Gate on BEFORE the source debit lands: from here until
+            # aclose, admission serves only the envelope the export
+            # withheld — late requests cannot spend balances the
+            # successor already received.
+            self._drain_envelope = env
+            self._drain_deadline = time.monotonic() + window_s
+            await placement.debit_source(self.store, entries,
+                                         envelope_fraction,
+                                         keep_envelope=True)
+            target_epoch = (self.placement.epoch + 1
+                            if self.placement.active else 1)
+            push = getattr(successor, "migrate_push", None)
+            rows = 0
+            for bid, chunk in enumerate(placement.chunk_entries(export)):
+                if callable(push):
+                    rows += await push({"target_epoch": target_epoch,
+                                        # Namespaced like the cluster's
+                                        # per-source batch ids: drain
+                                        # pushes must never collide with
+                                        # a concurrent migration's.
+                                        "batch": (0xD << 24) | bid,
+                                        "entries": chunk})
+                else:
+                    rows += await placement.import_entries(successor,
+                                                           chunk)
+            if self.liveconfig.active:
+                # The gates ride along: a successor serving the shipped
+                # (already-rebased) state without the forwarding rules
+                # would silently re-open every retired config
+                # init-on-miss — the exact over-admission this shutdown
+                # exists to prevent. Adopt is idempotent + version-
+                # monotonic, so a coordinator-side replay is harmless.
+                ann = getattr(successor, "config_announce", None)
+                if callable(ann):
+                    try:
+                        await ann({"adopt":
+                                   self.liveconfig.snapshot_payload()})
+                        out["config_version"] = self.liveconfig.version
+                    except Exception as exc:
+                        log.error_evaluating_kernel(exc)
+                        out["config_forward_error"] = repr(exc)
+            out["shipped_rows"] = rows
+            # Linger for the rest of the handoff window serving the
+            # envelope: in-flight and stale-mapped clients get bounded
+            # answers instead of connection resets, and the window is
+            # the documented epsilon term — the same accounting as a
+            # migration's parked keys (DESIGN.md §13).
+            linger = self._drain_deadline - time.monotonic()
+            if linger > 0:
+                await asyncio.sleep(linger)
+            out["envelope_decisions"] = env.decisions
+        elif self.snapshot_path is not None:
+            out["checkpoint"] = await self._final_checkpoint()
+        await self.aclose()
+        return out
+
     def _stats_json(self) -> str:
         import json
 
@@ -988,6 +1267,13 @@ class BucketStoreServer:
             payload["stages"] = stages
         if self.placement.active:
             payload["placement"] = self.placement.stats()
+        if self.liveconfig.active or self.liveconfig.version:
+            payload["config"] = self.liveconfig.stats()
+        if self._snapshot_chain is not None:
+            payload["snapshot_chain"] = self._snapshot_chain.stats()
+            dirty = getattr(self.store, "dirty_stats", None)
+            if callable(dirty):
+                payload["snapshot_chain"]["dirty"] = dirty()
         if self.heavy_hitters is not None:
             payload["hot_keys"] = self.heavy_hitters.snapshot()
         if self.flight_recorder is not None:
@@ -1070,7 +1356,21 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--snapshot-path", default=None,
                         help="checkpoint file for OP_SAVE (≙ Redis BGSAVE "
                         "dump path); if it exists at startup, the store "
-                        "restores from it")
+                        "restores from it (any .delta.* chain beside it "
+                        "is applied too)")
+    parser.add_argument("--snapshot-incremental", action="store_true",
+                        help="OP_SAVE writes v4 delta checkpoints "
+                        "against the previous save instead of full "
+                        "files (base + bounded chain + compaction — "
+                        "docs/OPERATIONS.md §10); requires "
+                        "--snapshot-path")
+    parser.add_argument("--drain-to", default=None, metavar="HOST:PORT",
+                        help="on SIGTERM, ship the whole keyspace's "
+                        "state to the successor server at this address "
+                        "through the migration handoff lane before "
+                        "exiting (drain-and-handoff shutdown); without "
+                        "it SIGTERM writes a final checkpoint to "
+                        "--snapshot-path when one is configured")
     parser.add_argument("--sweep-period", type=float, default=0.0,
                         help="active TTL-expiry period in seconds "
                         "(0 = on-demand sweeps only; device backend only)")
@@ -1145,6 +1445,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.fe_tier0 and not args.native_frontend:
         parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
                      "admission cache lives inside the C front-end)")
+    if args.snapshot_incremental and not args.snapshot_path:
+        parser.error("--snapshot-incremental requires --snapshot-path "
+                     "(there is no chain without a base file)")
 
     async def serve() -> None:
         if args.backend == "device":
@@ -1182,19 +1485,23 @@ def main(argv: list[str] | None = None) -> None:
 
             if os.path.exists(args.snapshot_path):
                 try:
-                    checkpoint.load_snapshot(
+                    # Chain-aware: applies any .delta.* files beside the
+                    # base (exactly load_snapshot when there are none).
+                    deltas = checkpoint.load_snapshot_chain(
                         store, args.snapshot_path,
                         expected_placement_epoch=(
                             args.expect_placement_epoch))
                 except checkpoint.SnapshotCorruptError as exc:
                     # Documented init-on-miss fallback: a torn snapshot
-                    # must not keep the store down — serve fresh (state
-                    # self-heals to full buckets) and say so loudly.
+                    # (or broken delta chain — SnapshotChainError folds
+                    # in here) must not keep the store down — serve
+                    # fresh (state self-heals) and say so loudly.
                     print(f"WARNING: ignoring corrupt snapshot: {exc}\n"
                           "starting with empty state (init-on-miss)",
                           flush=True)
                 else:
-                    print(f"restored snapshot from {args.snapshot_path}",
+                    print(f"restored snapshot from {args.snapshot_path}"
+                          + (f" (+{deltas} deltas)" if deltas else ""),
                           flush=True)
         if args.sweep_period > 0 and hasattr(store, "start_sweeper"):
             store.start_sweeper(args.sweep_period)
@@ -1224,15 +1531,43 @@ def main(argv: list[str] | None = None) -> None:
                                        "latency_threshold_s":
                                            args.trace_latency_ms / 1e3,
                                        "max_traces": args.trace_buffer,
-                                   } if args.trace else None)
+                                   } if args.trace else None,
+                                   snapshot_incremental=(
+                                       args.snapshot_incremental))
         host, port = await server.start()
         print(f"bucket-store server listening on {host}:{port}", flush=True)
         if server.metrics_port is not None:
             print(f"metrics exposition on "
                   f"http://{host}:{server.metrics_port}/metrics",
                   flush=True)
+        # SIGTERM = planned shutdown: drain to the successor (or write
+        # the final checkpoint) instead of dying with wiped state.
+        import signal
+
+        term = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, term.set)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main thread / platform without signals
         try:
-            await asyncio.Event().wait()
+            await term.wait()
+            successor = None
+            if args.drain_to:
+                from distributedratelimiting.redis_tpu.runtime.remote import (
+                    RemoteBucketStore,
+                )
+
+                successor = RemoteBucketStore(url=args.drain_to,
+                                              auth_token=args.auth_token)
+            print("SIGTERM: drain-and-handoff shutdown"
+                  + (f" → {args.drain_to}" if args.drain_to else ""),
+                  flush=True)
+            summary = await server.shutdown(successor)
+            print(f"shutdown complete: {summary}", flush=True)
+            if successor is not None:
+                await successor.aclose()
         finally:
             await server.aclose()
             await store.aclose()

@@ -509,6 +509,18 @@ class _PackedLaunchMixin:
     counts are mixed fall back to per-row entries with exact cumulative
     prefixes."""
 
+    #: Dirty-slot accounting for incremental checkpoints
+    #: (runtime/checkpoint.py v4; docs/OPERATIONS.md §10): ``None`` —
+    #: the default, zero hot-path cost — until the owning store's
+    #: ``enable_dirty_tracking()`` arms it with a set. Every slot a
+    #: launch resolves lands here (slightly over-inclusive: a denied
+    #: request still refreshes its slot's refill timestamp, so resolved
+    #: ≈ written), giving OP_STATS the dirty/total ratio that predicts
+    #: the next delta's size. The delta itself is computed by structural
+    #: diff, not this set — forgetting a site here can never corrupt a
+    #: checkpoint, only misreport the gauge.
+    dirty_slots: "set[int] | None" = None
+
     async def _flush(self, reqs: Sequence[_AcquireReq]) -> list[AcquireResult]:
         groups = (self._coalesce(reqs)
                   if self.store.coalesce_duplicates else None)
@@ -848,6 +860,32 @@ class _PackedLaunchMixin:
         return self._grant_probes(res, counts_np)
 
 
+def _arm_dirty(table) -> None:
+    """Arm one table's dirty accounting (idempotent). Classic tables
+    track the exact host-resolved slot set (``dirty_slots``);
+    fingerprint tables — whose slot placement happens in-kernel, never
+    on host — count dispatched rows instead (``dirty_rows``, a
+    documented upper bound: duplicates re-count)."""
+    if hasattr(table, "dirty_slots"):
+        if table.dirty_slots is None:
+            table.dirty_slots = set()
+    elif hasattr(table, "dirty_rows") and table.dirty_rows is None:
+        table.dirty_rows = 0
+
+
+def _dirty_clear(table) -> None:
+    if getattr(table, "dirty_slots", None) is not None:
+        table.dirty_slots.clear()
+    elif getattr(table, "dirty_rows", None) is not None:
+        table.dirty_rows = 0
+
+
+def _dirty_count(table) -> int:
+    if getattr(table, "dirty_slots", None) is not None:
+        return len(table.dirty_slots)
+    return int(getattr(table, "dirty_rows", None) or 0)
+
+
 class _DeviceTable(_PackedLaunchMixin):
     """One homogeneous-config bucket table: device arrays + host directory."""
 
@@ -883,6 +921,8 @@ class _DeviceTable(_PackedLaunchMixin):
         """Batch key→slot resolution (the host hot path — one native call)."""
         slots = _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow,
                                       min_free=self.n_slots // 16)
+        if self.dirty_slots is not None:
+            self.dirty_slots.update(slots.tolist())
         self._maybe_pregrow()
         return slots
 
@@ -1159,6 +1199,8 @@ class _DeviceWindowTable(_PackedLaunchMixin):
     def resolve_slots(self, keys: list[str]) -> np.ndarray:
         slots = _resolve_with_reclaim(self.dir, keys, self._sweep, self._grow,
                                       min_free=self.n_slots // 16)
+        if self.dirty_slots is not None:
+            self.dirty_slots.update(slots.tolist())
         self._maybe_pregrow()
         return slots
 
@@ -1345,6 +1387,9 @@ class DeviceBucketStore(BucketStore):
         # joined on aclose so process exit never tears XLA down under a
         # mid-compile thread.
         self._bg_threads: set[threading.Thread] = set()
+        # Dirty-slot accounting (incremental checkpoints; see
+        # enable_dirty_tracking) — off by default, zero serving cost.
+        self._dirty_tracking = False
 
     # -- connection lifecycle (lazy, idempotent) ---------------------------
     async def connect(self) -> None:
@@ -1422,6 +1467,8 @@ class DeviceBucketStore(BucketStore):
             if table is None:
                 table = self._TABLE_CLS(self, capacity, fill_rate_per_sec,
                                         self.n_slots_default)
+                if self._dirty_tracking:
+                    _arm_dirty(table)
                 self._tables[key] = table
             return table
 
@@ -1434,6 +1481,8 @@ class DeviceBucketStore(BucketStore):
             if table is None:
                 table = self._WTABLE_CLS(self, limit, wt,
                                            self.n_slots_default, fixed=fixed)
+                if self._dirty_tracking:
+                    _arm_dirty(table)
                 self._wtables[key] = table
             return table
 
@@ -1866,6 +1915,35 @@ class DeviceBucketStore(BucketStore):
             self._bg_threads.difference_update(live)
         self._bg_threads.clear()  # drop finished-thread references
 
+    # -- dirty accounting (incremental checkpoints; OPERATIONS.md §10) ------
+    def enable_dirty_tracking(self) -> None:
+        """Arm per-table dirty accounting: between two saves, every
+        launched-upon slot is counted, so OP_STATS can report the
+        dirty/total ratio that predicts the next v4 delta's size
+        (runtime/checkpoint.py). Observability only — the delta itself
+        is a structural diff, correct with or without this. Counter and
+        semaphore tiers are deliberately untracked: their state is a
+        handful of fixed arrays, noise next to the key tables."""
+        with self._lock:
+            self._dirty_tracking = True
+            for t in (*self._tables.values(), *self._wtables.values()):
+                _arm_dirty(t)
+
+    def mark_snapshot_base(self) -> None:
+        """Start a fresh dirty window (called by the checkpoint chain at
+        every save — the window is save-to-save)."""
+        with self._lock:
+            for t in (*self._tables.values(), *self._wtables.values()):
+                _dirty_clear(t)
+
+    def dirty_stats(self) -> dict:
+        with self._lock:
+            dirty = total = 0
+            for t in (*self._tables.values(), *self._wtables.values()):
+                dirty += _dirty_count(t)
+                total += t.n_slots
+            return {"dirty": dirty, "total": total}
+
     def snapshot(self) -> dict:
         """Pull all live state to host (planned-restart checkpoint).
         ``now_ticks`` is captured so restore into a *different* process
@@ -1950,6 +2028,9 @@ class InProcessBucketStore(BucketStore):
         self._windows: dict[tuple, tuple[float, float, int]] = {}
         self._semas: dict[str, int] = {}                     # active permits
         self._connected = False
+        # Dirty-key accounting for incremental checkpoints (OPERATIONS.md
+        # §10) — None (one falsy check per write) until armed.
+        self._dirty: "set | None" = None
 
     async def connect(self) -> None:
         self._connected = True
@@ -1966,6 +2047,8 @@ class InProcessBucketStore(BucketStore):
             refilled = min(float(capacity), tokens + max(0, now - ts) * rate)
         granted = refilled >= count
         self._buckets[bkey] = (refilled - (count if granted else 0), now)
+        if self._dirty is not None:
+            self._dirty.add(bkey)
         return AcquireResult(granted, self._buckets[bkey][0])
 
     async def acquire(self, key, count, capacity, fill_rate_per_sec):
@@ -2048,6 +2131,8 @@ class InProcessBucketStore(BucketStore):
                                tokens + max(0, now - ts) * rate)
             applied = min(amt, max(refilled, 0.0))
             self._buckets[bkey] = (refilled - applied, now)
+            if self._dirty is not None:
+                self._dirty.add(bkey)
             remaining[i] = refilled - applied
             shortfall[i] = amt - applied
         return remaining, shortfall
@@ -2067,6 +2152,8 @@ class InProcessBucketStore(BucketStore):
             v = max(0.0, v0 - delta * rate) + local_count
             p = (1 - bm.PERIOD_EWMA_ALPHA) * p0 + bm.PERIOD_EWMA_ALPHA * delta
         self._counters[key] = (v, p, now)
+        if self._dirty is not None:
+            self._dirty.add(key)
         return SyncResult(v, p)
 
     async def concurrency_acquire(self, key, count, limit):
@@ -2077,6 +2164,8 @@ class InProcessBucketStore(BucketStore):
         if active + count <= limit:
             if count > 0:  # count == 0 is a read-only probe
                 self._semas[key] = active + count
+                if self._dirty is not None:
+                    self._dirty.add(key)
             return AcquireResult(True, float(active + count))
         return AcquireResult(False, float(active))
 
@@ -2087,6 +2176,8 @@ class InProcessBucketStore(BucketStore):
         if key not in self._semas:
             return  # unknown key: nothing to release, create nothing
         self._semas[key] = max(0, self._semas[key] - count)
+        if self._dirty is not None:
+            self._dirty.add(key)
 
     async def window_acquire(self, key, count, limit, window_sec):
         return self.window_acquire_blocking(key, count, limit, window_sec)
@@ -2127,6 +2218,8 @@ class InProcessBucketStore(BucketStore):
         if granted:
             curr += count
         self._windows[wkey] = (prev, curr, idx_now)
+        if self._dirty is not None:
+            self._dirty.add(wkey)
         return AcquireResult(granted, max(0.0, limit - est - (count if granted else 0)))
 
     async def aclose(self) -> None:
@@ -2151,6 +2244,8 @@ class InProcessBucketStore(BucketStore):
             else:
                 self._buckets[bkey] = (min(entry[0], float(tokens)),
                                        max(entry[1], ts))
+            if self._dirty is not None:
+                self._dirty.add(bkey)
             n += 1
         for key, limit, wt, interp, prev, curr, behind in \
                 entries.get("windows", ()):
@@ -2166,17 +2261,42 @@ class InProcessBucketStore(BucketStore):
                     min(float(limit), entry[0] + float(prev)),
                     min(float(limit), entry[1] + float(curr)), idx)
             # a stale PUSHED window (older idx) carries no usage to keep
+            if self._dirty is not None:
+                self._dirty.add(wkey)
             n += 1
         for key, value, period, age in entries.get("counters", ()):
             entry = self._counters.get(key)
             if entry is None or entry[0] < value:
                 self._counters[key] = (float(value), float(period),
                                        now - int(age))
+            if self._dirty is not None:
+                self._dirty.add(key)
             n += 1
         for key, active in entries.get("semas", ()):
             self._semas[key] = max(self._semas.get(key, 0), int(active))
+            if self._dirty is not None:
+                self._dirty.add(key)
             n += 1
         return n
+
+    # -- dirty accounting (incremental checkpoints; OPERATIONS.md §10) ------
+    def enable_dirty_tracking(self) -> None:
+        """Arm exact per-entry dirty accounting (the device store's
+        counterpart tracks slots): between two saves every written entry
+        key is counted, so OP_STATS reports the dirty/total ratio that
+        predicts the next v4 delta's size. Observability only — the
+        delta is a structural diff either way."""
+        if self._dirty is None:
+            self._dirty = set()
+
+    def mark_snapshot_base(self) -> None:
+        if self._dirty is not None:
+            self._dirty.clear()
+
+    def dirty_stats(self) -> dict:
+        return {"dirty": len(self._dirty or ()),
+                "total": (len(self._buckets) + len(self._windows)
+                          + len(self._counters) + len(self._semas))}
 
     def snapshot(self) -> dict:
         return {

@@ -125,7 +125,7 @@ __all__ = [
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
     "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
-    "OP_MIGRATE_PUSH", "TEXT_OPS",
+    "OP_MIGRATE_PUSH", "OP_CONFIG", "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
@@ -180,12 +180,20 @@ OP_MIGRATE_PUSH = 17  # new owner: import one handoff batch —
 # [u32 mlen][json {target_epoch, batch, entries}] → RESP_VALUE rows
 # applied. Exactly-once per (target_epoch, batch): a re-delivered batch
 # is a counted no-op, never a double-apply.
+OP_CONFIG = 18  # live config mutation (runtime/liveconfig.py, round 7;
+# OP_METRICS posture — a new op on the existing frame layout, routable
+# unknown-op error from old servers): [u32 mlen][json] where {} fetches
+# the committed rules (RESP_TEXT), {"prepare": rule, "version": v} /
+# {"commit": v} / {"abort": v} drive the two-phase mutation
+# (RESP_VALUE committed version). Version-monotonic and idempotent at
+# every form — the OP_PLACEMENT_ANNOUNCE discipline — so post-send
+# retries are always safe.
 
 #: Control ops whose request payload is one u32-length-prefixed UTF-8
 #: JSON text (rides in the ``key`` slot of encode/decode_request —
 #: ensure_ascii JSON, so the strict codec never meets a surrogate).
 TEXT_OPS = frozenset((OP_PLACEMENT_ANNOUNCE, OP_MIGRATE_PULL,
-                      OP_MIGRATE_PUSH))
+                      OP_MIGRATE_PUSH, OP_CONFIG))
 
 #: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
 #: payload. Only sampled requests carry it; an old server answers the
@@ -232,6 +240,7 @@ _OP_NAMES = {
     OP_PLACEMENT_ANNOUNCE: "placement_announce",
     OP_MIGRATE_PULL: "migrate_pull",
     OP_MIGRATE_PUSH: "migrate_push",
+    OP_CONFIG: "config",
 }
 
 

@@ -72,9 +72,20 @@ def breaker_transition(node: int, old: str, new: str) -> None:
 
 
 def cluster_migration(event: dict) -> None:
-    """Event id 5 — a membership migration committed or aborted. The
-    event dict is the same record ``ClusterBucketStore.migration_log``
-    keeps (type, reason, epochs, moved slots/keys, window times)."""
+    """Event id 5 — a membership migration or live config mutation
+    committed or aborted. The event dict is the same record
+    ``ClusterBucketStore.migration_log`` keeps (migrations: type,
+    reason, epochs, moved slots/keys, window times; config mutations:
+    kind, old/new operands, version)."""
+    if str(event.get("type", "")).startswith("config"):
+        logger.warning(
+            "Cluster config %s: %s %s -> %s (version %s)",
+            event.get("type"), event.get("kind"), event.get("old"),
+            event.get("new"), event.get("version"),
+            extra={"event_id": EVENT_CLUSTER_MIGRATION,
+                   "migration": dict(event)},
+        )
+        return
     logger.warning(
         "Cluster migration %s: %s -> epoch %s (%s)",
         event.get("type"), event.get("from_epoch"),

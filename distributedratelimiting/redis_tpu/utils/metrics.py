@@ -192,6 +192,11 @@ class Tier0Metrics:
     #: monotonic timestamp of the last successful sync (0 = never) —
     #: ``last_sync_age_s`` in snapshots is the staleness gauge.
     last_sync_mono: float = 0.0
+    #: Harvested rows whose (cap, rate) a live config mutation retired
+    #: mid-flight: their debits re-routed to the replacement config and
+    #: the replica's headroom for the old config was zeroed
+    #: (docs/OPERATIONS.md §10).
+    retired_config_rows: int = 0
 
     def record_sync(self, n_keys: int, shortfalls, now_mono: float) -> None:
         self.syncs += 1
@@ -210,6 +215,7 @@ class Tier0Metrics:
             "keys_synced": self.keys_synced,
             "overadmit_total": self.overadmit_total,
             "overadmit_max": self.overadmit_max,
+            "retired_config_rows": self.retired_config_rows,
             "last_sync_age_s": (now_mono - self.last_sync_mono
                                 if self.last_sync_mono else -1.0),
         }

@@ -331,6 +331,16 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.has_tier0 = True
     except AttributeError:  # stale binary without the tier-0 ABI
         lib.has_tier0 = False
+    try:
+        # Round 7 (live config mutation): retire one (cap, rate)
+        # config's replicas, returning their un-harvested grants.
+        lib.fe_t0_retire.argtypes = [
+            c.c_void_p, c.c_double, c.c_double, c.c_char_p, c.c_int,
+            c.POINTER(c.c_int32), c.POINTER(c.c_double), c.c_int]
+        lib.fe_t0_retire.restype = c.c_int
+        lib.has_t0_retire = True
+    except AttributeError:  # stale binary without the retire ABI
+        lib.has_t0_retire = False
     return lib
 
 

@@ -158,6 +158,12 @@ constexpr uint8_t OP_PLACEMENT = 14;
 constexpr uint8_t OP_PLACEMENT_ANNOUNCE = 15;
 constexpr uint8_t OP_MIGRATE_PULL = 16;
 constexpr uint8_t OP_MIGRATE_PUSH = 17;
+// Live config mutation (wire.py, round 7): control-plane, never hot —
+// passthrough like the placement ops. The CONFIG GATE for the fast
+// lanes lives in Python (_serve_batch answers retired-config rows with
+// the routable "config moved" error; the tier-0 sync pump re-routes a
+// retired config's debits and zeroes its replica headroom).
+constexpr uint8_t OP_CONFIG = 18;
 
 // Op-byte bit 7 (wire.py TRACE_FLAG): a 25-byte trace tail —
 // [u64 trace_hi][u64 trace_lo][u64 parent span][u8 flags] — follows the
@@ -840,8 +846,9 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
       case OP_PLACEMENT_ANNOUNCE:
       case OP_MIGRATE_PULL:
       case OP_MIGRATE_PUSH:
+      case OP_CONFIG:
       default: {
-        // Placement/migration control ops, HELLO, PEEK, SYNC, STATS,
+        // Placement/migration/config control ops, HELLO, PEEK, SYNC, STATS,
         // SAVE, ACQUIRE_MANY, unknown: Python decides (including the
         // unknown-op error) — the wire module stays the single
         // authority for every non-hot shape.
@@ -1511,6 +1518,40 @@ void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
     e->last_ack_ns = now;
     e->last_touch_ns = now;
   }
+}
+
+// Live config mutation (round 7): kill every replica of one retired
+// (cap, rate) config and hand back its un-harvested local grants —
+// [key_blob/klens/amounts rows, like fe_t0_harvest] — so the sync pump
+// debits them through the REPLACEMENT config. One call under the lock:
+// no grant can slip between the harvest and the kill. Without the kill,
+// stale frames would keep being admitted (or confidently denied)
+// against a table nobody serves from anymore; dead entries make them
+// fall through to the batch lane's routable "config moved" error.
+// Returns the number of rows written (entries with pending > 0); every
+// matching entry is dead on return regardless.
+int fe_t0_retire(void* h, double cap, double rate, char* key_blob,
+                 int blob_cap, int32_t* klens, double* amounts,
+                 int max_keys) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  int n = 0;
+  int off = 0;
+  for (T0Entry& e : fe->t0tab) {
+    if (!e.live || e.cap != cap || e.rate != rate) continue;
+    if (e.pending > 0.0 && n < max_keys &&
+        off + int(e.key.size()) <= blob_cap) {
+      std::memcpy(key_blob + off, e.key.data(), e.key.size());
+      klens[n] = int32_t(e.key.size());
+      amounts[n] = e.pending;
+      off += int(e.key.size());
+      n++;
+    }
+    e.live = false;
+    e.pending = 0.0;
+    fe->t0_evictions++;
+  }
+  return n;
 }
 
 // out[6]: hits, local denies, misses, installs, evictions, live entries.

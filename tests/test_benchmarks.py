@@ -44,3 +44,25 @@ def test_two_level_global_tier_accumulates():
     # minimum (earlier steps decay).
     assert result["global_score_after"] > 0
     assert result["n_devices"] >= 1
+
+
+def test_recapture_debt_ledger_semantics(tmp_path):
+    """The device-bench debt list (benchmarks/recapture.py): debts are
+    owed until an `ok` row that SETTLES lands in the ledger — CPU
+    stand-in rows never settle, and a torn tail row hides nothing."""
+    from benchmarks import recapture
+
+    names = [n for n, _why, _fn in recapture.DEBTS]
+    assert names == ["fp_mesh_fixed", "fp_bulk_optimized",
+                     "native_fe_device_sweep"]
+    ledger = tmp_path / "recapture.jsonl"
+    assert recapture.owed(ledger) == names  # nothing settled yet
+    recapture._append(ledger, {"debt": names[0], "status": "ok",
+                               "settles_debt": False})  # CPU stand-in
+    assert recapture.owed(ledger) == names
+    recapture._append(ledger, {"debt": names[0], "status": "ok",
+                               "settles_debt": True})  # real device row
+    assert recapture.owed(ledger) == names[1:]
+    with open(ledger, "a", encoding="utf-8") as f:
+        f.write('{"torn json\n')  # a torn tail row must not mask debts
+    assert recapture.owed(ledger) == names[1:]

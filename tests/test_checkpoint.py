@@ -303,3 +303,316 @@ def test_placement_epoch_gate(tmp_path):
                       expected_placement_epoch=3)
     # …but loads fine with no expectation (single-node deployments)
     load_snapshot(InProcessBucketStore(clock=clock), path)
+
+
+# -- v4 incremental delta chains (round 7; docs/OPERATIONS.md §10) -----------
+
+from distributedratelimiting.redis_tpu.runtime.checkpoint import (  # noqa: E402
+    PlacementMismatchError,
+    SnapshotChain,
+    SnapshotChainError,
+    SnapshotCorruptError,
+    load_snapshot_chain,
+)
+
+
+def _chain_store(clock, n=0):
+    s = InProcessBucketStore(clock=clock)
+    for i in range(n):
+        s.acquire_blocking(f"k{i}", 1, 100.0, 0.0)
+    return s
+
+
+def test_chain_roundtrip_preserves_decisions(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 8)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    assert chain.save(s) == path  # first save is the base
+    s.acquire_blocking("k0", 50, 100.0, 0.0)
+    p1 = chain.save(s)
+    assert p1 == path + ".delta.1"
+    s.acquire_blocking("k1", 99, 100.0, 0.0)
+    assert chain.save(s) == path + ".delta.2"
+
+    s2 = InProcessBucketStore(clock=clock)
+    assert load_snapshot_chain(s2, path) == 2
+    # exact balances survive base + 2 deltas
+    assert s2.peek_blocking("k0", 100.0, 0.0) == 49.0
+    assert s2.peek_blocking("k1", 100.0, 0.0) == 0.0
+    assert s2.peek_blocking("k7", 100.0, 0.0) == 99.0
+
+
+def test_chain_loader_without_deltas_is_plain_load(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 3)
+    path = str(tmp_path / "snap.bin")
+    save_snapshot(s, path)
+    s2 = InProcessBucketStore(clock=clock)
+    assert load_snapshot_chain(s2, path) == 0
+    assert s2.peek_blocking("k1", 100.0, 0.0) == 99.0
+
+
+def test_sparse_delta_is_10x_smaller_than_full(tmp_path):
+    """Acceptance: a table with <1% dirty slots checkpoints ≥10× smaller
+    incrementally than the full v3 snapshot — on the DEVICE store, whose
+    slot arrays are exactly what full saves re-serialize every time."""
+    clock = ManualClock()
+    dev = DeviceBucketStore(n_slots=4096, counter_slots=8, clock=clock,
+                            max_batch=256)
+    keys = [f"k{i}" for i in range(2000)]
+    dev.acquire_many_blocking(keys, [1] * len(keys), 100.0, 0.0)
+    dev.enable_dirty_tracking()
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path)
+    chain.save(dev)
+    base_size = os.path.getsize(path)
+    # touch <1% of the table
+    dirty = [f"k{i}" for i in range(10)]
+    dev.acquire_many_blocking(dirty, [5] * len(dirty), 100.0, 0.0)
+    stats = dev.dirty_stats()
+    assert 0 < stats["dirty"] <= 40  # the touched slots, tracked
+    p1 = chain.save(dev)
+    delta_size = os.path.getsize(p1)
+    assert delta_size * 10 <= base_size, (delta_size, base_size)
+
+    dev2 = DeviceBucketStore(n_slots=4096, counter_slots=8, clock=clock,
+                             max_batch=256)
+    assert load_snapshot_chain(dev2, path) == 1
+    assert dev2.peek_blocking("k3", 100.0, 0.0) == 94.0
+    assert dev2.peek_blocking("k100", 100.0, 0.0) == 99.0
+
+
+def test_chain_truncated_delta_raises_typed(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    s.acquire_blocking("k0", 9, 100.0, 0.0)
+    p1 = chain.save(s)
+    data = open(p1, "rb").read()
+    with open(p1, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(SnapshotChainError):
+        load_snapshot_chain(InProcessBucketStore(), path)
+    assert issubclass(SnapshotChainError, SnapshotCorruptError)
+
+
+def test_chain_missing_base_raises_typed(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    s.acquire_blocking("k0", 9, 100.0, 0.0)
+    chain.save(s)
+    os.unlink(path)  # the base vanishes; the deltas dangle
+    with pytest.raises(SnapshotChainError, match="missing"):
+        load_snapshot_chain(InProcessBucketStore(), path)
+
+
+def test_chain_foreign_base_refused(tmp_path):
+    """Stale deltas beside a base they do not belong to (operator copy,
+    partial restore from backup) must not replay — base_crc is the
+    chain's identity."""
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    s.acquire_blocking("k0", 9, 100.0, 0.0)
+    chain.save(s)
+    # an operator copies in a different base file, bypassing the save
+    # lanes (which would have retired the chain)
+    s.acquire_blocking("k1", 5, 100.0, 0.0)
+    other = str(tmp_path / "other.bin")
+    save_snapshot(s, other)
+    os.replace(other, path)
+    with pytest.raises(SnapshotChainError, match="different base"):
+        load_snapshot_chain(InProcessBucketStore(), path)
+
+
+def test_plain_full_save_retires_the_chain(tmp_path):
+    """Review regression: a full save_snapshot over a chained path used
+    to leave the .delta.* links — the next chain-aware load refused the
+    NEW valid base (base_crc mismatch) and wiped to init-on-miss. A
+    full save now supersedes the chain (the --snapshot-incremental
+    flag can be turned off between restarts safely)."""
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    s.acquire_blocking("k0", 9, 100.0, 0.0)
+    chain.save(s)
+    s.acquire_blocking("k1", 5, 100.0, 0.0)
+    save_snapshot(s, path)  # plain full save, chain manager not used
+    assert [q for q in os.listdir(tmp_path) if ".delta." in q] == []
+    s2 = InProcessBucketStore(clock=clock)
+    assert load_snapshot_chain(s2, path) == 0
+    assert s2.peek_blocking("k1", 100.0, 0.0) == 94.0
+
+
+def test_compaction_crash_window_keeps_old_base_loadable(tmp_path):
+    """Review regression: compaction used to replace the base BEFORE
+    unlinking the old chain — a crash between the two left foreign
+    links beside the new base, refused wholesale at load (total state
+    loss). Links now go first: a crash mid-compaction restores the OLD
+    base's save point, never nothing."""
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0, max_chain=1)
+    chain.save(s)
+    s.acquire_blocking("k0", 9, 100.0, 0.0)
+    chain.save(s)
+    s.acquire_blocking("k1", 5, 100.0, 0.0)
+    # crash INSIDE the compacting full save, after the old chain was
+    # retired but before the new base lands
+    from distributedratelimiting.redis_tpu.runtime import checkpoint as cp
+
+    orig = cp._atomic_write
+    cp._atomic_write = lambda *a: (_ for _ in ()).throw(
+        OSError("disk gone"))
+    try:
+        with pytest.raises(OSError):
+            chain.save(s)  # max_chain exceeded → compaction path
+    finally:
+        cp._atomic_write = orig
+    # old base + first delta's state is gone (bounded staleness), but
+    # the base itself restores cleanly — no SnapshotChainError, no
+    # init-on-miss wipe
+    s2 = InProcessBucketStore(clock=clock)
+    assert load_snapshot_chain(s2, path) == 0
+    assert s2.peek_blocking("k3", 100.0, 0.0) == 99.0
+
+
+def test_chain_crc_bad_middle_link_raises_typed(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    for i in range(3):
+        s.acquire_blocking(f"k{i}", 3, 100.0, 0.0)
+        chain.save(s)
+    p2 = path + ".delta.2"
+    data = bytearray(open(p2, "rb").read())
+    data[len(data) * 3 // 4] ^= 0x10
+    with open(p2, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(SnapshotChainError, match="checksum"):
+        load_snapshot_chain(InProcessBucketStore(), path)
+
+
+def test_chain_missing_middle_link_raises_typed(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s)
+    for i in range(3):
+        s.acquire_blocking(f"k{i}", 3, 100.0, 0.0)
+        chain.save(s)
+    os.unlink(path + ".delta.1")
+    with pytest.raises(SnapshotChainError, match="missing link"):
+        load_snapshot_chain(InProcessBucketStore(), path)
+
+
+def test_chain_placement_epoch_mismatch_raises_typed(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s, placement_epoch=3)
+    s.acquire_blocking("k0", 3, 100.0, 0.0)
+    chain.save(s, placement_epoch=3)
+    # matching epoch loads clean
+    assert load_snapshot_chain(InProcessBucketStore(clock=clock), path,
+                               expected_placement_epoch=3) == 1
+    with pytest.raises(PlacementMismatchError):
+        load_snapshot_chain(InProcessBucketStore(), path,
+                            expected_placement_epoch=5)
+
+
+def test_chain_epoch_change_compacts_to_fresh_base(tmp_path):
+    """A chain is single-epoch by contract: a save under a new placement
+    epoch becomes a full base, not a mixed-epoch link."""
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, compact_ratio=10.0)
+    chain.save(s, placement_epoch=1)
+    s.acquire_blocking("k0", 3, 100.0, 0.0)
+    chain.save(s, placement_epoch=1)
+    s.acquire_blocking("k1", 3, 100.0, 0.0)
+    p = chain.save(s, placement_epoch=2)  # epoch moved → full save
+    assert p == path
+    assert [q for q in os.listdir(tmp_path) if ".delta." in q] == []
+    assert load_snapshot_chain(InProcessBucketStore(clock=clock), path,
+                               expected_placement_epoch=2) == 0
+
+
+def test_chain_compacts_at_max_length(tmp_path):
+    clock = ManualClock()
+    s = _chain_store(clock, 4)
+    path = str(tmp_path / "snap.bin")
+    chain = SnapshotChain(path, max_chain=2, compact_ratio=10.0)
+    chain.save(s)
+    for i in range(2):
+        s.acquire_blocking(f"k{i}", 2, 100.0, 0.0)
+        assert chain.save(s) == path + f".delta.{i + 1}"
+    s.acquire_blocking("k2", 2, 100.0, 0.0)
+    assert chain.save(s) == path  # chain full → compact to fresh base
+    assert [q for q in os.listdir(tmp_path) if ".delta." in q] == []
+    assert chain.stats()["full_saves"] == 2
+    s2 = InProcessBucketStore(clock=clock)
+    assert load_snapshot_chain(s2, path) == 0
+    assert s2.peek_blocking("k2", 100.0, 0.0) == 97.0
+
+
+def test_writer_killed_mid_save_leaves_previous_checkpoint(tmp_path):
+    """Satellite: SIGKILL strikes INSIDE a save (temp file written,
+    fsync stalled, os.replace not reached) — the checkpoint path must
+    still hold the previous, CRC-clean file."""
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    path = str(tmp_path / "snap.bin")
+    child = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import os, sys, time
+            from distributedratelimiting.redis_tpu.runtime.checkpoint \\
+                import save_snapshot
+            from distributedratelimiting.redis_tpu.runtime.store \\
+                import InProcessBucketStore
+            s = InProcessBucketStore()
+            s.acquire_blocking("a", 1, 10.0, 0.0)
+            save_snapshot(s, {path!r})
+            print("READY", flush=True)
+            real_fsync = os.fsync
+            def stall(fd):
+                print("MID", flush=True)
+                time.sleep(1e6)
+            os.fsync = stall
+            s.acquire_blocking("b", 1, 10.0, 0.0)
+            save_snapshot(s, {path!r})
+        """)],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert child.stdout.readline().strip() == "READY"
+        assert child.stdout.readline().strip() == "MID"
+        child.send_signal(signal.SIGKILL)
+        child.wait(30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    # The interrupted save left a temp file but never touched the path:
+    # the previous checkpoint loads clean (only "a" was ever saved).
+    s2 = InProcessBucketStore()
+    load_snapshot(s2, path)
+    assert s2.peek_blocking("a", 10.0, 0.0) == 9.0
+    assert s2.peek_blocking("b", 10.0, 0.0) == 10.0  # never persisted

@@ -333,6 +333,8 @@ def test_cli_exit_codes(tmp_path):
      / "wire.py").write_text(WIRE.read_text())
     (shim / "distributedratelimiting" / "redis_tpu" / "runtime"
      / "server.py").write_text(SERVER.read_text())
+    (shim / "distributedratelimiting" / "redis_tpu" / "runtime"
+     / "remote.py").write_text(REMOTE.read_text())
     (shim / "distributedratelimiting" / "redis_tpu" / "utils"
      / "native.py").write_text(NATIVE_PY.read_text())
     (shim / "native" / "frontend.cc").write_text(
@@ -450,3 +452,76 @@ def test_dispatch_covers_every_live_op():
             "OP_MIGRATE_PUSH"} <= ops
     assert ops <= set(refs)
     assert len(ops) >= 17
+
+
+# -- wire-idempotency (round 7) ---------------------------------------------
+
+REMOTE = (ROOT / "distributedratelimiting" / "redis_tpu" / "runtime"
+          / "remote.py")
+
+
+def test_unclassified_op_fires_once(tmp_path):
+    """Satellite: an OP_* in neither _IDEMPOTENT_OPS nor
+    _NON_IDEMPOTENT_OPS fires wire-idempotency exactly once, naming the
+    wire.py line and both classification sets."""
+    mutated = tmp_path / "wire.py"
+    text = WIRE.read_text()
+    anchor = "OP_CONFIG = 18"
+    assert anchor in text, "fixture anchor gone from wire.py"
+    mutated.write_text(text.replace(
+        anchor, anchor + "\nOP_GHOST = 99", 1))
+    findings = wire_conformance.check_idempotency(mutated, REMOTE,
+                                                  tmp_path)
+    assert [f.rule for f in findings] == ["wire-idempotency"]
+    f = findings[0]
+    assert "OP_GHOST" in f.message and "neither" in f.message
+    assert f.file.endswith("wire.py")
+    assert len(f.related) == 2
+    assert all(rf.endswith("remote.py") for rf, _, _ in f.related)
+
+
+def test_doubly_classified_op_fires(tmp_path):
+    """An op claimed by BOTH sets is a contradiction, not a pass."""
+    mutated = tmp_path / "remote.py"
+    text = REMOTE.read_text()
+    anchor = "    wire.OP_ACQUIRE, wire.OP_WINDOW"
+    assert anchor in text, "fixture anchor gone from remote.py"
+    mutated.write_text(text.replace(
+        anchor, "    wire.OP_PEEK,\n" + anchor, 1))
+    findings = wire_conformance.check_idempotency(WIRE, mutated,
+                                                  tmp_path)
+    assert [f.rule for f in findings] == ["wire-idempotency"]
+    f = findings[0]
+    assert "OP_PEEK" in f.message and "BOTH" in f.message
+    notes = {note for _, _, note in f.related}
+    assert any("_IDEMPOTENT_OPS" in n for n in notes)
+    assert any("_NON_IDEMPOTENT_OPS" in n for n in notes)
+
+
+def test_missing_classification_set_fires(tmp_path):
+    """remote.py losing one of the two sets entirely is itself a
+    finding — the rule must not silently pass a refactor that deletes
+    the classification."""
+    mutated = tmp_path / "remote.py"
+    mutated.write_text("import wire\n_IDEMPOTENT_OPS = frozenset()\n")
+    findings = wire_conformance.check_idempotency(WIRE, mutated,
+                                                  tmp_path)
+    assert [f.rule for f in findings] == ["wire-idempotency"]
+    assert "_NON_IDEMPOTENT_OPS" in findings[0].message
+
+
+def test_idempotency_covers_every_live_op():
+    """The live tree is clean AND non-vacuously so — OP_CONFIG included,
+    and both sets are seen with sane populations."""
+    assert wire_conformance.check_idempotency(WIRE, REMOTE, ROOT) == []
+    sets = wire_conformance._remote_op_sets(REMOTE)
+    assert set(sets) == {"_IDEMPOTENT_OPS", "_NON_IDEMPOTENT_OPS"}
+    idem = set(sets["_IDEMPOTENT_OPS"][0])
+    non = set(sets["_NON_IDEMPOTENT_OPS"][0])
+    assert "OP_CONFIG" in idem
+    assert "OP_ACQUIRE" in non and "OP_ACQUIRE_MANY" in non
+    assert not (idem & non)
+    py = wire_conformance.extract_py_model(WIRE)
+    ops = {n for n in py.constants if n.startswith("OP_")}
+    assert ops == idem | non
+    assert len(ops) >= 18

@@ -752,3 +752,50 @@ def test_traced_tier0_local_decision_still_traces(tracer):
         linked += sum(1 for s in t["spans"]
                       if s["name"] == "fe.tier0" and s["parent_id"] in ids)
     assert linked > 0
+
+
+def test_config_moved_gate_on_native_batch_lane():
+    """Round 7: the C batch lane honors the live-config gate exactly
+    like the asyncio lane — a frame carrying a retired (kind, a, b)
+    answers the routable "config moved" error per-row (fe_send +
+    kRowSkip), the store untouched for that row; the client chases once
+    and every later call translates up front (one moved error total,
+    window and bucket kinds alike)."""
+
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(backing,
+                                     native_frontend=True) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                for _ in range(30):
+                    await store.acquire("k", 1, 100.0, 0.0)
+                await store.config_announce({"prepare": {
+                    "kind": "bucket", "old": [100.0, 0.0],
+                    "new": [50.0, 0.0]}, "version": 1})
+                await store.config_announce({"commit": 1})
+                # stale per-request frames ride the C batch lane: one
+                # moved chase, then translated — exact balance carry
+                r = await store.acquire("k", 0, 100.0, 0.0)
+                assert r.remaining == 20.0  # 50 − 30 spent
+                r = await store.acquire("k", 20, 100.0, 0.0)
+                assert r.granted and r.remaining == 0.0
+                assert not (await store.acquire("k", 1, 100.0,
+                                                0.0)).granted
+                st = await store.stats()
+                assert st["config"]["moved_errors"] == 1
+                # window kind gates on the same lane
+                await store.window_acquire("w", 3, 10.0, 100.0)
+                await store.config_announce({"prepare": {
+                    "kind": "window", "old": [10.0, 100.0],
+                    "new": [4.0, 100.0]}, "version": 2})
+                await store.config_announce({"commit": 2})
+                r = await store.window_acquire("w", 1, 10.0, 100.0)
+                assert r.granted  # 3 of 4 replayed + 1 = at the limit
+                r = await store.window_acquire("w", 1, 10.0, 100.0)
+                assert not r.granted
+            finally:
+                await store.aclose()
+
+    run(body())

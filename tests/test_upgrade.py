@@ -1,0 +1,910 @@
+"""Zero-downtime operations: live limit mutation, drain-and-handoff
+shutdown, and the rolling-restart soak (ISSUE 7; docs/OPERATIONS.md §10,
+DESIGN.md §13).
+
+Three planes under test:
+
+- **Live config mutation** (runtime/liveconfig.py): the versioned
+  two-phase ``OP_CONFIG`` plane — prepare/commit/abort idempotence,
+  the epoch-rebase balance carry through ``debit_many``, the routable
+  "config moved" error and the client's one-chase translation cache,
+  and the coordinator's clean abort.
+- **Drain-and-handoff shutdown** (``BucketStoreServer.shutdown``): a
+  planned exit ships state to a successor through the MIGRATE_PUSH lane
+  (or to a final checkpoint), serving stragglers from the withheld
+  fair-share envelope for the handoff window.
+- **Rolling-restart soak**: restart every node of a 3-node cluster one
+  at a time under wire chaos and live traffic, mutate a limit mid-roll,
+  and audit from the stores' own admission records that no acquire is
+  double-admitted and the hot key's over-admission stays inside the
+  epsilon envelope (``make upgrade-soak SEED=…`` replays any run).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.runtime import liveconfig, wire
+from distributedratelimiting.redis_tpu.runtime.cluster import (
+    ClusterBucketStore,
+)
+from distributedratelimiting.redis_tpu.runtime.liveconfig import (
+    ConfigError,
+    ConfigRule,
+    ConfigState,
+    StaleConfigError,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+    StoreTimeoutError,
+)
+from distributedratelimiting.redis_tpu.runtime.server import (
+    BucketStoreServer,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+from distributedratelimiting.redis_tpu.utils.faults import (
+    FaultInjector,
+    FaultRule,
+)
+
+SEED = int(os.environ.get("DRL_UPGRADE_SEED", "20260803"))
+
+_NET_ERRORS = (ConnectionError, OSError, StoreTimeoutError,
+               wire.RemoteStoreError)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.uninstall()
+
+
+# -- liveconfig unit surface -------------------------------------------------
+
+def test_config_rule_validation():
+    with pytest.raises(ConfigError):
+        ConfigRule("nope", (1.0, 1.0), (2.0, 1.0))
+    with pytest.raises(ConfigError):
+        ConfigRule("bucket", (1.0, 1.0), (1.0, 1.0))  # self-rewrite
+    with pytest.raises(ConfigError):
+        ConfigRule("bucket", (0.0, 1.0), (2.0, 1.0))  # a must be > 0
+    with pytest.raises(ConfigError):
+        ConfigRule("bucket", (float("nan"), 1.0), (2.0, 1.0))
+    r = ConfigRule("bucket", (100, 1), (50, 1))
+    assert ConfigRule.from_dict(r.to_dict()) == r
+
+
+def test_moved_message_roundtrip():
+    msg = liveconfig.moved_message("window", (10.0, 5.0), (4.0, 5.0), 3)
+    assert msg.startswith(liveconfig.CONFIG_MOVED_PREFIX)
+    assert liveconfig.parse_moved(msg) == (
+        "window", (10.0, 5.0), (4.0, 5.0), 3)
+    assert liveconfig.parse_moved("some other error") is None
+    assert liveconfig.parse_moved(
+        liveconfig.CONFIG_MOVED_PREFIX + ": {broken json") is None
+
+
+def test_config_state_two_phase_idempotent():
+    async def body():
+        st = ConfigState()
+        store = InProcessBucketStore()
+        rule = ConfigRule("bucket", (100.0, 0.0), (50.0, 0.0))
+        assert not st.active
+        # prepare stages, serving unchanged
+        v = await st.announce({"prepare": rule.to_dict(), "version": 1},
+                              store)
+        assert v == 0 and not st.active
+        # re-prepare at the same version with the SAME rule: idempotent
+        await st.announce({"prepare": rule.to_dict(), "version": 1},
+                          store)
+        # a DIFFERENT rule at the same version is a conflict, loudly
+        other = ConfigRule("bucket", (100.0, 0.0), (25.0, 0.0))
+        with pytest.raises(StaleConfigError):
+            await st.announce({"prepare": other.to_dict(), "version": 1},
+                              store)
+        # commit flips the gate; a retried commit no-ops at the version
+        assert await st.announce({"commit": 1}, store) == 1
+        assert st.active and st.commits == 1
+        assert await st.announce({"commit": 1}, store) == 1
+        assert st.commits == 1  # idempotent — no second rebase
+        # stale prepare (version not > committed) is typed
+        with pytest.raises(StaleConfigError):
+            await st.announce({"prepare": other.to_dict(), "version": 1},
+                              store)
+        # the forwarding gate answers for the retired config only
+        assert st.forward("bucket", 100.0, 0.0) == (50.0, 0.0, 1)
+        assert st.forward("bucket", 50.0, 0.0) is None
+        # commit for an unstaged version is an error, not a silent skip
+        with pytest.raises(ConfigError):
+            await st.announce({"commit": 5}, store)
+
+    run(body())
+
+
+def test_config_state_abort_drops_staged_rule():
+    async def body():
+        st = ConfigState()
+        store = InProcessBucketStore()
+        rule = ConfigRule("bucket", (10.0, 1.0), (5.0, 1.0))
+        await st.announce({"prepare": rule.to_dict(), "version": 1},
+                          store)
+        await st.announce({"abort": 1}, store)
+        assert st.aborts == 1 and not st.active
+        with pytest.raises(ConfigError):
+            await st.announce({"commit": 1}, store)  # abort dropped it
+
+    run(body())
+
+
+def test_config_chain_compression_one_chase():
+    """Committing A→B then B→C rewrites the A rule to A→C: a client two
+    mutations behind chases ONE moved error, not one per hop."""
+    async def body():
+        st = ConfigState()
+        store = InProcessBucketStore()
+        a, b, c = (100.0, 0.0), (50.0, 0.0), (25.0, 0.0)
+        await st.announce({"prepare": ConfigRule(
+            "bucket", a, b).to_dict(), "version": 1}, store)
+        await st.announce({"commit": 1}, store)
+        await st.announce({"prepare": ConfigRule(
+            "bucket", b, c).to_dict(), "version": 2}, store)
+        await st.announce({"commit": 2}, store)
+        assert st.forward("bucket", *a) == (25.0, 0.0, 2)
+        assert st.forward("bucket", *b) == (25.0, 0.0, 2)
+
+    run(body())
+
+
+def test_rebase_carries_spent_budget_buckets_and_windows():
+    async def body():
+        store = InProcessBucketStore()
+        await store.acquire("k", 30, 100.0, 0.0)     # 30 spent
+        await store.window_acquire("w", 7, 10.0, 1000.0)
+        st = ConfigState()
+        await st.announce({"prepare": ConfigRule(
+            "bucket", (100.0, 0.0), (50.0, 0.0)).to_dict(),
+            "version": 1}, store)
+        await st.announce({"commit": 1}, store)
+        # 30 spent of 100 → new table holds 50 − 30 = 20
+        assert store.peek_blocking("k", 50.0, 0.0) == 20.0
+        await st.announce({"prepare": ConfigRule(
+            "window", (10.0, 1000.0), (5.0, 1000.0)).to_dict(),
+            "version": 2}, store)
+        await st.announce({"commit": 2}, store)
+        # 7 of 10 consumed replays clamped into the new limit 5: full
+        r = await store.window_acquire("w", 1, 5.0, 1000.0)
+        assert not r.granted
+        assert st.rebased_rows >= 2
+
+    run(body())
+
+
+def test_window_rebase_floors_fractional_carry():
+    """Review regression: the window replay used to ceil the carried
+    count — a fractional carry rounded UP past a fractional new limit
+    was DENIED, recording nothing, and the key reset to a fresh full
+    budget (over-admission from the carry mechanism itself)."""
+    async def body():
+        store = InProcessBucketStore()
+        wt = int(1000.0 * 1024)  # TICKS_PER_SECOND
+        idx = store.clock.now_ticks() // wt
+        # current-window count 10.2 under limit 11 (fractional counts
+        # arise from envelope pre-charges on migrated windows)
+        store._windows[("w", 11.0, wt, True)] = (0.0, 10.2, idx)
+        st = ConfigState()
+        await st.announce({"prepare": ConfigRule(
+            "window", (11.0, 1000.0), (10.5, 1000.0)).to_dict(),
+            "version": 1}, store)
+        await st.announce({"commit": 1}, store)
+        # floor(10.2) = 10 carried: 0.5 of headroom left, 1 is denied
+        r = await store.window_acquire("w", 1, 10.5, 1000.0)
+        assert not r.granted
+
+    run(body())
+
+
+def test_rebase_can_only_under_admit():
+    """The saturating carry: a spend EXCEEDING the new cap lands at
+    zero, never negative, never a fresh full budget."""
+    async def body():
+        store = InProcessBucketStore()
+        await store.acquire("k", 90, 100.0, 0.0)
+        st = ConfigState()
+        await st.announce({"prepare": ConfigRule(
+            "bucket", (100.0, 0.0), (20.0, 0.0)).to_dict(),
+            "version": 1}, store)
+        await st.announce({"commit": 1}, store)
+        assert store.peek_blocking("k", 20.0, 0.0) == 0.0
+
+    run(body())
+
+
+def test_config_revert_deletes_rule_instead_of_self_forwarding():
+    """Review regression: committing A→B then the revert B→A used to
+    compress A's rule into A→A — forward(A) bounced every A frame to
+    itself and the client (rightly refusing an identity rule) failed
+    the call forever. A revert must DELETE A's rule: A is current."""
+    async def body():
+        st = ConfigState()
+        store = InProcessBucketStore()
+        a, b = (100.0, 0.0), (50.0, 0.0)
+        await st.announce({"prepare": ConfigRule(
+            "bucket", a, b).to_dict(), "version": 1}, store)
+        await st.announce({"commit": 1}, store)
+        await st.announce({"prepare": ConfigRule(
+            "bucket", b, a).to_dict(), "version": 2}, store)
+        await st.announce({"commit": 2}, store)
+        assert st.forward("bucket", *a) is None  # A serves again
+        assert st.forward("bucket", *b) == (a[0], a[1], 2)
+
+    run(body())
+
+
+def test_revert_mutation_converges_stale_clients():
+    """E2E revert over the wire: a client that already learned A→B must
+    converge back to A after the revert (cycle-safe resolve + inverse
+    eviction), not loop or fail."""
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(backing) as srv:
+            c = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+            try:
+                await c.acquire("k", 30, 100.0, 0.0)
+                await c.config_announce({"prepare": {
+                    "kind": "bucket", "old": [100.0, 0.0],
+                    "new": [50.0, 0.0]}, "version": 1})
+                await c.config_announce({"commit": 1})
+                r = await c.acquire("k", 0, 100.0, 0.0)  # learns A→B
+                assert r.remaining == 20.0
+                await c.config_announce({"prepare": {
+                    "kind": "bucket", "old": [50.0, 0.0],
+                    "new": [100.0, 0.0]}, "version": 2})
+                await c.config_announce({"commit": 2})
+                # stale cache says A→B; the revert's moved error teaches
+                # B→A, evicts the contradicted entry, and the call lands
+                # on A — carried balance: spent 30 then 20-rebase-carry
+                r = await c.acquire("k", 0, 100.0, 0.0)
+                assert r.granted
+                # converged: later calls translate to A up front and the
+                # server sees no more moved chases than the two hops
+                st = await c.stats()
+                moved_before = st["config"]["moved_errors"]
+                for _ in range(5):
+                    await c.acquire("k", 0, 100.0, 0.0)
+                st = await c.stats()
+                assert st["config"]["moved_errors"] == moved_before
+            finally:
+                await c.aclose()
+
+    run(body())
+
+
+# -- the wire plane (OP_CONFIG + the moved gate) -----------------------------
+
+def test_op_config_fetch_mutate_and_gate_over_wire():
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(backing) as srv:
+            c = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+            try:
+                assert await c.config_fetch() == {"version": 0,
+                                                  "rules": []}
+                for _ in range(30):
+                    await c.acquire("k", 1, 100.0, 0.0)
+                rule = {"kind": "bucket", "old": [100.0, 0.0],
+                        "new": [50.0, 0.0]}
+                assert await c.config_announce(
+                    {"prepare": rule, "version": 1}) == 0
+                assert await c.config_announce({"commit": 1}) == 1
+                got = await c.config_fetch()
+                assert got["version"] == 1
+                assert got["rules"][0]["new"] == [50.0, 0.0]
+                # Old config chases ONE moved error, then translates
+                # client-side: the server sees exactly one moved answer.
+                r = await c.acquire("k", 0, 100.0, 0.0)
+                assert r.remaining == 20.0  # 50 − 30 spent
+                r = await c.acquire("k", 5, 100.0, 0.0)
+                assert r.granted
+                st = await c.stats()
+                assert st["config"]["moved_errors"] == 1
+                # PEEK redirects too (a probe against the retired table
+                # would report a number nobody serves from).
+                assert await asyncio.to_thread(
+                    c.peek_blocking, "k", 100.0, 0.0) == 15.0
+            finally:
+                await c.aclose()
+
+    run(body())
+
+
+def test_bulk_lane_chases_config_moved_frame_level():
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(backing) as srv:
+            c = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+            try:
+                await c.config_announce({"prepare": {
+                    "kind": "bucket", "old": [100.0, 0.0],
+                    "new": [50.0, 0.0]}, "version": 1})
+                await c.config_announce({"commit": 1})
+                keys = [f"b{i}" for i in range(64)]
+                res = await c.acquire_many(keys, [1] * 64, 100.0, 0.0)
+                assert res.granted.all()
+                # every row landed on the NEW table
+                assert backing.peek_blocking("b0", 50.0, 0.0) == 49.0
+                st = await c.stats()
+                assert st["config"]["moved_errors"] == 1
+                # …and the translation is cached for the next frame
+                res = await c.acquire_many(keys, [1] * 64, 100.0, 0.0)
+                assert res.granted.all()
+                st = await c.stats()
+                assert st["config"]["moved_errors"] == 1
+                # window bulk lane gates identically
+                await c.config_announce({"prepare": {
+                    "kind": "window", "old": [10.0, 100.0],
+                    "new": [4.0, 100.0]}, "version": 2})
+                await c.config_announce({"commit": 2})
+                res = await c.window_acquire_many(
+                    keys[:8], [1] * 8, 10.0, 100.0)
+                assert res.granted.all()
+                assert not (await c.window_acquire_many(
+                    ["b0"], [4], 10.0, 100.0)).granted.any()
+            finally:
+                await c.aclose()
+
+    run(body())
+
+
+def test_op_config_is_post_send_retry_safe_classified():
+    from distributedratelimiting.redis_tpu.runtime import remote
+
+    assert wire.OP_CONFIG in remote._IDEMPOTENT_OPS
+    assert wire.OP_CONFIG not in remote._NON_IDEMPOTENT_OPS
+
+
+def test_cluster_mutation_aborts_cleanly_on_prepare_fault():
+    async def body():
+        backings = [InProcessBucketStore() for _ in range(2)]
+        servers = [BucketStoreServer(b) for b in backings]
+        for s in servers:
+            await s.start()
+        cluster = ClusterBucketStore(
+            addresses=[(s.host, s.port) for s in servers],
+            coalesce_requests=False, request_timeout_s=1.0,
+            retry_policy=None)
+        try:
+            for _ in range(10):
+                await cluster.acquire("k", 1, 100.0, 0.0)
+            faults.install(FaultInjector(SEED, {
+                "cluster.config": (FaultRule("error", probability=1.0),)}))
+            with pytest.raises(ConfigError):
+                await cluster.mutate_config("bucket", (100.0, 0.0),
+                                            (50.0, 0.0))
+            assert cluster.config_aborts == 1
+            assert cluster.migration_log[-1]["type"] == "config_abort"
+            faults.uninstall()
+            # nothing committed anywhere: old config serves untouched
+            for s in servers:
+                assert not s.liveconfig.active
+                assert s.liveconfig.version == 0
+            r = await cluster.acquire("k", 0, 100.0, 0.0)
+            assert r.granted
+            # fault cleared → the SAME mutation commits fleet-wide
+            v = await cluster.mutate_config("bucket", (100.0, 0.0),
+                                            (50.0, 0.0))
+            assert v == 1
+            assert all(s.liveconfig.version == 1 for s in servers)
+        finally:
+            faults.uninstall()
+            await cluster.aclose()
+            for s in servers:
+                await s.aclose()
+
+    run(body())
+
+
+# -- drain-and-handoff shutdown ----------------------------------------------
+
+def test_shutdown_ships_state_to_successor_exactly():
+    async def body():
+        old_back, new_back = (InProcessBucketStore(),
+                              InProcessBucketStore())
+        old = BucketStoreServer(old_back)
+        new = BucketStoreServer(new_back)
+        await old.start()
+        await new.start()
+        c = RemoteBucketStore(address=(old.host, old.port),
+                              coalesce_requests=False)
+        succ = RemoteBucketStore(address=(new.host, new.port),
+                                 coalesce_requests=False)
+        try:
+            for _ in range(30):
+                await c.acquire("k", 1, 100.0, 0.0)
+            summary = await old.shutdown(successor=succ, window_s=0.05)
+            assert summary["shipped_rows"] == 1
+            # shipped balance = 70 remaining − 50 envelope withheld
+            tokens, _ = new_back._buckets[("k", 100.0, 0.0)]
+            assert tokens == pytest.approx(20.0)
+            # the OLD store was debited for the shipped amount: even a
+            # lingering process cannot re-spend what it handed off
+            assert old_back.peek_blocking("k", 100.0, 0.0) <= 50.0
+            # idempotent: a second shutdown is a no-op
+            assert (await old.shutdown(successor=succ))["already"]
+        finally:
+            await c.aclose()
+            await succ.aclose()
+            await new.aclose()
+
+    run(body())
+
+
+def test_shutdown_serves_envelope_during_drain_window():
+    async def body():
+        old_back, new_back = (InProcessBucketStore(),
+                              InProcessBucketStore())
+        old = BucketStoreServer(old_back)
+        new = BucketStoreServer(new_back)
+        await old.start()
+        await new.start()
+        c = RemoteBucketStore(address=(old.host, old.port),
+                              coalesce_requests=False,
+                              request_timeout_s=1.0)
+        succ = RemoteBucketStore(address=(new.host, new.port),
+                                 coalesce_requests=False)
+        try:
+            await c.acquire("k", 10, 1000.0, 0.0)
+            task = asyncio.ensure_future(
+                old.shutdown(successor=succ, window_s=0.4))
+            # straggler traffic during the window: bounded envelope
+            # answers, not connection resets
+            served = denied = 0
+            t0 = time.monotonic()
+            while not task.done() and time.monotonic() - t0 < 2.0:
+                try:
+                    r = await c.acquire("k", 1, 1000.0, 0.0)
+                    served += 1 if r.granted else 0
+                    denied += 0 if r.granted else 1
+                except _NET_ERRORS:
+                    pass
+                await asyncio.sleep(0.01)
+            summary = await task
+            assert summary["envelope_decisions"] >= 1
+            # the envelope is the withheld fair-share budget, hard-capped
+            budget = headroom_budget(1000.0, fraction=0.5, min_budget=1.0)
+            assert served <= budget
+        finally:
+            await c.aclose()
+            await succ.aclose()
+            await new.aclose()
+
+    run(body())
+
+
+def test_shutdown_without_successor_writes_final_checkpoint(tmp_path):
+    async def body():
+        from distributedratelimiting.redis_tpu.runtime import checkpoint
+
+        path = str(tmp_path / "final.bin")
+        back = InProcessBucketStore()
+        srv = BucketStoreServer(back, snapshot_path=path)
+        await srv.start()
+        c = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+        try:
+            for _ in range(40):
+                await c.acquire("k", 1, 100.0, 0.0)
+        finally:
+            await c.aclose()
+        summary = await srv.shutdown()
+        assert summary["checkpoint"] == path
+        # the restarted process restores the exact balance
+        fresh = InProcessBucketStore()
+        checkpoint.load_snapshot_chain(fresh, path)
+        assert fresh.peek_blocking("k", 100.0, 0.0) == 60.0
+
+    run(body())
+
+
+def test_shutdown_checkpoint_uses_incremental_chain(tmp_path):
+    async def body():
+        from distributedratelimiting.redis_tpu.runtime import checkpoint
+
+        path = str(tmp_path / "snap.bin")
+        back = InProcessBucketStore()
+        srv = BucketStoreServer(back, snapshot_path=path,
+                                snapshot_incremental=True)
+        await srv.start()
+        c = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+        try:
+            for i in range(64):
+                await c.acquire(f"k{i}", 1, 100.0, 0.0)
+            await c.save()  # base
+            await c.acquire("k0", 5, 100.0, 0.0)
+            await c.save()  # delta 1
+            st = await c.stats()
+            assert st["snapshot_chain"]["delta_saves"] >= 1
+            assert st["snapshot_chain"]["dirty"]["total"] >= 64
+        finally:
+            await c.aclose()
+        summary = await srv.shutdown()  # final save through the chain
+        assert summary["checkpoint"]
+        fresh = InProcessBucketStore()
+        checkpoint.load_snapshot_chain(fresh, path)
+        assert fresh.peek_blocking("k0", 100.0, 0.0) == 94.0
+        assert fresh.peek_blocking("k63", 100.0, 0.0) == 99.0
+
+    run(body())
+
+
+def test_failed_drain_falls_back_to_final_checkpoint(tmp_path):
+    """Review regression: shutdown() used to latch _shutdown_done
+    before doing any work — a push failure left the state neither on
+    the successor nor on disk, and the retry answered {'already'}.
+    With a snapshot path, a failed drain now lands the state in a
+    final checkpoint instead."""
+    async def body():
+        from distributedratelimiting.redis_tpu.runtime import checkpoint
+
+        path = str(tmp_path / "fallback.bin")
+        back = InProcessBucketStore()
+        srv = BucketStoreServer(back, snapshot_path=path)
+        await srv.start()
+        c = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+        try:
+            for _ in range(30):
+                await c.acquire("k", 1, 100.0, 0.0)
+        finally:
+            await c.aclose()
+        # successor at a dead address: the push cannot land
+        dead = RemoteBucketStore(address=("127.0.0.1", 1),
+                                 coalesce_requests=False,
+                                 request_timeout_s=0.3,
+                                 retry_policy=None)
+        try:
+            summary = await srv.shutdown(successor=dead, window_s=0.05)
+        finally:
+            await dead.aclose()
+        assert summary["checkpoint"] == path
+        assert "drain_error" in summary
+        fresh = InProcessBucketStore()
+        checkpoint.load_snapshot_chain(fresh, path)
+        # the balance survived (the envelope debit may have landed —
+        # conservative direction only, never a fresh full budget)
+        assert fresh.peek_blocking("k", 100.0, 0.0) <= 70.0
+
+    run(body())
+
+
+def test_failed_drain_without_snapshot_is_retryable():
+    """…and with no snapshot path the failure re-opens shutdown: the
+    retry against a healthy successor ships the state."""
+    async def body():
+        old_back, new_back = (InProcessBucketStore(),
+                              InProcessBucketStore())
+        old = BucketStoreServer(old_back)
+        new = BucketStoreServer(new_back)
+        await old.start()
+        await new.start()
+        c = RemoteBucketStore(address=(old.host, old.port),
+                              coalesce_requests=False)
+        try:
+            for _ in range(30):
+                await c.acquire("k", 1, 100.0, 0.0)
+        finally:
+            await c.aclose()
+        dead = RemoteBucketStore(address=("127.0.0.1", 1),
+                                 coalesce_requests=False,
+                                 request_timeout_s=0.3,
+                                 retry_policy=None)
+        with pytest.raises(Exception):
+            await old.shutdown(successor=dead, window_s=0.05)
+        await dead.aclose()
+        # review regression: the failed drain must DISARM the envelope —
+        # the still-running server resumes authoritative serving from
+        # the (debited) store, it is not envelope-capped forever
+        assert old._drain_envelope is None
+        c2 = RemoteBucketStore(address=(old.host, old.port),
+                               coalesce_requests=False)
+        try:
+            r = await c2.acquire("k", 0, 100.0, 0.0)
+            assert r.granted  # served from the store, post-debit
+        finally:
+            await c2.aclose()
+        succ = RemoteBucketStore(address=(new.host, new.port),
+                                 coalesce_requests=False)
+        try:
+            summary = await old.shutdown(successor=succ, window_s=0.05)
+        finally:
+            await succ.aclose()
+        assert summary.get("already") is None
+        assert ("k", 100.0, 0.0) in new_back._buckets
+        await new.aclose()
+
+    run(body())
+
+
+# -- the rolling-restart soak -------------------------------------------------
+
+class RecordingStore(InProcessBucketStore):
+    """Backing store stamping every authoritative admission — the ground
+    truth the double-admit audit replays. Envelope decisions (drain or
+    degraded) never reach a store, by design; they are bounded by the
+    epsilon assertion instead."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.admissions: list[tuple[str, float, bool]] = []
+
+    async def acquire(self, key, count, capacity, fill_rate_per_sec):
+        res = await super().acquire(key, count, capacity,
+                                    fill_rate_per_sec)
+        self.admissions.append((key, time.monotonic(),
+                                bool(res.granted and count > 0)))
+        return res
+
+
+class TestRollingRestartSoak:
+    RULES = {
+        "client.connect": (
+            FaultRule("reset", probability=0.08),
+            FaultRule("delay", probability=0.2, delay_s=0.001,
+                      jitter_s=0.002),
+        ),
+        "server.dispatch": (
+            FaultRule("delay", probability=0.05, delay_s=0.002,
+                      jitter_s=0.002),
+        ),
+    }
+
+    def test_soak_rolling_restart_with_midroll_mutation(self):
+        """Restart all 3 nodes one at a time (drain-and-handoff to a
+        successor process, LB switch via replace_node) under wire chaos
+        and live traffic, mutate the hot limit mid-roll, then audit:
+        zero double-admits over the stores' own records, hot-key
+        over-admission inside the epsilon envelope, no stranded
+        futures, deterministic schedule."""
+
+        async def main():
+            inj = FaultInjector(SEED, self.RULES)
+            faults.install(inj)
+            cap_hot = 40.0
+            new_cap = 24.0
+            generations = [[RecordingStore()] for _ in range(3)]
+            servers = [BucketStoreServer(g[0]) for g in generations]
+            for s in servers:
+                await s.start()
+            cluster = ClusterBucketStore(
+                addresses=[(s.host, s.port) for s in servers],
+                coalesce_requests=False, request_timeout_s=1.0,
+                reconnect_backoff_base_s=0.004, resilience_seed=SEED)
+
+            hot_grants = 0
+            unique_sent = 0
+            cold_ok = 0
+            cold_n = 0
+            stop = asyncio.Event()
+
+            async def drive():
+                nonlocal hot_grants, unique_sent, cold_ok, cold_n
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        # NOTE: always the ORIGINAL operands — after the
+                        # mid-roll mutation this lane proves the moved
+                        # chase + client-side translation.
+                        r = await cluster.acquire("hot", 1, cap_hot,
+                                                  1e-9)
+                        hot_grants += r.granted
+                    except _NET_ERRORS:
+                        pass
+                    try:
+                        # unique-key lane: each logical acquire must be
+                        # admitted AT MOST once fleet-wide, ever.
+                        unique_sent += 1
+                        await cluster.acquire(f"u{i}", 1, 1.0, 1e-9)
+                    except _NET_ERRORS:
+                        pass
+                    cold_n += 1
+                    try:
+                        r = await cluster.acquire(f"cold{i % 16}", 1,
+                                                  1e6, 1.0)
+                        cold_ok += r.granted
+                    except _NET_ERRORS:
+                        pass
+                    await asyncio.sleep(0)
+
+            shipped_total = 0
+
+            async def roll(j: int) -> None:
+                nonlocal shipped_total
+                new_back = RecordingStore()
+                new_srv = BucketStoreServer(new_back)
+                await new_srv.start()
+                succ = RemoteBucketStore(
+                    address=(new_srv.host, new_srv.port),
+                    coalesce_requests=False)
+                try:
+                    summary = await servers[j].shutdown(
+                        successor=succ, window_s=0.25)
+                finally:
+                    await succ.aclose()
+                shipped_total += summary["shipped_rows"]
+                generations[j].append(new_back)
+                servers[j] = new_srv
+                await cluster.replace_node(
+                    j, address=(new_srv.host, new_srv.port))
+
+            async def upgrade():
+                await asyncio.sleep(0.15)
+                await roll(0)
+                await asyncio.sleep(0.10)
+                # mid-roll live limit mutation: 40 → 24, balances carry
+                v = await cluster.mutate_config(
+                    "bucket", (cap_hot, 1e-9), (new_cap, 1e-9))
+                assert v == 1
+                await asyncio.sleep(0.10)
+                await roll(1)
+                await asyncio.sleep(0.10)
+                await roll(2)
+                await asyncio.sleep(0.15)
+                stop.set()
+
+            driver = asyncio.ensure_future(drive())
+            try:
+                await asyncio.wait_for(upgrade(), 60.0)
+                await driver
+            finally:
+                driver.cancel()
+                try:
+                    await driver
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+            try:
+                # Every node restarted once; state rode the handoff.
+                assert all(len(g) == 2 for g in generations)
+                assert shipped_total >= 1
+                assert cluster.config_mutations == 1
+                ev = [e for e in cluster.migration_log
+                      if e["type"] == "config_commit"]
+                assert len(ev) == 1 and ev[0]["commit_errors"] == 0
+                # The fleet's gates all committed the mutation; the
+                # stale-operand hot lane really exercised them.
+                assert all(s.liveconfig.version == 1 for s in servers)
+                assert sum(s.liveconfig.moved_errors
+                           for s in servers) >= 1
+
+                # Differential audit over the stores' OWN records:
+                # no unique-key acquire admitted twice, ever — not
+                # across a handoff, not across the mutation.
+                grants: dict[str, int] = {}
+                for gen in generations:
+                    for store in gen:
+                        for key, _t, granted in store.admissions:
+                            if granted and key.startswith("u"):
+                                grants[key] = grants.get(key, 0) + 1
+                doubles = {k: n for k, n in grants.items() if n > 1}
+                assert doubles == {}, f"double-admitted: {doubles}"
+                assert len(grants) >= 50, "audit must not be vacuous"
+
+                # Epsilon envelope on the hot key: the mutation rebase
+                # carries spent budget (can only under-admit), so total
+                # grants stay within the ORIGINAL cap plus one
+                # fair-share envelope per restart episode.
+                budget = headroom_budget(cap_hot, fraction=0.5,
+                                         min_budget=1.0)
+                assert hot_grants <= cap_hot + budget * 3, (
+                    hot_grants, budget)
+                assert hot_grants >= 10  # availability through the roll
+                assert cold_ok >= cold_n * 0.5
+
+                # Zero stranded futures on any live node client.
+                for node in cluster.nodes:
+                    assert node._pending == {}
+
+                # Schedule determinism: realized == pure preview.
+                for seam in self.RULES:
+                    realized = [e for e in inj.events if e.seam == seam]
+                    assert realized == inj.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                twin = FaultInjector(SEED, self.RULES)
+                for seam in self.RULES:
+                    assert (twin.schedule_preview(
+                        seam, inj.occurrence_count(seam))
+                        == inj.schedule_preview(
+                            seam, inj.occurrence_count(seam)))
+            finally:
+                await cluster.aclose()
+                for s in servers:
+                    await s.aclose()
+
+        run(main())
+
+
+# -- native front-end: tier-0 × live config ----------------------------------
+
+def _native_tier0_lib():
+    from distributedratelimiting.redis_tpu.utils.native import (
+        load_frontend_lib,
+    )
+
+    lib = load_frontend_lib()
+    return lib if lib is not None and getattr(lib, "has_tier0",
+                                              False) else None
+
+
+@pytest.mark.skipif(_native_tier0_lib() is None,
+                    reason="native front-end (tier-0 ABI) unavailable")
+def test_tier0_retired_config_reroutes_debits_and_stops_serving():
+    """A config mutation retiring a tier-0-hosted (cap, rate): the sync
+    pump re-routes the harvested debits onto the REPLACEMENT config's
+    table and zeroes the replica's headroom, so within a sync interval
+    the C fast lane stops admitting against the dead table and stale
+    frames fall through to the batch lane's routable moved error."""
+
+    async def body():
+        from distributedratelimiting.redis_tpu.runtime.native_frontend \
+            import Tier0Config
+
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(
+                backing, native_frontend=True,
+                native_tier0=Tier0Config(min_budget=8.0,
+                                         sync_interval_s=0.02,
+                                         max_stale_s=10.0)) as srv:
+            c = RemoteBucketStore(address=(srv.host, srv.port),
+                                  coalesce_requests=False)
+            try:
+                for _ in range(200):
+                    r = await c.acquire("hot", 1, 1000.0, 1e-9)
+                    assert r.granted
+                await asyncio.sleep(0.08)  # instals + a few syncs
+                st = await c.stats()
+                assert st["tier0"]["installs"] >= 1
+                await c.config_announce({"prepare": {
+                    "kind": "bucket", "old": [1000.0, 1e-9],
+                    "new": [500.0, 1e-9]}, "version": 1})
+                await c.config_announce({"commit": 1})
+                # immediately after the commit, stale frames may still
+                # be served from the C replica's last-acked headroom —
+                # the documented one-sync-interval epsilon
+                for _ in range(100):
+                    r = await c.acquire("hot", 1, 1000.0, 1e-9)
+                    assert r.granted
+                await asyncio.sleep(0.1)  # pump retires the replicas
+                # now a stale frame falls through to the batch lane and
+                # chases the routable moved error exactly once
+                r = await c.acquire("hot", 1, 1000.0, 1e-9)
+                assert r.granted
+                st = await c.stats()
+                assert st["config"]["moved_errors"] >= 1
+                assert st["tier0"]["retired_config_rows"] >= 1
+                # every spent permit is accounted on the NEW table: the
+                # authoritative balance reflects all ~301 grants, not
+                # just the post-mutation ones (500 − spent, saturating)
+                tokens, _ = backing._buckets[("hot", 500.0, 1e-9)]
+                assert tokens == pytest.approx(500.0 - 301.0, abs=16.0)
+            finally:
+                await c.aclose()
+
+    run(body())

@@ -25,6 +25,14 @@ extracts a wire model from each side and diffs them:
   corresponding ``.cc``, and vice versa — a symbol on one side only is
   either a binding that can never resolve or dead C surface nothing
   feature-detects.
+- **Retry-safety classification** (``wire-idempotency``): every
+  ``OP_*`` constant in ``wire.py`` must be explicitly classified in
+  exactly one of ``_IDEMPOTENT_OPS`` / ``_NON_IDEMPOTENT_OPS`` in
+  ``runtime/remote.py`` (file:line on both sides). The idempotent set
+  is the client's post-send retry whitelist — an op missing from BOTH
+  sets is a deliberate-looking accident: nobody decided whether a
+  retry after an ambiguous failure can double-apply it, and a future
+  op silently defaults to whatever the author forgot to think about.
 """
 
 from __future__ import annotations
@@ -42,7 +50,7 @@ from tools.drl_check.common import (
 )
 
 __all__ = ["check", "check_wire", "check_abi", "check_dispatch",
-           "extract_py_model", "extract_c_model"]
+           "check_idempotency", "extract_py_model", "extract_c_model"]
 
 
 # -- Python-side model ------------------------------------------------------
@@ -427,6 +435,84 @@ def check_dispatch(wire_py: pathlib.Path, server_py: pathlib.Path,
     return findings
 
 
+# -- retry-safety classification --------------------------------------------
+
+_IDEMPOTENCY_SETS = ("_IDEMPOTENT_OPS", "_NON_IDEMPOTENT_OPS")
+
+
+def _remote_op_sets(remote_py: pathlib.Path
+                    ) -> "dict[str, tuple[dict[str, int], int]]":
+    """The two classification sets in remote.py: ``{set_name:
+    ({op_name: line}, assignment_line)}``. Members are the ``wire.OP_*``
+    attributes inside the (frozen)set literal the name is assigned."""
+    tree = ast.parse(remote_py.read_text())
+    out: dict[str, tuple[dict[str, int], int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) \
+                or target.id not in _IDEMPOTENCY_SETS:
+            continue
+        members: dict[str, int] = {}
+        for sub in ast.walk(node.value):
+            if (isinstance(sub, ast.Attribute)
+                    and sub.attr.startswith("OP_")
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "wire"):
+                members.setdefault(sub.attr, sub.lineno)
+        out[target.id] = (members, node.lineno)
+    return out
+
+
+def check_idempotency(wire_py: pathlib.Path, remote_py: pathlib.Path,
+                      root: pathlib.Path) -> list[Finding]:
+    """``wire-idempotency``: every ``OP_*`` in wire.py appears in
+    exactly one of remote.py's ``_IDEMPOTENT_OPS`` /
+    ``_NON_IDEMPOTENT_OPS``. In one set = someone decided whether a
+    post-send retry may replay it; in neither = the decision was never
+    made (and the op silently defaults to retry-unsafe); in both = the
+    two halves of the classification disagree."""
+    py = extract_py_model(wire_py)
+    sets = _remote_op_sets(remote_py)
+    wire_rel = rel(wire_py, root)
+    remote_rel = rel(remote_py, root)
+    findings: list[Finding] = []
+    missing_sets = [s for s in _IDEMPOTENCY_SETS if s not in sets]
+    if missing_sets:
+        return [Finding(
+            "wire-idempotency",
+            f"remote.py does not define {', '.join(missing_sets)} — the "
+            "explicit retry-safety classification is gone",
+            remote_rel, 1, ((wire_rel, 1, "ops defined here"),))]
+    for name, (value, line) in sorted(py.constants.items()):
+        if not name.startswith("OP_"):
+            continue
+        homes = [s for s in _IDEMPOTENCY_SETS if name in sets[s][0]]
+        if len(homes) == 1:
+            continue
+        if not homes:
+            findings.append(Finding(
+                "wire-idempotency",
+                f"{name} = {value} is classified in neither "
+                "_IDEMPOTENT_OPS nor _NON_IDEMPOTENT_OPS — decide "
+                "whether a post-send retry may replay it and say so "
+                "explicitly",
+                wire_rel, line,
+                tuple((remote_rel, sets[s][1], f"{s} defined here")
+                      for s in _IDEMPOTENCY_SETS)))
+        else:
+            findings.append(Finding(
+                "wire-idempotency",
+                f"{name} = {value} appears in BOTH _IDEMPOTENT_OPS and "
+                "_NON_IDEMPOTENT_OPS — the classification contradicts "
+                "itself",
+                wire_rel, line,
+                tuple((remote_rel, sets[s][0][name], f"member of {s}")
+                      for s in _IDEMPOTENCY_SETS)))
+    return findings
+
+
 # -- entry points -----------------------------------------------------------
 
 def check_wire(wire_py: pathlib.Path, frontend_cc: pathlib.Path,
@@ -447,6 +533,8 @@ def check(root: pathlib.Path) -> list[Finding]:
                           root / "native" / "frontend.cc", root)
     findings += check_dispatch(pkg / "runtime" / "wire.py",
                                pkg / "runtime" / "server.py", root)
+    findings += check_idempotency(pkg / "runtime" / "wire.py",
+                                  pkg / "runtime" / "remote.py", root)
     findings += check_abi(pkg / "utils" / "native.py",
                           [root / "native" / "frontend.cc",
                            root / "native" / "directory.cc"], root)
