@@ -8,9 +8,9 @@ PY ?= python
 CXX ?= g++
 
 .PHONY: check lint test native asan-test tsan-test chaos-test \
-        reshard-soak upgrade-soak
+        reshard-soak upgrade-soak parity-fuzz
 
-check: lint test chaos-test upgrade-soak asan-test tsan-test
+check: lint test chaos-test upgrade-soak parity-fuzz asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -53,6 +53,13 @@ reshard-soak:
 upgrade-soak:
 	JAX_PLATFORMS=cpu DRL_UPGRADE_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_upgrade.py -v -p no:cacheprovider
+
+# Native-vs-asyncio differential fuzz, verbosely (also part of tier-1):
+# reply-for-reply byte identity over randomized scalar AND bulk
+# (ACQUIRE_MANY) traffic, including traced/MOVED/retired-config frames.
+parity-fuzz:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_parity_fuzz.py \
+	  tests/test_native_bulk.py -v -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).
 native:

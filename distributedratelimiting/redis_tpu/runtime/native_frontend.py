@@ -8,9 +8,12 @@ policy), and encodes/writes every reply natively. This module is the
 *decision* half: a pump thread blocks in ``fe_wait`` (GIL released) and
 dispatches each batch onto the server's asyncio loop as ONE store bulk
 call — so Python cost is per-flush, not per-request. The hot set is the
-four per-request decision ops: ACQUIRE, WINDOW, FWINDOW, and SEMA
-(signed-delta semaphore rows batch into ``concurrency_acquire_many``).
-Non-hot ops (HELLO, PEEK, SYNC, STATS, SAVE, ACQUIRE_MANY, …) arrive as
+four per-request decision ops — ACQUIRE, WINDOW, FWINDOW, and SEMA
+(signed-delta semaphore rows batch into ``concurrency_acquire_many``) —
+plus, since round 8, OP_ACQUIRE_MANY: bulk frames parse, tier-0-decide,
+and encode RESP_BULK in C, and only the residue rows cross here as one
+zero-copy KeyBlob batch (``_serve_bulk``). Non-hot ops (HELLO, PEEK,
+SYNC, STATS, SAVE, control ops, …) and MALFORMED bulk frames arrive as
 passthrough frames and are served by the same
 :class:`~.server.BucketStoreServer` handler the asyncio path uses;
 :mod:`~.wire` stays the single protocol authority for those shapes.
@@ -107,7 +110,8 @@ class NativeFrontend:
 
     def __init__(self, server, *, host: str, port: int,
                  max_batch: int = 4096, deadline_us: int = 300,
-                 tier0: "Tier0Config | bool | None" = None) -> None:
+                 tier0: "Tier0Config | bool | None" = None,
+                 bulk: bool = True) -> None:
         lib = load_frontend_lib()
         if lib is None:
             raise RuntimeError(
@@ -163,6 +167,29 @@ class NativeFrontend:
         if tier0:
             self._tier0_setup(
                 tier0 if isinstance(tier0, Tier0Config) else Tier0Config())
+        # Native bulk lane (round 8): OP_ACQUIRE_MANY parses, tier-0
+        # decides hot rows, and RESP_BULK encodes in C; only the residue
+        # crosses here (fe_wait kind 3). Armed explicitly — a new .so
+        # under an older pump keeps the round-7 passthrough behavior,
+        # and a stale .so under this pump falls back the same way.
+        self._bulk_native = bool(bulk) and getattr(lib, "has_bulk", False)
+        if bulk and not self._bulk_native:
+            logger.warning(
+                "native bulk lane requested but the loaded front-end "
+                "binary predates the fe_bulk ABI; ACQUIRE_MANY stays on "
+                "the passthrough lane")
+        self._hot_task: asyncio.Task | None = None
+        if self._bulk_native:
+            hh = getattr(server, "heavy_hitters", None)
+            lib.fe_bulk_configure(self._h, 1, 1, 1 if hh is not None
+                                  else 0)
+            if hh is not None:
+                # The bulk lane's keys never materialize in Python, so
+                # the C side aggregates per-frame top-K and this pump
+                # offers the survivors to the sketch (the scalar batch
+                # lane's offer_many discipline, re-hosted below the ABI).
+                self._hot_task = asyncio.get_running_loop().create_task(
+                    self._hot_harvest_loop())
         self._pump = threading.Thread(target=self._pump_loop, daemon=True,
                                       name="native-frontend-pump")
         self._pump.start()
@@ -229,6 +256,8 @@ class NativeFrontend:
                     self._dispatch_batch()
                 elif kind == 2:
                     self._dispatch_passthrough()
+                elif kind == 3:
+                    self._dispatch_bulk()
             except Exception as exc:  # noqa: BLE001 — the pump is the one
                 # thread every connection depends on: it must survive any
                 # single bad batch/frame (the items get error replies via
@@ -240,6 +269,15 @@ class NativeFrontend:
                             self._h), repr(exc)[:200].encode())
                     # the batch failure above was already logged;
                     # fe_fail itself dying adds nothing
+                    # drl-check: ok(swallowed-exception)
+                    except Exception:  # noqa: BLE001
+                        pass
+                elif kind == 3:
+                    try:
+                        self._lib.fe_bulk_fail(
+                            self._h, self._lib.fe_bulk_id(self._h),
+                            repr(exc)[:200].encode())
+                    # same posture as fe_fail above
                     # drl-check: ok(swallowed-exception)
                     except Exception:  # noqa: BLE001
                         pass
@@ -306,6 +344,46 @@ class NativeFrontend:
         lib.fe_pt_copy(h, buf)
         body = buf.raw[:ln]
         self._track(self._serve_passthrough(int(conn_id), body))
+
+    def _dispatch_bulk(self) -> None:
+        """Hand one bulk residue job to the loop. The key blob, offsets,
+        counts, and residue arrays are ZERO-COPY views into the C-held
+        job (the ``wire.KeyBlob`` → ``dir_resolve_batch`` lane on the
+        Python side): valid until fe_bulk_complete/discard/fail erases
+        the job, which only ``_serve_bulk`` does — after its last read."""
+        lib, h = self._lib, self._h
+        c = ctypes
+        u = np.zeros(11, np.uint64)
+        f = np.zeros(2, np.float64)
+        lib.fe_bulk_meta(h, u.ctypes.data_as(c.POINTER(c.c_uint64)),
+                         f.ctypes.data_as(c.POINTER(c.c_double)))
+        jid = int(u[0])
+        if jid == 0:
+            return
+        n, blob_len, res_n = int(u[4]), int(u[5]), int(u[6])
+        ptrs = np.zeros(4, np.uint64)
+        lib.fe_bulk_ptrs(h, ptrs.ctypes.data_as(c.POINTER(c.c_uint64)))
+        # A (c_char × len) view passes anywhere the KeyBlob contract
+        # needs it: c_char_p args (dir_resolve_batch, dir_route_batch,
+        # dir_fp64_batch) take it directly and slicing yields bytes for
+        # the serial stores' lazy per-key decode. No blob copy, no
+        # Python strings.
+        blob = ((c.c_char * blob_len).from_address(int(ptrs[0]))
+                if blob_len else b"")
+        offsets = np.ctypeslib.as_array(
+            c.cast(int(ptrs[1]), c.POINTER(c.c_int64)), (n + 1,))
+        counts = np.ctypeslib.as_array(
+            c.cast(int(ptrs[2]), c.POINTER(c.c_int64)), (n,))
+        residue = np.ctypeslib.as_array(
+            c.cast(int(ptrs[3]), c.POINTER(c.c_int32)), (res_n,))
+        tctx = None
+        if int(u[10]) & 1 and tracing.get_tracer().enabled:
+            tctx = tracing.TraceContext(int(u[7]), int(u[8]), int(u[9]),
+                                        1 if int(u[10]) & 2 else 0)
+        self._track(self._serve_bulk(
+            jid, int(u[1]), int(u[2]), int(u[3]), float(f[0]),
+            float(f[1]), wire.KeyBlob(blob, offsets), counts, residue,
+            tctx))
 
     # -- loop-side serving -------------------------------------------------
 
@@ -593,6 +671,141 @@ class NativeFrontend:
                 "fe.batch", ctx, t_start, t_end, status=status,
                 attrs={"op": wire.op_name(int(ops[i]))})
 
+    async def _serve_bulk(self, jid: int, conn_id: int, seq: int,
+                          flags: int, a: float, b: float,
+                          keys: "wire.KeyBlob", counts: np.ndarray,
+                          residue: np.ndarray, tctx=None) -> None:
+        """Loop half of the native bulk lane: decide the residue rows
+        the C side could not (cold keys, windows, probes), mirroring the
+        asyncio server's ACQUIRE_MANY branch gate for gate — config,
+        drain, placement, in that order — so the two lanes stay
+        reply-for-reply identical; then ``fe_bulk_complete`` merges the
+        verdicts and encodes RESP_BULK in C. Frame-level gate errors are
+        answered via fe_send + fe_bulk_discard (the kRowSkip posture,
+        whole-frame edition). Rows tier-0 already granted in a frame
+        that then hits a gate stay debited through the sync/retire lane
+        — the documented ≤-one-interval epsilon family, same as the
+        scalar lanes' commit races."""
+        srv = self._server
+        lib, h = self._lib, self._h
+        n = len(keys)
+        try:
+            # wire.py stays the single layout authority for the flags
+            # byte (the C mirror is drl-check-diffed; a third hand-coded
+            # copy here would sit outside that conformance net).
+            with_rem = bool(flags & wire._FLAG_WITH_REMAINING)
+            kind = (flags & wire._KIND_MASK) >> wire._KIND_SHIFT
+            ckind = liveconfig.BULK_KINDS.get(kind)
+            lc = srv.liveconfig
+            if lc.active and ckind is not None:
+                fwd = lc.forward(ckind, a, b)
+                if fwd is not None:
+                    # Retired config: the frame-level routable moved
+                    # error, byte-identical to the asyncio gate (no
+                    # residue row was applied, so the translated
+                    # re-send is not a replay).
+                    self._send(conn_id, wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        lc.moved(ckind, a, b, fwd)))
+                    lib.fe_bulk_discard(h, jid)
+                    return
+            env = srv._drain_envelope
+            if env is not None:
+                resp = srv._serve_bulk_draining(seq, keys, counts, a, b,
+                                                with_rem, kind, env)
+                self._send(conn_id, resp)
+                lib.fe_bulk_discard(h, jid)
+                return
+            gate = (srv.placement.bulk_gate(keys)
+                    if srv.placement.active else None)
+            if gate is not None and gate[2].any():
+                # Misrouted rows: frame-level moved error (the asyncio
+                # lane's posture — a bulk-only client needs the refresh
+                # trigger; no row was applied).
+                i = int(np.nonzero(gate[2])[0][0])
+                key = keys[i]
+                self._send(conn_id, wire.encode_response(
+                    seq, wire.RESP_ERROR, srv.placement.moved_message(
+                        key, int(srv.placement.pmap.node_of(key)))))
+                lib.fe_bulk_discard(h, jid)
+                return
+            rn = len(residue)
+            granted = np.zeros(rn, np.uint8)
+            remaining = np.zeros(rn, np.float64)
+            espan = tracing._NULL_SPAN
+            if tctx is not None:
+                tracer = tracing.get_tracer()
+                if tracer.enabled:
+                    espan = tracer.start_span(
+                        "fe.bulk", parent=tctx,
+                        attrs={"n": n, "residue": rn})
+            with espan:
+                if gate is None:
+                    # Whole-frame residue keeps the zero-copy KeyBlob
+                    # (the common tier-0-cold / window-kind shape); a
+                    # partial residue decodes only its own minority.
+                    sub_keys = (keys if rn == n
+                                else [keys[int(i)] for i in residue])
+                    sub_counts = (counts if rn == n
+                                  else np.asarray(counts)[residue])
+                    res = await self._bulk_store_call(
+                        sub_keys, sub_counts, a, b, kind, with_rem)
+                    granted = np.asarray(res.granted, np.uint8)
+                    if res.remaining is not None:
+                        remaining = np.asarray(res.remaining, np.float64)
+                else:
+                    serve_mask, envelope_rows, _moved = gate
+                    env_rows = dict(envelope_rows)
+                    store_pos = [p for p in range(rn)
+                                 if serve_mask[int(residue[p])]]
+                    if store_pos:
+                        sub_keys = [keys[int(residue[p])]
+                                    for p in store_pos]
+                        sub_counts = np.asarray(counts)[
+                            np.asarray(residue)[store_pos]]
+                        res = await self._bulk_store_call(
+                            sub_keys, sub_counts, a, b, kind, with_rem)
+                        granted[store_pos] = np.asarray(res.granted,
+                                                        np.uint8)
+                        if res.remaining is not None:
+                            remaining[store_pos] = np.asarray(
+                                res.remaining, np.float64)
+                    # Parked rows serve their handoff envelope, exactly
+                    # like _serve_bulk_gated's rows (same helper, same
+                    # order: store first, envelopes after).
+                    for p in range(rn):
+                        i = int(residue[p])
+                        handoff = env_rows.get(i)
+                        if handoff is not None:
+                            g, rem = srv.placement.envelope_acquire(
+                                handoff, keys[i], int(counts[i]), a, b,
+                                liveconfig.BULK_KINDS[kind])
+                            granted[p] = g
+                            remaining[p] = rem
+            c = ctypes
+            lib.fe_bulk_complete(
+                h, jid,
+                np.ascontiguousarray(granted).ctypes.data_as(
+                    c.POINTER(c.c_uint8)),
+                np.ascontiguousarray(remaining).ctypes.data_as(
+                    c.POINTER(c.c_double)))
+        except Exception as exc:  # noqa: BLE001 — every frame must get
+            log.error_evaluating_kernel(exc)  # a routable error reply
+            lib.fe_bulk_fail(h, jid, repr(exc)[:200].encode())
+
+    async def _bulk_store_call(self, keys, counts, a: float, b: float,
+                               kind: int, with_rem: bool):
+        """The same store entry the asyncio ACQUIRE_MANY branch calls —
+        shared shape, shared semantics (the differential fuzz pins the
+        two lanes reply-for-reply)."""
+        if kind == wire.BULK_KIND_BUCKET:
+            return await self._server.store.acquire_many(
+                keys, counts, a, b, with_remaining=with_rem)
+        return await self._server.store.window_acquire_many(
+            keys, counts, a, b,
+            fixed=(kind == wire.BULK_KIND_FWINDOW),
+            with_remaining=with_rem)
+
     async def _serve_passthrough(self, conn_id: int, body: bytes) -> None:
         try:
             op = body[5] if len(body) >= 6 else 0
@@ -602,9 +815,11 @@ class NativeFrontend:
             if op != wire.OP_ACQUIRE_MANY:
                 await self._serve_passthrough_inner(conn_id, body)
                 return
-            # Bulk frames run as their own tasks so a long store call
-            # can't stall the pump's other passthrough work; chained
-            # chunks order behind the connection's tail.
+            # Only MALFORMED bulk frames (or a disabled/stale bulk lane)
+            # reach this path since round 8 — well-formed ones are
+            # native. They still run as their own tasks so a long store
+            # call can't stall the pump's other passthrough work;
+            # chained chunks order behind the connection's tail.
             prev = (self._bulk_tails.get(conn_id)
                     if wire.bulk_request_chained(body) else None)
             task = self._track_task(
@@ -869,6 +1084,62 @@ class NativeFrontend:
             if got < 256:
                 return
 
+    #: Cadence of the bulk-lane hot-key harvest (C ring → sketch). The
+    #: ring is bounded (oldest drop), so a slower drain costs tail
+    #: fidelity, never memory.
+    _HOT_HARVEST_S = 0.5
+
+    async def _hot_harvest_loop(self) -> None:
+        """Drain the C bulk lane's per-frame top-K ring into the
+        server's heavy-hitter sketch. This closes the PR-2 exemption for
+        the native lane: zero-copy bulk keys never materialize in
+        Python, so the C side aggregates (top-K per frame) and this pump
+        offers only the survivors — exactly the traffic tier-0 bulk
+        needs surfaced for hot-row identification."""
+        hh = self._server.heavy_hitters
+        blob = ctypes.create_string_buffer(256 * 256)
+        klens = np.zeros(256, np.int32)
+        weights = np.zeros(256, np.float64)
+        c = ctypes
+        while True:
+            await asyncio.sleep(self._HOT_HARVEST_S)
+            while True:
+                got = self._lib.fe_hot_harvest(
+                    self._h, blob, len(blob),
+                    klens.ctypes.data_as(c.POINTER(c.c_int32)),
+                    weights.ctypes.data_as(c.POINTER(c.c_double)), 256)
+                if got <= 0:
+                    break
+                used = ctypes.string_at(blob, int(klens[:got].sum()))
+                keys = wire.decode_key_blob(used, klens[:got],
+                                            errors="surrogateescape")
+                for k, w in zip(keys, weights[:got].tolist()):
+                    hh.offer(k, w)
+                if got < 256:
+                    break
+
+    def bulk_stats(self) -> dict | None:
+        """C-side native-bulk gauges (``None`` when the lane is off).
+        ``rows_local`` are per-row tier-0 decisions (grant or confident
+        deny) made without leaving C; ``permits_local`` is the granted
+        amount the tier-0 sync pump debits — the "bulk sync debits"
+        gauge of the epsilon audit."""
+        if not self._bulk_native:
+            return None
+        counts = (ctypes.c_longlong * 7)()
+        self._lib.fe_bulk_counts(self._h, counts)
+        (frames, frames_local, rows, rows_local, rows_residue,
+         permits_local, hot_dropped) = (int(v) for v in counts)
+        return {
+            "frames": frames,
+            "frames_local": frames_local,
+            "rows": rows,
+            "rows_local": rows_local,
+            "rows_residue": rows_residue,
+            "permits_local": permits_local,
+            "hot_ring_dropped": hot_dropped,
+        }
+
     #: Consecutive failed sync rounds that count as a degraded-mode
     #: streak and trip the flight recorder.
     T0_STREAK_DUMP = 3
@@ -997,6 +1268,15 @@ class NativeFrontend:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
             self._t0_task = None
+        if self._hot_task is not None:
+            # Same handle discipline as the t0 pump: fe_hot_harvest
+            # reads the C handle, so the drain must die before fe_free.
+            self._hot_task.cancel()
+            try:
+                await self._hot_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._hot_task = None
         await asyncio.to_thread(self._lib.fe_stop, self._h)
         await asyncio.to_thread(self._pump.join, 5.0)
         while self._loop_tasks:

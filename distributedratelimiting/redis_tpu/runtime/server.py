@@ -97,6 +97,7 @@ class BucketStoreServer:
                  native_max_batch: int = 4096,
                  native_deadline_us: int = 300,
                  native_tier0=False,
+                 native_bulk: bool = True,
                  metrics_port: int | None = None,
                  observability: bool = True,
                  heavy_hitters_k: int = 64,
@@ -131,6 +132,11 @@ class BucketStoreServer:
         # C epoll loop — no batcher, no Python, no device round trip —
         # reconciled by an async bulk debit (docs/OPERATIONS.md §3).
         self.native_tier0 = native_tier0
+        # Native bulk lane (round 8, native front-end only): well-formed
+        # OP_ACQUIRE_MANY frames parse, tier-0-decide per row, and
+        # encode RESP_BULK in C — only cold-row residue reaches Python.
+        # Default on; --no-fe-bulk restores the round-7 passthrough.
+        self.native_bulk = native_bulk
         self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
@@ -238,7 +244,8 @@ class BucketStoreServer:
                     self, host=self.host, port=self.port,
                     max_batch=self.native_max_batch,
                     deadline_us=self.native_deadline_us,
-                    tier0=self.native_tier0)
+                    tier0=self.native_tier0,
+                    bulk=self.native_bulk)
             except RuntimeError as exc:
                 # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
                 # serve anyway on the asyncio path — availability over
@@ -434,6 +441,13 @@ class BucketStoreServer:
             counters={"hits", "local_denies", "misses", "installs",
                       "evictions", "syncs", "sync_failures",
                       "keys_synced"})
+        reg.register_numeric_dict(
+            "native_bulk", "native bulk admission lane",
+            lambda: (self._native.bulk_stats()
+                     if self._native is not None else None),
+            counters={"frames", "frames_local", "rows", "rows_local",
+                      "rows_residue", "permits_local",
+                      "hot_ring_dropped"})
         if self.heavy_hitters is not None:
             hh = self.heavy_hitters
             reg.gauge("hot_keys_offered",
@@ -1234,6 +1248,9 @@ class BucketStoreServer:
             tier0 = self._native.tier0_stats()
             if tier0 is not None:
                 payload["tier0"] = tier0
+            bulk = self._native.bulk_stats()
+            if bulk is not None:
+                payload["native_bulk"] = bulk
         else:
             payload = {
                 "connections_served": self.connections_served,
@@ -1410,6 +1427,12 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--fe-tier0-fraction", type=float, default=0.5,
                         help="tier-0: fraction of the last-synced "
                         "balance granted as local headroom")
+    parser.add_argument("--no-fe-bulk", action="store_true",
+                        help="disable the native bulk lane: "
+                        "OP_ACQUIRE_MANY frames fall back to the Python "
+                        "passthrough path instead of parsing, tier-0-"
+                        "deciding, and encoding RESP_BULK in C "
+                        "(docs/OPERATIONS.md §3)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="serve the OpenMetrics exposition over HTTP "
                         "on this port (GET /metrics; 0 picks a free "
@@ -1522,6 +1545,7 @@ def main(argv: list[str] | None = None) -> None:
                                    native_max_batch=args.fe_max_batch,
                                    native_deadline_us=args.fe_deadline_us,
                                    native_tier0=native_tier0,
+                                   native_bulk=not args.no_fe_bulk,
                                    metrics_port=args.metrics_port,
                                    observability=not args.no_observability,
                                    flight_dir=args.flight_dir,

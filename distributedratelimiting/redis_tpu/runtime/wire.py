@@ -138,6 +138,7 @@ __all__ = [
     "encode_bulk_request", "decode_bulk_request", "encode_bulk_response",
     "bulk_chunk_spans", "KeyBlob", "decode_key_blob",
     "BULK_KIND_BUCKET", "BULK_KIND_WINDOW", "BULK_KIND_FWINDOW",
+    "BULK_REQ_HEAD_LEN", "BULK_RESP_HEAD_LEN",
     "read_frame", "write_frame",
 ]
 
@@ -482,6 +483,12 @@ def decode_response(frame: bytes) -> tuple[int, int, tuple]:
 
 _BULK_REQ_HEAD = struct.Struct("<BddI")   # flags, capacity, fill_rate, n
 _BULK_RESP_HEAD = struct.Struct("<BI")    # flags, n
+
+#: Named head widths so the native bulk lane's C mirror (kBulkReqHead /
+#: kBulkRespHead in frontend.cc) is diffable by drl-check — this module
+#: stays the normative layout (docs/DESIGN.md §10).
+BULK_REQ_HEAD_LEN = _BULK_REQ_HEAD.size
+BULK_RESP_HEAD_LEN = _BULK_RESP_HEAD.size
 
 #: Per-request wire overhead in an ACQUIRE_MANY frame: u16 klen + u32 count.
 BULK_PER_KEY_OVERHEAD = 6

@@ -48,7 +48,12 @@ class FlightRecorder:
         self.dumps_written = 0
         self.dumps_suppressed = 0
         self.last_dump_path: str | None = None
-        self._last_dump_mono = 0.0
+        # None, not 0.0: time.monotonic() counts from boot, so a zero
+        # sentinel reads as "dumped at boot" and wrongly suppresses the
+        # FIRST automatic dump on any machine whose uptime is still
+        # below min_dump_interval_s (a fresh container losing its first
+        # — often only — outage capture).
+        self._last_dump_mono: float | None = None
 
     def record(self, kind: str, **fields) -> None:
         """Append one frame. Cheap by design (one dict + deque append);
@@ -70,8 +75,8 @@ class FlightRecorder:
         when suppressed or the write fails (a full disk must never take
         the serving path down with it)."""
         now = time.monotonic()
-        if not force and (now - self._last_dump_mono
-                          < self.min_dump_interval_s):
+        if (not force and self._last_dump_mono is not None
+                and now - self._last_dump_mono < self.min_dump_interval_s):
             self.dumps_suppressed += 1
             return None
         safe_reason = "".join(c if c.isalnum() or c in "-_" else "_"

@@ -341,6 +341,39 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.has_t0_retire = True
     except AttributeError:  # stale binary without the retire ABI
         lib.has_t0_retire = False
+    try:
+        # Round 8 (native bulk lane): OP_ACQUIRE_MANY parses, tier-0
+        # decides, and RESP_BULK encodes in C; fe_wait returns 3 for a
+        # residue job. Armed explicitly via fe_bulk_configure so a new
+        # binary under an older pump keeps the passthrough behavior.
+        lib.fe_bulk_configure.argtypes = [c.c_void_p, c.c_int, c.c_int,
+                                          c.c_int]
+        lib.fe_bulk_configure.restype = c.c_int
+        lib.fe_bulk_id.argtypes = [c.c_void_p]
+        lib.fe_bulk_id.restype = c.c_longlong
+        lib.fe_bulk_meta.argtypes = [c.c_void_p, c.POINTER(c.c_uint64),
+                                     c.POINTER(c.c_double)]
+        lib.fe_bulk_meta.restype = None
+        lib.fe_bulk_ptrs.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+        lib.fe_bulk_ptrs.restype = None
+        lib.fe_bulk_complete.argtypes = [c.c_void_p, c.c_longlong,
+                                         c.POINTER(c.c_uint8),
+                                         c.POINTER(c.c_double)]
+        lib.fe_bulk_complete.restype = None
+        lib.fe_bulk_discard.argtypes = [c.c_void_p, c.c_longlong]
+        lib.fe_bulk_discard.restype = None
+        lib.fe_bulk_fail.argtypes = [c.c_void_p, c.c_longlong, c.c_char_p]
+        lib.fe_bulk_fail.restype = None
+        lib.fe_bulk_counts.argtypes = [c.c_void_p,
+                                       c.POINTER(c.c_longlong)]
+        lib.fe_bulk_counts.restype = None
+        lib.fe_hot_harvest.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int, c.POINTER(c.c_int32),
+            c.POINTER(c.c_double), c.c_int]
+        lib.fe_hot_harvest.restype = c.c_int
+        lib.has_bulk = True
+    except AttributeError:  # stale binary without the bulk ABI
+        lib.has_bulk = False
     return lib
 
 

@@ -7,10 +7,13 @@
 // (reference TokenBucket/RedisTokenBucketRateLimiter.cs:111-174
 // ConnectAsync; SURVEY.md §5.8). This file plays the Redis-process role
 // for the TPU store: it owns the listening socket, parses the v4 wire
-// protocol (runtime/wire.py is the format authority), decides NOTHING
-// itself, and hands micro-batches of per-request acquires to Python
-// exactly once per flush — so the per-REQUEST Python cost of the serving
-// path drops to zero and the per-BATCH cost is one store bulk call. The
+// protocol (runtime/wire.py is the format authority), hands
+// micro-batches of per-request acquires to Python exactly once per
+// flush — so the per-REQUEST Python cost of the serving path drops to
+// zero and the per-BATCH cost is one store bulk call — and since round
+// 8 serves OP_ACQUIRE_MANY natively too: parse, per-row tier-0
+// decisions, and the RESP_BULK encode all run here, with only the
+// cold-row residue crossing the ABI as one zero-copy batch. The
 // measured per-request asyncio ceiling this replaces is ~13K req/s/core
 // with a zero-cost kernel (benchmarks/RESULTS.md "Per-request socket
 // ceiling isolated"); everything that ceiling charges per request
@@ -60,6 +63,7 @@
 #include <deque>
 #include <mutex>
 #include <string>
+#include <string_view>
 #include <chrono>
 #include <thread>
 #include <unordered_map>
@@ -165,6 +169,26 @@ constexpr uint8_t OP_MIGRATE_PUSH = 17;
 // retired config's debits and zeroes its replica headroom).
 constexpr uint8_t OP_CONFIG = 18;
 
+// Bulk admission lane (round 8): OP_ACQUIRE_MANY parses HERE, tier-0
+// decides hot bucket rows per-row, and the RESP_BULK reply encodes in C
+// — only the cold/uncertain residue crosses the fe_bulk_* ABI, as one
+// zero-copy blob+offsets+counts batch (the wire.KeyBlob lane). wire.py
+// stays the layout authority; drl-check diffs every constant below
+// against it (kBulkReqHead ↔ _BULK_REQ_HEAD et al).
+constexpr uint8_t OP_ACQUIRE_MANY = 11;
+constexpr size_t kBulkReqHead = 21;   // [u8 flags][f64 a][f64 b][u32 n]
+constexpr size_t kBulkRespHead = 5;   // [u8 flags][u32 n]
+constexpr uint8_t kBulkFlagRemaining = 1;  // wire _FLAG_WITH_REMAINING
+constexpr uint8_t kBulkFlagChained = 8;    // wire _FLAG_CHAINED
+constexpr uint8_t kBulkKindMask = 6;       // wire _KIND_MASK (bits 1-2)
+constexpr int kBulkKindShift = 1;          // wire _KIND_SHIFT
+constexpr uint8_t BULK_KIND_BUCKET = 0;
+constexpr uint8_t BULK_KIND_WINDOW = 1;
+constexpr uint8_t BULK_KIND_FWINDOW = 2;
+// Flags bit 4: the 25-byte trace tail rides after the counts array
+// (old decoders read arrays by explicit counts and never see it).
+constexpr uint8_t BULK_FLAG_TRACED = 16;
+
 // Op-byte bit 7 (wire.py TRACE_FLAG): a 25-byte trace tail —
 // [u64 trace_hi][u64 trace_lo][u64 parent span][u8 flags] — follows the
 // payload. Only sampled requests carry it; parsing it here keeps traced
@@ -175,6 +199,7 @@ constexpr size_t kTraceTail = 25;
 
 constexpr uint8_t RESP_DECISION = 64;
 constexpr uint8_t RESP_EMPTY = 67;
+constexpr uint8_t RESP_BULK = 69;
 constexpr uint8_t RESP_ERROR = 127;
 
 // Serving-latency histogram: identical convention to
@@ -277,6 +302,41 @@ struct Passthrough {
   std::string frame;  // full body: [ver][seq][op][payload]
 };
 
+// One OP_ACQUIRE_MANY frame whose residue rows (cold keys, windows,
+// probes — everything tier-0 could not decide) are out with Python.
+// The reply is one RESP_BULK covering ALL rows: the C-decided verdicts
+// wait here until fe_bulk_complete merges the residue verdicts in, so
+// nothing is sent early and the frame stays all-or-one-reply. blob/
+// offsets/counts are address-stable until the job is erased — the
+// zero-copy contract fe_bulk_ptrs hands to Python.
+struct BulkJob {
+  int64_t id = 0;
+  uint64_t conn_id = 0;
+  uint32_t seq = 0;
+  uint8_t flags = 0;  // the frame's wire flags byte
+  uint8_t kind = 0;   // BULK_KIND_*
+  bool with_remaining = false;
+  double a = 0.0, b = 0.0;
+  uint32_t n = 0;
+  std::string blob;              // concatenated key bytes
+  std::vector<int64_t> offsets;  // n + 1 boundaries into blob
+  std::vector<int64_t> counts;   // per-row requested permits
+  std::vector<uint8_t> verdict;  // 0 deny, 1 grant, 2 awaiting residue
+  std::vector<float> remaining;  // per-row estimate (RESP_BULK is f32)
+  std::vector<int32_t> residue;  // row indices Python must decide
+  uint64_t t_ns = 0;             // arrival — serving latency start
+  uint64_t tr_hi = 0, tr_lo = 0, tr_parent = 0;
+  uint8_t tr_flags = 0;
+};
+
+// Per-frame hot-key aggregation slot (bulk_hot_feed scratch).
+struct HotSlot {
+  uint64_t hash = 0;
+  uint64_t epoch = 0;
+  int64_t row = 0;
+  double weight = 0.0;
+};
+
 // One traced C-local decision, exported to Python as six u64s:
 // hi, lo, parent, start_ns (CLOCK_MONOTONIC — the same epoch Python's
 // perf_counter reads), dur_ns, meta (bits 0-7 wire flags, bit 8
@@ -298,6 +358,21 @@ struct Conn {
   std::string out;          // unwritten reply bytes
   size_t out_off = 0;       // write cursor into `out` (no O(n^2) erase)
   bool want_write = false;  // EPOLLOUT armed
+  // Native bulk lane ordering: the last bulk frame's inflight job id
+  // (0 once it completed). A chained chunk (wire _FLAG_CHAINED) must
+  // decide AFTER its predecessor — the asyncio server's per-connection
+  // bulk_tail contract — so chained frames park here until the
+  // predecessor's reply is encoded.
+  int64_t cur_bulk = 0;
+  std::deque<std::string> parked_bulk;  // raw frame bodies, FIFO
+  size_t parked_bytes = 0;
+  // True when the connection's LAST bulk frame was handed to the
+  // Python passthrough lane (malformed shape, or the lane disabled):
+  // a chained successor must order behind it THERE (the server's
+  // _bulk_tails), not race it natively — the asyncio server answers
+  // a malformed chunk's error before its chained successor's reply,
+  // and reply-for-reply parity includes that order.
+  bool bulk_pt_tail = false;
 };
 
 // Bound on bytes a connection may pipeline behind an unresolved HELLO.
@@ -347,7 +422,7 @@ struct T0Config {
 constexpr size_t kT0Probe = 8;
 constexpr size_t kT0MaxKey = 256;
 
-uint64_t t0_hash(const std::string& k) {
+uint64_t t0_hash(std::string_view k) {
   uint64_t h = 1469598103934665603ull;  // FNV-1a 64
   for (unsigned char ch : k) {
     h ^= ch;
@@ -417,36 +492,80 @@ struct Frontend {
   // requests still leave a trace. Bounded; overflow drops oldest.
   std::deque<TraceRec> trace_ring;
   int64_t trace_dropped = 0;
+
+  // Native bulk lane (fe_bulk_configure; round 8). Off by default so a
+  // freshly-built .so under an older Python half keeps the round-7
+  // passthrough behavior — the pump arms it only when it binds the
+  // fe_bulk_* ABI.
+  bool bulk_native = false;  // parse + decide OP_ACQUIRE_MANY here
+  bool bulk_t0 = true;       // per-row tier-0 decisions on bulk rows
+  bool bulk_hot = false;     // per-frame top-K feed for the sketch
+  std::deque<int64_t> bulk_ready;
+  std::unordered_map<int64_t, BulkJob> bulk_inflight;
+  int64_t next_bulk_id = 1;
+  int64_t cur_bulk_id = 0;  // last job returned by fe_wait
+  int64_t bulk_frames = 0;
+  int64_t bulk_frames_local = 0;  // answered without leaving C
+  int64_t bulk_rows = 0;
+  int64_t bulk_rows_local = 0;    // tier-0 grant/deny rows
+  int64_t bulk_rows_residue = 0;  // rows that crossed into Python
+  double bulk_permits_local = 0.0;  // locally granted permits — the
+                                    // amount the sync pump will debit
+  // Bulk parse scratch, reused per frame under mu (no per-frame allocs
+  // in the steady state; a residue job copies them out).
+  std::vector<int64_t> bulk_offsets_scratch;
+  std::vector<int64_t> bulk_counts_scratch;
+  std::vector<uint8_t> bulk_verdict_scratch;
+  std::vector<float> bulk_rem_scratch;
+  std::vector<int32_t> bulk_residue_scratch;
+  // Hot-key feed for the heavy-hitter sketch: per-frame open-addressed
+  // aggregation scratch + the bounded harvest ring fe_hot_harvest
+  // drains (overflow drops oldest — telemetry, not accounting).
+  std::vector<HotSlot> hot_scratch;
+  uint64_t hot_epoch = 0;
+  std::deque<std::pair<std::string, double>> hot_ring;
+  int64_t hot_dropped = 0;
 };
 
 constexpr size_t kTraceRing = 1024;
 
-void trace_ring_push(Frontend* fe, const Item& it, bool granted,
-                     uint64_t end_ns) {
+void trace_ring_push_raw(Frontend* fe, uint64_t hi, uint64_t lo,
+                         uint64_t parent, uint8_t tr_flags, uint8_t op,
+                         bool granted, uint64_t start_ns,
+                         uint64_t end_ns) {
   // mu held.
   if (fe->trace_ring.size() >= kTraceRing) {
     fe->trace_ring.pop_front();
     fe->trace_dropped++;
   }
   TraceRec r;
-  r.hi = it.tr_hi;
-  r.lo = it.tr_lo;
-  r.parent = it.tr_parent;
-  r.start_ns = it.t_ns;
-  r.dur_ns = end_ns - it.t_ns;
-  r.meta = uint64_t(it.tr_flags) | (granted ? 0x100u : 0u) |
-           (uint64_t(it.op) << 16);
+  r.hi = hi;
+  r.lo = lo;
+  r.parent = parent;
+  r.start_ns = start_ns;
+  r.dur_ns = end_ns - start_ns;
+  r.meta = uint64_t(tr_flags) | (granted ? 0x100u : 0u) |
+           (uint64_t(op) << 16);
   fe->trace_ring.push_back(r);
 }
 
-T0Entry* t0_find(Frontend* fe, const std::string& key, double cap,
+void trace_ring_push(Frontend* fe, const Item& it, bool granted,
+                     uint64_t end_ns) {
+  trace_ring_push_raw(fe, it.tr_hi, it.tr_lo, it.tr_parent, it.tr_flags,
+                      it.op, granted, it.t_ns, end_ns);
+}
+
+T0Entry* t0_find(Frontend* fe, std::string_view key, double cap,
                  double rate) {
   // mu held.
   if (fe->t0tab.empty()) return nullptr;
   size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
   for (size_t p = 0; p < kT0Probe; p++) {
     T0Entry& e = fe->t0tab[(idx + p) & fe->t0.mask];
-    if (e.live && e.cap == cap && e.rate == rate && e.key == key) return &e;
+    if (e.live && e.cap == cap && e.rate == rate &&
+        std::string_view(e.key) == key) {
+      return &e;
+    }
   }
   return nullptr;
 }
@@ -493,18 +612,19 @@ void t0_install(Frontend* fe, const std::string& key, double cap,
   e->last_touch_ns = now;
 }
 
-int t0_decide(Frontend* fe, const std::string& key, int32_t count,
-              double cap, double rate, double* rem_out) {
+int t0_decide(Frontend* fe, std::string_view key, int64_t count,
+              double cap, double rate, double* rem_out, uint64_t now) {
   // mu held. 1 = grant locally, 0 = deny locally, -1 = fall through to
   // the device path. The estimate reported with local replies is the
   // envelope's own conservative view (last acked balance minus local
   // grants — refill since the ack is credit the next sync will restore).
+  // `now` comes from the caller: the bulk lane decides up to ~100K rows
+  // per frame and must not pay one clock read per row.
   T0Entry* e = t0_find(fe, key, cap, rate);
   if (e == nullptr) {
     fe->t0_misses++;
     return -1;
   }
-  uint64_t now = now_ns();
   if (now - e->last_ack_ns > fe->t0.stale_ns) {
     fe->t0_misses++;  // envelope too old: device decides (and re-seeds)
     return -1;
@@ -729,11 +849,315 @@ void flush_pending(Frontend* fe, bool include_tail) {
 }
 
 void maybe_flush_after_complete(Frontend* fe) {
-  // mu held (called from fe_complete / fe_fail).
+  // mu held (called from fe_complete / fe_fail / finish_bulk_job).
   if (!fe->pending.empty() && fe->ready.empty() && fe->pt.empty() &&
-      fe->inflight.empty()) {
+      fe->inflight.empty() && fe->bulk_ready.empty() &&
+      fe->bulk_inflight.empty()) {
     flush_pending(fe, /*include_tail=*/true);  // pipeline idle: drain
   }
+}
+
+void to_passthrough(Frontend* fe, Conn* c, const uint8_t* body,
+                    size_t len) {
+  // mu held. Hand a frame to Python wholesale — the wire module stays
+  // the single authority for every non-hot (or malformed) shape.
+  Passthrough ptf;
+  ptf.conn_id = c->id;
+  ptf.frame.assign(reinterpret_cast<const char*>(body), len);
+  fe->pt.push_back(std::move(ptf));
+  fe->cv.notify_one();
+}
+
+// ---------------------------------------------------------------------
+// Native bulk lane (round 8). One OP_ACQUIRE_MANY frame = one RESP_BULK
+// reply; tier-0 decides hot bucket rows per-row against the SAME
+// replica table (and therefore the same epsilon envelope) as the scalar
+// ACQUIRE lane — one budget, not two. Rows tier-0 cannot decide cross
+// the fe_bulk_* ABI as a zero-copy residue batch.
+// ---------------------------------------------------------------------
+
+std::string encode_bulk_reply(uint32_t seq, bool with_rem, uint32_t n,
+                              const uint8_t* verdict,
+                              const float* remaining) {
+  // Byte-identical to wire.encode_bulk_response: [u8 flags][u32 n]
+  // [granted bits, LSB-first] [f32 remaining × n iff flags bit 0].
+  size_t nbits = (size_t(n) + 7) / 8;
+  size_t payload = kBulkRespHead + nbits + (with_rem ? 4ull * n : 0);
+  std::string s;
+  s.reserve(4 + kBodyOff + payload);
+  wr_u32(&s, uint32_t(kBodyOff + payload));
+  s.push_back(char(kVersion));
+  wr_u32(&s, seq);
+  s.push_back(char(RESP_BULK));
+  s.push_back(char(with_rem ? kBulkFlagRemaining : 0));
+  wr_u32(&s, n);
+  for (uint32_t base = 0; base < n; base += 8) {
+    uint8_t byte = 0;
+    for (uint32_t j = 0; j < 8 && base + j < n; j++) {
+      byte |= uint8_t((verdict[base + j] == 1 ? 1u : 0u) << j);
+    }
+    s.push_back(char(byte));
+  }
+  if (with_rem) {
+    s.append(reinterpret_cast<const char*>(remaining), 4ull * n);
+  }
+  return s;
+}
+
+// Per-frame hot-key aggregation for the heavy-hitter sketch: the bulk
+// lane's keys never materialize in Python (KeyBlob end to end — the
+// PR-2 exemption), so the C side mirrors the scalar batch lane's
+// "top-K per batch" feed: one bounded open-addressed pass over the
+// frame, then the frame's heaviest rows land in a ring the pump drains
+// into the sketch. Telemetry-grade: scratch overflow and hash-identity
+// merging cost tail fidelity, never head weight.
+constexpr size_t kHotScratchSlots = 512;  // power of two
+constexpr size_t kHotScratchProbe = 4;
+constexpr size_t kHotTopPerFrame = 32;
+constexpr size_t kHotRingCap = 4096;
+
+void bulk_hot_feed(Frontend* fe, const uint8_t* blob,
+                   const int64_t* offs, const int64_t* counts,
+                   uint64_t n) {
+  // mu held.
+  if (fe->hot_scratch.empty()) fe->hot_scratch.resize(kHotScratchSlots);
+  fe->hot_epoch++;
+  uint64_t epoch = fe->hot_epoch;
+  size_t used_idx[kHotScratchSlots];
+  size_t used = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    int64_t w = counts[i];
+    if (w <= 0) continue;  // probes/releases are not admission demand
+    size_t klen = size_t(offs[i + 1] - offs[i]);
+    if (klen == 0 || klen > kT0MaxKey) continue;
+    std::string_view key(reinterpret_cast<const char*>(blob) + offs[i],
+                         klen);
+    uint64_t hsh = t0_hash(key);
+    size_t idx = size_t(hsh) & (kHotScratchSlots - 1);
+    for (size_t pr = 0; pr < kHotScratchProbe; pr++) {
+      size_t at = (idx + pr) & (kHotScratchSlots - 1);
+      HotSlot& s = fe->hot_scratch[at];
+      if (s.epoch != epoch) {
+        s.epoch = epoch;
+        s.hash = hsh;
+        s.row = int64_t(i);
+        s.weight = double(w);
+        used_idx[used++] = at;
+        break;
+      }
+      if (s.hash == hsh) {  // hash identity suffices for telemetry
+        s.weight += double(w);
+        break;
+      }
+    }
+  }
+  size_t top = used < kHotTopPerFrame ? used : kHotTopPerFrame;
+  if (top < used) {
+    std::nth_element(used_idx, used_idx + top, used_idx + used,
+                     [&](size_t x, size_t y) {
+                       return fe->hot_scratch[x].weight >
+                              fe->hot_scratch[y].weight;
+                     });
+  }
+  for (size_t j = 0; j < top; j++) {
+    const HotSlot& s = fe->hot_scratch[used_idx[j]];
+    if (fe->hot_ring.size() >= kHotRingCap) {
+      fe->hot_ring.pop_front();
+      fe->hot_dropped++;
+    }
+    fe->hot_ring.emplace_back(
+        std::string(
+            reinterpret_cast<const char*>(blob) + offs[s.row],
+            size_t(offs[s.row + 1] - offs[s.row])),
+        s.weight);
+  }
+}
+
+// Parse + decide one OP_ACQUIRE_MANY frame natively. Returns false when
+// the frame does not parse as a well-formed bulk request — the caller
+// routes it to the Python passthrough lane, where wire.py (the
+// protocol authority) raises the exact routable error the asyncio
+// server would, byte for byte. Well-formed frames never leave C unless
+// rows need the store.
+bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
+                       size_t len) {
+  // mu held (parse burst on the IO thread, or a parked-frame drain /
+  // fe_set_authed replay on the loop thread).
+  if (len < kBodyOff + kBulkReqHead) return false;
+  const uint8_t* p = body + kBodyOff;
+  uint8_t flags = p[0];
+  double a = rd_f64(p + 1);
+  double b = rd_f64(p + 9);
+  uint64_t n = rd_u32(p + 17);
+  uint8_t kind = uint8_t((flags & kBulkKindMask) >> kBulkKindShift);
+  if (kind > BULK_KIND_FWINDOW) return false;  // Python raises the error
+  if (n == 0) return false;  // degenerate frame: Python authority
+  bool traced = (flags & BULK_FLAG_TRACED) != 0;
+  size_t tail = traced ? kTraceTail : 0;
+  if (kBodyOff + kBulkReqHead + 6 * n + tail > len) return false;
+  const uint8_t* kl = p + kBulkReqHead;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) total += rd_u16(kl + 2 * i);
+  if (len != kBodyOff + kBulkReqHead + 6 * n + total + tail) return false;
+  const uint8_t* blob = kl + 2 * n;
+  const uint8_t* cnts = blob + total;
+  uint32_t seq = rd_u32(body + 1);
+  uint64_t now = now_ns();
+
+  fe->bulk_frames++;
+  fe->bulk_rows += int64_t(n);
+  std::vector<int64_t>& offs = fe->bulk_offsets_scratch;
+  std::vector<int64_t>& cnt64 = fe->bulk_counts_scratch;
+  std::vector<uint8_t>& verdict = fe->bulk_verdict_scratch;
+  std::vector<float>& remaining = fe->bulk_rem_scratch;
+  std::vector<int32_t>& residue = fe->bulk_residue_scratch;
+  offs.resize(n + 1);
+  cnt64.resize(n);
+  verdict.assign(n, 2);
+  remaining.assign(n, 0.0f);
+  residue.clear();
+  bool t0able = fe->bulk_t0 && fe->t0.enabled &&
+                kind == BULK_KIND_BUCKET;
+  int64_t off = 0;
+  double permits_local = 0.0;
+  offs[0] = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    size_t klen = rd_u16(kl + 2 * i);
+    std::string_view key(
+        reinterpret_cast<const char*>(blob) + off, klen);
+    off += int64_t(klen);
+    offs[i + 1] = off;
+    int64_t count = int64_t(rd_u32(cnts + 4 * i));
+    cnt64[i] = count;
+    if (t0able && count > 0 && klen <= kT0MaxKey) {
+      // Same replica table, budgets, and counters as the scalar
+      // ACQUIRE lane — a bulk row's local grant draws down the exact
+      // envelope a scalar grant would (one epsilon budget, not two).
+      double rem = 0.0;
+      int v = t0_decide(fe, key, count, a, b, &rem, now);
+      if (v >= 0) {
+        verdict[i] = uint8_t(v);
+        remaining[i] = float(rem);
+        if (v == 1) permits_local += double(count);
+        continue;
+      }
+    }
+    residue.push_back(int32_t(i));
+  }
+  if (fe->bulk_hot) bulk_hot_feed(fe, blob, offs.data(), cnt64.data(), n);
+  fe->bulk_rows_local += int64_t(n) - int64_t(residue.size());
+  fe->bulk_permits_local += permits_local;
+  if (residue.empty()) {
+    // Whole frame decided locally: encode + queue RESP_BULK without
+    // ever leaving this thread — the all-hot fast path.
+    std::string resp = encode_bulk_reply(
+        seq, (flags & kBulkFlagRemaining) != 0, uint32_t(n),
+        verdict.data(), remaining.data());
+    queue_to_conn(c, resp.data(), resp.size());
+    uint64_t t_end = now_ns();
+    if (traced) {
+      const uint8_t* tp = body + len - kTraceTail;
+      uint64_t hi, lo, parent;
+      std::memcpy(&hi, tp, 8);
+      std::memcpy(&lo, tp + 8, 8);
+      std::memcpy(&parent, tp + 16, 8);
+      bool all = true;
+      for (uint64_t i = 0; i < n; i++) all = all && verdict[i] == 1;
+      trace_ring_push_raw(fe, hi, lo, parent,
+                          uint8_t(1 | (tp[24] & 1) << 1),
+                          OP_ACQUIRE_MANY, all, now, t_end);
+    }
+    hist_record(fe, double(t_end - now) * 1e-9);
+    fe->requests_served++;
+    fe->bulk_frames_local++;
+    c->cur_bulk = 0;  // nothing inflight: chained successors may run
+    return true;
+  }
+  BulkJob job;
+  job.id = fe->next_bulk_id++;
+  job.conn_id = c->id;
+  job.seq = seq;
+  job.flags = flags;
+  job.kind = kind;
+  job.with_remaining = (flags & kBulkFlagRemaining) != 0;
+  job.a = a;
+  job.b = b;
+  job.n = uint32_t(n);
+  job.blob.assign(reinterpret_cast<const char*>(blob), size_t(total));
+  job.offsets = offs;
+  job.counts = cnt64;
+  job.verdict = verdict;
+  job.remaining = remaining;
+  job.residue = residue;
+  job.t_ns = now;
+  if (traced) {
+    const uint8_t* tp = body + len - kTraceTail;
+    std::memcpy(&job.tr_hi, tp, 8);
+    std::memcpy(&job.tr_lo, tp + 8, 8);
+    std::memcpy(&job.tr_parent, tp + 16, 8);
+    job.tr_flags = uint8_t(1 | (tp[24] & 1) << 1);
+  }
+  fe->bulk_rows_residue += int64_t(job.residue.size());
+  c->cur_bulk = job.id;
+  fe->bulk_ready.push_back(job.id);
+  fe->bulk_inflight.emplace(job.id, std::move(job));
+  fe->cv.notify_one();
+  return true;
+}
+
+// Decide one un-parked bulk frame: native when well-formed, else the
+// Python lane — and once a frame of a chain lands on the Python lane,
+// its chained successors follow it there (the server's _bulk_tails
+// keeps their order; deciding them natively would race the
+// predecessor's reply).
+void process_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
+                        size_t len) {
+  // mu held.
+  bool chained =
+      len > kBodyOff && (body[kBodyOff] & kBulkFlagChained) != 0;
+  if (chained && c->bulk_pt_tail) {
+    to_passthrough(fe, c, body, len);
+    return;  // bulk_pt_tail stays set for the rest of the chain
+  }
+  if (!handle_bulk_frame(fe, c, body, len)) {
+    to_passthrough(fe, c, body, len);  // malformed: Python errors
+    c->bulk_pt_tail = true;
+    return;
+  }
+  c->bulk_pt_tail = false;
+}
+
+void drain_parked(Frontend* fe, Conn* c) {
+  // mu held. Un-park chained successors once the connection has no
+  // inflight bulk job; stops when a drained frame starts a new one (its
+  // completion resumes the drain) or the connection goes bad.
+  while (!c->parked_bulk.empty() && c->cur_bulk == 0 && !c->closing) {
+    std::string f = std::move(c->parked_bulk.front());
+    c->parked_bulk.pop_front();
+    c->parked_bytes -= f.size();
+    process_bulk_frame(fe, c,
+                       reinterpret_cast<const uint8_t*>(f.data()),
+                       f.size());
+  }
+  flush_queued(fe, c);
+}
+
+void finish_bulk_job(Frontend* fe, int64_t job_id) {
+  // mu held. Erase a completed/abandoned job and un-park the
+  // connection's chained successors (the asyncio server's per-
+  // connection bulk_tail contract, kept here by parking raw frames
+  // until the predecessor's reply is encoded).
+  auto it = fe->bulk_inflight.find(job_id);
+  if (it == fe->bulk_inflight.end()) return;
+  uint64_t conn_id = it->second.conn_id;
+  fe->bulk_inflight.erase(it);
+  auto itc = fe->conns.find(conn_id);
+  if (itc != fe->conns.end()) {
+    Conn* c = itc->second;
+    if (c->cur_bulk == job_id) c->cur_bulk = 0;
+    drain_parked(fe, c);
+  }
+  maybe_flush_after_complete(fe);
 }
 
 // Handle one complete frame body. Returns false if the connection must
@@ -821,7 +1245,8 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
           // record for the Python harvest — locally-granted requests
           // still trace.
           double rem = 0.0;
-          int verdict = t0_decide(fe, it.key, it.count, it.a, it.b, &rem);
+          int verdict = t0_decide(fe, it.key, it.count, it.a, it.b, &rem,
+                                  it.t_ns);
           if (verdict >= 0) {
             std::string resp = encode_decision(seq, verdict == 1, rem);
             queue_to_conn(c, resp.data(), resp.size());
@@ -842,21 +1267,55 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         fe->requests_served++;  // the asyncio server counts pings too
         break;
       }
+      case OP_ACQUIRE_MANY: {
+        if (!fe->bulk_native) {
+          // The pump never armed the lane (older Python half, or the
+          // operator disabled it): round-7 passthrough behavior.
+          to_passthrough(fe, c, body, len);
+          break;
+        }
+        bool chained =
+            len > kBodyOff && (body[kBodyOff] & kBulkFlagChained) != 0;
+        bool busy = c->cur_bulk != 0 &&
+                    fe->bulk_inflight.count(c->cur_bulk) != 0;
+        if (!c->parked_bulk.empty() || (chained && busy)) {
+          // Chained chunk behind an in-flight predecessor (or any bulk
+          // frame queued behind a parked chain — FIFO keeps relative
+          // order trivially): park the raw frame; completion drains in
+          // order. Bounded like the outbox: a chain backlog past the
+          // budget is a dead/hostile pipeliner.
+          if (c->parked_bytes + len > kMaxConnOut) {
+            std::string err = encode_error(
+                seq, "bulk chain backlog exceeds buffer budget");
+            send_to_conn(fe, c, err.data(), err.size());
+            return false;
+          }
+          c->parked_bulk.emplace_back(
+              reinterpret_cast<const char*>(body), len);
+          c->parked_bytes += len;
+          break;
+        }
+        // Malformed / degenerate shapes go to Python inside
+        // process_bulk_frame so the error reply stays byte-identical
+        // to the asyncio server's — and mark the conn's bulk tail as
+        // Python-side so a chained successor follows it there.
+        process_bulk_frame(fe, c, body, len);
+        break;
+      }
       case OP_PLACEMENT:
       case OP_PLACEMENT_ANNOUNCE:
       case OP_MIGRATE_PULL:
       case OP_MIGRATE_PUSH:
       case OP_CONFIG:
       default: {
-        // Placement/migration/config control ops, HELLO, PEEK, SYNC, STATS,
-        // SAVE, ACQUIRE_MANY, unknown: Python decides (including the
+        // Placement/migration/config control ops, HELLO, PEEK, SYNC,
+        // STATS, SAVE, unknown: Python decides (including the
         // unknown-op error) — the wire module stays the single
-        // authority for every non-hot shape.
-        Passthrough ptf;
-        ptf.conn_id = c->id;
-        ptf.frame.assign(reinterpret_cast<const char*>(body), len);
-        fe->pt.push_back(std::move(ptf));
-        fe->cv.notify_one();
+        // authority for every non-hot shape. ACQUIRE_MANY left this
+        // list in round 8: well-formed bulk frames are native above,
+        // and only malformed ones fall through so wire.py raises the
+        // exact routable error.
+        to_passthrough(fe, c, body, len);
         break;
       }
   }
@@ -1010,7 +1469,8 @@ void io_loop(Frontend* fe) {
       // the batch size adapts to load (same reasoning as MicroBatcher's
       // flush-on-idle, benchmarks/RESULTS.md).
       bool idle_pump = fe->pump_waiting && fe->ready.empty() &&
-                       fe->pt.empty() && fe->inflight.empty();
+                       fe->pt.empty() && fe->inflight.empty() &&
+                       fe->bulk_ready.empty() && fe->bulk_inflight.empty();
       bool due = now_ns() >= fe->pending_oldest_ns + fe->deadline_ns;
       if (fe->pending.size() >= fe->max_batch || idle_pump || due) {
         // Size-only trigger holds the sub-max_batch tail to coalesce;
@@ -1092,29 +1552,36 @@ void* fe_start(const char* host, int port, int max_batch, int deadline_us,
 int fe_port(void* h) { return static_cast<Frontend*>(h)->port; }
 
 // Wait for work: 1 = batch ready (use fe_batch_*), 2 = passthrough frame
-// (use fe_pt_*), 0 = timeout, -1 = stopping.
+// (use fe_pt_*), 3 = bulk residue job (use fe_bulk_*), 0 = timeout,
+// -1 = stopping.
 int fe_wait(void* h, int timeout_ms) {
   Frontend* fe = static_cast<Frontend*>(h);
   std::unique_lock<FeMutex> lk(fe->mu);
   fe->pump_waiting = true;
   bool got = fe->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-    return fe->stopping.load() || !fe->pt.empty() || !fe->ready.empty();
+    return fe->stopping.load() || !fe->pt.empty() || !fe->ready.empty() ||
+           !fe->bulk_ready.empty();
   });
   fe->pump_waiting = false;
   if (fe->stopping.load()) return -1;
   if (!got) return 0;
   // Control ops first so STATS/HELLO can't starve behind a hot-batch
-  // stream; both queues drain promptly because the pump never blocks.
+  // stream; all queues drain promptly because the pump never blocks.
   if (!fe->pt.empty()) {
     fe->cur_pt = std::move(fe->pt.front());
     fe->pt.pop_front();
     return 2;
   }
-  Batch b = std::move(fe->ready.front());
-  fe->ready.pop_front();
-  fe->cur_batch_id = b.id;
-  fe->inflight.emplace(b.id, std::move(b));
-  return 1;
+  if (!fe->ready.empty()) {
+    Batch b = std::move(fe->ready.front());
+    fe->ready.pop_front();
+    fe->cur_batch_id = b.id;
+    fe->inflight.emplace(b.id, std::move(b));
+    return 1;
+  }
+  fe->cur_bulk_id = fe->bulk_ready.front();
+  fe->bulk_ready.pop_front();
+  return 3;
 }
 
 long long fe_batch_id(void* h) {
@@ -1566,6 +2033,185 @@ void fe_t0_counts(void* h, long long* out) {
   out[3] = fe->t0_installs;
   out[4] = fe->t0_evictions;
   out[5] = live;
+}
+
+// ---------------------------------------------------------------------
+// Native bulk lane ABI (round 8). fe_bulk_configure arms it (default
+// off so a new binary under an older pump keeps the round-7
+// passthrough behavior); fe_wait returns 3 when a residue job is
+// ready; fe_bulk_meta / fe_bulk_ptrs expose the CURRENT job (same
+// call-window contract as fe_batch_*: between fe_wait returning 3 and
+// the matching complete/discard/fail); fe_bulk_complete merges
+// Python's residue verdicts, encodes RESP_BULK, and answers the
+// client. The ptrs stay valid until the job is erased — Python's
+// KeyBlob views read them in place (zero copy, zero UTF-8 decode).
+// ---------------------------------------------------------------------
+
+int fe_bulk_configure(void* h, int enable, int t0_rows, int hot_feed) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  fe->bulk_native = enable != 0;
+  fe->bulk_t0 = t0_rows != 0;
+  fe->bulk_hot = hot_feed != 0;
+  return 1;
+}
+
+long long fe_bulk_id(void* h) {
+  return static_cast<Frontend*>(h)->cur_bulk_id;
+}
+
+// u[11]: job id, conn id, seq, flags, n, blob bytes, residue rows,
+// trace hi/lo/parent, trace flags. f[2]: a, b. Job id 0 = no job.
+void fe_bulk_meta(void* h, unsigned long long* u, double* f) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  auto it = fe->bulk_inflight.find(fe->cur_bulk_id);
+  if (it == fe->bulk_inflight.end()) {
+    u[0] = 0;
+    return;
+  }
+  const BulkJob& j = it->second;
+  u[0] = (unsigned long long)j.id;
+  u[1] = j.conn_id;
+  u[2] = j.seq;
+  u[3] = j.flags;
+  u[4] = j.n;
+  u[5] = j.blob.size();
+  u[6] = j.residue.size();
+  u[7] = j.tr_hi;
+  u[8] = j.tr_lo;
+  u[9] = j.tr_parent;
+  u[10] = j.tr_flags;
+  f[0] = j.a;
+  f[1] = j.b;
+}
+
+// ptrs[4]: key blob, offsets (i64[n+1]), counts (i64[n]), residue
+// (i32[residue_n]) — addresses into the job, stable until it is erased.
+void fe_bulk_ptrs(void* h, unsigned long long* ptrs) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  auto it = fe->bulk_inflight.find(fe->cur_bulk_id);
+  if (it == fe->bulk_inflight.end()) {
+    ptrs[0] = ptrs[1] = ptrs[2] = ptrs[3] = 0;
+    return;
+  }
+  BulkJob& j = it->second;
+  ptrs[0] = (unsigned long long)(uintptr_t)j.blob.data();
+  ptrs[1] = (unsigned long long)(uintptr_t)j.offsets.data();
+  ptrs[2] = (unsigned long long)(uintptr_t)j.counts.data();
+  ptrs[3] = (unsigned long long)(uintptr_t)j.residue.data();
+}
+
+// Merge Python's residue verdicts (granted/remaining indexed in
+// `residue` order), install replicas from granted fall-through rows
+// (the bulk lane's mirror of fe_complete's scalar install), encode the
+// RESP_BULK reply, and answer the client.
+void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
+                      const double* remaining) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  auto it = fe->bulk_inflight.find(job_id);
+  if (it == fe->bulk_inflight.end()) return;
+  BulkJob& job = it->second;
+  uint64_t t = now_ns();
+  for (size_t r = 0; r < job.residue.size(); r++) {
+    size_t i = size_t(job.residue[r]);
+    job.verdict[i] = granted[r] ? 1 : 0;
+    job.remaining[i] = float(remaining[r]);
+    if (fe->t0.enabled && fe->bulk_t0 && job.kind == BULK_KIND_BUCKET &&
+        granted[r] && job.with_remaining && job.counts[i] > 0) {
+      size_t klen = size_t(job.offsets[i + 1] - job.offsets[i]);
+      if (klen <= kT0MaxKey) {
+        t0_install(fe,
+                   std::string(job.blob.data() + job.offsets[i], klen),
+                   job.a, job.b, remaining[r], t);
+      }
+    }
+  }
+  std::string resp = encode_bulk_reply(job.seq, job.with_remaining,
+                                       job.n, job.verdict.data(),
+                                       job.remaining.data());
+  auto itc = fe->conns.find(job.conn_id);
+  if (itc != fe->conns.end()) {
+    send_to_conn(fe, itc->second, resp.data(), resp.size());
+  }
+  if (job.tr_flags & 1) {
+    bool all = true;
+    for (uint32_t i = 0; i < job.n; i++) all = all && job.verdict[i] == 1;
+    trace_ring_push_raw(fe, job.tr_hi, job.tr_lo, job.tr_parent,
+                        job.tr_flags, OP_ACQUIRE_MANY, all, job.t_ns, t);
+  }
+  hist_record(fe, double(t - job.t_ns) * 1e-9);
+  fe->requests_served++;
+  finish_bulk_job(fe, job_id);
+}
+
+// Drop a job whose frame Python already answered wholesale via fe_send
+// (frame-level gate errors / drain envelope — the kRowSkip posture,
+// whole-frame edition). fe_send counted the request; this only records
+// latency and un-parks chained successors.
+void fe_bulk_discard(void* h, long long job_id) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  auto it = fe->bulk_inflight.find(job_id);
+  if (it == fe->bulk_inflight.end()) return;
+  hist_record(fe, double(now_ns() - it->second.t_ns) * 1e-9);
+  finish_bulk_job(fe, job_id);
+}
+
+// Fail a job (store raised): the frame gets one routable error reply.
+void fe_bulk_fail(void* h, long long job_id, const char* msg) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  auto it = fe->bulk_inflight.find(job_id);
+  if (it == fe->bulk_inflight.end()) return;
+  BulkJob& job = it->second;
+  std::string resp = encode_error(job.seq, msg);
+  auto itc = fe->conns.find(job.conn_id);
+  if (itc != fe->conns.end()) {
+    send_to_conn(fe, itc->second, resp.data(), resp.size());
+  }
+  hist_record(fe, double(now_ns() - job.t_ns) * 1e-9);
+  fe->requests_served++;
+  finish_bulk_job(fe, job_id);
+}
+
+// out[7]: frames, frames decided fully in C, rows, rows decided
+// locally (tier-0 grant/deny), residue rows, locally granted permits
+// (the amount the sync pump debits), hot-ring drops.
+void fe_bulk_counts(void* h, long long* out) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  out[0] = fe->bulk_frames;
+  out[1] = fe->bulk_frames_local;
+  out[2] = fe->bulk_rows;
+  out[3] = fe->bulk_rows_local;
+  out[4] = fe->bulk_rows_residue;
+  out[5] = (long long)fe->bulk_permits_local;
+  out[6] = fe->hot_dropped;
+}
+
+// Drain up to max_n aggregated (key, weight) hot-key rows from the
+// bulk lane's ring (key_blob concatenated, klens delimiting) — the
+// pump offers them to the heavy-hitter sketch. Returns the row count.
+int fe_hot_harvest(void* h, char* key_blob, int blob_cap, int32_t* klens,
+                   double* weights, int max_n) {
+  Frontend* fe = static_cast<Frontend*>(h);
+  std::lock_guard<FeMutex> lk(fe->mu);
+  int n = 0;
+  int off = 0;
+  while (n < max_n && !fe->hot_ring.empty()) {
+    const auto& front = fe->hot_ring.front();
+    if (off + int(front.first.size()) > blob_cap) break;
+    std::memcpy(key_blob + off, front.first.data(), front.first.size());
+    klens[n] = int32_t(front.first.size());
+    weights[n] = front.second;
+    off += int(front.first.size());
+    n++;
+    fe->hot_ring.pop_front();
+  }
+  return n;
 }
 
 // ---------------------------------------------------------------------

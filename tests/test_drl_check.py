@@ -96,6 +96,48 @@ def test_wire_layout_drift_fires_once(tmp_path):
     assert "_ACQ_TAIL" in findings[0].message
 
 
+def test_bulk_head_layout_drift_fires_once(tmp_path):
+    # Shift the second f64 of the bulk request head: the native bulk
+    # parser no longer matches struct _BULK_REQ_HEAD ("<BddI").
+    cc = _mutated_frontend(tmp_path, "double b = rd_f64(p + 9);",
+                           "double b = rd_f64(p + 8);")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-layout"]
+    assert "_BULK_REQ_HEAD" in findings[0].message
+
+
+def test_bulk_head_size_drift_fires(tmp_path):
+    cc = _mutated_frontend(tmp_path,
+                           "constexpr size_t kBulkReqHead = 21;",
+                           "constexpr size_t kBulkReqHead = 20;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    assert "BULK_REQ_HEAD_LEN" in findings[0].message
+
+
+def test_bulk_kind_constant_drift_fires(tmp_path):
+    cc = _mutated_frontend(tmp_path,
+                           "constexpr uint8_t BULK_KIND_FWINDOW = 2;",
+                           "constexpr uint8_t BULK_KIND_FWINDOW = 3;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    assert "BULK_KIND_FWINDOW" in findings[0].message
+
+
+def test_bulk_abi_exports_are_bound():
+    """Both directions of the round-8 ABI: every fe_bulk_*/fe_hot_*
+    export has a ctypes binding and vice versa (the live-tree clean test
+    covers it, but pin the symbols so a rename cannot silently drop the
+    whole lane back to passthrough)."""
+    bound = wire_conformance._py_bound_symbols(NATIVE_PY)
+    exported = wire_conformance._c_exported_symbols(FRONTEND)
+    for sym in ("fe_bulk_configure", "fe_bulk_meta", "fe_bulk_ptrs",
+                "fe_bulk_complete", "fe_bulk_discard", "fe_bulk_fail",
+                "fe_bulk_counts", "fe_bulk_id", "fe_hot_harvest"):
+        assert sym in bound, sym
+        assert sym in exported, sym
+
+
 def test_missing_fe_export_fires_both_ways(tmp_path):
     # Rename an exported symbol: the binding can't resolve (one finding
     # at the Python binding site) and the renamed export is dead surface

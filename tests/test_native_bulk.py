@@ -1,0 +1,275 @@
+"""Native bulk lane (round 8): OP_ACQUIRE_MANY end-to-end in C.
+
+Covers what the byte-level differential fuzz (test_native_parity_fuzz)
+does not: the tier-0 bulk epsilon envelope (per-row local decisions
+share the scalar budget — one envelope, not two), the sync-pump
+reconciliation of bulk grants, the C-side hot-key feed into the
+heavy-hitter sketch, the OP_STATS / OpenMetrics bulk gauges, and the
+pinned fall-through behavior of everything that must STAY on the Python
+passthrough lane (SAVE, unknown ops, malformed bulk, --no-fe-bulk).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+    overadmit_epsilon,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+    Tier0Config,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+
+pytestmark = pytest.mark.skipif(
+    load_frontend_lib() is None,
+    reason="native front-end library unavailable (no compiler?)")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _roundtrip_raw(host, port, frames: "list[bytes]") -> list[bytes]:
+    """Send raw frames on one fresh connection, read one reply each."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        for f in frames:
+            writer.write(f)
+        await writer.drain()
+        out = []
+        for _ in frames:
+            hdr = await asyncio.wait_for(reader.readexactly(4), 10.0)
+            (ln,) = struct.unpack("<I", hdr)
+            out.append(hdr + await asyncio.wait_for(
+                reader.readexactly(ln), 10.0))
+        return out
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+def test_bulk_rows_decide_locally_and_reconcile():
+    """Hot bulk rows decide in C (rows_local grows, frames go fully
+    local) and the sync pump debits the authoritative store — the
+    balance visibly drops by roughly the locally-granted amount."""
+    cfg = Tier0Config(sync_interval_s=0.01)
+    capacity, fill = 100000.0, 1e-9
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                keys = [f"hot{i % 4}" for i in range(256)]
+                counts = [1] * 256
+                # Warm: all-residue frame installs the replicas.
+                await store.acquire_many(keys, counts, capacity, fill)
+                for _ in range(4):
+                    res = await store.acquire_many(keys, counts,
+                                                   capacity, fill)
+                    assert res.granted.all()
+                st = await store.stats()
+                bulk = st["native_bulk"]
+                assert bulk["frames"] == 5
+                assert bulk["rows"] == 5 * 256
+                assert bulk["rows_local"] > 0
+                assert bulk["frames_local"] > 0
+                assert bulk["permits_local"] == bulk["rows_local"]
+                assert st["tier0"]["hits"] >= bulk["rows_local"] * 0.5
+                await asyncio.sleep(0.1)  # several sync rounds
+                bal = await asyncio.to_thread(store.peek_blocking,
+                                              "hot0", capacity, fill)
+                # 5 frames x 64 rows per key were granted somewhere
+                # (store or tier-0); after reconciliation the balance
+                # reflects all of them (fill ~ 0).
+                assert bal == pytest.approx(capacity - 5 * 64, abs=1.0)
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_bulk_tier0_overadmit_bounded():
+    """The epsilon differential, bulk edition: per key, granted ≤
+    device-only oracle + overadmit_epsilon(budget, fill, sync_s) — the
+    SAME formula and budget as the scalar lane (one envelope, not
+    two)."""
+    capacity, fill = 200.0, 1e-9
+    cfg = Tier0Config(sync_interval_s=0.005)
+    budget = headroom_budget(capacity, fraction=cfg.budget_fraction,
+                             min_budget=cfg.min_budget,
+                             max_budget=cfg.max_budget)
+    assert budget > 0  # must exercise tier-0, not bypass it
+    epsilon = overadmit_epsilon(budget, fill, cfg.sync_interval_s)
+    n_keys, per_frame, frames = 4, 30, 20
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                keys = [f"h{i}" for i in range(n_keys)]
+                frame_keys = [keys[i % n_keys]
+                              for i in range(n_keys * per_frame)]
+                counts = [1] * len(frame_keys)
+                admitted = {k: 0 for k in keys}
+                results = await asyncio.gather(
+                    *(store.acquire_many(frame_keys, counts, capacity,
+                                         fill) for _ in range(frames)))
+                for res in results:
+                    for k, g in zip(frame_keys, res.granted):
+                        admitted[k] += bool(g)
+                for k in keys:
+                    # Oracle: with ~zero fill and unit counts, any
+                    # serialization admits exactly capacity per key.
+                    assert admitted[k] <= capacity + epsilon, (
+                        k, admitted[k], epsilon)
+                    assert admitted[k] >= capacity * 0.9, (k, admitted[k])
+                st = await store.stats()
+                assert st["native_bulk"]["rows_local"] > 0  # not vacuous
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_bulk_hot_keys_feed_the_sketch():
+    """The zero-copy bulk lane's PR-2 sketch exemption is closed for the
+    native lane: C aggregates per-frame top-K and the harvest pump
+    offers it — the skewed keys surface in the server's top-K."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                rng = np.random.default_rng(11)
+                hot = [b"whale-a", b"whale-b"]
+                for _ in range(6):
+                    pool = list(hot) * 40 + [
+                        b"c%d" % rng.integers(0, 5000)
+                        for _ in range(200)]
+                    counts = [1] * len(pool)
+                    await store.acquire_many(
+                        [k.decode() for k in pool], counts, 1e9, 1e9)
+                await asyncio.sleep(0.8)  # ≥ one harvest cadence
+                top = [k for k, _c, _e in srv.heavy_hitters.top()]
+                assert "whale-a" in top and "whale-b" in top
+                st = await store.stats()
+                assert st["native_bulk"]["frames"] >= 6
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_bulk_gauges_in_openmetrics():
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                await store.acquire_many(["a", "b"], [1, 1], 10.0, 1.0)
+                text = srv.registry.render()
+                assert "native_bulk_frames_total" in text
+                assert "native_bulk_rows_residue_total" in text
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_fall_through_cases_unchanged():
+    """Pin the passthrough dispatch list after ACQUIRE_MANY went native:
+    SAVE (no snapshot path) and unknown ops answer byte-identically on
+    the native and asyncio servers — Python stays the authority for
+    every non-hot shape."""
+    async def body():
+        servers = [
+            BucketStoreServer(InProcessBucketStore(),
+                              native_frontend=False),
+            BucketStoreServer(InProcessBucketStore(),
+                              native_frontend=True),
+        ]
+        for s in servers:
+            await s.start()
+        try:
+            save = wire.encode_request(3, wire.OP_SAVE)
+            # Unknown op 99 on the keyed-request layout.
+            unknown = bytearray(
+                wire.encode_request(4, wire.OP_ACQUIRE, "k", 1, 1.0, 1.0))
+            unknown[9] = 99
+            unknown = bytes(unknown)
+            replies = [await _roundtrip_raw(s.host, s.port,
+                                            [save, unknown])
+                       for s in servers]
+            assert replies[0] == replies[1]
+            assert b"snapshot-path" in replies[0][0]
+            assert b"unknown op" in replies[0][1]
+        finally:
+            for s in servers:
+                await s.aclose()
+
+    run(body())
+
+
+def test_no_fe_bulk_knob_keeps_passthrough():
+    """native_bulk=False restores the round-7 behavior: bulk frames
+    serve via the Python passthrough lane (correct replies, zero native
+    bulk frames counted)."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_bulk=False) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                res = await store.acquire_many(
+                    [f"u{i % 10}" for i in range(100)], [1] * 100,
+                    30.0, 1e-9)
+                # 10 distinct keys x 10 requests, capacity 30: all grant.
+                assert int(res.granted.sum()) == 100
+                st = await store.stats()
+                assert "native_bulk" not in st
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_bulk_without_remaining_and_window_kinds():
+    """with_remaining=False frames and window kinds ride the native
+    lane (windows are always residue — tier-0 is bucket-only)."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=True) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                res = await store.acquire_many(
+                    ["a", "b", "a"], [1, 1, 1], 1e6, 1e6,
+                    with_remaining=False)
+                assert res.granted.all() and res.remaining is None
+                res = await store.window_acquire_many(
+                    [f"w{i % 3}" for i in range(30)], [1] * 30,
+                    5.0, 60.0)
+                assert int(res.granted.sum()) == 15
+                st = await store.stats()
+                assert st["native_bulk"]["frames"] == 2
+            finally:
+                await store.aclose()
+
+    run(body())

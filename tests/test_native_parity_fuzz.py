@@ -14,10 +14,13 @@ both sides deterministic.
 from __future__ import annotations
 
 import asyncio
+import json
+import struct
 
 import numpy as np
 import pytest
 
+from distributedratelimiting.redis_tpu.runtime import wire
 from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
 from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
 from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
@@ -27,6 +30,196 @@ from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
 pytestmark = pytest.mark.skipif(
     load_frontend_lib() is None,
     reason="native front-end library unavailable (no compiler?)")
+
+
+# -- raw-socket helpers for the byte-level bulk differential ----------------
+
+async def _start_pair(tier0=False):
+    """One asyncio server and one native server over identical
+    InProcess stores on lockstep manual clocks."""
+    clocks = [ManualClock(), ManualClock()]
+    servers = [
+        BucketStoreServer(InProcessBucketStore(clock=clocks[0]),
+                          native_frontend=False),
+        BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
+                          native_frontend=True, native_tier0=tier0),
+    ]
+    for s in servers:
+        await s.start()
+    conns = [await asyncio.open_connection(s.host, s.port)
+             for s in servers]
+    return clocks, servers, conns
+
+
+async def _close_pair(servers, conns):
+    for _r, w in conns:
+        w.close()
+        try:
+            await w.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+    for s in servers:
+        await s.aclose()
+
+
+async def _read_reply(conn) -> bytes:
+    r, _w = conn
+    hdr = await asyncio.wait_for(r.readexactly(4), 10.0)
+    (ln,) = struct.unpack("<I", hdr)
+    return hdr + await asyncio.wait_for(r.readexactly(ln), 10.0)
+
+
+async def _roundtrip(conn, frame: bytes) -> bytes:
+    _r, w = conn
+    w.write(frame)
+    await w.drain()
+    return await _read_reply(conn)
+
+
+def _random_bulk_frame(rng, seq: int) -> bytes:
+    """One randomized ACQUIRE_MANY frame: random key blobs (duplicates
+    and non-UTF-8 bytes included), random counts (zero-permit probes
+    in), all three table kinds, both remaining modes, and a trace tail
+    on a sampled minority (wire flags bit 4)."""
+    nk = int(rng.integers(1, 28))
+    pool = [b"k%d" % rng.integers(0, 8) for _ in range(nk)]
+    if rng.random() < 0.25:
+        # byte-identity keys: invalid UTF-8 must rate-limit under its
+        # own stable identity on BOTH lanes, never error the frame
+        pool[0] = bytes(rng.integers(0, 256, int(rng.integers(1, 12)),
+                                     dtype=np.uint8).tolist())
+    counts = rng.integers(0, 4, nk)
+    kind = int(rng.integers(0, 3))
+    with_rem = bool(rng.integers(0, 2))
+    trace = None
+    if rng.random() < 0.3:
+        trace = (int(rng.integers(1, 1 << 62)),
+                 int(rng.integers(1, 1 << 62)),
+                 int(rng.integers(1, 1 << 62)), 1)
+    return wire.encode_bulk_request(
+        seq, pool, counts, 10.0, 1.0, with_remaining=with_rem,
+        kind=kind, trace=trace)
+
+
+@pytest.mark.parametrize("seed,tier0", [(5, False), (29, False),
+                                        (5, True)])
+def test_bulk_frames_reply_byte_identical(seed, tier0):
+    """Randomized ACQUIRE_MANY frames — duplicates, probes, hostile
+    keys, trace tails, every kind, chained chunks, malformed shapes —
+    must produce byte-identical replies from the native bulk lane and
+    the asyncio server. (tier0=True arms the cache at capacity 10 <
+    min_budget, so tier-0 must stay semantically invisible.)"""
+    async def main():
+        clocks, servers, conns = await _start_pair(tier0=tier0)
+        rng = np.random.default_rng(seed)
+        try:
+            for step in range(150):
+                frame = _random_bulk_frame(rng, step)
+                roll = rng.random()
+                if roll < 0.1:
+                    # Malformed: truncate the body and re-stamp the
+                    # length prefix — both servers must answer the same
+                    # routable error (wire.py is the authority on both).
+                    cut = int(rng.integers(1, 8))
+                    body = frame[4:]
+                    if len(body) > cut + 7:
+                        body = body[:-cut]
+                    frame = struct.pack("<I", len(body)) + body
+                    replies = [await _roundtrip(cn, frame)
+                               for cn in conns]
+                    assert replies[0] == replies[1], step
+                elif roll < 0.25:
+                    # Chained pair: chunk 2 must decide after chunk 1 on
+                    # both lanes (the asyncio bulk_tail contract; the
+                    # native lane parks the chained frame in C). Chunk 1
+                    # is sometimes MALFORMED: its error reply must still
+                    # come back BEFORE the chained successor's verdict —
+                    # the chain follows it onto the Python lane.
+                    if rng.random() < 0.3:
+                        cut = int(rng.integers(1, 6))
+                        body = frame[4:]
+                        if len(body) > cut + 7:
+                            body = body[:-cut]
+                        frame = struct.pack("<I", len(body)) + body
+                    f2 = wire.encode_bulk_request(
+                        10000 + step, [b"c0", b"c1", b"c0"], [1, 1, 1],
+                        10.0, 1.0, chained=True)
+                    for cn in conns:
+                        cn[1].write(frame + f2)
+                        await cn[1].drain()
+                    r1 = [await _read_reply(cn) for cn in conns]
+                    r2 = [await _read_reply(cn) for cn in conns]
+                    assert r1[0] == r1[1], step
+                    assert r2[0] == r2[1], step
+                else:
+                    replies = [await _roundtrip(cn, frame)
+                               for cn in conns]
+                    assert replies[0] == replies[1], step
+                if rng.random() < 0.2:
+                    dt = float(rng.uniform(0.0, 2.0))
+                    for c in clocks:
+                        c.advance_seconds(dt)
+        finally:
+            await _close_pair(servers, conns)
+
+    asyncio.run(main())
+
+
+def test_bulk_gated_rows_byte_identical():
+    """Placement-MOVED and retired-config bulk frames answer the exact
+    same routable errors from both lanes (frame-level gates; the native
+    lane answers them via fe_send + fe_bulk_discard)."""
+    async def main():
+        _clocks, servers, conns = await _start_pair()
+        try:
+            # Live-config mutation on both: retire (50, 1) -> (80, 2).
+            for payload in ({"prepare": {"kind": "bucket",
+                                         "old": [50.0, 1.0],
+                                         "new": [80.0, 2.0]},
+                             "version": 1},
+                            {"commit": 1}):
+                frame = wire.encode_request(900, wire.OP_CONFIG,
+                                            key=json.dumps(payload))
+                rs = [await _roundtrip(cn, frame) for cn in conns]
+                assert rs[0] == rs[1]
+            frame = wire.encode_bulk_request(7, [b"a", b"b", b"a"],
+                                             [1, 2, 1], 50.0, 1.0)
+            rs = [await _roundtrip(cn, frame) for cn in conns]
+            assert rs[0] == rs[1]
+            assert b"config moved" in rs[0]
+            # Current-config frames still decide normally.
+            frame = wire.encode_bulk_request(8, [b"a", b"b"], [1, 1],
+                                             80.0, 2.0)
+            rs = [await _roundtrip(cn, frame) for cn in conns]
+            assert rs[0] == rs[1]
+            assert rs[0][9] == wire.RESP_BULK
+            # Placement map: half the slots belong to node 1 — frames
+            # touching them answer the frame-level MOVED error.
+            ann = {"map": {"epoch": 1, "n_slots": 16,
+                           "slot_owner": [0, 1] * 8, "overrides": {}},
+                   "node_id": 0}
+            frame = wire.encode_request(901, wire.OP_PLACEMENT_ANNOUNCE,
+                                        key=json.dumps(ann))
+            rs = [await _roundtrip(cn, frame) for cn in conns]
+            assert rs[0] == rs[1]
+            rng = np.random.default_rng(3)
+            saw_moved = saw_bulk = False
+            for step in range(40):
+                nk = int(rng.integers(1, 12))
+                pool = [b"m%d" % rng.integers(0, 64) for _ in range(nk)]
+                frame = wire.encode_bulk_request(
+                    1000 + step, pool, [1] * nk, 80.0, 2.0)
+                rs = [await _roundtrip(cn, frame) for cn in conns]
+                assert rs[0] == rs[1], step
+                if b"placement moved" in rs[0]:
+                    saw_moved = True
+                elif rs[0][9] == wire.RESP_BULK:
+                    saw_bulk = True
+            assert saw_moved and saw_bulk
+        finally:
+            await _close_pair(servers, conns)
+
+    asyncio.run(main())
 
 
 # tier0=True runs the same fuzz with the tier-0 admission cache armed:
@@ -84,9 +277,10 @@ def test_native_and_asyncio_servers_answer_identically(seed, tier0):
                 elif op == 4:    # semaphore release (incl. over-release)
                     for st in stores:
                         await st.concurrency_release(key, count + 1)
-                elif op == 5:    # bulk frame (passthrough on native)
-                    keys = [f"k{rng.integers(0, 6)}" for _ in range(17)]
-                    counts = [1] * 17
+                elif op == 5:    # bulk frame (native lane since round 8)
+                    nk = int(rng.integers(1, 25))
+                    keys = [f"k{rng.integers(0, 6)}" for _ in range(nk)]
+                    counts = [int(c) for c in rng.integers(0, 4, nk)]
                     rs = [await st.acquire_many(keys, counts, 10.0, 1.0)
                           for st in stores]
                     assert (rs[0].granted == rs[1].granted).all(), step

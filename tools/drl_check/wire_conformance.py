@@ -144,9 +144,17 @@ _C_TO_PY = {
     "kMaxFrame": "MAX_FRAME",
     "kBodyOff": "_BODY_OFF",
     "kTraceTail": "TRACE_TAIL_LEN",
+    # Native bulk lane (round 8): the C parser/encoder's head widths and
+    # flag bits mirror wire.py's private bulk-layout names.
+    "kBulkReqHead": "BULK_REQ_HEAD_LEN",
+    "kBulkRespHead": "BULK_RESP_HEAD_LEN",
+    "kBulkFlagRemaining": "_FLAG_WITH_REMAINING",
+    "kBulkFlagChained": "_FLAG_CHAINED",
+    "kBulkKindMask": "_KIND_MASK",
+    "kBulkKindShift": "_KIND_SHIFT",
 }
 _MIRRORED_PREFIX = re.compile(
-    r"^(OP_|RESP_|TRACE_FLAG$|STATS_FLAG_|BULK_FLAG_)")
+    r"^(OP_|RESP_|TRACE_FLAG$|STATS_FLAG_|BULK_FLAG_|BULK_KIND_)")
 
 #: The wire.py names C hard-codes via the mapped k-constants; used for
 #: the Python-side existence direction of the diff.
@@ -286,7 +294,29 @@ def _layout_checks(py: PyWireModel, c: CWireModel, wire_rel: str,
                      f"encode_error length-prefix width {got} != "
                      f"struct.calcsize(_KEYED) = {keyed}", "_KEYED")
 
-    # 4. Trace tail: [u64 hi][u64 lo][u64 parent][u8 flags] — the C parse
+    # 4. Bulk request head: [u8 flags][f64 a][f64 b][u32 n] — the native
+    # bulk lane's hand-written reads in handle_bulk_frame must match
+    # _BULK_REQ_HEAD's field table (the head-size constant itself is
+    # covered by the kBulkReqHead ↔ BULK_REQ_HEAD_LEN diff above).
+    bulk_fields = py.field_offsets("_BULK_REQ_HEAD")
+    region = _c_region(c, r"bool handle_bulk_frame", r"void drain_parked")
+    if region and bulk_fields is not None:
+        text, base = region
+        reads = [(m.group(1), int(m.group(2)),
+                  base + text.count("\n", 0, m.start()))
+                 for m in re.finditer(
+                     r"(rd_f64|rd_u32)\(p \+ (\d+)\)", text)]
+        want = [("rd_f64" if ch == "d" else "rd_u32", off)
+                for ch, off in bulk_fields if ch in "dI"]
+        got = [(fn, off) for fn, off, _ in reads]
+        if want != got:
+            at = reads[0][2] if reads else base
+            mismatch(at,
+                     f"bulk-request head reads {got} do not match "
+                     f"_BULK_REQ_HEAD field layout {want}",
+                     "_BULK_REQ_HEAD")
+
+    # 5. Trace tail: [u64 hi][u64 lo][u64 parent][u8 flags] — the C parse
     # memcpys at fixed offsets that must match _TRACE_TAIL's field table.
     tail_fields = py.field_offsets("_TRACE_TAIL")
     region = _c_region(c, r"if \(traced\) \{", r"if \(op == OP_ACQUIRE")
