@@ -8,9 +8,10 @@ PY ?= python
 CXX ?= g++
 
 .PHONY: check lint test native asan-test tsan-test chaos-test \
-        reshard-soak upgrade-soak parity-fuzz
+        reshard-soak upgrade-soak parity-fuzz llm-soak
 
-check: lint test chaos-test upgrade-soak parity-fuzz asan-test tsan-test
+check: lint test chaos-test upgrade-soak parity-fuzz llm-soak \
+       asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -53,6 +54,15 @@ reshard-soak:
 upgrade-soak:
 	JAX_PLATFORMS=cpu DRL_UPGRADE_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_upgrade.py -v -p no:cacheprovider
+
+# LLM multi-tenant admission soak: seeded Zipf-tenant × log-normal-cost
+# schedule with a noisy-neighbor scavenger flood through the
+# hierarchical wire lanes, plus the admission-subsystem unit surface
+# (docs/OPERATIONS.md §11). `make llm-soak SEED=...` replays any
+# schedule bit-for-bit — the chaos-test determinism contract.
+llm-soak:
+	JAX_PLATFORMS=cpu DRL_LLM_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_llm_admission.py -v -p no:cacheprovider
 
 # Native-vs-asyncio differential fuzz, verbosely (also part of tier-1):
 # reply-for-reply byte identity over randomized scalar AND bulk

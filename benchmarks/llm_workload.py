@@ -1,0 +1,279 @@
+"""The LLM-serving workload benchmark: Zipf tenants × log-normal token
+costs × mixed priorities (ISSUE 10; ROADMAP item 2).
+
+Production LLM gateways limit by token budget with wildly heavy-tailed
+cost-per-request ("Token-Budget-Aware Pool Routing", "TokenScale" —
+PAPERS.md). This benchmark makes that scenario a TRACKED number: one
+seeded workload (tenant popularity Zipf(s), costs LogNormal(μ, σ)
+clamped to [1, max_cost], priorities mixed 60/30/10) driven through the
+serving lanes, reporting rows/s AND tokens/s per lane:
+
+- ``inprocess``      — the serial in-memory store, flat vs hierarchical
+                       (two-level) per-row cost; the hierarchical path
+                       must stay ≤ 2× the flat path per row (the
+                       acceptance ratio — one extra bucket touch).
+- ``remote_scalar``  — one OP_ACQUIRE_H frame per row over TCP.
+- ``asyncio_bulk``   — HBUCKET ACQUIRE_MANY frames (one per tenant
+                       flush) on the asyncio server.
+- ``native_bulk``    — the same frames against the native front-end
+                       (the tenant extension rides its Python
+                       passthrough lane today — the number is the
+                       honest current cost, not the C fast lane's).
+
+Usage::
+
+    python -m benchmarks.llm_workload [--rows 40000] [--seed 20260804]
+        [--lanes inprocess,remote_scalar,...] [--smoke] [--json]
+
+One JSON row per lane on stdout; ``--evidence`` appends them to
+``benchmarks/evidence/llm_workload.jsonl``. ``benchmarks/recapture.py``
+owes this workload a real-device number (``llm_workload_device``)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import pathlib
+import time
+
+import numpy as np
+
+__all__ = ["gen_workload", "run_lane", "LANES", "main"]
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = _ROOT / "benchmarks" / "evidence" / "llm_workload.jsonl"
+
+#: Workload shape defaults (the tracked scenario's identity — change
+#: them and the numbers stop being comparable across rounds).
+N_TENANTS = 64
+ZIPF_S = 1.2
+LOGN_MU, LOGN_SIGMA = 4.0, 1.3   # median ~55 tokens, heavy tail
+MAX_COST = 8192
+TENANT_CAP = 5e6                 # tokens; budgets refill fast enough
+TENANT_RATE = 1e5                # that the bench measures THROUGHPUT,
+CHILD_CAP, CHILD_RATE = 1e6, 1e5  # not denial handling
+PRIORITY_MIX = (0.6, 0.3, 0.1)   # interactive / batch / scavenger
+
+
+def gen_workload(seed: int, n_rows: int):
+    """Returns ``(tenants i64[n], keys list, costs i64[n], prios
+    i8[n])`` — tenant ids Zipf-ranked, per-tenant user keys, log-normal
+    token costs, mixed priorities."""
+    rng = np.random.default_rng(seed)
+    t_idx = rng.zipf(ZIPF_S, n_rows) % N_TENANTS
+    costs = np.minimum(
+        np.maximum(rng.lognormal(LOGN_MU, LOGN_SIGMA, n_rows), 1.0),
+        MAX_COST).astype(np.int64)
+    u = rng.random(n_rows)
+    prios = np.where(u < PRIORITY_MIX[0], 0,
+                     np.where(u < PRIORITY_MIX[0] + PRIORITY_MIX[1],
+                              1, 2)).astype(np.int8)
+    keys = [f"t{t}/u{rng.zipf(1.5) % 200}" for t in t_idx]
+    tenants = [f"tenant:{t}" for t in t_idx]
+    return tenants, keys, costs, prios
+
+
+def _rate_row(lane: str, n: int, tokens: int, dt: float,
+              extra: "dict | None" = None) -> dict:
+    row = {
+        "bench": "llm_workload", "lane": lane, "rows": n,
+        "rows_per_sec": round(n / dt),
+        "tokens_per_sec": round(tokens / dt),
+        "wall_s": round(dt, 4),
+    }
+    if extra:
+        row.update(extra)
+    return row
+
+
+#: Coalescing window for the bulk lanes: a gateway accumulates this many
+#: rows, then flushes one HBUCKET frame per tenant present (the
+#: client-side MicroBatcher shape, spelled out so the bench is
+#: deterministic).
+FLUSH_WINDOW = 2048
+
+
+def _tenant_batches(tenants) -> list[list[int]]:
+    """Row-index batches: within each FLUSH_WINDOW window, one batch
+    per tenant (row order preserved inside a batch)."""
+    batches: list[list[int]] = []
+    for s in range(0, len(tenants), FLUSH_WINDOW):
+        by_tenant: dict[str, list[int]] = {}
+        for i in range(s, min(s + FLUSH_WINDOW, len(tenants))):
+            by_tenant.setdefault(tenants[i], []).append(i)
+        batches.extend(by_tenant.values())
+    return batches
+
+
+# -- lanes -------------------------------------------------------------------
+
+def lane_inprocess(tenants, keys, costs, prios) -> dict:
+    """Flat vs hierarchical per-row cost on the serial in-memory store
+    — the acceptance ratio (hier ≤ 2× flat per row). ABBA-interleaved
+    best-of-3 arms (the serving_metrics_overhead discipline): machine
+    noise hits both paths, the MIN of each is the structural cost."""
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    n = len(keys)
+    counts = costs.tolist()
+
+    def run_flat() -> float:
+        st = InProcessBucketStore()
+        acquire = st.acquire_blocking
+        t0 = time.perf_counter()
+        for k, c in zip(keys, counts):
+            acquire(k, c, CHILD_CAP, CHILD_RATE)
+        return time.perf_counter() - t0
+
+    last_res = None
+
+    def run_hier() -> float:
+        nonlocal last_res
+        st = InProcessBucketStore()
+        t0 = time.perf_counter()
+        last_res = st.acquire_hierarchical_many_blocking(
+            tenants, keys, counts, TENANT_CAP, TENANT_RATE, CHILD_CAP,
+            CHILD_RATE)
+        return time.perf_counter() - t0
+
+    run_flat(), run_hier()  # warm (dict growth, bytecode)
+    flats, hiers = [], []
+    for arm in range(3):
+        if arm % 2 == 0:
+            flats.append(run_flat())
+            hiers.append(run_hier())
+        else:
+            hiers.append(run_hier())
+            flats.append(run_flat())
+    t_flat, t_hier = min(flats), min(hiers)
+    granted_tokens = int(costs[np.asarray(last_res.granted,
+                                          bool)].sum())
+    ratio = t_hier / t_flat if t_flat > 0 else float("inf")
+    return _rate_row("inprocess", n, granted_tokens, t_hier, {
+        "flat_rows_per_sec": round(n / t_flat),
+        "hier_over_flat_per_row": round(ratio, 3),
+        "grant_rate": round(float(np.mean(last_res.granted)), 4),
+    })
+
+
+async def _wire_lane(tenants, keys, costs, prios, *, native: bool,
+                     bulk: bool) -> "dict | None":
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    backing = InProcessBucketStore()
+    srv = BucketStoreServer(backing, native_frontend=native)
+    await srv.start()
+    if native and srv._native is None:
+        await srv.aclose()
+        return None  # no compiler in this environment
+    store = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+    n = len(keys)
+    granted_tokens = 0
+    n_frames = 0
+    try:
+        t0 = time.perf_counter()
+        if bulk:
+            for idx in _tenant_batches(tenants):
+                sub_costs = costs[idx]
+                res = await store.acquire_hierarchical_many(
+                    [tenants[idx[0]]] * len(idx),
+                    [keys[i] for i in idx], sub_costs, TENANT_CAP,
+                    TENANT_RATE, CHILD_CAP, CHILD_RATE,
+                    priority=int(prios[idx[0]]))
+                granted_tokens += int(
+                    sub_costs[np.asarray(res.granted, bool)].sum())
+                n_frames += 1
+        else:
+            for i in range(n):
+                r = await store.acquire_hierarchical(
+                    tenants[i], keys[i], int(costs[i]), TENANT_CAP,
+                    TENANT_RATE, CHILD_CAP, CHILD_RATE,
+                    priority=int(prios[i]))
+                if r.granted:
+                    granted_tokens += int(costs[i])
+        dt = time.perf_counter() - t0
+    finally:
+        await store.aclose()
+        await srv.aclose()
+    lane = ("native_bulk" if native else
+            "asyncio_bulk" if bulk else "remote_scalar")
+    return _rate_row(lane, n, granted_tokens, dt,
+                     {"frames": n_frames if bulk else n})
+
+
+def lane_remote_scalar(tenants, keys, costs, prios):
+    return asyncio.run(_wire_lane(tenants, keys, costs, prios,
+                                  native=False, bulk=False))
+
+
+def lane_asyncio_bulk(tenants, keys, costs, prios):
+    return asyncio.run(_wire_lane(tenants, keys, costs, prios,
+                                  native=False, bulk=True))
+
+
+def lane_native_bulk(tenants, keys, costs, prios):
+    return asyncio.run(_wire_lane(tenants, keys, costs, prios,
+                                  native=True, bulk=True))
+
+
+LANES = {
+    "inprocess": lane_inprocess,
+    "remote_scalar": lane_remote_scalar,
+    "asyncio_bulk": lane_asyncio_bulk,
+    "native_bulk": lane_native_bulk,
+}
+
+
+def run_lane(name: str, seed: int, n_rows: int) -> "dict | None":
+    tenants, keys, costs, prios = gen_workload(seed, n_rows)
+    row = LANES[name](tenants, keys, costs, prios)
+    if row is not None:
+        row.update({"seed": seed, "t": time.time()})
+    return row
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--rows", type=int, default=40_000)
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--lanes", default=",".join(LANES),
+                        help=f"comma list from {sorted(LANES)}")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny row count (plumbing check)")
+    parser.add_argument("--evidence", action="store_true",
+                        help=f"append rows to {EVIDENCE}")
+    args = parser.parse_args(argv)
+    n_rows = 2000 if args.smoke else args.rows
+    rc = 0
+    for name in args.lanes.split(","):
+        name = name.strip()
+        if name not in LANES:
+            print(json.dumps({"lane": name, "error": "unknown lane"}))
+            rc = 2
+            continue
+        row = run_lane(name, args.seed, n_rows)
+        if row is None:
+            row = {"bench": "llm_workload", "lane": name,
+                   "skipped": "lane unavailable (no native build)"}
+        print(json.dumps(row), flush=True)
+        if args.evidence:
+            EVIDENCE.parent.mkdir(parents=True, exist_ok=True)
+            with open(EVIDENCE, "a", encoding="utf-8") as f:
+                f.write(json.dumps(row) + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

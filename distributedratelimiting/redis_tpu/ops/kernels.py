@@ -51,6 +51,7 @@ __all__ = [
     "acquire_scan_packed24",
     "pack_slots24",
     "SLOT24_PAD",
+    "acquire_hierarchical_packed",
     "debit_batch_packed",
     "sync_batch",
     "sync_batch_packed",
@@ -938,6 +939,94 @@ def rebase_sema_epoch(state: SemaState, offset_ticks):
         jnp.maximum(state.last_ts - offset_ticks, 0),
         state.exists,
     )
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def acquire_hierarchical_packed(child_state: BucketState,
+                                parent_state: BucketState, packed,
+                                child_capacity, child_rate_per_tick,
+                                parent_capacity, parent_rate_per_tick):
+    """Fused two-level (tenant → key) weighted-cost admission — the
+    token-denominated plane's kernel (runtime/admission.py, DESIGN.md
+    §15): ONE launch gathers the child key row AND the parent tenant
+    row, refills both, and grants iff BOTH levels admit, with
+    both-or-neither state change (the "parent refund on child deny"
+    contract, closed algebraically: neither side is debited unless the
+    row is granted, and every touched slot still advances its refill
+    timestamp exactly like a denied flat acquire would).
+
+    ``packed i32[4, B]``: row 0 child slots (-1 ⇒ padding), row 1
+    token costs, row 2 broadcast batch timestamp, row 3 parent slots.
+    The two states are distinct tables (the store rejects identical
+    child/parent configs — one donated buffer cannot be donated
+    twice).
+
+    Duplicate serialization is conservative on BOTH axes, mirroring
+    the flat bulk paths' documented posture: the child prefix counts
+    ALL earlier same-key demand; the parent prefix counts earlier
+    same-tenant demand that the child level admitted (a
+    child-admitted-but-parent-denied row still reserves ahead on its
+    tenant within the batch). Exact on serial stores and whenever the
+    in-call demand fits — the same latitude ``acquire_many``
+    documents.
+
+    Returns ``(child_state', parent_state', out f32[2, B])`` with
+    ``out[0] = granted`` (0/1) and ``out[1] = min(child_remaining,
+    parent_remaining)`` — each row's post-decision view of its binding
+    constraint."""
+    cslots = packed[0]
+    counts = packed[1]
+    now = packed[2, 0]
+    pslots = packed[3]
+    c_size = child_state.tokens.shape[0]
+    p_size = parent_state.tokens.shape[0]
+    valid = (_valid_slots(cslots, cslots >= 0, c_size)
+             & _valid_slots(pslots, pslots >= 0, p_size))
+    counts_f = jnp.asarray(counts, jnp.float32)
+
+    cgs = _gather_slots(cslots, valid)
+    pgs = _gather_slots(pslots, valid)
+    c_ref = bm.refill_or_init(child_state.tokens[cgs],
+                              child_state.last_ts[cgs],
+                              child_state.exists[cgs], now,
+                              child_capacity, child_rate_per_tick)
+    p_ref = bm.refill_or_init(parent_state.tokens[pgs],
+                              parent_state.last_ts[pgs],
+                              parent_state.exists[pgs], now,
+                              parent_capacity, parent_rate_per_tick)
+
+    c_prefix = bm.duplicate_prefix(cslots, counts, valid)
+    child_ok = valid & (c_ref >= c_prefix + counts_f)
+    # Parent axis: only child-admitted demand reserves ahead (a row the
+    # child already denied cannot double-charge its tenant's headroom).
+    p_demand = jnp.where(child_ok, counts_f, 0.0)
+    p_prefix = bm.duplicate_prefix(pslots, p_demand, valid)
+    granted = child_ok & (p_ref >= p_prefix + counts_f)
+
+    consumed = jnp.where(granted, counts_f, 0.0)
+    c_rem = jnp.where(valid,
+                      jnp.maximum(c_ref - c_prefix - consumed, 0.0), 0.0)
+    p_rem = jnp.where(valid,
+                      jnp.maximum(p_ref - p_prefix - consumed, 0.0), 0.0)
+    remaining = jnp.minimum(c_rem, p_rem)
+
+    css = _scatter_slots(cslots, valid, c_size)
+    new_c_tokens = child_state.tokens.at[css].set(c_ref, mode="drop")
+    new_c_tokens = new_c_tokens.at[css].add(-consumed, mode="drop")
+    new_c_ts = child_state.last_ts.at[css].set(
+        jnp.asarray(now, jnp.int32), mode="drop")
+    new_c_exists = child_state.exists.at[css].set(True, mode="drop")
+
+    pss = _scatter_slots(pslots, valid, p_size)
+    new_p_tokens = parent_state.tokens.at[pss].set(p_ref, mode="drop")
+    new_p_tokens = new_p_tokens.at[pss].add(-consumed, mode="drop")
+    new_p_ts = parent_state.last_ts.at[pss].set(
+        jnp.asarray(now, jnp.int32), mode="drop")
+    new_p_exists = parent_state.exists.at[pss].set(True, mode="drop")
+
+    out = jnp.stack([granted.astype(jnp.float32), remaining])
+    return (BucketState(new_c_tokens, new_c_ts, new_c_exists),
+            BucketState(new_p_tokens, new_p_ts, new_p_exists), out)
 
 
 @partial(jax.jit, donate_argnums=0)

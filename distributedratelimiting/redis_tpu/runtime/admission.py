@@ -1,0 +1,350 @@
+"""Token-denominated, SLO-aware admission — the LLM-serving policy layer.
+
+The raw machinery below this module counts *permits*; production LLM
+gateways limit by **token budget** with wildly heavy-tailed
+cost-per-request (PAPERS.md "Token-Budget-Aware Pool Routing",
+"TokenScale"): a 4K-token completion must cost 4096× what a 1-token
+probe costs, a tenant's whole fleet of keys must share one budget, and
+under pressure the *right* traffic must shed first. This module turns
+the counted-acquire machinery into that admission plane:
+
+- **Weighted-cost acquire** — every lane already carries a ``count``
+  operand end to end (wire ACQUIRE tail, bulk counts arrays, the
+  ``debit_many`` kernel); this module makes N-token costs the
+  first-class unit: budgets, envelopes, velocity, and the tier-0 edge
+  cache all denominate in tokens (a 4K-token grant can never hide
+  inside a 1-permit epsilon — the C replica install requires its
+  budget to cover the observed cost, ``native/frontend.cc
+  t0_install``).
+- **Hierarchical tenant → key budgets** — a two-level composition of
+  the existing bucket tables: the child key's bucket AND the parent
+  tenant's bucket decide in ONE fused kernel launch
+  (:func:`~.ops.kernels.acquire_hierarchical_packed`), grant iff both
+  levels admit, with both-or-neither state change (parent refund on
+  child deny). Rides the wire as ``OP_ACQUIRE_H`` / the
+  ``BULK_KIND_HBUCKET`` bulk kind (:mod:`~.runtime.wire`); tenant
+  budgets are plain bucket configs, so the live-config mutation plane
+  (``OP_CONFIG``) rebases them with no restart.
+- **Priority classes** — interactive / batch / scavenger with a defined
+  shed order, honored wherever bounded envelopes serve instead of the
+  authoritative store (drain windows, parked handoffs, the cluster's
+  degraded fallback): scavenger sheds first, batch cannot spend the
+  envelope's reserved half, interactive gets the whole envelope
+  (:func:`shed_allows` — THE shared gate, called from
+  ``placement.envelope_step``).
+- **Token velocity** — per-tenant tokens/sec as an exponentially
+  decayed rate (:class:`TokenVelocity`), exported via OP_STATS and
+  OpenMetrics (``drl_token_velocity{tenant=…}``). The heavy-hitter
+  sketch weights offers by cost on every lane (scalar, asyncio bulk via
+  :meth:`~.utils.heavy_hitters.HeavyHitters.offer_blob`, native batch,
+  native bulk via the C per-frame aggregation), so the resharder's
+  ``split_hot_keys`` candidates are hot-*cost* keys, not just
+  hot-count keys.
+
+Contract (docs/DESIGN.md §15): a hierarchical decision changes state
+both-or-neither — a denied request leaves both buckets exactly as a
+refill-only touch would. In-batch duplicate demand serializes
+conservatively on BOTH axes (an earlier row's demand reserves ahead on
+its key and, when child-admitted, on its tenant, even if ultimately
+denied), identical to the flat bulk paths' documented posture: exact
+on serial stores and whenever the in-call demand fits.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Mapping
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+
+__all__ = [
+    "PRIORITY_INTERACTIVE", "PRIORITY_BATCH", "PRIORITY_SCAVENGER",
+    "PRIORITY_NAMES", "BATCH_ENVELOPE_RESERVE",
+    "shed_allows", "TenantBudget", "TokenVelocity", "AdmissionPolicy",
+]
+
+#: Priority classes, shed-order ascending: the HIGHEST value sheds
+#: first. The wire carries the value as one byte on the tenant
+#: extension (wire.py ``_HIER_TAIL``); plain (non-hierarchical) frames
+#: default to interactive — unchanged behavior for every existing
+#: caller.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+PRIORITY_SCAVENGER = 2
+
+PRIORITY_NAMES = ("interactive", "batch", "scavenger")
+
+#: Fraction of an envelope's budget reserved for interactive traffic:
+#: batch admits only while the post-grant balance stays above
+#: ``budget × BATCH_ENVELOPE_RESERVE`` — the envelope's last half is
+#: spent on interactive alone. Scavenger never touches an envelope.
+BATCH_ENVELOPE_RESERVE = 0.5
+
+
+def priority_name(priority: int) -> str:
+    if 0 <= priority < len(PRIORITY_NAMES):
+        return PRIORITY_NAMES[priority]
+    return f"priority{priority}"
+
+
+def shed_allows(priority: int, tokens: float, count: int,
+                budget: float) -> bool:
+    """THE envelope shed gate — one formula for every bounded-envelope
+    serving site (drain windows, parked handoffs, degraded fallback),
+    so the documented shed order can never drift between them:
+
+    - **scavenger** is shed outright: envelope serving exists to keep a
+      bounded epsilon of availability through an outage/handoff, and
+      that epsilon is not spent on best-effort traffic (probes
+      included — a scavenger probe during degraded serving answers
+      "no").
+    - **batch** admits only while the post-grant balance stays above
+      ``budget × BATCH_ENVELOPE_RESERVE`` — it cannot consume the
+      reserved half.
+    - **interactive** (and anything unclassified below batch) gets the
+      plain ``tokens >= count`` envelope rule.
+
+    ``tokens`` is the envelope's refilled balance, ``budget`` its full
+    size (``headroom_budget(cap, fraction)``). Callers debit on True
+    exactly as before."""
+    if count < 0:
+        return False
+    if priority >= PRIORITY_SCAVENGER:
+        return False
+    if priority >= PRIORITY_BATCH:
+        return tokens - count >= budget * BATCH_ENVELOPE_RESERVE
+    return tokens >= count
+
+
+class TenantBudget:
+    """One tenant's token budget: a plain bucket config (capacity in
+    tokens, refill in tokens/sec) under the tenant's id. Being an
+    ordinary bucket config, it is live-mutable through the OP_CONFIG
+    plane (``ClusterBucketStore.mutate_config("bucket", old, new)``)
+    and checkpointed/migrated like any other bucket state."""
+
+    __slots__ = ("tenant", "capacity", "fill_rate_per_sec")
+
+    def __init__(self, tenant: str, capacity: float,
+                 fill_rate_per_sec: float) -> None:
+        if not tenant:
+            raise ValueError("tenant id must be non-empty")
+        if not math.isfinite(capacity) or capacity <= 0:
+            raise ValueError(f"tenant capacity must be > 0: {capacity}")
+        if not math.isfinite(fill_rate_per_sec) or fill_rate_per_sec < 0:
+            raise ValueError(
+                f"tenant fill rate must be >= 0: {fill_rate_per_sec}")
+        self.tenant = tenant
+        self.capacity = float(capacity)
+        self.fill_rate_per_sec = float(fill_rate_per_sec)
+
+    def config(self) -> tuple[float, float]:
+        return self.capacity, self.fill_rate_per_sec
+
+    def __repr__(self) -> str:
+        return (f"TenantBudget({self.tenant!r}, {self.capacity}, "
+                f"{self.fill_rate_per_sec}/s)")
+
+
+class TokenVelocity:
+    """Per-tenant tokens/sec — the signal autoscalers and the resharder
+    consume (TokenScale's observation: token *velocity*, not request
+    rate, is what predicts LLM-serving load).
+
+    Estimator: an exponentially decayed token sum per tenant —
+    ``S ← S·exp(−Δt/τ) + cost`` on every observation — read as
+    ``rate = S/τ``. Under a steady feed of r tokens/sec, S converges to
+    ``r·τ``, so the readout converges to r; after the feed stops, the
+    estimate decays to zero with time constant τ. One dict entry and
+    two floats per tenant, deterministic under an injected clock (the
+    seeded soaks), bounded tenant cardinality (smallest sum evicts
+    first — a tenant hot enough to matter re-enters immediately)."""
+
+    __slots__ = ("tau_s", "max_tenants", "_clock", "_state",
+                 "observed_tokens")
+
+    def __init__(self, tau_s: float = 10.0, max_tenants: int = 512,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if tau_s <= 0:
+            raise ValueError("tau_s must be positive")
+        self.tau_s = float(tau_s)
+        self.max_tenants = max_tenants
+        self._clock = clock
+        self._state: dict[str, tuple[float, float]] = {}  # S, last_t
+        #: Lifetime admitted tokens observed (all tenants) — the
+        #: cheap absolute counter beside the rate gauge.
+        self.observed_tokens = 0.0
+
+    def observe(self, tenant: str, cost: float) -> None:
+        """Fold ``cost`` admitted tokens for ``tenant`` into the rate."""
+        if cost <= 0:
+            return
+        now = self._clock()
+        self.observed_tokens += cost
+        entry = self._state.get(tenant)
+        if entry is None:
+            if len(self._state) >= self.max_tenants:
+                victim = min(self._state, key=lambda t: self._state[t][0])
+                del self._state[victim]
+            self._state[tenant] = (float(cost), now)
+            return
+        s, last = entry
+        s = s * math.exp(-(now - last) / self.tau_s) + cost
+        self._state[tenant] = (s, now)
+
+    def rate(self, tenant: str) -> float:
+        """Current tokens/sec estimate for one tenant (0.0 unknown)."""
+        entry = self._state.get(tenant)
+        if entry is None:
+            return 0.0
+        s, last = entry
+        return s * math.exp(-(self._clock() - last) / self.tau_s) \
+            / self.tau_s
+
+    def rates(self) -> dict[str, float]:
+        """``{tenant: tokens_per_sec}`` for every tracked tenant,
+        decay-corrected to now."""
+        now = self._clock()
+        return {t: s * math.exp(-(now - last) / self.tau_s) / self.tau_s
+                for t, (s, last) in self._state.items()}
+
+    def snapshot(self) -> dict:
+        """JSON-shaped summary for OP_STATS embedding."""
+        rates = self.rates()
+        return {
+            "tau_s": self.tau_s,
+            "observed_tokens": self.observed_tokens,
+            "tenants": {t: round(r, 6)
+                        for t, r in sorted(rates.items(),
+                                           key=lambda kv: -kv[1])},
+        }
+
+
+class AdmissionPolicy:
+    """The client-side admission façade: tenant budgets + priorities +
+    velocity over any :class:`~.runtime.store.BucketStore`.
+
+    One instance binds a store, a default child (per-key) bucket
+    config, and a set of :class:`TenantBudget` rows; ``acquire`` is
+    then the LLM-gateway entry point::
+
+        policy = AdmissionPolicy(store, key_config=(4096.0, 64.0))
+        policy.set_tenant(TenantBudget("tenant:acme", 1e6, 5e4))
+        res = await policy.acquire("tenant:acme", "user:42", cost=812,
+                                   priority=PRIORITY_BATCH)
+
+    Decisions go through the store's hierarchical lane (grant iff both
+    the key's bucket and the tenant's budget admit — on remote/cluster
+    stores that is the ``OP_ACQUIRE_H`` wire op, priority stamped on
+    the frame). Granted costs feed the local :class:`TokenVelocity`.
+
+    ``shed_level`` is the operator brownout knob: priorities at/above
+    it are denied locally without touching the store (e.g.
+    ``set_shed_level(PRIORITY_SCAVENGER)`` during an incident sheds
+    scavenger fleet-wide at the edge). ``None`` (default) sheds
+    nothing."""
+
+    def __init__(self, store, *, key_config: tuple[float, float],
+                 tenants: "Mapping[str, TenantBudget] | None" = None,
+                 velocity_tau_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.store = store
+        self.key_config = (float(key_config[0]), float(key_config[1]))
+        self._tenants: dict[str, TenantBudget] = dict(tenants or {})
+        self.velocity = TokenVelocity(velocity_tau_s, clock=clock)
+        self.shed_level: "int | None" = None
+        # Visible counters (stats()).
+        self.decisions = 0
+        self.granted = 0
+        self.admitted_tokens = 0.0
+        self.shed = 0
+
+    # -- tenant budget management (live-mutable) -----------------------------
+    def set_tenant(self, budget: TenantBudget) -> None:
+        """Install/replace a tenant's budget for FUTURE local calls.
+        NOTE for wire fleets: this changes only which config this
+        client *sends*; balances already accumulated under the old
+        config keep living in the old table until a live-config
+        mutation rebases them (``mutate_config("bucket", old, new)`` —
+        docs/OPERATIONS.md §11). Both together are the zero-restart
+        tenant-budget change."""
+        self._tenants[budget.tenant] = budget
+
+    def tenant(self, tenant: str) -> TenantBudget:
+        b = self._tenants.get(tenant)
+        if b is None:
+            raise KeyError(f"no budget configured for tenant {tenant!r}")
+        return b
+
+    def tenants(self) -> dict[str, TenantBudget]:
+        return dict(self._tenants)
+
+    def set_shed_level(self, level: "int | None") -> None:
+        self.shed_level = level
+
+    # -- admission -----------------------------------------------------------
+    async def acquire(self, tenant: str, key: str, cost: int = 1,
+                      priority: int = PRIORITY_INTERACTIVE):
+        """One weighted-cost hierarchical admission decision."""
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            AcquireResult,
+        )
+
+        self.decisions += 1
+        if self.shed_level is not None and priority >= self.shed_level:
+            self.shed += 1
+            return AcquireResult(False, 0.0)
+        budget = self.tenant(tenant)
+        cap, rate = self.key_config
+        res = await self.store.acquire_hierarchical(
+            tenant, key, int(cost), budget.capacity,
+            budget.fill_rate_per_sec, cap, rate, priority=priority)
+        if res.granted:
+            self.granted += 1
+            self.admitted_tokens += cost
+            self.velocity.observe(tenant, float(cost))
+        return res
+
+    def acquire_blocking(self, tenant: str, key: str, cost: int = 1,
+                         priority: int = PRIORITY_INTERACTIVE):
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            AcquireResult,
+        )
+
+        self.decisions += 1
+        if self.shed_level is not None and priority >= self.shed_level:
+            self.shed += 1
+            return AcquireResult(False, 0.0)
+        budget = self.tenant(tenant)
+        cap, rate = self.key_config
+        res = self.store.acquire_hierarchical_blocking(
+            tenant, key, int(cost), budget.capacity,
+            budget.fill_rate_per_sec, cap, rate, priority=priority)
+        if res.granted:
+            self.granted += 1
+            self.admitted_tokens += cost
+            self.velocity.observe(tenant, float(cost))
+        return res
+
+    def envelope_budget(self, tenant: str, *,
+                        fraction: float = 0.5) -> float:
+        """The tenant's fair-share envelope size — the epsilon term a
+        degraded/drain window can add on top of the budget (the same
+        ``headroom_budget`` formula every envelope uses)."""
+        return headroom_budget(self.tenant(tenant).capacity,
+                               fraction=fraction, min_budget=1.0)
+
+    def stats(self) -> dict:
+        return {
+            "decisions": self.decisions,
+            "granted": self.granted,
+            "admitted_tokens": self.admitted_tokens,
+            "shed": self.shed,
+            "shed_level": self.shed_level,
+            "tenants": {t: list(b.config())
+                        for t, b in sorted(self._tenants.items())},
+            "token_velocity": self.velocity.snapshot(),
+        }

@@ -152,7 +152,8 @@ class _DegradedKeyspace:
 
     def acquire(self, node: int, key: str, count: int, capacity: float,
                 fill_rate_per_sec: float,
-                kind: str = "bucket") -> AcquireResult:
+                kind: str = "bucket",
+                priority: int = 0) -> AcquireResult:
         now = self._clock()
         k = (node, key, kind, float(capacity), float(fill_rate_per_sec))
         entry = self._buckets.get(k)
@@ -178,10 +179,12 @@ class _DegradedKeyspace:
                         key=lambda kv: kv[1]):
                     del self._grants[gk]
         # The shared envelope formula (placement.envelope_step): the
-        # epsilon bound's two halves must never drift apart.
+        # epsilon bound's two halves must never drift apart. Priority
+        # routes through the one shed gate — scavenger is never served
+        # from a degraded envelope, batch can't spend its reserve.
         granted, tokens = placement_mod.envelope_step(
             entry, now, count, capacity, fill_rate_per_sec,
-            self._fraction)
+            self._fraction, priority)
         if granted and count > 0:
             self._grants[k] = self._grants.get(k, 0.0) + count
         self._buckets[k] = (tokens, now)
@@ -1112,7 +1115,15 @@ class ClusterBucketStore(BucketStore):
                              min_count: float = 0.0) -> list[str]:
         """Consult every node's heavy-hitter sketch (OP_STATS
         ``hot_keys``) and split the fleet-wide top ``top_n`` keys that
-        are not already overrides. Returns the keys split."""
+        are not already overrides. Returns the keys split.
+
+        Sketch offers are COST-weighted on every lane (an N-token
+        admission weighs N — utils/heavy_hitters.py), so the ranking
+        here is admitted TOKENS, not request count: a key taking few
+        huge-cost requests is as much a split candidate as one taking
+        many small ones, and ``min_count`` is a token threshold. The
+        per-tenant tokens/sec companion signal is OP_STATS
+        ``token_velocity`` / ``drl_token_velocity``."""
         scores: dict[str, float] = {}
         st = await self.stats()
         for node_stats in st["nodes"]:
@@ -1258,6 +1269,122 @@ class ClusterBucketStore(BucketStore):
                                                fill_rate_per_sec))
         return self.node_of(key).acquire_blocking(key, count, capacity,
                                                   fill_rate_per_sec)
+
+    # -- hierarchical tenant → key admission (runtime/admission.py) ----------
+    def _degraded_hier(self, j: int, tenant: str, key: str, count: int,
+                       tcap: float, trate: float, cap: float,
+                       rate: float, priority: int) -> AcquireResult:
+        """Two-level degraded fallback for a quarantined tenant node:
+        tenant envelope then key envelope, grant iff both, priority
+        shed order applied at both levels via the shared gate (a
+        tenant-envelope debit on a key deny stays debited — envelope
+        over-conservatism, the safe direction)."""
+        par = self._degraded.acquire(j, tenant, count, tcap, trate,
+                                     "bucket", priority)
+        if not par.granted:
+            return AcquireResult(False, par.remaining)
+        ch = self._degraded.acquire(j, key, count, cap, rate,
+                                    "bucket", priority)
+        return AcquireResult(ch.granted,
+                             min(par.remaining, ch.remaining))
+
+    async def acquire_hierarchical(self, tenant: str, key: str,
+                                   count: int, tenant_capacity: float,
+                                   tenant_fill_rate_per_sec: float,
+                                   capacity: float,
+                                   fill_rate_per_sec: float, *,
+                                   priority: int = 0) -> AcquireResult:
+        """Routed by TENANT, not key: the parent tenant bucket must
+        live whole on one node (a per-node split would multiply the
+        tenant's budget by the node count), so a tenant's hierarchical
+        admission — and its keys' child buckets — all land on the
+        tenant's owner under the placement map. The degraded fallback
+        honors the priority shed order (scavenger sheds first)."""
+        return await self._routed(
+            tenant,
+            lambda j: self.nodes[j].acquire_hierarchical(
+                tenant, key, count, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority),
+            lambda j: self._degraded_hier(
+                j, tenant, key, count, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority))
+
+    def acquire_hierarchical_blocking(self, tenant: str, key: str,
+                                      count: int,
+                                      tenant_capacity: float,
+                                      tenant_fill_rate_per_sec: float,
+                                      capacity: float,
+                                      fill_rate_per_sec: float, *,
+                                      priority: int = 0) -> AcquireResult:
+        if self._resilient:
+            return self._blocking(self.acquire_hierarchical(
+                tenant, key, count, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority))
+        return self.node_of(tenant).acquire_hierarchical_blocking(
+            tenant, key, count, tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+            priority=priority)
+
+    async def acquire_hierarchical_many(self, tenants, keys, counts,
+                                        tenant_capacity: float,
+                                        tenant_fill_rate_per_sec: float,
+                                        capacity: float,
+                                        fill_rate_per_sec: float, *,
+                                        with_remaining: bool = True,
+                                        priority: int = 0
+                                        ) -> "BulkAcquireResult":
+        """Bulk hierarchical: rows fan out BY TENANT (each tenant's
+        group is one node's call — see :meth:`acquire_hierarchical`),
+        results scatter back in row order. Quarantined groups serve the
+        two-level degraded envelope row-by-row."""
+        n = len(keys)
+        granted = np.zeros(n, bool)
+        remaining = (np.zeros(n, np.float32) if with_remaining
+                     else None)
+        counts_np = np.asarray(counts, np.int64)
+        by_tenant: dict[str, list[int]] = {}
+        for i, t in enumerate(tenants):
+            by_tenant.setdefault(t, []).append(i)
+
+        async def one_tenant(tenant: str, idx: list[int]):
+            sub_keys = [keys[i] for i in idx]
+            sub_counts = counts_np[idx]
+
+            def fallback(j):
+                g = np.zeros(len(sub_keys), bool)
+                r = np.zeros(len(sub_keys), np.float32)
+                for i2, (k, c) in enumerate(zip(sub_keys, sub_counts)):
+                    res = self._degraded_hier(
+                        j, tenant, k, int(c), tenant_capacity,
+                        tenant_fill_rate_per_sec, capacity,
+                        fill_rate_per_sec, priority)
+                    g[i2] = res.granted
+                    r[i2] = res.remaining
+                return BulkAcquireResult(g, r)
+
+            return await self._routed(
+                tenant,
+                lambda j: self.nodes[j].acquire_hierarchical_many(
+                    [tenant] * len(sub_keys), sub_keys, sub_counts,
+                    tenant_capacity, tenant_fill_rate_per_sec,
+                    capacity, fill_rate_per_sec,
+                    with_remaining=with_remaining, priority=priority),
+                fallback)
+
+        # Tenant groups fan out concurrently (the flat bulk lane's
+        # posture): one call's wall clock is the slowest node, not the
+        # sum over tenants. Distinct tenants' decisions are independent.
+        groups = list(by_tenant.items())
+        results = await asyncio.gather(
+            *(one_tenant(t, idx) for t, idx in groups))
+        for (_t, idx), res in zip(groups, results):
+            granted[idx] = res.granted
+            if remaining is not None and res.remaining is not None:
+                remaining[idx] = res.remaining
+        return BulkAcquireResult(granted, remaining)
 
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:

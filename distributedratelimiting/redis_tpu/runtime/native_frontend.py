@@ -400,15 +400,23 @@ class NativeFrontend:
             if hh is not None:
                 # Keys are already materialized for the store call; one
                 # C-speed Counter pass + a bounded top-2K merge
-                # (utils/heavy_hitters.py overhead discipline). Rows with
-                # count <= 0 (SEMA releases/probes) are not admission
-                # demand — filter only when any exist (rare outside
-                # semaphore traffic; the mask check is one vector op).
+                # (utils/heavy_hitters.py overhead discipline). Offers
+                # are COST-weighted — an N-token acquire weighs N, so
+                # the sketch ranks hot-cost keys (the split-candidate
+                # feed), not just hot-count keys. Rows with count <= 0
+                # (SEMA releases/probes) are not admission demand —
+                # filter only when any exist (rare outside semaphore
+                # traffic; the mask check is one vector op).
                 if (counts <= 0).any():
-                    hh.offer_many([k for k, c in zip(keys, counts)
-                                   if c > 0])
-                else:
+                    mask = counts > 0
+                    hh.offer_many([k for k, keep in zip(keys, mask)
+                                   if keep], counts[mask])
+                elif int(counts.max(initial=0)) <= 1:
+                    # All-unit batch (the overwhelmingly common shape):
+                    # weights are identical, keep the Counter fast path.
                     hh.offer_many(keys)
+                else:
+                    hh.offer_many(keys, counts)
             # Placement gate (runtime/placement.py): the C batch lane
             # must honor keyspace ownership exactly like the asyncio
             # lane's scalar gate. Dormant (None) until a map is

@@ -581,7 +581,8 @@ async def debit_source(store, entries: Mapping, fraction: float,
 
 def envelope_step(entry: "tuple[float, float] | None", now: float,
                   count: int, cap: float, rate: float,
-                  fraction: float) -> "tuple[bool, float]":
+                  fraction: float, priority: int = 0
+                  ) -> "tuple[bool, float]":
     """One fair-share-envelope admission step — THE shared formula the
     epsilon over-admission bound depends on: a ``headroom_budget(cap,
     fraction)`` bucket refilled at ``fraction × rate``, clamped to the
@@ -590,14 +591,24 @@ def envelope_step(entry: "tuple[float, float] | None", now: float,
     callers persist ``(new_tokens, now)`` and own their eviction and
     ledger policy. Shared by the handoff :class:`_FairShareEnvelope`
     (old-owner side) and the cluster's ``_DegradedKeyspace`` (client
-    edge) so the two halves of the bound can never drift apart."""
+    edge) so the two halves of the bound can never drift apart.
+
+    ``priority`` routes the grant rule through the ONE shed gate
+    (:func:`~.runtime.admission.shed_allows`): scavenger is shed
+    outright from any envelope, batch cannot spend the reserved half,
+    interactive (the default — every plain wire frame) keeps the
+    classic ``tokens >= count`` rule bit-for-bit."""
+    from distributedratelimiting.redis_tpu.runtime.admission import (
+        shed_allows,
+    )
+
     budget = headroom_budget(cap, fraction=fraction, min_budget=1.0)
     if entry is None:
         tokens = budget
     else:
         tokens, ts = entry
         tokens = min(budget, tokens + (now - ts) * rate * fraction)
-    granted = tokens >= count and count >= 0
+    granted = shed_allows(priority, tokens, count, budget)
     if granted and count > 0:
         tokens -= count
     return bool(granted), float(tokens)
@@ -621,7 +632,7 @@ class _FairShareEnvelope:
         self.decisions = 0
 
     def acquire(self, key: str, count: int, a: float, b: float,
-                kind: str) -> tuple[bool, float]:
+                kind: str, priority: int = 0) -> tuple[bool, float]:
         cap, rate = ((a, b) if kind == "bucket"
                      else (a, a / b if b > 0 else 0.0))
         now = self._clock()
@@ -630,7 +641,7 @@ class _FairShareEnvelope:
         if entry is None and len(self._buckets) >= self._MAX_KEYS:
             self._buckets.pop(next(iter(self._buckets)))
         granted, tokens = envelope_step(entry, now, count, cap, rate,
-                                        self._fraction)
+                                        self._fraction, priority)
         self._buckets[k] = (tokens, now)
         self.decisions += 1
         return granted, max(tokens, 0.0)
@@ -1010,10 +1021,10 @@ class NodePlacementState:
                 f"epoch {self.pmap.epoch}")
 
     def envelope_acquire(self, h: _Handoff, key: str, count: int,
-                         a: float, b: float, kind: str
-                         ) -> tuple[bool, float]:
+                         a: float, b: float, kind: str,
+                         priority: int = 0) -> tuple[bool, float]:
         self.envelope_decisions += 1
-        return h.envelope.acquire(key, count, a, b, kind)
+        return h.envelope.acquire(key, count, a, b, kind, priority)
 
     def stats(self) -> dict:
         out = {

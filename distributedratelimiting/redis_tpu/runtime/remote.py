@@ -96,7 +96,7 @@ _IDEMPOTENT_OPS = frozenset((
 _NON_IDEMPOTENT_OPS = frozenset((
     wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW, wire.OP_SEMA,
     wire.OP_SYNC, wire.OP_HELLO, wire.OP_SAVE, wire.OP_STATS,
-    wire.OP_TRACES, wire.OP_ACQUIRE_MANY))
+    wire.OP_TRACES, wire.OP_ACQUIRE_MANY, wire.OP_ACQUIRE_H))
 
 
 class RemoteBucketStore(BucketStore):
@@ -163,6 +163,14 @@ class RemoteBucketStore(BucketStore):
         # (a, b) chases exactly one routable error, then every later
         # call translates up front. {(kind, a, b) → (a, b)}.
         self._config_fwd: dict[tuple, tuple[float, float]] = {}
+        # Tenant-extension latch (OP_ACQUIRE_H / BULK_KIND_HBUCKET): an
+        # old server answers either with a routable unknown-op /
+        # unknown-bulk-kind error — latch off once per connection
+        # lifetime and fall back to FLAT child-only admission (counted:
+        # the tenant level goes unenforced against that peer —
+        # availability over tenant-budget accuracy, logged once).
+        self._peer_hier = True
+        self._hier_fallbacks = 0
 
         # -- resilience (docs/OPERATIONS.md §8, DESIGN.md §11) ---------
         # Bounded, jittered retries. At-most-once for admission: an op
@@ -417,8 +425,8 @@ class RemoteBucketStore(BucketStore):
     async def _request_io(self, op: int, key: str, count: int,
                           a: float, b: float,
                           parent: "tracing.TraceContext | None" = None,
-                          timeout_s: "float | None" = None
-                          ) -> tuple:
+                          timeout_s: "float | None" = None,
+                          hier=None) -> tuple:
         # rows=1: one wire command = one request (the permit count is the
         # command's argument, not its row count — keep units consistent
         # with the device store's per-batch rows).
@@ -426,7 +434,8 @@ class RemoteBucketStore(BucketStore):
         if not tracer.enabled:
             with self.profiler.span(wire.op_name(op), 1, annotate=False):
                 return await self._request_io_unprofiled(
-                    op, key, count, a, b, timeout_s=timeout_s)
+                    op, key, count, a, b, timeout_s=timeout_s,
+                    hier=hier)
         # The trace starts HERE (the client wire layer): `parent` is the
         # caller-side ambient context, captured before hopping onto the
         # I/O loop where contextvars don't follow (cluster fan-out spans
@@ -438,7 +447,8 @@ class RemoteBucketStore(BucketStore):
             trace = span.context if self._peer_traces else None
             try:
                 vals = await self._request_io_unprofiled(
-                    op, key, count, a, b, trace, timeout_s=timeout_s)
+                    op, key, count, a, b, trace, timeout_s=timeout_s,
+                    hier=hier)
             except wire.RemoteStoreError as exc:
                 if trace is not None and "unknown op" in str(exc):
                     # Old peer: it parsed the frame far enough to route
@@ -448,8 +458,22 @@ class RemoteBucketStore(BucketStore):
                     # its own, inner latch — it is tried and shed first.)
                     self._peer_traces = False
                     span.set_attr("trace_tail", "unsupported_peer")
-                    vals = await self._request_io_unprofiled(
-                        op, key, count, a, b, None, timeout_s=timeout_s)
+                    try:
+                        vals = await self._request_io_unprofiled(
+                            op, key, count, a, b, None,
+                            timeout_s=timeout_s, hier=hier)
+                    except wire.RemoteStoreError as exc2:
+                        if "unknown op" in str(exc2):
+                            # The BARE re-send was rejected too: the
+                            # base OP is what the peer doesn't speak
+                            # (e.g. OP_ACQUIRE_H against an old server)
+                            # — the trace tail was never the problem,
+                            # so undo the latch before surfacing (the
+                            # deadline latch's posture; without this, a
+                            # hier flat-fallback would silently strip
+                            # tracing from the whole connection).
+                            self._peer_traces = True
+                        raise
                 else:
                     raise
             if vals and vals[0] is False:
@@ -459,8 +483,8 @@ class RemoteBucketStore(BucketStore):
     async def _request_io_unprofiled(self, op: int, key: str, count: int,
                                      a: float, b: float,
                                      trace=None, *,
-                                     timeout_s: "float | None" = None
-                                     ) -> tuple:
+                                     timeout_s: "float | None" = None,
+                                     hier=None) -> tuple:
         """Send one request with the at-most-once retry contract
         (docs/DESIGN.md §11): a failure in the CONNECT phase provably
         never sent this request's frame, so any op may retry it; once
@@ -482,7 +506,7 @@ class RemoteBucketStore(BucketStore):
                 await self._connect_io()
                 sent = True  # past here the frame may be on the wire
                 return await self._send_once(op, key, count, a, b,
-                                             trace, ddl, timeout)
+                                             trace, ddl, timeout, hier)
             except wire.RemoteStoreError as exc:
                 if ddl is not None and "unknown op" in str(exc):
                     # Pre-deadline peer: it routed an error without
@@ -511,7 +535,7 @@ class RemoteBucketStore(BucketStore):
     async def _send_once(self, op: int, key: str, count: int,
                          a: float, b: float, trace,
                          deadline_s: "float | None",
-                         timeout: float) -> tuple:
+                         timeout: float, hier=None) -> tuple:
         if self._writer is None or self._io_loop is None:
             raise ConnectionError("store client is closed")
         self._seq = (self._seq + 1) & 0xFFFFFFFF
@@ -524,7 +548,8 @@ class RemoteBucketStore(BucketStore):
                     self._writer,
                     wire.encode_request(seq, op, key, count, a, b,
                                         trace=trace,
-                                        deadline_s=deadline_s),
+                                        deadline_s=deadline_s,
+                                        hier=hier),
                 )
                 # Drain only under real buffer pressure — a per-request
                 # drain await costs a task switch on a hot pipelined
@@ -553,12 +578,14 @@ class RemoteBucketStore(BucketStore):
 
     async def _request(self, op: int, key: str = "", count: int = 0,
                        a: float = 0.0, b: float = 0.0,
-                       timeout_s: "float | None" = None) -> tuple:
+                       timeout_s: "float | None" = None,
+                       hier=None) -> tuple:
         # Capture the ambient trace context on the CALLER's side — the
         # coroutine body runs on the I/O loop thread, where the caller's
         # contextvars are invisible.
         return await self._await_on_io(self._request_io(
-            op, key, count, a, b, tracing.current_context(), timeout_s))
+            op, key, count, a, b, tracing.current_context(), timeout_s,
+            hier))
 
     async def _retry_sleep(self, attempt: int) -> None:
         """One retry's backoff: the policy's jittered delay, stretched
@@ -598,8 +625,8 @@ class RemoteBucketStore(BucketStore):
                        kind: int = wire.BULK_KIND_BUCKET,
                        profile: bool = True,
                        parent: "tracing.TraceContext | None" = None,
-                       timeout_s: "float | None" = None
-                       ) -> list[tuple]:
+                       timeout_s: "float | None" = None,
+                       hier=None) -> list[tuple]:
         """Send every chunk of one bulk call pipelined on the connection,
         then await all replies. One wire round-trip (per ~MAX_FRAME of
         keys) carries thousands of decisions — this is what carries the
@@ -639,7 +666,8 @@ class RemoteBucketStore(BucketStore):
                                 seq, blob, offsets, klens, counts_np,
                                 start, end, capacity, fill_rate,
                                 with_remaining=with_remaining, kind=kind,
-                                chained=(i > 0), trace=trace))
+                                chained=(i > 0), trace=trace,
+                                hier=hier))
                     await self._writer.drain()
                 except Exception as exc:
                     self._drop_connection(
@@ -658,7 +686,8 @@ class RemoteBucketStore(BucketStore):
                 for seq, _ in futs:
                     self._pending.pop(seq, None)
 
-    def _bulk_prepare(self, keys: Sequence[str], counts: Sequence[int]):
+    def _bulk_prepare(self, keys: Sequence[str], counts: Sequence[int],
+                      budget: "int | None" = None):
         """Whole-call key prep: ONE join + ONE encode for the common
         all-ascii case (393K ``str.encode`` calls plus two length
         genexprs per 131K-key call were the client's top profile
@@ -679,7 +708,8 @@ class RemoteBucketStore(BucketStore):
             blob = b"".join(key_blobs)
         offsets = np.zeros(n + 1, np.int64)
         np.cumsum(klens, out=offsets[1:])
-        return blob, offsets, klens, counts_np, wire.bulk_chunk_spans(klens)
+        return (blob, offsets, klens, counts_np,
+                wire.bulk_chunk_spans(klens, budget))
 
     @staticmethod
     def _bulk_assemble(chunks: list[tuple],
@@ -780,6 +810,228 @@ class RemoteBucketStore(BucketStore):
             lambda a, b: self._bulk_call_blocking(
                 keys, counts, a, b, with_remaining, kind))
 
+    # -- hierarchical tenant → key admission (OP_ACQUIRE_H / HBUCKET) -------
+    def _note_hier_fallback(self) -> None:
+        """Old-peer latch: log the degradation ONCE per client (the
+        tenant level goes unenforced against this server), count every
+        fallback decision."""
+        if self._peer_hier:
+            self._peer_hier = False
+            log.error_evaluating_kernel(RuntimeError(
+                "server does not speak the tenant extension "
+                "(OP_ACQUIRE_H/HBUCKET); hierarchical calls fall back "
+                "to FLAT child-only admission — tenant budgets are NOT "
+                "enforced against this peer"))
+        self._hier_fallbacks += 1
+
+    @staticmethod
+    def _hier_unsupported(exc: Exception) -> bool:
+        msg = str(exc)
+        return "unknown op" in msg or "unknown bulk kind" in msg
+
+    async def _chase_hier(self, tcap: float, trate: float, cap: float,
+                          rate: float, call):
+        """The hierarchical edition of :meth:`_chase_config`: BOTH
+        levels' operands translate through the learned "bucket" rules
+        up front, and a moved error on EITHER level learns its rule and
+        re-sends — at most two chases (one per level; the gate answered
+        without touching the store, so a re-send is not a replay)."""
+        for attempt in range(3):
+            a, b = self._fwd_config("bucket", cap, rate)
+            ta, tb = self._fwd_config("bucket", tcap, trate)
+            try:
+                return await call(ta, tb, a, b)
+            except wire.RemoteStoreError as exc:
+                if (attempt >= 2
+                        or self._learn_config(exc, "bucket") is None):
+                    raise
+
+    def _chase_hier_blocking(self, tcap: float, trate: float,
+                             cap: float, rate: float, call):
+        for attempt in range(3):
+            a, b = self._fwd_config("bucket", cap, rate)
+            ta, tb = self._fwd_config("bucket", tcap, trate)
+            try:
+                return call(ta, tb, a, b)
+            except wire.RemoteStoreError as exc:
+                if (attempt >= 2
+                        or self._learn_config(exc, "bucket") is None):
+                    raise
+
+    async def acquire_hierarchical(self, tenant: str, key: str,
+                                   count: int, tenant_capacity: float,
+                                   tenant_fill_rate_per_sec: float,
+                                   capacity: float,
+                                   fill_rate_per_sec: float, *,
+                                   priority: int = 0,
+                                   timeout_s: "float | None" = None
+                                   ) -> AcquireResult:
+        """Two-level admission as ONE OP_ACQUIRE_H frame (grant iff
+        both levels admit, decided server-side in one fused launch);
+        ``priority`` rides the tenant extension so the server's
+        envelope serving honors the shed order."""
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            check_hierarchical_args,
+        )
+
+        check_hierarchical_args(count, tenant_capacity,
+                                tenant_fill_rate_per_sec, capacity,
+                                fill_rate_per_sec)
+        if not self._peer_hier:
+            self._hier_fallbacks += 1
+            return await self.acquire(key, count, capacity,
+                                      fill_rate_per_sec,
+                                      timeout_s=timeout_s)
+
+        async def call(ta, tb, a, b):
+            granted, remaining = await self._request(
+                wire.OP_ACQUIRE_H, key, count, a, b,
+                timeout_s=timeout_s,
+                hier=(tenant, ta, tb, priority))
+            return AcquireResult(granted, remaining)
+
+        try:
+            return await self._chase_hier(
+                tenant_capacity, tenant_fill_rate_per_sec, capacity,
+                fill_rate_per_sec, call)
+        except wire.RemoteStoreError as exc:
+            if not self._hier_unsupported(exc):
+                raise
+            self._note_hier_fallback()
+            return await self.acquire(key, count, capacity,
+                                      fill_rate_per_sec,
+                                      timeout_s=timeout_s)
+
+    def acquire_hierarchical_blocking(self, tenant: str, key: str,
+                                      count: int,
+                                      tenant_capacity: float,
+                                      tenant_fill_rate_per_sec: float,
+                                      capacity: float,
+                                      fill_rate_per_sec: float, *,
+                                      priority: int = 0,
+                                      timeout_s: "float | None" = None
+                                      ) -> AcquireResult:
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            check_hierarchical_args,
+        )
+
+        check_hierarchical_args(count, tenant_capacity,
+                                tenant_fill_rate_per_sec, capacity,
+                                fill_rate_per_sec)
+        if not self._peer_hier:
+            self._hier_fallbacks += 1
+            return self.acquire_blocking(key, count, capacity,
+                                         fill_rate_per_sec,
+                                         timeout_s=timeout_s)
+
+        def call(ta, tb, a, b):
+            granted, remaining = self._request_blocking(
+                wire.OP_ACQUIRE_H, key, count, a, b,
+                timeout_s=timeout_s,
+                hier=(tenant, ta, tb, priority))
+            return AcquireResult(granted, remaining)
+
+        try:
+            return self._chase_hier_blocking(
+                tenant_capacity, tenant_fill_rate_per_sec, capacity,
+                fill_rate_per_sec, call)
+        except wire.RemoteStoreError as exc:
+            if not self._hier_unsupported(exc):
+                raise
+            self._note_hier_fallback()
+            return self.acquire_blocking(key, count, capacity,
+                                         fill_rate_per_sec,
+                                         timeout_s=timeout_s)
+
+    def _hier_tail_budget(self, tenant: str) -> int:
+        """Chunk budget for HBUCKET frames: the per-frame tenant
+        extension rides every chunk, so the spans must leave room for
+        it under MAX_FRAME."""
+        tlen = len(tenant.encode("utf-8", "surrogateescape"))
+        return wire.BULK_CHUNK_BUDGET - (2 + tlen + wire.HIER_TAIL_LEN)
+
+    async def acquire_hierarchical_many(self, tenants, keys, counts,
+                                        tenant_capacity: float,
+                                        tenant_fill_rate_per_sec: float,
+                                        capacity: float,
+                                        fill_rate_per_sec: float, *,
+                                        with_remaining: bool = True,
+                                        priority: int = 0,
+                                        timeout_s: "float | None" = None
+                                        ) -> BulkAcquireResult:
+        """Bulk hierarchical admission over the wire: rows group by
+        tenant (one HBUCKET frame-set per distinct tenant — the
+        natural gateway shape is one tenant's flush), results scatter
+        back in row order."""
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            check_hierarchical_args,
+        )
+
+        n = len(keys)
+        counts_np = np.asarray(counts, np.int64)
+        check_hierarchical_args(int(counts_np.min(initial=0)),
+                                tenant_capacity,
+                                tenant_fill_rate_per_sec, capacity,
+                                fill_rate_per_sec)
+        if n == 0:
+            return self._bulk_empty(with_remaining)
+        if not self._peer_hier:
+            self._hier_fallbacks += 1
+            return await self.acquire_many(
+                keys, counts, capacity, fill_rate_per_sec,
+                with_remaining=with_remaining, timeout_s=timeout_s)
+        granted = np.zeros(n, bool)
+        remaining = np.zeros(n, np.float32) if with_remaining else None
+        by_tenant: dict[str, list[int]] = {}
+        for i, t in enumerate(tenants):
+            by_tenant.setdefault(t, []).append(i)
+
+        async def one_tenant(tenant: str, idx: list[int]):
+            sub_keys = [keys[i] for i in idx]
+            sub_counts = counts_np[idx]
+            if not self._peer_hier:  # latched mid-call by a sibling
+                self._hier_fallbacks += 1
+                return await self.acquire_many(
+                    sub_keys, sub_counts, capacity, fill_rate_per_sec,
+                    with_remaining=with_remaining, timeout_s=timeout_s)
+
+            async def call(ta, tb, a, b):
+                blob, offsets, klens, c_np, spans = self._bulk_prepare(
+                    sub_keys, sub_counts,
+                    self._hier_tail_budget(tenant))
+                chunks = await self._await_on_io(self._bulk_io(
+                    blob, offsets, klens, c_np, spans, a, b,
+                    with_remaining, kind=wire.BULK_KIND_HBUCKET,
+                    parent=tracing.current_context(),
+                    timeout_s=timeout_s,
+                    hier=(tenant, ta, tb, priority)))
+                return self._bulk_assemble(chunks, with_remaining)
+
+            try:
+                return await self._chase_hier(
+                    tenant_capacity, tenant_fill_rate_per_sec,
+                    capacity, fill_rate_per_sec, call)
+            except wire.RemoteStoreError as exc:
+                if not self._hier_unsupported(exc):
+                    raise
+                self._note_hier_fallback()
+                return await self.acquire_many(
+                    sub_keys, sub_counts, capacity, fill_rate_per_sec,
+                    with_remaining=with_remaining, timeout_s=timeout_s)
+
+        # All tenants' frame-sets in flight together — one bulk call is
+        # one pipelined burst on the connection, not one RTT per tenant
+        # (the flat lane's posture; frames of distinct tenants are
+        # independent, so concurrency changes no decision).
+        groups = list(by_tenant.items())
+        results = await asyncio.gather(
+            *(one_tenant(t, idx) for t, idx in groups))
+        for (_t, idx), res in zip(groups, results):
+            granted[idx] = res.granted
+            if remaining is not None and res.remaining is not None:
+                remaining[idx] = res.remaining
+        return BulkAcquireResult(granted, remaining)
+
     def _blocking_timeout(self, timeout_s: "float | None" = None) -> float:
         """Grace timeout for a blocking ``.result()`` wait: the request
         timeout plus the retry policy's worst-case backoff, plus one
@@ -791,10 +1043,11 @@ class RemoteBucketStore(BucketStore):
 
     def _request_blocking(self, op: int, key: str = "", count: int = 0,
                           a: float = 0.0, b: float = 0.0,
-                          timeout_s: "float | None" = None) -> tuple:
+                          timeout_s: "float | None" = None,
+                          hier=None) -> tuple:
         return self._submit(self._request_io(
             op, key, count, a, b, tracing.current_context(),
-            timeout_s)).result(self._blocking_timeout(timeout_s))
+            timeout_s, hier)).result(self._blocking_timeout(timeout_s))
 
     # -- client-side frame coalescing ---------------------------------------
     #: Cap on distinct (capacity, fill_rate) coalescing batchers: configs
@@ -1082,6 +1335,7 @@ class RemoteBucketStore(BucketStore):
             "timeouts": self._timeouts,
             "connect_failures": self._connect_failures,
             "backing_off": backing_off,
+            "hier_fallbacks": self._hier_fallbacks,
         }
 
     async def save(self) -> None:

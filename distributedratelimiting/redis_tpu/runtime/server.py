@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from distributedratelimiting.redis_tpu.runtime import (
+    admission,
     liveconfig,
     placement,
     wire,
@@ -190,6 +191,12 @@ class BucketStoreServer:
         self.heavy_hitters = (HeavyHitters(heavy_hitters_k)
                               if observability and heavy_hitters_k > 0
                               else None)
+        # Per-tenant tokens/sec (runtime/admission.py): fed by the
+        # hierarchical lanes' GRANTED costs, exported via OP_STATS
+        # "token_velocity" + drl_token_velocity{tenant=…} — the signal
+        # an autoscaler (or the resharder) consumes.
+        self.token_velocity = (admission.TokenVelocity()
+                               if observability else None)
         self.flight_recorder = (FlightRecorder(flight_capacity,
                                                dump_dir=flight_dir)
                                 if observability else None)
@@ -462,6 +469,19 @@ class BucketStoreServer:
                 "hot_key_error",
                 "Space-saving overcount bound per tracked key",
                 lambda: [({"key": k}, e) for k, _, e in hh.top()])
+        if self.token_velocity is not None:
+            tv = self.token_velocity
+            reg.counter("admitted_tokens",
+                        "Tokens admitted through the hierarchical "
+                        "(tenant-budgeted) lanes",
+                        lambda: tv.observed_tokens)
+            reg.labeled_gauges(
+                "token_velocity",
+                "Per-tenant admitted tokens/sec (exponentially decayed "
+                "rate, tau=token_velocity tau_s) — the autoscaling / "
+                "resharding signal",
+                lambda: [({"tenant": t}, r)
+                         for t, r in tv.rates().items()])
         if self.flight_recorder is not None:
             reg.register_numeric_dict(
                 "flight", "flight recorder",
@@ -735,6 +755,12 @@ class BucketStoreServer:
                 # stores iterate the view like the list they used to get.
                 seq, keys, counts, a, b, with_rem, kind = (
                     wire.decode_bulk_request(body, as_view=True))
+                if kind == wire.BULK_KIND_HBUCKET:
+                    # Hierarchical bulk: one tenant's rows, decided
+                    # two-level — its own lane (tenant extension, both
+                    # config gates, priority-aware envelopes).
+                    return await self._serve_bulk_hier(
+                        seq, body, keys, counts, a, b, with_rem)
                 if self.liveconfig.active:
                     # Frame-level config gate: one (kind, a, b) decides a
                     # whole bulk frame, so one probe covers every row —
@@ -767,6 +793,7 @@ class BucketStoreServer:
                         seq, wire.RESP_ERROR,
                         self.placement.moved_message(
                             key, int(self.placement.pmap.node_of(key))))
+                self._offer_bulk_hot(keys, counts)
                 if gate is not None:
                     res = await self._serve_bulk_gated(
                         keys, counts, a, b, with_rem, kind, gate)
@@ -780,6 +807,8 @@ class BucketStoreServer:
                         with_remaining=with_rem)
                 return wire.encode_bulk_response(seq, res.granted,
                                                  res.remaining)
+            if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_H:
+                return await self._serve_hierarchical(body)
             seq, op, key, count, a, b = wire.decode_request(body)
             if self.liveconfig.active and op in _CONFIG_GATED_OPS:
                 fwd = self.liveconfig.forward(_CONFIG_GATED_OPS[op], a, b)
@@ -1018,6 +1047,168 @@ class BucketStoreServer:
             log.error_evaluating_kernel(exc)  # kill the connection
             resp = wire.encode_response(seq, wire.RESP_ERROR, repr(exc))
         return resp
+
+    def _offer_bulk_hot(self, keys, counts) -> None:
+        """Cost-weighted heavy-hitter feed for the asyncio bulk lane —
+        closes the PR-2 zero-copy exemption: ``offer_blob`` aggregates
+        straight off the frame's byte blob (bounded sample + top-K
+        merge, no 100K-string materialization), so velocity/split
+        telemetry sees all three serving lanes, weighted in TOKENS."""
+        hh = self.heavy_hitters
+        if hh is None:
+            return
+        if isinstance(keys, wire.KeyBlob):
+            hh.offer_blob(keys.blob, keys.offsets, counts)
+        else:
+            hh.offer_many(keys, np.asarray(counts, np.float64))
+
+    def _hier_config_gate(self, seq: int, a: float, b: float,
+                          ta: float, tb: float) -> "bytes | None":
+        """Both levels of a hierarchical frame gate on the live-config
+        rules — a retired CHILD config and a retired PARENT (tenant)
+        config each answer the routable moved error (the client learns
+        the rule for whichever level moved and re-sends translated;
+        both rules live under the one "bucket" kind)."""
+        if not self.liveconfig.active:
+            return None
+        for pa, pb in ((a, b), (ta, tb)):
+            fwd = self.liveconfig.forward("bucket", pa, pb)
+            if fwd is not None:
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    self.liveconfig.moved("bucket", pa, pb, fwd))
+        return None
+
+    @staticmethod
+    def _hier_envelope(env_acquire, tenant: str, key: str, count: int,
+                       a: float, b: float, ta: float, tb: float,
+                       priority: int) -> tuple[bool, float]:
+        """Two-level envelope serving for hierarchical requests during
+        a drain window / parked handoff: child envelope then tenant
+        envelope, grant iff both (a child-envelope debit on a tenant
+        deny stays debited — envelope over-conservatism, the safe
+        direction). The priority shed order applies at BOTH levels via
+        the shared gate (admission.shed_allows)."""
+        g1, r1 = env_acquire(key, count, a, b, "bucket", priority)
+        if not g1:
+            return False, r1
+        g2, r2 = env_acquire(tenant, count, ta, tb, "bucket", priority)
+        return g2, min(r1, r2)
+
+    async def _serve_hierarchical(self, body: bytes) -> bytes:
+        """One OP_ACQUIRE_H frame: tenant → key two-level weighted-cost
+        admission (runtime/admission.py; DESIGN.md §15). Mirrors the
+        scalar ACQUIRE lane gate-for-gate — live-config (both levels),
+        drain envelope, placement — with the placement gate keyed on
+        the TENANT: hierarchical calls route by tenant (the parent
+        bucket must live whole on one node), so tenant ownership is
+        the routing truth the MOVED error must name."""
+        seq, key, count, a, b, tenant, ta, tb, priority = (
+            wire.decode_hierarchical_request(body))
+        gate_resp = self._hier_config_gate(seq, a, b, ta, tb)
+        if gate_resp is not None:
+            return gate_resp
+        env = self._drain_envelope
+        if env is not None:
+            if count >= 0:
+                granted, remaining = self._hier_envelope(
+                    env.acquire, tenant, key, count, a, b, ta, tb,
+                    priority)
+                return wire.encode_response(
+                    seq, wire.RESP_DECISION, granted, remaining)
+            return wire.encode_response(
+                seq, wire.RESP_ERROR,
+                f"{placement.HANDOFF_DEFERRAL_PREFIX}: server is "
+                "draining to its successor; retry shortly")
+        if self.placement.active:
+            verdict = self.placement.gate(tenant)
+            if verdict is not None:
+                what, info = verdict
+                if what == "envelope" and count >= 0:
+                    granted, remaining = self._hier_envelope(
+                        lambda k, c, pa, pb, kind, prio:
+                        self.placement.envelope_acquire(
+                            info, k, c, pa, pb, kind, prio),
+                        tenant, key, count, a, b, ta, tb, priority)
+                    return wire.encode_response(
+                        seq, wire.RESP_DECISION, granted, remaining)
+                if what == "envelope":
+                    self.placement.handoff_deferrals += 1
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        f"{placement.HANDOFF_DEFERRAL_PREFIX} for "
+                        f"this tenant (target epoch "
+                        f"{info.target_epoch}); retry shortly")
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    self.placement.moved_message(tenant, info))
+        hh = self.heavy_hitters
+        if hh is not None and count > 0:
+            # Cost-weighted: an N-token admission weighs N in the
+            # sketch, so hot-COST keys surface as split candidates.
+            if count > 1:
+                hh.offer(key, count)
+            else:
+                hh.offer_buffered(key)
+        res = await self.store.acquire_hierarchical(
+            tenant, key, count, ta, tb, a, b, priority=priority)
+        if res.granted and count > 0 and self.token_velocity is not None:
+            self.token_velocity.observe(tenant, float(count))
+        return wire.encode_response(seq, wire.RESP_DECISION,
+                                    res.granted, res.remaining)
+
+    async def _serve_bulk_hier(self, seq: int, body: bytes, keys,
+                               counts, a: float, b: float,
+                               with_rem: bool) -> bytes:
+        """One BULK_KIND_HBUCKET frame: one tenant's rows decided
+        two-level in one store call (the fused kernel on device
+        stores). Frame-level gates mirror the flat bulk lane's; the
+        placement gate keys on the tenant (the frame's routing
+        identity)."""
+        tenant, ta, tb, priority = wire.bulk_hier_tail(body)
+        gate_resp = self._hier_config_gate(seq, a, b, ta, tb)
+        if gate_resp is not None:
+            return gate_resp
+        n = len(keys)
+        counts_np = np.asarray(counts, np.int64)
+        env = self._drain_envelope
+        env_acquire = None
+        if env is not None:
+            env_acquire = env.acquire
+        elif self.placement.active:
+            verdict = self.placement.gate(tenant)
+            if verdict is not None:
+                what, info = verdict
+                if what != "envelope":
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        self.placement.moved_message(tenant, info))
+                env_acquire = (
+                    lambda k, c, pa, pb, kind, prio:
+                    self.placement.envelope_acquire(info, k, c, pa, pb,
+                                                    kind, prio))
+        if env_acquire is not None:
+            granted = np.zeros(n, bool)
+            remaining = np.zeros(n, np.float32) if with_rem else None
+            for i in range(n):
+                g, rem = self._hier_envelope(
+                    env_acquire, tenant, keys[i], int(counts_np[i]),
+                    a, b, ta, tb, priority)
+                granted[i] = g
+                if remaining is not None:
+                    remaining[i] = rem
+            return wire.encode_bulk_response(seq, granted, remaining)
+        self._offer_bulk_hot(keys, counts_np)
+        res = await self.store.acquire_hierarchical_many(
+            [tenant] * n, keys, counts_np, ta, tb, a, b,
+            with_remaining=with_rem, priority=priority)
+        if self.token_velocity is not None:
+            admitted = int(counts_np[np.asarray(res.granted,
+                                                bool)].sum())
+            if admitted > 0:
+                self.token_velocity.observe(tenant, float(admitted))
+        return wire.encode_bulk_response(seq, res.granted,
+                                         res.remaining)
 
     async def _serve_bulk_gated(self, keys, counts, a: float, b: float,
                                 with_rem: bool, kind: int, gate):
@@ -1293,6 +1484,9 @@ class BucketStoreServer:
                 payload["snapshot_chain"]["dirty"] = dirty()
         if self.heavy_hitters is not None:
             payload["hot_keys"] = self.heavy_hitters.snapshot()
+        if (self.token_velocity is not None
+                and self.token_velocity.observed_tokens > 0):
+            payload["token_velocity"] = self.token_velocity.snapshot()
         if self.flight_recorder is not None:
             payload["flight_recorder"] = self.flight_recorder.snapshot()
         if self.tracer.enabled:

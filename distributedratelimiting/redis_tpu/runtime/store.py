@@ -51,7 +51,30 @@ __all__ = [
     "BucketStore",
     "DeviceBucketStore",
     "InProcessBucketStore",
+    "check_hierarchical_args",
 ]
+
+
+def check_hierarchical_args(count: int, tenant_capacity: float,
+                            tenant_fill_rate_per_sec: float,
+                            capacity: float,
+                            fill_rate_per_sec: float) -> None:
+    """Shared validation for every hierarchical lane (in-process,
+    device, remote client, server dispatch — one rule, zero drift):
+    costs must be non-negative (a negative 'cost' would MINT tokens
+    through the refund algebra), and the tenant and key configs must
+    differ — identical configs would alias parent and child into one
+    table (the fused kernel donates each state buffer once), and a
+    tenant budget equal to the per-key config is a flat limiter
+    spelled twice, not a hierarchy."""
+    if count < 0:
+        raise ValueError("hierarchical acquire cost must be >= 0")
+    if (float(tenant_capacity), float(tenant_fill_rate_per_sec)) == \
+            (float(capacity), float(fill_rate_per_sec)):
+        raise ValueError(
+            "hierarchical acquire requires distinct tenant and key "
+            "configs (identical (capacity, fill_rate) would alias the "
+            "two levels into one table)")
 
 # Host tick value at which the store rebases its epoch (≪ int32 max), and
 # how much history the new epoch keeps. Margin 2^29 (~6 days): timestamps
@@ -235,6 +258,133 @@ class BucketStore(abc.ABC):
             np.fromiter((r.granted for r in results), bool, len(results)),
             np.fromiter((r.remaining for r in results), np.float32,
                         len(results)) if with_remaining else None)
+
+    # -- hierarchical tenant → key admission (runtime/admission.py) --------
+    async def acquire_hierarchical(self, tenant: str, key: str, count: int,
+                                   tenant_capacity: float,
+                                   tenant_fill_rate_per_sec: float,
+                                   capacity: float,
+                                   fill_rate_per_sec: float, *,
+                                   priority: int = 0) -> AcquireResult:
+        """Two-level weighted-cost admission: grant iff BOTH the child
+        key's ``(capacity, fill_rate)`` bucket and the parent tenant's
+        ``(tenant_capacity, tenant_fill_rate)`` bucket admit ``count``
+        tokens, with both-or-neither state change (parent refund on
+        child deny — DESIGN.md §15). ``remaining`` is the binding
+        constraint's post-decision view: ``min(child, parent)``.
+        ``priority`` (admission.PRIORITY_*) never changes a
+        healthy-path decision; wire stores stamp it on the frame so
+        envelope serving (drain windows, parked handoffs) can honor
+        the shed order.
+
+        Default: sequential parent-then-child compose with a
+        saturating refund of the parent on child deny (via
+        ``debit_many`` with a negative amount, where the store has
+        one; stores without a reconciliation lane skip the refund —
+        under-admission only, never over). Exact single-step
+        implementations: :class:`InProcessBucketStore` (serial core)
+        and :class:`DeviceBucketStore` (the fused
+        ``acquire_hierarchical_packed`` kernel);
+        ``RemoteBucketStore`` ships the whole decision as one
+        ``OP_ACQUIRE_H`` frame."""
+        check_hierarchical_args(count, tenant_capacity,
+                                tenant_fill_rate_per_sec, capacity,
+                                fill_rate_per_sec)
+        parent = await self.acquire(tenant, count, tenant_capacity,
+                                    tenant_fill_rate_per_sec)
+        if not parent.granted:
+            return AcquireResult(False, parent.remaining)
+        child = await self.acquire(key, count, capacity,
+                                   fill_rate_per_sec)
+        if child.granted:
+            return AcquireResult(True, min(child.remaining,
+                                           parent.remaining))
+        if count > 0 and type(self).debit_many is not BucketStore.debit_many:
+            # Refund the parent debit through the saturating debit lane
+            # (a negative amount credits back; the next refill's
+            # capacity clamp bounds any transient overshoot, so the
+            # refund can only under-credit — the safe direction).
+            await self.debit_many([tenant], [-float(count)],
+                                  tenant_capacity,
+                                  tenant_fill_rate_per_sec)
+        return AcquireResult(False, child.remaining)
+
+    def acquire_hierarchical_blocking(self, tenant: str, key: str,
+                                      count: int,
+                                      tenant_capacity: float,
+                                      tenant_fill_rate_per_sec: float,
+                                      capacity: float,
+                                      fill_rate_per_sec: float, *,
+                                      priority: int = 0) -> AcquireResult:
+        """Blocking compose (overridden with exact single-step
+        implementations by the serial/device/remote stores). The base
+        compose has no blocking refund lane: a child deny leaves the
+        parent debited — under-admission only, documented."""
+        check_hierarchical_args(count, tenant_capacity,
+                                tenant_fill_rate_per_sec, capacity,
+                                fill_rate_per_sec)
+        parent = self.acquire_blocking(tenant, count, tenant_capacity,
+                                       tenant_fill_rate_per_sec)
+        if not parent.granted:
+            return AcquireResult(False, parent.remaining)
+        child = self.acquire_blocking(key, count, capacity,
+                                      fill_rate_per_sec)
+        if child.granted:
+            return AcquireResult(True, min(child.remaining,
+                                           parent.remaining))
+        return AcquireResult(False, child.remaining)
+
+    async def acquire_hierarchical_many(self, tenants: Sequence[str],
+                                        keys: Sequence[str],
+                                        counts: Sequence[int],
+                                        tenant_capacity: float,
+                                        tenant_fill_rate_per_sec: float,
+                                        capacity: float,
+                                        fill_rate_per_sec: float, *,
+                                        with_remaining: bool = True,
+                                        priority: int = 0
+                                        ) -> "BulkAcquireResult":
+        """Vectorized hierarchical admission — row ``i`` decides
+        ``counts[i]`` tokens for ``(tenants[i], keys[i])``. Same-key /
+        same-tenant rows serialize in request order; on batched device
+        stores the serialization is conservative on BOTH axes (the
+        fused kernel's documented posture). Default: sequential loop
+        over :meth:`acquire_hierarchical`."""
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        for i in range(n):
+            r = await self.acquire_hierarchical(
+                tenants[i], keys[i], int(counts[i]), tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority)
+            granted[i] = r.granted
+            if remaining is not None:
+                remaining[i] = r.remaining
+        return BulkAcquireResult(granted, remaining)
+
+    def acquire_hierarchical_many_blocking(self, tenants: Sequence[str],
+                                           keys: Sequence[str],
+                                           counts: Sequence[int],
+                                           tenant_capacity: float,
+                                           tenant_fill_rate_per_sec: float,
+                                           capacity: float,
+                                           fill_rate_per_sec: float, *,
+                                           with_remaining: bool = True,
+                                           priority: int = 0
+                                           ) -> "BulkAcquireResult":
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        for i in range(n):
+            r = self.acquire_hierarchical_blocking(
+                tenants[i], keys[i], int(counts[i]), tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority)
+            granted[i] = r.granted
+            if remaining is not None:
+                remaining[i] = r.remaining
+        return BulkAcquireResult(granted, remaining)
 
     # -- decaying global counter (approximate algorithm's shared tier) -----
     @abc.abstractmethod
@@ -1671,6 +1821,138 @@ class DeviceBucketStore(BucketStore):
         return (out_np[0, :n].astype(np.float64),
                 out_np[1, :n].astype(np.float64))
 
+    # -- hierarchical tenant → key admission (fused kernel) ----------------
+    def _hier_dispatch(self, tenants: Sequence[str], keys: Sequence[str],
+                       counts_np: np.ndarray, tcap: float, trate: float,
+                       cap: float, rate: float) -> list[tuple]:
+        """Dispatch hierarchical rows as fused two-table launches: the
+        child key table and the parent tenant table decide together in
+        ONE kernel (grant iff both levels admit — the decision itself
+        is the reconciliation, no refund traffic exists). Returns
+        per-chunk device handles (no readback; callers overlap it)."""
+        check_hierarchical_args(int(counts_np.min(initial=0)), tcap,
+                                trate, cap, rate)
+        n = len(keys)
+        ctable = self._table(cap, rate)
+        ptable = self._table(tcap, trate)
+        outs: list[tuple] = []
+        with self.profiler.span("acquire_hierarchical", n), self._lock:
+            cslots = ctable.resolve_slots(list(keys))
+            pslots = ptable.resolve_slots(list(tenants))
+            now = self.now_ticks_checked()
+            b = self.max_batch
+            pos = 0
+            while pos < n:
+                take = min(b, n - pos)
+                packed = np.full((4, b), -1, np.int32)
+                packed[1] = 0
+                packed[0, :take] = cslots[pos:pos + take]
+                packed[1, :take] = np.minimum(counts_np[pos:pos + take],
+                                              2**31 - 1)
+                packed[2] = now
+                packed[3, :take] = pslots[pos:pos + take]
+                ctable.state, ptable.state, out = \
+                    K.acquire_hierarchical_packed(
+                        ctable.state, ptable.state, jnp.asarray(packed),
+                        ctable.cap_dev, ctable.rate_dev,
+                        ptable.cap_dev, ptable.rate_dev)
+                outs.append((out, take))
+                self.metrics.record_launch(b, take)
+                pos += take
+        return outs
+
+    @staticmethod
+    def _hier_gather(outs: list[tuple], n: int,
+                     with_remaining: bool) -> BulkAcquireResult:
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        pos = 0
+        arrs = jax.device_get([h for h, _ in outs])
+        for out_np, (_, take) in zip(arrs, outs):
+            granted[pos:pos + take] = out_np[0, :take] > 0.5
+            if remaining is not None:
+                remaining[pos:pos + take] = out_np[1, :take]
+            pos += take
+        return BulkAcquireResult(granted, remaining)
+
+    def _hier_fused_supported(self) -> bool:
+        """The fused lane needs host-resolved slots; fingerprint tables
+        place in-kernel, so the fp store keeps the base compose (exact
+        per call, parent refund through its ``debit_many``)."""
+        return getattr(self._TABLE_CLS, "resolve_slots", None) is not None
+
+    async def acquire_hierarchical(self, tenant, key, count,
+                                   tenant_capacity,
+                                   tenant_fill_rate_per_sec, capacity,
+                                   fill_rate_per_sec, *, priority=0):
+        await self.connect()
+        if not self._hier_fused_supported():
+            return await super().acquire_hierarchical(
+                tenant, key, count, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority)
+        res = await self.acquire_hierarchical_many(
+            [tenant], [key], [int(count)], tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec)
+        return res[0]
+
+    def acquire_hierarchical_blocking(self, tenant, key, count,
+                                      tenant_capacity,
+                                      tenant_fill_rate_per_sec, capacity,
+                                      fill_rate_per_sec, *, priority=0):
+        if not self._hier_fused_supported():
+            return super().acquire_hierarchical_blocking(
+                tenant, key, count, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority)
+        return self.acquire_hierarchical_many_blocking(
+            [tenant], [key], [int(count)], tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec)[0]
+
+    async def acquire_hierarchical_many(self, tenants, keys, counts,
+                                        tenant_capacity,
+                                        tenant_fill_rate_per_sec,
+                                        capacity, fill_rate_per_sec, *,
+                                        with_remaining: bool = True,
+                                        priority: int = 0):
+        await self.connect()
+        if not self._hier_fused_supported():
+            return await BucketStore.acquire_hierarchical_many(
+                self, tenants, keys, counts, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                with_remaining=with_remaining, priority=priority)
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._hier_dispatch(tenants, keys, counts_np,
+                                   tenant_capacity,
+                                   tenant_fill_rate_per_sec,
+                                   capacity, fill_rate_per_sec)
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(
+            None, lambda: self._hier_gather(outs, len(keys),
+                                            with_remaining))
+        _grant_zero_probes(res.granted, counts_np)
+        return res
+
+    def acquire_hierarchical_many_blocking(self, tenants, keys, counts,
+                                           tenant_capacity,
+                                           tenant_fill_rate_per_sec,
+                                           capacity, fill_rate_per_sec,
+                                           *, with_remaining: bool = True,
+                                           priority: int = 0):
+        if not self._hier_fused_supported():
+            return BucketStore.acquire_hierarchical_many_blocking(
+                self, tenants, keys, counts, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                with_remaining=with_remaining, priority=priority)
+        counts_np = np.asarray(counts, np.int64)
+        outs = self._hier_dispatch(tenants, keys, counts_np,
+                                   tenant_capacity,
+                                   tenant_fill_rate_per_sec,
+                                   capacity, fill_rate_per_sec)
+        res = self._hier_gather(outs, len(keys), with_remaining)
+        _grant_zero_probes(res.granted, counts_np)
+        return res
+
     # -- concurrency semaphore ---------------------------------------------
     def _sema_slot(self, key: str) -> int:
         with self._lock:
@@ -2136,6 +2418,93 @@ class InProcessBucketStore(BucketStore):
             remaining[i] = refilled - applied
             shortfall[i] = amt - applied
         return remaining, shortfall
+
+    # -- hierarchical tenant → key admission (exact serial core) -----------
+    def _hier_refill(self, bkey: tuple, capacity: float,
+                     rate_per_sec: float, now: int) -> float:
+        entry = self._buckets.get(bkey)
+        if entry is None:
+            return float(capacity)
+        tokens, ts = entry
+        rate = _rate_per_tick(rate_per_sec)
+        return min(float(capacity), tokens + max(0, now - ts) * rate)
+
+    def _hier_core(self, tenant, key, count, tcap, trate, cap, rate
+                   ) -> AcquireResult:
+        """Atomic two-level decision — the serial reference the fused
+        kernel (:func:`~.ops.kernels.acquire_hierarchical_packed`) is
+        differential-tested against: refill both levels, grant iff
+        both cover ``count``, debit both-or-neither, advance BOTH
+        timestamps either way (a denied request leaves each bucket
+        exactly as a refill-only touch would — the refund contract,
+        closed algebraically)."""
+        check_hierarchical_args(count, tcap, trate, cap, rate)
+        now = self.clock.now_ticks()
+        ckey = (key, float(cap), float(rate))
+        pkey = (tenant, float(tcap), float(trate))
+        c_ref = self._hier_refill(ckey, cap, rate, now)
+        p_ref = self._hier_refill(pkey, tcap, trate, now)
+        granted = c_ref >= count and p_ref >= count
+        spend = count if granted else 0
+        self._buckets[ckey] = (c_ref - spend, now)
+        self._buckets[pkey] = (p_ref - spend, now)
+        if self._dirty is not None:
+            self._dirty.add(ckey)
+            self._dirty.add(pkey)
+        return AcquireResult(granted,
+                             min(c_ref - spend, p_ref - spend))
+
+    async def acquire_hierarchical(self, tenant, key, count,
+                                   tenant_capacity,
+                                   tenant_fill_rate_per_sec, capacity,
+                                   fill_rate_per_sec, *, priority=0):
+        await self.connect()
+        return self._hier_core(tenant, key, int(count), tenant_capacity,
+                               tenant_fill_rate_per_sec, capacity,
+                               fill_rate_per_sec)
+
+    def acquire_hierarchical_blocking(self, tenant, key, count,
+                                      tenant_capacity,
+                                      tenant_fill_rate_per_sec, capacity,
+                                      fill_rate_per_sec, *, priority=0):
+        return self._hier_core(tenant, key, int(count), tenant_capacity,
+                               tenant_fill_rate_per_sec, capacity,
+                               fill_rate_per_sec)
+
+    async def acquire_hierarchical_many(self, tenants, keys, counts,
+                                        tenant_capacity,
+                                        tenant_fill_rate_per_sec,
+                                        capacity, fill_rate_per_sec, *,
+                                        with_remaining: bool = True,
+                                        priority: int = 0):
+        """Serial-core bulk: one in-order pass, no per-row coroutine —
+        the per-row cost stays within 2× of the flat serial core (one
+        extra dict round per row), which is the llm_workload bench's
+        hierarchical-overhead contract on the in-memory backing."""
+        await self.connect()
+        return self.acquire_hierarchical_many_blocking(
+            tenants, keys, counts, tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+            with_remaining=with_remaining, priority=priority)
+
+    def acquire_hierarchical_many_blocking(self, tenants, keys, counts,
+                                           tenant_capacity,
+                                           tenant_fill_rate_per_sec,
+                                           capacity, fill_rate_per_sec,
+                                           *, with_remaining: bool = True,
+                                           priority: int = 0):
+        n = len(keys)
+        granted = np.empty(n, bool)
+        remaining = np.empty(n, np.float32) if with_remaining else None
+        core = self._hier_core
+        for i in range(n):
+            r = core(tenants[i], keys[i], int(counts[i]),
+                     tenant_capacity, tenant_fill_rate_per_sec,
+                     capacity, fill_rate_per_sec)
+            granted[i] = r.granted
+            if remaining is not None:
+                remaining[i] = r.remaining
+        return BulkAcquireResult(granted, remaining)
 
     async def sync_counter(self, key, local_count, decay_rate_per_sec):
         return self.sync_counter_blocking(key, local_count, decay_rate_per_sec)

@@ -110,6 +110,33 @@ server answers the flagged op with a routable "unknown op" error and
 the client latches deadline stamping off for the connection (the trace
 latch's posture); the native C front-end routes flagged scalar ops to
 the Python passthrough lane, which speaks this dialect.
+
+Tenant extension (within v4, OP_METRICS posture — the token-denominated
+admission plane, runtime/admission.py, DESIGN.md §15):
+
+- ``OP_ACQUIRE_H`` is hierarchical (tenant → key) weighted-cost
+  admission: the OP_ACQUIRE payload followed by a tenant extension —
+  ``[u16 tlen][tenant utf-8][f64 tenant_a][f64 tenant_b][u8 priority]``
+  (``_HIER_TAIL`` after the tenant id) — decided grant-iff-both-levels
+  against the child ``(a, b)`` bucket AND the parent tenant
+  ``(tenant_a, tenant_b)`` bucket in one fused kernel launch. A new op
+  on the existing frame layout: an old server answers a routable
+  "unknown op" error (never a misparse) and the client latches tenant
+  stamping off for the connection, falling back to child-only flat
+  admission (counted — availability over tenant-budget accuracy, the
+  degraded-mode posture of invariant 9). The native C front-end routes
+  op 19 to the Python passthrough lane (its scalar switch names only
+  the ops it fast-paths — drl-check's ``wire-hier`` rule pins this).
+- ``BULK_KIND_HBUCKET`` (table-kind bits value 3) is the bulk edition:
+  one ACQUIRE_MANY frame carries ONE tenant's rows, with the same
+  tenant extension appended after the counts array (before any trace
+  tail). Old bulk decoders answer a routable "unknown bulk kind 3"
+  error; the C bulk fast lane's kind gate routes it to Python.
+- ``priority`` is the request's priority class
+  (:mod:`~.runtime.admission`: 0 interactive / 1 batch / 2 scavenger).
+  It never changes a healthy-path decision; envelope serving (drain
+  windows, parked handoffs, degraded fallback) honors the shed order —
+  scavenger sheds first, the envelope is spent on interactive.
 """
 
 from __future__ import annotations
@@ -125,7 +152,7 @@ __all__ = [
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
     "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
-    "OP_MIGRATE_PUSH", "OP_CONFIG", "TEXT_OPS",
+    "OP_MIGRATE_PUSH", "OP_CONFIG", "OP_ACQUIRE_H", "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
@@ -135,9 +162,11 @@ __all__ = [
     "MAX_FRAME", "PROTOCOL_VERSION", "RemoteStoreError",
     "ProtocolVersionError", "op_name",
     "encode_request", "decode_request", "encode_response", "decode_response",
+    "decode_hierarchical_request", "bulk_hier_tail",
     "encode_bulk_request", "decode_bulk_request", "encode_bulk_response",
     "bulk_chunk_spans", "KeyBlob", "decode_key_blob",
     "BULK_KIND_BUCKET", "BULK_KIND_WINDOW", "BULK_KIND_FWINDOW",
+    "BULK_KIND_HBUCKET", "HIER_TAIL_LEN",
     "BULK_REQ_HEAD_LEN", "BULK_RESP_HEAD_LEN",
     "read_frame", "write_frame",
 ]
@@ -190,6 +219,16 @@ OP_CONFIG = 18  # live config mutation (runtime/liveconfig.py, round 7;
 # every form — the OP_PLACEMENT_ANNOUNCE discipline — so post-send
 # retries are always safe.
 
+OP_ACQUIRE_H = 19  # hierarchical (tenant → key) weighted-cost acquire
+# (runtime/admission.py; OP_METRICS posture — a new op on the existing
+# frame layout, routable unknown-op error from old servers, never a
+# misparse): the OP_ACQUIRE payload followed by the tenant extension
+# [u16 tlen][tenant][_HIER_TAIL]. Decoded via
+# decode_hierarchical_request; decided grant-iff-both-levels with
+# parent refund on child deny (both-or-neither state change). The
+# native C front-end names the op only to pin its Python-lane
+# fallthrough (drl-check wire-hier).
+
 #: Control ops whose request payload is one u32-length-prefixed UTF-8
 #: JSON text (rides in the ``key`` slot of encode/decode_request —
 #: ensure_ascii JSON, so the strict codec never meets a surrogate).
@@ -215,6 +254,14 @@ BULK_FLAG_TRACED = 0b10000
 DEADLINE_FLAG = 0x40
 _DEADLINE_TAIL = struct.Struct("<d")  # remaining budget, seconds
 DEADLINE_TAIL_LEN = _DEADLINE_TAIL.size
+
+#: Tenant extension tail (after the ``[u16 tlen][tenant]`` id):
+#: parent-bucket config operands + the request's priority class.
+#: Rides OP_ACQUIRE_H (after the OP_ACQUIRE-shaped payload) and
+#: BULK_KIND_HBUCKET ACQUIRE_MANY frames (after the counts array,
+#: before any trace tail).
+_HIER_TAIL = struct.Struct("<ddB")  # tenant_a, tenant_b, priority
+HIER_TAIL_LEN = _HIER_TAIL.size
 
 #: OP_STATS flag bits (the optional one-byte payload): bit 0 resets the
 #: serving/stage latency windows after the snapshot; bit 1 asks the
@@ -242,6 +289,7 @@ _OP_NAMES = {
     OP_MIGRATE_PULL: "migrate_pull",
     OP_MIGRATE_PUSH: "migrate_push",
     OP_CONFIG: "config",
+    OP_ACQUIRE_H: "acquire_hierarchical",
 }
 
 
@@ -319,8 +367,20 @@ def _codepoint_truncate(mb: bytes, limit: int) -> bytes:
 
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
                    a: float = 0.0, b: float = 0.0,
-                   trace=None, deadline_s: "float | None" = None) -> bytes:
-    if op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
+                   trace=None, deadline_s: "float | None" = None,
+                   hier: "tuple[str, float, float, int] | None" = None
+                   ) -> bytes:
+    if op == OP_ACQUIRE_H:
+        # Hierarchical acquire: the OP_ACQUIRE payload followed by the
+        # tenant extension [u16 tlen][tenant][_HIER_TAIL]. `hier` is
+        # (tenant, tenant_a, tenant_b, priority).
+        if hier is None:
+            raise ValueError("OP_ACQUIRE_H requires the tenant extension")
+        tenant, ta, tb, priority = hier
+        payload = (_keyed(key, _ACQ_TAIL.pack(count, a, b))
+                   + _keyed(tenant, _HIER_TAIL.pack(ta, tb,
+                                                    priority & 0xFF)))
+    elif op in (OP_ACQUIRE, OP_WINDOW, OP_SEMA, OP_FWINDOW):
         payload = _keyed(key, _ACQ_TAIL.pack(count, a, b))
     elif op in (OP_PEEK, OP_SYNC):
         payload = _keyed(key, _F64x2.pack(a, b))
@@ -422,7 +482,34 @@ def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
     if op == OP_ACQUIRE_MANY:
         raise RemoteStoreError(
             "ACQUIRE_MANY frames decode via decode_bulk_request")
+    if op == OP_ACQUIRE_H:
+        raise RemoteStoreError(
+            "ACQUIRE_H frames decode via decode_hierarchical_request")
     raise RemoteStoreError(f"unknown op {op}")
+
+
+def decode_hierarchical_request(frame: bytes
+                                ) -> tuple[int, str, int, float, float,
+                                           str, float, float, int]:
+    """Decode one OP_ACQUIRE_H frame body: returns ``(seq, key, count,
+    a, b, tenant, tenant_a, tenant_b, priority)``. Strict like
+    :func:`decode_request` — truncation raises the routable error, and
+    the caller strips any deadline/trace tails first (the server does,
+    in ``handle_frame_body``)."""
+    ver, seq, op = _VER_SEQ_OP.unpack_from(frame, 0)
+    _check_version(ver)
+    if op != OP_ACQUIRE_H:
+        raise RemoteStoreError(f"expected ACQUIRE_H, got op {op}")
+    body = frame[_BODY_OFF:]
+    key, tail = _split_key(body)
+    if len(tail) < _ACQ_TAIL.size:
+        raise RemoteStoreError("truncated ACQUIRE_H payload")
+    count, a, b = _ACQ_TAIL.unpack_from(tail, 0)
+    tenant, rest = _split_key(tail[_ACQ_TAIL.size:])
+    if len(rest) != HIER_TAIL_LEN:
+        raise RemoteStoreError("malformed ACQUIRE_H tenant extension")
+    ta, tb, priority = _HIER_TAIL.unpack(rest)
+    return seq, key, count, a, b, tenant, ta, tb, priority
 
 
 def encode_response(seq: int, kind: int, *vals) -> bytes:
@@ -502,6 +589,14 @@ _FLAG_WITH_REMAINING = 1
 BULK_KIND_BUCKET = 0
 BULK_KIND_WINDOW = 1
 BULK_KIND_FWINDOW = 2
+#: Hierarchical tenant → key buckets (runtime/admission.py): the frame
+#: decides ONE tenant's rows — grant iff both the row's child bucket
+#: and the shared parent tenant bucket admit. Carries the tenant
+#: extension ``[u16 tlen][tenant][_HIER_TAIL]`` after the counts array
+#: (before any trace tail; old decoders read arrays by explicit counts
+#: and answer a routable "unknown bulk kind 3" error — never a
+#: misparse; the C bulk fast lane's kind gate routes it to Python).
+BULK_KIND_HBUCKET = 3
 _KIND_SHIFT = 1
 _KIND_MASK = 0b110
 #: Flags bit 3: this frame is a continuation chunk of the immediately
@@ -543,12 +638,14 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         with_remaining: bool = True,
                         kind: int = BULK_KIND_BUCKET,
                         chained: bool = False,
-                        trace=None) -> bytes:
+                        trace=None, hier=None) -> bytes:
     """Encode one ACQUIRE_MANY frame from per-key byte blobs. A thin
     wrapper over :func:`encode_bulk_request_span` (ONE definition of the
     frame layout — the two entry points must stay wire-identical);
-    ``kind`` selects the table family (bucket/window/fixed-window); for
-    windows the (capacity, fill_rate) slots carry (limit, window_s)."""
+    ``kind`` selects the table family (bucket/window/fixed-window/
+    hierarchical); for windows the (capacity, fill_rate) slots carry
+    (limit, window_s); ``hier`` is the HBUCKET tenant extension
+    ``(tenant, tenant_a, tenant_b, priority)``."""
     n = len(key_blobs)
     klens = np.fromiter((len(b) for b in key_blobs), np.int64, n)
     offsets = np.zeros(n + 1, np.int64)
@@ -557,7 +654,7 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
         seq, b"".join(key_blobs), offsets, klens,
         np.asarray(counts, np.uint32), 0, n, capacity, fill_rate,
         with_remaining=with_remaining, kind=kind, chained=chained,
-        trace=trace)
+        trace=trace, hier=hier)
 
 
 def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
@@ -567,19 +664,25 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
                              with_remaining: bool = True,
                              kind: int = BULK_KIND_BUCKET,
                              chained: bool = False,
-                             trace=None) -> bytes:
+                             trace=None, hier=None) -> bytes:
     """Encode one ACQUIRE_MANY chunk by SLICING a whole-call key blob —
     the client-side half of the zero-copy lane. ``_bulk_prepare`` joins
     and encodes the call's keys once; each chunk's payload is then two
     array casts and one bytes slice instead of a per-key join (the
     per-chunk ``b"".join(key_blobs[s:e])`` plus its length genexpr were
-    the client's top profile entries at 131K keys/call)."""
+    the client's top profile entries at 131K keys/call). ``hier``
+    (required iff ``kind == BULK_KIND_HBUCKET``) is the frame's tenant
+    extension ``(tenant, tenant_a, tenant_b, priority)``."""
     n = end - start
     kl = klens[start:end]
     if n and int(kl.max()) > 0xFFFF:
         raise ValueError("key exceeds 65535 utf-8 bytes")
-    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
+    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW,
+                    BULK_KIND_HBUCKET):
         raise ValueError(f"unknown bulk kind {kind}")
+    if (hier is not None) != (kind == BULK_KIND_HBUCKET):
+        raise ValueError(
+            "the tenant extension rides exactly the HBUCKET kind")
     flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
              | (kind << _KIND_SHIFT)
              | (_FLAG_CHAINED if chained else 0)
@@ -590,6 +693,13 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
         blob[offsets[start]:offsets[end]],
         np.asarray(counts[start:end], "<u4").tobytes(),
     ]
+    if hier is not None:
+        # Tenant extension AFTER the arrays (an old decoder reads them
+        # by explicit counts and rejects the kind before reaching it),
+        # BEFORE any trace tail (which always rides last).
+        tenant, ta, tb, priority = hier
+        parts.append(_keyed(tenant, _HIER_TAIL.pack(ta, tb,
+                                                    priority & 0xFF)))
     if trace is not None:
         # The trace tail rides AFTER the arrays: an old decoder reads
         # them by explicit counts and never touches it.
@@ -638,7 +748,10 @@ def decode_bulk_request(frame: bytes, *, as_view: bool = False
         # modes must not disagree about which frames are valid.
         keys = decode_key_blob(blob, klens, errors="surrogateescape")
     kind = (flags & _KIND_MASK) >> _KIND_SHIFT
-    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW):
+    if kind not in (BULK_KIND_BUCKET, BULK_KIND_WINDOW, BULK_KIND_FWINDOW,
+                    BULK_KIND_HBUCKET):
+        # Unreachable while the 2-bit kind field stays fully assigned —
+        # kept so narrowing the assignment can never silently misparse.
         raise RemoteStoreError(f"unknown bulk kind {kind}")
     return (seq, keys, counts, capacity, fill_rate,
             bool(flags & _FLAG_WITH_REMAINING), kind)
@@ -663,6 +776,29 @@ def bulk_trace_tail(body: bytes) -> "TraceContext | None":
     hi, lo, span, flags = _TRACE_TAIL.unpack_from(body,
                                                   len(body) - TRACE_TAIL_LEN)
     return TraceContext(hi, lo, span, flags)
+
+
+def bulk_hier_tail(body: bytes) -> tuple[str, float, float, int]:
+    """Parse an HBUCKET ACQUIRE_MANY frame body's tenant extension:
+    ``(tenant, tenant_a, tenant_b, priority)``. The extension sits at a
+    FIXED offset — right after the counts array, before any trace tail
+    — so it parses forward (the trace tail still parses from the end,
+    :func:`bulk_trace_tail`). Truncation raises the routable error; the
+    arrays themselves were already validated by
+    :func:`decode_bulk_request`."""
+    flags, _a, _b, n = _BULK_REQ_HEAD.unpack_from(body, _BODY_OFF)
+    off = _BODY_OFF + _BULK_REQ_HEAD.size
+    klens = np.frombuffer(body, "<u2", n, off)
+    off += 2 * n + int(klens.astype(np.int64).sum()) + 4 * n
+    if len(body) < off + _KEYED.size:
+        raise RemoteStoreError("truncated HBUCKET tenant extension")
+    (tlen,) = _KEYED.unpack_from(body, off)
+    off += _KEYED.size
+    if len(body) < off + tlen + HIER_TAIL_LEN:
+        raise RemoteStoreError("truncated HBUCKET tenant extension")
+    tenant = body[off:off + tlen].decode("utf-8", "surrogateescape")
+    ta, tb, priority = _HIER_TAIL.unpack_from(body, off + tlen)
+    return tenant, ta, tb, priority
 
 
 class KeyBlob:

@@ -27,11 +27,19 @@ Overhead discipline (the <3% serving-plane budget):
   below the per-batch top-2K never reach the sketch — a true heavy
   hitter is by definition in its batches' tops, so the truncation costs
   tail fidelity (which space-saving never promised), not head fidelity.
-- The zero-copy bulk lane (``wire.KeyBlob``) is deliberately NOT fed:
-  materializing 100K+ Python strings per frame to count them would cost
-  more than the whole telemetry budget. Per-request lanes (asyncio and
-  native front-end batches, whose keys are already materialized) and the
-  tier-0 sync pump are the feeds.
+- ``offer_blob`` feeds the zero-copy bulk lane (``wire.KeyBlob``)
+  without materializing per-key strings: a bounded (strided) sample of
+  the frame's positive-cost rows is tallied as BYTE slices, only the
+  per-frame top ``batch_top`` survivors decode to ``str`` and merge —
+  the asyncio bulk analogue of the native lane's per-frame C
+  aggregation (frontend.cc ``bulk_hot_feed``). Sampling scales the
+  surviving weights by the frame's total, so head weight is preserved
+  in expectation while per-frame cost stays O(sample).
+- Offers are **cost-weighted** everywhere (an N-token admission weighs
+  N): the sketch's counts are TOKENS, which is what makes its top-K the
+  hot-*cost* split-candidate feed the resharder consumes
+  (``ClusterBucketStore.split_hot_keys``) and the denominator of the
+  token-velocity signal (runtime/admission.py).
 """
 
 from __future__ import annotations
@@ -39,6 +47,8 @@ from __future__ import annotations
 import heapq
 from collections import Counter
 from typing import Iterable, Sequence
+
+import numpy as np
 
 __all__ = ["HeavyHitters"]
 
@@ -140,6 +150,46 @@ class HeavyHitters:
         for key, c in tally.most_common(self.batch_top):
             self.offer(key, float(c))
             merged += c
+        self.offered += total - merged  # truncated tail still counts in N
+
+    def offer_blob(self, blob: bytes, offsets, counts, *,
+                   sample: int = 4096) -> None:
+        """Cost-weighted feed straight off a bulk frame's key blob (see
+        module doc). ``offsets`` is the ``i64[n+1]`` boundary array of a
+        :class:`~.runtime.wire.KeyBlob`; ``counts`` the per-row token
+        costs (rows with cost <= 0 — probes — carry no admission
+        weight). Bounded work per call: at most ``sample`` byte-slice
+        tallies and ``batch_top`` string decodes."""
+        counts_np = np.asarray(counts, np.float64)
+        n = len(counts_np)
+        if n == 0:
+            return
+        pos = np.nonzero(counts_np > 0)[0]
+        if len(pos) == 0:
+            return
+        total = float(counts_np[pos].sum())
+        scale = 1.0
+        if len(pos) > sample:
+            # Deterministic strided sample (no rng on the serving
+            # path); the scale preserves the frame's total weight in
+            # expectation — head keys dominate any stride.
+            step = -(-len(pos) // sample)
+            pos = pos[::step]
+            sampled = float(counts_np[pos].sum())
+            if sampled <= 0.0:
+                return
+            scale = total / sampled
+        off = np.asarray(offsets, np.int64)
+        tally: dict[bytes, float] = {}
+        for i in pos.tolist():
+            kb = blob[off[i]:off[i + 1]]
+            tally[kb] = tally.get(kb, 0.0) + counts_np[i]
+        merged = 0.0
+        for kb, c in heapq.nlargest(self.batch_top, tally.items(),
+                                    key=lambda kv: kv[1]):
+            w = c * scale
+            self.offer(kb.decode("utf-8", "surrogateescape"), w)
+            merged += w
         self.offered += total - merged  # truncated tail still counts in N
 
     def top(self, n: int | None = None) -> list[tuple[str, float, float]]:

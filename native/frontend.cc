@@ -168,6 +168,14 @@ constexpr uint8_t OP_MIGRATE_PUSH = 17;
 // the routable "config moved" error; the tier-0 sync pump re-routes a
 // retired config's debits and zeroes its replica headroom).
 constexpr uint8_t OP_CONFIG = 18;
+// Hierarchical tenant → key acquire (wire.py, the token-denominated
+// admission plane): its frame carries a tenant extension this parser
+// does not speak, so the op MUST stay on the Python passthrough lane —
+// named here (never case-listed in the scalar switch) so drl-check's
+// wire-hier rule can pin the fallthrough; a future fast-path for it
+// must mirror the full [u16 tlen][tenant][f64 ta][f64 tb][u8 priority]
+// tail first.
+constexpr uint8_t OP_ACQUIRE_H = 19;
 
 // Bulk admission lane (round 8): OP_ACQUIRE_MANY parses HERE, tier-0
 // decides hot bucket rows per-row, and the RESP_BULK reply encodes in C
@@ -185,6 +193,11 @@ constexpr int kBulkKindShift = 1;          // wire _KIND_SHIFT
 constexpr uint8_t BULK_KIND_BUCKET = 0;
 constexpr uint8_t BULK_KIND_WINDOW = 1;
 constexpr uint8_t BULK_KIND_FWINDOW = 2;
+// Hierarchical tenant → key bulk frames (wire.py BULK_KIND_HBUCKET):
+// carry a tenant extension after the counts array that this parser
+// does not speak — handle_bulk_frame's `kind > BULK_KIND_FWINDOW` gate
+// routes them to the Python lane (drl-check wire-hier pins the gate).
+constexpr uint8_t BULK_KIND_HBUCKET = 3;
 // Flags bit 4: the 25-byte trace tail rides after the counts array
 // (old decoders read arrays by explicit counts and never see it).
 constexpr uint8_t BULK_FLAG_TRACED = 16;
@@ -571,16 +584,29 @@ T0Entry* t0_find(Frontend* fe, std::string_view key, double cap,
 }
 
 void t0_install(Frontend* fe, const std::string& key, double cap,
-                double rate, double remaining, uint64_t now) {
+                double rate, double remaining, uint64_t now,
+                double cost) {
   // mu held. Seed/refresh a replica from an authoritative device
   // decision (fe_complete). A refresh keeps `admitted`: the device
   // balance predates our un-drained local grants, so the envelope stays
   // conservative until the next sync acks them away.
+  //
+  // `cost` is the granting request's token count: a fresh install must
+  // have the headroom to decide at least ONE request of the cost that
+  // seeded it — min_budget alone is denominated for unit permits, and
+  // a replica whose budget cannot cover the workload's typical cost
+  // can never grant locally (every request would miss), so installing
+  // it only burns probe-window slots the genuinely decidable keys
+  // need. Token-denominated install terms, not request-denominated
+  // (the count>1 audit, ISSUE 10 satellite).
   if (fe->t0tab.empty() || key.size() > kT0MaxKey) return;
+  if (cost < 1.0) cost = 1.0;  // probe-seeded installs size for 1 token
   T0Entry* e = t0_find(fe, key, cap, rate);
   if (e == nullptr) {
     double budget = t0_budget_of(fe->t0, remaining);
-    if (budget <= 0.0) return;  // headroom too small to host locally
+    if (budget <= 0.0 || budget < cost) {
+      return;  // headroom too small to host locally
+    }
     size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
     for (size_t p = 0; p < kT0Probe && e == nullptr; p++) {
       T0Entry& cand = fe->t0tab[(idx + p) & fe->t0.mask];
@@ -990,7 +1016,10 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
   double b = rd_f64(p + 9);
   uint64_t n = rd_u32(p + 17);
   uint8_t kind = uint8_t((flags & kBulkKindMask) >> kBulkKindShift);
-  if (kind > BULK_KIND_FWINDOW) return false;  // Python raises the error
+  // Kinds past FWINDOW (BULK_KIND_HBUCKET's tenant extension) are
+  // Python-lane shapes: wire.py either serves them (hierarchical) or
+  // raises the routable error. drl-check wire-hier pins this gate.
+  if (kind > BULK_KIND_FWINDOW) return false;
   if (n == 0) return false;  // degenerate frame: Python authority
   bool traced = (flags & BULK_FLAG_TRACED) != 0;
   size_t tail = traced ? kTraceTail : 0;
@@ -1714,8 +1743,10 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
     }
     if (fe->t0.enabled && item.op == OP_ACQUIRE && granted[i] != 0) {
       // Every granted fall-through decision is an authoritative balance
-      // observation: seed/refresh the key's tier-0 replica from it.
-      t0_install(fe, item.key, item.a, item.b, remaining[i], t);
+      // observation: seed/refresh the key's tier-0 replica from it —
+      // sized for the grant's token cost (see t0_install).
+      t0_install(fe, item.key, item.a, item.b, remaining[i], t,
+                 double(item.count));
     }
     hist_record(fe, double(t - item.t_ns) * 1e-9);
     stage_record(fe, 0, double(t_flush - item.t_ns) * 1e-9);  // queue
@@ -2125,7 +2156,8 @@ void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
       if (klen <= kT0MaxKey) {
         t0_install(fe,
                    std::string(job.blob.data() + job.offsets[i], klen),
-                   job.a, job.b, remaining[r], t);
+                   job.a, job.b, remaining[r], t,
+                   double(job.counts[i]));
       }
     }
   }

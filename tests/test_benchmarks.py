@@ -54,7 +54,7 @@ def test_recapture_debt_ledger_semantics(tmp_path):
 
     names = [n for n, _why, _fn in recapture.DEBTS]
     assert names == ["fp_mesh_fixed", "fp_bulk_optimized",
-                     "native_fe_device_sweep"]
+                     "native_fe_device_sweep", "llm_workload_device"]
     ledger = tmp_path / "recapture.jsonl"
     assert recapture.owed(ledger) == names  # nothing settled yet
     recapture._append(ledger, {"debt": names[0], "status": "ok",
@@ -66,3 +66,40 @@ def test_recapture_debt_ledger_semantics(tmp_path):
     with open(ledger, "a", encoding="utf-8") as f:
         f.write('{"torn json\n')  # a torn tail row must not mask debts
     assert recapture.owed(ledger) == names[1:]
+
+
+def test_llm_workload_smoke_and_hier_ratio():
+    """The LLM workload bench (ISSUE 10): the in-memory lane runs, is
+    JSON-serializable, and holds the acceptance ratio — the
+    hierarchical (two-level) path costs ≤ 2× the flat path per row on
+    the in-memory backing (one extra bucket touch, amortized loop)."""
+    import json as _json
+
+    from benchmarks import llm_workload
+
+    row = llm_workload.run_lane("inprocess", seed=1, n_rows=20_000)
+    assert row["rows_per_sec"] > 0 and row["tokens_per_sec"] > 0
+    assert row["hier_over_flat_per_row"] <= 2.0, row
+    _json.dumps(row)
+
+
+def test_llm_workload_generator_is_seed_deterministic():
+    from benchmarks import llm_workload
+
+    a = llm_workload.gen_workload(3, 500)
+    b = llm_workload.gen_workload(3, 500)
+    assert a[0] == b[0] and a[1] == b[1]
+    assert (a[2] == b[2]).all() and (a[3] == b[3]).all()
+    # The advertised shape: heavy-tailed costs, clamped, all ≥ 1.
+    assert int(a[2].min()) >= 1 and int(a[2].max()) <= llm_workload.MAX_COST
+    assert a[2].std() > a[2].mean()  # genuinely heavy-tailed
+
+
+def test_llm_workload_wire_lane_smoke():
+    """The bulk wire lane end to end at tiny size (plumbing: HBUCKET
+    frames, per-tenant batching, token accounting)."""
+    from benchmarks import llm_workload
+
+    row = llm_workload.run_lane("asyncio_bulk", seed=2, n_rows=400)
+    assert row["rows"] == 400 and row["frames"] >= 1
+    assert row["tokens_per_sec"] > 0

@@ -197,30 +197,169 @@ def test_bulk_paths_match_serial_reference(seed, directory):
 
 @pytest.mark.parametrize("seed", [30, 31])
 def test_bulk_duplicates_conserve_and_order(seed):
-    """With in-call duplicates (Zipf-ish), the bulk paths must never
-    over-admit a key beyond its capacity/limit, and grants within one
-    call land on the EARLIEST occurrences (request-order serialization)."""
+    """With in-call duplicates (Zipf-ish) and randomized N-token costs,
+    the bulk paths must never over-admit a key beyond its
+    capacity/limit IN TOKENS, and grants within one call land on the
+    EARLIEST occurrences (request-order serialization). Fixed counts=1
+    widened to random costs by ISSUE 10 (weighted-cost parity)."""
     rng = np.random.default_rng(seed)
     clock = ManualClock()
     dev = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock,
                             max_batch=16)
-    cap = 5.0
+    cap = 9.0
     for step in range(10):
         n = 40
+        # Uniform cost PER KEY each step (mixed per-key costs void the
+        # order property across chunk boundaries: a denied big-cost
+        # row's reservation dies with its launch, and a later cheap row
+        # can legitimately fit the residue).
+        cost_of = {f"h{i}": int(rng.integers(1, 4)) for i in range(6)}
         keys = [f"h{rng.zipf(1.3) % 6}" for _ in range(n)]
-        res = dev.acquire_many_blocking(keys, [1] * n, cap, 0.0)
+        counts = [cost_of[k] for k in keys]
+        res = dev.acquire_many_blocking(keys, counts, cap, 0.0)
         granted_per: dict[str, int] = {}
         last_granted_rank: dict[str, int] = {}
         occurrence: dict[str, int] = {}
-        for k, g in zip(keys, res.granted):
+        for k, c, g in zip(keys, counts, res.granted):
             rank = occurrence.get(k, 0)
             occurrence[k] = rank + 1
             if g:
-                granted_per[k] = granted_per.get(k, 0) + 1
+                granted_per[k] = granted_per.get(k, 0) + c
                 # Order: a grant may not follow a denial of the same key
-                # within the call.
+                # within the call (conservative serialization means a
+                # denied row's demand still reserves ahead, so once any
+                # row of a key denies, every later row must too).
                 assert last_granted_rank.get(k, rank - 1) == rank - 1, (
                     f"seed={seed} step={step} key={k}: grant after denial")
                 last_granted_rank[k] = rank
         clock.advance_seconds(10.0)  # full refill between steps
         assert all(v <= cap for v in granted_per.values())
+
+
+@pytest.mark.parametrize("seed", [40, 41])
+def test_hierarchical_matches_serial_reference(seed):
+    """Differential for the fused two-level kernel
+    (acquire_hierarchical_packed): with DISTINCT tenants and keys per
+    call the device decisions must be bit-identical to the serial
+    reference (InProcessBucketStore._hier_core) — grant, remaining
+    (min of the binding constraints), refill across time advances, and
+    the both-or-neither refund contract."""
+    rng = np.random.default_rng(seed)
+    clock_a = ManualClock()
+    clock_b = ManualClock()
+    dev = DeviceBucketStore(n_slots=64, counter_slots=8, clock=clock_a,
+                            max_batch=8)
+    ref = InProcessBucketStore(clock=clock_b)
+
+    async def run():
+        for step in range(30):
+            perm = rng.permutation(12)
+            tenants = [f"t{i}" for i in perm[:6]]
+            keys = [f"k{i}" for i in perm[:6]]
+            counts = [int(c) for c in rng.integers(0, 6, 6)]
+            a = await dev.acquire_hierarchical_many(
+                tenants, keys, counts, 15.0, 1.0, 9.0, 2.0)
+            b = await ref.acquire_hierarchical_many(
+                tenants, keys, counts, 15.0, 1.0, 9.0, 2.0)
+            for i in range(6):
+                assert bool(a.granted[i]) == bool(b.granted[i]), (
+                    f"seed={seed} step={step} row={i} "
+                    f"tenant={tenants[i]} key={keys[i]} "
+                    f"count={counts[i]}: device={a[i]} reference={b[i]}")
+                assert a.remaining[i] == pytest.approx(b.remaining[i],
+                                                       abs=1e-3)
+            if rng.random() < 0.5:
+                dt = float(rng.random() * 2.0)
+                clock_a.advance_seconds(dt)
+                clock_b.advance_seconds(dt)
+        await dev.aclose()
+
+    import asyncio
+
+    asyncio.run(run())
+
+
+def test_weighted_cost_parity_across_lanes():
+    """ISSUE 10 satellite: ONE seeded schedule of N-token acquires must
+    produce IDENTICAL grant/deny sequences through all four serving
+    lanes — InProcess direct, remote scalar (OP_ACQUIRE), asyncio bulk
+    (OP_ACQUIRE_MANY), and the native bulk lane — each against its own
+    fresh in-memory backing on a never-advancing clock (decisions are
+    then a pure function of the schedule)."""
+    import asyncio
+
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.utils.native import (
+        load_frontend_lib,
+    )
+
+    rng = np.random.default_rng(7)
+    cap, rate = 1024.0, 1e-9
+    n = 160
+    keys = [f"u{rng.zipf(1.4) % 12}" for _ in range(n)]
+    # Heavy-tailed token costs (the LLM shape): clamp into the capacity.
+    costs = np.minimum(
+        np.maximum(rng.lognormal(4.0, 1.2, n).astype(np.int64), 1),
+        3000)
+
+    async def lane_inprocess():
+        st = InProcessBucketStore(clock=ManualClock())
+        return [
+            (await st.acquire(k, int(c), cap, rate)).granted
+            for k, c in zip(keys, costs)]
+
+    async def lane_remote_scalar():
+        backing = InProcessBucketStore(clock=ManualClock())
+        async with BucketStoreServer(backing) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                return [
+                    (await store.acquire(k, int(c), cap, rate)).granted
+                    for k, c in zip(keys, costs)]
+            finally:
+                await store.aclose()
+
+    async def lane_bulk(native: bool):
+        backing = InProcessBucketStore(clock=ManualClock())
+        async with BucketStoreServer(backing,
+                                     native_frontend=native) as srv:
+            if native and srv._native is None:
+                return None  # no compiler: lane unavailable, skip
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                out: list[bool] = []
+                # Several frames, sequential — in-frame duplicates ride
+                # the same serial backing, so decisions stay exact.
+                for s in range(0, n, 40):
+                    res = await store.acquire_many(
+                        keys[s:s + 40], costs[s:s + 40], cap, rate)
+                    out.extend(bool(g) for g in res.granted)
+                return out
+            finally:
+                await store.aclose()
+
+    async def main():
+        lanes = {
+            "inprocess": await lane_inprocess(),
+            "remote_scalar": await lane_remote_scalar(),
+            "asyncio_bulk": await lane_bulk(False),
+        }
+        if load_frontend_lib() is not None:
+            lanes["native_bulk"] = await lane_bulk(True)
+        want = lanes["inprocess"]
+        assert any(want) and not all(want)  # schedule crosses the edge
+        for name, got in lanes.items():
+            if got is None:
+                continue
+            assert got == want, (
+                f"lane {name} diverged at row "
+                f"{next(i for i, (x, y) in enumerate(zip(got, want)) if x != y)}")
+
+    asyncio.run(main())

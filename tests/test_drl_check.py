@@ -120,8 +120,54 @@ def test_bulk_kind_constant_drift_fires(tmp_path):
                            "constexpr uint8_t BULK_KIND_FWINDOW = 2;",
                            "constexpr uint8_t BULK_KIND_FWINDOW = 3;")
     findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
-    assert [f.rule for f in findings] == ["wire-const"]
-    assert "BULK_KIND_FWINDOW" in findings[0].message
+    # The drifted value both disagrees with wire.py (wire-const) and
+    # swallows BULK_KIND_HBUCKET under the C fast lane's kind gate
+    # (wire-hier) — both findings are real.
+    assert sorted(f.rule for f in findings) == ["wire-const",
+                                                "wire-hier"]
+    assert any("BULK_KIND_FWINDOW" in f.message for f in findings)
+
+
+# -- seeded divergences: tenant-extension fallthrough (wire-hier) -----------
+
+def test_hier_gate_removal_fires_once(tmp_path):
+    """Dropping the bulk parser's unknown-kind gate would let C misparse
+    HBUCKET frames — the rule must catch the gate's absence."""
+    cc = _mutated_frontend(
+        tmp_path,
+        "if (kind > BULK_KIND_FWINDOW) return false;",
+        "if (kind > BULK_KIND_HBUCKET) return false;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-hier"]
+    assert "handle_bulk_frame" in findings[0].message
+    assert findings[0].file.endswith("frontend.cc")
+
+
+def test_hier_scalar_fastpath_fires_once(tmp_path):
+    """Case-listing OP_ACQUIRE_H in the scalar switch would parse the
+    tenant-extended frame as the flat keyed shape (silently dropping
+    the tenant level) — the rule pins the passthrough."""
+    cc = _mutated_frontend(tmp_path, "case OP_ACQUIRE:",
+                           "case OP_ACQUIRE_H:\n      case OP_ACQUIRE:")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-hier"]
+    assert "OP_ACQUIRE_H" in findings[0].message
+    # The other side of the diff names wire.py's definition.
+    assert any("wire.py" in rf for rf, _, _ in findings[0].related)
+
+
+def test_hier_surface_removal_fires(tmp_path):
+    """A wire.py refactor that drops the extension pieces must fail the
+    rule loudly (not read as vacuously clean)."""
+    text = WIRE.read_text()
+    anchor = "BULK_KIND_HBUCKET = 3"
+    assert anchor in text
+    mutated = tmp_path / "wire.py"
+    mutated.write_text(text.replace(anchor, "_RETIRED_KIND = 3", 1))
+    findings = wire_conformance.check_wire(mutated, FRONTEND, tmp_path)
+    hier = [f for f in findings if f.rule == "wire-hier"]
+    assert len(hier) == 1
+    assert "BULK_KIND_HBUCKET" in hier[0].message
 
 
 def test_bulk_abi_exports_are_bound():

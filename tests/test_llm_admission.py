@@ -1,0 +1,474 @@
+"""Token-denominated, SLO-aware admission (ISSUE 10): the admission
+subsystem's unit surface plus THE seeded multi-tenant soak.
+
+The soak is the acceptance differential: a deterministic Zipf-tenant ×
+log-normal-cost schedule with a noisy neighbor flooding scavenger
+traffic, driven over the real wire (OP_ACQUIRE_H + HBUCKET bulk frames)
+against an in-memory backing, audited over the STORE'S OWN admission
+records — per-tenant admitted tokens never exceed budget + the epsilon
+envelope, and under envelope serving (a drain-and-handoff window)
+scavenger sheds before interactive. ``make llm-soak SEED=…`` replays
+any schedule bit-for-bit (DRL_LLM_SEED)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.runtime import admission, wire
+from distributedratelimiting.redis_tpu.runtime.admission import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_SCAVENGER,
+    AdmissionPolicy,
+    TenantBudget,
+    TokenVelocity,
+    shed_allows,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+)
+from distributedratelimiting.redis_tpu.runtime.server import (
+    BucketStoreServer,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+
+SEED = int(os.environ.get("DRL_LLM_SEED", "20260804"))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- priority shed gate ------------------------------------------------------
+
+def test_shed_allows_order():
+    budget = 100.0
+    # Interactive: the plain envelope rule, down to the last token.
+    assert shed_allows(PRIORITY_INTERACTIVE, 10.0, 10, budget)
+    assert not shed_allows(PRIORITY_INTERACTIVE, 9.0, 10, budget)
+    # Batch: cannot spend the reserved half.
+    assert shed_allows(PRIORITY_BATCH, 100.0, 50, budget)
+    assert not shed_allows(PRIORITY_BATCH, 100.0, 51, budget)
+    assert not shed_allows(PRIORITY_BATCH, 55.0, 10, budget)
+    # Scavenger: shed outright from any envelope, probes included.
+    assert not shed_allows(PRIORITY_SCAVENGER, 100.0, 1, budget)
+    assert not shed_allows(PRIORITY_SCAVENGER, 100.0, 0, budget)
+    # Negative costs never pass.
+    assert not shed_allows(PRIORITY_INTERACTIVE, 100.0, -1, budget)
+
+
+def test_envelope_step_honors_priority():
+    from distributedratelimiting.redis_tpu.runtime.placement import (
+        envelope_step,
+    )
+
+    # cap 200, fraction 0.5 → budget 100, fresh key born at budget.
+    g, tokens = envelope_step(None, 0.0, 10, 200.0, 0.0, 0.5,
+                              PRIORITY_INTERACTIVE)
+    assert g and tokens == 90.0
+    g, _ = envelope_step(None, 0.0, 10, 200.0, 0.0, 0.5,
+                         PRIORITY_SCAVENGER)
+    assert not g
+    g, _ = envelope_step((60.0, 0.0), 0.0, 20, 200.0, 0.0, 0.5,
+                         PRIORITY_BATCH)
+    assert not g  # 60 − 20 < 50: the reserved half is interactive's
+
+
+# -- token velocity ----------------------------------------------------------
+
+def test_token_velocity_converges_and_decays():
+    t = [0.0]
+    tv = TokenVelocity(tau_s=5.0, clock=lambda: t[0])
+    # Steady 100 tokens/sec for 60s (1 observation of 100 per second).
+    for _ in range(60):
+        tv.observe("acme", 100.0)
+        t[0] += 1.0
+    rate = tv.rate("acme")
+    assert rate == pytest.approx(100.0, rel=0.15)
+    # Feed stops: the estimate decays with tau.
+    t[0] += 5.0
+    assert tv.rate("acme") == pytest.approx(rate / np.e, rel=0.05)
+    t[0] += 50.0
+    assert tv.rate("acme") < 1.0
+    assert tv.rate("nobody") == 0.0
+    snap = tv.snapshot()
+    assert snap["observed_tokens"] == 6000.0 and "acme" in snap["tenants"]
+
+
+def test_token_velocity_bounded_tenants():
+    t = [0.0]
+    tv = TokenVelocity(tau_s=5.0, max_tenants=4, clock=lambda: t[0])
+    for i in range(10):
+        tv.observe(f"t{i}", float(i + 1))
+    assert len(tv.rates()) == 4
+    # The heaviest stay; the smallest were evicted.
+    assert "t9" in tv.rates()
+
+
+# -- hierarchical semantics (the refund contract) ---------------------------
+
+def test_hier_deny_leaves_both_levels_untouched():
+    run(_hier_deny_body())
+
+
+async def _hier_deny_body():
+    st = InProcessBucketStore(clock=ManualClock())
+    # Tenant 50, child 100: child admits, tenant denies → NEITHER debited.
+    r = await st.acquire_hierarchical("t", "k", 80, 50.0, 1e-9,
+                                      100.0, 1e-9)
+    assert not r.granted
+    assert st._buckets[("t", 50.0, 1e-9)][0] == 50.0
+    assert st._buckets[("k", 100.0, 1e-9)][0] == 100.0
+    # Child denies, tenant admits → neither debited either.
+    r = await st.acquire_hierarchical("t2", "k2", 80, 500.0, 1e-9,
+                                      60.0, 1e-9)
+    assert not r.granted
+    assert st._buckets[("t2", 500.0, 1e-9)][0] == 500.0
+    assert st._buckets[("k2", 60.0, 1e-9)][0] == 60.0
+    # Grant debits both; remaining is the binding constraint's view.
+    r = await st.acquire_hierarchical("t", "k", 30, 50.0, 1e-9,
+                                      100.0, 1e-9)
+    assert r.granted and r.remaining == pytest.approx(20.0)
+
+
+def test_hier_validation_is_shared():
+    st = InProcessBucketStore()
+    with pytest.raises(ValueError, match="distinct tenant and key"):
+        st.acquire_hierarchical_blocking("t", "k", 1, 10.0, 1.0,
+                                         10.0, 1.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        st.acquire_hierarchical_blocking("t", "k", -1, 20.0, 1.0,
+                                         10.0, 1.0)
+
+
+# -- AdmissionPolicy ---------------------------------------------------------
+
+def test_admission_policy_budgets_and_shed():
+    run(_policy_body())
+
+
+async def _policy_body():
+    st = InProcessBucketStore(clock=ManualClock())
+    policy = AdmissionPolicy(st, key_config=(10_000.0, 1e-9))
+    policy.set_tenant(TenantBudget("acme", 1000.0, 1e-9))
+    with pytest.raises(KeyError):
+        await policy.acquire("unknown", "k", 1)
+    granted = 0
+    for i in range(30):
+        r = await policy.acquire("acme", f"k{i % 5}", 100)
+        granted += r.granted
+    # 1000-token budget admits exactly 10 hundred-token requests.
+    assert granted == 10
+    assert policy.admitted_tokens == 1000.0
+    assert policy.velocity.rate("acme") > 0.0
+    # Operator brownout: scavenger shed locally, store untouched.
+    policy.set_shed_level(PRIORITY_SCAVENGER)
+    r = await policy.acquire("acme", "k", 0,
+                             priority=PRIORITY_SCAVENGER)
+    assert not r.granted and policy.shed == 1
+    assert policy.envelope_budget("acme") == headroom_budget(
+        1000.0, fraction=0.5, min_budget=1.0)
+    stats = policy.stats()
+    assert stats["granted"] == 10 and stats["shed"] == 1
+    assert "acme" in stats["token_velocity"]["tenants"]
+
+
+def test_tenant_budget_validation():
+    with pytest.raises(ValueError):
+        TenantBudget("", 10.0, 1.0)
+    with pytest.raises(ValueError):
+        TenantBudget("t", 0.0, 1.0)
+    with pytest.raises(ValueError):
+        TenantBudget("t", 10.0, -1.0)
+
+
+# -- old-peer latch ----------------------------------------------------------
+
+def test_old_peer_latches_flat_fallback():
+    """A server that does not speak the tenant extension answers the
+    routable unknown-op error; the client latches once, falls back to
+    FLAT child-only admission, and counts every fallback."""
+    run(_old_peer_body())
+
+
+async def _old_peer_body():
+    backing = InProcessBucketStore(clock=ManualClock())
+    srv = BucketStoreServer(backing)
+    real = srv.handle_frame_body
+
+    async def old_peer(body, arrival_s=None):
+        if len(body) >= 6 and (body[5] & 0x3F) == wire.OP_ACQUIRE_H:
+            from distributedratelimiting.redis_tpu.runtime.server import (
+                _recover_seq,
+            )
+
+            return wire.encode_response(_recover_seq(body),
+                                        wire.RESP_ERROR,
+                                        "unknown op 19")
+        return await real(body, arrival_s=arrival_s)
+
+    srv.handle_frame_body = old_peer
+    await srv.start()
+    store = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+    try:
+        r = await store.acquire_hierarchical("t", "k", 30, 100.0, 1e-9,
+                                             60.0, 1e-9)
+        # Flat fallback decided against the CHILD config only.
+        assert r.granted and r.remaining == pytest.approx(30.0)
+        assert store.resilience_stats()["hier_fallbacks"] == 1
+        assert not store._peer_hier
+        # The tenant bucket was never touched (unenforced, by contract).
+        assert ("t", 100.0, 1e-9) not in backing._buckets
+        # Later calls skip the wire probe entirely and keep counting.
+        await store.acquire_hierarchical("t", "k2", 1, 100.0, 1e-9,
+                                         60.0, 1e-9)
+        assert store.resilience_stats()["hier_fallbacks"] == 2
+    finally:
+        await store.aclose()
+        await srv.aclose()
+
+
+def test_old_peer_hier_fallback_keeps_trace_latch():
+    """Review regression: an old peer rejecting OP_ACQUIRE_H must not
+    permanently latch TRACE stamping off — the unknown-op answer names
+    the base op, not the trace tail, so after the bare re-send also
+    fails the trace latch is restored (the deadline latch's posture)."""
+    run(_trace_latch_body())
+
+
+async def _trace_latch_body():
+    from distributedratelimiting.redis_tpu.utils import tracing
+
+    backing = InProcessBucketStore(clock=ManualClock())
+    srv = BucketStoreServer(backing)
+    real = srv.handle_frame_body
+
+    async def old_peer(body, arrival_s=None):
+        if len(body) >= 6 and (body[5] & 0x3F) == wire.OP_ACQUIRE_H:
+            from distributedratelimiting.redis_tpu.runtime.server import (
+                _recover_seq,
+            )
+
+            return wire.encode_response(_recover_seq(body),
+                                        wire.RESP_ERROR,
+                                        "unknown op 19")
+        return await real(body, arrival_s=arrival_s)
+
+    srv.handle_frame_body = old_peer
+    await srv.start()
+    tracing.configure(enabled=True, sample_rate=1.0)
+    store = RemoteBucketStore(address=(srv.host, srv.port),
+                              coalesce_requests=False)
+    try:
+        r = await store.acquire_hierarchical("t", "k", 2, 100.0, 1e-9,
+                                             60.0, 1e-9)
+        assert r.granted  # flat fallback served
+        assert store._peer_traces  # the trace latch survived
+        assert not store._peer_hier
+    finally:
+        tracing.configure(enabled=False)
+        await store.aclose()
+        await srv.aclose()
+
+
+# -- THE seeded multi-tenant soak (acceptance) -------------------------------
+
+#: Tenant budgets (tokens) and the noisy neighbor: C floods scavenger
+#: traffic at 4× everyone's row rate. Fill rates ≈ 0 make the audit
+#: exact: admitted tokens can never exceed capacity while healthy.
+_TENANTS = {
+    "tenant:a": 6000.0,
+    "tenant:b": 4000.0,
+    "tenant:noisy": 3000.0,
+}
+_FILL = 1e-9
+_CHILD_CAP, _CHILD_RATE = 100_000.0, 1e-9
+
+
+def _soak_schedule(seed: int, n_rows: int = 900):
+    """Deterministic Zipf-tenant × log-normal-cost × mixed-priority
+    schedule. The noisy neighbor's rows are all scavenger; tenant:a is
+    interactive-heavy, tenant:b batch-heavy."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n_rows):
+        r = rng.random()
+        if r < 0.5:
+            tenant = "tenant:noisy"  # the flood
+            prio = PRIORITY_SCAVENGER
+        elif r < 0.8:
+            tenant = "tenant:a"
+            prio = (PRIORITY_INTERACTIVE if rng.random() < 0.8
+                    else PRIORITY_BATCH)
+        else:
+            tenant = "tenant:b"
+            prio = (PRIORITY_BATCH if rng.random() < 0.7
+                    else PRIORITY_INTERACTIVE)
+        key = f"{tenant}/u{rng.zipf(1.5) % 40}"
+        cost = int(min(max(rng.lognormal(3.0, 1.3), 1.0), 2000.0))
+        bulk = rng.random() < 0.25  # a minority rides HBUCKET frames
+        rows.append((tenant, key, cost, prio, bulk))
+    return rows
+
+
+async def _drive(store: RemoteBucketStore, rows) -> list[bool]:
+    """Run the schedule sequentially (deterministic); bulk rows batch
+    per 8 consecutive same-tenant rows when marked."""
+    out: list[bool] = []
+    i = 0
+    while i < len(rows):
+        tenant, key, cost, prio, bulk = rows[i]
+        if bulk:
+            # Gather a small same-tenant run into one HBUCKET frame.
+            j = i
+            ks, cs = [], []
+            while (j < len(rows) and rows[j][0] == tenant
+                   and rows[j][4] and j - i < 8):
+                ks.append(rows[j][1])
+                cs.append(rows[j][2])
+                j += 1
+            res = await store.acquire_hierarchical_many(
+                [tenant] * len(ks), ks, cs, _TENANTS[tenant], _FILL,
+                _CHILD_CAP, _CHILD_RATE, priority=prio)
+            out.extend(bool(g) for g in res.granted)
+            i = j
+        else:
+            r = await store.acquire_hierarchical(
+                tenant, key, cost, _TENANTS[tenant], _FILL,
+                _CHILD_CAP, _CHILD_RATE, priority=prio)
+            out.append(r.granted)
+            i += 1
+    return out
+
+
+def _audit(rows, grants) -> dict[str, float]:
+    admitted: dict[str, float] = {t: 0.0 for t in _TENANTS}
+    for (tenant, _k, cost, _p, _b), g in zip(rows, grants):
+        if g:
+            admitted[tenant] += cost
+    return admitted
+
+
+def test_llm_multitenant_soak():
+    """Acceptance: per-tenant admitted tokens ≤ budget + epsilon
+    envelope under a noisy-neighbor scavenger flood, scavenger shed
+    before interactive under envelope serving, differential audit over
+    the store's own admission records, deterministic schedule."""
+    run(_soak_body())
+
+
+async def _soak_body():
+    rows = _soak_schedule(SEED)
+
+    async def healthy_run():
+        backing = InProcessBucketStore(clock=ManualClock())
+        async with BucketStoreServer(backing) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                grants = await _drive(store, rows)
+                stats = await store.stats()
+            finally:
+                await store.aclose()
+            return grants, backing, stats
+
+    grants, backing, stats = await healthy_run()
+    admitted = _audit(rows, grants)
+
+    # 1. Tenant isolation while healthy: admitted ≤ budget EXACTLY
+    # (fill ≈ 0, the authoritative path has no epsilon), and the noisy
+    # neighbor's flood never ate another tenant's budget.
+    for tenant, cap in _TENANTS.items():
+        assert admitted[tenant] <= cap, (tenant, admitted[tenant])
+        assert admitted[tenant] >= cap - 2000.0, (
+            tenant, admitted[tenant], "budget left unexhausted — the "
+            "schedule no longer saturates; grow n_rows")
+        # Differential audit over the store's own records: the tenant
+        # bucket's balance is exactly capacity − admitted.
+        tokens, _ = backing._buckets[(tenant, cap, _FILL)]
+        assert tokens == pytest.approx(cap - admitted[tenant],
+                                       abs=1e-3), tenant
+
+    # 2. Healthy-path priorities change nothing: scavenger rows were
+    # admitted while the noisy tenant's own budget lasted.
+    noisy_granted = sum(
+        1 for (t, _k, _c, _p, _b), g in zip(rows, grants)
+        if g and t == "tenant:noisy")
+    assert noisy_granted > 0
+
+    # 3. The velocity signal saw every tenant, denominated in tokens.
+    vel = stats["token_velocity"]["tenants"]
+    assert set(vel) == set(_TENANTS)
+    assert stats["token_velocity"]["observed_tokens"] == pytest.approx(
+        sum(admitted.values()))
+
+    # 4. Determinism: the same seed replays the same grant sequence
+    # bit-for-bit on a fresh topology.
+    grants2, _backing2, _ = await healthy_run()
+    assert grants2 == grants
+
+    # 5. Envelope serving (drain-and-handoff window): scavenger sheds
+    # first, the envelope is spent on interactive, and the extra
+    # admission is bounded by the envelope — budget + epsilon overall.
+    src_backing = InProcessBucketStore(clock=ManualClock())
+    dst_backing = InProcessBucketStore(clock=ManualClock())
+    src = BucketStoreServer(src_backing, snapshot_path=None)
+    dst = BucketStoreServer(dst_backing)
+    await src.start()
+    await dst.start()
+    store = RemoteBucketStore(address=(src.host, src.port),
+                              coalesce_requests=False)
+    successor = RemoteBucketStore(address=(dst.host, dst.port),
+                                  coalesce_requests=False)
+    try:
+        # Some pre-drain consumption so the export carries state.
+        await _drive(store, rows[:120])
+        shutdown_task = asyncio.ensure_future(
+            src.shutdown(successor, window_s=1.5))
+        for _ in range(200):
+            if src._drain_envelope is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert src._drain_envelope is not None
+        env_budget = headroom_budget(_TENANTS["tenant:a"],
+                                     fraction=0.5, min_budget=1.0)
+        outcomes: dict[int, list[bool]] = {0: [], 1: [], 2: []}
+        env_admitted = 0.0
+        for i in range(90):
+            prio = (PRIORITY_INTERACTIVE, PRIORITY_BATCH,
+                    PRIORITY_SCAVENGER)[i % 3]
+            cost = 40
+            r = await store.acquire_hierarchical(
+                "tenant:a", f"tenant:a/e{i % 6}", cost,
+                _TENANTS["tenant:a"], _FILL, _CHILD_CAP, _CHILD_RATE,
+                priority=prio)
+            outcomes[prio].append(r.granted)
+            if r.granted:
+                env_admitted += cost
+        # Scavenger shed before interactive: zero scavenger grants,
+        # interactive served from the envelope.
+        assert not any(outcomes[PRIORITY_SCAVENGER])
+        assert any(outcomes[PRIORITY_INTERACTIVE])
+        # Batch never spends the reserved half; interactive outlives it.
+        assert (sum(outcomes[PRIORITY_INTERACTIVE])
+                >= sum(outcomes[PRIORITY_BATCH]))
+        # The envelope bound: window admission ≤ the tenant's envelope
+        # (each level's envelope is ≤ this; the tenant level binds).
+        assert env_admitted <= env_budget
+        await shutdown_task
+    finally:
+        await store.aclose()
+        await successor.aclose()
+        await src.aclose()
+        await dst.aclose()

@@ -89,6 +89,12 @@ def _random_bulk_frame(rng, seq: int) -> bytes:
         pool[0] = bytes(rng.integers(0, 256, int(rng.integers(1, 12)),
                                      dtype=np.uint8).tolist())
     counts = rng.integers(0, 4, nk)
+    if rng.random() < 0.3:
+        # Weighted-cost arm (ISSUE 10 satellite): heavy-tailed N-token
+        # costs beside the unit/probe mix — both lanes must agree on
+        # multi-token boundary decisions byte for byte too.
+        heavy = rng.integers(0, nk + 1)
+        counts[:heavy] = rng.integers(1, 3000, heavy)
     kind = int(rng.integers(0, 3))
     with_rem = bool(rng.integers(0, 2))
     trace = None
@@ -246,9 +252,13 @@ def test_native_and_asyncio_servers_answer_identically(seed, tier0):
         rng = np.random.default_rng(seed)
         try:
             for step in range(300):
-                op = rng.integers(0, 8)
+                op = rng.integers(0, 9)
                 key = f"k{rng.integers(0, 6)}"
-                count = int(rng.integers(0, 4))
+                # Weighted-cost mix (ISSUE 10 satellite): mostly small
+                # counts, a heavy arm near/over the boundary — grant
+                # edges must match for N-token costs too.
+                count = (int(rng.integers(0, 4)) if rng.random() < 0.7
+                         else int(rng.integers(1, 10)))
                 if op == 0:      # token bucket acquire / zero-probe
                     rs = [await st.acquire(key, count, 10.0, 1.0)
                           for st in stores]
@@ -291,6 +301,14 @@ def test_native_and_asyncio_servers_answer_identically(seed, tier0):
                           for st in stores]
                     assert rs[0].global_score == pytest.approx(
                         rs[1].global_score), step
+                elif op == 8:    # hierarchical tenant → key (OP_ACQUIRE_H)
+                    tenant = f"t{rng.integers(0, 3)}"
+                    rs = [await st.acquire_hierarchical(
+                        tenant, key, count, 30.0, 1.0, 10.0, 1.0)
+                          for st in stores]
+                    assert rs[0].granted == rs[1].granted, step
+                    assert rs[0].remaining == pytest.approx(
+                        rs[1].remaining), step
                 else:            # ping + clock advance in lockstep
                     for st in stores:
                         await st.ping()

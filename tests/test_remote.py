@@ -473,14 +473,19 @@ class TestBulkWire:
         with pytest.raises(ValueError, match="unknown bulk kind"):
             wire.encode_bulk_request(1, [b"k"], np.ones(1, np.uint32),
                                      1.0, 1.0, kind=4)
-        # A reserved kind arriving on the wire is a protocol error, not
-        # silently served as some other table family.
+        # Kind 3 (BULK_KIND_HBUCKET since ISSUE 10) decodes — but a
+        # frame claiming it WITHOUT the tenant extension is a protocol
+        # error when the server reads the extension, not silently
+        # served as some other table family.
         good = wire.encode_bulk_request(1, [b"k"], np.ones(1, np.uint32),
                                         1.0, 1.0)
         body = bytearray(good[4:])
-        body[6] |= 0b110  # force kind bits to the reserved value 3
-        with pytest.raises(wire.RemoteStoreError, match="unknown bulk kind"):
-            wire.decode_bulk_request(bytes(body))
+        body[6] |= 0b110  # force kind bits to HBUCKET (3)
+        *_rest, kind = wire.decode_bulk_request(bytes(body))
+        assert kind == wire.BULK_KIND_HBUCKET
+        with pytest.raises(wire.RemoteStoreError,
+                           match="tenant extension"):
+            wire.bulk_hier_tail(bytes(body))
 
     def test_oversized_unchunked_frame_is_loud(self):
         blobs = [b"k" * 60_000] * 20  # ~1.2MB in one frame

@@ -234,6 +234,102 @@ def test_tier0_overadmit_bounded_vs_device_only_oracle():
     run(body())
 
 
+def test_tier0_weighted_cost_overadmit_bounded():
+    """The token-denominated differential (ISSUE 10 satellite): the
+    same epsilon bound as the unit-count oracle test, with N-TOKEN
+    costs — per key, admitted TOKENS ≤ the device-only oracle's tokens
+    plus ``overadmit_epsilon`` (a formula already denominated in
+    tokens; a 4K-token grant cannot hide inside a 1-permit epsilon).
+    Mixed costs per key exercise the replica's budget math at several
+    grant sizes."""
+    capacity, fill = 4096.0, 1e-9
+    n_keys, per_key = 3, 220
+    cfg = _tier0_config(sync_interval_s=0.005, budget_fraction=0.5)
+    budget = headroom_budget(capacity, fraction=cfg.budget_fraction,
+                             min_budget=cfg.min_budget,
+                             max_budget=cfg.max_budget)
+    assert budget > 0
+    epsilon = overadmit_epsilon(budget, fill, cfg.sync_interval_s)
+
+    async def body():
+        backing = InProcessBucketStore()
+        async with BucketStoreServer(backing, native_frontend=True,
+                                     native_tier0=cfg) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                rng = np.random.default_rng(10)
+                keys = [f"w{i}" for i in range(n_keys)]
+                trace = [(keys[i % n_keys],
+                          int(rng.choice((4, 16, 64))))
+                         for i in range(n_keys * per_key)]
+                results = await asyncio.gather(
+                    *(store.acquire(k, c, capacity, fill)
+                      for k, c in trace))
+                admitted = {k: 0 for k in keys}
+                for (k, c), r in zip(trace, results):
+                    if r.granted:
+                        admitted[k] += c
+                # Device-only oracle: with ~zero fill, ANY serialization
+                # admits at most `capacity` tokens per key (the bucket
+                # only empties), and at least capacity - max_cost.
+                for k in keys:
+                    assert admitted[k] <= capacity + epsilon, (
+                        k, admitted[k], epsilon)
+                    assert admitted[k] >= (capacity - 64) * 0.9, (
+                        k, admitted[k])
+                st = await store.stats()
+                assert st["tier0"]["hits"] > 0          # lane exercised
+                assert st["tier0"]["installs"] >= 1
+                # Differential audit over the store's own records: the
+                # authoritative balance equals capacity − admitted −
+                # un-reconciled carry (≤ epsilon, in tokens).
+                await asyncio.sleep(0.05)  # let syncs drain
+                for k in keys:
+                    tokens, _ = backing._buckets[(k, capacity, fill)]
+                    assert tokens == pytest.approx(
+                        capacity - admitted[k], abs=epsilon)
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_tier0_install_requires_cost_headroom():
+    """A replica whose budget cannot cover even ONE request of the
+    cost that seeded it is never installed (the count>1 install-terms
+    fix: min_budget is denominated in tokens, and so is the install
+    gate). Semantics stay exact — every decision keeps the device
+    path."""
+    async def body():
+        backing = InProcessBucketStore()
+        # capacity 1000 → budget 500; every request costs 600 > budget.
+        cfg = _tier0_config(min_budget=8.0)
+        async with BucketStoreServer(backing, native_frontend=True,
+                                     native_tier0=cfg) as srv:
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            try:
+                r1 = await store.acquire("big", 600, 1000.0, 1e-9)
+                assert r1.granted and r1.remaining == pytest.approx(400.0)
+                r2 = await store.acquire("big", 600, 1000.0, 1e-9)
+                assert not r2.granted
+                st = await store.stats()
+                # The granted 600-token fall-through must NOT have
+                # installed a replica its budget (≤ 500) can't serve.
+                assert st["tier0"]["installs"] == 0
+                assert st["tier0"]["hits"] == 0
+                # Unit-cost traffic on a fresh key still installs.
+                for _ in range(3):
+                    await store.acquire("small", 1, 1000.0, 1e-9)
+                st = await store.stats()
+                assert st["tier0"]["installs"] >= 1
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
 class _OutageStore(InProcessBucketStore):
     """Backing store whose device-touching paths can be failed on demand
     (the r04/r05 outage mode, as seen by the front-end)."""

@@ -167,3 +167,83 @@ def test_client_bulk_nonascii_fallback_roundtrip():
     seq, dec_keys, dec_counts, *_ = wire.decode_bulk_request(frame[4:])
     assert dec_keys == keys
     assert dec_counts.tolist() == [1, 2, 3, 4]
+
+
+# -- tenant extension (OP_ACQUIRE_H / BULK_KIND_HBUCKET, ISSUE 10) ----------
+
+def test_hierarchical_request_roundtrip():
+    frame = wire.encode_request(
+        7, wire.OP_ACQUIRE_H, "user:42", 812, 4096.0, 64.0,
+        hier=("tenant:acme", 1e6, 5e4, 1))
+    seq, key, count, a, b, tenant, ta, tb, prio = (
+        wire.decode_hierarchical_request(frame[4:]))
+    assert (seq, key, count, a, b) == (7, "user:42", 812, 4096.0, 64.0)
+    assert (tenant, ta, tb, prio) == ("tenant:acme", 1e6, 5e4, 1)
+    # decode_request routes the op to its own decoder, strictly.
+    with pytest.raises(wire.RemoteStoreError,
+                       match="decode_hierarchical_request"):
+        wire.decode_request(frame[4:])
+    # The generic encoder refuses a hier-less OP_ACQUIRE_H.
+    with pytest.raises(ValueError, match="tenant extension"):
+        wire.encode_request(1, wire.OP_ACQUIRE_H, "k", 1, 1.0, 1.0)
+
+
+def test_hierarchical_tails_compose_with_deadline_and_trace():
+    """Tail order contract: payload (incl. tenant extension), deadline,
+    trace — the server strips trace then deadline, and the remaining
+    body must decode as a plain hierarchical frame."""
+    ctx = (1, 2, 3, 1)
+    frame = wire.encode_request(
+        9, wire.OP_ACQUIRE_H, "k", 5, 10.0, 1.0,
+        hier=("t", 30.0, 2.0, 2), deadline_s=0.25, trace=ctx)
+    body = frame[4:]
+    assert body[5] & wire.TRACE_FLAG and body[5] & wire.DEADLINE_FLAG
+    body, tctx = wire.strip_trace(body)
+    body, ddl = wire.strip_deadline(body)
+    assert tuple(tctx) == ctx and ddl == 0.25
+    seq, key, count, a, b, tenant, ta, tb, prio = (
+        wire.decode_hierarchical_request(body))
+    assert (key, count, tenant, ta, tb, prio) == ("k", 5, "t", 30.0,
+                                                  2.0, 2)
+
+
+def test_hierarchical_truncated_extension_is_routable():
+    frame = wire.encode_request(
+        3, wire.OP_ACQUIRE_H, "k", 1, 1.0, 1.0, hier=("t", 2.0, 1.0, 0))
+    with pytest.raises(wire.RemoteStoreError, match="tenant extension"):
+        wire.decode_hierarchical_request(frame[4:-4])
+
+
+def test_bulk_hier_tail_roundtrip_and_trace_compose():
+    keys = [b"a", b"bb", b"ccc"]
+    counts = [10, 0, 77]
+    trace = (11, 12, 13, 1)
+    frame = wire.encode_bulk_request(
+        5, keys, counts, 100.0, 1.0, kind=wire.BULK_KIND_HBUCKET,
+        hier=("tenant:x", 500.0, 9.0, 1), trace=trace)
+    body = frame[4:]
+    seq, dec_keys, dec_counts, a, b, with_rem, kind = (
+        wire.decode_bulk_request(body))
+    assert kind == wire.BULK_KIND_HBUCKET
+    assert dec_keys == ["a", "bb", "ccc"]
+    assert dec_counts.tolist() == counts
+    tenant, ta, tb, prio = wire.bulk_hier_tail(body)
+    assert (tenant, ta, tb, prio) == ("tenant:x", 500.0, 9.0, 1)
+    # The trace tail still parses from the end, extension untouched.
+    tctx = wire.bulk_trace_tail(body)
+    assert tuple(tctx) == trace
+    # The extension rides exactly the HBUCKET kind.
+    with pytest.raises(ValueError, match="HBUCKET"):
+        wire.encode_bulk_request(5, keys, counts, 1.0, 1.0,
+                                 hier=("t", 1.0, 1.0, 0))
+    with pytest.raises(ValueError, match="HBUCKET"):
+        wire.encode_bulk_request(5, keys, counts, 1.0, 1.0,
+                                 kind=wire.BULK_KIND_HBUCKET)
+
+
+def test_bulk_hier_tail_truncation_is_routable():
+    frame = wire.encode_bulk_request(
+        5, [b"k"], [1], 10.0, 1.0, kind=wire.BULK_KIND_HBUCKET,
+        hier=("tenant", 50.0, 1.0, 0))
+    with pytest.raises(wire.RemoteStoreError, match="tenant extension"):
+        wire.bulk_hier_tail(frame[4:-3])

@@ -33,6 +33,15 @@ extracts a wire model from each side and diffs them:
   sets is a deliberate-looking accident: nobody decided whether a
   retry after an ambiguous failure can double-apply it, and a future
   op silently defaults to whatever the author forgot to think about.
+- **Tenant-extension fallthrough** (``wire-hier``): the hierarchical
+  frames (``OP_ACQUIRE_H``, ``BULK_KIND_HBUCKET``) carry a tenant
+  extension the C parser does not speak, so they MUST reach the Python
+  lane: the bulk parser's ``kind > BULK_KIND_FWINDOW`` gate must exist,
+  the scalar switch must not case-list ``OP_ACQUIRE_H`` (a case there
+  would parse the frame as the flat keyed shape and silently drop the
+  tenant level), the HBUCKET kind value must sit above the C fast
+  lane's gate and inside the 2-bit kind field, and ``wire.py`` must
+  define the extension pieces (``_HIER_TAIL``) the rule is pinning.
 """
 
 from __future__ import annotations
@@ -339,6 +348,72 @@ def _layout_checks(py: PyWireModel, c: CWireModel, wire_rel: str,
     return findings
 
 
+def _hier_checks(py: PyWireModel, c: CWireModel, wire_rel: str,
+                 cc_rel: str) -> list[Finding]:
+    """``wire-hier``: pin the tenant extension's Python-lane
+    fallthrough (see module doc). The hierarchical frames are the one
+    wire surface the C side deliberately does NOT mirror — this rule
+    is what keeps that deliberate, not accidental."""
+    findings: list[Finding] = []
+    missing = [n for n in ("OP_ACQUIRE_H", "BULK_KIND_HBUCKET")
+               if n not in py.constants]
+    if "_HIER_TAIL" not in py.structs:
+        missing.append("_HIER_TAIL")
+    if missing:
+        return [Finding(
+            "wire-hier",
+            f"wire.py no longer defines {', '.join(missing)} — the "
+            "tenant-extension surface this rule pins is gone (remove "
+            "the rule only with the feature)",
+            wire_rel, 1, ((cc_rel, 1, "C fallthrough pinned here"),))]
+    hb, hb_line = py.constants["BULK_KIND_HBUCKET"]
+    fw = c.constants.get("BULK_KIND_FWINDOW")
+    if fw is not None and hb <= fw[0]:
+        findings.append(Finding(
+            "wire-hier",
+            f"BULK_KIND_HBUCKET = {hb} does not sit above the C bulk "
+            f"fast lane's kind gate (BULK_KIND_FWINDOW = {fw[0]}, "
+            f"{cc_rel}:{fw[1]}) — HBUCKET frames would parse as a flat "
+            "kind and silently drop the tenant level",
+            wire_rel, hb_line, ((cc_rel, fw[1], "C kind gate bound"),)))
+    mask = py.constants.get("_KIND_MASK")
+    shift = py.constants.get("_KIND_SHIFT")
+    if mask is not None and shift is not None \
+            and hb > (mask[0] >> shift[0]):
+        findings.append(Finding(
+            "wire-hier",
+            f"BULK_KIND_HBUCKET = {hb} does not fit the kind field "
+            f"(_KIND_MASK >> _KIND_SHIFT = {mask[0] >> shift[0]}) — "
+            "the flag bits cannot encode it",
+            wire_rel, hb_line, ((wire_rel, mask[1], "_KIND_MASK"),)))
+    m = re.search(r"kind\s*>\s*BULK_KIND_FWINDOW\s*\)\s*return false",
+                  c.text)
+    if m is None:
+        anchor = re.search(r"bool handle_bulk_frame", c.text)
+        at = c.line_of(anchor.start()) if anchor else 1
+        findings.append(Finding(
+            "wire-hier",
+            "handle_bulk_frame no longer routes kinds past "
+            "BULK_KIND_FWINDOW to the Python lane (`kind > "
+            "BULK_KIND_FWINDOW) return false` gate missing) — HBUCKET "
+            "frames would be misparsed in C instead of served by "
+            "wire.py", cc_rel, at,
+            ((wire_rel, hb_line, "BULK_KIND_HBUCKET defined here"),)))
+    m = re.search(r"case\s+OP_ACQUIRE_H\s*:", c.text)
+    if m is not None:
+        findings.append(Finding(
+            "wire-hier",
+            "frontend.cc case-lists OP_ACQUIRE_H in a switch — the C "
+            "parser does not speak the tenant extension, so the op "
+            "must stay on the default (passthrough) arm; a real C fast "
+            "path must mirror the full tenant tail layout first and "
+            "retire this rule deliberately",
+            cc_rel, c.line_of(m.start()),
+            ((wire_rel, py.constants["OP_ACQUIRE_H"][1],
+              "OP_ACQUIRE_H defined here"),)))
+    return findings
+
+
 # -- ctypes ABI cross-check -------------------------------------------------
 
 _PY_SYMBOL_RE = re.compile(r"^(fe_|dir_)\w+$")
@@ -554,6 +629,7 @@ def check_wire(wire_py: pathlib.Path, frontend_cc: pathlib.Path,
     findings = _diff_constants(py, c, wire_rel, cc_rel)
     findings += _check_endianness(py, wire_rel)
     findings += _layout_checks(py, c, wire_rel, cc_rel)
+    findings += _hier_checks(py, c, wire_rel, cc_rel)
     return findings
 
 
