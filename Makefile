@@ -66,10 +66,13 @@ llm-soak:
 
 # Native-vs-asyncio differential fuzz, verbosely (also part of tier-1):
 # reply-for-reply byte identity over randomized scalar AND bulk
-# (ACQUIRE_MANY) traffic, including traced/MOVED/retired-config frames.
+# (ACQUIRE_MANY) traffic, including traced/MOVED/retired-config frames,
+# plus the multi-shard arms (round 11: 4-shard server, same replies)
+# and the shard-ABI/envelope/retire-fan-out suite.
 parity-fuzz:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_parity_fuzz.py \
-	  tests/test_native_bulk.py -v -p no:cacheprovider
+	  tests/test_native_bulk.py tests/test_native_shards.py \
+	  -v -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).
 native:

@@ -641,13 +641,32 @@ def bench_serving_p99_cpu(timeout_s: float = 600.0,
 
 def _serving_server_child(backing_kind: str = "device",
                           native: bool = False,
-                          tier0: bool = False) -> None:
+                          tier0: bool = False,
+                          shards: int = 1,
+                          pin: bool = False) -> None:
     """Server half of the co-located stand-in: owns the (CPU-platform)
     device store and its kernel — or, for ``backing_kind="instant"``, the
     pure-Python ``InProcessBucketStore`` whose microsecond kernel makes
     the serving histogram a pure framework-overhead measurement. With
     ``native=True`` the sockets are served by the C++ epoll front-end
     (native/frontend.cc). Parks until the parent closes stdin."""
+    if pin:
+        # CPU discipline for the pinned multi-shard rig: the C shard
+        # threads get CPUs 0..N-1 EXCLUSIVELY (fe_start_sharded pins
+        # them there); every Python thread of this process — the
+        # asyncio loop that serves residue frames and runs the tier-0
+        # sync pump, and the per-shard pump threads — is herded onto
+        # the next few CPUs so neither the shards nor the load child
+        # can starve the reconciliation loop (a sync pump starved past
+        # max_stale_s fails SAFE — stale replicas stop deciding — but
+        # the resulting all-residue storm is exactly the regime the
+        # sweep must not measure by accident).
+        nproc = os.cpu_count() or 1
+        herd = set(range(shards, min(shards + 4, nproc))) or {0}
+        try:
+            os.sched_setaffinity(0, herd)
+        except OSError:
+            pass
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -673,10 +692,21 @@ def _serving_server_child(backing_kind: str = "device",
 
             # Tight sync cadence: the bench window is seconds long and the
             # hit-rate/overadmit gauges should reflect settled envelopes.
-            native_tier0 = Tier0Config(sync_interval_s=0.01)
+            # The pinned multi-shard rig also raises max_budget: at
+            # node-level demand (~2M permits/s/key) the 1M default gives
+            # each key <1s of envelope headroom, so any sync-round
+            # hiccup longer than that tips the whole keyspace into the
+            # all-residue regime (fail-safe but Python-speed — see
+            # docs/OPERATIONS.md §12). 16M ≈ 10s of headroom.
+            native_tier0 = (Tier0Config(sync_interval_s=0.01,
+                                        max_budget=float(1 << 24))
+                            if pin else
+                            Tier0Config(sync_interval_s=0.01))
         async with BucketStoreServer(backing,
                                      native_frontend=native,
-                                     native_tier0=native_tier0) as srv:
+                                     native_tier0=native_tier0,
+                                     native_shards=shards,
+                                     native_pin_shards=pin) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
             await asyncio.get_running_loop().run_in_executor(
@@ -924,6 +954,167 @@ def _serving_load_child(host: str, port: str) -> None:
         print(json.dumps(out), flush=True)
 
     asyncio.run(run())
+
+
+def _shard_load_child(host: str, port: str, shards: str) -> None:
+    """Load half of the multi-shard rig: 3 loadgen threads per shard,
+    each a C closed-loop bulk client (fe_lg_bulk — frames built and
+    replies counted in C, so the client bounds nothing) pinned AWAY
+    from the shard CPUs (the server child pins shard i to CPU i; an
+    unpinned client thread scheduled onto a shard CPU steals exactly
+    the core the measurement is charging). The kernel's SO_REUSEPORT
+    hash spreads each thread's 4 connections across shards. Reports
+    the aggregate rows/s over the threads' own windows plus the
+    server's merged and per-shard gauges."""
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        maybe_force_cpu_from_env,
+    )
+
+    maybe_force_cpu_from_env()
+    import threading
+
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        native_bulk_loadgen,
+    )
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+
+    n_shards = int(shards)
+    nproc = os.cpu_count() or 1
+    # Mirror of the server child's CPU discipline: shards own CPUs
+    # 0..N-1, the server's Python threads own the next 4 — clients take
+    # what's left (everything, when the box is too small to carve).
+    first = min(n_shards + 4, max(nproc - 2, 0))
+    client_cpus = (set(range(first, nproc))
+                   if first < nproc else set(range(nproc)))
+    n_threads = max(6, 4 * n_shards)
+
+    def one(out: list, warm: bool) -> None:
+        try:
+            os.sched_setaffinity(0, client_cpus)
+        except OSError:
+            pass  # restricted cpuset: measure unpinned
+        frames, rows, granted, el = native_bulk_loadgen(
+            host, int(port), conns=4, depth=2 if warm else 8,
+            frames_per_conn=10 if warm else 400,
+            rows_per_frame=1024 if warm else 4096, keyspace=64)
+        out.append((rows, granted, el))
+
+    async def run() -> None:
+        store = RemoteBucketStore(address=(host, int(port)))
+        # Warm: connects + installs the 64 hot keys' tier-0 replicas.
+        rows_out: list = []
+        th = [threading.Thread(target=one, args=(rows_out, True))
+              for _ in range(4)]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        await store.stats(reset=True)
+        best = 0.0
+        for _ in range(3):
+            rows_out = []
+            th = [threading.Thread(target=one, args=(rows_out, False))
+                  for _ in range(n_threads)]
+            for t in th:
+                t.start()
+            for t in th:
+                t.join()
+            best = max(best, sum(r / el for r, _g, el in rows_out))
+        stats = await store.stats()
+        out = {
+            "rows_per_s": best,
+            "shards": n_shards,
+            "load_threads": n_threads,
+            "p50_ms": stats["serving_p50_ms"],
+            "p99_ms": stats["serving_p99_ms"],
+        }
+        nb = stats.get("native_bulk")
+        if nb:
+            out["rows_local_frac"] = (nb["rows_local"]
+                                      / max(nb["rows"], 1))
+        if "tier0" in stats:
+            out["tier0_hit_rate"] = stats["tier0"]["hit_rate"]
+        per = stats.get("shards")
+        if per:
+            out["per_shard_rows"] = [r["native_bulk"]["rows"]
+                                     for r in per]
+        await store.aclose()
+        print(json.dumps(out), flush=True)
+
+    asyncio.run(run())
+
+
+def _shard_rig(shards: int, timeout_s: float) -> dict | None:
+    """One multi-shard measurement: an instant-backed native server
+    child with ``shards`` pinned epoll shards (tier-0 armed), driven by
+    a --shard-load-child (the bench_serving_p99_cpu child discipline)."""
+    import concurrent.futures
+    import subprocess
+
+    from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
+        FORCE_CPU_ENV,
+    )
+
+    env = os.environ.copy()
+    env[FORCE_CPU_ENV] = "1"
+    deadline = time.monotonic() + timeout_s
+    server = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--serving-server-child", "instant", "native", "tier0",
+         f"shards={shards}", "pin"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
+    pool = concurrent.futures.ThreadPoolExecutor(1)
+    try:
+        line = pool.submit(server.stdout.readline).result(
+            timeout=min(120.0, timeout_s))
+        addr = json.loads(line)
+        load = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--shard-load-child", addr["host"], str(addr["port"]),
+             str(shards)],
+            env=env, capture_output=True, text=True,
+            timeout=max(deadline - time.monotonic(), 30.0))
+        if load.returncode != 0:
+            return None
+        return json.loads(load.stdout.strip().splitlines()[-1])
+    except Exception:
+        return None
+    finally:
+        try:
+            server.stdin.close()
+            server.wait(timeout=10)
+        except Exception:
+            server.kill()
+        pool.shutdown(wait=False)
+
+
+def bench_native_shards(timeout_s: float = 600.0) -> dict | None:
+    """``serving_native_shards`` section: the multi-shard front-end's
+    node-level scaling curve (round 11). One native server per point,
+    shards ∈ {1, 2, 4, 8} pinned to CPUs 0..N-1, instant backing,
+    tier-0 armed, hot 64-key ACQUIRE_MANY workload from the C bulk
+    loadgen — rows/s through ONE port as a function of shard count.
+    The acceptance bound is s4 ≥ 3.5× s1 on the same machine; the
+    device-backed arm stays owed in benchmarks/recapture.py
+    (native_fe_shard_sweep) until a TPU window."""
+    out: dict = {}
+    budget = max(timeout_s / 4.5, 60.0)
+    for s in (1, 2, 4, 8):
+        res = _shard_rig(s, budget)
+        if res is None:
+            if s == 1:
+                return None  # nothing to normalize against
+            continue
+        out[f"s{s}"] = res
+    if "s1" in out and "s4" in out:
+        out["speedup_4v1"] = (out["s4"]["rows_per_s"]
+                              / out["s1"]["rows_per_s"])
+    if "s1" in out and "s8" in out:
+        out["speedup_8v1"] = (out["s8"]["rows_per_s"]
+                              / out["s1"]["rows_per_s"])
+    return out
 
 
 def bench_metrics_overhead() -> tuple[float, float, float, int,
@@ -1194,6 +1385,19 @@ RESULT: dict = {
     "serving_native_bulk_device_p99_ms": None,
     "serving_native_bulk_device_cold_rows_per_s": None,
     "serving_native_bulk_device_cold_p99_ms": None,
+    # Multi-shard native front-end (round 11): bulk rows/s through ONE
+    # port as a function of SO_REUSEPORT epoll shard count (pinned,
+    # instant backing, hot keyspace, tier-0 armed — the node-level
+    # scaling curve the 50M/s aggregate model multiplies). Acceptance:
+    # s4 >= 3.5x s1 on the same machine.
+    "serving_native_shards_rows_per_s_s1": None,
+    "serving_native_shards_rows_per_s_s2": None,
+    "serving_native_shards_rows_per_s_s4": None,
+    "serving_native_shards_rows_per_s_s8": None,
+    "serving_native_shards_speedup_4v1": None,
+    "serving_native_shards_speedup_8v1": None,
+    "serving_native_shards_p99_s4_ms": None,
+    "serving_native_shards_local_frac_s4": None,
     # Observability-plane cost audit: closed-loop per-request rate with
     # the plane (heavy hitters + flight recorder + /metrics listener +
     # stage stamps) enabled vs observability=False. Contract: <3%.
@@ -1573,6 +1777,36 @@ def main() -> int:
                 RESULT[f"{key}_p99_ms"] = round(dev["p99_ms"], 3)
         _emit()
 
+    def sec_native_shards():
+        out = bench_native_shards(timeout_s=min(600.0,
+                                                max(_remaining(), 60.0)))
+        if out is None:
+            raise RuntimeError("shard-sweep children failed or timed out")
+        return out
+
+    status, value = _section("serving_native_shards", sec_native_shards,
+                             timeout_s=620)
+    if status == "ok" and value is not None:
+        for s_n in (1, 2, 4, 8):
+            arm = value.get(f"s{s_n}")
+            if arm is not None:
+                RESULT[f"serving_native_shards_rows_per_s_s{s_n}"] = \
+                    round(arm["rows_per_s"])
+        if value.get("speedup_4v1") is not None:
+            RESULT["serving_native_shards_speedup_4v1"] = round(
+                value["speedup_4v1"], 2)
+        if value.get("speedup_8v1") is not None:
+            RESULT["serving_native_shards_speedup_8v1"] = round(
+                value["speedup_8v1"], 2)
+        s4 = value.get("s4")
+        if s4 is not None:
+            RESULT["serving_native_shards_p99_s4_ms"] = round(
+                s4["p99_ms"], 3)
+            if "rows_local_frac" in s4:
+                RESULT["serving_native_shards_local_frac_s4"] = round(
+                    s4["rows_local_frac"], 4)
+        _emit()
+
     def sec_metrics_overhead():
         (on_rate, off_rate, pct, scraped,
          trace_rate, trace_pct) = bench_metrics_overhead()
@@ -1616,8 +1850,18 @@ if __name__ == "__main__":
         i = sys.argv.index("--serving-server-child")
         kind = sys.argv[i + 1] if len(sys.argv) > i + 1 else "device"
         rest = sys.argv[i + 2:]
+        shards = 1
+        for arg in rest:
+            if arg.startswith("shards="):
+                shards = int(arg.split("=", 1)[1])
         _serving_server_child(kind, native="native" in rest,
-                              tier0="tier0" in rest)
+                              tier0="tier0" in rest, shards=shards,
+                              pin="pin" in rest)
+        sys.exit(0)
+    if "--shard-load-child" in sys.argv:
+        i = sys.argv.index("--shard-load-child")
+        _shard_load_child(sys.argv[i + 1], sys.argv[i + 2],
+                          sys.argv[i + 3])
         sys.exit(0)
     if "--native-load-child" in sys.argv:
         i = sys.argv.index("--native-load-child")

@@ -171,6 +171,59 @@ def _debt_llm_workload_device(smoke: bool) -> dict:
             "unit": "rows/s + tokens/s", "rows": n}
 
 
+def _debt_native_fe_shard_sweep(smoke: bool) -> dict:
+    """The multi-shard front-end (round 11) against a DEVICE-class
+    backing: shards ∈ {1, 2, 4, 8} SO_REUSEPORT epoll shards on one
+    port, tier-0 armed, driven by the C bulk loadgen — the node-level
+    rows/s curve whose CPU stand-in lives in
+    evidence/native_shards_r11.jsonl and BENCH serving_native_shards.
+    On a real device the residue rows meet a multi-ms flush, so the
+    device arm is the one that prices the shield, not just the shards."""
+    import concurrent.futures
+
+    env = os.environ.copy()
+    env.pop("DRL_TPU_FORCE_CPU", None)
+    if smoke:
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    out: dict = {}
+    for shards in (1, 2, 4, 8):
+        server = subprocess.Popen(
+            [sys.executable, str(_ROOT / "bench.py"),
+             "--serving-server-child", "device", "native", "tier0",
+             f"shards={shards}", "pin"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env, cwd=str(_ROOT))
+        pool = concurrent.futures.ThreadPoolExecutor(1)
+        try:
+            line = pool.submit(server.stdout.readline).result(
+                timeout=180.0)
+            addr = json.loads(line)
+            load = subprocess.run(
+                [sys.executable, str(_ROOT / "bench.py"),
+                 "--shard-load-child", addr["host"],
+                 str(addr["port"]), str(shards)],
+                capture_output=True, text=True, env=env,
+                cwd=str(_ROOT), timeout=600)
+            if load.returncode != 0:
+                raise RuntimeError(
+                    f"s{shards} load child failed: "
+                    f"{load.stderr.strip()[-400:]}")
+            out[f"s{shards}"] = json.loads(
+                load.stdout.strip().splitlines()[-1])
+        finally:
+            try:
+                server.stdin.close()
+                server.wait(30)
+            except Exception:
+                server.kill()
+            pool.shutdown(wait=False)
+    if "s1" in out and "s4" in out:
+        out["speedup_4v1"] = (out["s4"]["rows_per_s"]
+                              / out["s1"]["rows_per_s"])
+    return {"metric": "shard_sweep", "sweep": out,
+            "unit": "rows/s per shard count"}
+
+
 #: Ordered debt list: name → (what is owed, runner). The NAME is the
 #: ledger identity — renaming one un-retires it, deliberately.
 DEBTS: "list[tuple[str, str, object]]" = [
@@ -192,6 +245,12 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "number: the fused hierarchical kernel's rows/s + tokens/s rest "
      "on the CPU stand-in (benchmarks/llm_workload.py)",
      _debt_llm_workload_device),
+    ("native_fe_shard_sweep",
+     "the multi-shard front-end (round 11) has no device number: the "
+     "shards x {1,2,4,8} node-level curve rests on the CPU stand-in "
+     "(evidence/native_shards_r11.jsonl); the device arm prices the "
+     "residue path against a real multi-ms flush",
+     _debt_native_fe_shard_sweep),
 ]
 
 
@@ -273,6 +332,11 @@ def main(argv: "list[str] | None" = None) -> int:
                         "(smoke sizes; rows do not settle debts)")
     parser.add_argument("--force", action="store_true",
                         help="re-run debts that already have evidence")
+    parser.add_argument("--only", default=None, metavar="NAME",
+                        help="run just this debt (others stay owed "
+                        "untouched — e.g. appending one section's CPU "
+                        "stand-in row without burning a window on the "
+                        "rest)")
     parser.add_argument("--probe-s", type=float, default=float(
         os.environ.get("BENCH_PROBE_S", "240")))
     parser.add_argument("--section-timeout-s", type=float, default=900.0)
@@ -288,8 +352,18 @@ def main(argv: "list[str] | None" = None) -> int:
         return 0
 
     pending = owed(ledger) if not args.force else [n for n, _, _ in DEBTS]
+    if args.only is not None:
+        if args.only not in {n for n, _, _ in DEBTS}:
+            print(json.dumps({"status": "unknown_debt",
+                              "only": args.only,
+                              "known": [n for n, _, _ in DEBTS]}))
+            return 2
+        pending = [n for n in pending if n == args.only]
     results = {}
     for name, why, fn in DEBTS:
+        if args.only is not None and name != args.only:
+            results[name] = "skipped_only"
+            continue
         if name not in pending:
             results[name] = "already_settled"
             continue

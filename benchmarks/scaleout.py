@@ -50,9 +50,13 @@ import time
 # Child roles ---------------------------------------------------------------
 
 
-def _server_child() -> None:
+def _server_child(shards: int = 0) -> None:
     """One store-server process: CPU-platform device store (the serving
-    stand-in) or the real device, prints its address, parks on stdin."""
+    stand-in) or the real device, prints its address, parks on stdin.
+    ``shards > 0`` serves through the native multi-shard front-end
+    (round 11): N SO_REUSEPORT epoll shards + tier-0 per node, so the
+    scale-out curve can compose node counts from NODE-level (not
+    core-level) serving rates."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -67,7 +71,11 @@ def _server_child() -> None:
 
     async def run() -> None:
         backing = DeviceBucketStore(n_slots=1 << 18, max_batch=4096)
-        async with BucketStoreServer(backing) as srv:
+        kwargs = {}
+        if shards > 0:
+            kwargs = {"native_frontend": True, "native_tier0": True,
+                      "native_shards": shards}
+        async with BucketStoreServer(backing, **kwargs) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
             await asyncio.get_running_loop().run_in_executor(
@@ -124,7 +132,7 @@ def _client_child(addrs_json: str, seconds: str) -> None:
 
 def _measure(n_nodes: int, n_clients: int, seconds: float,
              backing: str, hosts: "list[list] | None" = None,
-             cores: int | None = None) -> dict:
+             cores: int | None = None, fe_shards: int = 0) -> dict:
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         FORCE_CPU_ENV,
     )
@@ -140,7 +148,7 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
     # External topology: the operator's already-running servers replace
     # the spawned localhost children; everything else is identical.
     servers = [] if hosts else [subprocess.Popen(
-        [sys.executable, me, "--server-child"], env=env,
+        [sys.executable, me, "--server-child", str(fe_shards)], env=env,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
         for _ in range(n_nodes)]
     pool = concurrent.futures.ThreadPoolExecutor(1)
@@ -180,6 +188,7 @@ def _measure(n_nodes: int, n_clients: int, seconds: float,
             "config": "scaleout",
             "n_nodes": n_nodes,
             "n_clients": n_clients,
+            "fe_shards": fe_shards or None,
             "backing": backing if not hosts else "external",
             # Clients start together and run identical closed-loop
             # windows, so the aggregate is the sum of per-client rates
@@ -216,10 +225,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--cores", type=int, default=None,
                    help="core count the rig actually owns (recorded in "
                    "the JSONL; default os.cpu_count())")
+    p.add_argument("--shards", type=int, default=None,
+                   help="serve each spawned node through the native "
+                   "multi-shard front-end with this many SO_REUSEPORT "
+                   "epoll shards (0/absent = the asyncio server): the "
+                   "node-level arm of the aggregate model — rows/s per "
+                   "NODE x node count, not per core")
     p.add_argument("--config", default=None,
                    help="JSON file supplying the same knobs (nodes, "
-                   "clients, seconds, backing, hosts, cores); CLI "
-                   "flags override it")
+                   "clients, seconds, backing, hosts, cores, shards); "
+                   "CLI flags override it")
     args = p.parse_args(argv)
     cfg: dict = {}
     if args.config:
@@ -235,19 +250,25 @@ def main(argv: list[str] | None = None) -> int:
     hosts = (args.hosts.split(",") if args.hosts
              else cfg.get("hosts") or None)
     cores = args.cores if args.cores is not None else cfg.get("cores")
+    fe_shards = (args.shards if args.shards is not None
+                 else int(cfg.get("shards", 0) or 0))
     if hosts:
         print(json.dumps(_measure(len(hosts), clients, seconds, backing,
                                   hosts=hosts, cores=cores)), flush=True)
         return 0
     for n in [int(x) for x in nodes]:
         print(json.dumps(_measure(n, clients, seconds, backing,
-                                  cores=cores)), flush=True)
+                                  cores=cores, fe_shards=fe_shards)),
+              flush=True)
     return 0
 
 
 if __name__ == "__main__":
     if "--server-child" in sys.argv:
-        _server_child()
+        i = sys.argv.index("--server-child")
+        shards = (int(sys.argv[i + 1])
+                  if len(sys.argv) > i + 1 else 0)
+        _server_child(shards)
         sys.exit(0)
     if "--client-child" in sys.argv:
         i = sys.argv.index("--client-child")

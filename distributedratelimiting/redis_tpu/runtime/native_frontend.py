@@ -50,7 +50,8 @@ from distributedratelimiting.redis_tpu.utils.metrics import (
 )
 from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
 
-__all__ = ["NativeFrontend", "Tier0Config", "native_loadgen"]
+__all__ = ["NativeFrontend", "Tier0Config", "native_loadgen",
+           "native_bulk_loadgen"]
 
 logger = logging.getLogger(__name__)
 
@@ -65,8 +66,10 @@ class Tier0Config:
     ``overadmit_epsilon(headroom_budget(capacity, ...),
     fill_rate, sync_interval_s)``."""
 
-    #: Replica table slots (rounded up to a power of two). Memory is
-    #: bounded: slots × (entry + key ≤ 256 B) ≈ 1.5 MB at the default.
+    #: Replica table slots PER SHARD SLICE (rounded up to a power of
+    #: two; any shard can see any key, so each slice is full-size).
+    #: Memory is bounded: shards × slots × (entry + key ≤ 256 B) —
+    #: ~1.5 MB per shard at the default.
     slots: int = 4096
     #: Fraction of the last-synced balance granted as local headroom.
     budget_fraction: float = 0.5
@@ -111,7 +114,8 @@ class NativeFrontend:
     def __init__(self, server, *, host: str, port: int,
                  max_batch: int = 4096, deadline_us: int = 300,
                  tier0: "Tier0Config | bool | None" = None,
-                 bulk: bool = True) -> None:
+                 bulk: bool = True, shards: int = 1,
+                 pin_shards: bool = False) -> None:
         lib = load_frontend_lib()
         if lib is None:
             raise RuntimeError(
@@ -133,20 +137,51 @@ class NativeFrontend:
             raise OSError(
                 f"native front-end cannot resolve {host!r} as IPv4: {exc}"
             ) from exc
-        self._h = lib.fe_start(numeric_host.encode(), port, max_batch,
-                               deadline_us,
-                               1 if server.auth_token is not None else 0)
+        # Multi-shard serving (round 11): N epoll shards accepting on
+        # SO_REUSEPORT listeners bound to ONE port, each with its own IO
+        # thread, connection table, and pump thread below; the tier-0
+        # replica table is partitioned by key hash so the node keeps ONE
+        # envelope (docs/DESIGN.md §16). A stale .so without the shard
+        # ABI serves single-shard — availability over scale, loudly.
+        shards = max(1, int(shards))
+        has_shards = getattr(lib, "has_shards", False)
+        if shards > 1 and not has_shards:
+            logger.warning(
+                "native front-end shards=%d requested but the loaded "
+                "binary predates the shard ABI; serving single-shard",
+                shards)
+            shards = 1
+        if has_shards:
+            self._h = lib.fe_start_sharded(
+                numeric_host.encode(), port, max_batch, deadline_us,
+                1 if server.auth_token is not None else 0, shards,
+                1 if pin_shards else 0)
+        else:
+            self._h = lib.fe_start(
+                numeric_host.encode(), port, max_batch, deadline_us,
+                1 if server.auth_token is not None else 0)
         if not self._h:
             raise OSError(f"native front-end failed to bind {host}:{port}")
         self.port = lib.fe_port(self._h)
         self.host = host
         self._stopping = False
-        # Per-connection tail task for chained ACQUIRE_MANY chunks — the
-        # same request-order contract the asyncio server keeps
-        # (server.py `bulk_tail`). Entries drop when their task is still
-        # the tail at completion, so the dict tracks active bulk conns
-        # only.
-        self._bulk_tails: dict[int, asyncio.Task] = {}
+        # Per-shard sub-handles: every fe_* call a pump or serve task
+        # makes goes through the shard handle its work arrived on (conn
+        # ids, batch ids, and bulk job ids are all per-shard).
+        if has_shards:
+            self.n_shards = int(lib.fe_shard_count(self._h))
+            self._shards = [int(lib.fe_shard(self._h, i))
+                            for i in range(self.n_shards)]
+        else:
+            self.n_shards = 1
+            self._shards = [self._h]
+        # Per-(shard, connection) tail task for chained ACQUIRE_MANY
+        # chunks — the same request-order contract the asyncio server
+        # keeps (server.py `bulk_tail`); a connection lives on one shard
+        # for its whole life, so the contract stays shard-local. Entries
+        # drop when their task is still the tail at completion, so the
+        # dict tracks active bulk conns only.
+        self._bulk_tails: dict[tuple[int, int], asyncio.Task] = {}
         # Loop tasks still holding the C handle: aclose must drain these
         # BETWEEN fe_stop (no new work) and fe_free (handle invalid) — a
         # straggler batch completing after fe_free would call
@@ -181,18 +216,30 @@ class NativeFrontend:
         self._hot_task: asyncio.Task | None = None
         if self._bulk_native:
             hh = getattr(server, "heavy_hitters", None)
+            # One call arms the lane on EVERY shard (the C side fans
+            # out) — a frame can never race a half-armed shard mix.
             lib.fe_bulk_configure(self._h, 1, 1, 1 if hh is not None
                                   else 0)
             if hh is not None:
                 # The bulk lane's keys never materialize in Python, so
                 # the C side aggregates per-frame top-K and this pump
                 # offers the survivors to the sketch (the scalar batch
-                # lane's offer_many discipline, re-hosted below the ABI).
+                # lane's offer_many discipline, re-hosted below the
+                # ABI). One pump drains every shard's ring, so the
+                # sketch keeps whole-node ranks.
                 self._hot_task = asyncio.get_running_loop().create_task(
                     self._hot_harvest_loop())
-        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
-                                      name="native-frontend-pump")
-        self._pump.start()
+        # One pump thread per shard: fe_wait and its batch/bulk/pt
+        # cursors are per-shard state, so each pump drives exactly one
+        # shard and the shards never contend a shared C queue.
+        self._pumps = [
+            threading.Thread(target=self._pump_loop, args=(sh,),
+                             daemon=True,
+                             name=f"native-frontend-pump-{i}")
+            for i, sh in enumerate(self._shards)
+        ]
+        for p in self._pumps:
+            p.start()
 
     def _tier0_setup(self, cfg: Tier0Config) -> None:
         if not getattr(self._lib, "has_tier0", False):
@@ -245,28 +292,31 @@ class NativeFrontend:
 
     # -- pump thread -------------------------------------------------------
 
-    def _pump_loop(self) -> None:
-        lib, h = self._lib, self._h
+    def _pump_loop(self, sh: int) -> None:
+        """One shard's pump: blocks in fe_wait (GIL released) on the
+        SHARD handle and dispatches that shard's work onto the loop."""
+        lib = self._lib
         while not self._stopping:
-            kind = lib.fe_wait(h, 200)
+            kind = lib.fe_wait(sh, 200)
             if kind == -1:
                 break
             try:
                 if kind == 1:
-                    self._dispatch_batch()
+                    self._dispatch_batch(sh)
                 elif kind == 2:
-                    self._dispatch_passthrough()
+                    self._dispatch_passthrough(sh)
                 elif kind == 3:
-                    self._dispatch_bulk()
+                    self._dispatch_bulk(sh)
             except Exception as exc:  # noqa: BLE001 — the pump is the one
-                # thread every connection depends on: it must survive any
-                # single bad batch/frame (the items get error replies via
-                # fe_fail where possible; the connections stay up).
+                # thread every connection on its shard depends on: it
+                # must survive any single bad batch/frame (the items get
+                # error replies via fe_fail where possible; the
+                # connections stay up).
                 log.error_evaluating_kernel(exc)
                 if kind == 1:
                     try:
-                        self._lib.fe_fail(self._h, self._lib.fe_batch_id(
-                            self._h), repr(exc)[:200].encode())
+                        self._lib.fe_fail(sh, self._lib.fe_batch_id(sh),
+                                          repr(exc)[:200].encode())
                     # the batch failure above was already logged;
                     # fe_fail itself dying adds nothing
                     # drl-check: ok(swallowed-exception)
@@ -275,15 +325,15 @@ class NativeFrontend:
                 elif kind == 3:
                     try:
                         self._lib.fe_bulk_fail(
-                            self._h, self._lib.fe_bulk_id(self._h),
+                            sh, self._lib.fe_bulk_id(sh),
                             repr(exc)[:200].encode())
                     # same posture as fe_fail above
                     # drl-check: ok(swallowed-exception)
                     except Exception:  # noqa: BLE001
                         pass
 
-    def _dispatch_batch(self) -> None:
-        lib, h = self._lib, self._h
+    def _dispatch_batch(self, sh: int) -> None:
+        lib, h = self._lib, sh
         bid = lib.fe_batch_id(h)
         n = lib.fe_batch_n(h)
         if n <= 0:
@@ -333,25 +383,25 @@ class NativeFrontend:
                 tr_par.ctypes.data_as(c.POINTER(c.c_uint64)),
                 tr_fl.ctypes.data_as(c.POINTER(c.c_uint8)))
             traces = (tr_hi, tr_lo, tr_par, tr_fl)
-        self._track(self._serve_batch(bid, keys, counts, ops, a_arr, b_arr,
-                                      traces, seqs, conn_ids))
+        self._track(self._serve_batch(sh, bid, keys, counts, ops, a_arr,
+                                      b_arr, traces, seqs, conn_ids))
 
-    def _dispatch_passthrough(self) -> None:
-        lib, h = self._lib, self._h
+    def _dispatch_passthrough(self, sh: int) -> None:
+        lib, h = self._lib, sh
         conn_id = lib.fe_pt_conn(h)
         ln = lib.fe_pt_len(h)
         buf = ctypes.create_string_buffer(max(ln, 1))
         lib.fe_pt_copy(h, buf)
         body = buf.raw[:ln]
-        self._track(self._serve_passthrough(int(conn_id), body))
+        self._track(self._serve_passthrough(sh, int(conn_id), body))
 
-    def _dispatch_bulk(self) -> None:
+    def _dispatch_bulk(self, sh: int) -> None:
         """Hand one bulk residue job to the loop. The key blob, offsets,
         counts, and residue arrays are ZERO-COPY views into the C-held
         job (the ``wire.KeyBlob`` → ``dir_resolve_batch`` lane on the
         Python side): valid until fe_bulk_complete/discard/fail erases
         the job, which only ``_serve_bulk`` does — after its last read."""
-        lib, h = self._lib, self._h
+        lib, h = self._lib, sh
         c = ctypes
         u = np.zeros(11, np.uint64)
         f = np.zeros(2, np.float64)
@@ -381,13 +431,13 @@ class NativeFrontend:
             tctx = tracing.TraceContext(int(u[7]), int(u[8]), int(u[9]),
                                         1 if int(u[10]) & 2 else 0)
         self._track(self._serve_bulk(
-            jid, int(u[1]), int(u[2]), int(u[3]), float(f[0]),
+            sh, jid, int(u[1]), int(u[2]), int(u[3]), float(f[0]),
             float(f[1]), wire.KeyBlob(blob, offsets), counts, residue,
             tctx))
 
     # -- loop-side serving -------------------------------------------------
 
-    async def _serve_batch(self, bid: int, keys: list[str],
+    async def _serve_batch(self, sh: int, bid: int, keys: list[str],
                            counts: np.ndarray, ops: np.ndarray,
                            a_arr: np.ndarray, b_arr: np.ndarray,
                            traces=None, seqs: np.ndarray | None = None,
@@ -580,7 +630,7 @@ class NativeFrontend:
                             # the store was never touched for this row,
                             # so the client's translated re-send is not
                             # a replay.
-                            self._send(int(conn_ids[i]),
+                            self._send(sh, int(conn_ids[i]),
                                        wire.encode_response(
                                            int(seqs[i]), wire.RESP_ERROR,
                                            lc.moved(pk[0], pk[1], pk[2],
@@ -609,7 +659,8 @@ class NativeFrontend:
                         # transient error the asyncio lane does so the
                         # caller retries after the window.
                         ps.handoff_deferrals += 1
-                        self._send(int(conn_ids[i]), wire.encode_response(
+                        self._send(sh, int(conn_ids[i]),
+                                   wire.encode_response(
                             int(seqs[i]), wire.RESP_ERROR,
                             f"{placement.HANDOFF_DEFERRAL_PREFIX} for "
                             f"this key (target epoch "
@@ -622,7 +673,8 @@ class NativeFrontend:
                     for i in np.nonzero(pgate[2])[0].tolist():
                         if g_full[i] == _ROW_SKIP:
                             continue  # already answered config-moved
-                        self._send(int(conn_ids[i]), wire.encode_response(
+                        self._send(sh, int(conn_ids[i]),
+                                   wire.encode_response(
                             int(seqs[i]), wire.RESP_ERROR,
                             ps.moved_message(
                                 keys[i],
@@ -633,7 +685,7 @@ class NativeFrontend:
                 self._record_batch_spans(traces, granted, ops, t_start)
             c = ctypes
             self._lib.fe_complete(
-                self._h, bid,
+                sh, bid,
                 np.ascontiguousarray(granted).ctypes.data_as(
                     c.POINTER(c.c_uint8)),
                 np.ascontiguousarray(remaining).ctypes.data_as(
@@ -647,7 +699,7 @@ class NativeFrontend:
                 self._record_batch_spans(
                     traces, None, ops if full is None else full[3],
                     t_start)
-            self._lib.fe_fail(self._h, bid, repr(exc)[:200].encode())
+            self._lib.fe_fail(sh, bid, repr(exc)[:200].encode())
 
     def _record_batch_spans(self, traces, granted, ops: np.ndarray,
                             t_start: float) -> None:
@@ -679,8 +731,8 @@ class NativeFrontend:
                 "fe.batch", ctx, t_start, t_end, status=status,
                 attrs={"op": wire.op_name(int(ops[i]))})
 
-    async def _serve_bulk(self, jid: int, conn_id: int, seq: int,
-                          flags: int, a: float, b: float,
+    async def _serve_bulk(self, sh: int, jid: int, conn_id: int,
+                          seq: int, flags: int, a: float, b: float,
                           keys: "wire.KeyBlob", counts: np.ndarray,
                           residue: np.ndarray, tctx=None) -> None:
         """Loop half of the native bulk lane: decide the residue rows
@@ -695,7 +747,7 @@ class NativeFrontend:
         — the documented ≤-one-interval epsilon family, same as the
         scalar lanes' commit races."""
         srv = self._server
-        lib, h = self._lib, self._h
+        lib, h = self._lib, sh
         n = len(keys)
         try:
             # wire.py stays the single layout authority for the flags
@@ -712,7 +764,7 @@ class NativeFrontend:
                     # error, byte-identical to the asyncio gate (no
                     # residue row was applied, so the translated
                     # re-send is not a replay).
-                    self._send(conn_id, wire.encode_response(
+                    self._send(sh, conn_id, wire.encode_response(
                         seq, wire.RESP_ERROR,
                         lc.moved(ckind, a, b, fwd)))
                     lib.fe_bulk_discard(h, jid)
@@ -721,7 +773,7 @@ class NativeFrontend:
             if env is not None:
                 resp = srv._serve_bulk_draining(seq, keys, counts, a, b,
                                                 with_rem, kind, env)
-                self._send(conn_id, resp)
+                self._send(sh, conn_id, resp)
                 lib.fe_bulk_discard(h, jid)
                 return
             gate = (srv.placement.bulk_gate(keys)
@@ -732,7 +784,7 @@ class NativeFrontend:
                 # trigger; no row was applied).
                 i = int(np.nonzero(gate[2])[0][0])
                 key = keys[i]
-                self._send(conn_id, wire.encode_response(
+                self._send(sh, conn_id, wire.encode_response(
                     seq, wire.RESP_ERROR, srv.placement.moved_message(
                         key, int(srv.placement.pmap.node_of(key)))))
                 lib.fe_bulk_discard(h, jid)
@@ -814,51 +866,56 @@ class NativeFrontend:
             fixed=(kind == wire.BULK_KIND_FWINDOW),
             with_remaining=with_rem)
 
-    async def _serve_passthrough(self, conn_id: int, body: bytes) -> None:
+    async def _serve_passthrough(self, sh: int, conn_id: int,
+                                 body: bytes) -> None:
         try:
             op = body[5] if len(body) >= 6 else 0
             if op == wire.OP_HELLO:
-                await self._serve_hello(conn_id, body)
+                await self._serve_hello(sh, conn_id, body)
                 return
             if op != wire.OP_ACQUIRE_MANY:
-                await self._serve_passthrough_inner(conn_id, body)
+                await self._serve_passthrough_inner(sh, conn_id, body)
                 return
             # Only MALFORMED bulk frames (or a disabled/stale bulk lane)
             # reach this path since round 8 — well-formed ones are
             # native. They still run as their own tasks so a long store
             # call can't stall the pump's other passthrough work;
-            # chained chunks order behind the connection's tail.
-            prev = (self._bulk_tails.get(conn_id)
+            # chained chunks order behind the connection's tail (conn
+            # ids are per-shard, so the tail key carries the shard).
+            prev = (self._bulk_tails.get((sh, conn_id))
                     if wire.bulk_request_chained(body) else None)
             task = self._track_task(
-                self._serve_passthrough_inner(conn_id, body, after=prev))
-            self._bulk_tails[conn_id] = task
+                self._serve_passthrough_inner(sh, conn_id, body,
+                                              after=prev))
+            self._bulk_tails[(sh, conn_id)] = task
 
-            def _clear(t, cid=conn_id):
-                if self._bulk_tails.get(cid) is t:
-                    del self._bulk_tails[cid]
+            def _clear(t, key=(sh, conn_id)):
+                if self._bulk_tails.get(key) is t:
+                    del self._bulk_tails[key]
 
             task.add_done_callback(_clear)
         except Exception as exc:  # noqa: BLE001
             log.error_evaluating_kernel(exc)
 
-    async def _serve_passthrough_inner(self, conn_id: int, body: bytes,
+    async def _serve_passthrough_inner(self, sh: int, conn_id: int,
+                                       body: bytes,
                                        after: "asyncio.Task | None" = None
                                        ) -> None:
         if after is not None:
             await asyncio.gather(after, return_exceptions=True)
         resp = await self._server.handle_frame_body(body)
-        self._send(conn_id, resp)
+        self._send(sh, conn_id, resp)
 
-    async def _serve_hello(self, conn_id: int, body: bytes) -> None:
+    async def _serve_hello(self, sh: int, conn_id: int,
+                           body: bytes) -> None:
         import hmac
 
         try:
             seq, _, token, _, _, _ = wire.decode_request(body)
         except Exception:
-            self._send(conn_id, wire.encode_response(
+            self._send(sh, conn_id, wire.encode_response(
                 0, wire.RESP_ERROR, "malformed HELLO frame"))
-            self._lib.fe_close_conn(self._h, conn_id)
+            self._lib.fe_close_conn(sh, conn_id)
             return
         auth_token = self._server.auth_token
         # surrogateescape mirrors the wire decode: a token with invalid
@@ -867,15 +924,15 @@ class NativeFrontend:
         if auth_token is not None and not hmac.compare_digest(
                 token.encode("utf-8", "surrogateescape"),
                 auth_token.encode()):
-            self._send(conn_id, wire.encode_response(
+            self._send(sh, conn_id, wire.encode_response(
                 seq, wire.RESP_ERROR, "authentication failed"))
-            self._lib.fe_close_conn(self._h, conn_id)
+            self._lib.fe_close_conn(sh, conn_id)
             return
-        self._lib.fe_set_authed(self._h, conn_id, 1)
-        self._send(conn_id, wire.encode_response(seq, wire.RESP_EMPTY))
+        self._lib.fe_set_authed(sh, conn_id, 1)
+        self._send(sh, conn_id, wire.encode_response(seq, wire.RESP_EMPTY))
 
-    def _send(self, conn_id: int, resp: bytes) -> None:
-        self._lib.fe_send(self._h, conn_id, resp, len(resp))
+    def _send(self, sh: int, conn_id: int, resp: bytes) -> None:
+        self._lib.fe_send(sh, conn_id, resp, len(resp))
 
     # -- tier-0 sync pump --------------------------------------------------
 
@@ -900,8 +957,15 @@ class NativeFrontend:
         used = ctypes.string_at(blob, int(klens[:n].sum()))
         keys = wire.decode_key_blob(used, klens[:n],
                                     errors="surrogateescape")
-        return {(k, float(caps[i]), float(rates[i])): float(amounts[i])
-                for i, k in enumerate(keys)}
+        # SUM duplicate idents: with N shards each hosts its own replica
+        # slice, so one harvest can return the same key once per shard —
+        # the merged amount is the node's whole drained grant, debited
+        # once and acked back into every slice (fe_t0_ack fans out).
+        out: dict[tuple[str, float, float], float] = {}
+        for i, k in enumerate(keys):
+            ident = (k, float(caps[i]), float(rates[i]))
+            out[ident] = out.get(ident, 0.0) + float(amounts[i])
+        return out
 
     def _t0_retire(self, cap: float, rate: float
                    ) -> list[tuple[str, float]]:
@@ -1195,6 +1259,53 @@ class NativeFrontend:
             **self.t0_metrics.snapshot(time.monotonic()),
         }
 
+    def shard_stats(self) -> "list[dict] | None":
+        """Per-shard breakdown of the serving / tier-0 / bulk gauges
+        (``None`` single-shard — the merged top-level gauges already
+        tell the whole story there). Every row reads through that
+        shard's sub-handle; the top-level OP_STATS gauges stay the
+        whole-node SUM the C side computes over the same state, so
+        ``sum(shards[*].x) == merged x`` is an invariant the tests
+        pin. Tier-0 rows read the shard's own replica SLICE (each
+        shard hosts its own replicas with a split budget share —
+        docs/DESIGN.md §16), so `entries` counts replicas, not
+        distinct keys."""
+        if self.n_shards <= 1:
+            return None
+        c = ctypes
+        out: list[dict] = []
+        for i, sh in enumerate(self._shards):
+            req = c.c_longlong()
+            conns = c.c_longlong()
+            batches = c.c_longlong()
+            self._lib.fe_counts(sh, c.byref(req), c.byref(conns),
+                                c.byref(batches))
+            row: dict = {
+                "shard": i,
+                "requests_served": req.value,
+                "connections_served": conns.value,
+                "batches_flushed": batches.value,
+            }
+            if self._tier0 is not None:
+                t0 = (c.c_longlong * 6)()
+                self._lib.fe_t0_counts(sh, t0)
+                row["tier0"] = {
+                    "hits": int(t0[0]), "local_denies": int(t0[1]),
+                    "misses": int(t0[2]), "installs": int(t0[3]),
+                    "evictions": int(t0[4]), "entries": int(t0[5]),
+                }
+            if self._bulk_native:
+                bk = (c.c_longlong * 7)()
+                self._lib.fe_bulk_counts(sh, bk)
+                row["native_bulk"] = {
+                    "frames": int(bk[0]), "frames_local": int(bk[1]),
+                    "rows": int(bk[2]), "rows_local": int(bk[3]),
+                    "rows_residue": int(bk[4]),
+                    "permits_local": int(bk[5]),
+                }
+            out.append(row)
+        return out
+
     # -- stats / lifecycle -------------------------------------------------
 
     def counts(self) -> tuple[int, int, int]:
@@ -1286,15 +1397,16 @@ class NativeFrontend:
                 pass
             self._hot_task = None
         await asyncio.to_thread(self._lib.fe_stop, self._h)
-        await asyncio.to_thread(self._pump.join, 5.0)
+        for pump in self._pumps:
+            await asyncio.to_thread(pump.join, 5.0)
         while self._loop_tasks:
             # Loop, not a one-shot gather: a bulk passthrough parent can
             # spawn its _serve_passthrough_inner child AFTER the snapshot
             # was taken — the child also holds the handle.
             await asyncio.gather(*list(self._loop_tasks),
                                  return_exceptions=True)
-        if self._pump.is_alive():
-            # The pump blew past the join timeout: it may still be inside
+        if any(pump.is_alive() for pump in self._pumps):
+            # A pump blew past the join timeout: it may still be inside
             # fe_wait/fe_batch_copy holding the handle. Freeing now would
             # be a use-after-free on its next C call — leak the handle
             # (one struct + sockets already closed) and say so instead.
@@ -1346,3 +1458,35 @@ def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
     if rc != 0:
         raise OSError("native loadgen failed to connect")
     return replies.value, granted.value, elapsed.value
+
+
+def native_bulk_loadgen(host: str, port: int, *, conns: int = 8,
+                        depth: int = 4, frames_per_conn: int = 200,
+                        rows_per_frame: int = 4096, keyspace: int = 64,
+                        capacity: float = 1e8, fill_rate: float = 1e8
+                        ) -> tuple[int, int, int, float]:
+    """Closed-loop native BULK measurement client: ``conns`` connections
+    each keeping ``depth`` pipelined OP_ACQUIRE_MANY frames of
+    ``rows_per_frame`` rows in flight, frames built and replies counted
+    in C (``fe_lg_bulk``). Returns ``(frames, rows, granted_rows,
+    elapsed_s)``. This is the shard-sweep rig's client: at multi-shard
+    bulk rates even a per-frame Python client bounds the node, and the
+    kernel's SO_REUSEPORT hash spreads the ``conns`` across shards.
+    Requires a front-end binary with the shard ABI."""
+    lib = load_frontend_lib()
+    if lib is None or not getattr(lib, "has_shards", False):
+        raise RuntimeError(
+            "native bulk loadgen unavailable (library missing or "
+            "predates the fe_lg_bulk ABI)")
+    c = ctypes
+    elapsed = c.c_double()
+    frames = c.c_longlong()
+    rows = c.c_longlong()
+    granted = c.c_longlong()
+    rc = lib.fe_lg_bulk(host.encode(), port, conns, depth,
+                        frames_per_conn, rows_per_frame, keyspace,
+                        capacity, fill_rate, c.byref(elapsed),
+                        c.byref(frames), c.byref(rows), c.byref(granted))
+    if rc != 0:
+        raise OSError("native bulk loadgen failed to connect")
+    return frames.value, rows.value, granted.value, elapsed.value

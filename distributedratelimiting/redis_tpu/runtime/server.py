@@ -99,6 +99,8 @@ class BucketStoreServer:
                  native_deadline_us: int = 300,
                  native_tier0=False,
                  native_bulk: bool = True,
+                 native_shards: int = 1,
+                 native_pin_shards: bool = False,
                  metrics_port: int | None = None,
                  observability: bool = True,
                  heavy_hitters_k: int = 64,
@@ -138,6 +140,14 @@ class BucketStoreServer:
         # encode RESP_BULK in C — only cold-row residue reaches Python.
         # Default on; --no-fe-bulk restores the round-7 passthrough.
         self.native_bulk = native_bulk
+        # Multi-shard native serving (round 11): N epoll shards accept
+        # on SO_REUSEPORT listeners bound to one port — node-level
+        # scaling for the C front-end (docs/OPERATIONS.md §12). Shard
+        # count 1 keeps the single-listener posture bit for bit.
+        if native_shards < 1:
+            raise ValueError("native_shards must be >= 1")
+        self.native_shards = native_shards
+        self.native_pin_shards = native_pin_shards
         self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
@@ -252,7 +262,9 @@ class BucketStoreServer:
                     max_batch=self.native_max_batch,
                     deadline_us=self.native_deadline_us,
                     tier0=self.native_tier0,
-                    bulk=self.native_bulk)
+                    bulk=self.native_bulk,
+                    shards=self.native_shards,
+                    pin_shards=self.native_pin_shards)
             except RuntimeError as exc:
                 # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
                 # serve anyway on the asyncio path — availability over
@@ -403,6 +415,10 @@ class BucketStoreServer:
                     "Requests dropped unexecuted: client deadline "
                     "expired in server queueing",
                     lambda: self.requests_shed)
+        reg.gauge("native_fe_shards", "native front-end epoll shard "
+                  "count (0 = asyncio path)",
+                  lambda: (float(self._native.n_shards)
+                           if self._native is not None else 0.0))
         reg.gauge("native_frontend", "1 when the C front-end owns the "
                   "sockets", lambda: 1.0 if self._native is not None
                   else 0.0)
@@ -1442,6 +1458,13 @@ class BucketStoreServer:
             bulk = self._native.bulk_stats()
             if bulk is not None:
                 payload["native_bulk"] = bulk
+            shards = self._native.shard_stats()
+            if shards is not None:
+                # Per-shard breakdown beside the merged gauges above
+                # (which stay the whole-node sums — the invariant
+                # sum(shards[*].x) == merged x is test-pinned).
+                payload["fe_shards"] = len(shards)
+                payload["shards"] = shards
         else:
             payload = {
                 "connections_served": self.connections_served,
@@ -1621,6 +1644,17 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--fe-tier0-fraction", type=float, default=0.5,
                         help="tier-0: fraction of the last-synced "
                         "balance granted as local headroom")
+    parser.add_argument("--fe-shards", type=int, default=1,
+                        help="native front-end: number of epoll shards "
+                        "accepting on SO_REUSEPORT listeners bound to "
+                        "the one port (kernel-level accept balancing). "
+                        "1 = the single-listener posture; dozens-of-"
+                        "cores nodes want one shard per serving core "
+                        "(docs/OPERATIONS.md §12)")
+    parser.add_argument("--fe-pin-shards", action="store_true",
+                        help="native front-end: pin shard i's IO thread "
+                        "to CPU i mod nproc (combine with numactl/"
+                        "taskset for NUMA placement)")
     parser.add_argument("--no-fe-bulk", action="store_true",
                         help="disable the native bulk lane: "
                         "OP_ACQUIRE_MANY frames fall back to the Python "
@@ -1662,6 +1696,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.fe_tier0 and not args.native_frontend:
         parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
                      "admission cache lives inside the C front-end)")
+    if args.fe_shards != 1 and not args.native_frontend:
+        parser.error("--fe-shards requires --native-frontend (the epoll "
+                     "shards ARE the C front-end)")
     if args.snapshot_incremental and not args.snapshot_path:
         parser.error("--snapshot-incremental requires --snapshot-path "
                      "(there is no chain without a base file)")
@@ -1740,6 +1777,8 @@ def main(argv: list[str] | None = None) -> None:
                                    native_deadline_us=args.fe_deadline_us,
                                    native_tier0=native_tier0,
                                    native_bulk=not args.no_fe_bulk,
+                                   native_shards=args.fe_shards,
+                                   native_pin_shards=args.fe_pin_shards,
                                    metrics_port=args.metrics_port,
                                    observability=not args.no_observability,
                                    flight_dir=args.flight_dir,

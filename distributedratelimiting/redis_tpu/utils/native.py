@@ -36,6 +36,15 @@ _REPO_NATIVE = pathlib.Path(__file__).resolve().parents[3] / "native"
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
+#: Serializes first-load across threads. The load generators are run
+#: from worker THREADS (the multi-shard bench rig starts several at
+#: once); without the lock, racing first callers see ``_TRIED`` set by
+#: a loader still mid-build and return ``None`` for a library that is
+#: about to exist.
+import threading as _threading
+
+_LOAD_LOCK = _threading.Lock()
+
 #: Sanitizer opt-in (the `make asan-test` / `make tsan-test` env hook):
 #: value selects the instrumented build directory and flag set ("asan"
 #: or legacy "1" → build/asan, "tsan" → build/tsan). -O1 keeps stack
@@ -203,8 +212,20 @@ def load_directory_lib() -> ctypes.CDLL | None:
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
-    _TRIED = True
+    with _LOAD_LOCK:
+        if _TRIED:
+            return _LIB
+        return _load_directory_locked()
+
+
+def _load_directory_locked() -> ctypes.CDLL | None:
+    # _TRIED is published LAST (see the tail): the unlocked fast path in
+    # load_directory_lib reads it before taking the lock, so setting it
+    # before the build/load completes would hand concurrent first
+    # callers a permanent None for a library that is about to exist.
+    global _LIB, _TRIED
     if os.environ.get("DRL_TPU_NO_NATIVE"):
+        _TRIED = True
         return None
     src = _REPO_NATIVE / "directory.cc"
     out = _out_path("_directory.so")
@@ -220,6 +241,8 @@ def load_directory_lib() -> ctypes.CDLL | None:
         _LIB = _bind(ctypes.PyDLL(str(out)))
     except Exception:
         _LIB = None
+    finally:
+        _TRIED = True
     return _LIB
 
 
@@ -306,6 +329,30 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.fe_stop.restype = None
     lib.fe_free.argtypes = [c.c_void_p]
     lib.fe_free.restype = None
+    try:
+        # Round 11 (multi-shard front-end): N epoll shards accepting on
+        # SO_REUSEPORT listeners bound to one port. fe_shard hands out
+        # per-shard sub-handles every fe_* entry accepts; stats/harvest
+        # calls aggregate across shards for the Frontend handle and
+        # slice per shard for a sub-handle. A stale binary without
+        # these exports serves single-shard (has_shards gates it).
+        lib.fe_start_sharded.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                         c.c_int, c.c_int, c.c_int,
+                                         c.c_int]
+        lib.fe_start_sharded.restype = c.c_void_p
+        lib.fe_shard_count.argtypes = [c.c_void_p]
+        lib.fe_shard_count.restype = c.c_int
+        lib.fe_shard.argtypes = [c.c_void_p, c.c_int]
+        lib.fe_shard.restype = c.c_void_p
+        lib.fe_lg_bulk.argtypes = [
+            c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+            c.c_int, c.c_double, c.c_double, c.POINTER(c.c_double),
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+            c.POINTER(c.c_longlong)]
+        lib.fe_lg_bulk.restype = c.c_int
+        lib.has_shards = True
+    except AttributeError:  # stale binary without the shard ABI
+        lib.has_shards = False
     lib.fe_loadgen.argtypes = [
         c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
         c.c_double, c.c_int, c.POINTER(c.c_double),
@@ -386,8 +433,17 @@ def load_frontend_lib() -> ctypes.CDLL | None:
     global _FE_LIB, _FE_TRIED
     if _FE_TRIED:
         return _FE_LIB
-    _FE_TRIED = True
+    with _LOAD_LOCK:
+        if _FE_TRIED:
+            return _FE_LIB
+        return _load_frontend_locked()
+
+
+def _load_frontend_locked() -> ctypes.CDLL | None:
+    # Same publication order as _load_directory_locked: _FE_TRIED last.
+    global _FE_LIB, _FE_TRIED
     if os.environ.get("DRL_TPU_NO_NATIVE"):
+        _FE_TRIED = True
         return None
     src = _REPO_NATIVE / "frontend.cc"
     out = _out_path("_frontend.so")
@@ -400,4 +456,6 @@ def load_frontend_lib() -> ctypes.CDLL | None:
         _FE_LIB = _bind_frontend(ctypes.CDLL(str(out)))
     except Exception:
         _FE_LIB = None
+    finally:
+        _FE_TRIED = True
     return _FE_LIB

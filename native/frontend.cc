@@ -39,6 +39,8 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
+#include <sched.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
@@ -421,8 +423,8 @@ struct T0Entry {
 };
 
 struct T0Config {
-  bool enabled = false;
-  size_t mask = 0;                // slots - 1 (power of two)
+  size_t mask = 0;                // per-slice slots - 1 (power of two)
+  double split = 1.0;             // shard count: per-shard budget divisor
   double fraction = 0.5;          // budget = floor(balance * fraction)
   double min_budget = 64.0;       // below this, not worth hosting locally
   double max_budget = 1048576.0;
@@ -430,10 +432,81 @@ struct T0Config {
   uint64_t ttl_ns = 0;            // idle eviction
 };
 
+// Tier-0 partition lock: a TTAS spinlock, not a pthread mutex. The
+// partition critical sections are sub-microsecond (a probe plus a few
+// arithmetic ops; one aggregate update per key per bulk frame), and
+// with N shard threads crossing partitions every frame the futex
+// block/wake syscalls of a contended pthread mutex cost more than the
+// work they guard (measured ~20% of 4-shard throughput). Spinners
+// pause, then yield after a bound — the sync pump can hold a
+// partition for tens of microseconds while harvesting, and a
+// preempted holder must not burn the shard CPUs. Acquire/release
+// atomics keep TSan's happens-before modeling exact.
+class T0SpinMutex {
+ public:
+  void lock() {
+    int spins = 0;
+    while (flag_.exchange(1, std::memory_order_acquire) != 0) {
+      do {
+        if (++spins > 2048) {
+          sched_yield();
+          spins = 0;
+        }
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      } while (flag_.load(std::memory_order_relaxed) != 0);
+    }
+  }
+  void unlock() { flag_.store(0, std::memory_order_release); }
+  bool try_lock() {
+    return flag_.exchange(1, std::memory_order_acquire) == 0;
+  }
+
+ private:
+  std::atomic<int> flag_{0};
+};
+
+// One SHARD's tier-0 replica slice (round 11: the multi-shard
+// front-end). Each shard hosts its own replicas of the keys it serves
+// and decides them against a budget DIVIDED by the shard count —
+// t0_budget_of clamps to max_budget first and divides after, so the
+// summed headroom across shards for any key never exceeds the flat
+// single-shard budget: Σ_s floor(min(fraction·avail_s, max_budget)/N)
+// ≤ min(fraction·avail, max_budget). One envelope, one epsilon — the
+// same overadmit_epsilon(budget, fill, sync) bound as single-shard
+// (docs/DESIGN.md §16 carries the inequality). The alternative — one
+// replica per key in a key-hash-partitioned shared table — was built
+// first and REJECTED on measurement: every frame then writes every hot
+// key's entry from every shard, and the cross-core line transfers plus
+// partition-lock handoffs cost ~25% of 4-shard throughput; per-shard
+// slices make the hot path touch exclusively shard-local memory, which
+// is where the node-level scaling actually comes from. The slice lock
+// is only ever contended by the ONE sync pump's harvest/ack/retire
+// (brief, ~100 Hz), never by another shard. Lock order: shard
+// connection mutex → slice mutex; the sync pump takes slice mutexes
+// only.
+struct T0Part {
+  T0SpinMutex mu;
+  T0Config cfg;               // per-partition copy, read/written under mu
+  std::vector<T0Entry> tab;
+  size_t scan = 0;            // harvest resume cursor (fairness)
+  int64_t hits = 0;           // local grants
+  int64_t local_denies = 0;   // confident local denies
+  int64_t misses = 0;         // eligible requests that fell through
+  int64_t installs = 0;
+  int64_t evictions = 0;
+};
+
 // Linear-probe window and the key-size cap that bounds table memory
 // (slots × (entry + key) — ~1.5 MB at the 4096-slot default).
 constexpr size_t kT0Probe = 8;
 constexpr size_t kT0MaxKey = 256;
+
+// Shard-count ceiling (fe_start_sharded clamps to it): bounds the
+// per-frame touched[] scratch below, and a node with more epoll
+// shards than this has no cores to feed them anyway.
+constexpr int kMaxShards = 128;
 
 uint64_t t0_hash(std::string_view k) {
   uint64_t h = 1469598103934665603ull;  // FNV-1a 64
@@ -447,18 +520,47 @@ uint64_t t0_hash(std::string_view k) {
 double t0_budget_of(const T0Config& cfg, double avail) {
   double b = avail * cfg.fraction;
   if (b > cfg.max_budget) b = cfg.max_budget;
+  // Multi-shard split AFTER the max_budget clamp: the per-shard shares
+  // then sum to ≤ the flat single-shard budget whatever the balance,
+  // which is the whole single-envelope invariant (see T0Part). The
+  // min_budget gate applies to the POST-split share — a bucket whose
+  // per-shard share is not worth hosting stays exact, so tier-0's
+  // semantic invisibility to small buckets only widens with shards.
+  b /= cfg.split;
   if (b < cfg.min_budget) return 0.0;
   return std::floor(b);
 }
 
-struct Frontend {
+struct Frontend;
+
+// Handle tags: every ABI entry takes a void* that is either the whole
+// Frontend (aggregate view / shard 0 for per-shard calls — the
+// single-shard compatibility posture a stale Python half relies on) or
+// one Shard (returned by fe_shard). Both structs lead with a magic so
+// the entry points can tell which they were handed.
+constexpr uint32_t kFeMagic = 0xFE11D311u;
+constexpr uint32_t kShardMagic = 0x5AAD0011u;
+
+// One epoll serving shard (round 11): its own SO_REUSEPORT listener on
+// the shared port (kernel-level accept balancing — no dispatch thread),
+// its own IO thread, connection table, micro-batch queues, bulk lane,
+// stats, and rings, all under its own mutex. A connection lives its
+// whole life on one shard, so the per-connection order contract and the
+// chained-chunk parking (round 8) carry over shard-locally, unchanged.
+// The hot path touches NO cross-shard state: tier-0 decisions draw
+// from the shard's own replica slice (see T0Part above), and only the
+// sync pump's harvest/ack/retire ever crosses shards.
+struct Shard {
+  uint32_t magic = kShardMagic;
+  Frontend* owner = nullptr;
+  int index = 0;
   int listen_fd = -1, epfd = -1, evfd = -1, tfd = -1;
-  int port = 0;
-  size_t max_batch;
-  uint64_t deadline_ns;
-  bool require_auth;
+  // Read-only copies of the Frontend-level serving knobs (stamped
+  // before the IO thread starts) so the hot path never reaches across.
+  size_t max_batch = 4096;
+  uint64_t deadline_ns = 300000;
+  bool require_auth = false;
   std::thread io;
-  std::atomic<bool> stopping{false};
 
   FeMutex mu;
   FeCondVar cv;
@@ -488,16 +590,6 @@ struct Frontend {
   uint64_t stage_hist[kStages][kHistBuckets] = {{0}};
   int64_t stage_total[kStages] = {0};
   double stage_sum[kStages] = {0.0};
-
-  // Tier-0 admission cache (empty/disabled until fe_t0_configure).
-  T0Config t0;
-  std::vector<T0Entry> t0tab;
-  size_t t0_scan = 0;  // harvest resume cursor (fairness under overflow)
-  int64_t t0_hits = 0;          // local grants
-  int64_t t0_local_denies = 0;  // confident local denies
-  int64_t t0_misses = 0;        // eligible requests that fell through
-  int64_t t0_installs = 0;
-  int64_t t0_evictions = 0;
 
   // Completed-span records for traced requests decided entirely in C
   // (tier-0 local grant/deny): Python's sync pump harvests these via
@@ -531,6 +623,29 @@ struct Frontend {
   std::vector<uint8_t> bulk_verdict_scratch;
   std::vector<float> bulk_rem_scratch;
   std::vector<int32_t> bulk_residue_scratch;
+  // Round 11: per-frame key aggregation for the tier-0 decide pass. A
+  // hot frame carries thousands of rows over a few dozen keys; the
+  // parse pass groups them (open-addressed, epoch-stamped table) so
+  // the decide pass takes each touched partition's lock ONCE per frame
+  // and makes ONE envelope decision per (key, summed count) — per-row
+  // locking across N shard threads cache-bounces the partition mutexes
+  // (measured SLOWER at 4 shards than one), and even batched per-row
+  // decides keep the lock held for the whole row scan. Keys whose
+  // aggregate does not cleanly fit the budget fall back to the exact
+  // per-row legacy walk under the same lock (the boundary minority),
+  // so observable semantics are unchanged.
+  std::vector<int32_t> bulk_aggof_scratch;    // row -> agg index | -1
+  std::vector<uint64_t> bulk_aggtab_epoch;    // open table stamp
+  std::vector<int32_t> bulk_aggtab_idx;       // open table payload
+  uint64_t bulk_agg_epoch = 0;
+  std::vector<uint64_t> agg_hash;
+  std::vector<int32_t> agg_first;   // first row (key-byte authority)
+  std::vector<int32_t> agg_nrows;
+  std::vector<int64_t> agg_total;   // summed requested permits
+  std::vector<uint8_t> agg_mode;    // see kAgg* in handle_bulk_frame
+  std::vector<double> agg_before;   // admitted before a grant-all
+  std::vector<double> agg_lastrem;  // last acked balance snapshot
+  std::vector<double> agg_run;      // per-row remaining fill cursor
   // Hot-key feed for the heavy-hitter sketch: per-frame open-addressed
   // aggregation scratch + the bounded harvest ring fe_hot_harvest
   // drains (overflow drops oldest — telemetry, not accounting).
@@ -540,16 +655,93 @@ struct Frontend {
   int64_t hot_dropped = 0;
 };
 
+// The whole front-end: N shards accepting on SO_REUSEPORT listeners
+// bound to ONE port, plus the key-hash-partitioned tier-0 replica
+// table they all decide against. The Python half runs one pump thread
+// per shard (fe_shard hands out the per-shard handles) and ONE sync
+// pump that drains every partition's grant ledger through the
+// Frontend-level harvest/ack/retire calls — a single reconciliation
+// stream into the store, a single epsilon envelope across shards.
+struct Frontend {
+  uint32_t magic = kFeMagic;
+  int port = 0;
+  int nshards = 1;
+  size_t max_batch = 4096;
+  uint64_t deadline_ns = 300000;
+  bool require_auth = false;
+  std::atomic<bool> stopping{false};
+  std::vector<Shard*> shards;
+  // Tier-0 partitions, one per shard by key-hash affinity (see T0Part).
+  // Empty tables until fe_t0_configure; t0_enabled is the lock-free
+  // fast gate the parse loops read before paying a partition lock.
+  std::vector<T0Part*> t0parts;
+  std::atomic<bool> t0_enabled{false};
+  // Harvest fan-out cursor: which partition the Frontend-level harvest
+  // resumes from (single sync-pump caller; rotates so an overflowing
+  // round cannot starve the high-numbered partitions).
+  size_t harvest_part = 0;
+  // Same rotation for the shard-level trace/hot harvests.
+  size_t trace_shard = 0;
+  size_t hot_shard = 0;
+};
+
+inline Frontend* as_frontend(void* h) {
+  return *static_cast<uint32_t*>(h) == kFeMagic
+             ? static_cast<Frontend*>(h)
+             : nullptr;
+}
+
+// Per-shard entry points accept either handle kind; a Frontend handle
+// means shard 0 — exactly the single-shard ABI a stale Python half
+// (which never calls fe_shard) keeps using.
+inline Shard* shard_of(void* h) {
+  Frontend* fe = as_frontend(h);
+  return fe != nullptr ? fe->shards[0] : static_cast<Shard*>(h);
+}
+
+inline Frontend* owner_of(void* h) {
+  Frontend* fe = as_frontend(h);
+  return fe != nullptr ? fe : static_cast<Shard*>(h)->owner;
+}
+
+// Aggregating entry points: every shard for a Frontend handle, just the
+// one for a Shard handle (the per-shard breakdown OP_STATS exposes).
+inline std::vector<Shard*> shards_of(void* h) {
+  Frontend* fe = as_frontend(h);
+  if (fe != nullptr) return fe->shards;
+  return {static_cast<Shard*>(h)};
+}
+
+// The shard's own tier-0 slice (nullptr before fe_t0_configure).
+inline T0Part* t0_slice(Shard* sh);
+
+// Slices a tier-0 call touches: the shard's own for a Shard handle
+// (per-shard breakdown / the hot path), all of them for a Frontend
+// handle (the sync pump's merge view).
+inline std::vector<T0Part*> t0parts_of(void* h) {
+  Frontend* fe = as_frontend(h);
+  if (fe != nullptr) return fe->t0parts;
+  Shard* sh = static_cast<Shard*>(h);
+  if (sh->owner->t0parts.empty()) return {};
+  return {sh->owner->t0parts[size_t(sh->index)]};
+}
+
+inline T0Part* t0_slice(Shard* sh) {
+  Frontend* fe = sh->owner;
+  return fe->t0parts.empty() ? nullptr
+                             : fe->t0parts[size_t(sh->index)];
+}
+
 constexpr size_t kTraceRing = 1024;
 
-void trace_ring_push_raw(Frontend* fe, uint64_t hi, uint64_t lo,
+void trace_ring_push_raw(Shard* sh, uint64_t hi, uint64_t lo,
                          uint64_t parent, uint8_t tr_flags, uint8_t op,
                          bool granted, uint64_t start_ns,
                          uint64_t end_ns) {
   // mu held.
-  if (fe->trace_ring.size() >= kTraceRing) {
-    fe->trace_ring.pop_front();
-    fe->trace_dropped++;
+  if (sh->trace_ring.size() >= kTraceRing) {
+    sh->trace_ring.pop_front();
+    sh->trace_dropped++;
   }
   TraceRec r;
   r.hi = hi;
@@ -559,22 +751,22 @@ void trace_ring_push_raw(Frontend* fe, uint64_t hi, uint64_t lo,
   r.dur_ns = end_ns - start_ns;
   r.meta = uint64_t(tr_flags) | (granted ? 0x100u : 0u) |
            (uint64_t(op) << 16);
-  fe->trace_ring.push_back(r);
+  sh->trace_ring.push_back(r);
 }
 
-void trace_ring_push(Frontend* fe, const Item& it, bool granted,
+void trace_ring_push(Shard* sh, const Item& it, bool granted,
                      uint64_t end_ns) {
-  trace_ring_push_raw(fe, it.tr_hi, it.tr_lo, it.tr_parent, it.tr_flags,
+  trace_ring_push_raw(sh, it.tr_hi, it.tr_lo, it.tr_parent, it.tr_flags,
                       it.op, granted, it.t_ns, end_ns);
 }
 
-T0Entry* t0_find(Frontend* fe, std::string_view key, double cap,
-                 double rate) {
-  // mu held.
-  if (fe->t0tab.empty()) return nullptr;
-  size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
+T0Entry* t0_find(T0Part* part, std::string_view key, uint64_t h,
+                 double cap, double rate) {
+  // part->mu held.
+  if (part->tab.empty()) return nullptr;
+  size_t idx = size_t(h) & part->cfg.mask;
   for (size_t p = 0; p < kT0Probe; p++) {
-    T0Entry& e = fe->t0tab[(idx + p) & fe->t0.mask];
+    T0Entry& e = part->tab[(idx + p) & part->cfg.mask];
     if (e.live && e.cap == cap && e.rate == rate &&
         std::string_view(e.key) == key) {
       return &e;
@@ -583,13 +775,16 @@ T0Entry* t0_find(Frontend* fe, std::string_view key, double cap,
   return nullptr;
 }
 
-void t0_install(Frontend* fe, const std::string& key, double cap,
+void t0_install(T0Part* part, const std::string& key, double cap,
                 double rate, double remaining, uint64_t now,
                 double cost) {
-  // mu held. Seed/refresh a replica from an authoritative device
-  // decision (fe_complete). A refresh keeps `admitted`: the device
-  // balance predates our un-drained local grants, so the envelope stays
-  // conservative until the next sync acks them away.
+  // Called with the deciding shard's connection mutex held; takes the
+  // shard's OWN slice mutex (lock order: shard mu → slice mu — the one
+  // nesting this file allows). Seed/refresh a replica from an
+  // authoritative device decision (fe_complete). A refresh keeps
+  // `admitted`: the device balance predates our un-drained local
+  // grants, so the envelope stays conservative until the next sync
+  // acks them away.
   //
   // `cost` is the granting request's token count: a fresh install must
   // have the headroom to decide at least ONE request of the cost that
@@ -599,22 +794,25 @@ void t0_install(Frontend* fe, const std::string& key, double cap,
   // it only burns probe-window slots the genuinely decidable keys
   // need. Token-denominated install terms, not request-denominated
   // (the count>1 audit, ISSUE 10 satellite).
-  if (fe->t0tab.empty() || key.size() > kT0MaxKey) return;
+  if (key.size() > kT0MaxKey || part == nullptr) return;
   if (cost < 1.0) cost = 1.0;  // probe-seeded installs size for 1 token
-  T0Entry* e = t0_find(fe, key, cap, rate);
+  uint64_t h = t0_hash(key);
+  std::lock_guard<T0SpinMutex> lk(part->mu);
+  if (part->tab.empty()) return;
+  T0Entry* e = t0_find(part, key, h, cap, rate);
   if (e == nullptr) {
-    double budget = t0_budget_of(fe->t0, remaining);
+    double budget = t0_budget_of(part->cfg, remaining);
     if (budget <= 0.0 || budget < cost) {
       return;  // headroom too small to host locally
     }
-    size_t idx = size_t(t0_hash(key)) & fe->t0.mask;
+    size_t idx = size_t(h) & part->cfg.mask;
     for (size_t p = 0; p < kT0Probe && e == nullptr; p++) {
-      T0Entry& cand = fe->t0tab[(idx + p) & fe->t0.mask];
+      T0Entry& cand = part->tab[(idx + p) & part->cfg.mask];
       if (!cand.live) {
         e = &cand;
       } else if (cand.pending == 0.0 &&
-                 now - cand.last_touch_ns > fe->t0.ttl_ns) {
-        fe->t0_evictions++;  // reuse an idle slot (un-drained grants pin)
+                 now - cand.last_touch_ns > part->cfg.ttl_ns) {
+        part->evictions++;  // reuse an idle slot (un-drained grants pin)
         e = &cand;
       }
     }
@@ -629,30 +827,33 @@ void t0_install(Frontend* fe, const std::string& key, double cap,
     e->budget = budget;
     e->last_ack_ns = now;
     e->last_touch_ns = now;
-    fe->t0_installs++;
+    part->installs++;
     return;
   }
   e->last_remaining = remaining;
-  e->budget = t0_budget_of(fe->t0, std::max(remaining - e->admitted, 0.0));
+  e->budget = t0_budget_of(part->cfg,
+                           std::max(remaining - e->admitted, 0.0));
   e->last_ack_ns = now;
   e->last_touch_ns = now;
 }
 
-int t0_decide(Frontend* fe, std::string_view key, int64_t count,
-              double cap, double rate, double* rem_out, uint64_t now) {
-  // mu held. 1 = grant locally, 0 = deny locally, -1 = fall through to
-  // the device path. The estimate reported with local replies is the
-  // envelope's own conservative view (last acked balance minus local
-  // grants — refill since the ack is credit the next sync will restore).
-  // `now` comes from the caller: the bulk lane decides up to ~100K rows
-  // per frame and must not pay one clock read per row.
-  T0Entry* e = t0_find(fe, key, cap, rate);
+int t0_decide_locked(T0Part* part, std::string_view key, uint64_t h,
+                     int64_t count, double cap, double rate,
+                     double* rem_out, uint64_t now) {
+  // part->mu held. 1 = grant locally, 0 = deny locally, -1 = fall
+  // through to the device path. The estimate reported with local
+  // replies is the envelope's own conservative view (last acked
+  // balance minus local grants — refill since the ack is credit the
+  // next sync will restore). `now` comes from the caller: the bulk
+  // lane decides up to ~100K rows per frame and must not pay one
+  // clock read per row.
+  T0Entry* e = t0_find(part, key, h, cap, rate);
   if (e == nullptr) {
-    fe->t0_misses++;
+    part->misses++;
     return -1;
   }
-  if (now - e->last_ack_ns > fe->t0.stale_ns) {
-    fe->t0_misses++;  // envelope too old: device decides (and re-seeds)
+  if (now - e->last_ack_ns > part->cfg.stale_ns) {
+    part->misses++;  // envelope too old: device decides (and re-seeds)
     return -1;
   }
   e->last_touch_ns = now;
@@ -660,7 +861,7 @@ int t0_decide(Frontend* fe, std::string_view key, int64_t count,
   if (e->admitted + cnt <= e->budget) {
     e->admitted += cnt;
     e->pending += cnt;
-    fe->t0_hits++;
+    part->hits++;
     *rem_out = std::max(e->last_remaining - e->admitted, 0.0);
     return 1;
   }
@@ -670,12 +871,26 @@ int t0_decide(Frontend* fe, std::string_view key, int64_t count,
   double elapsed_s = double(now - e->last_ack_ns) * 1e-9;
   double optimistic = e->last_remaining - e->admitted + rate * elapsed_s;
   if (optimistic < cnt) {
-    fe->t0_local_denies++;
+    part->local_denies++;
     *rem_out = std::max(e->last_remaining - e->admitted, 0.0);
     return 0;
   }
-  fe->t0_misses++;
+  part->misses++;
   return -1;
+}
+
+int t0_decide(T0Part* part, std::string_view key, int64_t count,
+              double cap, double rate, double* rem_out, uint64_t now) {
+  // Scalar-lane entry: called with the deciding shard's connection
+  // mutex held; takes the shard's OWN slice mutex (the shard's budget
+  // share is its to draw down — the split in t0_budget_of keeps the
+  // cross-shard sum inside the flat envelope). The bulk lane does NOT
+  // come through here: it aggregates a frame by key and locks the
+  // slice once (handle_bulk_frame).
+  if (part == nullptr) return -1;
+  uint64_t h = t0_hash(key);
+  std::lock_guard<T0SpinMutex> lk(part->mu);
+  return t0_decide_locked(part, key, h, count, cap, rate, rem_out, now);
 }
 
 int hist_bucket(double seconds) {
@@ -688,16 +903,16 @@ int hist_bucket(double seconds) {
   return idx;
 }
 
-void hist_record(Frontend* fe, double seconds) {
-  fe->hist[hist_bucket(seconds)]++;
-  fe->hist_total++;
-  fe->hist_sum += seconds;
+void hist_record(Shard* sh, double seconds) {
+  sh->hist[hist_bucket(seconds)]++;
+  sh->hist_total++;
+  sh->hist_sum += seconds;
 }
 
-void stage_record(Frontend* fe, int stage, double seconds) {
-  fe->stage_hist[stage][hist_bucket(seconds)]++;
-  fe->stage_total[stage]++;
-  fe->stage_sum[stage] += seconds;
+void stage_record(Shard* sh, int stage, double seconds) {
+  sh->stage_hist[stage][hist_bucket(seconds)]++;
+  sh->stage_total[stage]++;
+  sh->stage_sum[stage] += seconds;
 }
 
 void set_nonblock(int fd) {
@@ -706,17 +921,17 @@ void set_nonblock(int fd) {
 }
 
 // Flush as much of conn->out as the socket accepts. mu held.
-void flush_out(Frontend* fe, Conn* c);
+void flush_out(Shard* sh, Conn* c);
 
-void close_conn(Frontend* fe, Conn* c) {
+void close_conn(Shard* sh, Conn* c) {
   // mu held. Removes from epoll + conn map and frees.
-  epoll_ctl(fe->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  epoll_ctl(sh->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   ::close(c->fd);
-  fe->conns.erase(c->id);
+  sh->conns.erase(c->id);
   delete c;
 }
 
-void send_to_conn(Frontend* fe, Conn* c, const char* data, size_t len) {
+void send_to_conn(Shard* sh, Conn* c, const char* data, size_t len) {
   // mu held. Append-or-write: when nothing is queued, try the socket
   // immediately (saves an epoll round trip — the common case); queue
   // the remainder and arm EPOLLOUT on partial writes.
@@ -748,7 +963,7 @@ void send_to_conn(Frontend* fe, Conn* c, const char* data, size_t len) {
     epoll_event ev{};
     ev.events = EPOLLIN | EPOLLOUT;
     ev.data.u64 = c->id;
-    epoll_ctl(fe->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    epoll_ctl(sh->epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
 }
 
@@ -768,7 +983,7 @@ void queue_to_conn(Conn* c, const char* data, size_t len) {
   c->out.append(data, len);
 }
 
-void flush_queued(Frontend* fe, Conn* c) {
+void flush_queued(Shard* sh, Conn* c) {
   // mu held. Push burst-queued replies out with one send(); arm
   // EPOLLOUT for any leftover. Never closes/frees the connection (hard
   // errors mark `closing` and the IO loop reaps on the next event), so
@@ -793,10 +1008,10 @@ void flush_queued(Frontend* fe, Conn* c) {
   epoll_event ev{};
   ev.events = EPOLLIN | EPOLLOUT;
   ev.data.u64 = c->id;
-  epoll_ctl(fe->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  epoll_ctl(sh->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
-void flush_out(Frontend* fe, Conn* c) {
+void flush_out(Shard* sh, Conn* c) {
   // mu held. Cursor-based drain: erase-from-front per partial send is
   // O(n^2) memmove on a multi-MB backpressured outbox, all of it under
   // the global mutex — advance out_off instead, compact occasionally.
@@ -811,7 +1026,7 @@ void flush_out(Frontend* fe, Conn* c) {
         }
         return;
       }
-      close_conn(fe, c);
+      close_conn(sh, c);
       return;
     }
     c->out_off += size_t(n);
@@ -819,7 +1034,7 @@ void flush_out(Frontend* fe, Conn* c) {
   c->out.clear();
   c->out_off = 0;
   if (c->closing) {
-    close_conn(fe, c);
+    close_conn(sh, c);
     return;
   }
   if (c->want_write) {
@@ -827,7 +1042,7 @@ void flush_out(Frontend* fe, Conn* c) {
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u64 = c->id;
-    epoll_ctl(fe->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    epoll_ctl(sh->epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
 }
 
@@ -835,9 +1050,9 @@ void flush_out(Frontend* fe, Conn* c) {
 // straight to the pump instead of waiting out the deadline timer (the
 // adaptive half of flush-on-idle — batch size tracks Python's service
 // time under load, and completion immediately restarts service).
-void maybe_flush_after_complete(Frontend* fe);
+void maybe_flush_after_complete(Shard* sh);
 
-void flush_pending(Frontend* fe, bool include_tail) {
+void flush_pending(Shard* sh, bool include_tail) {
   // mu held. pending -> ready queue in <= max_batch chunks (max_batch
   // bounds flush SIZE like the asyncio MicroBatcher's, not just the
   // flush trigger). A size-triggered flush (include_tail=false) emits
@@ -845,53 +1060,53 @@ void flush_pending(Frontend* fe, bool include_tail) {
   // coalesce with the next arrivals — the MicroBatcher's remainder
   // semantics (batcher.py); deadline/idle flushes drain everything
   // (the tail is as overdue as the rest).
-  if (fe->pending.empty()) return;
-  size_t n = fe->pending.size();
-  size_t limit = include_tail ? n : (n / fe->max_batch) * fe->max_batch;
+  if (sh->pending.empty()) return;
+  size_t n = sh->pending.size();
+  size_t limit = include_tail ? n : (n / sh->max_batch) * sh->max_batch;
   if (limit == 0) return;
   size_t pos = 0;
   uint64_t t_cut = now_ns();
   while (pos < limit) {
     size_t take = limit - pos;
-    if (take > fe->max_batch) take = fe->max_batch;
+    if (take > sh->max_batch) take = sh->max_batch;
     Batch b;
-    b.id = fe->next_batch_id++;
+    b.id = sh->next_batch_id++;
     b.t_flush_ns = t_cut;
-    b.items.assign(std::make_move_iterator(fe->pending.begin() + pos),
-                   std::make_move_iterator(fe->pending.begin() + pos +
+    b.items.assign(std::make_move_iterator(sh->pending.begin() + pos),
+                   std::make_move_iterator(sh->pending.begin() + pos +
                                            take));
     pos += take;
-    fe->ready.push_back(std::move(b));
-    fe->batches_flushed++;
+    sh->ready.push_back(std::move(b));
+    sh->batches_flushed++;
   }
   if (limit == n) {
-    fe->pending.clear();
+    sh->pending.clear();
   } else {
-    fe->pending.erase(fe->pending.begin(),
-                      fe->pending.begin() + static_cast<ptrdiff_t>(limit));
-    fe->pending_oldest_ns = fe->pending.front().t_ns;
+    sh->pending.erase(sh->pending.begin(),
+                      sh->pending.begin() + static_cast<ptrdiff_t>(limit));
+    sh->pending_oldest_ns = sh->pending.front().t_ns;
   }
-  fe->cv.notify_one();
+  sh->cv.notify_one();
 }
 
-void maybe_flush_after_complete(Frontend* fe) {
+void maybe_flush_after_complete(Shard* sh) {
   // mu held (called from fe_complete / fe_fail / finish_bulk_job).
-  if (!fe->pending.empty() && fe->ready.empty() && fe->pt.empty() &&
-      fe->inflight.empty() && fe->bulk_ready.empty() &&
-      fe->bulk_inflight.empty()) {
-    flush_pending(fe, /*include_tail=*/true);  // pipeline idle: drain
+  if (!sh->pending.empty() && sh->ready.empty() && sh->pt.empty() &&
+      sh->inflight.empty() && sh->bulk_ready.empty() &&
+      sh->bulk_inflight.empty()) {
+    flush_pending(sh, /*include_tail=*/true);  // pipeline idle: drain
   }
 }
 
-void to_passthrough(Frontend* fe, Conn* c, const uint8_t* body,
+void to_passthrough(Shard* sh, Conn* c, const uint8_t* body,
                     size_t len) {
   // mu held. Hand a frame to Python wholesale — the wire module stays
   // the single authority for every non-hot (or malformed) shape.
   Passthrough ptf;
   ptf.conn_id = c->id;
   ptf.frame.assign(reinterpret_cast<const char*>(body), len);
-  fe->pt.push_back(std::move(ptf));
-  fe->cv.notify_one();
+  sh->pt.push_back(std::move(ptf));
+  sh->cv.notify_one();
 }
 
 // ---------------------------------------------------------------------
@@ -942,13 +1157,13 @@ constexpr size_t kHotScratchProbe = 4;
 constexpr size_t kHotTopPerFrame = 32;
 constexpr size_t kHotRingCap = 4096;
 
-void bulk_hot_feed(Frontend* fe, const uint8_t* blob,
+void bulk_hot_feed(Shard* sh, const uint8_t* blob,
                    const int64_t* offs, const int64_t* counts,
                    uint64_t n) {
   // mu held.
-  if (fe->hot_scratch.empty()) fe->hot_scratch.resize(kHotScratchSlots);
-  fe->hot_epoch++;
-  uint64_t epoch = fe->hot_epoch;
+  if (sh->hot_scratch.empty()) sh->hot_scratch.resize(kHotScratchSlots);
+  sh->hot_epoch++;
+  uint64_t epoch = sh->hot_epoch;
   size_t used_idx[kHotScratchSlots];
   size_t used = 0;
   for (uint64_t i = 0; i < n; i++) {
@@ -962,7 +1177,7 @@ void bulk_hot_feed(Frontend* fe, const uint8_t* blob,
     size_t idx = size_t(hsh) & (kHotScratchSlots - 1);
     for (size_t pr = 0; pr < kHotScratchProbe; pr++) {
       size_t at = (idx + pr) & (kHotScratchSlots - 1);
-      HotSlot& s = fe->hot_scratch[at];
+      HotSlot& s = sh->hot_scratch[at];
       if (s.epoch != epoch) {
         s.epoch = epoch;
         s.hash = hsh;
@@ -981,17 +1196,17 @@ void bulk_hot_feed(Frontend* fe, const uint8_t* blob,
   if (top < used) {
     std::nth_element(used_idx, used_idx + top, used_idx + used,
                      [&](size_t x, size_t y) {
-                       return fe->hot_scratch[x].weight >
-                              fe->hot_scratch[y].weight;
+                       return sh->hot_scratch[x].weight >
+                              sh->hot_scratch[y].weight;
                      });
   }
   for (size_t j = 0; j < top; j++) {
-    const HotSlot& s = fe->hot_scratch[used_idx[j]];
-    if (fe->hot_ring.size() >= kHotRingCap) {
-      fe->hot_ring.pop_front();
-      fe->hot_dropped++;
+    const HotSlot& s = sh->hot_scratch[used_idx[j]];
+    if (sh->hot_ring.size() >= kHotRingCap) {
+      sh->hot_ring.pop_front();
+      sh->hot_dropped++;
     }
-    fe->hot_ring.emplace_back(
+    sh->hot_ring.emplace_back(
         std::string(
             reinterpret_cast<const char*>(blob) + offs[s.row],
             size_t(offs[s.row + 1] - offs[s.row])),
@@ -1005,7 +1220,7 @@ void bulk_hot_feed(Frontend* fe, const uint8_t* blob,
 // protocol authority) raises the exact routable error the asyncio
 // server would, byte for byte. Well-formed frames never leave C unless
 // rows need the store.
-bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
+bool handle_bulk_frame(Shard* sh, Conn* c, const uint8_t* body,
                        size_t len) {
   // mu held (parse burst on the IO thread, or a parked-frame drain /
   // fe_set_authed replay on the loop thread).
@@ -1033,23 +1248,56 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
   uint32_t seq = rd_u32(body + 1);
   uint64_t now = now_ns();
 
-  fe->bulk_frames++;
-  fe->bulk_rows += int64_t(n);
-  std::vector<int64_t>& offs = fe->bulk_offsets_scratch;
-  std::vector<int64_t>& cnt64 = fe->bulk_counts_scratch;
-  std::vector<uint8_t>& verdict = fe->bulk_verdict_scratch;
-  std::vector<float>& remaining = fe->bulk_rem_scratch;
-  std::vector<int32_t>& residue = fe->bulk_residue_scratch;
+  sh->bulk_frames++;
+  sh->bulk_rows += int64_t(n);
+  std::vector<int64_t>& offs = sh->bulk_offsets_scratch;
+  std::vector<int64_t>& cnt64 = sh->bulk_counts_scratch;
+  std::vector<uint8_t>& verdict = sh->bulk_verdict_scratch;
+  std::vector<float>& remaining = sh->bulk_rem_scratch;
+  std::vector<int32_t>& residue = sh->bulk_residue_scratch;
   offs.resize(n + 1);
   cnt64.resize(n);
   verdict.assign(n, 2);
   remaining.assign(n, 0.0f);
   residue.clear();
-  bool t0able = fe->bulk_t0 && fe->t0.enabled &&
+  bool t0able = sh->bulk_t0 &&
+                sh->owner->t0_enabled.load(std::memory_order_relaxed) &&
                 kind == BULK_KIND_BUCKET;
+  // Agg modes after the decide pass (see the Shard scratch block):
+  // grant-all rows fill verdict/remaining lock-free afterward; per-row
+  // rows were written exactly by the legacy walk; residue-all rows
+  // keep verdict 2 and fall through to Python.
+  constexpr uint8_t kAggGrantAll = 0;
+  constexpr uint8_t kAggPerRow = 1;
+  constexpr uint8_t kAggResidue = 2;
+  std::vector<int32_t>& agg_of = sh->bulk_aggof_scratch;
+  size_t naggs = 0;
+  if (t0able) {
+    agg_of.assign(n, -1);
+    // Epoch-stamped open table sized for the frame (2n slots, power of
+    // two): no per-frame clear, collisions resolved by key bytes — a
+    // hash-identity merge would fuse two tenants' budgets.
+    size_t want = 2;
+    while (want < 2 * n) want <<= 1;
+    if (sh->bulk_aggtab_epoch.size() < want) {
+      sh->bulk_aggtab_epoch.assign(want, 0);
+      sh->bulk_aggtab_idx.assign(want, -1);
+    }
+    sh->bulk_agg_epoch++;
+    sh->agg_hash.clear();
+    sh->agg_first.clear();
+    sh->agg_nrows.clear();
+    sh->agg_total.clear();
+    sh->agg_mode.clear();
+  }
+  size_t aggmask = t0able ? sh->bulk_aggtab_epoch.size() - 1 : 0;
+  uint64_t aggepoch = sh->bulk_agg_epoch;
   int64_t off = 0;
   double permits_local = 0.0;
   offs[0] = 0;
+  // Pass 1 — parse + aggregate. Tier-0-eligible rows group by key (one
+  // agg per distinct key); nothing is decided and no lock is touched
+  // while the row loop runs.
   for (uint64_t i = 0; i < n; i++) {
     size_t klen = rd_u16(kl + 2 * i);
     std::string_view key(
@@ -1059,23 +1307,165 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
     int64_t count = int64_t(rd_u32(cnts + 4 * i));
     cnt64[i] = count;
     if (t0able && count > 0 && klen <= kT0MaxKey) {
-      // Same replica table, budgets, and counters as the scalar
-      // ACQUIRE lane — a bulk row's local grant draws down the exact
-      // envelope a scalar grant would (one epsilon budget, not two).
-      double rem = 0.0;
-      int v = t0_decide(fe, key, count, a, b, &rem, now);
-      if (v >= 0) {
-        verdict[i] = uint8_t(v);
-        remaining[i] = float(rem);
-        if (v == 1) permits_local += double(count);
-        continue;
+      uint64_t hsh = t0_hash(key);
+      size_t slot = size_t(hsh) & aggmask;
+      int32_t agg = -1;
+      for (;;) {
+        if (sh->bulk_aggtab_epoch[slot] != aggepoch) {
+          agg = int32_t(naggs++);
+          sh->bulk_aggtab_epoch[slot] = aggepoch;
+          sh->bulk_aggtab_idx[slot] = agg;
+          sh->agg_hash.push_back(hsh);
+          sh->agg_first.push_back(int32_t(i));
+          sh->agg_nrows.push_back(1);
+          sh->agg_total.push_back(count);
+          sh->agg_mode.push_back(kAggResidue);
+          break;
+        }
+        int32_t cand = sh->bulk_aggtab_idx[slot];
+        if (sh->agg_hash[size_t(cand)] == hsh) {
+          int32_t fr = sh->agg_first[size_t(cand)];
+          std::string_view fkey(
+              reinterpret_cast<const char*>(blob) + offs[fr],
+              size_t(offs[fr + 1] - offs[fr]));
+          if (fkey == key) {
+            agg = cand;
+            sh->agg_nrows[size_t(cand)]++;
+            sh->agg_total[size_t(cand)] += count;
+            break;
+          }
+        }
+        slot = (slot + 1) & aggmask;
+      }
+      agg_of[i] = agg;
+    }
+  }
+  // Pass 2 — decide, ONE lock acquisition on the shard's own tier-0
+  // slice and one envelope decision per KEY. The grant-all fast path
+  // (the hot steady state: the key's summed ask fits this shard's
+  // budget share) draws the aggregate down in O(1) under the lock; a
+  // key near its envelope edge falls back to the exact per-row legacy
+  // walk under the same lock, so boundary semantics — progressive
+  // remaining, confident denies, fall-through — stay bit-identical to
+  // the scalar lane's. Same replica slice, budgets, and counters as
+  // the scalar ACQUIRE lane: a bulk row's local grant draws down the
+  // exact envelope a scalar grant would (one epsilon budget, not two).
+  if (t0able && naggs > 0) {
+    sh->agg_before.assign(naggs, 0.0);
+    sh->agg_lastrem.assign(naggs, 0.0);
+    T0Part* part = t0_slice(sh);
+    if (part != nullptr) {
+      bool any_per_row = false;
+      std::lock_guard<T0SpinMutex> lk(part->mu);
+      for (size_t g = 0; g < naggs; g++) {
+        int32_t fr = sh->agg_first[g];
+        std::string_view key(
+            reinterpret_cast<const char*>(blob) + offs[fr],
+            size_t(offs[fr + 1] - offs[fr]));
+        T0Entry* e = t0_find(part, key, sh->agg_hash[g], a, b);
+        if (e == nullptr ||
+            now - e->last_ack_ns > part->cfg.stale_ns) {
+          part->misses += sh->agg_nrows[g];
+          continue;  // kAggResidue: every row falls through identically
+        }
+        e->last_touch_ns = now;
+        double total = double(sh->agg_total[g]);
+        if (e->admitted + total <= e->budget) {
+          sh->agg_mode[g] = kAggGrantAll;
+          sh->agg_before[g] = e->admitted;
+          sh->agg_lastrem[g] = e->last_remaining;
+          e->admitted += total;
+          e->pending += total;
+          part->hits += sh->agg_nrows[g];
+          permits_local += total;
+          continue;
+        }
+        // Envelope edge: mark for the exact legacy walk below. The
+        // walk runs as ONE row pass over the frame for ALL boundary
+        // keys together — a per-key rescan would be
+        // O(boundary keys × rows) under this lock, and the boundary
+        // regime (budget shares drawn down between sync rounds) is
+        // exactly where frames get slow, not where they may.
+        sh->agg_mode[g] = kAggPerRow;
+        any_per_row = true;
+      }
+      if (any_per_row) {
+        for (uint64_t i = 0; i < n; i++) {
+          int32_t g = agg_of[i];
+          if (g < 0 || sh->agg_mode[size_t(g)] != kAggPerRow) continue;
+          std::string_view rkey(
+              reinterpret_cast<const char*>(blob) + offs[i],
+              size_t(offs[i + 1] - offs[i]));
+          double rem = 0.0;
+          int v = t0_decide_locked(part, rkey, sh->agg_hash[size_t(g)],
+                                   cnt64[i], a, b, &rem, now);
+          if (v >= 0) {
+            verdict[i] = uint8_t(v);
+            remaining[i] = float(rem);
+            if (v == 1) permits_local += double(cnt64[i]);
+          }
+        }
       }
     }
-    residue.push_back(int32_t(i));
+    // Lock-free fill for the grant-all keys: row j's remaining is the
+    // envelope view after its own grant (last acked balance minus the
+    // running admitted) — exactly the per-row walk's estimates.
+    sh->agg_run.assign(naggs, 0.0);
+    for (uint64_t i = 0; i < n; i++) {
+      int32_t g = agg_of[i];
+      if (g < 0 || sh->agg_mode[size_t(g)] != kAggGrantAll) continue;
+      sh->agg_run[size_t(g)] += double(cnt64[i]);
+      verdict[i] = 1;
+      remaining[i] = float(std::max(
+          sh->agg_lastrem[size_t(g)] -
+              (sh->agg_before[size_t(g)] + sh->agg_run[size_t(g)]),
+          0.0));
+    }
   }
-  if (fe->bulk_hot) bulk_hot_feed(fe, blob, offs.data(), cnt64.data(), n);
-  fe->bulk_rows_local += int64_t(n) - int64_t(residue.size());
-  fe->bulk_permits_local += permits_local;
+  for (uint64_t i = 0; i < n; i++) {
+    if (verdict[i] == 2) residue.push_back(int32_t(i));
+  }
+  if (sh->bulk_hot) {
+    if (t0able && naggs > 0) {
+      // The decide pass already aggregated this frame by key — feed
+      // the sketch from the aggs instead of re-hashing every row
+      // (bulk_hot_feed's own pass exists for frames the tier-0 lane
+      // never grouped: windows, disabled tier-0). Same top-K bound.
+      size_t top = naggs < kHotTopPerFrame ? naggs : kHotTopPerFrame;
+      static_assert(kHotTopPerFrame > 0, "top-K feed");
+      std::vector<size_t> order(naggs);
+      for (size_t g = 0; g < naggs; g++) order[g] = g;
+      if (top < naggs) {
+        std::nth_element(order.begin(), order.begin() + top, order.end(),
+                         [&](size_t x, size_t y) {
+                           return sh->agg_total[x] > sh->agg_total[y];
+                         });
+      }
+      for (size_t j = 0; j < top; j++) {
+        size_t g = order[j];
+        int32_t fr0 = sh->agg_first[g];
+        if (sh->agg_total[g] <= 0 ||
+            offs[fr0 + 1] - offs[fr0] == 0) {
+          continue;  // empty keys stay out of the sketch, matching
+                     // bulk_hot_feed's klen==0 filter
+        }
+        if (sh->hot_ring.size() >= kHotRingCap) {
+          sh->hot_ring.pop_front();
+          sh->hot_dropped++;
+        }
+        int32_t fr = sh->agg_first[g];
+        sh->hot_ring.emplace_back(
+            std::string(
+                reinterpret_cast<const char*>(blob) + offs[fr],
+                size_t(offs[fr + 1] - offs[fr])),
+            double(sh->agg_total[g]));
+      }
+    } else {
+      bulk_hot_feed(sh, blob, offs.data(), cnt64.data(), n);
+    }
+  }
+  sh->bulk_rows_local += int64_t(n) - int64_t(residue.size());
+  sh->bulk_permits_local += permits_local;
   if (residue.empty()) {
     // Whole frame decided locally: encode + queue RESP_BULK without
     // ever leaving this thread — the all-hot fast path.
@@ -1092,18 +1482,18 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
       std::memcpy(&parent, tp + 16, 8);
       bool all = true;
       for (uint64_t i = 0; i < n; i++) all = all && verdict[i] == 1;
-      trace_ring_push_raw(fe, hi, lo, parent,
+      trace_ring_push_raw(sh, hi, lo, parent,
                           uint8_t(1 | (tp[24] & 1) << 1),
                           OP_ACQUIRE_MANY, all, now, t_end);
     }
-    hist_record(fe, double(t_end - now) * 1e-9);
-    fe->requests_served++;
-    fe->bulk_frames_local++;
+    hist_record(sh, double(t_end - now) * 1e-9);
+    sh->requests_served++;
+    sh->bulk_frames_local++;
     c->cur_bulk = 0;  // nothing inflight: chained successors may run
     return true;
   }
   BulkJob job;
-  job.id = fe->next_bulk_id++;
+  job.id = sh->next_bulk_id++;
   job.conn_id = c->id;
   job.seq = seq;
   job.flags = flags;
@@ -1126,11 +1516,11 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
     std::memcpy(&job.tr_parent, tp + 16, 8);
     job.tr_flags = uint8_t(1 | (tp[24] & 1) << 1);
   }
-  fe->bulk_rows_residue += int64_t(job.residue.size());
+  sh->bulk_rows_residue += int64_t(job.residue.size());
   c->cur_bulk = job.id;
-  fe->bulk_ready.push_back(job.id);
-  fe->bulk_inflight.emplace(job.id, std::move(job));
-  fe->cv.notify_one();
+  sh->bulk_ready.push_back(job.id);
+  sh->bulk_inflight.emplace(job.id, std::move(job));
+  sh->cv.notify_one();
   return true;
 }
 
@@ -1139,24 +1529,24 @@ bool handle_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
 // its chained successors follow it there (the server's _bulk_tails
 // keeps their order; deciding them natively would race the
 // predecessor's reply).
-void process_bulk_frame(Frontend* fe, Conn* c, const uint8_t* body,
+void process_bulk_frame(Shard* sh, Conn* c, const uint8_t* body,
                         size_t len) {
   // mu held.
   bool chained =
       len > kBodyOff && (body[kBodyOff] & kBulkFlagChained) != 0;
   if (chained && c->bulk_pt_tail) {
-    to_passthrough(fe, c, body, len);
+    to_passthrough(sh, c, body, len);
     return;  // bulk_pt_tail stays set for the rest of the chain
   }
-  if (!handle_bulk_frame(fe, c, body, len)) {
-    to_passthrough(fe, c, body, len);  // malformed: Python errors
+  if (!handle_bulk_frame(sh, c, body, len)) {
+    to_passthrough(sh, c, body, len);  // malformed: Python errors
     c->bulk_pt_tail = true;
     return;
   }
   c->bulk_pt_tail = false;
 }
 
-void drain_parked(Frontend* fe, Conn* c) {
+void drain_parked(Shard* sh, Conn* c) {
   // mu held. Un-park chained successors once the connection has no
   // inflight bulk job; stops when a drained frame starts a new one (its
   // completion resumes the drain) or the connection goes bad.
@@ -1164,36 +1554,36 @@ void drain_parked(Frontend* fe, Conn* c) {
     std::string f = std::move(c->parked_bulk.front());
     c->parked_bulk.pop_front();
     c->parked_bytes -= f.size();
-    process_bulk_frame(fe, c,
+    process_bulk_frame(sh, c,
                        reinterpret_cast<const uint8_t*>(f.data()),
                        f.size());
   }
-  flush_queued(fe, c);
+  flush_queued(sh, c);
 }
 
-void finish_bulk_job(Frontend* fe, int64_t job_id) {
+void finish_bulk_job(Shard* sh, int64_t job_id) {
   // mu held. Erase a completed/abandoned job and un-park the
   // connection's chained successors (the asyncio server's per-
   // connection bulk_tail contract, kept here by parking raw frames
   // until the predecessor's reply is encoded).
-  auto it = fe->bulk_inflight.find(job_id);
-  if (it == fe->bulk_inflight.end()) return;
+  auto it = sh->bulk_inflight.find(job_id);
+  if (it == sh->bulk_inflight.end()) return;
   uint64_t conn_id = it->second.conn_id;
-  fe->bulk_inflight.erase(it);
-  auto itc = fe->conns.find(conn_id);
-  if (itc != fe->conns.end()) {
+  sh->bulk_inflight.erase(it);
+  auto itc = sh->conns.find(conn_id);
+  if (itc != sh->conns.end()) {
     Conn* c = itc->second;
     if (c->cur_bulk == job_id) c->cur_bulk = 0;
-    drain_parked(fe, c);
+    drain_parked(sh, c);
   }
-  maybe_flush_after_complete(fe);
+  maybe_flush_after_complete(sh);
 }
 
 // Handle one complete frame body. Returns false if the connection must
 // close (protocol breakage — an error reply is already queued). Called
 // from parse_frames (IO thread) and from fe_set_authed's held-frame
 // replay (loop thread); mu held either way.
-bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
+bool handle_frame(Shard* sh, Conn* c, const uint8_t* body, size_t len) {
   if (c->closing) return true;  // replies would be dropped: stop mutating
                                 // store state for a dying connection
   uint8_t ver = body[0];
@@ -1206,7 +1596,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
   uint8_t op = rawop & uint8_t(~TRACE_FLAG);
   if (ver != kVersion) {
     std::string err = encode_error(seq, "protocol version mismatch");
-    send_to_conn(fe, c, err.data(), err.size());
+    send_to_conn(sh, c, err.data(), err.size());
     return false;
   }
   if (!c->authed) {
@@ -1219,7 +1609,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
       // does). Bounded: a flood before auth is protocol abuse.
       if (c->held_bytes + len > kMaxHeld) {
         std::string err = encode_error(seq, "auth pending: too much data");
-        send_to_conn(fe, c, err.data(), err.size());
+        send_to_conn(sh, c, err.data(), err.size());
         return false;
       }
       c->held.emplace_back(reinterpret_cast<const char*>(body), len);
@@ -1228,7 +1618,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
     } else {
       std::string err =
           encode_error(seq, "authentication required: send HELLO first");
-      send_to_conn(fe, c, err.data(), err.size());
+      send_to_conn(sh, c, err.data(), err.size());
       return false;
     }
   }
@@ -1241,13 +1631,13 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         size_t tail = traced ? kTraceTail : 0;
         if (len < kBodyOff + 2 + 20 + tail) {
           std::string err = encode_error(seq, "truncated request");
-          send_to_conn(fe, c, err.data(), err.size());
+          send_to_conn(sh, c, err.data(), err.size());
           return false;
         }
         uint16_t klen = rd_u16(body + kBodyOff);
         if (len != kBodyOff + 2 + size_t(klen) + 20 + tail) {
           std::string err = encode_error(seq, "malformed request");
-          send_to_conn(fe, c, err.data(), err.size());
+          send_to_conn(sh, c, err.data(), err.size());
           return false;
         }
         const uint8_t* kp = body + kBodyOff + 2;
@@ -1267,46 +1657,47 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
           std::memcpy(&it.tr_parent, tp + 16, 8);
           it.tr_flags = uint8_t(1 | (tp[24] & 1) << 1);
         }
-        if (op == OP_ACQUIRE && fe->t0.enabled && it.count > 0) {
+        if (op == OP_ACQUIRE && it.count > 0 &&
+            sh->owner->t0_enabled.load(std::memory_order_relaxed)) {
           // Tier-0: answer from the local replica when it is confident
           // either way; zero-permit probes and every other op keep the
           // exact device path. A traced local decision leaves a span
           // record for the Python harvest — locally-granted requests
           // still trace.
           double rem = 0.0;
-          int verdict = t0_decide(fe, it.key, it.count, it.a, it.b, &rem,
-                                  it.t_ns);
+          int verdict = t0_decide(t0_slice(sh), it.key, it.count, it.a,
+                                  it.b, &rem, it.t_ns);
           if (verdict >= 0) {
             std::string resp = encode_decision(seq, verdict == 1, rem);
             queue_to_conn(c, resp.data(), resp.size());
             uint64_t t_end = now_ns();
-            if (traced) trace_ring_push(fe, it, verdict == 1, t_end);
-            hist_record(fe, double(t_end - it.t_ns) * 1e-9);
-            fe->requests_served++;
+            if (traced) trace_ring_push(sh, it, verdict == 1, t_end);
+            hist_record(sh, double(t_end - it.t_ns) * 1e-9);
+            sh->requests_served++;
             break;
           }
         }
-        if (fe->pending.empty()) fe->pending_oldest_ns = it.t_ns;
-        fe->pending.push_back(std::move(it));
+        if (sh->pending.empty()) sh->pending_oldest_ns = it.t_ns;
+        sh->pending.push_back(std::move(it));
         break;
       }
       case OP_PING: {
         std::string resp = encode_empty(seq);
         queue_to_conn(c, resp.data(), resp.size());
-        fe->requests_served++;  // the asyncio server counts pings too
+        sh->requests_served++;  // the asyncio server counts pings too
         break;
       }
       case OP_ACQUIRE_MANY: {
-        if (!fe->bulk_native) {
+        if (!sh->bulk_native) {
           // The pump never armed the lane (older Python half, or the
           // operator disabled it): round-7 passthrough behavior.
-          to_passthrough(fe, c, body, len);
+          to_passthrough(sh, c, body, len);
           break;
         }
         bool chained =
             len > kBodyOff && (body[kBodyOff] & kBulkFlagChained) != 0;
         bool busy = c->cur_bulk != 0 &&
-                    fe->bulk_inflight.count(c->cur_bulk) != 0;
+                    sh->bulk_inflight.count(c->cur_bulk) != 0;
         if (!c->parked_bulk.empty() || (chained && busy)) {
           // Chained chunk behind an in-flight predecessor (or any bulk
           // frame queued behind a parked chain — FIFO keeps relative
@@ -1316,7 +1707,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
           if (c->parked_bytes + len > kMaxConnOut) {
             std::string err = encode_error(
                 seq, "bulk chain backlog exceeds buffer budget");
-            send_to_conn(fe, c, err.data(), err.size());
+            send_to_conn(sh, c, err.data(), err.size());
             return false;
           }
           c->parked_bulk.emplace_back(
@@ -1328,7 +1719,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         // process_bulk_frame so the error reply stays byte-identical
         // to the asyncio server's — and mark the conn's bulk tail as
         // Python-side so a chained successor follows it there.
-        process_bulk_frame(fe, c, body, len);
+        process_bulk_frame(sh, c, body, len);
         break;
       }
       case OP_PLACEMENT:
@@ -1344,7 +1735,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
         // list in round 8: well-formed bulk frames are native above,
         // and only malformed ones fall through so wire.py raises the
         // exact routable error.
-        to_passthrough(fe, c, body, len);
+        to_passthrough(sh, c, body, len);
         break;
       }
   }
@@ -1353,7 +1744,7 @@ bool handle_frame(Frontend* fe, Conn* c, const uint8_t* body, size_t len) {
 
 // Parse every complete frame in c->in. Returns false if the connection
 // must close (an error reply is already queued).
-bool parse_frames(Frontend* fe, Conn* c) {
+bool parse_frames(Shard* sh, Conn* c) {
   // mu held.
   for (;;) {
     if (c->closing) {  // drop pipelined input behind a fatal reply — the
@@ -1366,13 +1757,13 @@ bool parse_frames(Frontend* fe, Conn* c) {
     uint32_t len = rd_u32(p);
     if (len < kBodyOff || len > kMaxFrame) {
       std::string err = encode_error(0, "bad frame length");
-      send_to_conn(fe, c, err.data(), err.size());
+      send_to_conn(sh, c, err.data(), err.size());
       return false;
     }
     if (avail < 4 + size_t(len)) break;
     const uint8_t* body = p + 4;
     c->in_off += 4 + len;
-    if (!handle_frame(fe, c, body, len)) return false;
+    if (!handle_frame(sh, c, body, len)) return false;
   }
   // Compact the read buffer once the parsed prefix dominates.
   if (c->in_off > 0 && (c->in_off == c->in.size() || c->in_off > 65536)) {
@@ -1380,80 +1771,80 @@ bool parse_frames(Frontend* fe, Conn* c) {
     c->in_off = 0;
   }
   // One send() for the whole burst's queued replies (tier-0/PING).
-  flush_queued(fe, c);
+  flush_queued(sh, c);
   return true;
 }
 
-void arm_deadline(Frontend* fe) {
+void arm_deadline(Shard* sh) {
   // mu held. Arm the timerfd for the oldest pending request's flush
   // deadline (ns precision — this is why not epoll_wait's ms timeout).
   itimerspec its{};
-  if (!fe->pending.empty()) {
-    uint64_t due = fe->pending_oldest_ns + fe->deadline_ns;
+  if (!sh->pending.empty()) {
+    uint64_t due = sh->pending_oldest_ns + sh->deadline_ns;
     uint64_t now = now_ns();
     uint64_t delta = due > now ? due - now : 1;
     its.it_value.tv_sec = time_t(delta / 1000000000ull);
     its.it_value.tv_nsec = long(delta % 1000000000ull);
   }  // pending empty => zero itimerspec disarms
-  timerfd_settime(fe->tfd, 0, &its, nullptr);
+  timerfd_settime(sh->tfd, 0, &its, nullptr);
 }
 
-void io_loop(Frontend* fe) {
+void io_loop(Shard* sh) {
   epoll_event events[128];
   for (;;) {
-    int n = epoll_wait(fe->epfd, events, 128, -1);
-    if (fe->stopping.load()) break;
+    int n = epoll_wait(sh->epfd, events, 128, -1);
+    if (sh->owner->stopping.load()) break;
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
-    std::unique_lock<FeMutex> lk(fe->mu);
+    std::unique_lock<FeMutex> lk(sh->mu);
     for (int i = 0; i < n; i++) {
       uint64_t tag = events[i].data.u64;
       if (tag == 0) {  // listen socket
         for (;;) {
-          int cfd = accept4(fe->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          int cfd = accept4(sh->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
           if (cfd < 0) break;
           int one = 1;
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
           Conn* c = new Conn();
           c->fd = cfd;
-          c->id = fe->next_conn_id++;
-          c->authed = !fe->require_auth;
-          fe->conns[c->id] = c;
-          fe->connections_served++;
+          c->id = sh->next_conn_id++;
+          c->authed = !sh->require_auth;
+          sh->conns[c->id] = c;
+          sh->connections_served++;
           epoll_event ev{};
           ev.events = EPOLLIN;
           ev.data.u64 = c->id;
-          epoll_ctl(fe->epfd, EPOLL_CTL_ADD, cfd, &ev);
+          epoll_ctl(sh->epfd, EPOLL_CTL_ADD, cfd, &ev);
         }
         continue;
       }
       if (tag == 1) {  // eventfd: stop/wake
         uint64_t junk;
-        while (read(fe->evfd, &junk, 8) == 8) {
+        while (read(sh->evfd, &junk, 8) == 8) {
         }
         continue;
       }
       if (tag == 2) {  // timerfd: flush deadline
         uint64_t junk;
-        while (read(fe->tfd, &junk, 8) == 8) {
+        while (read(sh->tfd, &junk, 8) == 8) {
         }
-        flush_pending(fe, /*include_tail=*/true);  // deadline: all due
+        flush_pending(sh, /*include_tail=*/true);  // deadline: all due
         continue;
       }
-      auto itc = fe->conns.find(tag);
-      if (itc == fe->conns.end()) continue;  // closed earlier this burst
+      auto itc = sh->conns.find(tag);
+      if (itc == sh->conns.end()) continue;  // closed earlier this burst
       Conn* c = itc->second;
       uint32_t evs = events[i].events;
       if (evs & (EPOLLHUP | EPOLLERR)) {
-        close_conn(fe, c);
+        close_conn(sh, c);
         continue;
       }
       if (evs & EPOLLOUT) {
-        flush_out(fe, c);
-        itc = fe->conns.find(tag);
-        if (itc == fe->conns.end()) continue;  // flush closed it
+        flush_out(sh, c);
+        itc = sh->conns.find(tag);
+        if (itc == sh->conns.end()) continue;  // flush closed it
       }
       if (evs & EPOLLIN) {
         bool eof = false, ok = true;
@@ -1464,7 +1855,7 @@ void io_loop(Frontend* fe) {
             c->in.insert(c->in.end(), buf, buf + r);
             if (c->in.size() - c->in_off > 2 * size_t(kMaxFrame) + 4) {
               // Parse eagerly so a pipelining client can't balloon RAM.
-              ok = parse_frames(fe, c);
+              ok = parse_frames(sh, c);
               if (!ok) break;
             }
             continue;
@@ -1477,13 +1868,13 @@ void io_loop(Frontend* fe) {
           eof = true;  // ECONNRESET et al.
           break;
         }
-        if (ok) ok = parse_frames(fe, c);
+        if (ok) ok = parse_frames(sh, c);
         if (!ok || eof) {
           if (!ok && !c->out.empty()) {
             c->closing = true;  // let the error reply drain first
-            flush_out(fe, c);
+            flush_out(sh, c);
           } else {
-            close_conn(fe, c);
+            close_conn(sh, c);
           }
           continue;
         }
@@ -1491,37 +1882,37 @@ void io_loop(Frontend* fe) {
     }
     // Flush decision once per event burst (so one TCP segment's worth of
     // pipelined frames coalesces into one batch, not N):
-    if (!fe->pending.empty()) {
+    if (!sh->pending.empty()) {
       // "Idle" means nothing is queued for OR being served by Python
       // (ready empty AND inflight empty): batching only pays when a
       // flush is already running — while one is, arrivals accumulate so
       // the batch size adapts to load (same reasoning as MicroBatcher's
       // flush-on-idle, benchmarks/RESULTS.md).
-      bool idle_pump = fe->pump_waiting && fe->ready.empty() &&
-                       fe->pt.empty() && fe->inflight.empty() &&
-                       fe->bulk_ready.empty() && fe->bulk_inflight.empty();
-      bool due = now_ns() >= fe->pending_oldest_ns + fe->deadline_ns;
-      if (fe->pending.size() >= fe->max_batch || idle_pump || due) {
+      bool idle_pump = sh->pump_waiting && sh->ready.empty() &&
+                       sh->pt.empty() && sh->inflight.empty() &&
+                       sh->bulk_ready.empty() && sh->bulk_inflight.empty();
+      bool due = now_ns() >= sh->pending_oldest_ns + sh->deadline_ns;
+      if (sh->pending.size() >= sh->max_batch || idle_pump || due) {
         // Size-only trigger holds the sub-max_batch tail to coalesce;
         // idle/deadline triggers drain it (see flush_pending).
-        flush_pending(fe, /*include_tail=*/idle_pump || due);
+        flush_pending(sh, /*include_tail=*/idle_pump || due);
       }
     }
-    arm_deadline(fe);
+    arm_deadline(sh);
   }
   // Shutdown: fail the pump out of its wait and close every socket.
-  std::lock_guard<FeMutex> lk(fe->mu);
-  for (auto& [id, c] : fe->conns) {
+  std::lock_guard<FeMutex> lk(sh->mu);
+  for (auto& [id, c] : sh->conns) {
     ::close(c->fd);
     delete c;
   }
-  fe->conns.clear();
-  fe->cv.notify_all();
+  sh->conns.clear();
+  sh->cv.notify_all();
 }
 
-void wake_io(Frontend* fe) {
+void wake_io(Shard* sh) {
   uint64_t one = 1;
-  ssize_t r = write(fe->evfd, &one, 8);
+  ssize_t r = write(sh->evfd, &one, 8);
   (void)r;
 }
 
@@ -1529,106 +1920,184 @@ void wake_io(Frontend* fe) {
 
 extern "C" {
 
-void* fe_start(const char* host, int port, int max_batch, int deadline_us,
-               int require_auth) {
+void* fe_start_sharded(const char* host, int port, int max_batch,
+                       int deadline_us, int require_auth, int nshards,
+                       int pin_cpus) {
+  if (nshards < 1) nshards = 1;
+  if (nshards > kMaxShards) nshards = kMaxShards;
   Frontend* fe = new Frontend();
+  fe->nshards = nshards;
   fe->max_batch = size_t(max_batch > 0 ? max_batch : 4096);
   fe->deadline_ns = uint64_t(deadline_us > 0 ? deadline_us : 300) * 1000ull;
   fe->require_auth = require_auth != 0;
 
-  fe->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (fe->listen_fd < 0) {
-    delete fe;
-    return nullptr;
-  }
-  int one = 1;
-  setsockopt(fe->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(port));
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fe->listen_fd);
+  bool bad_host = inet_pton(AF_INET, host, &addr.sin_addr) != 1;
+  bool failed = bad_host;
+  for (int i = 0; i < nshards && !failed; i++) {
+    Shard* sh = new Shard();
+    sh->owner = fe;
+    sh->index = i;
+    sh->max_batch = fe->max_batch;
+    sh->deadline_ns = fe->deadline_ns;
+    sh->require_auth = fe->require_auth;
+    fe->shards.push_back(sh);
+    sh->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (sh->listen_fd < 0) {
+      failed = true;
+      break;
+    }
+    int one = 1;
+    setsockopt(sh->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    if (nshards > 1 &&
+        setsockopt(sh->listen_fd, SOL_SOCKET, SO_REUSEPORT, &one,
+                   sizeof one) < 0) {
+      // SO_REUSEPORT must be set on EVERY listener before bind (the
+      // first included — later binds can only join a reuseport group
+      // the first opted into). The kernel then hashes each incoming
+      // connection's 4-tuple across the group: accept balancing with
+      // no dispatch thread. Single-shard keeps the round-10 posture
+      // (no REUSEPORT), so `fe_start` behavior is bit-identical.
+      failed = true;
+      break;
+    }
+    if (bind(sh->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof addr) < 0 ||
+        listen(sh->listen_fd, 512) < 0) {
+      failed = true;
+      break;
+    }
+    if (i == 0) {
+      // Port 0 resolves on the first bind; `addr` then carries the
+      // resolved port so the sibling shards join the same group.
+      socklen_t alen = sizeof addr;
+      getsockname(sh->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                  &alen);
+      fe->port = ntohs(addr.sin_port);
+    }
+    sh->epfd = epoll_create1(0);
+    sh->evfd = eventfd(0, EFD_NONBLOCK);
+    sh->tfd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = 0;
+    epoll_ctl(sh->epfd, EPOLL_CTL_ADD, sh->listen_fd, &ev);
+    ev.data.u64 = 1;
+    epoll_ctl(sh->epfd, EPOLL_CTL_ADD, sh->evfd, &ev);
+    ev.data.u64 = 2;
+    epoll_ctl(sh->epfd, EPOLL_CTL_ADD, sh->tfd, &ev);
+  }
+  if (failed) {
+    for (Shard* sh : fe->shards) {
+      if (sh->listen_fd >= 0) ::close(sh->listen_fd);
+      if (sh->epfd >= 0) ::close(sh->epfd);
+      if (sh->evfd >= 0) ::close(sh->evfd);
+      if (sh->tfd >= 0) ::close(sh->tfd);
+      delete sh;
+    }
     delete fe;
     return nullptr;
   }
-  if (bind(fe->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) <
-          0 ||
-      listen(fe->listen_fd, 512) < 0) {
-    ::close(fe->listen_fd);
-    delete fe;
-    return nullptr;
+  for (int i = 0; i < nshards; i++) fe->t0parts.push_back(new T0Part());
+  // Optional affinity: shard i -> the i-th CPU of the set this process
+  // is ALLOWED to run on (taskset/numactl/cgroup cpusets shrink it —
+  // absolute CPU ids would silently fail pthread_setaffinity_np under
+  // exactly the NUMA workflow docs/OPERATIONS.md par.12 recommends).
+  std::vector<int> allowed;
+  if (pin_cpus != 0) {
+    cpu_set_t mask;
+    CPU_ZERO(&mask);
+    if (sched_getaffinity(0, sizeof mask, &mask) == 0) {
+      for (int c = 0; c < CPU_SETSIZE; c++) {
+        if (CPU_ISSET(c, &mask)) allowed.push_back(c);
+      }
+    }
   }
-  socklen_t alen = sizeof addr;
-  getsockname(fe->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
-  fe->port = ntohs(addr.sin_port);
-
-  fe->epfd = epoll_create1(0);
-  fe->evfd = eventfd(0, EFD_NONBLOCK);
-  fe->tfd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.u64 = 0;
-  epoll_ctl(fe->epfd, EPOLL_CTL_ADD, fe->listen_fd, &ev);
-  ev.data.u64 = 1;
-  epoll_ctl(fe->epfd, EPOLL_CTL_ADD, fe->evfd, &ev);
-  ev.data.u64 = 2;
-  epoll_ctl(fe->epfd, EPOLL_CTL_ADD, fe->tfd, &ev);
-
-  fe->io = std::thread(io_loop, fe);
+  for (int i = 0; i < nshards; i++) {
+    Shard* sh = fe->shards[size_t(i)];
+    sh->io = std::thread(io_loop, sh);
+    if (!allowed.empty()) {
+      cpu_set_t cpus;
+      CPU_ZERO(&cpus);
+      CPU_SET(allowed[size_t(i) % allowed.size()], &cpus);
+      pthread_setaffinity_np(sh->io.native_handle(), sizeof cpus, &cpus);
+    }
+  }
   return fe;
 }
 
-int fe_port(void* h) { return static_cast<Frontend*>(h)->port; }
+void* fe_start(const char* host, int port, int max_batch, int deadline_us,
+               int require_auth) {
+  // Single-shard compatibility entry (an older Python half calls only
+  // this): one listener, no SO_REUSEPORT — the round-10 behavior.
+  return fe_start_sharded(host, port, max_batch, deadline_us,
+                          require_auth, 1, 0);
+}
+
+int fe_shard_count(void* h) { return owner_of(h)->nshards; }
+
+// Per-shard sub-handle, valid for every fe_* entry point: fe_wait /
+// fe_batch_* / fe_bulk_* / fe_send / fe_complete address per-shard
+// state (each Python pump thread drives exactly one shard), and the
+// stats/harvest entries give the per-shard breakdown with it where the
+// Frontend handle gives the whole-node merge.
+void* fe_shard(void* h, int index) {
+  Frontend* fe = owner_of(h);
+  if (index < 0 || index >= fe->nshards) return nullptr;
+  return fe->shards[size_t(index)];
+}
+
+int fe_port(void* h) { return owner_of(h)->port; }
 
 // Wait for work: 1 = batch ready (use fe_batch_*), 2 = passthrough frame
 // (use fe_pt_*), 3 = bulk residue job (use fe_bulk_*), 0 = timeout,
-// -1 = stopping.
+// -1 = stopping. Per-shard: each pump thread waits on its own shard.
 int fe_wait(void* h, int timeout_ms) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::unique_lock<FeMutex> lk(fe->mu);
-  fe->pump_waiting = true;
-  bool got = fe->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-    return fe->stopping.load() || !fe->pt.empty() || !fe->ready.empty() ||
-           !fe->bulk_ready.empty();
+  Shard* sh = shard_of(h);
+  std::unique_lock<FeMutex> lk(sh->mu);
+  sh->pump_waiting = true;
+  bool got = sh->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    return sh->owner->stopping.load() || !sh->pt.empty() ||
+           !sh->ready.empty() || !sh->bulk_ready.empty();
   });
-  fe->pump_waiting = false;
-  if (fe->stopping.load()) return -1;
+  sh->pump_waiting = false;
+  if (sh->owner->stopping.load()) return -1;
   if (!got) return 0;
   // Control ops first so STATS/HELLO can't starve behind a hot-batch
   // stream; all queues drain promptly because the pump never blocks.
-  if (!fe->pt.empty()) {
-    fe->cur_pt = std::move(fe->pt.front());
-    fe->pt.pop_front();
+  if (!sh->pt.empty()) {
+    sh->cur_pt = std::move(sh->pt.front());
+    sh->pt.pop_front();
     return 2;
   }
-  if (!fe->ready.empty()) {
-    Batch b = std::move(fe->ready.front());
-    fe->ready.pop_front();
-    fe->cur_batch_id = b.id;
-    fe->inflight.emplace(b.id, std::move(b));
+  if (!sh->ready.empty()) {
+    Batch b = std::move(sh->ready.front());
+    sh->ready.pop_front();
+    sh->cur_batch_id = b.id;
+    sh->inflight.emplace(b.id, std::move(b));
     return 1;
   }
-  fe->cur_bulk_id = fe->bulk_ready.front();
-  fe->bulk_ready.pop_front();
+  sh->cur_bulk_id = sh->bulk_ready.front();
+  sh->bulk_ready.pop_front();
   return 3;
 }
 
-long long fe_batch_id(void* h) {
-  return static_cast<Frontend*>(h)->cur_batch_id;
-}
+long long fe_batch_id(void* h) { return shard_of(h)->cur_batch_id; }
 
 int fe_batch_n(void* h) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(fe->cur_batch_id);
-  return it == fe->inflight.end() ? 0 : int(it->second.items.size());
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(sh->cur_batch_id);
+  return it == sh->inflight.end() ? 0 : int(it->second.items.size());
 }
 
 long long fe_batch_key_bytes(void* h) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(fe->cur_batch_id);
-  if (it == fe->inflight.end()) return 0;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(sh->cur_batch_id);
+  if (it == sh->inflight.end()) return 0;
   long long total = 0;
   for (const Item& item : it->second.items) total += (long long)item.key.size();
   return total;
@@ -1639,10 +2108,10 @@ long long fe_batch_key_bytes(void* h) {
 void fe_batch_copy(void* h, char* key_blob, int32_t* klens, int32_t* counts,
                    uint8_t* ops, uint32_t* seqs, uint64_t* conn_ids,
                    double* as, double* bs) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(fe->cur_batch_id);
-  if (it == fe->inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(sh->cur_batch_id);
+  if (it == sh->inflight.end()) return;
   size_t off = 0;
   size_t i = 0;
   for (const Item& item : it->second.items) {
@@ -1663,10 +2132,10 @@ void fe_batch_copy(void* h, char* key_blob, int32_t* klens, int32_t* counts,
 // checks before paying fe_batch_traces' array allocations (at 1% head
 // sampling ~99% of batches carry none).
 int fe_batch_traced_n(void* h) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(fe->cur_batch_id);
-  if (it == fe->inflight.end()) return 0;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(sh->cur_batch_id);
+  if (it == sh->inflight.end()) return 0;
   int n = 0;
   for (const Item& item : it->second.items) n += item.tr_flags & 1;
   return n;
@@ -1677,10 +2146,10 @@ int fe_batch_traced_n(void* h) {
 // call between fe_wait returning 1 and fe_complete/fe_fail.
 void fe_batch_traces(void* h, uint64_t* hi, uint64_t* lo, uint64_t* parent,
                      uint8_t* flags) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(fe->cur_batch_id);
-  if (it == fe->inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(sh->cur_batch_id);
+  if (it == sh->inflight.end()) return;
   size_t i = 0;
   for (const Item& item : it->second.items) {
     hi[i] = item.tr_hi;
@@ -1692,22 +2161,35 @@ void fe_batch_traces(void* h, uint64_t* hi, uint64_t* lo, uint64_t* parent,
 }
 
 // Drain up to `max` traced tier-0 local decisions (6 u64 each: hi, lo,
-// parent, start_ns, dur_ns, meta). Returns the record count.
+// parent, start_ns, dur_ns, meta). Returns the record count. A Frontend
+// handle drains every shard's ring (rotating so a loud shard cannot
+// starve the others); a shard handle drains just that shard.
 int fe_trace_harvest(void* h, uint64_t* out, int max) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
+  Frontend* fe = owner_of(h);
+  std::vector<Shard*> shards = shards_of(h);
+  size_t nsh = shards.size();
+  size_t start = (as_frontend(h) != nullptr && nsh > 1)
+                     ? fe->trace_shard % nsh
+                     : 0;
   int n = 0;
-  while (n < max && !fe->trace_ring.empty()) {
-    const TraceRec& r = fe->trace_ring.front();
-    out[0] = r.hi;
-    out[1] = r.lo;
-    out[2] = r.parent;
-    out[3] = r.start_ns;
-    out[4] = r.dur_ns;
-    out[5] = r.meta;
-    out += 6;
-    n++;
-    fe->trace_ring.pop_front();
+  for (size_t si = 0; si < nsh && n < max; si++) {
+    Shard* sh = shards[(start + si) % nsh];
+    std::lock_guard<FeMutex> lk(sh->mu);
+    while (n < max && !sh->trace_ring.empty()) {
+      const TraceRec& r = sh->trace_ring.front();
+      out[0] = r.hi;
+      out[1] = r.lo;
+      out[2] = r.parent;
+      out[3] = r.start_ns;
+      out[4] = r.dur_ns;
+      out[5] = r.meta;
+      out += 6;
+      n++;
+      sh->trace_ring.pop_front();
+    }
+  }
+  if (as_frontend(h) != nullptr && nsh > 1) {
+    fe->trace_shard = (start + 1) % nsh;
   }
   return n;
 }
@@ -1722,13 +2204,14 @@ constexpr uint8_t kRowSkip = 2;
 
 void fe_complete(void* h, long long batch_id, const uint8_t* granted,
                  const double* remaining) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(batch_id);
-  if (it == fe->inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(batch_id);
+  if (it == sh->inflight.end()) return;
   uint64_t t = now_ns();
   uint64_t t_flush = it->second.t_flush_ns;
   double exec_s = double(t - t_flush) * 1e-9;
+  bool t0_on = sh->owner->t0_enabled.load(std::memory_order_relaxed);
   size_t i = 0;
   for (const Item& item : it->second.items) {
     if (granted[i] == kRowSkip) {
@@ -1737,62 +2220,61 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
     }
     std::string resp =
         encode_decision(item.seq, granted[i] != 0, remaining[i]);
-    auto itc = fe->conns.find(item.conn_id);
-    if (itc != fe->conns.end()) {
-      send_to_conn(fe, itc->second, resp.data(), resp.size());
+    auto itc = sh->conns.find(item.conn_id);
+    if (itc != sh->conns.end()) {
+      send_to_conn(sh, itc->second, resp.data(), resp.size());
     }
-    if (fe->t0.enabled && item.op == OP_ACQUIRE && granted[i] != 0) {
+    if (t0_on && item.op == OP_ACQUIRE && granted[i] != 0) {
       // Every granted fall-through decision is an authoritative balance
-      // observation: seed/refresh the key's tier-0 replica from it —
-      // sized for the grant's token cost (see t0_install).
-      t0_install(fe, item.key, item.a, item.b, remaining[i], t,
-                 double(item.count));
+      // observation: seed/refresh the key's tier-0 replica (in its
+      // OWNER partition) from it — sized for the grant's token cost
+      // (see t0_install).
+      t0_install(t0_slice(sh), item.key, item.a, item.b, remaining[i],
+                 t, double(item.count));
     }
-    hist_record(fe, double(t - item.t_ns) * 1e-9);
-    stage_record(fe, 0, double(t_flush - item.t_ns) * 1e-9);  // queue
-    stage_record(fe, 1, exec_s);  // Python dispatch + store + kernel
-    fe->requests_served++;
+    hist_record(sh, double(t - item.t_ns) * 1e-9);
+    stage_record(sh, 0, double(t_flush - item.t_ns) * 1e-9);  // queue
+    stage_record(sh, 1, exec_s);  // Python dispatch + store + kernel
+    sh->requests_served++;
     i++;
   }
-  fe->inflight.erase(it);
-  maybe_flush_after_complete(fe);
+  sh->inflight.erase(it);
+  maybe_flush_after_complete(sh);
 }
 
 // Fail a batch (store raised): every item gets a routable error reply.
 void fe_fail(void* h, long long batch_id, const char* msg) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->inflight.find(batch_id);
-  if (it == fe->inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->inflight.find(batch_id);
+  if (it == sh->inflight.end()) return;
   uint64_t t = now_ns();
   uint64_t t_flush = it->second.t_flush_ns;
   double exec_s = double(t - t_flush) * 1e-9;
   for (const Item& item : it->second.items) {
     std::string resp = encode_error(item.seq, msg);
-    auto itc = fe->conns.find(item.conn_id);
-    if (itc != fe->conns.end()) {
-      send_to_conn(fe, itc->second, resp.data(), resp.size());
+    auto itc = sh->conns.find(item.conn_id);
+    if (itc != sh->conns.end()) {
+      send_to_conn(sh, itc->second, resp.data(), resp.size());
     }
-    hist_record(fe, double(t - item.t_ns) * 1e-9);
-    stage_record(fe, 0, double(t_flush - item.t_ns) * 1e-9);
-    stage_record(fe, 1, exec_s);
-    fe->requests_served++;
+    hist_record(sh, double(t - item.t_ns) * 1e-9);
+    stage_record(sh, 0, double(t_flush - item.t_ns) * 1e-9);
+    stage_record(sh, 1, exec_s);
+    sh->requests_served++;
   }
-  fe->inflight.erase(it);
-  maybe_flush_after_complete(fe);
+  sh->inflight.erase(it);
+  maybe_flush_after_complete(sh);
 }
 
 long long fe_pt_conn(void* h) {
-  return (long long)static_cast<Frontend*>(h)->cur_pt.conn_id;
+  return (long long)shard_of(h)->cur_pt.conn_id;
 }
 
-int fe_pt_len(void* h) {
-  return int(static_cast<Frontend*>(h)->cur_pt.frame.size());
-}
+int fe_pt_len(void* h) { return int(shard_of(h)->cur_pt.frame.size()); }
 
 void fe_pt_copy(void* h, char* buf) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::memcpy(buf, fe->cur_pt.frame.data(), fe->cur_pt.frame.size());
+  Shard* sh = shard_of(h);
+  std::memcpy(buf, sh->cur_pt.frame.data(), sh->cur_pt.frame.size());
 }
 
 // Feature probe: this binary's fe_complete honors the kRowSkip
@@ -1802,19 +2284,19 @@ int fe_has_row_skip(void) { return 1; }
 
 // Send a pre-encoded reply frame (passthrough responses).
 void fe_send(void* h, uint64_t conn_id, const char* data, int len) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto itc = fe->conns.find(conn_id);
-  if (itc == fe->conns.end()) return;
-  send_to_conn(fe, itc->second, data, size_t(len));
-  fe->requests_served++;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto itc = sh->conns.find(conn_id);
+  if (itc == sh->conns.end()) return;
+  send_to_conn(sh, itc->second, data, size_t(len));
+  sh->requests_served++;
 }
 
 void fe_set_authed(void* h, uint64_t conn_id, int authed) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto itc = fe->conns.find(conn_id);
-  if (itc == fe->conns.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto itc = sh->conns.find(conn_id);
+  if (itc == sh->conns.end()) return;
   Conn* c = itc->second;
   c->auth_pending = false;
   c->authed = authed != 0;
@@ -1827,7 +2309,7 @@ void fe_set_authed(void* h, uint64_t conn_id, int authed) {
   c->held_bytes = 0;
   bool ok = true;
   for (const std::string& f : held) {
-    if (!handle_frame(fe, c,
+    if (!handle_frame(sh, c,
                       reinterpret_cast<const uint8_t*>(f.data()),
                       f.size())) {
       ok = false;
@@ -1837,233 +2319,310 @@ void fe_set_authed(void* h, uint64_t conn_id, int authed) {
   if (!ok) {
     if (!c->out.empty()) {
       c->closing = true;  // drain the error reply first
-      flush_out(fe, c);
+      flush_out(sh, c);
     } else {
-      close_conn(fe, c);
+      close_conn(sh, c);
     }
   } else {
-    flush_queued(fe, c);  // replayed tier-0/PING replies
+    flush_queued(sh, c);  // replayed tier-0/PING replies
   }
   // Replayed hot items joined `pending` from this (loop) thread: wake
   // the IO thread so its flush/deadline evaluation sees them.
-  wake_io(fe);
+  wake_io(sh);
 }
 
 void fe_close_conn(void* h, uint64_t conn_id) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto itc = fe->conns.find(conn_id);
-  if (itc == fe->conns.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto itc = sh->conns.find(conn_id);
+  if (itc == sh->conns.end()) return;
   Conn* c = itc->second;
   if (c->out.empty()) {
-    close_conn(fe, c);
+    close_conn(sh, c);
   } else {
     c->closing = true;  // drain the goodbye (e.g. auth-failed error) first
   }
 }
 
+// Whole-node counters with a Frontend handle (the sum across shards);
+// one shard's slice with a shard handle — the OP_STATS shards=[...]
+// breakdown.
 void fe_counts(void* h, long long* requests, long long* connections,
                long long* batches) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  *requests = fe->requests_served;
-  *connections = fe->connections_served;
-  *batches = fe->batches_flushed;
+  *requests = *connections = *batches = 0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    *requests += sh->requests_served;
+    *connections += sh->connections_served;
+    *batches += sh->batches_flushed;
+  }
 }
 
 long long fe_hist(void* h, uint64_t* counts) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  std::memcpy(counts, fe->hist, sizeof fe->hist);
-  return fe->hist_total;
+  std::memset(counts, 0, sizeof(uint64_t) * kHistBuckets);
+  long long total = 0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    for (int b = 0; b < kHistBuckets; b++) counts[b] += sh->hist[b];
+    total += sh->hist_total;
+  }
+  return total;
 }
 
 // Per-stage latency histograms (same 82-bucket convention as fe_hist).
 // stage: 0 = serving (arrival -> completion, the fe_hist span), 1 =
 // queue (arrival -> batch cut), 2 = exec (batch cut -> completion).
-// Copies bucket counts into `counts`, writes the running sum of seconds
-// into `sum_out`, returns the sample total. Unknown stage returns -1.
+// Sums across shards for a Frontend handle (log-bucket histograms are
+// closed under addition, so merged quantiles read identically to a
+// single shard's). Copies bucket counts into `counts`, writes the
+// running sum of seconds into `sum_out`, returns the sample total.
+// Unknown stage returns -1.
 long long fe_stage_hist(void* h, int stage, uint64_t* counts,
                         double* sum_out) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  if (stage == 0) {
-    std::memcpy(counts, fe->hist, sizeof fe->hist);
-    *sum_out = fe->hist_sum;
-    return fe->hist_total;
+  if (stage != 0 && (stage - 1 < 0 || stage - 1 >= Shard::kStages)) {
+    return -1;
   }
-  int s = stage - 1;
-  if (s < 0 || s >= Frontend::kStages) return -1;
-  std::memcpy(counts, fe->stage_hist[s], sizeof fe->stage_hist[s]);
-  *sum_out = fe->stage_sum[s];
-  return fe->stage_total[s];
+  std::memset(counts, 0, sizeof(uint64_t) * kHistBuckets);
+  *sum_out = 0.0;
+  long long total = 0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    if (stage == 0) {
+      for (int b = 0; b < kHistBuckets; b++) counts[b] += sh->hist[b];
+      *sum_out += sh->hist_sum;
+      total += sh->hist_total;
+    } else {
+      int s = stage - 1;
+      for (int b = 0; b < kHistBuckets; b++) {
+        counts[b] += sh->stage_hist[s][b];
+      }
+      *sum_out += sh->stage_sum[s];
+      total += sh->stage_total[s];
+    }
+  }
+  return total;
 }
 
 void fe_hist_reset(void* h) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  std::memset(fe->hist, 0, sizeof fe->hist);
-  fe->hist_total = 0;
-  fe->hist_sum = 0.0;
-  std::memset(fe->stage_hist, 0, sizeof fe->stage_hist);
-  std::memset(fe->stage_total, 0, sizeof fe->stage_total);
-  for (int s = 0; s < Frontend::kStages; s++) fe->stage_sum[s] = 0.0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    std::memset(sh->hist, 0, sizeof sh->hist);
+    sh->hist_total = 0;
+    sh->hist_sum = 0.0;
+    std::memset(sh->stage_hist, 0, sizeof sh->stage_hist);
+    std::memset(sh->stage_total, 0, sizeof sh->stage_total);
+    for (int s = 0; s < Shard::kStages; s++) sh->stage_sum[s] = 0.0;
+  }
 }
 
 void fe_stop(void* h) {
-  Frontend* fe = static_cast<Frontend*>(h);
+  Frontend* fe = owner_of(h);
   fe->stopping.store(true);
-  wake_io(fe);
-  {
-    std::lock_guard<FeMutex> lk(fe->mu);
-    fe->cv.notify_all();
+  for (Shard* sh : fe->shards) {
+    wake_io(sh);
+    {
+      std::lock_guard<FeMutex> lk(sh->mu);
+      sh->cv.notify_all();
+    }
+    if (sh->io.joinable()) sh->io.join();
+    ::close(sh->listen_fd);
+    ::close(sh->epfd);
+    ::close(sh->evfd);
+    ::close(sh->tfd);
   }
-  if (fe->io.joinable()) fe->io.join();
-  ::close(fe->listen_fd);
-  ::close(fe->epfd);
-  ::close(fe->evfd);
-  ::close(fe->tfd);
 }
 
-void fe_free(void* h) { delete static_cast<Frontend*>(h); }
+void fe_free(void* h) {
+  Frontend* fe = owner_of(h);
+  for (Shard* sh : fe->shards) delete sh;
+  for (T0Part* part : fe->t0parts) delete part;
+  delete fe;
+}
 
 // ---------------------------------------------------------------------
-// Tier-0 admission cache ABI (see the T0Entry block above). All calls
-// take the global mutex; the harvest/ack pair is driven by the Python
-// sync pump (runtime/native_frontend.py _t0_sync_loop).
+// Tier-0 admission cache ABI (see the T0Part block above). The table is
+// partitioned by key hash across the shards; all calls below take
+// partition mutexes only (never a shard's connection mutex), and the
+// harvest/ack pair is driven by the ONE Python sync pump
+// (runtime/native_frontend.py _t0_sync_loop) regardless of shard count
+// — a single reconciliation stream, a single epsilon envelope.
 // ---------------------------------------------------------------------
 
-// Enable tier-0 with a bounded replica table. Returns the (power-of-two
-// rounded) slot count actually allocated.
+// Enable tier-0 with a bounded replica table. `slots` sizes EACH
+// shard's slice (rounded up to a power of two): any shard can see any
+// key, so every slice needs full-keyspace capacity — table memory is
+// nshards × slots × (entry + key). Budgets are divided by the shard
+// count inside t0_budget_of, so the summed per-shard headroom stays
+// inside the flat single-shard envelope (see T0Part). Returns the
+// total slot count actually allocated (the Python pump sizes harvest
+// buffers from it — a harvest can return one row per shard per key).
 int fe_t0_configure(void* h, int slots, double fraction, double min_budget,
                     double max_budget, int stale_ms, int ttl_ms) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  size_t n = 1;
-  while (n < size_t(slots > 0 ? slots : 4096)) n <<= 1;
-  fe->t0tab.assign(n, T0Entry{});
-  fe->t0.mask = n - 1;
-  fe->t0.fraction = fraction > 0 ? fraction : 0.5;
-  fe->t0.min_budget = min_budget > 0 ? min_budget : 1.0;
-  fe->t0.max_budget = max_budget > 0 ? max_budget : 1048576.0;
-  fe->t0.stale_ns =
-      uint64_t(stale_ms > 0 ? stale_ms : 1000) * 1000000ull;
-  fe->t0.ttl_ns = uint64_t(ttl_ms > 0 ? ttl_ms : 30000) * 1000000ull;
-  fe->t0.enabled = true;
-  return int(n);
+  Frontend* fe = owner_of(h);
+  size_t want = size_t(slots > 0 ? slots : 4096);
+  size_t per = 1;
+  while (per < want) per <<= 1;
+  T0Config cfg;
+  cfg.mask = per - 1;
+  cfg.split = double(fe->nshards);
+  cfg.fraction = fraction > 0 ? fraction : 0.5;
+  cfg.min_budget = min_budget > 0 ? min_budget : 1.0;
+  cfg.max_budget = max_budget > 0 ? max_budget : 1048576.0;
+  cfg.stale_ns = uint64_t(stale_ms > 0 ? stale_ms : 1000) * 1000000ull;
+  cfg.ttl_ns = uint64_t(ttl_ms > 0 ? ttl_ms : 30000) * 1000000ull;
+  for (T0Part* part : fe->t0parts) {
+    std::lock_guard<T0SpinMutex> lk(part->mu);
+    part->cfg = cfg;
+    part->tab.assign(per, T0Entry{});
+    part->scan = 0;
+  }
+  fe->t0_enabled.store(true, std::memory_order_release);
+  return int(per * size_t(fe->nshards));
 }
 
 // Drain accumulated local grants: copies up to max_n (key, amount, cap,
 // rate) rows out (key_blob concatenated, klens delimiting) and zeroes
 // each entry's pending. Entries that do not fit stay pending for the
-// next round — the scan resumes from a rotating cursor, so an
-// overflowing round cannot starve the tail of the table (every entry's
-// grants reconcile within a bounded number of rounds). Idle
-// pending-free entries are TTL-evicted in the same pass. Returns the
-// row count.
+// next round — partitions rotate and each partition's scan resumes
+// from its own cursor, so an overflowing round cannot starve either a
+// partition or the tail of one partition's table. Idle pending-free
+// entries are TTL-evicted in the same pass. Returns the row count.
 int fe_t0_harvest(void* h, char* key_blob, int blob_cap, int32_t* klens,
                   double* amounts, double* caps, double* rates, int max_n) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  size_t total = fe->t0tab.size();
-  if (total == 0) return 0;
+  Frontend* fe = owner_of(h);
+  std::vector<T0Part*> parts = t0parts_of(h);
+  if (parts.empty()) return 0;
   uint64_t now = now_ns();
+  size_t nparts = parts.size();
+  bool rotate = as_frontend(h) != nullptr && nparts > 1;
+  size_t start = rotate ? fe->harvest_part % nparts : 0;
   int n = 0;
   size_t off = 0;
-  size_t i = fe->t0_scan;
-  for (size_t scanned = 0; scanned < total; scanned++, i++) {
-    T0Entry& e = fe->t0tab[i % total];
-    if (!e.live) continue;
-    if (e.pending > 0.0) {
-      if (n >= max_n || off + e.key.size() > size_t(blob_cap)) break;
-      std::memcpy(key_blob + off, e.key.data(), e.key.size());
-      off += e.key.size();
-      klens[n] = int32_t(e.key.size());
-      amounts[n] = e.pending;
-      caps[n] = e.cap;
-      rates[n] = e.rate;
-      e.pending = 0.0;
-      n++;
-    } else if (now - e.last_touch_ns > fe->t0.ttl_ns) {
-      e.live = false;
-      fe->t0_evictions++;
+  bool full = false;
+  for (size_t pi = 0; pi < nparts && !full; pi++) {
+    T0Part* part = parts[(start + pi) % nparts];
+    std::lock_guard<T0SpinMutex> lk(part->mu);
+    size_t total = part->tab.size();
+    if (total == 0) continue;
+    size_t i = part->scan;
+    for (size_t scanned = 0; scanned < total; scanned++, i++) {
+      T0Entry& e = part->tab[i % total];
+      if (!e.live) continue;
+      if (e.pending > 0.0) {
+        if (n >= max_n || off + e.key.size() > size_t(blob_cap)) {
+          full = true;
+          break;
+        }
+        std::memcpy(key_blob + off, e.key.data(), e.key.size());
+        off += e.key.size();
+        klens[n] = int32_t(e.key.size());
+        amounts[n] = e.pending;
+        caps[n] = e.cap;
+        rates[n] = e.rate;
+        e.pending = 0.0;
+        n++;
+      } else if (now - e.last_touch_ns > part->cfg.ttl_ns) {
+        e.live = false;
+        part->evictions++;
+      }
     }
+    part->scan = i % total;
+    if (full && rotate) fe->harvest_part = (start + pi) % nparts;
   }
-  fe->t0_scan = i % total;  // resume where the scan stopped
+  if (!full && rotate) fe->harvest_part = (start + 1) % nparts;
   return n;
 }
 
-// Complete a sync round: install fresh authoritative balances for the
-// harvested keys and recompute their budgets. Grants made after the
-// harvest (still in `pending`) remain outstanding against the new
-// envelope; the drained portion is reflected in the balance itself.
+// Complete a sync round: install the fresh authoritative balance into
+// EVERY shard's replica of each harvested key (the Python pump merges
+// per-shard harvest rows by key before the debit, so each key is
+// acked once with the one store balance) and recompute the per-shard
+// budget shares. Grants made after the harvest (still in `pending`)
+// remain outstanding against the new envelope; the drained portion is
+// reflected in the balance itself.
 void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
                const double* caps, const double* rates,
                const double* remainings, int n) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
+  std::vector<T0Part*> parts = t0parts_of(h);
+  if (parts.empty()) return;
   uint64_t now = now_ns();
-  size_t off = 0;
-  for (int i = 0; i < n; i++) {
-    std::string key(key_blob + off, size_t(klens[i]));
-    off += size_t(klens[i]);
-    T0Entry* e = t0_find(fe, key, caps[i], rates[i]);
-    if (e == nullptr) continue;  // evicted while the sync was in flight
-    e->last_remaining = remainings[i];
-    e->admitted = e->pending;
-    e->budget = t0_budget_of(
-        fe->t0, std::max(remainings[i] - e->admitted, 0.0));
-    e->last_ack_ns = now;
-    e->last_touch_ns = now;
+  for (T0Part* part : parts) {
+    std::lock_guard<T0SpinMutex> lk(part->mu);
+    size_t off = 0;
+    for (int i = 0; i < n; i++) {
+      std::string_view key(key_blob + off, size_t(klens[i]));
+      off += size_t(klens[i]);
+      T0Entry* e = t0_find(part, key, t0_hash(key), caps[i], rates[i]);
+      if (e == nullptr) continue;  // not hosted here / evicted mid-sync
+      e->last_remaining = remainings[i];
+      e->admitted = e->pending;
+      e->budget = t0_budget_of(
+          part->cfg, std::max(remainings[i] - e->admitted, 0.0));
+      e->last_ack_ns = now;
+      e->last_touch_ns = now;
+    }
   }
 }
 
 // Live config mutation (round 7): kill every replica of one retired
 // (cap, rate) config and hand back its un-harvested local grants —
 // [key_blob/klens/amounts rows, like fe_t0_harvest] — so the sync pump
-// debits them through the REPLACEMENT config. One call under the lock:
-// no grant can slip between the harvest and the kill. Without the kill,
-// stale frames would keep being admitted (or confidently denied)
-// against a table nobody serves from anymore; dead entries make them
-// fall through to the batch lane's routable "config moved" error.
-// Returns the number of rows written (entries with pending > 0); every
-// matching entry is dead on return regardless.
+// debits them through the REPLACEMENT config. Round 11: the sweep fans
+// out to EVERY partition under ONE combined critical section — all
+// partition locks are taken up front (index order; this is the only
+// multi-partition lock site, so there is no ordering partner to
+// deadlock with). A config retired on shard 0 but still live on shard
+// 3 would be a double-admit window; with the combined section no grant
+// can land on ANY partition between the harvest and the kill. Without
+// the kill, stale frames would keep being admitted (or confidently
+// denied) against a table nobody serves from anymore; dead entries
+// make them fall through to the batch lane's routable "config moved"
+// error. Returns the number of rows written (entries with pending >
+// 0); every matching entry is dead on return regardless.
 int fe_t0_retire(void* h, double cap, double rate, char* key_blob,
                  int blob_cap, int32_t* klens, double* amounts,
                  int max_keys) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
+  std::vector<T0Part*> parts = t0parts_of(h);
+  std::vector<std::unique_lock<T0SpinMutex>> locks;
+  locks.reserve(parts.size());
+  for (T0Part* part : parts) locks.emplace_back(part->mu);
   int n = 0;
   int off = 0;
-  for (T0Entry& e : fe->t0tab) {
-    if (!e.live || e.cap != cap || e.rate != rate) continue;
-    if (e.pending > 0.0 && n < max_keys &&
-        off + int(e.key.size()) <= blob_cap) {
-      std::memcpy(key_blob + off, e.key.data(), e.key.size());
-      klens[n] = int32_t(e.key.size());
-      amounts[n] = e.pending;
-      off += int(e.key.size());
-      n++;
+  for (T0Part* part : parts) {
+    for (T0Entry& e : part->tab) {
+      if (!e.live || e.cap != cap || e.rate != rate) continue;
+      if (e.pending > 0.0 && n < max_keys &&
+          off + int(e.key.size()) <= blob_cap) {
+        std::memcpy(key_blob + off, e.key.data(), e.key.size());
+        klens[n] = int32_t(e.key.size());
+        amounts[n] = e.pending;
+        off += int(e.key.size());
+        n++;
+      }
+      e.live = false;
+      e.pending = 0.0;
+      part->evictions++;
     }
-    e.live = false;
-    e.pending = 0.0;
-    fe->t0_evictions++;
   }
   return n;
 }
 
-// out[6]: hits, local denies, misses, installs, evictions, live entries.
+// out[6]: hits, local denies, misses, installs, evictions, live
+// entries. Frontend handle = summed across partitions (the whole-node
+// gauges); shard handle = that shard's own partition.
 void fe_t0_counts(void* h, long long* out) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  long long live = 0;
-  for (const T0Entry& e : fe->t0tab) live += e.live ? 1 : 0;
-  out[0] = fe->t0_hits;
-  out[1] = fe->t0_local_denies;
-  out[2] = fe->t0_misses;
-  out[3] = fe->t0_installs;
-  out[4] = fe->t0_evictions;
-  out[5] = live;
+  for (int i = 0; i < 6; i++) out[i] = 0;
+  for (T0Part* part : t0parts_of(h)) {
+    std::lock_guard<T0SpinMutex> lk(part->mu);
+    long long live = 0;
+    for (const T0Entry& e : part->tab) live += e.live ? 1 : 0;
+    out[0] += part->hits;
+    out[1] += part->local_denies;
+    out[2] += part->misses;
+    out[3] += part->installs;
+    out[4] += part->evictions;
+    out[5] += live;
+  }
 }
 
 // ---------------------------------------------------------------------
@@ -2076,28 +2635,33 @@ void fe_t0_counts(void* h, long long* out) {
 // Python's residue verdicts, encodes RESP_BULK, and answers the
 // client. The ptrs stay valid until the job is erased — Python's
 // KeyBlob views read them in place (zero copy, zero UTF-8 decode).
+// Jobs are per-shard state: the pump thread that pulled the job from
+// fe_wait completes it against the same shard handle.
 // ---------------------------------------------------------------------
 
+// Arm/disarm the lane on every shard of the handle — one call, all
+// shards, so a frame arriving on shard 3 mid-configure can at worst
+// see the OLD whole-lane mode, never a half-armed mix on its own
+// shard.
 int fe_bulk_configure(void* h, int enable, int t0_rows, int hot_feed) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  fe->bulk_native = enable != 0;
-  fe->bulk_t0 = t0_rows != 0;
-  fe->bulk_hot = hot_feed != 0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    sh->bulk_native = enable != 0;
+    sh->bulk_t0 = t0_rows != 0;
+    sh->bulk_hot = hot_feed != 0;
+  }
   return 1;
 }
 
-long long fe_bulk_id(void* h) {
-  return static_cast<Frontend*>(h)->cur_bulk_id;
-}
+long long fe_bulk_id(void* h) { return shard_of(h)->cur_bulk_id; }
 
 // u[11]: job id, conn id, seq, flags, n, blob bytes, residue rows,
 // trace hi/lo/parent, trace flags. f[2]: a, b. Job id 0 = no job.
 void fe_bulk_meta(void* h, unsigned long long* u, double* f) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->bulk_inflight.find(fe->cur_bulk_id);
-  if (it == fe->bulk_inflight.end()) {
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->bulk_inflight.find(sh->cur_bulk_id);
+  if (it == sh->bulk_inflight.end()) {
     u[0] = 0;
     return;
   }
@@ -2120,10 +2684,10 @@ void fe_bulk_meta(void* h, unsigned long long* u, double* f) {
 // ptrs[4]: key blob, offsets (i64[n+1]), counts (i64[n]), residue
 // (i32[residue_n]) — addresses into the job, stable until it is erased.
 void fe_bulk_ptrs(void* h, unsigned long long* ptrs) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->bulk_inflight.find(fe->cur_bulk_id);
-  if (it == fe->bulk_inflight.end()) {
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->bulk_inflight.find(sh->cur_bulk_id);
+  if (it == sh->bulk_inflight.end()) {
     ptrs[0] = ptrs[1] = ptrs[2] = ptrs[3] = 0;
     return;
   }
@@ -2140,21 +2704,22 @@ void fe_bulk_ptrs(void* h, unsigned long long* ptrs) {
 // RESP_BULK reply, and answer the client.
 void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
                       const double* remaining) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->bulk_inflight.find(job_id);
-  if (it == fe->bulk_inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->bulk_inflight.find(job_id);
+  if (it == sh->bulk_inflight.end()) return;
   BulkJob& job = it->second;
   uint64_t t = now_ns();
+  bool t0_on = sh->owner->t0_enabled.load(std::memory_order_relaxed);
   for (size_t r = 0; r < job.residue.size(); r++) {
     size_t i = size_t(job.residue[r]);
     job.verdict[i] = granted[r] ? 1 : 0;
     job.remaining[i] = float(remaining[r]);
-    if (fe->t0.enabled && fe->bulk_t0 && job.kind == BULK_KIND_BUCKET &&
+    if (t0_on && sh->bulk_t0 && job.kind == BULK_KIND_BUCKET &&
         granted[r] && job.with_remaining && job.counts[i] > 0) {
       size_t klen = size_t(job.offsets[i + 1] - job.offsets[i]);
       if (klen <= kT0MaxKey) {
-        t0_install(fe,
+        t0_install(t0_slice(sh),
                    std::string(job.blob.data() + job.offsets[i], klen),
                    job.a, job.b, remaining[r], t,
                    double(job.counts[i]));
@@ -2164,19 +2729,19 @@ void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
   std::string resp = encode_bulk_reply(job.seq, job.with_remaining,
                                        job.n, job.verdict.data(),
                                        job.remaining.data());
-  auto itc = fe->conns.find(job.conn_id);
-  if (itc != fe->conns.end()) {
-    send_to_conn(fe, itc->second, resp.data(), resp.size());
+  auto itc = sh->conns.find(job.conn_id);
+  if (itc != sh->conns.end()) {
+    send_to_conn(sh, itc->second, resp.data(), resp.size());
   }
   if (job.tr_flags & 1) {
     bool all = true;
     for (uint32_t i = 0; i < job.n; i++) all = all && job.verdict[i] == 1;
-    trace_ring_push_raw(fe, job.tr_hi, job.tr_lo, job.tr_parent,
+    trace_ring_push_raw(sh, job.tr_hi, job.tr_lo, job.tr_parent,
                         job.tr_flags, OP_ACQUIRE_MANY, all, job.t_ns, t);
   }
-  hist_record(fe, double(t - job.t_ns) * 1e-9);
-  fe->requests_served++;
-  finish_bulk_job(fe, job_id);
+  hist_record(sh, double(t - job.t_ns) * 1e-9);
+  sh->requests_served++;
+  finish_bulk_job(sh, job_id);
 }
 
 // Drop a job whose frame Python already answered wholesale via fe_send
@@ -2184,65 +2749,84 @@ void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
 // whole-frame edition). fe_send counted the request; this only records
 // latency and un-parks chained successors.
 void fe_bulk_discard(void* h, long long job_id) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->bulk_inflight.find(job_id);
-  if (it == fe->bulk_inflight.end()) return;
-  hist_record(fe, double(now_ns() - it->second.t_ns) * 1e-9);
-  finish_bulk_job(fe, job_id);
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->bulk_inflight.find(job_id);
+  if (it == sh->bulk_inflight.end()) return;
+  hist_record(sh, double(now_ns() - it->second.t_ns) * 1e-9);
+  finish_bulk_job(sh, job_id);
 }
 
 // Fail a job (store raised): the frame gets one routable error reply.
 void fe_bulk_fail(void* h, long long job_id, const char* msg) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  auto it = fe->bulk_inflight.find(job_id);
-  if (it == fe->bulk_inflight.end()) return;
+  Shard* sh = shard_of(h);
+  std::lock_guard<FeMutex> lk(sh->mu);
+  auto it = sh->bulk_inflight.find(job_id);
+  if (it == sh->bulk_inflight.end()) return;
   BulkJob& job = it->second;
   std::string resp = encode_error(job.seq, msg);
-  auto itc = fe->conns.find(job.conn_id);
-  if (itc != fe->conns.end()) {
-    send_to_conn(fe, itc->second, resp.data(), resp.size());
+  auto itc = sh->conns.find(job.conn_id);
+  if (itc != sh->conns.end()) {
+    send_to_conn(sh, itc->second, resp.data(), resp.size());
   }
-  hist_record(fe, double(now_ns() - job.t_ns) * 1e-9);
-  fe->requests_served++;
-  finish_bulk_job(fe, job_id);
+  hist_record(sh, double(now_ns() - job.t_ns) * 1e-9);
+  sh->requests_served++;
+  finish_bulk_job(sh, job_id);
 }
 
 // out[7]: frames, frames decided fully in C, rows, rows decided
 // locally (tier-0 grant/deny), residue rows, locally granted permits
-// (the amount the sync pump debits), hot-ring drops.
+// (the amount the sync pump debits), hot-ring drops. Frontend handle =
+// summed across shards; shard handle = that shard's slice.
 void fe_bulk_counts(void* h, long long* out) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
-  out[0] = fe->bulk_frames;
-  out[1] = fe->bulk_frames_local;
-  out[2] = fe->bulk_rows;
-  out[3] = fe->bulk_rows_local;
-  out[4] = fe->bulk_rows_residue;
-  out[5] = (long long)fe->bulk_permits_local;
-  out[6] = fe->hot_dropped;
+  for (int i = 0; i < 7; i++) out[i] = 0;
+  for (Shard* sh : shards_of(h)) {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    out[0] += sh->bulk_frames;
+    out[1] += sh->bulk_frames_local;
+    out[2] += sh->bulk_rows;
+    out[3] += sh->bulk_rows_local;
+    out[4] += sh->bulk_rows_residue;
+    out[5] += (long long)sh->bulk_permits_local;
+    out[6] += sh->hot_dropped;
+  }
 }
 
 // Drain up to max_n aggregated (key, weight) hot-key rows from the
-// bulk lane's ring (key_blob concatenated, klens delimiting) — the
-// pump offers them to the heavy-hitter sketch. Returns the row count.
+// bulk lanes' rings (key_blob concatenated, klens delimiting) — the
+// pump offers them to the heavy-hitter sketch. Each shard keeps its
+// own ring; the ONE harvest pump drains them all (rotating), so the
+// sketch — and therefore split_hot_keys — still sees whole-node ranks.
+// Returns the row count.
 int fe_hot_harvest(void* h, char* key_blob, int blob_cap, int32_t* klens,
                    double* weights, int max_n) {
-  Frontend* fe = static_cast<Frontend*>(h);
-  std::lock_guard<FeMutex> lk(fe->mu);
+  Frontend* fe = owner_of(h);
+  std::vector<Shard*> shards = shards_of(h);
+  size_t nsh = shards.size();
+  bool rotate = as_frontend(h) != nullptr && nsh > 1;
+  size_t start = rotate ? fe->hot_shard % nsh : 0;
   int n = 0;
   int off = 0;
-  while (n < max_n && !fe->hot_ring.empty()) {
-    const auto& front = fe->hot_ring.front();
-    if (off + int(front.first.size()) > blob_cap) break;
-    std::memcpy(key_blob + off, front.first.data(), front.first.size());
-    klens[n] = int32_t(front.first.size());
-    weights[n] = front.second;
-    off += int(front.first.size());
-    n++;
-    fe->hot_ring.pop_front();
+  bool full = false;
+  for (size_t si = 0; si < nsh && !full; si++) {
+    Shard* sh = shards[(start + si) % nsh];
+    std::lock_guard<FeMutex> lk(sh->mu);
+    while (!sh->hot_ring.empty()) {
+      const auto& front = sh->hot_ring.front();
+      if (n >= max_n || off + int(front.first.size()) > blob_cap) {
+        full = true;
+        break;
+      }
+      std::memcpy(key_blob + off, front.first.data(), front.first.size());
+      klens[n] = int32_t(front.first.size());
+      weights[n] = front.second;
+      off += int(front.first.size());
+      n++;
+      sh->hot_ring.pop_front();
+    }
+    if (full && rotate) fe->hot_shard = (start + si) % nsh;
   }
+  if (!full && rotate) fe->hot_shard = (start + 1) % nsh;
   return n;
 }
 
@@ -2389,6 +2973,193 @@ int fe_loadgen(const char* host, int port, int n_conns, int depth,
   }
   *out_elapsed_s = double(now_ns() - t0) * 1e-9;
   *out_replies = replies;
+  *out_granted = granted;
+  for (auto& c : conns) ::close(c.fd);
+  ::close(epfd);
+  return 0;
+}
+
+// Bulk-lane measurement client (round 11): `conns` connections each
+// keeping `depth` OP_ACQUIRE_MANY frames of `rows_per_frame` rows in
+// flight. The scalar fe_loadgen exists because a Python client's
+// ~14µs/request floor would bound the measurement; at multi-shard bulk
+// rates even a Python PER-FRAME client bounds the node (one encode +
+// event-loop turn per 4096 rows × N shards), so the shard-sweep rig
+// needs frames built and counted in C too. Keys draw from one shared
+// `keyspace` pool ("b<i>") — the hot tier-0 shape the sweep measures —
+// and the kernel's SO_REUSEPORT hash spreads the connections across
+// shards. Frames carry the with-remaining flag: the bulk lane only
+// installs tier-0 replicas from with-remaining grants, and the sweep
+// exists to measure the replicated-envelope hot path, not the residue
+// lane. Returns total frames, rows, and granted rows (bitmap popcount
+// — the bitmap precedes the f32 remaining array in RESP_BULK).
+int fe_lg_bulk(const char* host, int port, int n_conns, int depth,
+               int frames_per_conn, int rows_per_frame, int keyspace,
+               double a, double b, double* out_elapsed_s,
+               long long* out_frames, long long* out_rows,
+               long long* out_granted) {
+  if (n_conns <= 0 || rows_per_frame <= 0 || keyspace <= 0) return -1;
+  std::vector<LgConn> conns{size_t(n_conns)};
+  int epfd = epoll_create1(0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(epfd);
+    return -1;
+  }
+  for (int i = 0; i < n_conns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+      ::close(epfd);
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    set_nonblock(fd);
+    conns[size_t(i)].fd = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = uint32_t(i);
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+  // Outbound staging per connection: frames queue in LgConn-local
+  // buffers and drain via EPOLLOUT — a burst past the socket buffer
+  // must NOT busy-spin on EAGAIN (16 client threads spinning is a
+  // measurable bite out of the very CPUs the server under test needs).
+  std::vector<std::string> outq(static_cast<size_t>(n_conns));
+  std::vector<size_t> outq_off(static_cast<size_t>(n_conns), 0);
+  std::vector<uint8_t> want_out(static_cast<size_t>(n_conns), 0);
+  // One frame template per sequence slot: the body is identical for
+  // every send except the seq, so build it once and patch seq in place.
+  uint64_t n = uint64_t(rows_per_frame);
+  std::string body;
+  body.push_back(char(kVersion));
+  wr_u32(&body, 0);  // seq, patched per send at offset 1
+  body.push_back(char(OP_ACQUIRE_MANY));
+  body.push_back(char(kBulkFlagRemaining));  // kind bucket, remainings on
+  wr_f64(&body, a);
+  wr_f64(&body, b);
+  wr_u32(&body, uint32_t(n));
+  std::string blob;
+  std::vector<uint16_t> klens(n);
+  for (uint64_t i = 0; i < n; i++) {
+    std::string key = "b" + std::to_string(i % uint64_t(keyspace));
+    klens[i] = uint16_t(key.size());
+    blob += key;
+  }
+  body.append(reinterpret_cast<const char*>(klens.data()), 2 * n);
+  body += blob;
+  for (uint64_t i = 0; i < n; i++) wr_u32(&body, 1);  // unit counts
+  std::string frame;
+  wr_u32(&frame, uint32_t(body.size()));
+  frame += body;
+  constexpr size_t kSeqOff = 5;  // [u32 len][u8 ver] then seq
+  auto flush_conn = [&](size_t ci) {
+    LgConn& c = conns[ci];
+    std::string& out = outq[ci];
+    size_t& off = outq_off[ci];
+    while (off < out.size()) {
+      ssize_t r = ::send(c.fd, out.data() + off, out.size() - off,
+                         MSG_NOSIGNAL);
+      if (r > 0) {
+        off += size_t(r);
+        continue;
+      }
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (!want_out[ci]) {
+          want_out[ci] = 1;
+          epoll_event ev{};
+          ev.events = EPOLLIN | EPOLLOUT;
+          ev.data.u32 = uint32_t(ci);
+          epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
+        }
+        return;
+      }
+      break;  // hard error: reader side will reap the conn
+    }
+    out.clear();
+    off = 0;
+    if (want_out[ci]) {
+      want_out[ci] = 0;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u32 = uint32_t(ci);
+      epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
+    }
+  };
+  auto send_frames = [&](size_t ci, int count) {
+    LgConn& c = conns[ci];
+    for (int d = 0; d < count && c.sent < frames_per_conn; d++) {
+      uint32_t seq = uint32_t(c.sent++);
+      std::memcpy(&frame[kSeqOff], &seq, 4);
+      outq[ci] += frame;
+    }
+    flush_conn(ci);
+  };
+  long long frames_done = 0, granted = 0;
+  int live = n_conns;
+  const long long want = (long long)n_conns * frames_per_conn;
+  uint64_t t0 = now_ns();
+  for (size_t ci = 0; ci < size_t(n_conns); ci++) {
+    send_frames(ci, depth);
+  }
+  epoll_event events[64];
+  while (frames_done < want && live > 0) {
+    int nev = epoll_wait(epfd, events, 64, 10000);
+    if (nev <= 0) break;  // stalled server: bail with what we have
+    for (int e = 0; e < nev; e++) {
+      size_t ci = events[e].data.u32;
+      LgConn& c = conns[ci];
+      if (c.dead) continue;
+      if (events[e].events & EPOLLOUT) flush_conn(ci);
+      uint8_t buf[65536];
+      for (;;) {
+        ssize_t r = ::recv(c.fd, buf, sizeof buf, 0);
+        if (r > 0) {
+          c.in.insert(c.in.end(), buf, buf + r);
+          continue;
+        }
+        if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK)) {
+          epoll_ctl(epfd, EPOLL_CTL_DEL, c.fd, nullptr);
+          c.dead = true;
+          live--;
+        }
+        break;
+      }
+      int completed = 0;
+      for (;;) {
+        size_t avail = c.in.size() - c.in_off;
+        if (avail < 4) break;
+        uint32_t len = rd_u32(c.in.data() + c.in_off);
+        if (avail < 4 + size_t(len)) break;
+        const uint8_t* rbody = c.in.data() + c.in_off + 4;
+        if (len >= kBodyOff + kBulkRespHead &&
+            rbody[5] == RESP_BULK) {
+          uint32_t rn = rd_u32(rbody + kBodyOff + 1);
+          const uint8_t* bits = rbody + kBodyOff + kBulkRespHead;
+          size_t nbits = (size_t(rn) + 7) / 8;
+          if (len >= kBodyOff + kBulkRespHead + nbits) {
+            for (size_t bi = 0; bi < nbits; bi++) {
+              granted += __builtin_popcount(bits[bi]);
+            }
+          }
+        }
+        c.in_off += 4 + len;
+        frames_done++;
+        c.recvd++;
+        completed++;
+      }
+      if (c.in_off == c.in.size()) {
+        c.in.clear();
+        c.in_off = 0;
+      }
+      if (completed > 0) send_frames(ci, completed);
+    }
+  }
+  *out_elapsed_s = double(now_ns() - t0) * 1e-9;
+  *out_frames = frames_done;
+  *out_rows = frames_done * (long long)rows_per_frame;
   *out_granted = granted;
   for (auto& c : conns) ::close(c.fd);
   ::close(epfd);

@@ -184,6 +184,21 @@ def test_bulk_abi_exports_are_bound():
         assert sym in exported, sym
 
 
+def test_shard_abi_exports_are_bound():
+    """Both directions of the round-11 multi-shard ABI: the shard
+    lifecycle exports (fe_start_sharded / fe_shard_count / fe_shard)
+    and the C bulk load generator have ctypes bindings and vice versa —
+    a rename on either side would silently degrade every multi-shard
+    deployment to single-shard (has_shards feature detection reads the
+    same symbols)."""
+    bound = wire_conformance._py_bound_symbols(NATIVE_PY)
+    exported = wire_conformance._c_exported_symbols(FRONTEND)
+    for sym in ("fe_start_sharded", "fe_shard_count", "fe_shard",
+                "fe_lg_bulk"):
+        assert sym in bound, sym
+        assert sym in exported, sym
+
+
 def test_missing_fe_export_fires_both_ways(tmp_path):
     # Rename an exported symbol: the binding can't resolve (one finding
     # at the Python binding site) and the renamed export is dead surface

@@ -34,15 +34,20 @@ pytestmark = pytest.mark.skipif(
 
 # -- raw-socket helpers for the byte-level bulk differential ----------------
 
-async def _start_pair(tier0=False):
+async def _start_pair(tier0=False, shards=1):
     """One asyncio server and one native server over identical
-    InProcess stores on lockstep manual clocks."""
+    InProcess stores on lockstep manual clocks. ``shards`` sizes the
+    native side's SO_REUSEPORT shard group (round 11): the fuzz drives
+    ONE connection, which lives its whole life on whichever shard the
+    kernel picked — the per-connection order contract is shard-local,
+    so replies must stay byte-identical at any shard count."""
     clocks = [ManualClock(), ManualClock()]
     servers = [
         BucketStoreServer(InProcessBucketStore(clock=clocks[0]),
                           native_frontend=False),
         BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
-                          native_frontend=True, native_tier0=tier0),
+                          native_frontend=True, native_tier0=tier0,
+                          native_shards=shards),
     ]
     for s in servers:
         await s.start()
@@ -107,16 +112,23 @@ def _random_bulk_frame(rng, seq: int) -> bytes:
         kind=kind, trace=trace)
 
 
-@pytest.mark.parametrize("seed,tier0", [(5, False), (29, False),
-                                        (5, True)])
-def test_bulk_frames_reply_byte_identical(seed, tier0):
+@pytest.mark.parametrize("seed,tier0,shards", [(5, False, 1),
+                                               (29, False, 1),
+                                               (5, True, 1),
+                                               (5, False, 4),
+                                               (29, True, 4)])
+def test_bulk_frames_reply_byte_identical(seed, tier0, shards):
     """Randomized ACQUIRE_MANY frames — duplicates, probes, hostile
     keys, trace tails, every kind, chained chunks, malformed shapes —
     must produce byte-identical replies from the native bulk lane and
     the asyncio server. (tier0=True arms the cache at capacity 10 <
-    min_budget, so tier-0 must stay semantically invisible.)"""
+    min_budget, so tier-0 must stay semantically invisible; shards=4
+    runs the same contract against the multi-shard front-end — the
+    chained-chunk parking and error ordering are per-connection state
+    and must behave identically on whichever shard accepts.)"""
     async def main():
-        clocks, servers, conns = await _start_pair(tier0=tier0)
+        clocks, servers, conns = await _start_pair(tier0=tier0,
+                                                   shards=shards)
         rng = np.random.default_rng(seed)
         try:
             for step in range(150):
@@ -232,17 +244,23 @@ def test_bulk_gated_rows_byte_identical():
 # at the fuzz's capacity (10) every key sits below the default
 # min_budget confidence gate, so tier-0 must be semantically INVISIBLE —
 # identical replies, never a locally-guessed decision.
-@pytest.mark.parametrize("seed,tier0", [(11, False), (23, False),
-                                        (47, False), (11, True),
-                                        (47, True)])
-def test_native_and_asyncio_servers_answer_identically(seed, tier0):
+@pytest.mark.parametrize("seed,tier0,shards", [(11, False, 1),
+                                               (23, False, 1),
+                                               (47, False, 1),
+                                               (11, True, 1),
+                                               (47, True, 1),
+                                               (23, False, 4),
+                                               (11, True, 4)])
+def test_native_and_asyncio_servers_answer_identically(seed, tier0,
+                                                       shards):
     async def main():
         clocks = [ManualClock(), ManualClock()]
         servers = [
             BucketStoreServer(InProcessBucketStore(clock=clocks[0]),
                               native_frontend=False),
             BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
-                              native_frontend=True, native_tier0=tier0),
+                              native_frontend=True, native_tier0=tier0,
+                              native_shards=shards),
         ]
         for s in servers:
             await s.start()

@@ -1,0 +1,303 @@
+"""Multi-shard native front-end (round 11): N epoll shards, one port.
+
+Covers what the 4-shard arms of the differential fuzz
+(test_native_parity_fuzz) do not: the shard ABI surface itself
+(fe_shard_count / per-shard sub-handles / stale-binary fallback), the
+whole-node telemetry merge invariant (the top-level OP_STATS gauges are
+the SUM of the per-shard breakdown), the single-envelope bound with the
+tier-0 budget split across shards (summed over-admission inside the
+SAME flat epsilon as single-shard), and the retire fan-out regression —
+a live OP_CONFIG mutation must kill every shard's replicas of the old
+config atomically (a config retired on shard 0 but live on shard 3 is
+a double-admit window).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+    overadmit_epsilon,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+    Tier0Config,
+    native_bulk_loadgen,
+    native_loadgen,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+
+_LIB = load_frontend_lib()
+pytestmark = pytest.mark.skipif(
+    _LIB is None or not getattr(_LIB, "has_shards", False),
+    reason="native front-end library unavailable or predates the shard ABI")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _roundtrip_raw(host, port, frames: "list[bytes]") -> list[bytes]:
+    """Send raw frames on one fresh connection, read one reply each."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        for f in frames:
+            writer.write(f)
+        await writer.drain()
+        out = []
+        for _ in frames:
+            hdr = await asyncio.wait_for(reader.readexactly(4), 10.0)
+            (ln,) = struct.unpack("<I", hdr)
+            out.append(hdr + await asyncio.wait_for(
+                reader.readexactly(ln), 10.0))
+        return out
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+def test_multishard_serves_one_port_and_stats_merge():
+    """4 shards accept on ONE port (kernel balancing spreads the C
+    loadgen's connections), every request is answered, and the merged
+    top-level gauges are exactly the sum of the per-shard breakdown."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=4) as srv:
+            assert srv._native.n_shards == 4
+            replies, granted, _el = await asyncio.to_thread(
+                native_loadgen, srv.host, srv.port, conns=32, depth=8,
+                reqs_per_conn=200, keyspace=8)
+            assert replies == 32 * 200
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                res = await store.acquire_many(
+                    [f"k{i % 8}" for i in range(64)], [1] * 64, 1e7, 1e7)
+                assert res.granted.all()
+                st = await store.stats()
+                assert st["fe_shards"] == 4
+                shards = st["shards"]
+                assert len(shards) == 4
+                assert sum(s["requests_served"] for s in shards) == \
+                    st["requests_served"]
+                assert sum(s["connections_served"] for s in shards) == \
+                    st["connections_served"]
+                assert sum(s["native_bulk"]["rows"] for s in shards) == \
+                    st["native_bulk"]["rows"]
+                # 33 connections over 4 kernel-balanced listeners: the
+                # chance every one lands on a single shard is (1/4)^32 —
+                # at least two shards must have served.
+                assert sum(1 for s in shards
+                           if s["connections_served"] > 0) >= 2
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_shard_handle_bounds_and_single_shard_breakdown():
+    """fe_shard rejects out-of-range indexes; a single-shard server
+    reports no per-shard breakdown (the merged gauges already ARE the
+    node) and keeps the exact pre-shard OP_STATS shape."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=1) as srv:
+            h = srv._native._h
+            assert _LIB.fe_shard_count(h) == 1
+            assert _LIB.fe_shard(h, 0)
+            assert not _LIB.fe_shard(h, 1)
+            assert not _LIB.fe_shard(h, -1)
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                await store.acquire("a", 1, 10.0, 1.0)
+                st = await store.stats()
+                assert "shards" not in st
+                assert "fe_shards" not in st
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_stale_binary_fallback_serves_single_shard(monkeypatch):
+    """shards>1 against a binary without the shard ABI must serve —
+    single-shard, loudly — not fail: availability over scale."""
+    async def body():
+        monkeypatch.setattr(_LIB, "has_shards", False)
+        try:
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         native_frontend=True,
+                                         native_shards=4) as srv:
+                assert srv._native.n_shards == 1
+                assert srv._native.shard_stats() is None
+                store = RemoteBucketStore(address=(srv.host, srv.port))
+                try:
+                    res = await store.acquire("a", 1, 10.0, 1.0)
+                    assert res.granted
+                finally:
+                    await store.aclose()
+        finally:
+            monkeypatch.setattr(_LIB, "has_shards", True)
+
+    run(body())
+
+
+def test_multishard_overadmit_bounded_by_flat_envelope():
+    """The single-envelope acceptance bound: with 4 shards deciding
+    concurrently from split budget shares, the SUMMED per-key
+    over-admission across every connection and shard stays inside the
+    SAME flat epsilon envelope as single-shard —
+    overadmit_epsilon(headroom_budget(...), fill, sync) computed from
+    the UNSPLIT budget, because the per-shard shares sum to at most it
+    (native/frontend.cc t0_budget_of; docs/DESIGN.md §16)."""
+    capacity, fill = 400.0, 1e-9
+    cfg = Tier0Config(sync_interval_s=0.005, min_budget=8.0)
+    budget = headroom_budget(capacity, fraction=cfg.budget_fraction,
+                             min_budget=cfg.min_budget,
+                             max_budget=cfg.max_budget)
+    assert budget / 4 >= cfg.min_budget  # split shares must host
+    epsilon = overadmit_epsilon(budget, fill, cfg.sync_interval_s)
+    n_keys, per_frame, frames, n_conns = 4, 25, 8, 4
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg,
+                                     native_shards=4) as srv:
+            stores = [RemoteBucketStore(address=(srv.host, srv.port))
+                      for _ in range(n_conns)]
+            try:
+                keys = [f"h{i}" for i in range(n_keys)]
+                frame_keys = [keys[i % n_keys]
+                              for i in range(n_keys * per_frame)]
+                counts = [1] * len(frame_keys)
+                admitted = {k: 0 for k in keys}
+                results = await asyncio.gather(
+                    *(st.acquire_many(frame_keys, counts, capacity, fill)
+                      for st in stores for _ in range(frames)))
+                for res in results:
+                    for k, g in zip(frame_keys, res.granted):
+                        admitted[k] += bool(g)
+                for k in keys:
+                    # Oracle: with ~zero fill and unit counts, any
+                    # serialization admits exactly `capacity` per key.
+                    # The bound is the FLAT epsilon — not N times it.
+                    assert admitted[k] <= capacity + epsilon, (
+                        k, admitted[k], epsilon)
+                    assert admitted[k] >= capacity * 0.9, (k, admitted[k])
+            finally:
+                for st in stores:
+                    await st.aclose()
+
+    run(body())
+
+
+def test_retire_fans_out_to_every_shard():
+    """Live OP_CONFIG mutation under multi-shard load: once the sync
+    pump retires the old config, NO shard may still answer old-config
+    frames from a live replica — fe_t0_retire must sweep every shard's
+    slice under one combined critical section (a replica surviving on
+    shard 3 after shard 0 retired is the double-admit window this
+    regression pins)."""
+    old_cap, old_rate = 100000.0, 1e-9
+    new_cap, new_rate = 120000.0, 2e-9
+    cfg = Tier0Config(sync_interval_s=0.005, min_budget=8.0)
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg,
+                                     native_shards=4) as srv:
+            # Load phase: hot old-config bulk traffic over many
+            # connections so replicas install across shards' slices.
+            await asyncio.to_thread(
+                native_bulk_loadgen, srv.host, srv.port, conns=16,
+                depth=4, frames_per_conn=40, rows_per_frame=256,
+                keyspace=8, capacity=old_cap, fill_rate=old_rate)
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                st = await store.stats()
+                hosting = [s["shard"] for s in st["shards"]
+                           if s["tier0"]["entries"] > 0]
+                assert len(hosting) >= 2, (
+                    "load phase must install replicas on several "
+                    f"shards to make the fan-out meaningful: {hosting}")
+                # Mutate the live config while loadgen traffic is still
+                # in flight on other connections.
+                load = asyncio.create_task(asyncio.to_thread(
+                    native_bulk_loadgen, srv.host, srv.port, conns=8,
+                    depth=2, frames_per_conn=40, rows_per_frame=256,
+                    keyspace=8, capacity=old_cap, fill_rate=old_rate))
+                for payload in ({"prepare": {"kind": "bucket",
+                                             "old": [old_cap, old_rate],
+                                             "new": [new_cap, new_rate]},
+                                 "version": 1},
+                                {"commit": 1}):
+                    frame = wire.encode_request(900, wire.OP_CONFIG,
+                                                key=json.dumps(payload))
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] != wire.RESP_ERROR, reply
+                await load
+                # Give the sync pump a few rounds to run the retire.
+                await asyncio.sleep(cfg.sync_interval_s * 10)
+                # Terminal state: EVERY connection (each landing on a
+                # kernel-chosen shard) answers old-config frames with
+                # the routable config-moved error — a grant here means
+                # some shard still holds a live old-config replica.
+                for _ in range(16):
+                    frame = wire.encode_bulk_request(
+                        7, [b"b0", b"b1"], [1, 1], old_cap, old_rate)
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] == wire.RESP_ERROR, reply
+                    assert b"config moved" in reply, reply
+                    # New config decides normally on the same shard.
+                    frame = wire.encode_bulk_request(
+                        8, [b"b0", b"b1"], [1, 1], new_cap, new_rate)
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] == wire.RESP_BULK, reply
+            finally:
+                await store.aclose()
+
+    run(body())
+
+
+def test_bulk_loadgen_counts_are_consistent():
+    """The C bulk load generator's own accounting (frames, rows,
+    granted) agrees with the server's gauges — the shard sweep's
+    evidence numbers come from it, so it gets its own audit."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=True,
+                                     native_shards=2) as srv:
+            frames, rows, granted, el = await asyncio.to_thread(
+                native_bulk_loadgen, srv.host, srv.port, conns=4,
+                depth=2, frames_per_conn=25, rows_per_frame=512,
+                keyspace=16)
+            assert frames == 4 * 25
+            assert rows == frames * 512
+            assert granted == rows  # capacity 1e8, unit counts: all grant
+            assert el > 0
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                st = await store.stats()
+                assert st["native_bulk"]["rows"] == rows
+            finally:
+                await store.aclose()
+
+    run(body())
