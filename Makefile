@@ -8,10 +8,10 @@ PY ?= python
 CXX ?= g++
 
 .PHONY: check lint test native asan-test tsan-test chaos-test \
-        reshard-soak upgrade-soak parity-fuzz llm-soak
+        reshard-soak upgrade-soak parity-fuzz llm-soak controller-soak
 
 check: lint test chaos-test upgrade-soak parity-fuzz llm-soak \
-       asan-test tsan-test
+       controller-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -63,6 +63,16 @@ upgrade-soak:
 llm-soak:
 	JAX_PLATFORMS=cpu DRL_LLM_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_llm_admission.py -v -p no:cacheprovider
+
+# Autonomous control plane soak: the seeded diurnal + flash-crowd swing
+# driven against a live 3-node fleet under wire + controller.tick chaos
+# with zero operator calls (docs/OPERATIONS.md §13) — plus the
+# controller's policy unit surface (hysteresis, cooldown, budget,
+# dry-run parity). `make controller-soak SEED=...` replays any action
+# schedule bit-for-bit, the chaos-test determinism contract.
+controller-soak:
+	JAX_PLATFORMS=cpu DRL_CONTROLLER_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_controller.py -v -p no:cacheprovider
 
 # Native-vs-asyncio differential fuzz, verbosely (also part of tier-1):
 # reply-for-reply byte identity over randomized scalar AND bulk

@@ -1,0 +1,263 @@
+"""Controller-loop overhead benchmark: what one reconciliation tick
+costs, and what a whole diurnal + flash-crowd day of decisions costs
+(ISSUE 12; ROADMAP item 2's closing leg).
+
+The control plane must be operationally free: a tick is one OP_STATS
+fan-out plus pure-Python delta math and threshold checks — nothing on
+the serving path pays for it, and the loop itself must stay far below
+one core even at aggressive cadences. This benchmark pins that as a
+TRACKED number along two lanes:
+
+- ``decide``  — the pure policy half (scrape parsing + CounterDeltas +
+  hysteresis/cooldown/budget + the decision) over a synthetic in-memory
+  sensor feed: ticks/s with zero I/O, i.e. the loop's own CPU ceiling.
+- ``wire``    — full ticks against a live localhost 2-node fleet
+  (real OP_STATS scrapes over TCP): ticks/s including the sensor
+  plane's round trips — the number an operator compares against the
+  chosen ``--controller-tick-ms``.
+
+Both lanes replay the same seeded diurnal + flash-crowd day shape the
+acceptance soak uses (tests/test_controller.py), and report the decided
+action mix so a policy regression (a flappier loop) shows up as a
+DIFFERENT action count at the same seed, not just different latency.
+
+Usage::
+
+    python -m benchmarks.controller_loop [--ticks 2000] [--seed 20260804]
+        [--lanes decide,wire] [--smoke] [--json] [--evidence]
+
+One JSON row per lane on stdout; ``--evidence`` appends them to
+``benchmarks/evidence/controller_loop.jsonl``."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import pathlib
+import time
+
+import numpy as np
+
+__all__ = ["synthetic_feed", "run_decide_lane", "run_wire_lane", "main"]
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = _ROOT / "benchmarks" / "evidence" / "controller_loop.jsonl"
+
+#: The tracked scenario's shape (change it and the numbers stop being
+#: comparable across rounds): a 36-tick "day" with a 10× flash crowd in
+#: ticks 12-23, tiled to the requested tick count.
+DAY_TICKS = 36
+FLASH = range(12, 24)
+BASE_TOKENS = 165.0
+FLASH_TOKENS = 1650.0
+TOKEN_CAPACITY = 800.0
+
+
+def _controller_config(**kw):
+    from distributedratelimiting.redis_tpu.runtime.controller import (
+        ControllerConfig,
+    )
+
+    base = dict(tick_s=1.0, token_rate_capacity=TOKEN_CAPACITY,
+                shed_high=0.9, shed_low=0.6, shed_raise_ticks=2,
+                shed_lower_ticks=2, split_share=0.2,
+                split_min_tokens=100.0, split_streak_ticks=2,
+                cooldown_ticks=2, budget_actions=64,
+                budget_window_ticks=DAY_TICKS)
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+def synthetic_feed(seed: int, n_ticks: int) -> list[dict]:
+    """n_ticks of OP_STATS-shaped fleet snapshots replaying the day
+    shape: monotonic counters with a diurnal sine, a flash-crowd token
+    surge, and a hot key that takes a large share during the flash."""
+    rng = np.random.default_rng(seed)
+    feed = []
+    admitted = {"tenant:a": 0.0, "tenant:noisy": 0.0}
+    hot = {"flash/hot": 0.0, "tenant:a/u0": 0.0}
+    reqs = [0, 0]
+    for i in range(n_ticks):
+        t = i % DAY_TICKS
+        diurnal = 1.0 + 0.4 * math.sin(2 * math.pi * t / DAY_TICKS)
+        flash = t in FLASH
+        tokens = (FLASH_TOKENS if flash else BASE_TOKENS) * diurnal
+        admitted["tenant:a"] += tokens * 0.4
+        admitted["tenant:noisy"] += tokens * 0.6
+        hot["flash/hot"] += tokens * (0.4 if flash else 0.02)
+        hot["tenant:a/u0"] += tokens * 0.05
+        reqs[0] += int(20 * diurnal + rng.integers(4))
+        reqs[1] += int(20 * diurnal + rng.integers(4))
+        feed.append({
+            "nodes": [
+                {"requests_served": reqs[0],
+                 "token_velocity": {"admitted": dict(admitted)},
+                 "hot_keys": {"top": [
+                     {"key": k, "count": c, "error": 0.0}
+                     for k, c in hot.items()]}},
+                {"requests_served": reqs[1]},
+            ],
+            "resilience": {},
+            "placement": {"slot_counts": [8, 8], "drained": []},
+        })
+    return feed
+
+
+class _FeedCluster:
+    """Inert cluster: scripted sensors, recording actuators."""
+
+    def __init__(self, feed: list[dict]) -> None:
+        self.feed = feed
+        self.i = 0
+        self.actuations = 0
+        import types
+
+        self.placement = types.SimpleNamespace(overrides={})
+        self.flight_recorder = None
+
+    async def stats(self) -> dict:
+        snap = self.feed[min(self.i, len(self.feed) - 1)]
+        self.i += 1
+        return snap
+
+    async def split_hot_keys(self, top_n: int = 1,
+                             min_count: float = 0.0) -> list[str]:
+        self.actuations += 1
+        return ["flash/hot"]
+
+    async def rebalance(self, reason: str = "") -> int:
+        self.actuations += 1
+        return 1
+
+    async def drain_node(self, j: int) -> int:
+        self.actuations += 1
+        return 1
+
+    async def rejoin_node(self, j: int) -> int:
+        self.actuations += 1
+        return 1
+
+
+def _action_mix(controller) -> dict[str, int]:
+    mix: dict[str, int] = {}
+    for a in controller.actions:
+        mix[a["action"]] = mix.get(a["action"], 0) + 1
+    return mix
+
+
+async def run_decide_lane(seed: int, n_ticks: int) -> dict:
+    from distributedratelimiting.redis_tpu.runtime.controller import (
+        Controller,
+    )
+
+    feed = synthetic_feed(seed, n_ticks)
+    ctrl = Controller(_FeedCluster(feed), config=_controller_config())
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        await ctrl.tick()
+    dt = time.perf_counter() - t0
+    return {
+        "lane": "decide",
+        "ticks": n_ticks,
+        "wall_s": round(dt, 4),
+        "ticks_per_s": round(n_ticks / dt, 1),
+        "tick_p50_us_est": round(dt / n_ticks * 1e6, 2),
+        "actions": _action_mix(ctrl),
+        "actions_recorded": ctrl.actions_recorded,
+    }
+
+
+async def run_wire_lane(seed: int, n_ticks: int) -> dict:
+    """Full ticks against a live 2-node localhost fleet: the sensor
+    fan-out is real OP_STATS over TCP; actuators are live but the feed
+    carries no sustained pressure, so the lane measures the SCRAPE
+    cost (the common case: a healthy fleet ticks and does nothing)."""
+    from distributedratelimiting.redis_tpu.runtime.cluster import (
+        ClusterBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.controller import (
+        Controller,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    backings = [InProcessBucketStore() for _ in range(2)]
+    servers = [BucketStoreServer(b) for b in backings]
+    for s in servers:
+        await s.start()
+    cluster = ClusterBucketStore(
+        addresses=[(s.host, s.port) for s in servers],
+        coalesce_requests=False)
+    ctrl = Controller(cluster, config=_controller_config())
+    # Light background traffic so the scrape parses non-trivial stats.
+    for i in range(200):
+        await cluster.acquire(f"warm/{i % 20}", 1, 1e6, 10.0)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(n_ticks):
+            await ctrl.tick()
+        dt = time.perf_counter() - t0
+    finally:
+        await cluster.aclose()
+        for s, b in zip(servers, backings):
+            await s.aclose()
+            await b.aclose()
+    return {
+        "lane": "wire",
+        "ticks": n_ticks,
+        "nodes": 2,
+        "wall_s": round(dt, 4),
+        "ticks_per_s": round(n_ticks / dt, 1),
+        "tick_ms_mean": round(dt / n_ticks * 1e3, 3),
+        "actions": _action_mix(ctrl),
+        "scrape_errors": ctrl.scrape_errors,
+    }
+
+
+LANES = {"decide": run_decide_lane, "wire": run_wire_lane}
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="controller reconciliation-loop overhead benchmark")
+    parser.add_argument("--ticks", type=int, default=2000)
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--lanes", default="decide,wire")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny tick counts (CI sanity, not numbers)")
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--evidence", action="store_true",
+                        help=f"append rows to {EVIDENCE}")
+    args = parser.parse_args(argv)
+    n_ticks = 72 if args.smoke else args.ticks
+    # Wire ticks cost a real fan-out each; keep the lane bounded.
+    wire_ticks = 36 if args.smoke else min(n_ticks, 400)
+    rows = []
+    for lane in args.lanes.split(","):
+        lane = lane.strip()
+        if lane not in LANES:
+            raise SystemExit(f"unknown lane {lane!r} "
+                             f"(have: {sorted(LANES)})")
+        n = wire_ticks if lane == "wire" else n_ticks
+        row = asyncio.run(LANES[lane](args.seed, n))
+        row.update(seed=args.seed, smoke=args.smoke,
+                   captured_at=time.strftime("%Y-%m-%dT%H:%M:%S"))
+        rows.append(row)
+        print(json.dumps(row) if args.json
+              else f"{row['lane']}: {row['ticks_per_s']} ticks/s "
+                   f"({row['ticks']} ticks, actions={row['actions']})")
+    if args.evidence:
+        EVIDENCE.parent.mkdir(parents=True, exist_ok=True)
+        with EVIDENCE.open("a", encoding="utf-8") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -164,7 +164,7 @@ class TokenVelocity:
     first — a tenant hot enough to matter re-enters immediately)."""
 
     __slots__ = ("tau_s", "max_tenants", "_clock", "_state",
-                 "observed_tokens")
+                 "observed_tokens", "_totals")
 
     def __init__(self, tau_s: float = 10.0, max_tenants: int = 512,
                  clock: Callable[[], float] = time.monotonic) -> None:
@@ -177,6 +177,14 @@ class TokenVelocity:
         #: Lifetime admitted tokens observed (all tenants) — the
         #: cheap absolute counter beside the rate gauge.
         self.observed_tokens = 0.0
+        # Per-tenant lifetime admitted tokens — the MONOTONIC companion
+        # of the decayed rate, for consumers that derive their own
+        # windowed rates from counter deltas instead of trusting a
+        # wall-clock-decayed gauge (the controller's determinism
+        # contract: same traffic schedule ⇒ same deltas, regardless of
+        # when the scrape lands). Evicted together with the rate state;
+        # delta consumers tolerate the reset (CounterDeltas).
+        self._totals: dict[str, float] = {}
 
     def observe(self, tenant: str, cost: float) -> None:
         """Fold ``cost`` admitted tokens for ``tenant`` into the rate."""
@@ -189,11 +197,14 @@ class TokenVelocity:
             if len(self._state) >= self.max_tenants:
                 victim = min(self._state, key=lambda t: self._state[t][0])
                 del self._state[victim]
+                self._totals.pop(victim, None)
             self._state[tenant] = (float(cost), now)
+            self._totals[tenant] = self._totals.get(tenant, 0.0) + cost
             return
         s, last = entry
         s = s * math.exp(-(now - last) / self.tau_s) + cost
         self._state[tenant] = (s, now)
+        self._totals[tenant] = self._totals.get(tenant, 0.0) + cost
 
     def rate(self, tenant: str) -> float:
         """Current tokens/sec estimate for one tenant (0.0 unknown)."""
@@ -211,6 +222,11 @@ class TokenVelocity:
         return {t: s * math.exp(-(now - last) / self.tau_s) / self.tau_s
                 for t, (s, last) in self._state.items()}
 
+    def totals(self) -> dict[str, float]:
+        """Per-tenant lifetime admitted tokens (monotonic while the
+        tenant stays tracked) — the delta-of-counters feed."""
+        return dict(self._totals)
+
     def snapshot(self) -> dict:
         """JSON-shaped summary for OP_STATS embedding."""
         rates = self.rates()
@@ -220,6 +236,11 @@ class TokenVelocity:
             "tenants": {t: round(r, 6)
                         for t, r in sorted(rates.items(),
                                            key=lambda kv: -kv[1])},
+            # Monotonic per-tenant counters beside the decayed gauges:
+            # rate derivation that must be scrape-time independent
+            # (runtime/controller.py) diffs these instead.
+            "admitted": {t: self._totals[t]
+                         for t in sorted(self._totals)},
         }
 
 

@@ -298,6 +298,11 @@ class ClusterBucketStore(BucketStore):
         #: authoritative buckets (the rejoin-reconcile satellite).
         self.rejoin_debits = 0
         self._announced = False
+        #: The autonomous control plane, when one is reconciling this
+        #: cluster (runtime/controller.py assigns itself here) — its
+        #: audit surface rides stats() and cluster_metrics() so the
+        #: loop's decisions are visible wherever the fleet's are.
+        self.controller = None
         # Membership ops serialize on this coordinator: two concurrent
         # reshapes would read the same epoch, build conflicting targets,
         # and cross-wire the per-epoch pull/push ledgers (the server
@@ -1804,6 +1809,21 @@ class ClusterBucketStore(BucketStore):
         reg.counter("cluster_client_timeouts",
                     "Wire-client request timeouts, summed over nodes",
                     lambda: self._sum_node_stat("timeouts"))
+        # The autonomous controller's families, read dynamically so a
+        # controller attached after the first scrape still renders (a
+        # None controller renders nothing — register_numeric_dict and
+        # the dynamic counter family both skip empty readers).
+        reg.register_numeric_dict(
+            "controller", "autonomous control plane",
+            lambda: (self.controller.numeric_stats()
+                     if self.controller is not None else None),
+            counters={"ticks", "tick_failures", "actions_recorded",
+                      "actuation_errors"})
+        reg.labeled_counters(
+            "controller_actions",
+            "Controller decisions by action and outcome",
+            lambda: (self.controller.action_series()
+                     if self.controller is not None else []))
         self._registry = reg
         return reg
 
@@ -1911,6 +1931,8 @@ class ClusterBucketStore(BucketStore):
             "aborts": self.config_aborts,
             "rebased_rows": self.config_rebased_rows,
         }
+        if self.controller is not None:
+            out["controller"] = self.controller.stats()
         return out
 
     # -- checkpoint ----------------------------------------------------------

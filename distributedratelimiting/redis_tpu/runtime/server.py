@@ -237,6 +237,11 @@ class BucketStoreServer:
         self._drain_envelope: "placement._FairShareEnvelope | None" = None
         self._drain_deadline = 0.0
         self._shutdown_done = False
+        #: Autonomous control plane, when this process hosts one (the
+        #: ``--controller`` CLI or an embedder assigns it): its audit
+        #: surface rides OP_STATS, /flight (shared flight recorder),
+        #: and the drl_controller_* families below.
+        self.controller = None
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -530,6 +535,25 @@ class BucketStoreServer:
                      if self.tracer.enabled else None),
             counters={"spans_recorded", "traces_kept", "traces_dropped",
                       "traces_evicted"})
+        # Autonomous control plane (read dynamically: the CLI attaches
+        # the controller after start(), which may be after the first
+        # scrape built this registry — a None controller just renders
+        # nothing).
+        reg.register_numeric_dict(
+            "controller", "autonomous control plane",
+            lambda: (self.controller.numeric_stats()
+                     if self.controller is not None else None),
+            counters={"ticks", "tick_failures", "actions_recorded",
+                      "actuation_errors"})
+        reg.labeled_counters(
+            "controller_actions",
+            "Controller decisions by action and outcome",
+            lambda: (self.controller.action_series()
+                     if self.controller is not None else []))
+        reg.counter("stats_resets",
+                    "Destructive serving-window resets, any trigger "
+                    "(the shared-window tripwire, utils/metrics.py)",
+                    lambda: self.serving_latency.resets)
         return reg
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
@@ -980,7 +1004,12 @@ class BucketStoreServer:
                     seq, wire.RESP_TEXT, self._stats_json())
                 if count & wire.STATS_FLAG_RESET:
                     # Start a fresh measurement window (serving + every
-                    # stage histogram, both halves of the stack).
+                    # stage histogram, both halves of the stack). The
+                    # window is SHARED — see the destructive-reset
+                    # contract in utils/metrics.py; the serving
+                    # histogram's own `resets` count (surfaced as
+                    # stats_resets) is the tripwire other consumers
+                    # watch, and it counts direct embedder resets too.
                     if self._native is not None:
                         self._native.reset_latency()
                     self.serving_latency.reset()
@@ -1474,6 +1503,11 @@ class BucketStoreServer:
                 "serving_samples": self.serving_latency.total,
             }
         payload["requests_shed"] = self.requests_shed
+        # The destructive-reset tripwire (utils/metrics.py): the
+        # serving histogram counts its resets, whoever triggered them
+        # (the OP_STATS flag path resets it unconditionally, direct
+        # embedder resets count too).
+        payload["stats_resets"] = self.serving_latency.resets
         metrics = getattr(self.store, "metrics", None)
         if metrics is not None:
             payload["store"] = metrics.snapshot()
@@ -1514,6 +1548,8 @@ class BucketStoreServer:
             payload["flight_recorder"] = self.flight_recorder.snapshot()
         if self.tracer.enabled:
             payload["tracing"] = self.tracer.snapshot()
+        if self.controller is not None:
+            payload["controller"] = self.controller.stats()
         return json.dumps(payload)
 
     async def aclose(self) -> None:
@@ -1692,6 +1728,39 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--trace-buffer", type=int, default=256,
                         help="bounded in-memory kept-trace buffer "
                         "(oldest evicted first)")
+    parser.add_argument("--controller", default=None,
+                        metavar="HOST:PORT[,HOST:PORT...]",
+                        help="arm the autonomous control plane: run the "
+                        "reconciliation loop (runtime/controller.py) "
+                        "inside this server process over the given "
+                        "fleet (comma-separated store addresses — "
+                        "include this node's own to reconcile it). The "
+                        "controller scrapes the fleet's OP_STATS plane "
+                        "every tick, derives rates from counter deltas "
+                        "(never reset=True), and autonomously splits "
+                        "hot-cost keys, rebalances, drains/rejoins "
+                        "breaker-dead nodes, and steps the shed ladder; "
+                        "every action is a flight-recorder frame + "
+                        "drl_controller_* series (docs/OPERATIONS.md "
+                        "§13)")
+    parser.add_argument("--controller-tick-ms", type=float, default=500.0,
+                        help="controller reconciliation cadence")
+    parser.add_argument("--controller-dry-run", action="store_true",
+                        help="controller decides and logs intended "
+                        "actions without executing — the recommended "
+                        "first rollout posture (docs/OPERATIONS.md §13)")
+    parser.add_argument("--controller-token-rate", type=float,
+                        default=None,
+                        help="sustainable fleet admitted-tokens/sec for "
+                        "the controller's shed ladder (unset disarms "
+                        "the shed actuator; membership/split actuators "
+                        "stay armed). NOTE: shed actuation needs "
+                        "admission gateways (AdmissionPolicy "
+                        "shed_targets), which live client-side — a "
+                        "server-embedded controller records shed "
+                        "decisions as outcome=noop and exports the "
+                        "decided level for gateways to poll "
+                        "(docs/OPERATIONS.md §13)")
     args = parser.parse_args(argv)
     if args.fe_tier0 and not args.native_frontend:
         parser.error("--fe-tier0 requires --native-frontend (the tier-0 "
@@ -1702,6 +1771,14 @@ def main(argv: list[str] | None = None) -> None:
     if args.snapshot_incremental and not args.snapshot_path:
         parser.error("--snapshot-incremental requires --snapshot-path "
                      "(there is no chain without a base file)")
+    if (args.controller_dry_run or args.controller_token_rate
+            is not None) and not args.controller:
+        parser.error("--controller-dry-run/--controller-token-rate "
+                     "require --controller (there is no loop to "
+                     "configure)")
+    if args.controller_token_rate is not None \
+            and args.controller_token_rate <= 0:
+        parser.error("--controller-token-rate must be positive")
 
     async def serve() -> None:
         if args.backend == "device":
@@ -1797,6 +1874,35 @@ def main(argv: list[str] | None = None) -> None:
             print(f"metrics exposition on "
                   f"http://{host}:{server.metrics_port}/metrics",
                   flush=True)
+        controller_task = None
+        controller_cluster = None
+        if args.controller:
+            from distributedratelimiting.redis_tpu.runtime.cluster import (
+                ClusterBucketStore,
+            )
+            from distributedratelimiting.redis_tpu.runtime.controller import (
+                Controller,
+                ControllerConfig,
+            )
+
+            urls = [u.strip() for u in args.controller.split(",")
+                    if u.strip()]
+            controller_cluster = ClusterBucketStore(
+                urls=urls, breaker=True, auth_token=args.auth_token,
+                flight_recorder=server.flight_recorder)
+            server.controller = Controller(
+                controller_cluster,
+                config=ControllerConfig(
+                    tick_s=args.controller_tick_ms / 1e3,
+                    dry_run=args.controller_dry_run,
+                    token_rate_capacity=args.controller_token_rate),
+                flight_recorder=server.flight_recorder)
+            controller_task = asyncio.ensure_future(
+                server.controller.run())
+            print(f"controller reconciling {len(urls)} node(s) every "
+                  f"{args.controller_tick_ms:g} ms"
+                  + (" [dry-run]" if args.controller_dry_run else ""),
+                  flush=True)
         # SIGTERM = planned shutdown: drain to the successor (or write
         # the final checkpoint) instead of dying with wiped state.
         import signal
@@ -1826,6 +1932,13 @@ def main(argv: list[str] | None = None) -> None:
             if successor is not None:
                 await successor.aclose()
         finally:
+            if controller_task is not None:
+                server.controller.stop()
+                controller_task.cancel()
+                await asyncio.gather(controller_task,
+                                     return_exceptions=True)
+            if controller_cluster is not None:
+                await controller_cluster.aclose()
             await server.aclose()
             await store.aclose()
 

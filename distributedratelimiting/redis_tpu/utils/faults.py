@@ -23,6 +23,11 @@ runtime consults when — and only when — an injector is installed:
   (:meth:`ClusterBucketStore._apply_placement`: health gate, pull, each
   push batch, each commit announce): the membership-change seam the
   reshard soak drives.
+- ``controller.tick`` — one reconciliation round of the autonomous
+  control plane (:meth:`Controller.tick`, runtime/controller.py): a
+  fault fails the whole tick loudly (counted, flight-recorder frame,
+  no decisions that round) — the controller soak's proof that a flaky
+  sensor plane degrades the loop to inaction, never to flapping.
 
 **Determinism.** Each seam owns its own ``random.Random`` seeded from
 ``(seed, seam)`` and its own occurrence counter, and every occurrence

@@ -63,8 +63,13 @@ class FlightRecorder:
         self._frames.append(frame)
         self.frames_recorded += 1
 
-    def frames(self) -> list[dict]:
-        return list(self._frames)
+    def frames(self, kind: str | None = None) -> list[dict]:
+        """The ring's frames, oldest first; ``kind`` filters to one
+        frame kind (e.g. ``"controller"`` — the audit path the control
+        plane's action-log assertions read)."""
+        if kind is None:
+            return list(self._frames)
+        return [f for f in self._frames if f.get("kind") == kind]
 
     def dump(self, reason: str, extra: dict | None = None, *,
              force: bool = True) -> str | None:

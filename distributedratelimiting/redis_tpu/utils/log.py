@@ -15,6 +15,11 @@ pipelines can pivot per node.
 The membership plane adds 5 = a migration committed or aborted (the
 full event dict — moved slots/keys, epochs, handoff window — rides in
 ``extra``, mirroring ``ClusterBucketStore.migration_log``).
+
+The autonomous control plane adds 6 = the controller decided an action
+(split / rebalance / drain / rejoin / shed step — executed, dry-run,
+budget-starved, or failed; the full record mirrors
+``Controller.actions``).
 """
 
 from __future__ import annotations
@@ -28,6 +33,7 @@ EVENT_ERROR_EVALUATING = 2
 EVENT_CLUSTER_NODE_ERROR = 3
 EVENT_BREAKER_TRANSITION = 4
 EVENT_CLUSTER_MIGRATION = 5
+EVENT_CONTROLLER_ACTION = 6
 
 
 def could_not_connect_to_store(exc: BaseException) -> None:
@@ -92,4 +98,18 @@ def cluster_migration(event: dict) -> None:
         event.get("target_epoch"), event.get("reason"),
         extra={"event_id": EVENT_CLUSTER_MIGRATION,
                "migration": dict(event)},
+    )
+
+
+def controller_action(record: dict) -> None:
+    """Event id 6 — the autonomous controller decided an action. The
+    record is the same dict ``Controller.actions`` keeps (tick, action,
+    target, reason, outcome, actuator extras) — the log pipeline's view
+    of every autonomous move, executed or not."""
+    logger.warning(
+        "Controller %s -> %s (%s): %s",
+        record.get("action"), record.get("target"),
+        record.get("outcome"), record.get("reason"),
+        extra={"event_id": EVENT_CONTROLLER_ACTION,
+               "controller": dict(record)},
     )

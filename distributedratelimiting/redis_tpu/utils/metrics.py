@@ -15,6 +15,22 @@ across cluster nodes by :func:`aggregate_openmetrics` /
 ``ClusterBucketStore.cluster_metrics``. Exposition is pull-only: rendering
 walks live callables at scrape time; nothing on the serving path pays for
 it between scrapes.
+
+**The destructive-reset contract.** ``stats(reset=True)`` (OP_STATS flag
+bit 0) zeroes the server's latency-measurement windows IN PLACE — there
+is exactly ONE window per server, shared by every scraper. Two scrapers
+racing ``reset=True`` silently halve each other's windows: each believes
+it owns ``[its-last-reset, now)`` but the other's reset tore the window
+in the middle, and neither can tell from the numbers alone. Reset is
+therefore reserved for a single operator-driven measurement run (the
+bench's warmup exclusion); *automation* — the autonomous controller
+above all (``runtime/controller.py``) — derives rates with
+:class:`CounterDeltas` instead: keep your OWN last-seen snapshot and
+diff the monotonic counters, which composes with any number of
+concurrent consumers and never mutates the source. Every histogram
+counts its resets (:attr:`LatencyHistogram.resets`, surfaced as
+``stats_resets`` in OP_STATS) so a consumer can at least DETECT that
+someone else tore a window it was relying on.
 """
 
 from __future__ import annotations
@@ -49,6 +65,11 @@ class LatencyHistogram:
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
         self.sum_s = 0.0  # running sum → OpenMetrics _sum / mean
+        # Measurement-window resets survive reset() by design: the
+        # count is the destructive-reset contract's tripwire (module
+        # docstring) — a delta-consumer watching it can detect that a
+        # concurrent scraper tore the window it was reading.
+        self.resets = 0
         # bucket idx -> (trace_id, observed value, unix ts); None until
         # the first traced observation.
         self.exemplars: dict[int, tuple[str, float, float]] | None = None
@@ -56,10 +77,17 @@ class LatencyHistogram:
     def reset(self) -> None:
         """Zero in place. Holders keep their reference (the MicroBatcher
         captures the histogram at construction), so a measurement-window
-        reset must NOT swap in a fresh object."""
+        reset must NOT swap in a fresh object.
+
+        DESTRUCTIVE for every other consumer of this histogram (module
+        docstring): the window is shared, so concurrent scrapers that
+        both reset halve each other's measurements. Rate-deriving
+        consumers use :class:`CounterDeltas` over the cumulative
+        counters instead and never call this."""
         self.counts = [0] * self.N_BUCKETS
         self.total = 0
         self.sum_s = 0.0
+        self.resets += 1
         self.exemplars = None
 
     def _bucket_index(self, seconds: float) -> int:
@@ -290,6 +318,63 @@ class StoreMetrics:
         }
 
 
+class CounterDeltas:
+    """Per-CONSUMER monotonic-counter differ — THE non-destructive way to
+    turn cumulative counters into windowed rates (and the guard half of
+    the destructive-reset contract in the module docstring).
+
+    Each consumer owns one instance: :meth:`delta` returns the
+    non-negative increase of a named counter since *this consumer's*
+    previous observation, so any number of scrapers derive rates over
+    the same source concurrently without coordinating and without ever
+    mutating server state (no ``reset=True``). Counter resets — a
+    restarted server reporting a smaller value — restart the window:
+    the new value counts as the increase since the reset (the
+    Prometheus ``rate()`` convention), never a negative delta.
+
+    Bounded: at ``max_keys`` tracked names the least-recently-observed
+    one is forgotten (dynamic series like per-key sketch counts churn;
+    a forgotten key's next observation re-anchors at zero delta, which
+    only ever under-reports — the conservative direction for every
+    consumer this class has)."""
+
+    __slots__ = ("max_keys", "_last")
+
+    def __init__(self, max_keys: int = 8192) -> None:
+        if max_keys <= 0:
+            raise ValueError("max_keys must be positive")
+        self.max_keys = max_keys
+        # Insertion order == recency order (moved on every touch).
+        self._last: dict[str, float] = {}
+
+    def __len__(self) -> int:
+        return len(self._last)
+
+    def delta(self, key: str, value: float) -> float:
+        """Increase of counter ``key`` since the previous observation
+        (0.0 on the first — the window anchors, it does not report the
+        counter's whole lifetime as one burst)."""
+        value = float(value)
+        last = self._last.pop(key, None)
+        if last is None and len(self._last) >= self.max_keys:
+            del self._last[next(iter(self._last))]
+        self._last[key] = value
+        if last is None:
+            return 0.0
+        if value < last:
+            return value  # counter reset: increase since the restart
+        return value - last
+
+    def rate(self, key: str, value: float, dt_s: float) -> float:
+        """``delta / dt_s`` — the per-second rate over one window."""
+        d = self.delta(key, value)
+        return d / dt_s if dt_s > 0 else 0.0
+
+    def deltas(self, samples: "Mapping[str, float]") -> dict[str, float]:
+        """Vector :meth:`delta` over a ``{name: value}`` snapshot."""
+        return {k: self.delta(k, v) for k, v in samples.items()}
+
+
 # ---------------------------------------------------------------------------
 # OpenMetrics exposition
 # ---------------------------------------------------------------------------
@@ -389,6 +474,15 @@ class MetricsRegistry:
         top-K, whose keys change between scrapes)."""
         self._add(name, "gauge", help_text, fn, {"__dynamic__": "1"})
 
+    def labeled_counters(self, name: str, help_text: str,
+                         fn: "Callable[[], Iterable[tuple[dict, float]]]"
+                         ) -> None:
+        """Counter twin of :meth:`labeled_gauges`: a dynamic series set
+        rendered with the OpenMetrics-required ``_total`` sample suffix
+        (e.g. the controller's
+        ``drl_controller_actions_total{action=,outcome=}`` family)."""
+        self._add(name, "counter", help_text, fn, {"__dynamic__": "1"})
+
     def register_numeric_dict(self, prefix: str, help_prefix: str,
                               fn: "Callable[[], Mapping | None]",
                               counters: "set[str] | frozenset[str]" = frozenset(),
@@ -467,8 +561,10 @@ class MetricsRegistry:
                 continue
             type_line(full, mtype, help_text)
             if dynamic:
+                suffix = "_total" if mtype == "counter" else ""
                 for series_labels, v in value:
-                    lines.append(f"{full}{_format_labels(series_labels)} "
+                    lines.append(f"{full}{suffix}"
+                                 f"{_format_labels(series_labels)} "
                                  f"{_format_value(v)}")
             elif mtype == "histogram":
                 if value is None:

@@ -17,6 +17,7 @@ from tools.drl_check import (
     build_freshness,
     concurrency_lint,
     jax_lint,
+    metric_names,
     run_all,
     wire_conformance,
 )
@@ -611,6 +612,94 @@ def test_missing_classification_set_fires(tmp_path):
                                                   tmp_path)
     assert [f.rule for f in findings] == ["wire-idempotency"]
     assert "_NON_IDEMPOTENT_OPS" in findings[0].message
+
+
+# -- metric-name (round 12: the controller's sensor contract) ---------------
+
+CONTROLLER = (ROOT / "distributedratelimiting" / "redis_tpu" / "runtime"
+              / "controller.py")
+CLUSTER = (ROOT / "distributedratelimiting" / "redis_tpu" / "runtime"
+           / "cluster.py")
+
+
+def test_metric_names_see_the_real_sources():
+    """Non-vacuous cleanliness: the extractor reads a richly populated
+    subscription list AND registration map from the live tree."""
+    subs = metric_names.controller_subscriptions(CONTROLLER)
+    assert len(subs) >= 5
+    names = {n for n, _ in subs}
+    assert "drl_token_velocity" in names
+    assert "drl_cluster_breaker_state" in names
+    from tools.drl_check.common import iter_py_files
+
+    exact, prefixes = metric_names.registered_families(
+        iter_py_files(ROOT / "distributedratelimiting"))
+    assert len(exact) >= 20 and len(prefixes) >= 5
+    assert "drl_requests_served" in exact
+    assert "drl_controller" in prefixes  # register_numeric_dict family
+    assert "drl_controller_actions" in exact  # labeled_counters family
+
+
+def test_unregistered_sensor_series_fires_once(tmp_path):
+    """Satellite: a series the controller subscribes to that no
+    registry emits fires metric-name exactly once, file:line on both
+    sides (subscription element + nearest registration site)."""
+    text = CONTROLLER.read_text()
+    anchor = '    "drl_requests_served",'
+    assert anchor in text, "fixture anchor gone from controller.py"
+    mutated = tmp_path / "controller.py"
+    mutated.write_text(text.replace(
+        anchor, anchor + '\n    "drl_ghost_series",', 1))
+    findings = metric_names.check_sources(
+        mutated, [SERVER, CLUSTER], tmp_path)
+    assert [f.rule for f in findings] == ["metric-name"]
+    f = findings[0]
+    assert "drl_ghost_series" in f.message
+    assert f.file.endswith("controller.py")
+    assert mutated.read_text().splitlines()[f.line - 1].strip() \
+        .startswith('"drl_ghost_series",')
+    # The other side names a real registration site.
+    assert f.related and any(rf.endswith(".py") for rf, _, _ in f.related)
+
+
+def test_renamed_emitting_family_fires(tmp_path):
+    """The drift this rule exists for: renaming the EMITTING family
+    (server registry) blinds the subscribed sensor — caught statically,
+    not discovered as a zero-reading controller in production."""
+    mutated_server = tmp_path / "server.py"
+    text = SERVER.read_text()
+    anchor = 'reg.counter("admitted_tokens",'
+    assert anchor in text, "fixture anchor gone from server.py"
+    mutated_server.write_text(text.replace(
+        anchor, 'reg.counter("admitted_tokens_renamed",', 1))
+    findings = metric_names.check_sources(
+        CONTROLLER, [mutated_server, CLUSTER], tmp_path)
+    assert [f.rule for f in findings] == ["metric-name"]
+    assert "drl_admitted_tokens" in findings[0].message
+
+
+def test_metric_name_suppressible(tmp_path):
+    text = CONTROLLER.read_text()
+    anchor = '    "drl_requests_served",'
+    mutated = tmp_path / "controller.py"
+    mutated.write_text(text.replace(
+        anchor,
+        anchor + '\n    # drl-check: ok(metric-name)'
+                 '\n    "drl_external_series",', 1))
+    assert metric_names.check_sources(
+        mutated, [SERVER, CLUSTER], tmp_path) == []
+
+
+def test_numeric_dict_prefix_matches(tmp_path):
+    """A subscription under a register_numeric_dict prefix family
+    (dynamic per-key suffixes) resolves — e.g. drl_tier0_syncs."""
+    text = CONTROLLER.read_text()
+    anchor = '    "drl_requests_served",'
+    mutated = tmp_path / "controller.py"
+    mutated.write_text(text.replace(
+        anchor, anchor + '\n    "drl_tier0_syncs",', 1))
+    assert metric_names.check_sources(
+        mutated, [SERVER, CLUSTER], tmp_path) == []
 
 
 def test_idempotency_covers_every_live_op():

@@ -24,6 +24,11 @@ a production misparse. Four analyzers:
 - :mod:`.build_freshness` — verifies ``native/build/*.so.hash``
   sidecars against the current source hashes, so analysis results are
   never reported against a binary built from different source.
+- :mod:`.metric_names` — the autonomous controller's sensor
+  subscriptions (``SENSOR_SERIES`` in ``runtime/controller.py``) must
+  each resolve to a registered metric family in the registry that
+  emits it; a renamed family is a failed check, not a silently blinded
+  control loop.
 
 Run ``python -m tools.drl_check`` (exit 0 = clean); suppress a
 deliberate exception with ``# drl-check: ok(<rule>)`` on (or one line
@@ -46,6 +51,7 @@ def run_all(repo_root=None) -> "list[Finding]":
         build_freshness,
         concurrency_lint,
         jax_lint,
+        metric_names,
         wire_conformance,
     )
 
@@ -56,4 +62,5 @@ def run_all(repo_root=None) -> "list[Finding]":
     findings += concurrency_lint.check(root)
     findings += jax_lint.check(root)
     findings += build_freshness.check(root)
+    findings += metric_names.check(root)
     return findings

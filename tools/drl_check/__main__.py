@@ -15,6 +15,7 @@ from tools.drl_check import (
     build_freshness,
     concurrency_lint,
     jax_lint,
+    metric_names,
     wire_conformance,
 )
 
@@ -23,6 +24,7 @@ _ANALYZERS = {
     "concurrency": concurrency_lint.check,
     "jax": jax_lint.check,
     "freshness": build_freshness.check,
+    "metrics": metric_names.check,
 }
 
 

@@ -1,0 +1,150 @@
+"""metric-name — the controller's sensor subscriptions must name real
+series.
+
+The autonomous control plane (``runtime/controller.py``) drives
+actuators from metric series it never emits itself: the server's and
+the cluster client's :class:`MetricsRegistry` families. A rename on the
+emitting side — ``requests_served`` becoming ``requests_answered`` in a
+refactor — would not fail any test; the controller's sensor would just
+read zero forever and the loop would go quietly blind. This analyzer
+makes that drift a failed ``make check`` instead:
+
+- the controller declares its subscriptions in the module-level
+  ``SENSOR_SERIES`` tuple (full OpenMetrics names, ``drl_`` prefix);
+- every registration site repo-wide is extracted via ``ast`` — the
+  ``counter``/``gauge``/``histogram``/``labeled_gauges``/
+  ``labeled_counters`` calls (exact family names) and
+  ``register_numeric_dict`` calls (prefix families whose per-key
+  suffixes are dynamic);
+- each subscribed name must resolve to a registered family: exact
+  match, or ``<prefix>_…`` under a dict family. A miss is one finding
+  at the subscription element's line, with the nearest registered
+  family's registration site as the other side of the diff.
+
+Suppress a deliberate exception (e.g. a series produced by an external
+scraper) with ``# drl-check: ok(metric-name)`` on the tuple element.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import pathlib
+
+from tools.drl_check.common import (
+    Finding,
+    Suppressions,
+    iter_py_files,
+    rel,
+)
+
+__all__ = ["check", "check_sources"]
+
+#: Default namespace every registry in this repo uses
+#: (MetricsRegistry.NAMESPACE) — full names are ``drl_<family>``.
+_NAMESPACE = "drl"
+
+_EXACT_METHODS = frozenset({"counter", "gauge", "histogram",
+                            "labeled_gauges", "labeled_counters"})
+_SUBSCRIPTION_NAMES = ("SENSOR_SERIES",)
+
+
+def controller_subscriptions(path: pathlib.Path
+                             ) -> list[tuple[str, int]]:
+    """``(series_name, line)`` per element of the controller's
+    subscription tuple(s)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: list[tuple[str, int]] = []
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                targets = [node.target.id]
+            value = node.value
+        else:
+            continue
+        if not any(t in _SUBSCRIPTION_NAMES for t in targets):
+            continue
+        if isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out.append((elt.value, elt.lineno))
+    return out
+
+
+def registered_families(py_files: "list[pathlib.Path]"
+                        ) -> tuple[dict[str, tuple[pathlib.Path, int]],
+                                   dict[str, tuple[pathlib.Path, int]]]:
+    """Scan registration call sites: returns ``(exact, prefixes)`` maps
+    of full (``drl_``-prefixed) family name → first registration site.
+    ``prefixes`` holds ``register_numeric_dict`` families, whose sample
+    names extend the prefix per snapshot key at scrape time."""
+    exact: dict[str, tuple[pathlib.Path, int]] = {}
+    prefixes: dict[str, tuple[pathlib.Path, int]] = {}
+    for path in py_files:
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            method = node.func.attr
+            name = f"{_NAMESPACE}_{node.args[0].value}"
+            if method in _EXACT_METHODS:
+                exact.setdefault(name, (path, node.lineno))
+            elif method == "register_numeric_dict":
+                prefixes.setdefault(name, (path, node.lineno))
+    return exact, prefixes
+
+
+def check_sources(controller_path: pathlib.Path,
+                  py_files: "list[pathlib.Path]",
+                  root: pathlib.Path) -> list[Finding]:
+    subs = controller_subscriptions(controller_path)
+    exact, prefixes = registered_families(py_files)
+    suppress = Suppressions(controller_path.read_text())
+    findings: list[Finding] = []
+    for name, line in subs:
+        if suppress.suppressed(line, "metric-name"):
+            continue
+        if name in exact or name in prefixes:
+            continue
+        if any(name.startswith(prefix + "_") for prefix in prefixes):
+            continue
+        all_families = sorted(exact) + sorted(prefixes)
+        related: list[tuple[str, int, str]] = []
+        near = difflib.get_close_matches(name, all_families, n=1,
+                                         cutoff=0.0)
+        if near:
+            site = exact.get(near[0]) or prefixes[near[0]]
+            related.append((rel(site[0], root), site[1],
+                            f"nearest registered family: {near[0]}"))
+        findings.append(Finding(
+            rule="metric-name",
+            message=(f"controller subscribes to series {name!r} but no "
+                     "MetricsRegistry registration emits it — the "
+                     "sensor would read zero forever"),
+            file=rel(controller_path, root),
+            line=line,
+            related=tuple(related),
+        ))
+    return findings
+
+
+def check(root: pathlib.Path) -> list[Finding]:
+    controller = (root / "distributedratelimiting" / "redis_tpu"
+                  / "runtime" / "controller.py")
+    if not controller.exists():
+        return []  # shim trees (CLI tests) carry no controller
+    py_files = iter_py_files(root / "distributedratelimiting")
+    return check_sources(controller, py_files, root)
