@@ -8,10 +8,11 @@ PY ?= python
 CXX ?= g++
 
 .PHONY: check lint test native asan-test tsan-test chaos-test \
-        reshard-soak upgrade-soak parity-fuzz llm-soak controller-soak
+        reshard-soak upgrade-soak parity-fuzz llm-soak controller-soak \
+        reserve-soak
 
 check: lint test chaos-test upgrade-soak parity-fuzz llm-soak \
-       controller-soak asan-test tsan-test
+       controller-soak reserve-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -63,6 +64,16 @@ upgrade-soak:
 llm-soak:
 	JAX_PLATFORMS=cpu DRL_LLM_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_llm_admission.py -v -p no:cacheprovider
+
+# Estimate-reserve-settle soak: the seeded streaming schedule
+# (estimate = actual × log-normal error) under wire chaos with a
+# mid-soak drain-and-handoff and a live OP_CONFIG budget mutation,
+# plus the reservation ledger's unit surface (docs/OPERATIONS.md §14).
+# `make reserve-soak SEED=...` replays any schedule bit-for-bit — the
+# chaos-test determinism contract.
+reserve-soak:
+	JAX_PLATFORMS=cpu DRL_RESERVE_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_reservations.py -v -p no:cacheprovider
 
 # Autonomous control plane soak: the seeded diurnal + flash-crowd swing
 # driven against a live 3-node fleet under wire + controller.tick chaos

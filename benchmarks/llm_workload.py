@@ -25,9 +25,24 @@ Usage::
     python -m benchmarks.llm_workload [--rows 40000] [--seed 20260804]
         [--lanes inprocess,remote_scalar,...] [--smoke] [--json]
 
+- ``reservations``    — the estimate-reserve-settle lane (ISSUE 13):
+                       every row reserves at ``estimate = actual ×
+                       LogNormal(0, σ)``, streams, then settles the
+                       actual — reporting SETTLED-token throughput,
+                       refund/debt ratios, and two audits on a
+                       zero-fill arm: the differential bound (settled
+                       tokens ≤ oracle + debt + epsilon, the oracle
+                       being the same schedule with a perfect
+                       estimator) and the ≤1%% net-drift
+                       reconciliation (store-observed spend vs settled
+                       − outstanding debt).
+
 One JSON row per lane on stdout; ``--evidence`` appends them to
-``benchmarks/evidence/llm_workload.jsonl``. ``benchmarks/recapture.py``
-owes this workload a real-device number (``llm_workload_device``)."""
+``benchmarks/evidence/llm_workload.jsonl`` (the reservations lane also
+appends to ``benchmarks/evidence/llm_reservations.jsonl``).
+``benchmarks/recapture.py`` owes this workload a real-device number
+(``llm_workload_device``) and the reservation lane another
+(``llm_reservations_device``)."""
 
 from __future__ import annotations
 
@@ -43,6 +58,8 @@ __all__ = ["gen_workload", "run_lane", "LANES", "main"]
 
 _ROOT = pathlib.Path(__file__).resolve().parents[1]
 EVIDENCE = _ROOT / "benchmarks" / "evidence" / "llm_workload.jsonl"
+EVIDENCE_RESERVATIONS = (_ROOT / "benchmarks" / "evidence"
+                         / "llm_reservations.jsonl")
 
 #: Workload shape defaults (the tracked scenario's identity — change
 #: them and the numbers stop being comparable across rounds).
@@ -228,11 +245,121 @@ def lane_native_bulk(tenants, keys, costs, prios):
                                   native=True, bulk=True))
 
 
+#: Estimate-error shape of the reservations lane: ``estimate = actual ×
+#: LogNormal(0, σ)`` — σ 0.55 puts ~32% of estimates off by more than
+#: 1.7× in one direction or the other (both refund and debt lanes run
+#: hot). The error stream is seeded independently of the workload seed
+#: so the SAME error pattern prices every workload (a tracked-number
+#: identity, like the shape constants above).
+RESV_EST_SIGMA = 0.55
+_RESV_ERR_SEED = 0x5E771E
+#: Zero-fill audit arm: per-tenant budget small enough that the Zipf
+#: head saturates (denials + debt actually exercise), fill ≈ 0 so the
+#: reconciliation identity is exact.
+_AUDIT_TENANT_CAP = 20_000.0
+_AUDIT_FILL = 1e-9
+
+
+async def _drive_reservations(store, tenants, keys, costs, estimates,
+                              prios, tenant_cap, tenant_rate,
+                              prefix: str):
+    """Reserve → settle every row through the store-attached ledger;
+    returns ``(granted_rows, settled_tokens, ledger)``."""
+    led = store.reservation_ledger()
+    granted = 0
+    settled = 0
+    for i in range(len(keys)):
+        r = await led.reserve(f"{prefix}{i}", tenants[i], keys[i],
+                              float(estimates[i]), tenant_cap,
+                              tenant_rate, CHILD_CAP, CHILD_RATE,
+                              priority=int(prios[i]))
+        if r.granted:
+            s = await led.settle(f"{prefix}{i}", tenants[i],
+                                 float(costs[i]))
+            if s.outcome == "settled":
+                granted += 1
+                settled += int(costs[i])
+    return granted, settled, led
+
+
+def lane_reservations(tenants, keys, costs, prios) -> dict:
+    """The estimate-reserve-settle lane (module docstring): throughput
+    at the tracked workload constants, then the zero-fill audit arm
+    (differential bound + net-drift reconciliation)."""
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    n = len(keys)
+    rng = np.random.default_rng(_RESV_ERR_SEED)
+    estimates = np.maximum(
+        costs * rng.lognormal(0.0, RESV_EST_SIGMA, n), 1.0)
+
+    async def throughput() -> dict:
+        st = InProcessBucketStore()
+        t0 = time.perf_counter()
+        granted, settled, led = await _drive_reservations(
+            st, tenants, keys, costs, estimates, prios, TENANT_CAP,
+            TENANT_RATE, "r")
+        dt = time.perf_counter() - t0
+        return {"dt": dt, "granted": granted, "settled": settled,
+                "refunded": led.refunded_tokens,
+                "debt_created": led.debt_tokens_created}
+
+    async def audit() -> dict:
+        m = min(n, 8000)
+        st = InProcessBucketStore()
+        _g, settled, led = await _drive_reservations(
+            st, tenants[:m], keys[:m], costs[:m], estimates[:m],
+            prios[:m], _AUDIT_TENANT_CAP, _AUDIT_FILL, "a")
+        # Store-observed spend per tenant vs the ledger's accounting:
+        # spend == settled − outstanding debt, exactly (zero fill).
+        spend = 0.0
+        for t in set(tenants[:m]):
+            bkey = (t, _AUDIT_TENANT_CAP, _AUDIT_FILL)
+            entry = st._buckets.get(bkey)
+            if entry is not None:
+                spend += _AUDIT_TENANT_CAP - entry[0]
+        debt_out = sum(led.debts().values())
+        drift = (abs(spend - (settled - debt_out)) / settled
+                 if settled else 0.0)
+        # Oracle: the same schedule with a PERFECT estimator.
+        st2 = InProcessBucketStore()
+        _g2, oracle, _led2 = await _drive_reservations(
+            st2, tenants[:m], keys[:m], costs[:m], costs[:m],
+            prios[:m], _AUDIT_TENANT_CAP, _AUDIT_FILL, "o")
+        # The differential bound: estimate errors may admit MORE than
+        # the oracle only through visible debt (an under-estimated
+        # stream spends before the overage is known) — never silently.
+        epsilon = led.debt_tokens_created + 0.01 * oracle
+        return {"audit_rows": m, "audit_settled": settled,
+                "oracle_settled": oracle,
+                "audit_debt_created": round(led.debt_tokens_created, 1),
+                "audit_debt_outstanding": round(debt_out, 1),
+                "net_drift": round(drift, 6),
+                "drift_ok": bool(drift <= 0.01),
+                "bound_ok": bool(settled <= oracle + epsilon)}
+
+    out = asyncio.run(throughput())
+    audits = asyncio.run(audit())
+    row = _rate_row("reservations", n, out["settled"], out["dt"], {
+        "settled_rows": out["granted"],
+        "est_sigma": RESV_EST_SIGMA,
+        "refund_ratio": round(out["refunded"]
+                              / max(out["settled"], 1), 4),
+        "debt_ratio": round(out["debt_created"]
+                            / max(out["settled"], 1), 4),
+        **audits,
+    })
+    return row
+
+
 LANES = {
     "inprocess": lane_inprocess,
     "remote_scalar": lane_remote_scalar,
     "asyncio_bulk": lane_asyncio_bulk,
     "native_bulk": lane_native_bulk,
+    "reservations": lane_reservations,
 }
 
 
@@ -272,6 +399,10 @@ def main(argv: "list[str] | None" = None) -> int:
             EVIDENCE.parent.mkdir(parents=True, exist_ok=True)
             with open(EVIDENCE, "a", encoding="utf-8") as f:
                 f.write(json.dumps(row) + "\n")
+            if name == "reservations":
+                with open(EVIDENCE_RESERVATIONS, "a",
+                          encoding="utf-8") as f:
+                    f.write(json.dumps(row) + "\n")
     return rc
 
 

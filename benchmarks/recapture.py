@@ -171,6 +171,49 @@ def _debt_llm_workload_device(smoke: bool) -> dict:
             "unit": "rows/s + tokens/s", "rows": n}
 
 
+def _debt_llm_reservations_device(smoke: bool) -> dict:
+    """The estimate-reserve-settle lane (ISSUE 13) against the DEVICE
+    store: every reserve is a fused hierarchical launch at the
+    estimate, every settle a saturating debit (refund or overage
+    collection) — the reserve+settle round-trip rate and settled
+    tokens/s have only CPU stand-in numbers until this lands on real
+    hardware."""
+    import asyncio
+
+    from benchmarks import llm_workload
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    n = 1 << (9 if smoke else 13)
+    tenants, keys, costs, prios = llm_workload.gen_workload(9, n)
+    np = __import__("numpy")
+    rng = np.random.default_rng(llm_workload._RESV_ERR_SEED)
+    # The TRACKED estimate identity — must match lane_reservations
+    # exactly or the device row stops being comparable to the CPU
+    # stand-in it settles.
+    estimates = np.maximum(
+        costs * rng.lognormal(0.0, llm_workload.RESV_EST_SIGMA, n),
+        1.0)
+    store = DeviceBucketStore(n_slots=1 << (12 if smoke else 16),
+                              max_batch=1024)
+
+    async def one_round(prefix: str) -> float:
+        t0 = time.perf_counter()
+        _g, _s, _led = await llm_workload._drive_reservations(
+            store, tenants, keys, costs, estimates, prios,
+            llm_workload.TENANT_CAP, llm_workload.TENANT_RATE, prefix)
+        return time.perf_counter() - t0
+
+    asyncio.run(one_round("w"))  # warm: compile + slot inserts
+    dt = min(asyncio.run(one_round(p)) for p in ("x", "y"))
+    total_tokens = int(costs.sum())
+    return {"metric": "reserve_settle_pairs_per_sec",
+            "value": round(n / dt),
+            "settled_tokens_per_sec": round(total_tokens / dt),
+            "unit": "reserve+settle pairs/s", "rows": n}
+
+
 def _debt_native_fe_shard_sweep(smoke: bool) -> dict:
     """The multi-shard front-end (round 11) against a DEVICE-class
     backing: shards ∈ {1, 2, 4, 8} SO_REUSEPORT epoll shards on one
@@ -251,6 +294,12 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "(evidence/native_shards_r11.jsonl); the device arm prices the "
      "residue path against a real multi-ms flush",
      _debt_native_fe_shard_sweep),
+    ("llm_reservations_device",
+     "the estimate-reserve-settle lane (ISSUE 13) has no device "
+     "number: reserve = fused hierarchical launch, settle = "
+     "saturating debit — the pair rate rests on the CPU stand-in "
+     "(benchmarks/llm_workload.py reservations lane)",
+     _debt_llm_reservations_device),
 ]
 
 

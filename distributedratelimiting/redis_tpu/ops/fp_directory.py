@@ -61,6 +61,7 @@ __all__ = [
     "fp_acquire_scan_fused",
     "fp_acquire_scan_fused_bits",
     "pack_fp12",
+    "fp_debit_batch",
     "fp_peek_batch",
     "fp_migrate_chunk",
     "fp_sweep_expired",
@@ -341,6 +342,61 @@ def fp_acquire_scan_fused(fp, state: K.BucketState, fused_k, nows_k,
 
     (fp, state), out = jax.lax.scan(body, (fp, state), (fused_k, nows_k))
     return fp, state, out
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("probe_window", "rounds"))
+def fp_debit_batch(fp, state: K.BucketState, kpair, amounts, valid, now,
+                   capacity, fill_rate_per_tick, *,
+                   probe_window: int = 16, rounds: int = 4):
+    """Saturating bulk debit with in-kernel slot resolution — the
+    fingerprint edition of :func:`~.kernels.debit_batch_packed`, and
+    the lane the hierarchical deny-refund (``debit_many`` with a
+    negative amount, runtime/store.py) rides on the fp store. The debit
+    algebra is byte-for-byte the packed kernel's: refill-or-init, then
+    subtract clamped at zero (a NEGATIVE amount credits back; the next
+    refill's capacity clamp bounds any overshoot — refunds can only
+    under-credit, the safe direction), duplicate fingerprints
+    serialized via the demand prefix.
+
+    Resolution inserts on miss (a debit of an absent key initializes
+    it at capacity and debits from there — the host-dict
+    ``InProcessBucketStore.debit_many`` semantics); rows still
+    unresolved after ``rounds`` (window pressure) apply nothing and
+    report their full amount as shortfall.
+
+    Returns ``(fp, state, out f32[2, B])``: row 0 the post-debit
+    balance, row 1 the clamped shortfall.
+    """
+    out = fp_resolve_core(fp, kpair, valid, probe_window=probe_window,
+                          rounds=rounds)
+    live = valid & out.resolved
+    amounts = jnp.asarray(amounts, jnp.float32)
+    size = state.tokens.shape[0]
+    gs = jnp.where(live, out.slots, 0)
+    refilled = bm.refill_or_init(state.tokens[gs], state.last_ts[gs],
+                                 state.exists[gs], now, capacity,
+                                 fill_rate_per_tick)
+    prefix = bm.duplicate_prefix(out.slots, amounts, live)
+    avail = jnp.maximum(refilled - prefix, 0.0)
+    applied = jnp.where(live, jnp.minimum(amounts, avail), 0.0)
+    # Unresolved-but-valid rows (window pressure) applied nothing: a
+    # positive debit reports its full amount as shortfall; a refund
+    # reports zero (shortfall means "tokens the debit did not find",
+    # a refund has none — it just went un-credited, the safe side).
+    shortfall = jnp.where(live, amounts - applied,
+                          jnp.where(valid, jnp.maximum(amounts, 0.0),
+                                    0.0))
+    remaining = jnp.where(live, avail - applied, 0.0)
+    ss = jnp.where(live, out.slots, size)  # size ⇒ scatter-dropped
+    new_tokens = state.tokens.at[ss].set(refilled, mode="drop")
+    new_tokens = new_tokens.at[ss].add(-applied, mode="drop")
+    new_last_ts = state.last_ts.at[ss].set(
+        jnp.asarray(now, jnp.int32), mode="drop")
+    new_exists = state.exists.at[ss].set(True, mode="drop")
+    return (out.fp,
+            K.BucketState(new_tokens, new_last_ts, new_exists),
+            jnp.stack([remaining, shortfall]))
 
 
 @partial(jax.jit, static_argnames=("probe_window",))

@@ -277,11 +277,26 @@ class AdmissionPolicy:
         self._tenants: dict[str, TenantBudget] = dict(tenants or {})
         self.velocity = TokenVelocity(velocity_tau_s, clock=clock)
         self.shed_level: "int | None" = None
+        # Client-side estimate prior for the reservation lane
+        # (runtime/reservations.py): fed by this gateway's own settled
+        # actuals, consulted when reserve() is called with no estimate
+        # — the server keeps its own prior too; the client one lets the
+        # old-peer fallback (flat acquire at the estimate) stay sane.
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            EstimatePrior,
+        )
+
+        self.prior = EstimatePrior()
+        self._rid_seq = 0
         # Visible counters (stats()).
         self.decisions = 0
         self.granted = 0
         self.admitted_tokens = 0.0
         self.shed = 0
+        self.reserves = 0
+        self.reserved_tokens = 0.0
+        self.settles = 0
+        self.settled_tokens = 0.0
 
     # -- tenant budget management (live-mutable) -----------------------------
     def set_tenant(self, budget: TenantBudget) -> None:
@@ -350,6 +365,64 @@ class AdmissionPolicy:
             self.velocity.observe(tenant, float(cost))
         return res
 
+    # -- streaming reservations (runtime/reservations.py) --------------------
+    def next_rid(self, tenant: str) -> str:
+        """A per-gateway reservation id: tenant-scoped + monotonic.
+        Unique across gateways only when each gateway's ids carry a
+        distinct prefix — callers with several gateways pass their own
+        rids instead (the seeded soaks do, for determinism)."""
+        self._rid_seq += 1
+        return f"{tenant}#{id(self) & 0xFFFFFF:x}#{self._rid_seq}"
+
+    async def reserve(self, tenant: str, key: str, *,
+                      estimate: "float | None" = None,
+                      priority: int = PRIORITY_INTERACTIVE,
+                      rid: "str | None" = None,
+                      ttl_s: "float | None" = None):
+        """Phase 1 of a streaming request: admit an ESTIMATED cost and
+        hold it against the tenant → key budgets. With no ``estimate``
+        the gateway's own prior supplies one (interactive → p99,
+        batch/scavenger → mean — the server-side prior applies the same
+        rule when the estimate is omitted on the wire). Returns the
+        store's ReserveResult; pass ``result``'s rid (yours or
+        :meth:`next_rid`'s) to :meth:`settle` when the stream ends."""
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            ReserveResult,
+        )
+
+        self.decisions += 1
+        if self.shed_level is not None and priority >= self.shed_level:
+            self.shed += 1
+            return ReserveResult(False, 0.0, 0.0, 0.0)
+        budget = self.tenant(tenant)
+        cap, rate = self.key_config
+        if estimate is None:
+            estimate = self.prior.estimate(tenant, priority)
+        res = await self.store.reserve(
+            rid if rid is not None else self.next_rid(tenant),
+            tenant, key, estimate, budget.capacity,
+            budget.fill_rate_per_sec, cap, rate, priority=priority,
+            ttl_s=ttl_s)
+        if res.granted:
+            self.granted += 1
+            self.reserves += 1
+            self.reserved_tokens += res.reserved
+        return res
+
+    async def settle(self, rid: str, tenant: str, actual: float, *,
+                     priority: int = PRIORITY_INTERACTIVE):
+        """Phase 3: reconcile the actual cost. Feeds the gateway's
+        velocity (at the TRUE spend) and its estimate prior."""
+        res = await self.store.settle(rid, tenant, actual)
+        if res.outcome in ("settled", "fallback", "expired"):
+            self.settles += 1
+            self.settled_tokens += actual
+            self.admitted_tokens += actual
+            if actual > 0:
+                self.velocity.observe(tenant, float(actual))
+            self.prior.observe(tenant, priority, float(actual))
+        return res
+
     def envelope_budget(self, tenant: str, *,
                         fraction: float = 0.5) -> float:
         """The tenant's fair-share envelope size — the epsilon term a
@@ -364,6 +437,10 @@ class AdmissionPolicy:
             "granted": self.granted,
             "admitted_tokens": self.admitted_tokens,
             "shed": self.shed,
+            "reserves": self.reserves,
+            "reserved_tokens": self.reserved_tokens,
+            "settles": self.settles,
+            "settled_tokens": self.settled_tokens,
             "shed_level": self.shed_level,
             "tenants": {t: list(b.config())
                         for t, b in sorted(self._tenants.items())},

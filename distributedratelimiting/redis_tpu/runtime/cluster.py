@@ -1391,6 +1391,55 @@ class ClusterBucketStore(BucketStore):
                 remaining[idx] = res.remaining
         return BulkAcquireResult(granted, remaining)
 
+    # -- estimate-reserve-settle (runtime/reservations.py) -------------------
+    async def reserve(self, rid: str, tenant: str, key: str,
+                      estimate: "float | None",
+                      tenant_capacity: float,
+                      tenant_fill_rate_per_sec: float,
+                      capacity: float, fill_rate_per_sec: float, *,
+                      priority: int = 0,
+                      ttl_s: "float | None" = None):
+        """Routed by TENANT like every hierarchical lane (the ledger
+        entry must live with the tenant's owner so its settle finds
+        it). The degraded fallback admits the estimate through the
+        two-level envelope — bounded availability with NO hold (the
+        quarantined owner's ledger is unreachable); the eventual
+        settle answers the counted "unknown" no-op, conservative."""
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            ReserveResult,
+            fallback_charge,
+        )
+
+        charge = fallback_charge(estimate)
+
+        def fallback(j):
+            res = self._degraded_hier(
+                j, tenant, key, charge, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority)
+            return ReserveResult(res.granted,
+                                 float(charge) if res.granted else 0.0,
+                                 res.remaining, 0.0, fallback=True)
+
+        return await self._routed(
+            tenant,
+            lambda j: self.nodes[j].reserve(
+                rid, tenant, key, estimate, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority=priority, ttl_s=ttl_s),
+            fallback)
+
+    async def settle(self, rid: str, tenant: str, actual: float):
+        """Settle routes to the tenant's owner (one MOVED chase like
+        every keyed lane — the op is idempotent by rid, so the re-send
+        after a placement refresh is safe). No degraded fallback: a
+        settle against a quarantined owner surfaces the typed
+        unavailability and the caller retries after rejoin — the TTL
+        auto-settle bounds how long an unreachable ledger can hold."""
+        return await self._routed(
+            tenant,
+            lambda j: self.nodes[j].settle(rid, tenant, actual))
+
     def peek_blocking(self, key: str, capacity: float,
                       fill_rate_per_sec: float) -> float:
         # No degraded value exists for a peek — it reports the

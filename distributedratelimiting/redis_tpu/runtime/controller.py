@@ -86,6 +86,7 @@ SENSOR_SERIES = (
     "drl_token_velocity",         # server.py — per-tenant decayed tokens/sec
     "drl_hot_key_count",          # server.py — cost-weighted top-K sketch
     "drl_requests_shed",          # server.py — shed feedback
+    "drl_reservations_outstanding",  # server.py — unsettled reserved tokens
     "drl_cluster_breaker_state",  # cluster.py — membership health
     "drl_cluster_node_errors",    # cluster.py — node failure counters
 )
@@ -124,6 +125,16 @@ class ControllerConfig:
     #: the level shed). PRIORITY_BATCH sheds batch + scavenger;
     #: interactive traffic is NEVER shed autonomously.
     shed_floor: int = PRIORITY_BATCH
+    #: Outstanding-reservation horizon: reserved-but-unsettled tokens
+    #: (the ``drl_reservations_outstanding`` gauge, summed fleet-wide)
+    #: are load that WILL land — fold them into the shed pressure as a
+    #: prospective rate, ``outstanding / horizon`` tokens/sec (they are
+    #: expected to settle within about one horizon — the reservation
+    #: TTL's scale). This is what lets a brownout start BEFORE a wave
+    #: of admitted-but-still-streaming requests hits the settled-token
+    #: rate. The existing shed hysteresis (raise/lower streaks + the
+    #: shed_low/shed_high dead band) guards the combined signal.
+    reservation_horizon_s: float = 10.0
 
     # -- hot-cost key splitting (sketch-fed) --------------------------------
     #: One key's share of the fleet's per-tick admitted-token delta
@@ -176,6 +187,8 @@ class ControllerConfig:
                 raise ValueError(f"{name} must be >= 1")
         if self.cooldown_ticks < 0:
             raise ValueError("cooldown_ticks must be >= 0")
+        if self.reservation_horizon_s <= 0:
+            raise ValueError("reservation_horizon_s must be positive")
         if self.shed_floor < PRIORITY_BATCH:
             raise ValueError("shed_floor below PRIORITY_BATCH would shed "
                              "interactive traffic autonomously — refused")
@@ -202,6 +215,10 @@ class Sensors:
     #: fleet-aggregated per-key admitted-token delta THIS tick,
     #: descending — the sketch-fed hot-cost ranking.
     hot_key_deltas: "list[tuple[str, float]]"
+    #: fleet-summed outstanding reserved tokens (reserve issued, settle
+    #: pending — a LEVEL gauge, not a counter delta: the holds
+    #: themselves are the prospective load).
+    outstanding_tokens: float = 0.0
 
     @property
     def skew(self) -> float:
@@ -276,6 +293,7 @@ class Controller:
         self.last_pressure = 0.0
         self.last_skew = 1.0
         self.last_token_rate = 0.0
+        self.last_outstanding = 0.0
         self._stop = asyncio.Event()
         # Announce on the audit surfaces that can splice us in
         # (cluster.stats() "controller" section, cluster_metrics()).
@@ -306,6 +324,7 @@ class Controller:
         node_rates = []
         tenant_rates: dict[str, float] = {}
         hot_totals: dict[str, float] = {}
+        outstanding = 0.0
         for j, ns in enumerate(nodes):
             if not ns:
                 node_rates.append(0.0)
@@ -313,6 +332,11 @@ class Controller:
             node_rates.append(self._deltas.rate(
                 f"node{j}/requests", ns.get("requests_served", 0),
                 cfg.tick_s))
+            # Outstanding reservations are a level, summed as-is (an
+            # unobserved node contributes nothing — conservative: its
+            # holds neither spike nor mask the fleet pressure).
+            outstanding += float((ns.get("reservations") or {})
+                                 .get("outstanding_tokens", 0.0))
             tv = ns.get("token_velocity") or {}
             for tenant, total in (tv.get("admitted") or {}).items():
                 tenant_rates[tenant] = tenant_rates.get(tenant, 0.0) \
@@ -348,6 +372,7 @@ class Controller:
             token_rate=token_rate,
             tenant_rates=tenant_rates,
             hot_key_deltas=hot_deltas,
+            outstanding_tokens=outstanding,
         )
 
     # -- flap guards ---------------------------------------------------------
@@ -475,11 +500,19 @@ class Controller:
                  spread=round(spread, 4))
             self._streaks["rebalance"] = 0
 
-        # 4. Shed ladder from token-velocity pressure. The decided
-        # level evolves here (dry-run included); execution only pushes
-        # it to the attached gateways.
+        # 4. Shed ladder from token-velocity pressure PLUS outstanding-
+        # reservation pressure: reserved-but-unsettled tokens are load
+        # that WILL land, folded in as a prospective rate over the
+        # reservation horizon — brownouts start before a wave of
+        # still-streaming requests reaches the settled-token rate. The
+        # decided level evolves here (dry-run included); execution only
+        # pushes it to the attached gateways.
+        self.last_outstanding = sensors.outstanding_tokens
         if cfg.token_rate_capacity:
-            pressure = sensors.token_rate / cfg.token_rate_capacity
+            prospective = (sensors.outstanding_tokens
+                           / cfg.reservation_horizon_s)
+            pressure = ((sensors.token_rate + prospective)
+                        / cfg.token_rate_capacity)
             self.last_pressure = pressure
             hi = self._streak("shed_high", pressure >= cfg.shed_high)
             lo = self._streak("shed_low", pressure <= cfg.shed_low)
@@ -636,6 +669,7 @@ class Controller:
             "pressure": self.last_pressure,
             "skew": self.last_skew,
             "token_rate": self.last_token_rate,
+            "outstanding_tokens": self.last_outstanding,
             "budget_remaining": self.budget_remaining(),
             "dry_run": int(self.config.dry_run),
             "auto_drained": len(self.auto_drained),

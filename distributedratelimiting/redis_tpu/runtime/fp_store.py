@@ -348,6 +348,38 @@ class _FpTable:
         return await loop.run_in_executor(
             None, lambda: self._gather_bulk(outs, counts_np, with_remaining))
 
+    def _debit_launch(self, keys: Sequence[str],
+                      amounts: Sequence[float]):
+        """One saturating-debit launch with in-kernel slot resolution
+        (``fp_debit_batch``) — the lane ``DeviceBucketStore.debit_many``
+        dispatches through. On this store it carries BOTH the tier-0
+        reconciliation shape and the hierarchical deny-refund's
+        NEGATIVE-amount credit (the PR-9 base-compose fallback: fp
+        tables skip the fused hierarchical kernel, so a child deny
+        refunds the parent here). Mirrors the host-directory
+        ``_DeviceTable._debit_launch`` contract — returns the packed
+        ``f32[2, B]`` (post-debit balance, clamped shortfall)."""
+        store = self.store
+        n = len(keys)
+        with store.profiler.span("debit_batch", n), store._lock:
+            b = _pad_size(n, floor=64)
+            kpair = np.zeros((b, 2), np.uint32)
+            kpair[:n] = fingerprints(list(keys))
+            amts = np.zeros((b,), np.float32)
+            amts[:n] = np.asarray(amounts, np.float32)
+            valid = np.zeros((b,), bool)
+            valid[:n] = True
+            now = store.now_ticks_checked()
+            self.fp, self.state, out = F.fp_debit_batch(
+                self.fp, self.state, jnp.asarray(kpair),
+                jnp.asarray(amts), jnp.asarray(valid), jnp.int32(now),
+                self.cap_dev, self.rate_dev,
+                probe_window=self.probe_window, rounds=self.rounds)
+            if self.dirty_rows is not None:
+                self.dirty_rows += n
+            store.metrics.record_launch(b, n)
+            return out
+
     # -- reads -------------------------------------------------------------
     def peek_blocking(self, key: str) -> float:
         b = 64

@@ -328,7 +328,16 @@ class PlacementMap:
 # (``now_ticks − ts``) — the two processes' clock epochs never compare
 # (invariant 1); the importer re-anchors against its own now.
 
-_EMPTY_ENTRIES = {"buckets": [], "windows": [], "counters": [], "semas": []}
+#: ``reservations`` rows are the estimate-reserve-settle ledger's
+#: outstanding holds (``[tenant, rid, key, reserved, a, b, ta, tb,
+#: priority, ttl_remaining_s]`` — row[0] is the TENANT because that is
+#: the routing identity hierarchical traffic and its settles follow);
+#: ``debts`` rows are ``[tenant, amount, export_tag]``. Both ride the same
+#: chunk/dedup/push machinery as bucket state; an old importer simply
+#: ignores the unknown sections (the reserved tokens stay debited and
+#: unrefunded — under-admission, the safe direction).
+_EMPTY_ENTRIES = {"buckets": [], "windows": [], "counters": [],
+                  "semas": [], "reservations": [], "debts": []}
 
 
 def entry_count(entries: Mapping) -> int:
@@ -440,11 +449,16 @@ def chunk_entries(entries: Mapping, max_rows: int = 4096) -> list[dict]:
     size = 0
     for section in _EMPTY_ENTRIES:
         for row in entries.get(section, ()):
-            # Size the key as it will actually serialize: ensure_ascii
-            # JSON expands every non-ASCII / surrogate-escaped char to a
-            # 6-byte \uXXXX escape, so a 60 KiB hostile key can be ~6x
-            # its character count on the wire.
-            row_size = len(json.dumps(str(row[0]))) + _ROW_OVERHEAD
+            # Size EVERY string field as it will actually serialize:
+            # ensure_ascii JSON expands every non-ASCII / surrogate-
+            # escaped char to a 6-byte \uXXXX escape, so a 60 KiB
+            # hostile key can be ~6x its character count on the wire —
+            # and reservation rows carry rid + child key at positions
+            # 1-2 beyond the tenant at row[0], so sizing row[0] alone
+            # would let a chunk of long-keyed reservations blow past
+            # MAX_FRAME.
+            row_size = sum(len(json.dumps(v)) for v in row
+                           if isinstance(v, str)) + _ROW_OVERHEAD
             if n and (n >= max_rows
                       or size + row_size > _CHUNK_BYTE_BUDGET):
                 chunks.append(cur)
@@ -653,7 +667,8 @@ class _Handoff:
     that serves the parked keys until commit, abort, or expiry."""
 
     __slots__ = ("target_epoch", "slots", "keys", "export", "chunks",
-                 "window_s", "started_s", "envelope")
+                 "window_s", "started_s", "envelope", "ledger",
+                 "res_stash")
 
     def __init__(self, target_epoch: int, slots: frozenset,
                  keys: "frozenset | None", export: dict, window_s: float,
@@ -669,6 +684,11 @@ class _Handoff:
         self.window_s = window_s
         self.started_s = started_s
         self.envelope = _FairShareEnvelope(fraction, clock)
+        # Reservation-ledger stash: the rows pull removed from the
+        # source ledger, kept so an abort can restore them (the new
+        # owner's copy only exists once a push delivered the chunk).
+        self.ledger = None
+        self.res_stash: "tuple[list, list] | None" = None
 
     def expired(self, now: float) -> bool:
         return now - self.started_s > self.window_s
@@ -806,6 +826,12 @@ class NodePlacementState:
         h = self._handoffs.pop(target_epoch, None)
         if h is not None:
             self._unpark(h)
+            if h.ledger is not None and h.res_stash is not None:
+                # The migration died: the exported reservations come
+                # home (restore_rows skips any rid the ledger re-learned
+                # meanwhile, so a racing late push cannot double-count).
+                h.ledger.restore_rows(*h.res_stash)
+                h.res_stash = None
             self.aborts += 1
             # The export for this epoch (and its source debit) is gone:
             # refuse late re-pulls until the coordinator acknowledges
@@ -866,9 +892,30 @@ class NodePlacementState:
             # so one pull never stalls the serving path's event loop.
             entries = await asyncio.to_thread(_export_from_store, store,
                                               keep)
+            # Outstanding reservations (and debts) whose TENANT moves
+            # ride the same export: their settles will land on the new
+            # owner (the tenant's MOVED target), so the ledger entries
+            # must be there to reconcile against. Removed from the
+            # source ledger here; an abort restores them (stash below).
+            led = getattr(store, "_reservations", None)
+            res_stash = None
+            if led is not None:
+                # Tag = the export episode: a same-epoch retry after an
+                # abort re-ships the restored debts under the SAME tag,
+                # and an owner already holding attempt 1's copy skips
+                # them (ReservationLedger.restore_rows).
+                res_rows, debt_rows = led.export_rows(
+                    keep, tag=f"epoch:{target_epoch}")
+                if res_rows or debt_rows:
+                    entries = dict(entries)
+                    entries["reservations"] = res_rows
+                    entries["debts"] = debt_rows
+                    res_stash = (res_rows, debt_rows)
             export = debit_export(entries, self._fraction)
             h = _Handoff(target_epoch, slots, keys, export, window_s,
                          self._clock(), self._fraction, self._clock)
+            h.ledger = led
+            h.res_stash = res_stash
             self._handoffs[target_epoch] = h
             for s in slots:
                 self._parked_slots[s] = h
@@ -1076,10 +1123,21 @@ async def import_entries(store, entries: Mapping) -> int:
       value); semaphores via ``concurrency_acquire``.
 
     Returns the number of rows applied."""
+    n = 0
+    # Reservation-ledger sections route to the store's attached ledger
+    # BEFORE the store-specific importer branch — both import lanes
+    # (exact host-dict merge and generic replay) must adopt them, and
+    # the ledger is shared with the serving path by construction
+    # (BucketStore.reservation_ledger), so the next settle sees them.
+    res_rows = entries.get("reservations") or ()
+    debt_rows = entries.get("debts") or ()
+    if res_rows or debt_rows:
+        maker = getattr(store, "reservation_ledger", None)
+        if callable(maker):
+            n += maker().restore_rows(res_rows, debt_rows)
     importer = getattr(store, "import_entries", None)
     if callable(importer):
-        return await importer(entries)
-    n = 0
+        return n + await importer(entries)
     by_config: dict[tuple, tuple[list, list]] = {}
     for key, cap, rate, tokens, _age in entries.get("buckets", ()):
         ks, amounts = by_config.setdefault((float(cap), float(rate)),

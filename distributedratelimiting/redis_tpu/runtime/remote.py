@@ -86,7 +86,13 @@ class StoreTimeoutError(asyncio.TimeoutError):
 _IDEMPOTENT_OPS = frozenset((
     wire.OP_PEEK, wire.OP_PING, wire.OP_METRICS, wire.OP_PLACEMENT,
     wire.OP_PLACEMENT_ANNOUNCE, wire.OP_MIGRATE_PULL,
-    wire.OP_MIGRATE_PUSH, wire.OP_CONFIG))
+    wire.OP_MIGRATE_PUSH, wire.OP_CONFIG,
+    # Reservation lane: application-idempotent BY RESERVATION ID — a
+    # retried reserve of a granted rid replays the recorded decision
+    # (no second debit), a retried settle replays the recorded
+    # reconciliation (outcome "duplicate", zero side effects) — the
+    # MIGRATE_PUSH dedup posture, so post-send retries are safe.
+    wire.OP_RESERVE, wire.OP_SETTLE))
 
 #: The explicit NOT-idempotent half of the classification: admission
 #: ops double-debit on replay; HELLO re-auth mid-stream is a protocol
@@ -171,6 +177,14 @@ class RemoteBucketStore(BucketStore):
         # availability over tenant-budget accuracy, logged once).
         self._peer_hier = True
         self._hier_fallbacks = 0
+        # Reservation-lane latch (OP_RESERVE/OP_SETTLE): an old server
+        # answers the routable unknown-op error — latch off once per
+        # connection lifetime and fall back to plain
+        # acquire_hierarchical at the estimate (no server-side hold:
+        # refunds are forgone against that peer — the conservative
+        # direction, logged once + counted).
+        self._peer_reserve = True
+        self._reserve_fallbacks = 0
 
         # -- resilience (docs/OPERATIONS.md §8, DESIGN.md §11) ---------
         # Bounded, jittered retries. At-most-once for admission: an op
@@ -943,6 +957,137 @@ class RemoteBucketStore(BucketStore):
                                          fill_rate_per_sec,
                                          timeout_s=timeout_s)
 
+    # -- estimate-reserve-settle (OP_RESERVE / OP_SETTLE) --------------------
+    #: The ledger lives SERVER-side; None (not a method) so the
+    #: migration import lane's ``callable(...)`` probe skips this
+    #: client instead of minting a local ledger nothing would serve.
+    reservation_ledger = None
+
+    def _note_reserve_fallback(self) -> None:
+        if self._peer_reserve:
+            self._peer_reserve = False
+            log.error_evaluating_kernel(RuntimeError(
+                "server does not speak the reservation lane "
+                "(OP_RESERVE/OP_SETTLE); reserve falls back to plain "
+                "acquire_hierarchical at the estimate — over-estimate "
+                "refunds are NOT issued against this peer"))
+        self._reserve_fallbacks += 1
+
+    async def _reserve_fallback(self, rid: str, tenant: str, key: str,
+                                estimate: "float | None",
+                                tenant_capacity: float,
+                                tenant_fill_rate_per_sec: float,
+                                capacity: float,
+                                fill_rate_per_sec: float,
+                                priority: int,
+                                timeout_s: "float | None"):
+        """Old-peer path: charge the estimate through the hierarchical
+        lane (which itself degrades to flat child-only admission
+        against even older peers). No hold exists anywhere — the later
+        settle is a client-side no-op."""
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            ReserveResult,
+            fallback_charge,
+        )
+
+        charge = fallback_charge(estimate)
+        res = await self.acquire_hierarchical(
+            tenant, key, charge, tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+            priority=priority, timeout_s=timeout_s)
+        return ReserveResult(res.granted,
+                             float(charge) if res.granted else 0.0,
+                             res.remaining, 0.0, fallback=True)
+
+    async def reserve(self, rid: str, tenant: str, key: str,
+                      estimate: "float | None",
+                      tenant_capacity: float,
+                      tenant_fill_rate_per_sec: float,
+                      capacity: float, fill_rate_per_sec: float, *,
+                      priority: int = 0,
+                      ttl_s: "float | None" = None,
+                      timeout_s: "float | None" = None):
+        """One OP_RESERVE frame: admission at the estimate + a TTL'd
+        server-side hold (runtime/reservations.py). Both config levels
+        translate through the learned live-config rules up front (the
+        ``_chase_hier`` contract); post-send retries are safe — the
+        server dedups by ``rid``."""
+        import json
+
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            ReserveResult,
+        )
+
+        if not self._peer_reserve:
+            self._reserve_fallbacks += 1
+            return await self._reserve_fallback(
+                rid, tenant, key, estimate, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority, timeout_s)
+
+        async def call(ta, tb, a, b):
+            payload: dict = {"rid": rid, "tenant": tenant, "key": key,
+                             "a": a, "b": b, "ta": ta, "tb": tb,
+                             "priority": int(priority)}
+            if estimate is not None:
+                payload["estimate"] = float(estimate)
+            if ttl_s is not None:
+                payload["ttl_s"] = float(ttl_s)
+            (text,) = await self._request(
+                wire.OP_RESERVE, json.dumps(payload),
+                timeout_s=timeout_s)
+            d = json.loads(text)
+            return ReserveResult(bool(d.get("granted")),
+                                 float(d.get("reserved", 0.0)),
+                                 float(d.get("remaining", 0.0)),
+                                 float(d.get("debt", 0.0)),
+                                 bool(d.get("duplicate", False)))
+
+        try:
+            return await self._chase_hier(
+                tenant_capacity, tenant_fill_rate_per_sec, capacity,
+                fill_rate_per_sec, call)
+        except wire.RemoteStoreError as exc:
+            if "unknown op" not in str(exc):
+                raise
+            self._note_reserve_fallback()
+            return await self._reserve_fallback(
+                rid, tenant, key, estimate, tenant_capacity,
+                tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+                priority, timeout_s)
+
+    async def settle(self, rid: str, tenant: str, actual: float, *,
+                     timeout_s: "float | None" = None):
+        """One OP_SETTLE frame (idempotent by rid — post-send-retry-
+        safe). Against a latched old peer this is a counted client-side
+        no-op: the fallback reserve charged the estimate outright, and
+        there is no server-side hold to reconcile."""
+        import json
+
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            SettleResult,
+        )
+
+        if not self._peer_reserve:
+            self._reserve_fallbacks += 1
+            return SettleResult("fallback", 0.0, 0.0, 0.0)
+        try:
+            (text,) = await self._request(
+                wire.OP_SETTLE,
+                json.dumps({"rid": rid, "tenant": tenant,
+                            "actual": float(actual)}),
+                timeout_s=timeout_s)
+        except wire.RemoteStoreError as exc:
+            if "unknown op" not in str(exc):
+                raise
+            self._note_reserve_fallback()
+            return SettleResult("fallback", 0.0, 0.0, 0.0)
+        d = json.loads(text)
+        return SettleResult(str(d.get("outcome", "settled")),
+                            float(d.get("delta", 0.0)),
+                            float(d.get("refunded", 0.0)),
+                            float(d.get("debt", 0.0)))
+
     def _hier_tail_budget(self, tenant: str) -> int:
         """Chunk budget for HBUCKET frames: the per-frame tenant
         extension rides every chunk, so the spans must leave room for
@@ -1336,6 +1481,7 @@ class RemoteBucketStore(BucketStore):
             "connect_failures": self._connect_failures,
             "backing_off": backing_off,
             "hier_fallbacks": self._hier_fallbacks,
+            "reserve_fallbacks": self._reserve_fallbacks,
         }
 
     async def save(self) -> None:

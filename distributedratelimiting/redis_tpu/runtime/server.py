@@ -231,10 +231,31 @@ class BucketStoreServer:
         # Live-config half (docs/OPERATIONS.md §10): committed forwarding
         # rules behind OP_CONFIG. Dormant until the first rule commits.
         self.liveconfig = liveconfig.ConfigState()
+        # Estimate-reserve-settle ledger (runtime/reservations.py):
+        # the STORE-attached ledger, shared with the migration import
+        # lane (placement.import_entries routes "reservations" entry
+        # sections into the same instance), wired with this server's
+        # observability plane. Always on — reservations are admission
+        # correctness, not telemetry; the OP_STATS section and metric
+        # families render only once traffic arrives.
+        if callable(getattr(store, "reservation_ledger", None)):
+            self.reservations = store.reservation_ledger()
+            # (Re)wire explicitly rather than via creation kwargs: a
+            # store re-fronted by a new server (rolling restarts in
+            # tests) must see THIS server's plane, not the old one's.
+            self.reservations.flight_recorder = self.flight_recorder
+            self.reservations.velocity = self.token_velocity
+            self.reservations.liveconfig = self.liveconfig
+        else:  # pragma: no cover — every BucketStore carries the hook
+            self.reservations = None
         # Drain-and-handoff shutdown (shutdown()): while a drain is in
         # flight, admission ops serve from this bounded fair-share
         # envelope instead of the (already exported) store.
         self._drain_envelope: "placement._FairShareEnvelope | None" = None
+        #: Successor handle while a drain window is open: OP_SETTLE is
+        #: RELAYED there (the ledger entries shipped with the export),
+        #: so in-flight streams settle instead of erroring out.
+        self._drain_successor = None
         self._drain_deadline = 0.0
         self._shutdown_done = False
         #: Autonomous control plane, when this process hosts one (the
@@ -503,6 +524,46 @@ class BucketStoreServer:
                 "resharding signal",
                 lambda: [({"tenant": t}, r)
                          for t, r in tv.rates().items()])
+        if self.reservations is not None:
+            led = self.reservations
+            reg.labeled_gauges(
+                "reservations_outstanding",
+                "Outstanding reserved tokens per tenant (reserve "
+                "issued, settle pending) — the unsettled-load signal "
+                "the controller's shed ladder folds into its pressure "
+                "sensor",
+                lambda: [({"tenant": t}, v)
+                         for t, v in led.outstanding_by_tenant()
+                         .items()])
+            reg.labeled_gauges(
+                "reservation_debt",
+                "Per-tenant unsettled under-estimate debt (tokens the "
+                "budget must cover before the next reserve admits)",
+                lambda: [({"tenant": t}, v)
+                         for t, v in led.debts().items()])
+            reg.register_numeric_dict(
+                "reservation", "estimate-reserve-settle ledger",
+                lambda: (led.numeric_stats() if led.active else None),
+                counters={"reserves", "reserve_denied",
+                          "reserve_duplicates", "ledger_full_denials",
+                          "debt_denials", "settles",
+                          "settle_duplicates", "settle_unknown",
+                          "ttl_expired", "refunds", "refunded_tokens",
+                          "debts_created", "debt_tokens_created",
+                          "debt_tokens_collected", "rehomed",
+                          "reserved_tokens_total",
+                          "settled_tokens_total"})
+            # Settle-error magnitude histograms. Values record at
+            # tokens × 1e-6 (the class buckets from 1e-6 up — see
+            # reservations.py), so bucket bounds read as micro-tokens.
+            reg.histogram("reservation_refund_tokens",
+                          "Over-estimate refund magnitudes "
+                          "(bucket unit: tokens x 1e-6)",
+                          lambda: led.refund_hist)
+            reg.histogram("reservation_debt_tokens",
+                          "Under-estimate overage magnitudes "
+                          "(bucket unit: tokens x 1e-6)",
+                          lambda: led.debt_hist)
         if self.flight_recorder is not None:
             reg.register_numeric_dict(
                 "flight", "flight recorder",
@@ -1076,6 +1137,14 @@ class BucketStoreServer:
                         payload, self.store)
                     resp = wire.encode_response(seq, wire.RESP_VALUE,
                                                 float(version))
+            elif op == wire.OP_RESERVE:
+                import json
+
+                resp = await self._serve_reserve(seq, json.loads(key))
+            elif op == wire.OP_SETTLE:
+                import json
+
+                resp = await self._serve_settle(seq, json.loads(key))
             elif op == wire.OP_TRACES:
                 # Chrome-trace JSON capped under MAX_FRAME (newest traces
                 # win); flag bit 0 drains the buffer after export.
@@ -1201,6 +1270,145 @@ class BucketStoreServer:
             self.token_velocity.observe(tenant, float(count))
         return wire.encode_response(seq, wire.RESP_DECISION,
                                     res.granted, res.remaining)
+
+    # -- estimate-reserve-settle dispatch (runtime/reservations.py) ----------
+    async def _serve_reserve(self, seq: int, req: dict) -> bytes:
+        """One OP_RESERVE frame: admission at the estimate + a TTL'd
+        ledger hold. Mirrors the OP_ACQUIRE_H lane gate-for-gate —
+        live-config on both levels, drain envelope, placement keyed on
+        the TENANT (reservations route with the hierarchical traffic
+        they budget). Envelope-served reserves (drain window / parked
+        handoff) take NO ledger entry: the state is mid-flight to
+        another owner, the grant is envelope-bounded, and the eventual
+        settle answers the counted "unknown" no-op — the hold is never
+        refunded, the conservative direction (DESIGN.md §18)."""
+        import json
+
+        rid = str(req.get("rid") or "")
+        tenant = str(req.get("tenant") or "")
+        key = str(req.get("key") or "")
+        if not rid or not tenant or not key:
+            return wire.encode_response(
+                seq, wire.RESP_ERROR,
+                "reserve requires rid, tenant, and key")
+        estimate = req.get("estimate")
+        a, b = float(req.get("a", 0.0)), float(req.get("b", 0.0))
+        ta, tb = float(req.get("ta", 0.0)), float(req.get("tb", 0.0))
+        priority = int(req.get("priority", 0))
+        ttl_s = req.get("ttl_s")
+        gate_resp = self._hier_config_gate(seq, a, b, ta, tb)
+        if gate_resp is not None:
+            return gate_resp
+        from distributedratelimiting.redis_tpu.runtime.reservations import (
+            fallback_charge,
+        )
+
+        led = self.reservations
+        est = float(estimate) if estimate else None
+        if est is None and led is not None:
+            est = led.prior.estimate(tenant, priority)
+            if est is None:
+                est = led.default_estimate
+        # fallback_charge floors an estimate-less charge at the same
+        # DEFAULT_ESTIMATE the ledger uses — the envelope paths below
+        # must not admit a typical stream for a 1-token charge.
+        charge = fallback_charge(est)
+
+        def envelope_reply(env_acquire) -> bytes:
+            granted, remaining = self._hier_envelope(
+                env_acquire, tenant, key, charge, a, b, ta, tb,
+                priority)
+            return wire.encode_response(seq, wire.RESP_TEXT, json.dumps(
+                {"granted": bool(granted),
+                 "reserved": float(charge) if granted else 0.0,
+                 "remaining": float(remaining), "debt": 0.0,
+                 "envelope": True}))
+
+        env = self._drain_envelope
+        if env is not None:
+            return envelope_reply(env.acquire)
+        if self.placement.active:
+            verdict = self.placement.gate(tenant)
+            if verdict is not None:
+                what, info = verdict
+                if what == "envelope":
+                    return envelope_reply(
+                        lambda k, c, pa, pb, kind, prio:
+                        self.placement.envelope_acquire(
+                            info, k, c, pa, pb, kind, prio))
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    self.placement.moved_message(tenant, info))
+        if led is None:  # pragma: no cover — every store has a ledger
+            return wire.encode_response(
+                seq, wire.RESP_ERROR,
+                "this server has no reservation ledger")
+        hh = self.heavy_hitters
+        if hh is not None and charge > 1:
+            hh.offer(key, charge)
+        res = await led.reserve(rid, tenant, key, estimate, ta, tb,
+                                a, b, priority=priority, ttl_s=ttl_s)
+        return wire.encode_response(seq, wire.RESP_TEXT, json.dumps(
+            {"granted": res.granted, "reserved": res.reserved,
+             "remaining": res.remaining, "debt": res.debt,
+             "duplicate": res.duplicate}))
+
+    async def _serve_settle(self, seq: int, req: dict) -> bytes:
+        """One OP_SETTLE frame: reconcile a reservation's actual cost.
+        During a drain window the settle RELAYS to the successor (the
+        ledger entries shipped with the export; settle is idempotent,
+        so even a duplicated relay is safe); a parked/moved tenant
+        answers the deferral/MOVED errors so the retry lands on the
+        ledger's new owner."""
+        import json
+
+        rid = str(req.get("rid") or "")
+        tenant = str(req.get("tenant") or "")
+        if not rid or not tenant:
+            return wire.encode_response(
+                seq, wire.RESP_ERROR,
+                "settle requires rid and tenant")
+        try:
+            actual = float(req.get("actual", 0.0))
+        except (TypeError, ValueError):
+            return wire.encode_response(seq, wire.RESP_ERROR,
+                                        "settle actual must be a number")
+        successor = self._drain_successor
+        if self._drain_envelope is not None and successor is not None:
+            try:
+                res = await successor.settle(rid, tenant, actual)
+            except Exception as exc:
+                log.error_evaluating_kernel(exc)
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    f"{placement.HANDOFF_DEFERRAL_PREFIX}: settle "
+                    "relay to the drain successor failed; retry")
+            return wire.encode_response(
+                seq, wire.RESP_TEXT, json.dumps(res._asdict()))
+        if self.placement.active:
+            verdict = self.placement.gate(tenant)
+            if verdict is not None:
+                what, info = verdict
+                if what == "envelope":
+                    # Parked mid-handoff: the ledger rows already left
+                    # with the export — the retry (settle is post-send-
+                    # retry-safe) lands after commit on the new owner.
+                    self.placement.handoff_deferrals += 1
+                    return wire.encode_response(
+                        seq, wire.RESP_ERROR,
+                        f"{placement.HANDOFF_DEFERRAL_PREFIX} for "
+                        f"this tenant (target epoch "
+                        f"{info.target_epoch}); retry shortly")
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    self.placement.moved_message(tenant, info))
+        if self.reservations is None:  # pragma: no cover
+            return wire.encode_response(
+                seq, wire.RESP_ERROR,
+                "this server has no reservation ledger")
+        res = await self.reservations.settle(rid, tenant, actual)
+        return wire.encode_response(seq, wire.RESP_TEXT,
+                                    json.dumps(res._asdict()))
 
     async def _serve_bulk_hier(self, seq: int, body: bytes, keys,
                                counts, a: float, b: float,
@@ -1361,13 +1569,20 @@ class BucketStoreServer:
         except asyncio.CancelledError:
             self._shutdown_done = False
             self._drain_envelope = None
+            self._drain_successor = None
             raise
         except Exception as exc:
             # Resume authoritative serving from the (possibly already
             # debited) store — the migration-abort posture: the residual
             # IS the envelope, so un-gating under-admits at worst. Left
             # armed, the envelope would cap this server forever.
+            # (Exported reservations stay gone from the local ledger —
+            # which chunks landed at the successor is unknowable, and a
+            # blind restore could double-count a delivered hold; their
+            # settles answer the counted "unknown" no-op, the
+            # conservative direction.)
             self._drain_envelope = None
+            self._drain_successor = None
             if successor is not None and self.snapshot_path is not None:
                 # The drain failed mid-flight (successor unreachable,
                 # push error) AFTER the source debit may have landed:
@@ -1406,17 +1621,30 @@ class BucketStoreServer:
             entries = await asyncio.to_thread(
                 placement._export_from_store, self.store, lambda _k: True)
             export = placement.debit_export(entries, envelope_fraction)
+            target_epoch = (self.placement.epoch + 1
+                            if self.placement.active else 1)
+            # Outstanding reservations (and tenant debts) ship with the
+            # state: their settles will be relayed to the successor for
+            # the window and must find the ledger entries there. The
+            # tag dedups a re-delivered debt chunk at the successor.
+            led = self.reservations
+            if led is not None:
+                res_rows, debt_rows = led.export_rows(
+                    lambda _t: True, tag=f"drain:{target_epoch}")
+                if res_rows or debt_rows:
+                    export = dict(export)
+                    export["reservations"] = res_rows
+                    export["debts"] = debt_rows
             # Gate on BEFORE the source debit lands: from here until
             # aclose, admission serves only the envelope the export
             # withheld — late requests cannot spend balances the
             # successor already received.
             self._drain_envelope = env
+            self._drain_successor = successor
             self._drain_deadline = time.monotonic() + window_s
             await placement.debit_source(self.store, entries,
                                          envelope_fraction,
                                          keep_envelope=True)
-            target_epoch = (self.placement.epoch + 1
-                            if self.placement.active else 1)
             push = getattr(successor, "migrate_push", None)
             rows = 0
             for bid, chunk in enumerate(placement.chunk_entries(export)):
@@ -1544,6 +1772,10 @@ class BucketStoreServer:
         if (self.token_velocity is not None
                 and self.token_velocity.observed_tokens > 0):
             payload["token_velocity"] = self.token_velocity.snapshot()
+        if self.reservations is not None and self.reservations.active:
+            # stats() piggybacks one TTL-expiry pass — a scraped-but-
+            # idle server still auto-settles dead clients' holds.
+            payload["reservations"] = self.reservations.stats()
         if self.flight_recorder is not None:
             payload["flight_recorder"] = self.flight_recorder.snapshot()
         if self.tracer.enabled:

@@ -386,6 +386,50 @@ class BucketStore(abc.ABC):
                 remaining[i] = r.remaining
         return BulkAcquireResult(granted, remaining)
 
+    # -- estimate-reserve-settle (runtime/reservations.py) -----------------
+    def reservation_ledger(self, **kwargs):
+        """Get-or-create this store's :class:`~.reservations.
+        ReservationLedger` — ONE ledger per store, shared by every
+        consumer (the server's OP_RESERVE/OP_SETTLE dispatch, the
+        migration import lane, in-process cluster nodes), so a
+        reservation imported by a MIGRATE_PUSH is visible to the next
+        settle. ``kwargs`` configure the ledger on FIRST creation only
+        (the server wires flight recorder / velocity / liveconfig in
+        before serving); later callers get the existing instance."""
+        led = getattr(self, "_reservations", None)
+        if led is None:
+            from distributedratelimiting.redis_tpu.runtime.reservations import (
+                ReservationLedger,
+            )
+
+            led = self._reservations = ReservationLedger(self, **kwargs)
+        return led
+
+    async def reserve(self, rid: str, tenant: str, key: str,
+                      estimate: "float | None",
+                      tenant_capacity: float,
+                      tenant_fill_rate_per_sec: float,
+                      capacity: float, fill_rate_per_sec: float, *,
+                      priority: int = 0,
+                      ttl_s: "float | None" = None):
+        """Admit an ESTIMATED cost against the tenant → key budgets and
+        hold a TTL'd reservation (:mod:`~.reservations` — the streaming
+        lane for costs unknown until generation ends). Default: the
+        store-attached ledger; ``RemoteBucketStore`` overrides with one
+        ``OP_RESERVE`` frame so the ledger lives server-side."""
+        return await self.reservation_ledger().reserve(
+            rid, tenant, key, estimate, tenant_capacity,
+            tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
+            priority=priority, ttl_s=ttl_s)
+
+    async def settle(self, rid: str, tenant: str, actual: float):
+        """Reconcile a reservation's actual cost: refund over-estimates
+        through the saturating negative-debit lane, carry
+        under-estimates as per-tenant debt (idempotent by rid — see
+        :meth:`~.reservations.ReservationLedger.settle`)."""
+        return await self.reservation_ledger().settle(rid, tenant,
+                                                      actual)
+
     # -- decaying global counter (approximate algorithm's shared tier) -----
     @abc.abstractmethod
     async def sync_counter(self, key: str, local_count: float,

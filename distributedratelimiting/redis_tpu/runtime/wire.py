@@ -137,6 +137,18 @@ admission plane, runtime/admission.py, DESIGN.md §15):
   It never changes a healthy-path decision; envelope serving (drain
   windows, parked handoffs, degraded fallback) honors the shed order —
   scavenger sheds first, the envelope is spent on interactive.
+
+Reservation lane (within v4, OP_METRICS posture — streaming token
+costs, :mod:`~.runtime.reservations`, DESIGN.md §18): ``OP_RESERVE`` /
+``OP_SETTLE`` carry u32-length-prefixed JSON like the other control
+ops (``TEXT_OPS``) and reply RESP_TEXT JSON. Both are *application-
+idempotent by reservation id* — a retried reserve of a granted id
+replays the recorded decision without a second debit, a retried settle
+replays the recorded reconciliation — so both sit in the client's
+post-send-retryable set. An old server answers either with a routable
+unknown-op error; the client latches once per connection and falls
+back to plain ``acquire_hierarchical`` at the estimate (counted —
+refunds are forgone against that peer, the conservative direction).
 """
 
 from __future__ import annotations
@@ -152,7 +164,8 @@ __all__ = [
     "OP_SAVE", "OP_STATS", "OP_SEMA", "OP_FWINDOW", "OP_HELLO",
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
     "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
-    "OP_MIGRATE_PUSH", "OP_CONFIG", "OP_ACQUIRE_H", "TEXT_OPS",
+    "OP_MIGRATE_PUSH", "OP_CONFIG", "OP_ACQUIRE_H", "OP_RESERVE",
+    "OP_SETTLE", "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
@@ -229,11 +242,28 @@ OP_ACQUIRE_H = 19  # hierarchical (tenant → key) weighted-cost acquire
 # native C front-end names the op only to pin its Python-lane
 # fallthrough (drl-check wire-hier).
 
+OP_RESERVE = 20  # estimate-reserve-settle, phase 1 (runtime/
+# reservations.py; OP_METRICS posture — a new op on the existing frame
+# layout, routable unknown-op error from old servers, never a misparse;
+# the client latches a fallback to plain acquire_hierarchical at the
+# estimate): [u32 mlen][json {rid, tenant, key, estimate?, a, b, ta,
+# tb, priority?, ttl_s?}] → RESP_TEXT JSON {granted, reserved,
+# remaining, debt, duplicate}. Application-idempotent by reservation
+# id (a granted rid's retry replays the recorded decision without a
+# second debit), so post-send retries are always safe.
+OP_SETTLE = 21  # estimate-reserve-settle, phase 3: [u32 mlen][json
+# {rid, tenant, actual}] → RESP_TEXT JSON {outcome, delta, refunded,
+# debt}. Idempotent by reservation id — a duplicate settle replays the
+# recorded result (outcome "duplicate", zero side effects), which is
+# what makes the op post-send-retry-safe. Routed by TENANT like
+# OP_ACQUIRE_H (the ledger entry lives with the tenant's owner).
+
 #: Control ops whose request payload is one u32-length-prefixed UTF-8
 #: JSON text (rides in the ``key`` slot of encode/decode_request —
 #: ensure_ascii JSON, so the strict codec never meets a surrogate).
 TEXT_OPS = frozenset((OP_PLACEMENT_ANNOUNCE, OP_MIGRATE_PULL,
-                      OP_MIGRATE_PUSH, OP_CONFIG))
+                      OP_MIGRATE_PUSH, OP_CONFIG, OP_RESERVE,
+                      OP_SETTLE))
 
 #: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
 #: payload. Only sampled requests carry it; an old server answers the
@@ -290,6 +320,8 @@ _OP_NAMES = {
     OP_MIGRATE_PUSH: "migrate_push",
     OP_CONFIG: "config",
     OP_ACQUIRE_H: "acquire_hierarchical",
+    OP_RESERVE: "reserve",
+    OP_SETTLE: "settle",
 }
 
 

@@ -178,6 +178,14 @@ constexpr uint8_t OP_CONFIG = 18;
 // must mirror the full [u16 tlen][tenant][f64 ta][f64 tb][u8 priority]
 // tail first.
 constexpr uint8_t OP_ACQUIRE_H = 19;
+// Estimate-reserve-settle lane (wire.py, runtime/reservations.py):
+// JSON control frames (TEXT_OPS) against the server-side reservation
+// ledger — control-plane cadence, never hot. Passthrough like the
+// placement/config ops: named (and case-listed) so drl-check's
+// wire-conformance diff pins their values against wire.py and a
+// future fast-path cannot typo them.
+constexpr uint8_t OP_RESERVE = 20;
+constexpr uint8_t OP_SETTLE = 21;
 
 // Bulk admission lane (round 8): OP_ACQUIRE_MANY parses HERE, tier-0
 // decides hot bucket rows per-row, and the RESP_BULK reply encodes in C
@@ -1727,10 +1735,12 @@ bool handle_frame(Shard* sh, Conn* c, const uint8_t* body, size_t len) {
       case OP_MIGRATE_PULL:
       case OP_MIGRATE_PUSH:
       case OP_CONFIG:
+      case OP_RESERVE:
+      case OP_SETTLE:
       default: {
-        // Placement/migration/config control ops, HELLO, PEEK, SYNC,
-        // STATS, SAVE, unknown: Python decides (including the
-        // unknown-op error) — the wire module stays the single
+        // Placement/migration/config/reservation control ops, HELLO,
+        // PEEK, SYNC, STATS, SAVE, unknown: Python decides (including
+        // the unknown-op error) — the wire module stays the single
         // authority for every non-hot shape. ACQUIRE_MANY left this
         // list in round 8: well-formed bulk frames are native above,
         // and only malformed ones fall through so wire.py raises the

@@ -288,6 +288,56 @@ async def _shed_hysteresis_body():
     assert ctrl.last_pressure == pytest.approx(1.25)
 
 
+def test_reservation_pressure_raises_shed_before_settled_rate():
+    """Satellite (round 13): outstanding reserved-but-unsettled tokens
+    fold into the shed pressure as a prospective rate over the
+    reservation horizon — the ladder steps up while the SETTLED token
+    rate alone is still under the threshold (brownout before the
+    unsettled load lands), through the same hysteresis streaks."""
+    run(_reservation_pressure_body())
+
+
+async def _reservation_pressure_body():
+    # Settled rate 200/tick over capacity 400 → pressure 0.5 alone
+    # (below shed_high 0.9). Outstanding 2000 tokens over horizon 10s
+    # adds a prospective 200/s → combined pressure 1.0 ≥ 0.9.
+    def with_outstanding(feed, tokens):
+        for st in feed:
+            st["nodes"][0]["reservations"] = {
+                "outstanding_tokens": tokens}
+        return feed
+
+    calm = Controller(FakeCluster(_pressure_feed(6, 200.0)),
+                      config=_cfg(reservation_horizon_s=10.0))
+    await _drive_ticks(calm, 6)
+    assert calm.shed_level is None  # settled rate alone: no brownout
+    assert calm.last_pressure == pytest.approx(0.5)
+
+    feed = with_outstanding(_pressure_feed(6, 200.0), 2000.0)
+    ctrl = Controller(FakeCluster(feed),
+                      config=_cfg(reservation_horizon_s=10.0))
+    acts = await _drive_ticks(ctrl, 3)  # anchor + raise streak of 2
+    assert [a["action"] for a in acts] == ["shed_raise"]
+    assert ctrl.shed_level == PRIORITY_SCAVENGER
+    assert ctrl.last_pressure == pytest.approx(1.0)
+    assert ctrl.last_outstanding == pytest.approx(2000.0)
+    assert ctrl.numeric_stats()["outstanding_tokens"] == \
+        pytest.approx(2000.0)
+    # Dry-run parity holds for the new sensor: same feed, identical
+    # decision stream, zero shed pushes.
+    target = ShedTarget()
+    dry = Controller(
+        FakeCluster(with_outstanding(_pressure_feed(6, 200.0),
+                                     2000.0)),
+        config=_cfg(reservation_horizon_s=10.0, dry_run=True),
+        shed_targets=[target])
+    dry_acts = await _drive_ticks(dry, 3)
+    assert [(a["action"], a["target"]) for a in dry_acts] == \
+        [(a["action"], a["target"]) for a in acts]
+    assert dry.shed_level == PRIORITY_SCAVENGER
+    assert target.levels == []
+
+
 def test_shed_middle_band_resets_streak():
     run(_shed_middle_band_body())
 

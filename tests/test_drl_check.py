@@ -558,6 +558,72 @@ def test_dispatch_covers_every_live_op():
     assert len(ops) >= 17
 
 
+# -- reservation lane (round 13: OP_RESERVE / OP_SETTLE) ---------------------
+
+REMOTE_PATH = (ROOT / "distributedratelimiting" / "redis_tpu"
+               / "runtime" / "remote.py")
+
+
+def test_reserve_settle_ops_are_covered_everywhere():
+    """Satellite: the two reservation ops exist in wire.py, are
+    mirrored (value-diffed) in frontend.cc's passthrough constants,
+    are dispatched by server.py, and sit in the client's post-send-
+    retryable set (application-idempotent by reservation id)."""
+    py = wire_conformance.extract_py_model(WIRE)
+    c = wire_conformance.extract_c_model(FRONTEND)
+    assert py.constants["OP_RESERVE"][0] == 20
+    assert py.constants["OP_SETTLE"][0] == 21
+    assert c.constants["OP_RESERVE"][0] == 20
+    assert c.constants["OP_SETTLE"][0] == 21
+    refs = wire_conformance._server_op_references(SERVER)
+    assert {"OP_RESERVE", "OP_SETTLE"} <= set(refs)
+    sets = wire_conformance._remote_op_sets(REMOTE_PATH)
+    members, _line = sets["_IDEMPOTENT_OPS"]
+    assert {"OP_RESERVE", "OP_SETTLE"} <= set(members)
+
+
+def test_reserve_constant_drift_fires_wire_const(tmp_path):
+    """Seeded divergence: frontend.cc disagreeing with wire.py about
+    OP_RESERVE's value fires wire-const exactly once (the two new ops
+    are diffed like every mirrored constant)."""
+    cc = _mutated_frontend(tmp_path,
+                           "constexpr uint8_t OP_RESERVE = 20;",
+                           "constexpr uint8_t OP_RESERVE = 29;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    assert "OP_RESERVE" in findings[0].message
+
+
+def test_settle_undispatched_fires_wire_dispatch(tmp_path):
+    """Seeded divergence: a server.py that stops referencing
+    wire.OP_SETTLE fires wire-dispatch for exactly that op."""
+    mutated = tmp_path / "server.py"
+    text = SERVER.read_text()
+    assert "wire.OP_SETTLE" in text
+    mutated.write_text(text.replace("wire.OP_SETTLE",
+                                    "wire.OP_TRACES"))
+    findings = wire_conformance.check_dispatch(WIRE, mutated, tmp_path)
+    assert [f.rule for f in findings] == ["wire-dispatch"]
+    assert "OP_SETTLE" in findings[0].message
+
+
+def test_reserve_unclassified_fires_wire_idempotency(tmp_path):
+    """Seeded divergence: dropping OP_RESERVE from the client's
+    idempotent set (without adding it to the non-idempotent one) fires
+    wire-idempotency — a future edit cannot silently make the op
+    post-send-retry-unsafe by omission."""
+    mutated = tmp_path / "remote.py"
+    text = REMOTE_PATH.read_text()
+    anchor = "    wire.OP_RESERVE, wire.OP_SETTLE))"
+    assert anchor in text, "fixture anchor gone from remote.py"
+    mutated.write_text(text.replace(anchor,
+                                    "    wire.OP_SETTLE))", 1))
+    findings = wire_conformance.check_idempotency(WIRE, mutated,
+                                                  tmp_path)
+    assert [f.rule for f in findings] == ["wire-idempotency"]
+    assert "OP_RESERVE" in findings[0].message
+
+
 # -- wire-idempotency (round 7) ---------------------------------------------
 
 REMOTE = (ROOT / "distributedratelimiting" / "redis_tpu" / "runtime"

@@ -1,0 +1,840 @@
+"""Estimate-reserve-settle (ISSUE 13): the reservation subsystem's unit
+surface plus THE seeded streaming soak.
+
+The soak is the acceptance differential: a deterministic streaming
+schedule (estimate = actual × log-normal error) driven over the real
+wire (OP_RESERVE / OP_SETTLE) under seeded chaos, with a mid-soak
+drain-and-handoff AND a live OP_CONFIG budget mutation, audited over
+the store's own bucket records — settled tokens reconcile exactly
+against the tenant balance (outstanding + settled − debt identity),
+stay inside budget + the epsilon envelope, no rid settles twice under
+post-send retry, TTL auto-settle fires for killed clients, and the
+same seed replays the same grant sequence bit for bit.
+``make reserve-soak SEED=…`` replays any schedule (DRL_RESERVE_SEED)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+)
+from distributedratelimiting.redis_tpu.runtime import placement, wire
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+)
+from distributedratelimiting.redis_tpu.runtime.reservations import (
+    EstimatePrior,
+    ReservationLedger,
+)
+from distributedratelimiting.redis_tpu.runtime.server import (
+    BucketStoreServer,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+from distributedratelimiting.redis_tpu.utils.faults import (
+    FaultInjector,
+    FaultRule,
+)
+
+SEED = int(os.environ.get("DRL_RESERVE_SEED", "20260804"))
+
+_FILL = 1e-9
+_CHILD_CAP, _CHILD_RATE = 1e6, 1e-9
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- EstimatePrior -----------------------------------------------------------
+
+def test_prior_p99_for_interactive_mean_for_batch():
+    p = EstimatePrior(window=200)
+    for v in range(1, 101):  # 1..100
+        p.observe("t", 0, float(v))
+        p.observe("t", 1, float(v))
+    assert p.estimate("t", 0) == 99.0          # p99 of 1..100
+    assert p.estimate("t", 1) == pytest.approx(50.5)  # mean
+    # A priority with no samples borrows the tenant's merged history.
+    assert p.estimate("t", 2) == pytest.approx(50.5)
+    assert p.estimate("nobody", 0) is None
+
+
+def test_prior_bounded_window_and_groups():
+    p = EstimatePrior(window=4, max_groups=2)
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        p.observe("a", 0, v)
+    # Window keeps the newest 4: mean-of-window for batch read.
+    assert p.estimate("a", 1) == pytest.approx((2 + 3 + 4 + 100) / 4)
+    p.observe("b", 0, 5.0)
+    p.observe("c", 0, 7.0)  # evicts the oldest-touched group
+    assert len(p) == 2
+    # Bad samples are ignored, never raise.
+    p.observe("a", 0, -1.0)
+    p.observe("a", 0, float("nan"))
+
+
+# -- ledger unit surface -----------------------------------------------------
+
+def _ledger(store, **kw):
+    t = [0.0]
+    led = ReservationLedger(store, clock=lambda: t[0], **kw)
+    return led, t
+
+
+def test_ledger_reserve_settle_refund_and_debt():
+    run(_ledger_body())
+
+
+async def _ledger_body():
+    st = InProcessBucketStore(clock=ManualClock())
+    led, _t = _ledger(st)
+    r = await led.reserve("r1", "t", "k", 100, 1000.0, _FILL,
+                          _CHILD_CAP, _CHILD_RATE)
+    assert r.granted and r.reserved == 100.0
+    assert led.outstanding_tokens() == 100.0
+    assert led.outstanding_by_tenant() == {"t": 100.0}
+    # Over-estimate: the refund lands in BOTH levels.
+    s = await led.settle("r1", "t", 40.0)
+    assert s.outcome == "settled" and s.delta == -60.0
+    assert s.refunded == 60.0 and s.debt == 0.0
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(960.0)
+    assert st._buckets[("k", _CHILD_CAP, _CHILD_RATE)][0] == \
+        pytest.approx(_CHILD_CAP - 40.0)
+    assert led.outstanding_tokens() == 0.0
+    # Under-estimate past the whole budget: the uncovered part is debt.
+    r2 = await led.reserve("r2", "t", "k", 100, 1000.0, _FILL,
+                           _CHILD_CAP, _CHILD_RATE)
+    assert r2.granted
+    s2 = await led.settle("r2", "t", 1500.0)
+    assert s2.outcome == "settled" and s2.delta == 1400.0
+    assert s2.debt == pytest.approx(540.0)  # 1400 − 860 available
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(0.0)
+    # The next reserve must cover the debt first — empty budget: denied.
+    r3 = await led.reserve("r3", "t", "k", 10, 1000.0, _FILL,
+                           _CHILD_CAP, _CHILD_RATE)
+    assert not r3.granted and r3.debt == pytest.approx(540.0)
+    assert led.debt_denials == 1
+
+
+def test_ledger_debt_collected_once_budget_refills():
+    run(_debt_refill_body())
+
+
+async def _debt_refill_body():
+    clock = ManualClock()
+    st = InProcessBucketStore(clock=clock)
+    led, _t = _ledger(st)
+    await led.reserve("r1", "t", "k", 100, 1000.0, 50.0,
+                      _CHILD_CAP, _CHILD_RATE)
+    await led.settle("r1", "t", 1500.0)
+    assert led.debts()["t"] > 0
+    clock.advance_seconds(120.0)  # refill the tenant bucket fully
+    r = await led.reserve("r2", "t", "k", 10, 1000.0, 50.0,
+                          _CHILD_CAP, _CHILD_RATE)
+    # Debt paid down from the refilled budget, then the reserve admits.
+    assert r.granted and r.debt == 0.0
+    assert led.debts() == {}
+    assert led.debt_tokens_collected > 0
+
+
+def test_ledger_idempotency_under_retry():
+    run(_idem_body())
+
+
+async def _idem_body():
+    st = InProcessBucketStore(clock=ManualClock())
+    led, _t = _ledger(st)
+    r1 = await led.reserve("r1", "t", "k", 100, 1000.0, _FILL,
+                           _CHILD_CAP, _CHILD_RATE)
+    # A post-send retry of a GRANTED reserve replays the decision —
+    # the tenant balance moves exactly once.
+    r1b = await led.reserve("r1", "t", "k", 100, 1000.0, _FILL,
+                            _CHILD_CAP, _CHILD_RATE)
+    assert r1b.granted and r1b.duplicate
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(900.0)
+    s1 = await led.settle("r1", "t", 30.0)
+    s1b = await led.settle("r1", "t", 30.0)
+    assert s1.outcome == "settled" and s1b.outcome == "duplicate"
+    assert (s1b.delta, s1b.refunded) == (s1.delta, s1.refunded)
+    # Zero double-refunds: the balance reflects ONE settle.
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(970.0)
+    # Unknown rid: counted no-op.
+    s3 = await led.settle("ghost", "t", 10.0)
+    assert s3.outcome == "unknown" and led.settle_unknown == 1
+    # A reserve retry arriving after the settle replays granted too.
+    r1c = await led.reserve("r1", "t", "k", 100, 1000.0, _FILL,
+                            _CHILD_CAP, _CHILD_RATE)
+    assert r1c.granted and r1c.duplicate
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(970.0)
+
+
+def test_ledger_ttl_auto_settles_at_estimate():
+    run(_ttl_body())
+
+
+async def _ttl_body():
+    from distributedratelimiting.redis_tpu.utils.flight_recorder import (
+        FlightRecorder,
+    )
+
+    st = InProcessBucketStore(clock=ManualClock())
+    fr = FlightRecorder(64)
+    led, t = _ledger(st, default_ttl_s=5.0)
+    led.flight_recorder = fr
+    await led.reserve("r1", "t", "k", 100, 1000.0, _FILL,
+                      _CHILD_CAP, _CHILD_RATE)
+    await led.reserve("r2", "t", "k", 50, 1000.0, _FILL,
+                      _CHILD_CAP, _CHILD_RATE, ttl_s=60.0)
+    t[0] = 6.0
+    assert led.expire() == 1  # r1 only; r2's explicit TTL holds
+    assert led.ttl_expired == 1
+    assert led.outstanding_by_tenant() == {"t": 50.0}
+    # Auto-settle at estimate: no refund, the hold became the spend.
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(850.0)
+    # Flight-recorded, and the late settle answers the dedup record.
+    assert any(f["kind"] == "reservation"
+               and f.get("event") == "ttl_expired"
+               for f in fr.frames())
+    s = await led.settle("r1", "t", 40.0)
+    assert s.outcome == "duplicate"
+    assert st._buckets[("t", 1000.0, _FILL)][0] == pytest.approx(850.0)
+
+
+def test_ledger_bounded_denies_loudly():
+    run(_bounded_body())
+
+
+async def _bounded_body():
+    st = InProcessBucketStore(clock=ManualClock())
+    led, _t = _ledger(st, max_entries=2)
+    for i in range(2):
+        r = await led.reserve(f"r{i}", "t", "k", 1, 1000.0, _FILL,
+                              _CHILD_CAP, _CHILD_RATE)
+        assert r.granted
+    r = await led.reserve("r9", "t", "k", 1, 1000.0, _FILL,
+                          _CHILD_CAP, _CHILD_RATE)
+    assert not r.granted and led.ledger_full_denials == 1
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_debt_rows_dedup_on_abort_retry():
+    """Review regression: a debt restored on abort and re-exported by
+    the same-epoch retry must not DOUBLE at the new owner (whose copy
+    of attempt 1's chunk already landed) — tagged debt rows apply once
+    per (tag, tenant)."""
+    run(_debt_dedup_body())
+
+
+async def _debt_dedup_body():
+    src = InProcessBucketStore(clock=ManualClock())
+    dst = InProcessBucketStore(clock=ManualClock())
+    led_src, _ = _ledger(src)
+    led_dst, _ = _ledger(dst)
+    led_src._debts["t"] = 500.0
+    # Attempt 1: export ships, chunk lands at the destination.
+    res1, debts1 = led_src.export_rows(lambda _t: True, tag="epoch:7")
+    assert led_src.debts() == {}
+    led_dst.restore_rows(res1, debts1)
+    assert led_dst.debts()["t"] == 500.0
+    # Abort: the stash comes home to the source.
+    led_src.restore_rows(res1, debts1)
+    assert led_src.debts()["t"] == 500.0
+    # Attempt 2 (same epoch): re-export + re-deliver — the destination
+    # already holds attempt 1's copy and must skip it.
+    res2, debts2 = led_src.export_rows(lambda _t: True, tag="epoch:7")
+    led_dst.restore_rows(res2, debts2)
+    assert led_dst.debts()["t"] == 500.0  # not 1000
+    # A LATER legitimate migration (new episode) merges normally.
+    led_src._debts["t"] = 100.0
+    _res3, debts3 = led_src.export_rows(lambda _t: True, tag="epoch:9")
+    led_dst.restore_rows([], debts3)
+    assert led_dst.debts()["t"] == 600.0
+
+
+def test_fallback_charge_floors_at_default_estimate():
+    """Review regression: the degraded/old-peer reserve fallbacks must
+    not admit an estimate-less stream for a 1-token charge — the
+    shared helper floors at the ledger's DEFAULT_ESTIMATE."""
+    from distributedratelimiting.redis_tpu.runtime.reservations import (
+        DEFAULT_ESTIMATE,
+        fallback_charge,
+    )
+
+    assert fallback_charge(None) == int(DEFAULT_ESTIMATE)
+    assert fallback_charge(0) == int(DEFAULT_ESTIMATE)
+    assert fallback_charge(12.3) == 13
+    run(_fallback_charge_wire_body())
+
+
+async def _fallback_charge_wire_body():
+    backing = InProcessBucketStore(clock=ManualClock())
+    srv = BucketStoreServer(backing)
+    real = srv.handle_frame_body
+
+    async def old_peer(body, arrival_s=None):
+        if len(body) >= 6 and (body[5] & 0x3F) in (wire.OP_RESERVE,
+                                                   wire.OP_SETTLE):
+            from distributedratelimiting.redis_tpu.runtime.server import (
+                _recover_seq,
+            )
+
+            return wire.encode_response(_recover_seq(body),
+                                        wire.RESP_ERROR,
+                                        f"unknown op {body[5] & 0x3F}")
+        return await real(body, arrival_s=arrival_s)
+
+    srv.handle_frame_body = old_peer
+    await srv.start()
+    st = RemoteBucketStore(address=(srv.host, srv.port),
+                           coalesce_requests=False)
+    try:
+        r = await st.reserve("fc1", "t", "k", None, 1000.0, _FILL,
+                             _CHILD_CAP, _CHILD_RATE)
+        # The old-peer fallback charged DEFAULT_ESTIMATE, not 1.
+        assert r.granted and r.reserved == 64.0
+        assert backing._buckets[("t", 1000.0, _FILL)][0] == \
+            pytest.approx(936.0)
+    finally:
+        await st.aclose()
+        await srv.aclose()
+
+
+def test_chunk_entries_sizes_reservation_rows(tmp_path):
+    """Review regression: chunk_entries must size a reservation row by
+    ALL its string fields (tenant + rid + child key) — long child keys
+    otherwise packed chunks past MAX_FRAME."""
+    long_key = "k" * 60_000
+    rows = [["t", f"rid{i}", long_key, 10.0, 1e6, 1e-9, 1e3, 1e-9, 0,
+             30.0] for i in range(40)]
+    chunks = placement.chunk_entries({"reservations": rows})
+    assert len(chunks) > 1  # 40 × 60KB cannot be one frame-sized chunk
+    import json as _json
+    for c in chunks:
+        assert len(_json.dumps(c)) < 800_000
+
+
+# -- fp-store negative-debit pin (satellite bugfix sweep) --------------------
+
+def test_fp_store_debit_many_direct_including_refund():
+    """Satellite: the fp-store saturating debit lane, pinned DIRECTLY
+    (PR 9 exercised it only via hierarchical deny-refund — which in
+    fact crashed: _FpTable had no _debit_launch until round 13's
+    fp_debit_batch kernel). Positive debits saturate with the clamped
+    shortfall; NEGATIVE amounts credit back (the refund primitive the
+    reservation settle and the hierarchical deny-refund share), with
+    the capacity clamp applying at the next refill."""
+    from distributedratelimiting.redis_tpu.runtime.fp_store import (
+        FingerprintBucketStore,
+    )
+
+    async def body():
+        st = FingerprintBucketStore(n_slots=256)
+        await st.connect()
+        await st.acquire("k1", 40, 100.0, _FILL)
+        rem, short = await st.debit_many(["k1"], [30.0], 100.0, _FILL)
+        assert rem[0] == pytest.approx(30.0) and short[0] == 0.0
+        # Saturating: the debit finds only 30, reports 470 shortfall.
+        rem, short = await st.debit_many(["k1"], [500.0], 100.0, _FILL)
+        assert rem[0] == 0.0 and short[0] == pytest.approx(470.0)
+        # Negative amount = refund; init-on-miss debits a fresh key
+        # from capacity (the InProcess debit_many semantics).
+        rem, short = await st.debit_many(["k1"], [-25.0], 100.0, _FILL)
+        assert rem[0] == pytest.approx(25.0) and short[0] == 0.0
+        rem, short = await st.debit_many(["fresh"], [10.0], 100.0,
+                                         _FILL)
+        assert rem[0] == pytest.approx(90.0) and short[0] == 0.0
+        await st.aclose()
+
+    run(body())
+
+
+def test_fp_store_hier_deny_refund_regression():
+    """The PR-9 deny-refund path on the fp store (base compose: parent
+    granted, child denied → parent refunded through debit_many with a
+    negative amount) used to raise AttributeError — _FpTable had no
+    _debit_launch. Pin the repaired behavior: the tenant bucket ends
+    exactly where it started."""
+    from distributedratelimiting.redis_tpu.runtime.fp_store import (
+        FingerprintBucketStore,
+    )
+
+    async def body():
+        st = FingerprintBucketStore(n_slots=256)
+        await st.connect()
+        r = await st.acquire_hierarchical("tenantA", "kk", 50,
+                                          500.0, _FILL, 20.0, _FILL)
+        assert not r.granted  # child cap 20 < 50
+        assert st.peek_blocking("tenantA", 500.0, _FILL) == \
+            pytest.approx(500.0)
+        await st.aclose()
+
+    run(body())
+
+
+# -- wire lane + old-peer latch + stats-reset immunity -----------------------
+
+def test_wire_reserve_settle_and_stats_reset_immunity():
+    run(_wire_body())
+
+
+async def _wire_body():
+    backing = InProcessBucketStore(clock=ManualClock())
+    async with BucketStoreServer(backing) as srv:
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            r = await st.reserve("w1", "t", "k", 100, 1000.0, _FILL,
+                                 _CHILD_CAP, _CHILD_RATE)
+            assert r.granted and r.reserved == 100.0
+            s = await st.settle("w1", "t", 25.0)
+            assert s.outcome == "settled" and s.refunded == 75.0
+            # A wire retry of the settle is the dedup no-op.
+            s2 = await st.settle("w1", "t", 25.0)
+            assert s2.outcome == "duplicate"
+            # Server-side estimate from the prior: no estimate on the
+            # wire → the tenant's settled history (25.0, interactive
+            # p99) sizes the charge.
+            r2 = await st.reserve("w2", "t", "k", None, 1000.0, _FILL,
+                                  _CHILD_CAP, _CHILD_RATE)
+            assert r2.granted and r2.reserved == 25.0
+            # The satellite contract: stats(reset=True) clears latency
+            # WINDOWS, never the reservation ledger (monotonic-counter
+            # contract from PR 12).
+            before = dict(srv.reservations.numeric_stats())
+            stats = await st.stats(reset=True)
+            assert stats["reservations"]["reserves"] == 2
+            after = srv.reservations.numeric_stats()
+            assert after == before
+            assert srv.reservations.outstanding_tokens() == 25.0
+            # The new families render.
+            text = await st.metrics()
+            assert 'drl_reservations_outstanding{tenant="t"}' in text
+            assert "drl_reservation_reserves_total 2" in text
+        finally:
+            await st.aclose()
+
+
+def test_old_peer_latches_acquire_fallback():
+    """A server that does not speak the reservation lane answers the
+    routable unknown-op error; the client latches once, reserves via
+    plain acquire_hierarchical at the estimate, and settles become
+    client-side no-ops — counted."""
+    run(_old_peer_body())
+
+
+async def _old_peer_body():
+    backing = InProcessBucketStore(clock=ManualClock())
+    srv = BucketStoreServer(backing)
+    real = srv.handle_frame_body
+
+    async def old_peer(body, arrival_s=None):
+        if len(body) >= 6 and (body[5] & 0x3F) in (wire.OP_RESERVE,
+                                                   wire.OP_SETTLE):
+            from distributedratelimiting.redis_tpu.runtime.server import (
+                _recover_seq,
+            )
+
+            return wire.encode_response(_recover_seq(body),
+                                        wire.RESP_ERROR,
+                                        f"unknown op {body[5] & 0x3F}")
+        return await real(body, arrival_s=arrival_s)
+
+    srv.handle_frame_body = old_peer
+    await srv.start()
+    st = RemoteBucketStore(address=(srv.host, srv.port),
+                           coalesce_requests=False)
+    try:
+        r = await st.reserve("f1", "t", "k", 100, 1000.0, _FILL,
+                             _CHILD_CAP, _CHILD_RATE)
+        assert r.granted and r.fallback and r.reserved == 100.0
+        assert not st._peer_reserve
+        assert st.resilience_stats()["reserve_fallbacks"] == 1
+        # The estimate was charged outright through the hier lane.
+        assert backing._buckets[("t", 1000.0, _FILL)][0] == \
+            pytest.approx(900.0)
+        # Settle: client-side no-op (no hold exists server-side).
+        s = await st.settle("f1", "t", 10.0)
+        assert s.outcome == "fallback"
+        assert st.resilience_stats()["reserve_fallbacks"] == 2
+        assert backing._buckets[("t", 1000.0, _FILL)][0] == \
+            pytest.approx(900.0)
+    finally:
+        await st.aclose()
+        await srv.aclose()
+
+
+# -- OP_CONFIG rebase re-homes outstanding reservations ----------------------
+
+def test_config_rebase_rehomes_settles():
+    run(_rebase_body())
+
+
+async def _rebase_body():
+    backing = InProcessBucketStore(clock=ManualClock())
+    async with BucketStoreServer(backing) as srv:
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            r = await st.reserve("c1", "t", "k", 100, 1000.0, _FILL,
+                                 _CHILD_CAP, _CHILD_RATE)
+            assert r.granted
+            # Live mutation: tenant budget 1000 → 600. The commit
+            # rebases the balance (600 − 100 spent = 500 in the new
+            # table) through the rebase debit.
+            v = await st.config_announce({
+                "prepare": {"kind": "bucket", "old": [1000.0, _FILL],
+                            "new": [600.0, _FILL]},
+                "version": 1})
+            assert v == 0  # prepared, not yet committed
+            assert await st.config_announce({"commit": 1}) == 1
+            assert backing._buckets[("t", 600.0, _FILL)][0] == \
+                pytest.approx(500.0)
+            # Settle AFTER the commit: the refund must land in the NEW
+            # table (lazy re-home through the forwarding rules), and
+            # the entry's retired config counts as re-homed.
+            s = await st.settle("c1", "t", 30.0)
+            assert s.outcome == "settled" and s.refunded == 70.0
+            assert backing._buckets[("t", 600.0, _FILL)][0] == \
+                pytest.approx(570.0)
+            assert srv.reservations.rehomed >= 1
+        finally:
+            await st.aclose()
+
+
+# -- live migration: ledger entries ride MIGRATE_PULL / PUSH -----------------
+
+def test_migration_moves_ledger_and_reroutes_settles():
+    run(_migration_body())
+
+
+async def _migration_body():
+    b1 = InProcessBucketStore(clock=ManualClock())
+    b2 = InProcessBucketStore(clock=ManualClock())
+    s1 = BucketStoreServer(b1)
+    s2 = BucketStoreServer(b2)
+    await s1.start()
+    await s2.start()
+    c1 = RemoteBucketStore(address=(s1.host, s1.port),
+                           coalesce_requests=False)
+    c2 = RemoteBucketStore(address=(s2.host, s2.port),
+                           coalesce_requests=False)
+    try:
+        m0 = placement.PlacementMap.initial(2)
+        tenant = next(f"t{i}" for i in range(64)
+                      if m0.node_of(f"t{i}") == 0)
+        await c1.placement_announce({"map": m0.to_dict(), "node_id": 0})
+        await c2.placement_announce({"map": m0.to_dict(), "node_id": 1})
+        r = await c1.reserve("m1", tenant, "k", 100, 1000.0, _FILL,
+                             _CHILD_CAP, _CHILD_RATE)
+        assert r.granted
+        # Pull the tenant (an override split) off node 0: the export
+        # carries the ledger entry alongside the bucket state.
+        pulled = await c1.migrate_pull({"target_epoch": 1,
+                                        "keys": [tenant],
+                                        "window_s": 30.0})
+        assert len(pulled["entries"]["reservations"]) == 1
+        assert s1.reservations.outstanding_count() == 0
+        # Parked mid-handoff: the settle defers (retry-safe — the op
+        # is idempotent), it does NOT vanish into "unknown".
+        with pytest.raises(wire.RemoteStoreError,
+                           match="handoff in progress"):
+            await c1.settle("m1", tenant, 40.0)
+        applied = await c2.migrate_push({"target_epoch": 1, "batch": 1,
+                                         "entries": pulled["entries"]})
+        assert applied >= 1
+        assert s2.reservations.outstanding_count() == 1
+        m1 = m0.with_assignments(set_overrides={tenant: 1})
+        await c1.placement_announce({"map": m1.to_dict(), "node_id": 0})
+        await c2.placement_announce({"map": m1.to_dict(), "node_id": 1})
+        # Old owner answers MOVED; the new owner settles with the
+        # refund landing in ITS store (which received the balances).
+        with pytest.raises(wire.RemoteStoreError,
+                           match="placement moved"):
+            await c1.settle("m1", tenant, 40.0)
+        s = await c2.settle("m1", tenant, 40.0)
+        assert s.outcome == "settled" and s.refunded == 60.0
+        assert b2._buckets[(tenant, 1000.0, _FILL)][0] > 0
+    finally:
+        await c1.aclose()
+        await c2.aclose()
+        await s1.aclose()
+        await s2.aclose()
+
+
+def test_migration_abort_restores_ledger():
+    run(_abort_body())
+
+
+async def _abort_body():
+    b1 = InProcessBucketStore(clock=ManualClock())
+    s1 = BucketStoreServer(b1)
+    await s1.start()
+    c1 = RemoteBucketStore(address=(s1.host, s1.port),
+                           coalesce_requests=False)
+    try:
+        m0 = placement.PlacementMap.initial(1)
+        tenant = "t0"
+        await c1.placement_announce({"map": m0.to_dict(), "node_id": 0})
+        await c1.reserve("a1", tenant, "k", 100, 1000.0, _FILL,
+                         _CHILD_CAP, _CHILD_RATE)
+        await c1.migrate_pull({"target_epoch": 1, "keys": [tenant],
+                               "window_s": 30.0})
+        assert s1.reservations.outstanding_count() == 0
+        await c1.placement_announce({"abort_epoch": 1})
+        # The entry came home; the settle reconciles locally.
+        assert s1.reservations.outstanding_count() == 1
+        s = await c1.settle("a1", tenant, 60.0)
+        assert s.outcome == "settled" and s.refunded == 40.0
+    finally:
+        await c1.aclose()
+        await s1.aclose()
+
+
+# -- THE seeded streaming soak (acceptance) ----------------------------------
+
+_TENANTS = {"tenant:a": 3_000.0, "tenant:b": 2_000.0}
+#: Mid-soak live mutation: tenant:a's budget shrinks (the rebase debit
+#: re-homes the spent balance; outstanding reservations settle into the
+#: new table through the lazy re-home).
+_NEW_A_CAP = 2_400.0
+
+_RULES = {
+    "client.connect": (
+        FaultRule("reset", probability=0.08),
+        FaultRule("delay", probability=0.2, delay_s=0.001,
+                  jitter_s=0.002),
+    ),
+    "server.dispatch": (
+        FaultRule("delay", probability=0.05, delay_s=0.002,
+                  jitter_s=0.002),
+    ),
+}
+
+
+def _soak_schedule(seed: int, n_rows: int = 220):
+    """Deterministic streaming schedule: (tenant, key, actual cost,
+    estimate = actual × LogNormal(0, 0.55), priority, dies) rows. A
+    ``dies`` row never settles — its TTL auto-settle is part of the
+    audit."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n_rows):
+        tenant = "tenant:a" if rng.random() < 0.6 else "tenant:b"
+        key = f"{tenant}/u{rng.zipf(1.5) % 30}"
+        actual = float(min(max(rng.lognormal(3.2, 1.1), 1.0), 2000.0))
+        estimate = float(max(actual * rng.lognormal(0.0, 0.55), 1.0))
+        prio = int(rng.random() < 0.3)  # 70% interactive, 30% batch
+        dies = rng.random() < 0.05
+        rows.append((tenant, key, actual, estimate, prio, dies))
+    return rows
+
+
+async def _soak_once(seed: int) -> dict:
+    """One full soak run; returns the audit summary (compared across
+    runs for determinism)."""
+    rows = _soak_schedule(seed)
+    inj = FaultInjector(seed, _RULES)
+    faults.install(inj)
+    backing_a = InProcessBucketStore(clock=ManualClock())
+    backing_b = InProcessBucketStore(clock=ManualClock())
+    srv_a = BucketStoreServer(backing_a)
+    srv_b = BucketStoreServer(backing_b)
+    await srv_a.start()
+    await srv_b.start()
+    client = RemoteBucketStore(address=(srv_a.host, srv_a.port),
+                               coalesce_requests=False,
+                               resilience_seed=seed)
+    successor = RemoteBucketStore(address=(srv_b.host, srv_b.port),
+                                  coalesce_requests=False,
+                                  resilience_seed=seed + 1)
+    grants: list[bool] = []
+    settled: dict[str, float] = {t: 0.0 for t in _TENANTS}
+    open_rids: list[tuple[str, str, float]] = []  # (rid, tenant, actual)
+    dead_rids: list[tuple[str, str]] = []
+    settled_rids: list[tuple[str, str]] = []
+
+    async def drive(store, rows_slice, offset, hold=()):
+        """``hold`` rows reserve but defer their settle — the cross-
+        mutation holds whose settle-time config re-home the soak
+        audits."""
+        for j, (tenant, key, actual, estimate, prio, dies) in \
+                enumerate(rows_slice):
+            i = offset + j
+            rid = f"r{i}"
+            cap = _TENANTS[tenant]
+            r = await store.reserve(rid, tenant, key, estimate, cap,
+                                    _FILL, _CHILD_CAP, _CHILD_RATE,
+                                    priority=prio)
+            grants.append(bool(r.granted))
+            if not r.granted:
+                continue
+            if dies:
+                dead_rids.append((rid, tenant))
+                continue
+            if i in hold:
+                open_rids.append((rid, tenant, actual))
+                continue
+            s = await store.settle(rid, tenant, actual)
+            if s.outcome == "settled":
+                settled[tenant] += actual
+                settled_rids.append((rid, tenant))
+
+    try:
+        # Phase 1: healthy, under wire chaos. Rows 105-109 hold their
+        # settles open all the way into the drain window (the relay
+        # audit); rows 110-119 hold across the config mutation (the
+        # re-home audit).
+        await drive(client, rows[:120], 0, hold=set(range(105, 120)))
+        # Differential identity over the store's OWN bucket records
+        # (fill ≈ 0, ManualClock → zero refill; exact):
+        #   cap − balance == outstanding + settled_actual − debt.
+        led = srv_a.reservations
+        for tenant, cap in _TENANTS.items():
+            entry = backing_a._buckets.get((tenant, cap, _FILL))
+            balance = entry[0] if entry is not None else cap
+            lhs = cap - balance
+            rhs = (led.outstanding_by_tenant().get(tenant, 0.0)
+                   + settled[tenant]
+                   - led.debts().get(tenant, 0.0))
+            assert lhs == pytest.approx(rhs, abs=1e-3), tenant
+
+        # Phase 2: live OP_CONFIG mutation on tenant:a's budget.
+        await client.config_announce({
+            "prepare": {"kind": "bucket",
+                        "old": [_TENANTS["tenant:a"], _FILL],
+                        "new": [_NEW_A_CAP, _FILL]},
+            "version": 1})
+        await client.config_announce({"commit": 1})
+        # The held (pre-mutation) reservations from rows 110+ settle
+        # NOW: their recorded configs are retired — the ledger's lazy
+        # re-home routes every refund/extra-debit into the rebased
+        # table. Rows 105-109 stay open for the drain relay.
+        for rid, tenant, actual in list(open_rids):
+            if int(rid[1:]) < 110:
+                continue
+            s = await client.settle(rid, tenant, actual)
+            if s.outcome == "settled":
+                settled[tenant] += actual
+                settled_rids.append((rid, tenant))
+            open_rids.remove((rid, tenant, actual))
+        await drive(client, rows[120:170], 120)
+
+        # Phase 3: drain-and-handoff to the successor mid-stream, with
+        # the held reservations (rows 105-109) still outstanding —
+        # their ledger entries ship with the export, and settles
+        # during the window RELAY through the draining server.
+        still_open = list(open_rids)
+        open_rids.clear()
+        assert still_open, "schedule lost its drain-open holds"
+        shutdown_task = asyncio.ensure_future(
+            srv_a.shutdown(successor, window_s=1.0))
+        for _ in range(300):
+            if srv_a._drain_envelope is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert srv_a._drain_envelope is not None
+        # Settle two outstanding rids THROUGH the draining server: the
+        # relay reaches the successor's migrated ledger.
+        relayed = 0
+        for rid, tenant, actual in still_open[:2]:
+            assert rid in srv_b.reservations._entries, (
+                rid, "drain export did not migrate the hold")
+            s = await client.settle(rid, tenant, actual)
+            if s.outcome == "settled":
+                settled[tenant] += actual
+                settled_rids.append((rid, tenant))
+                relayed += 1
+        assert relayed == 2
+        await shutdown_task
+        # Phase 4: the fleet's LB switched to the successor; the
+        # remaining open rids settle there directly.
+        for rid, tenant, actual in still_open[2:]:
+            if rid in srv_b.reservations._entries:
+                s = await successor.settle(rid, tenant, actual)
+                if s.outcome == "settled":
+                    settled[tenant] += actual
+                    settled_rids.append((rid, tenant))
+
+        # Audit: zero double-settles under post-send retry — re-settle
+        # a sample of settled rids; refunded totals must not move.
+        led_b = srv_b.reservations
+        refunded_before = led_b.refunded_tokens + led.refunded_tokens
+        for rid, tenant in settled_rids[:20]:
+            target = (led_b if rid in led_b._settled else led)
+            s = await target.settle(rid, tenant, 99999.0)
+            assert s.outcome == "duplicate", rid
+        assert led_b.refunded_tokens + led.refunded_tokens == \
+            pytest.approx(refunded_before)
+
+        # Audit: TTL auto-settle fires for the killed clients whose
+        # reservations migrated to the successor.
+        migrated_dead = [rid for rid, _t in dead_rids
+                         if rid in led_b._entries]
+        if migrated_dead:
+            led_b._clock = (lambda base=led_b._clock: base() + 1e6)
+            assert led_b.expire() >= len(migrated_dead)
+            assert led_b.ttl_expired >= len(migrated_dead)
+            for rid in migrated_dead:
+                assert rid not in led_b._entries
+
+        # Audit: the epsilon envelope. Settled spend per tenant minus
+        # carried debt stays inside the LARGEST budget the tenant ever
+        # had plus one fair-share envelope (drain-window serving).
+        for tenant, cap in _TENANTS.items():
+            env = headroom_budget(cap, fraction=0.5, min_budget=1.0)
+            debt = (led.debts().get(tenant, 0.0)
+                    + led_b.debts().get(tenant, 0.0))
+            assert settled[tenant] - debt <= cap + env + 1e-6, tenant
+
+        return {
+            "grants": grants,
+            "settled": dict(settled),
+            "reserves": led.reserves + led_b.reserves,
+            "settles": led.settles + led_b.settles,
+            "refunded": round(led.refunded_tokens
+                              + led_b.refunded_tokens, 3),
+            "debt_created": round(led.debt_tokens_created
+                                  + led_b.debt_tokens_created, 3),
+            "rehomed": led.rehomed + led_b.rehomed,
+            "relayed": relayed,
+            "expired": led_b.ttl_expired,
+        }
+    finally:
+        faults.uninstall()
+        await client.aclose()
+        await successor.aclose()
+        await srv_a.aclose()
+        await srv_b.aclose()
+
+
+def test_reservation_streaming_soak():
+    """Acceptance (ISSUE 13): the seeded streaming soak — reserve/
+    stream/settle under wire chaos with a mid-soak drain-and-handoff
+    and a live OP_CONFIG mutation; settled tokens reconcile exactly
+    against the stores' own bucket records and stay inside budget +
+    epsilon; zero double-settles; TTL auto-settle fires; bit-for-bit
+    seed determinism."""
+    run(_soak_acceptance())
+
+
+async def _soak_acceptance():
+    out1 = await _soak_once(SEED)
+    # The schedule exercises every lane: grants and denials, refunds
+    # AND debt, config re-homing, relayed settles.
+    assert any(out1["grants"]) and not all(out1["grants"])
+    assert out1["refunded"] > 0 and out1["settles"] > 0
+    assert out1["rehomed"] >= 1  # pre-mutation holds settled post-commit
+    # Determinism: the same seed replays the same grant sequence and
+    # the same ledger accounting, bit for bit.
+    out2 = await _soak_once(SEED)
+    assert out2 == out1
