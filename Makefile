@@ -7,12 +7,12 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: check lint test native asan-test tsan-test chaos-test \
-        reshard-soak upgrade-soak parity-fuzz llm-soak controller-soak \
-        reserve-soak
+.PHONY: check lint verify-model test native asan-test tsan-test \
+        chaos-test reshard-soak upgrade-soak parity-fuzz llm-soak \
+        controller-soak reserve-soak
 
-check: lint test chaos-test upgrade-soak parity-fuzz llm-soak \
-       controller-soak reserve-soak asan-test tsan-test
+check: lint verify-model test chaos-test upgrade-soak parity-fuzz \
+       llm-soak controller-soak reserve-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -26,6 +26,16 @@ lint:
 	       "(pip install ruff to enable)"; \
 	fi
 	$(PY) -m tools.drl_check
+
+# Protocol model checking + lock-order analysis (docs/OPERATIONS.md
+# §15): extracts the epoch/config/reservation/breaker state machines
+# from the live code and explores their product exhaustively under an
+# adversarial scheduler (>= 10^5 states in ~10 s; state/depth caps are
+# printed whenever they truncate — never silently). Exit 1 prints the
+# minimized counterexample traces; regenerate their replay pytests
+# with `python -m tools.drl_verify --emit-replays <dir>`.
+verify-model:
+	$(PY) -m tools.drl_verify
 
 # Tier-1: the suite every PR must keep green (ROADMAP.md).
 test:

@@ -726,6 +726,14 @@ class NodePlacementState:
         # deliberate retry-same-epoch path stays open (and is the one
         # place a second envelope is knowingly charged).
         self._aborted_epochs: set[int] = set()
+        # Reservation rows imported by this node's pushes, by target
+        # epoch: (ledger, rids). The dual of the _applied reset in
+        # _abort — an aborted epoch's imported rows must LEAVE this
+        # ledger, or the rid lives in two gated-owner ledgers once the
+        # source restores its stash and a later retry commits: a
+        # settle retry then refunds on both sides (drl-verify's
+        # settle-dedup counterexample). Pruned with _applied.
+        self._imported_res: "dict[int, tuple] | dict" = {}
         # Serializes pull/push bodies: their idempotency checks span an
         # await (export off-thread, import through the store), and a
         # post-send retry racing the original in-flight op must wait and
@@ -743,6 +751,7 @@ class NodePlacementState:
         self.rows_imported = 0
         self.aborts = 0
         self.expired_aborts = 0
+        self.res_stash_forfeited = 0
 
     @property
     def active(self) -> bool:
@@ -808,6 +817,11 @@ class NodePlacementState:
         for e in [e for e in self._handoffs
                   if e <= pmap.epoch]:
             self._unpark(self._handoffs.pop(e))
+        # Committed epochs' imported rows are legitimately owned now —
+        # drop the abort provenance so a later abort of a NEWER epoch
+        # cannot evict them.
+        for e in [e for e in self._imported_res if e <= pmap.epoch]:
+            del self._imported_res[e]
         # Tombstones at or below the adopted epoch are unreachable
         # (pull refuses non-future epochs outright) — drop them.
         self._aborted_epochs = {e for e in self._aborted_epochs
@@ -815,7 +829,8 @@ class NodePlacementState:
         self._prune_ledger()
         return pmap.epoch
 
-    def _abort(self, target_epoch: int) -> None:
+    def _abort(self, target_epoch: int, *,
+               restore_reservations: bool = True) -> None:
         # A retried migration REUSES the aborted target epoch (the
         # adopted epoch never moved), so the push ledger for it must
         # reset with the abort: deduping attempt 2's batches against
@@ -823,14 +838,51 @@ class NodePlacementState:
         # at full capacity — over-admission); re-applying is merely
         # conservative (the import's debit replay floors at zero).
         self._applied.pop(target_epoch, None)
+        # The destination half: reservation rows imported under the
+        # aborted epoch leave this ledger again. The source's stash
+        # restore (coordinator abort) or the retry's re-export is the
+        # single surviving home for each rid — without this, a settle
+        # retried across the abort+retry window refunds at BOTH the
+        # restored source and the stale destination copy.
+        imported = self._imported_res.pop(target_epoch, None)
+        if imported is not None:
+            led, rids = imported
+            dropper = getattr(led, "drop_rids", None)
+            if callable(dropper):
+                dropper(rids)
         h = self._handoffs.pop(target_epoch, None)
         if h is not None:
             self._unpark(h)
             if h.ledger is not None and h.res_stash is not None:
-                # The migration died: the exported reservations come
-                # home (restore_rows skips any rid the ledger re-learned
-                # meanwhile, so a racing late push cannot double-count).
-                h.ledger.restore_rows(*h.res_stash)
+                if restore_reservations:
+                    # A COORDINATOR abort: it only runs pre-commit, so
+                    # no destination ever adopted the target epoch and
+                    # the exported reservations safely come home
+                    # (restore_rows skips any rid the ledger re-learned
+                    # meanwhile, so a racing late push cannot
+                    # double-count).
+                    h.ledger.restore_rows(*h.res_stash)
+                else:
+                    # An EXPIRY abort: the coordinator is presumed dead
+                    # and the commit MAY already have reached the
+                    # destinations (dst-first commit order). Restoring
+                    # the RESERVATION rows here would put the SAME rid
+                    # live in two gated-owner ledgers — a retried
+                    # settle then refunds on BOTH sides (drl-verify's
+                    # settle-dedup counterexample). Forfeit those:
+                    # settles answer the counted "unknown" no-op (the
+                    # hold is never refunded — the conservative
+                    # direction), and the destination copy either
+                    # serves settles after its commit or TTL-expires
+                    # at the estimate. DEBT rows are the opposite
+                    # polarity and DO come home: dropping them would
+                    # FORGIVE the tenant's overdraft (over-admission),
+                    # while dual-homing debt at worst double-collects
+                    # (over-denial, bounded by the per-(tag, tenant)
+                    # dedup when the retry re-exports it).
+                    self.res_stash_forfeited += len(h.res_stash[0])
+                    if h.res_stash[1]:
+                        h.ledger.restore_rows([], h.res_stash[1])
                 h.res_stash = None
             self.aborts += 1
             # The export for this epoch (and its source debit) is gone:
@@ -954,8 +1006,20 @@ class NodePlacementState:
             if batch in applied:
                 self.pushes_duplicate += 1
                 return 0
-            n = await import_entries(store, req.get("entries") or {})
+            entries = req.get("entries") or {}
+            n = await import_entries(store, entries)
             applied.add(batch)
+            # Provenance for the abort path: remember which reservation
+            # rids this epoch's pushes put into our ledger, so an abort
+            # can take them back out (see _abort / _imported_res).
+            rids = [row[1] for row in (entries.get("reservations")
+                                       or ())]
+            if rids:
+                maker = getattr(store, "reservation_ledger", None)
+                if callable(maker):
+                    led, seen = self._imported_res.setdefault(
+                        target_epoch, (maker(), set()))
+                    seen.update(str(r) for r in rids)
             self.pushes_applied += 1
             self.rows_imported += n
             self._prune_ledger()
@@ -964,6 +1028,19 @@ class NodePlacementState:
     def _prune_ledger(self) -> None:
         while len(self._applied) > self._LEDGER_EPOCHS:
             del self._applied[min(self._applied)]
+        while len(self._imported_res) > self._LEDGER_EPOCHS:
+            # Evicting abort provenance must not strand the rows it
+            # tracks: a later abort of the evicted epoch would find no
+            # record and leave them dual-homed (the double-refund this
+            # machinery closes). Drop them NOW instead — the
+            # conservative direction: if that epoch somehow still
+            # commits, its settles answer the counted "unknown" (no
+            # refund), never a second one.
+            led, rids = self._imported_res.pop(
+                min(self._imported_res))
+            dropper = getattr(led, "drop_rids", None)
+            if callable(dropper):
+                dropper(rids)
 
     # -- serving gate --------------------------------------------------------
     def gate(self, key: str):
@@ -987,8 +1064,12 @@ class NodePlacementState:
                 # slow commit DID announce it, this store was already
                 # debited down to the envelope at pull time
                 # (debit_source), so resuming authoritative serving
-                # stays inside the dual-ownership bound.
-                self._abort(h.target_epoch)
+                # stays inside the dual-ownership bound. Reservation
+                # rows are NOT restored on this path (unlike a
+                # coordinator abort): they were moved whole, not
+                # debited, so restoring them under a slow commit would
+                # double-home the rid — see _abort.
+                self._abort(h.target_epoch, restore_reservations=False)
                 self.expired_aborts += 1
             else:
                 return ("envelope", h)
@@ -1014,7 +1095,7 @@ class NodePlacementState:
         now = self._clock()
         for e in [e for e, h in self._handoffs.items()
                   if h.expired(now)]:
-            self._abort(e)
+            self._abort(e, restore_reservations=False)
             self.expired_aborts += 1
         pmap = self.pmap
         slots = route_keys(keys, pmap.n_slots)
@@ -1088,6 +1169,7 @@ class NodePlacementState:
             "rows_imported": self.rows_imported,
             "aborts": self.aborts,
             "expired_aborts": self.expired_aborts,
+            "res_stash_forfeited": self.res_stash_forfeited,
         }
         if self.pmap is not None and self.node_id is not None:
             out["owned_slots"] = int(

@@ -271,6 +271,7 @@ class ReservationLedger:
         self.debt_tokens_created = 0.0
         self.debt_tokens_collected = 0.0
         self.rehomed = 0
+        self.aborted_imports = 0
         self.reserved_tokens_total = 0.0
         self.settled_tokens_total = 0.0
         #: Settle-error magnitudes, log-1.25 bucketed. The histogram
@@ -585,6 +586,23 @@ class ReservationLedger:
             del self._debts[t]
         return res_rows, debt_rows
 
+    def drop_rids(self, rids) -> int:
+        """Remove outstanding entries for ``rids`` without settling —
+        the destination half of a migration ABORT (placement.py
+        ``_abort``): rows this node imported for the aborted epoch go
+        back out, because the source's stash restore (or the retry's
+        re-export) is each rid's single surviving home. Settled
+        records stay (a dedup answer is still correct); unknown rids
+        are skipped. Counted, returns the number dropped."""
+        n = 0
+        for rid in rids:
+            entry = self._entries.get(str(rid))
+            if entry is not None:
+                self._drop_entry(entry)
+                n += 1
+        self.aborted_imports += n
+        return n
+
     #: Seen (tag, tenant) debt deliveries kept for dedup (bounded).
     _DEBT_SEEN_CAP = 4096
 
@@ -650,6 +668,7 @@ class ReservationLedger:
             "debt_tokens_created": self.debt_tokens_created,
             "debt_tokens_collected": self.debt_tokens_collected,
             "rehomed": self.rehomed,
+            "aborted_imports": self.aborted_imports,
             "reserved_tokens_total": self.reserved_tokens_total,
             "settled_tokens_total": self.settled_tokens_total,
             "outstanding": float(len(self._entries)),

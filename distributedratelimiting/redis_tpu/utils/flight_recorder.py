@@ -26,7 +26,24 @@ import tempfile
 import time
 from collections import deque
 
-__all__ = ["FlightRecorder"]
+__all__ = ["FlightRecorder", "REGISTERED_KINDS"]
+
+#: THE frame-kind registry. Every ``record(kind=...)`` call site and
+#: every ``frames(kind=...)`` filter must use a kind from this table —
+#: drl-check's ``flight-kind`` rule enforces it statically, because a
+#: typo'd kind on either side fails SILENTLY (``frames(kind="flsh")``
+#: matches nothing and an audit assertion passes vacuously). Add the
+#: kind here first, then record it. ``"header"`` is the dump-file
+#: header line's own kind.
+REGISTERED_KINDS = frozenset({
+    "flush",         # store flush observer (runtime/store.py)
+    "t0_sync",       # tier-0 sync pump (runtime/native_frontend.py)
+    "breaker",       # cluster breaker transitions (runtime/cluster.py)
+    "node_error",    # cluster node failures (runtime/cluster.py)
+    "controller",    # control-plane actions (runtime/controller.py)
+    "reservation",   # reserve/settle events (runtime/reservations.py)
+    "header",        # the dump file's header line
+})
 
 
 class FlightRecorder:

@@ -493,7 +493,9 @@ class T0SpinMutex {
 // is only ever contended by the ONE sync pump's harvest/ack/retire
 // (brief, ~100 Hz), never by another shard. Lock order: shard
 // connection mutex → slice mutex; the sync pump takes slice mutexes
-// only.
+// only. (drl-verify extracts this order as the c:FeMutex →
+// c:T0SpinMutex graph edge — by guard TYPE, so renaming variables
+// cannot blind it — and fails on any cycle against it.)
 struct T0Part {
   T0SpinMutex mu;
   T0Config cfg;               // per-partition copy, read/written under mu
@@ -2581,7 +2583,11 @@ void fe_t0_ack(void* h, const char* key_blob, const int32_t* klens,
 // out to EVERY partition under ONE combined critical section — all
 // partition locks are taken up front (index order; this is the only
 // multi-partition lock site, so there is no ordering partner to
-// deadlock with). A config retired on shard 0 but still live on shard
+// deadlock with — and that is now a CHECKED contract, not a comment:
+// drl-verify's lock-order analyzer (tools/drl_verify/lockorder.py,
+// rule slice-sweep-order) fails `make check` on a reversed sweep, a
+// second multi-slice section, or any nested same-class acquisition
+// outside this one). A config retired on shard 0 but still live on shard
 // 3 would be a double-admit window; with the combined section no grant
 // can land on ANY partition between the harvest and the kill. Without
 // the kill, stale frames would keep being admitted (or confidently

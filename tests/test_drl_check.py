@@ -768,6 +768,156 @@ def test_numeric_dict_prefix_matches(tmp_path):
         mutated, [SERVER, CLUSTER], tmp_path) == []
 
 
+# -- flight-kind (round 14: the frame-kind registry) -------------------------
+
+FLIGHT = (ROOT / "distributedratelimiting" / "redis_tpu" / "utils"
+          / "flight_recorder.py")
+
+
+def test_flight_kind_extractor_sees_the_real_table():
+    """Non-vacuous cleanliness: the registry anchor exists and carries
+    every kind the runtime records today."""
+    from tools.drl_check import flight_kinds
+
+    kinds, line = flight_kinds.registered_kinds(FLIGHT)
+    assert {"flush", "t0_sync", "breaker", "node_error", "controller",
+            "reservation", "header"} <= kinds
+    assert line > 0
+    # A refactor that drops the table must be LOUD, never vacuous.
+    import pytest as _pytest
+    mutated_text = FLIGHT.read_text().replace("REGISTERED_KINDS",
+                                              "_RETIRED_KINDS")
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(mutated_text)
+    with _pytest.raises(RuntimeError):
+        flight_kinds.registered_kinds(pathlib.Path(f.name))
+
+
+def test_flight_kind_typo_fires_once_both_sides():
+    """Seeded divergence: a typo'd record() kind and a typo'd
+    frames(kind=) filter each fire exactly once, with the registry
+    table as the other side of the diff."""
+    from tools.drl_check import flight_kinds
+
+    kinds, line = flight_kinds.registered_kinds(FLIGHT)
+    src = ('rec.record("flsh", n=1)\n'
+           'frames_list = fr.frames(kind="contoller")\n'
+           'import numpy as np\n'
+           'np.argsort(x, kind="stable")\n'      # not a frames() call
+           'session.record(cmd)\n')              # not a literal kind
+    findings = flight_kinds.check_sources([("t.py", src)], kinds,
+                                          "fr.py", line)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("flight-kind", 1), ("flight-kind", 2)]
+    assert "flsh" in findings[0].message
+    assert "contoller" in findings[1].message
+    for f in findings:
+        assert f.related and f.related[0][0] == "fr.py"
+
+
+def test_flight_kind_suppressible_and_live_clean():
+    from tools.drl_check import flight_kinds
+
+    kinds, line = flight_kinds.registered_kinds(FLIGHT)
+    src = ('# drl-check: ok(flight-kind)\n'
+           'rec.record("foreign-kind", n=1)\n')
+    assert flight_kinds.check_sources([("t.py", src)], kinds,
+                                      "fr.py", line) == []
+    assert flight_kinds.check(ROOT) == []
+
+
+# -- stale-suppression (round 14: dead ok(...) comments) ----------------------
+
+def test_stale_suppression_fires_on_orphaned_comment():
+    from tools.drl_check import stale_suppression
+
+    src = ("def f():\n"
+           "    # drl-check: ok(task-off-loop)\n"
+           "    return 1\n")
+    findings = stale_suppression.check_source_entries(
+        ROOT, "distributedratelimiting/redis_tpu/runtime/x.py", src)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("stale-suppression", 2)]
+    assert "no longer fires" in findings[0].message
+
+
+def test_stale_suppression_unknown_and_dead_rules_fire():
+    from tools.drl_check import stale_suppression
+
+    unknown = "X = 1  # drl-check: ok(task-of-loop)\n"
+    findings = stale_suppression.check_source_entries(
+        ROOT, "x.py", unknown)
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "unknown rule" in findings[0].message
+
+    dead = "X = 1  # drl-check: ok(wire-const)\n"
+    findings = stale_suppression.check_source_entries(
+        ROOT, "x.py", dead)
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "never honors inline suppression" in findings[0].message
+
+
+def test_stale_suppression_live_comment_and_escape_hatch_pass():
+    from tools.drl_check import stale_suppression
+
+    live = ("import asyncio\n"
+            "class P:\n"
+            "    def cb(self, loop, coro):\n"
+            "        # drl-check: ok(task-off-loop)\n"
+            "        return loop.create_task(coro)\n")
+    assert stale_suppression.check_source_entries(
+        ROOT, "distributedratelimiting/redis_tpu/runtime/x.py",
+        live) == []
+    hatch = ("def f():\n"
+             "    # drl-check: ok(task-off-loop, stale-suppression)\n"
+             "    return 1\n")
+    assert stale_suppression.check_source_entries(
+        ROOT, "x.py", hatch) == []
+
+
+def test_stale_suppression_whitespace_tolerant_neutralizer():
+    """Review hardening: a live suppression with non-canonical spacing
+    (which common.Suppressions honors) must not be falsely staled —
+    the neutralizer operates through the SAME regex."""
+    from tools.drl_check import stale_suppression
+
+    src = ("import time\n"
+           "def f():\n"
+           "    time.sleep(1)  # drl-check:  ok(async-blocking)\n")
+    # Sync function: async-blocking doesn't fire -> stale, detected
+    # even with the odd spacing (the comment IS recognized).
+    assert [f.rule for f in stale_suppression.check_source_entries(
+        ROOT, "x.py", src)] == ["stale-suppression"]
+    live = ("import time\n"
+            "async def f():\n"
+            "    time.sleep(1)  # drl-check:  ok(async-blocking)\n")
+    assert stale_suppression.check_source_entries(
+        ROOT, "x.py", live) == []
+
+
+def test_stale_suppression_metric_name_is_file_scoped():
+    """A metric-name suppression OUTSIDE controller.py is dead by
+    location — it must fire regardless of any coincidental line-number
+    collision with a controller.py finding."""
+    from tools.drl_check import stale_suppression
+
+    src = ("X = 1\n# drl-check: ok(metric-name)\nY = 2\n")
+    findings = stale_suppression.check_source_entries(
+        ROOT, "distributedratelimiting/redis_tpu/runtime/cluster_x.py",
+        src)
+    assert [f.rule for f in findings] == ["stale-suppression"]
+
+
+def test_stale_suppression_live_tree_swept_clean():
+    """The satellite's sweep: every suppression in the tree either
+    still fires its rule or was deleted in this PR."""
+    from tools.drl_check import stale_suppression
+
+    assert stale_suppression.check(ROOT) == []
+
+
 def test_idempotency_covers_every_live_op():
     """The live tree is clean AND non-vacuously so — OP_CONFIG included,
     and both sets are seen with sane populations."""

@@ -29,6 +29,19 @@ a production misparse. Four analyzers:
   each resolve to a registered metric family in the registry that
   emits it; a renamed family is a failed check, not a silently blinded
   control loop.
+- :mod:`.flight_kinds` — every flight-recorder ``record(kind)`` call
+  and ``frames(kind=)`` filter must use a kind from
+  ``REGISTERED_KINDS`` (``utils/flight_recorder.py``); a typo'd kind
+  fails silently (the filter matches nothing), so it fails here
+  instead.
+- :mod:`.stale_suppression` — a ``# drl-check: ok(<rule>)`` whose rule
+  no longer fires at that site (or names an unknown/non-suppressible
+  rule) is itself a finding: dead suppressions read as protection they
+  don't provide and pre-excuse future regressions.
+
+The protocol-level counterpart — model checking the epoch/config/
+reservation/breaker state machines plus the cross-language lock-order
+analyzer — lives in :mod:`tools.drl_verify` (``make verify-model``).
 
 Run ``python -m tools.drl_check`` (exit 0 = clean); suppress a
 deliberate exception with ``# drl-check: ok(<rule>)`` on (or one line
@@ -50,8 +63,10 @@ def run_all(repo_root=None) -> "list[Finding]":
     from tools.drl_check import (
         build_freshness,
         concurrency_lint,
+        flight_kinds,
         jax_lint,
         metric_names,
+        stale_suppression,
         wire_conformance,
     )
 
@@ -63,4 +78,6 @@ def run_all(repo_root=None) -> "list[Finding]":
     findings += jax_lint.check(root)
     findings += build_freshness.check(root)
     findings += metric_names.check(root)
+    findings += flight_kinds.check(root)
+    findings += stale_suppression.check(root)
     return findings

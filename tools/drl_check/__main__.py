@@ -14,8 +14,10 @@ import sys
 from tools.drl_check import (
     build_freshness,
     concurrency_lint,
+    flight_kinds,
     jax_lint,
     metric_names,
+    stale_suppression,
     wire_conformance,
 )
 
@@ -25,6 +27,8 @@ _ANALYZERS = {
     "jax": jax_lint.check,
     "freshness": build_freshness.check,
     "metrics": metric_names.check,
+    "flightkinds": flight_kinds.check,
+    "suppressions": stale_suppression.check,
 }
 
 

@@ -10,8 +10,40 @@ import re
 
 __all__ = [
     "Finding", "Suppressions", "const_eval_py", "const_eval_c",
-    "rel", "iter_py_files",
+    "rel", "iter_py_files", "KNOWN_RULES", "INLINE_SUPPRESSIBLE",
 ]
+
+#: Every rule name any checker in this repo can emit (drl-check AND
+#: drl-verify's lock-order leg). The ``stale-suppression`` rule flags
+#: a ``# drl-check: ok(<rule>)`` naming anything else — a typo'd rule
+#: name suppresses nothing and rots silently.
+KNOWN_RULES = frozenset({
+    # wire/ABI conformance
+    "wire-const", "wire-layout", "wire-endian", "wire-hier",
+    "wire-dispatch", "wire-idempotency", "abi-export",
+    # concurrency lint
+    "async-blocking", "lock-across-await", "task-off-loop",
+    "unguarded-loop-close", "swallowed-exception",
+    # JAX hot-path lint
+    "traced-branch", "jit-rewrap", "jit-static-unhashable",
+    # build freshness / metrics / flight recorder
+    "stale-binary", "metric-name", "flight-kind",
+    # drl-verify lock-order leg
+    "lock-cycle", "slice-sweep-order",
+    # this meta-rule itself (ok(stale-suppression) is the escape hatch)
+    "stale-suppression",
+})
+
+#: Rules whose analyzers actually consult inline suppression comments.
+#: Naming any OTHER known rule in an ok(...) is dead by construction —
+#: the analyzer never reads the comment — and stale-suppression says
+#: so instead of letting the comment imply protection it doesn't have.
+INLINE_SUPPRESSIBLE = frozenset({
+    "async-blocking", "lock-across-await", "task-off-loop",
+    "unguarded-loop-close", "swallowed-exception",
+    "traced-branch", "jit-rewrap", "jit-static-unhashable",
+    "metric-name", "flight-kind",
+})
 
 
 @dataclasses.dataclass(frozen=True)

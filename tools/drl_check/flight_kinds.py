@@ -1,0 +1,138 @@
+"""``flight-kind`` — every flight-recorder frame kind must be
+registered.
+
+The flight recorder's ``frames(kind=...)`` filter is how audits read
+the evidence ring (the controller's action-log assertions, the chaos
+soaks' breaker checks). A typo'd kind on EITHER side fails silently:
+``record("flsh", ...)`` produces frames no filter finds, and
+``frames(kind="contoller")`` matches nothing — the audit assertion
+passes vacuously. This rule extracts :data:`REGISTERED_KINDS` from
+``utils/flight_recorder.py`` via ``ast`` (the table is the anchor; its
+absence is a loud error, never a vacuous pass) and cross-checks every
+recorder ``record("<kind>", ...)`` call and every
+``frames(kind="<kind>")`` filter across the package AND the tests —
+file:line on both sides.
+
+Receiver discipline keeps unrelated ``record()`` methods (histograms,
+profiling sessions) out: the first argument must be a string literal
+and the receiver expression must be recorder-shaped
+(``*recorder*``/``*rec*``/``flight``). ``frames(kind=...)`` is matched
+by attribute name with a string-literal kind (``np.argsort(...,
+kind="stable")`` has no ``frames`` attribute and never matches).
+
+Suppress a deliberately foreign kind with
+``# drl-check: ok(flight-kind)``."""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.drl_check.common import (
+    Finding,
+    Suppressions,
+    iter_py_files,
+    rel,
+)
+
+__all__ = ["check", "check_sources", "registered_kinds"]
+
+_RECORDERISH = ("recorder", "rec", "flight", "fr")
+
+
+def registered_kinds(flight_recorder_py: pathlib.Path
+                     ) -> "tuple[frozenset[str], int]":
+    """Extract ``REGISTERED_KINDS`` (+ its line) from the live module
+    source. A missing/empty table raises — the rule must never pass
+    vacuously because a refactor moved the anchor."""
+    tree = ast.parse(flight_recorder_py.read_text())
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, ast.AnnAssign) else [])
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "REGISTERED_KINDS":
+                kinds = {
+                    k.value for k in ast.walk(node.value)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+                if not kinds:
+                    raise RuntimeError(
+                        "REGISTERED_KINDS is empty in "
+                        f"{flight_recorder_py}")
+                return frozenset(kinds), node.lineno
+    raise RuntimeError(
+        f"REGISTERED_KINDS not found in {flight_recorder_py} — the "
+        "flight-kind rule's anchor is gone")
+
+
+def _recorder_shaped(expr: ast.AST) -> bool:
+    try:
+        text = ast.unparse(expr).lower()
+    except Exception:
+        return False
+    last = text.split(".")[-1]
+    return any(t in last for t in _RECORDERISH) \
+        or "flight" in text
+
+
+def _kind_sites(source: str) -> "list[tuple[str, int, str]]":
+    """(kind, line, site-kind) for record()/frames() literal kinds."""
+    out = []
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr == "record" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and _recorder_shaped(node.func.value):
+            out.append((node.args[0].value, node.lineno, "record"))
+        elif node.func.attr == "frames":
+            for kw in node.keywords:
+                if kw.arg == "kind" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out.append((kw.value.value, node.lineno,
+                                "frames(kind=)"))
+    return out
+
+
+def check_sources(sources: "list[tuple[str, str]]",
+                  kinds: "frozenset[str]",
+                  table_file: str, table_line: int) -> "list[Finding]":
+    """``sources`` is ``[(path, text), ...]``."""
+    findings = []
+    for path, text in sources:
+        supp = Suppressions(text)
+        try:
+            sites = _kind_sites(text)
+        except SyntaxError:
+            continue
+        for kind, line, what in sites:
+            if kind in kinds or supp.suppressed(line, "flight-kind"):
+                continue
+            findings.append(Finding(
+                "flight-kind",
+                f"{what} uses unregistered frame kind {kind!r} — a "
+                "typo here fails silently (the filter matches "
+                "nothing); add it to REGISTERED_KINDS or fix the "
+                "spelling",
+                path, line,
+                ((table_file, table_line,
+                  "the registered-kinds table"),)))
+    return sorted(findings, key=lambda f: (f.file, f.line))
+
+
+def check(root: pathlib.Path) -> "list[Finding]":
+    fr = (root / "distributedratelimiting" / "redis_tpu" / "utils"
+          / "flight_recorder.py")
+    kinds, table_line = registered_kinds(fr)
+    sources = []
+    for base in ("distributedratelimiting", "tests"):
+        d = root / base
+        if d.exists():
+            for py in iter_py_files(d):
+                sources.append((rel(py, root), py.read_text()))
+    return check_sources(sources, kinds, rel(fr, root), table_line)

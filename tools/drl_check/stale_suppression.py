@@ -1,0 +1,183 @@
+"""``stale-suppression`` — dead ``# drl-check: ok(...)`` comments are
+findings.
+
+A suppression comment is a standing claim: "the named rule fires here,
+and we accept it for this reason". When a refactor removes the code
+that fired — or the comment names a rule that never existed — the
+claim rots: the comment now suppresses NOTHING, but it still reads as
+protection, and the next real finding at that site is silently eaten
+the day the code regresses into firing again. Three failure shapes,
+all flagged:
+
+- **unknown rule** — ``ok(task-of-loop)`` (typo'd / renamed rule):
+  suppresses nothing anywhere.
+- **non-suppressible rule** — ``ok(wire-const)``: that analyzer never
+  consults inline comments (see ``INLINE_SUPPRESSIBLE`` in common.py),
+  so the comment is dead by construction.
+- **stale** — the named rule IS suppressible but no longer fires at
+  this site: re-run the owning analyzer on the file with every
+  suppression comment neutralized (same line count, so line numbers
+  hold) and require a finding of that rule at the comment's line or
+  the line below (the comment's coverage).
+
+Escape hatch: a comment whose rule list includes ``stale-suppression``
+is exempt (it declares "keep me even while dormant" — e.g. a rule
+that fires only on some platforms)."""
+
+from __future__ import annotations
+
+import pathlib
+
+from tools.drl_check.common import (
+    INLINE_SUPPRESSIBLE,
+    KNOWN_RULES,
+    _SUPPRESS_RE,
+    Finding,
+    iter_py_files,
+    rel,
+)
+
+__all__ = ["check", "check_source_entries", "suppression_comments"]
+
+
+def _neutralize(text: str) -> str:
+    """Disarm every suppression THE SAME regex recognizes (one shared
+    pattern in common.py — a private copy here once drifted on
+    whitespace and falsely staled live comments). Line count and
+    character positions are preserved, so re-run findings keep their
+    line numbers."""
+    return _SUPPRESS_RE.sub(
+        lambda m: m.group(0).replace("ok(", "xx(", 1), text)
+
+
+def suppression_comments(text: str) -> "list[tuple[int, list[str]]]":
+    out = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out.append((i, [r.strip() for r in m.group(1).split(",")]))
+    return out
+
+
+def _raw_findings(path: str, text: str) -> "list":
+    """Every suppressible analyzer's findings for this file with the
+    suppression comments neutralized."""
+    from tools.drl_check import concurrency_lint, jax_lint
+
+    neutral = _neutralize(text)
+    findings = []
+    try:
+        findings += concurrency_lint.check_source(neutral, path)
+        findings += jax_lint.check_source(neutral, path)
+    except SyntaxError:
+        return []
+    return findings
+
+
+def _metric_name_fires(root: pathlib.Path, path: str,
+                       line: int) -> bool:
+    """Would metric-name fire at ``line`` of THIS file with the
+    suppression neutralized? The rule only ever consults controller.py
+    — a metric-name suppression anywhere else is dead by location (and
+    must not be exonerated by a coincidental line-number collision
+    with a controller.py finding)."""
+    import tempfile
+
+    from tools.drl_check import metric_names
+
+    if pathlib.PurePath(path).name != "controller.py":
+        return False
+    controller = (root / "distributedratelimiting" / "redis_tpu"
+                  / "runtime" / "controller.py")
+    if not controller.exists():
+        return False
+    neutral = _neutralize(controller.read_text())
+    with tempfile.TemporaryDirectory() as td:
+        mutated = pathlib.Path(td) / "controller.py"
+        mutated.write_text(neutral)
+        try:
+            findings = metric_names.check_sources(
+                mutated,
+                [p for p in iter_py_files(
+                    root / "distributedratelimiting")
+                 if p.name != "controller.py"],
+                root)
+        except Exception:
+            return False
+    return any(f.line in (line, line + 1) for f in findings)
+
+
+def _flight_kind_fires(root: pathlib.Path, path: str, text: str,
+                       line: int) -> bool:
+    from tools.drl_check import flight_kinds
+
+    fr = (root / "distributedratelimiting" / "redis_tpu" / "utils"
+          / "flight_recorder.py")
+    try:
+        kinds, table_line = flight_kinds.registered_kinds(fr)
+        findings = flight_kinds.check_sources(
+            [(path, _neutralize(text))], kinds,
+            rel(fr, root), table_line)
+    except Exception:
+        return False
+    return any(f.line in (line, line + 1) for f in findings)
+
+
+def check_source_entries(root: pathlib.Path, path: str,
+                         text: str) -> "list[Finding]":
+    findings: list[Finding] = []
+    comments = suppression_comments(text)
+    if not comments:
+        return findings
+    raw = None   # computed lazily, once per file
+    for line, rules in comments:
+        if "stale-suppression" in rules:
+            continue   # the declared keep-while-dormant escape hatch
+        for rule in rules:
+            if rule not in KNOWN_RULES:
+                findings.append(Finding(
+                    "stale-suppression",
+                    f"suppression names unknown rule {rule!r} — it "
+                    "suppresses nothing (typo, or the rule was "
+                    "renamed); fix or delete the comment",
+                    path, line))
+                continue
+            if rule not in INLINE_SUPPRESSIBLE:
+                findings.append(Finding(
+                    "stale-suppression",
+                    f"rule {rule!r} never honors inline suppression "
+                    "comments — this ok(...) is dead by construction "
+                    "and reads as protection it does not provide",
+                    path, line))
+                continue
+            if rule == "metric-name":
+                fires = _metric_name_fires(root, path, line)
+            elif rule == "flight-kind":
+                fires = _flight_kind_fires(root, path, text, line)
+            else:
+                if raw is None:
+                    raw = _raw_findings(path, text)
+                fires = any(f.rule == rule and f.line in (line, line + 1)
+                            for f in raw)
+            if not fires:
+                findings.append(Finding(
+                    "stale-suppression",
+                    f"suppressed rule {rule!r} no longer fires at "
+                    "this site — the code it excused is gone; delete "
+                    "the comment so a future regression here is "
+                    "LOUD, not silently pre-excused",
+                    path, line))
+    return findings
+
+
+def check(root: pathlib.Path) -> "list[Finding]":
+    findings: list[Finding] = []
+    for py in iter_py_files(root / "distributedratelimiting"):
+        findings += check_source_entries(root, rel(py, root),
+                                         py.read_text())
+    native = root / "native"
+    if native.exists():
+        for cc in sorted(native.glob("*.cc")):
+            findings += check_source_entries(root, rel(cc, root),
+                                             cc.read_text())
+    return sorted(findings, key=lambda f: (f.file, f.line))

@@ -1,0 +1,120 @@
+"""drl-verify — exhaustive protocol model checking + lock-order
+analysis for the repo's four distributed state machines.
+
+PRs 6–13 stacked four interacting protocols — placement epochs
+(``runtime/placement.py``), config versions (``runtime/liveconfig.py``),
+reservation rid-idempotency (``runtime/reservations.py``), and the
+breaker lifecycle (``utils/resilience.py``) — whose safety arguments
+lived in prose (docs/DESIGN.md §12–§18) and in seeded soaks that
+sample a vanishing fraction of interleavings. This package checks the
+*protocols* themselves:
+
+1. **Extract** (:mod:`.extract`) small formal models from the live
+   code via ``ast`` — guard comparisons, dedup probes, the breaker's
+   transition table, the client's ``_IDEMPOTENT_OPS`` classification —
+   so the models can never silently drift from the implementation.
+2. **Explore** (:mod:`.machines` + :mod:`.explorer`) their product
+   exhaustively under an adversarial scheduler (message loss and
+   duplication, idempotent retry, coordinator crash, window expiry,
+   concurrent reshape × live-limit mutation) checking machine-readable
+   invariants; every violation carries a minimized counterexample
+   trace AND a generated pytest (:mod:`.replay`) that replays it
+   against the real in-process implementation
+   (:mod:`.replay_harness`) — the model-to-code gap closes in both
+   directions.
+3. **Lock order** (:mod:`.lockorder`): one static lock-acquisition
+   graph across Python (``with``/``async with`` scopes) and
+   ``native/frontend.cc`` (``lock_guard`` sites by mutex type, the
+   ``fe_t0_retire`` all-slices combined section), failing on cycles
+   and on non-canonical slice sweeps.
+
+CLI: ``python -m tools.drl_verify`` — exit 0 on the live tree, 1 with
+traces on violation, 2 on a checker/extraction crash (never a fake
+'clean'). ``make verify-model`` wires it into ``make check`` with
+bounded, LOUDLY-logged state/depth caps. Runbook:
+docs/OPERATIONS.md §15; modeling contract: docs/DESIGN.md §19."""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+
+__all__ = ["run_verify", "VerifyResult"]
+
+#: Exploration bounds for `make check` (CLI flags override): the four
+#: base worlds complete EXHAUSTIVELY far below these; the migration ×
+#: config product is cut off at the cap — reported, never silent.
+DEFAULT_MAX_STATES = 400_000
+DEFAULT_PRODUCT_STATES = 150_000
+DEFAULT_MAX_DEPTH = 64
+
+
+@dataclasses.dataclass
+class VerifyResult:
+    results: list          # per-world ExploreResult
+    violations: list       # flattened Violation list
+    lock_findings: list    # lockorder Finding list
+    unmodeled: "list[str]"
+    facts: object
+
+    @property
+    def total_states(self) -> int:
+        return sum(r.states for r in self.results)
+
+    @property
+    def invariants_checked(self) -> "set[str]":
+        out: set = set()
+        for r in self.results:
+            out |= set(r.invariants)
+        return out
+
+    @property
+    def clean(self) -> bool:
+        return (not self.violations and not self.lock_findings
+                and not self.unmodeled)
+
+
+def run_verify(root: "pathlib.Path | None" = None, *,
+               max_states: int = DEFAULT_MAX_STATES,
+               product_states: int = DEFAULT_PRODUCT_STATES,
+               max_depth: int = DEFAULT_MAX_DEPTH,
+               include_product: bool = True,
+               include_lockorder: bool = True,
+               log=lambda msg: None) -> VerifyResult:
+    """Run the whole suite against ``root`` (default: this repo)."""
+    from tools.drl_verify import lockorder
+    from tools.drl_verify.explorer import explore
+    from tools.drl_verify.extract import extract_facts
+    from tools.drl_verify.machines import (
+        all_worlds,
+        unmodeled_idempotent_ops,
+    )
+
+    root = pathlib.Path(root) if root else \
+        pathlib.Path(__file__).resolve().parents[2]
+    facts = extract_facts(root)
+    unmodeled = unmodeled_idempotent_ops(facts)
+
+    results = []
+    violations = []
+    for world in all_worlds(facts, include_product=include_product):
+        cap = (product_states if "x" in world.name else max_states)
+        r = explore(world, max_states=cap, max_depth=max_depth)
+        results.append(r)
+        violations.extend(r.violations)
+        note = ""
+        if r.truncated_states:
+            note = f" [CAPPED at max_states={cap}]"
+        elif r.truncated_depth:
+            note = f" [CAPPED at max_depth={max_depth}]"
+        else:
+            note = " [exhaustive]"
+        log(f"world {world.name}: {r.states} states, "
+            f"{r.transitions} transitions, depth {r.depth}, "
+            f"{len(r.violations)} violation(s){note}")
+
+    lock_findings = lockorder.check(root) if include_lockorder else []
+    if include_lockorder:
+        log(f"lock-order: {len(lock_findings)} finding(s)")
+    return VerifyResult(results, violations, lock_findings,
+                        unmodeled, facts)

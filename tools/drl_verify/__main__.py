@@ -1,0 +1,126 @@
+"""CLI: ``python -m tools.drl_verify [--json] [--emit-replays DIR]``.
+
+Exit status: 0 = every invariant holds over the explored product and
+the lock graph is cycle-free; 1 = violations (counterexample traces on
+stdout, replay pytests written with ``--emit-replays``); 2 = checker /
+extraction crash — a blinded checker is loud, never a fake 'clean'.
+
+State/depth caps are explicit flags and every truncation is printed:
+a bounded run can never read as an exhaustive one."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.drl_verify import (
+    DEFAULT_MAX_DEPTH,
+    DEFAULT_MAX_STATES,
+    DEFAULT_PRODUCT_STATES,
+    run_verify,
+)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="drl-verify",
+        description="exhaustive protocol model checker (placement / "
+                    "config / reservation / breaker machines) + "
+                    "cross-language lock-order analyzer "
+                    "(see tools/drl_verify)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable results on stdout")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: inferred)")
+    parser.add_argument("--max-states", type=int,
+                        default=DEFAULT_MAX_STATES,
+                        help="per-world state cap (truncation is "
+                             "always reported)")
+    parser.add_argument("--product-states", type=int,
+                        default=DEFAULT_PRODUCT_STATES,
+                        help="state cap for the migration x config "
+                             "product world")
+    parser.add_argument("--max-depth", type=int,
+                        default=DEFAULT_MAX_DEPTH)
+    parser.add_argument("--no-product", action="store_true",
+                        help="skip the (large) product world")
+    parser.add_argument("--no-lockorder", action="store_true",
+                        help="skip the lock-order analyzer")
+    parser.add_argument("--emit-replays", metavar="DIR", default=None,
+                        help="write one generated replay pytest per "
+                             "violation into DIR")
+    args = parser.parse_args(argv)
+
+    try:
+        res = run_verify(
+            pathlib.Path(args.root) if args.root else None,
+            max_states=args.max_states,
+            product_states=args.product_states,
+            max_depth=args.max_depth,
+            include_product=not args.no_product,
+            include_lockorder=not args.no_lockorder,
+            log=lambda m: print(f"drl-verify: {m}", file=sys.stderr))
+    except Exception as exc:  # noqa: BLE001 — checker bug: loud, rc 2
+        print(f"drl-verify: checker crashed: {exc!r}", file=sys.stderr)
+        return 2
+
+    emitted = []
+    if args.emit_replays and res.violations:
+        from tools.drl_verify.replay import (
+            generate_pytest,
+            replay_filename,
+        )
+
+        out = pathlib.Path(args.emit_replays)
+        out.mkdir(parents=True, exist_ok=True)
+        for v in res.violations:
+            path = out / replay_filename(v)
+            path.write_text(generate_pytest(v))
+            emitted.append(str(path))
+
+    if args.json:
+        print(json.dumps({
+            "states": res.total_states,
+            "invariants": sorted(res.invariants_checked),
+            "worlds": [{
+                "name": r.world, "states": r.states,
+                "transitions": r.transitions, "depth": r.depth,
+                "truncated": r.truncated,
+                "violations": [{
+                    "invariant": v.invariant, "detail": v.detail,
+                    "trace": list(v.trace),
+                } for v in r.violations],
+            } for r in res.results],
+            "lock_findings": [{
+                "rule": f.rule, "file": f.file, "line": f.line,
+                "message": f.message,
+                "related": [list(r) for r in f.related],
+            } for f in res.lock_findings],
+            "unmodeled_idempotent_ops": res.unmodeled,
+            "replays_written": emitted,
+        }, indent=2))
+    else:
+        for v in res.violations:
+            print(v.format())
+        for f in res.lock_findings:
+            print(f.format())
+        for op in res.unmodeled:
+            print(f"error[idempotent-unmodeled]: {op} is in "
+                  "_IDEMPOTENT_OPS but has no replay model — extend "
+                  "tools/drl_verify/machines.py (MODELED_OPS / "
+                  "READ_OPS) or reclassify the op")
+        n = (len(res.violations) + len(res.lock_findings)
+             + len(res.unmodeled))
+        verdict = "clean" if n == 0 else f"{n} violation(s)"
+        print(f"drl-verify: {verdict} — {res.total_states} product "
+              f"states explored, {len(res.invariants_checked)} "
+              "invariants checked"
+              + (f", {len(emitted)} replay test(s) written"
+                 if emitted else ""))
+    return 0 if res.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Bounded explicit-state exploration with counterexample traces.
+
+The worlds (:mod:`tools.drl_verify.machines`) are deterministic labeled
+transition systems: ``init_states()`` gives the roots, ``labels(s)``
+the enabled actions, ``apply(s, label)`` the successor plus any
+invariant violations the transition itself detects (monotonicity,
+replay-divergence, budget bounds are all edge properties). The
+explorer runs breadth-first, so the FIRST trace found for a violation
+class is already the shortest; a greedy deletion pass then drops every
+action the violation does not actually need (re-executing the
+remainder from the root each time), which is what turns a 14-step
+schedule into the 4-step story a human reads.
+
+Bounds are explicit and LOUD: ``max_states`` / ``max_depth`` caps are
+reported in the result so a truncated exploration can never read as an
+exhaustive one (the ISSUE-14 contract: caps are logged, never silently
+applied)."""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+__all__ = ["Violation", "ExploreResult", "explore", "minimize_trace",
+           "replay_trace"]
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant violation with its (minimized) counterexample."""
+
+    world: str
+    invariant: str
+    detail: str
+    trace: "tuple[str, ...]"   # action labels root -> violating action
+    root: object               # the initial state the trace starts from
+    key: str = ""              # the violation class key (dedup + names)
+
+    def format(self) -> str:
+        steps = "\n".join(f"    {i + 1}. {label}"
+                          for i, label in enumerate(self.trace))
+        return (f"[{self.world}] invariant '{self.invariant}' violated: "
+                f"{self.detail}\n  counterexample "
+                f"({len(self.trace)} steps):\n{steps}")
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    world: str
+    states: int
+    transitions: int
+    depth: int
+    violations: "list[Violation]"
+    truncated_states: bool = False
+    truncated_depth: bool = False
+    invariants: "tuple[str, ...]" = ()
+
+    @property
+    def truncated(self) -> bool:
+        return self.truncated_states or self.truncated_depth
+
+
+def explore(world, *, max_states: int = 200_000,
+            max_depth: int = 64) -> ExploreResult:
+    """BFS over ``world``. Collects the first (shortest) violation per
+    ``(invariant, detail-key)`` class, minimized. Exploration continues
+    past a violating edge's SOURCE state but does not expand the
+    violating successor (one bad state explains itself; its successors
+    would only repeat the story)."""
+    roots = list(world.init_states())
+    seen: "dict[object, tuple[object, str] | None]" = {
+        s: None for s in roots}
+    queue = deque((s, 0) for s in roots)
+    violations: "dict[tuple[str, str], Violation]" = {}
+    transitions = 0
+    depth_reached = 0
+    truncated_states = truncated_depth = False
+
+    def trace_to(state: object) -> "tuple[list[str], object]":
+        labels: list[str] = []
+        cur = state
+        while seen[cur] is not None:
+            prev, label = seen[cur]
+            labels.append(label)
+            cur = prev
+        labels.reverse()
+        return labels, cur
+
+    while queue:
+        state, depth = queue.popleft()
+        depth_reached = max(depth_reached, depth)
+        if depth >= max_depth:
+            truncated_depth = True
+            continue
+        for label in world.labels(state):
+            nxt, viols = world.apply(state, label)
+            transitions += 1
+            bad = False
+            for inv, detail, key in viols:
+                bad = True
+                vkey = (inv, key)
+                if vkey not in violations:
+                    prefix, root = trace_to(state)
+                    trace = tuple(prefix + [label])
+                    trace = minimize_trace(world, root, trace, inv, key)
+                    violations[vkey] = Violation(
+                        world.name, inv, detail, trace, root, key)
+            if bad or nxt is None or nxt in seen:
+                continue
+            if len(seen) >= max_states:
+                truncated_states = True
+                continue
+            seen[nxt] = (state, label)
+            queue.append((nxt, depth + 1))
+
+    return ExploreResult(
+        world=world.name, states=len(seen), transitions=transitions,
+        depth=depth_reached,
+        violations=sorted(violations.values(),
+                          key=lambda v: (v.invariant, v.detail)),
+        truncated_states=truncated_states,
+        truncated_depth=truncated_depth,
+        invariants=tuple(getattr(world, "invariants", ())),
+    )
+
+
+def replay_trace(world, root, trace: "tuple[str, ...]"
+                 ) -> "tuple[str, str, str] | None":
+    """Re-execute ``trace`` from ``root``; returns the first violation
+    tuple the final action produces (``None`` when the schedule is not
+    even executable — a label disabled along the way — or ends clean).
+    Intermediate violations don't count: a minimized trace must put its
+    violation at the END, where the generated replay test asserts."""
+    state = root
+    for i, label in enumerate(trace):
+        if label not in world.labels(state):
+            return None
+        state, viols = world.apply(state, label)
+        if i < len(trace) - 1:
+            if viols or state is None:
+                return None
+    return viols[0] if viols else None
+
+
+def minimize_trace(world, root, trace: "tuple[str, ...]",
+                   invariant: str, key: str) -> "tuple[str, ...]":
+    """Greedy single-deletion minimization: drop any action whose
+    removal still reproduces the SAME (invariant, key) violation at the
+    end of the schedule. BFS already gives the shortest path through
+    the state graph; this removes actions that were merely on the way
+    (a dup delivery, an unrelated acquire)."""
+    labels = list(trace)
+    changed = True
+    while changed:
+        changed = False
+        for i in range(len(labels) - 1):  # never drop the final action
+            cand = tuple(labels[:i] + labels[i + 1:])
+            viol = replay_trace(world, root, cand)
+            if viol is not None and viol[0] == invariant \
+                    and viol[2] == key:
+                labels = list(cand)
+                changed = True
+                break
+    return tuple(labels)

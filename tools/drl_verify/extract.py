@@ -1,0 +1,393 @@
+"""Model extraction — the live code is the spec.
+
+drl-verify does NOT keep a hand-written copy of the protocol rules it
+checks: every behavioral fact the models depend on is extracted from
+the implementation via ``ast`` at check time, so a refactor that drops
+a guard *changes the model* and the exploration finds the resulting
+violation (with a trace), instead of a stale hand-model silently
+passing. The extraction surface (docs/DESIGN.md §19):
+
+- ``runtime/remote.py`` — the ``_IDEMPOTENT_OPS`` /
+  ``_NON_IDEMPOTENT_OPS`` classification (which wire ops the client may
+  replay post-send; every idempotent op must have a replay model).
+- ``runtime/placement.py`` — the epoch state machine's guards: the
+  stale-announce raise, the conflicting-twin raise, the pull cache, the
+  expiry-abort tombstone, the push batch dedup, and the abort's push-
+  ledger reset + reservation-stash restore.
+- ``runtime/liveconfig.py`` — the config-version machine's guards: the
+  stale prepare/adopt raises, commit idempotency, the staged-twin
+  conflict raise, and the gate-flips-before-rebase statement order.
+- ``runtime/reservations.py`` — the ledger's dedup probes: duplicate
+  reserve, recorded settle, restore-skips-known-rid, and the
+  per-(tag, tenant) debt dedup.
+- ``utils/resilience.py`` — the breaker transition table (every
+  ``self._transition(...)`` call site with its guarding state) plus the
+  single-probe and probe-reclaim guards in ``allow``.
+
+A missing CLASS or METHOD is an :class:`ExtractionError` (the checker
+is blind — exit 2, never a silent 'clean'); a missing GUARD inside a
+found method is a *fact* (``False``) that the model faithfully adopts —
+and the exploration then produces the counterexample that guard exists
+to prevent. Each fact carries file:line provenance for findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+__all__ = ["Facts", "ExtractionError", "extract_facts",
+           "extract_placement", "extract_liveconfig",
+           "extract_reservations", "extract_breaker", "extract_op_sets"]
+
+
+class ExtractionError(RuntimeError):
+    """An extraction anchor (class/method/assignment) is gone: the
+    checker cannot see the code it models. Loud by design."""
+
+
+@dataclasses.dataclass
+class Fact:
+    """One extracted boolean fact with provenance."""
+
+    present: bool
+    file: str
+    line: int
+
+    def __bool__(self) -> bool:
+        return self.present
+
+
+@dataclasses.dataclass
+class Facts:
+    """Everything the worlds consume (see module docstring)."""
+
+    # remote.py — op name -> line of the classification set.
+    idempotent_ops: "dict[str, int]"
+    non_idempotent_ops: "dict[str, int]"
+    remote_file: str
+
+    # placement.py — NodePlacementState guards.
+    announce_stale_guard: Fact      # stale epoch announce raises
+    announce_conflict_guard: Fact   # same-epoch different-map raises
+    pull_cached: Fact               # re-delivered pull serves the cache
+    pull_tombstone_guard: Fact      # post-expiry-abort pull refuses
+    push_dedup: Fact                # (epoch, batch) applied-set dedup
+    abort_resets_push_ledger: Fact  # _abort pops the target epoch's set
+    abort_restores_reservations: Fact  # _abort restores the res stash
+    expiry_abort_forfeits: Fact     # expiry abort does NOT restore it
+    abort_drops_imported_res: Fact  # dst abort drops imported rows
+
+    # liveconfig.py — ConfigState guards.
+    prepare_stale_guard: Fact
+    prepare_conflict_guard: Fact    # staged twin at same version raises
+    commit_idempotent_guard: Fact   # version <= committed -> no-op
+    adopt_stale_guard: Fact         # stale adopt snapshot -> no-op
+    commit_gate_first: Fact         # gate flip precedes the rebase
+
+    # reservations.py — ReservationLedger dedup probes.
+    reserve_dedup: Fact             # duplicate reserve replays decision
+    settle_dedup: Fact              # settled-rid map replays the result
+    restore_skip_known: Fact        # restore skips an already-known rid
+    debt_tag_dedup: Fact            # tagged debt applies once per tag
+
+    # resilience.py — CircuitBreaker.
+    breaker_edges: "frozenset[tuple[str, str, str]]"  # (from, event, to)
+    breaker_single_probe_guard: Fact  # allow() rejects while in flight
+    breaker_probe_reclaim: Fact       # abandoned slot reclaimed on time
+    breaker_file: str
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def _parse(path: pathlib.Path) -> ast.Module:
+    try:
+        return ast.parse(path.read_text())
+    except (OSError, SyntaxError) as exc:
+        raise ExtractionError(f"cannot parse {path}: {exc!r}") from exc
+
+
+def _class(tree: ast.Module, name: str, path: pathlib.Path) -> ast.ClassDef:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    raise ExtractionError(f"class {name} not found in {path}")
+
+
+def _method(cls: ast.ClassDef, name: str,
+            path: pathlib.Path) -> "ast.FunctionDef | ast.AsyncFunctionDef":
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    raise ExtractionError(
+        f"method {cls.name}.{name} not found in {path}")
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _find_fact(fn: ast.AST, file: str, *needles: str,
+               node_type: type = ast.AST) -> Fact:
+    """A fact holds when some node of ``node_type`` inside ``fn``
+    unparses to text containing EVERY needle. Line = the matching node
+    (guard present) or the method header (guard absent — the site the
+    refactor would have to restore)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, node_type):
+            continue
+        text = _src(node)
+        if text and all(n in text for n in needles):
+            return Fact(True, file, getattr(node, "lineno", fn.lineno))
+    return Fact(False, file, fn.lineno)
+
+
+def _all_facts(file: str, *facts: Fact) -> Fact:
+    """Conjunction: the combined fact holds only when EVERY site does;
+    the provenance line is the first missing site's (the one a revert
+    would have to restore), else the first site's."""
+    for f in facts:
+        if not f.present:
+            return f
+    return facts[0]
+
+
+def _find_if_test(fn: ast.AST, file: str, *needles: str) -> Fact:
+    """Like :func:`_find_fact` restricted to ``If`` CONDITIONS — for
+    guards whose needle text also appears as ordinary statements in
+    the surrounding branches (matching a whole If's body would keep
+    the fact alive after the guard itself is deleted)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        text = _src(node.test)
+        if text and all(n in text for n in needles):
+            return Fact(True, file, node.lineno)
+    return Fact(False, file, fn.lineno)
+
+
+# -- remote.py: the idempotency classification -------------------------------
+
+def extract_op_sets(remote_py: pathlib.Path
+                    ) -> "tuple[dict[str, int], dict[str, int]]":
+    """``{op_name: line}`` for both classification sets. Reuses the
+    drl-check extractor (one parser, two checkers — they cannot
+    drift apart)."""
+    from tools.drl_check import wire_conformance
+
+    sets = wire_conformance._remote_op_sets(remote_py)
+    out = []
+    for name in ("_IDEMPOTENT_OPS", "_NON_IDEMPOTENT_OPS"):
+        if name not in sets:
+            raise ExtractionError(f"{name} not found in {remote_py}")
+        members, line = sets[name]
+        out.append({m: line for m in members})
+    return out[0], out[1]
+
+
+# -- placement.py ------------------------------------------------------------
+
+def extract_placement(placement_py: pathlib.Path, rel: str) -> dict:
+    tree = _parse(placement_py)
+    cls = _class(tree, "NodePlacementState", placement_py)
+    announce = _method(cls, "announce", placement_py)
+    abort = _method(cls, "_abort", placement_py)
+    pull = _method(cls, "pull", placement_py)
+    push = _method(cls, "push", placement_py)
+    return {
+        "announce_stale_guard": _find_fact(
+            announce, rel, "pmap.epoch < self.pmap.epoch",
+            "StalePlacementError", node_type=ast.If),
+        "announce_conflict_guard": _find_fact(
+            announce, rel, "pmap.epoch == self.pmap.epoch",
+            "StalePlacementError", node_type=ast.If),
+        "pull_cached": _find_fact(
+            pull, rel, "self._handoffs.get(target_epoch)"),
+        "pull_tombstone_guard": _find_fact(
+            pull, rel, "in self._aborted_epochs", node_type=ast.If),
+        "push_dedup": _find_fact(
+            push, rel, "batch in applied", node_type=ast.If),
+        "abort_resets_push_ledger": _find_fact(
+            abort, rel, "self._applied.pop(target_epoch"),
+        # The FULL coordinator-abort restore (rows + debts) — needle is
+        # the whole call so the forfeit branch's debt-only
+        # restore_rows([], ...) cannot keep this fact alive.
+        "abort_restores_reservations": _find_fact(
+            abort, rel, "restore_rows(*h.res_stash)"),
+        # BOTH expiry paths (gate() and bulk_gate()) must forfeit the
+        # reservation stash: restoring under a slow commit double-homes
+        # the rid and a retried settle refunds on both sides — the
+        # settle-dedup counterexample this PR's fix closed. ANDed so a
+        # revert of EITHER call site drops the fact (and the model
+        # then re-derives the counterexample).
+        "expiry_abort_forfeits": _all_facts(
+            rel,
+            _find_fact(_method(cls, "gate", placement_py), rel,
+                       "self._abort(", "restore_reservations=False",
+                       node_type=ast.Call),
+            _find_fact(_method(cls, "bulk_gate", placement_py), rel,
+                       "self._abort(", "restore_reservations=False",
+                       node_type=ast.Call)),
+        # The destination half of the same fix: an abort must drop the
+        # reservation rows its pushes imported for the aborted epoch.
+        "abort_drops_imported_res": _find_fact(
+            abort, rel, "self._imported_res.pop(target_epoch"),
+    }
+
+
+# -- liveconfig.py -----------------------------------------------------------
+
+def extract_liveconfig(liveconfig_py: pathlib.Path, rel: str) -> dict:
+    tree = _parse(liveconfig_py)
+    cls = _class(tree, "ConfigState", liveconfig_py)
+    prepare = _method(cls, "_prepare", liveconfig_py)
+    commit = _method(cls, "_commit", liveconfig_py)
+    adopt = _method(cls, "_adopt", liveconfig_py)
+
+    # Statement order inside _commit: the serving gate must flip BEFORE
+    # the rebase exports the old table (DESIGN.md §13 — the over-
+    # admission epsilon depends on it). Compare first-occurrence lines.
+    gate_line = rebase_line = None
+    for node in ast.walk(commit):
+        if (gate_line is None and isinstance(node, ast.Assign)
+                and any("self.rules[" in _src(t) for t in node.targets)):
+            gate_line = node.lineno
+        if (rebase_line is None and isinstance(node, ast.Await)
+                and "_rebase_state" in _src(node)):
+            rebase_line = node.lineno
+    gate_first = (gate_line is not None and rebase_line is not None
+                  and gate_line < rebase_line)
+
+    return {
+        "prepare_stale_guard": _find_fact(
+            prepare, rel, "version <= self.version", "StaleConfigError",
+            node_type=ast.If),
+        "prepare_conflict_guard": _find_fact(
+            prepare, rel, "staged != rule", node_type=ast.If),
+        "commit_idempotent_guard": _find_fact(
+            commit, rel, "version <= self.version", node_type=ast.If),
+        "adopt_stale_guard": _find_fact(
+            adopt, rel, "version <= self.version", node_type=ast.If),
+        "commit_gate_first": Fact(gate_first, rel,
+                                  gate_line or commit.lineno),
+    }
+
+
+# -- reservations.py ---------------------------------------------------------
+
+def extract_reservations(reservations_py: pathlib.Path, rel: str) -> dict:
+    tree = _parse(reservations_py)
+    cls = _class(tree, "ReservationLedger", reservations_py)
+    reserve = _method(cls, "reserve", reservations_py)
+    settle = _method(cls, "settle", reservations_py)
+    restore = _method(cls, "restore_rows", reservations_py)
+    return {
+        "reserve_dedup": _find_fact(
+            reserve, rel, "self._duplicate_reserve("),
+        "settle_dedup": _find_fact(
+            settle, rel, "self._settled.get(rid)"),
+        "restore_skip_known": _find_fact(
+            restore, rel, "rid in self._entries", "rid in self._settled",
+            node_type=ast.If),
+        "debt_tag_dedup": _find_fact(
+            restore, rel, "(tag, tenant) in seen", node_type=ast.If),
+    }
+
+
+# -- resilience.py: the breaker transition table -----------------------------
+
+_STATE_NAMES = {"CLOSED": "closed", "OPEN": "open",
+                "HALF_OPEN": "half_open"}
+
+
+def _breaker_edges_in(fn: ast.AST, event: str
+                      ) -> "set[tuple[str, str, str]]":
+    """Every ``self._transition(self.X)`` call with the nearest
+    enclosing ``self._state == self.Y`` condition as the source state
+    (``*`` when unconditioned — e.g. ``allow``'s OPEN->HALF_OPEN flip
+    happens after the state was already tested by the surrounding
+    branch structure)."""
+    edges: set[tuple[str, str, str]] = set()
+
+    def walk(node: ast.AST, ctx: str) -> None:
+        if isinstance(node, ast.If):
+            new_ctx = ctx
+            text = _src(node.test)
+            for const, name in _STATE_NAMES.items():
+                if f"self._state == self.{const}" in text:
+                    new_ctx = name
+            for child in node.body:
+                walk(child, new_ctx)
+            for child in node.orelse:
+                walk(child, ctx)
+            return
+        if isinstance(node, ast.Call) and \
+                _src(node.func).endswith("._transition") and node.args:
+            target = _src(node.args[0])
+            for const, name in _STATE_NAMES.items():
+                if f"self.{const}" == target:
+                    edges.add((ctx, event, name))
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, ctx)
+
+    walk(fn, "*")
+    return edges
+
+
+def extract_breaker(resilience_py: pathlib.Path, rel: str) -> dict:
+    tree = _parse(resilience_py)
+    cls = _class(tree, "CircuitBreaker", resilience_py)
+    allow = _method(cls, "allow", resilience_py)
+    succ = _method(cls, "record_success", resilience_py)
+    fail = _method(cls, "record_failure", resilience_py)
+    edges = (_breaker_edges_in(allow, "timeout")
+             | _breaker_edges_in(succ, "success")
+             | _breaker_edges_in(fail, "failure"))
+    # The single-probe guard: allow() must answer reject while a probe
+    # is in flight; the reclaim guard: ONLY inside its recovery window
+    # (an abandoned slot frees itself — no reject-forever wedge). Both
+    # match If CONDITIONS: the same attribute names appear as plain
+    # assignments elsewhere in allow(), which must not keep the facts
+    # alive after the guards are deleted.
+    single = _find_if_test(allow, rel, "self._probe_inflight")
+    reclaim = _find_if_test(allow, rel, "self._probe_started")
+    return {
+        "breaker_edges": frozenset(edges),
+        "breaker_single_probe_guard": single,
+        "breaker_probe_reclaim": reclaim,
+    }
+
+
+# -- the one entry point -----------------------------------------------------
+
+def extract_facts(root: pathlib.Path) -> Facts:
+    pkg = root / "distributedratelimiting" / "redis_tpu"
+    remote = pkg / "runtime" / "remote.py"
+    placement = pkg / "runtime" / "placement.py"
+    liveconfig = pkg / "runtime" / "liveconfig.py"
+    reservations = pkg / "runtime" / "reservations.py"
+    resilience = pkg / "utils" / "resilience.py"
+
+    def rel(p: pathlib.Path) -> str:
+        try:
+            return str(p.resolve().relative_to(root.resolve()))
+        except ValueError:
+            return str(p)
+
+    idem, non_idem = extract_op_sets(remote)
+    return Facts(
+        idempotent_ops=idem,
+        non_idempotent_ops=non_idem,
+        remote_file=rel(remote),
+        **extract_placement(placement, rel(placement)),
+        **extract_liveconfig(liveconfig, rel(liveconfig)),
+        **extract_reservations(reservations, rel(reservations)),
+        **extract_breaker(resilience, rel(resilience)),
+        breaker_file=rel(resilience),
+    )

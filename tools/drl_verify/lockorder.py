@@ -1,0 +1,516 @@
+"""Cross-language lock-order analyzer (the second drl-verify leg).
+
+Builds ONE static lock-acquisition graph spanning both halves of the
+stack and fails on cycles:
+
+- **Python** (``distributedratelimiting/``): every ``with`` /
+  ``async with`` on a lock-shaped expression (``*lock*``, ``*gate*``)
+  is an acquisition; a lock's identity is ``py:<Class>.<attr>`` (or
+  ``py:<module>.<name>`` for locals). While a lock is held, lexically
+  nested acquisitions AND calls to functions that themselves acquire a
+  lock at top level become edges. Call resolution is deliberately
+  conservative — noisy resolution would drown real cycles in
+  same-name coincidences:
+
+  - ``self.method(...)`` resolves within the caller's class hierarchy
+    (its own class and AST-visible ancestors); a resolved callee that
+    takes the SAME attribute the caller already holds is same-object
+    re-entrancy (the RLock pattern ``now_ticks_checked`` uses), not an
+    ordering edge.
+  - other calls resolve by bare name only when exactly ONE class in
+    the corpus defines a lock-acquiring method of that name
+    (``pull``/``push`` -> the placement control lock, ``announce`` ->
+    the config lock, ...); ambiguous names contribute no edge.
+  - calls to ``fe_*``/``dir_*`` ABI entry points bridge into the C
+    half: the edge targets whatever lock classes that C function
+    takes.
+
+- **C** (``native/frontend.cc``): lock classes are identified by the
+  mutex TYPE in ``std::lock_guard<T>`` / ``std::unique_lock<T>``
+  declarations (``c:FeMutex`` is the shard connection mutex,
+  ``c:T0SpinMutex`` the tier-0 slice lock) — renaming a guard variable
+  cannot blind the extractor. A guard is held to the end of its brace
+  block; a guard declared while another is live is an edge. Call edges
+  propagate one hop, so a handler holding the shard mutex that calls
+  ``t0_local_try`` (takes the slice lock) yields the documented
+  ``FeMutex -> T0SpinMutex`` order.
+
+Second rule, same scan: the ``fe_t0_retire`` all-slices combined
+section — the ONE place multiple slice locks are held together — must
+take them in canonical container order (forward iteration over the
+partition vector). A reversed sweep, a *second* multi-slice section
+anywhere else, or a scalar nested same-class acquisition fails
+``slice-sweep-order``: two combined sections with different orders is
+exactly how the shard-vs-pump deadlock would ship.
+
+Findings reuse drl-check's :class:`Finding` (file:line on every edge
+of a reported cycle)."""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.drl_check.common import Finding, iter_py_files, rel
+
+__all__ = ["check", "build_graph", "LockGraph", "py_summaries",
+           "py_summaries_from_source", "c_lock_summaries"]
+
+_LOCKISH = ("lock", "gate")
+
+
+class LockGraph:
+    """Nodes are lock identities; edges carry provenance."""
+
+    def __init__(self) -> None:
+        self.nodes: set[str] = set()
+        #: (src, dst) -> (file, line, note)
+        self.edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+    def add(self, src: str, dst: str, file: str, line: int,
+            note: str) -> None:
+        if src == dst:
+            return
+        self.nodes.add(src)
+        self.nodes.add(dst)
+        self.edges.setdefault((src, dst), (file, line, note))
+
+    def cycles(self) -> "list[list[str]]":
+        """Every elementary cycle, canonicalized (rotation-minimal,
+        found from its minimal node only). The graph is tiny; simple
+        DFS is plenty."""
+        adj: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        out: list[list[str]] = []
+
+        def dfs(start: str, node: str, path: list[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    out.append(path[:])
+                elif nxt not in path and nxt > start:
+                    dfs(start, nxt, path + [nxt])
+
+        for node in sorted(adj):
+            dfs(node, node, [node])
+        return out
+
+
+# ===========================================================================
+# Python half
+# ===========================================================================
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _is_lockish(text: str) -> bool:
+    low = text.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+class _PyFn:
+    """Per-function lock summary."""
+
+    def __init__(self, qualname: str, cls: "str | None",
+                 module: str) -> None:
+        self.qualname = qualname
+        self.cls = cls
+        self.module = module
+        self.name = qualname.rsplit(".", 1)[-1]
+        #: top-level acquisitions: (lock_id, attr_name, file, line)
+        self.direct: "list[tuple[str, str, str, int]]" = []
+        #: (outer_lock_id, inner_lock_id, file, line)
+        self.held_acquires: "list[tuple[str, str, str, int]]" = []
+        #: (outer_lock_id, outer_attr, callee, selfcall, file, line)
+        self.held_calls: "list[tuple[str, str, str, bool, str, int]]" \
+            = []
+
+
+class _PyVisitor(ast.NodeVisitor):
+    def __init__(self, module: str, file: str) -> None:
+        self.module = module
+        self.file = file
+        self.cls: "str | None" = None
+        self.fns: "list[_PyFn]" = []
+        self.bases: "dict[str, list[str]]" = {}
+        self._fn: "_PyFn | None" = None
+        self._held: "list[tuple[str, str]]" = []   # (lock_id, attr)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self.cls = self.cls, node.name
+        self.bases[node.name] = [
+            b.id if isinstance(b, ast.Name) else _expr_text(b)
+            .rsplit(".", 1)[-1]
+            for b in node.bases]
+        self.generic_visit(node)
+        self.cls = prev
+
+    def _visit_fn(self, node) -> None:
+        prev_fn, prev_held = self._fn, self._held
+        qual = (f"{self.cls}.{node.name}" if self.cls else node.name)
+        self._fn = _PyFn(qual, self.cls, self.module)
+        self._held = []
+        self.fns.append(self._fn)
+        self.generic_visit(node)
+        self._fn, self._held = prev_fn, prev_held
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _lock_of(self, text: str) -> "tuple[str, str]":
+        attr = text.split(".")[-1].split("(")[0]
+        scope = (self.cls if text.startswith("self.") and self.cls
+                 else self.module)
+        return f"py:{scope}.{attr}", attr
+
+    def _visit_with(self, node) -> None:
+        fn = self._fn
+        locks = []
+        for item in node.items:
+            text = _expr_text(item.context_expr)
+            if _is_lockish(text):
+                locks.append(self._lock_of(text))
+        if fn is None or not locks:
+            self.generic_visit(node)
+            return
+        for lk, attr in locks:
+            if self._held:
+                fn.held_acquires.append(
+                    (self._held[-1][0], lk, self.file, node.lineno))
+            else:
+                fn.direct.append((lk, attr, self.file, node.lineno))
+        self._held.extend(locks)
+        for child in node.body:
+            self.visit(child)
+        del self._held[len(self._held) - len(locks):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._fn is not None and self._held:
+            name, selfcall = "", False
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+                selfcall = (isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self")
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name:
+                outer_id, outer_attr = self._held[-1]
+                self._fn.held_calls.append(
+                    (outer_id, outer_attr, name, selfcall,
+                     self.file, node.lineno))
+        self.generic_visit(node)
+
+
+def py_summaries_from_source(source: str, module: str, file: str
+                             ) -> "tuple[list, dict]":
+    v = _PyVisitor(module, file)
+    v.visit(ast.parse(source))
+    return v.fns, v.bases
+
+
+def py_summaries(root: pathlib.Path) -> "tuple[list, dict]":
+    fns: list = []
+    bases: dict = {}
+    for py in iter_py_files(root / "distributedratelimiting"):
+        try:
+            f, b = py_summaries_from_source(py.read_text(), py.stem,
+                                            rel(py, root))
+        except SyntaxError:
+            continue
+        fns.extend(f)
+        bases.update(b)
+    return fns, bases
+
+
+def _ancestors(cls: str, bases: "dict[str, list[str]]") -> "set[str]":
+    out, todo = {cls}, list(bases.get(cls, ()))
+    while todo:
+        b = todo.pop()
+        if b not in out:
+            out.add(b)
+            todo.extend(bases.get(b, ()))
+    return out
+
+
+# ===========================================================================
+# C half
+# ===========================================================================
+
+_C_SIG_RE = re.compile(
+    r"^[A-Za-z_][\w:<>,\*&\s]*?\b([A-Za-z_]\w*)\s*\($")
+_C_GUARD_RE = re.compile(
+    r"std::(?:lock_guard|unique_lock)\s*<\s*([A-Za-z_]\w*)\s*>")
+_C_VEC_GUARD_RE = re.compile(
+    r"std::vector\s*<\s*std::unique_lock\s*<\s*([A-Za-z_]\w*)\s*>")
+_C_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+
+
+class _CFn:
+    def __init__(self, name: str, line: int) -> None:
+        self.name = name
+        self.line = line
+        self.direct: "list[tuple[str, int]]" = []      # (class, line)
+        self.held_acquires: "list[tuple[str, str, int]]" = []
+        self.held_calls: "list[tuple[str, str, int]]" = []
+        self.multi: "list[tuple[str, int, str]]" = []  # combined sects
+
+
+def c_lock_summaries(cc: pathlib.Path) -> "dict[str, _CFn]":
+    """Scan one C++ translation unit: function extents by brace depth
+    (multi-line signatures included), guard declarations by mutex
+    type, combined (vector-of-unique_lock) sections with their loop
+    source text."""
+    out: dict[str, _CFn] = {}
+    depth = 0
+    base_depth = 0   # open `namespace {` / `extern "C" {` wrappers
+    fn: "_CFn | None" = None
+    pending: "tuple[str, int] | None" = None   # (name, line) pre-'{'
+    held: "list[tuple[str, int]]" = []   # (class, depth at declaration)
+    vec_types: dict[str, str] = {}       # vector var name -> class
+    last_for = ""    # most recent loop header (sweep-order evidence)
+    for lineno, raw in enumerate(cc.read_text().splitlines(), 1):
+        line = raw.split("//")[0]
+        stripped = line.strip()
+        if fn is None and depth == base_depth and "{" in stripped \
+                and (stripped.startswith("namespace")
+                     or stripped.startswith('extern "C"')):
+            base_depth += 1
+        elif fn is None and depth == base_depth:
+            # A signature may span lines: remember the name at the
+            # opening paren, arm the function body at the first '{'
+            # (unless a ';' lands first — that was a prototype).
+            if pending is None and stripped and "(" in stripped \
+                    and not stripped.startswith(("#", "}",
+                                                 "namespace")):
+                m = _C_SIG_RE.match(re.sub(r"\(.*$", "(", stripped))
+                if m and "=" not in stripped.split("(")[0]:
+                    pending = (m.group(1), lineno)
+            if pending is not None:
+                brace, semi = line.find("{"), line.find(";")
+                if brace >= 0 and (semi < 0 or brace < semi):
+                    fn = _CFn(pending[0], pending[1])
+                    pending = None
+                elif semi >= 0:
+                    pending = None
+        if fn is not None:
+            if re.search(r"\bfor\s*\(", line):
+                last_for = stripped
+            m = _C_VEC_GUARD_RE.search(line)
+            if m:
+                var = line.split(">")[-1].strip().rstrip(";").split(
+                    " ")[-1]
+                vec_types[var] = m.group(1)
+            for var, klass in list(vec_types.items()):
+                if f"{var}.emplace_back" in line \
+                        or f"{var}.push_back" in line:
+                    # Evidence = the acquiring line PLUS its enclosing
+                    # loop header (a reversed iterator usually lives in
+                    # the `for (...)`, not on the emplace line).
+                    src = raw.strip()
+                    if last_for and last_for not in src:
+                        src = f"{last_for} | {src}"
+                    fn.multi.append((klass, lineno, src))
+            m = _C_GUARD_RE.search(line)
+            if m and "vector" not in line:
+                klass = m.group(1)
+                if held:
+                    fn.held_acquires.append(
+                        (held[-1][0], klass, lineno))
+                else:
+                    fn.direct.append((klass, lineno))
+                # Declaration depth includes braces OPENED EARLIER ON
+                # THIS LINE: `if (x) { std::lock_guard<M> g(m); }`
+                # lives one level deeper than the line's start, so the
+                # net-zero brace count releases it at end of line
+                # instead of holding it for the rest of the function.
+                prefix = line[:m.start()]
+                held.append((klass, depth + prefix.count("{")
+                             - prefix.count("}")))
+            if held:
+                for cm in _C_CALL_RE.finditer(line):
+                    name = cm.group(1)
+                    if name not in ("lock_guard", "unique_lock",
+                                    "vector", "emplace_back",
+                                    "push_back"):
+                        fn.held_calls.append(
+                            (held[-1][0], name, lineno))
+        depth += line.count("{") - line.count("}")
+        base_depth = min(base_depth, max(depth, 0))
+        held = [(c, d) for c, d in held if d <= depth]
+        if fn is not None and depth <= base_depth:
+            out.setdefault(fn.name, fn)
+            fn = None
+            held = []
+            vec_types = {}
+            last_for = ""
+    if fn is not None:
+        out.setdefault(fn.name, fn)
+    return out
+
+
+# ===========================================================================
+# the combined graph + rules
+# ===========================================================================
+
+def build_graph(root: pathlib.Path,
+                frontend: "pathlib.Path | None" = None,
+                py_fns: "list | None" = None,
+                py_bases: "dict | None" = None
+                ) -> "tuple[LockGraph, dict]":
+    frontend = frontend or (root / "native" / "frontend.cc")
+    if py_fns is None:
+        py_fns, py_bases = py_summaries(root)
+    py_bases = py_bases or {}
+    c_fns = c_lock_summaries(frontend) if frontend.exists() else {}
+    c_file = rel(frontend, root)
+
+    graph = LockGraph()
+    #: bare name -> lock-acquiring functions (for call resolution).
+    by_name: dict[str, list] = {}
+    for fn in py_fns:
+        if fn.direct:
+            by_name.setdefault(fn.name, []).append(fn)
+
+    for fn in py_fns:
+        for lk, _attr, _f, _ln in fn.direct:
+            graph.nodes.add(lk)
+        for outer, inner, f, ln in fn.held_acquires:
+            graph.add(outer, inner, f, ln,
+                      f"nested acquisition in {fn.qualname}")
+        for outer, outer_attr, callee, selfcall, f, ln in \
+                fn.held_calls:
+            if callee.startswith(("fe_", "dir_")) and callee in c_fns:
+                cfn = c_fns[callee]
+                for klass, cl in cfn.direct:
+                    graph.add(outer, f"c:{klass}", f, ln,
+                              f"{fn.qualname} calls {callee} (takes "
+                              f"{klass} at {c_file}:{cl})")
+                for klass, cl, _src in cfn.multi:
+                    graph.add(outer, f"c:{klass}", f, ln,
+                              f"{fn.qualname} calls {callee} "
+                              f"(all-slices section at {c_file}:{cl})")
+                continue
+            targets = by_name.get(callee, ())
+            if selfcall:
+                # Resolve inside the class hierarchy; a callee taking
+                # the SAME attribute is same-object re-entrancy (the
+                # RLock pattern), not an ordering edge.
+                hierarchy = _ancestors(fn.cls or "", py_bases)
+                targets = [t for t in targets
+                           if t.cls in hierarchy]
+            elif len({t.cls or t.module for t in targets}) != 1:
+                continue   # ambiguous bare name: no edge
+            for target in targets:
+                if target is fn:
+                    continue
+                for lk, attr, tf, tl in target.direct:
+                    if selfcall and attr == outer_attr:
+                        continue
+                    graph.add(outer, lk, f, ln,
+                              f"{fn.qualname} calls "
+                              f"{target.qualname} (takes {lk} at "
+                              f"{tf}:{tl})")
+
+    for name, cfn in c_fns.items():
+        for klass, _ln in cfn.direct:
+            graph.nodes.add(f"c:{klass}")
+        for outer, inner, ln in cfn.held_acquires:
+            graph.add(f"c:{outer}", f"c:{inner}", c_file, ln,
+                      f"nested acquisition in {name}()")
+        for outer, callee, ln in cfn.held_calls:
+            target = c_fns.get(callee)
+            if target is None or target is cfn:
+                continue
+            for klass, tl in target.direct:
+                graph.add(f"c:{outer}", f"c:{klass}", c_file, ln,
+                          f"{name}() calls {callee}() (takes {klass} "
+                          f"at {c_file}:{tl})")
+            for klass, tl, _src in target.multi:
+                graph.add(f"c:{outer}", f"c:{klass}", c_file, ln,
+                          f"{name}() calls {callee}() (all-slices "
+                          f"section at {c_file}:{tl})")
+    return graph, c_fns
+
+
+def check_graph(graph: LockGraph) -> "list[Finding]":
+    findings: list[Finding] = []
+    for cyc in graph.cycles():
+        related = []
+        for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+            f, ln, note = graph.edges[(a, b)]
+            related.append((f, ln, f"{a} -> {b}: {note}"))
+        f0, l0, _ = related[0]
+        findings.append(Finding(
+            "lock-cycle",
+            "lock acquisition cycle: " + " -> ".join(cyc + [cyc[0]])
+            + " — two paths taking these locks in opposite order "
+            "deadlock under contention",
+            f0, l0, tuple(related)))
+    return findings
+
+
+#: THE sanctioned all-slices combined section (named, not inferred
+#: from file order): the fe_t0_retire config-retire sweep. Any other
+#: multi-slice section is a finding — even if fe_t0_retire's own
+#: sweep was refactored away meanwhile.
+SANCTIONED_SWEEP = "fe_t0_retire"
+
+
+def check_sweeps(c_fns: "dict[str, _CFn]",
+                 c_file: str) -> "list[Finding]":
+    findings: list[Finding] = []
+    multi_sites = [(name, klass, ln, src)
+                   for name, cfn in c_fns.items()
+                   for klass, ln, src in cfn.multi]
+    for name, klass, ln, src in multi_sites:
+        if re.search(r"rbegin|\brend\b|reverse", src):
+            findings.append(Finding(
+                "slice-sweep-order",
+                f"{name}() takes all {klass} slice locks in "
+                f"NON-canonical order ({src!r}) — the documented "
+                "all-slices sweep acquires in forward container "
+                "order; any second ordering deadlocks against it",
+                c_file, ln))
+    sanctioned = [(name, klass, ln, src)
+                  for name, klass, ln, src in multi_sites
+                  if name == SANCTIONED_SWEEP]
+    for name, klass, ln, _src in multi_sites:
+        if name == SANCTIONED_SWEEP:
+            continue
+        related = tuple(
+            (c_file, sl, f"the documented sweep: {sn}()")
+            for sn, _sk, sl, _ss in sanctioned)
+        findings.append(Finding(
+            "slice-sweep-order",
+            f"{name}() holds multiple {klass} slice locks combined "
+            f"— only the documented {SANCTIONED_SWEEP}() sweep may "
+            "do this; a second multi-slice section can order-race "
+            "the first",
+            c_file, ln, related))
+    for name, cfn in c_fns.items():
+        for outer, inner, ln in cfn.held_acquires:
+            if outer == inner:
+                findings.append(Finding(
+                    "slice-sweep-order",
+                    f"{name}() acquires a second {inner} while one "
+                    "is already held — unordered multi-lock section "
+                    "outside the documented all-slices sweep",
+                    c_file, ln))
+    return findings
+
+
+def check(root: pathlib.Path,
+          frontend: "pathlib.Path | None" = None) -> "list[Finding]":
+    frontend = frontend or (root / "native" / "frontend.cc")
+    graph, c_fns = build_graph(root, frontend)
+    findings = check_graph(graph)
+    findings += check_sweeps(c_fns, rel(frontend, root))
+    return sorted(findings, key=lambda f: (f.rule, f.file, f.line))

@@ -1,0 +1,1014 @@
+"""The formal models — four interacting worlds built from extracted
+facts (:mod:`tools.drl_verify.extract`), explored exhaustively by
+:mod:`tools.drl_verify.explorer`.
+
+Each world is a small deterministic labeled transition system whose
+*behavior* is parameterized by the facts extracted from the live code:
+a guard the implementation dropped is a guard the model drops, and the
+exploration then produces the counterexample that guard existed to
+prevent. The adversarial scheduler is the action alphabet itself —
+message duplication (every ``dup_*`` label), loss (the scheduler simply
+never delivering), coordinator crash, handoff-window expiry,
+stale/conflicting control frames, and client traffic interleaved
+anywhere.
+
+Worlds and their invariants (names are the machine-readable contract —
+docs/DESIGN.md §19 maps each back to the prose it formalizes):
+
+- **migration** — the product of src/dst :class:`NodePlacementState`
+  machines, the exactly-once import ledger, a crash-able coordinator,
+  a reservation row riding the handoff, and a stale-mapped client:
+  ``no-double-admit``, ``epoch-monotonic``, ``idempotent-replay``,
+  ``abort-restores-old-epoch``, ``settle-dedup``,
+  ``res-survives-migration``, ``outstanding-conserved``,
+  ``same-epoch-map-immutable``.
+- **config** — one node's :class:`ConfigState` with a stale-cached
+  client; the commit's gate-flip and rebase are separate micro-steps
+  so traffic interleaves exactly where DESIGN.md §13's epsilon lives:
+  ``config-version-monotonic``, ``config-rebase-order``,
+  ``same-version-rule-immutable``, ``idempotent-replay``.
+- **reservation** — one :class:`ReservationLedger` with debt pay-down,
+  TTL expiry, and the migration export/restore lane with tagged debt
+  rows: ``settle-dedup``, ``debt-conserved``,
+  ``outstanding-conserved``, ``idempotent-replay``.
+- **breaker** — the :class:`CircuitBreaker` rebuilt from its extracted
+  transition table: ``breaker-single-probe``,
+  ``breaker-failure-never-closes``, ``breaker-opens-at-threshold``,
+  ``breaker-recloses``, ``breaker-no-wedge``.
+
+``idempotent-replay`` is the op-classification bridge: every op in the
+extracted ``_IDEMPOTENT_OPS`` must either be a pure read
+(:data:`READ_OPS`) or be covered by a ``dup_*`` action in some world
+(:data:`MODELED_OPS`); an op added to the set with no replay model is
+itself a violation (``idempotent-unmodeled``) — the set cannot grow
+past what has been verified.
+
+Token arithmetic is exact and tiny (CAP = 2, one envelope unit, no
+refill), which makes the over-admission bounds *equalities at the
+boundary*: the clean tree explores tight against them, and any dropped
+guard steps past. What the models deliberately do NOT cover (DESIGN.md
+§19): refill-rate interactions, the accepted init-on-miss self-heal
+over-admission of a crashed migration's never-exported keys (bounded
+separately per root), and wall-clock-dependent TTL arithmetic."""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from tools.drl_verify.extract import Facts
+
+__all__ = ["MigrationWorld", "ConfigWorld", "ReservationWorld",
+           "BreakerWorld", "READ_OPS", "MODELED_OPS", "all_worlds",
+           "unmodeled_idempotent_ops", "CAP", "ENV"]
+
+#: Idempotent ops that are pure reads — replay-safe by construction
+#: (their server handlers mutate nothing; the wire fuzz pins replies).
+READ_OPS = frozenset({"OP_PEEK", "OP_PING", "OP_METRICS",
+                      "OP_PLACEMENT"})
+
+#: Idempotent ops whose replay safety is *explored*: each maps to the
+#: world whose dup_* labels exercise it. Adding an op to
+#: _IDEMPOTENT_OPS without extending this table fails verification.
+MODELED_OPS = {
+    "OP_PLACEMENT_ANNOUNCE": "migration",
+    "OP_MIGRATE_PULL": "migration",
+    "OP_MIGRATE_PUSH": "migration",
+    "OP_CONFIG": "config",
+    "OP_RESERVE": "reservation",
+    "OP_SETTLE": "reservation",
+}
+
+
+def unmodeled_idempotent_ops(facts: Facts) -> "list[str]":
+    return sorted(op for op in facts.idempotent_ops
+                  if op not in READ_OPS and op not in MODELED_OPS)
+
+
+#: One key, CAP tokens, no refill: the over-admission bounds are then
+#: exact — see each world's ``_post_checks``.
+CAP = 2
+ENV = 1
+
+
+# ===========================================================================
+# Migration world
+# ===========================================================================
+
+MigState = namedtuple("MigState", [
+    "se", "de", "ce",        # adopted epochs: src, dst, client (0|1)
+    "h",                     # src handoff: None | (export, envelope_left)
+    "tomb",                  # src local-expiry tombstone for epoch 1
+    "applied",               # dst import ledger: applied batch ids
+    "acked",                 # coordinator's view of applied batches
+    "db", "sb",              # balances (-1 = no table entry yet)
+    "g",                     # total granted tokens
+    "eb",                    # envelope tokens minted (export episodes)
+    "co",                    # coordinator: idle|pulled|cdst|done|aborted
+    "att", "cr",             # attempt (1|2), coordinator crashed
+    "px",                    # coordinator's pulled bucket row (-1 = none)
+    "pr",                    # coordinator's pulled copy carries the res row
+    "rsrc", "rstash", "rdst",  # reservation row: src ledger/stash/dst
+    "ssrc", "sdst",          # settled-record flags per ledger
+    "rf", "og",              # refunds issued, dst outstanding gauge
+    "rff",                   # reservation stash forfeited (expiry abort)
+    "fresh", "res0",         # root flags: key untouched / res existed
+])
+
+
+class MigrationWorld:
+    """Product of the two placement machines under the adversarial
+    scheduler. Batch 0 of the handoff carries the bucket row, batch 1
+    the reservation row — so a partially-pushed, aborted, retried
+    migration exercises the exactly-once ledger the way PR 6's shipped
+    bug did."""
+
+    name = "migration"
+    invariants = ("no-double-admit", "epoch-monotonic",
+                  "idempotent-replay", "abort-restores-old-epoch",
+                  "settle-dedup", "res-survives-migration",
+                  "outstanding-conserved", "same-epoch-map-immutable")
+
+    def __init__(self, facts: Facts) -> None:
+        self.f = facts
+
+    def init_states(self):
+        # Roots cover every pre-migration traffic history: spent 0..CAP
+        # plus the never-touched key (init-on-miss at first acquire),
+        # each with and without an outstanding reservation.
+        for sb in list(range(CAP + 1)) + [-1]:
+            for res in (True, False):
+                yield MigState(
+                    se=0, de=0, ce=0, h=None, tomb=False,
+                    applied=frozenset(), acked=frozenset(),
+                    db=-1, sb=sb, g=(CAP - sb) if sb >= 0 else 0,
+                    eb=0, co="idle", att=1, cr=False, px=-1, pr=False,
+                    rsrc=res, rstash=False, rdst=False,
+                    ssrc=False, sdst=False, rf=0, og=0, rff=False,
+                    fresh=sb < 0, res0=res)
+
+    def _bound(self, s: MigState) -> int:
+        # Grants ≤ CAP + minted envelopes — DESIGN.md §12's epsilon
+        # with budget = ENV and no fill term. A never-touched key adds
+        # one accepted init-on-miss budget: a crashed migration whose
+        # handoff expired can re-mint the key's FIRST budget on both
+        # sides (nothing was exported, so nothing was debited — the
+        # reference's init-on-miss self-heal posture, documented as
+        # out of scope in DESIGN.md §19).
+        return CAP * (2 if s.fresh else 1) + s.eb
+
+    # -- action alphabet ----------------------------------------------------
+    def labels(self, s: MigState):
+        out = []
+        if not s.cr:
+            out.append("crash")
+            if s.co == "idle" and not s.tomb:
+                out.append("pull")
+            if s.co == "pulled":
+                for b in (0, 1):
+                    if b not in s.acked:
+                        out.append(f"push_{b}")
+                if s.acked == frozenset((0, 1)):
+                    out.append("commit_dst")
+                out.append("coord_abort")
+            if s.co == "cdst":
+                out.append("commit_src")
+            if s.co == "aborted" and s.att == 1:
+                out.append("retry")
+        if s.h is not None:
+            out.append("expire")
+        # Network duplication — the idempotent-replay probes.
+        if s.h is not None or s.tomb:
+            out.append("dup_pull")
+        for b in (0, 1):
+            if b in s.applied:
+                out.append(f"dup_push_{b}")
+        if s.de == 1:
+            out += ["dup_commit_dst", "twin_announce_dst"]
+        if s.se == 1:
+            out.append("dup_commit_src")
+        if s.se == 1 or s.de == 1:
+            out += ["stale_announce_src", "stale_announce_dst"]
+        # Client traffic: acquires, a placement refresh, settles
+        # (relayed — deliverable to either node) and their replays.
+        if s.g < self._bound(s) + 1:   # one step past the bound suffices
+            out.append("acquire")
+        if s.ce < s.de:
+            out.append("refresh")
+        if s.res0:
+            out += ["settle_src", "settle_dst"]
+            if s.ssrc:
+                out.append("dup_settle_src")
+            if s.sdst:
+                out.append("dup_settle_dst")
+        return out
+
+    # -- transition semantics ----------------------------------------------
+    def apply(self, s: MigState, label: str):
+        f = self.f
+        viols: list = []
+        before = s
+
+        def dup_changed(op: str, what: str) -> None:
+            viols.append((
+                "idempotent-replay",
+                f"replayed {op} frame changed state: {what} "
+                f"(classified idempotent at {f.remote_file}:"
+                f"{f.idempotent_ops.get(op, 0)})", op))
+
+        if label == "pull":
+            s = self._pull(s)._replace(
+                co="pulled", acked=frozenset())
+            if s.pr:
+                s = s._replace(rsrc=False, rstash=True)
+
+        elif label == "dup_pull":
+            # The coordinator ignores the dup reply, so px/pr keep the
+            # original pull's content — and an illegitimate re-mint
+            # does NOT grow the envelope bound (eb), so the grants it
+            # enables land past it.
+            if s.h is not None:
+                if not f.pull_cached:
+                    s = self._pull(s)._replace(px=s.px, pr=s.pr,
+                                               eb=s.eb)
+                    dup_changed("OP_MIGRATE_PULL",
+                                "re-exported instead of serving the "
+                                "cached handoff — a second source "
+                                "debit and envelope")
+            elif s.tomb:
+                if not f.pull_tombstone_guard:
+                    s = self._pull(s)._replace(px=s.px, pr=s.pr,
+                                               eb=s.eb, tomb=False)
+                    dup_changed("OP_MIGRATE_PULL",
+                                "re-exported after a local expiry "
+                                "abort (tombstone ignored) — the "
+                                "aborted export is charged again")
+
+        elif label.startswith("push_") or label.startswith("dup_push_"):
+            dup = label.startswith("dup_")
+            b = int(label[-1])
+            if b in s.applied:
+                if not f.push_dedup:
+                    s = self._apply_batch(s, b)
+                    if dup and s != before:
+                        dup_changed("OP_MIGRATE_PUSH",
+                                    f"batch {b} imported twice — the "
+                                    "(epoch, batch) dedup is gone")
+            else:
+                s = self._apply_batch(s, b)._replace(
+                    applied=s.applied | {b})
+            if not dup:
+                s = s._replace(acked=s.acked | {b})
+
+        elif label == "commit_dst":
+            s = s._replace(de=1, co="cdst")
+
+        elif label == "commit_src":
+            # Commit unparks: handoff dropped, stashed rows live at the
+            # destination now, tombstone cleared.
+            s = s._replace(se=1, h=None, rstash=False, tomb=False,
+                           co="done")
+            if s.res0 and not s.rff and not (s.ssrc or s.sdst) \
+                    and not s.rdst and not s.rsrc:
+                viols.append((
+                    "res-survives-migration",
+                    "migration committed but the outstanding "
+                    "reservation row reached no ledger — its settle "
+                    "answers 'unknown' and the hold is silently lost",
+                    "lost-row"))
+
+        elif label in ("dup_commit_dst", "dup_commit_src"):
+            pass  # same-epoch same-map re-announce: idempotent always
+
+        elif label in ("stale_announce_src", "stale_announce_dst"):
+            node = "se" if label.endswith("src") else "de"
+            if getattr(s, node) == 1 and not f.announce_stale_guard:
+                s = s._replace(**{node: 0})
+                dup_changed("OP_PLACEMENT_ANNOUNCE",
+                            "a stale epoch-0 announce was adopted "
+                            "over epoch 1")
+
+        elif label == "twin_announce_dst":
+            if not f.announce_conflict_guard:
+                viols.append((
+                    "same-epoch-map-immutable",
+                    "a conflicting placement map was adopted at an "
+                    "already-committed epoch — split-brain slot "
+                    "ownership (guard at "
+                    f"{f.announce_conflict_guard.file}:"
+                    f"{f.announce_conflict_guard.line} missing)",
+                    "twin"))
+                return None, viols
+
+        elif label == "coord_abort":
+            s = self._src_abort(s, viols, tombstone=False)
+            if f.abort_resets_push_ledger:
+                s = s._replace(applied=frozenset())
+            # The destination half of the abort: imported reservation
+            # rows leave the dst ledger again (their surviving home is
+            # the restored source stash / the retry's re-export).
+            if f.abort_drops_imported_res and s.rdst:
+                s = s._replace(rdst=False, og=max(0, s.og - 1))
+            s = s._replace(co="aborted")
+
+        elif label == "expire":
+            s = self._src_abort(s, viols, tombstone=True)
+
+        elif label == "retry":
+            s = s._replace(att=2, co="idle", acked=frozenset(),
+                           px=-1, pr=False)
+
+        elif label == "crash":
+            s = s._replace(cr=True)
+
+        elif label == "acquire":
+            s = self._acquire(s, viols)
+
+        elif label == "refresh":
+            s = s._replace(ce=max(s.ce, s.de))
+
+        elif label in ("settle_src", "settle_dst"):
+            s = self._settle(s, at_src=label.endswith("src"))
+
+        elif label in ("dup_settle_src", "dup_settle_dst"):
+            if not f.settle_dedup:
+                dup_changed("OP_SETTLE",
+                            "replayed settle answered 'unknown' "
+                            "instead of replaying the recorded "
+                            "reconciliation — the settled-rid record "
+                            "is gone")
+
+        else:  # pragma: no cover - label/apply drift is a checker bug
+            raise AssertionError(f"unknown label {label!r}")
+
+        self._post_checks(before, s, viols)
+        return s, viols
+
+    # -- helpers ------------------------------------------------------------
+    def _pull(self, s: MigState) -> MigState:
+        """Export + park + source debit (placement.pull): the envelope
+        is withheld from the export and stays as the source's
+        authoritative residual; an entry-less key exports no row."""
+        if s.sb < 0:
+            return s._replace(h=(-1, 0), px=-1, pr=s.rsrc)
+        env = min(ENV, s.sb)
+        return s._replace(h=(s.sb - env, env), sb=env,
+                          px=s.sb - env, pr=s.rsrc, eb=s.eb + env)
+
+    def _apply_batch(self, s: MigState, b: int) -> MigState:
+        if b == 0:
+            if s.px < 0:
+                return s  # no bucket row in this attempt's export
+            # Saturating import: a fresh key initializes full and
+            # CAP - export is debited away, landing exactly export.
+            db = s.px if s.db < 0 else max(0, s.db - (CAP - s.px))
+            return s._replace(db=db)
+        if s.pr:
+            if s.rdst or s.sdst:
+                if self.f.restore_skip_known:
+                    return s
+                return s._replace(og=s.og + 1)  # gauge double-count
+            return s._replace(rdst=True, og=s.og + 1)
+        return s
+
+    def _src_abort(self, s: MigState, viols: list,
+                   tombstone: bool) -> MigState:
+        had_stash = s.rstash
+        s = s._replace(h=None, tomb=tombstone)
+        if not had_stash:
+            return s
+        if tombstone:
+            # Expiry abort: the coordinator is presumed dead and the
+            # commit may already have reached the destination, so the
+            # FIXED code forfeits the stash (conservative — settles
+            # answer 'unknown'). The pre-fix code restored it, double-
+            # homing the rid: the model follows the extracted fact and
+            # the settle-dedup invariant catches the regression.
+            if self.f.expiry_abort_forfeits:
+                return s._replace(rstash=False, rff=True)
+            return s._replace(rstash=False, rsrc=True)
+        if self.f.abort_restores_reservations:
+            return s._replace(rstash=False, rsrc=True)
+        s = s._replace(rstash=False)
+        viols.append((
+            "abort-restores-old-epoch",
+            "coordinator abort dropped the handoff but did not "
+            "restore the exported reservation rows — the hold "
+            "vanished with the dead migration (restore at "
+            f"{self.f.abort_restores_reservations.file}:"
+            f"{self.f.abort_restores_reservations.line} missing)",
+            "res-stash"))
+        return s
+
+    def _acquire(self, s: MigState, viols: list) -> MigState:
+        if s.ce == 0:
+            if s.h is not None:                      # parked: envelope
+                export, env = s.h
+                if env > 0:
+                    return s._replace(h=(export, env - 1), g=s.g + 1)
+                return s
+            if s.se == 1:                            # moved: chase once
+                return s._replace(ce=1)
+            sb = CAP if s.sb < 0 else s.sb           # init-on-miss
+            if sb > 0:
+                return s._replace(sb=sb - 1, g=s.g + 1)
+            return s._replace(sb=sb)
+        if s.de == 1:
+            if s.db < 0:
+                # Init-on-miss at the NEW owner: legitimate only when
+                # the committed attempt exported no bucket row. If a
+                # row was exported and the destination still has no
+                # entry, the exactly-once import silently dropped it —
+                # the PR-6 over-admission bug class, caught here.
+                if s.px >= 0:
+                    viols.append((
+                        "no-double-admit",
+                        "destination served init-on-miss at full "
+                        "capacity for a key whose bucket row WAS "
+                        "exported — the import ledger silently "
+                        "dropped the retried batch (abort must reset "
+                        "the per-epoch dedup set: "
+                        f"{self.f.abort_resets_push_ledger.file}:"
+                        f"{self.f.abort_resets_push_ledger.line})",
+                        "dropped-import"))
+                return s._replace(db=CAP - 1, g=s.g + 1)
+            if s.db > 0:
+                return s._replace(db=s.db - 1, g=s.g + 1)
+            return s
+        return s
+
+    def _settle(self, s: MigState, at_src: bool) -> MigState:
+        if at_src:
+            if s.h is not None:        # parked: settle defers (retried)
+                return s
+            if s.se == 1:              # moved: client re-routes
+                return s._replace(ce=1)
+            if s.ssrc:
+                return s
+            if s.rsrc:
+                return s._replace(rsrc=False, ssrc=True, rf=s.rf + 1)
+            return s                   # unknown rid: counted no-op
+        # At dst: the placement gate rejects until dst owns the tenant.
+        if s.de != 1:
+            return s
+        if s.sdst:
+            return s
+        if s.rdst:
+            return s._replace(rdst=False, sdst=True, rf=s.rf + 1,
+                              og=max(0, s.og - 1))
+        return s
+
+    def _post_checks(self, old: MigState, new: MigState,
+                     viols: list) -> None:
+        if new.g > self._bound(new):
+            viols.append((
+                "no-double-admit",
+                f"granted {new.g} tokens against a bound of "
+                f"{self._bound(new)} (CAP {CAP} + envelopes {new.eb}"
+                f"{' + accepted first-touch budget' if new.fresh else ''}"
+                ") — DESIGN.md §12 envelope epsilon exceeded",
+                "bound"))
+        if new.se < old.se or new.de < old.de:
+            viols.append((
+                "epoch-monotonic",
+                "an observer's adopted placement epoch went backwards "
+                f"(src {old.se}->{new.se}, dst {old.de}->{new.de}); "
+                f"stale-announce guard at "
+                f"{self.f.announce_stale_guard.file}:"
+                f"{self.f.announce_stale_guard.line}", "epoch"))
+        if new.rf > 1:
+            viols.append((
+                "settle-dedup",
+                f"{new.rf} refunds issued for one reservation id — a "
+                "relayed/replayed settle reconciled twice", "refunds"))
+        rows = 1 if new.rdst else 0
+        if new.og != rows:
+            viols.append((
+                "outstanding-conserved",
+                f"destination outstanding gauge {new.og} != live rows "
+                f"{rows} — a re-delivered restore double-counted the "
+                "hold", "gauge"))
+
+
+# ===========================================================================
+# Config world
+# ===========================================================================
+
+CfgState = namedtuple("CfgState", [
+    "v",          # committed config version (0..2)
+    "staged",     # staged (version, rule) pairs, frozenset
+    "rules",      # committed forwarding map, tuple of (old, new)
+    "balA", "balB", "balC",  # -1 = table untouched (init-on-miss full)
+    "exported",   # tables whose rebase export ran, frozenset
+    "cph",        # mid-commit micro-phase: None | "gated" | "rebased"
+    "ccl",        # client's cached config
+    "g",          # total granted
+])
+
+_SNAP2 = (("A", "C"),)   # the v2 adopt snapshot's rule set
+
+
+class ConfigWorld:
+    """One node's ConfigState (two-phase mutation, adopt, the serving
+    gate) against a stale-cached client. ``commit1_a``/``commit1_b``
+    split the commit into its gate-flip and rebase halves in whichever
+    order the extracted ``commit_gate_first`` fact says the code runs
+    them — the adversary interleaves acquires in between."""
+
+    name = "config"
+    invariants = ("config-version-monotonic", "config-rebase-order",
+                  "same-version-rule-immutable", "idempotent-replay")
+
+    def __init__(self, facts: Facts) -> None:
+        self.f = facts
+
+    def init_states(self):
+        for spent in range(CAP + 1):
+            yield CfgState(v=0, staged=frozenset(), rules=(),
+                           balA=CAP - spent, balB=-1, balC=-1,
+                           exported=frozenset(), cph=None, ccl="A",
+                           g=spent)
+
+    def labels(self, s: CfgState):
+        if s.cph is not None:
+            return ["commit1_b", "acquire"]
+        out = []
+        if s.v == 0 and (1, "AB") not in s.staged:
+            out.append("prepare1")
+        if (1, "AB") in s.staged:
+            out += ["commit1_a", "abort1", "prepare_twin",
+                    "dup_prepare1"]
+        if s.v >= 1:
+            out += ["dup_commit1", "stale_adopt0", "stale_prepare1"]
+        if s.v < 2:
+            out.append("adopt2")
+        else:
+            out.append("dup_adopt2")
+        if s.g < CAP + 2:
+            out.append("acquire")
+        return out
+
+    def apply(self, s: CfgState, label: str):
+        f = self.f
+        viols: list = []
+        before = s
+
+        def dup_changed(what: str) -> None:
+            viols.append((
+                "idempotent-replay",
+                "replayed OP_CONFIG frame changed state: " + what +
+                f" (classified idempotent at {f.remote_file}:"
+                f"{f.idempotent_ops.get('OP_CONFIG', 0)})",
+                "OP_CONFIG"))
+
+        if label == "prepare1":
+            s = s._replace(staged=s.staged | {(1, "AB")})
+        elif label == "dup_prepare1":
+            pass  # same rule at same version: idempotent by contract
+        elif label == "stale_prepare1":
+            if not f.prepare_stale_guard:
+                s = s._replace(staged=s.staged | {(1, "AB")})
+                viols.append((
+                    "config-version-monotonic",
+                    "a stale prepare (version already committed past) "
+                    "was accepted instead of raising StaleConfigError "
+                    f"(guard at {f.prepare_stale_guard.file}:"
+                    f"{f.prepare_stale_guard.line})", "stale-prepare"))
+        elif label == "prepare_twin":
+            if not f.prepare_conflict_guard:
+                viols.append((
+                    "same-version-rule-immutable",
+                    "a conflicting rule was staged over an existing "
+                    "one at the same version — two coordinators' "
+                    "mutations silently merged (guard at "
+                    f"{f.prepare_conflict_guard.file}:"
+                    f"{f.prepare_conflict_guard.line})", "twin"))
+                return None, viols
+        elif label == "abort1":
+            s = s._replace(staged=s.staged - {(1, "AB")})
+        elif label == "commit1_a":
+            if s.v >= 1:
+                pass  # stale commit: version <= committed -> no-op
+            elif f.commit_gate_first:
+                s = s._replace(rules=s.rules + (("A", "B"),), v=1,
+                               staged=s.staged - {(1, "AB")},
+                               cph="gated")
+            else:
+                s = self._rebase(s)._replace(cph="rebased")
+        elif label == "commit1_b":
+            if s.cph == "gated":
+                s = self._rebase(s)._replace(cph=None)
+            else:
+                s = s._replace(rules=s.rules + (("A", "B"),), v=1,
+                               staged=s.staged - {(1, "AB")},
+                               cph=None)
+        elif label == "dup_commit1":
+            if not f.commit_idempotent_guard:
+                s = self._rebase(s)
+                if s != before:
+                    dup_changed("the rebase ran a second time")
+        elif label == "adopt2":
+            s = s._replace(v=2, rules=_SNAP2)
+        elif label == "dup_adopt2":
+            pass  # version <= committed: no-op
+        elif label == "stale_adopt0":
+            if not f.adopt_stale_guard:
+                s = s._replace(v=0, rules=())
+        elif label == "acquire":
+            s = self._acquire(s, viols)
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown label {label!r}")
+
+        if s.v < before.v:
+            viols.append((
+                "config-version-monotonic",
+                f"committed config version went backwards "
+                f"({before.v} -> {s.v}); adopt stale-guard at "
+                f"{f.adopt_stale_guard.file}:"
+                f"{f.adopt_stale_guard.line}", "version"))
+        return s, viols
+
+    def _rebase(self, s: CfgState) -> CfgState:
+        spent = CAP - (CAP if s.balA < 0 else s.balA)
+        balB = max(0, (CAP if s.balB < 0 else s.balB) - spent)
+        return s._replace(balB=balB, exported=s.exported | {"A"})
+
+    def _acquire(self, s: CfgState, viols: list) -> CfgState:
+        cfg = s.ccl
+        fwd = dict(s.rules)
+        seen = set()
+        while cfg in fwd and cfg not in seen:
+            seen.add(cfg)
+            cfg = fwd[cfg]
+        if cfg != s.ccl:
+            return s._replace(ccl=cfg)   # one chase, then cached
+        bal_field = "bal" + cfg
+        bal = getattr(s, bal_field)
+        bal = CAP if bal < 0 else bal
+        if bal <= 0:
+            return s._replace(**{bal_field: bal})
+        if cfg in s.exported:
+            viols.append((
+                "config-rebase-order",
+                f"a grant landed on retired table {cfg} AFTER its "
+                "balance was exported by the rebase — the spent carry "
+                "missed it (the gate must flip before the export; "
+                f"order fact at {self.f.commit_gate_first.file}:"
+                f"{self.f.commit_gate_first.line})", "rebase-order"))
+        return s._replace(**{bal_field: bal - 1, "g": s.g + 1})
+
+
+# ===========================================================================
+# Reservation world
+# ===========================================================================
+
+ResState = namedtuple("ResState", [
+    "out", "set_", "exp",    # row outstanding / settled-recorded / expired
+    "tb", "kb",              # tenant / key balances (0..CAP)
+    "debt", "dcre", "dcol",  # tenant debt, created, collected (0..3)
+    "og",                    # outstanding gauge
+    "stash", "dstash",       # exported row flag, exported debt amount
+    "tag_seen",              # tagged debt delivery seen at this ledger
+    "restored",              # a restore delivery has been processed
+    "rf",                    # refunds issued for the rid
+])
+
+
+class ReservationWorld:
+    """One ledger, one rid, estimate 1 token: reserve/settle/expire
+    with debt pay-down, plus the migration export/restore lane with
+    tagged debt rows and duplicate restore deliveries."""
+
+    name = "reservation"
+    invariants = ("settle-dedup", "debt-conserved",
+                  "outstanding-conserved", "idempotent-replay")
+
+    def __init__(self, facts: Facts) -> None:
+        self.f = facts
+
+    def init_states(self):
+        for tb in range(CAP + 1):
+            yield ResState(out=False, set_=False, exp=False,
+                           tb=tb, kb=CAP, debt=0, dcre=0, dcol=0,
+                           og=0, stash=False, dstash=0, tag_seen=False,
+                           restored=False, rf=0)
+
+    def labels(self, s: ResState):
+        out = ["reserve"]
+        if s.out:
+            out += ["settle_refund", "settle_debt", "expire"]
+        if s.out or s.debt:
+            if not s.stash and not s.dstash:
+                out.append("export")
+        if s.set_:
+            out.append("dup_settle")
+        if s.out or s.set_:
+            out.append("dup_reserve")
+        if s.stash or s.dstash:
+            out.append("restore")
+        if s.restored:
+            out.append("dup_restore")
+        return out
+
+    def apply(self, s: ResState, label: str):
+        f = self.f
+        viols: list = []
+
+        def dup_changed(op: str, what: str) -> None:
+            viols.append((
+                "idempotent-replay",
+                f"replayed {op} frame changed state: {what} "
+                f"(classified idempotent at {f.remote_file}:"
+                f"{f.idempotent_ops.get(op, 0)})", op))
+
+        if label in ("reserve", "dup_reserve"):
+            if s.out or s.set_:
+                if not f.reserve_dedup:
+                    ns = self._collect_debt(s)
+                    if ns.debt < 1 and ns.tb >= 1 and ns.kb >= 1:
+                        ns = ns._replace(tb=ns.tb - 1, kb=ns.kb - 1,
+                                         og=ns.og + 1)
+                    if ns != s:
+                        dup_changed("OP_RESERVE",
+                                    "the estimate was debited a second "
+                                    "time — the duplicate-rid probe is "
+                                    "gone")
+                    s = ns
+            else:
+                s = self._collect_debt(s)
+                if s.debt < 1 and s.tb >= 1 and s.kb >= 1:
+                    s = s._replace(tb=s.tb - 1, kb=s.kb - 1, out=True,
+                                   og=s.og + 1)
+        elif label == "settle_refund":
+            s = s._replace(out=False, og=s.og - 1, set_=True,
+                           rf=s.rf + 1, tb=min(CAP, s.tb + 1),
+                           kb=min(CAP, s.kb + 1))
+        elif label == "settle_debt":
+            s = s._replace(out=False, og=s.og - 1, set_=True,
+                           kb=max(0, s.kb - 1))
+            if s.tb >= 1:
+                s = s._replace(tb=s.tb - 1)
+            else:
+                s = s._replace(debt=min(3, s.debt + 1),
+                               dcre=min(3, s.dcre + 1))
+        elif label == "dup_settle":
+            if not f.settle_dedup:
+                dup_changed("OP_SETTLE",
+                            "replayed settle answered 'unknown' "
+                            "instead of replaying the recorded "
+                            "reconciliation")
+        elif label == "expire":
+            s = s._replace(out=False, og=s.og - 1, set_=True, exp=True)
+        elif label == "export":
+            s = s._replace(dstash=s.debt, debt=0)
+            if s.out:
+                s = s._replace(out=False, og=s.og - 1, stash=True)
+        elif label in ("restore", "dup_restore"):
+            dup = label == "dup_restore"
+            if s.stash or dup:
+                if s.out or s.set_:
+                    if not f.restore_skip_known:
+                        s = s._replace(og=s.og + 1)
+                elif s.stash:
+                    s = s._replace(out=True, og=s.og + 1)
+                s = s._replace(stash=False)
+            if s.dstash or dup:
+                if s.tag_seen:
+                    if not f.debt_tag_dedup and s.dstash:
+                        s = s._replace(debt=min(3, s.debt + s.dstash))
+                elif s.dstash:
+                    s = s._replace(debt=min(3, s.debt + s.dstash),
+                                   tag_seen=True)
+            if not dup:
+                s = s._replace(restored=True)
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown label {label!r}")
+
+        rows = 1 if s.out else 0
+        if s.og != rows:
+            viols.append((
+                "outstanding-conserved",
+                f"outstanding gauge {s.og} != live rows {rows} — a "
+                "re-delivered restore double-counted the hold "
+                f"(skip-known guard at {f.restore_skip_known.file}:"
+                f"{f.restore_skip_known.line})", "gauge"))
+        if s.rf > 1:
+            viols.append((
+                "settle-dedup",
+                f"{s.rf} refunds issued for one rid", "refunds"))
+        # Exported debt counts as in flight until its tagged delivery
+        # lands; later copies of the same tag are duplicates, not value.
+        if s.debt + (0 if s.tag_seen else s.dstash) \
+                != s.dcre - s.dcol:
+            viols.append((
+                "debt-conserved",
+                f"tenant debt {s.debt} (+{s.dstash} exported) != "
+                f"created {s.dcre} - collected {s.dcol} — a "
+                "re-delivered debt row applied twice (tag dedup at "
+                f"{f.debt_tag_dedup.file}:{f.debt_tag_dedup.line})",
+                "debt"))
+        return s, viols
+
+    def _collect_debt(self, s: ResState) -> ResState:
+        if s.debt >= 1:
+            pay = min(s.debt, s.tb)
+            s = s._replace(tb=s.tb - pay, debt=s.debt - pay,
+                           dcol=min(3, s.dcol + pay))
+        return s
+
+
+# ===========================================================================
+# Breaker world
+# ===========================================================================
+
+BrState = namedtuple("BrState", [
+    "st",     # closed | open | half_open
+    "fl",     # consecutive closed-state failures (0..THRESH)
+    "pi",     # probe slot held
+    "oa",     # ticks since opened (saturating)
+    "pa",     # ticks since probe granted (saturating)
+    "outp",   # unsettled probes outstanding (0..2)
+])
+
+THRESH = 2   # failure_threshold in the model
+TO = 2       # recovery_timeout in ticks
+
+
+class BreakerWorld:
+    """The breaker machine rebuilt from the extracted transition table:
+    the model takes exactly the edges the ``_transition`` call sites
+    encode, so a rewired transition is a rewired model — and a violated
+    contract."""
+
+    name = "breaker"
+    invariants = ("breaker-single-probe",
+                  "breaker-failure-never-closes",
+                  "breaker-opens-at-threshold", "breaker-recloses",
+                  "breaker-no-wedge")
+
+    def __init__(self, facts: Facts) -> None:
+        self.f = facts
+        self.edges = facts.breaker_edges
+
+    def init_states(self):
+        yield BrState(st="closed", fl=0, pi=False, oa=0, pa=0, outp=0)
+
+    def labels(self, s: BrState):
+        out = ["tick", "allow"]
+        if s.st == "closed":
+            out += ["fail", "success"]
+        if s.outp >= 1:
+            out += ["probe_success", "probe_failure", "probe_abandon"]
+        return out
+
+    def _edge(self, frm: str, event: str) -> "str | None":
+        for f, e, t in self.edges:
+            if e == event and f in (frm, "*"):
+                return t
+        return None
+
+    def apply(self, s: BrState, label: str):
+        f = self.f
+        viols: list = []
+
+        if label == "tick":
+            s = s._replace(oa=min(TO, s.oa + 1),
+                           pa=min(TO, s.pa + 1) if s.pi else s.pa)
+
+        elif label == "fail":
+            s = s._replace(fl=min(THRESH, s.fl + 1))
+            if s.fl >= THRESH:
+                to = self._edge("closed", "failure")
+                if to:
+                    s = s._replace(st=to, oa=0, fl=0)
+
+        elif label == "success":
+            s = s._replace(fl=0)
+
+        elif label == "allow":
+            if s.st == "open" and s.oa >= TO:
+                to = self._edge("open", "timeout")
+                if to:
+                    s = s._replace(st=to, pi=True, pa=0,
+                                   outp=min(2, s.outp + 1))
+            elif s.st == "half_open":
+                if not s.pi:
+                    s = s._replace(pi=True, pa=0,
+                                   outp=min(2, s.outp + 1))
+                elif not f.breaker_single_probe_guard:
+                    s = s._replace(pa=0, outp=min(2, s.outp + 1))
+                elif s.pa >= TO and f.breaker_probe_reclaim:
+                    # Abandoned slot reclaimed after a full recovery
+                    # window: the old holder is written off (its late
+                    # settle is out of model scope) and a new holder
+                    # probes — still one live probe per window.
+                    s = s._replace(pa=0, outp=1)
+
+        elif label == "probe_success":
+            s = s._replace(outp=s.outp - 1, pi=False)
+            if s.st == "half_open":
+                to = self._edge("half_open", "success")
+                if to:
+                    s = s._replace(st=to, fl=0)
+                else:
+                    viols.append((
+                        "breaker-recloses",
+                        "a successful half-open probe did not re-close "
+                        "the breaker — the node stays quarantined "
+                        "after proving healthy (transitions extracted "
+                        f"from {f.breaker_file})", "recloses"))
+
+        elif label == "probe_failure":
+            s = s._replace(outp=s.outp - 1, pi=False)
+            if s.st == "half_open":
+                to = self._edge("half_open", "failure")
+                if to:
+                    s = s._replace(st=to,
+                                   oa=0 if to == "open" else s.oa)
+                if to == "closed":
+                    viols.append((
+                        "breaker-failure-never-closes",
+                        "a FAILED half-open probe re-closed the "
+                        "breaker — traffic floods a node that just "
+                        "failed its health probe (transitions "
+                        f"extracted from {f.breaker_file})",
+                        "fail-close"))
+
+        elif label == "probe_abandon":
+            s = s._replace(outp=s.outp - 1)   # cancelled, never settled
+
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown label {label!r}")
+
+        if s.outp > 1:
+            viols.append((
+                "breaker-single-probe",
+                f"{s.outp} unsettled half-open probes in flight — the "
+                "single-probe admission gate is gone (guard at "
+                f"{f.breaker_single_probe_guard.file}:"
+                f"{f.breaker_single_probe_guard.line})", "probes"))
+        if s.fl >= THRESH and s.st == "closed":
+            viols.append((
+                "breaker-opens-at-threshold",
+                f"{THRESH} consecutive failures left the breaker "
+                "CLOSED — a dead node keeps eating traffic "
+                f"(transitions extracted from {f.breaker_file})",
+                "threshold"))
+        if s.st == "open" and s.oa >= TO \
+                and self._edge("open", "timeout") is None:
+            viols.append((
+                "breaker-no-wedge",
+                "recovery timeout elapsed but no OPEN -> HALF_OPEN "
+                "transition exists — the node is quarantined forever",
+                "open-wedge"))
+        if s.st == "half_open" and s.pi and s.outp == 0 \
+                and s.pa >= TO and not f.breaker_probe_reclaim:
+            viols.append((
+                "breaker-no-wedge",
+                "an abandoned probe slot is never reclaimed — allow() "
+                "answers reject forever (reclaim guard at "
+                f"{f.breaker_probe_reclaim.file}:"
+                f"{f.breaker_probe_reclaim.line})", "probe-wedge"))
+        return s, viols
+
+
+class ProductWorld:
+    """The asynchronous product of two worlds: every interleaving of
+    their action alphabets (``left:`` / ``right:`` label prefixes).
+    migration × config is the ISSUE-14 adversary 'concurrent reshape
+    AND live limit mutation': the exploration proves every invariant
+    of both machines holds under arbitrary interleaving of the other's
+    control plane — and it is where the state count earns the word
+    'product'."""
+
+    def __init__(self, left, right) -> None:
+        self.left, self.right = left, right
+        self.name = f"{left.name}x{right.name}"
+        self.invariants = tuple(dict.fromkeys(
+            left.invariants + right.invariants))
+
+    def init_states(self):
+        rights = list(self.right.init_states())
+        for ls in self.left.init_states():
+            for rs in rights:
+                yield (ls, rs)
+
+    def labels(self, s):
+        return ([f"left:{l}" for l in self.left.labels(s[0])]
+                + [f"right:{l}" for l in self.right.labels(s[1])])
+
+    def apply(self, s, label):
+        side, _, inner = label.partition(":")
+        if side == "left":
+            ns, viols = self.left.apply(s[0], inner)
+            return (None if ns is None else (ns, s[1])), viols
+        ns, viols = self.right.apply(s[1], inner)
+        return (None if ns is None else (s[0], ns)), viols
+
+
+def all_worlds(facts: Facts, *, include_product: bool = True) -> list:
+    worlds = [MigrationWorld(facts), ConfigWorld(facts),
+              ReservationWorld(facts), BreakerWorld(facts)]
+    if include_product:
+        worlds.append(ProductWorld(MigrationWorld(facts),
+                                   ConfigWorld(facts)))
+    return worlds

@@ -1,0 +1,567 @@
+"""Replay a drl-verify counterexample trace against the REAL code.
+
+This is the model-to-code bridge in the code direction: every action
+label a world can emit maps here to calls on the live implementation —
+:class:`NodePlacementState` pairs over :class:`InProcessBucketStore`
+(with real :class:`ReservationLedger` attachments), a real
+:class:`ConfigState`, a real :class:`CircuitBreaker` under a manual
+clock. A violation trace the model produced is replayed step for step
+and the harness asserts the same invariants on the real objects:
+
+- If the model's violation came from a *seeded divergence* (a mutated
+  source copy), the replay PASSES on the live tree — proving the live
+  code still carries the guard the mutant lost.
+- If the replay FAILS on the live tree, the model found a real defect
+  and the failing generated test is the regression test to promote
+  (the ISSUE-14 settle-dedup fix shipped exactly this way).
+
+The harness is intentionally wire-free: it drives the same objects the
+server dispatch drives, one async step at a time, with the placement
+gate applied the way ``server.py`` applies it. Unknown labels raise —
+a world/harness drift is a loud error, not a silently skipped step."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+__all__ = ["ReplayReport", "replay", "HARNESSES"]
+
+CAP = 2.0
+TCAP = 4.0   # tenant config must differ from the key config
+KEY = "drlv:key"
+TENANT = "drlv:tenant"
+RID = "drlv:rid"
+WINDOW_S = 5.0
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    ok: bool
+    detail: str
+    granted: int = 0
+    refunds: int = 0
+    steps: int = 0
+
+
+class _ManualClock:
+    """time.monotonic stand-in AND a store Clock (now_ticks)."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def now_ticks(self) -> int:
+        from distributedratelimiting.redis_tpu.ops import bucket_math
+
+        return int(self.t * bucket_math.TICKS_PER_SECOND)
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class MigrationHarness:
+    """src/dst NodePlacementState over InProcessBucketStores, one key
+    migrating from node 0 to node 1 at epoch 1, with a reservation row
+    riding the handoff and settles gated exactly like _serve_settle."""
+
+    def __init__(self) -> None:
+        from distributedratelimiting.redis_tpu.runtime.placement import (
+            NodePlacementState,
+            PlacementMap,
+            PlacementError,
+            StalePlacementError,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        self.PlacementError = PlacementError
+        self.StaleError = StalePlacementError
+        self.clock = _ManualClock()
+        self.src_store = InProcessBucketStore(clock=self.clock)
+        self.dst_store = InProcessBucketStore(clock=self.clock)
+        self.src_led = self.src_store.reservation_ledger(
+            clock=self.clock)
+        self.dst_led = self.dst_store.reservation_ledger(
+            clock=self.clock)
+        self.map0 = PlacementMap.initial(2)
+        # Pin the key to node 0 at epoch 0, node 1 at epoch 1 — the
+        # override route is exact regardless of the key's slot hash.
+        self.map0 = PlacementMap(0, self.map0.slot_owner,
+                                 {KEY: 0, TENANT: 0})
+        self.map1 = self.map0.with_assignments(
+            set_overrides={KEY: 1, TENANT: 1})
+        self.twin1 = self.map0.with_assignments(
+            set_overrides={KEY: 0, TENANT: 0})
+        self.src = NodePlacementState(clock=self.clock)
+        self.dst = NodePlacementState(clock=self.clock)
+        self.src.announce({"map": self.map0.to_dict(), "node_id": 0})
+        self.dst.announce({"map": self.map0.to_dict(), "node_id": 1})
+        self.client_epoch = 0
+        self.granted = 0
+        self.refunds = 0
+        self.envelope_minted = 0.0
+        self.pulled: "dict | None" = None   # coordinator's export copy
+        self.res_live = False
+
+    # -- setup driven by the trace's root ----------------------------------
+    async def prepare_root(self, root) -> None:
+        # root: MigState namedtuple — honor sb (pre-spent) and res0.
+        sb = getattr(root, "sb", CAP)
+        if sb >= 0:
+            spend = int(CAP - sb)
+            for _ in range(spend):
+                res = await self.src_store.acquire(KEY, 1, CAP, 0.0)
+                assert res.granted
+                self.granted += 1
+            if spend == 0:
+                # Touch the table so the entry exists at full balance.
+                await self.src_store.acquire(KEY, 0, CAP, 0.0)
+        if getattr(root, "res0", False):
+            res = await self.src_led.reserve(
+                RID, TENANT, KEY, 1.0, TCAP, 0.0, CAP, 0.0)
+            assert res.granted
+            self.res_live = True
+
+    # -- one action ---------------------------------------------------------
+    async def step(self, label: str) -> None:
+        if label in ("crash", "retry"):
+            return
+        if label in ("pull", "dup_pull"):
+            try:
+                reply = await self.src.pull(
+                    {"target_epoch": 1,
+                     "keys": [KEY, TENANT],
+                     "window_s": WINDOW_S}, self.src_store)
+            except self.PlacementError:
+                return  # tombstoned / stale: the routable error reply
+            if not reply.get("cached"):
+                # Each export episode mints one fair-share envelope for
+                # the key: headroom_budget(CAP, fraction) — the
+                # documented budget×episodes epsilon term, independent
+                # of the exported balance (placement.envelope_step).
+                self.envelope_minted += (
+                    CAP * self.src._fraction)
+            entries = dict(reply["entries"])
+            for page in range(1, reply["pages"]):
+                more = await self.src.pull(
+                    {"target_epoch": 1, "page": page}, self.src_store)
+                for k, v in more["entries"].items():
+                    entries.setdefault(k, [])
+                    entries[k] = list(entries[k]) + list(v)
+            if label == "pull" or self.pulled is None:
+                self.pulled = entries
+            return
+        if label.startswith("push_") or label.startswith("dup_push_"):
+            b = int(label[-1])
+            chunk = self._batch(b)
+            await self.dst.push({"target_epoch": 1, "batch": b,
+                                 "entries": chunk}, self.dst_store)
+            return
+        if label in ("commit_dst", "dup_commit_dst"):
+            self._announce(self.dst, self.map1, node_id=1)
+            return
+        if label in ("commit_src", "dup_commit_src"):
+            self._announce(self.src, self.map1, node_id=0)
+            return
+        if label == "coord_abort":
+            self.src.announce({"abort_epoch": 1})
+            self.dst.announce({"abort_epoch": 1})
+            return
+        if label == "expire":
+            self.clock.advance(WINDOW_S + 1.0)
+            self.src.gate(KEY)       # expiry fires on the next touch
+            self.src.gate(TENANT)
+            return
+        if label.startswith("stale_announce"):
+            node = self.src if label.endswith("src") else self.dst
+            self._announce(node, self.map0,
+                           node_id=0 if node is self.src else 1)
+            return
+        if label == "twin_announce_dst":
+            self._announce(self.dst, self.twin1, node_id=1)
+            return
+        if label == "acquire":
+            await self._acquire()
+            return
+        if label == "refresh":
+            if self.dst.epoch > self.client_epoch:
+                self.client_epoch = self.dst.epoch
+            return
+        if label.endswith("settle_src") or label.endswith("settle_dst"):
+            at_src = label.endswith("src")
+            await self._settle(self.src if at_src else self.dst,
+                               self.src_led if at_src else self.dst_led)
+            return
+        raise AssertionError(f"harness does not map label {label!r}")
+
+    def _announce(self, node, pmap, node_id: int) -> None:
+        try:
+            node.announce({"map": pmap.to_dict(), "node_id": node_id})
+        except self.StaleError:
+            pass  # the routable stale/conflict error reply
+
+    def _batch(self, b: int) -> dict:
+        entries = self.pulled or {}
+        if b == 0:
+            return {k: v for k, v in entries.items()
+                    if k not in ("reservations", "debts")}
+        return {k: v for k, v in entries.items()
+                if k in ("reservations", "debts")}
+
+    async def _acquire(self) -> None:
+        node = self.src if self.client_epoch == 0 else self.dst
+        store = (self.src_store if self.client_epoch == 0
+                 else self.dst_store)
+        verdict = node.gate(KEY)
+        if verdict is None:
+            res = await store.acquire(KEY, 1, CAP, 0.0)
+            if res.granted:
+                self.granted += 1
+            return
+        what, info = verdict
+        if what == "envelope":
+            granted, _rem = node.envelope_acquire(
+                info, KEY, 1, CAP, 0.0, "bucket")
+            if granted:
+                self.granted += 1
+            return
+        # Moved: chase to the OWNER the verdict names (node id == the
+        # epoch that owns in this two-node topology) — a pre-commit
+        # probe at dst answers moved-back-to-src, not moved-forward.
+        self.client_epoch = int(info)
+
+    async def _settle(self, node, led) -> None:
+        # Mirrors server._serve_settle: placement gate on the TENANT,
+        # parked -> deferral, moved -> reroute, else ledger settle.
+        verdict = node.gate(TENANT)
+        if verdict is not None:
+            what, info = verdict
+            if what == "moved":
+                self.client_epoch = int(info)   # follow the owner
+            return
+        res = await led.settle(RID, TENANT, 0.0)
+        if res.refunded > 0:
+            self.refunds += 1
+
+    # -- final assertions ----------------------------------------------------
+    def check(self) -> "list[str]":
+        problems = []
+        bound = CAP + self.envelope_minted
+        if self.granted > bound:
+            problems.append(
+                f"no-double-admit: granted {self.granted} > CAP + "
+                f"minted envelopes = {bound}")
+        if self.refunds > 1:
+            problems.append(
+                f"settle-dedup: {self.refunds} refunds issued for "
+                f"one rid across the src/dst ledgers")
+        for led in (self.src_led, self.dst_led):
+            live = led.outstanding_count()
+            gauge = sum(1 for v in led.outstanding_by_tenant().values()
+                        if v > 0)
+            if gauge > live:
+                problems.append(
+                    f"outstanding-conserved: gauge {gauge} > rows "
+                    f"{live}")
+        if self.src.epoch > 1 or self.dst.epoch > 1 \
+                or self.src.epoch < 0:
+            problems.append("epoch-monotonic: epoch out of range")
+        return problems
+
+
+class ReservationHarness:
+    """One real ReservationLedger over an InProcessBucketStore."""
+
+    def __init__(self) -> None:
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        self.clock = _ManualClock()
+        self.store = InProcessBucketStore(clock=self.clock)
+        self.led = self.store.reservation_ledger(clock=self.clock)
+        self.refunds = 0
+        self.stash: "tuple | None" = None
+
+    async def prepare_root(self, root) -> None:
+        tb = getattr(root, "tb", CAP)
+        spend = int(CAP - tb)
+        if spend:
+            await self.store.acquire(TENANT, spend, CAP, 0.0)
+
+    async def step(self, label: str) -> None:
+        led = self.led
+        if label in ("reserve", "dup_reserve"):
+            await led.reserve(RID, TENANT, KEY, 1.0, TCAP, 0.0,
+                              CAP, 0.0)
+            return
+        if label in ("settle_refund", "dup_settle"):
+            res = await led.settle(RID, TENANT, 0.0)
+            if res.refunded > 0:
+                self.refunds += 1
+            return
+        if label == "settle_debt":
+            await led.settle(RID, TENANT, 2.0)
+            return
+        if label == "expire":
+            self.clock.advance(led.default_ttl_s + 1.0)
+            led.expire()
+            return
+        if label == "export":
+            self.stash = led.export_rows(lambda t: True, tag="epoch:1")
+            return
+        if label in ("restore", "dup_restore"):
+            if self.stash is not None:
+                led.restore_rows(*self.stash)
+            return
+        raise AssertionError(f"harness does not map label {label!r}")
+
+    def check(self) -> "list[str]":
+        problems = []
+        if self.refunds > 1:
+            problems.append(
+                f"settle-dedup: {self.refunds} refunds for one rid")
+        led = self.led
+        if led.outstanding_count() != len(led._entries):
+            problems.append("outstanding-conserved: count drift")
+        gauge = led.outstanding_tokens()
+        true_rows = sum(e.reserved for e in led._entries.values())
+        if abs(gauge - true_rows) > 1e-9:
+            problems.append(
+                f"outstanding-conserved: gauge {gauge} != rows "
+                f"{true_rows}")
+        debt = sum(led.debts().values())
+        if debt - (led.debt_tokens_created
+                   - led.debt_tokens_collected) > 1e-9:
+            problems.append(
+                f"debt-conserved: debt {debt} > created "
+                f"{led.debt_tokens_created} - collected "
+                f"{led.debt_tokens_collected}")
+        return problems
+
+
+class ConfigHarness:
+    """One real ConfigState over an InProcessBucketStore; the model's
+    commit micro-steps (commit1_a/commit1_b) collapse into the single
+    real commit on the first of the pair."""
+
+    A = (2.0, 0.0)
+    B = (2.0, 1.0)
+    C = (2.0, 3.0)
+
+    def __init__(self) -> None:
+        from distributedratelimiting.redis_tpu.runtime.liveconfig import (
+            ConfigState,
+            ConfigRule,
+            StaleConfigError,
+            ConfigError,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        self.clock = _ManualClock()
+        self.store = InProcessBucketStore(clock=self.clock)
+        self.cs = ConfigState()
+        self.Rule = ConfigRule
+        self.errors = (StaleConfigError, ConfigError)
+        self.client_cfg = self.A
+        self.granted = 0
+        self.versions_seen = [0]
+        self._committed1 = False
+
+    async def prepare_root(self, root) -> None:
+        spend = int(CAP - getattr(root, "balA", CAP))
+        for _ in range(spend):
+            res = await self.store.acquire(KEY, 1, *self.A)
+            assert res.granted
+            self.granted += 1
+
+    async def _announce(self, payload) -> None:
+        try:
+            await self.cs.announce(payload, self.store)
+        except self.errors:
+            pass  # the routable error reply
+        self.versions_seen.append(self.cs.version)
+
+    async def step(self, label: str) -> None:
+        rule1 = {"kind": "bucket", "old": list(self.A),
+                 "new": list(self.B)}
+        twin = {"kind": "bucket", "old": list(self.A),
+                "new": list(self.C)}
+        if label in ("prepare1", "dup_prepare1"):
+            await self._announce({"prepare": rule1, "version": 1})
+        elif label == "stale_prepare1":
+            await self._announce({"prepare": rule1, "version": 1})
+        elif label == "prepare_twin":
+            await self._announce({"prepare": twin, "version": 1})
+        elif label == "abort1":
+            await self._announce({"abort": 1})
+        elif label == "commit1_a":
+            if not self._committed1:
+                self._committed1 = True
+                await self._announce({"commit": 1})
+        elif label == "commit1_b":
+            pass  # folded into commit1_a — the real commit is atomic
+        elif label == "dup_commit1":
+            await self._announce({"commit": 1})
+        elif label in ("adopt2", "dup_adopt2"):
+            await self._announce({"adopt": {
+                "version": 2,
+                "rules": [{"kind": "bucket", "old": list(self.A),
+                           "new": list(self.C), "version": 2}]}})
+        elif label == "stale_adopt0":
+            await self._announce({"adopt": {"version": 0, "rules": []}})
+        elif label == "acquire":
+            fwd = self.cs.forward("bucket", *self.client_cfg)
+            if fwd is not None:
+                self.client_cfg = (fwd[0], fwd[1])
+                return
+            res = await self.store.acquire(KEY, 1, *self.client_cfg)
+            if res.granted:
+                self.granted += 1
+        else:
+            raise AssertionError(
+                f"harness does not map label {label!r}")
+
+    def check(self) -> "list[str]":
+        problems = []
+        if any(b < a for a, b in zip(self.versions_seen,
+                                     self.versions_seen[1:])):
+            problems.append(
+                "config-version-monotonic: committed version went "
+                f"backwards along {self.versions_seen}")
+        if self.granted > CAP:
+            problems.append(
+                f"config-rebase-order: granted {self.granted} > "
+                f"CAP {CAP} across the rewrite chain")
+        return problems
+
+
+class BreakerHarness:
+    """One real CircuitBreaker under a manual clock. A model tick is
+    0.6 s against a 1.0 s recovery timeout (2 ticks elapse it, like
+    the model's TO = 2)."""
+
+    TICK = 0.6
+
+    def __init__(self) -> None:
+        from distributedratelimiting.redis_tpu.utils.resilience import (
+            BreakerConfig,
+            CircuitBreaker,
+        )
+
+        self.clock = _ManualClock()
+        self.br = CircuitBreaker(
+            BreakerConfig(failure_threshold=2, recovery_timeout_s=1.0,
+                          half_open_successes=1),
+            clock=self.clock)
+        self.outstanding = 0
+        self.problems: "list[str]" = []
+
+    async def prepare_root(self, root) -> None:
+        return None
+
+    async def step(self, label: str) -> None:
+        br = self.br
+        if label == "tick":
+            self.clock.advance(self.TICK)
+        elif label == "fail":
+            br.record_failure()
+            if br.state == "closed" and br._failures >= 2:
+                self.problems.append(
+                    "breaker-opens-at-threshold: threshold reached "
+                    "but state is closed")
+        elif label == "success":
+            br.record_success()
+        elif label == "allow":
+            verdict = br.allow()
+            if verdict == "probe":
+                self.outstanding += 1
+                if self.outstanding > 1:
+                    # The reclaim path writes the stale holder off.
+                    self.outstanding = 1
+        elif label == "probe_success":
+            if self.outstanding:
+                self.outstanding -= 1
+            was = self.br.state
+            br.record_success()
+            if was == "half_open" and br.state != "closed":
+                self.problems.append(
+                    "breaker-recloses: successful probe left state "
+                    f"{br.state}")
+        elif label == "probe_failure":
+            if self.outstanding:
+                self.outstanding -= 1
+            was = br.state
+            br.record_failure()
+            if was == "half_open" and br.state == "closed":
+                self.problems.append(
+                    "breaker-failure-never-closes: failed probe "
+                    "closed the breaker")
+        elif label == "probe_abandon":
+            if self.outstanding:
+                self.outstanding -= 1
+        else:
+            raise AssertionError(
+                f"harness does not map label {label!r}")
+
+    def check(self) -> "list[str]":
+        br = self.br
+        problems = list(self.problems)
+        # No-wedge: after a full recovery window, allow() must answer
+        # something other than reject.
+        self.clock.advance(2.0)
+        if br.allow() == "reject":
+            problems.append(
+                "breaker-no-wedge: allow() rejects after a full "
+                "recovery window")
+        return problems
+
+
+HARNESSES = {
+    "migration": MigrationHarness,
+    "reservation": ReservationHarness,
+    "config": ConfigHarness,
+    "breaker": BreakerHarness,
+}
+
+
+def replay(world: str, trace, root=None) -> ReplayReport:
+    """Replay ``trace`` (a list of action labels) for ``world`` against
+    the real implementation and evaluate the invariants. For product
+    worlds, ``left:``/``right:`` labels route to the two harnesses."""
+    if "x" in world and world not in HARNESSES:
+        lname, _, rname = world.partition("x")
+        left = HARNESSES[lname]()
+        right = HARNESSES[rname]()
+
+        async def run_product():
+            await left.prepare_root(root[0] if root else None)
+            await right.prepare_root(root[1] if root else None)
+            for label in trace:
+                side, _, inner = label.partition(":")
+                await (left if side == "left" else right).step(inner)
+            return left.check() + right.check()
+
+        problems = asyncio.run(run_product())
+        return ReplayReport(ok=not problems, detail="; ".join(problems),
+                            steps=len(trace))
+
+    h = HARNESSES[world]()
+
+    async def run():
+        await h.prepare_root(root)
+        for label in trace:
+            await h.step(label)
+        return h.check()
+
+    problems = asyncio.run(run())
+    return ReplayReport(
+        ok=not problems, detail="; ".join(problems) or "clean",
+        granted=getattr(h, "granted", 0),
+        refunds=getattr(h, "refunds", 0), steps=len(trace))
