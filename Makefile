@@ -9,10 +9,11 @@ CXX ?= g++
 
 .PHONY: check lint verify-model test native asan-test tsan-test \
         chaos-test reshard-soak upgrade-soak parity-fuzz llm-soak \
-        controller-soak reserve-soak
+        controller-soak reserve-soak federation-soak
 
 check: lint verify-model test chaos-test upgrade-soak parity-fuzz \
-       llm-soak controller-soak reserve-soak asan-test tsan-test
+       llm-soak controller-soak reserve-soak federation-soak \
+       asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -84,6 +85,18 @@ llm-soak:
 reserve-soak:
 	JAX_PLATFORMS=cpu DRL_RESERVE_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_reservations.py -v -p no:cacheprovider
+
+# Global quota federation soak: the seeded 3-region WAN-lease schedule
+# under chaos on the federation seams, with a full partition of one
+# region spanning > 2 lease periods (slice → monotonic expiry →
+# fair-share envelope), a home crash/restart off the v4 checkpoint
+# chain, and the Σ-regional-admits ≤ global cap + ε(RTT, lease_len)
+# differential audit (docs/OPERATIONS.md §16).
+# `make federation-soak SEED=...` replays any schedule bit-for-bit —
+# the chaos-test determinism contract.
+federation-soak:
+	JAX_PLATFORMS=cpu DRL_FEDERATION_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_federation.py -v -p no:cacheprovider
 
 # Autonomous control plane soak: the seeded diurnal + flash-crowd swing
 # driven against a live 3-node fleet under wire + controller.tick chaos

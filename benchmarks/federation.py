@@ -1,0 +1,235 @@
+"""The 3-region federation soak lane (ISSUE 15; ROADMAP item 5).
+
+Two tracked numbers for the WAN lease ledger
+(:mod:`~distributedratelimiting.redis_tpu.runtime.federation`):
+
+- ``local_decision`` — regional decision throughput vs LEASE LENGTH:
+  a region decides from its slice at full local speed while renewing
+  over the (simulated-WAN) control plane every ``renew_fraction ×
+  lease_len``. The claim under test is the paper's whole posture
+  lifted to WAN scale: the data plane's rate is INDEPENDENT of the
+  lease length — only the control-plane renew rate changes (reported
+  per arm as ``renews_per_1k_decisions``).
+- ``partition_epsilon`` — partition-window over-admission vs the
+  ε(RTT, lease_len) model: one region is fully partitioned for a
+  window spanning several lease periods; its admits past its slice
+  (the degraded-envelope serving) are measured against
+  :func:`federation_epsilon` — the ratio must stay ≤ 1 (the model is
+  an upper bound), and > 0 on a non-vacuous run (the envelope DID
+  serve — never hard-down).
+
+Usage::
+
+    python -m benchmarks.federation [--seed 20260804] [--smoke]
+        [--json] [--evidence]
+
+One JSON row per lane on stdout; ``--evidence`` appends them to
+``benchmarks/evidence/federation_r15.jsonl``. ``benchmarks/
+recapture.py`` owes this workload a real-device number
+(``federation_device``): every row here is a CPU stand-in
+(InProcessBucketStore regions)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import pathlib
+import time
+
+import numpy as np
+
+__all__ = ["run_local_decision", "run_partition_epsilon", "main"]
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = _ROOT / "benchmarks" / "evidence" / "federation_r15.jsonl"
+
+TENANT = "tenant:g"
+#: local_decision lane: an ample global budget — the lane measures
+#: mechanism cost, not budget exhaustion.
+G_CAP, G_RATE = 1e9, 1e6
+#: partition_epsilon lane: a HUMAN-SCALE budget — the lane drives
+#: offered load past the envelope to measure the bound itself.
+P_CAP, P_RATE = 20_000.0, 0.0
+
+
+class _Mono:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+async def _rig(lease_len_s: float, *, envelope_fraction: float = 0.5,
+               g_cap: float = G_CAP, g_rate: float = G_RATE):
+    from distributedratelimiting.redis_tpu.runtime.clock import (
+        ManualClock,
+    )
+    from distributedratelimiting.redis_tpu.runtime.federation import (
+        RegionFederation,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    home_store = InProcessBucketStore(clock=ManualClock())
+    home_mono = _Mono()
+    led = home_store.federation_ledger(clock=home_mono,
+                                       default_ttl_s=lease_len_s)
+    region_store = InProcessBucketStore(clock=ManualClock())
+    mono = _Mono()
+    admitted = [0]
+    agent = RegionFederation(
+        "bench", led, tenants={TENANT: (g_cap, g_rate)},
+        admitted_total=lambda _t: float(admitted[0]),
+        ttl_s=lease_len_s, clock=mono,
+        envelope_fraction=envelope_fraction)
+    await agent.tick()
+    return led, home_mono, region_store, mono, agent, admitted
+
+
+async def run_local_decision(seed: int, lease_len_s: float,
+                             n_decisions: int) -> dict:
+    """Regional decisions from the slice at full local speed, the
+    renew control plane on its lease-length cadence (simulated time:
+    one decision advances the region clock by 0.1 ms)."""
+    del seed  # the lane is deterministic; the knob is lease_len_s
+    led, home_mono, store, mono, agent, admitted = await _rig(
+        lease_len_s)
+    cfg = agent.slice(TENANT)
+    renew_every = lease_len_s * agent.renew_fraction
+    next_renew = renew_every
+    dt = 1e-4
+    t0 = time.perf_counter()
+    for _ in range(n_decisions):
+        res = await store.acquire(TENANT, 1, cfg[0], cfg[1])
+        if res.granted:
+            admitted[0] += 1
+        mono.t += dt
+        home_mono.t += dt
+        if mono.t >= next_renew:
+            next_renew += renew_every
+            await agent.tick()
+            cfg = agent.slice(TENANT)
+    elapsed = time.perf_counter() - t0
+    return {
+        "lane": "local_decision",
+        "lease_len_s": lease_len_s,
+        "decisions": n_decisions,
+        "granted": admitted[0],
+        "decisions_per_s": round(n_decisions / elapsed, 1),
+        "renews": agent.renews,
+        "renews_per_1k_decisions": round(
+            1000.0 * agent.renews / n_decisions, 3),
+        "elapsed_s": round(elapsed, 4),
+    }
+
+
+async def run_partition_epsilon(seed: int, lease_len_s: float,
+                                partition_periods: float) -> dict:
+    """One region fully partitioned for ``partition_periods`` lease
+    lengths: measure its over-admission past the slice against the
+    ε(RTT, lease_len) model (an upper bound — ratio ≤ 1)."""
+    from distributedratelimiting.redis_tpu.runtime.federation import (
+        degraded_config,
+        federation_epsilon,
+    )
+
+    rng = np.random.default_rng(seed)
+    led, home_mono, store, mono, agent, admitted = await _rig(
+        lease_len_s, g_cap=P_CAP, g_rate=P_RATE)
+    cfg0 = agent.slice(TENANT)
+    # Pre-partition traffic: spend a seeded fraction of the slice.
+    pre = int(cfg0[0] * float(rng.uniform(0.1, 0.3)))
+    for _ in range(pre):
+        res = await store.acquire(TENANT, 1, cfg0[0], cfg0[1])
+        if res.granted:
+            admitted[0] += 1
+
+    class _Down:
+        async def lease(self, _p):
+            raise ConnectionResetError("partitioned")
+        renew = reclaim = lease
+
+    agent.home = _Down()
+    window_s = partition_periods * lease_len_s
+    slice_at_partition = cfg0
+    # Drive the partition window in lease-length steps: the agent
+    # degrades at its monotonic expiry, then serves the envelope.
+    partition_admits = 0
+    steps = max(4, int(partition_periods * 4))
+    step_s = window_s / steps
+    per_step = int(cfg0[0])   # demand far above the envelope: measure
+    #                           the BOUND, not the offered load
+    for _ in range(steps):
+        mono.t += step_s
+        home_mono.t += step_s
+        await agent.tick()
+        cfg = agent.slice(TENANT)
+        for _ in range(per_step):
+            res = await store.acquire(TENANT, 1, cfg[0], cfg[1])
+            if res.granted:
+                admitted[0] += 1
+                partition_admits += 1
+    env_cap, env_rate = degraded_config(*slice_at_partition)
+    over = max(0.0, partition_admits
+               - (slice_at_partition[0] - pre)
+               - env_rate * window_s)
+    eps = federation_epsilon(1, slice_at_partition[0],
+                             slice_at_partition[1],
+                             lease_len_s * agent.renew_fraction,
+                             partition_s=window_s)
+    return {
+        "lane": "partition_epsilon",
+        "lease_len_s": lease_len_s,
+        "partition_periods": partition_periods,
+        "slice_cap": slice_at_partition[0],
+        "pre_partition_admits": pre,
+        "partition_admits": partition_admits,
+        "envelope_cap": env_cap,
+        "degraded_entries": agent.degraded_entries,
+        "over_admission": round(over, 1),
+        "epsilon_model": round(eps, 1),
+        "ratio_vs_model": round(over / eps, 4) if eps > 0 else 0.0,
+        "within_model": bool(over <= eps + 1e-6),
+    }
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="3-region federation soak lane (ISSUE 15)")
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes (CI wiring check)")
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--evidence", action="store_true",
+                        help=f"append rows to {EVIDENCE}")
+    args = parser.parse_args(argv)
+
+    n = 2_000 if args.smoke else 100_000
+    lease_lens = ((2.0,) if args.smoke else (2.0, 5.0, 10.0, 30.0))
+    rows = []
+    for ll in lease_lens:
+        rows.append(asyncio.run(run_local_decision(args.seed, ll, n)))
+    for periods in ((2.5,) if args.smoke else (2.5, 4.0)):
+        rows.append(asyncio.run(run_partition_epsilon(
+            args.seed, lease_lens[0], periods)))
+    ok = all(r.get("within_model", True) for r in rows)
+    for row in rows:
+        row["seed"] = args.seed
+        row["backend"] = "cpu_standin"
+        print(json.dumps(row), flush=True)
+        if args.evidence:
+            EVIDENCE.parent.mkdir(parents=True, exist_ok=True)
+            with EVIDENCE.open("a", encoding="utf-8") as f:
+                f.write(json.dumps(row) + "\n")
+    if not args.json:
+        print("OK: partition over-admission within the "
+              "epsilon(RTT, lease_len) model" if ok else
+              "FAIL: over-admission exceeded the epsilon model")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -267,6 +267,55 @@ def _debt_native_fe_shard_sweep(smoke: bool) -> dict:
             "unit": "rows/s per shard count"}
 
 
+def _debt_federation_device(smoke: bool) -> dict:
+    """The WAN federation lane (ISSUE 15) against the DEVICE store:
+    the region's local decisions from a leased slice are ordinary
+    device-store acquires and the home's renew charges are
+    ``debit_many`` launches — both rates have only CPU stand-in
+    numbers (benchmarks/federation.py) until this lands on real
+    hardware."""
+    import asyncio
+
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        DeviceBucketStore,
+    )
+
+    n = 1 << (10 if smoke else 14)
+    cap, rate = 1e9, 1e6
+
+    async def drive() -> dict:
+        store = DeviceBucketStore(n_slots=1 << (12 if smoke else 15),
+                                  max_batch=1024)
+        led = store.federation_ledger(default_ttl_s=30.0)
+        grant = await led.lease({
+            "region": "bench", "lease_id": "dev:1",
+            "tenant": "tenant:g", "demand": 1.0,
+            "global_cap": cap, "global_rate": rate})
+        slice_cap, slice_rate = grant["slice"]
+        t0 = time.perf_counter()
+        granted = 0
+        for i in range(n):
+            res = await store.acquire("tenant:g", 1, slice_cap,
+                                      slice_rate)
+            granted += int(res.granted)
+        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        renew = await led.renew({
+            "region": "bench", "lease_id": "dev:1",
+            "tenant": "tenant:g", "total": float(granted),
+            "demand": 1.0})
+        renew_s = time.perf_counter() - t1
+        await store.aclose()
+        return {"metric": "federation_local_decisions",
+                "decisions": n, "granted": granted,
+                "decisions_per_s": round(n / dt, 1),
+                "renew_charge_s": round(renew_s, 5),
+                "renew_charged": renew["charged"],
+                "unit": "slice-local decisions/s"}
+
+    return asyncio.run(drive())
+
+
 #: Ordered debt list: name → (what is owed, runner). The NAME is the
 #: ledger identity — renaming one un-retires it, deliberately.
 DEBTS: "list[tuple[str, str, object]]" = [
@@ -300,6 +349,12 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "saturating debit — the pair rate rests on the CPU stand-in "
      "(benchmarks/llm_workload.py reservations lane)",
      _debt_llm_reservations_device),
+    ("federation_device",
+     "the WAN federation lane (ISSUE 15) has no device number: the "
+     "regional local-decision throughput behind a leased slice (and "
+     "the home's debit_many settle lane under renew reports) rest on "
+     "the CPU stand-in (benchmarks/federation.py)",
+     _debt_federation_device),
 ]
 
 

@@ -161,6 +161,37 @@ def _retire_chain(path: str) -> None:
             pass
 
 
+def _snapshot_with_attachments(store) -> dict:
+    """``store.snapshot()`` plus the store-attached control-plane
+    state that must survive a planned restart: an ACTIVE federation
+    ledger's lease state (runtime/federation.py) rides as its own
+    ``"federation"`` section — TTLs exported as remaining ages, so a
+    restore can only shorten a lease's term (conservative, never
+    extended). Non-home stores (no ledger, or a never-used one) keep
+    their snapshot shape byte for byte, and the v4 structural diff
+    handles the extra dict section generically."""
+    snap = store.snapshot()
+    fed = getattr(store, "_federation", None)
+    if fed is not None and fed.active:
+        snap = dict(snap)
+        snap["federation"] = fed.export_state()
+    return snap
+
+
+def _restore_with_attachments(store, snap: dict) -> None:
+    """The restore half: route a ``"federation"`` section back into
+    the store-attached ledger (created on demand) BEFORE the store
+    body restores — the store's own ``restore`` never sees the
+    attachment key."""
+    fed_state = None
+    if isinstance(snap, dict) and "federation" in snap:
+        snap = dict(snap)
+        fed_state = snap.pop("federation")
+    store.restore(snap)
+    if fed_state is not None:
+        store.federation_ledger().restore_state(fed_state)
+
+
 def save_snapshot(store, path: str,
                   placement_epoch: "int | None" = None) -> None:
     """Pull ``store``'s live state to host and write it to ``path``
@@ -169,8 +200,10 @@ def save_snapshot(store, path: str,
     base at the next chain-aware load). ``placement_epoch`` stamps the
     cluster placement epoch the state was owned under (placement-aware
     servers pass it on OP_SAVE) so a later restore can be held to the
-    current map."""
-    payload = _full_payload(store.snapshot(), placement_epoch)
+    current map. Store-attached federation lease state rides along
+    (:func:`_snapshot_with_attachments`)."""
+    payload = _full_payload(_snapshot_with_attachments(store),
+                            placement_epoch)
     _retire_chain(path)
     _atomic_write(path, payload)
 
@@ -193,7 +226,7 @@ def load_snapshot(store, path: str,
     a file that is simply not a snapshot or speaks an unknown newer
     version."""
     snap, _crc = _read_full(path, expected_placement_epoch)
-    store.restore(snap)
+    _restore_with_attachments(store, snap)
 
 
 def _read_full(path: str,
@@ -468,7 +501,7 @@ def load_snapshot_chain(store, path: str,
         payloads.append(payload)
     for payload in payloads:
         snap = apply_snapshot_delta(snap, payload["delta"])
-    store.restore(snap)
+    _restore_with_attachments(store, snap)
     return len(payloads)
 
 
@@ -500,8 +533,11 @@ class SnapshotChain:
 
     def save(self, store, placement_epoch: "int | None" = None) -> str:
         """One checkpoint: a delta when a base is held and the chain has
-        room, else a compacting full save. Returns the file written."""
-        snap = store.snapshot()
+        room, else a compacting full save. Returns the file written.
+        Store-attached federation lease state rides every link
+        (:func:`_snapshot_with_attachments` — the structural diff
+        treats the section like any other dict)."""
+        snap = _snapshot_with_attachments(store)
         mark = getattr(store, "mark_snapshot_base", None)
         if callable(mark):
             mark()  # reset the store's dirty accounting window

@@ -89,6 +89,10 @@ SENSOR_SERIES = (
     "drl_reservations_outstanding",  # server.py — unsettled reserved tokens
     "drl_cluster_breaker_state",  # cluster.py — membership health
     "drl_cluster_node_errors",    # cluster.py — node failure counters
+    "drl_federation_outstanding_leases",  # server.py — home lease count
+    "drl_federation_region_degraded_now",  # server.py — slices serving
+    # their degraded envelope (the partition symptom the federation
+    # actuator reacts to between its cadence renews)
 )
 
 
@@ -157,6 +161,17 @@ class ControllerConfig:
     #: controller itself drained (it never rejoins operator drains).
     drain_after_open_ticks: int = 3
 
+    # -- federation (WAN lease agent) ---------------------------------------
+    #: Cadence, in ticks, of the federation actuator when a region
+    #: agent is attached: every N ticks the controller drives one
+    #: ``RegionFederation.tick`` with its per-tenant velocity-delta
+    #: rates as the demand report — the demand-proportional slice
+    #: sizing signal ("TokenScale"). A degraded slice (partition
+    #: symptom in the drl_federation_region_* sensors) fires the
+    #: actuator off-cadence, hysteresis-guarded like every other.
+    federation_renew_ticks: int = 4
+    federation_degraded_streak_ticks: int = 2
+
     # -- flap guards ---------------------------------------------------------
     #: Ticks after an actuator fires before the SAME actuator may fire
     #: again (per action kind).
@@ -182,7 +197,8 @@ class ControllerConfig:
         for name in ("shed_raise_ticks", "shed_lower_ticks",
                      "split_streak_ticks", "rebalance_streak_ticks",
                      "drain_after_open_ticks", "budget_actions",
-                     "budget_window_ticks"):
+                     "budget_window_ticks", "federation_renew_ticks",
+                     "federation_degraded_streak_ticks"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
         if self.cooldown_ticks < 0:
@@ -219,6 +235,11 @@ class Sensors:
     #: pending — a LEVEL gauge, not a counter delta: the holds
     #: themselves are the prospective load).
     outstanding_tokens: float = 0.0
+    #: federation sensors (LEVEL gauges): outstanding leases at any
+    #: home in the fleet, and slices currently serving their degraded
+    #: envelope at any region agent — the partition symptom.
+    fed_outstanding: float = 0.0
+    fed_degraded: float = 0.0
 
     @property
     def skew(self) -> float:
@@ -255,11 +276,19 @@ class Controller:
     def __init__(self, cluster, *,
                  config: "ControllerConfig | None" = None,
                  shed_targets: Sequence = (),
+                 federation=None,
                  flight_recorder=None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.cluster = cluster
         self.config = config or ControllerConfig()
         self._shed_targets = list(shed_targets)
+        #: Optional :class:`~.federation.RegionFederation`: when
+        #: attached, the ``federation`` actuator drives its WAN
+        #: renew/lease rounds on the tick cadence, feeding the
+        #: controller's own per-tenant velocity-delta rates as the
+        #: demand report (hysteresis + cooldown + budget guarded,
+        #: dry-run parity — like every actuator).
+        self.federation = federation
         self.flight_recorder = (flight_recorder
                                 if flight_recorder is not None
                                 else getattr(cluster, "flight_recorder",
@@ -294,6 +323,8 @@ class Controller:
         self.last_skew = 1.0
         self.last_token_rate = 0.0
         self.last_outstanding = 0.0
+        self.last_fed_degraded = 0.0
+        self.last_fed_outstanding = 0.0
         self._stop = asyncio.Event()
         # Announce on the audit surfaces that can splice us in
         # (cluster.stats() "controller" section, cluster_metrics()).
@@ -325,6 +356,7 @@ class Controller:
         tenant_rates: dict[str, float] = {}
         hot_totals: dict[str, float] = {}
         outstanding = 0.0
+        fed_outstanding = fed_degraded = 0.0
         for j, ns in enumerate(nodes):
             if not ns:
                 node_rates.append(0.0)
@@ -337,6 +369,12 @@ class Controller:
             # holds neither spike nor mask the fleet pressure).
             outstanding += float((ns.get("reservations") or {})
                                  .get("outstanding_tokens", 0.0))
+            # Federation levels: home lease count + region degraded
+            # slices (the partition symptom the actuator reacts to).
+            fed_outstanding += float((ns.get("federation") or {})
+                                     .get("outstanding_leases", 0.0))
+            fed_degraded += float((ns.get("federation_region") or {})
+                                  .get("degraded_now", 0.0))
             tv = ns.get("token_velocity") or {}
             for tenant, total in (tv.get("admitted") or {}).items():
                 tenant_rates[tenant] = tenant_rates.get(tenant, 0.0) \
@@ -373,6 +411,8 @@ class Controller:
             tenant_rates=tenant_rates,
             hot_key_deltas=hot_deltas,
             outstanding_tokens=outstanding,
+            fed_outstanding=fed_outstanding,
+            fed_degraded=fed_degraded,
         )
 
     # -- flap guards ---------------------------------------------------------
@@ -418,6 +458,8 @@ class Controller:
         intents: list[dict] = []
         self.last_skew = sensors.skew
         self.last_token_rate = sensors.token_rate
+        self.last_fed_degraded = sensors.fed_degraded
+        self.last_fed_outstanding = sensors.fed_outstanding
 
         def want(kind: str, target, reason: str, **extra) -> bool:
             """Returns True when the intent passed every gate (it WILL
@@ -500,7 +542,30 @@ class Controller:
                  spread=round(spread, 4))
             self._streaks["rebalance"] = 0
 
-        # 4. Shed ladder from token-velocity pressure PLUS outstanding-
+        # 4. Federation: when a region agent is attached, drive its
+        # WAN renew round on the tick cadence — the controller's
+        # velocity-delta rates ARE the demand report the home's
+        # demand-proportional slice sizing consumes — and off-cadence
+        # when a slice is serving its degraded envelope (partition
+        # symptom, hysteresis-guarded: a one-scrape blip never fires).
+        if self.federation is not None:
+            self._last_tenant_rates = dict(sensors.tenant_rates)
+            due = self._tick % cfg.federation_renew_ticks == 0
+            deg = self._streak("fed_degraded",
+                               sensors.fed_degraded > 0)
+            if due:
+                want("federation", None,
+                     f"renew cadence (every "
+                     f"{cfg.federation_renew_ticks} ticks)")
+            elif deg >= cfg.federation_degraded_streak_ticks:
+                want("federation", None,
+                     f"{sensors.fed_degraded:.0f} slice(s) degraded "
+                     f"{deg} ticks — attempting heal")
+                self._streaks["fed_degraded"] = 0
+        else:
+            self._streak("fed_degraded", False)
+
+        # 5. Shed ladder from token-velocity pressure PLUS outstanding-
         # reservation pressure: reserved-but-unsettled tokens are load
         # that WILL land, folded in as a prospective rate over the
         # reservation horizon — brownouts start before a wave of
@@ -565,6 +630,19 @@ class Controller:
                 return "executed"
             if kind == "rejoin":
                 await self.cluster.rejoin_node(target)
+                return "executed"
+            if kind == "federation":
+                if self.federation is None:   # pragma: no cover
+                    return "noop"             # decide() gates on it
+                summary = await self.federation.tick(
+                    demands=getattr(self, "_last_tenant_rates", None))
+                intent["summary"] = summary
+                if summary.get("errors") and not (
+                        summary.get("renewed") or summary.get("leased")):
+                    # Every WAN call failed: a partition symptom, not
+                    # an actuator error — counted on the agent, and
+                    # the outcome says so for the audit trail.
+                    return "partitioned"
                 return "executed"
             if kind in ("shed_raise", "shed_lower"):
                 if not self._shed_targets:
@@ -670,6 +748,8 @@ class Controller:
             "skew": self.last_skew,
             "token_rate": self.last_token_rate,
             "outstanding_tokens": self.last_outstanding,
+            "fed_degraded": self.last_fed_degraded,
+            "fed_outstanding_leases": self.last_fed_outstanding,
             "budget_remaining": self.budget_remaining(),
             "dry_run": int(self.config.dry_run),
             "auto_drained": len(self.auto_drained),

@@ -92,7 +92,15 @@ _IDEMPOTENT_OPS = frozenset((
     # (no second debit), a retried settle replays the recorded
     # reconciliation (outcome "duplicate", zero side effects) — the
     # MIGRATE_PUSH dedup posture, so post-send retries are safe.
-    wire.OP_RESERVE, wire.OP_SETTLE))
+    wire.OP_RESERVE, wire.OP_SETTLE,
+    # Federation lane (runtime/federation.py): lease and reclaim
+    # replay their per-lease-id recorded results (the OP_RESERVE
+    # posture); renew is absorbing by construction — monotonic
+    # admitted totals make a replayed report a zero delta, and slice
+    # changes carry an epoch the region adopts only forward (the
+    # OP_CONFIG version discipline). A WAN retry mid-partition can
+    # never double-grant a slice or double-refund a reclaim.
+    wire.OP_FED_LEASE, wire.OP_FED_RENEW, wire.OP_FED_RECLAIM))
 
 #: The explicit NOT-idempotent half of the classification: admission
 #: ops double-debit on replay; HELLO re-auth mid-stream is a protocol
@@ -185,6 +193,13 @@ class RemoteBucketStore(BucketStore):
         # direction, logged once + counted).
         self._peer_reserve = True
         self._reserve_fallbacks = 0
+        # Federation-lane latch (OP_FED_LEASE/RENEW/RECLAIM): an old
+        # home answers the routable unknown-op error — latch off once
+        # per connection lifetime; the region then treats federation
+        # as partitioned (keep serving the current slice, degrade to
+        # the envelope at expiry — never unlimited, never hard-down).
+        self._peer_fed = True
+        self._fed_fallbacks = 0
 
         # -- resilience (docs/OPERATIONS.md §8, DESIGN.md §11) ---------
         # Bounded, jittered retries. At-most-once for admission: an op
@@ -1087,6 +1102,68 @@ class RemoteBucketStore(BucketStore):
                             float(d.get("delta", 0.0)),
                             float(d.get("refunded", 0.0)),
                             float(d.get("debt", 0.0)))
+
+    # -- global quota federation (OP_FED_LEASE / RENEW / RECLAIM) ------------
+    #: The ledger lives at the HOME; None (not a method) for the same
+    #: reason as reservation_ledger — a ``callable(...)`` probe must
+    #: skip this client, not mint a local ledger nothing serves.
+    federation_ledger = None
+
+    def _note_fed_fallback(self) -> None:
+        if self._peer_fed:
+            self._peer_fed = False
+            log.error_evaluating_kernel(RuntimeError(
+                "home does not speak the federation lane "
+                "(OP_FED_LEASE/RENEW/RECLAIM); the region keeps "
+                "serving from its current slice and degrades to its "
+                "fair-share envelope at lease expiry — federation "
+                "unavailability is treated as a partition"))
+        self._fed_fallbacks += 1
+
+    async def _fed_call(self, op: int, payload: dict,
+                        timeout_s: "float | None") -> dict:
+        """One federation control frame (TEXT_OPS JSON; post-send-
+        retry-safe — see _IDEMPOTENT_OPS). Against a latched old home
+        this returns ``{"fallback": True}``: the region treats it as a
+        partition symptom (keep serving, degrade at expiry) — the
+        conservative direction, never unlimited."""
+        import json
+
+        if not self._peer_fed:
+            self._fed_fallbacks += 1
+            return {"fallback": True}
+        try:
+            (text,) = await self._request(op, json.dumps(payload),
+                                          timeout_s=timeout_s)
+        except wire.RemoteStoreError as exc:
+            if "unknown op" not in str(exc):
+                raise
+            self._note_fed_fallback()
+            return {"fallback": True}
+        return json.loads(text)
+
+    async def fed_lease(self, payload: dict, *,
+                        timeout_s: "float | None" = None) -> dict:
+        """Request (or idempotently re-request) a slice lease from the
+        home federation ledger (``OP_FED_LEASE``; wire.py documents
+        the payload/reply fields)."""
+        return await self._fed_call(wire.OP_FED_LEASE, payload,
+                                    timeout_s)
+
+    async def fed_renew(self, payload: dict, *,
+                        timeout_s: "float | None" = None) -> dict:
+        """Renew a lease: report the region's monotonic admitted total
+        + demand, extend the TTL, adopt any slice resize
+        (``OP_FED_RENEW``; absorbing — replay-safe)."""
+        return await self._fed_call(wire.OP_FED_RENEW, payload,
+                                    timeout_s)
+
+    async def fed_reclaim(self, payload: dict, *,
+                          timeout_s: "float | None" = None) -> dict:
+        """Return a slice to the pool (``OP_FED_RECLAIM``; idempotent
+        by lease id — a duplicate replays the recorded result)."""
+        return await self._fed_call(wire.OP_FED_RECLAIM, payload,
+                                    timeout_s)
 
     def _hier_tail_budget(self, tenant: str) -> int:
         """Chunk budget for HBUCKET frames: the per-frame tenant

@@ -248,6 +248,11 @@ class BucketStoreServer:
             self.reservations.liveconfig = self.liveconfig
         else:  # pragma: no cover — every BucketStore carries the hook
             self.reservations = None
+        #: Region-side federation agent, when this process hosts one
+        #: (an embedder or the controller wiring assigns it): its
+        #: partition/degraded counters ride OP_STATS and the
+        #: drl_federation_region_* families below.
+        self.federation_agent = None
         # Drain-and-handoff shutdown (shutdown()): while a drain is in
         # flight, admission ops serve from this bounded fair-share
         # envelope instead of the (already exported) store.
@@ -564,6 +569,41 @@ class BucketStoreServer:
                           "Under-estimate overage magnitudes "
                           "(bucket unit: tokens x 1e-6)",
                           lambda: led.debt_hist)
+        # Global quota federation (runtime/federation.py). Read
+        # dynamically: the home ledger materializes on the first
+        # OP_FED_* frame and the region agent is attached by an
+        # embedder — both may postdate the first scrape.
+        reg.register_numeric_dict(
+            "federation", "WAN federation ledger (home side)",
+            lambda: (self.federation.numeric_stats()
+                     if self.federation is not None
+                     and self.federation.active else None),
+            counters={"leases_granted", "lease_duplicates",
+                      "lease_denied", "renews", "renew_unknown",
+                      "resizes", "reclaims", "reclaim_duplicates",
+                      "reclaim_unknown", "leases_expired", "heals",
+                      "charged_tokens", "conservative_tokens",
+                      "refunded_tokens", "debts_created",
+                      "debt_tokens_created", "debt_tokens_collected",
+                      "restores"})
+        reg.labeled_gauges(
+            "federation_slice_share",
+            "Leased share of each global tenant budget per region "
+            "(slice utilization — Σ over regions <= 1 per tenant)",
+            lambda: ([({"tenant": t, "region": r}, s)
+                      for t, r, s in self.federation.shares()]
+                     if self.federation is not None else []))
+        reg.register_numeric_dict(
+            "federation_region",
+            "WAN federation agent (region side): partition/degraded "
+            "counters",
+            lambda: (self.federation_agent.numeric_stats()
+                     if self.federation_agent is not None else None),
+            counters={"leases_acquired", "lease_failures", "renews",
+                      "renew_failures", "partition_errors",
+                      "degraded_entries", "heals", "slice_updates",
+                      "stale_slice_replies", "reclaims",
+                      "fed_fallbacks"})
         if self.flight_recorder is not None:
             reg.register_numeric_dict(
                 "flight", "flight recorder",
@@ -1145,6 +1185,13 @@ class BucketStoreServer:
                 import json
 
                 resp = await self._serve_settle(seq, json.loads(key))
+            elif op in (wire.OP_FED_LEASE, wire.OP_FED_RENEW,
+                        wire.OP_FED_RECLAIM):
+                import json
+
+                await faults.seam("server.federation")
+                resp = await self._serve_federation(seq, op,
+                                                    json.loads(key))
             elif op == wire.OP_TRACES:
                 # Chrome-trace JSON capped under MAX_FRAME (newest traces
                 # win); flag bit 0 drains the buffer after export.
@@ -1409,6 +1456,44 @@ class BucketStoreServer:
         res = await self.reservations.settle(rid, tenant, actual)
         return wire.encode_response(seq, wire.RESP_TEXT,
                                     json.dumps(res._asdict()))
+
+    # -- global quota federation dispatch (runtime/federation.py) ------------
+    @property
+    def federation(self):
+        """The store-attached home ledger, or ``None`` until the first
+        federation frame creates it (non-home servers never pay for
+        one) — read dynamically so the registry/stats callables see it
+        the moment it exists."""
+        return getattr(self.store, "_federation", None)
+
+    def _fed_ledger(self):
+        """Get-or-create the home ledger, wired into THIS server's
+        observability plane (the reservations re-wire posture: a store
+        re-fronted by a new server must see the new plane)."""
+        led = self.store.federation_ledger()
+        led.flight_recorder = self.flight_recorder
+        led.velocity = self.token_velocity
+        return led
+
+    async def _serve_federation(self, seq: int, op: int,
+                                req: dict) -> bytes:
+        """One federation control frame at the home: lease / renew /
+        reclaim against the store-attached :class:`~.federation.
+        FederationLedger`. All three are post-send-retry-safe
+        (lease/reclaim replay recorded results, renew is absorbing) —
+        validation failures answer the routable error, the ledger
+        untouched."""
+        import json
+
+        led = self._fed_ledger()
+        if op == wire.OP_FED_LEASE:
+            out = await led.lease(req)
+        elif op == wire.OP_FED_RENEW:
+            out = await led.renew(req)
+        else:
+            out = await led.reclaim(req)
+        return wire.encode_response(seq, wire.RESP_TEXT,
+                                    json.dumps(out))
 
     async def _serve_bulk_hier(self, seq: int, body: bytes, keys,
                                counts, a: float, b: float,
@@ -1776,6 +1861,12 @@ class BucketStoreServer:
             # stats() piggybacks one TTL-expiry pass — a scraped-but-
             # idle server still auto-settles dead clients' holds.
             payload["reservations"] = self.reservations.stats()
+        if self.federation is not None and self.federation.active:
+            # stats() piggybacks one monotonic-expiry pass — a
+            # scraped-but-idle home still expires unrenewed leases.
+            payload["federation"] = self.federation.stats()
+        if self.federation_agent is not None:
+            payload["federation_region"] = self.federation_agent.stats()
         if self.flight_recorder is not None:
             payload["flight_recorder"] = self.flight_recorder.snapshot()
         if self.tracer.enabled:

@@ -405,6 +405,25 @@ class BucketStore(abc.ABC):
             led = self._reservations = ReservationLedger(self, **kwargs)
         return led
 
+    # -- global quota federation (runtime/federation.py) -------------------
+    def federation_ledger(self, **kwargs):
+        """Get-or-create this store's :class:`~.federation.
+        FederationLedger` — ONE ledger per store (the
+        ``reservation_ledger`` pattern), shared by the server's
+        OP_FED_* dispatch and the checkpoint attachment lane
+        (runtime/checkpoint.py snapshots/restores its lease state
+        beside the bucket tables, so a home crash/restart resumes
+        every lease). ``kwargs`` configure the ledger on FIRST
+        creation only."""
+        led = getattr(self, "_federation", None)
+        if led is None:
+            from distributedratelimiting.redis_tpu.runtime.federation import (
+                FederationLedger,
+            )
+
+            led = self._federation = FederationLedger(self, **kwargs)
+        return led
+
     async def reserve(self, rid: str, tenant: str, key: str,
                       estimate: "float | None",
                       tenant_capacity: float,

@@ -149,6 +149,22 @@ post-send-retryable set. An old server answers either with a routable
 unknown-op error; the client latches once per connection and falls
 back to plain ``acquire_hierarchical`` at the estimate (counted —
 refunds are forgone against that peer, the conservative direction).
+
+Federation lane (within v4, OP_METRICS posture — the WAN lease ledger,
+:mod:`~.runtime.federation`, DESIGN.md §20): ``OP_FED_LEASE`` /
+``OP_FED_RENEW`` / ``OP_FED_RECLAIM`` carry u32-length-prefixed JSON
+(``TEXT_OPS``) and reply RESP_TEXT JSON. All three are *application-
+idempotent* — lease and reclaim replay their per-lease-id recorded
+results (the OP_RESERVE dedup posture), renew is absorbing by
+construction (monotonic admitted totals + epoch-monotonic slice
+adoption, the OP_CONFIG discipline) — so every one of them sits in the
+client's post-send-retryable set: a WAN retry mid-partition can never
+double-grant a slice or double-refund a reclaim. An old home answers
+any of them with a routable unknown-op error; the regional client
+latches once per connection (counted) and keeps serving from its
+current slice until lease expiry, then degrades to its fair-share
+envelope — federation unavailability is indistinguishable from a
+partition, by design never unlimited and never hard-down.
 """
 
 from __future__ import annotations
@@ -165,7 +181,8 @@ __all__ = [
     "OP_ACQUIRE_MANY", "OP_METRICS", "OP_TRACES",
     "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
     "OP_MIGRATE_PUSH", "OP_CONFIG", "OP_ACQUIRE_H", "OP_RESERVE",
-    "OP_SETTLE", "TEXT_OPS",
+    "OP_SETTLE", "OP_FED_LEASE", "OP_FED_RENEW", "OP_FED_RECLAIM",
+    "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
@@ -258,12 +275,38 @@ OP_SETTLE = 21  # estimate-reserve-settle, phase 3: [u32 mlen][json
 # what makes the op post-send-retry-safe. Routed by TENANT like
 # OP_ACQUIRE_H (the ledger entry lives with the tenant's owner).
 
+OP_FED_LEASE = 22  # global quota federation, phase 1 (runtime/
+# federation.py; OP_METRICS posture — a new op on the existing frame
+# layout, routable unknown-op error from old homes, never a misparse):
+# [u32 mlen][json {region, lease_id, tenant, demand, total,
+# global_cap, global_rate, ttl_s?}] → RESP_TEXT JSON {granted,
+# lease_id, epoch, slice: [cap, rate], ttl_s, share, debt,
+# duplicate} — `total` is the region's monotonic admitted counter,
+# seeding the lease's report baseline. Application-
+# idempotent by LEASE ID (a granted lease_id's retry replays the
+# recorded grant without a second share debit — the OP_RESERVE
+# posture), so WAN post-send retries are always safe.
+OP_FED_RENEW = 23  # federation heartbeat + demand report:
+# [u32 mlen][json {region, lease_id, tenant, total, demand}] →
+# RESP_TEXT JSON {outcome, epoch, slice, ttl_s, charged, refunded,
+# debt}. Naturally idempotent: `total` is the region's MONOTONIC
+# admitted-token counter (a replayed renew's delta is zero) and slice
+# changes carry an epoch the region adopts only forward (the OP_CONFIG
+# version discipline) — post-send-retry-safe without a dedup ledger.
+OP_FED_RECLAIM = 24  # return a slice to the federation pool:
+# [u32 mlen][json {region, lease_id, tenant, total}] → RESP_TEXT JSON
+# {outcome, charged, refunded, debt}. Idempotent by lease id — a
+# duplicate reclaim replays the recorded result (outcome "duplicate",
+# zero side effects: no second share free, no second refund), the
+# at-most-once property tests/test_federation.py audits.
+
 #: Control ops whose request payload is one u32-length-prefixed UTF-8
 #: JSON text (rides in the ``key`` slot of encode/decode_request —
 #: ensure_ascii JSON, so the strict codec never meets a surrogate).
 TEXT_OPS = frozenset((OP_PLACEMENT_ANNOUNCE, OP_MIGRATE_PULL,
                       OP_MIGRATE_PUSH, OP_CONFIG, OP_RESERVE,
-                      OP_SETTLE))
+                      OP_SETTLE, OP_FED_LEASE, OP_FED_RENEW,
+                      OP_FED_RECLAIM))
 
 #: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
 #: payload. Only sampled requests carry it; an old server answers the
@@ -322,6 +365,9 @@ _OP_NAMES = {
     OP_ACQUIRE_H: "acquire_hierarchical",
     OP_RESERVE: "reserve",
     OP_SETTLE: "settle",
+    OP_FED_LEASE: "fed_lease",
+    OP_FED_RENEW: "fed_renew",
+    OP_FED_RECLAIM: "fed_reclaim",
 }
 
 

@@ -28,6 +28,21 @@ runtime consults when — and only when — an injector is installed:
   fault fails the whole tick loudly (counted, flight-recorder frame,
   no decisions that round) — the controller soak's proof that a flaky
   sensor plane degrades the loop to inaction, never to flapping.
+- ``federation.lease`` / ``federation.renew`` / ``federation.reclaim``
+  — one WAN control call from a regional federation agent to the home
+  ledger (:meth:`RegionFederation._call_home`,
+  runtime/federation.py): a fault here IS a partition symptom — the
+  region counts it and keeps serving from its current slice, and only
+  monotonic lease expiry degrades it to the envelope.
+- ``server.federation`` — an OP_FED_LEASE/RENEW/RECLAIM dispatch at
+  the home (:meth:`BucketStoreServer._handle_frame_inner`): a fault
+  fails one control frame; the ops are post-send-retry-safe, so the
+  region's retry dedups.
+- clock skew (``CLOCK_SKEW`` rules on any seam, read via
+  :meth:`FaultInjector.clock_skew` / :class:`SkewedClock`) — the
+  federation tests wrap the WALL clocks on both ends with it and pin
+  that lease lifetimes never move: TTLs are monotonic-local by
+  contract.
 
 **Determinism.** Each seam owns its own ``random.Random`` seeded from
 ``(seed, seam)`` and its own occurrence counter, and every occurrence

@@ -42,6 +42,9 @@ REGISTERED_KINDS = frozenset({
     "node_error",    # cluster node failures (runtime/cluster.py)
     "controller",    # control-plane actions (runtime/controller.py)
     "reservation",   # reserve/settle events (runtime/reservations.py)
+    "federation",    # WAN lease events (runtime/federation.py):
+                     # grants/resizes/expiries/heals at the home,
+                     # degrade/heal transitions at the region
     "header",        # the dump file's header line
 })
 

@@ -186,6 +186,15 @@ constexpr uint8_t OP_ACQUIRE_H = 19;
 // future fast-path cannot typo them.
 constexpr uint8_t OP_RESERVE = 20;
 constexpr uint8_t OP_SETTLE = 21;
+// Global quota federation lane (wire.py, runtime/federation.py): WAN
+// lease control frames (TEXT_OPS JSON) against the home ledger —
+// WAN-RTT cadence, never hot. Passthrough like the placement/config/
+// reservation ops: named (and case-listed) so drl-check's
+// wire-conformance diff pins their values against wire.py and a
+// future fast-path cannot typo them.
+constexpr uint8_t OP_FED_LEASE = 22;
+constexpr uint8_t OP_FED_RENEW = 23;
+constexpr uint8_t OP_FED_RECLAIM = 24;
 
 // Bulk admission lane (round 8): OP_ACQUIRE_MANY parses HERE, tier-0
 // decides hot bucket rows per-row, and the RESP_BULK reply encodes in C
@@ -1739,8 +1748,12 @@ bool handle_frame(Shard* sh, Conn* c, const uint8_t* body, size_t len) {
       case OP_CONFIG:
       case OP_RESERVE:
       case OP_SETTLE:
+      case OP_FED_LEASE:
+      case OP_FED_RENEW:
+      case OP_FED_RECLAIM:
       default: {
-        // Placement/migration/config/reservation control ops, HELLO,
+        // Placement/migration/config/reservation/federation control
+        // ops, HELLO,
         // PEEK, SYNC, STATS, SAVE, unknown: Python decides (including
         // the unknown-op error) — the wire module stays the single
         // authority for every non-hot shape. ACQUIRE_MANY left this

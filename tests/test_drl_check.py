@@ -614,14 +614,98 @@ def test_reserve_unclassified_fires_wire_idempotency(tmp_path):
     post-send-retry-unsafe by omission."""
     mutated = tmp_path / "remote.py"
     text = REMOTE_PATH.read_text()
-    anchor = "    wire.OP_RESERVE, wire.OP_SETTLE))"
+    anchor = "    wire.OP_RESERVE, wire.OP_SETTLE,"
     assert anchor in text, "fixture anchor gone from remote.py"
     mutated.write_text(text.replace(anchor,
-                                    "    wire.OP_SETTLE))", 1))
+                                    "    wire.OP_SETTLE,", 1))
     findings = wire_conformance.check_idempotency(WIRE, mutated,
                                                   tmp_path)
     assert [f.rule for f in findings] == ["wire-idempotency"]
     assert "OP_RESERVE" in findings[0].message
+
+
+# -- federation lane (round 15: OP_FED_LEASE / RENEW / RECLAIM) --------------
+
+def test_federation_ops_are_covered_everywhere():
+    """Satellite: the three federation ops exist in wire.py, are
+    mirrored (value-diffed) in frontend.cc's passthrough constants,
+    are dispatched by server.py, and sit in the client's post-send-
+    retryable set (lease/reclaim replay recorded results, renew is
+    absorbing — wire.py documents why)."""
+    py = wire_conformance.extract_py_model(WIRE)
+    c = wire_conformance.extract_c_model(FRONTEND)
+    fed = {"OP_FED_LEASE": 22, "OP_FED_RENEW": 23,
+           "OP_FED_RECLAIM": 24}
+    for name, value in fed.items():
+        assert py.constants[name][0] == value
+        assert c.constants[name][0] == value
+    refs = wire_conformance._server_op_references(SERVER)
+    assert set(fed) <= set(refs)
+    sets = wire_conformance._remote_op_sets(REMOTE_PATH)
+    members, _line = sets["_IDEMPOTENT_OPS"]
+    assert set(fed) <= set(members)
+
+
+def test_fed_lease_constant_drift_fires_wire_const(tmp_path):
+    """Seeded divergence: frontend.cc disagreeing with wire.py about
+    OP_FED_LEASE's value fires wire-const exactly once."""
+    cc = _mutated_frontend(tmp_path,
+                           "constexpr uint8_t OP_FED_LEASE = 22;",
+                           "constexpr uint8_t OP_FED_LEASE = 92;")
+    findings = wire_conformance.check_wire(WIRE, cc, tmp_path)
+    assert [f.rule for f in findings] == ["wire-const"]
+    assert "OP_FED_LEASE" in findings[0].message
+
+
+def test_fed_renew_undispatched_fires_wire_dispatch(tmp_path):
+    """Seeded divergence: a server.py that stops referencing
+    wire.OP_FED_RENEW fires wire-dispatch for exactly that op."""
+    mutated = tmp_path / "server.py"
+    text = SERVER.read_text()
+    assert "wire.OP_FED_RENEW" in text
+    mutated.write_text(text.replace("wire.OP_FED_RENEW",
+                                    "wire.OP_FED_LEASE"))
+    findings = wire_conformance.check_dispatch(WIRE, mutated, tmp_path)
+    assert [f.rule for f in findings] == ["wire-dispatch"]
+    assert "OP_FED_RENEW" in findings[0].message
+
+
+def test_fed_reclaim_unclassified_fires_wire_idempotency(tmp_path):
+    """Seeded divergence: dropping OP_FED_RECLAIM from the client's
+    idempotent set (without adding it to the non-idempotent one) fires
+    wire-idempotency."""
+    mutated = tmp_path / "remote.py"
+    text = REMOTE_PATH.read_text()
+    anchor = ("    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
+              "wire.OP_FED_RECLAIM))")
+    assert anchor in text, "fixture anchor gone from remote.py"
+    mutated.write_text(text.replace(
+        anchor, "    wire.OP_FED_LEASE, wire.OP_FED_RENEW))", 1))
+    findings = wire_conformance.check_idempotency(WIRE, mutated,
+                                                  tmp_path)
+    assert [f.rule for f in findings] == ["wire-idempotency"]
+    assert "OP_FED_RECLAIM" in findings[0].message
+
+
+def test_federation_flight_kind_is_registered():
+    """The federation frame kind sits in REGISTERED_KINDS (the PR-14
+    flight-kind rule then passes by construction) and the controller's
+    federation sensor entries resolve against live registration sites
+    (the metric-name rule's contract — checked live here, not just by
+    the repo-wide sweep)."""
+    from tools.drl_check import flight_kinds, metric_names
+
+    fr = (ROOT / "distributedratelimiting" / "redis_tpu" / "utils"
+          / "flight_recorder.py")
+    kinds, _line = flight_kinds.registered_kinds(fr)
+    assert "federation" in kinds
+    controller = (ROOT / "distributedratelimiting" / "redis_tpu"
+                  / "runtime" / "controller.py")
+    subs = [s for s, _l in
+            metric_names.controller_subscriptions(controller)]
+    assert "drl_federation_outstanding_leases" in subs
+    assert "drl_federation_region_degraded_now" in subs
+    assert metric_names.check(ROOT) == []
 
 
 # -- wire-idempotency (round 7) ---------------------------------------------

@@ -144,6 +144,7 @@ def _shim(tmp_path, mutate: "dict[str, tuple[str, str]]"
                      (RT / "placement.py", rt / "placement.py"),
                      (RT / "liveconfig.py", rt / "liveconfig.py"),
                      (RT / "reservations.py", rt / "reservations.py"),
+                     (RT / "federation.py", rt / "federation.py"),
                      (UT / "resilience.py", ut / "resilience.py")]:
         text = src.read_text()
         if src.name in mutate:
@@ -253,6 +254,33 @@ _KNOB_MATRIX = [
       "                return \"reject\"",
       "return \"reject\""),
      "breaker-no-wedge"),
+    # -- federation (runtime/federation.py, ISSUE 15) -----------------
+    # Recorded-grant replay dropped: a WAN retry of a granted
+    # lease_id re-runs the grant body.
+    (("federation.py", "dup = self._duplicate_lease(lease_id)",
+      "dup = None"),
+     "idempotent-replay"),
+    # Region adopts slice epochs in any order: a stale out-of-order
+    # reply rolls the applied config back.
+    (("federation.py", "if epoch <= lease.epoch:", "if False:"),
+     "fed-lease-monotonic"),
+    # Expiry keyed on the WALL clock: a skewed clock extends the
+    # lease past its monotonic TTL (the WAN-skew hazard the whole
+    # design exists to prevent).
+    (("federation.py",
+      "now = self._clock() if now is None else now\n        n = 0",
+      "now = self._wall() if now is None else now\n        n = 0"),
+     "fed-no-skew-extension"),
+    # The fully-spent presumption dropped: an unreachable region's
+    # unreported slice entitlement escapes the global record.
+    (("federation.py", "charge = self._conservative_charge(lease)",
+      "charge = 0.0"),
+     "fed-global-bound"),
+    # Heal leaves the expired record behind: a re-delivered
+    # renew/reclaim refunds the conservative charge twice.
+    (("federation.py", "rec = self._expired.pop(lease_id, None)",
+      "rec = self._expired.get(lease_id, None)"),
+     "fed-reclaim-idempotent"),
 ]
 
 
@@ -314,9 +342,12 @@ def test_unmodeled_idempotent_op_is_flagged(tmp_path):
     """Adding an op to _IDEMPOTENT_OPS with no replay model must fail
     verification — the set cannot grow past what is verified."""
     shim = _shim(tmp_path, {
-        "remote.py": ("    wire.OP_RESERVE, wire.OP_SETTLE))",
-                      "    wire.OP_RESERVE, wire.OP_SETTLE,\n"
-                      "    wire.OP_SAVE))")})
+        "remote.py": (
+            "    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
+            "wire.OP_FED_RECLAIM))",
+            "    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
+            "wire.OP_FED_RECLAIM,\n"
+            "    wire.OP_SAVE))")})
     facts = extract_facts(shim)
     assert unmodeled_idempotent_ops(facts) == ["OP_SAVE"]
 

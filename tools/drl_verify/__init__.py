@@ -1,11 +1,12 @@
 """drl-verify — exhaustive protocol model checking + lock-order
-analysis for the repo's four distributed state machines.
+analysis for the repo's five distributed state machines.
 
-PRs 6–13 stacked four interacting protocols — placement epochs
+PRs 6–15 stacked five interacting protocols — placement epochs
 (``runtime/placement.py``), config versions (``runtime/liveconfig.py``),
-reservation rid-idempotency (``runtime/reservations.py``), and the
+reservation rid-idempotency (``runtime/reservations.py``), the WAN
+federation lease machine (``runtime/federation.py``), and the
 breaker lifecycle (``utils/resilience.py``) — whose safety arguments
-lived in prose (docs/DESIGN.md §12–§18) and in seeded soaks that
+lived in prose (docs/DESIGN.md §12–§20) and in seeded soaks that
 sample a vanishing fraction of interleavings. This package checks the
 *protocols* themselves:
 
@@ -41,7 +42,7 @@ import pathlib
 
 __all__ = ["run_verify", "VerifyResult"]
 
-#: Exploration bounds for `make check` (CLI flags override): the four
+#: Exploration bounds for `make check` (CLI flags override): the five
 #: base worlds complete EXHAUSTIVELY far below these; the migration ×
 #: config product is cut off at the cap — reported, never silent.
 DEFAULT_MAX_STATES = 400_000

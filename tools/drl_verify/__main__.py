@@ -27,8 +27,8 @@ def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="drl-verify",
         description="exhaustive protocol model checker (placement / "
-                    "config / reservation / breaker machines) + "
-                    "cross-language lock-order analyzer "
+                    "config / reservation / federation / breaker "
+                    "machines) + cross-language lock-order analyzer "
                     "(see tools/drl_verify)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable results on stdout")

@@ -20,6 +20,11 @@ passing. The extraction surface (docs/DESIGN.md §19):
 - ``runtime/reservations.py`` — the ledger's dedup probes: duplicate
   reserve, recorded settle, restore-skips-known-rid, and the
   per-(tag, tenant) debt dedup.
+- ``runtime/federation.py`` — the WAN lease machine's guards: the
+  duplicate-lease grant replay, the region's forward-only slice-epoch
+  adoption, the MONOTONIC (never wall) expiry clock, the
+  conservative fully-spent charge at expiry, and the heal record's
+  at-most-once pop.
 - ``utils/resilience.py`` — the breaker transition table (every
   ``self._transition(...)`` call site with its guarding state) plus the
   single-probe and probe-reclaim guards in ``allow``.
@@ -39,7 +44,8 @@ import pathlib
 
 __all__ = ["Facts", "ExtractionError", "extract_facts",
            "extract_placement", "extract_liveconfig",
-           "extract_reservations", "extract_breaker", "extract_op_sets"]
+           "extract_reservations", "extract_federation",
+           "extract_breaker", "extract_op_sets"]
 
 
 class ExtractionError(RuntimeError):
@@ -91,6 +97,16 @@ class Facts:
     settle_dedup: Fact              # settled-rid map replays the result
     restore_skip_known: Fact        # restore skips an already-known rid
     debt_tag_dedup: Fact            # tagged debt applies once per tag
+
+    # federation.py — FederationLedger / RegionFederation guards.
+    fed_lease_dedup: Fact           # duplicate lease_id replays the grant
+    fed_adopt_epoch_guard: Fact     # region adopts slice epochs forward-only
+    fed_expiry_monotonic: Fact      # expire() reads the MONOTONIC clock,
+    #                                 never the wall clock (skew immunity)
+    fed_conservative_spent: Fact    # expiry charges the unreported slice
+    #                                 entitlement (fully-spent presumption)
+    fed_heal_once: Fact             # heal POPS the expired record (at most
+    #                                 one refund per lease id)
 
     # resilience.py — CircuitBreaker.
     breaker_edges: "frozenset[tuple[str, str, str]]"  # (from, event, to)
@@ -299,6 +315,44 @@ def extract_reservations(reservations_py: pathlib.Path, rel: str) -> dict:
     }
 
 
+# -- federation.py -----------------------------------------------------------
+
+def extract_federation(federation_py: pathlib.Path, rel: str) -> dict:
+    tree = _parse(federation_py)
+    ledger = _class(tree, "FederationLedger", federation_py)
+    region = _class(tree, "RegionFederation", federation_py)
+    lease = _method(ledger, "lease", federation_py)
+    expire = _method(ledger, "expire", federation_py)
+    heal = _method(ledger, "_heal", federation_py)
+    adopt = _method(region, "_adopt", federation_py)
+
+    # The monotonic-TTL contract is a NEGATIVE fact too: expire() must
+    # read self._clock AND must not read self._wall — a refactor that
+    # swaps the clock source silently re-opens the WAN-skew lease
+    # extension the whole design exists to prevent.
+    uses_clock = _find_fact(expire, rel, "self._clock(",
+                            node_type=ast.Call)
+    uses_wall = _find_fact(expire, rel, "self._wall(",
+                           node_type=ast.Call)
+    expiry_monotonic = Fact(
+        bool(uses_clock) and not bool(uses_wall), rel,
+        uses_wall.line if uses_wall else uses_clock.line)
+
+    return {
+        "fed_lease_dedup": _find_fact(
+            lease, rel, "self._duplicate_lease(", node_type=ast.Call),
+        "fed_adopt_epoch_guard": _find_if_test(
+            adopt, rel, "epoch <= lease.epoch"),
+        "fed_expiry_monotonic": expiry_monotonic,
+        "fed_conservative_spent": _find_fact(
+            expire, rel, "self._conservative_charge(",
+            node_type=ast.Call),
+        "fed_heal_once": _find_fact(
+            heal, rel, "self._expired.pop(lease_id",
+            node_type=ast.Call),
+    }
+
+
 # -- resilience.py: the breaker transition table -----------------------------
 
 _STATE_NAMES = {"CLOSED": "closed", "OPEN": "open",
@@ -372,6 +426,7 @@ def extract_facts(root: pathlib.Path) -> Facts:
     placement = pkg / "runtime" / "placement.py"
     liveconfig = pkg / "runtime" / "liveconfig.py"
     reservations = pkg / "runtime" / "reservations.py"
+    federation = pkg / "runtime" / "federation.py"
     resilience = pkg / "utils" / "resilience.py"
 
     def rel(p: pathlib.Path) -> str:
@@ -388,6 +443,7 @@ def extract_facts(root: pathlib.Path) -> Facts:
         **extract_placement(placement, rel(placement)),
         **extract_liveconfig(liveconfig, rel(liveconfig)),
         **extract_reservations(reservations, rel(reservations)),
+        **extract_federation(federation, rel(federation)),
         **extract_breaker(resilience, rel(resilience)),
         breaker_file=rel(resilience),
     )

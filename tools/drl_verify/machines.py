@@ -1,4 +1,4 @@
-"""The formal models — four interacting worlds built from extracted
+"""The formal models — five interacting worlds built from extracted
 facts (:mod:`tools.drl_verify.extract`), explored exhaustively by
 :mod:`tools.drl_verify.explorer`.
 
@@ -31,6 +31,12 @@ docs/DESIGN.md §19 maps each back to the prose it formalizes):
   TTL expiry, and the migration export/restore lane with tagged debt
   rows: ``settle-dedup``, ``debt-conserved``,
   ``outstanding-conserved``, ``idempotent-replay``.
+- **federation** — the WAN lease machine (one home
+  :class:`FederationLedger`, one region agent, independent monotonic
+  clocks, wall-clock skew, partition/heal):
+  ``fed-lease-monotonic``, ``fed-no-skew-extension``,
+  ``fed-global-bound``, ``fed-reclaim-idempotent``,
+  ``idempotent-replay``.
 - **breaker** — the :class:`CircuitBreaker` rebuilt from its extracted
   transition table: ``breaker-single-probe``,
   ``breaker-failure-never-closes``, ``breaker-opens-at-threshold``,
@@ -58,8 +64,9 @@ from collections import namedtuple
 from tools.drl_verify.extract import Facts
 
 __all__ = ["MigrationWorld", "ConfigWorld", "ReservationWorld",
-           "BreakerWorld", "READ_OPS", "MODELED_OPS", "all_worlds",
-           "unmodeled_idempotent_ops", "CAP", "ENV"]
+           "FederationWorld", "BreakerWorld", "READ_OPS",
+           "MODELED_OPS", "all_worlds", "unmodeled_idempotent_ops",
+           "CAP", "ENV"]
 
 #: Idempotent ops that are pure reads — replay-safe by construction
 #: (their server handlers mutate nothing; the wire fuzz pins replies).
@@ -76,6 +83,9 @@ MODELED_OPS = {
     "OP_CONFIG": "config",
     "OP_RESERVE": "reservation",
     "OP_SETTLE": "reservation",
+    "OP_FED_LEASE": "federation",
+    "OP_FED_RENEW": "federation",
+    "OP_FED_RECLAIM": "federation",
 }
 
 
@@ -817,6 +827,293 @@ class ReservationWorld:
 
 
 # ===========================================================================
+# Federation world
+# ===========================================================================
+
+FedState = namedtuple("FedState", [
+    "lh",       # home holds the lease
+    "le",       # current lease epoch at home (= grants issued)
+    "lr",       # region's adopted lease epoch (0 = no lease)
+    "hs",       # home ticks since last renewal (lease TTL clock)
+    "rs",       # region ticks since last renewal (its own mono clock)
+    "rb",       # region slice balance (config persistence: minted once)
+    "minted",   # the slice bucket has been minted
+    "eb",       # degraded-envelope balance
+    "em",       # envelope episodes minted
+    "deg",      # region serving its degraded envelope
+    "adm",      # region admitted total (monotonic)
+    "rep",      # admitted total the home has seen reported
+    "hb",       # home global bucket balance
+    "d",        # home-side debt (charge the bucket could not cover)
+    "hxc",      # expired-lease record's conservative charge (-1 = none)
+    "hxr",      # expired-lease record's reported-at-expiry total
+    "exp",      # a home-side expiry has happened for the current term
+    "rcl",      # a reclaim has been recorded
+    "ref",      # heal refunds issued (at most one per lease id)
+    "skew",     # a wall-clock skew fault is active
+])
+
+#: Lease TTL in model ticks; the slice is CAP tokens, no refill — the
+#: bounds are then equalities at the boundary like every other world.
+FED_TTL = 2
+
+
+class FederationWorld:
+    """One home :class:`FederationLedger` and one region agent under
+    the adversarial WAN: lease / renew / reclaim with duplication,
+    stale replies, independent monotonic clocks on both ends (the two
+    ``*_tick`` labels — a partition is simply the scheduler ticking
+    one side without delivering a renew), wall-clock skew, home-side
+    expiry with the conservative fully-spent charge, region-side
+    expiry into the degraded envelope, and heal reconciliation. The
+    slice bucket is minted ONCE (a re-lease under the same config
+    re-mints nothing — the OP_CONFIG rebase carries spent balances),
+    so ``adm <= CAP + em·ENV`` is exact."""
+
+    name = "federation"
+    invariants = ("fed-lease-monotonic", "fed-no-skew-extension",
+                  "fed-global-bound", "fed-reclaim-idempotent",
+                  "idempotent-replay")
+
+    def __init__(self, facts: Facts) -> None:
+        self.f = facts
+
+    def init_states(self):
+        # Roots: with and without an active skew fault from the start
+        # (skew may also arrive mid-trace via the label).
+        for skew in (False, True):
+            yield FedState(
+                lh=False, le=0, lr=0, hs=0, rs=0, rb=0, minted=False,
+                eb=0, em=0, deg=False, adm=0, rep=0, hb=CAP, d=0,
+                hxc=-1, hxr=0, exp=False, rcl=False, ref=0, skew=skew)
+
+    def labels(self, s: FedState):
+        out = []
+        if not s.lh and s.le < 2 and not s.rcl:
+            out.append("lease")         # first lease / post-heal fresh id
+        if s.le >= 1:
+            out.append("dup_lease")
+        if s.lr >= 2:
+            out.append("stale_reply")
+        if s.lr > 0:
+            out += ["renew", "reclaim"]
+        if s.lr > 0 or s.exp:
+            # A duplicate WAN delivery does not care what the region
+            # currently believes — a post-expiry replay re-enters the
+            # home's heal path, where the popped record keeps the
+            # refund at-most-once.
+            out.append("dup_renew")
+        if s.rcl:
+            out.append("dup_reclaim")
+        if s.lh:
+            out.append("home_tick")
+        if s.lr > 0 and s.rs <= FED_TTL:
+            out.append("region_tick")
+        if s.adm < CAP + 2 * ENV:
+            out.append("admit")
+        if not s.skew:
+            out.append("skew")
+        return out
+
+    def apply(self, s: FedState, label: str):
+        f = self.f
+        viols: list = []
+        before = s
+
+        def dup_changed(op: str, what: str) -> None:
+            viols.append((
+                "idempotent-replay",
+                f"replayed {op} frame changed state: {what} "
+                f"(classified idempotent at {f.remote_file}:"
+                f"{f.idempotent_ops.get(op, 0)})", op))
+
+        if label == "lease":
+            epoch = s.le + 1
+            # ref tracks heal refunds PER LEASE ID (the invariant's
+            # unit): a fresh grant is a fresh id, whose own single
+            # heal is legitimate.
+            s = s._replace(lh=True, le=epoch, lr=epoch, hs=0, rs=0,
+                           deg=False, exp=False, ref=0)
+            if not s.minted:
+                # First lease mints the slice bucket; a re-lease under
+                # the same config re-mints NOTHING (the regional
+                # bucket's spent state persists — config identity).
+                s = s._replace(rb=CAP, minted=True)
+
+        elif label == "dup_lease":
+            if not f.fed_lease_dedup:
+                # The recorded-grant replay is gone: the replayed
+                # frame re-runs the grant body — a new epoch, the old
+                # lease's term restarted, a second conservative
+                # charge staged. Visible state change on a replay.
+                ns = s._replace(le=min(3, s.le + 1),
+                                lr=min(3, s.le + 1), hs=0)
+                if ns != s:
+                    dup_changed("OP_FED_LEASE",
+                                "the grant body ran a second time — "
+                                "a fresh epoch and term were minted "
+                                "for a replayed lease_id")
+                s = ns
+
+        elif label == "stale_reply":
+            # An out-of-order WAN reply carrying epoch lr-1 reaches
+            # the region's adoption path.
+            if not f.fed_adopt_epoch_guard:
+                s = s._replace(lr=s.lr - 1)
+
+        elif label == "home_tick":
+            hs = min(FED_TTL + 1, s.hs + 1)
+            s = s._replace(hs=hs)
+            if hs >= FED_TTL:
+                if f.fed_expiry_monotonic or not s.skew:
+                    s = self._home_expire(s, viols)
+                # else: the wall-based expiry comparison is skewed —
+                # the lease silently outlives its TTL (checked below).
+
+        elif label == "region_tick":
+            rs = min(FED_TTL + 1, s.rs + 1)
+            s = s._replace(rs=rs)
+            if rs >= FED_TTL and s.lr > 0 and not s.deg:
+                # Region-side monotonic expiry: degrade to the
+                # envelope — one fresh envelope budget per episode.
+                s = s._replace(deg=True, eb=ENV, em=min(2, s.em + 1))
+
+        elif label == "renew":
+            if s.lh:
+                delta = s.adm - s.rep
+                s = self._charge(s, delta)._replace(
+                    rep=s.adm, hs=0, rs=0, deg=False)
+            else:
+                # A renew reaching an expired lease is the HEAL path;
+                # the reply tells the region to take a fresh lease.
+                s = self._heal(s, viols)._replace(lr=0)
+
+        elif label == "dup_renew":
+            # Re-delivery of the last processed report: monotonic
+            # totals make its delta max(0, rep − rep) = 0 — absorbing
+            # by construction. The TTL re-arm is its only effect (the
+            # same effect any renew has). A re-delivered POST-EXPIRY
+            # renew re-enters the heal path — where the popped record
+            # is what keeps the refund at-most-once.
+            if s.lh:
+                s = s._replace(hs=0)
+            else:
+                s = self._heal(s, viols)._replace(lr=0)
+
+        elif label in ("reclaim", "dup_reclaim"):
+            dup = label == "dup_reclaim"
+            if dup:
+                # The live recorded-reclaim replay: zero side effects.
+                # (Its absence is pinned by the at-most-once unit
+                # audit in tests/test_federation.py; the model's
+                # double-refund class is the heal-record leak below.)
+                pass
+            elif s.lh:
+                delta = s.adm - s.rep
+                s = self._charge(s, delta)._replace(
+                    rep=s.adm, lh=False, lr=0, rcl=True)
+            else:
+                s = self._heal(s, viols)._replace(lr=0, rcl=True)
+
+        elif label == "admit":
+            if s.deg:
+                if s.eb > 0:
+                    s = s._replace(eb=s.eb - 1, adm=s.adm + 1)
+            elif s.lr > 0 and s.rb > 0:
+                s = s._replace(rb=s.rb - 1, adm=s.adm + 1)
+
+        elif label == "skew":
+            s = s._replace(skew=True)
+
+        else:  # pragma: no cover - label/apply drift is a checker bug
+            raise AssertionError(f"unknown label {label!r}")
+
+        self._post_checks(before, s, viols)
+        return s, viols
+
+    # -- helpers ------------------------------------------------------------
+    def _charge(self, s: FedState, delta: int) -> FedState:
+        if delta <= 0:
+            return s
+        short = max(0, delta - s.hb)
+        return s._replace(hb=max(0, s.hb - delta),
+                          d=min(6, s.d + short))
+
+    def _home_expire(self, s: FedState, viols: list) -> FedState:
+        """The home's monotonic lease expiry: the unreported slice
+        entitlement is presumed FULLY SPENT (conservative) and charged;
+        the heal refund reconciles the true total later."""
+        charge = max(0, CAP - s.rep) if self.f.fed_conservative_spent \
+            else 0
+        s = self._charge(s, charge)._replace(
+            lh=False, hxc=charge, hxr=s.rep, exp=True)
+        if not self.f.fed_conservative_spent:
+            accounted = (CAP - s.hb) + s.d
+            if accounted < CAP:
+                viols.append((
+                    "fed-global-bound",
+                    "home expired an unreachable region's lease "
+                    f"with only {accounted}/{CAP} tokens accounted — "
+                    "the slice must be presumed fully spent until "
+                    "reclaim-or-expiry reconciles (conservative "
+                    "charge at "
+                    f"{self.f.fed_conservative_spent.file}:"
+                    f"{self.f.fed_conservative_spent.line} missing)",
+                    "conservative"))
+        return s
+
+    def _heal(self, s: FedState, viols: list) -> FedState:
+        """A late renew/reclaim reconciling an expired lease's
+        conservative charge: refund = charge − true unreported delta
+        (never negative — the charge was an upper bound). At most one
+        refund per lease id: the record must POP."""
+        if s.hxc < 0:
+            return s   # unknown lease id: counted no-op
+        true_delta = max(0, s.adm - s.hxr)
+        refund = max(0, s.hxc - true_delta)
+        extra = max(0, true_delta - s.hxc)
+        ns = self._charge(s, extra)._replace(
+            hb=min(CAP, s.hb + refund))
+        if refund > 0:
+            ns = ns._replace(ref=min(2, ns.ref + 1))
+        if self.f.fed_heal_once:
+            ns = ns._replace(hxc=-1)
+        return ns
+
+    def _post_checks(self, old: FedState, new: FedState,
+                     viols: list) -> None:
+        if 0 < new.lr < old.lr:
+            viols.append((
+                "fed-lease-monotonic",
+                f"the region's adopted lease epoch went backwards "
+                f"({old.lr} -> {new.lr}): a stale out-of-order WAN "
+                "reply rolled the applied slice config back (epoch "
+                f"guard at {self.f.fed_adopt_epoch_guard.file}:"
+                f"{self.f.fed_adopt_epoch_guard.line})", "epoch"))
+        if new.lh and new.hs > FED_TTL:
+            viols.append((
+                "fed-no-skew-extension",
+                f"the lease outlived its TTL ({new.hs} ticks > "
+                f"{FED_TTL}) under a wall-clock skew fault — expiry "
+                "must be keyed on the MONOTONIC clock "
+                f"({self.f.fed_expiry_monotonic.file}:"
+                f"{self.f.fed_expiry_monotonic.line})", "skew"))
+        if new.adm > CAP + new.em * ENV:
+            viols.append((
+                "fed-global-bound",
+                f"region admitted {new.adm} tokens against a slice of "
+                f"{CAP} + {new.em} envelope episode(s) x {ENV} — the "
+                "partition envelope bound is exceeded", "bound"))
+        if new.ref > 1:
+            viols.append((
+                "fed-reclaim-idempotent",
+                f"{new.ref} heal refunds issued for one lease id — "
+                "the expired-lease record must pop at the first "
+                f"reconciliation ({self.f.fed_heal_once.file}:"
+                f"{self.f.fed_heal_once.line})", "refunds"))
+
+
+# ===========================================================================
 # Breaker world
 # ===========================================================================
 
@@ -1007,7 +1304,8 @@ class ProductWorld:
 
 def all_worlds(facts: Facts, *, include_product: bool = True) -> list:
     worlds = [MigrationWorld(facts), ConfigWorld(facts),
-              ReservationWorld(facts), BreakerWorld(facts)]
+              ReservationWorld(facts), FederationWorld(facts),
+              BreakerWorld(facts)]
     if include_product:
         worlds.append(ProductWorld(MigrationWorld(facts),
                                    ConfigWorld(facts)))

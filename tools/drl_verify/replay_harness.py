@@ -523,11 +523,217 @@ class BreakerHarness:
         return problems
 
 
+class FederationHarness:
+    """One real FederationLedger (home, over an InProcessBucketStore)
+    plus a real RegionFederation lease record under SEPARATE manual
+    monotonic clocks for the two ends — a model tick is 0.6 s against
+    a 1.0 s lease TTL (two ticks elapse it, the model's FED_TTL = 2).
+    The wall clocks are independently skewable and must never move a
+    lease lifetime."""
+
+    TICK = 0.6
+    TTL_S = 1.0
+    REGION = "drlv:region"
+
+    def __init__(self) -> None:
+        from distributedratelimiting.redis_tpu.runtime.federation import (
+            FederationLedger,
+            RegionFederation,
+        )
+        from distributedratelimiting.redis_tpu.runtime.store import (
+            InProcessBucketStore,
+        )
+
+        self.home_mono = _ManualClock()
+        self.region_mono = _ManualClock()
+        self.wall_skew = [0.0]
+        self.store = InProcessBucketStore(clock=self.home_mono)
+        self.led: FederationLedger = self.store.federation_ledger(
+            clock=self.home_mono,
+            wall=lambda: 1e9 + self.wall_skew[0],
+            default_ttl_s=self.TTL_S)
+        self.agent = RegionFederation(
+            self.REGION, self.led,
+            tenants={TENANT: (CAP, 0.0)},
+            ttl_s=self.TTL_S, clock=self.region_mono,
+            wall=lambda: 1e9 + self.wall_skew[0])
+        self.lo = self.agent._leases[TENANT]
+        self.lease_seq = 0
+        self.last_lease_payload: "dict | None" = None
+        self.last_renew_payload: "dict | None" = None
+        self.last_reclaim_payload: "dict | None" = None
+        self.admitted = 0.0
+        self.slice_budget = 0.0
+        self.env_budget = 0.0
+        self.epochs_seen = [0]
+        self.refunds_by_lease: "dict[str, int]" = {}
+        self.problems: "list[str]" = []
+
+    async def prepare_root(self, root) -> None:
+        if getattr(root, "skew", False):
+            self.wall_skew[0] = 3600.0
+
+    def _note_refund(self, lease_id: str, reply: dict) -> None:
+        if float(reply.get("refunded", 0.0)) > 0:
+            self.refunds_by_lease[lease_id] = \
+                self.refunds_by_lease.get(lease_id, 0) + 1
+
+    async def step(self, label: str) -> None:
+        led, lo = self.led, self.lo
+        if label == "lease":
+            self.lease_seq += 1
+            payload = {"region": self.REGION,
+                       "lease_id": f"L{self.lease_seq}",
+                       "tenant": TENANT, "demand": 1.0,
+                       "global_cap": CAP, "global_rate": 0.0,
+                       "ttl_s": self.TTL_S}
+            self.last_lease_payload = payload
+            reply = await led.lease(payload)
+            if reply.get("granted"):
+                if lo.lease_id is None and self.slice_budget == 0 \
+                        and not lo.applied:
+                    # First grant mints the slice budget; re-leases
+                    # under the same config re-mint nothing (the
+                    # regional bucket's state persists).
+                    self.slice_budget = float(reply["slice"][0])
+                lo.lease_id = payload["lease_id"]
+                lo.degraded = False
+                self.agent._arm(lo, self.region_mono())
+                await self.agent._adopt(TENANT, lo,
+                                        int(reply["epoch"]),
+                                        reply["slice"])
+            return
+        if label == "dup_lease":
+            if self.last_lease_payload is None:
+                return
+            before = (led.outstanding_leases(), self.lo.epoch)
+            reply = await led.lease(dict(self.last_lease_payload))
+            if not reply.get("duplicate"):
+                self.problems.append(
+                    "idempotent-replay: a replayed OP_FED_LEASE was "
+                    "not answered from the recorded grant")
+            after = (led.outstanding_leases(), self.lo.epoch)
+            if before != after:
+                self.problems.append(
+                    "idempotent-replay: a replayed OP_FED_LEASE "
+                    f"changed state {before} -> {after}")
+            return
+        if label == "stale_reply":
+            await self.agent._adopt(TENANT, lo, lo.epoch - 1,
+                                    [999.0, 999.0])
+            return
+        if label == "home_tick":
+            self.home_mono.advance(self.TICK)
+            self.led.expire()
+            return
+        if label == "region_tick":
+            self.region_mono.advance(self.TICK)
+            if (lo.lease_id is not None and not lo.degraded
+                    and self.region_mono() >= lo.expires_mono):
+                await self.agent._degrade(TENANT, lo)
+                self.env_budget = float(
+                    (lo.applied or (1.0, 0.0))[0])
+            return
+        if label in ("renew", "dup_renew"):
+            if label == "renew" or self.last_renew_payload is None:
+                if lo.lease_id is None:
+                    return
+                payload = {"region": self.REGION,
+                           "lease_id": lo.lease_id, "tenant": TENANT,
+                           "total": self.admitted, "demand": 1.0}
+                self.last_renew_payload = payload
+            else:
+                payload = dict(self.last_renew_payload)
+            reply = await led.renew(payload)
+            self._note_refund(payload["lease_id"], reply)
+            if reply.get("outcome") == "ok" and label == "renew":
+                self.agent._arm(lo, self.region_mono())
+                lo.degraded = False
+                await self.agent._adopt(TENANT, lo,
+                                        int(reply.get("epoch", 0)),
+                                        reply.get("slice")
+                                        or [lo.slice_cap,
+                                            lo.slice_rate])
+            elif reply.get("outcome") in ("expired", "unknown") \
+                    and label == "renew":
+                lo.lease_id = None
+            return
+        if label in ("reclaim", "dup_reclaim"):
+            if label == "reclaim":
+                if lo.lease_id is None:
+                    return
+                payload = {"region": self.REGION,
+                           "lease_id": lo.lease_id, "tenant": TENANT,
+                           "total": self.admitted}
+                self.last_reclaim_payload = payload
+            else:
+                if self.last_reclaim_payload is None:
+                    return
+                payload = dict(self.last_reclaim_payload)
+            reply = await led.reclaim(payload)
+            self._note_refund(payload["lease_id"], reply)
+            if label == "dup_reclaim" \
+                    and reply.get("outcome") not in ("duplicate",
+                                                     "unknown"):
+                self.problems.append(
+                    "fed-reclaim-idempotent: a replayed "
+                    "OP_FED_RECLAIM re-executed "
+                    f"({reply.get('outcome')})")
+            if label == "reclaim" \
+                    and reply.get("outcome") in ("reclaimed",
+                                                 "duplicate"):
+                lo.lease_id = None
+            return
+        if label == "admit":
+            if lo.degraded:
+                if self.env_budget >= 1:
+                    self.env_budget -= 1
+                    self.admitted += 1
+            elif lo.lease_id is not None and self.slice_budget >= 1:
+                self.slice_budget -= 1
+                self.admitted += 1
+            return
+        if label == "skew":
+            self.wall_skew[0] = 3600.0
+            # Skew must not move lease lifetimes: with NO monotonic
+            # advance, nothing new may expire.
+            before = self.led.leases_expired
+            self.led.expire()
+            if self.led.leases_expired != before:
+                self.problems.append(
+                    "fed-no-skew-extension: a wall-clock skew alone "
+                    "expired a lease")
+            return
+        raise AssertionError(f"harness does not map label {label!r}")
+
+    def check(self) -> "list[str]":
+        problems = list(self.problems)
+        for lease_id, n in self.refunds_by_lease.items():
+            if n > 1:
+                problems.append(
+                    f"fed-reclaim-idempotent: {n} heal refunds "
+                    f"issued for lease {lease_id}")
+        # Home accounting: every charge landed in the bucket or in
+        # debt (clamped refunds can only UNDER-credit — conservative).
+        bal = self.store.peek_blocking(TENANT, CAP, 0.0)
+        spent = CAP - bal
+        debt = sum(self.led.debts().values())
+        if self.led.charged_tokens - self.led.refunded_tokens \
+                > spent + debt + 1e-9:
+            problems.append(
+                "fed-global-bound: home charged "
+                f"{self.led.charged_tokens} - refunded "
+                f"{self.led.refunded_tokens} but only {spent} spent "
+                f"+ {debt} debt are accounted")
+        return problems
+
+
 HARNESSES = {
     "migration": MigrationHarness,
     "reservation": ReservationHarness,
     "config": ConfigHarness,
     "breaker": BreakerHarness,
+    "federation": FederationHarness,
 }
 
 
