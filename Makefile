@@ -9,11 +9,11 @@ CXX ?= g++
 
 .PHONY: check lint verify-model test native asan-test tsan-test \
         chaos-test reshard-soak upgrade-soak parity-fuzz llm-soak \
-        controller-soak reserve-soak federation-soak
+        controller-soak reserve-soak federation-soak uring-test
 
 check: lint verify-model test chaos-test upgrade-soak parity-fuzz \
-       llm-soak controller-soak reserve-soak federation-soak \
-       asan-test tsan-test
+       uring-test llm-soak controller-soak reserve-soak \
+       federation-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -116,6 +116,18 @@ controller-soak:
 parity-fuzz:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_parity_fuzz.py \
 	  tests/test_native_bulk.py tests/test_native_shards.py \
+	  -v -p no:cacheprovider
+
+# io_uring transport suite (round 16, docs/OPERATIONS.md §17): the
+# feature-detection matrix always runs (kill switch, simulated seccomp
+# denial, stale-binary fallback — those ARE the epoll-fallback paths),
+# and the live-ring arms self-skip inside pytest when the kernel lacks
+# io_uring. The banner below makes that skip loud at the make level so
+# "uring-test passed" on a ringless host is never read as ring
+# coverage. Parity arms for the uring transport ride parity-fuzz.
+uring-test:
+	@JAX_PLATFORMS=cpu $(PY) -c "import sys; from distributedratelimiting.redis_tpu.runtime.native_frontend import uring_probe; ok, why = uring_probe(); sys.stdout.write('' if ok else 'uring-test: NO RING on this host (%s) -- live-ring arms SELF-SKIP; running the fallback/feature-detection matrix only\n' % why)" || true
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_uring.py \
 	  -v -p no:cacheprovider
 
 # Explicit native builds (the loader also builds on first import).

@@ -643,13 +643,19 @@ def _serving_server_child(backing_kind: str = "device",
                           native: bool = False,
                           tier0: bool = False,
                           shards: int = 1,
-                          pin: bool = False) -> None:
+                          pin: bool = False,
+                          uring: str | None = None) -> None:
     """Server half of the co-located stand-in: owns the (CPU-platform)
     device store and its kernel — or, for ``backing_kind="instant"``, the
     pure-Python ``InProcessBucketStore`` whose microsecond kernel makes
     the serving histogram a pure framework-overhead measurement. With
-    ``native=True`` the sockets are served by the C++ epoll front-end
-    (native/frontend.cc). Parks until the parent closes stdin."""
+    ``native=True`` the sockets are served by the C++ front-end
+    (native/frontend.cc) — epoll by default, or the io_uring data plane
+    when ``uring`` is ``"on"``/``"sqpoll"`` (round 16). Parks until the
+    parent closes stdin, then prints ONE more JSON line — the transport
+    counters (fe_uring_counts: data-plane syscalls, ring enters, SQEs,
+    fallbacks) and this process's rusage CPU-seconds — so the rig can
+    charge syscalls/frame and cycles/row to the server, not the client."""
     if pin:
         # CPU discipline for the pinned multi-shard rig: the C shard
         # threads get CPUs 0..N-1 EXCLUSIVELY (fe_start_sharded pins
@@ -706,11 +712,26 @@ def _serving_server_child(backing_kind: str = "device",
                                      native_frontend=native,
                                      native_tier0=native_tier0,
                                      native_shards=shards,
-                                     native_pin_shards=pin) as srv:
+                                     native_pin_shards=pin,
+                                     native_uring=uring) as srv:
             print(json.dumps({"host": srv.host, "port": srv.port}),
                   flush=True)
             await asyncio.get_running_loop().run_in_executor(
                 None, sys.stdin.read)
+            # Shutdown report, read by _shard_rig AFTER it closes our
+            # stdin: transport counters must be sampled while the
+            # front-end is still up (the handle dies with the context
+            # manager), and rusage here charges the server process only.
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            tail: dict = {"server_cpu_s": round(ru.ru_utime
+                                                + ru.ru_stime, 4)}
+            if native and srv._native is not None:
+                ts = srv._native.transport_stats()
+                if ts is not None:
+                    tail["transport"] = ts
+            print(json.dumps(tail), flush=True)
         await backing.aclose()
 
     asyncio.run(run())
@@ -965,7 +986,11 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
     the core the measurement is charging). The kernel's SO_REUSEPORT
     hash spreads each thread's 4 connections across shards. Reports
     the aggregate rows/s over the threads' own windows plus the
-    server's merged and per-shard gauges."""
+    server's merged and per-shard gauges, and the total frames/rows
+    this child pushed (warm included) so the rig can divide the
+    server's lifetime syscall counter by a lifetime denominator.
+    ``DRL_BENCH_SHARD_FRAMES`` / ``DRL_BENCH_SHARD_ROWS`` shrink the
+    per-thread workload for small hosts (defaults 400 / 4096)."""
     from distributedratelimiting.redis_tpu.utils.cpu_bootstrap import (
         maybe_force_cpu_from_env,
     )
@@ -989,6 +1014,8 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
     client_cpus = (set(range(first, nproc))
                    if first < nproc else set(range(nproc)))
     n_threads = max(6, 4 * n_shards)
+    frames_hot = int(os.environ.get("DRL_BENCH_SHARD_FRAMES", "400"))
+    rows_hot = int(os.environ.get("DRL_BENCH_SHARD_ROWS", "4096"))
 
     def one(out: list, warm: bool) -> None:
         try:
@@ -997,9 +1024,9 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
             pass  # restricted cpuset: measure unpinned
         frames, rows, granted, el = native_bulk_loadgen(
             host, int(port), conns=4, depth=2 if warm else 8,
-            frames_per_conn=10 if warm else 400,
-            rows_per_frame=1024 if warm else 4096, keyspace=64)
-        out.append((rows, granted, el))
+            frames_per_conn=10 if warm else frames_hot,
+            rows_per_frame=1024 if warm else rows_hot, keyspace=64)
+        out.append((frames, rows, granted, el))
 
     async def run() -> None:
         store = RemoteBucketStore(address=(host, int(port)))
@@ -1011,6 +1038,8 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
             t.start()
         for t in th:
             t.join()
+        frames_sent = sum(f for f, _r, _g, _el in rows_out)
+        rows_sent = sum(r for _f, r, _g, _el in rows_out)
         await store.stats(reset=True)
         best = 0.0
         for _ in range(3):
@@ -1021,12 +1050,16 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
                 t.start()
             for t in th:
                 t.join()
-            best = max(best, sum(r / el for r, _g, el in rows_out))
+            best = max(best, sum(r / el for _f, r, _g, el in rows_out))
+            frames_sent += sum(f for f, _r, _g, _el in rows_out)
+            rows_sent += sum(r for _f, r, _g, _el in rows_out)
         stats = await store.stats()
         out = {
             "rows_per_s": best,
             "shards": n_shards,
             "load_threads": n_threads,
+            "frames_sent": frames_sent,
+            "rows_sent": rows_sent,
             "p50_ms": stats["serving_p50_ms"],
             "p99_ms": stats["serving_p99_ms"],
         }
@@ -1046,10 +1079,15 @@ def _shard_load_child(host: str, port: str, shards: str) -> None:
     asyncio.run(run())
 
 
-def _shard_rig(shards: int, timeout_s: float) -> dict | None:
+def _shard_rig(shards: int, timeout_s: float,
+               uring: str | None = None) -> dict | None:
     """One multi-shard measurement: an instant-backed native server
-    child with ``shards`` pinned epoll shards (tier-0 armed), driven by
-    a --shard-load-child (the bench_serving_p99_cpu child discipline)."""
+    child with ``shards`` pinned shards (tier-0 armed), driven by a
+    --shard-load-child (the bench_serving_p99_cpu child discipline).
+    ``uring`` picks the transport arm ("on"/"sqpoll"; None = epoll).
+    After the load finishes the server's stdin is closed and its
+    shutdown line (transport counters + rusage) is folded into the
+    load child's result."""
     import concurrent.futures
     import subprocess
 
@@ -1060,10 +1098,13 @@ def _shard_rig(shards: int, timeout_s: float) -> dict | None:
     env = os.environ.copy()
     env[FORCE_CPU_ENV] = "1"
     deadline = time.monotonic() + timeout_s
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--serving-server-child", "instant", "native", "tier0",
+            f"shards={shards}", "pin"]
+    if uring is not None:
+        argv.append(f"uring={uring}")
     server = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__),
-         "--serving-server-child", "instant", "native", "tier0",
-         f"shards={shards}", "pin"],
+        argv,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
     pool = concurrent.futures.ThreadPoolExecutor(1)
     try:
@@ -1078,12 +1119,18 @@ def _shard_rig(shards: int, timeout_s: float) -> dict | None:
             timeout=max(deadline - time.monotonic(), 30.0))
         if load.returncode != 0:
             return None
-        return json.loads(load.stdout.strip().splitlines()[-1])
+        res = json.loads(load.stdout.strip().splitlines()[-1])
+        server.stdin.close()
+        tail = pool.submit(server.stdout.readline).result(timeout=30.0)
+        if tail.strip():
+            res.update(json.loads(tail))
+        return res
     except Exception:
         return None
     finally:
         try:
-            server.stdin.close()
+            if not server.stdin.closed:
+                server.stdin.close()
             server.wait(timeout=10)
         except Exception:
             server.kill()
@@ -1115,6 +1162,83 @@ def bench_native_shards(timeout_s: float = 600.0) -> dict | None:
         out["speedup_8v1"] = (out["s8"]["rows_per_s"]
                               / out["s1"]["rows_per_s"])
     return out
+
+
+def _nominal_mhz() -> float:
+    """Nominal clock for the cycles/row stand-in: first ``cpu MHz``
+    row of /proc/cpuinfo, 2 GHz when the field is absent (ARM,
+    containers that mask cpuinfo). A stand-in, not a cycle counter —
+    the column is only compared across arms on the SAME host."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    return float(line.split(":", 1)[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return 2000.0
+
+
+def bench_native_uring(timeout_s: float = 540.0) -> dict | None:
+    """``serving_native_uring`` section: transport economics of the
+    multi-shard front-end (round 16). The serving_native_shards rig,
+    run once per transport arm — epoll, io_uring, io_uring+SQPOLL —
+    at 1/4/8 shards. Two headline columns per arm:
+
+    - syscalls/frame — the server's own data-plane syscall counter
+      (every accept/recv/send/epoll_wait/io_uring_enter is counted in
+      C at the call site, both transports) divided by the frames the
+      load child pushed over the server's lifetime. This is the number
+      the io_uring rebuild exists to shrink: one ring enter drains and
+      submits for every ready connection, where epoll pays a recv and
+      a send per connection per burst, and SQPOLL retires the submit
+      enter too.
+    - cycles/row — server-process rusage CPU-seconds x nominal MHz
+      divided by rows pushed: an honest CPU stand-in (documented as
+      such in RESULTS.md), not a hardware cycle counter.
+
+    A uring arm whose shards fell back to epoll (old kernel, seccomp)
+    is reported with ``fell_back: true`` instead of being passed off
+    as ring numbers; kernels with no io_uring at all run the epoll arm
+    only and say so in ``probe``."""
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        uring_probe,
+    )
+
+    ok, reason = uring_probe()
+    mhz = _nominal_mhz()
+    out: dict = {"uring_available": ok, "probe": reason,
+                 "nominal_mhz": round(mhz, 1)}
+    arms = [("epoll", None)]
+    if ok:
+        arms += [("uring", "on"), ("sqpoll", "sqpoll")]
+    points = [(name, uring, s) for name, uring in arms for s in (1, 4, 8)]
+    budget = max(timeout_s / (len(points) + 1.0), 40.0)
+    got_any = False
+    for name, uring, s in points:
+        res = _shard_rig(s, budget, uring=uring)
+        if res is None:
+            continue
+        row: dict = {"rows_per_s": res["rows_per_s"],
+                     "p50_ms": res["p50_ms"], "p99_ms": res["p99_ms"]}
+        tr = res.get("transport")
+        if tr is not None:
+            row["uring_shards"] = tr["uring_shards"]
+            row["fallbacks"] = tr["fallbacks"]
+            if uring is not None and tr["uring_shards"] < s:
+                row["fell_back"] = True  # loud: NOT ring numbers
+            frames = res.get("frames_sent")
+            if frames:
+                row["syscalls_per_frame"] = round(
+                    tr["io_syscalls"] / frames, 3)
+        cpu_s = res.get("server_cpu_s")
+        rows_sent = res.get("rows_sent")
+        if cpu_s and rows_sent:
+            row["cycles_per_row"] = round(
+                cpu_s * mhz * 1e6 / rows_sent, 1)
+        out[f"{name}_s{s}"] = row
+        got_any = True
+    return out if got_any else None
 
 
 def bench_metrics_overhead() -> tuple[float, float, float, int,
@@ -1398,6 +1522,18 @@ RESULT: dict = {
     "serving_native_shards_speedup_8v1": None,
     "serving_native_shards_p99_s4_ms": None,
     "serving_native_shards_local_frac_s4": None,
+    # io_uring data plane (round 16): the same pinned shard rig per
+    # transport arm — epoll vs uring vs uring+SQPOLL — with the
+    # server's C-side data-plane syscall counter divided by frames
+    # pushed, and rusage-derived cycles/row. Acceptance: syscalls/frame
+    # on the ring ≤ 1/10 of epoll's at the multi-connection point.
+    "serving_native_uring_available": None,
+    "serving_native_uring_syscalls_per_frame_epoll_s4": None,
+    "serving_native_uring_syscalls_per_frame_uring_s4": None,
+    "serving_native_uring_syscalls_per_frame_sqpoll_s4": None,
+    "serving_native_uring_syscall_reduction_s4": None,
+    "serving_native_uring_rows_per_s_uring_s4": None,
+    "serving_native_uring_p99_uring_s4_ms": None,
     # Observability-plane cost audit: closed-loop per-request rate with
     # the plane (heavy hitters + flight recorder + /metrics listener +
     # stage stamps) enabled vs observability=False. Contract: <3%.
@@ -1807,6 +1943,40 @@ def main() -> int:
                     s4["rows_local_frac"], 4)
         _emit()
 
+    def sec_native_uring():
+        out = bench_native_uring(timeout_s=min(540.0,
+                                               max(_remaining(), 60.0)))
+        if out is None:
+            raise RuntimeError("uring-sweep children failed or timed out")
+        return out
+
+    status, value = _section("serving_native_uring", sec_native_uring,
+                             timeout_s=560)
+    if status == "ok" and value is not None:
+        RESULT["serving_native_uring_available"] = value.get(
+            "uring_available")
+        spf = {}
+        for arm in ("epoll", "uring", "sqpoll"):
+            row = value.get(f"{arm}_s4")
+            if row is None or row.get("fell_back"):
+                continue
+            if "syscalls_per_frame" in row:
+                spf[arm] = row["syscalls_per_frame"]
+                RESULT[f"serving_native_uring_syscalls_per_frame"
+                       f"_{arm}_s4"] = row["syscalls_per_frame"]
+        if "epoll" in spf and ("sqpoll" in spf or "uring" in spf):
+            ring = spf.get("sqpoll", spf.get("uring"))
+            if ring:
+                RESULT["serving_native_uring_syscall_reduction_s4"] = \
+                    round(spf["epoll"] / ring, 2)
+        u4 = value.get("uring_s4")
+        if u4 is not None and not u4.get("fell_back"):
+            RESULT["serving_native_uring_rows_per_s_uring_s4"] = round(
+                u4["rows_per_s"])
+            RESULT["serving_native_uring_p99_uring_s4_ms"] = round(
+                u4["p99_ms"], 3)
+        _emit()
+
     def sec_metrics_overhead():
         (on_rate, off_rate, pct, scraped,
          trace_rate, trace_pct) = bench_metrics_overhead()
@@ -1851,12 +2021,15 @@ if __name__ == "__main__":
         kind = sys.argv[i + 1] if len(sys.argv) > i + 1 else "device"
         rest = sys.argv[i + 2:]
         shards = 1
+        uring = None
         for arg in rest:
             if arg.startswith("shards="):
                 shards = int(arg.split("=", 1)[1])
+            elif arg.startswith("uring="):
+                uring = arg.split("=", 1)[1]
         _serving_server_child(kind, native="native" in rest,
                               tier0="tier0" in rest, shards=shards,
-                              pin="pin" in rest)
+                              pin="pin" in rest, uring=uring)
         sys.exit(0)
     if "--shard-load-child" in sys.argv:
         i = sys.argv.index("--shard-load-child")

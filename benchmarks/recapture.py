@@ -267,6 +267,80 @@ def _debt_native_fe_shard_sweep(smoke: bool) -> dict:
             "unit": "rows/s per shard count"}
 
 
+def _debt_native_fe_uring_sweep(smoke: bool) -> dict:
+    """The io_uring data plane (round 16) against a DEVICE-class
+    backing: the round-11 shard rig once per transport arm — epoll vs
+    io_uring vs io_uring+SQPOLL at 1 and 4 shards — harvesting the
+    server child's shutdown line (fe_uring_counts data-plane syscall
+    counter + rusage CPU-seconds) so syscalls/frame and cycles/row get
+    device-backed numbers instead of the CPU stand-ins in
+    evidence/native_uring_r16.jsonl. On a host whose kernel lacks
+    io_uring only the epoll arm runs and the probe verdict is
+    recorded beside it — a fallback run never masquerades as ring
+    numbers (the per-arm rows carry uring_shards/fallbacks)."""
+    import concurrent.futures
+
+    from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+        uring_probe,
+    )
+
+    env = os.environ.copy()
+    env.pop("DRL_TPU_FORCE_CPU", None)
+    if smoke:
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    ok, reason = uring_probe()
+    arms = [("epoll", None)]
+    if ok:
+        arms += [("uring", "on"), ("sqpoll", "sqpoll")]
+    out: dict = {"uring_available": ok, "probe": reason}
+    for name, uring in arms:
+        for shards in (1, 4):
+            argv = [sys.executable, str(_ROOT / "bench.py"),
+                    "--serving-server-child", "device", "native",
+                    "tier0", f"shards={shards}", "pin"]
+            if uring is not None:
+                argv.append(f"uring={uring}")
+            server = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True, env=env, cwd=str(_ROOT))
+            pool = concurrent.futures.ThreadPoolExecutor(1)
+            try:
+                line = pool.submit(server.stdout.readline).result(
+                    timeout=180.0)
+                addr = json.loads(line)
+                load = subprocess.run(
+                    [sys.executable, str(_ROOT / "bench.py"),
+                     "--shard-load-child", addr["host"],
+                     str(addr["port"]), str(shards)],
+                    capture_output=True, text=True, env=env,
+                    cwd=str(_ROOT), timeout=600)
+                if load.returncode != 0:
+                    raise RuntimeError(
+                        f"{name}_s{shards} load child failed: "
+                        f"{load.stderr.strip()[-400:]}")
+                res = json.loads(load.stdout.strip().splitlines()[-1])
+                server.stdin.close()
+                tail = pool.submit(server.stdout.readline).result(
+                    timeout=60.0)
+                if tail.strip():
+                    res.update(json.loads(tail))
+                tr = res.get("transport")
+                if tr and res.get("frames_sent"):
+                    res["syscalls_per_frame"] = round(
+                        tr["io_syscalls"] / res["frames_sent"], 3)
+                out[f"{name}_s{shards}"] = res
+            finally:
+                try:
+                    if not server.stdin.closed:
+                        server.stdin.close()
+                    server.wait(30)
+                except Exception:
+                    server.kill()
+                pool.shutdown(wait=False)
+    return {"metric": "uring_transport_sweep", "sweep": out,
+            "unit": "syscalls/frame + rows/s per transport arm"}
+
+
 def _debt_federation_device(smoke: bool) -> dict:
     """The WAN federation lane (ISSUE 15) against the DEVICE store:
     the region's local decisions from a leased slice are ordinary
@@ -355,6 +429,12 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "the home's debit_many settle lane under renew reports) rest on "
      "the CPU stand-in (benchmarks/federation.py)",
      _debt_federation_device),
+    ("native_fe_uring_sweep",
+     "the io_uring data plane (round 16) has no device number: the "
+     "epoll/uring/sqpoll transport sweep — syscalls/frame and "
+     "cycles/row against a real multi-ms flush — rests on the CPU "
+     "stand-in (evidence/native_uring_r16.jsonl)",
+     _debt_native_fe_uring_sweep),
 ]
 
 

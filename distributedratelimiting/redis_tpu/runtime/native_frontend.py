@@ -48,12 +48,63 @@ from distributedratelimiting.redis_tpu.utils.metrics import (
     LatencyHistogram,
     Tier0Metrics,
 )
-from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+from distributedratelimiting.redis_tpu.utils.native import (
+    URING_OFF,
+    URING_ON,
+    URING_SQPOLL,
+    load_frontend_lib,
+)
 
 __all__ = ["NativeFrontend", "Tier0Config", "native_loadgen",
-           "native_bulk_loadgen"]
+           "native_bulk_loadgen", "uring_probe"]
 
 logger = logging.getLogger(__name__)
+
+#: Accepted spellings of the uring knob (constructor param, env var,
+#: CLI) → fe_start_sharded2 transport mode. The C side accepts the same
+#: strings in DRL_TPU_URING (uring_mode_from_env) so the two resolution
+#: paths can never disagree on a spelling.
+_URING_SPELLINGS = {
+    "": URING_OFF, "0": URING_OFF, "off": URING_OFF,
+    "1": URING_ON, "on": URING_ON, "uring": URING_ON,
+    "2": URING_SQPOLL, "sqpoll": URING_SQPOLL,
+}
+
+
+def _resolve_uring_mode(uring: "str | bool | int | None") -> int:
+    """Constructor/CLI knob → transport mode. ``None`` defers to the
+    ``DRL_TPU_URING`` env var (off when unset) — the conservative
+    default that keeps every existing caller on the epoll lane unless
+    the operator opts in."""
+    import os
+
+    if uring is None:
+        uring = os.environ.get("DRL_TPU_URING", "")
+    if isinstance(uring, bool):
+        return URING_ON if uring else URING_OFF
+    if isinstance(uring, int):
+        if uring not in (URING_OFF, URING_ON, URING_SQPOLL):
+            raise ValueError(f"unknown uring mode {uring!r}")
+        return uring
+    key = str(uring).strip().lower()
+    if key not in _URING_SPELLINGS:
+        raise ValueError(
+            f"unknown uring mode {uring!r}; use off/on/sqpoll")
+    return _URING_SPELLINGS[key]
+
+
+def uring_probe() -> tuple[bool, str]:
+    """Runtime io_uring availability: ``(available, reason)``. Reason is
+    human-readable either way (the probe's success string names the
+    feature level it verified; failure names the refusing syscall or
+    gate — OPERATIONS.md §17 shows the table)."""
+    lib = load_frontend_lib()
+    if lib is None or not getattr(lib, "has_uring", False):
+        return False, ("native front-end library unavailable or "
+                       "predates the uring ABI")
+    buf = ctypes.create_string_buffer(256)
+    ok = lib.fe_uring_probe(buf, len(buf))
+    return bool(ok), buf.value.decode("utf-8", "replace")
 
 
 @dataclass(frozen=True)
@@ -115,7 +166,8 @@ class NativeFrontend:
                  max_batch: int = 4096, deadline_us: int = 300,
                  tier0: "Tier0Config | bool | None" = None,
                  bulk: bool = True, shards: int = 1,
-                 pin_shards: bool = False) -> None:
+                 pin_shards: bool = False,
+                 uring: "str | bool | int | None" = None) -> None:
         lib = load_frontend_lib()
         if lib is None:
             raise RuntimeError(
@@ -151,7 +203,26 @@ class NativeFrontend:
                 "binary predates the shard ABI; serving single-shard",
                 shards)
             shards = 1
-        if has_shards:
+        # io_uring transport (round 16): the data plane swaps under the
+        # same reply bytes — DESIGN.md §21. Default is epoll unless the
+        # knob (param > DRL_TPU_URING env > off) asks otherwise; a
+        # stale .so or failed runtime probe falls back loudly, never
+        # fails the bind (availability over throughput, same posture as
+        # the shard fallback above).
+        mode = _resolve_uring_mode(uring)
+        has_uring = getattr(lib, "has_uring", False)
+        if mode != URING_OFF and not has_uring:
+            logger.warning(
+                "io_uring transport requested but the loaded binary "
+                "predates the uring ABI; serving on epoll")
+            mode = URING_OFF
+        self.uring_mode = mode
+        if has_uring:
+            self._h = lib.fe_start_sharded2(
+                numeric_host.encode(), port, max_batch, deadline_us,
+                1 if server.auth_token is not None else 0, shards,
+                1 if pin_shards else 0, mode)
+        elif has_shards:
             self._h = lib.fe_start_sharded(
                 numeric_host.encode(), port, max_batch, deadline_us,
                 1 if server.auth_token is not None else 0, shards,
@@ -162,6 +233,24 @@ class NativeFrontend:
                 1 if server.auth_token is not None else 0)
         if not self._h:
             raise OSError(f"native front-end failed to bind {host}:{port}")
+        if mode != URING_OFF:
+            # Per-shard fallback is graceful but never silent: name
+            # every shard that could not get a ring and why.
+            n_uring = int(lib.fe_uring_shards(self._h))
+            n_total = int(lib.fe_shard_count(self._h)) if has_shards else 1
+            if n_uring < n_total:
+                buf = ctypes.create_string_buffer(256)
+                for i in range(n_total):
+                    if lib.fe_uring_reason(self._h, i, buf,
+                                           len(buf)) == 0:
+                        logger.warning(
+                            "io_uring requested but shard %d fell back "
+                            "to epoll: %s", i,
+                            buf.value.decode("utf-8", "replace")
+                            or "no reason recorded")
+            self.uring_shards = n_uring
+        else:
+            self.uring_shards = 0
         self.port = lib.fe_port(self._h)
         self.host = host
         self._stopping = False
@@ -1306,6 +1395,42 @@ class NativeFrontend:
             out.append(row)
         return out
 
+    def transport_stats(self) -> dict | None:
+        """Uring transport gauges (``None`` when the loaded binary
+        predates the uring ABI): shard counts by transport, ring
+        counters (enter syscalls, SQEs submitted, CQEs reaped), the
+        self-instrumented data-plane syscall counter both transports
+        maintain (the ``syscalls/frame`` numerator in
+        benchmarks/RESULTS.md §r16), and per-shard fallback reasons
+        when uring was requested but a shard serves on epoll."""
+        if not getattr(self._lib, "has_uring", False) or self._h is None:
+            return None
+        c = ctypes
+        counts = (c.c_longlong * 8)()
+        self._lib.fe_uring_counts(self._h, counts)
+        out = {
+            "mode": {URING_OFF: "epoll", URING_ON: "uring",
+                     URING_SQPOLL: "uring+sqpoll"}[self.uring_mode],
+            "uring_shards": int(counts[0]),
+            "sqpoll_shards": int(counts[1]),
+            "enters": int(counts[2]),
+            "sqes_submitted": int(counts[3]),
+            "cqes_seen": int(counts[4]),
+            "io_syscalls": int(counts[5]),
+            "fallbacks": int(counts[6]),
+        }
+        if self.uring_mode != URING_OFF and out["fallbacks"]:
+            buf = ctypes.create_string_buffer(256)
+            reasons = {}
+            for i in range(self.n_shards):
+                if self._lib.fe_uring_reason(self._h, i, buf,
+                                             len(buf)) == 0:
+                    reasons[i] = (buf.value.decode("utf-8", "replace")
+                                  or "no reason recorded")
+            if reasons:
+                out["fallback_reasons"] = reasons
+        return out
+
     # -- stats / lifecycle -------------------------------------------------
 
     def counts(self) -> tuple[int, int, int]:
@@ -1463,7 +1588,8 @@ def native_loadgen(host: str, port: int, *, conns: int = 4, depth: int = 32,
 def native_bulk_loadgen(host: str, port: int, *, conns: int = 8,
                         depth: int = 4, frames_per_conn: int = 200,
                         rows_per_frame: int = 4096, keyspace: int = 64,
-                        capacity: float = 1e8, fill_rate: float = 1e8
+                        capacity: float = 1e8, fill_rate: float = 1e8,
+                        uring: bool = False
                         ) -> tuple[int, int, int, float]:
     """Closed-loop native BULK measurement client: ``conns`` connections
     each keeping ``depth`` pipelined OP_ACQUIRE_MANY frames of
@@ -1472,7 +1598,15 @@ def native_bulk_loadgen(host: str, port: int, *, conns: int = 8,
     elapsed_s)``. This is the shard-sweep rig's client: at multi-shard
     bulk rates even a per-frame Python client bounds the node, and the
     kernel's SO_REUSEPORT hash spreads the ``conns`` across shards.
-    Requires a front-end binary with the shard ABI."""
+    Requires a front-end binary with the shard ABI.
+
+    ``uring=True`` drives the frames through the loadgen's own
+    submission ring (``fe_lg_bulk_uring`` — one ``io_uring_enter`` per
+    burst instead of one send/recv syscall pair per frame) so the
+    client stops being the syscall bottleneck it was in the r11 sweep;
+    when the ring is unavailable (kernel, seccomp, or a stale .so) the
+    call falls back to the epoll-era client loudly and the measurement
+    still happens."""
     lib = load_frontend_lib()
     if lib is None or not getattr(lib, "has_shards", False):
         raise RuntimeError(
@@ -1483,6 +1617,22 @@ def native_bulk_loadgen(host: str, port: int, *, conns: int = 8,
     frames = c.c_longlong()
     rows = c.c_longlong()
     granted = c.c_longlong()
+    if uring and getattr(lib, "has_uring", False):
+        rc = lib.fe_lg_bulk_uring(
+            host.encode(), port, conns, depth, frames_per_conn,
+            rows_per_frame, keyspace, capacity, fill_rate,
+            c.byref(elapsed), c.byref(frames), c.byref(rows),
+            c.byref(granted))
+        if rc == 0:
+            return frames.value, rows.value, granted.value, elapsed.value
+        if rc != -2:
+            raise OSError("native uring bulk loadgen failed to connect")
+        logger.warning("uring bulk loadgen requested but no ring is "
+                       "available on this host; using the syscall client")
+    elif uring:
+        logger.warning("uring bulk loadgen requested but the loaded "
+                       "binary predates the uring ABI; using the "
+                       "syscall client")
     rc = lib.fe_lg_bulk(host.encode(), port, conns, depth,
                         frames_per_conn, rows_per_frame, keyspace,
                         capacity, fill_rate, c.byref(elapsed),

@@ -101,6 +101,7 @@ class BucketStoreServer:
                  native_bulk: bool = True,
                  native_shards: int = 1,
                  native_pin_shards: bool = False,
+                 native_uring: "str | bool | int | None" = None,
                  metrics_port: int | None = None,
                  observability: bool = True,
                  heavy_hitters_k: int = 64,
@@ -148,6 +149,12 @@ class BucketStoreServer:
             raise ValueError("native_shards must be >= 1")
         self.native_shards = native_shards
         self.native_pin_shards = native_pin_shards
+        # io_uring data plane (round 16, native front-end only): swap
+        # the shard IO loop's transport under the same reply bytes
+        # (docs/DESIGN.md §21). None defers to DRL_TPU_URING (off when
+        # unset); "on"/"sqpoll" opt in; per-shard fallback to epoll is
+        # graceful and loud when the kernel/seccomp refuses.
+        self.native_uring = native_uring
         self._native = None
         # Server-configured checkpoint destination for OP_SAVE (≙ Redis
         # BGSAVE writing its configured dump file — clients never supply
@@ -295,7 +302,8 @@ class BucketStoreServer:
                     tier0=self.native_tier0,
                     bulk=self.native_bulk,
                     shards=self.native_shards,
-                    pin_shards=self.native_pin_shards)
+                    pin_shards=self.native_pin_shards,
+                    uring=self.native_uring)
             except RuntimeError as exc:
                 # Library unavailable (no compiler / DRL_TPU_NO_NATIVE):
                 # serve anyway on the asyncio path — availability over
@@ -453,6 +461,12 @@ class BucketStoreServer:
         reg.gauge("native_frontend", "1 when the C front-end owns the "
                   "sockets", lambda: 1.0 if self._native is not None
                   else 0.0)
+        reg.gauge("native_fe_uring_shards", "native front-end shards "
+                  "serving on the io_uring transport (0 = epoll or "
+                  "asyncio path)",
+                  lambda: (float(getattr(self._native, "uring_shards",
+                                         0))
+                           if self._native is not None else 0.0))
         reg.histogram("serving_latency_seconds",
                       "Request arrival to result ready",
                       lambda: (self._native.latency_histogram()
@@ -1807,6 +1821,12 @@ class BucketStoreServer:
                 # sum(shards[*].x) == merged x is test-pinned).
                 payload["fe_shards"] = len(shards)
                 payload["shards"] = shards
+            transport = self._native.transport_stats()
+            if transport is not None and transport["mode"] != "epoll":
+                # Only when uring was requested: the epoll lane's
+                # OP_STATS shape is pinned (and the parity contract
+                # says the transport must be invisible there).
+                payload["fe_transport"] = transport
         else:
             payload = {
                 "connections_served": self.connections_served,
@@ -2014,6 +2034,21 @@ def main(argv: list[str] | None = None) -> None:
                         help="native front-end: pin shard i's IO thread "
                         "to CPU i mod nproc (combine with numactl/"
                         "taskset for NUMA placement)")
+    parser.add_argument("--fe-uring", default=None,
+                        choices=["off", "on", "sqpoll"],
+                        help="native front-end transport: 'on' serves "
+                        "each shard's IO from an io_uring ring "
+                        "(multishot accept/recv, linked send, provided "
+                        "buffers); 'sqpoll' adds a kernel submission "
+                        "poller so a hot shard submits without any "
+                        "syscall. Default defers to DRL_TPU_URING (off "
+                        "when unset); shards fall back to epoll loudly "
+                        "when the kernel or seccomp refuses "
+                        "(docs/OPERATIONS.md §17)")
+    parser.add_argument("--no-uring", action="store_true",
+                        help="force the epoll transport regardless of "
+                        "--fe-uring/DRL_TPU_URING (the same kill switch "
+                        "as DRL_TPU_NO_URING=1)")
     parser.add_argument("--no-fe-bulk", action="store_true",
                         help="disable the native bulk lane: "
                         "OP_ACQUIRE_MANY frames fall back to the Python "
@@ -2091,6 +2126,11 @@ def main(argv: list[str] | None = None) -> None:
     if args.fe_shards != 1 and not args.native_frontend:
         parser.error("--fe-shards requires --native-frontend (the epoll "
                      "shards ARE the C front-end)")
+    if args.fe_uring in ("on", "sqpoll") and not args.native_frontend:
+        parser.error("--fe-uring requires --native-frontend (the uring "
+                     "transport lives under the C front-end's shards)")
+    if args.no_uring:
+        args.fe_uring = "off"
     if args.snapshot_incremental and not args.snapshot_path:
         parser.error("--snapshot-incremental requires --snapshot-path "
                      "(there is no chain without a base file)")
@@ -2179,6 +2219,7 @@ def main(argv: list[str] | None = None) -> None:
                                    native_bulk=not args.no_fe_bulk,
                                    native_shards=args.fe_shards,
                                    native_pin_shards=args.fe_pin_shards,
+                                   native_uring=args.fe_uring,
                                    metrics_port=args.metrics_port,
                                    observability=not args.no_observability,
                                    flight_dir=args.flight_dir,

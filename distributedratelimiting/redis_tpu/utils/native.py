@@ -30,7 +30,17 @@ import os
 import pathlib
 import subprocess
 
-__all__ = ["load_directory_lib"]
+__all__ = ["load_directory_lib", "load_frontend_lib",
+           "URING_OFF", "URING_ON", "URING_SQPOLL"]
+
+#: Transport mode for ``fe_start_sharded2`` — MUST mirror the
+#: ``kUringOff``/``kUringOn``/``kUringSqpoll`` constexprs in
+#: ``native/frontend.cc`` (drl-check's ``transport-flag`` rule pins the
+#: pair both directions; a drift here is a build break, not a silent
+#: transport swap).
+URING_OFF = 0
+URING_ON = 1
+URING_SQPOLL = 2
 
 _REPO_NATIVE = pathlib.Path(__file__).resolve().parents[3] / "native"
 _LIB: ctypes.CDLL | None = None
@@ -421,6 +431,41 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.has_bulk = True
     except AttributeError:  # stale binary without the bulk ABI
         lib.has_bulk = False
+    try:
+        # Round 16 (io_uring data plane): fe_start_sharded2 is
+        # fe_start_sharded plus an explicit transport mode (URING_OFF /
+        # URING_ON / URING_SQPOLL module constants); fe_uring_* expose
+        # the runtime probe, per-shard transport status + fallback
+        # reason, and ring counters; fe_lg_bulk_uring is the bulk
+        # loadgen's uring submission path (returns -2 when the ring is
+        # unavailable — callers fall back to fe_lg_bulk). A stale
+        # binary without these exports serves epoll-only (has_uring
+        # gates it; the epoll lane is byte-identical by contract).
+        lib.fe_start_sharded2.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                          c.c_int, c.c_int, c.c_int,
+                                          c.c_int, c.c_int]
+        lib.fe_start_sharded2.restype = c.c_void_p
+        lib.fe_uring_available.argtypes = []
+        lib.fe_uring_available.restype = c.c_int
+        lib.fe_uring_probe.argtypes = [c.c_char_p, c.c_int]
+        lib.fe_uring_probe.restype = c.c_int
+        lib.fe_uring_shards.argtypes = [c.c_void_p]
+        lib.fe_uring_shards.restype = c.c_int
+        lib.fe_uring_reason.argtypes = [c.c_void_p, c.c_int, c.c_char_p,
+                                        c.c_int]
+        lib.fe_uring_reason.restype = c.c_int
+        lib.fe_uring_counts.argtypes = [c.c_void_p,
+                                        c.POINTER(c.c_longlong)]
+        lib.fe_uring_counts.restype = None
+        lib.fe_lg_bulk_uring.argtypes = [
+            c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+            c.c_int, c.c_double, c.c_double, c.POINTER(c.c_double),
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+            c.POINTER(c.c_longlong)]
+        lib.fe_lg_bulk_uring.restype = c.c_int
+        lib.has_uring = True
+    except AttributeError:  # stale binary without the uring ABI
+        lib.has_uring = False
     return lib
 
 

@@ -43,7 +43,9 @@
 #include <sched.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
 #include <sys/timerfd.h>
 #include <unistd.h>
 
@@ -55,12 +57,21 @@
 #endif
 #endif
 
+#if defined(__SANITIZE_ADDRESS__)
+#define DRL_ASAN 1
+#elif defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define DRL_ASAN 1
+#endif
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -405,6 +416,21 @@ struct Conn {
   // a malformed chunk's error before its chained successor's reply,
   // and reply-for-reply parity includes that order.
   bool bulk_pt_tail = false;
+  // io_uring transport state (round 16). Epoll connections leave these
+  // idle. `wbuf` holds the bytes an in-flight SEND sqe points at — the
+  // kernel reads them asynchronously, so they must not move while the
+  // op is pending (c->out keeps accumulating and swaps in when the
+  // current send drains). `uring_ops` counts CQEs still owed to this
+  // connection; teardown parks the Conn in Shard::dying until it hits
+  // zero — freeing wbuf under an in-flight SEND hands the kernel a
+  // dangling iov.
+  std::string wbuf;
+  size_t wbuf_off = 0;
+  uint32_t uring_ops = 0;
+  bool recv_armed = false;    // multishot RECV in flight
+  bool send_inflight = false;
+  bool close_linked = false;  // linked SEND->CLOSE chain in flight
+  bool dead = false;          // torn down; parked in Shard::dying
 };
 
 // Bound on bytes a connection may pipeline behind an unresolved HELLO.
@@ -557,6 +583,223 @@ struct Frontend;
 // single-shard compatibility posture a stale Python half relies on) or
 // one Shard (returned by fe_shard). Both structs lead with a magic so
 // the entry points can tell which they were handed.
+// ---------------------------------------------------------------------
+// io_uring data plane (round 16): the shard IO loop rebuilt on a raw-
+// syscall, liburing-free ring — multishot accept, multishot recv over a
+// provided-buffer ring, submit-on-reply SEND batching, linked
+// SEND->CLOSE teardown, and an optional SQPOLL mode where a hot shard
+// submits without any syscall at all. The reply bytes are the spec:
+// everything from parse_frames down is shared with the epoll loop, only
+// the transport differs, and every shard falls back to io_loop (with a
+// recorded reason) when the kernel, seccomp, or an env override refuses.
+//
+// The UAPI structs and constants are defined here rather than pulled
+// from <linux/io_uring.h>: the build host's header may predate the
+// 5.19 features this transport needs (multishot recv, PBUF_RING) even
+// when the running kernel has them, and the ABI below is frozen by the
+// kernel's compatibility contract.
+// ---------------------------------------------------------------------
+
+constexpr long kSysUringSetup = 425;
+constexpr long kSysUringEnter = 426;
+constexpr long kSysUringRegister = 427;
+
+struct DrlSqe {  // struct io_uring_sqe (64 bytes, ABI-frozen)
+  uint8_t opcode;
+  uint8_t flags;    // IOSQE_* bits
+  uint16_t ioprio;  // multishot flags live here for accept/recv
+  int32_t fd;
+  uint64_t off;
+  uint64_t addr;
+  uint32_t len;
+  uint32_t op_flags;  // msg_flags / accept_flags / cancel_flags union
+  uint64_t user_data;
+  uint16_t buf_group;  // buf_index/buf_group union
+  uint16_t personality;
+  int32_t splice_fd_in;
+  uint64_t pad2[2];
+};
+static_assert(sizeof(DrlSqe) == 64, "io_uring_sqe ABI");
+
+struct DrlCqe {  // struct io_uring_cqe (16 bytes)
+  uint64_t user_data;
+  int32_t res;
+  uint32_t flags;
+};
+static_assert(sizeof(DrlCqe) == 16, "io_uring_cqe ABI");
+
+struct DrlSqOffsets {
+  uint32_t head, tail, ring_mask, ring_entries, flags, dropped, array,
+      resv1;
+  uint64_t resv2;
+};
+struct DrlCqOffsets {
+  uint32_t head, tail, ring_mask, ring_entries, overflow, cqes, flags,
+      resv1;
+  uint64_t resv2;
+};
+struct DrlUringParams {  // struct io_uring_params (120 bytes)
+  uint32_t sq_entries, cq_entries, flags, sq_thread_cpu, sq_thread_idle,
+      features, wq_fd, resv[3];
+  DrlSqOffsets sq_off;
+  DrlCqOffsets cq_off;
+};
+static_assert(sizeof(DrlUringParams) == 120, "io_uring_params ABI");
+
+constexpr uint64_t kUringOffSqRing = 0;
+constexpr uint64_t kUringOffCqRing = 0x8000000ull;
+constexpr uint64_t kUringOffSqes = 0x10000000ull;
+
+constexpr uint32_t kUringFeatSingleMmap = 1u << 0;
+constexpr uint32_t kUringSetupSqpoll = 1u << 1;
+constexpr uint32_t kUringSetupCqsize = 1u << 3;
+constexpr uint32_t kUringSetupClamp = 1u << 4;
+
+constexpr uint32_t kUringSqNeedWakeup = 1u << 0;  // sq ring flags word
+constexpr uint32_t kUringEnterGetevents = 1u << 0;
+constexpr uint32_t kUringEnterSqWakeup = 1u << 1;
+
+constexpr uint8_t kOpTimeout = 11;      // IORING_OP_TIMEOUT
+constexpr uint8_t kOpAccept = 13;       // IORING_OP_ACCEPT
+constexpr uint8_t kOpAsyncCancel = 14;  // IORING_OP_ASYNC_CANCEL
+constexpr uint8_t kOpClose = 19;        // IORING_OP_CLOSE
+constexpr uint8_t kOpRead = 22;         // IORING_OP_READ
+constexpr uint8_t kOpSend = 26;         // IORING_OP_SEND
+constexpr uint8_t kOpRecv = 27;         // IORING_OP_RECV
+// IORING_OP_SOCKET landed in 5.19 alongside multishot recv and
+// PBUF_RING, which have no probe bit of their own — its presence in the
+// opcode probe is the documented feature-level proxy.
+constexpr uint8_t kOpSocketProxy = 45;
+
+constexpr uint16_t kAcceptMultishot = 1u << 0;  // sqe->ioprio, accept
+constexpr uint16_t kRecvMultishot = 1u << 1;    // sqe->ioprio, recv
+constexpr uint8_t kSqeFixedFile = 1u << 0;
+constexpr uint8_t kSqeIoLink = 1u << 2;
+constexpr uint8_t kSqeBufferSelect = 1u << 5;
+
+constexpr uint32_t kCqeFBuffer = 1u << 0;  // upper 16 bits carry the bid
+constexpr uint32_t kCqeFMore = 1u << 1;    // multishot op still armed
+constexpr uint32_t kCqeBufferShift = 16;
+
+constexpr unsigned kRegRegisterFiles = 2;
+constexpr unsigned kRegRegisterProbe = 8;
+constexpr unsigned kRegRegisterPbufRing = 22;
+
+struct DrlProbeOp {
+  uint8_t op;
+  uint8_t resv;
+  uint16_t flags;  // bit 0 = IO_URING_OP_SUPPORTED
+  uint32_t resv2;
+};
+struct DrlProbe {
+  uint8_t last_op;
+  uint8_t ops_len;
+  uint16_t resv;
+  uint32_t resv2[3];
+  DrlProbeOp ops[48];
+};
+
+struct DrlKTimespec {  // struct __kernel_timespec (TIMEOUT ops)
+  int64_t tv_sec;
+  long long tv_nsec;
+};
+
+struct DrlBufReg {  // struct io_uring_buf_reg
+  uint64_t ring_addr;
+  uint32_t ring_entries;
+  uint16_t bgid;
+  uint16_t flags;
+  uint64_t resv[3];
+};
+struct DrlBuf {  // struct io_uring_buf; entry 0's resv overlays the
+  uint64_t addr;  // ring tail the producer publishes
+  uint32_t len;
+  uint16_t bid;
+  uint16_t resv;
+};
+
+inline int sys_uring_setup(unsigned entries, DrlUringParams* p) {
+  return int(syscall(kSysUringSetup, entries, p));
+}
+inline int sys_uring_enter(int fd, unsigned to_submit, unsigned min_c,
+                           unsigned flags) {
+  return int(syscall(kSysUringEnter, fd, to_submit, min_c, flags,
+                     nullptr, size_t(0)));
+}
+inline int sys_uring_register(int fd, unsigned opcode, void* arg,
+                              unsigned nr) {
+  return int(syscall(kSysUringRegister, fd, opcode, arg, nr));
+}
+
+// Transport mode knob (fe_start_sharded2's uring_mode; mirrored as
+// URING_OFF/URING_ON/URING_SQPOLL in utils/native.py — drl-check's
+// transport-flag rule pins the pair, so a drift here is a build break,
+// not a silent mode swap).
+constexpr int kUringOff = 0;
+constexpr int kUringOn = 1;
+constexpr int kUringSqpoll = 2;
+
+constexpr unsigned kUringSqEntries = 256;
+constexpr unsigned kUringCqEntries = 4096;
+constexpr unsigned kUringBufCount = 64;      // provided-buffer slots
+constexpr size_t kUringBufSize = 32u << 10;  // 32 KiB per slot
+constexpr uint16_t kUringBgid = 7;           // buffer-group id
+
+// user_data = (kind << 56) | conn_id. Tags 0-2 stay reserved for the
+// listen/eventfd/timerfd fixed-file slots like the epoll loop's epoll
+// tags, so conn ids never collide with control ops.
+constexpr uint64_t kUdAccept = 1;
+constexpr uint64_t kUdEvRead = 2;
+constexpr uint64_t kUdTfRead = 3;
+constexpr uint64_t kUdRecv = 4;
+constexpr uint64_t kUdSend = 5;
+constexpr uint64_t kUdClose = 6;
+constexpr uint64_t kUdCancel = 7;
+
+inline uint64_t uring_ud(uint64_t kind, uint64_t id) {
+  return (kind << 56) | id;
+}
+
+// Per-shard ring state. Conn sockets are deliberately NOT in the
+// registered-file table: fixed slots are reused the moment a table
+// entry is overwritten, and a slot recycled while a canceled op is
+// still in flight attributes the completion to the WRONG connection —
+// the registered table holds only the three immortal control fds
+// (listen=0, eventfd=1, timerfd=2). docs/DESIGN.md §21.
+struct UringRing {
+  int fd = -1;
+  bool sqpoll = false;
+  void* sq_map = nullptr;
+  size_t sq_map_len = 0;
+  void* cq_map = nullptr;  // == sq_map under FEAT_SINGLE_MMAP
+  size_t cq_map_len = 0;
+  DrlSqe* sqes = nullptr;
+  size_t sqes_len = 0;
+  std::atomic<uint32_t>* sq_head = nullptr;  // kernel-consumed cursor
+  std::atomic<uint32_t>* sq_tail = nullptr;
+  uint32_t sq_mask = 0;
+  uint32_t* sq_array = nullptr;
+  std::atomic<uint32_t>* sq_flags = nullptr;  // NEED_WAKEUP under SQPOLL
+  std::atomic<uint32_t>* cq_head = nullptr;
+  std::atomic<uint32_t>* cq_tail = nullptr;
+  uint32_t cq_mask = 0;
+  DrlCqe* cqes = nullptr;
+  // Provided-buffer ring (bgid kUringBgid) feeding multishot recv.
+  DrlBuf* buf_ring = nullptr;
+  size_t buf_ring_len = 0;
+  uint8_t* buf_pool = nullptr;
+  size_t buf_pool_len = 0;
+  uint16_t buf_tail = 0;
+  uint32_t sq_pending = 0;  // SQEs staged since the last submit
+  uint64_t ev_buf = 0;      // READ landing pad, eventfd slot
+  uint64_t tf_buf = 0;      // READ landing pad, timerfd slot
+  // Telemetry (fe_uring_counts): enter calls are made both under the
+  // shard mutex (submits) and outside it (the wait leg), so atomics.
+  std::atomic<long long> enters{0};
+  std::atomic<long long> sqes_submitted{0};
+  std::atomic<long long> cqes_seen{0};
+};
+
 constexpr uint32_t kFeMagic = 0xFE11D311u;
 constexpr uint32_t kShardMagic = 0x5AAD0011u;
 
@@ -580,6 +823,26 @@ struct Shard {
   uint64_t deadline_ns = 300000;
   bool require_auth = false;
   std::thread io;
+
+  // io_uring transport (round 16): non-null ring means this shard's IO
+  // thread runs uring_loop; the eventfd/timerfd above double as
+  // registered-file slots so arm_deadline/wake_io stay transport-
+  // neutral. uring_reason records why a shard that was ASKED for uring
+  // fell back to epoll (fe_uring_reason / OPERATIONS.md §17).
+  UringRing* ring = nullptr;
+  bool uring = false;
+  bool uring_sqpoll = false;
+  bool uring_sweep = false;  // a conn needs re-arm/reap at burst end
+  bool tfd_armed = false;    // skip redundant timerfd disarm syscalls
+  std::string uring_reason;
+  // Connections torn down but still owed CQEs (in-flight SEND/RECV/
+  // CANCEL): reaped when their uring_ops drain to zero.
+  std::unordered_map<uint64_t, Conn*> dying;
+  // Data-plane syscalls this shard has issued (both transports count
+  // every epoll_wait/accept/recv/send/epoll_ctl/timerfd/eventfd/enter
+  // call) — the syscalls/frame evidence column is this over
+  // requests_served, measured, not modeled.
+  std::atomic<long long> io_syscalls{0};
 
   FeMutex mu;
   FeCondVar cv;
@@ -685,6 +948,7 @@ struct Frontend {
   uint32_t magic = kFeMagic;
   int port = 0;
   int nshards = 1;
+  int uring_mode = kUringOff;  // requested transport (kUring*)
   size_t max_batch = 4096;
   uint64_t deadline_ns = 300000;
   bool require_auth = false;
@@ -942,8 +1206,24 @@ void set_nonblock(int fd) {
 // Flush as much of conn->out as the socket accepts. mu held.
 void flush_out(Shard* sh, Conn* c);
 
+// io_uring transport entry points (defined after the epoll loop; the
+// shared helpers below branch to them when the shard runs on the ring).
+void uring_close_conn(Shard* sh, Conn* c);
+void uring_arm_send(Shard* sh, Conn* c);
+void uring_submit(Shard* sh);
+
+// Data-plane syscall accounting (see Shard::io_syscalls).
+inline void count_sys(Shard* sh, int n = 1) {
+  sh->io_syscalls.fetch_add(n, std::memory_order_relaxed);
+}
+
 void close_conn(Shard* sh, Conn* c) {
   // mu held. Removes from epoll + conn map and frees.
+  if (sh->uring) {
+    uring_close_conn(sh, c);
+    return;
+  }
+  count_sys(sh, 2);
   epoll_ctl(sh->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   ::close(c->fd);
   sh->conns.erase(c->id);
@@ -954,10 +1234,26 @@ void send_to_conn(Shard* sh, Conn* c, const char* data, size_t len) {
   // mu held. Append-or-write: when nothing is queued, try the socket
   // immediately (saves an epoll round trip — the common case); queue
   // the remainder and arm EPOLLOUT on partial writes.
-  if (c->closing) return;
+  if (c->closing || c->dead) return;
+  if (sh->uring) {
+    // uring lane: stage and arm a SEND op; the caller's burst-end
+    // submit batches every staged reply into one (or zero, under
+    // SQPOLL) enter call — the submit-on-reply contract.
+    if (c->out.size() - c->out_off + len > kMaxConnOut) {
+      c->closing = true;
+      c->out.clear();
+      c->out_off = 0;
+      uring_arm_send(sh, c);
+      return;
+    }
+    c->out.append(data, len);
+    uring_arm_send(sh, c);
+    return;
+  }
   if (c->out.size() == c->out_off) {
     c->out.clear();
     c->out_off = 0;
+    count_sys(sh);
     ssize_t n = ::send(c->fd, data, len, MSG_NOSIGNAL);
     if (n == ssize_t(len)) return;
     if (n < 0) {
@@ -982,6 +1278,7 @@ void send_to_conn(Shard* sh, Conn* c, const char* data, size_t len) {
     epoll_event ev{};
     ev.events = EPOLLIN | EPOLLOUT;
     ev.data.u64 = c->id;
+    count_sys(sh);
     epoll_ctl(sh->epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
 }
@@ -1007,7 +1304,12 @@ void flush_queued(Shard* sh, Conn* c) {
   // EPOLLOUT for any leftover. Never closes/frees the connection (hard
   // errors mark `closing` and the IO loop reaps on the next event), so
   // callers keep their pointer.
+  if (sh->uring) {
+    uring_arm_send(sh, c);
+    return;
+  }
   if (c->out_off >= c->out.size() || c->want_write) return;
+  count_sys(sh);
   ssize_t n = ::send(c->fd, c->out.data() + c->out_off,
                      c->out.size() - c->out_off, MSG_NOSIGNAL);
   if (n >= 0) {
@@ -1027,6 +1329,7 @@ void flush_queued(Shard* sh, Conn* c) {
   epoll_event ev{};
   ev.events = EPOLLIN | EPOLLOUT;
   ev.data.u64 = c->id;
+  count_sys(sh);
   epoll_ctl(sh->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
@@ -1034,7 +1337,12 @@ void flush_out(Shard* sh, Conn* c) {
   // mu held. Cursor-based drain: erase-from-front per partial send is
   // O(n^2) memmove on a multi-MB backpressured outbox, all of it under
   // the global mutex — advance out_off instead, compact occasionally.
+  if (sh->uring) {
+    uring_arm_send(sh, c);
+    return;
+  }
   while (c->out_off < c->out.size()) {
+    count_sys(sh);
     ssize_t n = ::send(c->fd, c->out.data() + c->out_off,
                        c->out.size() - c->out_off, MSG_NOSIGNAL);
     if (n < 0) {
@@ -1803,6 +2111,9 @@ bool parse_frames(Shard* sh, Conn* c) {
 void arm_deadline(Shard* sh) {
   // mu held. Arm the timerfd for the oldest pending request's flush
   // deadline (ns precision — this is why not epoll_wait's ms timeout).
+  bool want = !sh->pending.empty();
+  if (!want && !sh->tfd_armed) return;  // already disarmed: skip syscall
+  sh->tfd_armed = want;
   itimerspec its{};
   if (!sh->pending.empty()) {
     uint64_t due = sh->pending_oldest_ns + sh->deadline_ns;
@@ -1811,12 +2122,14 @@ void arm_deadline(Shard* sh) {
     its.it_value.tv_sec = time_t(delta / 1000000000ull);
     its.it_value.tv_nsec = long(delta % 1000000000ull);
   }  // pending empty => zero itimerspec disarms
+  count_sys(sh);
   timerfd_settime(sh->tfd, 0, &its, nullptr);
 }
 
 void io_loop(Shard* sh) {
   epoll_event events[128];
   for (;;) {
+    count_sys(sh);
     int n = epoll_wait(sh->epfd, events, 128, -1);
     if (sh->owner->stopping.load()) break;
     if (n < 0) {
@@ -1828,9 +2141,11 @@ void io_loop(Shard* sh) {
       uint64_t tag = events[i].data.u64;
       if (tag == 0) {  // listen socket
         for (;;) {
+          count_sys(sh);
           int cfd = accept4(sh->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
           if (cfd < 0) break;
           int one = 1;
+          count_sys(sh, 2);  // setsockopt + epoll_ctl below
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
           Conn* c = new Conn();
           c->fd = cfd;
@@ -1847,14 +2162,19 @@ void io_loop(Shard* sh) {
       }
       if (tag == 1) {  // eventfd: stop/wake
         uint64_t junk;
+        count_sys(sh);
         while (read(sh->evfd, &junk, 8) == 8) {
+          count_sys(sh);
         }
         continue;
       }
       if (tag == 2) {  // timerfd: flush deadline
         uint64_t junk;
+        count_sys(sh);
         while (read(sh->tfd, &junk, 8) == 8) {
+          count_sys(sh);
         }
+        sh->tfd_armed = false;  // one-shot timer disarmed itself
         flush_pending(sh, /*include_tail=*/true);  // deadline: all due
         continue;
       }
@@ -1875,6 +2195,7 @@ void io_loop(Shard* sh) {
         bool eof = false, ok = true;
         for (;;) {
           uint8_t buf[65536];
+          count_sys(sh);
           ssize_t r = ::recv(c->fd, buf, sizeof buf, 0);
           if (r > 0) {
             c->in.insert(c->in.end(), buf, buf + r);
@@ -1937,20 +2258,735 @@ void io_loop(Shard* sh) {
 
 void wake_io(Shard* sh) {
   uint64_t one = 1;
+  count_sys(sh);
   ssize_t r = write(sh->evfd, &one, 8);
   (void)r;
+}
+
+// ---------------------------------------------------------------------
+// io_uring transport (round 16). The reply bytes are the spec — every
+// frame still flows through the SAME parse_frames / handle_frame /
+// handle_bulk_frame / flush_pending machinery as the epoll loop; only
+// how bytes cross the kernel boundary changes. Contract notes:
+//   * order: at most ONE SEND op in flight per connection, staged from
+//     wbuf (the bytes an in-flight op points at) with out as the
+//     overflow queue — submission order IS reply order.
+//   * teardown: a Conn with owed CQEs parks in Shard::dying until its
+//     uring_ops drains to zero (a kernel op holds pointers into the
+//     Conn), then frees. close_conn and every helper branch here when
+//     sh->uring is set, so callers never see transport-specific state.
+//   * graceful close: when the farewell bytes are fully staged, a CLOSE
+//     is linked behind the SEND (IOSQE_IO_LINK). The kernel breaks a
+//     link on error OR short transfer, so the close runs only when the
+//     goodbye actually drained — otherwise the send CQE re-arms.
+// ---------------------------------------------------------------------
+
+// Runtime feature probe. Returns 1 when the 5.19+ feature level this
+// transport needs is present; 0 with a human-readable reason otherwise.
+// Sanitizer builds gate the transport off: the ring's kernel-side
+// writes into shared memory are invisible to ASan/TSan instrumentation.
+int uring_probe(std::string* reason) {
+#if defined(DRL_TSAN) || defined(DRL_ASAN)
+  if (reason) *reason = "sanitizer build: uring transport feature-gated off";
+  return 0;
+#else
+  const char* no = std::getenv("DRL_TPU_NO_URING");
+  if (no != nullptr && *no != '\0' && std::string(no) != "0") {
+    if (reason) *reason = "disabled by DRL_TPU_NO_URING";
+    return 0;
+  }
+  const char* deny = std::getenv("DRL_TPU_URING_FAKE_DENY");
+  if (deny != nullptr && *deny != '\0' && std::string(deny) != "0") {
+    // Test hook: behave exactly as a seccomp filter returning EPERM.
+    if (reason) *reason = "io_uring_setup denied (EPERM, simulated seccomp)";
+    return 0;
+  }
+  DrlUringParams p{};
+  int fd = sys_uring_setup(4, &p);
+  if (fd < 0) {
+    if (reason) {
+      if (errno == ENOSYS) {
+        *reason = "kernel lacks io_uring (ENOSYS)";
+      } else if (errno == EPERM) {
+        *reason = "io_uring_setup denied (EPERM — seccomp or "
+                  "kernel.io_uring_disabled)";
+      } else {
+        *reason = std::string("io_uring_setup failed: ") + strerror(errno);
+      }
+    }
+    return 0;
+  }
+  DrlProbe probe{};
+  int rc = sys_uring_register(fd, kRegRegisterProbe, &probe, 48);
+  ::close(fd);
+  if (rc < 0) {
+    if (reason) *reason = "io_uring opcode probe unsupported (pre-5.6)";
+    return 0;
+  }
+  if (probe.last_op < kOpSocketProxy) {
+    // Multishot recv and PBUF_RING have no probe bit; IORING_OP_SOCKET
+    // shipped in the same release (5.19) and is the documented proxy.
+    if (reason) {
+      *reason = "kernel predates the 5.19 feature level "
+                "(multishot recv + provided-buffer rings)";
+    }
+    return 0;
+  }
+  const uint8_t need[] = {kOpAccept, kOpAsyncCancel, kOpClose,
+                          kOpRead,   kOpSend,        kOpRecv};
+  for (uint8_t op : need) {
+    if (op >= probe.ops_len || (probe.ops[op].flags & 1) == 0) {
+      if (reason) {
+        *reason = "required io_uring opcode " + std::to_string(int(op)) +
+                  " not supported";
+      }
+      return 0;
+    }
+  }
+  if (reason) reason->clear();
+  return 1;
+#endif
+}
+
+void uring_free_ring(UringRing* r) {
+  if (r == nullptr) return;
+  if (r->buf_ring != nullptr) munmap(r->buf_ring, r->buf_ring_len);
+  if (r->buf_pool != nullptr) munmap(r->buf_pool, r->buf_pool_len);
+  if (r->sqes != nullptr) munmap(r->sqes, r->sqes_len);
+  if (r->cq_map != nullptr && r->cq_map != r->sq_map) {
+    munmap(r->cq_map, r->cq_map_len);
+  }
+  if (r->sq_map != nullptr) munmap(r->sq_map, r->sq_map_len);
+  if (r->fd >= 0) ::close(r->fd);
+  delete r;
+}
+
+// Return one provided buffer to the recv pool. Entry 0's resv overlays
+// the ring tail; the release store publishes the refilled slot to the
+// kernel (mirrors liburing's io_uring_buf_ring_advance).
+void uring_recycle_buf(UringRing* r, uint16_t bid) {
+  DrlBuf* e = &r->buf_ring[r->buf_tail & (kUringBufCount - 1)];
+  e->addr = uint64_t(reinterpret_cast<uintptr_t>(r->buf_pool)) +
+            uint64_t(bid) * kUringBufSize;
+  e->len = uint32_t(kUringBufSize);
+  e->bid = bid;
+  r->buf_tail++;
+  reinterpret_cast<std::atomic<uint16_t>*>(&r->buf_ring[0].resv)
+      ->store(r->buf_tail, std::memory_order_release);
+}
+
+bool uring_setup_shard(Shard* sh, bool sqpoll) {
+  std::string reason;
+  if (uring_probe(&reason) == 0) {
+    sh->uring_reason = reason;
+    return false;
+  }
+  DrlUringParams p{};
+  p.flags = kUringSetupCqsize | kUringSetupClamp;
+  p.cq_entries = kUringCqEntries;
+  if (sqpoll) {
+    p.flags |= kUringSetupSqpoll;
+    p.sq_thread_idle = 50;  // ms the kernel SQ thread spins before napping
+  }
+  int fd = sys_uring_setup(kUringSqEntries, &p);
+  if (fd < 0 && sqpoll) {
+    // SQPOLL needs CAP_SYS_NICE pre-5.11 and can be policy-refused;
+    // fall one notch to plain uring rather than all the way to epoll.
+    sqpoll = false;
+    p = DrlUringParams{};
+    p.flags = kUringSetupCqsize | kUringSetupClamp;
+    p.cq_entries = kUringCqEntries;
+    fd = sys_uring_setup(kUringSqEntries, &p);
+    sh->uring_reason = "sqpoll refused by kernel; running uring without it";
+  }
+  if (fd < 0) {
+    sh->uring_reason = std::string("io_uring_setup failed: ") +
+                       strerror(errno);
+    return false;
+  }
+  UringRing* r = new UringRing();
+  r->fd = fd;
+  r->sqpoll = sqpoll;
+  size_t sq_len = size_t(p.sq_off.array) + p.sq_entries * sizeof(uint32_t);
+  size_t cq_len = size_t(p.cq_off.cqes) + p.cq_entries * sizeof(DrlCqe);
+  bool single = (p.features & kUringFeatSingleMmap) != 0;
+  if (single) sq_len = cq_len = std::max(sq_len, cq_len);
+  void* sq = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                  MAP_SHARED | MAP_POPULATE, fd, long(kUringOffSqRing));
+  if (sq == MAP_FAILED) {
+    sh->uring_reason = "sq ring mmap failed";
+    uring_free_ring(r);
+    return false;
+  }
+  r->sq_map = sq;
+  r->sq_map_len = sq_len;
+  if (single) {
+    r->cq_map = sq;
+    r->cq_map_len = sq_len;
+  } else {
+    void* cq = mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, long(kUringOffCqRing));
+    if (cq == MAP_FAILED) {
+      sh->uring_reason = "cq ring mmap failed";
+      uring_free_ring(r);
+      return false;
+    }
+    r->cq_map = cq;
+    r->cq_map_len = cq_len;
+  }
+  r->sqes_len = p.sq_entries * sizeof(DrlSqe);
+  void* sqes = mmap(nullptr, r->sqes_len, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, long(kUringOffSqes));
+  if (sqes == MAP_FAILED) {
+    sh->uring_reason = "sqe array mmap failed";
+    uring_free_ring(r);
+    return false;
+  }
+  r->sqes = static_cast<DrlSqe*>(sqes);
+  auto* sqb = static_cast<uint8_t*>(r->sq_map);
+  r->sq_head =
+      reinterpret_cast<std::atomic<uint32_t>*>(sqb + p.sq_off.head);
+  r->sq_tail =
+      reinterpret_cast<std::atomic<uint32_t>*>(sqb + p.sq_off.tail);
+  r->sq_mask = *reinterpret_cast<uint32_t*>(sqb + p.sq_off.ring_mask);
+  r->sq_array = reinterpret_cast<uint32_t*>(sqb + p.sq_off.array);
+  r->sq_flags =
+      reinterpret_cast<std::atomic<uint32_t>*>(sqb + p.sq_off.flags);
+  auto* cqb = static_cast<uint8_t*>(r->cq_map);
+  r->cq_head =
+      reinterpret_cast<std::atomic<uint32_t>*>(cqb + p.cq_off.head);
+  r->cq_tail =
+      reinterpret_cast<std::atomic<uint32_t>*>(cqb + p.cq_off.tail);
+  r->cq_mask = *reinterpret_cast<uint32_t*>(cqb + p.cq_off.ring_mask);
+  r->cqes = reinterpret_cast<DrlCqe*>(cqb + p.cq_off.cqes);
+  // Registered files: the three immortal control fds only (see the
+  // UringRing comment for why conn sockets stay out of the table).
+  int files[3] = {sh->listen_fd, sh->evfd, sh->tfd};
+  if (sys_uring_register(fd, kRegRegisterFiles, files, 3) < 0) {
+    sh->uring_reason = "IORING_REGISTER_FILES refused";
+    uring_free_ring(r);
+    return false;
+  }
+  // Provided-buffer ring + the registered buffer pool it points into.
+  r->buf_ring_len = kUringBufCount * sizeof(DrlBuf);
+  if (r->buf_ring_len < 4096) r->buf_ring_len = 4096;  // page-aligned
+  void* br = mmap(nullptr, r->buf_ring_len, PROT_READ | PROT_WRITE,
+                  MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
+  if (br == MAP_FAILED) {
+    r->buf_ring_len = 0;
+    sh->uring_reason = "buffer-ring mmap failed";
+    uring_free_ring(r);
+    return false;
+  }
+  r->buf_ring = static_cast<DrlBuf*>(br);
+  std::memset(r->buf_ring, 0, r->buf_ring_len);
+  r->buf_pool_len = size_t(kUringBufCount) * kUringBufSize;
+  void* pool = mmap(nullptr, r->buf_pool_len, PROT_READ | PROT_WRITE,
+                    MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
+  if (pool == MAP_FAILED) {
+    r->buf_pool_len = 0;
+    sh->uring_reason = "buffer-pool mmap failed";
+    uring_free_ring(r);
+    return false;
+  }
+  r->buf_pool = static_cast<uint8_t*>(pool);
+  DrlBufReg reg{};
+  reg.ring_addr = uint64_t(reinterpret_cast<uintptr_t>(r->buf_ring));
+  reg.ring_entries = kUringBufCount;
+  reg.bgid = kUringBgid;
+  if (sys_uring_register(fd, kRegRegisterPbufRing, &reg, 1) < 0) {
+    sh->uring_reason = "IORING_REGISTER_PBUF_RING refused (pre-5.19)";
+    uring_free_ring(r);
+    return false;
+  }
+  for (uint16_t b = 0; b < kUringBufCount; b++) uring_recycle_buf(r, b);
+  sh->ring = r;
+  sh->uring = true;
+  sh->uring_sqpoll = sqpoll;
+  return true;
+}
+
+// Stage-side submit. mu held (sq_pending and the SQ tail are only ever
+// touched under it; the kernel reads the published tail with its own
+// acquire). SQPOLL: no enter at all unless the kernel SQ thread napped.
+void uring_submit(Shard* sh) {
+  UringRing* r = sh->ring;
+  if (r == nullptr) return;
+  if (r->sqpoll) {
+    if (r->sq_pending > 0) {
+      r->sqes_submitted.fetch_add(r->sq_pending, std::memory_order_relaxed);
+      r->sq_pending = 0;
+    }
+    if (r->sq_flags->load(std::memory_order_acquire) & kUringSqNeedWakeup) {
+      count_sys(sh);
+      r->enters.fetch_add(1, std::memory_order_relaxed);
+      sys_uring_enter(r->fd, 0, 0, kUringEnterSqWakeup);
+    }
+    return;
+  }
+  while (r->sq_pending > 0) {
+    count_sys(sh);
+    r->enters.fetch_add(1, std::memory_order_relaxed);
+    int rc = sys_uring_enter(r->fd, r->sq_pending, 0, 0);
+    if (rc > 0) {
+      uint32_t done = uint32_t(rc) > r->sq_pending ? r->sq_pending
+                                                   : uint32_t(rc);
+      r->sqes_submitted.fetch_add(done, std::memory_order_relaxed);
+      r->sq_pending -= done;
+      continue;
+    }
+    if (rc == 0) break;
+    if (errno == EINTR) continue;
+    // EBUSY/EAGAIN: CQ backpressure — keep them staged, retry after
+    // the loop reaps completions. Anything else: drop the stage count
+    // (the SQEs are still in the ring; a later submit re-offers them).
+    break;
+  }
+}
+
+// Acquire one zeroed SQE slot. mu held. A full ring submits first; the
+// post-submit spin matters only under SQPOLL (non-SQPOLL enter consumes
+// synchronously). Returns nullptr only when the kernel cannot drain —
+// callers treat that as "stage later" and set sh->uring_sweep.
+DrlSqe* uring_get_sqe(Shard* sh) {
+  UringRing* r = sh->ring;
+  uint32_t tail = r->sq_tail->load(std::memory_order_relaxed);
+  uint32_t head = r->sq_head->load(std::memory_order_acquire);
+  if (tail - head >= r->sq_mask + 1) {
+    uring_submit(sh);
+    for (int spin = 0; spin < 65536; spin++) {
+      head = r->sq_head->load(std::memory_order_acquire);
+      if (tail - head < r->sq_mask + 1) break;
+    }
+    if (tail - head >= r->sq_mask + 1) return nullptr;
+  }
+  uint32_t idx = tail & r->sq_mask;
+  DrlSqe* sqe = &r->sqes[idx];
+  std::memset(sqe, 0, sizeof *sqe);
+  r->sq_array[idx] = idx;
+  r->sq_tail->store(tail + 1, std::memory_order_release);
+  r->sq_pending++;
+  return sqe;
+}
+
+void uring_arm_accept(Shard* sh) {
+  DrlSqe* sqe = uring_get_sqe(sh);
+  if (sqe == nullptr) {
+    sh->uring_sweep = true;
+    return;
+  }
+  sqe->opcode = kOpAccept;
+  sqe->flags = kSqeFixedFile;
+  sqe->fd = 0;  // registered slot 0: the listen socket
+  sqe->ioprio = kAcceptMultishot;
+  sqe->op_flags = SOCK_NONBLOCK;
+  sqe->user_data = uring_ud(kUdAccept, 0);
+}
+
+void uring_arm_ctl_read(Shard* sh, int slot, uint64_t* buf, uint64_t kind) {
+  DrlSqe* sqe = uring_get_sqe(sh);
+  if (sqe == nullptr) {
+    sh->uring_sweep = true;
+    return;
+  }
+  sqe->opcode = kOpRead;
+  sqe->flags = kSqeFixedFile;
+  sqe->fd = slot;  // registered slot 1 = eventfd, 2 = timerfd
+  sqe->addr = uint64_t(reinterpret_cast<uintptr_t>(buf));
+  sqe->len = 8;
+  sqe->user_data = uring_ud(kind, 0);
+}
+
+void uring_arm_recv(Shard* sh, Conn* c) {
+  if (c->recv_armed || c->dead || c->fd < 0) return;
+  DrlSqe* sqe = uring_get_sqe(sh);
+  if (sqe == nullptr) {
+    sh->uring_sweep = true;  // loop retries at burst end
+    return;
+  }
+  sqe->opcode = kOpRecv;
+  sqe->flags = kSqeBufferSelect;
+  sqe->ioprio = kRecvMultishot;
+  sqe->fd = c->fd;
+  sqe->len = 0;  // the provided buffer's size caps each completion
+  sqe->buf_group = kUringBgid;
+  sqe->user_data = uring_ud(kUdRecv, c->id);
+  c->recv_armed = true;
+  c->uring_ops++;
+}
+
+// Stage (at most) one SEND for this connection. mu held. NEVER closes
+// or frees the Conn (same contract as flush_queued: callers keep their
+// pointer) — drained+closing teardown happens in the send CQE handler
+// or the loop's sweep.
+void uring_arm_send(Shard* sh, Conn* c) {
+  if (sh->ring == nullptr || c->dead || c->send_inflight || c->fd < 0) {
+    return;
+  }
+  if (c->wbuf_off >= c->wbuf.size()) {
+    c->wbuf.clear();
+    c->wbuf_off = 0;
+    if (c->out_off >= c->out.size()) {
+      c->out.clear();
+      c->out_off = 0;
+      if (c->closing) {
+        // Nothing left to drain and no op to complete into teardown:
+        // let the IO loop reap at burst end.
+        sh->uring_sweep = true;
+        wake_io(sh);
+      }
+      return;
+    }
+    if (c->out_off > 0) c->out.erase(0, c->out_off);
+    c->out_off = 0;
+    c->wbuf.swap(c->out);  // out is now empty; new replies append there
+  }
+  UringRing* r = sh->ring;
+  // Decide the linked-CLOSE up front: acquiring the second SQE must not
+  // trigger a submit between the pair (a submit would flush the SEND
+  // without its link flag — the kernel only links within one batch).
+  bool link_close = false;
+  if (c->closing && c->out_off >= c->out.size()) {
+    uint32_t tail = r->sq_tail->load(std::memory_order_relaxed);
+    uint32_t head = r->sq_head->load(std::memory_order_acquire);
+    link_close = (tail - head) + 2 <= r->sq_mask + 1;
+  }
+  DrlSqe* sqe = uring_get_sqe(sh);
+  if (sqe == nullptr) {
+    sh->uring_sweep = true;  // bytes stay staged in wbuf; retried later
+    return;
+  }
+  sqe->opcode = kOpSend;
+  sqe->fd = c->fd;
+  sqe->addr =
+      uint64_t(reinterpret_cast<uintptr_t>(c->wbuf.data() + c->wbuf_off));
+  sqe->len = uint32_t(c->wbuf.size() - c->wbuf_off);
+  sqe->op_flags = MSG_NOSIGNAL;
+  sqe->user_data = uring_ud(kUdSend, c->id);
+  if (link_close) sqe->flags |= kSqeIoLink;
+  c->send_inflight = true;
+  c->uring_ops++;
+  if (link_close) {
+    DrlSqe* cl = uring_get_sqe(sh);
+    if (cl != nullptr) {
+      cl->opcode = kOpClose;
+      cl->fd = c->fd;
+      cl->user_data = uring_ud(kUdClose, c->id);
+      c->close_linked = true;
+      c->uring_ops++;
+    } else {
+      sqe->flags = uint8_t(sqe->flags & ~kSqeIoLink);
+    }
+  }
+}
+
+// Free the Conn once no kernel op holds pointers into it. mu held.
+void uring_reap(Shard* sh, Conn* c) {
+  if (c->uring_ops != 0) return;
+  if (c->fd >= 0) {
+    count_sys(sh);
+    ::close(c->fd);
+    c->fd = -1;
+  }
+  sh->dying.erase(c->id);
+  delete c;
+}
+
+void uring_close_conn(Shard* sh, Conn* c) {
+  // mu held. Tear down now if no op is in flight; otherwise park in
+  // `dying` (a multishot RECV or SEND still references this Conn) and
+  // let the owed CQEs drain it.
+  if (c->dead) return;
+  c->dead = true;
+  c->closing = true;
+  sh->conns.erase(c->id);
+  sh->dying[c->id] = c;
+  if (c->recv_armed) {
+    DrlSqe* sqe = uring_get_sqe(sh);
+    if (sqe != nullptr) {
+      sqe->opcode = kOpAsyncCancel;
+      sqe->addr = uring_ud(kUdRecv, c->id);
+      sqe->user_data = uring_ud(kUdCancel, c->id);
+      c->uring_ops++;
+    }
+    // SQE unavailable is near-impossible (get_sqe submits+drains); the
+    // armed RECV then completes on its own once the peer acts, and
+    // shutdown frees `dying` unconditionally.
+  }
+  if (c->fd >= 0 && !c->close_linked && !c->send_inflight) {
+    count_sys(sh);
+    ::close(c->fd);  // recv cancel above reaps the multishot op
+    c->fd = -1;
+  }
+  uring_reap(sh, c);
+}
+
+void uring_handle_cqe(Shard* sh, const DrlCqe& cqe) {
+  // mu held, called from uring_loop only.
+  UringRing* r = sh->ring;
+  uint64_t kind = cqe.user_data >> 56;
+  uint64_t id = cqe.user_data & ((1ull << 56) - 1);
+  if (kind == kUdAccept) {
+    if ((cqe.flags & kCqeFMore) == 0) uring_arm_accept(sh);
+    if (cqe.res < 0) return;
+    int cfd = cqe.res;
+    int one = 1;
+    count_sys(sh);
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    Conn* c = new Conn();
+    c->fd = cfd;
+    c->id = sh->next_conn_id++;
+    c->authed = !sh->require_auth;
+    sh->conns[c->id] = c;
+    sh->connections_served++;
+    uring_arm_recv(sh, c);
+    return;
+  }
+  if (kind == kUdEvRead) {  // eventfd: stop/wake — loop rechecks flags
+    uring_arm_ctl_read(sh, 1, &r->ev_buf, kUdEvRead);
+    return;
+  }
+  if (kind == kUdTfRead) {  // timerfd: flush deadline
+    sh->tfd_armed = false;
+    uring_arm_ctl_read(sh, 2, &r->tf_buf, kUdTfRead);
+    flush_pending(sh, /*include_tail=*/true);
+    return;
+  }
+  auto ita = sh->conns.find(id);
+  Conn* c = ita != sh->conns.end() ? ita->second : nullptr;
+  Conn* d = nullptr;
+  if (c == nullptr) {
+    auto itd = sh->dying.find(id);
+    if (itd != sh->dying.end()) d = itd->second;
+  }
+  Conn* any = c != nullptr ? c : d;
+  if (kind == kUdRecv) {
+    if ((cqe.flags & kCqeFMore) == 0 && any != nullptr && any->recv_armed) {
+      any->recv_armed = false;
+      any->uring_ops--;
+    }
+    if (cqe.flags & kCqeFBuffer) {
+      uint16_t bid = uint16_t(cqe.flags >> kCqeBufferShift);
+      if (c != nullptr && !c->closing && cqe.res > 0) {
+        const uint8_t* p = r->buf_pool + size_t(bid) * kUringBufSize;
+        c->in.insert(c->in.end(), p, p + cqe.res);
+      }
+      uring_recycle_buf(r, bid);  // ALWAYS — even when the conn is gone
+    }
+    if (c == nullptr) {
+      if (d != nullptr) uring_reap(sh, d);
+      return;
+    }
+    if (cqe.res > 0) {
+      if (!c->closing) {
+        if (!parse_frames(sh, c)) {
+          if (c->out_off < c->out.size() ||
+              c->wbuf_off < c->wbuf.size()) {
+            c->closing = true;  // drain the error reply first
+            uring_arm_send(sh, c);
+          } else {
+            uring_close_conn(sh, c);
+          }
+          return;
+        }
+      }
+      if (!c->recv_armed && !c->closing) uring_arm_recv(sh, c);
+      return;
+    }
+    if (cqe.res == -ENOBUFS) {
+      // Pool exhausted this burst; recycles above refill it — re-arm.
+      uring_arm_recv(sh, c);
+      return;
+    }
+    if (cqe.res == -ECANCELED) return;
+    uring_close_conn(sh, c);  // EOF (res==0) or hard error: epoll parity
+    return;
+  }
+  if (kind == kUdSend) {
+    if (any == nullptr) return;
+    any->uring_ops--;
+    any->send_inflight = false;
+    if (c == nullptr) {
+      uring_reap(sh, d);  // teardown already ran; just drain the op
+      return;
+    }
+    if (cqe.res < 0) {
+      if (cqe.res == -ECANCELED) return;
+      // Broken pipe etc. An armed linked CLOSE got -ECANCELED (its own
+      // CQE decrements); the fd is still ours to close.
+      c->close_linked = false;
+      uring_close_conn(sh, c);
+      return;
+    }
+    c->wbuf_off += size_t(cqe.res);
+    bool drained =
+        c->wbuf_off >= c->wbuf.size() && c->out_off >= c->out.size();
+    if (c->close_linked) {
+      if (drained) return;  // the linked CLOSE's CQE finishes teardown
+      // Short send broke the link (CLOSE comes back -ECANCELED): the
+      // remainder re-arms below and a fresh close links when staged.
+      c->close_linked = false;
+    }
+    if (!drained) {
+      uring_arm_send(sh, c);
+      return;
+    }
+    c->wbuf.clear();
+    c->wbuf_off = 0;
+    c->out.clear();
+    c->out_off = 0;
+    if (c->closing) uring_close_conn(sh, c);
+    return;
+  }
+  if (kind == kUdClose) {
+    if (any == nullptr) return;
+    any->uring_ops--;
+    any->close_linked = false;
+    if (cqe.res >= 0) any->fd = -1;  // the kernel closed it
+    // res < 0 (-ECANCELED: the short-send link break): fd still open;
+    // close_conn/reap below ::close it.
+    if (c != nullptr) {
+      uring_close_conn(sh, c);
+    } else {
+      uring_reap(sh, d);
+    }
+    return;
+  }
+  if (kind == kUdCancel) {
+    if (any == nullptr) return;
+    any->uring_ops--;
+    if (any->dead) uring_reap(sh, any);
+    return;
+  }
+}
+
+void uring_loop(Shard* sh) {
+  UringRing* r = sh->ring;
+  {
+    std::lock_guard<FeMutex> lk(sh->mu);
+    uring_arm_accept(sh);
+    uring_arm_ctl_read(sh, 1, &r->ev_buf, kUdEvRead);
+    uring_arm_ctl_read(sh, 2, &r->tf_buf, kUdTfRead);
+    uring_submit(sh);
+  }
+  std::vector<uint64_t> doomed;
+  for (;;) {
+    // Wait (WITHOUT mu — pump threads stage and submit under it) only
+    // when the CQ is empty; completed work never blocks on the wait.
+    if (r->cq_head->load(std::memory_order_relaxed) ==
+        r->cq_tail->load(std::memory_order_acquire)) {
+      count_sys(sh);
+      r->enters.fetch_add(1, std::memory_order_relaxed);
+      int rc = sys_uring_enter(r->fd, 0, 1, kUringEnterGetevents);
+      if (rc < 0 && errno != EINTR && errno != EAGAIN && errno != EBUSY &&
+          errno != ETIME) {
+        break;  // epoll-loop parity: a hard wait error ends the shard
+      }
+    }
+    if (sh->owner->stopping.load()) break;
+    std::unique_lock<FeMutex> lk(sh->mu);
+    uint32_t head = r->cq_head->load(std::memory_order_relaxed);
+    uint32_t tail = r->cq_tail->load(std::memory_order_acquire);
+    while (head != tail) {
+      DrlCqe cqe = r->cqes[head & r->cq_mask];
+      head++;
+      // Publish per-entry so the kernel regains CQ space mid-burst (a
+      // 4096-deep CQ can otherwise overflow under multishot recv).
+      r->cq_head->store(head, std::memory_order_release);
+      r->cqes_seen.fetch_add(1, std::memory_order_relaxed);
+      uring_handle_cqe(sh, cqe);
+      tail = r->cq_tail->load(std::memory_order_acquire);
+    }
+    if (sh->uring_sweep) {
+      // Rare slow path: an arm hit a full SQ, or a closing conn has no
+      // in-flight op to complete into teardown. Walk and repair.
+      sh->uring_sweep = false;
+      doomed.clear();
+      for (auto& [cid, cc] : sh->conns) {
+        if (cc->closing && !cc->send_inflight &&
+            cc->wbuf_off >= cc->wbuf.size() &&
+            cc->out_off >= cc->out.size()) {
+          doomed.push_back(cid);
+          continue;
+        }
+        if (!cc->send_inflight && (cc->wbuf_off < cc->wbuf.size() ||
+                                   cc->out_off < cc->out.size())) {
+          uring_arm_send(sh, cc);
+        }
+        if (!cc->recv_armed && !cc->closing) uring_arm_recv(sh, cc);
+      }
+      for (uint64_t cid : doomed) {
+        auto it = sh->conns.find(cid);
+        if (it != sh->conns.end()) uring_close_conn(sh, it->second);
+      }
+    }
+    // Flush decision once per completion burst — identical policy to
+    // the epoll loop (flush-on-idle + deadline + size trigger).
+    if (!sh->pending.empty()) {
+      bool idle_pump = sh->pump_waiting && sh->ready.empty() &&
+                       sh->pt.empty() && sh->inflight.empty() &&
+                       sh->bulk_ready.empty() && sh->bulk_inflight.empty();
+      bool due = now_ns() >= sh->pending_oldest_ns + sh->deadline_ns;
+      if (sh->pending.size() >= sh->max_batch || idle_pump || due) {
+        flush_pending(sh, /*include_tail=*/idle_pump || due);
+      }
+    }
+    arm_deadline(sh);
+    uring_submit(sh);
+  }
+  // Shutdown: fail the pump out of its wait and free every connection,
+  // parked or live — owed CQEs die with the ring (fe_stop frees it
+  // after this thread joins, so no op can complete into freed memory).
+  std::lock_guard<FeMutex> lk(sh->mu);
+  for (auto& [id, c] : sh->conns) {
+    if (c->fd >= 0) ::close(c->fd);
+    delete c;
+  }
+  sh->conns.clear();
+  for (auto& [id, c] : sh->dying) {
+    if (c->fd >= 0) ::close(c->fd);
+    delete c;
+  }
+  sh->dying.clear();
+  sh->cv.notify_all();
+}
+
+// Transport-mode resolution: DRL_TPU_NO_URING trumps everything (the
+// operator's kill switch), then DRL_TPU_URING ("1"/"on" → uring,
+// "sqpoll"/"2" → uring+SQPOLL). Default: epoll (the portable lane).
+int uring_mode_from_env(void) {
+  const char* m = std::getenv("DRL_TPU_URING");
+  if (m == nullptr || *m == '\0') return kUringOff;
+  std::string v(m);
+  if (v == "0" || v == "off") return kUringOff;
+  if (v == "2" || v == "sqpoll") return kUringSqpoll;
+  return kUringOn;
 }
 
 }  // namespace
 
 extern "C" {
 
-void* fe_start_sharded(const char* host, int port, int max_batch,
-                       int deadline_us, int require_auth, int nshards,
-                       int pin_cpus) {
+void* fe_start_sharded2(const char* host, int port, int max_batch,
+                        int deadline_us, int require_auth, int nshards,
+                        int pin_cpus, int uring_mode) {
   if (nshards < 1) nshards = 1;
   if (nshards > kMaxShards) nshards = kMaxShards;
+  // The operator kill switch trumps an explicit request from Python.
+  bool uring_killed = false;
+  {
+    const char* no = std::getenv("DRL_TPU_NO_URING");
+    if (no != nullptr && *no != '\0' && std::string(no) != "0") {
+      uring_killed = uring_mode != kUringOff;
+      uring_mode = kUringOff;
+    }
+  }
+  if (uring_mode != kUringOff && uring_mode != kUringOn &&
+      uring_mode != kUringSqpoll) {
+    uring_mode = kUringOff;
+  }
   Frontend* fe = new Frontend();
+  fe->uring_mode = uring_mode;
   fe->nshards = nshards;
   fe->max_batch = size_t(max_batch > 0 ? max_batch : 4096);
   fe->deadline_ns = uint64_t(deadline_us > 0 ? deadline_us : 300) * 1000ull;
@@ -2042,7 +3078,15 @@ void* fe_start_sharded(const char* host, int port, int max_batch,
   }
   for (int i = 0; i < nshards; i++) {
     Shard* sh = fe->shards[size_t(i)];
-    sh->io = std::thread(io_loop, sh);
+    if (uring_mode != kUringOff) {
+      // Per-shard graceful fallback: a shard the kernel (or seccomp)
+      // refuses runs the epoll loop, records why in uring_reason, and
+      // serves identically — availability over transport.
+      uring_setup_shard(sh, uring_mode == kUringSqpoll);
+    } else if (uring_killed) {
+      sh->uring_reason = "disabled by DRL_TPU_NO_URING";
+    }
+    sh->io = std::thread(sh->uring ? uring_loop : io_loop, sh);
     if (!allowed.empty()) {
       cpu_set_t cpus;
       CPU_ZERO(&cpus);
@@ -2051,6 +3095,16 @@ void* fe_start_sharded(const char* host, int port, int max_batch,
     }
   }
   return fe;
+}
+
+void* fe_start_sharded(const char* host, int port, int max_batch,
+                       int deadline_us, int require_auth, int nshards,
+                       int pin_cpus) {
+  // Round-11 compatibility entry: transport comes from the environment
+  // (DRL_TPU_URING / DRL_TPU_NO_URING), defaulting to epoll.
+  return fe_start_sharded2(host, port, max_batch, deadline_us,
+                           require_auth, nshards, pin_cpus,
+                           uring_mode_from_env());
 }
 
 void* fe_start(const char* host, int port, int max_batch, int deadline_us,
@@ -2265,6 +3319,7 @@ void fe_complete(void* h, long long batch_id, const uint8_t* granted,
   }
   sh->inflight.erase(it);
   maybe_flush_after_complete(sh);
+  if (sh->uring) uring_submit(sh);  // one enter for the whole batch
 }
 
 // Fail a batch (store raised): every item gets a routable error reply.
@@ -2289,6 +3344,7 @@ void fe_fail(void* h, long long batch_id, const char* msg) {
   }
   sh->inflight.erase(it);
   maybe_flush_after_complete(sh);
+  if (sh->uring) uring_submit(sh);
 }
 
 long long fe_pt_conn(void* h) {
@@ -2315,6 +3371,7 @@ void fe_send(void* h, uint64_t conn_id, const char* data, int len) {
   if (itc == sh->conns.end()) return;
   send_to_conn(sh, itc->second, data, size_t(len));
   sh->requests_served++;
+  if (sh->uring) uring_submit(sh);
 }
 
 void fe_set_authed(void* h, uint64_t conn_id, int authed) {
@@ -2351,6 +3408,7 @@ void fe_set_authed(void* h, uint64_t conn_id, int authed) {
   } else {
     flush_queued(sh, c);  // replayed tier-0/PING replies
   }
+  if (sh->uring) uring_submit(sh);
   // Replayed hot items joined `pending` from this (loop) thread: wake
   // the IO thread so its flush/deadline evaluation sees them.
   wake_io(sh);
@@ -2362,11 +3420,14 @@ void fe_close_conn(void* h, uint64_t conn_id) {
   auto itc = sh->conns.find(conn_id);
   if (itc == sh->conns.end()) return;
   Conn* c = itc->second;
-  if (c->out.empty()) {
+  if (c->out.empty() && (!sh->uring || (c->wbuf_off >= c->wbuf.size() &&
+                                        !c->send_inflight))) {
     close_conn(sh, c);
   } else {
     c->closing = true;  // drain the goodbye (e.g. auth-failed error) first
+    if (sh->uring) uring_arm_send(sh, c);
   }
+  if (sh->uring) uring_submit(sh);
 }
 
 // Whole-node counters with a Frontend handle (the sum across shards);
@@ -2450,6 +3511,13 @@ void fe_stop(void* h) {
       sh->cv.notify_all();
     }
     if (sh->io.joinable()) sh->io.join();
+    if (sh->ring != nullptr) {
+      // After the join no op can complete into shard memory; closing
+      // the ring fd also drops the registered-file references.
+      uring_free_ring(sh->ring);
+      sh->ring = nullptr;
+      sh->uring = false;
+    }
     ::close(sh->listen_fd);
     ::close(sh->epfd);
     ::close(sh->evfd);
@@ -2459,9 +3527,88 @@ void fe_stop(void* h) {
 
 void fe_free(void* h) {
   Frontend* fe = owner_of(h);
-  for (Shard* sh : fe->shards) delete sh;
+  for (Shard* sh : fe->shards) {
+    if (sh->ring != nullptr) uring_free_ring(sh->ring);  // stop-less free
+    delete sh;
+  }
   for (T0Part* part : fe->t0parts) delete part;
   delete fe;
+}
+
+// ---------------------------------------------------------------------
+// io_uring transport ABI (round 16). Feature detection mirrors the
+// shard ABI's: utils/native.py probes these symbols and falls back to
+// fe_start_sharded (epoll or env-resolved) when they are absent.
+// ---------------------------------------------------------------------
+
+// Process-wide availability: 1 when the kernel offers the 5.19+ feature
+// level this transport needs AND no env/sanitizer gate forbids it.
+int fe_uring_available(void) {
+  std::string r;
+  return uring_probe(&r);
+}
+
+// Availability plus the human-readable reason (for `--probe` output and
+// the loud fallback log line). Returns the same 0/1 as above; writes a
+// NUL-terminated reason (empty on success) into buf.
+int fe_uring_probe(char* buf, int len) {
+  std::string r;
+  int ok = uring_probe(&r);
+  if (ok != 0 && r.empty()) {
+    r = "io_uring available (5.19+ feature level)";
+  }
+  if (buf != nullptr && len > 0) {
+    size_t n = std::min(size_t(len - 1), r.size());
+    std::memcpy(buf, r.data(), n);
+    buf[n] = '\0';
+  }
+  return ok;
+}
+
+// How many of the node's shards are actually serving on uring (the
+// request is per-node; refusal is per-shard).
+int fe_uring_shards(void* h) {
+  int n = 0;
+  for (Shard* sh : owner_of(h)->shards) n += sh->uring ? 1 : 0;
+  return n;
+}
+
+// Per-shard transport status: returns 1 (uring) / 0 (epoll) / -1 (bad
+// index) and writes the shard's fallback reason (empty when it never
+// fell back) into buf.
+int fe_uring_reason(void* h, int shard, char* buf, int len) {
+  Frontend* fe = owner_of(h);
+  if (shard < 0 || shard >= fe->nshards) return -1;
+  Shard* sh = fe->shards[size_t(shard)];
+  if (buf != nullptr && len > 0) {
+    size_t n = std::min(size_t(len - 1), sh->uring_reason.size());
+    std::memcpy(buf, sh->uring_reason.data(), n);
+    buf[n] = '\0';
+  }
+  return sh->uring ? 1 : 0;
+}
+
+// out[8]: shards on uring, shards on SQPOLL, io_uring_enter calls,
+// SQEs submitted, CQEs completed, data-plane syscalls (both
+// transports — the syscalls/frame numerator), shards that fell back
+// after an explicit uring request, reserved. Frontend OR shard handle.
+void fe_uring_counts(void* h, long long* out) {
+  for (int i = 0; i < 8; i++) out[i] = 0;
+  for (Shard* sh : shards_of(h)) {
+    if (sh->uring) out[0]++;
+    if (sh->uring_sqpoll) out[1]++;
+    if (sh->ring != nullptr) {
+      out[2] += sh->ring->enters.load(std::memory_order_relaxed);
+      out[3] += sh->ring->sqes_submitted.load(std::memory_order_relaxed);
+      out[4] += sh->ring->cqes_seen.load(std::memory_order_relaxed);
+    }
+    out[5] += sh->io_syscalls.load(std::memory_order_relaxed);
+    // A fallback is any shard serving epoll WITH a recorded reason —
+    // that covers both probe/setup refusals and the DRL_TPU_NO_URING
+    // coercion (which rewrites fe->uring_mode, so the mode alone can't
+    // tell). uring_reason is written once before the IO threads start.
+    if (!sh->uring && !sh->uring_reason.empty()) out[6]++;
+  }
 }
 
 // ---------------------------------------------------------------------
@@ -2771,6 +3918,7 @@ void fe_bulk_complete(void* h, long long job_id, const uint8_t* granted,
   hist_record(sh, double(t - job.t_ns) * 1e-9);
   sh->requests_served++;
   finish_bulk_job(sh, job_id);
+  if (sh->uring) uring_submit(sh);
 }
 
 // Drop a job whose frame Python already answered wholesale via fe_send
@@ -2784,6 +3932,7 @@ void fe_bulk_discard(void* h, long long job_id) {
   if (it == sh->bulk_inflight.end()) return;
   hist_record(sh, double(now_ns() - it->second.t_ns) * 1e-9);
   finish_bulk_job(sh, job_id);
+  if (sh->uring) uring_submit(sh);
 }
 
 // Fail a job (store raised): the frame gets one routable error reply.
@@ -2801,6 +3950,7 @@ void fe_bulk_fail(void* h, long long job_id, const char* msg) {
   hist_record(sh, double(now_ns() - job.t_ns) * 1e-9);
   sh->requests_served++;
   finish_bulk_job(sh, job_id);
+  if (sh->uring) uring_submit(sh);
 }
 
 // out[7]: frames, frames decided fully in C, rows, rows decided
@@ -3192,6 +4342,292 @@ int fe_lg_bulk(const char* host, int port, int n_conns, int depth,
   *out_granted = granted;
   for (auto& c : conns) ::close(c.fd);
   ::close(epfd);
+  return 0;
+}
+
+// uring twin of fe_lg_bulk (round 16): identical frame template, depth
+// pipelining, and accounting — the transport is ONE ring driving every
+// connection, so a reply burst costs one enter instead of a recv+send
+// pair per connection (in r11 the epoll loadgen's own syscall bill was
+// part of the measured ceiling). Per connection at most one SEND and
+// one RECV op are in flight; a 10 s TIMEOUT op mirrors the epoll lane's
+// stalled-server bail. Returns -2 when the kernel lacks the uring
+// feature level (callers fall back to fe_lg_bulk), else 0/-1 with the
+// same contract.
+int fe_lg_bulk_uring(const char* host, int port, int n_conns, int depth,
+                     int frames_per_conn, int rows_per_frame, int keyspace,
+                     double a, double b, double* out_elapsed_s,
+                     long long* out_frames, long long* out_rows,
+                     long long* out_granted) {
+  {
+    std::string reason;
+    if (uring_probe(&reason) == 0) return -2;
+  }
+  if (n_conns <= 0 || rows_per_frame <= 0 || keyspace <= 0) return -1;
+  DrlUringParams p{};
+  p.flags = kUringSetupCqsize | kUringSetupClamp;
+  unsigned sq_want = 64;
+  while (sq_want < unsigned(2 * n_conns + 8) && sq_want < 4096) {
+    sq_want <<= 1;
+  }
+  p.cq_entries = sq_want * 2;
+  int rfd = sys_uring_setup(sq_want, &p);
+  if (rfd < 0) return -2;
+  size_t sq_len = size_t(p.sq_off.array) + p.sq_entries * sizeof(uint32_t);
+  size_t cq_len = size_t(p.cq_off.cqes) + p.cq_entries * sizeof(DrlCqe);
+  bool single = (p.features & kUringFeatSingleMmap) != 0;
+  if (single) sq_len = cq_len = std::max(sq_len, cq_len);
+  void* sqm = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, rfd, long(kUringOffSqRing));
+  void* cqm = single ? sqm
+                     : mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                            MAP_SHARED | MAP_POPULATE, rfd,
+                            long(kUringOffCqRing));
+  size_t sqes_len = p.sq_entries * sizeof(DrlSqe);
+  void* sqesm = mmap(nullptr, sqes_len, PROT_READ | PROT_WRITE,
+                     MAP_SHARED | MAP_POPULATE, rfd, long(kUringOffSqes));
+  if (sqm == MAP_FAILED || cqm == MAP_FAILED || sqesm == MAP_FAILED) {
+    if (sqm != MAP_FAILED) munmap(sqm, sq_len);
+    if (cqm != MAP_FAILED && cqm != sqm) munmap(cqm, cq_len);
+    if (sqesm != MAP_FAILED) munmap(sqesm, sqes_len);
+    ::close(rfd);
+    return -2;
+  }
+  auto* sqb = static_cast<uint8_t*>(sqm);
+  auto* sq_head =
+      reinterpret_cast<std::atomic<uint32_t>*>(sqb + p.sq_off.head);
+  auto* sq_tail =
+      reinterpret_cast<std::atomic<uint32_t>*>(sqb + p.sq_off.tail);
+  uint32_t sq_mask = *reinterpret_cast<uint32_t*>(sqb + p.sq_off.ring_mask);
+  uint32_t* sq_array = reinterpret_cast<uint32_t*>(sqb + p.sq_off.array);
+  DrlSqe* sqes = static_cast<DrlSqe*>(sqesm);
+  auto* cqb = static_cast<uint8_t*>(cqm);
+  auto* cq_head =
+      reinterpret_cast<std::atomic<uint32_t>*>(cqb + p.cq_off.head);
+  auto* cq_tail =
+      reinterpret_cast<std::atomic<uint32_t>*>(cqb + p.cq_off.tail);
+  uint32_t cq_mask = *reinterpret_cast<uint32_t*>(cqb + p.cq_off.ring_mask);
+  DrlCqe* cqes = reinterpret_cast<DrlCqe*>(cqb + p.cq_off.cqes);
+  uint32_t staged = 0;
+  auto get_sqe = [&]() -> DrlSqe* {
+    uint32_t tail = sq_tail->load(std::memory_order_relaxed);
+    uint32_t head = sq_head->load(std::memory_order_acquire);
+    if (tail - head >= sq_mask + 1) return nullptr;
+    uint32_t idx = tail & sq_mask;
+    DrlSqe* s = &sqes[idx];
+    std::memset(s, 0, sizeof *s);
+    sq_array[idx] = idx;
+    sq_tail->store(tail + 1, std::memory_order_release);
+    staged++;
+    return s;
+  };
+  auto cleanup = [&]() {
+    if (cqm != sqm) munmap(cqm, cq_len);
+    munmap(sqm, sq_len);
+    munmap(sqesm, sqes_len);
+    ::close(rfd);
+  };
+  std::vector<LgConn> conns{size_t(n_conns)};
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    cleanup();
+    return -1;
+  }
+  for (int i = 0; i < n_conns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+      for (int j = 0; j < i; j++) ::close(conns[size_t(j)].fd);
+      ::close(fd);
+      cleanup();
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    // Sockets stay blocking: a ring op parks in the kernel instead of
+    // returning EAGAIN, so no EPOLLOUT staging machinery is needed.
+    conns[size_t(i)].fd = fd;
+  }
+  // Frame template — byte-identical to fe_lg_bulk's (the server replies
+  // are compared across loadgens in the parity rig).
+  uint64_t n = uint64_t(rows_per_frame);
+  std::string body;
+  body.push_back(char(kVersion));
+  wr_u32(&body, 0);  // seq, patched per send at offset 1
+  body.push_back(char(OP_ACQUIRE_MANY));
+  body.push_back(char(kBulkFlagRemaining));
+  wr_f64(&body, a);
+  wr_f64(&body, b);
+  wr_u32(&body, uint32_t(n));
+  std::string blob;
+  std::vector<uint16_t> klens(n);
+  for (uint64_t i = 0; i < n; i++) {
+    std::string key = "b" + std::to_string(i % uint64_t(keyspace));
+    klens[i] = uint16_t(key.size());
+    blob += key;
+  }
+  body.append(reinterpret_cast<const char*>(klens.data()), 2 * n);
+  body += blob;
+  for (uint64_t i = 0; i < n; i++) wr_u32(&body, 1);  // unit counts
+  std::string frame;
+  wr_u32(&frame, uint32_t(body.size()));
+  frame += body;
+  constexpr size_t kSeqOff = 5;
+  std::vector<std::string> outq(static_cast<size_t>(n_conns));
+  std::vector<size_t> outq_off(static_cast<size_t>(n_conns), 0);
+  std::vector<uint8_t> send_busy(static_cast<size_t>(n_conns), 0);
+  std::vector<uint8_t> recv_busy(static_cast<size_t>(n_conns), 0);
+  std::vector<std::vector<uint8_t>> rbuf(
+      static_cast<size_t>(n_conns), std::vector<uint8_t>(65536));
+  auto arm_send = [&](size_t ci) {
+    LgConn& c = conns[ci];
+    if (send_busy[ci] != 0 || c.dead) return;
+    if (outq_off[ci] >= outq[ci].size()) {
+      outq[ci].clear();
+      outq_off[ci] = 0;
+      return;
+    }
+    DrlSqe* s = get_sqe();
+    if (s == nullptr) return;  // retried when the op count drops
+    s->opcode = kOpSend;
+    s->fd = c.fd;
+    s->addr = uint64_t(
+        reinterpret_cast<uintptr_t>(outq[ci].data() + outq_off[ci]));
+    s->len = uint32_t(outq[ci].size() - outq_off[ci]);
+    s->op_flags = MSG_NOSIGNAL;
+    s->user_data = uring_ud(kUdSend, ci);
+    send_busy[ci] = 1;
+  };
+  auto arm_recv = [&](size_t ci) {
+    LgConn& c = conns[ci];
+    if (recv_busy[ci] != 0 || c.dead) return;
+    DrlSqe* s = get_sqe();
+    if (s == nullptr) return;
+    s->opcode = kOpRecv;
+    s->fd = c.fd;
+    s->addr = uint64_t(reinterpret_cast<uintptr_t>(rbuf[ci].data()));
+    s->len = uint32_t(rbuf[ci].size());
+    s->user_data = uring_ud(kUdRecv, ci);
+    recv_busy[ci] = 1;
+  };
+  auto send_frames = [&](size_t ci, int count) {
+    LgConn& c = conns[ci];
+    for (int d = 0; d < count && c.sent < frames_per_conn; d++) {
+      uint32_t seq = uint32_t(c.sent++);
+      std::memcpy(&frame[kSeqOff], &seq, 4);
+      outq[ci] += frame;
+    }
+    arm_send(ci);
+  };
+  DrlKTimespec bail_ts{10, 0};  // the epoll lane's 10 s stall bail
+  auto arm_bail = [&]() {
+    DrlSqe* s = get_sqe();
+    if (s == nullptr) return;
+    s->opcode = kOpTimeout;
+    s->addr = uint64_t(reinterpret_cast<uintptr_t>(&bail_ts));
+    s->len = 1;
+    s->user_data = uring_ud(kUdTfRead, 0);  // kind reuse: the timer slot
+  };
+  long long frames_done = 0, granted = 0;
+  long long bail_mark = -1;
+  int live = n_conns;
+  const long long want = (long long)n_conns * frames_per_conn;
+  uint64_t t0 = now_ns();
+  for (size_t ci = 0; ci < size_t(n_conns); ci++) {
+    send_frames(ci, depth);
+    arm_recv(ci);
+  }
+  arm_bail();
+  bool stalled = false;
+  while (frames_done < want && live > 0 && !stalled) {
+    int rc = sys_uring_enter(rfd, staged, 1, kUringEnterGetevents);
+    if (rc < 0) {
+      if (errno == EINTR || errno == EBUSY || errno == EAGAIN) continue;
+      break;
+    }
+    staged -= uint32_t(rc) > staged ? staged : uint32_t(rc);
+    uint32_t head = cq_head->load(std::memory_order_relaxed);
+    uint32_t tail = cq_tail->load(std::memory_order_acquire);
+    while (head != tail) {
+      DrlCqe cqe = cqes[head & cq_mask];
+      head++;
+      cq_head->store(head, std::memory_order_release);
+      uint64_t kind = cqe.user_data >> 56;
+      size_t ci = size_t(cqe.user_data & ((1ull << 56) - 1));
+      if (kind == kUdTfRead) {  // the 10 s stall bail
+        if (frames_done == bail_mark) {
+          stalled = true;
+          break;
+        }
+        bail_mark = frames_done;
+        arm_bail();
+        tail = cq_tail->load(std::memory_order_acquire);
+        continue;
+      }
+      LgConn& c = conns[ci];
+      if (kind == kUdSend) {
+        send_busy[ci] = 0;
+        if (cqe.res < 0) {
+          if (!c.dead) {
+            c.dead = true;
+            live--;
+          }
+        } else if (!c.dead) {
+          outq_off[ci] += size_t(cqe.res);
+          arm_send(ci);
+        }
+        tail = cq_tail->load(std::memory_order_acquire);
+        continue;
+      }
+      // kUdRecv
+      recv_busy[ci] = 0;
+      if (cqe.res <= 0) {
+        if (!c.dead) {
+          c.dead = true;
+          live--;
+        }
+        tail = cq_tail->load(std::memory_order_acquire);
+        continue;
+      }
+      c.in.insert(c.in.end(), rbuf[ci].data(), rbuf[ci].data() + cqe.res);
+      int completed = 0;
+      for (;;) {
+        size_t avail = c.in.size() - c.in_off;
+        if (avail < 4) break;
+        uint32_t len = rd_u32(c.in.data() + c.in_off);
+        if (avail < 4 + size_t(len)) break;
+        const uint8_t* rbody = c.in.data() + c.in_off + 4;
+        if (len >= kBodyOff + kBulkRespHead && rbody[5] == RESP_BULK) {
+          uint32_t rn = rd_u32(rbody + kBodyOff + 1);
+          const uint8_t* bits = rbody + kBodyOff + kBulkRespHead;
+          size_t nbits = (size_t(rn) + 7) / 8;
+          if (len >= kBodyOff + kBulkRespHead + nbits) {
+            for (size_t bi = 0; bi < nbits; bi++) {
+              granted += __builtin_popcount(bits[bi]);
+            }
+          }
+        }
+        c.in_off += 4 + len;
+        frames_done++;
+        c.recvd++;
+        completed++;
+      }
+      if (c.in_off == c.in.size()) {
+        c.in.clear();
+        c.in_off = 0;
+      }
+      if (completed > 0) send_frames(ci, completed);
+      arm_recv(ci);
+      tail = cq_tail->load(std::memory_order_acquire);
+    }
+  }
+  *out_elapsed_s = double(now_ns() - t0) * 1e-9;
+  *out_frames = frames_done;
+  *out_rows = frames_done * (long long)rows_per_frame;
+  *out_granted = granted;
+  for (auto& c : conns) ::close(c.fd);
+  cleanup();
   return 0;
 }
 

@@ -56,7 +56,8 @@ def test_recapture_debt_ledger_semantics(tmp_path):
     assert names == ["fp_mesh_fixed", "fp_bulk_optimized",
                      "native_fe_device_sweep", "llm_workload_device",
                      "native_fe_shard_sweep",
-                     "llm_reservations_device", "federation_device"]
+                     "llm_reservations_device", "federation_device",
+                     "native_fe_uring_sweep"]
     ledger = tmp_path / "recapture.jsonl"
     assert recapture.owed(ledger) == names  # nothing settled yet
     recapture._append(ledger, {"debt": names[0], "status": "ok",

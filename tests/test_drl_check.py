@@ -200,6 +200,55 @@ def test_shard_abi_exports_are_bound():
         assert sym in exported, sym
 
 
+def test_uring_abi_exports_are_bound():
+    """Both directions of the round-16 uring ABI: the transport
+    lifecycle exports (fe_start_sharded2 / fe_uring_*) and the uring
+    bulk load generator have ctypes bindings and vice versa — a rename
+    on either side would silently degrade every uring deployment to
+    epoll (has_uring feature detection reads the same symbols)."""
+    bound = wire_conformance._py_bound_symbols(NATIVE_PY)
+    exported = wire_conformance._c_exported_symbols(FRONTEND)
+    for sym in ("fe_start_sharded2", "fe_uring_available",
+                "fe_uring_probe", "fe_uring_shards", "fe_uring_reason",
+                "fe_uring_counts", "fe_lg_bulk_uring"):
+        assert sym in bound, sym
+        assert sym in exported, sym
+
+
+def test_transport_flags_clean_on_live_tree():
+    assert wire_conformance.check_transport_flags(
+        NATIVE_PY, FRONTEND, ROOT) == []
+
+
+def test_transport_flag_drift_fires_once(tmp_path):
+    """Seeded divergence: drifting kUringSqpoll's value means an
+    operator asking for SQPOLL gets a different transport with no error
+    anywhere — the rule must catch it with both names in the message."""
+    cc = _mutated_frontend(tmp_path, "constexpr int kUringSqpoll = 2;",
+                           "constexpr int kUringSqpoll = 3;")
+    findings = wire_conformance.check_transport_flags(NATIVE_PY, cc,
+                                                      tmp_path)
+    assert [f.rule for f in findings] == ["transport-flag"]
+    f = findings[0]
+    assert "kUringSqpoll" in f.message and "URING_SQPOLL" in f.message
+    assert f.file.endswith("frontend.cc")
+    assert any("native.py" in rf for rf, _, _ in f.related)
+
+
+def test_transport_flag_missing_python_side_fires(tmp_path):
+    """A native.py refactor that drops a mode constant must fail the
+    rule loudly (not read as vacuously clean)."""
+    text = NATIVE_PY.read_text()
+    anchor = "URING_SQPOLL = 2"
+    assert anchor in text
+    mutated = tmp_path / "native.py"
+    mutated.write_text(text.replace(anchor, "_RETIRED_MODE = 2", 1))
+    findings = wire_conformance.check_transport_flags(mutated, FRONTEND,
+                                                      tmp_path)
+    assert [f.rule for f in findings] == ["transport-flag"]
+    assert "URING_SQPOLL" in findings[0].message
+
+
 def test_missing_fe_export_fires_both_ways(tmp_path):
     # Rename an exported symbol: the binding can't resolve (one finding
     # at the Python binding site) and the renamed export is dead surface

@@ -27,30 +27,51 @@ from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
 from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
 from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
 
+_LIB = load_frontend_lib()
 pytestmark = pytest.mark.skipif(
-    load_frontend_lib() is None,
+    _LIB is None,
     reason="native front-end library unavailable (no compiler?)")
+
+#: uring arms need a live ring: kernel support AND no seccomp gate AND
+#: a binary with the uring ABI. Arms skip loudly otherwise — the epoll
+#: arms still run, so parity is never silently untested.
+_URING_OK = bool(_LIB is not None and getattr(_LIB, "has_uring", False)
+                 and _LIB.fe_uring_available())
+_URING_SKIP = pytest.mark.skipif(
+    not _URING_OK, reason="io_uring unavailable on this host "
+    "(kernel, seccomp, or stale binary) — uring parity arm skipped")
+
+
+def _uring_arm(*vals):
+    return pytest.param(*vals, marks=_URING_SKIP)
 
 
 # -- raw-socket helpers for the byte-level bulk differential ----------------
 
-async def _start_pair(tier0=False, shards=1):
+async def _start_pair(tier0=False, shards=1, uring=None):
     """One asyncio server and one native server over identical
     InProcess stores on lockstep manual clocks. ``shards`` sizes the
     native side's SO_REUSEPORT shard group (round 11): the fuzz drives
     ONE connection, which lives its whole life on whichever shard the
     kernel picked — the per-connection order contract is shard-local,
-    so replies must stay byte-identical at any shard count."""
+    so replies must stay byte-identical at any shard count. ``uring``
+    swaps the native side's transport (round 16): the reply bytes are
+    the spec, so every arm must pass unchanged on either transport."""
     clocks = [ManualClock(), ManualClock()]
     servers = [
         BucketStoreServer(InProcessBucketStore(clock=clocks[0]),
                           native_frontend=False),
         BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
                           native_frontend=True, native_tier0=tier0,
-                          native_shards=shards),
+                          native_shards=shards, native_uring=uring),
     ]
     for s in servers:
         await s.start()
+    if uring in ("on", "sqpoll"):
+        # The arm must actually test the ring: a silent per-shard
+        # fallback here would green the uring parity without running it.
+        assert servers[1]._native.uring_shards == \
+            servers[1]._native.n_shards
     conns = [await asyncio.open_connection(s.host, s.port)
              for s in servers]
     return clocks, servers, conns
@@ -112,12 +133,21 @@ def _random_bulk_frame(rng, seq: int) -> bytes:
         kind=kind, trace=trace)
 
 
-@pytest.mark.parametrize("seed,tier0,shards", [(5, False, 1),
-                                               (29, False, 1),
-                                               (5, True, 1),
-                                               (5, False, 4),
-                                               (29, True, 4)])
-def test_bulk_frames_reply_byte_identical(seed, tier0, shards):
+@pytest.mark.parametrize(
+    "seed,tier0,shards,uring",
+    [(5, False, 1, None),
+     (29, False, 1, None),
+     (5, True, 1, None),
+     (5, False, 4, None),
+     (29, True, 4, None),
+     # round 16: the SAME seeds over the uring transport — multishot
+     # recv rechunks arbitrarily, so the chained/malformed ordering
+     # contract is exercised under a different segmentation than epoll
+     # ever produces, and the replies must not move a byte.
+     _uring_arm(5, False, 4, "on"),
+     _uring_arm(29, True, 4, "on"),
+     _uring_arm(5, True, 1, "sqpoll")])
+def test_bulk_frames_reply_byte_identical(seed, tier0, shards, uring):
     """Randomized ACQUIRE_MANY frames — duplicates, probes, hostile
     keys, trace tails, every kind, chained chunks, malformed shapes —
     must produce byte-identical replies from the native bulk lane and
@@ -128,7 +158,8 @@ def test_bulk_frames_reply_byte_identical(seed, tier0, shards):
     and must behave identically on whichever shard accepts.)"""
     async def main():
         clocks, servers, conns = await _start_pair(tier0=tier0,
-                                                   shards=shards)
+                                                   shards=shards,
+                                                   uring=uring)
         rng = np.random.default_rng(seed)
         try:
             for step in range(150):
@@ -183,12 +214,14 @@ def test_bulk_frames_reply_byte_identical(seed, tier0, shards):
     asyncio.run(main())
 
 
-def test_bulk_gated_rows_byte_identical():
+@pytest.mark.parametrize("uring", [None, _uring_arm("on")])
+def test_bulk_gated_rows_byte_identical(uring):
     """Placement-MOVED and retired-config bulk frames answer the exact
     same routable errors from both lanes (frame-level gates; the native
-    lane answers them via fe_send + fe_bulk_discard)."""
+    lane answers them via fe_send + fe_bulk_discard) — on either
+    transport."""
     async def main():
-        _clocks, servers, conns = await _start_pair()
+        _clocks, servers, conns = await _start_pair(uring=uring)
         try:
             # Live-config mutation on both: retire (50, 1) -> (80, 2).
             for payload in ({"prepare": {"kind": "bucket",
@@ -244,15 +277,22 @@ def test_bulk_gated_rows_byte_identical():
 # at the fuzz's capacity (10) every key sits below the default
 # min_budget confidence gate, so tier-0 must be semantically INVISIBLE —
 # identical replies, never a locally-guessed decision.
-@pytest.mark.parametrize("seed,tier0,shards", [(11, False, 1),
-                                               (23, False, 1),
-                                               (47, False, 1),
-                                               (11, True, 1),
-                                               (47, True, 1),
-                                               (23, False, 4),
-                                               (11, True, 4)])
+@pytest.mark.parametrize(
+    "seed,tier0,shards,uring",
+    [(11, False, 1, None),
+     (23, False, 1, None),
+     (47, False, 1, None),
+     (11, True, 1, None),
+     (47, True, 1, None),
+     (23, False, 4, None),
+     (11, True, 4, None),
+     # round 16: scalar/chained/hierarchical mix over the uring
+     # transport, same seeds as the epoll arms above.
+     _uring_arm(23, False, 4, "on"),
+     _uring_arm(11, True, 4, "on"),
+     _uring_arm(47, False, 1, "sqpoll")])
 def test_native_and_asyncio_servers_answer_identically(seed, tier0,
-                                                       shards):
+                                                       shards, uring):
     async def main():
         clocks = [ManualClock(), ManualClock()]
         servers = [
@@ -260,10 +300,13 @@ def test_native_and_asyncio_servers_answer_identically(seed, tier0,
                               native_frontend=False),
             BucketStoreServer(InProcessBucketStore(clock=clocks[1]),
                               native_frontend=True, native_tier0=tier0,
-                              native_shards=shards),
+                              native_shards=shards, native_uring=uring),
         ]
         for s in servers:
             await s.start()
+        if uring in ("on", "sqpoll"):
+            assert servers[1]._native.uring_shards == \
+                servers[1]._native.n_shards
         stores = [RemoteBucketStore(address=(s.host, s.port),
                                     coalesce_requests=False)
                   for s in servers]

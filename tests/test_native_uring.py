@@ -1,0 +1,446 @@
+"""io_uring data plane (round 16): feature detection and transport
+semantics.
+
+The uring transport's contract is DESIGN.md §21: the reply bytes are
+the spec — swapping the shard IO loop from epoll to a ring (multishot
+accept/recv, provided buffers, linked sends, optional SQPOLL) may
+change syscall counts and nothing else. These tests pin the two halves
+of that contract the parity fuzz cannot:
+
+- the feature-detection matrix: every way a host can lack io_uring
+  (operator kill switch, seccomp EPERM — simulated via the C side's
+  DRL_TPU_URING_FAKE_DENY hook, which takes the same probe-failure
+  path as a kernel without the syscall — and a stale .so without the
+  uring ABI) must fall back to epoll loudly with ZERO behavior change;
+- the transport-dependent semantics: the per-connection order contract
+  under multishot recv's arbitrary rechunking, the single-envelope
+  over-admission bound with 4 uring shards deciding concurrently, and
+  a live OP_CONFIG retire sweeping every shard under uring bulk load.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from distributedratelimiting.redis_tpu.models.approximate import (
+    headroom_budget,
+    overadmit_epsilon,
+)
+from distributedratelimiting.redis_tpu.runtime import wire
+from distributedratelimiting.redis_tpu.runtime.native_frontend import (
+    Tier0Config,
+    native_bulk_loadgen,
+    uring_probe,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import RemoteBucketStore
+from distributedratelimiting.redis_tpu.runtime.server import BucketStoreServer
+from distributedratelimiting.redis_tpu.runtime.store import InProcessBucketStore
+from distributedratelimiting.redis_tpu.utils.native import load_frontend_lib
+
+_LIB = load_frontend_lib()
+pytestmark = pytest.mark.skipif(
+    _LIB is None or not getattr(_LIB, "has_uring", False),
+    reason="native front-end library unavailable or predates the "
+    "uring ABI")
+
+#: The live-ring tests additionally need the kernel to grant a ring
+#: (the fallback tests below do NOT — they run everywhere the ABI
+#: exists, which is exactly the point of the matrix).
+_URING_OK = bool(_LIB is not None and getattr(_LIB, "has_uring", False)
+                 and _LIB.fe_uring_available())
+needs_ring = pytest.mark.skipif(
+    not _URING_OK, reason="io_uring unavailable on this host (kernel, "
+    "seccomp, or io_uring_disabled) — live-ring test skipped")
+
+#: Sanitizer builds (make asan-test / tsan-test) feature-gate the ring
+#: off BEFORE the env hooks, so the probe's reason is the sanitizer
+#: gate's — the FAKE_DENY arm's EPERM wording can only be observed on
+#: an un-sanitized binary. The kill-switch arm is unaffected: its
+#: reason is stamped by the mode-coercion path, not the probe.
+_SANITIZER_GATED = (_LIB is not None and getattr(_LIB, "has_uring", False)
+                    and not _LIB.fe_uring_available()
+                    and "sanitizer" in uring_probe()[1])
+not_sanitizer = pytest.mark.skipif(
+    _SANITIZER_GATED, reason="sanitizer build: the ring is feature-gated "
+    "off ahead of the FAKE_DENY hook, so the EPERM reason never surfaces "
+    "— covered by the un-sanitized leg")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _roundtrip_raw(host, port, frames: "list[bytes]") -> list[bytes]:
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        for f in frames:
+            writer.write(f)
+        await writer.drain()
+        out = []
+        for _ in frames:
+            hdr = await asyncio.wait_for(reader.readexactly(4), 10.0)
+            (ln,) = struct.unpack("<I", hdr)
+            out.append(hdr + await asyncio.wait_for(
+                reader.readexactly(ln), 10.0))
+        return out
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+async def _serves_normally(srv, transport_visible: bool = False) -> dict:
+    """The zero-behavior-change oracle every fallback arm shares:
+    scalar + bulk traffic decides correctly. ``transport_visible`` pins
+    the OP_STATS shape: when uring was never (effectively) requested
+    the epoll stats shape must survive byte-unchanged; when it WAS
+    requested and fell back, the fe_transport diagnostic block must
+    appear — the fallback is loud on the stats surface too."""
+    store = RemoteBucketStore(address=(srv.host, srv.port))
+    try:
+        res = await store.acquire("fb", 1, 10.0, 1.0)
+        assert res.granted
+        many = await store.acquire_many([f"k{i % 4}" for i in range(16)],
+                                        [1] * 16, 1e7, 1e7)
+        assert many.granted.all()
+        st = await store.stats()
+        assert ("fe_transport" in st) == transport_visible, st.keys()
+        return st
+    finally:
+        await store.aclose()
+
+
+# -- feature-detection matrix -----------------------------------------------
+
+def test_probe_reports_availability_with_reason():
+    ok, reason = uring_probe()
+    assert isinstance(ok, bool)
+    assert reason, "probe must always explain itself"
+    if ok:
+        assert "io_uring available" in reason
+
+
+def test_kill_switch_forces_epoll(monkeypatch):
+    """DRL_TPU_NO_URING trumps an explicit uring request: every shard
+    serves on epoll, the reason names the switch, behavior unchanged."""
+    monkeypatch.setenv("DRL_TPU_NO_URING", "1")
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=2,
+                                     native_uring="on") as srv:
+            assert srv._native.uring_shards == 0
+            ts = srv._native.transport_stats()
+            assert ts["uring_shards"] == 0
+            assert ts["fallbacks"] == 2
+            assert all("DRL_TPU_NO_URING" in r
+                       for r in ts["fallback_reasons"].values())
+            await _serves_normally(srv, transport_visible=True)
+
+    run(body())
+
+
+@not_sanitizer
+def test_seccomp_denied_falls_back_per_shard(monkeypatch):
+    """A seccomp filter answering io_uring_setup with EPERM (simulated
+    by the C side's FAKE_DENY hook — the identical code path a kernel
+    without the syscall takes) must degrade every shard to epoll with
+    the EPERM reason recorded, and the probe must say so too."""
+    # An ambient kill switch outranks the hook (its check is first by
+    # design) — clear it so the simulated denial is what the probe sees.
+    monkeypatch.delenv("DRL_TPU_NO_URING", raising=False)
+    monkeypatch.setenv("DRL_TPU_URING_FAKE_DENY", "1")
+    ok, reason = uring_probe()
+    assert not ok
+    assert "EPERM" in reason and "seccomp" in reason
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=2,
+                                     native_uring="sqpoll") as srv:
+            assert srv._native.uring_shards == 0
+            ts = srv._native.transport_stats()
+            assert ts["fallbacks"] == 2
+            assert all("EPERM" in r
+                       for r in ts["fallback_reasons"].values())
+            await _serves_normally(srv, transport_visible=True)
+            # The uring loadgen arm must ALSO fall back (rc -2 path)
+            # and still measure.
+            f, r, _g, _el = await asyncio.to_thread(
+                native_bulk_loadgen, srv.host, srv.port, conns=2,
+                depth=2, frames_per_conn=10, rows_per_frame=32,
+                keyspace=4, uring=True)
+            assert f == 20 and r == 20 * 32
+
+    run(body())
+
+
+def test_stale_binary_fallback_serves_epoll(monkeypatch):
+    """uring requested against a binary without the uring ABI must
+    serve — on epoll, loudly — not fail: availability over throughput
+    (the has_shards fallback's posture, one ABI generation later)."""
+    async def body():
+        monkeypatch.setattr(_LIB, "has_uring", False)
+        try:
+            async with BucketStoreServer(InProcessBucketStore(),
+                                         native_frontend=True,
+                                         native_shards=2,
+                                         native_uring="on") as srv:
+                assert srv._native.uring_mode == 0
+                assert srv._native.uring_shards == 0
+                assert srv._native.transport_stats() is None
+                await _serves_normally(srv)
+        finally:
+            monkeypatch.setattr(_LIB, "has_uring", True)
+
+    run(body())
+
+
+def test_epoll_default_untouched_by_uring_abi():
+    """No uring request → no uring: the default server must not open a
+    ring just because the binary can (the epoll lane is the tier-1
+    baseline and must stay bit-for-bit what it was)."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=2) as srv:
+            assert srv._native.uring_shards == 0
+            ts = srv._native.transport_stats()
+            assert ts["mode"] == "epoll" and ts["uring_shards"] == 0
+            await _serves_normally(srv)
+
+    run(body())
+
+
+# -- live-ring semantics ----------------------------------------------------
+
+@needs_ring
+def test_uring_shards_actually_on_ring():
+    """The opt-in actually engages and pays: every shard reports the
+    uring transport, the ring counters move, and the self-instrumented
+    data-plane syscall counter comes in strictly below what the epoll
+    transport spends on the IDENTICAL load (the benchmark sweep owns
+    the big pipelined-ratio claim; this pins the direction under the
+    pytest-sized load)."""
+    async def run_one(uring):
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=True,
+                                     native_shards=2,
+                                     native_uring=uring) as srv:
+            expect = 2 if uring == "on" else 0
+            assert srv._native.uring_shards == expect
+            # Many concurrent connections is where the transports
+            # diverge: one ring enter drains/submits for EVERY ready
+            # conn in a burst, while the epoll loop pays recv+send per
+            # ready conn (plus the epoll_wait itself).
+            f, r, g, _el = await asyncio.to_thread(
+                native_bulk_loadgen, srv.host, srv.port, conns=32,
+                depth=4, frames_per_conn=25, rows_per_frame=64,
+                keyspace=8, uring=(uring == "on"))
+            assert f == 800 and r == 800 * 64 and g == r
+            return srv._native.transport_stats()
+
+    async def body():
+        epoll = await run_one(None)
+        uring = await run_one("on")
+        assert uring["uring_shards"] == 2
+        assert uring["sqes_submitted"] > 0
+        assert uring["cqes_seen"] >= 800  # ≥ one recv CQE per frame burst
+        assert epoll["enters"] == 0 and epoll["cqes_seen"] == 0
+        assert uring["io_syscalls"] < epoll["io_syscalls"], (uring, epoll)
+
+    run(body())
+
+
+@needs_ring
+def test_chained_chunk_order_under_multishot_recv():
+    """The per-connection order contract under the uring transport's
+    OWN segmentation: frames dribbled a few bytes at a time arrive as
+    many multishot-recv CQEs (a rechunking epoll never produces), and
+    a chained successor must still decide strictly AFTER its
+    predecessor — including a malformed predecessor whose error reply
+    must come back first."""
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_shards=1,
+                                     native_uring="on") as srv:
+            assert srv._native.uring_shards == 1
+            reader, writer = await asyncio.open_connection(srv.host,
+                                                           srv.port)
+            try:
+                async def dribble(blob: bytes, step: int):
+                    for i in range(0, len(blob), step):
+                        writer.write(blob[i:i + step])
+                        await writer.drain()
+                        await asyncio.sleep(0.002)
+
+                async def read_reply() -> bytes:
+                    hdr = await asyncio.wait_for(
+                        reader.readexactly(4), 10.0)
+                    (ln,) = struct.unpack("<I", hdr)
+                    return hdr + await asyncio.wait_for(
+                        reader.readexactly(ln), 10.0)
+
+                # Well-formed head + chained successor, 5 bytes/write.
+                f1 = wire.encode_bulk_request(
+                    1, [b"a", b"b", b"a"], [1, 1, 1], 100.0, 1.0)
+                f2 = wire.encode_bulk_request(
+                    2, [b"a", b"c"], [1, 1], 100.0, 1.0, chained=True)
+                await dribble(f1 + f2, 5)
+                r1, r2 = await read_reply(), await read_reply()
+                assert r1[5:9] == struct.pack("<I", 1)
+                assert r1[9] == wire.RESP_BULK
+                assert r2[5:9] == struct.pack("<I", 2)
+                assert r2[9] == wire.RESP_BULK
+                # Malformed head (truncated body, re-stamped length) +
+                # chained successor: the error must come back FIRST.
+                bad = f1[4:-3]
+                bad = struct.pack("<I", len(bad)) + bad
+                f3 = wire.encode_bulk_request(
+                    3, [b"d"], [1], 100.0, 1.0, chained=True)
+                await dribble(bad + f3, 7)
+                e1, e2 = await read_reply(), await read_reply()
+                assert e1[9] == wire.RESP_ERROR
+                assert e2[5:9] == struct.pack("<I", 3)
+                assert e2[9] == wire.RESP_BULK
+                # A pipelined burst after the dribbles: order holds at
+                # normal segmentation on the same (parked) connection.
+                frames = [wire.encode_bulk_request(
+                    100 + i, [b"p%d" % (i % 3)], [1], 100.0, 1.0)
+                    for i in range(32)]
+                writer.write(b"".join(frames))
+                await writer.drain()
+                for i in range(32):
+                    rep = await read_reply()
+                    assert rep[5:9] == struct.pack("<I", 100 + i), i
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError):
+                    pass
+
+    run(body())
+
+
+@needs_ring
+def test_uring_multishard_overadmit_bounded_by_flat_envelope():
+    """The single-envelope acceptance bound survives the transport
+    swap: 4 uring shards deciding concurrently from split budget
+    shares stay inside the SAME flat epsilon as single-shard epoll
+    (the envelope is tier-0 semantics — DESIGN.md §16 — and §21 says
+    the transport may not move it)."""
+    capacity, fill = 400.0, 1e-9
+    cfg = Tier0Config(sync_interval_s=0.005, min_budget=8.0)
+    budget = headroom_budget(capacity, fraction=cfg.budget_fraction,
+                             min_budget=cfg.min_budget,
+                             max_budget=cfg.max_budget)
+    assert budget / 4 >= cfg.min_budget
+    epsilon = overadmit_epsilon(budget, fill, cfg.sync_interval_s)
+    n_keys, per_frame, frames, n_conns = 4, 25, 8, 4
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg,
+                                     native_shards=4,
+                                     native_uring="on") as srv:
+            assert srv._native.uring_shards == 4
+            stores = [RemoteBucketStore(address=(srv.host, srv.port))
+                      for _ in range(n_conns)]
+            try:
+                keys = [f"u{i}" for i in range(n_keys)]
+                frame_keys = [keys[i % n_keys]
+                              for i in range(n_keys * per_frame)]
+                counts = [1] * len(frame_keys)
+                admitted = {k: 0 for k in keys}
+                results = await asyncio.gather(
+                    *(st.acquire_many(frame_keys, counts, capacity, fill)
+                      for st in stores for _ in range(frames)))
+                for res in results:
+                    for k, g in zip(frame_keys, res.granted):
+                        admitted[k] += bool(g)
+                for k in keys:
+                    assert admitted[k] <= capacity + epsilon, (
+                        k, admitted[k], epsilon)
+                    assert admitted[k] >= capacity * 0.9, (k, admitted[k])
+            finally:
+                for st in stores:
+                    await st.aclose()
+
+    run(body())
+
+
+@needs_ring
+def test_retire_fans_out_under_uring_bulk_load():
+    """Live OP_CONFIG mutation with 4 uring shards under bulk load:
+    after the sync pump retires the old config NO shard may answer
+    old-config frames from a live replica — the fe_t0_retire sweep is
+    transport-independent state, and the uring pump-facing submit path
+    (fe_bulk_complete & co. queueing SENDs) must not reorder the
+    terminal error/grant split."""
+    old_cap, old_rate = 100000.0, 1e-9
+    new_cap, new_rate = 120000.0, 2e-9
+    cfg = Tier0Config(sync_interval_s=0.005, min_budget=8.0)
+
+    async def body():
+        async with BucketStoreServer(InProcessBucketStore(),
+                                     native_frontend=True,
+                                     native_tier0=cfg,
+                                     native_shards=4,
+                                     native_uring="on") as srv:
+            assert srv._native.uring_shards == 4
+            await asyncio.to_thread(
+                native_bulk_loadgen, srv.host, srv.port, conns=16,
+                depth=4, frames_per_conn=40, rows_per_frame=256,
+                keyspace=8, capacity=old_cap, fill_rate=old_rate,
+                uring=True)
+            store = RemoteBucketStore(address=(srv.host, srv.port))
+            try:
+                st = await store.stats()
+                hosting = [s["shard"] for s in st["shards"]
+                           if s["tier0"]["entries"] > 0]
+                assert len(hosting) >= 2, hosting
+                load = asyncio.create_task(asyncio.to_thread(
+                    native_bulk_loadgen, srv.host, srv.port, conns=8,
+                    depth=2, frames_per_conn=40, rows_per_frame=256,
+                    keyspace=8, capacity=old_cap, fill_rate=old_rate,
+                    uring=True))
+                for payload in ({"prepare": {"kind": "bucket",
+                                             "old": [old_cap, old_rate],
+                                             "new": [new_cap, new_rate]},
+                                 "version": 1},
+                                {"commit": 1}):
+                    frame = wire.encode_request(900, wire.OP_CONFIG,
+                                                key=json.dumps(payload))
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] != wire.RESP_ERROR, reply
+                await load
+                await asyncio.sleep(cfg.sync_interval_s * 10)
+                for _ in range(16):
+                    frame = wire.encode_bulk_request(
+                        7, [b"b0", b"b1"], [1, 1], old_cap, old_rate)
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] == wire.RESP_ERROR, reply
+                    assert b"config moved" in reply, reply
+                    frame = wire.encode_bulk_request(
+                        8, [b"b0", b"b1"], [1, 1], new_cap, new_rate)
+                    reply = (await _roundtrip_raw(srv.host, srv.port,
+                                                  [frame]))[0]
+                    assert reply[9] == wire.RESP_BULK, reply
+            finally:
+                await store.aclose()
+
+    run(body())

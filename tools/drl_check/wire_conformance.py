@@ -33,6 +33,13 @@ extracts a wire model from each side and diffs them:
   sets is a deliberate-looking accident: nobody decided whether a
   retry after an ambiguous failure can double-apply it, and a future
   op silently defaults to whatever the author forgot to think about.
+- **Transport-mode flags** (``transport-flag``): the io_uring transport
+  selector ``fe_start_sharded2`` takes (``kUringOff`` / ``kUringOn`` /
+  ``kUringSqpoll`` in C; ``URING_OFF`` / ``URING_ON`` /
+  ``URING_SQPOLL`` in ``utils/native.py``) must exist on both sides
+  with equal values — a drift here silently starts the wrong transport
+  (an operator asking for SQPOLL getting plain uring, or uring getting
+  epoll) with no error anywhere.
 - **Tenant-extension fallthrough** (``wire-hier``): the hierarchical
   frames (``OP_ACQUIRE_H``, ``BULK_KIND_HBUCKET``) carry a tenant
   extension the C parser does not speak, so they MUST reach the Python
@@ -59,7 +66,8 @@ from tools.drl_check.common import (
 )
 
 __all__ = ["check", "check_wire", "check_abi", "check_dispatch",
-           "check_idempotency", "extract_py_model", "extract_c_model"]
+           "check_idempotency", "check_transport_flags",
+           "extract_py_model", "extract_c_model"]
 
 
 # -- Python-side model ------------------------------------------------------
@@ -497,6 +505,89 @@ def check_abi(native_py: pathlib.Path, cc_files: "list[pathlib.Path]",
     return findings
 
 
+# -- transport-mode flag cross-check ----------------------------------------
+
+#: fe_start_sharded2's uring_mode values: C constexpr name → the
+#: utils/native.py module constant that must mirror it. Pinned BOTH
+#: directions — a value drift or a missing side silently starts the
+#: wrong transport (no error: the C side would just run a mode the
+#: Python caller didn't mean).
+_TRANSPORT_FLAGS = {
+    "kUringOff": "URING_OFF",
+    "kUringOn": "URING_ON",
+    "kUringSqpoll": "URING_SQPOLL",
+}
+
+
+def _py_module_constants(py_file: pathlib.Path) -> dict[str, tuple[int, int]]:
+    """Module-level integer assignments → (value, line)."""
+    tree = ast.parse(py_file.read_text())
+    out: dict[str, tuple[int, int]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = const_eval_py(node.value, {})
+        if value is not None:
+            out[target.id] = (value, node.lineno)
+    return out
+
+
+def check_transport_flags(native_py: pathlib.Path,
+                          frontend_cc: pathlib.Path,
+                          root: pathlib.Path) -> list[Finding]:
+    """``transport-flag``: the uring transport-mode trio must exist on
+    both sides of the ctypes boundary with equal values."""
+    c = extract_c_model(frontend_cc)
+    py_consts = _py_module_constants(native_py)
+    py_rel = rel(native_py, root)
+    cc_rel = rel(frontend_cc, root)
+    findings: list[Finding] = []
+    for c_name, py_name in sorted(_TRANSPORT_FLAGS.items()):
+        c_has = c_name in c.constants
+        py_has = py_name in py_consts
+        if not c_has and not py_has:
+            findings.append(Finding(
+                "transport-flag",
+                f"transport mode pair {c_name}/{py_name} is defined on "
+                "neither side — the fe_start_sharded2 mode contract is "
+                "gone; retire this rule deliberately if the transport "
+                "knob was removed", cc_rel, 1, ((py_rel, 1, "searched"),)))
+            continue
+        if not c_has:
+            py_val, py_line = py_consts[py_name]
+            findings.append(Finding(
+                "transport-flag",
+                f"{py_name} = {py_val} has no C counterpart {c_name} in "
+                f"{cc_rel} — fe_start_sharded2 would receive a mode the "
+                "C side never interprets", py_rel, py_line,
+                ((cc_rel, 1, f"no constexpr {c_name}"),)))
+            continue
+        if not py_has:
+            c_val, c_line = c.constants[c_name]
+            findings.append(Finding(
+                "transport-flag",
+                f"{c_name} = {c_val} has no Python counterpart "
+                f"{py_name} in {py_rel} — callers cannot name this "
+                "transport mode", cc_rel, c_line,
+                ((py_rel, 1, f"no assignment to {py_name}"),)))
+            continue
+        c_val, c_line = c.constants[c_name]
+        py_val, py_line = py_consts[py_name]
+        if c_val != py_val:
+            findings.append(Finding(
+                "transport-flag",
+                f"{c_name} = {c_val} disagrees with {py_name} = "
+                f"{py_val} ({py_rel}:{py_line}) — fe_start_sharded2 "
+                "would start a different transport than the caller "
+                "asked for", cc_rel, c_line,
+                ((py_rel, py_line,
+                  f"python side defines {py_name} = {py_val}"),)))
+    return findings
+
+
 # -- op dispatch coverage ---------------------------------------------------
 
 def _server_op_references(server_py: pathlib.Path) -> dict[str, int]:
@@ -644,4 +735,7 @@ def check(root: pathlib.Path) -> list[Finding]:
     findings += check_abi(pkg / "utils" / "native.py",
                           [root / "native" / "frontend.cc",
                            root / "native" / "directory.cc"], root)
+    findings += check_transport_flags(pkg / "utils" / "native.py",
+                                      root / "native" / "frontend.cc",
+                                      root)
     return findings
