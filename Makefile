@@ -9,11 +9,12 @@ CXX ?= g++
 
 .PHONY: check lint verify-model test native asan-test tsan-test \
         chaos-test reshard-soak upgrade-soak parity-fuzz llm-soak \
-        controller-soak reserve-soak federation-soak uring-test
+        controller-soak reserve-soak federation-soak uring-test \
+        audit-soak
 
 check: lint verify-model test chaos-test upgrade-soak parity-fuzz \
        uring-test llm-soak controller-soak reserve-soak \
-       federation-soak asan-test tsan-test
+       federation-soak audit-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -107,6 +108,16 @@ federation-soak:
 controller-soak:
 	JAX_PLATFORMS=cpu DRL_CONTROLLER_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_controller.py -v -p no:cacheprovider
+
+# Conservation audit soak: the seeded audit.leak injection (a deny
+# flipped into a granted reply with NO store debit) must breach the
+# reply/witness identity within three watchdog ticks and yield exactly
+# one black-box incident bundle, with zero false alarms on the clean
+# arms (docs/OPERATIONS.md §18). `make audit-soak SEED=...` replays
+# any alert schedule bit-for-bit — the chaos-test determinism contract.
+audit-soak:
+	JAX_PLATFORMS=cpu DRL_AUDIT_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_audit.py -v -p no:cacheprovider
 
 # Native-vs-asyncio differential fuzz, verbosely (also part of tier-1):
 # reply-for-reply byte identity over randomized scalar AND bulk

@@ -731,6 +731,19 @@ def _serving_server_child(backing_kind: str = "device",
                 ts = srv._native.transport_stats()
                 if ts is not None:
                     tail["transport"] = ts
+                # ε-consumption counters (round 18): cumulative tier-0
+                # grant tokens + the per-slice split (fe_t0_eps) so the
+                # recapture lanes can price local-admission drift per
+                # shard slice beside the transport economics.
+                t0 = srv._native.tier0_stats()
+                if t0:
+                    tail["t0_grant_tokens"] = t0.get("grant_tokens",
+                                                     0.0)
+                    tail["t0_overadmit_total"] = t0.get(
+                        "overadmit_total", 0.0)
+                eps = srv._native.t0_eps_tokens()
+                if eps:
+                    tail["t0_eps_tokens"] = eps
             print(json.dumps(tail), flush=True)
         await backing.aclose()
 
@@ -1360,6 +1373,78 @@ def bench_metrics_overhead() -> tuple[float, float, float, int,
     return asyncio.run(main())
 
 
+def bench_audit_overhead() -> tuple[float, float, float, int]:
+    """``audit_overhead`` section: the conservation audit plane's
+    steady-state serving cost (runtime/audit.py). Two otherwise
+    identical closed-loop rigs — the ε-ledger + burn-rate watchdog
+    ticking at 10x the production cadence (tick_s=0.05 vs the 0.5
+    default, so the measured number upper-bounds the deployed cost) vs
+    the ``audit=False`` ablation — under the same ABBA window-block
+    discipline as ``serving_metrics_overhead``. The hot-path cost is
+    two float adds per scalar grant; everything else rides the
+    background tick. Contract: <3%.
+
+    Returns (on_rate, off_rate, overhead_pct, audit_ticks — the
+    enabled rig's tick count, proving the plane was live inside the
+    measured windows)."""
+    from distributedratelimiting.redis_tpu.runtime.audit import (
+        AuditConfig,
+    )
+    from distributedratelimiting.redis_tpu.runtime.remote import (
+        RemoteBucketStore,
+    )
+    from distributedratelimiting.redis_tpu.runtime.server import (
+        BucketStoreServer,
+    )
+    from distributedratelimiting.redis_tpu.runtime.store import (
+        InProcessBucketStore,
+    )
+
+    async def main() -> tuple[float, float, float, int]:
+        async def make(audit):
+            srv = BucketStoreServer(InProcessBucketStore(), audit=audit)
+            await srv.start()
+            store = RemoteBucketStore(address=(srv.host, srv.port),
+                                      coalesce_requests=False)
+            return srv, store
+
+        async def window(store, depth: int = 32, reqs: int = 150) -> float:
+            async def worker(w: int) -> None:
+                for j in range(reqs):
+                    await store.acquire(f"user{(w * 13 + j) % 512}", 1,
+                                        1e7, 1e7)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(worker(w) for w in range(depth)))
+            return depth * reqs / (time.perf_counter() - t0)
+
+        srv_on, store_on = await make(AuditConfig(tick_s=0.05))
+        srv_off, store_off = await make(False)
+        try:
+            await window(store_on, depth=16, reqs=40)
+            await window(store_off, depth=16, reqs=40)
+            blocks = []
+            for _ in range(4):
+                a1 = await window(store_on)
+                b1 = await window(store_off)
+                b2 = await window(store_off)
+                a2 = await window(store_on)
+                blocks.append(((a1 + a2) / 2, (b1 + b2) / 2))
+            on_rate = max(a for a, _ in blocks)
+            off_rate = max(b for _, b in blocks)
+            deltas = sorted((b - a) / b for a, b in blocks)
+            ticks = srv_on.auditor.ticks
+            return (on_rate, off_rate,
+                    deltas[len(deltas) // 2] * 100.0, ticks)
+        finally:
+            await store_on.aclose()
+            await store_off.aclose()
+            await srv_on.aclose()
+            await srv_off.aclose()
+
+    return asyncio.run(main())
+
+
 def bench_e2e_async_nproc_cpu(timeout_s: float = 600.0) -> tuple[float, int]:
     """Run the N-process scaling bench with a CPU-platform server child.
 
@@ -1545,6 +1630,13 @@ RESULT: dict = {
     # tracing toggled on the plane-enabled rig; same <3% contract.
     "serving_tracing_on_req_per_s": None,
     "serving_tracing_overhead_pct": None,
+    # Conservation audit plane arm (runtime/audit.py): ε-ledger +
+    # watchdog ticking at 10x production cadence vs audit=False; same
+    # ABBA estimator, same <3% contract. audit_ticks proves liveness.
+    "serving_audit_on_req_per_s": None,
+    "serving_audit_off_req_per_s": None,
+    "serving_audit_overhead_pct": None,
+    "serving_audit_ticks": None,
     "pallas_sweep_ok": None,
     "device_probe": None,
     "budget_s": BUDGET_S,
@@ -1992,6 +2084,19 @@ def main() -> int:
          RESULT["serving_metrics_scrape_bytes"],
          RESULT["serving_tracing_on_req_per_s"],
          RESULT["serving_tracing_overhead_pct"]) = value
+        _emit()
+
+    def sec_audit_overhead():
+        on_rate, off_rate, pct, ticks = bench_audit_overhead()
+        return round(on_rate), round(off_rate), round(pct, 2), ticks
+
+    status, value = _section("audit_overhead", sec_audit_overhead,
+                             timeout_s=240)
+    if status == "ok" and value is not None:
+        (RESULT["serving_audit_on_req_per_s"],
+         RESULT["serving_audit_off_req_per_s"],
+         RESULT["serving_audit_overhead_pct"],
+         RESULT["serving_audit_ticks"]) = value
         _emit()
 
     # Second chance for the chip: if the first probe found no window but

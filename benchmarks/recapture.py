@@ -328,6 +328,22 @@ def _debt_native_fe_uring_sweep(smoke: bool) -> dict:
                 if tr and res.get("frames_sent"):
                     res["syscalls_per_frame"] = round(
                         tr["io_syscalls"] / res["frames_sent"], 3)
+                # ε-consumption annotation (round 18): the server
+                # child's shutdown line carries the cumulative tier-0
+                # grant tokens and the per-slice split (fe_t0_eps) —
+                # fold them into the per-slice utilization proxy the
+                # conservation auditor renders as
+                # drl_epsilon_budget_used_ratio{source="shard"}, so
+                # each transport arm prices drift beside its syscall
+                # economics.
+                eps = res.get("t0_eps_tokens")
+                if eps and sum(eps) > 0:
+                    res["t0_eps_hot_slice_share"] = round(
+                        max(eps) / sum(eps), 4)
+                grant = res.get("t0_grant_tokens")
+                if grant:
+                    res["t0_overadmit_per_grant"] = round(
+                        res.get("t0_overadmit_total", 0.0) / grant, 9)
                 out[f"{name}_s{shards}"] = res
             finally:
                 try:
@@ -433,7 +449,9 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "the io_uring data plane (round 16) has no device number: the "
      "epoll/uring/sqpoll transport sweep — syscalls/frame and "
      "cycles/row against a real multi-ms flush — rests on the CPU "
-     "stand-in (evidence/native_uring_r16.jsonl)",
+     "stand-in (evidence/native_uring_r16.jsonl); round 18 annotates "
+     "each arm with the tier-0 ε-consumption counters (fe_t0_eps "
+     "per-slice grants, overadmit/grant ratio)",
      _debt_native_fe_uring_sweep),
 ]
 

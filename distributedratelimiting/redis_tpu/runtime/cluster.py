@@ -1984,6 +1984,36 @@ class ClusterBucketStore(BucketStore):
             out["controller"] = self.controller.stats()
         return out
 
+    async def audit(self, bundles: int = 0) -> dict:
+        """Fleet conservation-audit view (the :meth:`stats` posture):
+        ``nodes[j]`` is node ``j``'s OP_AUDIT snapshot positionally
+        (``{}`` where the node has no audit surface — down, or audit
+        disabled), ``total`` sums the numeric fields, and the fleet
+        roll-ups a watch console starts from ride at the top:
+        ``breaches``, ``alerts``, ``bundles_assembled``."""
+
+        async def one(j: int, n: BucketStore) -> dict:
+            if not hasattr(n, "audit"):
+                return {}
+            try:
+                return await n.audit(bundles=bundles)
+            except Exception as exc:
+                self._note_scrape_error(j, exc)
+                return {}
+
+        per_node = await asyncio.gather(*(one(j, n)
+                                          for j, n in
+                                          enumerate(self.nodes)))
+        total: dict = {}
+        for s in per_node:
+            for k, v in s.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    total[k] = total.get(k, 0) + v
+        return {"n_nodes": self.n_nodes, "nodes": list(per_node),
+                "total": total,
+                "breaches": total.get("breaches", 0),
+                "bundles_assembled": total.get("bundles_assembled", 0)}
+
     # -- checkpoint ----------------------------------------------------------
     def snapshot(self) -> dict:
         """Cluster checkpoint = each node's snapshot, keyed by position.

@@ -93,6 +93,10 @@ SENSOR_SERIES = (
     "drl_federation_region_degraded_now",  # server.py — slices serving
     # their degraded envelope (the partition symptom the federation
     # actuator reacts to between its cadence renews)
+    "drl_audit_breaches",         # server.py — conservation-identity
+    # violations observed by the audit plane (runtime/audit.py)
+    "drl_slo_alerts",             # server.py — burn-rate watchdog
+    # trip/clear transitions (utils/slo.py)
 )
 
 
@@ -240,6 +244,11 @@ class Sensors:
     #: envelope at any region agent — the partition symptom.
     fed_outstanding: float = 0.0
     fed_degraded: float = 0.0
+    #: Audit-plane sensors (cumulative fleet sums, zero when no node
+    #: carries an auditor — the pre-audit soak schedules stay bit-for-
+    #: bit): conservation breaches observed and watchdog alerts.
+    audit_breaches: float = 0.0
+    slo_alerts: float = 0.0
 
     @property
     def skew(self) -> float:
@@ -325,6 +334,8 @@ class Controller:
         self.last_outstanding = 0.0
         self.last_fed_degraded = 0.0
         self.last_fed_outstanding = 0.0
+        self.last_audit_breaches = 0.0
+        self.last_slo_alerts = 0.0
         self._stop = asyncio.Event()
         # Announce on the audit surfaces that can splice us in
         # (cluster.stats() "controller" section, cluster_metrics()).
@@ -357,6 +368,7 @@ class Controller:
         hot_totals: dict[str, float] = {}
         outstanding = 0.0
         fed_outstanding = fed_degraded = 0.0
+        audit_breaches = slo_alerts = 0.0
         for j, ns in enumerate(nodes):
             if not ns:
                 node_rates.append(0.0)
@@ -375,6 +387,13 @@ class Controller:
                                      .get("outstanding_leases", 0.0))
             fed_degraded += float((ns.get("federation_region") or {})
                                   .get("degraded_now", 0.0))
+            # Audit plane (cumulative counters summed as levels — the
+            # controller only watches for growth; a node without an
+            # auditor contributes zero, so pre-audit soaks replay
+            # unchanged).
+            au = ns.get("audit") or {}
+            audit_breaches += float(au.get("breaches", 0.0))
+            slo_alerts += float((au.get("slo") or {}).get("alerts", 0.0))
             tv = ns.get("token_velocity") or {}
             for tenant, total in (tv.get("admitted") or {}).items():
                 tenant_rates[tenant] = tenant_rates.get(tenant, 0.0) \
@@ -413,6 +432,8 @@ class Controller:
             outstanding_tokens=outstanding,
             fed_outstanding=fed_outstanding,
             fed_degraded=fed_degraded,
+            audit_breaches=audit_breaches,
+            slo_alerts=slo_alerts,
         )
 
     # -- flap guards ---------------------------------------------------------
@@ -460,6 +481,8 @@ class Controller:
         self.last_token_rate = sensors.token_rate
         self.last_fed_degraded = sensors.fed_degraded
         self.last_fed_outstanding = sensors.fed_outstanding
+        self.last_audit_breaches = sensors.audit_breaches
+        self.last_slo_alerts = sensors.slo_alerts
 
         def want(kind: str, target, reason: str, **extra) -> bool:
             """Returns True when the intent passed every gate (it WILL
@@ -750,6 +773,8 @@ class Controller:
             "outstanding_tokens": self.last_outstanding,
             "fed_degraded": self.last_fed_degraded,
             "fed_outstanding_leases": self.last_fed_outstanding,
+            "audit_breaches_seen": self.last_audit_breaches,
+            "slo_alerts_seen": self.last_slo_alerts,
             "budget_remaining": self.budget_remaining(),
             "dry_run": int(self.config.dry_run),
             "auto_drained": len(self.auto_drained),

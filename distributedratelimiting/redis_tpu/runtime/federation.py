@@ -881,6 +881,43 @@ class FederationLedger:
             for t, r, v in (state.get("region_totals") or ()))
         self.restores += 1
 
+    # -- conservation (runtime/audit.py, DESIGN.md §22) ----------------------
+    def conservation(self) -> dict:
+        """The home's cover identity: everything the ledger has charged
+        (or stands committed to charge — expired leases whose
+        conservative debit hasn't landed yet), net of heal refunds,
+        must COVER the regions' reported admissions:
+
+            charged + pending_conservative − refunded  ≥  Σ reported
+
+        ``residue`` is the left side minus the right. Positive residue
+        is the documented conservative slack (fully-spent presumption,
+        forfeited evictions) — tolerated by design. NEGATIVE residue
+        means regions admitted tokens the global budget never paid
+        for: global over-admission, the breach the audit plane pages
+        on. The ε terms here are the conservative charges: budget =
+        what every live lease could presume at expiry plus what
+        already presumed, used = the presumed (pending) part — the
+        ``source="federation"`` utilization gauge."""
+        pending = sum(rec["charge"] for rec in self._expired.values()
+                      if not rec["charged"])
+        accounted = (self.charged_tokens + pending
+                     - self.refunded_tokens)
+        admitted = sum(self._region_totals.values())
+        live_budget = sum(self._conservative_charge(lease)
+                          for pool in self._pools.values()
+                          for lease in pool.leases.values())
+        return {
+            "accounted": accounted,
+            "admitted": admitted,
+            "residue": accounted - admitted,
+            "charged": self.charged_tokens,
+            "pending_conservative": pending,
+            "refunded": self.refunded_tokens,
+            "epsilon_used": pending,
+            "epsilon_budget": pending + live_budget,
+        }
+
     # -- stats ---------------------------------------------------------------
     def numeric_stats(self) -> dict:
         """Flat numeric dict for ``register_numeric_dict`` — the

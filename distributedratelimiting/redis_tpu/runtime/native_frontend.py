@@ -1336,7 +1336,7 @@ class NativeFrontend:
         hits, denies, misses, installs, evictions, entries = (
             int(v) for v in counts)
         eligible = hits + denies + misses
-        return {
+        out = {
             "hits": hits,
             "local_denies": denies,
             "misses": misses,
@@ -1347,6 +1347,23 @@ class NativeFrontend:
             "carry_keys": len(self._t0_carry),
             **self.t0_metrics.snapshot(time.monotonic()),
         }
+        eps = self.t0_eps_tokens()
+        if eps is not None:
+            # C-side ε-consumption witness (round 18): cumulative
+            # locally-granted tokens, summed over slices — the audit
+            # plane's tier-0 "admitted" side.
+            out["grant_tokens"] = sum(eps)
+        return out
+
+    def t0_eps_tokens(self) -> "list[float] | None":
+        """Per-slice cumulative locally-granted tokens (fe_t0_eps) —
+        ``None`` when tier-0 is off or the binary predates the ABI."""
+        if self._tier0 is None or not getattr(self._lib, "has_t0_eps",
+                                              False):
+            return None
+        buf = (ctypes.c_double * max(1, self.n_shards))()
+        n = self._lib.fe_t0_eps(self._h, buf, len(buf))
+        return [float(buf[i]) for i in range(int(n))]
 
     def shard_stats(self) -> "list[dict] | None":
         """Per-shard breakdown of the serving / tier-0 / bulk gauges
@@ -1383,6 +1400,13 @@ class NativeFrontend:
                     "misses": int(t0[2]), "installs": int(t0[3]),
                     "evictions": int(t0[4]), "entries": int(t0[5]),
                 }
+                if getattr(self._lib, "has_t0_eps", False):
+                    # This shard's own slice ε-consumption (round 18):
+                    # one row per shard handle, so the per-slice
+                    # breakdown rides the same shards=[...] surface.
+                    eps = (c.c_double * 1)()
+                    if self._lib.fe_t0_eps(sh, eps, 1) == 1:
+                        row["tier0"]["grant_tokens"] = float(eps[0])
             if self._bulk_native:
                 bk = (c.c_longlong * 7)()
                 self._lib.fe_bulk_counts(sh, bk)

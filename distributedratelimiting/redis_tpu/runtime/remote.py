@@ -100,7 +100,11 @@ _IDEMPOTENT_OPS = frozenset((
     # changes carry an epoch the region adopts only forward (the
     # OP_CONFIG version discipline). A WAN retry mid-partition can
     # never double-grant a slice or double-refund a reclaim.
-    wire.OP_FED_LEASE, wire.OP_FED_RENEW, wire.OP_FED_RECLAIM))
+    wire.OP_FED_LEASE, wire.OP_FED_RENEW, wire.OP_FED_RECLAIM,
+    # Audit plane: a pure read of the conservation snapshot (bundles
+    # ship copies out of a bounded deque; nothing drains) — retrying a
+    # lost reply re-reads, never mutates.
+    wire.OP_AUDIT))
 
 #: The explicit NOT-idempotent half of the classification: admission
 #: ops double-debit on replay; HELLO re-auth mid-stream is a protocol
@@ -1675,6 +1679,19 @@ class RemoteBucketStore(BucketStore):
 
         (text,) = await self._request(wire.OP_TRACES,
                                       count=1 if drain else 0)
+        return json.loads(text)
+
+    async def audit(self, bundles: int = 0) -> dict:
+        """The server's conservation-audit snapshot (``OP_AUDIT``):
+        identity residues, ε-budget utilization per source, the
+        burn-rate watchdog's state and alert log. ``bundles=N`` ships
+        the newest N black-box incident bundles along (heavy —
+        correlated flight frames + traces ride inside), matching the
+        HTTP ``GET /audit?bundles=N`` surface."""
+        import json
+
+        payload = json.dumps({"bundles": bundles}) if bundles else ""
+        (text,) = await self._request(wire.OP_AUDIT, payload)
         return json.loads(text)
 
     # -- lifecycle ----------------------------------------------------------

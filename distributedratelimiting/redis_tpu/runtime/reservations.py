@@ -274,6 +274,28 @@ class ReservationLedger:
         self.aborted_imports = 0
         self.reserved_tokens_total = 0.0
         self.settled_tokens_total = 0.0
+        # Movement counters closing the conservation identity the audit
+        # plane checks every tick (runtime/audit.py, DESIGN.md §22):
+        #   reserved + restored_in + extra_debited ==
+        #   settled + refunded + exported_out + dropped + forfeited
+        #   + outstanding
+        # Each names one flow across the ledger boundary that the
+        # pre-existing counters above do not witness; without them the
+        # identity only closes cluster-wide (migration flows cancel),
+        # not per node — and per node is where the auditor runs.
+        #: Settle-time overage debits (actual > reserved): tokens that
+        #: entered the settled total without ever being held.
+        self.extra_debited_tokens = 0.0
+        #: Holds shipped out via migration export (placement pull).
+        self.exported_tokens_out = 0.0
+        #: Holds adopted via migration import / abort restore.
+        self.restored_tokens_in = 0.0
+        #: Holds dropped unsettled by a migration abort (drop_rids).
+        self.dropped_tokens = 0.0
+        #: Unspent holds a store without a negative-debit lane could
+        #: not credit back — under-admission, counted so the identity
+        #: still closes.
+        self.forfeited_tokens = 0.0
         #: Settle-error magnitudes, log-1.25 bucketed. The histogram
         #: class buckets from 1e-6, so values record at ``tokens × 1e-6``
         #: — quantiles read back ×1e6 (refund_p99_tokens et al).
@@ -510,7 +532,7 @@ class ReservationLedger:
         # settle moved the balances — follow them (module docstring).
         ta, tb = self._cfg(entry.ta, entry.tb)
         a, b = self._cfg(entry.a, entry.b)
-        if delta < 0.0 and callable(debit):
+        if delta < 0.0:
             # Over-estimate: credit the unspent hold back to BOTH
             # levels through the saturating negative-debit lane — the
             # EXACT delta, fractions included (skipping sub-token
@@ -519,12 +541,19 @@ class ReservationLedger:
             # capacity clamp bounds any overshoot — the refund can
             # only under-credit (the PR-9 contract).
             refund = -delta
-            await debit([entry.key], [-refund], a, b)
-            await debit([entry.tenant], [-refund], ta, tb)
-            refunded = refund
-            self.refunds += 1
-            self.refunded_tokens += refund
-            self.refund_hist.record(refund * 1e-6)
+            if callable(debit):
+                await debit([entry.key], [-refund], a, b)
+                await debit([entry.tenant], [-refund], ta, tb)
+                refunded = refund
+                self.refunds += 1
+                self.refunded_tokens += refund
+                self.refund_hist.record(refund * 1e-6)
+            else:
+                # No negative-debit lane: the hold cannot be credited
+                # back. Under-admission (the safe direction), but it
+                # must be WITNESSED or the conservation identity reads
+                # it as a leak.
+                self.forfeited_tokens += refund
         elif delta > 0.0:
             # Under-estimate: charge the overage now. Child shortfall
             # saturates silently (the key bucket can at worst sit at
@@ -542,6 +571,11 @@ class ReservationLedger:
                     self._debts.get(entry.tenant, 0.0) + owed
                 self.debts_created += 1
                 self.debt_tokens_created += owed
+            # The overage is an INFLOW across the ledger boundary
+            # (settled will exceed the hold by exactly this much) —
+            # witnessed whether the debit lane existed or the tenant
+            # shortfall became debt.
+            self.extra_debited_tokens += delta
             self.debt_hist.record(delta * 1e-6)
         self.settles += 1
         self.settled_tokens_total += actual
@@ -576,6 +610,7 @@ class ReservationLedger:
         for entry in [e for e in self._entries.values()
                       if keep(e.tenant)]:
             self._drop_entry(entry)
+            self.exported_tokens_out += entry.reserved
             res_rows.append([entry.tenant, entry.rid, entry.key,
                              entry.reserved, entry.a, entry.b,
                              entry.ta, entry.tb, entry.priority,
@@ -599,6 +634,7 @@ class ReservationLedger:
             entry = self._entries.get(str(rid))
             if entry is not None:
                 self._drop_entry(entry)
+                self.dropped_tokens += entry.reserved
                 n += 1
         self.aborted_imports += n
         return n
@@ -629,6 +665,7 @@ class ReservationLedger:
                 str(rid), str(tenant), str(key), float(reserved),
                 float(a), float(b), float(ta), float(tb), int(prio),
                 now + float(ttl), 0.0))
+            self.restored_tokens_in += float(reserved)
             n += 1
         seen = getattr(self, "_debt_seen", None)
         if seen is None:
@@ -647,6 +684,36 @@ class ReservationLedger:
             self._debts[tenant] = self._debts.get(tenant, 0.0) + amt
             n += 1
         return n
+
+    # -- conservation (runtime/audit.py, DESIGN.md §22) ----------------------
+    def conservation(self) -> dict:
+        """The ledger's flow identity, closed per node: every token
+        that crossed INTO the ledger boundary (a reserve hold, an
+        adopted migration row, a settle-time overage debit) must be
+        findable on the way OUT (settled spend, refund, export, abort
+        drop, forfeit) or still held (outstanding). ``residue`` is
+        inflow − outflow — zero up to f64 noise, ANY sign of drift is
+        a ledger bug (there is no ε term here; estimate error shows up
+        as refunds/debts, both witnessed flows)."""
+        inflow = (self.reserved_tokens_total + self.restored_tokens_in
+                  + self.extra_debited_tokens)
+        outflow = (self.settled_tokens_total + self.refunded_tokens
+                   + self.exported_tokens_out + self.dropped_tokens
+                   + self.forfeited_tokens + self.outstanding_tokens())
+        return {
+            "inflow": inflow,
+            "outflow": outflow,
+            "residue": inflow - outflow,
+            "reserved": self.reserved_tokens_total,
+            "restored_in": self.restored_tokens_in,
+            "extra_debited": self.extra_debited_tokens,
+            "settled": self.settled_tokens_total,
+            "refunded": self.refunded_tokens,
+            "exported_out": self.exported_tokens_out,
+            "dropped": self.dropped_tokens,
+            "forfeited": self.forfeited_tokens,
+            "outstanding": self.outstanding_tokens(),
+        }
 
     # -- stats ---------------------------------------------------------------
     def numeric_stats(self) -> dict:
@@ -671,6 +738,11 @@ class ReservationLedger:
             "aborted_imports": self.aborted_imports,
             "reserved_tokens_total": self.reserved_tokens_total,
             "settled_tokens_total": self.settled_tokens_total,
+            "extra_debited_tokens": self.extra_debited_tokens,
+            "exported_tokens_out": self.exported_tokens_out,
+            "restored_tokens_in": self.restored_tokens_in,
+            "dropped_tokens": self.dropped_tokens,
+            "forfeited_tokens": self.forfeited_tokens,
             "outstanding": float(len(self._entries)),
             "outstanding_tokens": self.outstanding_tokens(),
             "debt_tokens": sum(self._debts.values()),

@@ -33,6 +33,10 @@ from distributedratelimiting.redis_tpu.runtime import (
     placement,
     wire,
 )
+from distributedratelimiting.redis_tpu.runtime.audit import (
+    AuditConfig,
+    ConservationAuditor,
+)
 from distributedratelimiting.redis_tpu.runtime.store import BucketStore
 from distributedratelimiting.redis_tpu.utils import faults, log, tracing
 from distributedratelimiting.redis_tpu.utils.flight_recorder import (
@@ -108,6 +112,7 @@ class BucketStoreServer:
                  flight_dir: str | None = None,
                  flight_capacity: int = 512,
                  tracing_config: "bool | dict | None" = None,
+                 audit: "bool | AuditConfig | None" = None,
                  snapshot_incremental: bool = False) -> None:
         self.store = store
         self.host = host
@@ -275,6 +280,23 @@ class BucketStoreServer:
         #: surface rides OP_STATS, /flight (shared flight recorder),
         #: and the drl_controller_* families below.
         self.controller = None
+        # Conservation audit plane (runtime/audit.py): the witness pair
+        # below is the reply/witness identity's raw material — tokens
+        # this server TOLD clients it granted vs tokens the store
+        # actually debited, incremented adjacently at the scalar
+        # decision site. Plain counters (the requests_served posture),
+        # MONOTONIC, never reset.
+        self.audit_replied_tokens = 0.0
+        self.audit_witnessed_tokens = 0.0
+        # audit=None follows the observability master switch; an
+        # AuditConfig passes knobs through; False is the ablation the
+        # audit_overhead bench section compares against.
+        if audit is None:
+            audit = observability
+        self.auditor = (ConservationAuditor(
+            self, audit if isinstance(audit, AuditConfig) else None)
+            if audit else None)
+        self._audit_task: "asyncio.Task | None" = None
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -289,6 +311,10 @@ class BucketStoreServer:
             # flush) and fires the degraded-entry auto-dump on a flush
             # error — see DeviceBucketStore._flush_observer.
             metrics.flight_recorder = self.flight_recorder
+        if self.auditor is not None and self._audit_task is None:
+            # The ε-ledger's pacer — spawned before either listener
+            # path binds so both serve an already-ticking audit plane.
+            self._audit_task = asyncio.create_task(self.auditor.run())
         if self.native_frontend:
             from distributedratelimiting.redis_tpu.runtime.native_frontend import (
                 NativeFrontend,
@@ -408,6 +434,17 @@ class BucketStoreServer:
                 body = json.dumps({"dumped": dump_path,
                                    "suppressed": dump_path is None}
                                   ).encode()
+                status, ctype = "200 OK", "application/json"
+            elif route == "/audit":
+                from urllib.parse import parse_qs
+
+                # ?bundles=N ships the newest N incident bundles along
+                # with the conservation snapshot (runtime/audit.py).
+                try:
+                    n = int(parse_qs(query).get("bundles", ["0"])[-1])
+                except ValueError:
+                    n = 0
+                body = self._audit_json({"bundles": n}).encode("utf-8")
                 status, ctype = "200 OK", "application/json"
             else:
                 body, status, ctype = b"not found\n", "404 Not Found", \
@@ -571,7 +608,10 @@ class BucketStoreServer:
                           "debts_created", "debt_tokens_created",
                           "debt_tokens_collected", "rehomed",
                           "reserved_tokens_total",
-                          "settled_tokens_total"})
+                          "settled_tokens_total",
+                          "extra_debited_tokens",
+                          "exported_tokens_out", "restored_tokens_in",
+                          "dropped_tokens", "forfeited_tokens"})
             # Settle-error magnitude histograms. Values record at
             # tokens × 1e-6 (the class buckets from 1e-6 up — see
             # reservations.py), so bucket bounds read as micro-tokens.
@@ -665,6 +705,27 @@ class BucketStoreServer:
             "Controller decisions by action and outcome",
             lambda: (self.controller.action_series()
                      if self.controller is not None else []))
+        # Conservation audit plane (runtime/audit.py): the drl_audit_*
+        # prefix carries drl_audit_overadmitted_tokens — the SLI
+        # numerator SLO_SERIES (utils/slo.py) pins to this site.
+        reg.register_numeric_dict(
+            "audit", "conservation audit plane (epsilon ledger)",
+            lambda: (self.auditor.numeric_stats()
+                     if self.auditor is not None else None),
+            counters={"ticks", "tick_failures", "breaches",
+                      "overadmitted_tokens", "bundles_assembled"})
+        reg.register_numeric_dict(
+            "slo", "multi-window burn-rate watchdog (utils/slo.py)",
+            lambda: (self.auditor.watchdog.numeric_stats()
+                     if self.auditor is not None else None),
+            counters={"ticks", "alerts", "trips", "clears"})
+        reg.labeled_gauges(
+            "epsilon_budget_used_ratio",
+            "Fraction of each documented epsilon allowance consumed "
+            "(source=tier0|shard|envelope|federation; 1.0 = realized "
+            "drift ate the whole budget — see DESIGN.md §22)",
+            lambda: (self.auditor.epsilon_series()
+                     if self.auditor is not None else []))
         reg.counter("stats_resets",
                     "Destructive serving-window resets, any trigger "
                     "(the shared-window tripwire, utils/metrics.py)",
@@ -1039,8 +1100,25 @@ class BucketStoreServer:
                     hh.offer_buffered(key)
             if op == wire.OP_ACQUIRE:
                 res = await self.store.acquire(key, count, a, b)
+                granted = res.granted
+                if granted:
+                    # Witnessed: the store ACTUALLY debited this grant.
+                    self.audit_witnessed_tokens += count
+                if faults._INJECTOR is not None and not granted:
+                    # audit.leak (utils/faults.py): flip a deny into a
+                    # grant WITHOUT the store debit — a deliberate
+                    # token leak between the two witness counters, so
+                    # the seeded soak can prove the conservation
+                    # auditor catches exactly this class of bug.
+                    if faults._INJECTOR.decide("audit.leak") is not None:
+                        granted = True
+                if granted:
+                    # Replied: what the CLIENT was told. Any positive
+                    # replied−witnessed delta is a leak no ε excuses
+                    # (runtime/audit.py reply/witness identity).
+                    self.audit_replied_tokens += count
                 resp = wire.encode_response(
-                    seq, wire.RESP_DECISION, res.granted, res.remaining)
+                    seq, wire.RESP_DECISION, granted, res.remaining)
             elif op == wire.OP_PEEK:
                 # peek_blocking can wait on the store lock / a device op —
                 # run it off-loop so one PEEK never stalls other
@@ -1213,6 +1291,12 @@ class BucketStoreServer:
                     seq, wire.RESP_TEXT, self.tracer.export_chrome_json(
                         max_bytes=wire.MAX_FRAME - 256,
                         drain=bool(count & 1)))
+            elif op == wire.OP_AUDIT:
+                import json
+
+                resp = wire.encode_response(
+                    seq, wire.RESP_TEXT,
+                    self._audit_json(json.loads(key) if key else {}))
             else:  # pragma: no cover — decode_request raises first
                 resp = wire.encode_response(
                     seq, wire.RESP_ERROR, f"unknown op {op}")
@@ -1789,6 +1873,21 @@ class BucketStoreServer:
         await self.aclose()
         return out
 
+    def _audit_json(self, req: "dict | None" = None) -> str:
+        """OP_AUDIT / ``GET /audit`` body: the conservation snapshot,
+        plus the newest ``req["bundles"]`` black-box incident bundles
+        when asked (bundles carry whole flight/trace windows — heavy,
+        so they ship only on request)."""
+        import json
+
+        out: dict = {"enabled": self.auditor is not None}
+        if self.auditor is not None:
+            out.update(self.auditor.snapshot())
+            n = int((req or {}).get("bundles", 0) or 0)
+            if n > 0:
+                out["bundles"] = list(self.auditor.bundles)[-n:]
+        return json.dumps(out, default=repr)
+
     def _stats_json(self) -> str:
         import json
 
@@ -1893,9 +1992,18 @@ class BucketStoreServer:
             payload["tracing"] = self.tracer.snapshot()
         if self.controller is not None:
             payload["controller"] = self.controller.stats()
+        if self.auditor is not None:
+            payload["audit"] = self.auditor.snapshot()
         return json.dumps(payload)
 
     async def aclose(self) -> None:
+        if self._audit_task is not None:
+            self._audit_task.cancel()
+            try:
+                await self._audit_task
+            except asyncio.CancelledError:
+                pass
+            self._audit_task = None
         if self._metrics_server is not None:
             self._metrics_server.close()
             await self._metrics_server.wait_closed()

@@ -182,6 +182,7 @@ __all__ = [
     "OP_PLACEMENT", "OP_PLACEMENT_ANNOUNCE", "OP_MIGRATE_PULL",
     "OP_MIGRATE_PUSH", "OP_CONFIG", "OP_ACQUIRE_H", "OP_RESERVE",
     "OP_SETTLE", "OP_FED_LEASE", "OP_FED_RENEW", "OP_FED_RECLAIM",
+    "OP_AUDIT",
     "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
@@ -300,13 +301,22 @@ OP_FED_RECLAIM = 24  # return a slice to the federation pool:
 # zero side effects: no second share free, no second refund), the
 # at-most-once property tests/test_federation.py audits.
 
+OP_AUDIT = 25  # conservation audit plane (runtime/audit.py; OP_METRICS
+# posture — a new op on the existing frame layout, routable unknown-op
+# error from old servers, never a misparse): [u32 mlen][json {}] or
+# {"bundles": n} → RESP_TEXT JSON — the node's conservation-ledger
+# snapshot (per-source ε-budget utilization, per-subsystem residues,
+# watchdog state) plus, when asked, the newest n black-box incident
+# bundles. Read-only (no store mutation, no window reset), so retries
+# are trivially safe.
+
 #: Control ops whose request payload is one u32-length-prefixed UTF-8
 #: JSON text (rides in the ``key`` slot of encode/decode_request —
 #: ensure_ascii JSON, so the strict codec never meets a surrogate).
 TEXT_OPS = frozenset((OP_PLACEMENT_ANNOUNCE, OP_MIGRATE_PULL,
                       OP_MIGRATE_PUSH, OP_CONFIG, OP_RESERVE,
                       OP_SETTLE, OP_FED_LEASE, OP_FED_RENEW,
-                      OP_FED_RECLAIM))
+                      OP_FED_RECLAIM, OP_AUDIT))
 
 #: Op-byte bit 7: a 25-byte trace tail (``_TRACE_TAIL``) follows the
 #: payload. Only sampled requests carry it; an old server answers the
@@ -368,6 +378,7 @@ _OP_NAMES = {
     OP_FED_LEASE: "fed_lease",
     OP_FED_RENEW: "fed_renew",
     OP_FED_RECLAIM: "fed_reclaim",
+    OP_AUDIT: "audit",
 }
 
 

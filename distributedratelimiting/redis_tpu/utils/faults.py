@@ -38,6 +38,16 @@ runtime consults when — and only when — an injector is installed:
   the home (:meth:`BucketStoreServer._handle_frame_inner`): a fault
   fails one control frame; the ops are post-send-retry-safe, so the
   region's retry dedups.
+- ``audit.leak`` — the scalar OP_ACQUIRE decision site
+  (:meth:`BucketStoreServer._handle_frame_inner`, asyncio lane): an
+  injected fault flips one DENY into a granted reply WITHOUT the store
+  debit — a deliberate token leak between the server's reply/witness
+  counters. Unlike every other seam this one injects a *correctness*
+  bug, not a failure: it exists so the conservation audit soak
+  (runtime/audit.py, tests/test_audit.py) can prove the ε-ledger
+  detects exactly this class of drift within its detection budget.
+  Consulted through the sync :meth:`FaultInjector.decide` (the hot
+  path cannot await); any rule kind fires it.
 - clock skew (``CLOCK_SKEW`` rules on any seam, read via
   :meth:`FaultInjector.clock_skew` / :class:`SkewedClock`) — the
   federation tests wrap the WALL clocks on both ends with it and pin

@@ -45,6 +45,8 @@ REGISTERED_KINDS = frozenset({
     "federation",    # WAN lease events (runtime/federation.py):
                      # grants/resizes/expiries/heals at the home,
                      # degrade/heal transitions at the region
+    "slo",           # burn-rate watchdog alerts (utils/slo.py)
+    "audit",         # conservation-ledger breaches (runtime/audit.py)
     "header",        # the dump file's header line
 })
 
@@ -83,12 +85,18 @@ class FlightRecorder:
         self._frames.append(frame)
         self.frames_recorded += 1
 
-    def frames(self, kind: str | None = None) -> list[dict]:
+    def frames(self, kind: str | tuple[str, ...] | None = None
+               ) -> list[dict]:
         """The ring's frames, oldest first; ``kind`` filters to one
         frame kind (e.g. ``"controller"`` — the audit path the control
-        plane's action-log assertions read)."""
+        plane's action-log assertions read) or, given a tuple, any of
+        several kinds (the incident-bundle assembly path pulls
+        ``("slo", "audit", "controller")`` in one correlated slice)."""
         if kind is None:
             return list(self._frames)
+        if isinstance(kind, tuple):
+            wanted = frozenset(kind)
+            return [f for f in self._frames if f.get("kind") in wanted]
         return [f for f in self._frames if f.get("kind") == kind]
 
     def dump(self, reason: str, extra: dict | None = None, *,

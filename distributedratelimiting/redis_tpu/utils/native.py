@@ -399,6 +399,15 @@ def _bind_frontend(lib: ctypes.CDLL) -> ctypes.CDLL:
     except AttributeError:  # stale binary without the retire ABI
         lib.has_t0_retire = False
     try:
+        # Round 18 (conservation audit plane): per-slice cumulative
+        # locally-granted tokens — the C-side ε-consumption witness.
+        lib.fe_t0_eps.argtypes = [c.c_void_p, c.POINTER(c.c_double),
+                                  c.c_int]
+        lib.fe_t0_eps.restype = c.c_int
+        lib.has_t0_eps = True
+    except AttributeError:  # stale binary without the eps ABI
+        lib.has_t0_eps = False
+    try:
         # Round 8 (native bulk lane): OP_ACQUIRE_MANY parses, tier-0
         # decides, and RESP_BULK encodes in C; fe_wait returns 3 for a
         # residue job. Armed explicitly via fe_bulk_configure so a new

@@ -206,6 +206,12 @@ constexpr uint8_t OP_SETTLE = 21;
 constexpr uint8_t OP_FED_LEASE = 22;
 constexpr uint8_t OP_FED_RENEW = 23;
 constexpr uint8_t OP_FED_RECLAIM = 24;
+// Conservation audit plane (wire.py, runtime/audit.py): JSON audit
+// snapshot / incident-bundle surface (TEXT_OPS) — read-only diagnostic
+// cadence, never hot. Passthrough like the other control ops: named
+// (and case-listed) so drl-check's wire-conformance diff pins its
+// value against wire.py and a future fast-path cannot typo it.
+constexpr uint8_t OP_AUDIT = 25;
 
 // Bulk admission lane (round 8): OP_ACQUIRE_MANY parses HERE, tier-0
 // decides hot bucket rows per-row, and the RESP_BULK reply encodes in C
@@ -541,6 +547,13 @@ struct T0Part {
   int64_t misses = 0;         // eligible requests that fell through
   int64_t installs = 0;
   int64_t evictions = 0;
+  // Round 18 (conservation audit plane): cumulative TOKENS granted
+  // locally by this slice — the ε-consumption the sync pump will later
+  // reconcile, witnessed at the grant site itself so the Python-side
+  // conservation ledger can hold local admissions to the documented
+  // epsilon budget without trusting any Python counter. Monotonic;
+  // read via fe_t0_eps.
+  double grant_tokens = 0.0;
 };
 
 // Linear-probe window and the key-size cap that bounds table memory
@@ -1145,6 +1158,7 @@ int t0_decide_locked(T0Part* part, std::string_view key, uint64_t h,
     e->admitted += cnt;
     e->pending += cnt;
     part->hits++;
+    part->grant_tokens += cnt;
     *rem_out = std::max(e->last_remaining - e->admitted, 0.0);
     return 1;
   }
@@ -1704,6 +1718,7 @@ bool handle_bulk_frame(Shard* sh, Conn* c, const uint8_t* body,
           e->admitted += total;
           e->pending += total;
           part->hits += sh->agg_nrows[g];
+          part->grant_tokens += total;
           permits_local += total;
           continue;
         }
@@ -2059,6 +2074,7 @@ bool handle_frame(Shard* sh, Conn* c, const uint8_t* body, size_t len) {
       case OP_FED_LEASE:
       case OP_FED_RENEW:
       case OP_FED_RECLAIM:
+      case OP_AUDIT:
       default: {
         // Placement/migration/config/reservation/federation control
         // ops, HELLO,
@@ -3799,6 +3815,24 @@ void fe_t0_counts(void* h, long long* out) {
     out[4] += part->evictions;
     out[5] += live;
   }
+}
+
+// Per-slice ε-consumption counters (round 18, the conservation audit
+// plane): out[i] = cumulative tokens granted locally by slice i.
+// Frontend handle = every shard's slice in shard order (the whole-node
+// per-slice breakdown); shard handle = that shard's own slice only.
+// Returns the number of slices written (≤ max_parts). A separate
+// export rather than a widened fe_t0_counts: stale Python halves keep
+// passing 6-element arrays to fe_t0_counts, and the binding layer
+// feature-detects this symbol exactly like fe_t0_retire.
+int fe_t0_eps(void* h, double* out, int max_parts) {
+  int n = 0;
+  for (T0Part* part : t0parts_of(h)) {
+    if (n >= max_parts) break;
+    std::lock_guard<T0SpinMutex> lk(part->mu);
+    out[n++] = part->grant_tokens;
+  }
+  return n;
 }
 
 // ---------------------------------------------------------------------

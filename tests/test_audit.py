@@ -1,0 +1,582 @@
+"""Conservation audit plane (ISSUE 18): ε-ledger, burn-rate watchdog,
+and black-box incident bundles.
+
+The acceptance differential is THE seeded leak soak: an injected
+``audit.leak`` fault (utils/faults.py — a deny flipped into a granted
+reply WITHOUT the store debit, the exact bug class "two is worse than
+one" warns about) must breach the reply/witness conservation identity
+within three watchdog ticks and yield EXACTLY ONE black-box incident
+bundle carrying correlated flight frames, exemplar-matched kept traces,
+and the raw witnessing counter deltas. The same seed reproduces the
+identical alert schedule bit for bit (``make audit-soak SEED=…``,
+DRL_AUDIT_SEED). The negative arms pin the zero-false-alarm posture:
+clean traffic with legitimate denies, a rolling restart (counter
+reset), and a live federation lease flow must raise nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from distributedratelimiting.redis_tpu.runtime.audit import (
+    AuditConfig,
+    EPSILON_SOURCES,
+)
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+)
+from distributedratelimiting.redis_tpu.runtime.server import (
+    BucketStoreServer,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults, tracing
+from distributedratelimiting.redis_tpu.utils.faults import (
+    FaultInjector,
+    FaultRule,
+)
+from distributedratelimiting.redis_tpu.utils.flight_recorder import (
+    REGISTERED_KINDS,
+    FlightRecorder,
+)
+from distributedratelimiting.redis_tpu.utils.slo import (
+    SLO_SERIES,
+    BurnRateWatchdog,
+    SLOConfig,
+)
+
+SEED = int(os.environ.get("DRL_AUDIT_SEED", "20260803"))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _drain_audit_task(srv: BucketStoreServer) -> None:
+    """Cancel the wall-clock audit pacer so the test owns every tick —
+    the counted-not-clocked determinism contract."""
+    if srv._audit_task is not None:
+        srv._audit_task.cancel()
+        try:
+            await srv._audit_task
+        except asyncio.CancelledError:
+            pass
+        srv._audit_task = None
+
+
+# -- burn-rate watchdog unit surface -----------------------------------------
+
+#: Small windows so trips are reachable in a handful of ticks;
+#: overadmit armed alone so the math below stays exact.
+_WD_CFG = SLOConfig(overadmit_ratio=1e-3, latency_slo_s=None,
+                    shed_ratio=None, goodput_floor_rps=None,
+                    fast_ticks=2, slow_ticks=6, burn_fast=10.0,
+                    burn_slow=5.0, trip_streak=1, clear_streak=2)
+
+
+def _feed(wd: BurnRateWatchdog, *, ticks: int, admitted_per_tick: float,
+          over_jump: float = 0.0, start: dict | None = None) -> dict:
+    """Feed ``ticks`` cumulative samples; ``over_jump`` lands whole on
+    the first of them. Returns the final cumulative state."""
+    cum = dict(start or {"requests": 0.0, "shed": 0.0,
+                         "admitted_tokens": 0.0,
+                         "overadmitted_tokens": 0.0,
+                         "latency_total": 0.0, "latency_bad": 0.0})
+    for i in range(ticks):
+        cum["requests"] += admitted_per_tick
+        cum["admitted_tokens"] += admitted_per_tick
+        if i == 0:
+            cum["overadmitted_tokens"] += over_jump
+        wd.tick(cum)
+    return cum
+
+
+class TestBurnRateWatchdog:
+    def test_trip_requires_both_windows(self):
+        """A spike hot enough for the fast window but diluted below the
+        slow threshold must NOT page — the multi-window point."""
+        # fast: X/2000/1e-3 >= 10 needs X >= 20;
+        # slow: X/6000/1e-3 >= 5 needs X >= 30.
+        wd = BurnRateWatchdog(_WD_CFG)
+        cum = _feed(wd, ticks=8, admitted_per_tick=1000.0)
+        _feed(wd, ticks=1, admitted_per_tick=1000.0, over_jump=25.0,
+              start=cum)
+        assert wd.trips == 0 and wd.tripped() == []
+
+    def test_trip_then_hysteresis_clear(self):
+        wd = BurnRateWatchdog(_WD_CFG)
+        cum = _feed(wd, ticks=8, admitted_per_tick=1000.0)
+        cum = _feed(wd, ticks=1, admitted_per_tick=1000.0,
+                    over_jump=40.0, start=cum)
+        assert wd.tripped() == ["overadmit"]
+        (trip,) = wd.alert_log
+        assert trip["state"] == "trip" and trip["slo"] == "overadmit"
+        assert trip["burn_fast"] >= _WD_CFG.burn_fast
+        assert trip["burn_slow"] >= _WD_CFG.burn_slow
+        # The spike ages out of the fast window; clear_streak clean
+        # ticks later the dimension untrips — exactly one clear alert.
+        _feed(wd, ticks=6, admitted_per_tick=1000.0, start=cum)
+        assert wd.tripped() == []
+        assert [a["state"] for a in wd.alert_log] == ["trip", "clear"]
+
+    def test_goodput_arming_latch(self):
+        """A warming-up server (rate below floor from birth) never
+        alarms; once the floor has been reached, collapse trips."""
+        cfg = SLOConfig(overadmit_ratio=None, latency_slo_s=None,
+                        shed_ratio=None, goodput_floor_rps=100.0,
+                        fast_ticks=2, slow_ticks=4, burn_fast=2.0,
+                        burn_slow=2.0, trip_streak=1, clear_streak=2,
+                        tick_s=1.0)
+        wd = BurnRateWatchdog(cfg)
+        cum = {"requests": 0.0, "shed": 0.0, "admitted_tokens": 0.0,
+               "overadmitted_tokens": 0.0, "latency_total": 0.0,
+               "latency_bad": 0.0}
+        for _ in range(5):          # zero traffic: disarmed, silent
+            wd.tick(cum)
+        assert wd.alerts == 0
+        for _ in range(6):          # 200 rps >= floor: arms, silent
+            cum = dict(cum, requests=cum["requests"] + 200.0)
+            wd.tick(cum)
+        assert wd.alerts == 0
+        for _ in range(5):          # collapse to zero: trips
+            wd.tick(cum)
+        assert wd.tripped() == ["goodput"]
+
+    def test_same_stream_same_alert_log(self):
+        """The alert log is a pure function of the sample stream."""
+        def one() -> list[dict]:
+            wd = BurnRateWatchdog(_WD_CFG)
+            cum = _feed(wd, ticks=8, admitted_per_tick=1000.0)
+            cum = _feed(wd, ticks=2, admitted_per_tick=1000.0,
+                        over_jump=60.0, start=cum)
+            _feed(wd, ticks=8, admitted_per_tick=1000.0, start=cum)
+            return list(wd.alert_log)
+
+        assert json.dumps(one()) == json.dumps(one())
+
+    def test_alerts_land_as_slo_flight_frames(self):
+        assert "slo" in REGISTERED_KINDS and "audit" in REGISTERED_KINDS
+        fr = FlightRecorder(capacity=64)
+        wd = BurnRateWatchdog(_WD_CFG, flight_recorder=fr)
+        cum = _feed(wd, ticks=8, admitted_per_tick=1000.0)
+        _feed(wd, ticks=1, admitted_per_tick=1000.0, over_jump=40.0,
+              start=cum)
+        (frame,) = fr.frames(kind="slo")
+        assert frame["state"] == "trip"
+        # The tuple filter (the bundle assembler's query shape).
+        assert fr.frames(kind=("slo", "audit")) == [frame]
+        assert fr.frames(kind=("audit",)) == []
+
+    def test_slo_series_is_declared(self):
+        # The drl-check metric-name rule resolves each entry against a
+        # live registration site; here just pin the subscription shape.
+        assert "drl_audit_overadmitted_tokens" in SLO_SERIES
+        assert "drl_epsilon_budget_used_ratio" in SLO_SERIES
+
+
+# -- conservation identities over the wire surfaces --------------------------
+
+class TestConservationIdentities:
+    def test_reservation_flow_identity_closes(self):
+        run(self._reservation_body())
+
+    async def _reservation_body(self):
+        srv = BucketStoreServer(InProcessBucketStore(), port=0)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            # Over-settle, under-settle, and an outstanding hold.
+            await st.reserve("r1", "t", "k", 10.0, 1e6, 0.0, 1e5, 0.0)
+            await st.settle("r1", "t", 25.0)     # extra debit
+            await st.reserve("r2", "t", "k", 40.0, 1e6, 0.0, 1e5, 0.0)
+            await st.settle("r2", "t", 5.0)      # refund
+            await st.reserve("r3", "t", "k", 8.0, 1e6, 0.0, 1e5, 0.0)
+            rc = srv.reservations.conservation()
+            assert rc["outstanding"] == pytest.approx(8.0)
+            assert rc["residue"] == pytest.approx(0.0, abs=1e-6)
+            out = srv.auditor.tick()
+            assert "reservation" not in out["breaches"]
+            assert out["residues"]["reservation"] == pytest.approx(
+                0.0, abs=1e-6)
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+    def test_federation_cover_identity_nonnegative(self):
+        run(self._federation_body())
+
+    async def _federation_body(self):
+        backing = InProcessBucketStore()
+        backing.federation_ledger(default_ttl_s=30.0)
+        srv = BucketStoreServer(backing, port=0)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            r = await st.fed_lease({"region": "r0", "lease_id": "L1",
+                                    "tenant": "t", "demand": 2.0,
+                                    "global_cap": 600.0,
+                                    "global_rate": 0.0})
+            assert r["granted"]
+            n = await st.fed_renew({"region": "r0", "lease_id": "L1",
+                                    "tenant": "t", "total": 25.0,
+                                    "demand": 2.0})
+            assert n["outcome"] == "ok"
+            fc = srv.federation.conservation()
+            # Charges (+ conservative pending) COVER regional reports:
+            # never negative in correct operation.
+            assert fc["residue"] >= -1e-6
+            assert fc["admitted"] == pytest.approx(25.0)
+            out = srv.auditor.tick()
+            assert "federation" not in out["breaches"]
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+
+# -- the audit plane's serving surfaces --------------------------------------
+
+async def _http_get(host: str, port: int, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionResetError, BrokenPipeError):
+        pass
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body
+
+
+class TestAuditSurfaces:
+    def test_clean_traffic_surfaces_and_zero_breaches(self):
+        run(self._clean_body())
+
+    async def _clean_body(self):
+        srv = BucketStoreServer(InProcessBucketStore(), port=0,
+                                metrics_port=0)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            for i in range(40):
+                await st.acquire(f"k{i % 7}", 1, 1e6, 1e6)
+            for _ in range(3):
+                out = srv.auditor.tick()
+                assert out["breaches"] == [] and out["alerts"] == []
+            stats = srv.auditor.numeric_stats()
+            assert stats["ticks"] == 3 and stats["breaches"] == 0
+            assert stats["bundles_assembled"] == 0
+            for s in EPSILON_SOURCES:
+                assert 0.0 <= srv.auditor.epsilon_used[s] <= 1.0
+            # OP_AUDIT round-trip.
+            snap = await st.audit()
+            assert snap["enabled"] and snap["bundle_ids"] == []
+            assert snap["slo"]["tripped"] == []
+            # OP_STATS carries the audit section.
+            payload = await st.stats()
+            assert payload["audit"]["breaches"] == 0
+            # The OpenMetrics families render.
+            text = await st.metrics()
+            assert "drl_audit_breaches_total 0" in text
+            assert "drl_slo_trips_total 0" in text
+            assert ('drl_epsilon_budget_used_ratio{source="tier0"}'
+                    in text)
+            # GET /audit (+ the bundles query param).
+            status, body = await _http_get(srv.host, srv.metrics_port,
+                                           "/audit")
+            assert status == 200 and json.loads(body)["enabled"]
+            status, body = await _http_get(srv.host, srv.metrics_port,
+                                           "/audit?bundles=2")
+            assert status == 200 and json.loads(body)["bundles"] == []
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+    def test_audit_false_is_a_true_ablation(self):
+        run(self._ablation_body())
+
+    async def _ablation_body(self):
+        srv = BucketStoreServer(InProcessBucketStore(), port=0,
+                                audit=False)
+        await srv.start()
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            assert srv.auditor is None and srv._audit_task is None
+            snap = await st.audit()
+            assert snap == {"enabled": False}
+            payload = await st.stats()
+            assert "audit" not in payload
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+
+# -- THE seeded leak soak ----------------------------------------------------
+
+#: Tight windows so the acceptance "within three ticks" bound is a real
+#: detection-latency bound, not slack in a 60-tick window.
+_SOAK_AUDIT_CFG = AuditConfig(slo=SLOConfig(
+    fast_ticks=2, slow_ticks=6, trip_streak=1, clear_streak=2))
+
+
+async def _leak_soak(seed: int) -> dict:
+    """One deterministic leak episode. Returns the full observable
+    schedule — alert log, bundle identity, detection tick — for the
+    bit-for-bit same-seed comparison."""
+    tracing.configure(enabled=True, sample_rate=1.0, keep_rate=1.0,
+                      latency_threshold_s=10.0)
+    tracing.get_tracer().reset()
+    srv = BucketStoreServer(InProcessBucketStore(), port=0,
+                            audit=_SOAK_AUDIT_CFG)
+    await srv.start()
+    await _drain_audit_task(srv)
+    st = RemoteBucketStore(address=(srv.host, srv.port),
+                           coalesce_requests=False)
+    try:
+        # Clean warm-up: traffic + ticks, zero alarms.
+        for i in range(30):
+            await st.acquire(f"warm{i % 5}", 1, 1e6, 1e6)
+        for _ in range(3):
+            out = srv.auditor.tick()
+            assert out["breaches"] == [] and out["alerts"] == []
+        # The injected double-admit: every deny on the exhausted bucket
+        # flips into a granted reply with NO store debit.
+        inj = FaultInjector(seed, {"audit.leak": (
+            FaultRule(kind="error", probability=1.0),)})
+        faults.install(inj)
+        try:
+            for _ in range(30):
+                await st.acquire("hot", 50, 100.0, 0.0)
+        finally:
+            faults.uninstall()
+        assert inj.events, "the leak seam never fired"
+        detect_tick = None
+        for i in range(3):                     # acceptance: <= 3 ticks
+            out = srv.auditor.tick()
+            if out["breaches"]:
+                detect_tick = out["tick"]
+                assert out["breaches"] == ["reply_witness"]
+                assert out["residues"]["reply_witness"] > 0.0
+                break
+        assert detect_tick is not None, "leak not detected in 3 ticks"
+        # The episode keeps burning; hysteresis must hold it to ONE
+        # bundle (the leak trips the ledger AND the overadmit SLO).
+        for _ in range(4):
+            srv.auditor.tick()
+        assert srv.auditor.bundles_assembled == 1
+        (bundle,) = srv.auditor.bundles
+        assert bundle["reasons"][0] == "conservation:reply_witness"
+        w = bundle["witness_deltas"]
+        assert (w["replied_tokens_delta"]
+                > w["witnessed_tokens_delta"])   # the witnessing deltas
+        # Correlation: exemplar trace ids resolve into kept traces.
+        assert len(bundle["trace_ids"]) >= 1
+        kept = {t.get("trace_id") for t in srv.tracer.traces()}
+        assert set(bundle["trace_ids"]) & kept
+        assert bundle["flight_frames"], "no correlated flight frames"
+        # The wire surface ships the same bundle.
+        snap = await st.audit(bundles=4)
+        assert [b["id"] for b in snap["bundles"]] == [bundle["id"]]
+        return {
+            "detect_tick": detect_tick,
+            "injected": len(inj.events),
+            "alert_log": list(srv.auditor.watchdog.alert_log),
+            "bundle": {"id": bundle["id"], "tick": bundle["tick"],
+                       "reasons": bundle["reasons"],
+                       "residues": bundle["residues"],
+                       "witness_deltas": bundle["witness_deltas"]},
+        }
+    finally:
+        await st.aclose()
+        await srv.aclose()
+        tracing.configure(enabled=False)
+        tracing.get_tracer().reset()
+
+
+class TestLeakSoak:
+    def test_injected_leak_one_bundle_within_three_ticks(self):
+        sched = run(_leak_soak(SEED))
+        assert sched["detect_tick"] <= 3 + 3   # 3 warm-up + 3 allowed
+        assert sched["bundle"]["id"] == "bundle-0000"
+
+    def test_same_seed_identical_alert_schedule(self):
+        a = run(_leak_soak(SEED))
+        b = run(_leak_soak(SEED))
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+
+    # -- negative arms: the zero-false-alarm posture --
+
+    def test_legitimate_denies_raise_nothing(self):
+        """Honest denies move NEITHER witness counter — the reshard/
+        upgrade soaks' deny-heavy traffic must not read as a leak."""
+        run(self._denies_body())
+
+    async def _denies_body(self):
+        srv = BucketStoreServer(InProcessBucketStore(), port=0,
+                                audit=_SOAK_AUDIT_CFG)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            denied = 0
+            for _ in range(40):
+                r = await st.acquire("tight", 50, 100.0, 0.0)
+                denied += 0 if r.granted else 1
+            assert denied >= 30
+            for _ in range(6):
+                out = srv.auditor.tick()
+                assert out["breaches"] == [] and out["alerts"] == []
+            assert srv.auditor.bundles_assembled == 0
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+    def test_rolling_restart_raises_nothing(self):
+        """A restarted server re-fronting the same store (the upgrade
+        soak's move) resets the witness counters — the delta windows
+        must re-anchor, not read the restart as drift."""
+        run(self._restart_body())
+
+    async def _restart_body(self):
+        backing = InProcessBucketStore()
+        srv = BucketStoreServer(backing, port=0, audit=_SOAK_AUDIT_CFG)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        for i in range(20):
+            await st.acquire(f"k{i}", 1, 1e6, 1e6)
+        for _ in range(3):
+            assert srv.auditor.tick()["breaches"] == []
+        await st.aclose()
+        await srv.aclose()
+        srv2 = BucketStoreServer(backing, port=0,
+                                 audit=_SOAK_AUDIT_CFG)
+        await srv2.start()
+        await _drain_audit_task(srv2)
+        st2 = RemoteBucketStore(address=(srv2.host, srv2.port),
+                                coalesce_requests=False)
+        try:
+            for i in range(20):
+                await st2.acquire(f"k{i}", 1, 1e6, 1e6)
+            for _ in range(6):
+                out = srv2.auditor.tick()
+                assert out["breaches"] == [] and out["alerts"] == []
+            assert srv2.auditor.bundles_assembled == 0
+        finally:
+            await st2.aclose()
+            await srv2.aclose()
+
+    def test_federation_flow_raises_nothing(self):
+        """The federation soak's lease/renew/reclaim flow sits on the
+        CONSERVATIVE side of the cover identity — never an alarm."""
+        run(self._fed_body())
+
+    async def _fed_body(self):
+        backing = InProcessBucketStore()
+        backing.federation_ledger(default_ttl_s=30.0)
+        srv = BucketStoreServer(backing, port=0,
+                                audit=_SOAK_AUDIT_CFG)
+        await srv.start()
+        await _drain_audit_task(srv)
+        st = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False)
+        try:
+            for rid in ("A", "B", "C"):
+                r = await st.fed_lease({"region": f"r{rid}",
+                                        "lease_id": rid, "tenant": "t",
+                                        "demand": 1.0,
+                                        "global_cap": 600.0,
+                                        "global_rate": 0.0})
+                assert r["granted"]
+            for rid in ("A", "B"):
+                await st.fed_renew({"region": f"r{rid}", "lease_id": rid,
+                                    "tenant": "t", "total": 10.0,
+                                    "demand": 1.0})
+            await st.fed_reclaim({"region": "rC", "lease_id": "C",
+                                  "tenant": "t", "total": 5.0})
+            for _ in range(6):
+                out = srv.auditor.tick()
+                assert out["breaches"] == [] and out["alerts"] == []
+            assert srv.auditor.bundles_assembled == 0
+        finally:
+            await st.aclose()
+            await srv.aclose()
+
+
+# -- the <3% steady-state overhead contract ----------------------------------
+
+@pytest.mark.slow
+def test_audit_overhead_within_contract():
+    """CI regression for the audit plane's <3% serving-overhead
+    contract: ABBA-interleaved paired windows against two otherwise
+    identical in-process rigs — audit ticking at 10x the production
+    cadence vs the ``audit=False`` ablation — the same median-of-blocks
+    estimator as the bench's ``audit_overhead`` section."""
+    import time as _time
+
+    async def main() -> float:
+        srv_a = BucketStoreServer(
+            InProcessBucketStore(), port=0,
+            audit=AuditConfig(tick_s=0.05))      # 10x production rate
+        srv_b = BucketStoreServer(InProcessBucketStore(), port=0,
+                                  audit=False)
+        await srv_a.start()
+        await srv_b.start()
+        st_a = RemoteBucketStore(address=(srv_a.host, srv_a.port),
+                                 coalesce_requests=False)
+        st_b = RemoteBucketStore(address=(srv_b.host, srv_b.port),
+                                 coalesce_requests=False)
+
+        async def window(store, depth: int = 16,
+                         reqs: int = 80) -> float:
+            async def worker(w: int) -> None:
+                for j in range(reqs):
+                    await store.acquire(f"user{(w * 13 + j) % 512}", 1,
+                                        1e7, 1e7)
+
+            t0 = _time.perf_counter()
+            await asyncio.gather(*(worker(w) for w in range(depth)))
+            return depth * reqs / (_time.perf_counter() - t0)
+
+        try:
+            await window(st_a)       # warm both rigs
+            await window(st_b)
+            blocks = []
+            for _ in range(4):
+                a1 = await window(st_a)
+                b1 = await window(st_b)
+                b2 = await window(st_b)
+                a2 = await window(st_a)
+                blocks.append(((a1 + a2) / 2, (b1 + b2) / 2))
+            deltas = sorted((b - a) / b for a, b in blocks)
+            return deltas[len(deltas) // 2] * 100.0
+        finally:
+            await st_a.aclose()
+            await st_b.aclose()
+            await srv_a.aclose()
+            await srv_b.aclose()
+
+    measured = []
+    for _ in range(3):
+        overhead_pct = run(main())
+        measured.append(overhead_pct)
+        if overhead_pct < 3.0:
+            break
+    assert min(measured) < 3.0, (
+        f"audit-on overhead {measured} % across attempts")

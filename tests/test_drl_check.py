@@ -726,10 +726,10 @@ def test_fed_reclaim_unclassified_fires_wire_idempotency(tmp_path):
     mutated = tmp_path / "remote.py"
     text = REMOTE_PATH.read_text()
     anchor = ("    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
-              "wire.OP_FED_RECLAIM))")
+              "wire.OP_FED_RECLAIM,")
     assert anchor in text, "fixture anchor gone from remote.py"
     mutated.write_text(text.replace(
-        anchor, "    wire.OP_FED_LEASE, wire.OP_FED_RENEW))", 1))
+        anchor, "    wire.OP_FED_LEASE, wire.OP_FED_RENEW,", 1))
     findings = wire_conformance.check_idempotency(WIRE, mutated,
                                                   tmp_path)
     assert [f.rule for f in findings] == ["wire-idempotency"]

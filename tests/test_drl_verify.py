@@ -344,10 +344,10 @@ def test_unmodeled_idempotent_op_is_flagged(tmp_path):
     shim = _shim(tmp_path, {
         "remote.py": (
             "    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
-            "wire.OP_FED_RECLAIM))",
+            "wire.OP_FED_RECLAIM,",
             "    wire.OP_FED_LEASE, wire.OP_FED_RENEW, "
             "wire.OP_FED_RECLAIM,\n"
-            "    wire.OP_SAVE))")})
+            "    wire.OP_SAVE,")})
     facts = extract_facts(shim)
     assert unmodeled_idempotent_ops(facts) == ["OP_SAVE"]
 

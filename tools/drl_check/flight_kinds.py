@@ -91,11 +91,21 @@ def _kind_sites(source: str) -> "list[tuple[str, int, str]]":
             out.append((node.args[0].value, node.lineno, "record"))
         elif node.func.attr == "frames":
             for kw in node.keywords:
-                if kw.arg == "kind" \
-                        and isinstance(kw.value, ast.Constant) \
+                if kw.arg != "kind":
+                    continue
+                if isinstance(kw.value, ast.Constant) \
                         and isinstance(kw.value.value, str):
                     out.append((kw.value.value, node.lineno,
                                 "frames(kind=)"))
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    # frames(kind=("slo", "audit")) — each element is
+                    # checked on its own line so one typo'd member of
+                    # a multi-kind filter is still caught.
+                    for elt in kw.value.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            out.append((elt.value, node.lineno,
+                                        "frames(kind=)"))
     return out
 
 

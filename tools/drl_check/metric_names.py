@@ -46,7 +46,10 @@ _NAMESPACE = "drl"
 
 _EXACT_METHODS = frozenset({"counter", "gauge", "histogram",
                             "labeled_gauges", "labeled_counters"})
-_SUBSCRIPTION_NAMES = ("SENSOR_SERIES",)
+#: Module-level tuples holding series subscriptions: the controller's
+#: sensors and the SLO watchdog's sample sources (utils/slo.py) — both
+#: consume series they never emit, so both drift the same way.
+_SUBSCRIPTION_NAMES = ("SENSOR_SERIES", "SLO_SERIES")
 
 
 def controller_subscriptions(path: pathlib.Path
@@ -107,44 +110,51 @@ def registered_families(py_files: "list[pathlib.Path]"
     return exact, prefixes
 
 
-def check_sources(controller_path: pathlib.Path,
-                  py_files: "list[pathlib.Path]",
+def check_sources(subscription_paths, py_files: "list[pathlib.Path]",
                   root: pathlib.Path) -> list[Finding]:
-    subs = controller_subscriptions(controller_path)
+    """``subscription_paths`` is one path or a sequence of paths, each
+    scanned for ``_SUBSCRIPTION_NAMES`` tuples; every element of every
+    tuple must resolve against the registration sites in ``py_files``."""
+    if isinstance(subscription_paths, pathlib.Path):
+        subscription_paths = [subscription_paths]
     exact, prefixes = registered_families(py_files)
-    suppress = Suppressions(controller_path.read_text())
     findings: list[Finding] = []
-    for name, line in subs:
-        if suppress.suppressed(line, "metric-name"):
-            continue
-        if name in exact or name in prefixes:
-            continue
-        if any(name.startswith(prefix + "_") for prefix in prefixes):
-            continue
-        all_families = sorted(exact) + sorted(prefixes)
-        related: list[tuple[str, int, str]] = []
-        near = difflib.get_close_matches(name, all_families, n=1,
-                                         cutoff=0.0)
-        if near:
-            site = exact.get(near[0]) or prefixes[near[0]]
-            related.append((rel(site[0], root), site[1],
-                            f"nearest registered family: {near[0]}"))
-        findings.append(Finding(
-            rule="metric-name",
-            message=(f"controller subscribes to series {name!r} but no "
-                     "MetricsRegistry registration emits it — the "
-                     "sensor would read zero forever"),
-            file=rel(controller_path, root),
-            line=line,
-            related=tuple(related),
-        ))
+    for sub_path in subscription_paths:
+        subs = controller_subscriptions(sub_path)
+        suppress = Suppressions(sub_path.read_text())
+        for name, line in subs:
+            if suppress.suppressed(line, "metric-name"):
+                continue
+            if name in exact or name in prefixes:
+                continue
+            if any(name.startswith(prefix + "_") for prefix in prefixes):
+                continue
+            all_families = sorted(exact) + sorted(prefixes)
+            related: list[tuple[str, int, str]] = []
+            near = difflib.get_close_matches(name, all_families, n=1,
+                                             cutoff=0.0)
+            if near:
+                site = exact.get(near[0]) or prefixes[near[0]]
+                related.append((rel(site[0], root), site[1],
+                                f"nearest registered family: {near[0]}"))
+            findings.append(Finding(
+                rule="metric-name",
+                message=(f"subscriber declares series {name!r} but no "
+                         "MetricsRegistry registration emits it — the "
+                         "sensor would read zero forever"),
+                file=rel(sub_path, root),
+                line=line,
+                related=tuple(related),
+            ))
     return findings
 
 
 def check(root: pathlib.Path) -> list[Finding]:
-    controller = (root / "distributedratelimiting" / "redis_tpu"
-                  / "runtime" / "controller.py")
-    if not controller.exists():
-        return []  # shim trees (CLI tests) carry no controller
+    pkg = root / "distributedratelimiting" / "redis_tpu"
+    subscribers = [pkg / "runtime" / "controller.py",
+                   pkg / "utils" / "slo.py"]
+    subscribers = [p for p in subscribers if p.exists()]
+    if not subscribers:
+        return []  # shim trees (CLI tests) carry no subscribers
     py_files = iter_py_files(root / "distributedratelimiting")
-    return check_sources(controller, py_files, root)
+    return check_sources(subscribers, py_files, root)

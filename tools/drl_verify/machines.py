@@ -71,7 +71,7 @@ __all__ = ["MigrationWorld", "ConfigWorld", "ReservationWorld",
 #: Idempotent ops that are pure reads — replay-safe by construction
 #: (their server handlers mutate nothing; the wire fuzz pins replies).
 READ_OPS = frozenset({"OP_PEEK", "OP_PING", "OP_METRICS",
-                      "OP_PLACEMENT"})
+                      "OP_PLACEMENT", "OP_AUDIT"})
 
 #: Idempotent ops whose replay safety is *explored*: each maps to the
 #: world whose dup_* labels exercise it. Adding an op to
