@@ -7,13 +7,13 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: check lint verify-model test native asan-test tsan-test \
-        chaos-test reshard-soak upgrade-soak parity-fuzz llm-soak \
-        controller-soak reserve-soak federation-soak uring-test \
-        audit-soak
+.PHONY: check lint verify-model xla-budget xla-budget-restamp test \
+        native asan-test tsan-test chaos-test reshard-soak \
+        upgrade-soak parity-fuzz llm-soak controller-soak \
+        reserve-soak federation-soak uring-test audit-soak
 
-check: lint verify-model test chaos-test upgrade-soak parity-fuzz \
-       uring-test llm-soak controller-soak reserve-soak \
+check: lint verify-model xla-budget test chaos-test upgrade-soak \
+       parity-fuzz uring-test llm-soak controller-soak reserve-soak \
        federation-soak audit-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
@@ -38,6 +38,19 @@ lint:
 # with `python -m tools.drl_verify --emit-replays <dir>`.
 verify-model:
 	$(PY) -m tools.drl_verify
+
+# Compiled-artifact conformance (docs/OPERATIONS.md §19): traces every
+# jitted admission kernel to jaxpr/StableHLO and checks hot-path
+# purity, donation conformance, retrace stability, and the op-count
+# budget ledger (tools/drl_xla/budgets.json). Frozen here (--no-restamp)
+# so a drifted ledger FAILS the gate instead of silently rewriting
+# itself mid-check; run `make xla-budget-restamp` after a deliberate
+# kernel change to re-stamp, then commit the budgets.json diff.
+xla-budget:
+	JAX_PLATFORMS=cpu $(PY) -m tools.drl_xla --no-restamp
+
+xla-budget-restamp:
+	JAX_PLATFORMS=cpu $(PY) -m tools.drl_xla
 
 # Tier-1: the suite every PR must keep green (ROADMAP.md).
 test:

@@ -485,6 +485,21 @@ def _append(ledger: pathlib.Path, row: dict) -> None:
         f.write(json.dumps(row) + "\n")
 
 
+def _budget_ledger_hash() -> "str | None":
+    """Content hash of the checked-in drl-xla op-budget ledger
+    (tools/drl_xla/budgets.json). Every debt row carries it so a
+    settled number names the compiled-artifact shape it was measured
+    under — a later kernel rework that changes gather/launch counts
+    visibly orphans the old evidence instead of silently inheriting
+    it (docs/OPERATIONS.md §19). ``None`` when the ledger is absent
+    (a fresh checkout mid-restamp): the row still lands, unannotated."""
+    try:
+        from tools.drl_xla import budgets
+        return budgets.ledger_hash(budgets.ledger_path(_ROOT))
+    except Exception:
+        return None
+
+
 # -- device window probe (bench.py's disposable-child discipline) ------------
 
 def _probe_platform(max_wait_s: float) -> "str | None":
@@ -573,7 +588,8 @@ def main(argv: "list[str] | None" = None) -> int:
                                      timeout_s=args.section_timeout_s)
         row = {"debt": name, "why": why, "status": status,
                "platform": platform, "settles_debt": bool(device),
-               "t": time.time(), "result": value}
+               "t": time.time(), "budget_ledger": _budget_ledger_hash(),
+               "result": value}
         _append(ledger, row)
         results[name] = status
         print(json.dumps(row), flush=True)

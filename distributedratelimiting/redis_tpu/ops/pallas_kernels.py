@@ -75,7 +75,8 @@ def _sweep_kernel(now_ref, cap_ref, rate_ref, tokens_ref, last_ts_ref,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, donate_argnums=(2,),
+                   static_argnames=("interpret",))
 def sweep_expired_pallas(tokens, last_ts, exists_i8, now, capacity,
                          fill_rate_per_tick, *, interpret: bool = False):
     """Fused streaming TTL sweep over the whole table.
@@ -85,7 +86,14 @@ def sweep_expired_pallas(tokens, last_ts, exists_i8, now, capacity,
         is NOT required — inputs are padded here (padding rows carry
         ``exists = 0`` so they can never count as expired).
       last_ts: i32[N]; exists_i8: i8[N] (0/1 occupancy — int8 keeps the
-        occupancy traffic and mask readback at 1 byte/slot).
+        occupancy traffic and mask readback at 1 byte/slot). ``exists_i8``
+        is **donated**: its buffer is aliased to ``new_exists`` so the
+        occupancy plane is not double-buffered during a full-table sweep
+        (1 byte/slot — 10 MB transient at 10M slots; drl-xla
+        ``xla-donation`` pins the alias in the lowered artifact). Callers
+        pass a fresh array (every call site builds one via ``astype``)
+        and must not reuse it after the call. ``tokens``/``last_ts`` are
+        read-only here and stay un-donated — the caller keeps them.
       now/capacity/fill_rate_per_tick: scalars (host-side Python/np values
         or 0-d arrays).
 

@@ -424,6 +424,76 @@ def test_static_unhashable_default_fires_once():
         ("jit-static-unhashable", 5)]
 
 
+def test_jit_f64_fires_on_each_spelling():
+    """The three ways a 64-bit dtype sneaks into a jitted hot path —
+    an attribute, an astype string, a dtype= keyword — each fire; the
+    32-bit spellings stay silent."""
+    src = textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x, y):
+            a = x.astype(jnp.float64)
+            b = y.astype("int64")
+            c = jnp.zeros((4,), dtype="float64")
+            d = x.astype(jnp.float32) + jnp.int32(0)
+            return a + b + c + d
+    """)
+    findings = jax_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("jit-f64", 6), ("jit-f64", 7), ("jit-f64", 8)]
+    # un-jitted code may hold f64 freely (host-side accounting)
+    assert jax_lint.check_source(
+        "import numpy as np\n\ndef host():\n"
+        "    return np.float64(0.0)\n", "snippet.py") == []
+
+
+def test_jit_f64_suppressible():
+    src = textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            # drl-check: ok(jit-f64)
+            return x.astype(jnp.float64)
+    """)
+    assert jax_lint.check_source(src, "snippet.py") == []
+
+
+def test_jit_closed_scalar_fires_once_builders_exempt():
+    """A nested jitted function closing over an enclosing local bakes
+    the value into the trace (the retrace-per-value leak drl-xla's
+    xla-retrace probes on the compiled side); an lru_cache'd builder
+    and a closed-over helper FUNCTION are the two legitimate shapes."""
+    src = textwrap.dedent("""\
+        import functools
+        import jax
+
+        def make_kernel(cost, scale):
+            def helper(v):
+                return v + scale
+
+            @jax.jit
+            def kernel(x):
+                return helper(x) * cost
+            return kernel
+
+        @functools.lru_cache(maxsize=8)
+        def make_cached(cost):
+            @jax.jit
+            def kernel(x):
+                return x * cost
+            return kernel
+    """)
+    findings = jax_lint.check_source(src, "snippet.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("jit-closed-scalar", 10)]
+    assert "'cost'" in findings[0].message
+    assert "xla-retrace" in findings[0].message
+
+
 # -- build freshness --------------------------------------------------------
 
 def _fake_native(tmp_path: pathlib.Path) -> pathlib.Path:

@@ -26,10 +26,14 @@ KNOWN_RULES = frozenset({
     "unguarded-loop-close", "swallowed-exception",
     # JAX hot-path lint
     "traced-branch", "jit-rewrap", "jit-static-unhashable",
+    "jit-f64", "jit-closed-scalar",
     # build freshness / metrics / flight recorder
     "stale-binary", "metric-name", "flight-kind",
     # drl-verify lock-order leg
     "lock-cycle", "slice-sweep-order",
+    # drl-xla compiled-artifact conformance (python -m tools.drl_xla)
+    "xla-purity", "xla-donation", "xla-retrace", "xla-budget",
+    "xla-stale-ledger",
     # this meta-rule itself (ok(stale-suppression) is the escape hatch)
     "stale-suppression",
 })
@@ -42,7 +46,12 @@ INLINE_SUPPRESSIBLE = frozenset({
     "async-blocking", "lock-across-await", "task-off-loop",
     "unguarded-loop-close", "swallowed-exception",
     "traced-branch", "jit-rewrap", "jit-static-unhashable",
+    "jit-f64", "jit-closed-scalar",
     "metric-name", "flight-kind",
+    # Honored by drl-xla at the kernel's def line. xla-stale-ledger is
+    # deliberately NOT suppressible: a stale ledger is a freshness bug,
+    # not a judgment call.
+    "xla-purity", "xla-donation", "xla-retrace", "xla-budget",
 })
 
 

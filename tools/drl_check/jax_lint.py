@@ -17,6 +17,22 @@ Rules:
   literal (list/dict/set): static args key the jit cache by hash, so the
   first call raises ``TypeError: unhashable``; even when callers always
   override, the default documents an illegal call.
+- ``jit-f64`` — a 64-bit dtype (``float64``/``double``/``int64``/
+  ``complex128``, as an attribute, an ``astype`` target, or a ``dtype=``
+  keyword) inside a jitted hot path: the state plane is 32-bit by
+  contract, and with x64 disabled the promotion is silently *clamped* —
+  the source lies about the artifact. This is the AST layer of a
+  two-layer check: drl-xla's ``xla-purity`` verifies the compiled jaxpr
+  carries no 64-bit values (``python -m tools.drl_xla``), so a
+  violation is named at both the source line and the artifact.
+- ``jit-closed-scalar`` — a jitted function *nested* in another
+  function closes over an enclosing local/parameter: the value is baked
+  into the trace, so each rebuild (or each distinct value, via the
+  surrounding builder) re-traces and re-compiles — the retrace-per-cost
+  leak drl-xla's ``xla-retrace`` probes on the compiled side.
+  ``lru_cache``'d builders are exempt (intentional per-config
+  specialization with a bounded cache), as are closed-over helper
+  functions/classes.
 """
 
 from __future__ import annotations
@@ -123,6 +139,34 @@ def _branch_uses_traced(test: ast.AST, traced: set[str]) -> str | None:
     return scan(test)
 
 
+#: 64-bit dtype spellings that have no business on the 32-bit state
+#: plane. Matched as attribute names (``jnp.float64``), ``astype``
+#: string targets, and ``dtype=`` keyword constants.
+_WIDE_DTYPE_NAMES = frozenset({
+    "float64", "double", "int64", "uint64", "complex128",
+})
+
+
+def _wide_dtype_use(node: ast.AST) -> str | None:
+    """The wide dtype this node introduces, or None."""
+    if isinstance(node, ast.Attribute) and node.attr in _WIDE_DTYPE_NAMES:
+        return node.attr
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype":
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value in _WIDE_DTYPE_NAMES:
+                    return arg.value
+        for kw in node.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str) \
+                    and kw.value.value in _WIDE_DTYPE_NAMES:
+                return kw.value.value
+    return None
+
+
 #: Enclosing-function shapes that legitimately build-and-return a jitted
 #: callable (the result is cached by the caller / a lru_cache).
 _BUILDER_DECORATORS = {"lru_cache", "cache", "cached_property"}
@@ -143,6 +187,8 @@ class _Visitor(ast.NodeVisitor):
         spec = _jit_spec_from_decorators(node)
         if spec is not None:
             self._check_jitted(node, spec)
+            if self._fn_stack:
+                self._check_closed_scalar(node, spec)
         self._fn_stack.append(node)
         self.generic_visit(node)
         self._fn_stack.pop()
@@ -202,6 +248,71 @@ class _Visitor(ast.NodeVisitor):
                         "must be jnp.where / lax.cond / lax.select (or "
                         f"mark {hit!r} static if it is config, at the "
                         "cost of a cache entry per value)")
+
+        # jit-f64: a 64-bit dtype reaching a jitted hot path.
+        for node in ast.walk(fn):
+            wide = _wide_dtype_use(node)
+            if wide is not None:
+                self._emit(
+                    "jit-f64", node.lineno,
+                    f"64-bit dtype {wide!r} in a jitted hot path: the "
+                    "state plane is 32-bit by contract, and with x64 "
+                    "disabled this promotion is silently clamped to "
+                    "32-bit — the source no longer describes the "
+                    "artifact (compiled-side twin: xla-purity in "
+                    "`python -m tools.drl_xla` checks the jaxpr)")
+
+    def _check_closed_scalar(self, fn: ast.AST, spec: _JitSpec) -> None:
+        """jit-closed-scalar: a nested jitted function reading an
+        enclosing function's local/parameter bakes that value into the
+        trace — a retrace per rebuild (and per distinct value through
+        the builder). Cached builders and closed-over callables are the
+        two legitimate shapes; everything else is flagged."""
+        for enclosing in self._fn_stack:
+            decorated = {_dotted(d.func if isinstance(d, ast.Call) else d
+                                 )[-1]
+                         for d in getattr(enclosing, "decorator_list", [])}
+            if decorated & _BUILDER_DECORATORS:
+                return
+        outer_bound: set[str] = set()
+        outer_callables: set[str] = set()
+        for enclosing in self._fn_stack:
+            a = enclosing.args
+            outer_bound.update(x.arg for x in (a.posonlyargs + a.args
+                                               + a.kwonlyargs))
+            for node in ast.walk(enclosing):
+                if node is fn or isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not enclosing:
+                    outer_callables.add(getattr(node, "name", ""))
+                    continue
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Store):
+                    outer_bound.add(node.id)
+        a = fn.args
+        own: set[str] = {x.arg for x in (a.posonlyargs + a.args
+                                         + a.kwonlyargs)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                own.add(node.id)
+        reported: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in outer_bound and node.id not in own and \
+                    node.id not in outer_callables and \
+                    node.id not in reported:
+                reported.add(node.id)
+                self._emit(
+                    "jit-closed-scalar", node.lineno,
+                    f"jitted function {fn.name!r} closes over "
+                    f"{node.id!r} from the enclosing function: the "
+                    "value is baked into the trace, so the kernel "
+                    "re-traces per rebuild/per distinct value — pass "
+                    "it as an operand, mark it static, or cache the "
+                    "builder with lru_cache (compiled-side twin: "
+                    "xla-retrace in `python -m tools.drl_xla`)")
 
 
 def check_source(source: str, path: str) -> list[Finding]:

@@ -22,7 +22,12 @@ all flagged:
 
 Escape hatch: a comment whose rule list includes ``stale-suppression``
 is exempt (it declares "keep me even while dormant" — e.g. a rule
-that fires only on some platforms)."""
+that fires only on some platforms).
+
+``xla-*`` rules are validated for spelling/suppressibility here but
+their staleness is NOT re-checked — those analyzers read compiled
+artifacts, which this AST-level pass cannot re-run. drl-xla audits its
+own suppressions (``python -m tools.drl_xla``)."""
 
 from __future__ import annotations
 
@@ -149,6 +154,12 @@ def check_source_entries(root: pathlib.Path, path: str,
                     "comments — this ok(...) is dead by construction "
                     "and reads as protection it does not provide",
                     path, line))
+                continue
+            if rule.startswith("xla-"):
+                # Compile-level rules: drl-check cannot re-trace a
+                # kernel to test staleness. drl-xla audits its own
+                # xla-* suppressions (apply_suppressions emits the
+                # stale-suppression finding there).
                 continue
             if rule == "metric-name":
                 fires = _metric_name_fires(root, path, line)

@@ -1,0 +1,117 @@
+"""CLI: ``python -m tools.drl_xla [--json] [--only STAGE] [--root DIR]
+[--no-restamp] [--ledger PATH]``.
+
+Exit codes (the drl-check contract):
+
+- ``0`` — every kernel extracted, every analyzer clean, ledger exact
+  (or freshly restamped after a tightening).
+- ``1`` — findings, printed with file:line on both sides.
+- ``2`` — the extractor or an analyzer itself failed (blind extractor,
+  un-derivable operand, missing floor). A tool that cannot see must
+  say so — never report clean.
+
+``--no-restamp`` freezes the ledger: any drift (even a tightening)
+becomes an ``xla-stale-ledger`` finding instead of a write. The
+``make check`` gate and the tier-1 pins use it (``make xla-budget``);
+``make xla-budget-restamp`` runs without it so an improvement lands in
+the diff you are about to commit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import pathlib
+import sys
+
+# Must precede any jax import: the artifacts are traced on the CPU
+# lowering path by contract (platform-portable for these properties).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tools.drl_xla import analyzers, budgets, extract  # noqa: E402
+
+_STAGES = ("purity", "donation", "retrace", "budget")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.drl_xla",
+        description="compiled-artifact conformance for the admission "
+                    "kernels (jaxpr/HLO budget ledger)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings + measurements")
+    parser.add_argument("--only", choices=_STAGES, default=None,
+                        help="run a single analyzer (extraction always "
+                        "runs; the floors still apply)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: this checkout)")
+    parser.add_argument("--no-restamp", action="store_true",
+                        help="treat ANY ledger drift as a finding "
+                        "instead of rewriting budgets.json")
+    parser.add_argument("--ledger", default=None,
+                        help="alternate budgets.json path")
+    args = parser.parse_args(argv)
+    root = pathlib.Path(args.root).resolve() if args.root \
+        else pathlib.Path(__file__).resolve().parents[2]
+    ledger = pathlib.Path(args.ledger) if args.ledger else None
+
+    try:
+        decls = extract.discover(root)
+        sites = extract.launch_sites(root, decls)
+        artifacts = extract.trace_kernels(decls, root)
+    except extract.ExtractionError as exc:
+        print(f"drl-xla: extraction failed: {exc}", file=sys.stderr)
+        return 2
+
+    findings = []
+    status = "skipped"
+    try:
+        if args.only in (None, "purity"):
+            findings += analyzers.check_purity(artifacts, sites)
+        if args.only in (None, "donation"):
+            findings += analyzers.check_donation(artifacts, sites)
+        if args.only in (None, "retrace"):
+            findings += analyzers.check_retrace(artifacts, sites)
+        if args.only in (None, "budget"):
+            budget_findings, status = budgets.compare(
+                root, artifacts, sites=sites, path=ledger,
+                restamp=not args.no_restamp)
+            findings += budget_findings
+        findings = analyzers.apply_suppressions(findings, root, decls)
+    except extract.ExtractionError as exc:
+        print(f"drl-xla: analyzer blinded: {exc}", file=sys.stderr)
+        return 2
+    except Exception as exc:  # a checker bug must be loud, rc 2
+        print(f"drl-xla: checker bug: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "kernels": len(artifacts),
+            "launch_sites": sum(len(v) for v in sites.values()),
+            "budget_status": status,
+            "measured": budgets.measure_all(artifacts),
+            "findings": [
+                {"rule": f.rule, "message": f.message, "file": f.file,
+                 "line": f.line,
+                 "related": [list(r) for r in f.related]}
+                for f in findings],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.format())
+        by_rule = collections.Counter(f.rule for f in findings)
+        summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+        print(f"drl-xla: {len(artifacts)} kernels, "
+              f"{sum(len(v) for v in sites.values())} launch sites, "
+              f"ledger {status}; "
+              + (f"{len(findings)} finding(s): {summary}"
+                 if findings else "clean"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

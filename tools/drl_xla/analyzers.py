@@ -1,0 +1,244 @@
+"""The compiled-artifact analyzers: purity, donation conformance, and
+retrace stability.
+
+Each analyzer reads what the kernel *compiled to* (jaxpr, lowered
+StableHLO, jit cache), not what the decorator claims — the decorator is
+a request; the artifact is the fact. Findings anchor at the kernel's
+``def`` line and carry the runtime launch sites as related locations,
+so a violation names both the kernel and the serving path that pays
+for it.
+
+Suppression: ``# drl-check: ok(xla-...)`` on (or directly above) the
+kernel's ``def`` line, via the shared registry in
+tools/drl_check/common.py. drl-xla audits its own suppressions — an
+``ok(xla-*)`` comment whose rule no longer fires here is reported as
+``stale-suppression`` by THIS tool (drl-check's stale-suppression pass
+skips xla-* rules; it cannot re-run a compile-level analyzer).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import warnings
+
+from tools.drl_check.common import _SUPPRESS_RE, Finding
+
+from tools.drl_xla import budgets, extract
+
+__all__ = [
+    "check_purity", "check_donation", "check_retrace",
+    "apply_suppressions", "XLA_RULES",
+]
+
+XLA_RULES = frozenset({
+    "xla-purity", "xla-donation", "xla-retrace", "xla-budget",
+    "xla-stale-ledger",
+})
+
+#: Callback primitives that re-enter Python from a compiled admission
+#: kernel — a host round-trip per launch on the serving path.
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "debug_print",
+})
+#: Primitives that move data across the host/device boundary mid-kernel.
+_TRANSFER_PRIMS = frozenset({"device_put", "copy_to_host"})
+
+_WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+def _related(decl, sites):
+    rel = [(decl.file, decl.line, "kernel definition")]
+    for sf, sl in (sites or {}).get(decl.name, [])[:3]:
+        rel.append((sf, sl, "launch site"))
+    return tuple(rel)
+
+
+# -- hot-path purity --------------------------------------------------------
+
+def check_purity(artifacts, sites=None) -> "list[Finding]":
+    findings: list[Finding] = []
+    for art in artifacts:
+        decl = art.decl
+        callbacks: list[str] = []
+        transfers: list[str] = []
+        wide: set[str] = set()
+        for eqn in budgets._iter_eqns(art.jaxpr.jaxpr):
+            name = eqn.primitive.name
+            if name in _CALLBACK_PRIMS:
+                callbacks.append(name)
+            elif name in _TRANSFER_PRIMS:
+                transfers.append(name)
+            for var in tuple(eqn.outvars) + tuple(eqn.invars):
+                dt = str(getattr(getattr(var, "aval", None), "dtype", ""))
+                if dt in _WIDE_DTYPES:
+                    wide.add(dt)
+            new_dtype = eqn.params.get("new_dtype")
+            if new_dtype is not None and str(new_dtype) in _WIDE_DTYPES:
+                wide.add(str(new_dtype))
+        if callbacks:
+            findings.append(Finding(
+                "xla-purity",
+                f"{decl.name}: compiled artifact re-enters Python via "
+                f"{', '.join(sorted(set(callbacks)))} "
+                f"(x{len(callbacks)}) — a host round-trip inside an "
+                "admission kernel serializes every launch on the "
+                "serving path",
+                decl.file, decl.line, _related(decl, sites)))
+        if transfers:
+            findings.append(Finding(
+                "xla-purity",
+                f"{decl.name}: compiled artifact contains a mid-kernel "
+                f"host/device transfer ({', '.join(sorted(set(transfers)))})"
+                " — operands must arrive packed, once, per launch",
+                decl.file, decl.line, _related(decl, sites)))
+        if wide:
+            findings.append(Finding(
+                "xla-purity",
+                f"{decl.name}: 64-bit values reach the compiled "
+                f"artifact ({', '.join(sorted(wide))}) — the state "
+                "plane is 32-bit by contract; a silent f64 promotion "
+                "doubles HBM traffic and diverges from the wire "
+                "encoding (AST twin: drl-check rule jit-f64)",
+                decl.file, decl.line, _related(decl, sites)))
+    return findings
+
+
+# -- donation conformance ---------------------------------------------------
+
+def check_donation(artifacts, sites=None) -> "list[Finding]":
+    """Every state-table argument must be BOTH declared donated and
+    actually aliased in the lowered artifact. Half one: a donated leaf
+    with no ``tf.aliasing_output`` attribute is an XLA-declined
+    donation — the table is silently double-buffered. Half two: an
+    un-donated table leaf whose exact aval appears among the outputs
+    is a donation the kernel forgot to declare — same doubling, by
+    omission."""
+    findings: list[Finding] = []
+    for art in artifacts:
+        decl = art.decl
+        rank = {flat: pos for pos, flat in enumerate(art.kept)}
+        for leaf in art.leaves:
+            if leaf.donated:
+                pos = rank.get(leaf.index)
+                if pos is None or pos not in art.aliased:
+                    why = ("was dead-code-eliminated from the module"
+                           if pos is None else
+                           "carries no tf.aliasing_output attribute "
+                           "in the lowered StableHLO")
+                    findings.append(Finding(
+                        "xla-donation",
+                        f"{decl.name}: argument {leaf.name!r} is "
+                        f"declared donated but {why} — XLA declined "
+                        "the alias, so the buffer is double-buffered "
+                        "at runtime (a silent HBM capacity bug at "
+                        "table scale); don't trust the decorator",
+                        decl.file, decl.line, _related(decl, sites)))
+            elif leaf.table:
+                aval = (leaf.shape, leaf.dtype)
+                if aval in art.out_avals:
+                    findings.append(Finding(
+                        "xla-donation",
+                        f"{decl.name}: table-sized argument "
+                        f"{leaf.name!r} "
+                        f"({leaf.dtype}[{','.join(map(str, leaf.shape))}]) "
+                        "is not donated although the kernel returns an "
+                        "output of identical shape/dtype — the update "
+                        "allocates a second copy of a resident plane "
+                        "every launch; declare it in donate_argnums",
+                        decl.file, decl.line, _related(decl, sites)))
+    return findings
+
+
+# -- retrace stability ------------------------------------------------------
+
+def check_retrace(artifacts, sites=None) -> "list[Finding]":
+    """Call each kernel twice with different concrete values at
+    identical shapes/dtypes; exactly one cache entry may exist. A
+    second entry means some value is keying the trace (a Python scalar
+    routed through static_argnames / closed over at trace time) — the
+    kernel recompiles per distinct cost/config value in production."""
+    findings: list[Finding] = []
+    for art in artifacts:
+        decl = art.decl
+        fn = art.fn
+        if not hasattr(fn, "_cache_size"):
+            raise extract.ExtractionError(
+                f"{decl.key}: jit wrapper exposes no _cache_size — the "
+                "retrace probe cannot see; update tools/drl_xla for "
+                "this jax version")
+        if hasattr(fn, "clear_cache"):
+            fn.clear_cache()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # declined-donation noise
+            fn(*art.args1, **art.statics)     # — check_donation owns it
+            fn(*art.args2, **art.statics2)
+        entries = fn._cache_size()
+        if entries != 1:
+            findings.append(Finding(
+                "xla-retrace",
+                f"{decl.name}: two calls at identical shapes/dtypes "
+                f"but different values produced {entries} jit cache "
+                "entries — a concrete value is keying the trace "
+                "(static_argnames on a data operand, or a Python "
+                "scalar closed over at trace time); the kernel "
+                "recompiles per distinct value in production "
+                "(AST twin: drl-check rule jit-closed-scalar)",
+                decl.file, decl.line, _related(decl, sites)))
+    return findings
+
+
+# -- suppression plumbing ---------------------------------------------------
+
+def _comments(path: pathlib.Path) -> "list[tuple[int, list[str]]]":
+    out = []
+    try:
+        text = path.read_text()
+    except OSError:
+        return out
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out.append((i, [r.strip() for r in m.group(1).split(",")]))
+    return out
+
+
+def apply_suppressions(findings: "list[Finding]", root: pathlib.Path,
+                       decls) -> "list[Finding]":
+    """Honor ``# drl-check: ok(xla-...)`` at the kernel's def line and
+    audit the comments themselves: an xla-* suppression that ate
+    nothing this run is stale — delete it so the next real finding
+    there is loud, not pre-excused (``ok(stale-suppression)`` opts a
+    comment out, same escape hatch as drl-check)."""
+    by_file: dict[str, list[tuple[int, list[str]]]] = {}
+    for path in sorted({d.path for d in decls}):
+        relf = str(path.resolve().relative_to(root.resolve())) \
+            if path.resolve().is_relative_to(root.resolve()) \
+            else str(path)
+        by_file[relf] = _comments(path)
+
+    used: set[tuple[str, int, str]] = set()
+    kept: list[Finding] = []
+    for f in findings:
+        hit = None
+        for line, rules in by_file.get(f.file, ()):
+            if f.rule in rules and line in (f.line, f.line - 1):
+                hit = (f.file, line, f.rule)
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            used.add(hit)
+    for relf, comments in sorted(by_file.items()):
+        for line, rules in comments:
+            if "stale-suppression" in rules:
+                continue
+            for rule in rules:
+                if rule in XLA_RULES and (relf, line, rule) not in used:
+                    kept.append(Finding(
+                        "stale-suppression",
+                        f"suppressed rule {rule!r} no longer fires at "
+                        "this site under drl-xla — the artifact it "
+                        "excused is gone; delete the comment",
+                        relf, line))
+    return sorted(kept, key=lambda f: (f.file, f.line, f.rule))
