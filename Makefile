@@ -10,11 +10,11 @@ CXX ?= g++
 .PHONY: check lint verify-model xla-budget xla-budget-restamp test \
         native asan-test tsan-test chaos-test reshard-soak \
         upgrade-soak parity-fuzz llm-soak controller-soak \
-        reserve-soak federation-soak uring-test audit-soak
+        reserve-soak federation-soak uring-test audit-soak storm-soak
 
 check: lint verify-model xla-budget test chaos-test upgrade-soak \
        parity-fuzz uring-test llm-soak controller-soak reserve-soak \
-       federation-soak audit-soak asan-test tsan-test
+       federation-soak audit-soak storm-soak asan-test tsan-test
 
 # Static gate: ruff (style/pyflakes/asyncio, config in pyproject.toml;
 # optional — the container may not ship it) + drl-check (wire/ABI
@@ -121,6 +121,20 @@ federation-soak:
 controller-soak:
 	JAX_PLATFORMS=cpu DRL_CONTROLLER_SEED=$(SEED) $(PY) -m pytest \
 	  tests/test_controller.py -v -p no:cacheprovider
+
+# Retry-storm goodput soak: the seeded overload schedule (client
+# timeout < loaded server latency, multiplicative retries) through the
+# baseline/naive/defended arms over the real wire — defended holds ≥
+# 80% of no-storm first-attempt goodput while naive collapses < 50%,
+# retries/scavenger/doomed work shed before any viable interactive
+# first attempt, the over-budget tail routes to the overflow pool, and
+# the stores' own records audit to zero over-admission
+# (docs/OPERATIONS.md §20). `make storm-soak SEED=...` replays any
+# grant/shed/route schedule bit-for-bit — the chaos-test determinism
+# contract.
+storm-soak:
+	JAX_PLATFORMS=cpu DRL_STORM_SEED=$(SEED) $(PY) -m pytest \
+	  tests/test_storm.py -v -p no:cacheprovider
 
 # Conservation audit soak: the seeded audit.leak injection (a deny
 # flipped into a granted reply with NO store debit) must breach the

@@ -406,6 +406,27 @@ def _debt_federation_device(smoke: bool) -> dict:
     return asyncio.run(drive())
 
 
+def _debt_storm_goodput_device(smoke: bool) -> dict:
+    """The retry-storm goodput soak (ISSUE 20) — on this rung the CPU
+    stand-in IS the full differential (benchmarks/storm_goodput.py over
+    the in-process backing); what is owed is the device edition, where
+    the doomed-work gate's p99 comes from a real multi-ms device flush
+    and the per-row deny runs on the native bulk lane."""
+    import asyncio
+
+    from benchmarks import storm_goodput
+
+    out = asyncio.run(storm_goodput.run_soak(storm_goodput.DEFAULT_SEED))
+    return {"metric": "storm_goodput_ratio",
+            "value": out["defended_ratio"],
+            "naive_ratio": out["naive_ratio"],
+            "baseline_goodput": out["baseline"]["goodput"],
+            "defended_goodput": out["defended"]["goodput"],
+            "routed": out["defended"]["counts"]["routed"],
+            "retries_shed": out["defended"]["server"]["retries_shed"],
+            "unit": "defended/baseline first-attempt goodput"}
+
+
 #: Ordered debt list: name → (what is owed, runner). The NAME is the
 #: ledger identity — renaming one un-retires it, deliberately.
 DEBTS: "list[tuple[str, str, object]]" = [
@@ -453,6 +474,14 @@ DEBTS: "list[tuple[str, str, object]]" = [
      "each arm with the tier-0 ε-consumption counters (fe_t0_eps "
      "per-slice grants, overadmit/grant ratio)",
      _debt_native_fe_uring_sweep),
+    ("storm_goodput_device",
+     "the retry-storm goodput differential (ISSUE 20) has no device "
+     "number: the defended/naive/baseline arms run over the "
+     "in-process backing — the doomed-work gate pricing (p99 sensing "
+     "+ per-row deny on the native bulk lane) against a real "
+     "multi-ms device flush rests on the CPU stand-in "
+     "(benchmarks/storm_goodput.py)",
+     _debt_storm_goodput_device),
 ]
 
 

@@ -1,0 +1,308 @@
+"""Retry-storm goodput soak (docs/DESIGN.md §24, OPERATIONS.md §20).
+
+THE seeded overload differential for the goodput-under-overload plane:
+a deterministic discrete-event simulation of a retry storm — client
+timeout below server latency under load, multiplicative backoff — is
+driven over the REAL wire (OP_RESERVE / OP_SETTLE through an
+``AdmissionPolicy`` edge gateway and a ``RemoteBucketStore`` client
+against a ``BucketStoreServer``), three arms from one schedule:
+
+- **baseline** — the primary population alone, defenses off: the
+  no-storm goodput reference.
+- **naive** — primaries plus an exogenous stormer population whose
+  client timeout sits below any loaded service latency, defenses off:
+  every stormer retry executes, the load model pushes latency past the
+  primaries' timeout, the primaries start retrying too, and goodput
+  collapses (the classic metastable retry storm).
+- **defended** — same offered traffic, defenses armed: the server's
+  retry-shed gate denies attempt-stamped work before the store, the
+  doomed-work gate denies deadlines the pinned p99 cannot meet, the
+  edge sheds scavenger, and budget-aware route-to-pool redirects the
+  over-budget interactive tail into the overflow pool.
+
+Determinism: every admission decision depends only on the seeded
+schedule, the stores' ManualClock bucket state (fill ≈ 0 → zero
+refill), and the harness's latency MODEL (the server's serving
+histogram is swapped for one whose p99 the model pins — this process's
+wall clock never reaches a gate). Same seed ⇒ bit-for-bit identical
+grant/shed/route schedule.
+
+The latency model is the standard load-linear queue stand-in:
+``latency = BASE + PER_REQ × (executed requests in the last WINDOW)``.
+Admit-gate sheds (edge or server) are answered fast and add NO load —
+that asymmetry is the entire mechanism the defense exploits. Settles
+ride the streaming lane and are not charged to the serving window.
+
+``make storm-soak SEED=…`` replays any schedule (DRL_STORM_SEED).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from collections import deque
+
+from distributedratelimiting.redis_tpu.runtime.admission import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_SCAVENGER,
+    AdmissionPolicy,
+    TenantBudget,
+)
+from distributedratelimiting.redis_tpu.runtime.clock import ManualClock
+from distributedratelimiting.redis_tpu.runtime.remote import (
+    RemoteBucketStore,
+)
+from distributedratelimiting.redis_tpu.runtime.server import (
+    BucketStoreServer,
+)
+from distributedratelimiting.redis_tpu.runtime.store import (
+    InProcessBucketStore,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+from distributedratelimiting.redis_tpu.utils.metrics import (
+    LatencyHistogram,
+)
+
+__all__ = ["run_soak", "run_arm", "DEFAULT_SEED"]
+
+DEFAULT_SEED = 20260807
+
+# -- populations --------------------------------------------------------------
+N_PRIMARY = 120          # primaries: the goodput we defend
+N_STORMERS = 60          # exogenous stormers: timeout < any loaded latency
+PRIMARY_TIMEOUT_S = 0.05
+STORMER_TIMEOUT_S = 0.01
+DEADLINE_S = 0.2
+#: Every ``DOOMED_EVERY``-th interactive primary rid carries a deadline
+#: no loaded latency can meet — the doomed-work gate's cohort. Scoring
+#: excludes them from the goodput denominator (no arm can serve them);
+#: what differs across arms is whether tokens are BURNED on them.
+DOOMED_EVERY = 16
+DOOMED_DEADLINE_S = 0.010
+
+# -- budgets (fill ≈ 0: bucket state is pure seeded consumption) -------------
+_FILL = 1e-9
+_CHILD_CAP, _CHILD_RATE = 1e6, 1e-9
+TENANT_A_CAP = 200.0     # fits its whole primary demand
+TENANT_B_CAP = 70.0      # oversubscribed: the route-to-pool tail
+STORM_CAP = 100.0        # stormer first attempts all fit
+OVERFLOW_POOL = {"pool": "pool:overflow", "ta": 200.0, "tb": _FILL,
+                 "priority": PRIORITY_BATCH}
+
+# -- load-linear latency model ------------------------------------------------
+BASE_LAT_S = 0.012
+PER_REQ_S = 0.0006
+WINDOW_S = 0.25
+#: Admit-gate sheds answer in this long — fast enough for every client
+#: limit in the schedule, so a shed/deny at admit is always TERMINAL.
+ADMIT_LAT_S = 0.002
+
+
+class _PinnedLatency(LatencyHistogram):
+    """Serving histogram whose p99 the harness's latency model sets —
+    the doomed gate must sense the MODEL, not this process's wall
+    clock, for bit-for-bit replay."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.pinned_p99 = 0.0
+
+    @property
+    def p99(self) -> float:  # type: ignore[override]
+        return self.pinned_p99
+
+
+def _schedule(seed: int, *, storm: bool):
+    """The arm's event list: primaries (always) + stormers (storm arms),
+    merged in time order, with the doomed cohort's deadlines rewritten.
+    One schedule per (seed, storm) — both storm arms replay the SAME
+    offered traffic."""
+    events = list(faults.storm_schedule(
+        seed, n_requests=N_PRIMARY, tenants=("tenant:a", "tenant:b"),
+        priorities=(PRIORITY_INTERACTIVE, PRIORITY_INTERACTIVE,
+                    PRIORITY_BATCH, PRIORITY_SCAVENGER),
+        client_timeout_s=PRIMARY_TIMEOUT_S, deadline_s=DEADLINE_S))
+    doomed = {f"storm-{seed}-{i}" for i in range(0, N_PRIMARY,
+                                                 DOOMED_EVERY)}
+    events = [dataclasses.replace(e, deadline_s=min(
+        e.deadline_s, DOOMED_DEADLINE_S)) if e.rid in doomed else e
+        for e in events]
+    if storm:
+        events += faults.storm_schedule(
+            seed + 1, n_requests=N_STORMERS, tenants=("tenant:storm",),
+            priorities=(PRIORITY_INTERACTIVE,),
+            client_timeout_s=STORMER_TIMEOUT_S, deadline_s=DEADLINE_S)
+        events.sort(key=lambda e: (e.t_s, e.rid, e.attempt))
+    return events, doomed
+
+
+async def run_arm(seed: int, *, storm: bool, defended: bool) -> dict:
+    """One arm of the soak; returns its outcome schedule + audit."""
+    events, doomed = _schedule(seed, storm=storm)
+    clock = ManualClock()
+    backing = InProcessBucketStore(clock=clock)
+    srv = BucketStoreServer(
+        backing, overflow_pool=OVERFLOW_POOL if defended else None)
+    lat_model = _PinnedLatency()
+    srv.serving_latency = lat_model
+    if defended:
+        srv.set_retry_shed(True)
+        srv.set_doomed_gate(True)
+    await srv.start()
+    client = RemoteBucketStore(address=(srv.host, srv.port),
+                               coalesce_requests=False,
+                               resilience_seed=seed)
+    gw = AdmissionPolicy(
+        client, key_config=(_CHILD_CAP, _CHILD_RATE),
+        tenants={
+            "tenant:a": TenantBudget("tenant:a", TENANT_A_CAP, _FILL),
+            "tenant:b": TenantBudget("tenant:b", TENANT_B_CAP, _FILL),
+            "tenant:storm": TenantBudget("tenant:storm", STORM_CAP,
+                                         _FILL),
+        })
+    if defended:
+        gw.set_shed_level(PRIORITY_SCAVENGER)
+
+    status: dict[str, tuple[str, int]] = {}   # rid -> (state, attempt)
+    settled_charges: dict[str, float] = {}    # budget name -> tokens
+    executed: deque = deque()                 # executed-event times
+    outcomes: list[tuple[str, int, str, int]] = []
+    counts = {"granted": 0, "routed": 0, "denied": 0, "duplicate": 0,
+              "edge_shed": 0, "retry_shed": 0, "doomed": 0,
+              "skipped": 0, "won": 0}
+    try:
+        for e in events:
+            if status.get(e.rid, ("pending", -1))[0] != "pending":
+                counts["skipped"] += 1
+                continue  # the client already heard an answer
+            clock.set_ticks(int(e.t_s * 1024))
+            while executed and executed[0] <= e.t_s - WINDOW_S:
+                executed.popleft()
+            sim_lat = BASE_LAT_S + PER_REQ_S * len(executed)
+            shed0, rshed0 = gw.shed, srv.retries_shed
+            doomed0 = srv.requests_doomed
+            res = await gw.reserve(
+                e.tenant, f"{e.tenant}/k{e.cost}", estimate=float(e.cost),
+                priority=e.priority, rid=e.rid, ttl_s=3600.0,
+                attempt=e.attempt, deadline_s=e.deadline_s)
+            if gw.shed > shed0 and srv.retries_shed == rshed0 \
+                    and srv.requests_doomed == doomed0:
+                outcome, lat, is_exec = "edge_shed", ADMIT_LAT_S, False
+            elif srv.retries_shed > rshed0:
+                outcome, lat, is_exec = "retry_shed", ADMIT_LAT_S, False
+            elif srv.requests_doomed > doomed0:
+                outcome, lat, is_exec = "doomed", ADMIT_LAT_S, False
+            elif res.routed:
+                outcome, lat, is_exec = "routed", sim_lat, True
+            elif res.duplicate:
+                outcome, lat, is_exec = "duplicate", sim_lat, True
+            elif res.granted:
+                outcome, lat, is_exec = "granted", sim_lat, True
+            else:
+                outcome, lat, is_exec = "denied", sim_lat, True
+            counts[outcome] += 1
+            outcomes.append((e.rid, e.attempt, outcome, len(executed)))
+            if is_exec:
+                executed.append(e.t_s)
+                lat_model.pinned_p99 = sim_lat
+            timeout = (STORMER_TIMEOUT_S if e.tenant == "tenant:storm"
+                       else PRIMARY_TIMEOUT_S)
+            limit = min(timeout, e.deadline_s)
+            if lat > limit:
+                continue  # too slow: the client never heard this answer
+            if res.granted:
+                status[e.rid] = ("won", e.attempt)
+                counts["won"] += 1
+                settle_tenant = res.pool if res.pool else e.tenant
+                await gw.settle(e.rid, settle_tenant, float(e.cost),
+                                priority=e.priority)
+                settled_charges[settle_tenant] = (
+                    settled_charges.get(settle_tenant, 0.0)
+                    + float(e.cost))
+            else:
+                status[e.rid] = ("gave_up", e.attempt)
+
+        # Scoring: interactive primary rids outside the doomed cohort,
+        # won on their FIRST attempt (acceptance: "first-attempt grants
+        # settled before deadline").
+        scored = {e.rid for e in events
+                  if e.attempt == 0 and e.tenant != "tenant:storm"
+                  and e.priority == PRIORITY_INTERACTIVE
+                  and e.rid not in doomed}
+        goodput = sum(1 for rid in scored
+                      if status.get(rid, ("", -1)) == ("won", 0))
+
+        # Differential audit over the store's OWN bucket records
+        # (fill ≈ 0 under ManualClock → zero refill; exact):
+        #   cap − balance == outstanding + settled − debt, per budget.
+        # Settles ran at actual == estimate, so each settle leaves its
+        # full charge in the bucket (zero refund) — the harness's
+        # settled_charges tally IS the settled term.
+        led = srv.reservations
+        audit = {}
+        for name, cap in (("tenant:a", TENANT_A_CAP),
+                          ("tenant:b", TENANT_B_CAP),
+                          ("tenant:storm", STORM_CAP),
+                          (str(OVERFLOW_POOL["pool"]),
+                           float(OVERFLOW_POOL["ta"]))):
+            entry = backing._buckets.get((name, cap, _FILL))
+            balance = entry[0] if entry is not None else cap
+            charged = cap - balance
+            held = led.outstanding_by_tenant().get(name, 0.0)
+            settled = settled_charges.get(name, 0.0)
+            debt = led.debts().get(name, 0.0)
+            audit[name] = {"charged": round(charged, 6),
+                           "held": round(held, 6),
+                           "settled": round(settled, 6),
+                           "debt": round(debt, 6),
+                           "over_admitted": round(
+                               charged - held - settled + debt, 6)}
+        return {
+            "goodput": goodput,
+            "scored": len(scored),
+            "counts": counts,
+            "outcomes": outcomes,
+            "audit": audit,
+            "server": {"retries_shed": srv.retries_shed,
+                       "requests_doomed": srv.requests_doomed,
+                       "reserves_routed": srv.reserves_routed,
+                       "retry_attempts_seen": srv.retry_attempts_seen},
+        }
+    finally:
+        await client.aclose()
+        await srv.aclose()
+
+
+async def run_soak(seed: int = DEFAULT_SEED) -> dict:
+    """All three arms from one seed; the summary the soak test pins."""
+    baseline = await run_arm(seed, storm=False, defended=False)
+    naive = await run_arm(seed, storm=True, defended=False)
+    defended = await run_arm(seed, storm=True, defended=True)
+    base = max(1, baseline["goodput"])
+    return {
+        "seed": seed,
+        "baseline": baseline,
+        "naive": naive,
+        "defended": defended,
+        "naive_ratio": round(naive["goodput"] / base, 4),
+        "defended_ratio": round(defended["goodput"] / base, 4),
+    }
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    args = ap.parse_args()
+    out = asyncio.run(run_soak(args.seed))
+    for arm in ("baseline", "naive", "defended"):
+        out[arm] = {k: v for k, v in out[arm].items()
+                    if k != "outcomes"}
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -297,6 +297,18 @@ class AdmissionPolicy:
         self.reserved_tokens = 0.0
         self.settles = 0
         self.settled_tokens = 0.0
+        # Retry-aware admission (docs/DESIGN.md §24): first-attempt vs
+        # retry traffic per tenant, and the retry-shed switch — the
+        # controller's storm actuator. Under retry-shed, attempts >= 1
+        # are denied locally BEFORE the store (retries shed before any
+        # priority class: a retry burns budget a first attempt could
+        # have used for useful work).
+        self.retry_shed = False
+        self.first_attempts = 0
+        self.retry_attempts = 0
+        self.retries_shed = 0
+        self._first_by_tenant: dict[str, int] = {}
+        self._retry_by_tenant: dict[str, int] = {}
 
     # -- tenant budget management (live-mutable) -----------------------------
     def set_tenant(self, budget: TenantBudget) -> None:
@@ -321,15 +333,40 @@ class AdmissionPolicy:
     def set_shed_level(self, level: "int | None") -> None:
         self.shed_level = level
 
+    def set_retry_shed(self, enabled: bool) -> None:
+        """Arm/disarm the retry-shed rung: while armed, calls stamped
+        ``attempt >= 1`` are denied locally without touching the store
+        — the controller's storm defense actuator (it fires BEFORE the
+        priority ladder; docs/DESIGN.md §24)."""
+        self.retry_shed = bool(enabled)
+
+    def _note_attempt(self, tenant: str, attempt: int) -> None:
+        if attempt:
+            self.retry_attempts += 1
+            self._retry_by_tenant[tenant] = \
+                self._retry_by_tenant.get(tenant, 0) + 1
+        else:
+            self.first_attempts += 1
+            self._first_by_tenant[tenant] = \
+                self._first_by_tenant.get(tenant, 0) + 1
+
     # -- admission -----------------------------------------------------------
     async def acquire(self, tenant: str, key: str, cost: int = 1,
-                      priority: int = PRIORITY_INTERACTIVE):
-        """One weighted-cost hierarchical admission decision."""
+                      priority: int = PRIORITY_INTERACTIVE,
+                      attempt: int = 0):
+        """One weighted-cost hierarchical admission decision.
+        ``attempt`` fingerprints retries (0 = first attempt): tracked
+        per tenant, and denied locally while retry-shed is armed."""
         from distributedratelimiting.redis_tpu.runtime.store import (
             AcquireResult,
         )
 
         self.decisions += 1
+        self._note_attempt(tenant, attempt)
+        if self.retry_shed and attempt:
+            self.retries_shed += 1
+            self.shed += 1
+            return AcquireResult(False, 0.0)
         if self.shed_level is not None and priority >= self.shed_level:
             self.shed += 1
             return AcquireResult(False, 0.0)
@@ -345,12 +382,18 @@ class AdmissionPolicy:
         return res
 
     def acquire_blocking(self, tenant: str, key: str, cost: int = 1,
-                         priority: int = PRIORITY_INTERACTIVE):
+                         priority: int = PRIORITY_INTERACTIVE,
+                         attempt: int = 0):
         from distributedratelimiting.redis_tpu.runtime.store import (
             AcquireResult,
         )
 
         self.decisions += 1
+        self._note_attempt(tenant, attempt)
+        if self.retry_shed and attempt:
+            self.retries_shed += 1
+            self.shed += 1
+            return AcquireResult(False, 0.0)
         if self.shed_level is not None and priority >= self.shed_level:
             self.shed += 1
             return AcquireResult(False, 0.0)
@@ -378,7 +421,9 @@ class AdmissionPolicy:
                       estimate: "float | None" = None,
                       priority: int = PRIORITY_INTERACTIVE,
                       rid: "str | None" = None,
-                      ttl_s: "float | None" = None):
+                      ttl_s: "float | None" = None,
+                      attempt: int = 0,
+                      deadline_s: "float | None" = None):
         """Phase 1 of a streaming request: admit an ESTIMATED cost and
         hold it against the tenant → key budgets. With no ``estimate``
         the gateway's own prior supplies one (interactive → p99,
@@ -391,6 +436,11 @@ class AdmissionPolicy:
         )
 
         self.decisions += 1
+        self._note_attempt(tenant, attempt)
+        if self.retry_shed and attempt:
+            self.retries_shed += 1
+            self.shed += 1
+            return ReserveResult(False, 0.0, 0.0, 0.0)
         if self.shed_level is not None and priority >= self.shed_level:
             self.shed += 1
             return ReserveResult(False, 0.0, 0.0, 0.0)
@@ -402,7 +452,7 @@ class AdmissionPolicy:
             rid if rid is not None else self.next_rid(tenant),
             tenant, key, estimate, budget.capacity,
             budget.fill_rate_per_sec, cap, rate, priority=priority,
-            ttl_s=ttl_s)
+            ttl_s=ttl_s, attempt=attempt, deadline_s=deadline_s)
         if res.granted:
             self.granted += 1
             self.reserves += 1
@@ -442,6 +492,16 @@ class AdmissionPolicy:
             "settles": self.settles,
             "settled_tokens": self.settled_tokens,
             "shed_level": self.shed_level,
+            "retry_shed": self.retry_shed,
+            "first_attempts": self.first_attempts,
+            "retry_attempts": self.retry_attempts,
+            "retries_shed": self.retries_shed,
+            "first_attempts_by_tenant": {
+                t: self._first_by_tenant[t]
+                for t in sorted(self._first_by_tenant)},
+            "retry_attempts_by_tenant": {
+                t: self._retry_by_tenant[t]
+                for t in sorted(self._retry_by_tenant)},
             "tenants": {t: list(b.config())
                         for t, b in sorted(self._tenants.items())},
             "token_velocity": self.velocity.snapshot(),

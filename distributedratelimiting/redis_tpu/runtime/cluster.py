@@ -1398,7 +1398,9 @@ class ClusterBucketStore(BucketStore):
                       tenant_fill_rate_per_sec: float,
                       capacity: float, fill_rate_per_sec: float, *,
                       priority: int = 0,
-                      ttl_s: "float | None" = None):
+                      ttl_s: "float | None" = None,
+                      attempt: int = 0,
+                      deadline_s: "float | None" = None):
         """Routed by TENANT like every hierarchical lane (the ledger
         entry must live with the tenant's owner so its settle finds
         it). The degraded fallback admits the estimate through the
@@ -1426,7 +1428,8 @@ class ClusterBucketStore(BucketStore):
             lambda j: self.nodes[j].reserve(
                 rid, tenant, key, estimate, tenant_capacity,
                 tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
-                priority=priority, ttl_s=ttl_s),
+                priority=priority, ttl_s=ttl_s, attempt=attempt,
+                deadline_s=deadline_s),
             fallback)
 
     async def settle(self, rid: str, tenant: str, actual: float):

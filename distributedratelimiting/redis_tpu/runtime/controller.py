@@ -97,6 +97,13 @@ SENSOR_SERIES = (
     # violations observed by the audit plane (runtime/audit.py)
     "drl_slo_alerts",             # server.py — burn-rate watchdog
     # trip/clear transitions (utils/slo.py)
+    "drl_retry_attempts_seen",    # server.py — attempt-tail-stamped
+    # admissions (the retry-storm numerator; docs/DESIGN.md §24)
+    "drl_goodput_settled_in_deadline",  # server.py — settles inside
+    # the propagated deadline (the goodput numerator)
+    "drl_goodput_deadline_expired_grants",  # server.py — grants whose
+    # deadline passed before settle: admitted-but-doomed work, the
+    # sensor that arms the doomed-work gate
 )
 
 
@@ -176,6 +183,23 @@ class ControllerConfig:
     federation_renew_ticks: int = 4
     federation_degraded_streak_ticks: int = 2
 
+    # -- retry-storm defense (goodput under overload) -----------------------
+    #: Retries' share of the fleet request rate at/above which the
+    #: retry-storm rung arms the retry-shed + doomed-work gates after
+    #: ``retry_storm_raise_ticks`` consecutive ticks. This rung sits
+    #: BEFORE the priority shed ladder: retries and doomed work shed
+    #: before any priority class browns out (docs/DESIGN.md §24).
+    retry_storm_high: float = 0.5
+    #: Share at/below which the gates release after
+    #: ``retry_storm_lower_ticks`` ticks. Must sit strictly below
+    #: ``retry_storm_high`` — the gap is the hysteresis band.
+    retry_storm_low: float = 0.1
+    retry_storm_raise_ticks: int = 2
+    retry_storm_lower_ticks: int = 3
+    #: Absolute retry-rate floor (attempts/sec): an idle fleet where
+    #: one of two requests is a retry must not arm the defense.
+    retry_storm_min_rate: float = 1.0
+
     # -- flap guards ---------------------------------------------------------
     #: Ticks after an actuator fires before the SAME actuator may fire
     #: again (per action kind).
@@ -198,11 +222,17 @@ class ControllerConfig:
         if not self.shed_low < self.shed_high:
             raise ValueError("shed_low must sit strictly below shed_high "
                              "(the gap is the hysteresis band)")
+        if not self.retry_storm_low < self.retry_storm_high:
+            raise ValueError("retry_storm_low must sit strictly below "
+                             "retry_storm_high (the gap is the "
+                             "hysteresis band)")
         for name in ("shed_raise_ticks", "shed_lower_ticks",
                      "split_streak_ticks", "rebalance_streak_ticks",
                      "drain_after_open_ticks", "budget_actions",
                      "budget_window_ticks", "federation_renew_ticks",
-                     "federation_degraded_streak_ticks"):
+                     "federation_degraded_streak_ticks",
+                     "retry_storm_raise_ticks",
+                     "retry_storm_lower_ticks"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
         if self.cooldown_ticks < 0:
@@ -249,6 +279,12 @@ class Sensors:
     #: bit): conservation breaches observed and watchdog alerts.
     audit_breaches: float = 0.0
     slo_alerts: float = 0.0
+    #: Goodput-plane sensors (rates via counter deltas; zero on fleets
+    #: with no attempt/deadline-stamped traffic, so pre-storm soak
+    #: schedules stay bit-for-bit): attempt-tail-stamped admissions/sec
+    #: and doomed-work/sec (deadline-expired grants + late settles).
+    retry_rate: float = 0.0
+    doomed_rate: float = 0.0
 
     @property
     def skew(self) -> float:
@@ -320,6 +356,10 @@ class Controller:
         #: targets only when live; the decided value itself evolves in
         #: dry-run too, so the decision stream stays comparable.
         self.shed_level: "int | None" = None
+        #: Retry-storm defense posture (decided here, pushed to shed
+        #: targets' set_retry_shed/set_doomed_gate when live; evolves
+        #: identically in dry-run — the parity contract).
+        self.retry_shed_on = False
         # Audit surface.
         self.actions: list[dict] = []
         self.actions_recorded = 0
@@ -336,6 +376,8 @@ class Controller:
         self.last_fed_outstanding = 0.0
         self.last_audit_breaches = 0.0
         self.last_slo_alerts = 0.0
+        self.last_retry_ratio = 0.0
+        self.last_doomed_rate = 0.0
         self._stop = asyncio.Event()
         # Announce on the audit surfaces that can splice us in
         # (cluster.stats() "controller" section, cluster_metrics()).
@@ -369,6 +411,7 @@ class Controller:
         outstanding = 0.0
         fed_outstanding = fed_degraded = 0.0
         audit_breaches = slo_alerts = 0.0
+        retry_rate = doomed_rate = 0.0
         for j, ns in enumerate(nodes):
             if not ns:
                 node_rates.append(0.0)
@@ -394,6 +437,20 @@ class Controller:
             au = ns.get("audit") or {}
             audit_breaches += float(au.get("breaches", 0.0))
             slo_alerts += float((au.get("slo") or {}).get("alerts", 0.0))
+            # Goodput plane (docs/DESIGN.md §24): both sections are
+            # emitted only once stamped traffic exists — absent means
+            # zeros, and the per-node delta windows simply don't
+            # advance. Deadline-expired grants + late settles sum into
+            # one monotonic doomed-work counter per node.
+            rt = ns.get("retry") or {}
+            retry_rate += self._deltas.rate(
+                f"node{j}/retry_attempts",
+                float(rt.get("attempts_seen", 0.0)), cfg.tick_s)
+            gp = ns.get("goodput") or {}
+            doomed_rate += self._deltas.rate(
+                f"node{j}/goodput_doomed",
+                float(gp.get("deadline_expired_grants", 0.0))
+                + float(gp.get("settled_late", 0.0)), cfg.tick_s)
             tv = ns.get("token_velocity") or {}
             for tenant, total in (tv.get("admitted") or {}).items():
                 tenant_rates[tenant] = tenant_rates.get(tenant, 0.0) \
@@ -434,6 +491,8 @@ class Controller:
             fed_degraded=fed_degraded,
             audit_breaches=audit_breaches,
             slo_alerts=slo_alerts,
+            retry_rate=retry_rate,
+            doomed_rate=doomed_rate,
         )
 
     # -- flap guards ---------------------------------------------------------
@@ -588,7 +647,38 @@ class Controller:
         else:
             self._streak("fed_degraded", False)
 
-        # 5. Shed ladder from token-velocity pressure PLUS outstanding-
+        # 5. Retry-storm defense — the rung BEFORE the priority shed
+        # ladder (docs/DESIGN.md §24): when retries become a sustained
+        # share of the fleet request rate, arm the retry-shed and
+        # doomed-work gates so duplicate and unmeetable work sheds
+        # before any priority class browns out. Hysteresis-guarded
+        # like every rung; the decided posture evolves in dry-run too.
+        request_rate = sum(sensors.node_rates)
+        ratio = (sensors.retry_rate / request_rate
+                 if request_rate > 0 else 0.0)
+        self.last_retry_ratio = ratio
+        self.last_doomed_rate = sensors.doomed_rate
+        hi_r = self._streak(
+            "retry_high",
+            ratio >= cfg.retry_storm_high
+            and sensors.retry_rate >= cfg.retry_storm_min_rate)
+        lo_r = self._streak("retry_low", ratio <= cfg.retry_storm_low)
+        if hi_r >= cfg.retry_storm_raise_ticks and not self.retry_shed_on:
+            if want("retry_shed_on", None,
+                    f"retries are {ratio:.0%} of the fleet request "
+                    f"rate ({sensors.retry_rate:.1f}/s; doomed work "
+                    f"{sensors.doomed_rate:.1f}/s)",
+                    ratio=round(ratio, 4)):
+                self.retry_shed_on = True
+            self._streaks["retry_high"] = 0
+        elif lo_r >= cfg.retry_storm_lower_ticks and self.retry_shed_on:
+            if want("retry_shed_off", None,
+                    f"retry share {ratio:.0%} ≤ {cfg.retry_storm_low}",
+                    ratio=round(ratio, 4)):
+                self.retry_shed_on = False
+            self._streaks["retry_low"] = 0
+
+        # 6. Shed ladder from token-velocity pressure PLUS outstanding-
         # reservation pressure: reserved-but-unsettled tokens are load
         # that WILL land, folded in as a prospective rate over the
         # reservation horizon — brownouts start before a wave of
@@ -677,6 +767,23 @@ class Controller:
                 for policy in self._shed_targets:
                     policy.set_shed_level(target)
                 return "executed"
+            if kind in ("retry_shed_on", "retry_shed_off"):
+                if not self._shed_targets:
+                    return "noop"  # same posture as the shed ladder
+                on = kind == "retry_shed_on"
+                hit = False
+                for policy in self._shed_targets:
+                    # Both gates arm together: duplicate work (retries)
+                    # and unmeetable work (doomed deadlines) shed as
+                    # one defense. getattr-probed — a bare
+                    # AdmissionPolicy target has the retry gate only,
+                    # a server target has both.
+                    for meth in ("set_retry_shed", "set_doomed_gate"):
+                        fn = getattr(policy, meth, None)
+                        if callable(fn):
+                            fn(on)
+                            hit = True
+                return "executed" if hit else "noop"
             return "noop"  # unknown intent kinds are inert, visibly
         except asyncio.CancelledError:
             raise
@@ -775,6 +882,9 @@ class Controller:
             "fed_outstanding_leases": self.last_fed_outstanding,
             "audit_breaches_seen": self.last_audit_breaches,
             "slo_alerts_seen": self.last_slo_alerts,
+            "retry_ratio": self.last_retry_ratio,
+            "doomed_rate": self.last_doomed_rate,
+            "retry_shed_on": int(self.retry_shed_on),
             "budget_remaining": self.budget_remaining(),
             "dry_run": int(self.config.dry_run),
             "auto_drained": len(self.auto_drained),

@@ -197,6 +197,9 @@ class RemoteBucketStore(BucketStore):
         # direction, logged once + counted).
         self._peer_reserve = True
         self._reserve_fallbacks = 0
+        # Route-to-pool redirects chased (budget-aware pool routing,
+        # docs/DESIGN.md §24) — one count per re-send, not per answer.
+        self._reserves_routed = 0
         # Federation-lane latch (OP_FED_LEASE/RENEW/RECLAIM): an old
         # home answers the routable unknown-op error — latch off once
         # per connection lifetime; the region then treats federation
@@ -227,6 +230,11 @@ class RemoteBucketStore(BucketStore):
         # "unknown op" answer from a pre-deadline peer.
         self._propagate_deadlines = propagate_deadlines
         self._peer_deadlines = True
+        # Attempt propagation (retry-storm defense, docs/DESIGN.md
+        # §24): re-sends carry a saturating attempt counter so an
+        # armed server sheds retries before first-attempt work. Same
+        # old-peer posture as the deadline tail, latched independently.
+        self._peer_attempts = True
         # Seedable rng (jitter): deterministic under the chaos harness.
         self._rng = random.Random(resilience_seed)
         # Resilience counters (resilience_stats()).
@@ -531,16 +539,30 @@ class RemoteBucketStore(BucketStore):
         policy = self._retry_policy
         attempt = 0
         latched_here = False
+        attempt_latched_here = False
         while True:
             sent = False
             ddl = (timeout if (self._propagate_deadlines
                                and self._peer_deadlines) else None)
+            # Attempt tail (retry-storm defense, docs/DESIGN.md §24):
+            # stamped only on re-sends, so first attempts stay
+            # byte-identical to pre-attempt frames.
+            atl = attempt if (attempt and self._peer_attempts) else 0
             try:
                 await self._connect_io()
                 sent = True  # past here the frame may be on the wire
                 return await self._send_once(op, key, count, a, b,
-                                             trace, ddl, timeout, hier)
+                                             trace, ddl, timeout, hier,
+                                             attempt=atl)
             except wire.RemoteStoreError as exc:
+                if atl and "unknown op" in str(exc):
+                    # Pre-attempt peer: it routed an error without
+                    # executing, so re-sending is NOT a replay. The
+                    # attempt tail is the newest (innermost) and sheds
+                    # first — independently of the deadline latch.
+                    self._peer_attempts = False
+                    attempt_latched_here = True
+                    continue
                 if ddl is not None and "unknown op" in str(exc):
                     # Pre-deadline peer: it routed an error without
                     # executing, so re-sending is NOT a replay. Latch
@@ -548,12 +570,16 @@ class RemoteBucketStore(BucketStore):
                     self._peer_deadlines = False
                     latched_here = True
                     continue
-                if latched_here and "unknown op" in str(exc):
+                if ((latched_here or attempt_latched_here)
+                        and "unknown op" in str(exc)):
                     # The BARE re-send was rejected too: the base op is
                     # what the peer doesn't speak (e.g. a newer op) —
-                    # the deadline tail was never the problem, so undo
-                    # the latch before surfacing the error.
-                    self._peer_deadlines = True
+                    # the tails were never the problem, so undo the
+                    # latches before surfacing the error.
+                    if latched_here:
+                        self._peer_deadlines = True
+                    if attempt_latched_here:
+                        self._peer_attempts = True
                 raise  # the server answered: definitive, never retried
             except (StoreTimeoutError, asyncio.CancelledError):
                 raise
@@ -568,7 +594,8 @@ class RemoteBucketStore(BucketStore):
     async def _send_once(self, op: int, key: str, count: int,
                          a: float, b: float, trace,
                          deadline_s: "float | None",
-                         timeout: float, hier=None) -> tuple:
+                         timeout: float, hier=None, *,
+                         attempt: int = 0) -> tuple:
         if self._writer is None or self._io_loop is None:
             raise ConnectionError("store client is closed")
         self._seq = (self._seq + 1) & 0xFFFFFFFF
@@ -582,7 +609,7 @@ class RemoteBucketStore(BucketStore):
                     wire.encode_request(seq, op, key, count, a, b,
                                         trace=trace,
                                         deadline_s=deadline_s,
-                                        hier=hier),
+                                        hier=hier, attempt=attempt),
                 )
                 # Drain only under real buffer pressure — a per-request
                 # drain await costs a task switch on a hot pipelined
@@ -625,6 +652,8 @@ class RemoteBucketStore(BucketStore):
         to at least the reconnect-backoff window's remainder (no point
         dialing before it opens). Counts the retry."""
         self._retries += 1
+        if faults._INJECTOR is not None:  # chaos seam; no-op in prod
+            await faults._INJECTOR.on_event("client.retry")
         delay = self._retry_policy.delay_s(attempt, self._rng)
         remaining = (self._backoff_until
                      - asyncio.get_running_loop().time())
@@ -1025,14 +1054,26 @@ class RemoteBucketStore(BucketStore):
                       capacity: float, fill_rate_per_sec: float, *,
                       priority: int = 0,
                       ttl_s: "float | None" = None,
-                      timeout_s: "float | None" = None):
+                      timeout_s: "float | None" = None,
+                      attempt: int = 0,
+                      deadline_s: "float | None" = None):
         """One OP_RESERVE frame: admission at the estimate + a TTL'd
         server-side hold (runtime/reservations.py). Both config levels
         translate through the learned live-config rules up front (the
         ``_chase_hier`` contract); post-send retries are safe — the
-        server dedups by ``rid``."""
+        server dedups by ``rid``.
+
+        ``attempt``/``deadline_s`` ride as JSON fields (not binary
+        tails — old servers ignore unknown keys, so no latch). A
+        "route-to-pool" answer (budget-aware pool routing, docs/
+        DESIGN.md §24) is chased ONCE, like config-moved: the re-send
+        carries the redirect's pool config and the result reports
+        ``routed=True``."""
         import json
 
+        from distributedratelimiting.redis_tpu.runtime import (
+            reservations,
+        )
         from distributedratelimiting.redis_tpu.runtime.reservations import (
             ReserveResult,
         )
@@ -1044,17 +1085,42 @@ class RemoteBucketStore(BucketStore):
                 tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
                 priority, timeout_s)
 
-        async def call(ta, tb, a, b):
-            payload: dict = {"rid": rid, "tenant": tenant, "key": key,
+        async def call(ta, tb, a, b, *, _tenant=tenant,
+                       _priority=int(priority), _route=True):
+            payload: dict = {"rid": rid, "tenant": _tenant, "key": key,
                              "a": a, "b": b, "ta": ta, "tb": tb,
-                             "priority": int(priority)}
+                             "priority": _priority}
             if estimate is not None:
                 payload["estimate"] = float(estimate)
             if ttl_s is not None:
                 payload["ttl_s"] = float(ttl_s)
-            (text,) = await self._request(
-                wire.OP_RESERVE, json.dumps(payload),
-                timeout_s=timeout_s)
+            if attempt:
+                payload["attempt"] = int(attempt)
+            if deadline_s is not None:
+                payload["deadline_s"] = float(deadline_s)
+            try:
+                (text,) = await self._request(
+                    wire.OP_RESERVE, json.dumps(payload),
+                    timeout_s=timeout_s)
+            except wire.RemoteStoreError as exc:
+                route = (reservations.parse_route(str(exc))
+                         if _route else None)
+                if route is None:
+                    raise
+                # Chase the redirect once (the config-moved posture):
+                # re-send against the overflow/batch pool the server
+                # named — the POOL is the tenant-bucket key, so the
+                # hold lands in the pool's own budget, not the
+                # exhausted interactive one. A second redirect
+                # surfaces as the error — no routing loops.
+                self._reserves_routed += 1
+                pool_name = str(route["pool"])
+                routed = await call(
+                    float(route["ta"]), float(route["tb"]), a, b,
+                    _tenant=pool_name,
+                    _priority=int(route.get("priority", _priority)),
+                    _route=False)
+                return routed._replace(routed=True, pool=pool_name)
             d = json.loads(text)
             return ReserveResult(bool(d.get("granted")),
                                  float(d.get("reserved", 0.0)),
@@ -1563,6 +1629,7 @@ class RemoteBucketStore(BucketStore):
             "backing_off": backing_off,
             "hier_fallbacks": self._hier_fallbacks,
             "reserve_fallbacks": self._reserve_fallbacks,
+            "reserves_routed": self._reserves_routed,
         }
 
     async def save(self) -> None:

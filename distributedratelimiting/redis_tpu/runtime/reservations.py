@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import asyncio
 import heapq
+import json
 import math
 import time
 from collections import OrderedDict
@@ -72,6 +73,7 @@ from distributedratelimiting.redis_tpu.utils.metrics import (
 __all__ = [
     "ReserveResult", "SettleResult", "EstimatePrior",
     "ReservationLedger", "DEFAULT_TTL_S", "fallback_charge",
+    "ROUTE_PREFIX", "route_message", "parse_route",
 ]
 
 #: Default reservation TTL: generous for an LLM stream (minutes-long
@@ -84,6 +86,47 @@ DEFAULT_TTL_S = 30.0
 #: modest: the first settle seeds the prior, so the blind window is one
 #: request per (tenant, priority).
 DEFAULT_ESTIMATE = 64.0
+
+
+# -- route-to-pool redirect (budget-aware pool routing, DESIGN.md §24) -------
+
+#: Marker prefix of the OP_RESERVE "route-to-pool" redirect reply — a
+#: routable RESP_ERROR whose message carries the overflow pool's config
+#: as JSON. The MOVED posture: an error to peers that do not speak it
+#: (they surface it and fall back), a chase-once redirect to peers that
+#: do (remote.reserve re-sends ONCE against the named pool and marks
+#: the result ``routed=True``).
+ROUTE_PREFIX = "route-to-pool "
+
+
+def route_message(pool: str, ta: float, tb: float,
+                  priority: int) -> str:
+    """Encode the redirect reply body: the overflow pool's name, its
+    tenant-level config ``(ta, tb)`` and the priority class the routed
+    request is demoted to (batch — it left the interactive pool)."""
+    return ROUTE_PREFIX + json.dumps(
+        {"pool": pool, "ta": float(ta), "tb": float(tb),
+         "priority": int(priority)},
+        ensure_ascii=True, sort_keys=True)
+
+
+def parse_route(message: str) -> "dict | None":
+    """Parse a redirect out of a relayed error message, or ``None``
+    when the error is not a route-to-pool reply (the client treats it
+    as the plain error it is). Tolerant of relay prefixes — the marker
+    is searched, not anchored — but strict about the JSON body: a
+    mangled redirect is a plain error, never a half-parsed route."""
+    idx = message.find(ROUTE_PREFIX)
+    if idx < 0:
+        return None
+    try:
+        obj = json.loads(message[idx + len(ROUTE_PREFIX):])
+    except ValueError:
+        return None
+    if not isinstance(obj, dict) or "pool" not in obj \
+            or "ta" not in obj or "tb" not in obj:
+        return None
+    return obj
 
 
 def fallback_charge(estimate: "float | None") -> int:
@@ -111,6 +154,14 @@ class ReserveResult(NamedTuple):
     duplicate: bool = False
     #: True when an old peer forced the flat acquire-at-estimate path.
     fallback: bool = False
+    #: True when the grant came from a route-to-pool redirect chase
+    #: (the request was admitted in the OVERFLOW pool, not the one the
+    #: caller named — docs/DESIGN.md §24).
+    routed: bool = False
+    #: The pool (tenant-bucket key) a routed grant landed in — the
+    #: settle must target this name, not the original tenant (the
+    #: ledger hold lives under the pool's budget).
+    pool: "str | None" = None
 
 
 class SettleResult(NamedTuple):
@@ -187,12 +238,14 @@ class EstimatePrior:
 
 class _Reservation:
     __slots__ = ("rid", "tenant", "key", "reserved", "a", "b", "ta",
-                 "tb", "priority", "expires_at", "remaining")
+                 "tb", "priority", "expires_at", "remaining",
+                 "deadline_at")
 
     def __init__(self, rid: str, tenant: str, key: str, reserved: float,
                  a: float, b: float, ta: float, tb: float,
                  priority: int, expires_at: float,
-                 remaining: float) -> None:
+                 remaining: float,
+                 deadline_at: "float | None" = None) -> None:
         self.rid = rid
         self.tenant = tenant
         self.key = key
@@ -204,6 +257,10 @@ class _Reservation:
         self.priority = priority
         self.expires_at = expires_at
         self.remaining = remaining
+        #: Ledger-clock instant the CLIENT's propagated budget runs out
+        #: (None when the reserve carried no deadline). Settles after it
+        #: are useless work — the goodput sensor's raw signal.
+        self.deadline_at = deadline_at
 
 
 class ReservationLedger:
@@ -296,6 +353,25 @@ class ReservationLedger:
         #: not credit back — under-admission, counted so the identity
         #: still closes.
         self.forfeited_tokens = 0.0
+        # Goodput plane (docs/DESIGN.md §24): first-attempt vs retry
+        # admission, and how grants relate to their clients' propagated
+        # deadlines — the controller's goodput sensor reads these.
+        #: Grants whose reserve carried attempt == 0 (or no counter).
+        self.first_attempt_grants = 0
+        #: Grants whose reserve carried attempt >= 1 — tokens handed to
+        #: retry traffic, the storm's amplification signal.
+        self.retry_grants = 0
+        #: Reserve calls (granted or not) stamped attempt >= 1.
+        self.retry_reserves = 0
+        #: Settles that landed AT OR BEFORE the recorded deadline —
+        #: useful work, the goodput numerator's ledger half.
+        self.settled_in_deadline = 0
+        #: Settles that landed AFTER the recorded deadline: the client
+        #: was already gone — granted-but-useless work.
+        self.settled_late = 0
+        #: TTL-expired entries whose deadline had passed: grants that
+        #: burned their hold with no settle inside the client's budget.
+        self.deadline_expired_grants = 0
         #: Settle-error magnitudes, log-1.25 bucketed. The histogram
         #: class buckets from 1e-6, so values record at ``tokens × 1e-6``
         #: — quantiles read back ×1e6 (refund_p99_tokens et al).
@@ -364,6 +440,8 @@ class ReservationLedger:
                                   self._debts.get(entry.tenant, 0.0))
             self._record_settled(rid, result)
             self.ttl_expired += 1
+            if entry.deadline_at is not None and now > entry.deadline_at:
+                self.deadline_expired_grants += 1
             self.settles += 1
             self.settled_tokens_total += entry.reserved
             if self.velocity is not None and entry.reserved > 0:
@@ -401,12 +479,18 @@ class ReservationLedger:
                       tenant_fill_rate_per_sec: float,
                       capacity: float, fill_rate_per_sec: float, *,
                       priority: int = 0,
-                      ttl_s: "float | None" = None) -> ReserveResult:
+                      ttl_s: "float | None" = None,
+                      attempt: int = 0,
+                      deadline_s: "float | None" = None) -> ReserveResult:
         """One admission-at-estimate decision + ledger hold (module
         docstring). Outstanding tenant debt is paid down FIRST through
         the saturating ``debit_many``; debt the budget cannot cover yet
         denies the reserve (the tenant is over budget — the same answer
-        its empty bucket would give, reported honestly as debt)."""
+        its empty bucket would give, reported honestly as debt).
+        ``attempt`` fingerprints retries (0 = first attempt — the
+        retry-stable rid plus the wire attempt tail); ``deadline_s`` is
+        the client's remaining budget, recorded so the settle can be
+        judged useful-or-late (the goodput sensor's input)."""
         if not rid:
             raise ValueError("reservation id must be non-empty")
         async with self._lock:
@@ -416,6 +500,8 @@ class ReservationLedger:
             if dup is not None:
                 return dup
             self.reserves += 1
+            if attempt:
+                self.retry_reserves += 1
             debt = self._debts.get(tenant, 0.0)
             ta, tb = self._cfg(tenant_capacity, tenant_fill_rate_per_sec)
             a, b = self._cfg(capacity, fill_rate_per_sec)
@@ -452,8 +538,14 @@ class ReservationLedger:
             ttl = self.default_ttl_s if ttl_s is None else float(ttl_s)
             self._add_entry(_Reservation(
                 rid, tenant, key, float(charge), a, b, ta, tb,
-                int(priority), now + ttl, res.remaining))
+                int(priority), now + ttl, res.remaining,
+                (now + float(deadline_s)) if deadline_s is not None
+                and deadline_s > 0 else None))
             self.reserved_tokens_total += charge
+            if attempt:
+                self.retry_grants += 1
+            else:
+                self.first_attempt_grants += 1
             return ReserveResult(True, float(charge), res.remaining,
                                  debt)
 
@@ -519,6 +611,11 @@ class ReservationLedger:
                 return SettleResult("unknown", 0.0, 0.0,
                                     self._debts.get(tenant, 0.0))
             self._drop_entry(entry)
+            if entry.deadline_at is not None:
+                if now > entry.deadline_at:
+                    self.settled_late += 1
+                else:
+                    self.settled_in_deadline += 1
             result = await self._settle_entry(entry, float(actual))
             self._record_settled(rid, result)
             return result
@@ -746,6 +843,12 @@ class ReservationLedger:
             "outstanding": float(len(self._entries)),
             "outstanding_tokens": self.outstanding_tokens(),
             "debt_tokens": sum(self._debts.values()),
+            "first_attempt_grants": self.first_attempt_grants,
+            "retry_grants": self.retry_grants,
+            "retry_reserves": self.retry_reserves,
+            "settled_in_deadline": self.settled_in_deadline,
+            "settled_late": self.settled_late,
+            "deadline_expired_grants": self.deadline_expired_grants,
         }
 
     def stats(self) -> dict:

@@ -113,7 +113,8 @@ class BucketStoreServer:
                  flight_capacity: int = 512,
                  tracing_config: "bool | dict | None" = None,
                  audit: "bool | AuditConfig | None" = None,
-                 snapshot_incremental: bool = False) -> None:
+                 snapshot_incremental: bool = False,
+                 overflow_pool: "dict | None" = None) -> None:
         self.store = store
         self.host = host
         self.port = port
@@ -193,6 +194,32 @@ class BucketStoreServer:
         # in this server's own queueing — answering them would serve the
         # dead while live requests wait behind them.
         self.requests_shed = 0
+        # Goodput-under-overload plane (docs/DESIGN.md §24). The two
+        # gates are DISARMED by default — the controller's storm rung
+        # (or an operator) arms them; a healthy fleet's serving path is
+        # byte-identical to the ungated one.
+        #: Requests denied by the doomed-work gate: their propagated
+        #: deadline cannot be met given current p99 serving latency —
+        #: granting them would burn tokens on work the client will
+        #: never collect.
+        self.requests_doomed = 0
+        #: Frames that arrived stamped attempt >= 1 (wire ATTEMPT_FLAG
+        #: tail / bulk deadline tail) — the storm's raw size signal.
+        self.retry_attempts_seen = 0
+        #: Retry-stamped frames denied while retry-shed was armed.
+        self.retries_shed = 0
+        #: OP_RESERVE requests answered with a route-to-pool redirect.
+        self.reserves_routed = 0
+        #: Armed: deny attempt >= 1 frames before the store is touched.
+        self.retry_shed_enabled = False
+        #: Armed: deny deadline-stamped work that current p99 says
+        #: cannot finish inside its budget.
+        self.doomed_gate_enabled = False
+        #: Overflow pool config for budget-aware routing: a dict
+        #: ``{"pool", "ta", "tb", "priority"}`` naming the batch/
+        #: overflow pool OP_RESERVE redirects doomed-at-admit
+        #: interactive requests to (None disables routing).
+        self.overflow_pool = dict(overflow_pool) if overflow_pool else None
         # Server-side serving latency: request decoded (arrival) →
         # result ready (before the reply hits the socket). This is the
         # latency the FRAMEWORK is accountable for — client-observed
@@ -297,6 +324,20 @@ class BucketStoreServer:
             self, audit if isinstance(audit, AuditConfig) else None)
             if audit else None)
         self._audit_task: "asyncio.Task | None" = None
+
+    def set_retry_shed(self, enabled: bool) -> None:
+        """Arm/disarm the server-side retry-shed gate (the controller's
+        storm rung actuates this on every retry-shed target it holds —
+        the same name :meth:`AdmissionPolicy.set_retry_shed` answers on
+        the gateway side)."""
+        self.retry_shed_enabled = bool(enabled)
+
+    def set_doomed_gate(self, enabled: bool) -> None:
+        """Arm/disarm the doomed-work gate: deadline-stamped requests
+        whose budget cannot be met given current p99 serving latency
+        are denied at admit instead of granted tokens they will burn
+        uselessly (docs/DESIGN.md §24)."""
+        self.doomed_gate_enabled = bool(enabled)
 
     async def start(self) -> tuple[str, int]:
         """Bind and listen; returns the bound ``(host, port)`` (port 0 in
@@ -473,6 +514,38 @@ class BucketStoreServer:
             self._registry = self._build_registry()
         return self._registry
 
+    def _goodput_numeric_stats(self) -> "dict[str, float]":
+        """drl_goodput_* family: deadline outcomes from the reservation
+        ledger plus the server-side doomed/route gate work. Always
+        renders (zeros before any deadline-stamped traffic) so the
+        controller's goodput sensor has a stable scrape target."""
+        led = self.reservations
+        return {
+            "settled_in_deadline": (led.settled_in_deadline
+                                    if led is not None else 0),
+            "settled_late": led.settled_late if led is not None else 0,
+            "deadline_expired_grants": (led.deadline_expired_grants
+                                        if led is not None else 0),
+            "first_attempt_grants": (led.first_attempt_grants
+                                     if led is not None else 0),
+            "requests_doomed": self.requests_doomed,
+            "reserves_routed": self.reserves_routed,
+            "doomed_gate_enabled": 1.0 if self.doomed_gate_enabled
+            else 0.0,
+        }
+
+    def _retry_numeric_stats(self) -> "dict[str, float]":
+        """drl_retry_* family: attempt-tail observations and the
+        retry-shed gate (scalar + reserve lanes)."""
+        led = self.reservations
+        return {
+            "attempts_seen": self.retry_attempts_seen,
+            "shed": self.retries_shed,
+            "grants": led.retry_grants if led is not None else 0,
+            "reserves": led.retry_reserves if led is not None else 0,
+            "shed_enabled": 1.0 if self.retry_shed_enabled else 0.0,
+        }
+
     def _build_registry(self) -> MetricsRegistry:
         reg = MetricsRegistry()
         reg.counter("connections_served", "Accepted TCP connections",
@@ -623,6 +696,25 @@ class BucketStoreServer:
                           "Under-estimate overage magnitudes "
                           "(bucket unit: tokens x 1e-6)",
                           lambda: led.debt_hist)
+        # Goodput-under-overload plane (docs/DESIGN.md §24). Two
+        # families: drl_goodput_* folds the reservation ledger's
+        # deadline outcomes with the server's doomed/route gates into
+        # the controller's goodput sensor; drl_retry_* carries the
+        # retry-storm posture (attempt-tail observations plus the
+        # retry-shed gate's work). Both render even with the gates
+        # disarmed so operators can watch a storm build before arming.
+        reg.register_numeric_dict(
+            "goodput", "goodput sensor (deadline-outcome ledger + "
+            "doomed-work and pool-routing gates)",
+            lambda: self._goodput_numeric_stats(),
+            counters={"settled_in_deadline", "settled_late",
+                      "deadline_expired_grants", "first_attempt_grants",
+                      "requests_doomed", "reserves_routed"})
+        reg.register_numeric_dict(
+            "retry", "retry-storm defense (attempt-tail admissions "
+            "and the retry-shed gate)",
+            lambda: self._retry_numeric_stats(),
+            counters={"attempts_seen", "shed", "grants", "reserves"})
         # Global quota federation (runtime/federation.py). Read
         # dynamically: the home ledger materializes on the first
         # OP_FED_* frame and the region agent is attached by an
@@ -899,6 +991,17 @@ class BucketStoreServer:
         """
         tctx = None
         deadline_s = None
+        attempt = 0
+        flagged_op = None
+        if (len(body) >= 6 and body[5] & (wire.TRACE_FLAG
+                                          | wire.DEADLINE_FLAG
+                                          | wire.ATTEMPT_FLAG)):
+            # Remember the raw flagged byte: if the residual frame fails
+            # strict decode after the strips below, it was never a
+            # flagged <op> — answer the routable "unknown op" an old
+            # server gives the byte as sent, not a misparse of whatever
+            # real op the masked bits happen to spell.
+            flagged_op = body[5]
         if len(body) >= 6:
             if body[5] & wire.TRACE_FLAG:
                 try:
@@ -912,8 +1015,24 @@ class BucketStoreServer:
                 except wire.RemoteStoreError as exc:
                     return wire.encode_response(
                         _recover_seq(body), wire.RESP_ERROR, repr(exc))
-            elif body[5] == wire.OP_ACQUIRE_MANY:
+            if body[5] & wire.ATTEMPT_FLAG:
+                try:
+                    body, attempt = wire.strip_attempt(body)
+                except wire.RemoteStoreError as exc:
+                    return wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR, repr(exc))
+            if body[5] == wire.OP_ACQUIRE_MANY:
                 tctx = wire.bulk_trace_tail(body)
+                # The bulk lane's deadline + attempt ride one payload
+                # tail (flags bit 5) — honored through the SAME gates
+                # below, frame-level like the config/placement gates
+                # (no row is applied on a shed; the reply is the same
+                # routable error the scalar lane answers).
+                btail = wire.bulk_deadline_tail(body)
+                if btail is not None:
+                    deadline_s, attempt = btail
+        if attempt:
+            self.retry_attempts_seen += 1
         if deadline_s is not None and arrival_s is not None:
             waited = time.perf_counter() - arrival_s
             if waited > deadline_s:
@@ -923,12 +1042,40 @@ class BucketStoreServer:
                     f"deadline exceeded: request waited "
                     f"{waited * 1e3:.1f}ms against a "
                     f"{deadline_s * 1e3:.1f}ms budget (shed unexecuted)")
+            if self.doomed_gate_enabled:
+                # Doomed-work gate (armed with the storm defense): the
+                # remaining budget cannot cover this server's current
+                # p99 serving latency — deny at admit, store untouched,
+                # instead of granting tokens the client will never
+                # collect (docs/DESIGN.md §24).
+                p99 = (self.serving_latency.p99
+                       if self.serving_latency.total else 0.0)
+                if waited + p99 > deadline_s:
+                    self.requests_doomed += 1
+                    self.requests_shed += 1
+                    return wire.encode_response(
+                        _recover_seq(body), wire.RESP_ERROR,
+                        f"doomed: {deadline_s * 1e3:.1f}ms budget "
+                        f"cannot cover p99 {p99 * 1e3:.1f}ms at admit "
+                        "(shed unexecuted)")
+        if attempt and self.retry_shed_enabled:
+            # Retry-shed gate: retries shed FIRST, before any priority
+            # class — a granted retry burns budget a first attempt
+            # could have turned into goodput (docs/DESIGN.md §24).
+            self.retries_shed += 1
+            self.requests_shed += 1
+            return wire.encode_response(
+                _recover_seq(body), wire.RESP_ERROR,
+                f"retry shed: attempt {attempt} denied while the "
+                "retry-storm defense is armed")
         if tctx is None or not self.tracer.enabled:
-            return await self._handle_frame_inner(body)
+            return await self._handle_frame_inner(body,
+                                                  flagged_op=flagged_op)
         op = body[5] if len(body) >= 6 else 0
         with self.tracer.start_span(
                 f"server.{wire.op_name(op)}", parent=tctx) as span:
-            resp = await self._handle_frame_inner(body)
+            resp = await self._handle_frame_inner(body,
+                                                  flagged_op=flagged_op)
             kind = resp[9] if len(resp) >= 10 else 0
             if kind == wire.RESP_ERROR:
                 span.set_status("error")
@@ -957,7 +1104,8 @@ class BucketStoreServer:
                     span.context.trace_id)
         return resp
 
-    async def _handle_frame_inner(self, body: bytes) -> bytes:
+    async def _handle_frame_inner(self, body: bytes, *,
+                                  flagged_op: "int | None" = None) -> bytes:
         seq = _recover_seq(body)
         try:
             if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_MANY:
@@ -1025,7 +1173,19 @@ class BucketStoreServer:
                                                  res.remaining)
             if len(body) >= 6 and body[5] == wire.OP_ACQUIRE_H:
                 return await self._serve_hierarchical(body)
-            seq, op, key, count, a, b = wire.decode_request(body)
+            try:
+                seq, op, key, count, a, b = wire.decode_request(body)
+            except wire.RemoteStoreError:
+                raise  # already routable ("unknown op N", truncated, ...)
+            except Exception as exc:
+                if flagged_op is not None:
+                    # The tails were stripped off a flagged op byte whose
+                    # masked bits spell a real op, but the residual
+                    # payload is not that op's shape — the frame was
+                    # never a flagged <op>. Reject the byte as sent.
+                    raise wire.RemoteStoreError(
+                        f"unknown op {flagged_op}") from exc
+                raise
             if self.liveconfig.active and op in _CONFIG_GATED_OPS:
                 fwd = self.liveconfig.forward(_CONFIG_GATED_OPS[op], a, b)
                 if fwd is not None:
@@ -1441,9 +1601,18 @@ class BucketStoreServer:
         ta, tb = float(req.get("ta", 0.0)), float(req.get("tb", 0.0))
         priority = int(req.get("priority", 0))
         ttl_s = req.get("ttl_s")
+        attempt = int(req.get("attempt", 0) or 0)
+        try:
+            deadline_s = (float(req["deadline_s"])
+                          if req.get("deadline_s") is not None else None)
+        except (TypeError, ValueError):
+            deadline_s = None
         gate_resp = self._hier_config_gate(seq, a, b, ta, tb)
         if gate_resp is not None:
             return gate_resp
+        from distributedratelimiting.redis_tpu.runtime import (
+            reservations,
+        )
         from distributedratelimiting.redis_tpu.runtime.reservations import (
             fallback_charge,
         )
@@ -1488,11 +1657,68 @@ class BucketStoreServer:
             return wire.encode_response(
                 seq, wire.RESP_ERROR,
                 "this server has no reservation ledger")
+        if attempt:
+            self.retry_attempts_seen += 1
+            if self.retry_shed_enabled:
+                # The reservation lane's retry-shed answer is a plain
+                # deny (granted False) — a deny is terminal to the
+                # client, exactly the posture a storm needs; a routable
+                # error would invite another retry.
+                self.retries_shed += 1
+                self.requests_shed += 1
+                return wire.encode_response(
+                    seq, wire.RESP_TEXT, json.dumps(
+                        {"granted": False, "reserved": 0.0,
+                         "remaining": 0.0, "debt": 0.0,
+                         "duplicate": False, "shed": "retry"}))
+        if self.doomed_gate_enabled and deadline_s is not None:
+            p99 = (self.serving_latency.p99
+                   if self.serving_latency.total else 0.0)
+            if p99 > deadline_s:
+                self.requests_doomed += 1
+                self.requests_shed += 1
+                return wire.encode_response(
+                    seq, wire.RESP_TEXT, json.dumps(
+                        {"granted": False, "reserved": 0.0,
+                         "remaining": 0.0, "debt": 0.0,
+                         "duplicate": False, "shed": "doomed"}))
+        pool = self.overflow_pool
+        if (pool is not None and deadline_s is not None
+                and priority == admission.PRIORITY_INTERACTIVE):
+            # Budget-aware pool routing (docs/DESIGN.md §24): when the
+            # estimate will not fit the interactive pool's remaining
+            # tenant budget inside the client's deadline, answer the
+            # routable route-to-pool redirect (the config-moved
+            # posture: chase-once client, never a silent grant the
+            # budget cannot honor in time).
+            peek = getattr(self.store, "peek_blocking", None)
+            balance = None
+            if callable(peek):
+                try:
+                    balance = peek(tenant, ta, tb)
+                except Exception:  # drl-check: ok(swallowed-exception)
+                    # — a backing without a sync peek lane (e.g. a
+                    # remote/device store behind this node) degrades to
+                    # routing-off, the pre-§24 behavior; the reserve
+                    # itself still runs and is the visible outcome.
+                    balance = None
+            if (balance is not None
+                    and charge > balance + tb * max(0.0, deadline_s)):
+                self.reserves_routed += 1
+                return wire.encode_response(
+                    seq, wire.RESP_ERROR,
+                    reservations.route_message(
+                        str(pool.get("pool", "overflow")),
+                        float(pool.get("ta", ta)),
+                        float(pool.get("tb", tb)),
+                        int(pool.get("priority",
+                                     admission.PRIORITY_BATCH))))
         hh = self.heavy_hitters
         if hh is not None and charge > 1:
             hh.offer(key, charge)
         res = await led.reserve(rid, tenant, key, estimate, ta, tb,
-                                a, b, priority=priority, ttl_s=ttl_s)
+                                a, b, priority=priority, ttl_s=ttl_s,
+                                attempt=attempt, deadline_s=deadline_s)
         return wire.encode_response(seq, wire.RESP_TEXT, json.dumps(
             {"granted": res.granted, "reserved": res.reserved,
              "remaining": res.remaining, "debt": res.debt,
@@ -1980,6 +2206,17 @@ class BucketStoreServer:
             # stats() piggybacks one TTL-expiry pass — a scraped-but-
             # idle server still auto-settles dead clients' holds.
             payload["reservations"] = self.reservations.stats()
+        # Goodput-under-overload plane (docs/DESIGN.md §24). Emitted
+        # once any deadline/attempt-stamped traffic or gate has left a
+        # mark (or a gate is armed) so the pinned idle OP_STATS shape
+        # is untouched; the controller scrape treats a missing section
+        # as all-zeros.
+        goodput = self._goodput_numeric_stats()
+        retry = self._retry_numeric_stats()
+        if any(goodput.values()) or self.doomed_gate_enabled:
+            payload["goodput"] = goodput
+        if any(retry.values()) or self.retry_shed_enabled:
+            payload["retry"] = retry
         if self.federation is not None and self.federation.active:
             # stats() piggybacks one monotonic-expiry pass — a
             # scraped-but-idle home still expires unrenewed leases.

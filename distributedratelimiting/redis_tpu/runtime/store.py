@@ -430,16 +430,22 @@ class BucketStore(abc.ABC):
                       tenant_fill_rate_per_sec: float,
                       capacity: float, fill_rate_per_sec: float, *,
                       priority: int = 0,
-                      ttl_s: "float | None" = None):
+                      ttl_s: "float | None" = None,
+                      attempt: int = 0,
+                      deadline_s: "float | None" = None):
         """Admit an ESTIMATED cost against the tenant → key budgets and
         hold a TTL'd reservation (:mod:`~.reservations` — the streaming
         lane for costs unknown until generation ends). Default: the
         store-attached ledger; ``RemoteBucketStore`` overrides with one
-        ``OP_RESERVE`` frame so the ledger lives server-side."""
+        ``OP_RESERVE`` frame so the ledger lives server-side.
+        ``attempt``/``deadline_s`` feed the goodput plane — retry
+        fingerprinting and settle-vs-deadline accounting
+        (docs/DESIGN.md §24)."""
         return await self.reservation_ledger().reserve(
             rid, tenant, key, estimate, tenant_capacity,
             tenant_fill_rate_per_sec, capacity, fill_rate_per_sec,
-            priority=priority, ttl_s=ttl_s)
+            priority=priority, ttl_s=ttl_s, attempt=attempt,
+            deadline_s=deadline_s)
 
     async def settle(self, rid: str, tenant: str, actual: float):
         """Reconcile a reservation's actual cost: refund over-estimates

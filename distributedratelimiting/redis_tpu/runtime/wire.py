@@ -111,6 +111,23 @@ the client latches deadline stamping off for the connection (the trace
 latch's posture); the native C front-end routes flagged scalar ops to
 the Python passthrough lane, which speaks this dialect.
 
+Attempt tail (within v4, same posture — the retry-storm defense,
+docs/DESIGN.md §24): a RETRY of a scalar request may carry a 1-byte
+attempt counter — ``[u8 attempt]`` — appended after the payload
+(BEFORE the deadline tail, which rides before the trace tail) and
+signalled with :data:`ATTEMPT_FLAG` (op-byte bit 5). First attempts
+are never stamped, so the healthy path stays byte-identical to plain
+v4; the counter saturates at 255. A server under retry-shed denies
+flagged work with a routable error before the store is touched; an
+old server answers the flagged op with a routable "unknown op" error
+and the client latches attempt stamping off for the connection —
+independently of the deadline latch, each tail degrades alone. The
+bulk lane signals the SAME defense with ``BULK_FLAG_DEADLINE`` (flags
+bit 5): a 9-byte ``[f64 deadline_s][u8 attempt]`` tail after the
+tenant extension, before any trace tail — old bulk decoders read
+their arrays by explicit counts and never look at it, so no latch is
+needed on that lane.
+
 Tenant extension (within v4, OP_METRICS posture — the token-denominated
 admission plane, runtime/admission.py, DESIGN.md §15):
 
@@ -186,7 +203,10 @@ __all__ = [
     "TEXT_OPS",
     "TRACE_FLAG", "TRACE_TAIL_LEN", "BULK_FLAG_TRACED",
     "DEADLINE_FLAG", "DEADLINE_TAIL_LEN",
+    "ATTEMPT_FLAG", "ATTEMPT_TAIL_LEN", "BULK_FLAG_DEADLINE",
+    "BULK_DEADLINE_TAIL_LEN",
     "strip_trace", "bulk_trace_tail", "strip_deadline",
+    "strip_attempt", "bulk_deadline_tail",
     "STATS_FLAG_RESET", "STATS_FLAG_FLIGHT_DUMP",
     "RESP_DECISION", "RESP_VALUE", "RESP_PAIR", "RESP_EMPTY", "RESP_TEXT",
     "RESP_BULK", "RESP_ERROR",
@@ -338,6 +358,28 @@ DEADLINE_FLAG = 0x40
 _DEADLINE_TAIL = struct.Struct("<d")  # remaining budget, seconds
 DEADLINE_TAIL_LEN = _DEADLINE_TAIL.size
 
+#: Op-byte bit 5: a 1-byte attempt-counter tail (``_ATTEMPT_TAIL``)
+#: follows the payload (before the deadline tail — tail order on the
+#: wire is attempt, deadline, trace; servers strip trace → deadline →
+#: attempt). Stamped only on RETRIES (attempt ≥ 1, saturating at 255):
+#: first attempts stay byte-identical to plain v4, and an old server
+#: answers the flagged op with a routable "unknown op" error — the
+#: client latches attempt stamping off for the connection,
+#: independently of the deadline latch.
+ATTEMPT_FLAG = 0x20
+_ATTEMPT_TAIL = struct.Struct("<B")  # attempt number, saturating u8
+ATTEMPT_TAIL_LEN = _ATTEMPT_TAIL.size
+
+#: ACQUIRE_MANY flags bit 5: a 9-byte ``[f64 deadline_s][u8 attempt]``
+#: tail follows the payload (after any HBUCKET tenant extension, before
+#: any trace tail). The bulk edition of the deadline + attempt tails in
+#: one piece — old bulk decoders read their arrays by explicit counts
+#: and never look at it, so the bulk lane needs no client latch (the
+#: BULK_FLAG_TRACED posture).
+BULK_FLAG_DEADLINE = 0b100000
+_BULK_DEADLINE_TAIL = struct.Struct("<dB")  # deadline_s, attempt
+BULK_DEADLINE_TAIL_LEN = _BULK_DEADLINE_TAIL.size
+
 #: Tenant extension tail (after the ``[u16 tlen][tenant]`` id):
 #: parent-bucket config operands + the request's priority class.
 #: Rides OP_ACQUIRE_H (after the OP_ACQUIRE-shaped payload) and
@@ -457,8 +499,8 @@ def _codepoint_truncate(mb: bytes, limit: int) -> bytes:
 def encode_request(seq: int, op: int, key: str = "", count: int = 0,
                    a: float = 0.0, b: float = 0.0,
                    trace=None, deadline_s: "float | None" = None,
-                   hier: "tuple[str, float, float, int] | None" = None
-                   ) -> bytes:
+                   hier: "tuple[str, float, float, int] | None" = None,
+                   attempt: int = 0) -> bytes:
     if op == OP_ACQUIRE_H:
         # Hierarchical acquire: the OP_ACQUIRE payload followed by the
         # tenant extension [u16 tlen][tenant][_HIER_TAIL]. `hier` is
@@ -496,6 +538,13 @@ def encode_request(seq: int, op: int, key: str = "", count: int = 0,
         payload = b""
     else:
         raise ValueError(f"unknown op {op}")
+    if attempt:
+        # Tail order is fixed: attempt first, then deadline, trace last
+        # — the server strips trace (bit 7), then deadline (bit 6), then
+        # attempt (bit 5). First attempts (attempt == 0) never stamp, so
+        # the healthy path stays byte-identical to plain v4.
+        op |= ATTEMPT_FLAG
+        payload += _ATTEMPT_TAIL.pack(min(int(attempt), 0xFF))
     if deadline_s is not None:
         # Tail order is fixed: deadline first, trace last — the server
         # strips trace (bit 7), then deadline (bit 6). Frames without
@@ -543,6 +592,24 @@ def strip_deadline(body: bytes) -> "tuple[bytes, float | None]":
     plain = (body[:5] + bytes([body[5] & ~DEADLINE_FLAG])
              + body[_BODY_OFF:len(body) - DEADLINE_TAIL_LEN])
     return plain, deadline_s
+
+
+def strip_attempt(body: bytes) -> "tuple[bytes, int]":
+    """Split a scalar frame body's attempt tail: ``(plain_body,
+    attempt)`` — attempt 0 when the flag is clear (a first attempt, or
+    a peer not speaking the dialect). Call AFTER :func:`strip_deadline`
+    (the attempt tail is stamped first, so it sits innermost). Same
+    strictness posture as the other tails: an old server never reaches
+    here — the flagged op raises its routable "unknown op" error."""
+    if len(body) < _BODY_OFF or not body[5] & ATTEMPT_FLAG:
+        return body, 0
+    if len(body) < _BODY_OFF + ATTEMPT_TAIL_LEN:
+        raise RemoteStoreError("truncated attempt tail")
+    (attempt,) = _ATTEMPT_TAIL.unpack_from(body,
+                                           len(body) - ATTEMPT_TAIL_LEN)
+    plain = (body[:5] + bytes([body[5] & ~ATTEMPT_FLAG])
+             + body[_BODY_OFF:len(body) - ATTEMPT_TAIL_LEN])
+    return plain, attempt
 
 
 def decode_request(frame: bytes) -> tuple[int, int, str, int, float, float]:
@@ -727,7 +794,9 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
                         with_remaining: bool = True,
                         kind: int = BULK_KIND_BUCKET,
                         chained: bool = False,
-                        trace=None, hier=None) -> bytes:
+                        trace=None, hier=None,
+                        deadline_s: "float | None" = None,
+                        attempt: int = 0) -> bytes:
     """Encode one ACQUIRE_MANY frame from per-key byte blobs. A thin
     wrapper over :func:`encode_bulk_request_span` (ONE definition of the
     frame layout — the two entry points must stay wire-identical);
@@ -743,7 +812,7 @@ def encode_bulk_request(seq: int, key_blobs: "Sequence[bytes]",
         seq, b"".join(key_blobs), offsets, klens,
         np.asarray(counts, np.uint32), 0, n, capacity, fill_rate,
         with_remaining=with_remaining, kind=kind, chained=chained,
-        trace=trace, hier=hier)
+        trace=trace, hier=hier, deadline_s=deadline_s, attempt=attempt)
 
 
 def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
@@ -753,7 +822,9 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
                              with_remaining: bool = True,
                              kind: int = BULK_KIND_BUCKET,
                              chained: bool = False,
-                             trace=None, hier=None) -> bytes:
+                             trace=None, hier=None,
+                             deadline_s: "float | None" = None,
+                             attempt: int = 0) -> bytes:
     """Encode one ACQUIRE_MANY chunk by SLICING a whole-call key blob —
     the client-side half of the zero-copy lane. ``_bulk_prepare`` joins
     and encodes the call's keys once; each chunk's payload is then two
@@ -775,7 +846,8 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
     flags = ((_FLAG_WITH_REMAINING if with_remaining else 0)
              | (kind << _KIND_SHIFT)
              | (_FLAG_CHAINED if chained else 0)
-             | (BULK_FLAG_TRACED if trace is not None else 0))
+             | (BULK_FLAG_TRACED if trace is not None else 0)
+             | (BULK_FLAG_DEADLINE if deadline_s is not None else 0))
     parts = [
         _BULK_REQ_HEAD.pack(flags, capacity, fill_rate, n),
         kl.astype("<u2").tobytes(),
@@ -789,6 +861,12 @@ def encode_bulk_request_span(seq: int, blob: bytes, offsets: "np.ndarray",
         tenant, ta, tb, priority = hier
         parts.append(_keyed(tenant, _HIER_TAIL.pack(ta, tb,
                                                     priority & 0xFF)))
+    if deadline_s is not None:
+        # Deadline + attempt tail AFTER the tenant extension, BEFORE
+        # any trace tail (which always rides last). Old decoders read
+        # arrays by explicit counts and never reach it.
+        parts.append(_BULK_DEADLINE_TAIL.pack(deadline_s,
+                                              min(int(attempt), 0xFF)))
     if trace is not None:
         # The trace tail rides AFTER the arrays: an old decoder reads
         # them by explicit counts and never touches it.
@@ -865,6 +943,27 @@ def bulk_trace_tail(body: bytes) -> "TraceContext | None":
     hi, lo, span, flags = _TRACE_TAIL.unpack_from(body,
                                                   len(body) - TRACE_TAIL_LEN)
     return TraceContext(hi, lo, span, flags)
+
+
+def bulk_deadline_tail(body: bytes) -> "tuple[float, int] | None":
+    """Read an ACQUIRE_MANY frame body's deadline + attempt tail (flags
+    bit 5): ``(deadline_s, attempt)``, or ``None`` when absent. The
+    tail rides immediately BEFORE any trace tail, so it parses from the
+    end like :func:`bulk_trace_tail`; :func:`decode_bulk_request` reads
+    its arrays by explicit counts, so the same frame decodes
+    identically with the tail present — no old-peer latch on the bulk
+    lane, same as traced bulk frames."""
+    if (len(body) <= _BODY_OFF + BULK_DEADLINE_TAIL_LEN
+            or not body[_BODY_OFF] & BULK_FLAG_DEADLINE):
+        return None
+    end = len(body)
+    if body[_BODY_OFF] & BULK_FLAG_TRACED:
+        end -= TRACE_TAIL_LEN
+    if end - BULK_DEADLINE_TAIL_LEN < _BODY_OFF:
+        raise RemoteStoreError("truncated bulk deadline tail")
+    deadline_s, attempt = _BULK_DEADLINE_TAIL.unpack_from(
+        body, end - BULK_DEADLINE_TAIL_LEN)
+    return deadline_s, attempt
 
 
 def bulk_hier_tail(body: bytes) -> tuple[str, float, float, int]:

@@ -13,6 +13,12 @@ runtime consults when — and only when — an injector is installed:
   (per frame read / write).
 - ``server.dispatch`` — :meth:`BucketStoreServer._serve_request` before
   the frame is served.
+- ``client.retry`` — :meth:`RemoteBucketStore._retry_sleep` before the
+  client re-sends a timed-out/failed request (per retry occurrence,
+  never on first attempts). A DELAY rule here stretches the client's
+  backoff; a RESET/ERROR rule abandons the retry — the storm soak's
+  lever for shaping multiplicative retry traffic deterministically
+  (see :func:`storm_schedule` for the shared storm model).
 - ``t0.sync`` — one tier-0 reconciliation round in
   :meth:`NativeFrontend._t0_sync_loop` (a fault fails the round; rows
   carry, the degraded streak advances).
@@ -81,7 +87,7 @@ from typing import Mapping, Sequence
 __all__ = [
     "FaultRule", "FaultEvent", "FaultInjector", "FaultInjectedError",
     "BlackholeFault", "SkewedClock", "install", "uninstall",
-    "get_injector", "seam",
+    "get_injector", "seam", "storm_schedule", "StormEvent",
     "RESET", "DELAY", "PARTIAL_FRAME", "STALL", "BLACKHOLE", "ERROR",
     "CLOCK_SKEW",
 ]
@@ -400,6 +406,68 @@ async def seam(name: str) -> None:
     ``faults._INJECTOR is not None`` guard instead of paying a call."""
     if _INJECTOR is not None:
         await _INJECTOR.on_event(name)
+
+
+# -- the shared retry-storm model (docs/DESIGN.md §24) -----------------------
+
+@dataclass(frozen=True)
+class StormEvent:
+    """One client attempt in a seeded retry storm: the unit the storm
+    soak (tests/test_storm.py) and future chaos tests replay. ``rid``
+    is the retry-STABLE request identity (all attempts of one logical
+    request share it — the reservation-lane fingerprint);
+    ``deadline_s`` is the remaining client budget at send time, which
+    DECAYS across retries: the doomed-work gate's input."""
+
+    rid: str
+    tenant: str
+    priority: int
+    attempt: int       # 0 = first attempt, k = k-th retry
+    t_s: float         # send offset from storm start, seconds
+    deadline_s: float  # remaining end-to-end budget at send time
+    cost: int
+
+
+def storm_schedule(seed: int, *, n_requests: int = 200,
+                   tenants: "Sequence[str]" = ("tenant-a", "tenant-b"),
+                   priorities: "Sequence[int]" = (0, 0, 1, 2),
+                   client_timeout_s: float = 0.05,
+                   deadline_s: float = 0.2,
+                   max_retries: int = 3,
+                   backoff_mult: float = 2.0,
+                   arrival_span_s: float = 1.0,
+                   cost_range: "tuple[int, int]" = (1, 4),
+                   ) -> list[StormEvent]:
+    """The seeded timeout-then-retry schedule: ``n_requests`` logical
+    requests arrive uniformly over ``arrival_span_s``; each attempt
+    that the client gives up on (its ``client_timeout_s`` elapses,
+    multiplied by ``backoff_mult`` per retry) spawns the next attempt
+    under the SAME rid with the remaining deadline budget decayed by
+    the wait — the multiplicative-retry regime of "When Two is Worse
+    Than One". Attempts whose budget is already spent are never sent
+    (the client is dead by then). Pure function of ``seed`` + kwargs:
+    same seed ⇒ byte-for-byte the same event list, the chaos-test
+    determinism contract. Returned sorted by send time."""
+    rng = random.Random(f"{seed}/storm")
+    events: list[StormEvent] = []
+    for i in range(n_requests):
+        t0 = rng.random() * arrival_span_s
+        tenant = tenants[i % len(tenants)]
+        priority = priorities[i % len(priorities)]
+        cost = rng.randint(*cost_range)
+        rid = f"storm-{seed}-{i}"
+        t, timeout = t0, client_timeout_s
+        for attempt in range(max_retries + 1):
+            remaining = deadline_s - (t - t0)
+            if remaining <= 0.0:
+                break
+            events.append(StormEvent(rid, tenant, priority, attempt,
+                                     round(t, 9), round(remaining, 9),
+                                     cost))
+            t += timeout
+            timeout *= backoff_mult
+    events.sort(key=lambda e: (e.t_s, e.rid, e.attempt))
+    return events
 
 
 def _maybe_install_from_env() -> None:

@@ -59,6 +59,11 @@ SLO_SERIES = (
     # conservation ledger's realized over-admission (runtime/audit.py)
     "drl_epsilon_budget_used_ratio",  # server.py — per-source ε
     # utilization gauges the runbook's symptom table starts from
+    "drl_goodput_settled_in_deadline",  # server.py — deadline-true
+    # goodput (settles inside the client's propagated deadline): the
+    # refinement of the served-rate floor the overload runbook reads
+    # during a retry storm (docs/DESIGN.md §24, OPERATIONS.md §20) —
+    # served-rate can look healthy while every grant settles late
 )
 
 #: The watchdog's dimensions, in a fixed order (the alert log's

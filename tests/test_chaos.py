@@ -458,6 +458,132 @@ class TestDeadlinePropagation:
         run(main())
 
 
+class TestAttemptLatch:
+    """The attempt-counter tail's old-peer posture (ISSUE 20): its
+    latch is INDEPENDENT of the deadline latch — a peer that chokes on
+    one tail must not cost the client the other."""
+
+    @staticmethod
+    def _old_server(reject_flags: int):
+        """A fake old server rejecting any frame whose op byte carries
+        one of ``reject_flags`` with the routable "unknown op" error
+        (exactly what decode_request raises there), serving the rest."""
+        state = {"flagged": 0}
+
+        async def handler(reader, writer):
+            while True:
+                body = await wire.read_frame(reader)
+                if body is None:
+                    break
+                seq = int.from_bytes(body[1:5], "little")
+                if body[5] & reject_flags:
+                    state["flagged"] += 1
+                    resp = wire.encode_response(
+                        seq, wire.RESP_ERROR, f"unknown op {body[5]}")
+                else:
+                    resp = wire.encode_response(
+                        seq, wire.RESP_DECISION, True, 1.0)
+                writer.write(resp)
+                await writer.drain()
+            writer.close()
+
+        return handler, state
+
+    def test_attempt_rejecting_peer_keeps_deadline_stamping(self):
+        # One seeded connect reset forces a retry, so the re-send
+        # carries BOTH the attempt and deadline tails; the peer rejects
+        # only the attempt tail → that latch alone flips, and deadline
+        # stamping survives for the connection.
+        async def main():
+            handler, state = self._old_server(wire.ATTEMPT_FLAG)
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            faults.install(FaultInjector(3, {
+                "client.connect": (FaultRule("reset", probability=1.0,
+                                             max_faults=1),)}))
+            store = RemoteBucketStore(
+                address=("127.0.0.1", port), coalesce_requests=False,
+                propagate_deadlines=True,
+                retry_policy=RetryPolicy(max_attempts=4,
+                                         base_delay_s=0.005),
+                reconnect_backoff_base_s=0.005, resilience_seed=1)
+            try:
+                res = await store.acquire("k", 1, 5.0, 1.0)
+                assert res.granted  # attempt latched off, re-sent
+                assert store._peer_attempts is False
+                assert store._peer_deadlines is True  # independent
+                assert state["flagged"] == 1
+            finally:
+                await store.aclose()
+                server.close()
+                await server.wait_closed()
+
+        run(main())
+
+    def test_both_tail_rejections_peel_newest_first(self):
+        # A peer predating BOTH dialects: the attempt tail (newest,
+        # innermost) sheds first, then the deadline tail, and the bare
+        # third send is served — two rejected probes total.
+        async def main():
+            handler, state = self._old_server(
+                wire.ATTEMPT_FLAG | wire.DEADLINE_FLAG)
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            faults.install(FaultInjector(3, {
+                "client.connect": (FaultRule("reset", probability=1.0,
+                                             max_faults=1),)}))
+            store = RemoteBucketStore(
+                address=("127.0.0.1", port), coalesce_requests=False,
+                propagate_deadlines=True,
+                retry_policy=RetryPolicy(max_attempts=4,
+                                         base_delay_s=0.005),
+                reconnect_backoff_base_s=0.005, resilience_seed=1)
+            try:
+                res = await store.acquire("k", 1, 5.0, 1.0)
+                assert res.granted
+                assert store._peer_attempts is False
+                assert store._peer_deadlines is False
+                assert state["flagged"] == 2
+            finally:
+                await store.aclose()
+                server.close()
+                await server.wait_closed()
+
+        run(main())
+
+    def test_bare_rejection_undoes_both_latches(self):
+        # The peer rejects EVERYTHING: the base op is what it doesn't
+        # speak, the tails were never the problem — both latches must
+        # roll back before the error surfaces, so the next call still
+        # stamps.
+        async def main():
+            handler, state = self._old_server(0xFF)
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            faults.install(FaultInjector(3, {
+                "client.connect": (FaultRule("reset", probability=1.0,
+                                             max_faults=1),)}))
+            store = RemoteBucketStore(
+                address=("127.0.0.1", port), coalesce_requests=False,
+                propagate_deadlines=True,
+                retry_policy=RetryPolicy(max_attempts=4,
+                                         base_delay_s=0.005),
+                reconnect_backoff_base_s=0.005, resilience_seed=1)
+            try:
+                with pytest.raises(wire.RemoteStoreError,
+                                   match="unknown op"):
+                    await store.acquire("k", 1, 5.0, 1.0)
+                assert store._peer_attempts is True
+                assert store._peer_deadlines is True
+                assert state["flagged"] == 3  # stamped, ddl-only, bare
+            finally:
+                await store.aclose()
+                server.close()
+                await server.wait_closed()
+
+        run(main())
+
+
 # -- the at-most-once differential -------------------------------------------
 
 class TestAtMostOnceDifferential:

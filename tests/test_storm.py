@@ -1,0 +1,262 @@
+"""Goodput under overload (ISSUE 20): THE seeded retry-storm soak plus
+the controller's retry-storm rung unit surface.
+
+The soak (benchmarks/storm_goodput.py) replays one seeded storm
+schedule — client timeout below loaded server latency, multiplicative
+backoff — through three arms over the real wire. Acceptance, per
+docs/DESIGN.md §24:
+
+- defended goodput (interactive first-attempt grants settled before
+  deadline) ≥ 80% of the no-storm baseline; the naive arm < 50%;
+- retries and scavenger shed BEFORE any viable interactive first
+  attempt (the doomed cohort is unservable by construction and is
+  scored separately);
+- budget-aware route-to-pool redirects land over-budget interactive
+  work in the overflow pool — and only when the defense arms it;
+- same seed ⇒ bit-for-bit identical grant/shed/route schedule;
+- the differential audit over the stores' own bucket records shows
+  zero over-admission: cap − balance == held + settled − debt, exact.
+
+``make storm-soak SEED=…`` (DRL_STORM_SEED) replays any schedule.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import types
+
+import pytest
+
+from benchmarks import storm_goodput
+from distributedratelimiting.redis_tpu.runtime.admission import (
+    PRIORITY_INTERACTIVE,
+)
+from distributedratelimiting.redis_tpu.runtime.controller import (
+    Controller,
+    ControllerConfig,
+)
+from distributedratelimiting.redis_tpu.utils import faults
+
+SEED = int(os.environ.get("DRL_STORM_SEED", "20260807"))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def soak():
+    return run(storm_goodput.run_soak(SEED))
+
+
+# -- the storm schedule itself (utils/faults.py satellite) -------------------
+
+def test_storm_schedule_seeded_and_decaying():
+    """Same seed ⇒ identical event list; a rid's attempts decay its
+    remaining deadline monotonically and never exceed the retry cap."""
+    a = faults.storm_schedule(SEED)
+    b = faults.storm_schedule(SEED)
+    assert a == b
+    assert a != faults.storm_schedule(SEED + 1)
+    by_rid: dict[str, list] = {}
+    for e in a:
+        by_rid.setdefault(e.rid, []).append(e)
+    for events in by_rid.values():
+        events.sort(key=lambda e: e.attempt)
+        assert [e.attempt for e in events] == list(range(len(events)))
+        assert len(events) <= 4  # max_retries=3 → at most 4 attempts
+        deadlines = [e.deadline_s for e in events]
+        assert deadlines == sorted(deadlines, reverse=True)
+        assert all(d > 0.0 for d in deadlines)
+
+
+# -- THE soak ----------------------------------------------------------------
+
+def test_storm_defended_holds_goodput_naive_collapses(soak):
+    """The acceptance ratios: defense holds ≥ 80% of the no-storm
+    baseline while the undefended arm collapses below 50%."""
+    assert soak["baseline"]["goodput"] > 0
+    assert soak["defended_ratio"] >= 0.8, soak
+    assert soak["naive_ratio"] < 0.5, soak
+
+
+def test_storm_sheds_retries_and_scavenger_never_viable_interactive(soak):
+    """Shed ordering: the defended arm sheds retries (server gate),
+    scavenger (edge ladder), and doomed work — and not one VIABLE
+    interactive first attempt is denied or shed."""
+    d = soak["defended"]
+    assert d["counts"]["retry_shed"] > 0
+    assert d["counts"]["edge_shed"] > 0
+    assert d["counts"]["doomed"] > 0
+    assert d["server"]["retries_shed"] == d["counts"]["retry_shed"]
+    assert d["server"]["requests_doomed"] == d["counts"]["doomed"]
+    events, doomed = storm_goodput._schedule(SEED, storm=True)
+    scored = {e.rid for e in events
+              if e.attempt == 0 and e.tenant != "tenant:storm"
+              and e.priority == PRIORITY_INTERACTIVE
+              and e.rid not in doomed}
+    first_attempt_outcomes = {rid: outcome
+                              for rid, attempt, outcome, _ in d["outcomes"]
+                              if attempt == 0 and rid in scored}
+    assert set(first_attempt_outcomes) == scored
+    assert set(first_attempt_outcomes.values()) <= {"granted", "routed"}
+
+
+def test_storm_routes_over_budget_tail_to_pool(soak):
+    """Budget-aware routing: the oversubscribed tenant's interactive
+    tail lands in the overflow pool — only when the defense arms it —
+    and the pool's bucket shows the charge."""
+    assert soak["defended"]["counts"]["routed"] > 0
+    assert soak["defended"]["server"]["reserves_routed"] > 0
+    assert soak["defended"]["audit"]["pool:overflow"]["charged"] > 0
+    assert soak["naive"]["counts"]["routed"] == 0
+    assert soak["baseline"]["counts"]["routed"] == 0
+
+
+def test_storm_differential_audit_no_over_admission(soak):
+    """Every arm, every budget: the stores' own records balance —
+    cap − balance == held + settled − debt, to the epsilon envelope."""
+    for arm in ("baseline", "naive", "defended"):
+        for name, row in soak[arm]["audit"].items():
+            assert abs(row["over_admitted"]) <= 1e-3, (arm, name, row)
+            assert row["debt"] == pytest.approx(0.0, abs=1e-3)
+
+
+def test_storm_same_seed_bit_for_bit(soak):
+    """Same seed ⇒ the identical grant/shed/route schedule, down to
+    the per-event outcome and load observation."""
+    again = run(storm_goodput.run_arm(SEED, storm=True, defended=True))
+    assert again["outcomes"] == soak["defended"]["outcomes"]
+    assert again["counts"] == soak["defended"]["counts"]
+    assert again["audit"] == soak["defended"]["audit"]
+
+
+# -- the controller's retry-storm rung ---------------------------------------
+
+class _FakeCluster:
+    def __init__(self, feed):
+        self.feed = list(feed)
+        self.placement = types.SimpleNamespace(overrides={})
+        self.flight_recorder = None
+
+    async def stats(self):
+        if self.feed:
+            return self.feed.pop(0)
+        return {"nodes": [], "resilience": {}, "placement": {}}
+
+
+class _StormTarget:
+    """A shed target exposing both storm actuators — the probe order
+    (set_retry_shed, then set_doomed_gate) is part of the contract."""
+
+    def __init__(self):
+        self.calls: list = []
+
+    def set_shed_level(self, level):
+        self.calls.append(("level", level))
+
+    def set_retry_shed(self, on):
+        self.calls.append(("retry", bool(on)))
+
+    def set_doomed_gate(self, on):
+        self.calls.append(("doomed", bool(on)))
+
+
+def _storm_feed(storm_ticks, calm_ticks):
+    """Anchor + storm_ticks of 75% retry share + calm_ticks of zero
+    retries, over a 2-node fleet serving 200 req/s."""
+    feed = []
+    reqs, attempts = 100, 0.0
+    for i in range(1 + storm_ticks + calm_ticks):
+        feed.append({
+            "nodes": [
+                {"requests_served": reqs,
+                 "retry": {"attempts_seen": attempts}},
+                {"requests_served": reqs},
+            ],
+            "resilience": {},
+            "placement": {"slot_counts": [8, 8], "drained": []},
+        })
+        reqs += 100
+        if i < 1 + storm_ticks:
+            attempts += 150.0  # 150 of 200 req/s are retries: 75%
+    return feed
+
+
+def test_retry_storm_rung_arms_and_releases():
+    run(_retry_storm_rung_body())
+
+
+async def _retry_storm_rung_body():
+    target = _StormTarget()
+    ctrl = Controller(
+        _FakeCluster(_storm_feed(4, 5)),
+        config=ControllerConfig(tick_s=1.0, cooldown_ticks=1),
+        shed_targets=[target])
+    acts = []
+    for _ in range(10):
+        acts.extend(await ctrl.tick())
+    kinds = [a["action"] for a in acts]
+    assert "retry_shed_on" in kinds and "retry_shed_off" in kinds
+    assert kinds.index("retry_shed_on") < kinds.index("retry_shed_off")
+    storm_calls = [c for c in target.calls if c[0] in ("retry", "doomed")]
+    assert storm_calls == [("retry", True), ("doomed", True),
+                          ("retry", False), ("doomed", False)]
+    assert ctrl.retry_shed_on is False
+    assert ctrl.numeric_stats()["retry_shed_on"] == 0
+    assert "retry_ratio" in ctrl.numeric_stats()
+
+
+def test_retry_storm_rung_needs_absolute_rate_floor():
+    """An idle fleet where half the trickle is retries must NOT arm
+    the defense: the ratio trips but the absolute rate floor holds."""
+    run(_rate_floor_body())
+
+
+async def _rate_floor_body():
+    feed = []
+    reqs, attempts = 1, 0.0
+    for _ in range(7):
+        feed.append({
+            "nodes": [{"requests_served": reqs,
+                       "retry": {"attempts_seen": attempts}}],
+            "resilience": {},
+            "placement": {"slot_counts": [8], "drained": []},
+        })
+        reqs += 1
+        attempts += 0.5  # ratio 0.5 ≥ high, but 0.5/s < min_rate 1.0
+    ctrl = Controller(_FakeCluster(feed),
+                      config=ControllerConfig(tick_s=1.0))
+    for _ in range(7):
+        await ctrl.tick()
+    assert ctrl.retry_shed_on is False
+    assert [a for a in ctrl.actions
+            if a["action"].startswith("retry_shed")] == []
+
+
+def test_retry_storm_rung_dry_run_parity():
+    """Dry-run decides the identical retry rung stream and actuates
+    nothing — the §12 dry-run contract extends to the new rung."""
+    run(_dry_run_parity_body())
+
+
+async def _dry_run_parity_body():
+    live_t, dry_t = _StormTarget(), _StormTarget()
+    live = Controller(_FakeCluster(_storm_feed(4, 5)),
+                      config=ControllerConfig(tick_s=1.0,
+                                              cooldown_ticks=1),
+                      shed_targets=[live_t])
+    dry = Controller(_FakeCluster(_storm_feed(4, 5)),
+                     config=ControllerConfig(tick_s=1.0,
+                                             cooldown_ticks=1,
+                                             dry_run=True),
+                     shed_targets=[dry_t])
+    live_acts, dry_acts = [], []
+    for _ in range(10):
+        live_acts.extend(await live.tick())
+        dry_acts.extend(await dry.tick())
+    assert [a["action"] for a in live_acts] == \
+        [a["action"] for a in dry_acts]
+    assert [c for c in dry_t.calls if c[0] in ("retry", "doomed")] == []
+    assert dry.retry_shed_on == live.retry_shed_on

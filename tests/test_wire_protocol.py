@@ -247,3 +247,152 @@ def test_bulk_hier_tail_truncation_is_routable():
         hier=("tenant", 50.0, 1.0, 0))
     with pytest.raises(wire.RemoteStoreError, match="tenant extension"):
         wire.bulk_hier_tail(frame[4:-3])
+
+
+# -- attempt-counter tail (ISSUE 20: retry-storm fingerprinting) -------------
+
+class TestAttemptTailScalar:
+    def test_fuzz_strip_attempt_roundtrip(self):
+        """Fuzz: any keyed op, any attempt ≥ 1 — strip_attempt recovers
+        the (saturated) counter and yields a body byte-identical to the
+        frame a first-attempt client would have sent."""
+        rng = random.Random(0xA77)
+        ops = (wire.OP_ACQUIRE, wire.OP_WINDOW, wire.OP_FWINDOW,
+               wire.OP_SEMA, wire.OP_PEEK, wire.OP_SYNC)
+        for _ in range(200):
+            op = rng.choice(ops)
+            key = "k" * rng.randint(1, 40)
+            attempt = rng.randint(1, 1000)
+            stamped = wire.encode_request(3, op, key, 1, 10.0, 1.0,
+                                          attempt=attempt)
+            plain = wire.encode_request(3, op, key, 1, 10.0, 1.0)
+            body = stamped[4:]
+            assert body[5] & wire.ATTEMPT_FLAG
+            stripped, got = wire.strip_attempt(body)
+            assert got == min(attempt, 255)  # u8, saturating
+            assert stripped == plain[4:]
+
+    def test_first_attempt_never_stamps(self):
+        """attempt=0 emits a frame byte-identical to plain v4 — first
+        attempts never pay the tail and old peers never see it."""
+        plain = wire.encode_request(1, wire.OP_ACQUIRE, "k", 1, 2.0, 1.0)
+        explicit = wire.encode_request(1, wire.OP_ACQUIRE, "k", 1, 2.0,
+                                       1.0, attempt=0)
+        assert explicit == plain
+        assert not plain[4 + 5] & wire.ATTEMPT_FLAG
+        body, attempt = wire.strip_attempt(plain[4:])
+        assert attempt == 0 and body == plain[4:]
+
+    def test_truncated_attempt_tail_is_loud(self):
+        """A 1-byte tail is only detectably missing on a pathological
+        frame cut to the bare head — the flag byte survives but the
+        tail byte can't: that must raise, not misread the payload."""
+        frame = wire.encode_request(1, wire.OP_ACQUIRE, "k", 1, 2.0,
+                                    1.0, attempt=3)
+        head_only = frame[4:10]  # 6-byte head, ATTEMPT_FLAG still set
+        assert head_only[5] & wire.ATTEMPT_FLAG
+        with pytest.raises(wire.RemoteStoreError,
+                           match="truncated attempt tail"):
+            wire.strip_attempt(head_only)
+
+    def test_attempt_deadline_trace_compose_and_strip_order(self):
+        """All three tails on one frame. Wire order is attempt (inner),
+        deadline, trace (outer); the server strips trace → deadline →
+        attempt and the remainder is byte-identical to the plain frame
+        — each latch peels independently, docs/DESIGN.md §24."""
+        ctx = (5, 6, 7, 1)
+        frame = wire.encode_request(
+            9, wire.OP_ACQUIRE_H, "k", 5, 10.0, 1.0,
+            hier=("t", 30.0, 2.0, 2), deadline_s=0.25, trace=ctx,
+            attempt=2)
+        plain = wire.encode_request(
+            9, wire.OP_ACQUIRE_H, "k", 5, 10.0, 1.0,
+            hier=("t", 30.0, 2.0, 2))
+        body = frame[4:]
+        assert body[5] & wire.TRACE_FLAG
+        assert body[5] & wire.DEADLINE_FLAG
+        assert body[5] & wire.ATTEMPT_FLAG
+        body, tctx = wire.strip_trace(body)
+        body, ddl = wire.strip_deadline(body)
+        body, attempt = wire.strip_attempt(body)
+        assert tuple(tctx) == ctx and ddl == 0.25 and attempt == 2
+        assert body == plain[4:]
+
+    def test_attempt_and_deadline_latch_independently_on_the_wire(self):
+        """A frame stamped with only ONE of the two tails strips clean
+        — the byte-level ground truth under the client's independent
+        old-peer latches (tests/test_chaos.py drives the client side)."""
+        only_attempt = wire.encode_request(2, wire.OP_ACQUIRE, "k", 1,
+                                           2.0, 1.0, attempt=7)
+        body, attempt = wire.strip_attempt(only_attempt[4:])
+        assert attempt == 7
+        assert not body[5] & wire.DEADLINE_FLAG
+        only_deadline = wire.encode_request(2, wire.OP_ACQUIRE, "k", 1,
+                                            2.0, 1.0, deadline_s=0.5)
+        body, ddl = wire.strip_deadline(only_deadline[4:])
+        assert ddl == 0.5
+        assert not body[5] & wire.ATTEMPT_FLAG
+
+
+class TestBulkDeadlineTail:
+    def test_bulk_deadline_tail_roundtrip_old_decoder_unaffected(self):
+        """The bulk [f64 deadline][u8 attempt] tail parses from the
+        end; decode_bulk_request reads arrays by explicit counts and
+        decodes the SAME results with or without the tail — no old-peer
+        latch on the bulk lane (same posture as traced bulk frames)."""
+        keys = [b"a", b"bb", b"ccc"]
+        counts = [10, 0, 77]
+        plain = wire.encode_bulk_request(5, keys, counts, 100.0, 1.0)
+        stamped = wire.encode_bulk_request(5, keys, counts, 100.0, 1.0,
+                                           deadline_s=0.125, attempt=3)
+        assert wire.bulk_deadline_tail(plain[4:]) is None
+        assert wire.bulk_deadline_tail(stamped[4:]) == (0.125, 3)
+        p = wire.decode_bulk_request(plain[4:])
+        s = wire.decode_bulk_request(stamped[4:])
+        assert p[1] == s[1] and p[0] == s[0]
+        assert p[2].tolist() == s[2].tolist()
+        assert p[3:] == s[3:]
+
+    def test_bulk_deadline_composes_with_hier_and_trace(self):
+        """Full stack: tenant extension, deadline+attempt tail, trace
+        tail — each parser finds its own tail, none disturbs another,
+        across BOTH bulk entry points byte-identically (the asyncio and
+        native lanes share one frame-layout definition)."""
+        import numpy as np
+
+        keys = [b"a", b"bb"]
+        counts = np.array([1, 2], np.uint32)
+        trace = (21, 22, 23, 0)
+        frame = wire.encode_bulk_request(
+            7, keys, counts, 100.0, 1.0, kind=wire.BULK_KIND_HBUCKET,
+            hier=("tenant:x", 500.0, 9.0, 1), deadline_s=0.25,
+            attempt=1, trace=trace)
+        klens = np.fromiter((len(b) for b in keys), np.int64)
+        offsets = np.zeros(len(keys) + 1, np.int64)
+        np.cumsum(klens, out=offsets[1:])
+        span = wire.encode_bulk_request_span(
+            7, b"".join(keys), offsets, klens, counts, 0, len(keys),
+            100.0, 1.0, kind=wire.BULK_KIND_HBUCKET,
+            hier=("tenant:x", 500.0, 9.0, 1), deadline_s=0.25,
+            attempt=1, trace=trace)
+        assert span == frame
+        body = frame[4:]
+        assert wire.bulk_deadline_tail(body) == (0.25, 1)
+        assert wire.bulk_hier_tail(body) == ("tenant:x", 500.0, 9.0, 1)
+        assert tuple(wire.bulk_trace_tail(body)) == trace
+        seq, dec_keys, dec_counts, a, b, with_rem, kind = (
+            wire.decode_bulk_request(body))
+        assert (seq, dec_keys) == (7, ["a", "bb"])
+        assert dec_counts.tolist() == [1, 2]
+
+    def test_truncated_bulk_deadline_tail_is_loud(self):
+        """With BOTH the deadline and trace flags up, a frame cut so
+        the trace tail would overlap the head leaves no room for the
+        9-byte deadline tail — that must raise, not misread arrays."""
+        frame = wire.encode_bulk_request(5, [b"k"], [1], 10.0, 1.0,
+                                         deadline_s=0.5, attempt=2,
+                                         trace=(1, 2, 3, 0))
+        body = frame[4:4 + 30]  # head + flags intact, tails gone
+        with pytest.raises(wire.RemoteStoreError,
+                           match="truncated bulk deadline tail"):
+            wire.bulk_deadline_tail(body)
